@@ -1,0 +1,24 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the API surface
+of PaddlePaddle (reference: /root/reference, arogowie-intel/Paddle).
+
+Compute path: JAX/XLA (+ Pallas kernels); parallelism: jax.sharding.Mesh
+with pjit/shard_map; eager "dygraph" mode: tape over jax.vjp; compiled
+mode: paddle_tpu.jit traces whole train steps into single XLA modules.
+"""
+__version__ = '0.1.0'
+
+from .core import Tensor, no_grad, enable_grad, is_grad_enabled  # noqa: F401
+from .core.tensor import Parameter  # noqa: F401
+from .core.dtype import (  # noqa: F401
+    float16, bfloat16, float32, float64, int8, int16, int32, int64, uint8,
+    bool_, complex64, complex128, set_default_dtype, get_default_dtype)
+from .core.device import (  # noqa: F401
+    CPUPlace, CUDAPlace, TPUPlace, XPUPlace, set_device, get_device,
+    device_count, is_compiled_with_cuda, is_compiled_with_xpu)
+from .core.rng import seed  # noqa: F401
+
+from .tensor import *  # noqa: F401,F403
+from .tensor import __all__ as _tensor_all
+
+__all__ = ['Tensor', 'Parameter', 'no_grad', 'enable_grad', 'seed',
+           'set_device', 'get_device'] + list(_tensor_all)

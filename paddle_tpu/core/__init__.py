@@ -1,0 +1,7 @@
+from . import autograd, device, dispatch, dtype, rng
+from .tensor import Tensor, Parameter
+from .autograd import no_grad, enable_grad, is_grad_enabled
+
+__all__ = ['Tensor', 'Parameter', 'no_grad', 'enable_grad',
+           'is_grad_enabled', 'autograd', 'device', 'dispatch', 'dtype',
+           'rng']

@@ -1,0 +1,173 @@
+"""Dygraph autograd engine: a define-by-run tape over jax.vjp.
+
+Reference analogue: /root/reference/paddle/fluid/imperative/ (tracer.cc,
+basic_engine.cc, gradient_accumulator.cc).  The reference records a graph
+of GradOpMaker nodes per C++ op kernel; here each eager op call captures
+its own cotangent closure via jax.vjp, so every op automatically has a
+correct gradient without per-op GradOpMaker code.  backward() is a
+reverse-topological walk that accumulates cotangents; XLA executes the
+actual math.
+
+The compiled path (paddle_tpu.jit) does NOT use this tape — it traces a
+pure function and uses jax.grad, which is the TPU-fast route.
+"""
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+_grad_enabled = True
+
+
+def is_grad_enabled():
+    return _grad_enabled
+
+
+@contextlib.contextmanager
+def no_grad():
+    global _grad_enabled
+    prev = _grad_enabled
+    _grad_enabled = False
+    try:
+        yield
+    finally:
+        _grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    global _grad_enabled
+    prev = _grad_enabled
+    _grad_enabled = True
+    try:
+        yield
+    finally:
+        _grad_enabled = prev
+
+
+def _float0_zeros(shape):
+    return np.zeros(shape, dtype=jax.dtypes.float0)
+
+
+class GradNode:
+    """One recorded op: holds the vjp closure and graph edges."""
+
+    __slots__ = ('vjp_fn', 'inputs', 'out_avals', 'out_grads', 'name',
+                 'out_is_seq')
+
+    def __init__(self, vjp_fn, inputs, out_avals, name='', out_is_seq=False):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs          # Tensors that required grad (strong refs)
+        self.out_avals = out_avals    # [(shape, dtype)] per output
+        self.out_grads = [None] * len(out_avals)
+        self.name = name
+        self.out_is_seq = out_is_seq  # fn returned a tuple (vjp wants tuple)
+
+    def seed_grad(self, index, grad):
+        if self.out_grads[index] is None:
+            self.out_grads[index] = grad
+        else:
+            self.out_grads[index] = self.out_grads[index] + grad
+
+    def cotangents(self):
+        cts = []
+        for g, (shape, dtype) in zip(self.out_grads, self.out_avals):
+            if g is not None:
+                cts.append(g)
+            elif np.issubdtype(dtype, np.inexact) or dtype == jnp.bfloat16:
+                cts.append(jnp.zeros(shape, dtype))
+            else:
+                cts.append(_float0_zeros(shape))
+        return tuple(cts) if self.out_is_seq else cts[0]
+
+
+def backward(tensor, grad=None, retain_graph=False):
+    """Run reverse-mode accumulation from `tensor`.
+
+    Matches paddle.Tensor.backward(): scalar outputs seed with ones; the
+    resulting cotangents land in `.grad` of every reachable tensor with
+    stop_gradient=False.
+    """
+    from .tensor import Tensor
+
+    if tensor.grad_node is None:
+        if not tensor.stop_gradient:
+            g = jnp.ones_like(tensor.value) if grad is None else _val(grad)
+            tensor._accumulate_grad(g)
+        return
+    if grad is None:
+        grad = jnp.ones_like(tensor.value)
+    else:
+        grad = _val(grad)
+
+    if not tensor.stop_gradient:
+        tensor._accumulate_grad(grad)  # root keeps its seed, like the ref
+    root = tensor.grad_node
+    root.seed_grad(tensor.grad_index, grad)
+
+    order = _topo_order(root)
+    for node in order:
+        if all(g is None for g in node.out_grads):
+            continue
+        in_grads = node.vjp_fn(node.cotangents())
+        for t, g in zip(node.inputs, in_grads):
+            if t is None or g is None:
+                continue
+            if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+                continue
+            t._accumulate_grad(g)
+            if t.grad_node is not None:
+                t.grad_node.seed_grad(t.grad_index, g)
+        if not retain_graph:
+            node.vjp_fn = None
+            node.out_grads = [None] * len(node.out_avals)
+
+    if not retain_graph:
+        _detach_graph(tensor)
+
+
+def _topo_order(root):
+    """Reverse-topological order of GradNodes reachable from root."""
+    order, state = [], {}
+
+    def visit(node):
+        stack = [(node, iter(_parent_nodes(node)))]
+        while stack:
+            n, it = stack[-1]
+            if state.get(id(n)) == 2:
+                stack.pop()
+                continue
+            state[id(n)] = 1
+            advanced = False
+            for p in it:
+                if state.get(id(p), 0) == 0:
+                    stack.append((p, iter(_parent_nodes(p))))
+                    advanced = True
+                    break
+            if not advanced:
+                state[id(n)] = 2
+                order.append(n)
+                stack.pop()
+
+    visit(root)
+    return list(reversed(order))
+
+
+def _parent_nodes(node):
+    seen = []
+    for t in node.inputs:
+        if t is not None and t.grad_node is not None:
+            seen.append(t.grad_node)
+    return seen
+
+
+def _detach_graph(tensor):
+    # Drop the root edge so Python can free the tape.
+    tensor.grad_node = None
+
+
+def _val(x):
+    from .tensor import Tensor
+    return x.value if isinstance(x, Tensor) else jnp.asarray(x)

@@ -1,0 +1,86 @@
+"""Eager op dispatch: one choke point between the paddle-like API and jnp.
+
+Reference analogue: /root/reference/paddle/fluid/imperative/tracer.cc
+(Tracer::TraceOp) + the per-op GradOpMaker registry in
+paddle/fluid/operators/.  TPU-native: instead of a registry of hand-written
+grad kernels, `apply` captures the cotangent closure of the *actual jnp
+computation* with jax.vjp, so forward and backward always agree and both
+run through XLA.
+
+AMP (paddle_tpu.amp.auto_cast) installs a cast hook here, mirroring how
+the reference's AMP lists (amp/auto_cast.py) wrap the dygraph tracer.
+"""
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .autograd import GradNode
+
+# Installed by paddle_tpu.amp; signature: hook(fn_name, vals) -> vals
+_amp_hook = None
+
+
+def set_amp_hook(hook):
+    global _amp_hook
+    _amp_hook = hook
+
+
+def _raw(x):
+    from .tensor import Tensor
+    if isinstance(x, Tensor):
+        return x.value
+    return x
+
+
+def apply(fn, *args, op_name=None, **kwargs):
+    """Run `fn` on unwrapped values; record a GradNode if needed.
+
+    Tensor args anywhere in `args` are differentiated-through; Tensors in
+    kwargs are unwrapped without gradient tracking (keep differentiable
+    operands positional).
+    """
+    from .tensor import Tensor
+
+    kwargs = {k: _raw(v) for k, v in kwargs.items()}
+    tpos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    vals = [args[i].value for i in tpos]
+
+    if _amp_hook is not None:
+        vals = _amp_hook(op_name or getattr(fn, '__name__', ''), vals)
+
+    def pure(*vs):
+        full = list(args)
+        for i, v in zip(tpos, vs):
+            full[i] = v
+        out = fn(*full, **kwargs)
+        return tuple(out) if isinstance(out, (tuple, list)) else out
+
+    requires = (autograd.is_grad_enabled()
+                and any(not args[i].stop_gradient for i in tpos))
+
+    if requires:
+        out_vals, vjp_fn = jax.vjp(pure, *vals)
+        flat, single = _flatten(out_vals)
+        avals = [(v.shape, v.dtype) for v in flat]
+        node = GradNode(
+            vjp_fn,
+            [args[i] if not args[i].stop_gradient else None for i in tpos],
+            avals,
+            name=op_name or getattr(fn, '__name__', ''),
+            out_is_seq=not single)
+        outs = [Tensor._from_value(v, stop_gradient=False) for v in flat]
+        for i, t in enumerate(outs):
+            t.grad_node = node
+            t.grad_index = i
+        return outs[0] if single else type(out_vals)(outs)
+    else:
+        out_vals = pure(*vals)
+        flat, single = _flatten(out_vals)
+        outs = [Tensor._from_value(v, stop_gradient=True) for v in flat]
+        return outs[0] if single else type(out_vals)(outs)
+
+
+def _flatten(out):
+    if isinstance(out, (tuple, list)):
+        return list(out), False
+    return [out], True

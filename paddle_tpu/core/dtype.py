@@ -1,0 +1,85 @@
+"""Dtype registry.
+
+TPU-native analogue of the reference's framework dtype enum
+(/root/reference/python/paddle/fluid/core_*.py VarDesc.VarType): we map
+string dtype names straight onto jax/numpy dtypes instead of protobuf
+enum values.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+
+# 64-bit note: TPUs have no int64/float64 ALUs and jax truncates them
+# silently unless x64 mode is on.  We alias 64-bit names to 32-bit
+# OPENLY (the reference runs int64 indices everywhere; on TPU int32 is
+# the native index type).  Call enable_x64() to get true 64-bit.
+int64 = jnp.int32
+float64 = jnp.float32
+complex128 = jnp.complex64
+
+
+def enable_x64():
+    """Opt into true 64-bit dtypes (CPU debugging; not for TPU perf)."""
+    global int64, float64, complex128
+    jax.config.update('jax_enable_x64', True)
+    int64 = jnp.int64
+    float64 = jnp.float64
+    complex128 = jnp.complex128
+    _STR2DTYPE.update(int64=jnp.int64, float64=jnp.float64,
+                      complex128=jnp.complex128)
+
+
+_STR2DTYPE = {
+    'float16': float16, 'bfloat16': bfloat16, 'float32': float32,
+    'float64': float64, 'int8': int8, 'int16': int16, 'int32': int32,
+    'int64': int64, 'uint8': uint8, 'bool': bool_,
+    'complex64': complex64, 'complex128': complex128,
+}
+
+_default_dtype = jnp.float32
+
+
+def convert_dtype(dtype):
+    """Accept a string name, numpy/jnp dtype, or None → canonical np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _STR2DTYPE:
+            raise ValueError(f"unknown dtype {dtype!r}")
+        return np.dtype(_STR2DTYPE[dtype])
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype):
+    return np.dtype(dtype).name
+
+
+def set_default_dtype(dtype):
+    global _default_dtype
+    d = convert_dtype(dtype)
+    if not is_floating(d):
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return np.dtype(_default_dtype)
+
+
+def is_floating(dtype):
+    return np.issubdtype(np.dtype(dtype), np.floating) or \
+        np.dtype(dtype) == np.dtype(jnp.bfloat16)
+
+
+def is_integer(dtype):
+    return np.issubdtype(np.dtype(dtype), np.integer)

@@ -1,0 +1,37 @@
+"""Global PRNG state for eager (dygraph) mode.
+
+Reference analogue: the global generator in
+/root/reference/python/paddle/fluid/framework.py (Program.random_seed) and
+paddle.seed.  TPU-native: JAX has no stateful RNG, so eager mode keeps one
+explicit PRNGKey that is split per draw; compiled/functional paths thread
+keys explicitly (see nn/functional dropout and jit.functional_call).
+"""
+import jax
+
+
+class _RngState:
+    def __init__(self, seed=0):
+        self.seed_value = seed
+        self.key = jax.random.PRNGKey(seed)
+
+    def next_key(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+
+_state = _RngState(0)
+
+
+def seed(s):
+    """paddle.seed — reseed the global eager generator."""
+    global _state
+    _state = _RngState(int(s))
+    return _state
+
+
+def next_key():
+    return _state.next_key()
+
+
+def get_seed():
+    return _state.seed_value

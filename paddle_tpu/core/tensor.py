@@ -1,0 +1,259 @@
+"""paddle_tpu.Tensor — eager tensor wrapping a jax.Array.
+
+Reference analogue: the C++ VarBase in
+/root/reference/paddle/fluid/imperative/layer.h plus the Python-side
+monkey-patched methods in python/paddle/fluid/dygraph/math_op_patch.py.
+TPU-native: the storage IS a jax.Array (already on device, async
+dispatch); autograd state is two fields (grad_node, grad_index) pointing
+into the tape (core/autograd.py).  Most methods are patched on by
+paddle_tpu.tensor at import time, mirroring the reference's patch
+approach so the op library lives in one place.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import autograd, dispatch
+from .dtype import convert_dtype, get_default_dtype, dtype_name, is_floating
+
+
+class Tensor:
+    __array_priority__ = 100  # beat numpy in mixed binary ops
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True,
+                 name=None):
+        dtype = convert_dtype(dtype)
+        if isinstance(data, Tensor):
+            value = data.value
+            if dtype is not None and value.dtype != dtype:
+                value = value.astype(dtype)
+        elif isinstance(data, jax.Array):
+            value = data if dtype is None else data.astype(dtype)
+        else:
+            arr = np.asarray(data)
+            if dtype is None and arr.dtype == np.float64:
+                dtype = get_default_dtype()  # paddle-style float default
+            value = jnp.asarray(arr, dtype=dtype)
+        self.value = value
+        self.stop_gradient = stop_gradient
+        self.name = name
+        self.persistable = False
+        self._grad = None
+        self.grad_node = None
+        self.grad_index = 0
+
+    # -- construction helpers ------------------------------------------------
+    @classmethod
+    def _from_value(cls, value, stop_gradient=True):
+        t = cls.__new__(cls)
+        t.value = value
+        t.stop_gradient = stop_gradient
+        t.name = None
+        t.persistable = False
+        t._grad = None
+        t.grad_node = None
+        t.grad_index = 0
+        return t
+
+    # -- basic attributes ----------------------------------------------------
+    @property
+    def shape(self):
+        return list(self.value.shape)
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    @property
+    def ndim(self):
+        return self.value.ndim
+
+    dim = ndim
+    rank = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self.value.shape)) if self.value.shape else 1
+
+    @property
+    def place(self):
+        from . import device
+        return device.get_place()
+
+    @property
+    def T(self):
+        # paddle semantics: reverse ALL dims (paddle.t is the ≤2-D one)
+        return dispatch.apply(lambda v: jnp.transpose(v), self, op_name='T')
+
+    def numel(self):
+        return self.size
+
+    # -- autograd ------------------------------------------------------------
+    @property
+    def grad(self):
+        if self._grad is None:
+            return None
+        return Tensor._from_value(self._grad, stop_gradient=True)
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = None if g is None else (
+            g.value if isinstance(g, Tensor) else jnp.asarray(g))
+
+    def _accumulate_grad(self, g):
+        if g.dtype != self.value.dtype:
+            g = g.astype(self.value.dtype)
+        self._grad = g if self._grad is None else self._grad + g
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.backward(self, grad_tensor, retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self):
+        return Tensor._from_value(self.value, stop_gradient=True)
+
+    def detach_(self):
+        self.grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        return dispatch.apply(lambda v: v + 0, self, op_name='clone')
+
+    # -- host interop --------------------------------------------------------
+    def numpy(self):
+        v = self.value
+        if v.dtype == jnp.bfloat16:
+            return np.asarray(v.astype(jnp.float32))
+        return np.asarray(v)
+
+    def item(self, *args):
+        return self.value.item(*args) if args else np.asarray(self.value).item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self.value.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        return bool(np.asarray(self.value))
+
+    def __int__(self):
+        return int(np.asarray(self.value))
+
+    def __float__(self):
+        return float(np.asarray(self.value))
+
+    def __index__(self):
+        return int(np.asarray(self.value))
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        grad_flag = f", stop_gradient={self.stop_gradient}"
+        return (f"Tensor(shape={self.shape}, dtype={dtype_name(self.dtype)}"
+                f"{grad_flag},\n       {np.asarray(self.numpy())!r})")
+
+    # -- dtype / value management -------------------------------------------
+    def astype(self, dtype):
+        d = convert_dtype(dtype)
+        return dispatch.apply(lambda v: v.astype(d), self, op_name='cast')
+
+    cast = astype
+
+    def set_value(self, value):
+        """In-place value replacement (optimizer updates, state loading)."""
+        v = value.value if isinstance(value, Tensor) else jnp.asarray(value)
+        if tuple(v.shape) != tuple(self.value.shape):
+            raise ValueError(
+                f"set_value shape mismatch {v.shape} vs {self.value.shape}")
+        self.value = v.astype(self.value.dtype)
+        return self
+
+    def _snapshot(self):
+        """Pre-mutation view that keeps the tape edge to the old producer.
+
+        In-place ops record their GradNode against this snapshot, NOT
+        against self — otherwise the node's input would be self itself
+        (a self-edge) and the original producer would fall off the tape.
+        """
+        t = Tensor._from_value(self.value, stop_gradient=self.stop_gradient)
+        t.grad_node = self.grad_node
+        t.grad_index = self.grad_index
+        return t
+
+    def _replace(self, other):
+        """Adopt another tensor's value + tape edge (in-place op result)."""
+        self.value = other.value
+        self.grad_node = other.grad_node
+        self.grad_index = other.grad_index
+        self.stop_gradient = other.stop_gradient
+        return self
+
+    # -- indexing ------------------------------------------------------------
+    def _norm_index(self, idx):
+        if isinstance(idx, tuple):
+            return tuple(i.value if isinstance(i, Tensor) else i for i in idx)
+        return idx.value if isinstance(idx, Tensor) else idx
+
+    def __getitem__(self, idx):
+        idx = self._norm_index(idx)
+        return dispatch.apply(lambda v: v[idx], self, op_name='getitem')
+
+    def __setitem__(self, idx, val):
+        idx = self._norm_index(idx)
+        old = self._snapshot()
+        if isinstance(val, Tensor):
+            out = dispatch.apply(
+                lambda v, u: v.at[idx].set(u.astype(v.dtype)), old, val,
+                op_name='setitem')
+        else:
+            out = dispatch.apply(lambda v: v.at[idx].set(val), old,
+                                 op_name='setitem')
+        self._replace(out)
+
+
+def _register_pytree(cls):
+    jax.tree_util.register_pytree_node(
+        cls,
+        lambda t: ((t.value,), t.stop_gradient),
+        lambda sg, ch: cls._from_value(ch[0], stop_gradient=sg))
+
+
+_register_pytree(Tensor)
+
+
+class Parameter(Tensor):
+    """Trainable tensor: stop_gradient=False, tracked by nn.Layer.
+
+    Reference analogue: python/paddle/fluid/framework.py ParamBase.
+    """
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable)
+        self.name = name
+        self.persistable = True
+        self.trainable = trainable
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+
+_register_pytree(Parameter)

@@ -1,0 +1,79 @@
+"""Tensor op library + method patching.
+
+Mirrors the reference's approach of patching tensor methods onto the
+Tensor class at import time
+(/root/reference/python/paddle/fluid/dygraph/math_op_patch.py), so the op
+library lives in function modules and methods are generated.
+"""
+import numpy as np
+
+from ..core.tensor import Tensor
+
+from . import creation, math, manipulation, linalg, logic, random, search, stat
+from .creation import *      # noqa: F401,F403
+from .math import *          # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *        # noqa: F401,F403
+from .logic import *         # noqa: F401,F403
+from .random import *        # noqa: F401,F403
+from .search import *        # noqa: F401,F403
+from .stat import *          # noqa: F401,F403
+
+__all__ = (creation.__all__ + math.__all__ + manipulation.__all__ +
+           linalg.__all__ + logic.__all__ + random.__all__ +
+           search.__all__ + stat.__all__)
+
+# stat wins over math for `mean` etc. — patch order matters (last wins),
+# matching the reference where paddle.mean is the stat reduce_mean.
+_METHOD_MODULES = [math, manipulation, linalg, logic, search, stat]
+
+_SKIP_METHODS = {'is_tensor', 'meshgrid', 'einsum', 'multi_dot'}
+
+
+def _patch_methods():
+    for mod in _METHOD_MODULES:
+        for name in mod.__all__:
+            if name in _SKIP_METHODS:
+                continue
+            fn = getattr(mod, name)
+            if callable(fn) and not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+    # patched names that collide with core attrs get underscored variants
+    Tensor.sum = math.sum
+    Tensor.abs = math.abs
+    Tensor.mean = stat.mean
+    Tensor.reshape = manipulation.reshape
+    Tensor.astype_ = Tensor.astype
+
+
+def _patch_operators():
+    Tensor.__add__ = lambda s, o: math.add(s, o)
+    Tensor.__radd__ = lambda s, o: math.add(o, s)
+    Tensor.__sub__ = lambda s, o: math.subtract(s, o)
+    Tensor.__rsub__ = lambda s, o: math.subtract(o, s)
+    Tensor.__mul__ = lambda s, o: math.multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: math.multiply(o, s)
+    Tensor.__truediv__ = lambda s, o: math.divide(s, o)
+    Tensor.__rtruediv__ = lambda s, o: math.divide(o, s)
+    Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    Tensor.__mod__ = lambda s, o: math.mod(s, o)
+    Tensor.__pow__ = lambda s, o: math.pow(s, o)
+    Tensor.__rpow__ = lambda s, o: math.pow(o, s)
+    Tensor.__matmul__ = lambda s, o: linalg.matmul(s, o)
+    Tensor.__rmatmul__ = lambda s, o: linalg.matmul(o, s)
+    Tensor.__neg__ = lambda s: math.scale(s, -1.0)
+    Tensor.__abs__ = lambda s: math.abs(s)
+    Tensor.__eq__ = lambda s, o: logic.equal(s, o)
+    Tensor.__ne__ = lambda s, o: logic.not_equal(s, o)
+    Tensor.__lt__ = lambda s, o: logic.less_than(s, o)
+    Tensor.__le__ = lambda s, o: logic.less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: logic.greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: logic.greater_equal(s, o)
+    Tensor.__invert__ = lambda s: logic.logical_not(s)
+    Tensor.__and__ = lambda s, o: logic.logical_and(s, o)
+    Tensor.__or__ = lambda s, o: logic.logical_or(s, o)
+    Tensor.__xor__ = lambda s, o: logic.logical_xor(s, o)
+
+
+_patch_methods()
+_patch_operators()

@@ -1,0 +1,44 @@
+"""Shared helpers for the tensor op library."""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+
+def wrap(x, dtype=None):
+    """Coerce python scalars / numpy arrays / Tensors into Tensor."""
+    if isinstance(x, Tensor):
+        return x if dtype is None else x.astype(dtype)
+    return Tensor(x, dtype=dtype)
+
+
+def raw(x):
+    return x.value if isinstance(x, Tensor) else x
+
+
+def napply(fn, *args, op_name=None, **kwargs):
+    """apply() for non-differentiable ops (int/bool outputs)."""
+    with autograd.no_grad():
+        out = apply(fn, *args, op_name=op_name, **kwargs)
+    return out
+
+
+def normalize_shape(shape):
+    """Shape argument → tuple of ints; accepts int, list/tuple (possibly
+    holding scalar Tensors), or a 1-D int Tensor (paddle allows all)."""
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape.value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+def axis_tuple(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)

@@ -1,0 +1,142 @@
+"""Tensor creation ops.
+
+Reference analogue: /root/reference/python/paddle/tensor/creation.py
+(fill_constant / assign C++ kernels).  TPU-native: constants come out of
+jnp (constant-folded by XLA under jit).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.dispatch import apply
+from ._helpers import wrap, raw, normalize_shape as _shape
+
+__all__ = [
+    'to_tensor', 'zeros', 'ones', 'full', 'empty', 'zeros_like', 'ones_like',
+    'full_like', 'empty_like', 'arange', 'linspace', 'logspace', 'eye',
+    'diag', 'diagflat', 'tril', 'triu', 'meshgrid', 'assign', 'clone',
+    'create_parameter',
+]
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor._from_value(jnp.zeros(_shape(shape), d))
+
+
+def ones(shape, dtype=None, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor._from_value(jnp.ones(_shape(shape), d))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    # reference defaults to float32 when dtype is None, even for int fills
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor._from_value(jnp.full(_shape(shape), raw(fill_value), d))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = wrap(x)
+    return Tensor._from_value(
+        jnp.zeros_like(x.value, dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = wrap(x)
+    return Tensor._from_value(
+        jnp.ones_like(x.value, dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = wrap(x)
+    return Tensor._from_value(
+        jnp.full_like(x.value, raw(fill_value), dtype=convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    return Tensor._from_value(
+        jnp.arange(raw(start), raw(end), raw(step), convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor._from_value(
+        jnp.linspace(raw(start), raw(stop), int(num),
+                     dtype=convert_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor._from_value(
+        jnp.logspace(raw(start), raw(stop), int(num), base=raw(base),
+                     dtype=convert_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor._from_value(jnp.eye(num_rows, num_columns, dtype=d))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = wrap(x)
+    def fn(v):
+        if v.ndim == 1 and padding_value != 0:
+            n = v.shape[0] + abs(offset)
+            out = jnp.full((n, n), padding_value, v.dtype)
+            idx = jnp.arange(v.shape[0])
+            r, c = (idx, idx + offset) if offset >= 0 else (idx - offset, idx)
+            return out.at[r, c].set(v)
+        return jnp.diag(v, k=offset)
+    return apply(fn, x, op_name='diag')
+
+
+def diagflat(x, offset=0, name=None):
+    x = wrap(x)
+    return apply(lambda v: jnp.diagflat(v, k=offset), x, op_name='diagflat')
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.tril(v, k=diagonal), wrap(x), op_name='tril')
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.triu(v, k=diagonal), wrap(x), op_name='triu')
+
+
+def meshgrid(*args, **kwargs):
+    ts = [wrap(a) for a in (args[0] if len(args) == 1 and
+                            isinstance(args[0], (list, tuple)) else args)]
+    return apply(lambda *vs: jnp.meshgrid(*vs, indexing='ij'), *ts,
+                 op_name='meshgrid')
+
+
+def assign(x, output=None):
+    src = wrap(x)
+    if output is None:
+        return src.clone()
+    output.set_value(src.value)
+    return output
+
+
+def clone(x, name=None):
+    return wrap(x).clone()
+
+
+def create_parameter(shape, dtype='float32', name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from ..nn import initializer as I
+    init = default_initializer or (
+        I.Constant(0.0) if is_bias else I.XavierNormal())
+    value = init(_shape(shape), convert_dtype(dtype))
+    return Parameter(value, name=name)

@@ -1,0 +1,186 @@
+"""Linear algebra ops.
+
+Reference analogue: /root/reference/python/paddle/tensor/linalg.py (matmul
+→ cuBLAS in the reference).  TPU-native: jnp.matmul/einsum lower straight
+onto the MXU; bf16 inputs with fp32 accumulation is XLA's default contract.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ._helpers import wrap, raw, napply
+
+__all__ = [
+    'matmul', 'mm', 'bmm', 'dot', 'mv', 't', 'norm', 'dist', 'cross',
+    'cholesky', 'matrix_power', 'histogram', 'einsum', 'inv', 'det',
+    'slogdet', 'svd', 'solve', 'qr', 'eigh', 'pinv', 'multi_dot',
+    'triangular_solve', 'cond', 'matrix_rank',
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply(fn, wrap(x), wrap(y), op_name='matmul')
+
+
+mm = matmul
+
+
+def bmm(x, y, name=None):
+    return apply(jnp.matmul, wrap(x), wrap(y), op_name='bmm')
+
+
+def dot(x, y, name=None):
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), wrap(x), wrap(y),
+                 op_name='dot')
+
+
+def mv(x, vec, name=None):
+    return apply(jnp.matmul, wrap(x), wrap(vec), op_name='mv')
+
+
+def t(input, name=None):
+    x = wrap(input)
+    if x.ndim > 2:
+        raise ValueError(
+            "paddle.t only supports tensors of rank <= 2; use transpose")
+    return apply(lambda v: v.T if v.ndim == 2 else v, x, op_name='t')
+
+
+def norm(x, p='fro', axis=None, keepdim=False, name=None):
+    def fn(v):
+        if p == 'fro' and axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(v)))
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == 'fro':
+            return jnp.sqrt(jnp.sum(jnp.square(v), axis=ax,
+                                    keepdims=keepdim))
+        if p == np.inf:
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == -np.inf:
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax,
+                           keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=ax,
+                                 keepdims=keepdim), 1.0 / p)
+    return apply(fn, wrap(x), op_name='norm')
+
+
+def dist(x, y, p=2, name=None):
+    def fn(a, b):
+        d = a - b
+        if p == np.inf:
+            return jnp.max(jnp.abs(d))
+        if p == -np.inf:
+            return jnp.min(jnp.abs(d))
+        if p == 0:
+            return jnp.sum((d != 0).astype(d.dtype))
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+    return apply(fn, wrap(x), wrap(y), op_name='dist')
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else None
+    def fn(a, b):
+        if ax is None:
+            # paddle default: first axis with dim 3
+            for i, s in enumerate(a.shape):
+                if s == 3:
+                    return jnp.cross(a, b, axis=i)
+            raise ValueError("no axis of size 3 for cross")
+        return jnp.cross(a, b, axis=ax)
+    return apply(fn, wrap(x), wrap(y), op_name='cross')
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(v):
+        l = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return apply(fn, wrap(x), op_name='cholesky')
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda v: jnp.linalg.matrix_power(v, n), wrap(x),
+                 op_name='matrix_power')
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def fn(v):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (v.min(), v.max())
+        h, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+        return h
+    return napply(fn, wrap(input), op_name='histogram')
+
+
+def einsum(equation, *operands):
+    ts = [wrap(o) for o in operands]
+    return apply(lambda *vs: jnp.einsum(equation, *vs), *ts,
+                 op_name='einsum')
+
+
+def inv(x, name=None):
+    return apply(jnp.linalg.inv, wrap(x), op_name='inv')
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, wrap(x), op_name='det')
+
+
+def slogdet(x, name=None):
+    return apply(lambda v: tuple(jnp.linalg.slogdet(v)), wrap(x),
+                 op_name='slogdet')
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply(lambda v: tuple(jnp.linalg.svd(
+        v, full_matrices=full_matrices)), wrap(x), op_name='svd')
+
+
+def qr(x, mode='reduced', name=None):
+    return apply(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), wrap(x),
+                 op_name='qr')
+
+
+def eigh(x, UPLO='L', name=None):
+    return apply(lambda v: tuple(jnp.linalg.eigh(v, UPLO=UPLO)), wrap(x),
+                 op_name='eigh')
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda v: jnp.linalg.pinv(v, rcond=rcond,
+                                           hermitian=hermitian), wrap(x),
+                 op_name='pinv')
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, wrap(x), wrap(y), op_name='solve')
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    import jax.scipy.linalg as jsl
+    def fn(a, b):
+        return jsl.solve_triangular(a, b, lower=not upper, trans=int(transpose),
+                                    unit_diagonal=unitriangular)
+    return apply(fn, wrap(x), wrap(y), op_name='triangular_solve')
+
+
+def multi_dot(x, name=None):
+    ts = [wrap(t_) for t_ in x]
+    return apply(lambda *vs: jnp.linalg.multi_dot(vs), *ts,
+                 op_name='multi_dot')
+
+
+def cond(x, p=None, name=None):
+    return apply(lambda v: jnp.linalg.cond(v, p=p), wrap(x), op_name='cond')
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return napply(lambda v: jnp.linalg.matrix_rank(v, tol=tol), wrap(x),
+                  op_name='matrix_rank')

@@ -1,0 +1,71 @@
+"""Comparison + logical ops (reference: python/paddle/tensor/logic.py)."""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ._helpers import wrap, napply
+
+__all__ = [
+    'equal', 'not_equal', 'greater_than', 'greater_equal', 'less_than',
+    'less_equal', 'logical_and', 'logical_or', 'logical_not', 'logical_xor',
+    'allclose', 'isclose', 'equal_all', 'is_empty', 'is_tensor',
+    'bitwise_and', 'bitwise_or', 'bitwise_xor', 'bitwise_not',
+]
+
+
+def _cmp(jfn, name):
+    def op(x, y, name=None):
+        if np.isscalar(y):
+            return napply(lambda v: jfn(v, y), wrap(x), op_name=name)
+        if np.isscalar(x):
+            return napply(lambda v: jfn(x, v), wrap(y), op_name=name)
+        return napply(jfn, wrap(x), wrap(y), op_name=name)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp(jnp.equal, 'equal')
+not_equal = _cmp(jnp.not_equal, 'not_equal')
+greater_than = _cmp(jnp.greater, 'greater_than')
+greater_equal = _cmp(jnp.greater_equal, 'greater_equal')
+less_than = _cmp(jnp.less, 'less_than')
+less_equal = _cmp(jnp.less_equal, 'less_equal')
+logical_and = _cmp(jnp.logical_and, 'logical_and')
+logical_or = _cmp(jnp.logical_or, 'logical_or')
+logical_xor = _cmp(jnp.logical_xor, 'logical_xor')
+bitwise_and = _cmp(jnp.bitwise_and, 'bitwise_and')
+bitwise_or = _cmp(jnp.bitwise_or, 'bitwise_or')
+bitwise_xor = _cmp(jnp.bitwise_xor, 'bitwise_xor')
+
+
+def logical_not(x, out=None, name=None):
+    return napply(jnp.logical_not, wrap(x), op_name='logical_not')
+
+
+def bitwise_not(x, out=None, name=None):
+    return napply(jnp.bitwise_not, wrap(x), op_name='bitwise_not')
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return napply(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                            equal_nan=equal_nan),
+                  wrap(x), wrap(y), op_name='allclose')
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return napply(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                           equal_nan=equal_nan),
+                  wrap(x), wrap(y), op_name='isclose')
+
+
+def equal_all(x, y, name=None):
+    return napply(lambda a, b: jnp.array_equal(a, b), wrap(x), wrap(y),
+                  op_name='equal_all')
+
+
+def is_empty(x, name=None):
+    return Tensor(np.asarray(wrap(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

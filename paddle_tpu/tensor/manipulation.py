@@ -1,0 +1,304 @@
+"""Shape / layout manipulation ops.
+
+Reference analogue: /root/reference/python/paddle/tensor/manipulation.py.
+TPU-native note: reshape/transpose/slice are free-ish metadata ops under
+XLA; gather/scatter lower to lax.gather/scatter which tile onto the VPU.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ._helpers import wrap, raw, napply, normalize_shape as _resolve_shape
+
+__all__ = [
+    'reshape', 'flatten', 'transpose', 'concat', 'split', 'chunk', 'stack',
+    'unstack', 'squeeze', 'unsqueeze', 'expand', 'expand_as', 'tile',
+    'broadcast_to', 'flip', 'roll', 'gather', 'gather_nd', 'scatter',
+    'scatter_nd_add', 'unbind', 'unique', 'moveaxis', 'repeat_interleave',
+    'take_along_axis', 'put_along_axis', 'numel', 'cast', 'slice',
+    'strided_slice', 'rot90', 'as_strided', 'view', 'tolist',
+    'tensordot', 'atleast_1d', 'atleast_2d', 'atleast_3d',
+]
+
+
+
+
+
+def reshape(x, shape, name=None):
+    shape = _resolve_shape(shape)
+    return apply(lambda v: jnp.reshape(v, shape), wrap(x), op_name='reshape')
+
+
+def view(x, shape_or_dtype, name=None):
+    return reshape(x, shape_or_dtype)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = wrap(x)
+    nd = x.ndim
+    sa = start_axis % nd if nd else 0
+    so = stop_axis % nd if nd else 0
+    def fn(v):
+        shp = v.shape[:sa] + (-1,) + v.shape[so + 1:]
+        return jnp.reshape(v, shp)
+    return apply(fn, x, op_name='flatten')
+
+
+def transpose(x, perm, name=None):
+    perm = [int(p) for p in perm]
+    return apply(lambda v: jnp.transpose(v, perm), wrap(x),
+                 op_name='transpose')
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda v: jnp.moveaxis(v, source, destination), wrap(x),
+                 op_name='moveaxis')
+
+
+def concat(x, axis=0, name=None):
+    ts = [wrap(t) for t in x]
+    axis = int(raw(axis)) if not isinstance(axis, int) else axis
+    return apply(lambda *vs: jnp.concatenate(vs, axis=axis), *ts,
+                 op_name='concat')
+
+
+def stack(x, axis=0, name=None):
+    ts = [wrap(t) for t in x]
+    return apply(lambda *vs: jnp.stack(vs, axis=axis), *ts, op_name='stack')
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = wrap(x)
+    axis = int(axis)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: axis {axis} size {dim} is not divisible by "
+                f"{num_or_sections}")
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) if not isinstance(s, Tensor) else int(s.item())
+                 for s in num_or_sections]
+        n_unknown = sum(1 for s in sizes if s < 0)
+        if n_unknown:
+            known = sum(s for s in sizes if s >= 0)
+            sizes = [s if s >= 0 else dim - known for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1])
+    def fn2(v):
+        outs = []
+        for o, s in zip(offsets, sizes):
+            idx = [np.s_[:]] * v.ndim
+            idx[axis] = np.s_[o:o + s]
+            outs.append(v[tuple(idx)])
+        return tuple(outs)
+    return list(apply(fn2, x, op_name='split'))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = wrap(x)
+    n = num or x.shape[axis]
+    def fn(v):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(v, n, axis=axis))
+    return list(apply(fn, x, op_name='unstack'))
+
+
+def unbind(input, axis=0):
+    return unstack(input, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    x = wrap(x)
+    if axis is None:
+        ax = None
+    else:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+    return apply(lambda v: jnp.squeeze(v, axis=ax), x, op_name='squeeze')
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a) if not isinstance(a, Tensor) else int(a.item())
+            for a in axes]
+    def fn(v):
+        for a in sorted(axes):
+            v = jnp.expand_dims(v, a)
+        return v
+    return apply(fn, wrap(x), op_name='unsqueeze')
+
+
+def expand(x, shape, name=None):
+    shape = _resolve_shape(shape)
+    x = wrap(x)
+    def fn(v):
+        tgt = list(shape)
+        off = len(tgt) - v.ndim
+        for i in range(v.ndim):
+            if tgt[off + i] == -1:
+                tgt[off + i] = v.shape[i]
+        return jnp.broadcast_to(v, tuple(tgt))
+    return apply(fn, x, op_name='expand')
+
+
+def expand_as(x, y, name=None):
+    return expand(x, wrap(y).shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _resolve_shape(repeat_times)
+    return apply(lambda v: jnp.tile(v, reps), wrap(x), op_name='tile')
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply(lambda v: jnp.flip(v, axis=tuple(axes)), wrap(x),
+                 op_name='flip')
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), wrap(x),
+                 op_name='rot90')
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply(lambda v: jnp.roll(v, shifts, axis=axis), wrap(x),
+                 op_name='roll')
+
+
+def gather(x, index, axis=0, name=None):
+    axis = int(raw(axis)) if not isinstance(axis, int) else axis
+    return apply(lambda v, i: jnp.take(v, i.astype(jnp.int32), axis=axis),
+                 wrap(x), wrap(index), op_name='gather')
+
+
+def gather_nd(x, index, name=None):
+    return apply(
+        lambda v, i: v[tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))],
+        wrap(x), wrap(index), op_name='gather_nd')
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(v, i, u):
+        i = i.astype(jnp.int32)
+        if overwrite:
+            return v.at[i].set(u)
+        return v.at[i].add(u)
+    return apply(fn, wrap(x), wrap(index), wrap(updates), op_name='scatter')
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(v, i, u):
+        return v.at[tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))].add(u)
+    return apply(fn, wrap(x), wrap(index), wrap(updates),
+                 op_name='scatter_nd_add')
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype='int64', name=None):
+    x = wrap(x)
+    res = napply(
+        lambda v: jnp.unique(v, return_index=return_index,
+                             return_inverse=return_inverse,
+                             return_counts=return_counts, axis=axis),
+        x, op_name='unique')
+    return res
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = raw(repeats)
+    return apply(lambda v: jnp.repeat(v, r, axis=axis), wrap(x),
+                 op_name='repeat_interleave')
+
+
+def take_along_axis(arr, indices, axis, broadcast=True):
+    return apply(lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32),
+                                                  axis=axis),
+                 wrap(arr), wrap(indices), op_name='take_along_axis')
+
+
+def put_along_axis(arr, indices, values, axis, reduce='assign'):
+    def fn(v, i, u):
+        i = i.astype(jnp.int32)
+        u = jnp.broadcast_to(u, i.shape).astype(v.dtype)
+        idx = [jnp.arange(s).reshape([-1 if k == d else 1
+                                      for k in range(i.ndim)])
+               for d, s in enumerate(i.shape)]
+        idx[axis] = i
+        if reduce == 'add':
+            return v.at[tuple(idx)].add(u)
+        return v.at[tuple(idx)].set(u)
+    return apply(fn, wrap(arr), wrap(indices), wrap(values),
+                 op_name='put_along_axis')
+
+
+def numel(x, name=None):
+    return Tensor(np.int32(wrap(x).size))
+
+
+def cast(x, dtype):
+    return wrap(x).astype(dtype)
+
+
+def slice(input, axes, starts, ends):
+    x = wrap(input)
+    def fn(v):
+        idx = [np.s_[:]] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            s = int(raw(s)) if not isinstance(s, int) else s
+            e = int(raw(e)) if not isinstance(e, int) else e
+            idx[a] = np.s_[s:e]
+        return v[tuple(idx)]
+    return apply(fn, x, op_name='slice')
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = wrap(x)
+    def fn(v):
+        idx = [np.s_[:]] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = np.s_[s:e:st]
+        return v[tuple(idx)]
+    return apply(fn, x, op_name='strided_slice')
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    raise NotImplementedError(
+        "as_strided has no XLA analogue; use reshape/slice/gather")
+
+
+def tolist(x):
+    return wrap(x).tolist()
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=axes), wrap(x),
+                 wrap(y), op_name='tensordot')
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply(jnp.atleast_1d, wrap(t), op_name='atleast_1d')
+            for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply(jnp.atleast_2d, wrap(t), op_name='atleast_2d')
+            for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply(jnp.atleast_3d, wrap(t), op_name='atleast_3d')
+            for t in inputs]
+    return outs[0] if len(outs) == 1 else outs

@@ -1,0 +1,261 @@
+"""Elementwise + reduction math ops.
+
+Reference analogue: /root/reference/python/paddle/tensor/math.py backed by
+paddle/fluid/operators/elementwise/* and reduce_ops/*.  TPU-native: thin
+jnp lambdas through the dispatch choke point; XLA fuses chains of these
+into single HBM-friendly kernels, so there is no need for the reference's
+hand-fused kernels.
+"""
+import numpy as np
+import jax.numpy as jnp
+import jax
+
+from ..core.dispatch import apply
+from ._helpers import wrap, raw, napply, axis_tuple
+
+__all__ = [
+    'add', 'subtract', 'multiply', 'divide', 'floor_divide', 'mod',
+    'remainder', 'pow', 'float_power', 'maximum', 'minimum', 'fmax', 'fmin',
+    'exp', 'expm1', 'log', 'log2', 'log10', 'log1p', 'sqrt', 'rsqrt',
+    'square', 'abs', 'sign', 'sin', 'cos', 'tan', 'asin', 'acos', 'atan',
+    'atan2', 'sinh', 'cosh', 'tanh', 'asinh', 'acosh', 'atanh', 'reciprocal',
+    'floor', 'ceil', 'round', 'trunc', 'clip', 'sum', 'prod', 'max', 'min',
+    'amax', 'amin', 'cumsum', 'cumprod', 'logsumexp', 'logit', 'erf',
+    'erfinv', 'multiply_', 'addmm', 'inner', 'outer', 'kron', 'isfinite',
+    'isinf', 'isnan', 'nan_to_num', 'lerp', 'scale', 'increment', 'all',
+    'any', 'heaviside', 'frac', 'rad2deg', 'deg2rad', 'gcd', 'lcm', 'diff',
+    'angle', 'count_nonzero', 'sgn', 'take', 'digamma', 'lgamma',
+]
+
+
+def _binary(jfn, name):
+    # python scalars stay in the closure → jnp weak typing applies, so
+    # `f32_tensor + 2.5` stays float32 (the reference promotes the same way).
+    def op(x, y, name=None):
+        xs, ys = np.isscalar(x), np.isscalar(y)
+        if ys and not xs:
+            return apply(lambda v: jfn(v, y), wrap(x), op_name=name)
+        if xs and not ys:
+            return apply(lambda v: jfn(x, v), wrap(y), op_name=name)
+        return apply(jfn, wrap(x), wrap(y), op_name=name)
+    op.__name__ = name
+    return op
+
+
+def _unary(jfn, name):
+    def op(x, name=None):
+        return apply(jfn, wrap(x), op_name=name)
+    op.__name__ = name
+    return op
+
+
+def _nunary(jfn, name):
+    def op(x, name=None):
+        return napply(jfn, wrap(x), op_name=name)
+    op.__name__ = name
+    return op
+
+
+add = _binary(jnp.add, 'add')
+subtract = _binary(jnp.subtract, 'subtract')
+multiply = _binary(jnp.multiply, 'multiply')
+divide = _binary(jnp.divide, 'divide')
+floor_divide = _binary(jnp.floor_divide, 'floor_divide')
+mod = _binary(jnp.mod, 'mod')
+remainder = mod
+maximum = _binary(jnp.maximum, 'maximum')
+minimum = _binary(jnp.minimum, 'minimum')
+fmax = _binary(jnp.fmax, 'fmax')
+fmin = _binary(jnp.fmin, 'fmin')
+atan2 = _binary(jnp.arctan2, 'atan2')
+heaviside = _binary(jnp.heaviside, 'heaviside')
+gcd = _binary(jnp.gcd, 'gcd')
+lcm = _binary(jnp.lcm, 'lcm')
+
+
+def pow(x, y, name=None):
+    x = wrap(x)
+    if np.isscalar(y):
+        return apply(lambda v: jnp.power(v, y), x, op_name='pow')
+    return apply(jnp.power, x, wrap(y), op_name='pow')
+
+
+float_power = pow
+
+exp = _unary(jnp.exp, 'exp')
+expm1 = _unary(jnp.expm1, 'expm1')
+log = _unary(jnp.log, 'log')
+log2 = _unary(jnp.log2, 'log2')
+log10 = _unary(jnp.log10, 'log10')
+log1p = _unary(jnp.log1p, 'log1p')
+sqrt = _unary(jnp.sqrt, 'sqrt')
+rsqrt = _unary(jax.lax.rsqrt, 'rsqrt')
+square = _unary(jnp.square, 'square')
+abs = _unary(jnp.abs, 'abs')
+sign = _unary(jnp.sign, 'sign')
+sgn = sign
+sin = _unary(jnp.sin, 'sin')
+cos = _unary(jnp.cos, 'cos')
+tan = _unary(jnp.tan, 'tan')
+asin = _unary(jnp.arcsin, 'asin')
+acos = _unary(jnp.arccos, 'acos')
+atan = _unary(jnp.arctan, 'atan')
+sinh = _unary(jnp.sinh, 'sinh')
+cosh = _unary(jnp.cosh, 'cosh')
+tanh = _unary(jnp.tanh, 'tanh')
+asinh = _unary(jnp.arcsinh, 'asinh')
+acosh = _unary(jnp.arccosh, 'acosh')
+atanh = _unary(jnp.arctanh, 'atanh')
+reciprocal = _unary(jnp.reciprocal, 'reciprocal')
+floor = _unary(jnp.floor, 'floor')
+ceil = _unary(jnp.ceil, 'ceil')
+round = _unary(jnp.round, 'round')
+trunc = _unary(jnp.trunc, 'trunc')
+erf = _unary(jax.scipy.special.erf, 'erf')
+erfinv = _unary(jax.scipy.special.erfinv, 'erfinv')
+digamma = _unary(jax.scipy.special.digamma, 'digamma')
+lgamma = _unary(jax.scipy.special.gammaln, 'lgamma')
+frac = _unary(lambda v: v - jnp.trunc(v), 'frac')
+rad2deg = _unary(jnp.rad2deg, 'rad2deg')
+deg2rad = _unary(jnp.deg2rad, 'deg2rad')
+angle = _unary(jnp.angle, 'angle')
+isfinite = _nunary(jnp.isfinite, 'isfinite')
+isinf = _nunary(jnp.isinf, 'isinf')
+isnan = _nunary(jnp.isnan, 'isnan')
+
+
+def logit(x, eps=None, name=None):
+    def fn(v):
+        u = jnp.clip(v, eps, 1 - eps) if eps is not None else v
+        return jnp.log(u / (1 - u))
+    return apply(fn, wrap(x), op_name='logit')
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf,
+                                          neginf=neginf), wrap(x),
+                 op_name='nan_to_num')
+
+
+def clip(x, min=None, max=None, name=None):
+    return apply(lambda v: jnp.clip(v, raw(min), raw(max)), wrap(x),
+                 op_name='clip')
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..core.dtype import convert_dtype
+    return apply(lambda v: jnp.sum(v, axis=axis_tuple(axis),
+                                   dtype=convert_dtype(dtype),
+                                   keepdims=keepdim), wrap(x), op_name='sum')
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    from ..core.dtype import convert_dtype
+    return apply(lambda v: jnp.prod(v, axis=axis_tuple(axis),
+                                    dtype=convert_dtype(dtype),
+                                    keepdims=keepdim), wrap(x),
+                 op_name='prod')
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.max(v, axis=axis_tuple(axis),
+                                   keepdims=keepdim), wrap(x), op_name='max')
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.min(v, axis=axis_tuple(axis),
+                                   keepdims=keepdim), wrap(x), op_name='min')
+
+
+amax = max
+amin = min
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return napply(lambda v: jnp.all(v, axis=axis_tuple(axis),
+                                    keepdims=keepdim), wrap(x), op_name='all')
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return napply(lambda v: jnp.any(v, axis=axis_tuple(axis),
+                                    keepdims=keepdim), wrap(x), op_name='any')
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return napply(lambda v: jnp.count_nonzero(v, axis=axis_tuple(axis),
+                                              keepdims=keepdim), wrap(x),
+                  op_name='count_nonzero')
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def fn(v):
+        return jnp.cumsum(v.ravel() if axis is None else v,
+                          axis=None if axis is None else int(axis))
+    return apply(fn, wrap(x), op_name='cumsum')
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply(lambda v: jnp.cumprod(v, axis=dim), wrap(x),
+                 op_name='cumprod')
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jax.scipy.special.logsumexp(
+        v, axis=axis_tuple(axis), keepdims=keepdim), wrap(x),
+        op_name='logsumexp')
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * (a @ b),
+                 wrap(input), wrap(x), wrap(y), op_name='addmm')
+
+
+def inner(x, y, name=None):
+    return apply(jnp.inner, wrap(x), wrap(y), op_name='inner')
+
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), wrap(x), wrap(y),
+                 op_name='outer')
+
+
+def kron(x, y, name=None):
+    return apply(jnp.kron, wrap(x), wrap(y), op_name='kron')
+
+
+def lerp(x, y, weight, name=None):
+    if np.isscalar(weight):
+        return apply(lambda a, b: a + weight * (b - a), wrap(x), wrap(y),
+                     op_name='lerp')
+    return apply(lambda a, b, w: a + w * (b - a), wrap(x), wrap(y),
+                 wrap(weight), op_name='lerp')
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = raw(scale), raw(bias)
+    def fn(v):
+        return v * s + b if bias_after_scale else (v + b) * s
+    return apply(fn, wrap(x), op_name='scale')
+
+
+def increment(x, value=1.0, name=None):
+    if hasattr(x, '_snapshot'):
+        x._replace(apply(lambda v: v + value, x._snapshot(),
+                         op_name='increment'))
+        return x
+    return apply(lambda v: v + value, wrap(x), op_name='increment')
+
+
+def multiply_(x, y):
+    x._replace(multiply(x._snapshot(), y))
+    return x
+
+
+def diff(x, n=1, axis=-1, name=None):
+    return apply(lambda v: jnp.diff(v, n=n, axis=axis), wrap(x),
+                 op_name='diff')
+
+
+def take(x, index, mode='raise', name=None):
+    return apply(lambda v, i: jnp.take(v.ravel(), i.ravel(), mode=mode)
+                 .reshape(i.shape), wrap(x), wrap(index), op_name='take')

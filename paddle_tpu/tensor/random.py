@@ -1,0 +1,129 @@
+"""Random sampling ops.
+
+Reference analogue: /root/reference/python/paddle/tensor/random.py (cuRAND
+Philox kernels + global generator).  TPU-native: jax.random with the
+explicit global key in core/rng.py — every draw splits the key, so eager
+code matches paddle's stateful-generator feel while staying reproducible.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import rng
+from ..core import dtype as _dt
+from ..core.tensor import Tensor
+from ..core.dtype import convert_dtype, get_default_dtype
+from ._helpers import wrap, raw, normalize_shape as _shape
+
+__all__ = [
+    'rand', 'randn', 'randint', 'randint_like', 'uniform', 'normal',
+    'standard_normal', 'randperm', 'bernoulli', 'multinomial', 'poisson',
+    'shuffle', 'seed', 'uniform_', 'normal_', 'exponential_',
+]
+
+seed = rng.seed
+
+
+def rand(shape, dtype=None, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor._from_value(
+        jax.random.uniform(rng.next_key(), _shape(shape), d))
+
+
+def randn(shape, dtype=None, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor._from_value(
+        jax.random.normal(rng.next_key(), _shape(shape), d))
+
+
+standard_normal = randn
+
+
+def randint(low=0, high=None, shape=(1,), dtype='int64', name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor._from_value(
+        jax.random.randint(rng.next_key(), _shape(shape), low, high,
+                           convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = wrap(x)
+    return randint(low, high, tuple(x.shape), dtype or x.dtype)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor._from_value(
+        jax.random.uniform(rng.next_key(), _shape(shape), d,
+                           minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m, s = raw(mean), raw(std)
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        z = jax.random.normal(rng.next_key(), shp, get_default_dtype())
+        return Tensor._from_value(m + s * z)
+    z = jax.random.normal(rng.next_key(), _shape(shape), get_default_dtype())
+    return Tensor._from_value(mean + std * z)
+
+
+def randperm(n, dtype='int64', name=None):
+    return Tensor._from_value(
+        jax.random.permutation(rng.next_key(), n).astype(convert_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    x = wrap(x)
+    return Tensor._from_value(
+        jax.random.bernoulli(rng.next_key(), x.value).astype(x.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = wrap(x)
+    def draw(p):
+        logits = jnp.log(jnp.maximum(p, 1e-30))
+        if replacement:
+            return jax.random.categorical(rng.next_key(), logits,
+                                          shape=(num_samples,))
+        # Gumbel top-k for sampling without replacement
+        g = jax.random.gumbel(rng.next_key(), logits.shape)
+        return jax.lax.top_k(logits + g, num_samples)[1]
+    v = x.value
+    if v.ndim == 1:
+        out = draw(v)
+    else:
+        out = jnp.stack([draw(v[i]) for i in range(v.shape[0])])
+    return Tensor._from_value(out.astype(_dt.int64))
+
+
+def poisson(x, name=None):
+    x = wrap(x)
+    return Tensor._from_value(
+        jax.random.poisson(rng.next_key(), x.value).astype(x.dtype))
+
+
+def shuffle(x, axis=0):
+    x = wrap(x)
+    return Tensor._from_value(
+        jax.random.permutation(rng.next_key(), x.value, axis=axis,
+                               independent=False))
+
+
+def uniform_(x, min=-1.0, max=1.0):
+    x.set_value(jax.random.uniform(rng.next_key(), tuple(x.shape),
+                                   x.dtype, minval=min, maxval=max))
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0):
+    z = jax.random.normal(rng.next_key(), tuple(x.shape), x.dtype)
+    x.set_value(mean + std * z)
+    return x
+
+
+def exponential_(x, lam=1.0):
+    z = jax.random.exponential(rng.next_key(), tuple(x.shape), x.dtype)
+    x.set_value(z / lam)
+    return x

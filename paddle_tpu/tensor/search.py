@@ -1,0 +1,144 @@
+"""Search/sort/select ops (reference: python/paddle/tensor/search.py)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as _dt
+from ..core.dispatch import apply
+from ._helpers import wrap, napply
+
+__all__ = [
+    'argmax', 'argmin', 'argsort', 'sort', 'topk', 'where', 'nonzero',
+    'index_select', 'index_sample', 'masked_select', 'searchsorted',
+    'kthvalue', 'mode',
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype='int64', name=None):
+    def fn(v):
+        out = jnp.argmax(v, axis=axis)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(_dt.int64)
+    return napply(fn, wrap(x), op_name='argmax')
+
+
+def argmin(x, axis=None, keepdim=False, dtype='int64', name=None):
+    def fn(v):
+        out = jnp.argmin(v, axis=axis)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(_dt.int64)
+    return napply(fn, wrap(x), op_name='argmin')
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def fn(v):
+        o = jnp.argsort(v, axis=axis)
+        return jnp.flip(o, axis=axis) if descending else o
+    return napply(fn, wrap(x), op_name='argsort')
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def fn(v):
+        o = jnp.sort(v, axis=axis)
+        return jnp.flip(o, axis=axis) if descending else o
+    return apply(fn, wrap(x), op_name='sort')
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = wrap(x)
+    kk = int(k.item()) if hasattr(k, 'item') else int(k)
+    def fn(v):
+        ax = axis % v.ndim
+        vm = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vm, kk)
+        else:
+            vals, idx = jax.lax.top_k(-vm, kk)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx.astype(_dt.int64), -1, ax))
+    return apply(fn, x, op_name='topk')
+
+
+def where(condition, x=None, y=None, name=None):
+    cond = wrap(condition)
+    if x is None and y is None:
+        return nonzero(cond, as_tuple=False)
+    return apply(lambda c, a, b: jnp.where(c.astype(bool), a, b),
+                 cond, wrap(x), wrap(y), op_name='where')
+
+
+def nonzero(x, as_tuple=False):
+    # data-dependent output shape → host round-trip (same as reference's
+    # CPU sync in where_index); avoid inside jit paths.
+    v = np.asarray(wrap(x).value)
+    nz = np.nonzero(v)
+    from ..core.tensor import Tensor
+    if as_tuple:
+        return tuple(Tensor(np.asarray(i, dtype=np.int32)) for i in nz)
+    return Tensor(np.stack(nz, axis=1).astype(np.int32))
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply(lambda v, i: jnp.take(v, i.astype(jnp.int32), axis=axis),
+                 wrap(x), wrap(index), op_name='index_select')
+
+
+def index_sample(x, index):
+    return apply(lambda v, i: jnp.take_along_axis(
+        v, i.astype(jnp.int32), axis=1), wrap(x), wrap(index),
+        op_name='index_sample')
+
+
+def masked_select(x, mask, name=None):
+    # data-dependent shape → host round-trip like nonzero
+    from ..core.tensor import Tensor
+    v = np.asarray(wrap(x).value)
+    m = np.asarray(wrap(mask).value).astype(bool)
+    return Tensor(v[np.broadcast_to(m, v.shape)])
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = 'right' if right else 'left'
+    return napply(lambda s, v: jnp.searchsorted(s, v, side=side).astype(
+        jnp.int32 if out_int32 else _dt.int64),
+        wrap(sorted_sequence), wrap(values), op_name='searchsorted')
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        sv = jnp.sort(v, axis=ax)
+        si = jnp.argsort(v, axis=ax)
+        vals = jnp.take(sv, k - 1, axis=ax)
+        idx = jnp.take(si, k - 1, axis=ax).astype(_dt.int64)
+        if keepdim:
+            vals, idx = jnp.expand_dims(vals, ax), jnp.expand_dims(idx, ax)
+        return vals, idx
+    return apply(fn, wrap(x), op_name='kthvalue')
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        sv = jnp.sort(v, axis=ax)
+        n = v.shape[ax]
+        sv_m = jnp.moveaxis(sv, ax, -1)
+        runs = jnp.concatenate(
+            [jnp.ones(sv_m.shape[:-1] + (1,), bool),
+             sv_m[..., 1:] != sv_m[..., :-1]], axis=-1)
+        run_id = jnp.cumsum(runs, axis=-1) - 1
+        counts = jax.nn.one_hot(run_id, n, dtype=jnp.int32).sum(-2)
+        best_run = jnp.argmax(counts, axis=-1)
+        first_idx = jnp.argmax(run_id == best_run[..., None], axis=-1)
+        vals = jnp.take_along_axis(sv_m, first_idx[..., None], -1)[..., 0]
+        idx = jnp.argmax(jnp.moveaxis(v, ax, -1) == vals[..., None],
+                         axis=-1).astype(_dt.int64)
+        if keepdim:
+            vals, idx = vals[..., None], idx[..., None]
+            return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax)
+        return vals, idx
+    return apply(fn, wrap(x), op_name='mode')

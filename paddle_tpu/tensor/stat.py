@@ -1,0 +1,50 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ._helpers import wrap, axis_tuple
+
+__all__ = ['mean', 'std', 'var', 'median', 'quantile', 'nanmean', 'nansum']
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.mean(v, axis=axis_tuple(axis),
+                                    keepdims=keepdim), wrap(x),
+                 op_name='mean')
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda v: jnp.std(v, axis=axis_tuple(axis),
+                                   ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), wrap(x), op_name='std')
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda v: jnp.var(v, axis=axis_tuple(axis),
+                                   ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), wrap(x), op_name='var')
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.median(v, axis=axis_tuple(axis),
+                                      keepdims=keepdim), wrap(x),
+                 op_name='median')
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.quantile(v, jnp.asarray(q),
+                                        axis=axis_tuple(axis),
+                                        keepdims=keepdim), wrap(x),
+                 op_name='quantile')
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.nanmean(v, axis=axis_tuple(axis),
+                                       keepdims=keepdim), wrap(x),
+                 op_name='nanmean')
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.nansum(v, axis=axis_tuple(axis),
+                                      keepdims=keepdim), wrap(x),
+                 op_name='nansum')

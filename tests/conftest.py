@@ -1,0 +1,22 @@
+"""Test config: force an 8-device virtual CPU mesh BEFORE jax imports.
+
+Mirrors the reference's multi-device unittests
+(/root/reference/python/paddle/fluid/tests/unittests/test_collective_*)
+which launch multi-process NCCL groups; here XLA gives us N virtual
+devices in one process.
+"""
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=8').strip()
+os.environ.setdefault('JAX_ENABLE_X64', '0')
+
+# this build's XLA CPU defaults to bf16-ish matmul precision; tests check
+# f32 numerical parity, so force full precision (TPU perf paths pass bf16
+# dtypes explicitly, which this setting does not affect)
+import jax  # noqa: E402
+
+jax.config.update('jax_default_matmul_precision', 'highest')

@@ -1,0 +1,117 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+
+
+def mk(val, stop_gradient=False):
+    t = paddle.to_tensor(val)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = mk([2.0, 3.0])
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+    def test_matches_jax_grad(self):
+        a = np.random.RandomState(0).randn(4, 3).astype('float32')
+        w = np.random.RandomState(1).randn(3, 2).astype('float32')
+
+        def f(aa, ww):
+            return jnp.sum(jnp.tanh(aa @ ww))
+
+        ga, gw = jax.grad(f, argnums=(0, 1))(a, w)
+
+        ta, tw = mk(a), mk(w)
+        loss = paddle.sum(paddle.tanh(paddle.matmul(ta, tw)))
+        loss.backward()
+        np.testing.assert_allclose(ta.grad.numpy(), np.asarray(ga), rtol=1e-5)
+        np.testing.assert_allclose(tw.grad.numpy(), np.asarray(gw), rtol=1e-5)
+
+    def test_grad_accumulation(self):
+        x = mk([1.0, 2.0])
+        y1 = (x * 2).sum()
+        y1.backward()
+        y2 = (x * 3).sum()
+        y2.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_stop_gradient(self):
+        x = mk([1.0, 2.0])
+        y = mk([3.0, 4.0], stop_gradient=True)
+        loss = (x * y).sum()
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 4.0])
+        assert y.grad is None
+
+    def test_detach(self):
+        x = mk([2.0])
+        d = x.detach()
+        assert d.stop_gradient
+        loss = (x * d).sum()
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    def test_diamond_fanout(self):
+        # x used twice: grads must accumulate through both paths
+        x = mk([3.0])
+        y = x * x + x * 2.0
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])  # 2x + 2
+
+    def test_multi_output_op(self):
+        x = mk([[3.0, 1.0, 2.0]])
+        vals, idx = paddle.topk(x, 2)
+        vals.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [[1.0, 0.0, 1.0]])
+
+    def test_no_grad_context(self):
+        x = mk([1.0])
+        with paddle.no_grad():
+            y = x * 5
+        assert y.grad_node is None and y.stop_gradient
+
+    def test_deep_chain(self):
+        x = mk(np.ones(4, np.float32))
+        y = x
+        for _ in range(60):
+            y = y * 1.01
+        loss = y.sum()
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   np.full(4, 1.01 ** 60, np.float32),
+                                   rtol=1e-4)
+
+    def test_non_scalar_backward_with_grad(self):
+        x = mk([1.0, 2.0])
+        y = x * 3.0
+        y.backward(paddle.to_tensor([1.0, 10.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 30.0])
+
+    def test_getitem_grad(self):
+        x = mk([[1.0, 2.0], [3.0, 4.0]])
+        x[0].sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [[1, 1], [0, 0]])
+
+    def test_broadcast_grad(self):
+        x = mk(np.ones((3, 1), np.float32))
+        y = mk(np.ones((1, 4), np.float32))
+        (x + y).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full((3, 1), 4.0))
+        np.testing.assert_allclose(y.grad.numpy(), np.full((1, 4), 3.0))
+
+    def test_intermediate_grads_recorded(self):
+        x = mk([2.0])
+        h = x * 3.0
+        loss = (h * h).sum()
+        loss.backward()
+        np.testing.assert_allclose(h.grad.numpy(), [12.0])
+        np.testing.assert_allclose(x.grad.numpy(), [36.0])

@@ -1,0 +1,221 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def npx(t):
+    return t.numpy()
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.shape == [2, 2]
+        assert str(t.dtype) == 'float32'
+        np.testing.assert_allclose(npx(t), [[1, 2], [3, 4]])
+
+    def test_zeros_ones_full(self):
+        assert npx(paddle.zeros([2, 3])).sum() == 0
+        assert npx(paddle.ones([2, 3])).sum() == 6
+        np.testing.assert_allclose(npx(paddle.full([2], 7.0)), [7, 7])
+        np.testing.assert_allclose(npx(paddle.ones_like(paddle.zeros([3]))),
+                                   [1, 1, 1])
+
+    def test_arange_linspace_eye(self):
+        np.testing.assert_allclose(npx(paddle.arange(0, 5, 1)), np.arange(5))
+        np.testing.assert_allclose(npx(paddle.linspace(0, 1, 5)),
+                                   np.linspace(0, 1, 5), rtol=1e-6)
+        np.testing.assert_allclose(npx(paddle.eye(3)), np.eye(3))
+
+    def test_tril_triu_diag(self):
+        x = paddle.to_tensor(np.arange(9.0).reshape(3, 3))
+        np.testing.assert_allclose(npx(paddle.tril(x)),
+                                   np.tril(np.arange(9.0).reshape(3, 3)))
+        np.testing.assert_allclose(npx(paddle.diag(paddle.to_tensor([1., 2.]))),
+                                   np.diag([1., 2.]))
+
+
+class TestMath:
+    def setup_method(self, _):
+        self.a = np.random.RandomState(0).randn(3, 4).astype('float32')
+        self.b = np.random.RandomState(1).rand(3, 4).astype('float32') + 0.5
+        self.ta = paddle.to_tensor(self.a)
+        self.tb = paddle.to_tensor(self.b)
+
+    def test_binary(self):
+        np.testing.assert_allclose(npx(self.ta + self.tb), self.a + self.b,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(npx(self.ta - self.tb), self.a - self.b,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(npx(self.ta * self.tb), self.a * self.b,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(npx(self.ta / self.tb), self.a / self.b,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(npx(self.ta + 2.5), self.a + 2.5)
+        np.testing.assert_allclose(npx(2.5 - self.ta), 2.5 - self.a)
+        assert (self.ta + 2.5).dtype == self.ta.dtype
+
+    def test_unary(self):
+        np.testing.assert_allclose(npx(paddle.exp(self.ta)), np.exp(self.a),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(npx(paddle.tanh(self.ta)),
+                                   np.tanh(self.a), rtol=1e-4)
+        np.testing.assert_allclose(npx(paddle.abs(self.ta)), np.abs(self.a))
+        np.testing.assert_allclose(npx(paddle.sqrt(self.tb)),
+                                   np.sqrt(self.b), rtol=1e-6)
+
+    def test_reductions(self):
+        np.testing.assert_allclose(npx(paddle.sum(self.ta)), self.a.sum(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(npx(paddle.sum(self.ta, axis=1)),
+                                   self.a.sum(1), rtol=1e-5)
+        np.testing.assert_allclose(npx(paddle.mean(self.ta, axis=0,
+                                                   keepdim=True)),
+                                   self.a.mean(0, keepdims=True), rtol=1e-5)
+        np.testing.assert_allclose(npx(paddle.max(self.ta)), self.a.max())
+        np.testing.assert_allclose(npx(paddle.logsumexp(self.ta)),
+                                   np.log(np.exp(self.a).sum()), rtol=1e-5)
+
+    def test_clip_cumsum(self):
+        np.testing.assert_allclose(npx(paddle.clip(self.ta, -0.5, 0.5)),
+                                   np.clip(self.a, -0.5, 0.5))
+        np.testing.assert_allclose(npx(paddle.cumsum(self.ta, axis=1)),
+                                   np.cumsum(self.a, 1), rtol=1e-5)
+
+    def test_methods(self):
+        np.testing.assert_allclose(npx(self.ta.exp()), np.exp(self.a),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(npx(self.ta.sum(axis=0)), self.a.sum(0),
+                                   rtol=1e-5)
+
+
+class TestManip:
+    def setup_method(self, _):
+        self.a = np.arange(24.0).reshape(2, 3, 4).astype('float32')
+        self.t = paddle.to_tensor(self.a)
+
+    def test_reshape_transpose(self):
+        assert paddle.reshape(self.t, [6, 4]).shape == [6, 4]
+        assert paddle.reshape(self.t, [-1, 12]).shape == [2, 12]
+        np.testing.assert_allclose(npx(paddle.transpose(self.t, [2, 0, 1])),
+                                   self.a.transpose(2, 0, 1))
+        assert paddle.flatten(self.t, 1, 2).shape == [2, 12]
+
+    def test_concat_split_stack(self):
+        c = paddle.concat([self.t, self.t], axis=1)
+        assert c.shape == [2, 6, 4]
+        parts = paddle.split(c, 2, axis=1)
+        assert len(parts) == 2 and parts[0].shape == [2, 3, 4]
+        np.testing.assert_allclose(npx(parts[0]), self.a)
+        parts = paddle.split(self.t, [1, -1], axis=2)
+        assert parts[1].shape == [2, 3, 3]
+        s = paddle.stack([self.t, self.t], axis=0)
+        assert s.shape == [2, 2, 3, 4]
+
+    def test_squeeze_unsqueeze_expand(self):
+        u = paddle.unsqueeze(self.t, [0, 2])
+        assert u.shape == [1, 2, 1, 3, 4]
+        assert paddle.squeeze(u).shape == [2, 3, 4]
+        e = paddle.expand(paddle.to_tensor([[1.0], [2.0]]), [2, 4])
+        assert e.shape == [2, 4]
+
+    def test_gather_scatter(self):
+        x = paddle.to_tensor([[1.0, 2], [3, 4], [5, 6]])
+        idx = paddle.to_tensor([0, 2])
+        np.testing.assert_allclose(npx(paddle.gather(x, idx)),
+                                   [[1, 2], [5, 6]])
+        up = paddle.to_tensor([[9.0, 9], [8, 8]])
+        out = paddle.scatter(x, idx, up)
+        np.testing.assert_allclose(npx(out), [[9, 9], [3, 4], [8, 8]])
+        gnd = paddle.gather_nd(x, paddle.to_tensor([[0, 1], [2, 0]]))
+        np.testing.assert_allclose(npx(gnd), [2, 5])
+
+    def test_tile_flip_roll(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        assert paddle.tile(x, [3]).shape == [6]
+        np.testing.assert_allclose(npx(paddle.flip(x, 0)), [2, 1])
+        np.testing.assert_allclose(npx(paddle.roll(x, 1)), [2, 1])
+
+    def test_indexing(self):
+        t = paddle.to_tensor(self.a)
+        np.testing.assert_allclose(npx(t[0]), self.a[0])
+        np.testing.assert_allclose(npx(t[:, 1:3]), self.a[:, 1:3])
+        t[0, 0, 0] = 99.0
+        assert t.numpy()[0, 0, 0] == 99.0
+
+
+class TestLinalg:
+    def test_matmul(self):
+        a = np.random.RandomState(2).randn(3, 4).astype('float32')
+        b = np.random.RandomState(3).randn(4, 5).astype('float32')
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(npx(out), a @ b, rtol=1e-5)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b.T),
+                            transpose_y=True)
+        np.testing.assert_allclose(npx(out), a @ b, rtol=1e-5)
+
+    def test_norm_einsum(self):
+        a = np.random.RandomState(4).randn(3, 4).astype('float32')
+        np.testing.assert_allclose(npx(paddle.norm(paddle.to_tensor(a))),
+                                   np.linalg.norm(a), rtol=1e-5)
+        out = paddle.einsum('ij,kj->ik', paddle.to_tensor(a),
+                            paddle.to_tensor(a))
+        np.testing.assert_allclose(npx(out), a @ a.T, rtol=1e-5)
+
+
+class TestLogicSearch:
+    def test_compare(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0])
+        y = paddle.to_tensor([3.0, 2.0, 1.0])
+        np.testing.assert_array_equal(npx(x == y), [False, True, False])
+        np.testing.assert_array_equal(npx(x < y), [True, False, False])
+        assert bool(paddle.allclose(x, x))
+
+    def test_argmax_topk_sort(self):
+        x = paddle.to_tensor([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+        np.testing.assert_array_equal(npx(paddle.argmax(x, axis=1)), [0, 1])
+        vals, idx = paddle.topk(x, 2, axis=1)
+        np.testing.assert_allclose(npx(vals), [[3, 2], [5, 4]])
+        np.testing.assert_array_equal(npx(idx), [[0, 2], [1, 2]])
+        np.testing.assert_allclose(npx(paddle.sort(x, axis=1)),
+                                   np.sort(npx(x), 1))
+
+    def test_where_nonzero(self):
+        x = paddle.to_tensor([1.0, -1.0, 2.0])
+        out = paddle.where(x > 0, x, paddle.zeros_like(x))
+        np.testing.assert_allclose(npx(out), [1, 0, 2])
+        nz = paddle.nonzero(paddle.to_tensor([0, 3, 0, 4]))
+        np.testing.assert_array_equal(npx(nz), [[1], [3]])
+        np.testing.assert_allclose(
+            npx(paddle.masked_select(x, x > 0)), [1, 2])
+
+
+class TestRandom:
+    def test_reproducible(self):
+        paddle.seed(42)
+        a = paddle.randn([4, 4])
+        paddle.seed(42)
+        b = paddle.randn([4, 4])
+        np.testing.assert_allclose(npx(a), npx(b))
+        c = paddle.randn([4, 4])
+        assert not np.allclose(npx(b), npx(c))
+
+    def test_shapes_ranges(self):
+        u = paddle.uniform([100], min=2.0, max=3.0)
+        assert npx(u).min() >= 2.0 and npx(u).max() <= 3.0
+        r = paddle.randint(0, 5, [50])
+        assert npx(r).min() >= 0 and npx(r).max() < 5
+        p = paddle.randperm(10)
+        np.testing.assert_array_equal(np.sort(npx(p)), np.arange(10))
+
+
+class TestDtypeDevice:
+    def test_astype(self):
+        x = paddle.to_tensor([1.5, 2.5])
+        assert str(x.astype('int32').dtype) == 'int32'
+        assert str(x.astype(paddle.float16).dtype) == 'float16'
+
+    def test_item_scalar(self):
+        assert paddle.to_tensor(3.0).item() == 3.0
+        assert int(paddle.to_tensor(7)) == 7
