@@ -20,5 +20,10 @@ from .core.rng import seed  # noqa: F401
 from .tensor import *  # noqa: F401,F403
 from .tensor import __all__ as _tensor_all
 
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from .nn.layer.layers import ParamAttr  # noqa: F401
+
 __all__ = ['Tensor', 'Parameter', 'no_grad', 'enable_grad', 'seed',
            'set_device', 'get_device'] + list(_tensor_all)

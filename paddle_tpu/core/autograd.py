@@ -10,6 +10,12 @@ actual math.
 
 The compiled path (paddle_tpu.jit) does NOT use this tape — it traces a
 pure function and uses jax.grad, which is the TPU-fast route.
+
+Like the reference's dygraph engine (and unlike torch), cotangents are
+accumulated into `.grad` of EVERY reachable stop_gradient=False tensor,
+not only leaves — reference code frequently reads intermediate
+`.gradient()`s.  The memory cost lasts only until the tensors die; the
+tape itself is freed at the end of backward().
 """
 import contextlib
 

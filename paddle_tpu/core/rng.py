@@ -10,9 +10,22 @@ import jax
 
 
 class _RngState:
+    """Lazy: the PRNGKey is materialized on first draw, so importing
+    paddle_tpu never forces JAX backend initialization."""
+
     def __init__(self, seed=0):
         self.seed_value = seed
-        self.key = jax.random.PRNGKey(seed)
+        self._key = None
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self.seed_value)
+        return self._key
+
+    @key.setter
+    def key(self, k):
+        self._key = k
 
     def next_key(self):
         self.key, sub = jax.random.split(self.key)
@@ -20,6 +33,28 @@ class _RngState:
 
 
 _state = _RngState(0)
+
+# Functional-key stack: paddle_tpu.jit pushes a traced PRNGKey here while
+# tracing a Layer into a pure function, so stochastic ops (dropout) stay
+# correct under jax.jit instead of baking in a constant eager key.
+_functional_keys = []
+
+
+class functional_key_scope:
+    def __init__(self, key):
+        self.key = key
+
+    def __enter__(self):
+        _functional_keys.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _functional_keys.pop()
+
+    def next(self):
+        import jax
+        self.key, sub = jax.random.split(self.key)
+        return sub
 
 
 def seed(s):
@@ -30,6 +65,8 @@ def seed(s):
 
 
 def next_key():
+    if _functional_keys:
+        return _functional_keys[-1].next()
     return _state.next_key()
 
 

@@ -68,8 +68,12 @@ class Tensor:
     def ndim(self):
         return self.value.ndim
 
-    dim = ndim
-    rank = ndim
+    def dim(self):
+        # method in the reference API (t.dim()), unlike the ndim property
+        return self.value.ndim
+
+    def rank(self):
+        return self.value.ndim
 
     @property
     def size(self):
@@ -195,11 +199,15 @@ class Tensor:
         return t
 
     def _replace(self, other):
-        """Adopt another tensor's value + tape edge (in-place op result)."""
+        """Adopt another tensor's value + tape edge (in-place op result).
+
+        stop_gradient is deliberately NOT copied: mutating a Parameter
+        under no_grad() (weight init patterns) must not silently flip it
+        to untrainable.
+        """
         self.value = other.value
         self.grad_node = other.grad_node
         self.grad_index = other.grad_index
-        self.stop_gradient = other.stop_gradient
         return self
 
     # -- indexing ------------------------------------------------------------

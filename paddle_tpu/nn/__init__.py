@@ -1,0 +1,11 @@
+"""paddle_tpu.nn — layers, functionals, initializers, clipping.
+
+Reference analogue: /root/reference/python/paddle/nn/.
+"""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer.layers import Layer, ParamAttr  # noqa: F401
+from .layer import *  # noqa: F401,F403
+from .clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm)
+from .utils import weight_norm, remove_weight_norm, spectral_norm  # noqa: F401

@@ -1,0 +1,92 @@
+"""Gradient clipping.
+
+Reference analogue: /root/reference/python/paddle/fluid/clip.py.
+TPU-native: pytree-wide global-norm clip as pure jnp — inside a compiled
+train step it fuses into the update; eager mode works on .grad tensors.
+"""
+import jax.numpy as jnp
+
+__all__ = ['ClipGradByValue', 'ClipGradByNorm', 'ClipGradByGlobalNorm']
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        """params_grads: list of (param, grad Tensor) — eager API."""
+        return self._dygraph_clip(params_grads)
+
+    def clip_values(self, grads):
+        """grads: list/pytree of raw jnp arrays — functional API used by
+        the compiled train step."""
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def clip_values(self, grads):
+        return [jnp.clip(g, self.min, self.max) for g in grads]
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or getattr(p, 'need_clip', True) is False:
+                out.append((p, g))
+                continue
+            ng = g.clone()
+            ng.value = jnp.clip(g.value, self.min, self.max)
+            out.append((p, ng))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def clip_values(self, grads):
+        out = []
+        for g in grads:
+            n = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out.append(g * scale)
+        return out
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or getattr(p, 'need_clip', True) is False:
+                out.append((p, g))
+                continue
+            ng = g.clone()
+            ng.value = self.clip_values([g.value])[0]
+            out.append((p, ng))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name='default_group'):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def clip_values(self, grads):
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in grads))
+        scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        return [g * scale for g in grads]
+
+    def _dygraph_clip(self, params_grads):
+        gs = [g.value for p, g in params_grads
+              if g is not None and getattr(p, 'need_clip', True)]
+        if not gs:
+            return params_grads
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in gs))
+        scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or getattr(p, 'need_clip', True) is False:
+                out.append((p, g))
+                continue
+            ng = g.clone()
+            ng.value = g.value * scale
+            out.append((p, ng))
+        return out

@@ -1,0 +1,179 @@
+"""Activation functionals.
+
+Reference analogue: /root/reference/python/paddle/nn/functional/activation.py.
+All are jnp/jax.nn lambdas through the dispatch tape; XLA fuses them into
+surrounding matmuls so there is no reason for the reference's fused
+activation kernels.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...tensor._helpers import wrap
+
+__all__ = [
+    'relu', 'relu6', 'relu_', 'gelu', 'sigmoid', 'softmax', 'log_softmax',
+    'tanh', 'leaky_relu', 'elu', 'selu', 'celu', 'hardswish', 'hardsigmoid',
+    'swish', 'silu', 'mish', 'softplus', 'softsign', 'hardtanh',
+    'hardshrink', 'softshrink', 'tanhshrink', 'prelu', 'glu', 'maxout',
+    'thresholded_relu', 'log_sigmoid', 'gumbel_softmax',
+]
+
+
+def relu(x, name=None):
+    return apply(jax.nn.relu, wrap(x), op_name='relu')
+
+
+def relu_(x, name=None):
+    x._replace(apply(jax.nn.relu, x._snapshot(), op_name='relu'))
+    return x
+
+
+def relu6(x, name=None):
+    return apply(jax.nn.relu6, wrap(x), op_name='relu6')
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda v: jax.nn.gelu(v, approximate=approximate), wrap(x),
+                 op_name='gelu')
+
+
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, wrap(x), op_name='sigmoid')
+
+
+def log_sigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, wrap(x), op_name='log_sigmoid')
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    return apply(lambda v: jax.nn.softmax(v, axis=axis), wrap(x),
+                 op_name='softmax')
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return apply(lambda v: jax.nn.log_softmax(v, axis=axis), wrap(x),
+                 op_name='log_softmax')
+
+
+def tanh(x, name=None):
+    return apply(jnp.tanh, wrap(x), op_name='tanh')
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda v: jax.nn.leaky_relu(v, negative_slope), wrap(x),
+                 op_name='leaky_relu')
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.elu(v, alpha), wrap(x), op_name='elu')
+
+
+def selu(x,
+         scale=1.0507009873554804934193349852946,
+         alpha=1.6732632423543772848170429916717, name=None):
+    return apply(lambda v: scale * jnp.where(
+        v > 0, v, alpha * jnp.expm1(v)), wrap(x), op_name='selu')
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.celu(v, alpha), wrap(x), op_name='celu')
+
+
+def hardswish(x, name=None):
+    return apply(jax.nn.hard_swish, wrap(x), op_name='hardswish')
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5, name=None):
+    return apply(lambda v: jnp.clip(slope * v + offset, 0.0, 1.0), wrap(x),
+                 op_name='hardsigmoid')
+
+
+def swish(x, name=None):
+    return apply(jax.nn.silu, wrap(x), op_name='swish')
+
+
+silu = swish
+
+
+def mish(x, name=None):
+    return apply(lambda v: v * jnp.tanh(jax.nn.softplus(v)), wrap(x),
+                 op_name='mish')
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(lambda v: jnp.where(
+        beta * v > threshold, v, (1.0 / beta) * jax.nn.softplus(beta * v)),
+        wrap(x), op_name='softplus')
+
+
+def softsign(x, name=None):
+    return apply(jax.nn.soft_sign, wrap(x), op_name='softsign')
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda v: jnp.clip(v, min, max), wrap(x),
+                 op_name='hardtanh')
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0),
+                 wrap(x), op_name='hardshrink')
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.where(
+        v > threshold, v - threshold,
+        jnp.where(v < -threshold, v + threshold, 0.0)), wrap(x),
+        op_name='softshrink')
+
+
+def tanhshrink(x, name=None):
+    return apply(lambda v: v - jnp.tanh(v), wrap(x), op_name='tanhshrink')
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply(lambda v: jnp.where(v > threshold, v, 0.0), wrap(x),
+                 op_name='thresholded_relu')
+
+
+def prelu(x, weight, data_format='NCHW', name=None):
+    def fn(v, w):
+        if w.size == 1:
+            return jnp.where(v >= 0, v, w.reshape(()) * v)
+        ch_axis = 1 if data_format == 'NCHW' else v.ndim - 1
+        shape = [1] * v.ndim
+        shape[ch_axis] = w.size
+        return jnp.where(v >= 0, v, w.reshape(shape) * v)
+    return apply(fn, wrap(x), wrap(weight), op_name='prelu')
+
+
+def glu(x, axis=-1, name=None):
+    def fn(v):
+        a, b = jnp.split(v, 2, axis=axis)
+        return a * jax.nn.sigmoid(b)
+    return apply(fn, wrap(x), op_name='glu')
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+    return apply(fn, wrap(x), op_name='maxout')
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import rng
+    def fn(v):
+        g = jax.random.gumbel(rng.next_key(), v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis,
+                                        inplace=False)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+    return apply(fn, wrap(x), op_name='gumbel_softmax')

@@ -1,0 +1,245 @@
+"""Common functionals: linear, dropout, embedding, padding, interpolation.
+
+Reference analogue: /root/reference/python/paddle/nn/functional/common.py.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import rng
+from ...core.dispatch import apply
+from ...tensor._helpers import wrap, raw
+
+__all__ = [
+    'linear', 'dropout', 'dropout2d', 'dropout3d', 'alpha_dropout',
+    'embedding', 'one_hot', 'pad', 'interpolate', 'upsample',
+    'cosine_similarity', 'normalize', 'label_smooth', 'bilinear',
+    'pixel_shuffle', 'unfold',
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    # paddle stores weight as [in, out] — direct MXU matmul, no transpose
+    if bias is not None:
+        return apply(lambda v, w, b: v @ w + b, wrap(x), wrap(weight),
+                     wrap(bias), op_name='linear')
+    return apply(lambda v, w: v @ w, wrap(x), wrap(weight), op_name='linear')
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode='upscale_in_train',
+            name=None):
+    x = wrap(x)
+    if not training or p == 0.0:
+        if mode == 'downscale_in_infer' and not training:
+            return apply(lambda v: v * (1.0 - p), x, op_name='dropout')
+        return x.clone()
+    if p == 1.0:
+        return apply(lambda v: v * 0.0, x, op_name='dropout')
+
+    def fn(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(rng.next_key(), 1.0 - p, tuple(shape))
+        if mode == 'upscale_in_train':
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+
+    return apply(fn, x, op_name='dropout')
+
+
+def dropout2d(x, p=0.5, training=True, data_format='NCHW', name=None):
+    ax = [0, 1] if data_format == 'NCHW' else [0, 3]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format='NCDHW', name=None):
+    ax = [0, 1] if data_format == 'NCDHW' else [0, 4]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = wrap(x)
+    if not training or p == 0.0:
+        return x.clone()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(v):
+        keep = jax.random.bernoulli(rng.next_key(), 1.0 - p, v.shape)
+        a = (1.0 / np.sqrt((1.0 - p) * (1.0 + p * alpha_p ** 2)))
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+
+    return apply(fn, x, op_name='alpha_dropout')
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def fn(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply(fn, wrap(x), wrap(weight), op_name='embedding')
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(lambda v: jax.nn.one_hot(v.astype(jnp.int32), num_classes),
+                 wrap(x), op_name='one_hot')
+
+
+def pad(x, pad, mode='constant', value=0.0, data_format='NCHW', name=None):
+    x = wrap(x)
+    pad = [int(raw(p)) for p in pad] if not isinstance(pad, int) else pad
+
+    def fn(v):
+        nd = v.ndim
+        if isinstance(pad, int):
+            cfg = [(pad, pad)] * nd
+        elif len(pad) == 2 * nd:
+            # paddle flat form: [d0_lo, d0_hi, d1_lo, ...]
+            cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # spatial-only form, ordered last-dim-first pairs (torch style)
+            cfg = [(0, 0)] * nd
+            spatial_dims = list(range(nd - 1, 1, -1)) if data_format[1] == 'C' \
+                else list(range(nd - 2, 0, -1))
+            for i in range(len(pad) // 2):
+                cfg[spatial_dims[i]] = (pad[2 * i], pad[2 * i + 1])
+        jmode = {'constant': 'constant', 'reflect': 'reflect',
+                 'replicate': 'edge', 'circular': 'wrap'}[mode]
+        if jmode == 'constant':
+            return jnp.pad(v, cfg, mode='constant', constant_values=value)
+        return jnp.pad(v, cfg, mode=jmode)
+
+    return apply(fn, x, op_name='pad')
+
+
+def interpolate(x, size=None, scale_factor=None, mode='nearest',
+                align_corners=False, align_mode=0, data_format='NCHW',
+                name=None):
+    x = wrap(x)
+    channel_last = data_format in ('NHWC', 'NWC', 'NDHWC')
+    nd = x.ndim
+    n_sp = nd - 2
+    sp_axes = list(range(1, 1 + n_sp)) if channel_last else \
+        list(range(2, 2 + n_sp))
+    in_sizes = [x.shape[a] for a in sp_axes]
+    if size is not None:
+        size = [int(raw(s)) for s in (size if isinstance(size, (list, tuple))
+                                      else [size])]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+            [scale_factor] * n_sp
+        size = [int(in_sizes[i] * float(sf[i])) for i in range(n_sp)]
+
+    method = {'nearest': 'nearest', 'bilinear': 'linear',
+              'trilinear': 'linear', 'linear': 'linear', 'bicubic': 'cubic',
+              'area': 'linear'}[mode]
+
+    def fn(v):
+        out_shape = list(v.shape)
+        for i, a in enumerate(sp_axes):
+            out_shape[a] = size[i]
+        if method == 'nearest':
+            res = v
+            for i, a in enumerate(sp_axes):
+                idx = (jnp.arange(size[i]) * in_sizes[i] // size[i])
+                res = jnp.take(res, idx, axis=a)
+            return res
+        return jax.image.resize(v, tuple(out_shape), method=method)
+
+    return apply(fn, x, op_name='interpolate')
+
+
+def upsample(x, size=None, scale_factor=None, mode='nearest',
+             align_corners=False, align_mode=0, data_format='NCHW',
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+    return apply(fn, wrap(x1), wrap(x2), op_name='cosine_similarity')
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(v):
+        if p == 2:
+            n = jnp.linalg.norm(v, axis=axis, keepdims=True)
+        else:
+            n = jnp.sum(jnp.abs(v) ** p, axis=axis,
+                        keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+    return apply(fn, wrap(x), op_name='normalize')
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(v):
+        k = v.shape[-1]
+        if prior_dist is not None:
+            pd = raw(prior_dist)
+            return (1 - epsilon) * v + epsilon * pd
+        return (1 - epsilon) * v + epsilon / k
+    return apply(fn, wrap(label), op_name='label_smooth')
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    # weight: [out, in1, in2]
+    def fn(a, b, w, *maybe_bias):
+        out = jnp.einsum('bi,oij,bj->bo', a, w, b)
+        if maybe_bias:
+            out = out + maybe_bias[0]
+        return out
+    if bias is not None:
+        return apply(fn, wrap(x1), wrap(x2), wrap(weight), wrap(bias),
+                     op_name='bilinear')
+    return apply(fn, wrap(x1), wrap(x2), wrap(weight), op_name='bilinear')
+
+
+def pixel_shuffle(x, upscale_factor, data_format='NCHW', name=None):
+    r = int(upscale_factor)
+
+    def fn(v):
+        if data_format == 'NCHW':
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply(fn, wrap(x), op_name='pixel_shuffle')
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from .conv import _tuple
+    ks = _tuple(kernel_sizes, 2)
+    st = _tuple(strides, 2)
+    pd = _tuple(paddings, 2)
+    dl = _tuple(dilations, 2)
+
+    def fn(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, [(0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])])
+        oh = (v.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (v.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                ii, jj = i * dl[0], j * dl[1]
+                patches.append(v[:, :, ii:ii + oh * st[0]:st[0],
+                                 jj:jj + ow * st[1]:st[1]])
+        out = jnp.stack(patches, axis=2)  # [n, c, k*k, oh, ow]
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+
+    return apply(fn, wrap(x), op_name='unfold')

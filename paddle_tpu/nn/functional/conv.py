@@ -1,0 +1,149 @@
+"""Convolution functionals.
+
+Reference analogue: /root/reference/python/paddle/nn/functional/conv.py
+(cuDNN kernels).  TPU-native: one lax.conv_general_dilated call; XLA's
+TPU backend picks MXU-friendly layouts internally, so we keep paddle's
+NCHW/OIHW API contract without a performance penalty.
+"""
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import apply
+from ...tensor._helpers import wrap
+
+__all__ = ['conv1d', 'conv2d', 'conv3d', 'conv1d_transpose',
+           'conv2d_transpose', 'conv3d_transpose']
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in (v if len(v) == n else list(v) * n))
+    return (int(v),) * n
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and not isinstance(padding[0], (list, tuple)):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                for i in range(n)]
+    return [tuple(int(q) for q in p) for p in padding]
+
+
+def _conv(x, w, bias, stride, padding, dilation, groups, n, data_format):
+    channel_last = data_format in ('NHWC', 'NLC', 'NDHWC')
+    stride = _tuple(stride, n)
+    dilation = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    sp = 'DHW'[-n:]
+    dn = (f"N{sp}C", f"OI{sp}", f"N{sp}C") if channel_last else \
+        (f"NC{sp}", f"OI{sp}", f"NC{sp}")
+
+    def fn(v, k, *maybe_b):
+        out = lax.conv_general_dilated(
+            v, k, window_strides=stride, padding=pad,
+            rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups)
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply(fn, wrap(x), wrap(w), wrap(bias), op_name=f'conv{n}d')
+    return apply(fn, wrap(x), wrap(w), op_name=f'conv{n}d')
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format='NCL', name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format='NCHW', name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format='NCDHW', name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format)
+
+
+def _conv_transpose(x, w, bias, stride, padding, output_padding, groups,
+                    dilation, n, data_format):
+    channel_last = data_format in ('NHWC', 'NLC', 'NDHWC')
+    stride = _tuple(stride, n)
+    dilation = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    sp = 'DHW'[-n:]
+    dn = (f"N{sp}C", f"OI{sp}", f"N{sp}C") if channel_last else \
+        (f"NC{sp}", f"OI{sp}", f"NC{sp}")
+    opad = _tuple(output_padding, n) if output_padding else (0,) * n
+
+    def fn(v, k, *maybe_b):
+        # paddle transpose-kernel layout: [in_c, out_c/groups, *sp].
+        # Express the transpose as a regular conv over an lhs-dilated
+        # input with a spatially-flipped, in/out-swapped kernel.
+        ax = tuple(range(2, 2 + n))
+        k2 = jnp.swapaxes(jnp.flip(k, axis=ax), 0, 1)  # [oc/g, in_c, *sp]
+        if groups > 1:
+            oc_g, ic = k2.shape[0], k2.shape[1]
+            k2 = k2.reshape((oc_g, groups, ic // groups) + k2.shape[2:])
+            k2 = jnp.moveaxis(k2, 1, 0).reshape(
+                (groups * oc_g, ic // groups) + k2.shape[3:])
+        ksz = [k.shape[2 + i] for i in range(n)]
+        if isinstance(pad, str):
+            base = [(0, 0)] * n if pad == 'VALID' else [
+                ((ksz[i] - 1) // 2, (ksz[i] - 1) // 2) for i in range(n)]
+        else:
+            base = pad
+        tpad = []
+        for i in range(n):
+            kd = (ksz[i] - 1) * dilation[i]
+            tpad.append((kd - base[i][0], kd - base[i][1] + opad[i]))
+        out = lax.conv_general_dilated(
+            v, k2, window_strides=(1,) * n, padding=tpad,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups)
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply(fn, wrap(x), wrap(w), wrap(bias),
+                     op_name=f'conv{n}d_transpose')
+    return apply(fn, wrap(x), wrap(w), op_name=f'conv{n}d_transpose')
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format='NCL', name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, 1, data_format)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format='NCHW', name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, 2, data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format='NCDHW', name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, 3, data_format)

@@ -1,0 +1,302 @@
+"""Loss functionals.
+
+Reference analogue: /root/reference/python/paddle/nn/functional/loss.py
+(softmax_with_cross_entropy fused kernel etc.).  TPU-native: fused
+log_softmax+gather formulation; XLA keeps it one kernel.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...tensor._helpers import wrap
+
+__all__ = [
+    'cross_entropy', 'softmax_with_cross_entropy', 'binary_cross_entropy',
+    'binary_cross_entropy_with_logits', 'mse_loss', 'l1_loss', 'nll_loss',
+    'kl_div', 'smooth_l1_loss', 'margin_ranking_loss', 'ctc_loss',
+    'hinge_embedding_loss', 'cosine_embedding_loss', 'square_error_cost',
+    'sigmoid_focal_loss', 'log_loss',
+]
+
+
+def _reduce(v, reduction):
+    if reduction == 'mean':
+        return jnp.mean(v)
+    if reduction == 'sum':
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction='mean', soft_label=False, axis=-1,
+                  use_softmax=True, name=None):
+    ins = [wrap(input), wrap(label)]
+    if weight is not None:
+        ins.append(wrap(weight))
+
+    def fn(logits, lab, *maybe_w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        if soft_label:
+            per = -jnp.sum(lab * logp, axis=axis)
+            if maybe_w:
+                per = per * jnp.sum(lab * maybe_w[0], axis=axis)
+            return _reduce(per, reduction)
+        lab_i = lab.astype(jnp.int32)
+        if lab_i.ndim == logp.ndim:
+            lab_i = jnp.squeeze(lab_i, axis=axis)
+        safe = jnp.where(lab_i == ignore_index, 0, lab_i)
+        per = -jnp.take_along_axis(
+            logp, safe[..., None], axis=axis)[..., 0]
+        mask = (lab_i != ignore_index)
+        per = jnp.where(mask, per, 0.0)
+        if maybe_w:
+            w = maybe_w[0][safe]
+            per = per * jnp.where(mask, w, 0.0)
+            if reduction == 'mean':
+                denom = jnp.sum(jnp.where(mask, w, 0.0))
+                return jnp.sum(per) / jnp.maximum(denom, 1e-12)
+        if reduction == 'mean':
+            denom = jnp.maximum(jnp.sum(mask.astype(logp.dtype)), 1.0)
+            return jnp.sum(per) / denom
+        return _reduce(per, reduction)
+
+    return apply(fn, *ins, op_name='cross_entropy')
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction='none',
+                         axis=axis)
+    from .activation import softmax as _softmax
+    # reference keeps the trailing 1-dim on hard labels
+    if not soft_label:
+        from ...tensor.manipulation import unsqueeze
+        loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction='mean',
+                         name=None):
+    ins = [wrap(input), wrap(label)]
+    if weight is not None:
+        ins.append(wrap(weight))
+
+    def fn(p, y, *maybe_w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        per = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if maybe_w:
+            per = per * maybe_w[0]
+        return _reduce(per, reduction)
+
+    return apply(fn, *ins, op_name='binary_cross_entropy')
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction='mean', pos_weight=None,
+                                     name=None):
+    ins = [wrap(logit), wrap(label)]
+    if weight is not None:
+        ins.append(wrap(weight))
+    if pos_weight is not None:
+        ins.append(wrap(pos_weight))
+
+    def fn(z, y, *extra):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extra[i]; i += 1
+        if pos_weight is not None:
+            pw = extra[i]
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight
+        if pw is not None:
+            log_sig = jax.nn.log_sigmoid(z)
+            log_sig_neg = jax.nn.log_sigmoid(-z)
+            per = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        else:
+            per = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if w is not None:
+            per = per * w
+        return _reduce(per, reduction)
+
+    return apply(fn, *ins, op_name='bce_with_logits')
+
+
+def mse_loss(input, label, reduction='mean', name=None):
+    return apply(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                 wrap(input), wrap(label), op_name='mse_loss')
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), wrap(input), wrap(label),
+                 op_name='square_error_cost')
+
+
+def l1_loss(input, label, reduction='mean', name=None):
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                 wrap(input), wrap(label), op_name='l1_loss')
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction='mean',
+             name=None):
+    ins = [wrap(input), wrap(label)]
+    if weight is not None:
+        ins.append(wrap(weight))
+
+    def fn(logp, lab, *maybe_w):
+        lab_i = lab.astype(jnp.int32)
+        safe = jnp.where(lab_i == ignore_index, 0, lab_i)
+        per = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        mask = lab_i != ignore_index
+        per = jnp.where(mask, per, 0.0)
+        if maybe_w:
+            w = maybe_w[0][safe] * mask.astype(logp.dtype)
+            if reduction == 'mean':
+                return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1e-12)
+            per = per * w
+        if reduction == 'mean':
+            return jnp.sum(per) / jnp.maximum(
+                jnp.sum(mask.astype(logp.dtype)), 1.0)
+        return _reduce(per, reduction)
+
+    return apply(fn, *ins, op_name='nll_loss')
+
+
+def kl_div(input, label, reduction='mean', name=None):
+    def fn(logp, y):
+        per = y * (jnp.log(jnp.maximum(y, 1e-30)) - logp)
+        if reduction == 'batchmean':
+            return jnp.sum(per) / logp.shape[0]
+        return _reduce(per, reduction)
+    return apply(fn, wrap(input), wrap(label), op_name='kl_div')
+
+
+def smooth_l1_loss(input, label, reduction='mean', delta=1.0, name=None):
+    def fn(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        per = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+        return _reduce(per, reduction)
+    return apply(fn, wrap(input), wrap(label), op_name='smooth_l1_loss')
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction='mean',
+                        name=None):
+    def fn(a, b, y):
+        per = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce(per, reduction)
+    return apply(fn, wrap(input), wrap(other), wrap(label),
+                 op_name='margin_ranking_loss')
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction='mean',
+                         name=None):
+    def fn(a, y):
+        per = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(per, reduction)
+    return apply(fn, wrap(input), wrap(label),
+                 op_name='hinge_embedding_loss')
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction='mean', name=None):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        per = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(per, reduction)
+    return apply(fn, wrap(input1), wrap(input2), wrap(label),
+                 op_name='cosine_embedding_loss')
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction='sum', name=None):
+    ins = [wrap(logit), wrap(label)]
+    if normalizer is not None:
+        ins.append(wrap(normalizer))
+
+    def fn(z, y, *maybe_n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        per = a_t * ((1 - p_t) ** gamma) * ce
+        if maybe_n:
+            per = per / maybe_n[0]
+        return _reduce(per, reduction)
+
+    return apply(fn, *ins, op_name='sigmoid_focal_loss')
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def fn(p, y):
+        return -(y * jnp.log(p + epsilon) +
+                 (1 - y) * jnp.log(1 - p + epsilon))
+    return apply(fn, wrap(input), wrap(label), op_name='log_loss')
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction='mean'):
+    """CTC via the standard forward algorithm in log space, lax.scan over
+    time — compiler-friendly (no per-step Python), cf. the reference's
+    warp-ctc kernel (paddle/fluid/operators/warpctc_op.cc)."""
+    def fn(lp, lab, in_len, lab_len):
+        # lp: [T, B, C] log-probs; lab: [B, S]
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        L = 2 * S + 1
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+
+        init = jnp.full((B, L), neg_inf)
+        init = init.at[:, 0].set(lp[0, :, blank])
+        init = init.at[:, 1].set(
+            jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0])
+
+        same = jnp.concatenate(
+            [jnp.ones((B, 2), bool),
+             ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, xs):
+            lp_t, t = xs
+            a0 = alpha
+            a1 = jnp.concatenate([jnp.full((B, 1), neg_inf),
+                                  alpha[:, :-1]], axis=1)
+            a2 = jnp.concatenate([jnp.full((B, 2), neg_inf),
+                                  alpha[:, :-2]], axis=1)
+            a2 = jnp.where(same, neg_inf, a2)
+            m = jnp.maximum(jnp.maximum(a0, a1), a2)
+            s = (jnp.exp(a0 - m) + jnp.exp(a1 - m) + jnp.exp(a2 - m))
+            merged = m + jnp.log(jnp.maximum(s, 1e-37))
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            new = merged + emit
+            # freeze rows whose sequence already ended (t >= input_length)
+            active = (t < in_len.astype(jnp.int32))[:, None]
+            return jnp.where(active, new, alpha), None
+
+        alpha_T, _ = jax.lax.scan(
+            step, init, (lp[1:], jnp.arange(1, T, dtype=jnp.int32)))
+        # final: sum of positions L-1 and L-2 (adjusted by label length)
+        idx_last = 2 * lab_len.astype(jnp.int32)
+        idx_prev = idx_last - 1
+        aL = jnp.take_along_axis(alpha_T, idx_last[:, None], axis=1)[:, 0]
+        aP = jnp.take_along_axis(alpha_T, jnp.maximum(idx_prev, 0)[:, None],
+                                 axis=1)[:, 0]
+        m = jnp.maximum(aL, aP)
+        ll = m + jnp.log(jnp.exp(aL - m) + jnp.exp(aP - m))
+        per = -ll
+        if reduction == 'mean':
+            return jnp.mean(per / jnp.maximum(lab_len.astype(lp.dtype), 1.0))
+        return _reduce(per, reduction)
+
+    return apply(fn, wrap(log_probs), wrap(labels), wrap(input_lengths),
+                 wrap(label_lengths), op_name='ctc_loss')

@@ -1,0 +1,203 @@
+"""Normalization functionals.
+
+Reference analogue: /root/reference/python/paddle/nn/functional/norm.py
+(cuDNN batch-norm kernels).  TPU-native: plain jnp reductions — XLA fuses
+mean/var/normalize into one or two HBM passes; the Pallas fused layer_norm
+in paddle_tpu.ops.pallas is substituted on TPU for the hot path.
+"""
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...tensor._helpers import wrap
+
+__all__ = ['batch_norm', 'layer_norm', 'instance_norm', 'group_norm',
+           'local_response_norm']
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format='NCHW', use_global_stats=None, name=None):
+    """Returns the normalized tensor; updates running stats in-place on the
+    passed Tensors when training (eager semantics, like the reference)."""
+    x = wrap(x)
+    channel_last = data_format in ('NHWC', 'NLC', 'NDHWC')
+    ch_axis = x.ndim - 1 if channel_last else min(1, x.ndim - 1)
+    red_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_batch_stats = training and not use_global_stats
+
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+
+    if use_batch_stats:
+        def fn(v, w, b):
+            mean = jnp.mean(v, axis=red_axes)
+            var = jnp.var(v, axis=red_axes)
+            inv = jnp.reshape(1.0 / jnp.sqrt(var + epsilon), shape)
+            out = (v - mean.reshape(shape)) * inv
+            if w is not None:
+                out = out * w.reshape(shape)
+            if b is not None:
+                out = out + b.reshape(shape)
+            return out, mean, var
+
+        args = [x]
+        w_t = wrap(weight) if weight is not None else None
+        b_t = wrap(bias) if bias is not None else None
+
+        def fn2(v, *wb):
+            w = wb[0] if w_t is not None else None
+            b = wb[-1] if b_t is not None else None
+            return fn(v, w, b)
+
+        ins = [t for t in (x, w_t, b_t) if t is not None]
+        out, mean, var = apply(fn2, *ins, op_name='batch_norm')
+        # eager running-stat update (paddle: moving average with momentum)
+        if running_mean is not None:
+            running_mean.set_value(momentum * running_mean.value +
+                                   (1 - momentum) * mean.value)
+        if running_var is not None:
+            n = 1
+            for i in red_axes:
+                n *= x.shape[i]
+            unbiased = var.value * (n / max(n - 1, 1))
+            running_var.set_value(momentum * running_var.value +
+                                  (1 - momentum) * unbiased)
+        return out
+
+    rm, rv = wrap(running_mean), wrap(running_var)
+
+    def fn_eval(v, m, s, *wb):
+        inv = jnp.reshape(1.0 / jnp.sqrt(s + epsilon), shape)
+        out = (v - m.reshape(shape)) * inv
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    ins = [x, rm, rv]
+    if weight is not None:
+        ins.append(wrap(weight))
+    if bias is not None:
+        ins.append(wrap(bias))
+    return apply(fn_eval, *ins, op_name='batch_norm')
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    x = wrap(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(tuple(normalized_shape))
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+
+    def fn(v, *wb):
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) / jnp.sqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    ins = [x]
+    if weight is not None:
+        ins.append(wrap(weight))
+    if bias is not None:
+        ins.append(wrap(bias))
+    return apply(fn, *ins, op_name='layer_norm')
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  eps=1e-5, data_format='NCHW', name=None):
+    x = wrap(x)
+    channel_last = data_format in ('NHWC', 'NLC', 'NDHWC')
+    ch_axis = x.ndim - 1 if channel_last else 1
+    red_axes = tuple(i for i in range(2, x.ndim)) if not channel_last else \
+        tuple(i for i in range(1, x.ndim - 1))
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+
+    def fn(v, *wb):
+        mean = jnp.mean(v, axis=red_axes, keepdims=True)
+        var = jnp.var(v, axis=red_axes, keepdims=True)
+        out = (v - mean) / jnp.sqrt(var + eps)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    ins = [x]
+    if weight is not None:
+        ins.append(wrap(weight))
+    if bias is not None:
+        ins.append(wrap(bias))
+    return apply(fn, *ins, op_name='instance_norm')
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format='NCHW', name=None):
+    x = wrap(x)
+    channel_last = data_format in ('NHWC', 'NLC', 'NDHWC')
+
+    def fn(v, *wb):
+        if channel_last:
+            v_t = jnp.moveaxis(v, -1, 1)
+        else:
+            v_t = v
+        n, c = v_t.shape[0], v_t.shape[1]
+        g = num_groups
+        grouped = v_t.reshape((n, g, c // g) + v_t.shape[2:])
+        axes = tuple(range(2, grouped.ndim))
+        mean = jnp.mean(grouped, axis=axes, keepdims=True)
+        var = jnp.var(grouped, axis=axes, keepdims=True)
+        out = ((grouped - mean) / jnp.sqrt(var + epsilon)).reshape(v_t.shape)
+        shape = [1] * v_t.ndim
+        shape[1] = c
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    ins = [x]
+    if weight is not None:
+        ins.append(wrap(weight))
+    if bias is not None:
+        ins.append(wrap(bias))
+    return apply(fn, *ins, op_name='group_norm')
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format='NCHW', name=None):
+    x = wrap(x)
+    channel_last = data_format in ('NHWC', 'NLC', 'NDHWC')
+    ch_axis = x.ndim - 1 if channel_last else 1
+
+    def fn(v):
+        sq = jnp.square(v)
+        half = size // 2
+        pads = [(0, 0)] * v.ndim
+        pads[ch_axis] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(v)
+        for i in range(size):
+            sl = [slice(None)] * v.ndim
+            sl[ch_axis] = slice(i, i + v.shape[ch_axis])
+            acc = acc + padded[tuple(sl)]
+        return v / jnp.power(k + alpha * acc, beta)
+
+    return apply(fn, x, op_name='local_response_norm')

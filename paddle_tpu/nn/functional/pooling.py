@@ -1,0 +1,173 @@
+"""Pooling functionals via lax.reduce_window.
+
+Reference analogue: /root/reference/python/paddle/nn/functional/pooling.py.
+"""
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import apply
+from ...tensor._helpers import wrap
+from .conv import _tuple, _padding
+
+__all__ = [
+    'avg_pool1d', 'avg_pool2d', 'avg_pool3d', 'max_pool1d', 'max_pool2d',
+    'max_pool3d', 'adaptive_avg_pool1d', 'adaptive_avg_pool2d',
+    'adaptive_avg_pool3d', 'adaptive_max_pool1d', 'adaptive_max_pool2d',
+    'adaptive_max_pool3d',
+]
+
+
+def _pool(x, ksize, stride, padding, n, data_format, kind, exclusive=True,
+          ceil_mode=False):
+    channel_last = data_format in ('NHWC', 'NLC', 'NDHWC')
+    ksize = _tuple(ksize, n)
+    stride = _tuple(stride if stride is not None else ksize, n)
+    pad = _padding(padding, n)
+    if isinstance(pad, str):
+        pad = [(0, 0)] * n if pad == 'VALID' else None  # None → SAME later
+    sp_axes = tuple(range(1, 1 + n)) if channel_last else \
+        tuple(range(2, 2 + n))
+
+    if ceil_mode and pad is not None:
+        # extend high padding so partial windows are kept; reduce_window
+        # pads with the reduction's init value (-inf for max, 0 for add),
+        # and the exclusive-avg count window sees the same pads, so the
+        # divisor stays correct.
+        x_shape = list(wrap(x).shape)
+        pad = list(pad)
+        for i, ax in enumerate(sp_axes):
+            size = x_shape[ax] + pad[i][0] + pad[i][1]
+            rem = (size - ksize[i]) % stride[i]
+            if rem:
+                pad[i] = (pad[i][0], pad[i][1] + stride[i] - rem)
+
+    def expand(vals, one):
+        full = [one] * (n + 2)
+        for i, ax in enumerate(sp_axes):
+            full[ax] = vals[i]
+        return tuple(full)
+
+    window = expand(ksize, 1)
+    strides = expand(stride, 1)
+    if pad is None:
+        pads = 'SAME'
+    else:
+        pads = expand(pad, (0, 0))
+
+    def fn(v):
+        if kind == 'max':
+            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else \
+                jnp.iinfo(v.dtype).min
+            return lax.reduce_window(v, init, lax.max, window, strides,
+                                     pads)
+        s = lax.reduce_window(v, 0.0, lax.add, window, strides, pads)
+        if exclusive:
+            ones = jnp.ones_like(v)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides,
+                                    pads)
+            return s / cnt
+        return s / float(np.prod(ksize))
+
+    return apply(fn, wrap(x), op_name=f'{kind}_pool{n}d')
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, 'NCL', 'avg', exclusive,
+                 ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format='NCHW',
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, 'avg',
+                 exclusive, ceil_mode)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format='NCDHW',
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, 'avg',
+                 exclusive, ceil_mode)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, 'NCL', 'max',
+                 ceil_mode=ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format='NCHW', name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, 'max',
+                 ceil_mode=ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format='NCDHW', name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, 'max',
+                 ceil_mode=ceil_mode)
+
+
+def _adaptive(x, output_size, n, kind, data_format):
+    channel_last = data_format in ('NHWC', 'NLC', 'NDHWC')
+    out = _tuple(output_size, n)
+    sp_axes = tuple(range(1, 1 + n)) if channel_last else \
+        tuple(range(2, 2 + n))
+
+    def fn(v):
+        res = v
+        # adaptive pooling = split each spatial dim into output_size bins;
+        # when divisible this is a plain reduce_window (the common case)
+        for i, ax in enumerate(sp_axes):
+            size = res.shape[ax]
+            if out[i] == 1:
+                res = (jnp.max if kind == 'max' else jnp.mean)(
+                    res, axis=ax, keepdims=True)
+            elif size % out[i] == 0:
+                k = size // out[i]
+                shp = res.shape[:ax] + (out[i], k) + res.shape[ax + 1:]
+                res = (jnp.max if kind == 'max' else jnp.mean)(
+                    res.reshape(shp), axis=ax + 1)
+            else:
+                # uneven bins: gather-based windows (rare path)
+                starts = [int(np.floor(j * size / out[i]))
+                          for j in range(out[i])]
+                ends = [int(np.ceil((j + 1) * size / out[i]))
+                        for j in range(out[i])]
+                chunks = []
+                for s_, e_ in zip(starts, ends):
+                    sl = [np.s_[:]] * res.ndim
+                    sl[ax] = np.s_[s_:e_]
+                    red = (jnp.max if kind == 'max' else jnp.mean)(
+                        res[tuple(sl)], axis=ax, keepdims=True)
+                    chunks.append(red)
+                res = jnp.concatenate(chunks, axis=ax)
+        return res
+
+    return apply(fn, wrap(x), op_name=f'adaptive_{kind}_pool{n}d')
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, 'avg', 'NCL')
+
+
+def adaptive_avg_pool2d(x, output_size, data_format='NCHW', name=None):
+    return _adaptive(x, output_size, 2, 'avg', data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format='NCDHW', name=None):
+    return _adaptive(x, output_size, 3, 'avg', data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, 'max', 'NCL')
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, 'max', 'NCHW')
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, 'max', 'NCDHW')

@@ -1,0 +1,163 @@
+"""Weight initializers.
+
+Reference analogue: /root/reference/python/paddle/nn/initializer/ and
+fluid/initializer.py.  TPU-native: each initializer is a pure function of
+(shape, dtype, PRNGKey); eager mode pulls keys from the global generator,
+so paddle.seed() reproduces full init sequences.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import rng
+from ...core.dtype import convert_dtype, get_default_dtype
+
+__all__ = [
+    'Initializer', 'Constant', 'Normal', 'TruncatedNormal', 'Uniform',
+    'XavierNormal', 'XavierUniform', 'KaimingNormal', 'KaimingUniform',
+    'Assign', 'calculate_gain', 'set_global_initializer',
+]
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels [out_c, in_c, *spatial] (paddle layout)
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+def calculate_gain(nonlinearity, param=None):
+    gains = {'sigmoid': 1.0, 'linear': 1.0, 'conv1d': 1.0, 'conv2d': 1.0,
+             'conv3d': 1.0, 'tanh': 5.0 / 3.0, 'relu': math.sqrt(2.0),
+             'leaky_relu': math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
+             'selu': 3.0 / 4.0}
+    return gains[nonlinearity]
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None, key=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        if key is None:
+            key = rng.next_key()
+        return self._generate(tuple(shape), dtype, key)
+
+    def _generate(self, shape, dtype, key):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def _generate(self, shape, dtype, key):
+        return jnp.full(shape, self.value, dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def _generate(self, shape, dtype, key):
+        return self.mean + self.std * jax.random.normal(key, shape, dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def _generate(self, shape, dtype, key):
+        z = jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
+        return self.mean + self.std * z
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def _generate(self, shape, dtype, key):
+        return jax.random.uniform(key, shape, dtype, self.low, self.high)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _generate(self, shape, dtype, key):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return std * jax.random.normal(key, shape, dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _generate(self, shape, dtype, key):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0,
+                 nonlinearity='relu'):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _generate(self, shape, dtype, key):
+        fi = self.fan_in if self.fan_in is not None else _fans(shape)[0]
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        return std * jax.random.normal(key, shape, dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0,
+                 nonlinearity='relu'):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _generate(self, shape, dtype, key):
+        fi = self.fan_in if self.fan_in is not None else _fans(shape)[0]
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.assigned = value
+
+    def _generate(self, shape, dtype, key):
+        v = self.assigned
+        v = v.value if hasattr(v, 'value') else jnp.asarray(np.asarray(v))
+        return jnp.reshape(v.astype(dtype), shape)
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    global _global_weight_init, _global_bias_init
+    _global_weight_init, _global_bias_init = weight_init, bias_init
+
+
+def get_default_init(is_bias):
+    if is_bias:
+        return _global_bias_init or Constant(0.0)
+    return _global_weight_init or XavierNormal()

@@ -1,0 +1,343 @@
+"""nn.Layer — the module system.
+
+Reference analogue: /root/reference/python/paddle/fluid/dygraph/layers.py
+(class Layer).  TPU-native addition: `functional_state()` /
+`load_functional_state()` expose the whole parameter/buffer tree as a
+JAX pytree so paddle_tpu.jit can close a Layer into a pure function for
+XLA compilation — the reference has no such path because its executor
+walks a C++ graph instead.
+"""
+import collections
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, Parameter
+from ...core.dtype import convert_dtype, get_default_dtype
+from ..initializer import get_default_init
+
+
+class ParamAttr:
+    """Reference analogue: python/paddle/fluid/param_attr.py."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None or isinstance(attr, ParamAttr):
+            return attr
+        if attr is False:
+            return False
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        # bare initializer
+        return ParamAttr(initializer=attr)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype='float32'):
+        self._dtype = convert_dtype(dtype) or get_default_dtype()
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self.training = True
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # -- attribute magic -----------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get('_parameters')
+        layers = self.__dict__.get('_sub_layers')
+        buffers = self.__dict__.get('_buffers')
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError(
+                    "call Layer.__init__ before assigning parameters")
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError(
+                    "call Layer.__init__ before assigning sublayers")
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            layers[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None:
+                params.pop(name, None)
+            if layers is not None:
+                layers.pop(name, None)
+            if buffers is not None and isinstance(value, Tensor):
+                if name in buffers:
+                    buffers[name] = value
+                    return
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ('_parameters', '_sub_layers', '_buffers'):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ('_parameters', '_sub_layers', '_buffers'):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # -- parameter management ------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = convert_dtype(dtype) or self._dtype
+        init = None
+        if attr is not None and attr.initializer is not None:
+            init = attr.initializer
+        elif default_initializer is not None:
+            init = default_initializer
+        else:
+            init = get_default_init(is_bias)
+        value = init(tuple(int(s) for s in shape), dtype)
+        p = Parameter(value, name=attr.name if attr else None,
+                      trainable=attr.trainable if attr else True)
+        if attr is not None:
+            p.optimize_attr = {'learning_rate': attr.learning_rate}
+            p.regularizer = attr.regularizer
+            p.need_clip = attr.need_clip
+        return p
+
+    def add_parameter(self, name, parameter):
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # -- traversal -----------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix='', include_sublayers=True):
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix,
+                                                include_self=True):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{name}.{pname}" if name else pname), p
+            if not include_sublayers:
+                break
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix='', include_sublayers=True):
+        for name, layer in self.named_sublayers(prefix=prefix,
+                                                include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None:
+                    continue
+                yield (f"{name}.{bname}" if name else bname), b
+            if not include_sublayers:
+                break
+
+    def children(self):
+        return list(self._sub_layers.values())
+
+    def named_children(self):
+        return list(self._sub_layers.items())
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix='', include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_sublayers(prefix=sub_prefix,
+                                           include_self=True)
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # -- train / eval --------------------------------------------------------
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   use_hook=True):
+        dest = destination if destination is not None else \
+            collections.OrderedDict()
+        for name, p in self.named_parameters():
+            dest[name] = p
+        for name, b in self.named_buffers():
+            leaf = name.rsplit('.', 1)[-1]
+            owner = self._locate_owner(name)
+            if leaf in owner._non_persistable_buffer_names:
+                continue
+            dest[name] = b
+        return dest
+
+    def _locate_owner(self, qualname):
+        obj = self
+        parts = qualname.split('.')[:-1]
+        for p in parts:
+            obj = obj._sub_layers.get(p, obj) if isinstance(obj, Layer) \
+                else obj
+        return obj if isinstance(obj, Layer) else self
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, target in own.items():
+            if name not in state_dict:
+                missing.append(name)
+                continue
+            src = state_dict[name]
+            v = src.value if isinstance(src, Tensor) else jnp.asarray(
+                np.asarray(src))
+            if tuple(v.shape) != tuple(target.value.shape):
+                raise ValueError(
+                    f"shape mismatch for {name}: {v.shape} vs "
+                    f"{target.value.shape}")
+            target.set_value(v)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # -- functional bridge (TPU compile path) --------------------------------
+    def functional_state(self):
+        """(params, buffers) as name→jnp.ndarray dicts — a JAX pytree."""
+        params = {n: p.value for n, p in self.named_parameters()}
+        buffers = {n: b.value for n, b in self.named_buffers()}
+        return params, buffers
+
+    def load_functional_state(self, params=None, buffers=None):
+        """Write raw arrays back into the live Parameters/buffers."""
+        if params:
+            live = dict(self.named_parameters())
+            for n, v in params.items():
+                live[n].value = v
+        if buffers:
+            live = dict(self.named_buffers())
+            for n, v in buffers.items():
+                live[n].value = v
+
+    # -- dtype migration (AMP O2) -------------------------------------------
+    def to(self, dtype=None):
+        if dtype is not None:
+            d = convert_dtype(dtype)
+            for p in self.parameters():
+                p.value = p.value.astype(d)
+            for b in self.buffers():
+                if jnp.issubdtype(b.value.dtype, jnp.floating):
+                    b.value = b.value.astype(d)
+            for layer in self.sublayers(include_self=True):
+                layer._dtype = d
+        return self
+
+    float = lambda self: self.to('float32')  # noqa: E731
+
+    # -- hooks ---------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        handle = HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # -- call ----------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            out = hook(self, inputs, outputs)
+            if out is not None:
+                outputs = out
+        return outputs
+
+    def extra_repr(self):
+        return ''
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split('\n')
+            sub_repr = '\n  '.join(sub_repr)
+            lines.append(f"({name}): {sub_repr}")
+        body = ''
+        if lines:
+            body = '\n  ' + '\n  '.join(lines) + '\n'
+        return f"{type(self).__name__}({extra}{body})"
+
+
+class HookRemoveHelper:
+    _next_id = 0
+
+    def __init__(self, hooks_dict):
+        self._hooks = hooks_dict
+        self.id = HookRemoveHelper._next_id
+        HookRemoveHelper._next_id += 1
+
+    def remove(self):
+        self._hooks.pop(self.id, None)

@@ -1,0 +1,366 @@
+"""Recurrent layers.
+
+Reference analogue: /root/reference/python/paddle/nn/layer/rnn.py (cuDNN
+RNN kernels + per-step dygraph loop).  TPU-native: the WHOLE sequence is
+one lax.scan — a single XLA while-loop with fused cell math, no per-step
+Python dispatch, fully differentiable (scan has a native VJP).
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...tensor._helpers import wrap
+from ..initializer import Uniform
+from .layers import Layer
+
+__all__ = ['SimpleRNNCell', 'LSTMCell', 'GRUCell', 'RNN', 'BiRNN',
+           'SimpleRNN', 'LSTM', 'GRU']
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0):
+        from ...tensor.creation import full
+        batch = batch_ref.shape[0]
+        return full([batch, self.hidden_size], init_value,
+                    dtype or 'float32')
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation='tanh',
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def _step(self, x, h, w_ih, w_hh, b_ih, b_hh):
+        act = jnp.tanh if self.activation == 'tanh' else jax.nn.relu
+        return act(x @ w_ih.T + b_ih + h @ w_hh.T + b_hh)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out = apply(self._step, wrap(inputs), wrap(states), self.weight_ih,
+                    self.weight_hh, self.bias_ih, self.bias_hh,
+                    op_name='simple_rnn_cell')
+        return out, out
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    @staticmethod
+    def _step(x, h, c, w_ih, w_hh, b_ih, b_hh):
+        gates = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c2 = f * c + i * g
+        h2 = o * jnp.tanh(c2)
+        return h2, c2
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+        h2, c2 = apply(self._step, wrap(inputs), wrap(h), wrap(c),
+                       self.weight_ih, self.weight_hh, self.bias_ih,
+                       self.bias_hh, op_name='lstm_cell')
+        return h2, (h2, c2)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    @staticmethod
+    def _step(x, h, w_ih, w_hh, b_ih, b_hh):
+        gi = x @ w_ih.T + b_ih
+        gh = h @ w_hh.T + b_hh
+        ir, iz, ig = jnp.split(gi, 3, axis=-1)
+        hr, hz, hg = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        g = jnp.tanh(ig + r * hg)
+        return (1 - z) * g + z * h
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h2 = apply(self._step, wrap(inputs), wrap(states), self.weight_ih,
+                   self.weight_hh, self.bias_ih, self.bias_hh,
+                   op_name='gru_cell')
+        return h2, h2
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+def _scan_layer(cell_kind, x, h0, c0, w_ih, w_hh, b_ih, b_hh, reverse,
+                time_major):
+    """One direction of one recurrent layer as a single lax.scan."""
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)  # [T, B, F]
+    if reverse:
+        x = jnp.flip(x, 0)
+
+    if cell_kind == 'LSTM':
+        def step(carry, xt):
+            h, c = carry
+            h2, c2 = LSTMCell._step(xt, h, c, w_ih, w_hh, b_ih, b_hh)
+            return (h2, c2), h2
+        (hT, cT), ys = jax.lax.scan(step, (h0, c0), x)
+    elif cell_kind == 'GRU':
+        def step(h, xt):
+            h2 = GRUCell._step(xt, h, w_ih, w_hh, b_ih, b_hh)
+            return h2, h2
+        hT, ys = jax.lax.scan(step, h0, x)
+        cT = hT
+    else:
+        act = jnp.tanh if cell_kind == 'RNN_TANH' else jax.nn.relu
+        def step(h, xt):
+            h2 = act(xt @ w_ih.T + b_ih + h @ w_hh.T + b_hh)
+            return h2, h2
+        hT, ys = jax.lax.scan(step, h0, x)
+        cT = hT
+    if reverse:
+        ys = jnp.flip(ys, 0)
+    if not time_major:
+        ys = jnp.swapaxes(ys, 0, 1)
+    return ys, hT, cT
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) recurrent stack."""
+
+    CELL_KIND = 'RNN_TANH'
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction='forward', time_major=False, dropout=0.0,
+                 activation='tanh', weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ('bidirect', 'bidirectional')
+        self.num_directions = 2 if self.bidirectional else 1
+        if activation == 'relu':
+            self.CELL_KIND = 'RNN_RELU'
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        g = self.GATES
+        self._weights = []
+        for layer in range(num_layers):
+            for direction in range(self.num_directions):
+                in_sz = input_size if layer == 0 else \
+                    hidden_size * self.num_directions
+                suffix = '_reverse' if direction else ''
+                w_ih = self.create_parameter(
+                    [g * hidden_size, in_sz], attr=weight_ih_attr,
+                    default_initializer=init)
+                w_hh = self.create_parameter(
+                    [g * hidden_size, hidden_size], attr=weight_hh_attr,
+                    default_initializer=init)
+                b_ih = self.create_parameter(
+                    [g * hidden_size], attr=bias_ih_attr, is_bias=True,
+                    default_initializer=init)
+                b_hh = self.create_parameter(
+                    [g * hidden_size], attr=bias_hh_attr, is_bias=True,
+                    default_initializer=init)
+                self.add_parameter(f'weight_ih_l{layer}{suffix}', w_ih)
+                self.add_parameter(f'weight_hh_l{layer}{suffix}', w_hh)
+                self.add_parameter(f'bias_ih_l{layer}{suffix}', b_ih)
+                self.add_parameter(f'bias_hh_l{layer}{suffix}', b_hh)
+                self._weights.append((w_ih, w_hh, b_ih, b_hh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.creation import zeros
+        x = wrap(inputs)
+        batch_axis = 1 if self.time_major else 0
+        batch = x.shape[batch_axis]
+        L, D = self.num_layers, self.num_directions
+        is_lstm = self.CELL_KIND == 'LSTM'
+        if initial_states is None:
+            h0 = zeros([L * D, batch, self.hidden_size])
+            c0 = zeros([L * D, batch, self.hidden_size])
+        elif is_lstm:
+            h0, c0 = initial_states
+        else:
+            h0, c0 = initial_states, None
+
+        flat_w = [w for tup in self._weights for w in tup]
+        kind = self.CELL_KIND
+        time_major = self.time_major
+        bidi = self.bidirectional
+        hidden = self.hidden_size
+        dropout = self.dropout
+        training = self.training
+
+        def fn(xv, h0v, c0v, *weights):
+            from ...core import rng
+            out = xv
+            h_finals, c_finals = [], []
+            for layer in range(L):
+                outs_dir = []
+                for d in range(D):
+                    idx = layer * D + d
+                    w_ih, w_hh, b_ih, b_hh = weights[4 * idx:4 * idx + 4]
+                    ys, hT, cT = _scan_layer(
+                        kind, out, h0v[idx],
+                        c0v[idx] if c0v is not None else h0v[idx],
+                        w_ih, w_hh, b_ih, b_hh, reverse=bool(d),
+                        time_major=time_major)
+                    outs_dir.append(ys)
+                    h_finals.append(hT)
+                    c_finals.append(cT)
+                out = jnp.concatenate(outs_dir, axis=-1) if bidi else \
+                    outs_dir[0]
+                if dropout > 0 and training and layer < L - 1:
+                    keep = jax.random.bernoulli(
+                        rng.next_key(), 1 - dropout, out.shape)
+                    out = jnp.where(keep, out / (1 - dropout), 0.0)
+            hN = jnp.stack(h_finals, 0)
+            cN = jnp.stack(c_finals, 0)
+            return out, hN, cN
+
+        args = [x, wrap(h0)]
+        if is_lstm:
+            fn_c = fn
+            args.append(wrap(c0))
+        else:
+            def fn_c(xv, h0v, *weights):
+                return fn(xv, h0v, None, *weights)
+        out, hN, cN = apply(fn_c, *args, *flat_w, op_name='rnn')
+        if is_lstm:
+            return out, (hN, cN)
+        return out, hN
+
+
+class SimpleRNN(_RNNBase):
+    CELL_KIND = 'RNN_TANH'
+    GATES = 1
+
+
+class LSTM(_RNNBase):
+    CELL_KIND = 'LSTM'
+    GATES = 4
+
+
+class GRU(_RNNBase):
+    CELL_KIND = 'GRU'
+    GATES = 3
+
+
+class RNN(Layer):
+    """Generic sequence wrapper around a cell (reference rnn.py:RNN).
+    Runs the cell per-step via lax.scan using the cell's _step math when
+    available, else a python loop over time (still traced once under jit).
+    """
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import stack, unstack, flip
+        x = wrap(inputs)
+        seq = unstack(x, axis=0 if self.time_major else 1)
+        if self.is_reverse:
+            seq = seq[::-1]
+        states = initial_states
+        outs = []
+        for xt in seq:
+            out, states = self.cell(xt, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        y = stack(outs, axis=0 if self.time_major else 1)
+        return y, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        y_fw, s_fw = self.rnn_fw(inputs, st_fw)
+        y_bw, s_bw = self.rnn_bw(inputs, st_bw)
+        return concat([y_fw, y_bw], axis=-1), (s_fw, s_bw)

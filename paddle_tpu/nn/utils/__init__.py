@@ -1,0 +1,89 @@
+"""nn.utils — weight/spectral norm reparameterizations.
+
+Reference analogue: /root/reference/python/paddle/nn/utils/.
+Implemented as forward pre-hooks that recompute the wrapped parameter,
+mirroring the reference's hook-based approach.
+"""
+import jax.numpy as jnp
+
+from ...core.tensor import Parameter
+
+__all__ = ['weight_norm', 'remove_weight_norm', 'spectral_norm']
+
+
+def _norm_except(v, axis):
+    if axis is None:
+        return jnp.sqrt(jnp.sum(jnp.square(v)))
+    axes = tuple(i for i in range(v.ndim) if i != axis)
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name='weight', dim=0):
+    w = getattr(layer, name)
+    g = Parameter(_norm_except(w.value, dim))
+    v = Parameter(w.value)
+    layer.add_parameter(name + '_g', g)
+    layer.add_parameter(name + '_v', v)
+    del layer._parameters[name]
+
+    def hook(l, inputs):
+        vv = getattr(l, name + '_v')
+        gg = getattr(l, name + '_g')
+        from ...core.dispatch import apply
+        w_new = apply(
+            lambda vvv, ggg: vvv * (ggg / _norm_except(vvv, dim)), vv, gg,
+            op_name='weight_norm')
+        object.__setattr__(l, '_wn_cache_' + name, w_new)
+        l._parameters.pop(name, None)
+        l.__dict__[name] = w_new
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._wn_handle = handle
+    hook(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name='weight'):
+    if hasattr(layer, '_wn_handle'):
+        layer._wn_handle.remove()
+    w = layer.__dict__.pop(name, None)
+    if w is not None:
+        layer.add_parameter(name, Parameter(w.value))
+    for suffix in ('_g', '_v'):
+        layer._parameters.pop(name + suffix, None)
+    return layer
+
+
+def spectral_norm(layer, name='weight', n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    import jax
+    from ...core import rng
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    wm = jnp.moveaxis(w.value, dim, 0).reshape(w.value.shape[dim], -1)
+    u0 = jax.random.normal(rng.next_key(), (wm.shape[0],))
+    from ...core.tensor import Tensor
+    layer.register_buffer(name + '_u', Tensor(u0 / jnp.linalg.norm(u0)))
+
+    def hook(l, inputs):
+        wp = l._parameters.get(name) or getattr(l, name + '_orig')
+        u = getattr(l, name + '_u').value
+        wmat = jnp.moveaxis(wp.value, dim, 0).reshape(wp.value.shape[dim],
+                                                      -1)
+        for _ in range(n_power_iterations):
+            v = wmat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = wmat @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ wmat @ v
+        getattr(l, name + '_u').value = u
+        from ...core.dispatch import apply
+        w_new = apply(lambda ww: ww / sigma, wp, op_name='spectral_norm')
+        if name in l._parameters:
+            l.add_parameter(name + '_orig', l._parameters.pop(name))
+        l.__dict__[name] = w_new
+
+    layer.register_forward_pre_hook(hook)
+    hook(layer, None)
+    return layer

@@ -1,0 +1,9 @@
+"""Optimizers (reference: /root/reference/python/paddle/optimizer/)."""
+from . import lr  # noqa: F401
+from .optimizer import Optimizer  # noqa: F401
+from .adam import Adam, AdamW, Adamax, Lamb  # noqa: F401
+from .sgd_family import (  # noqa: F401
+    SGD, Momentum, Adagrad, Adadelta, RMSProp, Lars)
+
+__all__ = ['Optimizer', 'Adam', 'AdamW', 'Adamax', 'Lamb', 'SGD',
+           'Momentum', 'Adagrad', 'Adadelta', 'RMSProp', 'Lars', 'lr']

@@ -1,0 +1,108 @@
+"""Adam / AdamW / Adamax / Lamb.
+
+Reference analogue: /root/reference/python/paddle/optimizer/{adam,adamw,
+adamax,lamb}.py with fused CUDA kernels (fluid/operators/optimizers/
+adam_op.h).  TPU-native: pure jnp update rules; XLA fuses the whole
+parameter update into the train-step module, and `donate_argnums` in the
+jit wrapper makes it an in-place HBM update.
+"""
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ['Adam', 'AdamW', 'Adamax', 'Lamb']
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_state(self, p):
+        return {'moment1': jnp.zeros_like(p), 'moment2': jnp.zeros_like(p)}
+
+    def _rule(self, p, g, state, lr, t):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state['moment1'] + (1 - b1) * g
+        v = b2 * state['moment2'] + (1 - b2) * jnp.square(g)
+        t = jnp.asarray(t, jnp.float32)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        new_p = p - (lr * mhat / (jnp.sqrt(vhat) + eps)).astype(p.dtype)
+        return new_p, {'moment1': m, 'moment2': v}
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip)
+        self._wd = weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _rule(self, p, g, state, lr, t):
+        # decoupled decay (Loshchilov & Hutter), applied before the Adam
+        # step; apply_decay_param_fun(name)==False exempts a param (the
+        # reference uses it to skip biases/LayerNorm weights)
+        fn = self._apply_decay_param_fun
+        if fn is None or fn(self._ctx_param_name):
+            p = p * (1 - lr * self._wd)
+        return super()._rule(p, g, state, lr, t)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_state(self, p):
+        return {'moment': jnp.zeros_like(p), 'inf_norm': jnp.zeros_like(p)}
+
+    def _rule(self, p, g, state, lr, t):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state['moment'] + (1 - b1) * g
+        u = jnp.maximum(b2 * state['inf_norm'], jnp.abs(g))
+        t = jnp.asarray(t, jnp.float32)
+        new_p = p - (lr / (1 - b1 ** t) * m / (u + eps)).astype(p.dtype)
+        return new_p, {'moment': m, 'inf_norm': u}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _create_state(self, p):
+        return {'moment1': jnp.zeros_like(p), 'moment2': jnp.zeros_like(p)}
+
+    def _rule(self, p, g, state, lr, t):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state['moment1'] + (1 - b1) * g
+        v = b2 * state['moment2'] + (1 - b2) * jnp.square(g)
+        t = jnp.asarray(t, jnp.float32)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + eps) + self._lamb_wd * p
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p.astype(jnp.float32))))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r.astype(jnp.float32))))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = p - (lr * trust * r).astype(p.dtype)
+        return new_p, {'moment1': m, 'moment2': v}

@@ -1,0 +1,313 @@
+"""LR schedulers.
+
+Reference analogue: /root/reference/python/paddle/optimizer/lr.py.
+TPU-native: every scheduler also exposes value_at(step) as a pure
+function of the step count so compiled train steps can evaluate the LR
+on-device inside jit (no host sync); the stateful get_lr()/step() API is
+kept for eager parity.
+"""
+import math
+
+__all__ = [
+    'LRScheduler', 'NoamDecay', 'ExponentialDecay', 'NaturalExpDecay',
+    'InverseTimeDecay', 'PolynomialDecay', 'PiecewiseDecay', 'CosineAnnealingDecay',
+    'MultiStepDecay', 'StepDecay', 'LambdaDecay', 'ReduceOnPlateau',
+    'LinearWarmup',
+]
+
+
+class LRScheduler:
+    def __init__(self, learning_rate=0.1, last_epoch=-1, verbose=False):
+        self.base_lr = float(learning_rate)
+        self.last_epoch = last_epoch
+        self.last_lr = float(learning_rate)
+        self.verbose = verbose
+        self.step()
+
+    def __call__(self):
+        return self.last_lr
+
+    def step(self, epoch=None):
+        if epoch is None:
+            self.last_epoch += 1
+        else:
+            self.last_epoch = epoch
+        self.last_lr = self.get_lr()
+
+    def get_lr(self):
+        return self.value_at(self.last_epoch)
+
+    def value_at(self, step):
+        """Pure function of step → lr (jit-traceable with jnp step)."""
+        raise NotImplementedError
+
+    def state_dict(self):
+        return {'last_epoch': self.last_epoch, 'last_lr': self.last_lr}
+
+    def set_state_dict(self, state):
+        self.last_epoch = state['last_epoch']
+        self.last_lr = state['last_lr']
+
+    set_dict = set_state_dict
+
+
+class NoamDecay(LRScheduler):
+    def __init__(self, d_model, warmup_steps, learning_rate=1.0,
+                 last_epoch=-1, verbose=False):
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        import jax.numpy as jnp
+        s = jnp.maximum(jnp.asarray(step, jnp.float32), 1.0)
+        a = s ** -0.5
+        b = s * (self.warmup_steps ** -1.5)
+        return self.base_lr * (self.d_model ** -0.5) * jnp.minimum(a, b)
+
+    def get_lr(self):
+        step = max(self.last_epoch, 1)
+        a = step ** -0.5
+        b = step * (self.warmup_steps ** -1.5)
+        return self.base_lr * (self.d_model ** -0.5) * min(a, b)
+
+
+class ExponentialDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        return self.base_lr * self.gamma ** step
+
+    get_lr = lambda self: self.base_lr * self.gamma ** self.last_epoch  # noqa: E731
+
+
+class NaturalExpDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        import jax.numpy as jnp
+        return self.base_lr * jnp.exp(-self.gamma * step)
+
+    def get_lr(self):
+        return self.base_lr * math.exp(-self.gamma * self.last_epoch)
+
+
+class InverseTimeDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        return self.base_lr / (1 + self.gamma * step)
+
+    def get_lr(self):
+        return self.base_lr / (1 + self.gamma * self.last_epoch)
+
+
+class PolynomialDecay(LRScheduler):
+    def __init__(self, learning_rate, decay_steps, end_lr=0.0001, power=1.0,
+                 cycle=False, last_epoch=-1, verbose=False):
+        self.decay_steps = decay_steps
+        self.end_lr = end_lr
+        self.power = power
+        self.cycle = cycle
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        step = self.last_epoch
+        if self.cycle:
+            div = math.ceil(max(step, 1) / self.decay_steps)
+            decay_steps = self.decay_steps * max(div, 1)
+        else:
+            decay_steps = self.decay_steps
+            step = min(step, decay_steps)
+        return ((self.base_lr - self.end_lr) *
+                (1 - step / decay_steps) ** self.power + self.end_lr)
+
+    def value_at(self, step):
+        import jax.numpy as jnp
+        s = jnp.minimum(jnp.asarray(step, jnp.float32), self.decay_steps)
+        return ((self.base_lr - self.end_lr) *
+                (1 - s / self.decay_steps) ** self.power + self.end_lr)
+
+
+class PiecewiseDecay(LRScheduler):
+    def __init__(self, boundaries, values, last_epoch=-1, verbose=False):
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+        super().__init__(values[0], last_epoch, verbose)
+
+    def get_lr(self):
+        for b, v in zip(self.boundaries, self.values):
+            if self.last_epoch < b:
+                return v
+        return self.values[len(self.boundaries)]
+
+    def value_at(self, step):
+        import jax.numpy as jnp
+        lr = jnp.asarray(self.values[len(self.boundaries)], jnp.float32)
+        for b, v in zip(reversed(self.boundaries),
+                        reversed(self.values[:len(self.boundaries)])):
+            lr = jnp.where(step < b, v, lr)
+        return lr
+
+
+class CosineAnnealingDecay(LRScheduler):
+    def __init__(self, learning_rate, T_max, eta_min=0, last_epoch=-1,
+                 verbose=False):
+        self.T_max = T_max
+        self.eta_min = eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        return (self.eta_min + (self.base_lr - self.eta_min) *
+                (1 + math.cos(math.pi * self.last_epoch / self.T_max)) / 2)
+
+    def value_at(self, step):
+        import jax.numpy as jnp
+        return (self.eta_min + (self.base_lr - self.eta_min) *
+                (1 + jnp.cos(jnp.pi * step / self.T_max)) / 2)
+
+
+class MultiStepDecay(LRScheduler):
+    def __init__(self, learning_rate, milestones, gamma=0.1, last_epoch=-1,
+                 verbose=False):
+        self.milestones = list(milestones)
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        n = sum(1 for m in self.milestones if self.last_epoch >= m)
+        return self.base_lr * self.gamma ** n
+
+    def value_at(self, step):
+        import jax.numpy as jnp
+        n = sum((step >= m).astype(jnp.int32) if hasattr(step, 'astype')
+                else int(step >= m) for m in self.milestones)
+        return self.base_lr * self.gamma ** n
+
+
+class StepDecay(LRScheduler):
+    def __init__(self, learning_rate, step_size, gamma=0.1, last_epoch=-1,
+                 verbose=False):
+        self.step_size = step_size
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        return self.base_lr * self.gamma ** (self.last_epoch //
+                                             self.step_size)
+
+    def value_at(self, step):
+        return self.base_lr * self.gamma ** (step // self.step_size)
+
+
+class LambdaDecay(LRScheduler):
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        return self.base_lr * self.lr_lambda(self.last_epoch)
+
+    def value_at(self, step):
+        return self.base_lr * self.lr_lambda(step)
+
+
+class ReduceOnPlateau(LRScheduler):
+    def __init__(self, learning_rate, mode='min', factor=0.1, patience=10,
+                 threshold=1e-4, threshold_mode='rel', cooldown=0, min_lr=0,
+                 epsilon=1e-8, verbose=False):
+        self.mode = mode
+        self.factor = factor
+        self.patience = patience
+        self.threshold = threshold
+        self.threshold_mode = threshold_mode
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.epsilon = epsilon
+        self.best = None
+        self.cooldown_counter = 0
+        self.num_bad_epochs = 0
+        self.base_lr = float(learning_rate)
+        self.last_lr = float(learning_rate)
+        self.last_epoch = 0
+        self.verbose = verbose
+
+    def step(self, metrics=None, epoch=None):
+        self.last_epoch += 1
+        if metrics is None:
+            return
+        current = float(metrics.item() if hasattr(metrics, 'item')
+                        else metrics)
+        if self.best is None or self._better(current, self.best):
+            self.best = current
+            self.num_bad_epochs = 0
+        else:
+            self.num_bad_epochs += 1
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.num_bad_epochs = 0
+        if self.num_bad_epochs > self.patience:
+            new_lr = max(self.last_lr * self.factor, self.min_lr)
+            if self.last_lr - new_lr > self.epsilon:
+                self.last_lr = new_lr
+            self.cooldown_counter = self.cooldown
+            self.num_bad_epochs = 0
+
+    def _better(self, a, best):
+        if self.mode == 'min':
+            if self.threshold_mode == 'rel':
+                return a < best * (1 - self.threshold)
+            return a < best - self.threshold
+        if self.threshold_mode == 'rel':
+            return a > best * (1 + self.threshold)
+        return a > best + self.threshold
+
+    def get_lr(self):
+        return self.last_lr
+
+    def value_at(self, step):
+        return self.last_lr
+
+
+class LinearWarmup(LRScheduler):
+    def __init__(self, learning_rate, warmup_steps, start_lr, end_lr,
+                 last_epoch=-1, verbose=False):
+        self.lr_sched = learning_rate if isinstance(learning_rate,
+                                                    LRScheduler) else None
+        self.after_lr = (learning_rate if not self.lr_sched else None)
+        self.warmup_steps = warmup_steps
+        self.start_lr = start_lr
+        self.end_lr = end_lr
+        super().__init__(end_lr if self.lr_sched is None else
+                         self.lr_sched.base_lr, last_epoch, verbose)
+
+    def get_lr(self):
+        if self.last_epoch < self.warmup_steps:
+            return (self.start_lr + (self.end_lr - self.start_lr) *
+                    self.last_epoch / self.warmup_steps)
+        if self.lr_sched is not None:
+            self.lr_sched.last_epoch = self.last_epoch - self.warmup_steps
+            return self.lr_sched.get_lr()
+        return self.after_lr
+
+    def value_at(self, step):
+        import jax.numpy as jnp
+        warm = (self.start_lr + (self.end_lr - self.start_lr) *
+                step / self.warmup_steps)
+        if self.lr_sched is not None:
+            after = self.lr_sched.value_at(
+                jnp.maximum(step - self.warmup_steps, 0)
+                if hasattr(step, 'dtype') else max(step - self.warmup_steps,
+                                                   0))
+        else:
+            after = self.after_lr
+        if hasattr(step, 'dtype'):
+            return jnp.where(step < self.warmup_steps, warm, after)
+        return warm if step < self.warmup_steps else after

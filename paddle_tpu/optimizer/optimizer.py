@@ -1,0 +1,170 @@
+"""Optimizer base.
+
+Reference analogue: /root/reference/python/paddle/optimizer/optimizer.py
+(+ per-op C++ kernels like adam_op.cu).  TPU-native: each optimizer is a
+pure update rule `_rule(p, g, state, lr, t) -> (p', state')` over raw jnp
+arrays.  Eager `step()` applies it per-parameter; the compiled path
+(paddle_tpu.jit / hapi / fleet) calls `init()` + `apply_gradients()` on
+whole pytrees inside ONE jitted XLA module, where states can be sharded
+across the `dp` mesh axis for ZeRO-1 semantics.
+"""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .lr import LRScheduler
+
+__all__ = ['Optimizer']
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        self._parameter_list = list(parameters) if parameters is not None \
+            else None
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        if weight_decay is None:
+            self._coupled_wd = 0.0
+        elif isinstance(weight_decay, float):
+            self._coupled_wd = weight_decay
+        else:  # L1Decay / L2Decay object
+            self._coupled_wd = weight_decay
+        self._accumulators = {}   # id(param) -> state dict
+        self._global_step = 0
+        self._ctx_param_name = None  # name of the param currently in _rule
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return self._learning_rate
+
+    def set_lr(self, value):
+        self._learning_rate = float(value)
+
+    def _lr_value(self, step):
+        """LR as a traceable value for compiled steps."""
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate.value_at(step)
+        return self._learning_rate
+
+    # -- state ---------------------------------------------------------------
+    def _create_state(self, p_value):
+        """Return dict name→array of per-param slots (subclass)."""
+        return {}
+
+    def _rule(self, p, g, state, lr, t):
+        """Pure update: (new_p, new_state) (subclass)."""
+        raise NotImplementedError
+
+    def _apply_weight_decay_grad(self, p, g):
+        """Coupled (L2-to-grad) decay like the reference's regularizer."""
+        wd = self._coupled_wd
+        if wd:
+            coeff = getattr(wd, '_coeff', wd)
+            if getattr(wd, '_mode', 'l2') == 'l1':
+                return g + coeff * jnp.sign(p)
+            return g + coeff * p
+        return g
+
+    # -- eager API -----------------------------------------------------------
+    @property
+    def _params(self):
+        if self._parameter_list is None:
+            raise ValueError("optimizer constructed without parameters")
+        return self._parameter_list
+
+    def step(self):
+        t = self._global_step + 1
+        lr = self.get_lr()
+        pg = [(p, p.grad) for p in self._params
+              if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            pg = self._grad_clip(pg)
+        for i, (p, g) in enumerate(pg):
+            key = id(p)
+            if key not in self._accumulators:
+                self._accumulators[key] = self._create_state(p.value)
+            g_v = g.value.astype(p.value.dtype)
+            g_v = self._apply_weight_decay_grad(p.value, g_v)
+            plr = lr * getattr(p, 'optimize_attr',
+                               {'learning_rate': 1.0})['learning_rate']
+            self._ctx_param_name = p.name or str(i)
+            new_p, new_state = self._rule(p.value, g_v,
+                                          self._accumulators[key], plr, t)
+            p.value = new_p
+            self._accumulators[key] = new_state
+        self._global_step = t
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._params]
+
+    def clear_grad(self):
+        for p in self._params:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # -- functional API (compiled path) --------------------------------------
+    def init(self, params):
+        """params: pytree of raw arrays → pytree of state dicts."""
+        import jax
+        return jax.tree_util.tree_map(self._create_state, params)
+
+    def apply_gradients(self, params, grads, state, step):
+        """Pure pytree update; call inside jit. Returns (params', state')."""
+        import jax
+        lr = self._lr_value(step)
+        paths_p, treedef = jax.tree_util.tree_flatten_with_path(params)
+        leaves_p = [v for _, v in paths_p]
+        names = ['/'.join(str(getattr(k, 'key', getattr(k, 'idx', k)))
+                          for k in path) for path, _ in paths_p]
+        leaves_g = treedef.flatten_up_to(grads)
+        leaves_s = treedef.flatten_up_to(state)
+        if self._grad_clip is not None:
+            leaves_g = self._grad_clip.clip_values(leaves_g)
+        new_p, new_s = [], []
+        for p, g, s, name in zip(leaves_p, leaves_g, leaves_s, names):
+            g = self._apply_weight_decay_grad(p, g.astype(p.dtype))
+            self._ctx_param_name = name
+            np_, ns_ = self._rule(p, g, s, lr, step)
+            new_p.append(np_)
+            new_s.append(ns_)
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                jax.tree_util.tree_unflatten(treedef, new_s))
+
+    # -- checkpointing -------------------------------------------------------
+    def state_dict(self):
+        sd = {'global_step': self._global_step}
+        if isinstance(self._learning_rate, LRScheduler):
+            sd['LR_Scheduler'] = self._learning_rate.state_dict()
+        for i, p in enumerate(self._params):
+            st = self._accumulators.get(id(p))
+            if st:
+                for k, v in st.items():
+                    sd[f'{p.name or i}_{k}'] = Tensor(v)
+        return sd
+
+    def set_state_dict(self, sd):
+        self._global_step = sd.get('global_step', 0)
+        if isinstance(self._learning_rate, LRScheduler) and \
+                'LR_Scheduler' in sd:
+            self._learning_rate.set_state_dict(sd['LR_Scheduler'])
+        for i, p in enumerate(self._params):
+            st = self._create_state(p.value)
+            found = False
+            for k in st:
+                key = f'{p.name or i}_{k}'
+                if key in sd:
+                    v = sd[key]
+                    st[k] = v.value if isinstance(v, Tensor) else \
+                        jnp.asarray(v)
+                    found = True
+            if found:
+                self._accumulators[id(p)] = st
+
+    set_dict = set_state_dict

@@ -1,0 +1,134 @@
+"""SGD / Momentum / Adagrad / Adadelta / RMSProp (+LARS).
+
+Reference analogue: /root/reference/python/paddle/optimizer/{sgd,momentum,
+adagrad,adadelta,rmsprop}.py and fleet's lars_optimizer.
+"""
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ['SGD', 'Momentum', 'Adagrad', 'Adadelta', 'RMSProp', 'Lars']
+
+
+class SGD(Optimizer):
+    def _rule(self, p, g, state, lr, t):
+        return p - (lr * g).astype(p.dtype), state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _create_state(self, p):
+        return {'velocity': jnp.zeros_like(p)}
+
+    def _rule(self, p, g, state, lr, t):
+        mu = self._momentum
+        v = mu * state['velocity'] + g
+        if self._nesterov:
+            upd = g + mu * v
+        else:
+            upd = v
+        return p - (lr * upd).astype(p.dtype), {'velocity': v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _create_state(self, p):
+        return {'moment': jnp.full_like(p, self._init_acc)}
+
+    def _rule(self, p, g, state, lr, t):
+        acc = state['moment'] + jnp.square(g)
+        new_p = p - (lr * g / (jnp.sqrt(acc) + self._epsilon)).astype(
+            p.dtype)
+        return new_p, {'moment': acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _create_state(self, p):
+        return {'avg_squared_grad': jnp.zeros_like(p),
+                'avg_squared_update': jnp.zeros_like(p)}
+
+    def _rule(self, p, g, state, lr, t):
+        rho, eps = self._rho, self._epsilon
+        asg = rho * state['avg_squared_grad'] + (1 - rho) * jnp.square(g)
+        upd = (jnp.sqrt(state['avg_squared_update'] + eps) /
+               jnp.sqrt(asg + eps)) * g
+        asu = rho * state['avg_squared_update'] + (1 - rho) * jnp.square(upd)
+        return (p - (lr * upd).astype(p.dtype),
+                {'avg_squared_grad': asg, 'avg_squared_update': asu})
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _create_state(self, p):
+        st = {'mean_square': jnp.zeros_like(p),
+              'momentum': jnp.zeros_like(p)}
+        if self._centered:
+            st['mean_grad'] = jnp.zeros_like(p)
+        return st
+
+    def _rule(self, p, g, state, lr, t):
+        rho, eps, mu = self._rho, self._epsilon, self._momentum
+        ms = rho * state['mean_square'] + (1 - rho) * jnp.square(g)
+        if self._centered:
+            mg = rho * state['mean_grad'] + (1 - rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + eps)
+        else:
+            mg = None
+            denom = jnp.sqrt(ms + eps)
+        mom = mu * state['momentum'] + lr * g / denom
+        new_state = {'mean_square': ms, 'momentum': mom}
+        if mg is not None:
+            new_state['mean_grad'] = mg
+        return p - mom.astype(p.dtype), new_state
+
+
+class Lars(Momentum):
+    """LARS (fleet meta_optimizers/lars_optimizer.py): layerwise-adaptive
+    trust ratio on top of momentum."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, momentum, parameters, False, None,
+                         grad_clip)
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+
+    def _rule(self, p, g, state, lr, t):
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p.astype(jnp.float32))))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm /
+            (g_norm + self._lars_wd * w_norm + 1e-12), 1.0)
+        g = g + self._lars_wd * p
+        mu = self._momentum
+        v = mu * state['velocity'] + (lr * local_lr) * g
+        return p - v.astype(p.dtype), {'velocity': v}

@@ -1,0 +1,17 @@
+"""L1/L2 weight decay (reference: python/paddle/regularizer.py)."""
+
+__all__ = ['L1Decay', 'L2Decay']
+
+
+class L1Decay:
+    _mode = 'l1'
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+
+class L2Decay:
+    _mode = 'l2'
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)

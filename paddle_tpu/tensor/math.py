@@ -109,7 +109,8 @@ atanh = _unary(jnp.arctanh, 'atanh')
 reciprocal = _unary(jnp.reciprocal, 'reciprocal')
 floor = _unary(jnp.floor, 'floor')
 ceil = _unary(jnp.ceil, 'ceil')
-round = _unary(jnp.round, 'round')
+# paddle rounds half AWAY FROM ZERO; jnp.round is half-to-even
+round = _unary(lambda v: jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5), 'round')
 trunc = _unary(jnp.trunc, 'trunc')
 erf = _unary(jax.scipy.special.erf, 'erf')
 erfinv = _unary(jax.scipy.special.erfinv, 'erfinv')

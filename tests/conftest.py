@@ -7,7 +7,9 @@ devices in one process.
 """
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# force (not setdefault): the environment ships JAX_PLATFORMS=axon (real
+# TPU tunnel) globally; unit tests must run on the virtual 8-device CPU
+os.environ['JAX_PLATFORMS'] = 'cpu'
 flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (

@@ -1,0 +1,298 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+import torch  # cpu torch as independent numerical reference
+import torch.nn.functional as tF
+
+
+def t2n(t):
+    return t.numpy()
+
+
+class TestLinearConv:
+    def test_linear_vs_torch(self):
+        x = np.random.RandomState(0).randn(4, 8).astype('float32')
+        w = np.random.RandomState(1).randn(8, 16).astype('float32')
+        b = np.random.RandomState(2).randn(16).astype('float32')
+        ours = F.linear(paddle.to_tensor(x), paddle.to_tensor(w),
+                        paddle.to_tensor(b))
+        ref = tF.linear(torch.tensor(x), torch.tensor(w.T),
+                        torch.tensor(b)).numpy()
+        np.testing.assert_allclose(t2n(ours), ref, rtol=1e-5, atol=1e-5)
+
+    def test_conv2d_vs_torch(self):
+        x = np.random.RandomState(0).randn(2, 3, 8, 8).astype('float32')
+        w = np.random.RandomState(1).randn(5, 3, 3, 3).astype('float32')
+        b = np.random.RandomState(2).randn(5).astype('float32')
+        ours = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                        paddle.to_tensor(b), stride=2, padding=1)
+        ref = tF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                        stride=2, padding=1).numpy()
+        np.testing.assert_allclose(t2n(ours), ref, rtol=1e-4, atol=1e-4)
+
+    def test_conv2d_groups_dilation(self):
+        x = np.random.RandomState(0).randn(2, 4, 9, 9).astype('float32')
+        w = np.random.RandomState(1).randn(8, 2, 3, 3).astype('float32')
+        ours = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                        groups=2, dilation=2)
+        ref = tF.conv2d(torch.tensor(x), torch.tensor(w), groups=2,
+                        dilation=2).numpy()
+        np.testing.assert_allclose(t2n(ours), ref, rtol=1e-4, atol=1e-4)
+
+    def test_conv2d_transpose_vs_torch(self):
+        x = np.random.RandomState(0).randn(2, 4, 5, 5).astype('float32')
+        w = np.random.RandomState(1).randn(4, 6, 3, 3).astype('float32')
+        ours = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                                  stride=2, padding=1)
+        ref = tF.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                  stride=2, padding=1).numpy()
+        np.testing.assert_allclose(t2n(ours), ref, rtol=1e-4, atol=1e-4)
+
+
+class TestNorm:
+    def test_batch_norm_train_eval(self):
+        bn = nn.BatchNorm2D(3, momentum=0.8)
+        x = paddle.randn([4, 3, 5, 5])
+        y = bn(x)
+        out = t2n(y)
+        # normalized output: near-zero mean, unit var per channel
+        assert abs(out.mean()) < 1e-5
+        np.testing.assert_allclose(out.std(), 1.0, atol=1e-2)
+        m1 = bn._mean.numpy().copy()
+        bn(x)
+        m2 = bn._mean.numpy()
+        assert not np.allclose(m1, m2)  # running stats moving
+        bn.eval()
+        y2 = bn(x)
+        assert y2.shape == x.shape
+
+    def test_layer_norm_vs_torch(self):
+        x = np.random.RandomState(0).randn(4, 6).astype('float32')
+        ln = nn.LayerNorm(6)
+        ours = t2n(ln(paddle.to_tensor(x)))
+        ref = tF.layer_norm(torch.tensor(x), (6,)).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_group_norm_vs_torch(self):
+        x = np.random.RandomState(0).randn(2, 6, 4, 4).astype('float32')
+        ours = t2n(F.group_norm(paddle.to_tensor(x), 3))
+        ref = tF.group_norm(torch.tensor(x), 3).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestActivationsLosses:
+    def test_activations_vs_torch(self):
+        x = np.random.RandomState(0).randn(3, 7).astype('float32')
+        tx, px = torch.tensor(x), paddle.to_tensor(x)
+        pairs = [
+            (F.relu, tF.relu), (F.gelu, lambda v: tF.gelu(v)),
+            (F.sigmoid, torch.sigmoid), (F.silu, tF.silu),
+            (F.elu, tF.elu), (F.softplus, tF.softplus),
+            (F.leaky_relu, tF.leaky_relu),
+            (F.log_softmax, lambda v: tF.log_softmax(v, -1)),
+            (F.softmax, lambda v: tF.softmax(v, -1)),
+        ]
+        for ours_fn, ref_fn in pairs:
+            np.testing.assert_allclose(
+                t2n(ours_fn(px)), ref_fn(tx).numpy(), rtol=1e-4, atol=1e-5,
+                err_msg=str(ours_fn))
+
+    def test_cross_entropy_vs_torch(self):
+        logits = np.random.RandomState(0).randn(6, 10).astype('float32')
+        labels = np.array([1, 3, 9, 0, 5, 2])
+        ours = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels))
+        ref = tF.cross_entropy(torch.tensor(logits),
+                               torch.tensor(labels)).numpy()
+        np.testing.assert_allclose(float(ours), ref, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.RandomState(0).randn(4, 5).astype('float32')
+        labels = np.array([1, -100, 3, -100])
+        ours = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels))
+        ref = tF.cross_entropy(torch.tensor(logits), torch.tensor(labels),
+                               ignore_index=-100).numpy()
+        np.testing.assert_allclose(float(ours), ref, rtol=1e-5)
+
+    def test_soft_label_ce(self):
+        logits = np.random.RandomState(0).randn(4, 5).astype('float32')
+        soft = np.random.RandomState(1).rand(4, 5).astype('float32')
+        soft /= soft.sum(1, keepdims=True)
+        ours = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(soft), soft_label=True)
+        ref = (-(torch.tensor(soft) *
+                 tF.log_softmax(torch.tensor(logits), -1)).sum(1)
+               .mean().numpy())
+        np.testing.assert_allclose(float(ours), ref, rtol=1e-5)
+
+    def test_bce_mse(self):
+        p = np.random.RandomState(0).rand(4, 3).astype('float32')
+        y = (np.random.RandomState(1).rand(4, 3) > 0.5).astype('float32')
+        np.testing.assert_allclose(
+            float(F.binary_cross_entropy(paddle.to_tensor(p),
+                                         paddle.to_tensor(y))),
+            tF.binary_cross_entropy(torch.tensor(p),
+                                    torch.tensor(y)).numpy(), rtol=1e-4)
+        np.testing.assert_allclose(
+            float(F.mse_loss(paddle.to_tensor(p), paddle.to_tensor(y))),
+            tF.mse_loss(torch.tensor(p), torch.tensor(y)).numpy(),
+            rtol=1e-5)
+
+
+class TestRNN:
+    def test_lstm_vs_torch(self):
+        B, T, I, H = 2, 5, 4, 6
+        x = np.random.RandomState(0).randn(B, T, I).astype('float32')
+        ours = nn.LSTM(I, H)
+        ref = torch.nn.LSTM(I, H, batch_first=True)
+        # copy our params into torch
+        sd = {n: p.numpy() for n, p in ours.named_parameters()}
+        with torch.no_grad():
+            ref.weight_ih_l0.copy_(torch.tensor(sd['weight_ih_l0']))
+            ref.weight_hh_l0.copy_(torch.tensor(sd['weight_hh_l0']))
+            ref.bias_ih_l0.copy_(torch.tensor(sd['bias_ih_l0']))
+            ref.bias_hh_l0.copy_(torch.tensor(sd['bias_hh_l0']))
+        y_ours, (h_ours, c_ours) = ours(paddle.to_tensor(x))
+        y_ref, (h_ref, c_ref) = ref(torch.tensor(x))
+        np.testing.assert_allclose(t2n(y_ours), y_ref.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(t2n(h_ours), h_ref.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gru_shapes_grad(self):
+        gru = nn.GRU(4, 6, num_layers=2)
+        x = paddle.randn([3, 7, 4])
+        y, h = gru(x)
+        assert y.shape == [3, 7, 6] and h.shape == [2, 3, 6]
+        y.sum().backward()
+        assert gru.weight_ih_l0.grad is not None
+
+
+class TestLayerSystem:
+    def test_state_dict_roundtrip(self):
+        m1 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m2.set_state_dict(m1.state_dict())
+        x = paddle.randn([3, 4])
+        np.testing.assert_allclose(t2n(m1(x)), t2n(m2(x)), rtol=1e-6)
+
+    def test_named_parameters_buffers(self):
+        m = nn.Sequential(nn.Conv2D(3, 4, 3), nn.BatchNorm2D(4))
+        names = [n for n, _ in m.named_parameters()]
+        assert '0.weight' in names and '1.weight' in names
+        bnames = [n for n, _ in m.named_buffers()]
+        assert '1._mean' in bnames
+
+    def test_train_eval_dropout(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([100, 100])
+        paddle.seed(0)
+        y = d(x)
+        assert (t2n(y) == 0).mean() > 0.3  # training: drops
+        d.eval()
+        np.testing.assert_allclose(t2n(d(x)), t2n(x))
+
+    def test_hooks(self):
+        lin = nn.Linear(4, 4)
+        calls = []
+        h = lin.register_forward_post_hook(
+            lambda l, i, o: calls.append(1))
+        lin(paddle.randn([2, 4]))
+        assert calls == [1]
+        h.remove()
+        lin(paddle.randn([2, 4]))
+        assert calls == [1]
+
+    def test_grad_clip_global_norm(self):
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        lin = nn.Linear(8, 8)
+        (lin(paddle.randn([4, 8])) ** 2).sum().backward()
+        pg = clip([(p, p.grad) for p in lin.parameters()])
+        total = np.sqrt(sum((t2n(g) ** 2).sum() for _, g in pg))
+        assert total <= 1.0 + 1e-4
+
+
+class TestOptimizers:
+    def _train(self, opt_cls, steps=120, **kw):
+        paddle.seed(0)
+        w = paddle.Parameter(paddle.to_tensor([4.0, -3.0]))
+        opt = opt_cls(parameters=[w], **kw)
+        for _ in range(steps):
+            loss = (w * w).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return np.abs(w.numpy()).max()
+
+    def test_sgd(self):
+        assert self._train(paddle.optimizer.SGD,
+                           learning_rate=0.1) < 1e-2
+
+    def test_momentum(self):
+        assert self._train(paddle.optimizer.Momentum,
+                           learning_rate=0.05) < 1e-2
+
+    def test_adam(self):
+        assert self._train(paddle.optimizer.Adam, steps=400,
+                           learning_rate=0.05) < 1e-2
+
+    def test_adamw_decay(self):
+        final = self._train(paddle.optimizer.AdamW, steps=400,
+                            learning_rate=0.05, weight_decay=0.01)
+        assert final < 1e-2
+
+    def test_rmsprop_adagrad_adadelta_lamb(self):
+        assert self._train(paddle.optimizer.RMSProp, steps=300,
+                           learning_rate=0.02) < 5e-2
+        assert self._train(paddle.optimizer.Adagrad, steps=400,
+                           learning_rate=0.5) < 5e-2
+        assert self._train(paddle.optimizer.Lamb, steps=400,
+                           learning_rate=0.05) < 5e-2
+
+    def test_adam_single_step_closed_form(self):
+        w = paddle.Parameter(paddle.to_tensor([1.0]))
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+        (2.0 * w).sum().backward()  # grad = 2
+        opt.step()
+        # bias-corrected first step moves by exactly lr (adam property)
+        np.testing.assert_allclose(w.numpy(), [1.0 - 0.1], rtol=1e-5)
+
+    def test_lr_scheduler(self):
+        sched = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        w = paddle.Parameter(paddle.to_tensor([1.0]))
+        opt = paddle.optimizer.Adam(learning_rate=sched, parameters=[w])
+        assert abs(opt.get_lr() - 0.1) < 1e-8
+        sched.step(); sched.step()
+        assert abs(opt.get_lr() - 0.05) < 1e-8
+
+    def test_optimizer_state_dict(self):
+        w = paddle.Parameter(paddle.to_tensor([1.0, 2.0]))
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+        (w * w).sum().backward()
+        opt.step()
+        sd = opt.state_dict()
+        w2 = paddle.Parameter(paddle.to_tensor([1.0, 2.0]))
+        opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w2])
+        opt2.set_state_dict(sd)
+        assert opt2._global_step == 1
+
+
+class TestSchedulers:
+    def test_values(self):
+        s = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        v0 = s.get_lr()
+        s.step(5)
+        assert s.get_lr() < v0
+        n = paddle.optimizer.lr.NoamDecay(d_model=512, warmup_steps=100)
+        n.step(50)
+        lr_warm = n.get_lr()
+        n.step(1000)
+        assert n.get_lr() < lr_warm * 10  # decays after warmup
+        w = paddle.optimizer.lr.LinearWarmup(0.1, 10, 0.0, 0.1)
+        w.step(5)
+        assert abs(w.get_lr() - 0.05) < 1e-6
