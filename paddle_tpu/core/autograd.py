@@ -81,6 +81,10 @@ class GradNode:
         cts = []
         for g, (shape, dtype) in zip(self.out_grads, self.out_avals):
             if g is not None:
+                # vjp requires cotangent dtype == output dtype; under AMP
+                # the seed may arrive fp32 against a bf16 output.
+                if g.dtype != dtype:
+                    g = g.astype(dtype)
                 cts.append(g)
             elif np.issubdtype(dtype, np.inexact) or dtype == jnp.bfloat16:
                 cts.append(jnp.zeros(shape, dtype))

@@ -1,0 +1,40 @@
+"""paddle_tpu.distributed — mesh-based parallelism.
+
+Reference analogue: /root/reference/python/paddle/distributed/ (NCCL
+collectives, launch/spawn multi-process workers, fleet).  TPU-native:
+one process per HOST drives all local chips through XLA; parallelism is
+expressed as shardings over a `jax.sharding.Mesh` and collectives are
+compiler-scheduled XLA ops (see collective.py).  `spawn`/`launch` are
+therefore thin: they configure the mesh rather than forking per-device
+workers.
+"""
+from . import env  # noqa: F401
+from .env import (  # noqa: F401
+    ParallelEnv, get_rank, get_world_size, get_mesh, set_mesh, build_mesh)
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, all_reduce, all_gather,
+    all_gather_object, broadcast, reduce, scatter, alltoall, send, recv,
+    barrier, wait, axis_scope, current_axes, p2p_rotate)
+from .parallel import (  # noqa: F401
+    init_parallel_env, DataParallel)
+from . import fleet  # noqa: F401
+
+__all__ = ['ParallelEnv', 'get_rank', 'get_world_size', 'get_mesh',
+           'set_mesh', 'build_mesh', 'ReduceOp', 'new_group', 'get_group',
+           'all_reduce', 'all_gather', 'broadcast', 'reduce', 'scatter',
+           'alltoall', 'send', 'recv', 'barrier', 'wait',
+           'init_parallel_env', 'DataParallel', 'fleet', 'spawn', 'launch']
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Reference: paddle.distributed.spawn forks nprocs GPU workers.
+    On TPU one process drives all chips, so spawn configures an
+    nprocs-wide mesh and calls func once."""
+    init_parallel_env(nprocs if nprocs > 0 else None)
+    return func(*args)
+
+
+def launch():
+    raise NotImplementedError(
+        "use `python -m paddle_tpu.distributed.launch` (multi-host TPU "
+        "pods launch one process per host via the TPU runtime)")

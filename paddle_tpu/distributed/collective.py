@@ -1,0 +1,314 @@
+"""Collective communication ops.
+
+Reference analogue: /root/reference/python/paddle/distributed/collective.py
++ the C++ c_allreduce/c_allgather/... NCCL ops in
+paddle/fluid/operators/collective/.  TPU-native: a collective is NOT a
+runtime call into a comm library — it is an XLA op (`lax.psum`,
+`lax.all_gather`, `lax.ppermute`, `lax.all_to_all`) that the compiler
+schedules onto ICI links, overlapping with compute.  These functions are
+therefore *trace-time* constructs: inside a `shard_map` region (entered
+by paddle_tpu's parallel engines) they lower to the XLA collective over
+the bound mesh axis; outside any parallel region they are the identity
+(world of one replica), which keeps single-chip code runnable unchanged.
+
+Process groups: a reference `Group` names a NCCL communicator subset; a
+paddle_tpu `Group` names a SET OF MESH AXES — e.g. the dp group is axis
+('dp',), the mp group axis ('tp',).  XLA derives the participant subsets
+from the mesh, which is how sub-groups ride ICI instead of host loops.
+"""
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+from . import env as _env
+
+__all__ = ['ReduceOp', 'Group', 'new_group', 'get_group', 'all_reduce',
+           'all_gather', 'all_gather_object', 'broadcast', 'reduce',
+           'scatter', 'alltoall', 'send', 'recv', 'barrier', 'wait',
+           'axis_scope', 'current_axes', 'get_axis_rank', 'split_group']
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communicator = a tuple of mesh axis names."""
+
+    def __init__(self, id, axes, ranks=None):
+        self.id = id
+        self.axes = tuple(axes)
+        self.ranks = ranks
+        self.nranks = -1  # resolved against mesh at use time
+
+    @property
+    def name(self):
+        return f"group_{self.id}:{','.join(self.axes)}"
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axes={self.axes})"
+
+
+_groups = {}
+_next_gid = 1
+
+
+def _world_group():
+    mesh = _env.get_mesh()
+    axes = tuple(mesh.axis_names) if mesh is not None else ()
+    return Group(0, axes)
+
+
+def get_group(gid=0):
+    if gid == 0:
+        return _world_group()
+    return _groups[gid]
+
+
+def new_group(ranks=None, backend=None, axes=None):
+    """Create a group.  TPU-native callers pass `axes=('dp',)`; the
+    reference rank-list form is accepted and maps to the world group's
+    axes when it covers all ranks (arbitrary rank subsets that do not
+    correspond to a mesh sub-axis are not representable on ICI)."""
+    global _next_gid
+    gid = _next_gid
+    _next_gid += 1
+    if axes is None:
+        axes = _world_group().axes
+    g = Group(gid, axes, ranks)
+    _groups[gid] = g
+    return g
+
+
+# -- axis scope: which mesh axes are live inside the current shard_map ----
+
+_axis_stack = []
+
+
+@contextlib.contextmanager
+def axis_scope(*names):
+    """Entered by parallel engines around shard_map'd bodies so eager-API
+    collectives in user code resolve their mesh axis."""
+    _axis_stack.append(tuple(names))
+    try:
+        yield
+    finally:
+        _axis_stack.pop()
+
+
+def current_axes():
+    return _axis_stack[-1] if _axis_stack else ()
+
+
+def _resolve_axes(group):
+    live = current_axes()
+    if not live:
+        return ()
+    if group is None or group == 0:
+        return live
+    axes = group.axes if isinstance(group, Group) else tuple(group)
+    return tuple(a for a in axes if a in live)
+
+
+def get_axis_rank(axis):
+    """Logical coordinate along `axis` (only inside a parallel region)."""
+    if axis in current_axes():
+        return lax.axis_index(axis)
+    return 0
+
+
+def _unwrap(x):
+    return x.value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _rewrap(x, val):
+    if isinstance(x, Tensor):
+        x.value = val
+        return x
+    return Tensor._from_value(val)
+
+
+# -- collectives -------------------------------------------------------------
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    axes = _resolve_axes(group)
+    if not axes:
+        return tensor
+    def fn(v):
+        if op == ReduceOp.SUM:
+            return lax.psum(v, axes)
+        if op == ReduceOp.MAX:
+            return lax.pmax(v, axes)
+        if op == ReduceOp.MIN:
+            return lax.pmin(v, axes)
+        if op == ReduceOp.AVG:
+            return lax.pmean(v, axes)
+        if op == ReduceOp.PROD:
+            return jnp.exp(lax.psum(jnp.log(v), axes))
+        raise ValueError(f"bad ReduceOp {op}")
+    out = apply(fn, tensor if isinstance(tensor, Tensor)
+                else Tensor._from_value(_unwrap(tensor)),
+                op_name='all_reduce')
+    # reference mutates in place
+    return _rewrap(tensor, out.value if isinstance(out, Tensor) else out)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    """tensor_list (out): filled with per-rank shards; returns the
+    concatenated array as well (TPU-friendly return form)."""
+    axes = _resolve_axes(group)
+    v = _unwrap(tensor)
+    if not axes:
+        if isinstance(tensor_list, list):
+            tensor_list.append(_rewrap(None, v) if not isinstance(tensor, Tensor)
+                               else Tensor._from_value(v))
+        return Tensor._from_value(v)
+    name = axes[0] if len(axes) == 1 else axes
+    gathered = lax.all_gather(v, name, axis=0, tiled=False)
+    n = gathered.shape[0]
+    if isinstance(tensor_list, list):
+        for i in range(n):
+            tensor_list.append(Tensor._from_value(gathered[i]))
+    return Tensor._from_value(
+        jnp.concatenate([gathered[i] for i in range(n)], axis=axis)
+        if axis != 0 else gathered.reshape((-1,) + v.shape[1:]))
+
+
+def all_gather_object(obj_list, obj, group=None):
+    """Host-side object gather — single-process world: identity."""
+    obj_list.append(obj)
+    return obj_list
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    axes = _resolve_axes(group)
+    if not axes:
+        return tensor
+    v = _unwrap(tensor)
+    name = axes[0]
+    idx = lax.axis_index(name)
+    n = lax.psum(1, name)
+    # select src's value: mask + sum (XLA turns this into a broadcast)
+    mask = (idx == src).astype(v.dtype)
+    out = lax.psum(v * mask, name)
+    return _rewrap(tensor, out)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    axes = _resolve_axes(group)
+    if not axes:
+        return tensor
+    v = _unwrap(tensor)
+    name = axes[0]
+    summed = lax.psum(v, name) if op == ReduceOp.SUM else (
+        lax.pmax(v, name) if op == ReduceOp.MAX else lax.pmin(v, name))
+    idx = lax.axis_index(name)
+    out = jnp.where(idx == dst, summed, v)
+    return _rewrap(tensor, out)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    axes = _resolve_axes(group)
+    if not axes:
+        if tensor_list:
+            return _rewrap(tensor, _unwrap(tensor_list[0]))
+        return tensor
+    name = axes[0]
+    stacked = jnp.stack([_unwrap(t) for t in tensor_list], axis=0)
+    full = broadcast(Tensor._from_value(stacked), src=src, group=group)
+    idx = lax.axis_index(name)
+    out = lax.dynamic_index_in_dim(full.value, idx, axis=0, keepdims=False)
+    return _rewrap(tensor, out)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    axes = _resolve_axes(group)
+    if not axes:
+        outs = [Tensor._from_value(_unwrap(t)) for t in in_tensor_list]
+        if isinstance(out_tensor_list, list):
+            out_tensor_list.extend(outs)
+        return outs
+    name = axes[0]
+    x = jnp.stack([_unwrap(t) for t in in_tensor_list], axis=0)
+    y = lax.all_to_all(x, name, split_axis=0, concat_axis=0, tiled=False)
+    outs = [Tensor._from_value(y[i]) for i in range(y.shape[0])]
+    if isinstance(out_tensor_list, list):
+        out_tensor_list.extend(outs)
+    return outs
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Point-to-point on TPU = ppermute ring step.  send/recv pairs in
+    the reference's pipeline engine become ppermute rotations here; a
+    bare send outside a parallel region is a no-op."""
+    axes = _resolve_axes(group)
+    if not axes:
+        return tensor
+    name = axes[0]
+    n = lax.psum(1, name)
+    perm = [(i, dst) for i in range(n)]  # degenerate: everyone → dst
+    out = lax.ppermute(_unwrap(tensor), name, perm)
+    return _rewrap(tensor, out)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    axes = _resolve_axes(group)
+    if not axes:
+        return tensor
+    name = axes[0]
+    n = lax.psum(1, name)
+    perm = [(src, i) for i in range(n)]
+    out = lax.ppermute(_unwrap(tensor), name, perm)
+    return _rewrap(tensor, out)
+
+
+def p2p_rotate(tensor, group=None, shift=1):
+    """Ring rotation: rank i → rank (i+shift)%n.  The TPU-native
+    primitive behind pipeline microbatch handoff and ring attention."""
+    axes = _resolve_axes(group)
+    if not axes:
+        return tensor
+    name = axes[0]
+    n = _axis_size(name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    out = lax.ppermute(_unwrap(tensor), name, perm)
+    return _rewrap(tensor, out)
+
+
+def _axis_size(name):
+    mesh = _env.get_mesh()
+    if mesh is not None and name in mesh.shape:
+        return mesh.shape[name]
+    return lax.psum(1, name)
+
+
+def barrier(group=None):
+    """XLA programs are bulk-synchronous per step; barrier is only
+    meaningful host-side (multi-host sync)."""
+    try:
+        import jax.experimental.multihost_utils as mh
+        if jax.process_count() > 1:
+            mh.sync_global_devices('paddle_tpu_barrier')
+    except Exception:
+        pass
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    v = _unwrap(tensor)
+    if hasattr(v, 'block_until_ready'):
+        v.block_until_ready()
+    return tensor
+
+
+def split_group(mesh_axis):
+    """Convenience: the Group for one mesh axis."""
+    return new_group(axes=(mesh_axis,))

@@ -1,0 +1,114 @@
+"""Parallel environment state.
+
+Reference analogue: /root/reference/python/paddle/distributed/parallel.py
+(ParallelEnv reads trainer env vars set by launch/spawn).  TPU-native:
+"rank" is jax.process_index() for multi-host, and the *logical* rank of
+a shard is a mesh-axis coordinate inside shard_map — there are no
+per-GPU worker processes on one host.  The global Mesh is the single
+source of truth for topology.
+"""
+import os
+
+import numpy as np
+
+__all__ = ['ParallelEnv', 'get_rank', 'get_world_size', 'get_mesh',
+           'set_mesh', 'build_mesh', 'default_mesh_devices']
+
+_global_mesh = None
+
+
+def set_mesh(mesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh():
+    return _global_mesh
+
+
+def default_mesh_devices():
+    import jax
+    return jax.devices()
+
+
+def build_mesh(axes):
+    """axes: ordered dict/list of (name, size); size=-1 → infer.
+
+    Returns jax.sharding.Mesh over all visible devices.  Axis order is
+    chosen so the LAST axis maps to physically-adjacent devices (ICI
+    neighbours in JAX's default device order) — put the
+    highest-bandwidth-demand axis (tp) last.
+    """
+    import jax
+    from jax.sharding import Mesh
+    items = list(axes.items()) if isinstance(axes, dict) else list(axes)
+    devices = np.asarray(jax.devices())
+    n = devices.size
+    sizes = [s for _, s in items]
+    known = int(np.prod([s for s in sizes if s > 0])) or 1
+    sizes = [s if s > 0 else n // known for s in sizes]
+    if int(np.prod(sizes)) != n:
+        raise ValueError(f"mesh axes {items} do not cover {n} devices")
+    names = tuple(name for name, _ in items)
+    return Mesh(devices.reshape(sizes), names)
+
+
+class ParallelEnv:
+    """Reference: paddle.distributed.ParallelEnv."""
+
+    def __init__(self):
+        pass
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def dev_id(self):
+        return int(os.environ.get('FLAGS_selected_tpus', '0'))
+
+    @property
+    def device_type(self):
+        return 'tpu'
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get('PADDLE_CURRENT_ENDPOINT', '127.0.0.1:0')
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get('PADDLE_TRAINER_ENDPOINTS', '')
+        return eps.split(',') if eps else ['127.0.0.1:0']
+
+
+def get_rank():
+    """Host process rank (multi-host); inside shard_map use
+    collective.get_axis_rank for the logical shard rank."""
+    import jax
+    try:
+        return jax.process_index()
+    except RuntimeError:
+        return 0
+
+
+def get_world_size():
+    mesh = get_mesh()
+    if mesh is not None:
+        return int(np.prod(list(mesh.shape.values())))
+    import jax
+    try:
+        return jax.device_count()
+    except RuntimeError:
+        return 1

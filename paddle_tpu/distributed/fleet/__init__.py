@@ -1,0 +1,29 @@
+"""paddle_tpu.distributed.fleet — the distributed training facade.
+
+Reference analogue: /root/reference/python/paddle/distributed/fleet/
+(base/fleet_base.py Fleet singleton, DistributedStrategy proto,
+meta_optimizers rewriting Programs, meta_parallel layers).  TPU-native:
+a DistributedStrategy selects MESH AXES AND SHARDINGS, not graph
+rewrites — `fleet.init` builds one jax.sharding.Mesh with axes
+(pp, dp, sp, tp) sized from strategy.hybrid_configs, and the parallel
+engine (paddle_tpu.parallel.engine) compiles the train step with
+NamedShardings derived from layer metadata.  XLA then inserts the same
+collectives the reference's meta_optimizers insert by hand (allreduce ≙
+psum, ZeRO ≙ reduce-scatter + sharded opt state, etc.).
+"""
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .fleet_base import (  # noqa: F401
+    init, is_first_worker, worker_index, worker_num, is_worker,
+    worker_endpoints, server_num, server_index, server_endpoints,
+    is_server, barrier_worker, init_worker, init_server, run_server,
+    stop_worker, distributed_optimizer, distributed_model, get_hybrid_communicate_group,
+    get_fleet)
+from .meta_parallel import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy, PipelineLayer, LayerDesc, get_rng_state_tracker)
+
+__all__ = ['DistributedStrategy', 'init', 'distributed_optimizer',
+           'distributed_model', 'worker_index', 'worker_num',
+           'is_first_worker', 'ColumnParallelLinear', 'RowParallelLinear',
+           'VocabParallelEmbedding', 'ParallelCrossEntropy',
+           'PipelineLayer', 'LayerDesc', 'get_hybrid_communicate_group']

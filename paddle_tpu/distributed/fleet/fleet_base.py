@@ -1,0 +1,209 @@
+"""Fleet singleton.
+
+Reference analogue:
+/root/reference/python/paddle/distributed/fleet/base/fleet_base.py.
+`fleet.init(strategy)` builds THE mesh: axes ordered (pp, dp, sp, tp)
+— tp innermost so its heavy matmul-shard collectives ride adjacent ICI
+links, pp outermost since its traffic is one activation handoff per
+microbatch (see SURVEY §6).  Parameter-server paths (init_server etc.)
+exist for API parity and run the TPU sharded-embedding substitute.
+"""
+import numpy as np
+
+from .. import env as _env
+from .distributed_strategy import DistributedStrategy
+
+__all__ = ['init', 'get_fleet']
+
+
+class HybridCommunicateGroup:
+    """Reference: fleet/base/topology.py::HybridCommunicateGroup —
+    answers "what is my rank/world-size along each parallel dimension".
+    On TPU, ranks along axes are mesh coordinates; host code is rank 0
+    of everything (one process drives all chips)."""
+
+    def __init__(self, mesh):
+        self._mesh = mesh
+        shape = dict(mesh.shape) if mesh is not None else {}
+        self._dp = shape.get('dp', 1)
+        self._mp = shape.get('tp', 1)
+        self._pp = shape.get('pp', 1)
+        self._sp = shape.get('sp', 1)
+
+    def get_data_parallel_world_size(self):
+        return self._dp
+
+    def get_model_parallel_world_size(self):
+        return self._mp
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp
+
+    def get_sequence_parallel_world_size(self):
+        return self._sp
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_model_parallel_group(self):
+        from .. import collective
+        return collective.new_group(axes=('tp',))
+
+    def get_data_parallel_group(self):
+        from .. import collective
+        return collective.new_group(axes=('dp',))
+
+    def get_pipe_parallel_group(self):
+        from .. import collective
+        return collective.new_group(axes=('pp',))
+
+    def topology(self):
+        return self._mesh
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy = None
+        self._hcg = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        import jax
+        n = jax.device_count()
+        mp = max(1, hc.get('mp_degree') or 1)
+        pp = max(1, hc.get('pp_degree') or 1)
+        sp = max(1, hc.get('sp_degree') or 1)
+        dp = hc.get('dp_degree') or -1
+        if dp is None or dp <= 0:
+            dp = max(1, n // (mp * pp * sp))
+        axes = [('pp', pp), ('dp', dp), ('sp', sp), ('tp', mp)]
+        # only materialize axes that exist — 1-sized axes still get names
+        # so PartitionSpecs stay valid regardless of strategy
+        mesh = _env.build_mesh(axes)
+        _env.set_mesh(mesh)
+        self._hcg = HybridCommunicateGroup(mesh)
+        self._is_initialized = True
+        return self
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+
+_fleet = Fleet()
+
+
+def get_fleet():
+    return _fleet
+
+
+def init(role_maker=None, is_collective=True, strategy=None):
+    return _fleet.init(role_maker, is_collective, strategy)
+
+
+def get_hybrid_communicate_group():
+    return _fleet._hcg
+
+
+def distributed_model(model):
+    """Reference wraps with DataParallel; under GSPMD the model is
+    already mesh-aware via layer shardings — return as-is with the dp
+    wrapper only for grad-sync API parity."""
+    from ..parallel import DataParallel
+    return DataParallel(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Attach strategy-driven behavior to an optimizer.
+
+    The reference chains meta_optimizers that rewrite the Program; here
+    the strategy is carried on the optimizer and consumed by the
+    compiled step builder (paddle_tpu.parallel.engine):
+      lamb/lars → swap the update rule; sharding → shard opt state on dp;
+      gradient_merge → scan-accumulate; recompute → remat policy.
+    """
+    strategy = strategy or _fleet._strategy or DistributedStrategy()
+    if strategy.lamb:
+        from ...optimizer import Lamb
+        if not isinstance(optimizer, Lamb):
+            optimizer = Lamb(
+                learning_rate=optimizer.get_lr(),
+                parameters=optimizer._parameter_list,
+                lamb_weight_decay=strategy.lamb_configs.get(
+                    'lamb_weight_decay', 0.01))
+    optimizer._fleet_strategy = strategy
+    return optimizer
+
+
+# -- worker/server role API (parity; collective mode on TPU) -----------------
+
+def is_first_worker():
+    return _env.get_rank() == 0
+
+
+def worker_index():
+    return _env.get_rank()
+
+
+def worker_num():
+    import jax
+    try:
+        return jax.process_count()
+    except RuntimeError:
+        return 1
+
+
+def is_worker():
+    return True
+
+
+def worker_endpoints(to_string=False):
+    eps = _env.ParallelEnv().trainer_endpoints
+    return ','.join(eps) if to_string else eps
+
+
+def server_num():
+    return 0
+
+
+def server_index():
+    return 0
+
+
+def server_endpoints(to_string=False):
+    return '' if to_string else []
+
+
+def is_server():
+    return False
+
+
+def barrier_worker():
+    from .. import collective
+    collective.barrier()
+
+
+def init_worker():
+    pass
+
+
+def init_server(*args, **kwargs):
+    pass
+
+
+def run_server():
+    raise NotImplementedError(
+        "parameter-server runtime is replaced by mesh-sharded embeddings "
+        "on TPU (see paddle_tpu.incubate.sparse_embedding)")
+
+
+def stop_worker():
+    pass

@@ -1,0 +1,239 @@
+"""meta_parallel — tensor-parallel layers + pipeline structure.
+
+Reference analogue:
+/root/reference/python/paddle/distributed/fleet/meta_parallel/
+(parallel_layers/mp_layers.py: ColumnParallelLinear, RowParallelLinear,
+VocabParallelEmbedding backed by c_identity/c_allreduce/c_allgather NCCL
+ops; pp_layers.py: PipelineLayer/LayerDesc).  TPU-native: layers create
+FULL logical parameters and attach a PartitionSpec per parameter
+(`_param_shardings`); the compiled step (paddle_tpu.parallel.engine)
+turns those into NamedShardings over the mesh and XLA's SPMD partitioner
+inserts exactly the collectives the reference hand-codes — column split
+= no comm forward / reduce-scatter backward, row split = psum forward.
+Sharding-constraint hints inside forward keep the partitioner honest on
+activation layouts.  Single chip, everything degrades to plain layers.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, Parameter
+from ...core import rng as rng_mod
+from ...nn.layer.layers import Layer
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...parallel.api import maybe_shard
+
+__all__ = ['ColumnParallelLinear', 'RowParallelLinear',
+           'VocabParallelEmbedding', 'ParallelCrossEntropy',
+           'PipelineLayer', 'LayerDesc', 'get_rng_state_tracker',
+           'RNGStatesTracker']
+
+
+class ColumnParallelLinear(Layer):
+    """Y = XW + b with W column-split over 'tp'.
+
+    Reference: mp_layers.py::ColumnParallelLinear (c_identity fwd,
+    c_allreduce bwd).  Here: weight P(None,'tp'); XLA derives the
+    comm pattern from shardings.
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        w_init = getattr(weight_attr, 'initializer', None) if weight_attr \
+            else None
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=w_init or I.XavierNormal())
+        self.bias = self.create_parameter(
+            shape=[out_features], attr=None, is_bias=True) if has_bias \
+            else None
+        self._param_shardings = {'weight': (None, 'tp'),
+                                 'bias': ('tp',) if has_bias else None}
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return maybe_shard(y, None)      # replicated on tp
+        return maybe_shard(y, ('...', 'tp'))  # last dim tp-sharded
+
+    def extra_repr(self):
+        return f"col-parallel {list(self.weight.shape)}"
+
+
+class RowParallelLinear(Layer):
+    """Y = XW + b with W row-split over 'tp'; forward needs a psum
+    (XLA inserts it from the shardings).
+
+    Reference: mp_layers.py::RowParallelLinear (c_allreduce_sum fwd).
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        w_init = getattr(weight_attr, 'initializer', None) if weight_attr \
+            else None
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=w_init or I.XavierNormal())
+        self.bias = self.create_parameter(
+            shape=[out_features], attr=None, is_bias=True) if has_bias \
+            else None
+        self._param_shardings = {'weight': ('tp', None),
+                                 'bias': None if self.bias is not None
+                                 else None}
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = maybe_shard(x, ('...', 'tp'))
+        y = F.linear(x, self.weight, self.bias)
+        return maybe_shard(y, None)  # psum lands here under SPMD
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim split over 'tp'.
+
+    Reference: mp_layers.py::VocabParallelEmbedding (masked local lookup
+    + c_allreduce).  Under GSPMD the table is P('tp', None) and XLA
+    partitions the gather the same way.
+    """
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        w_init = getattr(weight_attr, 'initializer', None) if weight_attr \
+            else None
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=w_init or I.XavierNormal())
+        self._param_shardings = {'weight': ('tp', None)}
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(Layer):
+    """CE over tp-sharded logits.
+
+    Reference: parallel_cross_entropy in mp_layers — a
+    local-max/psum-logsumexp dance over NCCL.  With logits sharded
+    P(...,'tp'), XLA's partitioner derives that same pattern from the
+    ordinary fused CE.
+    """
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction='none',
+                               ignore_index=self.ignore_index)
+
+
+# -- pipeline structure ------------------------------------------------------
+
+class LayerDesc:
+    """Deferred layer constructor (reference: pp_layers.py::LayerDesc) —
+    lets PipelineLayer materialize parameters only on the owning stage."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr='weight',
+                 *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Reference: pp_layers.py::PipelineLayer — holds the full layer
+    list, segments it into `num_stages` contiguous stages.  TPU engine
+    options: (a) GSPMD: stage params live on 'pp' mesh rows, microbatch
+    GPipe loop via shard_map+ppermute (parallel/pipeline.py); (b) single
+    chip: plain sequential forward.  This class is the structure; the
+    schedule lives in the engine.
+    """
+
+    def __init__(self, layers, num_stages=1, loss_fn=None, topology=None,
+                 seg_method='uniform', recompute_interval=0, **kwargs):
+        super().__init__()
+        self.descs = list(layers)
+        self.num_stages = num_stages
+        self.loss_fn = loss_fn
+        self.recompute_interval = recompute_interval
+        built = []
+        for i, d in enumerate(self.descs):
+            layer = d.build_layer() if isinstance(d, LayerDesc) else d
+            built.append(layer)
+            if isinstance(layer, Layer):
+                self.add_sublayer(str(i), layer)
+        self.run_function = built
+        # contiguous uniform segmentation (reference default)
+        n = len(built)
+        per = int(np.ceil(n / num_stages))
+        self.stage_bounds = [(s * per, min(n, (s + 1) * per))
+                             for s in range(num_stages)]
+
+    def stage_layers(self, stage_id):
+        lo, hi = self.stage_bounds[stage_id]
+        return self.run_function[lo:hi]
+
+    def forward(self, x):
+        for fn in self.run_function:
+            x = fn(x)
+        return x
+
+
+# -- rng tracker -------------------------------------------------------------
+
+class RNGStatesTracker:
+    """Reference: parallel_layers/random.py::RNGStatesTracker — keeps
+    named RNG streams so tp ranks drop the SAME units where weights are
+    replicated and DIFFERENT units where they're sharded.  JAX version:
+    named substreams fork the global key; 'model_parallel' additionally
+    folds in the tp coordinate inside parallel regions."""
+
+    def __init__(self):
+        self.states = {}
+
+    def add(self, name, seed):
+        import jax
+        self.states[name] = jax.random.PRNGKey(int(seed))
+
+    def rng_state(self, name='model_parallel'):
+        import contextlib
+
+        @contextlib.contextmanager
+        def scope():
+            import jax
+            if name not in self.states:
+                self.add(name, hash(name) & 0x7fffffff)
+            key = self.states[name]
+            from .. import collective
+            if name == 'model_parallel' and 'tp' in collective.current_axes():
+                import jax.lax as lax
+                key = jax.random.fold_in(key, lax.axis_index('tp'))
+            self.states[name], use = jax.random.split(self.states[name])
+            with rng_mod.functional_key_scope(use):
+                yield
+        return scope()
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker

@@ -1,0 +1,89 @@
+"""init_parallel_env + DataParallel.
+
+Reference analogue: /root/reference/python/paddle/distributed/parallel.py.
+The reference's dygraph DataParallel registers grad hooks that issue
+NCCL allreduce per bucket.  TPU-native DataParallel instead *shards the
+batch over the dp mesh axis* and lets XLA insert the gradient
+reduce-scatter/all-reduce:
+
+  * eager (1 process): DataParallel is transparent — forward unchanged;
+    `apply_collective_grads` psum-averages grads ONLY inside a parallel
+    region (shard_map).  Single chip: identity.
+  * compiled (fleet engine / hapi): the train step is shard_mapped over
+    the mesh with batch sharded on 'dp'; grads come out of jax.grad
+    already per-shard, one `psum` over 'dp' synchronizes — exactly the
+    reference's allreduce semantics but fused by XLA.
+"""
+import numpy as np
+
+from ..nn.layer.layers import Layer
+from . import env as _env
+from . import collective
+
+__all__ = ['init_parallel_env', 'DataParallel']
+
+
+def init_parallel_env(n_devices=None, axes=None):
+    """Build and install the global mesh.
+
+    Reference signature takes no args (env vars decide); here optional
+    `axes` (e.g. {'dp': 2, 'tp': 4}) controls topology — default is a
+    pure data-parallel mesh over all visible chips.
+    """
+    import jax
+    if _env.get_mesh() is not None and n_devices is None and axes is None:
+        return _env.ParallelEnv()
+    if axes is None:
+        n = n_devices or jax.device_count()
+        axes = {'dp': n}
+    mesh = _env.build_mesh(axes)
+    _env.set_mesh(mesh)
+    return _env.ParallelEnv()
+
+
+class DataParallel(Layer):
+    """Reference: python/paddle/fluid/dygraph/parallel.py::DataParallel."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        # XLA psum-of-mean over equal shards already averages; keep the
+        # reference's API (it divides by nranks before backward).
+        axes = collective.current_axes()
+        if not axes or 'dp' not in axes:
+            return loss
+        n = _env.get_mesh().shape.get('dp', 1) if _env.get_mesh() else 1
+        return loss / float(n)
+
+    def apply_collective_grads(self):
+        """psum gradients over the dp axis (no-op outside a parallel
+        region — single chip or already-synchronized compiled step)."""
+        axes = collective.current_axes()
+        if not axes or 'dp' not in axes:
+            return
+        import jax.lax as lax
+        for p in self._layers.parameters():
+            if p._grad is not None:
+                p._grad = lax.psum(p._grad, 'dp')
+
+    # delegate state management to the wrapped layer
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix='', include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)

@@ -1,0 +1,9 @@
+"""paddle_tpu.framework — save/load and misc framework-level helpers.
+
+Reference analogue: /root/reference/python/paddle/framework/ (io.py,
+random.py, framework.py).
+"""
+from .io import save, load  # noqa: F401
+from ..core.rng import seed, get_seed  # noqa: F401
+
+__all__ = ['save', 'load', 'seed', 'get_seed']

@@ -1,0 +1,48 @@
+"""paddle.save / paddle.load.
+
+Reference analogue: /root/reference/python/paddle/framework/io.py, which
+pickles a dict of LoDTensor→numpy.  Same wire idea here: Tensors are
+converted to numpy on save (device→host once, async-friendly), and load
+returns numpy arrays — `set_state_dict` re-uploads to HBM lazily on
+first use.  Checkpoint-at-scale (async, sharded) lives in
+paddle_tpu.hapi.checkpoint (orbax-backed).
+"""
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ['save', 'load']
+
+_PROTO = 4
+
+
+def _to_host(obj):
+    if isinstance(obj, Tensor):
+        return np.asarray(obj.value)
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_host(v) for v in obj)
+    try:
+        import jax
+        if isinstance(obj, jax.Array):
+            return np.asarray(obj)
+    except ImportError:
+        pass
+    return obj
+
+
+def save(obj, path, protocol=_PROTO, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, 'wb') as f:
+        pickle.dump(_to_host(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, 'rb') as f:
+        return pickle.load(f)

@@ -1,0 +1,451 @@
+"""paddle_tpu.io — datasets, samplers, DataLoader.
+
+Reference analogue: /root/reference/python/paddle/io/ (dataset.py,
+dataloader/*, sampler.py) whose DataLoader forks C++/Python workers and
+pushes LoDTensors over a blocking queue.  TPU-native: the loader is a
+host-side prefetch pipeline — a thread pool maps the dataset, a
+ring-buffer queue of collated numpy batches keeps the accelerator fed,
+and `jax.device_put` happens at dequeue so H2D copy overlaps compute
+(double buffering).  TPU input pipelines are host-CPU-bound, not
+device-bound, so threads (which release the GIL inside numpy) replace
+the reference's process workers for typical decode/augment loads.
+"""
+import bisect
+import itertools
+import queue
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ['Dataset', 'IterableDataset', 'TensorDataset', 'ChainDataset',
+           'ComposeDataset', 'Subset', 'random_split', 'ConcatDataset',
+           'Sampler', 'SequenceSampler', 'RandomSampler', 'BatchSampler',
+           'WeightedRandomSampler', 'DistributedBatchSampler', 'DataLoader',
+           'default_collate_fn', 'get_worker_info']
+
+
+# -- datasets ----------------------------------------------------------------
+
+class Dataset:
+    """Map-style dataset (reference: io/dataset.py)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset has no __getitem__")
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset has no __len__")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors):
+        lens = {len(t) if isinstance(t, (list, np.ndarray)) else t.shape[0]
+                for t in tensors}
+        if len(lens) > 1:
+            raise ValueError("tensors must share dim 0")
+        self.tensors = tensors
+
+    def __getitem__(self, idx):
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self):
+        t = self.tensors[0]
+        return len(t) if isinstance(t, (list, np.ndarray)) else t.shape[0]
+
+
+class ComposeDataset(Dataset):
+    """Zip several map datasets into one (fields concatenated)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __len__(self):
+        return min(len(d) for d in self.datasets)
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            item = d[idx]
+            out.extend(item if isinstance(item, (tuple, list)) else [item])
+        return tuple(out)
+
+
+class ChainDataset(IterableDataset):
+    """Chain iterable datasets back-to-back."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        return itertools.chain(*self.datasets)
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self.cumulative_sizes = list(
+            itertools.accumulate(len(d) for d in self.datasets))
+
+    def __len__(self):
+        return self.cumulative_sizes[-1] if self.cumulative_sizes else 0
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        ds = bisect.bisect_right(self.cumulative_sizes, idx)
+        prev = self.cumulative_sizes[ds - 1] if ds > 0 else 0
+        return self.datasets[ds][idx - prev]
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None):
+    if sum(lengths) != len(dataset):
+        raise ValueError("sum of lengths must equal dataset length")
+    rng = np.random.RandomState(generator if isinstance(generator, int)
+                                else None)
+    perm = rng.permutation(len(dataset))
+    out, off = [], 0
+    for n in lengths:
+        out.append(Subset(dataset, perm[off:off + n].tolist()))
+        off += n
+    return out
+
+
+# -- samplers ----------------------------------------------------------------
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        rng = np.random.RandomState(
+            self.generator if isinstance(self.generator, int) else None)
+        if self.replacement:
+            return iter(rng.randint(0, n, size=self.num_samples).tolist())
+        return iter(rng.permutation(n)[:self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        super().__init__(None)
+        self.weights = np.asarray(weights, dtype='float64')
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        idx = np.random.choice(len(p), size=self.num_samples,
+                               replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False):
+        super().__init__(dataset)
+        if sampler is not None:
+            self.sampler = sampler
+        elif shuffle:
+            self.sampler = RandomSampler(dataset)
+        else:
+            self.sampler = SequenceSampler(dataset)
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Shards sample indices across data-parallel ranks.
+
+    Reference: io/dataloader/batch_sampler.py::DistributedBatchSampler.
+    On TPU the "rank" is a position on the `dp` mesh axis; with a global
+    (pmap-free, jit-sharded) input pipeline each host feeds its own
+    shard of the global batch.
+    """
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        self.dataset = dataset
+        from ..distributed import env as dist_env
+        self.nranks = (num_replicas if num_replicas is not None
+                       else dist_env.get_world_size())
+        self.local_rank = rank if rank is not None else dist_env.get_rank()
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.num_samples = int(np.ceil(len(dataset) / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def __iter__(self):
+        n = len(self.dataset)
+        indices = np.arange(n)
+        if self.shuffle:
+            rng = np.random.RandomState(self.epoch)
+            indices = rng.permutation(n)
+        indices = np.concatenate(
+            [indices, indices[:self.total_size - n]])  # pad to even shards
+        indices = indices[self.local_rank:self.total_size:self.nranks]
+        batch = []
+        for idx in indices.tolist():
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+
+# -- collate / worker info ---------------------------------------------------
+
+def default_collate_fn(batch):
+    """Stack a list of samples into batched numpy arrays (stay on host;
+    device transfer happens once per batch at dequeue)."""
+    sample = batch[0]
+    if isinstance(sample, (np.ndarray, np.generic)):
+        return np.stack(batch)
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s.value) for s in batch])
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return np.asarray(batch)
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return [default_collate_fn(list(field)) for field in zip(*batch)]
+    return list(batch)
+
+
+class _WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = threading.local()
+
+
+def get_worker_info():
+    return getattr(_worker_info, 'info', None)
+
+
+# -- DataLoader --------------------------------------------------------------
+
+class _EndOfEpoch:
+    pass
+
+
+class DataLoader:
+    """Prefetching loader (reference: io/dataloader/dataloader_iter.py).
+
+    num_workers>0 → a thread pool maps __getitem__+collate concurrently
+    and a bounded ring-buffer queue holds ready batches; the main thread
+    dequeues host batches and (optionally) returns device Tensors.
+    """
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=False, timeout=0, worker_init_fn=None,
+                 persistent_workers=False, to_tensor=True):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = max(0, int(num_workers))
+        self.prefetch_factor = max(2, int(prefetch_factor))
+        self.worker_init_fn = worker_init_fn
+        self.to_tensor = to_tensor
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            if batch_size is None:
+                self.batch_sampler = None
+                self.batch_size = None
+            else:
+                self.batch_sampler = BatchSampler(
+                    dataset, shuffle=shuffle, batch_size=batch_size,
+                    drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset has no len()")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)
+
+    def _fetch(self, indices):
+        batch = [self.dataset[i] for i in indices]
+        return self.collate_fn(batch)
+
+    def _wrap(self, host_batch):
+        if not self.to_tensor:
+            return host_batch
+        def dev(x):
+            if isinstance(x, np.ndarray) and x.dtype != object and \
+                    x.dtype.kind in 'biufc':
+                return Tensor(x)
+            return x
+        if isinstance(host_batch, dict):
+            return {k: dev(v) for k, v in host_batch.items()}
+        if isinstance(host_batch, (tuple, list)):
+            return [dev(v) for v in host_batch]
+        return dev(host_batch)
+
+    # -- iteration paths -----------------------------------------------------
+    def _iter_sync(self):
+        if self._iterable:
+            it = iter(self.dataset)
+            if self.batch_size is None:
+                for item in it:
+                    yield self._wrap(item)
+                return
+            while True:
+                batch = list(itertools.islice(it, self.batch_size))
+                if not batch:
+                    return
+                if len(batch) < self.batch_size and self.drop_last:
+                    return
+                yield self._wrap(self.collate_fn(batch))
+        elif self.batch_sampler is None:
+            # batch_size=None → yield raw samples, no collation
+            for i in range(len(self.dataset)):
+                yield self._wrap(self.dataset[i])
+        else:
+            for indices in self.batch_sampler:
+                yield self._wrap(self._fetch(indices))
+
+    def _iter_threaded(self):
+        out_q = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
+        work_q = queue.Queue()
+        for pos, indices in enumerate(self.batch_sampler):
+            work_q.put((pos, indices))
+        n_batches = work_q.qsize()
+        results = {}
+        lock = threading.Lock()
+
+        def worker(wid):
+            _worker_info.info = _WorkerInfo(wid, self.num_workers,
+                                            self.dataset)
+            if self.worker_init_fn is not None:
+                self.worker_init_fn(wid)
+            while True:
+                try:
+                    pos, indices = work_q.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    out_q.put((pos, self._fetch(indices)))
+                except Exception as e:  # surface in main thread
+                    out_q.put((pos, e))
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        # re-order: batches may finish out of order; emit sequentially
+        next_pos = 0
+        received = 0
+        while next_pos < n_batches:
+            if next_pos in results:
+                item = results.pop(next_pos)
+            else:
+                pos, item = out_q.get()
+                received += 1
+                if pos != next_pos:
+                    results[pos] = item
+                    continue
+            if isinstance(item, Exception):
+                raise item
+            yield self._wrap(item)
+            next_pos += 1
+
+    def __iter__(self):
+        if self.num_workers > 0 and not self._iterable \
+                and self.batch_sampler is not None:
+            return self._iter_threaded()
+        return self._iter_sync()

@@ -1,0 +1,333 @@
+"""paddle_tpu.jit — dy2static: compile dygraph code into ONE XLA module.
+
+Reference analogue: /root/reference/python/paddle/jit/ (to_static /
+ProgramTranslator in dy2static/program_translator.py, jit.save/load in
+jit.py + TranslatedLayer).  The reference rewrites Python AST into a
+static ProgramDesc executed op-by-op; TPU-native we instead *functionally
+capture* the Layer — parameters/buffers become pytree inputs, the global
+RNG becomes an explicit threaded PRNGKey — and hand the pure function to
+jax.jit, so the whole forward (or train step) compiles to a single
+fused StableHLO module.  save/load round-trips through jax.export
+serialization (our StableHLO stand-in for the reference's saved
+ProgramDesc + params).
+"""
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import rng as rng_mod
+from ..core.autograd import no_grad
+from ..core.dtype import convert_dtype
+from ..nn.layer.layers import Layer
+
+__all__ = ['to_static', 'not_to_static', 'save', 'load', 'functional_call',
+           'TranslatedLayer', 'StaticFunction', 'enable_to_static']
+
+_to_static_enabled = True
+
+
+def enable_to_static(flag):
+    """ProgramTranslator().enable(...) analogue — globally toggle."""
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
+def _unwrap(x):
+    return x.value if isinstance(x, Tensor) else x
+
+
+def _wrap_out(out):
+    if isinstance(out, (tuple, list)):
+        return type(out)(_wrap_out(o) for o in out)
+    if isinstance(out, Tensor):
+        return out
+    return Tensor._from_value(out)
+
+
+def _flatten_out(out):
+    """Layer outputs (Tensor | tuple/list of Tensors, nested) → raw pytree."""
+    if isinstance(out, (tuple, list)):
+        return type(out)(_flatten_out(o) for o in out)
+    if isinstance(out, dict):
+        return {k: _flatten_out(v) for k, v in out.items()}
+    return _unwrap(out)
+
+
+def _rewrap_out(vals):
+    if isinstance(vals, (tuple, list)):
+        return type(vals)(_rewrap_out(v) for v in vals)
+    if isinstance(vals, dict):
+        return {k: _rewrap_out(v) for k, v in vals.items()}
+    return Tensor._from_value(vals)
+
+
+def functional_call(layer, params, buffers, args, kwargs=None, key=None,
+                    training=None):
+    """Run `layer` as a pure function of (params, buffers, key, *args).
+
+    Returns (raw outputs pytree, new_buffers dict).  Safe to call inside a
+    jax trace: live eager state is swapped out and restored.  This is the
+    TPU-native replacement for the reference's ProgramDesc capture.
+    """
+    kwargs = kwargs or {}
+    old_params, old_buffers = layer.functional_state()
+    old_training = layer.training
+    if training is not None:
+        layer.train() if training else layer.eval()
+    layer.load_functional_state(params, buffers)
+    try:
+        scope = rng_mod.functional_key_scope(
+            key if key is not None else jax.random.PRNGKey(0))
+        with no_grad(), scope:
+            out = layer(*[Tensor._from_value(a) if not isinstance(a, Tensor)
+                          else a for a in args], **kwargs)
+        new_buffers = {n: b.value for n, b in layer.named_buffers()}
+        return _flatten_out(out), new_buffers
+    finally:
+        layer.load_functional_state(old_params, old_buffers)
+        layer.train() if old_training else layer.eval()
+
+
+class StaticFunction:
+    """The callable produced by @to_static.
+
+    jax.jit caches compiled modules by input shape/dtype; Python-level
+    (non-Tensor) arguments are closed over and keyed in our own cache,
+    mirroring how the reference re-traces per input signature
+    (dy2static/program_translator.py::StaticFunction).
+    """
+
+    def __init__(self, dygraph_function, input_spec=None, build_strategy=None,
+                 backend=None):
+        self._dygraph_function = dygraph_function
+        self._input_spec = input_spec
+        self._layer = dygraph_function if isinstance(dygraph_function, Layer) \
+            else None
+        self._jitted = {}          # static-key -> jitted fn
+        self._last_lowered = None  # for save()
+
+    @property
+    def dygraph_function(self):
+        return self._dygraph_function
+
+    def _split_args(self, args):
+        tpos, tvals, static = [], [], []
+        for i, a in enumerate(args):
+            if isinstance(a, (Tensor, jax.Array, np.ndarray)):
+                tpos.append(i)
+                tvals.append(_unwrap(a) if isinstance(a, Tensor)
+                             else jnp.asarray(a))
+            else:
+                static.append((i, a))
+        return tuple(tpos), tvals, tuple(static)
+
+    def _make_jitted(self, tpos, static, n_args, training):
+        layer, fn = self._layer, self._dygraph_function
+
+        def pure(params, buffers, key, tvals):
+            full = [None] * n_args
+            for (i, a) in static:
+                full[i] = a
+            for i, v in zip(tpos, tvals):
+                full[i] = v
+            if layer is not None:
+                return functional_call(layer, params, buffers, full,
+                                       key=key, training=training)
+            scope = rng_mod.functional_key_scope(key)
+            with no_grad(), scope:
+                out = fn(*[Tensor._from_value(v) if isinstance(v, jax.Array)
+                           else v for v in full])
+            return _flatten_out(out), {}
+
+        return jax.jit(pure)
+
+    def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            return self._dygraph_function(*args, **kwargs)
+        if kwargs:
+            # keyword tensors are not traced positionally; keep it simple
+            # and fall back to eager for kwarg-style calls.
+            return self._dygraph_function(*args, **kwargs)
+        tpos, tvals, static = self._split_args(args)
+        training = self._layer.training if self._layer is not None else False
+        cache_key = (tpos, tuple(repr(s) for s in static), len(args),
+                     training)
+        if cache_key not in self._jitted:
+            self._jitted[cache_key] = self._make_jitted(
+                tpos, static, len(args), training)
+        params, buffers = (self._layer.functional_state()
+                           if self._layer is not None else ({}, {}))
+        key = rng_mod.next_key()
+        out_vals, new_buffers = self._jitted[cache_key](
+            params, buffers, key, tvals)
+        if self._layer is not None and new_buffers:
+            self._layer.load_functional_state(buffers=new_buffers)
+        self._last_call = (cache_key, tpos, static, len(args), training)
+        return _rewrap_out(out_vals)
+
+    # -- export --------------------------------------------------------------
+    def _example_from_spec(self, input_spec):
+        vals = []
+        for s in input_spec:
+            shape = [1 if (d is None or d == -1) else d for d in s.shape]
+            vals.append(jnp.zeros(shape, convert_dtype(s.dtype) or
+                                  jnp.float32))
+        return vals
+
+    def exported(self, input_spec):
+        """jax.export the eval-mode forward for the given spec."""
+        tvals = self._example_from_spec(input_spec)
+        n = len(tvals)
+        tpos = tuple(range(n))
+        jitted = self._make_jitted(tpos, (), n, training=False)
+        params, buffers = (self._layer.functional_state()
+                           if self._layer is not None else ({}, {}))
+        key = jax.random.PRNGKey(0)
+        from jax import export as jexport
+        return jexport.export(jitted)(params, buffers, key, tvals)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Decorator/wrapper: compile a function or Layer with XLA.
+
+    Reference: python/paddle/jit/api.py::to_static.
+    """
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            fn.forward = StaticFunction(_BoundForward(fn), input_spec)
+            # calling the layer itself routes through forward, which is
+            # now compiled; also expose the StaticFunction
+            fn._static_forward = fn.forward
+            return fn
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+class _BoundForward(Layer):
+    """Adapter: present a Layer's forward as the traced callable while
+    sharing its parameter tree."""
+
+    def __init__(self, layer):
+        super().__init__()
+        self._inner = layer
+
+    def forward(self, *args, **kwargs):
+        return type(self._inner).forward(self._inner, *args, **kwargs)
+
+    # state delegation so functional capture sees the real tree
+    def named_parameters(self, prefix='', include_sublayers=True):
+        return self._inner.named_parameters(prefix, include_sublayers)
+
+    def named_buffers(self, prefix='', include_sublayers=True):
+        return self._inner.named_buffers(prefix, include_sublayers)
+
+    def functional_state(self):
+        return self._inner.functional_state()
+
+    def load_functional_state(self, params=None, buffers=None):
+        return self._inner.load_functional_state(params, buffers)
+
+    @property
+    def training(self):
+        return self._inner.training
+
+    @training.setter
+    def training(self, v):
+        # Layer.__init__ writes this before _inner exists
+        if '_inner' in self.__dict__ or '_inner' in self.__dict__.get(
+                '_sub_layers', {}):
+            self._inner.training = v
+
+    def train(self):
+        self._inner.train()
+
+    def eval(self):
+        self._inner.eval()
+
+
+def not_to_static(fn):
+    """Marker no-op (reference: paddle.jit.not_to_static)."""
+    fn._not_to_static = True
+    return fn
+
+
+# -- save / load -------------------------------------------------------------
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save — serialize compiled forward + params.
+
+    Reference: python/paddle/jit/api.py::save writes __model__ ProgramDesc
+    + params; we write <path>.pdmodel (jax.export serialized StableHLO)
+    and <path>.pdiparams (pickled state).
+    """
+    from ..static.input_spec import InputSpec
+
+    if isinstance(layer, StaticFunction):
+        static_fn = layer
+        base = static_fn._layer
+    elif isinstance(layer, Layer):
+        fwd = getattr(layer, '_static_forward', None)
+        static_fn = fwd if isinstance(fwd, StaticFunction) else \
+            StaticFunction(_BoundForward(layer))
+        base = layer
+    else:
+        raise TypeError("jit.save expects a Layer or StaticFunction")
+
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec in this framework "
+                         "(shapes define the XLA module)")
+    spec = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
+            for s in input_spec]
+    exp = static_fn.exported(spec)
+    blob = exp.serialize()
+    os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+    with open(path + '.pdmodel', 'wb') as f:
+        f.write(blob)
+    state = {}
+    if base is not None:
+        params, buffers = base.functional_state()
+        state = {'params': {k: np.asarray(v) for k, v in params.items()},
+                 'buffers': {k: np.asarray(v) for k, v in buffers.items()}}
+    with open(path + '.pdiparams', 'wb') as f:
+        pickle.dump({'state': state,
+                     'spec': [(s.shape, str(np.dtype(s.numpy_dtype()))
+                               if s.numpy_dtype() else 'float32', s.name)
+                              for s in spec]}, f)
+
+
+class TranslatedLayer(Layer):
+    """jit.load result — a Layer whose forward executes the deserialized
+    XLA module (reference: translated_layer.py runs the loaded
+    ProgramDesc)."""
+
+    def __init__(self, exported, state):
+        super().__init__()
+        self._exported = exported
+        self._params_tree = {k: jnp.asarray(v)
+                             for k, v in state.get('params', {}).items()}
+        self._buffers_tree = {k: jnp.asarray(v)
+                              for k, v in state.get('buffers', {}).items()}
+
+    def forward(self, *args):
+        tvals = [_unwrap(a) for a in args]
+        out_vals, _ = self._exported.call(
+            self._params_tree, self._buffers_tree, jax.random.PRNGKey(0),
+            tvals)
+        return _rewrap_out(out_vals)
+
+
+def load(path, **configs):
+    from jax import export as jexport
+    with open(path + '.pdmodel', 'rb') as f:
+        exp = jexport.deserialize(f.read())
+    with open(path + '.pdiparams', 'rb') as f:
+        meta = pickle.load(f)
+    return TranslatedLayer(exp, meta['state'])

@@ -1,0 +1,15 @@
+"""paddle_tpu.parallel — the SPMD compilation engine.
+
+This package has no single reference analogue: it replaces the C++
+ParallelExecutor + fleet meta_optimizer Program-rewrite machinery
+(/root/reference/paddle/fluid/framework/parallel_executor.cc,
+python/paddle/distributed/fleet/meta_optimizers/) with the TPU-native
+recipe: pick a Mesh → annotate NamedShardings → jit ONE train step →
+XLA inserts/schedules collectives over ICI.
+"""
+from .api import (  # noqa: F401
+    maybe_shard, collect_param_shardings, named_sharding, make_spec)
+from .engine import ParallelTrainer  # noqa: F401
+
+__all__ = ['maybe_shard', 'collect_param_shardings', 'named_sharding',
+           'make_spec', 'ParallelTrainer']

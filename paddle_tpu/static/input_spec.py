@@ -1,0 +1,40 @@
+"""InputSpec — symbolic input signature.
+
+Reference analogue: /root/reference/python/paddle/static/input.py
+(class InputSpec).  Here a spec is exactly what jax.jit needs to build a
+ShapeDtypeStruct: shape (None/-1 = dynamic batch), dtype, name.
+"""
+import numpy as np
+
+from ..core.dtype import convert_dtype
+
+__all__ = ['InputSpec']
+
+
+class InputSpec:
+    def __init__(self, shape, dtype='float32', name=None):
+        self.shape = tuple(-1 if d is None else int(d) for d in shape)
+        self.dtype = dtype
+        self.name = name
+
+    def numpy_dtype(self):
+        d = convert_dtype(self.dtype)
+        return np.dtype(str(d)) if d is not None else None
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name or tensor.name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, str(ndarray.dtype), name)
+
+    def batch(self, batch_size):
+        return InputSpec((batch_size,) + self.shape, self.dtype, self.name)
+
+    def unbatch(self):
+        return InputSpec(self.shape[1:], self.dtype, self.name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")

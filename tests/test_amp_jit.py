@@ -1,0 +1,119 @@
+"""AMP (auto_cast/GradScaler) and jit (to_static/save/load) tests.
+
+Mirrors reference tests: python/paddle/fluid/tests/unittests/test_amp_*,
+test_jit_save_load.py, dygraph_to_static/*.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import amp, jit
+from paddle_tpu.static.input_spec import InputSpec
+
+
+class TestAmp:
+    def test_autocast_o1_matmul_bf16(self):
+        a = paddle.ones([4, 4], dtype='float32')
+        b = paddle.ones([4, 4], dtype='float32')
+        with amp.auto_cast(level='O1'):
+            c = paddle.matmul(a, b)
+        assert str(c.dtype) == 'bfloat16'
+        # black-listed op stays fp32
+        with amp.auto_cast(level='O1'):
+            s = F.softmax(a)
+        assert str(s.dtype) == 'float32'
+
+    def test_autocast_disabled_outside(self):
+        a = paddle.ones([4, 4])
+        c = paddle.matmul(a, a)
+        assert str(c.dtype) == 'float32'
+
+    def test_autocast_o2(self):
+        a = paddle.ones([4, 4], dtype='float32')
+        with amp.auto_cast(level='O2'):
+            y = F.relu(a)
+        assert str(y.dtype) == 'bfloat16'
+
+    def test_grad_scaler_roundtrip(self):
+        lin = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        scaler = amp.GradScaler(init_loss_scaling=1024.0)
+        x = paddle.to_tensor(np.random.randn(2, 4).astype('float32'))
+        before = np.asarray(lin.weight.value).copy()
+        with amp.auto_cast(level='O1'):
+            loss = lin(x).mean()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        opt.clear_grad()
+        after = np.asarray(lin.weight.value)
+        assert not np.allclose(before, after)
+        # update magnitude must match UNscaled gradients
+        assert np.max(np.abs(before - after)) < 1.0
+
+    def test_grad_scaler_skips_on_inf(self):
+        lin = nn.Linear(2, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        scaler = amp.GradScaler(init_loss_scaling=8.0)
+        before = np.asarray(lin.weight.value).copy()
+        loss = lin(paddle.ones([1, 2])).sum()
+        loss.backward()
+        lin.weight._grad = lin.weight._grad * float('inf')
+        scaler.step(opt)
+        assert np.allclose(np.asarray(lin.weight.value), before)
+        assert scaler._scale < 8.0 or scaler._bad_steps > 0
+
+
+class TestJit:
+    def test_to_static_function(self):
+        @jit.to_static
+        def f(x, y):
+            return paddle.matmul(x, y) + 1.0
+
+        a = paddle.ones([3, 3])
+        out = f(a, a)
+        np.testing.assert_allclose(np.asarray(out.value),
+                                   np.full((3, 3), 4.0), rtol=1e-6)
+
+    def test_to_static_layer_matches_eager(self):
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        x = paddle.to_tensor(np.random.randn(2, 8).astype('float32'))
+        eager = np.asarray(net(x).value)
+        snet = jit.to_static(net)
+        out = np.asarray(snet(x).value)
+        np.testing.assert_allclose(out, eager, rtol=1e-5, atol=1e-5)
+
+    def test_to_static_batchnorm_updates_buffers(self):
+        net = nn.BatchNorm1D(4)
+        snet = jit.to_static(net)
+        x = paddle.to_tensor(
+            (np.random.randn(16, 4) * 3 + 5).astype('float32'))
+        m0 = np.asarray(net._mean.value).copy()
+        snet(x)
+        m1 = np.asarray(net._mean.value)
+        assert not np.allclose(m0, m1), "running mean must update under jit"
+
+    def test_save_load_roundtrip(self, tmp_path):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        net.eval()
+        x = paddle.to_tensor(np.random.randn(3, 4).astype('float32'))
+        want = np.asarray(net(x).value)
+        path = str(tmp_path / 'model')
+        jit.save(net, path, input_spec=[InputSpec([3, 4], 'float32')])
+        loaded = jit.load(path)
+        got = np.asarray(loaded(x).value)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_dropout_under_jit_not_constant(self):
+        paddle.seed(7)
+        net = nn.Dropout(0.5)
+        net.train()
+        snet = jit.to_static(net)
+        x = paddle.ones([1000])
+        a = np.asarray(snet(x).value)
+        b = np.asarray(snet(x).value)
+        assert not np.allclose(a, b), "dropout mask must differ per call"

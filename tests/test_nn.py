@@ -88,17 +88,22 @@ class TestActivationsLosses:
     def test_activations_vs_torch(self):
         x = np.random.RandomState(0).randn(3, 7).astype('float32')
         tx, px = torch.tensor(x), paddle.to_tensor(x)
+        tight = (1e-4, 1e-5)
+        # TPU transcendental units (exp/log) are lower-precision than CPU
+        # libm; softplus/log_softmax show up to ~6e-5 abs deviation on
+        # real chips.
+        loose = (1e-3, 1e-4)
         pairs = [
-            (F.relu, tF.relu), (F.gelu, lambda v: tF.gelu(v)),
-            (F.sigmoid, torch.sigmoid), (F.silu, tF.silu),
-            (F.elu, tF.elu), (F.softplus, tF.softplus),
-            (F.leaky_relu, tF.leaky_relu),
-            (F.log_softmax, lambda v: tF.log_softmax(v, -1)),
-            (F.softmax, lambda v: tF.softmax(v, -1)),
+            (F.relu, tF.relu, tight), (F.gelu, lambda v: tF.gelu(v), tight),
+            (F.sigmoid, torch.sigmoid, tight), (F.silu, tF.silu, tight),
+            (F.elu, tF.elu, tight), (F.softplus, tF.softplus, loose),
+            (F.leaky_relu, tF.leaky_relu, tight),
+            (F.log_softmax, lambda v: tF.log_softmax(v, -1), loose),
+            (F.softmax, lambda v: tF.softmax(v, -1), tight),
         ]
-        for ours_fn, ref_fn in pairs:
+        for ours_fn, ref_fn, (rtol, atol) in pairs:
             np.testing.assert_allclose(
-                t2n(ours_fn(px)), ref_fn(tx).numpy(), rtol=1e-4, atol=1e-5,
+                t2n(ours_fn(px)), ref_fn(tx).numpy(), rtol=rtol, atol=atol,
                 err_msg=str(ours_fn))
 
     def test_cross_entropy_vs_torch(self):
