@@ -25,5 +25,16 @@ from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
 from .nn.layer.layers import ParamAttr  # noqa: F401
 
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import static  # noqa: F401
+from . import framework  # noqa: F401
+from .framework.io import save, load  # noqa: F401
+from .static.program import enable_static, disable_static  # noqa: F401
+from . import distributed  # noqa: F401
+from . import parallel  # noqa: F401
+
 __all__ = ['Tensor', 'Parameter', 'no_grad', 'enable_grad', 'seed',
-           'set_device', 'get_device'] + list(_tensor_all)
+           'set_device', 'get_device', 'save', 'load', 'enable_static',
+           'disable_static'] + list(_tensor_all)

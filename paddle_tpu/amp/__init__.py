@@ -168,6 +168,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = False
 
     def is_enable(self):
         return self._enable
@@ -184,8 +185,9 @@ class GradScaler:
         return var * self._scale
 
     def unscale_(self, optimizer):
-        if not self._enable:
+        if not self._enable or self._unscaled:
             return
+        self._unscaled = True
         params = optimizer._params
         inv = 1.0 / self._scale
         found = False
@@ -214,6 +216,7 @@ class GradScaler:
     def update(self):
         if not (self._enable and self._dynamic):
             return
+        self._unscaled = False
         if self._found_inf:
             self._bad_steps += 1
             self._good_steps = 0

@@ -19,10 +19,19 @@ from .autograd import GradNode
 # Installed by paddle_tpu.amp; signature: hook(fn_name, vals) -> vals
 _amp_hook = None
 
+# Installed by paddle_tpu.static when static mode is enabled; signature:
+# handler(fn, args, kwargs, op_name) -> Variable | NotImplemented.
+_static_handler = None
+
 
 def set_amp_hook(hook):
     global _amp_hook
     _amp_hook = hook
+
+
+def set_static_handler(handler):
+    global _static_handler
+    _static_handler = handler
 
 
 def _raw(x):
@@ -40,6 +49,11 @@ def apply(fn, *args, op_name=None, **kwargs):
     operands positional).
     """
     from .tensor import Tensor
+
+    if _static_handler is not None:
+        recorded = _static_handler(fn, args, kwargs, op_name)
+        if recorded is not NotImplemented:
+            return recorded
 
     kwargs = {k: _raw(v) for k, v in kwargs.items()}
     tpos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]

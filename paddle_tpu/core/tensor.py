@@ -179,6 +179,12 @@ class Tensor:
 
     def set_value(self, value):
         """In-place value replacement (optimizer updates, state loading)."""
+        if getattr(value, 'kind', None) is not None and \
+                hasattr(value, 'program'):
+            # static-mode Variable: record a Program side effect; the
+            # Executor writes the computed value back after run()
+            value.program.side_effects.append((self, value))
+            return self
         v = value.value if isinstance(value, Tensor) else jnp.asarray(value)
         if tuple(v.shape) != tuple(self.value.shape):
             raise ValueError(

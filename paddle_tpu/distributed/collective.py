@@ -153,7 +153,9 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         if op == ReduceOp.AVG:
             return lax.pmean(v, axes)
         if op == ReduceOp.PROD:
-            return jnp.exp(lax.psum(jnp.log(v), axes))
+            g = lax.all_gather(v, axes[0] if len(axes) == 1 else axes,
+                               axis=0, tiled=False)
+            return jnp.prod(g, axis=0)
         raise ValueError(f"bad ReduceOp {op}")
     out = apply(fn, tensor if isinstance(tensor, Tensor)
                 else Tensor._from_value(_unwrap(tensor)),
@@ -196,10 +198,9 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     v = _unwrap(tensor)
     name = axes[0]
     idx = lax.axis_index(name)
-    n = lax.psum(1, name)
-    # select src's value: mask + sum (XLA turns this into a broadcast)
-    mask = (idx == src).astype(v.dtype)
-    out = lax.psum(v * mask, name)
+    # select src's value, then sum (XLA lowers this to a broadcast);
+    # where() not v*mask so inf/NaN on non-src ranks cannot pollute
+    out = lax.psum(jnp.where(idx == src, v, jnp.zeros_like(v)), name)
     return _rewrap(tensor, out)
 
 
@@ -246,29 +247,42 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     return outs
 
 
-def send(tensor, dst=0, group=None, sync_op=True):
-    """Point-to-point on TPU = ppermute ring step.  send/recv pairs in
-    the reference's pipeline engine become ppermute rotations here; a
-    bare send outside a parallel region is a no-op."""
+def p2p(tensor, src, dst, group=None):
+    """Single matched send/recv pair: rank `dst` receives rank `src`'s
+    tensor; every other rank receives zeros.  lax.ppermute with one
+    (src, dst) pair — the SPMD form of an NCCL send/recv pair."""
     axes = _resolve_axes(group)
     if not axes:
         return tensor
-    name = axes[0]
-    n = lax.psum(1, name)
-    perm = [(i, dst) for i in range(n)]  # degenerate: everyone → dst
-    out = lax.ppermute(_unwrap(tensor), name, perm)
+    out = lax.ppermute(_unwrap(tensor), axes[0], [(src, dst)])
     return _rewrap(tensor, out)
 
 
-def recv(tensor, src=0, group=None, sync_op=True):
+def send(tensor, dst=0, group=None, sync_op=True, src=None):
+    """Point-to-point send.  SPMD programs have no per-rank control
+    flow, so the sender rank must be explicit: pass `src` (then this is
+    p2p(src→dst)), or use p2p_rotate for the ring pattern the
+    reference's pipeline engine builds out of send/recv."""
     axes = _resolve_axes(group)
     if not axes:
         return tensor
-    name = axes[0]
-    n = lax.psum(1, name)
-    perm = [(src, i) for i in range(n)]
-    out = lax.ppermute(_unwrap(tensor), name, perm)
-    return _rewrap(tensor, out)
+    if src is None:
+        raise ValueError(
+            "send() inside an SPMD region needs src= (every rank runs "
+            "this line); use p2p(tensor, src, dst) or p2p_rotate()")
+    return p2p(tensor, src, dst, group)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, dst=None):
+    """Point-to-point receive; pairs with send(). With only `src` given,
+    all ranks receive src's value (a broadcast, matching how reference
+    code typically consumes recv)."""
+    axes = _resolve_axes(group)
+    if not axes:
+        return tensor
+    if dst is not None:
+        return p2p(tensor, src, dst, group)
+    return broadcast(tensor, src=src, group=group)
 
 
 def p2p_rotate(tensor, group=None, shift=1):

@@ -47,10 +47,11 @@ def build_mesh(axes):
     sizes = [s for _, s in items]
     known = int(np.prod([s for s in sizes if s > 0])) or 1
     sizes = [s if s > 0 else n // known for s in sizes]
-    if int(np.prod(sizes)) != n:
-        raise ValueError(f"mesh axes {items} do not cover {n} devices")
+    need = int(np.prod(sizes))
+    if need > n:
+        raise ValueError(f"mesh axes {items} need {need} > {n} devices")
     names = tuple(name for name, _ in items)
-    return Mesh(devices.reshape(sizes), names)
+    return Mesh(devices[:need].reshape(sizes), names)
 
 
 class ParallelEnv:

@@ -221,12 +221,11 @@ class RNGStatesTracker:
             import jax
             if name not in self.states:
                 self.add(name, hash(name) & 0x7fffffff)
-            key = self.states[name]
+            self.states[name], use = jax.random.split(self.states[name])
             from .. import collective
             if name == 'model_parallel' and 'tp' in collective.current_axes():
                 import jax.lax as lax
-                key = jax.random.fold_in(key, lax.axis_index('tp'))
-            self.states[name], use = jax.random.split(self.states[name])
+                use = jax.random.fold_in(use, lax.axis_index('tp'))
             with rng_mod.functional_key_scope(use):
                 yield
         return scope()

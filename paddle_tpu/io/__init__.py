@@ -409,10 +409,21 @@ class DataLoader:
         lock = threading.Lock()
 
         def worker(wid):
-            _worker_info.info = _WorkerInfo(wid, self.num_workers,
-                                            self.dataset)
-            if self.worker_init_fn is not None:
-                self.worker_init_fn(wid)
+            try:
+                _worker_info.info = _WorkerInfo(wid, self.num_workers,
+                                                self.dataset)
+                if self.worker_init_fn is not None:
+                    self.worker_init_fn(wid)
+            except Exception as e:
+                # deliver the failure for every batch this worker would
+                # have claimed, so the main thread raises instead of
+                # deadlocking on out_q.get()
+                while True:
+                    try:
+                        pos, _ = work_q.get_nowait()
+                    except queue.Empty:
+                        return
+                    out_q.put((pos, e))
             while True:
                 try:
                     pos, indices = work_q.get_nowait()

@@ -170,25 +170,45 @@ class StaticFunction:
         return _rewrap_out(out_vals)
 
     # -- export --------------------------------------------------------------
-    def _example_from_spec(self, input_spec):
-        vals = []
+    def _structs_from_spec(self, input_spec):
+        """InputSpecs → ShapeDtypeStructs; None/-1 dims become jax.export
+        symbolic dimensions so the serialized module stays batch-dynamic
+        (the reference's saved ProgramDesc is shape-polymorphic too)."""
+        from jax import export as jexport
+        structs = []
+        sym_i = 0
         for s in input_spec:
-            shape = [1 if (d is None or d == -1) else d for d in s.shape]
-            vals.append(jnp.zeros(shape, convert_dtype(s.dtype) or
-                                  jnp.float32))
-        return vals
+            parts = []
+            for d in s.shape:
+                if d is None or d == -1:
+                    parts.append(f"b{sym_i}")
+                    sym_i += 1
+                else:
+                    parts.append(str(d))
+            dtype = convert_dtype(s.dtype) or jnp.float32
+            if sym_i:
+                shape = jexport.symbolic_shape(','.join(parts))
+            else:
+                shape = tuple(int(p) for p in parts)
+            structs.append(jax.ShapeDtypeStruct(shape, dtype))
+        return structs
 
     def exported(self, input_spec):
         """jax.export the eval-mode forward for the given spec."""
-        tvals = self._example_from_spec(input_spec)
-        n = len(tvals)
+        structs = self._structs_from_spec(input_spec)
+        n = len(structs)
         tpos = tuple(range(n))
         jitted = self._make_jitted(tpos, (), n, training=False)
         params, buffers = (self._layer.functional_state()
                            if self._layer is not None else ({}, {}))
         key = jax.random.PRNGKey(0)
         from jax import export as jexport
-        return jexport.export(jitted)(params, buffers, key, tvals)
+        p_structs = jax.tree_util.tree_map(
+            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), params)
+        b_structs = jax.tree_util.tree_map(
+            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), buffers)
+        exp = jexport.export(jitted)(p_structs, b_structs, key, structs)
+        return exp
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,

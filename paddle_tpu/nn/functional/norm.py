@@ -51,17 +51,21 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
 
         ins = [t for t in (x, w_t, b_t) if t is not None]
         out, mean, var = apply(fn2, *ins, op_name='batch_norm')
-        # eager running-stat update (paddle: moving average with momentum)
-        if running_mean is not None:
-            running_mean.set_value(momentum * running_mean.value +
-                                   (1 - momentum) * mean.value)
-        if running_var is not None:
-            n = 1
-            for i in red_axes:
-                n *= x.shape[i]
-            unbiased = var.value * (n / max(n - 1, 1))
-            running_var.set_value(momentum * running_var.value +
-                                  (1 - momentum) * unbiased)
+        # running-stat update (paddle: moving average with momentum);
+        # expressed as dispatched Tensor ops so it records symbolically
+        # in static mode and traces correctly under jit
+        from ...core.autograd import no_grad
+        with no_grad():
+            if running_mean is not None:
+                running_mean.set_value(running_mean * momentum +
+                                       mean.detach() * (1.0 - momentum))
+            if running_var is not None:
+                n = 1
+                for i in red_axes:
+                    n *= x.shape[i]
+                unbiased = var.detach() * (n / max(n - 1, 1))
+                running_var.set_value(running_var * momentum +
+                                      unbiased * (1.0 - momentum))
         return out
 
     rm, rv = wrap(running_mean), wrap(running_var)

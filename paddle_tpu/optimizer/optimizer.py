@@ -99,9 +99,23 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static.program import Variable
+        if isinstance(loss, Variable):
+            # static mode: append the training section to the Program;
+            # Executor.run compiles grad+update into the same XLA module
+            loss.program.train_section = (loss, self)
+            loss.program.bump()
+            return None, []
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in self._params]
+
+    def _accumulators_for(self, p):
+        st = self._accumulators.get(id(p))
+        if st is None:
+            st = self._create_state(p.value)
+            self._accumulators[id(p)] = st
+        return st
 
     def clear_grad(self):
         for p in self._params:

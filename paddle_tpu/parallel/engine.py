@@ -1,0 +1,230 @@
+"""ParallelTrainer — ONE jitted SPMD train step over the mesh.
+
+Replaces (TPU-native) the reference's executor pipeline:
+ParallelExecutor + fleet meta_optimizer Program rewrites
+(/root/reference/paddle/fluid/framework/parallel_executor.cc,
+python/paddle/distributed/fleet/meta_optimizers/*).  Where the
+reference *rewrites a graph* to insert allreduce/recompute/AMP-cast ops,
+here the strategy simply parameterizes how ONE pure function is built
+and sharded, and XLA's SPMD partitioner materializes the collectives:
+
+  batch P('dp')          → grads arrive per-shard; psum by partitioner
+  params per-layer specs → tp matmul sharding (psum on row outputs)
+  opt state on 'dp'      → ZeRO-1: reduce-scatter + sharded update
+  strategy.recompute     → jax.checkpoint around the forward
+  strategy.gradient_merge→ lax.scan over microbatches inside the step
+  strategy.amp           → bf16 auto_cast applied during trace
+
+donate_argnums on (params, opt_state) lets XLA update HBM in place —
+peak memory ≈ params + state + activations, like the reference's
+in-place optimizer kernels.
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..core import rng as rng_mod
+from ..distributed import env as _env
+from .api import collect_param_shardings, make_spec
+
+__all__ = ['ParallelTrainer']
+
+
+def _zero_spec(spec, shape, mesh, dp_axis='dp'):
+    """ZeRO-1: additionally shard a (replicated-on-dp) state/param leaf
+    along dim 0 over dp when divisible."""
+    parts = list(make_spec(spec, len(shape), mesh))
+    if not shape or dp_axis not in mesh.shape or mesh.shape[dp_axis] <= 1:
+        return P(*parts)
+    if parts and parts[0] is not None:
+        return P(*parts)
+    if shape[0] % mesh.shape[dp_axis] == 0:
+        parts = [dp_axis] + parts[1:]
+    return P(*parts)
+
+
+class ParallelTrainer:
+    """Compile model+optimizer+loss into a sharded train step.
+
+    loss_fn(outputs, *labels) -> scalar Tensor; model outputs are
+    Tensors.  Used by hapi.Model.prepare(...) and directly by power
+    users (GPT/ERNIE training scripts).
+    """
+
+    def __init__(self, model, optimizer, loss_fn, mesh=None, strategy=None,
+                 donate=True):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.mesh = mesh or _env.get_mesh()
+        self.strategy = strategy or getattr(optimizer, '_fleet_strategy',
+                                            None)
+        self.donate = donate
+        self._step_no = 0
+        self._compiled = None
+        self._eval_compiled = None
+
+        params, buffers = model.functional_state()
+        self.param_specs = collect_param_shardings(model)
+        self.params = params
+        self.buffers = buffers
+        self.opt_state = optimizer.init(params)
+        if self.mesh is not None:
+            self._place_state()
+
+    # -- sharding placement --------------------------------------------------
+    def _sharding_for(self, name, v, zero=False):
+        spec = self.param_specs.get(name)
+        if zero:
+            return NamedSharding(self.mesh, _zero_spec(spec, v.shape,
+                                                       self.mesh))
+        return NamedSharding(self.mesh, make_spec(spec, v.ndim, self.mesh))
+
+    def _place_state(self):
+        zero = bool(self.strategy and self.strategy.sharding)
+        self.params = {n: jax.device_put(v, self._sharding_for(n, v))
+                       for n, v in self.params.items()}
+        self.opt_state = {
+            n: {k: (jax.device_put(s, self._sharding_for(n, s, zero=zero))
+                    if hasattr(s, 'shape') and s.shape == self.params[n].shape
+                    else s)
+                for k, s in st.items()}
+            for n, st in self.opt_state.items()}
+        self.buffers = {n: jax.device_put(v, NamedSharding(self.mesh, P()))
+                        for n, v in self.buffers.items()}
+
+    # -- step builders -------------------------------------------------------
+    def _forward_loss(self, params, buffers, key, batch):
+        from ..jit import functional_call
+        x, ys = batch[0], batch[1:]
+        amp_on = bool(self.strategy and self.strategy.amp)
+
+        def run(params, x):
+            import contextlib
+            from .. import amp as amp_mod
+            cm = amp_mod.auto_cast(level='O2' if (
+                self.strategy and self.strategy.amp_configs.get(
+                    'use_pure_fp16')) else 'O1') if amp_on else \
+                contextlib.nullcontext()
+            with cm:
+                out, new_buffers = functional_call(
+                    self.model, params, buffers, (x,), key=key,
+                    training=True)
+            return out, new_buffers
+
+        if self.strategy and self.strategy.recompute:
+            run = jax.checkpoint(run)
+        out, new_buffers = run(params, x)
+        out_t = jax.tree_util.tree_map(
+            lambda v: Tensor._from_value(v), out)
+        ys_t = [Tensor._from_value(y) for y in ys]
+        from ..core.autograd import no_grad
+        with no_grad():
+            loss = self.loss_fn(out_t, *ys_t)
+        loss_v = loss.value if isinstance(loss, Tensor) else loss
+        return loss_v.astype(jnp.float32).mean(), new_buffers
+
+    def _build_step(self):
+        opt = self.optimizer
+        merge_k = (self.strategy.gradient_merge_configs.get('k_steps', 1)
+                   if self.strategy and self.strategy.gradient_merge else 1)
+
+        def train_step(params, buffers, opt_state, step_no, key, *batch):
+            if merge_k > 1:
+                # microbatch accumulation: batch dim 0 must divide by k
+                def body(carry, mb):
+                    g_acc, buf = carry
+                    (loss, new_buf), g = jax.value_and_grad(
+                        self._forward_loss, has_aux=True)(
+                            params, buf, key, mb)
+                    g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                    return (g_acc, new_buf), loss
+                stacked = tuple(
+                    v.reshape((merge_k, v.shape[0] // merge_k) + v.shape[1:])
+                    for v in batch)
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+                (grads, new_buffers), losses = jax.lax.scan(
+                    body, (zeros, buffers), stacked)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / merge_k, grads)
+                loss = losses.mean()
+            else:
+                (loss, new_buffers), grads = jax.value_and_grad(
+                    self._forward_loss, has_aux=True)(
+                        params, buffers, key, batch)
+            new_params, new_state = opt.apply_gradients(
+                params, grads, opt_state, step_no)
+            return new_params, new_buffers, new_state, loss
+
+        kwargs = {}
+        if self.mesh is not None:
+            repl = NamedSharding(self.mesh, P())
+            dp = NamedSharding(
+                self.mesh,
+                P(('dp',) if 'dp' in self.mesh.shape
+                  and self.mesh.shape['dp'] > 1 else None))
+            zero = bool(self.strategy and self.strategy.sharding)
+            p_sh = {n: self._sharding_for(n, v)
+                    for n, v in self.params.items()}
+            s_sh = {n: {k: (self._sharding_for(n, s, zero=zero)
+                            if hasattr(s, 'shape')
+                            and s.shape == self.params[n].shape else repl)
+                        for k, s in st.items()}
+                    for n, st in self.opt_state.items()}
+            b_sh = {n: repl for n in self.buffers}
+            kwargs['in_shardings'] = (
+                p_sh, b_sh, s_sh, repl, repl) + tuple(
+                    dp for _ in range(self._n_batch))
+            kwargs['out_shardings'] = (p_sh, b_sh, s_sh, repl)
+        if self.donate:
+            kwargs['donate_argnums'] = (0, 2)
+        return jax.jit(train_step, **kwargs)
+
+    # -- public API ----------------------------------------------------------
+    def step(self, *batch):
+        """batch: numpy/jax arrays (x, y, ...). Returns python float loss."""
+        vals = tuple(b.value if isinstance(b, Tensor) else jnp.asarray(b)
+                     for b in batch)
+        if self._compiled is None:
+            self._n_batch = len(vals)
+            self._compiled = self._build_step()
+        key = rng_mod.next_key()
+        self.params, self.buffers, self.opt_state, loss = self._compiled(
+            self.params, self.buffers, self.opt_state,
+            jnp.asarray(self._step_no + 1), key, *vals)
+        self._step_no += 1
+        # LR-scheduler advancement is the caller's job (hapi epoch loop)
+        return loss
+
+    def eval_step(self, *batch):
+        vals = tuple(b.value if isinstance(b, Tensor) else jnp.asarray(b)
+                     for b in batch)
+        if self._eval_compiled is None:
+            def estep(params, buffers, key, *batch):
+                from ..jit import functional_call
+                out, _ = functional_call(self.model, params, buffers,
+                                         (batch[0],), key=key,
+                                         training=False)
+                out_t = jax.tree_util.tree_map(
+                    lambda v: Tensor._from_value(v), out)
+                ys_t = [Tensor._from_value(y) for y in batch[1:]]
+                from ..core.autograd import no_grad
+                with no_grad():
+                    loss = self.loss_fn(out_t, *ys_t)
+                loss_v = loss.value if isinstance(loss, Tensor) else loss
+                return out, loss_v.astype(jnp.float32).mean()
+            self._eval_compiled = jax.jit(estep)
+        key = rng_mod.next_key()
+        return self._eval_compiled(self.params, self.buffers, key, *vals)
+
+    def sync_to_model(self):
+        """Write compiled-state params/buffers back into the live Layer
+        (for state_dict/save after training)."""
+        self.model.load_functional_state(self.params, self.buffers)
+
+    def loss_float(self, loss):
+        return float(np.asarray(loss))

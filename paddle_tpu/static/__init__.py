@@ -1,0 +1,16 @@
+"""paddle_tpu.static — graph ("declarative") mode.
+
+Reference analogue: /root/reference/python/paddle/static/ (Program,
+Executor, program_guard, data).  TPU-native static mode records a lazy
+op DAG and lowers the WHOLE program to one jitted XLA module at
+Executor.run — see program.py.
+"""
+from .input_spec import InputSpec  # noqa: F401
+from .program import (  # noqa: F401
+    Program, program_guard, default_main_program, default_startup_program,
+    data, Executor, Variable, in_static_mode, enable_static, disable_static,
+    global_scope, scope_guard)
+
+__all__ = ['InputSpec', 'Program', 'program_guard', 'default_main_program',
+           'default_startup_program', 'data', 'Executor', 'Variable',
+           'enable_static', 'disable_static', 'global_scope', 'scope_guard']

@@ -1,0 +1,402 @@
+"""Static ("declarative") graph mode.
+
+Reference analogue: /root/reference/python/paddle/fluid/framework.py
+(Program/Block/Operator protos) + executor.py + the C++ Executor
+(/root/reference/paddle/fluid/framework/executor.cc) that schedules op
+kernels one by one.  TPU-native redesign: building a Program records a
+LAZY OP DAG of python closures over symbolic Variables; Executor.run
+topologically evaluates that DAG *inside one jax.jit trace*, so the
+whole Program — forward, backward (jax.grad), optimizer update — lowers
+to a single fused XLA module.  There is no op-by-op scheduling at run
+time at all; that is the point of the redesign (XLA owns scheduling,
+streams and memory).
+
+The op-recording hook lives in core/dispatch.py: when any input of an
+eager op is a `Variable`, the op is recorded instead of executed.
+nn.Layer forwards therefore work unchanged in static mode, like the
+reference where the same paddle.nn code builds ops into the default
+Program.
+"""
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dispatch
+from ..core.dtype import convert_dtype, get_default_dtype
+
+__all__ = ['Program', 'program_guard', 'default_main_program',
+           'default_startup_program', 'data', 'Executor', 'Variable',
+           'in_static_mode', 'enable_static', 'disable_static',
+           'global_scope', 'scope_guard']
+
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+    dispatch.set_static_handler(_record_op)
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+    dispatch.set_static_handler(None)
+
+
+def in_static_mode():
+    return _static_mode
+
+
+class Variable(Tensor):
+    """Symbolic node in a Program's DAG.
+
+    Reference analogue: framework.py::Variable (a name in a BlockDesc).
+    Holds a compute thunk instead of storage; shape/dtype come from
+    jax.eval_shape over the recorded subgraph (free shape inference —
+    the reference hand-writes InferShape per op).
+    """
+
+    def __init__(self, program, name, kind, thunk=None, aval=None):
+        # deliberately do NOT call Tensor.__init__ — no storage
+        self.program = program
+        self.name = name
+        self.kind = kind          # 'feed' | 'op' | 'param-read'
+        self._thunk = thunk       # fn(env) -> jax value
+        self._aval_cache = aval
+        self.stop_gradient = kind == 'feed'
+        self.persistable = False
+        self._grad = None
+        self.grad_node = None
+        self.grad_index = 0
+
+    # -- symbolic evaluation -------------------------------------------------
+    def _eval(self, env):
+        if id(self) in env:
+            return env[id(self)]
+        v = self._thunk(env)
+        env[id(self)] = v
+        return v
+
+    @property
+    def aval(self):
+        if self._aval_cache is None:
+            feed_objs = list(self.program.feed_vars.values())
+            structs = [jax.ShapeDtypeStruct(v._feed_shape, v._feed_dtype)
+                       for v in feed_objs]
+
+            def run(*fv):
+                env = {id(v): val for v, val in zip(feed_objs, fv)}
+                return self._eval(env)
+            self._aval_cache = jax.eval_shape(run, *structs)
+        return self._aval_cache
+
+    @property
+    def shape(self):
+        return list(self.aval.shape)
+
+    @property
+    def dtype(self):
+        return self.aval.dtype
+
+    @property
+    def ndim(self):
+        return len(self.aval.shape)
+
+    @property
+    def value(self):
+        raise RuntimeError(
+            f"Variable '{self.name}' has no value outside Executor.run — "
+            "fetch it via exe.run(fetch_list=[...])")
+
+    @value.setter
+    def value(self, v):
+        # buffer write-back during static trace (e.g. BatchNorm running
+        # stats): record as a program side-effect
+        if isinstance(v, Variable):
+            self.program.side_effects.append((self, v))
+        # concrete assignment replaces the thunk with a constant
+        else:
+            self._thunk = lambda env, _v=v: _v
+            self._aval_cache = None
+
+    def backward(self, *a, **k):
+        raise RuntimeError("call optimizer.minimize(loss) in static mode")
+
+    def detach(self):
+        # no eager tape in static mode; gradients come from jax.grad over
+        # the recorded graph, and Executor treats side-effect sources as
+        # non-differentiable roots already
+        return self
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable '{self.name}' has no value — fetch it via "
+            "exe.run(fetch_list=[...])")
+
+    def __repr__(self):
+        try:
+            return (f"Variable(name={self.name}, shape={self.shape}, "
+                    f"dtype={self.dtype})")
+        except Exception:
+            return f"Variable(name={self.name})"
+
+
+class Program:
+    """Reference: framework.py::Program (ProgramDesc proto).  Records
+    feed vars, the op DAG (implicit in Variable thunks), side effects,
+    and the training section appended by optimizer.minimize."""
+
+    _counter = 0
+
+    def __init__(self):
+        Program._counter += 1
+        self.id = Program._counter
+        self.feed_vars = {}        # name -> Variable(kind='feed')
+        self.side_effects = []     # [(target Variable/Tensor, source Var)]
+        self.train_section = None  # (loss_var, optimizer)
+        self.random_seed = 0
+        self._version = 0
+        self._cache = {}
+
+    def bump(self):
+        self._version += 1
+        self._cache.clear()
+
+    def clone(self, for_test=False):
+        import copy
+        p = copy.copy(self)
+        if for_test:
+            p = copy.copy(self)
+            p.train_section = None
+        return p
+
+    def global_block(self):
+        return self
+
+    # Block-API shim: list "vars" like the reference's Block
+    @property
+    def vars(self):
+        return dict(self.feed_vars)
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _default_main, _default_startup
+    prev_m, prev_s = _default_main, _default_startup
+    _default_main = main_program
+    if startup_program is not None:
+        _default_startup = startup_program
+    try:
+        yield
+    finally:
+        _default_main, _default_startup = prev_m, prev_s
+
+
+def data(name, shape, dtype='float32', lod_level=0):
+    """Declare a feed Variable (reference: static/input.py::data).
+    shape may contain None/-1 (dynamic batch) — resolved at run time;
+    the abstract batch dim defaults to 1 for shape inference."""
+    prog = default_main_program()
+    v = Variable(prog, name, 'feed')
+    v._feed_shape = tuple(1 if (d is None or d == -1) else int(d)
+                          for d in shape)
+    v._feed_dtype = convert_dtype(dtype) or get_default_dtype()
+    v._declared_shape = tuple(-1 if (d is None or d == -1) else int(d)
+                              for d in shape)
+    prog.feed_vars[name] = v
+    prog.bump()
+    return v
+
+
+# -- op recording hook (installed into core.dispatch) ------------------------
+
+def _record_op(fn, args, kwargs, op_name):
+    """Called by dispatch.apply BEFORE eager execution.  If any arg is a
+    Variable, record the op into its Program and return a new Variable.
+    Returns NotImplemented to fall through to eager execution."""
+    vars_in = [a for a in args if isinstance(a, Variable)]
+    if not vars_in:
+        return NotImplemented
+    prog = vars_in[0].program
+    arg_slots = []
+    for a in args:
+        if isinstance(a, Variable):
+            arg_slots.append(('var', a))
+        elif isinstance(a, Tensor):
+            arg_slots.append(('tensor', a))   # param: read value at run
+        else:
+            arg_slots.append(('const', a))
+    kw_slots = {}
+    for k, v in kwargs.items():
+        if isinstance(v, Variable):
+            kw_slots[k] = ('var', v)
+        elif isinstance(v, Tensor):
+            kw_slots[k] = ('tensor', v)
+        else:
+            kw_slots[k] = ('const', v)
+
+    def resolve(slot, env):
+        kind, obj = slot
+        if kind == 'var':
+            return obj._eval(env)
+        if kind == 'tensor':
+            pe = env.get('__params__')
+            if pe is not None and id(obj) in pe:
+                return pe[id(obj)]
+            return obj.value
+        return obj
+
+    def thunk(env):
+        a = [resolve(s, env) for s in arg_slots]
+        kw = {k: resolve(s, env) for k, s in kw_slots.items()}
+        out = fn(*a, **kw)
+        return out
+
+    out_var = Variable(prog, f"{op_name or 'op'}_{id(thunk)}", 'op', thunk)
+    # multi-output ops: build child selector Variables
+    try:
+        aval = out_var.aval
+    except Exception:
+        aval = None
+    if isinstance(aval, (tuple, list)):
+        outs = []
+        for i in range(len(aval)):
+            outs.append(Variable(
+                prog, f"{out_var.name}.{i}", 'op',
+                lambda env, i=i: out_var._eval(env)[i]))
+        return tuple(outs)
+    return out_var
+
+
+# -- Executor ----------------------------------------------------------------
+
+class _Scope:
+    pass
+
+
+_scope = _Scope()
+
+
+def global_scope():
+    return _scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    yield scope
+
+
+class Executor:
+    """Reference: python/paddle/fluid/executor.py + C++ executor.cc.
+    run() compiles the whole Program into one jitted function, keyed by
+    (program version, feed shapes, fetch ids)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        if program is _default_startup or (
+                not program.feed_vars and not fetch_list):
+            return []  # startup: params already initialized eagerly
+
+        feed_names = sorted(program.feed_vars.keys() & feed.keys())
+        feed_vals = [jnp.asarray(feed[n]) for n in feed_names]
+        fetch_vars = [v for v in fetch_list if isinstance(v, Variable)]
+
+        train = program.train_section
+        params = []
+        if train is not None:
+            loss_var, optimizer = train
+            params = [p for p in optimizer._params if not p.stop_gradient]
+
+        key = (program._version, tuple(f.shape + (str(f.dtype),)
+                                       for f in feed_vals),
+               tuple(id(v) for v in fetch_vars), bool(train))
+        compiled = program._cache.get(key)
+        if compiled is None:
+            compiled = self._compile(program, feed_names, fetch_vars,
+                                     params)
+            program._cache[key] = compiled
+
+        side_targets = [t for t, _ in program.side_effects]
+        if train is not None:
+            loss_var, optimizer = train
+            step = optimizer._global_step + 1
+            pvals = [p.value for p in params]
+            svals = [optimizer._accumulators_for(p) for p in params]
+            fetched, new_p, new_s, side_vals = compiled(
+                feed_vals, pvals, svals, jnp.asarray(step))
+            for p, nv, ns in zip(params, new_p, new_s):
+                p.value = nv
+                optimizer._accumulators[id(p)] = ns
+            optimizer._global_step = step
+        else:
+            fetched, side_vals = compiled(feed_vals)
+        # apply recorded buffer write-backs (e.g. BN running stats)
+        for t, v in zip(side_targets, side_vals):
+            t.value = v.astype(t.value.dtype)
+
+        if return_numpy:
+            return [np.asarray(v) for v in fetched]
+        return [Tensor._from_value(v) for v in fetched]
+
+    def _compile(self, program, feed_names, fetch_vars, params):
+        feed_var_objs = [program.feed_vars[n] for n in feed_names]
+        side_sources = [v for _, v in program.side_effects]
+
+        train = program.train_section
+        if train is None:
+            @jax.jit
+            def run_eval(feed_vals):
+                env = {'__params__': None}
+                for v, val in zip(feed_var_objs, feed_vals):
+                    env[id(v)] = val
+                outs = [fv._eval(env) for fv in fetch_vars]
+                side = [sv._eval(env) for sv in side_sources]
+                return outs, side
+            return run_eval
+
+        loss_var, optimizer = train
+
+        @jax.jit
+        def run_train(feed_vals, pvals, svals, step):
+            def loss_fn(pvals):
+                param_env = {id(p): v for p, v in zip(params, pvals)}
+                env = {'__params__': param_env}
+                for v, val in zip(feed_var_objs, feed_vals):
+                    env[id(v)] = val
+                loss = loss_var._eval(env)
+                outs = [fv._eval(env) for fv in fetch_vars]
+                side = [sv._eval(env) for sv in side_sources]
+                return loss.astype(jnp.float32).sum(), (outs, side)
+            grads, (outs, side) = jax.grad(loss_fn, has_aux=True)(pvals)
+            lr = optimizer._lr_value(step)
+            new_p, new_s = [], []
+            for p, v, g, s in zip(params, pvals, grads, svals):
+                g = optimizer._apply_weight_decay_grad(v, g.astype(v.dtype))
+                nv, ns = optimizer._rule(v, g, s, lr, step)
+                new_p.append(nv)
+                new_s.append(ns)
+            return outs, new_p, new_s, side
+
+        return run_train

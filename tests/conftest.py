@@ -16,9 +16,15 @@ if '--xla_force_host_platform_device_count' not in flags:
         flags + ' --xla_force_host_platform_device_count=8').strip()
 os.environ.setdefault('JAX_ENABLE_X64', '0')
 
+import jax  # noqa: E402
+
+# the axon sitecustomize imports jax at interpreter start, so jax's
+# config already captured JAX_PLATFORMS=axon from the global env — the
+# os.environ write above is too late for that one flag; override the
+# live config too (backends have not initialized yet at conftest time).
+jax.config.update('jax_platforms', 'cpu')
+
 # this build's XLA CPU defaults to bf16-ish matmul precision; tests check
 # f32 numerical parity, so force full precision (TPU perf paths pass bf16
 # dtypes explicitly, which this setting does not affect)
-import jax  # noqa: E402
-
 jax.config.update('jax_default_matmul_precision', 'highest')
