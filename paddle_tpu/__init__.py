@@ -34,7 +34,14 @@ from .framework.io import save, load  # noqa: F401
 from .static.program import enable_static, disable_static  # noqa: F401
 from . import distributed  # noqa: F401
 from . import parallel  # noqa: F401
+from . import vision  # noqa: F401
+from . import text  # noqa: F401
+from . import metric  # noqa: F401
+from . import distribution  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi import Model, summary, flops  # noqa: F401
+from .hapi import callbacks  # noqa: F401
 
 __all__ = ['Tensor', 'Parameter', 'no_grad', 'enable_grad', 'seed',
            'set_device', 'get_device', 'save', 'load', 'enable_static',
-           'disable_static'] + list(_tensor_all)
+           'disable_static', 'Model', 'summary', 'flops'] + list(_tensor_all)

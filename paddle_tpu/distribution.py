@@ -1,0 +1,148 @@
+"""Probability distributions: Normal / Uniform / Categorical.
+
+Reference analogue: python/paddle/distribution.py (Distribution, Normal,
+Uniform, Categorical).  TPU-native: sampling draws explicit PRNG keys from
+core.rng (jax.random), so samples are reproducible under paddle_tpu.seed
+and reparameterized (Normal/Uniform are pathwise-differentiable).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core.rng import _state, _functional_keys
+from .core.tensor import Tensor
+
+__all__ = ['Distribution', 'Normal', 'Uniform', 'Categorical']
+
+
+def _next_key():
+    if _functional_keys:
+        return _functional_keys[-1].next()
+    return _state.next_key()
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x.value
+    return jnp.asarray(x, dtype=jnp.float32)
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(
+            self.loc, jnp.broadcast_shapes(self.loc.shape,
+                                           self.scale.shape)))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(
+            self.scale ** 2, jnp.broadcast_shapes(self.loc.shape,
+                                                  self.scale.shape)))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape)
+        base = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        eps = jax.random.normal(_next_key(), shape + base,
+                                dtype=jnp.float32)
+        return Tensor(self.loc + self.scale * eps)
+
+    def entropy(self):
+        base = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        scale = jnp.broadcast_to(self.scale, base)
+        return Tensor(0.5 + 0.5 * np.log(2 * np.pi) + jnp.log(scale))
+
+    def log_prob(self, value):
+        v = _val(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * np.log(2 * np.pi))
+
+    def probs(self, value):
+        return Tensor(jnp.exp(self.log_prob(value).value))
+
+    def kl_divergence(self, other):
+        # KL(N0 || N1) elementwise over broadcast shapes
+        var0, var1 = self.scale ** 2, other.scale ** 2
+        t1 = (self.loc - other.loc) ** 2 / (2 * var1)
+        t2 = var0 / (2 * var1)
+        return Tensor(t1 + t2 - 0.5 + jnp.log(other.scale / self.scale))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _val(low)
+        self.high = _val(high)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape)
+        base = jnp.broadcast_shapes(self.low.shape, self.high.shape)
+        u = jax.random.uniform(_next_key(), shape + base,
+                               dtype=jnp.float32)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+    def log_prob(self, value):
+        v = _val(value)
+        inside = (v >= self.low) & (v < self.high)
+        dens = 1.0 / (self.high - self.low)
+        return Tensor(jnp.where(inside, jnp.log(dens), -jnp.inf))
+
+    def probs(self, value):
+        v = _val(value)
+        inside = (v >= self.low) & (v < self.high)
+        return Tensor(jnp.where(inside, 1.0 / (self.high - self.low), 0.0))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _val(logits)
+
+    def _log_pmf(self):
+        return self.logits - jax.scipy.special.logsumexp(
+            self.logits, axis=-1, keepdims=True)
+
+    def sample(self, shape=()):
+        shape = tuple(shape)
+        return Tensor(jax.random.categorical(
+            _next_key(), self.logits, shape=shape + self.logits.shape[:-1]))
+
+    def entropy(self):
+        logp = self._log_pmf()
+        return Tensor(-jnp.sum(jnp.exp(logp) * logp, axis=-1))
+
+    def log_prob(self, value):
+        v = _val(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(
+            self._log_pmf(), v[..., None], axis=-1)[..., 0])
+
+    def probs(self, value):
+        return Tensor(jnp.exp(self.log_prob(value).value))
+
+    def kl_divergence(self, other):
+        logp = self._log_pmf()
+        logq = other._log_pmf()
+        return Tensor(jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1))

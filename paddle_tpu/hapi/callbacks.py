@@ -1,0 +1,339 @@
+"""Training callbacks.
+
+Reference analogue: python/paddle/hapi/callbacks.py (Callback,
+ProgBarLogger, ModelCheckpoint, LRScheduler, EarlyStopping, VisualDL).
+VisualDL has no TPU-side service here, so it degrades to a JSONL event
+log with the same constructor.
+"""
+import json
+import numbers
+import os
+import sys
+import time
+
+__all__ = ['Callback', 'ProgBarLogger', 'ModelCheckpoint', 'LRScheduler',
+           'EarlyStopping', 'VisualDL', 'ReduceLROnPlateau', 'config_callbacks']
+
+
+class CallbackList:
+    def __init__(self, callbacks):
+        self.callbacks = list(callbacks)
+
+    def append(self, cb):
+        self.callbacks.append(cb)
+
+    def __iter__(self):
+        return iter(self.callbacks)
+
+    def set_params(self, params):
+        for cb in self.callbacks:
+            cb.set_params(params)
+
+    def set_model(self, model):
+        for cb in self.callbacks:
+            cb.set_model(model)
+
+    def _call(self, name, *args):
+        for cb in self.callbacks:
+            getattr(cb, name)(*args)
+
+    def __getattr__(self, name):
+        if name.startswith('on_'):
+            return lambda *args: self._call(name, *args)
+        raise AttributeError(name)
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_predict_begin(self, logs=None):
+        pass
+
+    def on_predict_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+    def on_predict_batch_begin(self, step, logs=None):
+        pass
+
+    def on_predict_batch_end(self, step, logs=None):
+        pass
+
+
+def _fmt(v):
+    if isinstance(v, numbers.Number):
+        return '{:.4f}'.format(v)
+    if isinstance(v, (list, tuple)):
+        return '[' + ', '.join(_fmt(x) for x in v) + ']'
+    return str(v)
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get('epochs')
+        self._t0 = time.time()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.steps = self.params.get('steps')
+        self._epoch_t0 = time.time()
+        if self.verbose and self.epochs:
+            print('Epoch {}/{}'.format(epoch + 1, self.epochs))
+
+    def _print_logs(self, prefix, step, logs):
+        logs = logs or {}
+        items = ['{}: {}'.format(k, _fmt(v)) for k, v in logs.items()]
+        total = self.steps if self.steps else '?'
+        print('{} step {}/{} - {}'.format(
+            prefix, step + 1, total, ' - '.join(items)), file=sys.stderr
+            if self.verbose == 1 else sys.stdout)
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose == 2 and (step + 1) % self.log_freq == 0:
+            self._print_logs('train', step, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._epoch_t0
+            items = ['{}: {}'.format(k, _fmt(v))
+                     for k, v in (logs or {}).items()]
+            print('Epoch {} done in {:.1f}s - {}'.format(
+                epoch + 1, dt, ' - '.join(items)))
+
+    def on_eval_batch_end(self, step, logs=None):
+        if self.verbose == 2 and (step + 1) % self.log_freq == 0:
+            self._print_logs('eval', step, logs)
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            items = ['{}: {}'.format(k, _fmt(v))
+                     for k, v in (logs or {}).items()]
+            print('Eval - ' + ' - '.join(items))
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(os.path.join(self.save_dir, 'final'))
+
+
+class LRScheduler(Callback):
+    """Steps the optimizer's LRScheduler (by_step or by_epoch)."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        assert by_step ^ by_epoch, 'exactly one of by_step/by_epoch'
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        from ..optimizer.lr import LRScheduler as Sched
+        opt = getattr(self.model, '_optimizer', None)
+        lr = getattr(opt, '_learning_rate', None)
+        return lr if isinstance(lr, Sched) else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s is not None:
+            s.step()
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor='loss', mode='auto', patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        self.stopped_epoch = 0
+        if mode == 'min' or (mode == 'auto' and 'acc' not in monitor):
+            self.is_better = lambda cur, best: cur < best - self.min_delta
+            self.best = float('inf')
+        else:
+            self.is_better = lambda cur, best: cur > best + self.min_delta
+            self.best = -float('inf')
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        if self.baseline is not None:
+            self.best = self.baseline
+
+    def on_eval_end(self, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        if self.is_better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+            if self.save_best_model and \
+                    self.params.get('save_dir') is not None:
+                self.model.save(os.path.join(self.params['save_dir'],
+                                             'best_model'))
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.model.stop_training = True
+                if self.verbose:
+                    print('Early stopping: {} did not improve beyond '
+                          '{:.5f}'.format(self.monitor, self.best))
+
+
+class ReduceLROnPlateau(Callback):
+    def __init__(self, monitor='loss', factor=0.1, patience=10, verbose=1,
+                 mode='auto', min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == 'min' or (mode == 'auto' and 'acc' not in monitor):
+            self.is_better = lambda cur, best: cur < best - self.min_delta
+            self.best = float('inf')
+        else:
+            self.is_better = lambda cur, best: cur > best + self.min_delta
+            self.best = -float('inf')
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def on_eval_end(self, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self.is_better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = self.model._optimizer
+                new_lr = max(opt.get_lr() * self.factor, self.min_lr)
+                opt.set_lr(new_lr)
+                if self.verbose:
+                    print('ReduceLROnPlateau: lr -> {:.6g}'.format(new_lr))
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+
+class VisualDL(Callback):
+    """Scalar logging; writes JSONL events (no VisualDL service on TPU
+    hosts — same constructor as the reference's VisualDL callback)."""
+
+    def __init__(self, log_dir='./log'):
+        super().__init__()
+        self.log_dir = log_dir
+        self._fh = None
+        self._step = 0
+
+    def _write(self, tag, logs):
+        if self._fh is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._fh = open(os.path.join(self.log_dir, 'events.jsonl'), 'a')
+        rec = {'tag': tag, 'step': self._step, 'ts': time.time()}
+        for k, v in (logs or {}).items():
+            if isinstance(v, numbers.Number):
+                rec[k] = v
+            elif isinstance(v, (list, tuple)) and v and \
+                    isinstance(v[0], numbers.Number):
+                rec[k] = list(v)
+        self._fh.write(json.dumps(rec) + '\n')
+        self._fh.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        self._write('train', logs)
+
+    def on_eval_end(self, logs=None):
+        self._write('eval', logs)
+
+    def on_train_end(self, logs=None):
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+def config_callbacks(callbacks=None, model=None, batch_size=None,
+                     epochs=None, steps=None, log_freq=2, verbose=2,
+                     save_freq=1, save_dir=None, metrics=None, mode='train'):
+    cbks = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
+        cbks.append(ProgBarLogger(log_freq, verbose=verbose))
+    if not any(isinstance(c, LRScheduler) for c in cbks):
+        cbks.append(LRScheduler())
+    if not any(isinstance(c, ModelCheckpoint) for c in cbks):
+        cbks.append(ModelCheckpoint(save_freq, save_dir))
+    cb_list = CallbackList(cbks)
+    cb_list.set_model(model)
+    cb_list.set_params({
+        'batch_size': batch_size, 'epochs': epochs, 'steps': steps,
+        'verbose': verbose, 'metrics': metrics or [], 'save_dir': save_dir})
+    return cb_list

@@ -1,0 +1,412 @@
+"""High-level Model API: fit / evaluate / predict / save / load.
+
+Reference analogue: python/paddle/hapi/model.py (class Model).  The
+reference dispatches per-batch through the dygraph tracer or a static
+Program; here `fit` compiles ONE jitted train step — forward + loss +
+grad + optimizer update + metric pre-compute — into a single XLA module
+with donated params/opt-state (in-place HBM update), and the epoch loop
+stays host-side.  That is the whole TPU story: the MXU sees one fused
+program per step, the host only feeds batches.
+"""
+import os
+import warnings
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..jit import functional_call
+from ..io import DataLoader, Dataset
+from ..framework.io import save as _save, load as _load
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+__all__ = ['Model']
+
+
+def _to_jnp(x):
+    if isinstance(x, Tensor):
+        return x.value
+    return jnp.asarray(x)
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _outs_list(outs):
+    """functional_call returns the layer's output pytree verbatim — a bare
+    array for single-output layers; normalize to a list."""
+    return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+
+class Model:
+    """Wraps a Layer with train/eval/predict loops over compiled steps.
+
+    Args:
+        network: paddle_tpu.nn.Layer with forward(*inputs).
+        inputs/labels: optional InputSpec lists (count determines the
+            input/label split of each batch; default 1 label).
+    """
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _as_list(inputs)
+        self._labels = _as_list(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+        # compiled-step caches, keyed by (shapes, dtypes, lr-if-constant)
+        self._train_step_cache = {}
+        self._eval_step_cache = {}
+        self._pred_step_cache = {}
+        # functional state lives here between steps (device pytrees)
+        self._fstate = None
+
+    # -- preparation ---------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _as_list(metrics)
+        for m in self._metrics:
+            assert isinstance(m, Metric), \
+                'metrics must be paddle_tpu.metric.Metric instances'
+        self._amp = amp_configs or {}
+        # a new optimizer/loss invalidates compiled steps (their traces
+        # closed over the old ones) and the functional state
+        self._train_step_cache.clear()
+        self._eval_step_cache.clear()
+        self._pred_step_cache.clear()
+        self._invalidate()
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    # -- functional state sync -----------------------------------------------
+    def _get_fstate(self):
+        if self._fstate is None:
+            params, buffers = self.network.functional_state()
+            # copy: the compiled step donates its inputs, and these arrays
+            # are aliased by the live eager Parameters
+            params = jax.tree_util.tree_map(
+                lambda v: jnp.array(v, copy=True), params)
+            buffers = jax.tree_util.tree_map(
+                lambda v: jnp.array(v, copy=True), buffers)
+            if self._optimizer is not None:
+                # resume from eager accumulators (set by load()) when present
+                live = dict(self.network.named_parameters())
+                acc = self._optimizer._accumulators
+                opt_state = {
+                    n: jax.tree_util.tree_map(
+                        lambda v: jnp.array(v, copy=True), acc[id(p)])
+                    if id(p) in acc
+                    else self._optimizer._create_state(p.value)
+                    for n, p in live.items()}
+                step = self._optimizer._global_step
+            else:
+                opt_state, step = {}, 0
+            self._fstate = {'params': params, 'buffers': buffers,
+                            'opt': opt_state, 'step': step}
+        return self._fstate
+
+    def _sync_back(self):
+        """Write device pytrees back into the eager Layer tree and the
+        optimizer's accumulators (so state_dict/save see trained state).
+        Copies: the next compiled step donates the fstate arrays."""
+        if self._fstate is None:
+            return
+        cp = lambda v: jnp.array(v, copy=True)  # noqa: E731
+        self.network.load_functional_state(
+            jax.tree_util.tree_map(cp, self._fstate['params']),
+            jax.tree_util.tree_map(cp, self._fstate['buffers']))
+        if self._optimizer is not None:
+            live = dict(self.network.named_parameters())
+            for n, st in self._fstate['opt'].items():
+                if n in live:
+                    self._optimizer._accumulators[id(live[n])] = \
+                        jax.tree_util.tree_map(cp, st)
+
+    def _invalidate(self):
+        """Eager params changed (load/user edit): drop functional state."""
+        self._fstate = None
+
+    # -- compiled steps ------------------------------------------------------
+    def _loss_value(self, outs, labels):
+        outs_t = [Tensor._from_value(o) for o in outs]
+        labels_t = [Tensor._from_value(l) for l in labels]
+        if self._loss is None:
+            lv = outs[0]
+        else:
+            lv = self._loss(*(outs_t + labels_t))
+            lv = lv.value if isinstance(lv, Tensor) else jnp.asarray(lv)
+        return jnp.mean(lv)
+
+    def _metric_computes(self, outs, labels):
+        res = []
+        for m in self._metrics:
+            if labels:
+                r = m.compute(outs[0], labels[0])
+            else:
+                r = m.compute(outs[0])
+            res.append(r.value if isinstance(r, Tensor) else r)
+        return res
+
+    def _batch_key(self, arrays, extra=()):
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        from ..optimizer.lr import LRScheduler
+        lr = None
+        if self._optimizer is not None and \
+                not isinstance(self._optimizer._learning_rate, LRScheduler):
+            lr = float(self._optimizer._learning_rate)
+        return sig + tuple(extra) + (lr,)
+
+    def _make_train_step(self, n_in):
+        network, opt = self.network, self._optimizer
+
+        def step_fn(params, buffers, opt_state, key, step, *arrays):
+            inputs, labels = arrays[:n_in], arrays[n_in:]
+
+            def loss_fn(p):
+                outs, new_buf = functional_call(
+                    network, p, buffers, inputs, key=key, training=True)
+                outs = _outs_list(outs)
+                return self._loss_value(outs, labels), (outs, new_buf)
+
+            (loss, (outs, new_buf)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opt = opt.apply_gradients(
+                params, grads, opt_state, step)
+            metrics = self._metric_computes(outs, labels)
+            return new_params, new_buf, new_opt, loss, metrics
+
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+
+    def _make_eval_step(self, n_in):
+        network = self.network
+
+        def step_fn(params, buffers, key, *arrays):
+            inputs, labels = arrays[:n_in], arrays[n_in:]
+            outs, _ = functional_call(network, params, buffers, inputs,
+                                      key=key, training=False)
+            outs = _outs_list(outs)
+            loss = self._loss_value(outs, labels) \
+                if self._loss is not None else jnp.zeros(())
+            metrics = self._metric_computes(outs, labels)
+            return outs, loss, metrics
+
+        return jax.jit(step_fn)
+
+    def _make_pred_step(self, n_in):
+        network = self.network
+
+        def step_fn(params, buffers, key, *arrays):
+            outs, _ = functional_call(network, params, buffers,
+                                      arrays[:n_in], key=key,
+                                      training=False)
+            return _outs_list(outs)
+
+        return jax.jit(step_fn)
+
+    def _split_batch(self, batch):
+        batch = [_to_jnp(b) for b in _as_list(batch)]
+        n_lab = len(self._labels) if self._labels else \
+            (1 if self._loss is not None else 0)
+        n_lab = min(n_lab, max(0, len(batch) - 1))
+        n_in = len(batch) - n_lab
+        return batch, n_in
+
+    # -- public batch APIs ---------------------------------------------------
+    def train_batch(self, inputs, labels=None):
+        """One compiled optimizer step; returns (loss, metric_results)."""
+        assert self._optimizer is not None and self._loss is not None, \
+            'call prepare(optimizer, loss) before train_batch'
+        batch = _as_list(inputs) + _as_list(labels)
+        arrays, n_in = self._split_batch(batch)
+        st = self._get_fstate()
+        key = self._batch_key(arrays, ('train', n_in))
+        if key not in self._train_step_cache:
+            self._train_step_cache[key] = self._make_train_step(n_in)
+        fn = self._train_step_cache[key]
+        rng = jax.random.fold_in(jax.random.PRNGKey(0), st['step'])
+        # optimizer rules take t starting at 1 (Adam bias correction)
+        new_params, new_buf, new_opt, loss, mres = fn(
+            st['params'], st['buffers'], st['opt'], rng,
+            jnp.asarray(st['step'] + 1, jnp.int32), *arrays)
+        st.update(params=new_params, buffers=new_buf, opt=new_opt,
+                  step=st['step'] + 1)
+        if self._optimizer is not None:
+            self._optimizer._global_step = st['step']
+        metric_logs = [m.update(r) if not isinstance(r, (tuple, list))
+                       else m.update(*r)
+                       for m, r in zip(self._metrics, mres)]
+        return float(loss), metric_logs
+
+    def eval_batch(self, inputs, labels=None):
+        batch = _as_list(inputs) + _as_list(labels)
+        arrays, n_in = self._split_batch(batch)
+        st = self._get_fstate() if self._optimizer is not None else None
+        if st is None:
+            params, buffers = self.network.functional_state()
+        else:
+            params, buffers = st['params'], st['buffers']
+        key = self._batch_key(arrays, ('eval', n_in))
+        if key not in self._eval_step_cache:
+            self._eval_step_cache[key] = self._make_eval_step(n_in)
+        outs, loss, mres = self._eval_step_cache[key](
+            params, buffers, jax.random.PRNGKey(0), *arrays)
+        for m, r in zip(self._metrics, mres):
+            m.update(r) if not isinstance(r, (tuple, list)) \
+                else m.update(*r)
+        return float(loss), [np.asarray(o) for o in outs]
+
+    def predict_batch(self, inputs):
+        arrays = [_to_jnp(b) for b in _as_list(inputs)]
+        n_in = len(arrays)
+        if self._fstate is not None:
+            params, buffers = self._fstate['params'], \
+                self._fstate['buffers']
+        else:
+            params, buffers = self.network.functional_state()
+        key = self._batch_key(arrays, ('pred', n_in))
+        if key not in self._pred_step_cache:
+            self._pred_step_cache[key] = self._make_pred_step(n_in)
+        outs = self._pred_step_cache[key](
+            params, buffers, jax.random.PRNGKey(0), *arrays)
+        return [np.asarray(o) for o in outs]
+
+    # -- loops ---------------------------------------------------------------
+    def _to_loader(self, data, batch_size, shuffle, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers)
+        return data  # any iterable of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None):
+        assert self._optimizer is not None and self._loss is not None, \
+            'call prepare(optimizer, loss) before fit'
+        train_loader = self._to_loader(train_data, batch_size, shuffle,
+                                       num_workers)
+        eval_loader = self._to_loader(eval_data, batch_size, False,
+                                      num_workers)
+        steps = len(train_loader) if hasattr(train_loader, '__len__') \
+            else None
+        cbks = config_callbacks(
+            callbacks, model=self, batch_size=batch_size, epochs=epochs,
+            steps=steps, log_freq=log_freq, verbose=verbose,
+            save_freq=save_freq, save_dir=save_dir,
+            metrics=['loss'] + [m.name() for m in self._metrics])
+        self.stop_training = False
+        cbks.on_train_begin({})
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch, {})
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step, {})
+                arrays, n_in = self._split_batch(batch)
+                loss, _ = self.train_batch(arrays[:n_in], arrays[n_in:])
+                logs = {'loss': loss}
+                for m in self._metrics:
+                    logs[str(m.name())] = m.accumulate()
+                cbks.on_train_batch_end(step, logs)
+                if self.stop_training:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(
+                    eval_loader, batch_size=batch_size, verbose=0,
+                    num_workers=num_workers, _callbacks=cbks)
+                cbks.on_eval_end(eval_logs)
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs)
+        self._sync_back()
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, _callbacks=None):
+        loader = self._to_loader(eval_data, batch_size, False, num_workers)
+        for m in self._metrics:
+            m.reset()
+        total_loss, n_batches = 0.0, 0
+        cbks = _callbacks
+        if cbks is None:
+            cbks = config_callbacks(
+                callbacks, model=self, batch_size=batch_size,
+                steps=len(loader) if hasattr(loader, '__len__') else None,
+                log_freq=log_freq, verbose=verbose, mode='eval',
+                metrics=['loss'] + [m.name() for m in self._metrics])
+            cbks.on_eval_begin({})
+        for step, batch in enumerate(loader):
+            arrays, n_in = self._split_batch(batch)
+            loss, _ = self.eval_batch(arrays[:n_in], arrays[n_in:])
+            total_loss += loss
+            n_batches += 1
+            cbks.on_eval_batch_end(step, {'loss': loss})
+        logs = {'loss': total_loss / max(1, n_batches)}
+        for m in self._metrics:
+            logs[str(m.name())] = m.accumulate()
+        if _callbacks is None:
+            cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = self._to_loader(test_data, batch_size, False, num_workers)
+        outputs = []
+        for batch in loader:
+            arrays, n_in = self._split_batch(batch)
+            outs = self.predict_batch(arrays[:n_in])
+            outputs.append(outs)
+        # transpose: list-of-batches -> per-output lists
+        n_out = len(outputs[0]) if outputs else 0
+        per_out = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            per_out = [np.concatenate(o, axis=0) for o in per_out]
+        return per_out
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        self._sync_back()
+        if training:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            _save(self.network.state_dict(), path + '.pdparams')
+            if self._optimizer is not None:
+                _save(self._optimizer.state_dict(), path + '.pdopt')
+        else:
+            from .. import jit as _jit
+            _jit.save(self.network, path)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        sd = _load(path + '.pdparams')
+        try:
+            self.network.set_state_dict(sd)
+        except (KeyError, ValueError):
+            if not skip_mismatch:
+                raise
+            warnings.warn('skip_mismatch=True: partially loaded')
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + '.pdopt'):
+            self._optimizer.set_state_dict(_load(path + '.pdopt'))
+        self._invalidate()
+        return self
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+        return _summary(self.network, input_size, dtypes=dtype)

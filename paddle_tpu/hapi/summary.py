@@ -1,0 +1,90 @@
+"""Model summary (reference: python/paddle/hapi/model_summary.py).
+
+Walks the Layer tree with forward hooks on a dummy forward, reporting
+per-layer output shapes and parameter counts.  Runs eager on host-sized
+dummy inputs; no TPU compile is triggered beyond the ops themselves.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn import Layer
+
+__all__ = ['summary']
+
+
+def _num_params(layer):
+    own = [p for _, p in layer.named_parameters(include_sublayers=False)]
+    return sum(int(np.prod(p.shape)) for p in own), \
+        sum(int(np.prod(p.shape)) for p in own if not p.stop_gradient)
+
+
+def _shape_of(out):
+    if isinstance(out, Tensor):
+        return list(out.shape)
+    if isinstance(out, (list, tuple)) and out:
+        return _shape_of(out[0])
+    return []
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table; returns {'total_params', 'trainable_params'}."""
+    assert isinstance(net, Layer)
+    if input is None:
+        assert input_size is not None, 'need input_size or input'
+        sizes = input_size if isinstance(input_size, list) and \
+            isinstance(input_size[0], (list, tuple)) else [input_size]
+        dtypes = dtypes or ['float32'] * len(sizes)
+        if isinstance(dtypes, str):
+            dtypes = [dtypes] * len(sizes)
+        inputs = [Tensor(jnp.zeros([s if s is not None else 1
+                                    for s in size], dtype=dt))
+                  for size, dt in zip(sizes, dtypes)]
+    else:
+        inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    rows = []
+    hooks = []
+
+    def register(layer, prefix):
+        subs = list(layer._sub_layers.items())
+        if not subs:
+            def hook(l, inp, out, name=prefix,
+                     cls=layer.__class__.__name__):
+                tot, train = _num_params(l)
+                rows.append((f'{cls}-{len(rows) + 1}', name,
+                             _shape_of(out), tot))
+            hooks.append(layer.register_forward_post_hook(hook))
+        for name, sub in subs:
+            register(sub, f'{prefix}.{name}' if prefix else name)
+
+    register(net, '')
+    was_training = net.training
+    net.eval()
+    try:
+        net(*inputs)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+
+    name_w = max([len(r[0]) for r in rows] + [12]) + 2
+    shape_w = max([len(str(r[2])) for r in rows] + [14]) + 2
+    line = '-' * (name_w + shape_w + 14)
+    print(line)
+    print(f"{'Layer (type)':<{name_w}}{'Output Shape':<{shape_w}}"
+          f"{'Param #':>12}")
+    print('=' * (name_w + shape_w + 14))
+    for cls_name, _, shape, n in rows:
+        print(f'{cls_name:<{name_w}}{str(shape):<{shape_w}}{n:>12,}')
+    print('=' * (name_w + shape_w + 14))
+    print(f'Total params: {total:,}')
+    print(f'Trainable params: {trainable:,}')
+    print(f'Non-trainable params: {total - trainable:,}')
+    print(line)
+    return {'total_params': total, 'trainable_params': trainable}

@@ -1,0 +1,210 @@
+"""Metrics: Accuracy / Precision / Recall / Auc.
+
+Reference analogue: python/paddle/metric/metrics.py (Metric, Accuracy,
+Precision, Recall, Auc, paddle.metric.accuracy).  `compute` is jit-safe
+(pure jnp on device); `update` accumulates small host-side scalars so
+the compiled train step never materialises metric state on device.
+"""
+import abc
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ['Metric', 'Accuracy', 'Precision', 'Recall', 'Auc', 'accuracy']
+
+
+def _to_np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x.value)
+    return np.asarray(x)
+
+
+class Metric(abc.ABC):
+    def __init__(self):
+        pass
+
+    @abc.abstractmethod
+    def reset(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def update(self, *args):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def accumulate(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        """Device-side pre-computation; runs inside the compiled step."""
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None, *args, **kwargs):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or 'acc'
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        """Return correctness matrix [N, maxk] (jit-safe)."""
+        pred = pred.value if isinstance(pred, Tensor) else jnp.asarray(pred)
+        label = label.value if isinstance(label, Tensor) \
+            else jnp.asarray(label)
+        pred_idx = jnp.argsort(pred, axis=-1)[..., ::-1][..., :self.maxk]
+        if label.ndim == pred.ndim:  # one-hot or column labels
+            if label.shape[-1] == 1:
+                label = label[..., 0]
+            else:
+                label = jnp.argmax(label, axis=-1)
+        return (pred_idx == label[..., None]).astype(jnp.float32)
+
+    def update(self, correct, *args):
+        correct = _to_np(correct)
+        accs = []
+        for k in self.topk:
+            num = correct[..., :k].sum()
+            accs.append(float(num) / max(1, correct.shape[0]))
+            self.total[self.topk.index(k)] += float(num)
+        self.count += correct.shape[0]
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = 0
+
+    def accumulate(self):
+        res = [t / max(1, self.count) for t in self.total]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return ['{}_top{}'.format(self._name, k) for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision over thresholded predictions."""
+
+    def __init__(self, name='precision', *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_np(preds).reshape(-1)
+        labels = _to_np(labels).reshape(-1)
+        pred_pos = preds > 0.5
+        self.tp += int(np.sum(pred_pos & (labels == 1)))
+        self.fp += int(np.sum(pred_pos & (labels != 1)))
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """Binary recall over thresholded predictions."""
+
+    def __init__(self, name='recall', *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_np(preds).reshape(-1)
+        labels = _to_np(labels).reshape(-1)
+        pred_pos = preds > 0.5
+        self.tp += int(np.sum(pred_pos & (labels == 1)))
+        self.fn += int(np.sum(~pred_pos & (labels == 1)))
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """ROC AUC via histogram buckets (streaming-friendly)."""
+
+    def __init__(self, curve='ROC', num_thresholds=4095, name='auc',
+                 *args, **kwargs):
+        super().__init__()
+        self.curve = curve
+        self.num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_np(preds)
+        labels = _to_np(labels).reshape(-1)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            scores = preds[:, 1]
+        else:
+            scores = preds.reshape(-1)
+        buckets = np.clip((scores * self.num_thresholds).astype(int),
+                          0, self.num_thresholds)
+        for b, l in zip(buckets, labels):
+            if l:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1, dtype=np.int64)
+        self._stat_neg = np.zeros(self.num_thresholds + 1, dtype=np.int64)
+
+    def accumulate(self):
+        # walk thresholds high->low accumulating TP/FP; trapezoid rule
+        tot_pos = float(self._stat_pos.sum())
+        tot_neg = float(self._stat_neg.sum())
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        tp = fp = 0.0
+        auc = 0.0
+        prev_tpr = prev_fpr = 0.0
+        for b in range(self.num_thresholds, -1, -1):
+            tp += float(self._stat_pos[b])
+            fp += float(self._stat_neg[b])
+            tpr, fpr = tp / tot_pos, fp / tot_neg
+            auc += (fpr - prev_fpr) * (tpr + prev_tpr) / 2.0
+            prev_tpr, prev_fpr = tpr, fpr
+        return auc
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (reference: paddle.metric.accuracy)."""
+    x = input.value if isinstance(input, Tensor) else jnp.asarray(input)
+    y = label.value if isinstance(label, Tensor) else jnp.asarray(label)
+    pred_idx = jnp.argsort(x, axis=-1)[..., ::-1][..., :k]
+    if y.ndim == x.ndim:
+        if y.shape[-1] == 1:
+            y = y[..., 0]
+        else:
+            y = jnp.argmax(y, axis=-1)
+    correct_mat = (pred_idx == y[..., None]).any(axis=-1)
+    return Tensor(jnp.mean(correct_mat.astype(jnp.float32), keepdims=True))

@@ -75,6 +75,13 @@ class ParallelTrainer:
         self.opt_state = optimizer.init(params)
         if self.mesh is not None:
             self._place_state()
+        elif self.donate:
+            # device_put would alias the live Parameters' arrays; the
+            # donated step would delete them out from under the Layer
+            self.params = {n: jnp.array(v, copy=True)
+                           for n, v in self.params.items()}
+            self.buffers = {n: jnp.array(v, copy=True)
+                            for n, v in self.buffers.items()}
 
     # -- sharding placement --------------------------------------------------
     def _sharding_for(self, name, v, zero=False):
@@ -223,8 +230,14 @@ class ParallelTrainer:
 
     def sync_to_model(self):
         """Write compiled-state params/buffers back into the live Layer
-        (for state_dict/save after training)."""
-        self.model.load_functional_state(self.params, self.buffers)
+        (for state_dict/save after training).  Copies when donating:
+        the next step() would otherwise delete the Layer's arrays."""
+        params, buffers = self.params, self.buffers
+        if self.donate:
+            params = {n: jnp.array(v, copy=True) for n, v in params.items()}
+            buffers = {n: jnp.array(v, copy=True)
+                       for n, v in buffers.items()}
+        self.model.load_functional_state(params, buffers)
 
     def loss_float(self, loss):
         return float(np.asarray(loss))

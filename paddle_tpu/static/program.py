@@ -390,13 +390,10 @@ class Executor:
                 side = [sv._eval(env) for sv in side_sources]
                 return loss.astype(jnp.float32).sum(), (outs, side)
             grads, (outs, side) = jax.grad(loss_fn, has_aux=True)(pvals)
-            lr = optimizer._lr_value(step)
-            new_p, new_s = [], []
-            for p, v, g, s in zip(params, pvals, grads, svals):
-                g = optimizer._apply_weight_decay_grad(v, g.astype(v.dtype))
-                nv, ns = optimizer._rule(v, g, s, lr, step)
-                new_p.append(nv)
-                new_s.append(ns)
+            # apply_gradients applies grad clipping + weight decay exactly
+            # like the eager step() path (clip skipped only if unset)
+            new_p, new_s = optimizer.apply_gradients(
+                list(pvals), list(grads), list(svals), step)
             return outs, new_p, new_s, side
 
         return run_train

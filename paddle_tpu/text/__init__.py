@@ -1,0 +1,6 @@
+"""paddle_tpu.text (reference: python/paddle/text)."""
+from .datasets import (  # noqa: F401
+    Imdb, Imikolov, Movielens, UCIHousing, Conll05st, WMT14, WMT16)
+
+__all__ = ['Imdb', 'Imikolov', 'Movielens', 'UCIHousing', 'Conll05st',
+           'WMT14', 'WMT16']

@@ -1,0 +1,297 @@
+"""Text datasets: Imdb / Imikolov / Movielens / UCIHousing / Conll05st /
+WMT14 / WMT16.
+
+Reference analogue: python/paddle/text/datasets/*.py — each downloads a
+corpus from bcebos; this zero-egress build serves deterministic synthetic
+corpora with the same per-sample structure (ids/shapes/dtypes), so model
+code written against the reference runs unchanged.  When `data_file`
+points at a real local corpus in the reference's format, Imdb and
+UCIHousing parse it.
+"""
+import gzip
+import os
+import re
+import string
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ['Imdb', 'Imikolov', 'Movielens', 'UCIHousing', 'Conll05st',
+           'WMT14', 'WMT16']
+
+
+def _rng(seed, mode):
+    return np.random.RandomState(seed + (0 if mode == 'train' else 1))
+
+
+class Imdb(Dataset):
+    """(word-id sequence, 0/1 sentiment label)."""
+
+    VOCAB_SIZE = 5147  # synthetic vocab size (reference cutoff-dependent)
+
+    def __init__(self, data_file=None, mode='train', cutoff=150,
+                 download=True):
+        mode = mode.lower()
+        assert mode in ('train', 'test'), \
+            "mode should be 'train', 'test', but got {}".format(mode)
+        self.mode = mode
+        if data_file and os.path.exists(data_file):
+            self._load_tar(data_file, cutoff)
+        else:
+            rng = _rng(501, mode)
+            n = 2048 if mode == 'train' else 512
+            self.docs, self.labels = [], []
+            for _ in range(n):
+                label = int(rng.randint(0, 2))
+                length = int(rng.randint(8, 120))
+                # sentiment-dependent token bias keeps the task learnable
+                lo = 0 if label == 0 else self.VOCAB_SIZE // 2
+                ids = rng.randint(lo, lo + self.VOCAB_SIZE // 2,
+                                  size=length)
+                self.docs.append(ids.astype(np.int64))
+                self.labels.append(label)
+        self.word_idx = {i: i for i in range(self.VOCAB_SIZE)}
+
+    def _load_tar(self, path, cutoff):
+        pat_pos = re.compile(r'aclImdb/{}/pos/.*\.txt$'.format(self.mode))
+        pat_neg = re.compile(r'aclImdb/{}/neg/.*\.txt$'.format(self.mode))
+        freq = {}
+        docs_raw = []
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                lab = 1 if pat_pos.match(m.name) else \
+                    (0 if pat_neg.match(m.name) else None)
+                if lab is None:
+                    continue
+                text = tf.extractfile(m).read().decode('latin-1').lower()
+                toks = text.translate(
+                    str.maketrans('', '', string.punctuation)).split()
+                docs_raw.append((toks, lab))
+                for t in toks:
+                    freq[t] = freq.get(t, 0) + 1
+        vocab = [w for w, c in sorted(freq.items(),
+                                      key=lambda kv: (-kv[1], kv[0]))
+                 if c >= cutoff]
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        unk = len(vocab)
+        self.docs = [np.array([self.word_idx.get(t, unk) for t in toks],
+                              dtype=np.int64) for toks, _ in docs_raw]
+        self.labels = [lab for _, lab in docs_raw]
+
+    def __getitem__(self, idx):
+        return self.docs[idx], np.array([self.labels[idx]], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram / sequence language-model samples."""
+
+    def __init__(self, data_file=None, data_type='NGRAM', window_size=-1,
+                 mode='train', min_word_freq=50, download=True):
+        mode = mode.lower()
+        assert mode in ('train', 'test'), \
+            "mode should be 'train', 'test', but got {}".format(mode)
+        assert data_type.upper() in ('NGRAM', 'SEQ')
+        self.data_type = data_type.upper()
+        if self.data_type == 'NGRAM':
+            assert window_size > 0, 'NGRAM needs window_size > 0'
+        self.window_size = window_size
+        self.vocab_size = 2074  # reference-scale PTB vocab after cutoff
+        rng = _rng(521, mode)
+        n_sents = 2048 if mode == 'train' else 256
+        self.data = []
+        for _ in range(n_sents):
+            length = int(rng.randint(4, 24))
+            sent = rng.randint(0, self.vocab_size, size=length)
+            if self.data_type == 'NGRAM':
+                for i in range(window_size - 1, length):
+                    self.data.append(tuple(
+                        np.int64(sent[i - window_size + 1 + j])
+                        for j in range(window_size)))
+            else:
+                self.data.append(sent.astype(np.int64))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """(user_id, gender, age, job, movie_id, category_vec, title_vec,
+    rating) — the Wide&Deep-style sparse-feature sample."""
+
+    NUM_USERS = 6040
+    NUM_MOVIES = 3952
+    NUM_JOBS = 21
+    NUM_AGES = 7
+    NUM_CATEGORIES = 18
+    TITLE_LEN = 5
+    TITLE_VOCAB = 5175
+
+    def __init__(self, data_file=None, mode='train', test_ratio=0.1,
+                 rand_seed=0, download=True):
+        mode = mode.lower()
+        assert mode in ('train', 'test'), \
+            "mode should be 'train', 'test', but got {}".format(mode)
+        rng = np.random.RandomState(541 + rand_seed
+                                    + (0 if mode == 'train' else 1))
+        n = 4096 if mode == 'train' else 512
+        self.samples = []
+        for _ in range(n):
+            uid = rng.randint(1, self.NUM_USERS + 1)
+            gender = rng.randint(0, 2)
+            age = rng.randint(0, self.NUM_AGES)
+            job = rng.randint(0, self.NUM_JOBS)
+            mid = rng.randint(1, self.NUM_MOVIES + 1)
+            cat = rng.randint(0, self.NUM_CATEGORIES,
+                              size=rng.randint(1, 4))
+            title = rng.randint(0, self.TITLE_VOCAB, size=self.TITLE_LEN)
+            # rating correlates with (uid+mid) parity so embeddings learn
+            rating = float((uid + mid + gender) % 5 + 1)
+            self.samples.append(
+                (np.int64(uid), np.int64(gender), np.int64(age),
+                 np.int64(job), np.int64(mid), cat.astype(np.int64),
+                 title.astype(np.int64),
+                 np.array([rating], dtype=np.float32)))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class UCIHousing(Dataset):
+    """(13 float features, house price)."""
+
+    FEATURE_DIM = 13
+
+    def __init__(self, data_file=None, mode='train', download=True):
+        mode = mode.lower()
+        assert mode in ('train', 'test'), \
+            "mode should be 'train' or 'test', but got {}".format(mode)
+        self.mode = mode
+        if data_file and os.path.exists(data_file):
+            raw = np.loadtxt(data_file)
+            feats, prices = raw[:, :-1], raw[:, -1:]
+            feats = (feats - feats.mean(0)) / (feats.std(0) + 1e-8)
+            split = int(len(raw) * 0.8)
+            if mode == 'train':
+                self.data = feats[:split].astype(np.float32)
+                self.label = prices[:split].astype(np.float32)
+            else:
+                self.data = feats[split:].astype(np.float32)
+                self.label = prices[split:].astype(np.float32)
+        else:
+            rng = _rng(561, mode)
+            n = 404 if mode == 'train' else 102  # reference split sizes
+            self.data = rng.randn(n, self.FEATURE_DIM).astype(np.float32)
+            w = np.linspace(-2, 2, self.FEATURE_DIM).astype(np.float32)
+            noise = rng.randn(n).astype(np.float32) * 0.1
+            self.label = (self.data @ w + 22.0 + noise)[:, None]
+
+    def __getitem__(self, idx):
+        return self.data[idx], self.label[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(Dataset):
+    """SRL sequences: (pred_idx, mark, word_ids..., label_ids)."""
+
+    WORD_VOCAB = 44068
+    LABEL_NUM = 67
+    PRED_VOCAB = 3162
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode='train',
+                 download=True):
+        rng = _rng(581, mode if mode in ('train', 'test') else 'train')
+        n = 1024
+        self.samples = []
+        for _ in range(n):
+            length = int(rng.randint(5, 40))
+            words = rng.randint(0, self.WORD_VOCAB, size=length)
+            pred = rng.randint(0, self.PRED_VOCAB)
+            pred_pos = rng.randint(0, length)
+            mark = np.zeros(length, dtype=np.int64)
+            mark[pred_pos] = 1
+            labels = rng.randint(0, self.LABEL_NUM, size=length)
+            ctx = [words[max(0, min(length - 1, pred_pos + d))]
+                   for d in (-2, -1, 0, 1, 2)]
+            self.samples.append(
+                tuple([words.astype(np.int64)]
+                      + [np.full(length, c, dtype=np.int64) for c in ctx]
+                      + [np.full(length, pred, dtype=np.int64), mark,
+                         labels.astype(np.int64)]))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class _WMTBase(Dataset):
+    """(src_ids, trg_ids, trg_ids_next) translation triples."""
+
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, seed, mode, dict_size):
+        rng = _rng(seed, mode)
+        self.dict_size = dict_size
+        n = 2048 if mode == 'train' else 256
+        self.samples = []
+        for _ in range(n):
+            slen = int(rng.randint(3, 30))
+            tlen = int(rng.randint(3, 30))
+            src = rng.randint(3, dict_size, size=slen).astype(np.int64)
+            trg_core = rng.randint(3, dict_size, size=tlen).astype(np.int64)
+            trg = np.concatenate([[self.BOS], trg_core]).astype(np.int64)
+            trg_next = np.concatenate([trg_core,
+                                       [self.EOS]]).astype(np.int64)
+            self.samples.append((src, trg, trg_next))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class WMT14(_WMTBase):
+    def __init__(self, data_file=None, mode='train', dict_size=30000,
+                 download=True):
+        mode = mode.lower()
+        assert mode in ('train', 'test', 'gen'), \
+            "mode should be 'train', 'test' or 'gen', got {}".format(mode)
+        super().__init__(601, 'train' if mode == 'train' else 'test',
+                         dict_size)
+        self.mode = mode
+
+    def get_dict(self, reverse=False):
+        d = {i: 'w{}'.format(i) for i in range(self.dict_size)}
+        return ({v: k for k, v in d.items()} if reverse else d,) * 2
+
+
+class WMT16(_WMTBase):
+    def __init__(self, data_file=None, mode='train', src_dict_size=-1,
+                 trg_dict_size=-1, lang='en', download=True):
+        mode = mode.lower()
+        assert mode in ('train', 'test', 'val'), \
+            "mode should be 'train', 'test' or 'val', got {}".format(mode)
+        size = src_dict_size if src_dict_size > 0 else 30000
+        super().__init__(621, 'train' if mode == 'train' else 'test', size)
+        self.mode = mode
+        self.lang = lang
+
+    def get_dict(self, lang='en', reverse=False):
+        d = {i: 'w{}'.format(i) for i in range(self.dict_size)}
+        return {v: k for k, v in d.items()} if reverse else d

@@ -1,0 +1,23 @@
+"""paddle_tpu.vision (reference: python/paddle/vision)."""
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+
+from .models import *  # noqa: F401,F403
+from .models import __all__ as _models_all
+
+__all__ = ['datasets', 'models', 'transforms'] + list(_models_all)
+
+
+def set_image_backend(backend):
+    if backend not in ('pil', 'cv2', 'numpy'):
+        raise ValueError('unsupported backend: {}'.format(backend))
+    global _image_backend
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+_image_backend = 'numpy'

@@ -1,0 +1,42 @@
+"""LeNet for MNIST-shaped inputs.
+
+Reference analogue: python/paddle/vision/models/lenet.py:21 (class LeNet).
+Same constructor/API; implementation is our Layer/functional stack, so the
+whole forward traces into one XLA module under paddle_tpu.jit.
+"""
+from ... import nn
+from ...tensor.manipulation import flatten
+
+__all__ = ['LeNet']
+
+
+class LeNet(nn.Layer):
+    """LeNet-5 style conv net.
+
+    Args:
+        num_classes: size of the classifier head; <= 0 disables the head
+            and the features are returned flat.
+    """
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.num_classes = num_classes
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 3, stride=1, padding=1),
+            nn.ReLU(),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(6, 16, 5, stride=1, padding=0),
+            nn.ReLU(),
+            nn.MaxPool2D(2, 2))
+        if num_classes > 0:
+            self.fc = nn.Sequential(
+                nn.Linear(400, 120),
+                nn.Linear(120, 84),
+                nn.Linear(84, num_classes))
+
+    def forward(self, inputs):
+        x = self.features(inputs)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.fc(x)
+        return x

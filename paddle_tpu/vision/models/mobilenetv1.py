@@ -1,0 +1,77 @@
+"""MobileNetV1 (depthwise-separable convolutions).
+
+Reference analogue: python/paddle/vision/models/mobilenetv1.py:84
+(class MobileNetV1, mobilenet_v1).  Same API.  Depthwise convs lower to
+XLA ``conv_general_dilated`` with feature_group_count — TPU handles these
+natively, no im2col.
+"""
+from ... import nn
+from ...tensor.manipulation import flatten
+
+__all__ = ['MobileNetV1', 'mobilenet_v1']
+
+
+class _ConvBNReLU(nn.Layer):
+    def __init__(self, in_ch, out_ch, kernel, stride=1, padding=0, groups=1):
+        super().__init__()
+        self.conv = nn.Conv2D(in_ch, out_ch, kernel, stride=stride,
+                              padding=padding, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_ch)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _DepthwiseSeparable(nn.Layer):
+    def __init__(self, in_ch, mid_ch, out_ch, stride, scale):
+        super().__init__()
+        mid = int(mid_ch * scale)
+        self.depthwise = _ConvBNReLU(int(in_ch * scale), mid, 3,
+                                     stride=stride, padding=1, groups=mid)
+        self.pointwise = _ConvBNReLU(mid, int(out_ch * scale), 1)
+
+    def forward(self, x):
+        return self.pointwise(self.depthwise(x))
+
+
+# (in, mid, out, stride) per depthwise-separable stage
+_STAGES = [(32, 32, 64, 1), (64, 64, 128, 2), (128, 128, 128, 1),
+           (128, 128, 256, 2), (256, 256, 256, 1), (256, 256, 512, 2),
+           (512, 512, 512, 1), (512, 512, 512, 1), (512, 512, 512, 1),
+           (512, 512, 512, 1), (512, 512, 512, 1), (512, 512, 1024, 2),
+           (1024, 1024, 1024, 1)]
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        blocks = [_ConvBNReLU(3, int(32 * scale), 3, stride=2, padding=1)]
+        for in_ch, mid_ch, out_ch, stride in _STAGES:
+            blocks.append(
+                _DepthwiseSeparable(in_ch, mid_ch, out_ch, stride, scale))
+        self.features = nn.Sequential(*blocks)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            'pretrained weights unavailable in this zero-egress build')
+    return MobileNetV1(scale=scale, **kwargs)

@@ -1,0 +1,175 @@
+"""ResNet family (18/34/50/101/152).
+
+Reference analogue: python/paddle/vision/models/resnet.py:151 (class ResNet,
+BasicBlock, BottleneckBlock, resnet18..resnet152).  Same public API; the
+implementation is TPU-first:
+
+- ``data_format='NHWC'`` runs the whole network channels-last, the layout
+  the TPU conv units prefer, with no per-layer transposes (the reference is
+  NCHW-only because cuDNN prefers it).
+- the forward is pure w.r.t. parameters, so paddle_tpu.jit compiles the
+  full model (+loss+grad) into one XLA module; XLA fuses BN+ReLU into the
+  conv epilogues.
+"""
+from ... import nn
+from ...tensor.manipulation import flatten
+
+__all__ = ['ResNet', 'resnet18', 'resnet34', 'resnet50', 'resnet101',
+           'resnet152']
+
+
+def _conv_bn(in_ch, out_ch, kernel, stride, padding, data_format,
+             groups=1, dilation=1):
+    return (nn.Conv2D(in_ch, out_ch, kernel, stride=stride, padding=padding,
+                      groups=groups, dilation=dilation, bias_attr=False,
+                      data_format=data_format),
+            nn.BatchNorm2D(out_ch, data_format=data_format))
+
+
+class BasicBlock(nn.Layer):
+    expansion = 1
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format='NCHW'):
+        super().__init__()
+        if groups != 1 or base_width != 64:
+            raise ValueError('BasicBlock only supports groups=1, width=64')
+        self.conv1, self.bn1 = _conv_bn(inplanes, planes, 3, stride, 1,
+                                        data_format)
+        self.conv2, self.bn2 = _conv_bn(planes, planes, 3, 1, 1, data_format)
+        self.relu = nn.ReLU()
+        self.downsample = downsample
+        self.stride = stride
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class BottleneckBlock(nn.Layer):
+    expansion = 4
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format='NCHW'):
+        super().__init__()
+        width = int(planes * (base_width / 64.0)) * groups
+        self.conv1, self.bn1 = _conv_bn(inplanes, width, 1, 1, 0, data_format)
+        self.conv2, self.bn2 = _conv_bn(width, width, 3, stride, dilation,
+                                        data_format, groups=groups,
+                                        dilation=dilation)
+        self.conv3, self.bn3 = _conv_bn(width, planes * self.expansion,
+                                        1, 1, 0, data_format)
+        self.relu = nn.ReLU()
+        self.downsample = downsample
+        self.stride = stride
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class ResNet(nn.Layer):
+    """ResNet backbone + classifier.
+
+    Args:
+        block: BasicBlock or BottleneckBlock.
+        depth: one of 18/34/50/101/152.
+        num_classes: head size; <= 0 disables the head.
+        with_pool: global-average-pool before the head.
+        data_format: 'NCHW' (reference-compatible) or 'NHWC' (TPU-native).
+    """
+
+    _layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
+                  101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
+
+    def __init__(self, block, depth, num_classes=1000, with_pool=True,
+                 data_format='NCHW'):
+        super().__init__()
+        layers = self._layer_cfg[depth]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.data_format = data_format
+        self.inplanes = 64
+
+        self.conv1 = nn.Conv2D(3, 64, 7, stride=2, padding=3,
+                               bias_attr=False, data_format=data_format)
+        self.bn1 = nn.BatchNorm2D(64, data_format=data_format)
+        self.relu = nn.ReLU()
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1,
+                                    data_format=data_format)
+        self.layer1 = self._make_layer(block, 64, layers[0], 1)
+        self.layer2 = self._make_layer(block, 128, layers[1], 2)
+        self.layer3 = self._make_layer(block, 256, layers[2], 2)
+        self.layer4 = self._make_layer(block, 512, layers[3], 2)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1),
+                                                data_format=data_format)
+        if num_classes > 0:
+            self.fc = nn.Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, planes, n_blocks, stride):
+        downsample = None
+        if stride != 1 or self.inplanes != planes * block.expansion:
+            conv, bn = _conv_bn(self.inplanes, planes * block.expansion,
+                                1, stride, 0, self.data_format)
+            downsample = nn.Sequential(conv, bn)
+        blocks = [block(self.inplanes, planes, stride, downsample,
+                        data_format=self.data_format)]
+        self.inplanes = planes * block.expansion
+        for _ in range(1, n_blocks):
+            blocks.append(block(self.inplanes, planes,
+                                data_format=self.data_format))
+        return nn.Sequential(*blocks)
+
+    def forward(self, x):
+        x = self.relu(self.bn1(self.conv1(x)))
+        x = self.maxpool(x)
+        x = self.layer1(x)
+        x = self.layer2(x)
+        x = self.layer3(x)
+        x = self.layer4(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def _resnet(block, depth, pretrained, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            'pretrained weights are unavailable in this zero-egress build; '
+            'load a checkpoint with paddle_tpu.load + set_state_dict')
+    return ResNet(block, depth, **kwargs)
+
+
+def resnet18(pretrained=False, **kwargs):
+    return _resnet(BasicBlock, 18, pretrained, **kwargs)
+
+
+def resnet34(pretrained=False, **kwargs):
+    return _resnet(BasicBlock, 34, pretrained, **kwargs)
+
+
+def resnet50(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 50, pretrained, **kwargs)
+
+
+def resnet101(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+
+
+def resnet152(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 152, pretrained, **kwargs)
