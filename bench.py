@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 bf16(AMP) training throughput on one
+TPU chip — imgs/sec/chip (SURVEY.md §3 item 2).
+
+Baseline constant: the reference's V100-class ResNet-50 AMP number is
+~900 imgs/s/chip (no published figure ships in BASELINE.json, see
+SURVEY.md §3); vs_baseline = value / 900.
+
+Prints ONE JSON line to stdout; progress goes to stderr.
+"""
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMGS_PER_SEC = 900.0
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--smoke', action='store_true',
+                   help='tiny shapes, few iters (CI sanity)')
+    p.add_argument('--batch', type=int, default=256)
+    p.add_argument('--image', type=int, default=224)
+    p.add_argument('--iters', type=int, default=30)
+    p.add_argument('--warmup', type=int, default=5)
+    args = p.parse_args()
+    if args.smoke:
+        args.batch, args.image, args.iters, args.warmup = 32, 64, 4, 2
+
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.vision.models.resnet import ResNet, BottleneckBlock
+    from paddle_tpu.parallel import ParallelTrainer
+    from paddle_tpu.distributed import fleet
+
+    log(f'device: {jax.devices()[0]}  batch={args.batch} '
+        f'image={args.image}')
+
+    paddle.seed(0)
+    net = ResNet(BottleneckBlock, 50, num_classes=1000,
+                 data_format='NHWC')
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=net.parameters())
+    ce = nn.CrossEntropyLoss()
+
+    strategy = fleet.DistributedStrategy()
+    strategy.amp = True                       # bf16 compute (TPU AMP)
+    strategy.amp_configs['use_pure_fp16'] = True   # O2: pure bf16
+
+    trainer = ParallelTrainer(net, opt, lambda out, y: ce(out, y),
+                              strategy=strategy)
+
+    rs = np.random.RandomState(0)
+    # place the batch in HBM once — the bench measures compute, not the
+    # host link (real input pipelines double-buffer via the DataLoader)
+    x = jax.device_put(
+        rs.randn(args.batch, args.image, args.image, 3).astype('float32'))
+    y = jax.device_put(
+        rs.randint(0, 1000, size=(args.batch, 1)).astype('int64'))
+
+    t0 = time.time()
+    loss = None
+    for i in range(args.warmup):
+        loss = trainer.step(x, y)
+    jax.block_until_ready(loss)
+    log(f'warmup ({args.warmup} steps incl. compile): '
+        f'{time.time() - t0:.1f}s  loss={float(np.asarray(loss)):.4f}')
+
+    t0 = time.time()
+    for i in range(args.iters):
+        loss = trainer.step(x, y)
+    jax.block_until_ready(loss)
+    dt = time.time() - t0
+
+    imgs_per_sec = args.batch * args.iters / dt
+    log(f'{args.iters} steps in {dt:.2f}s  '
+        f'({dt / args.iters * 1000:.1f} ms/step)  '
+        f'final loss={float(np.asarray(loss)):.4f}')
+
+    print(json.dumps({
+        'metric': 'resnet50_bf16_train_throughput',
+        'value': round(imgs_per_sec, 2),
+        'unit': 'imgs/sec/chip',
+        'vs_baseline': round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 4),
+    }))
+
+
+if __name__ == '__main__':
+    main()

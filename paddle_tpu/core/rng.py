@@ -58,9 +58,16 @@ class functional_key_scope:
 
 
 def seed(s):
-    """paddle.seed — reseed the global eager generator."""
+    """paddle.seed — reseed the global eager generator.
+
+    Also seeds stdlib random and numpy so host-side data augmentation
+    (vision.transforms) is reproducible from the same call."""
+    import random as _pyrandom
+    import numpy as _np
     global _state
     _state = _RngState(int(s))
+    _pyrandom.seed(int(s))
+    _np.random.seed(int(s) % (2 ** 32))
     return _state
 
 

@@ -9,16 +9,16 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .core.rng import _state, _functional_keys
+from .core import rng as _rng
 from .core.tensor import Tensor
 
 __all__ = ['Distribution', 'Normal', 'Uniform', 'Categorical']
 
 
 def _next_key():
-    if _functional_keys:
-        return _functional_keys[-1].next()
-    return _state.next_key()
+    # core.rng.next_key respects both paddle_tpu.seed reseeding and the
+    # functional-key scope installed by jit tracing
+    return _rng.next_key()
 
 
 def _val(x):

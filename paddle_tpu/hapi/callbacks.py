@@ -110,10 +110,15 @@ class ProgBarLogger(Callback):
         super().__init__()
         self.log_freq = log_freq
         self.verbose = verbose
+        self.epochs = None
+        self.steps = None
 
     def on_train_begin(self, logs=None):
         self.epochs = self.params.get('epochs')
         self._t0 = time.time()
+
+    def on_eval_begin(self, logs=None):
+        self.steps = self.params.get('steps')
 
     def on_epoch_begin(self, epoch, logs=None):
         self.epoch = epoch

@@ -161,17 +161,12 @@ class Model:
 
     def _batch_key(self, arrays, extra=()):
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
-        from ..optimizer.lr import LRScheduler
-        lr = None
-        if self._optimizer is not None and \
-                not isinstance(self._optimizer._learning_rate, LRScheduler):
-            lr = float(self._optimizer._learning_rate)
-        return sig + tuple(extra) + (lr,)
+        return sig + tuple(extra)
 
     def _make_train_step(self, n_in):
         network, opt = self.network, self._optimizer
 
-        def step_fn(params, buffers, opt_state, key, step, *arrays):
+        def step_fn(params, buffers, opt_state, key, step, lr, *arrays):
             inputs, labels = arrays[:n_in], arrays[n_in:]
 
             def loss_fn(p):
@@ -182,8 +177,10 @@ class Model:
 
             (loss, (outs, new_buf)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            # lr is a traced arg: scheduler steps / set_lr reach the
+            # compiled module without retracing
             new_params, new_opt = opt.apply_gradients(
-                params, grads, opt_state, step)
+                params, grads, opt_state, step, lr=lr)
             metrics = self._metric_computes(outs, labels)
             return new_params, new_buf, new_opt, loss, metrics
 
@@ -239,7 +236,8 @@ class Model:
         # optimizer rules take t starting at 1 (Adam bias correction)
         new_params, new_buf, new_opt, loss, mres = fn(
             st['params'], st['buffers'], st['opt'], rng,
-            jnp.asarray(st['step'] + 1, jnp.int32), *arrays)
+            jnp.asarray(st['step'] + 1, jnp.int32),
+            jnp.asarray(self._optimizer.get_lr(), jnp.float32), *arrays)
         st.update(params=new_params, buffers=new_buf, opt=new_opt,
                   step=st['step'] + 1)
         if self._optimizer is not None:
@@ -283,12 +281,13 @@ class Model:
         return [np.asarray(o) for o in outs]
 
     # -- loops ---------------------------------------------------------------
-    def _to_loader(self, data, batch_size, shuffle, num_workers):
+    def _to_loader(self, data, batch_size, shuffle, num_workers,
+                   drop_last=False):
         if data is None or isinstance(data, DataLoader):
             return data
         if isinstance(data, Dataset):
             return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
-                              num_workers=num_workers)
+                              num_workers=num_workers, drop_last=drop_last)
         return data  # any iterable of batches
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
@@ -298,7 +297,7 @@ class Model:
         assert self._optimizer is not None and self._loss is not None, \
             'call prepare(optimizer, loss) before fit'
         train_loader = self._to_loader(train_data, batch_size, shuffle,
-                                       num_workers)
+                                       num_workers, drop_last=drop_last)
         eval_loader = self._to_loader(eval_data, batch_size, False,
                                       num_workers)
         steps = len(train_loader) if hasattr(train_loader, '__len__') \

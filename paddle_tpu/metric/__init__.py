@@ -165,11 +165,10 @@ class Auc(Metric):
             scores = preds.reshape(-1)
         buckets = np.clip((scores * self.num_thresholds).astype(int),
                           0, self.num_thresholds)
-        for b, l in zip(buckets, labels):
-            if l:
-                self._stat_pos[b] += 1
-            else:
-                self._stat_neg[b] += 1
+        pos = labels.astype(bool)
+        n = self.num_thresholds + 1
+        self._stat_pos += np.bincount(buckets[pos], minlength=n)
+        self._stat_neg += np.bincount(buckets[~pos], minlength=n)
 
     def reset(self):
         self._stat_pos = np.zeros(self.num_thresholds + 1, dtype=np.int64)

@@ -1,0 +1,7 @@
+"""Flagship NLP model zoo (the reference keeps these in fleet examples;
+here they are first-class because they drive the distributed benches)."""
+from .gpt import (  # noqa: F401
+    GPTConfig, GPT, GPTForCausalLM, gpt_tiny, gpt_small, gpt_1p3b)
+
+__all__ = ['GPTConfig', 'GPT', 'GPTForCausalLM', 'gpt_tiny', 'gpt_small',
+           'gpt_1p3b']

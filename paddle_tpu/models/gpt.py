@@ -1,0 +1,216 @@
+"""GPT-family causal-transformer — the flagship distributed model.
+
+Reference analogue: the fleet GPT examples driving
+python/paddle/distributed/fleet/meta_parallel (mp_layers/pp_layers); the
+reference scales it with NCCL TP/PP process groups.  TPU-native design:
+
+- built on fleet.meta_parallel TP layers (ColumnParallelLinear /
+  RowParallelLinear / VocabParallelEmbedding) whose PartitionSpecs put
+  matmul shards on the `tp` mesh axis — XLA inserts the psum/all-gather
+  collectives over ICI;
+- sequence-parallel hook: activations between blocks carry a
+  P(dp, sp, None) sharding constraint, so long sequences split over the
+  `sp` axis (ring attention upgrades this path later);
+- eager single-chip: the same code runs unsharded (maybe_shard is the
+  identity outside a mesh trace).
+
+Everything under one `jax.jit` train step: no Python control flow
+depends on data; dropout threads PRNG keys via the functional-key scope.
+"""
+import math
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from ..core.tensor import Tensor
+from ..distributed.fleet.meta_parallel import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+from ..parallel.api import maybe_shard
+from ..tensor import creation, linalg, manipulation, math as pmath
+
+__all__ = ['GPTConfig', 'GPT', 'GPTForCausalLM', 'gpt_tiny', 'gpt_small',
+           'gpt_1p3b']
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, max_seq_len=1024, intermediate_size=None,
+                 dropout=0.1, layer_norm_epsilon=1e-5,
+                 sequence_parallel=False, initializer_range=0.02):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.max_seq_len = max_seq_len
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.dropout = dropout
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.sequence_parallel = sequence_parallel
+        self.initializer_range = initializer_range
+
+
+def _act_spec(cfg):
+    """Sharding of [B, T, H] activations between blocks."""
+    return ('dp', 'sp' if cfg.sequence_parallel else None, None)
+
+
+class CausalSelfAttention(nn.Layer):
+    """Multi-head causal attention; qkv column-parallel, output
+    row-parallel (Megatron split — one psum per block on TPU ICI)."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        assert cfg.hidden_size % cfg.num_heads == 0
+        self.n_head = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        self.qkv = ColumnParallelLinear(cfg.hidden_size,
+                                        3 * cfg.hidden_size,
+                                        gather_output=False)
+        self.proj = RowParallelLinear(cfg.hidden_size, cfg.hidden_size,
+                                      input_is_parallel=True)
+        self.attn_drop = nn.Dropout(cfg.dropout)
+        self.resid_drop = nn.Dropout(cfg.dropout)
+        self.cfg = cfg
+
+    def forward(self, x):
+        B, T, H = x.shape
+        # attention needs the full sequence: un-shard T, shard heads on tp
+        qkv = self.qkv(x)                       # [B, T, 3H/tp]
+        qkv = maybe_shard(qkv, ('dp', None, 'tp'))
+        qkv = manipulation.reshape(qkv, [B, T, 3, self.n_head,
+                                         self.head_dim])
+        q = manipulation.transpose(qkv[:, :, 0], [0, 2, 1, 3])
+        k = manipulation.transpose(qkv[:, :, 1], [0, 2, 1, 3])
+        v = manipulation.transpose(qkv[:, :, 2], [0, 2, 1, 3])
+        q = maybe_shard(q, ('dp', 'tp', None, None))
+        k = maybe_shard(k, ('dp', 'tp', None, None))
+        v = maybe_shard(v, ('dp', 'tp', None, None))
+        att = linalg.matmul(q, k, transpose_y=True)     # [B, nh, T, T]
+        att = att * (1.0 / math.sqrt(self.head_dim))
+        mask = creation.tril(creation.ones([T, T], dtype=att.dtype))
+        att = att - (1.0 - mask) * 1e9
+        att = F.softmax(att, axis=-1)
+        att = self.attn_drop(att)
+        y = linalg.matmul(att, v)                        # [B, nh, T, hd]
+        y = manipulation.transpose(y, [0, 2, 1, 3])
+        y = manipulation.reshape(y, [B, T, H])
+        y = maybe_shard(y, ('dp', None, 'tp'))
+        y = self.proj(y)                                 # psum over tp
+        y = self.resid_drop(y)
+        return maybe_shard(y, _act_spec(self.cfg))
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.fc = ColumnParallelLinear(cfg.hidden_size,
+                                       cfg.intermediate_size,
+                                       gather_output=False)
+        self.proj = RowParallelLinear(cfg.intermediate_size,
+                                      cfg.hidden_size,
+                                      input_is_parallel=True)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.cfg = cfg
+
+    def forward(self, x):
+        h = self.fc(x)
+        h = maybe_shard(h, ('dp', None, 'tp'))
+        h = F.gelu(h, approximate=True)
+        h = self.proj(h)
+        h = self.drop(h)
+        return maybe_shard(h, _act_spec(self.cfg))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.hidden_size,
+                                epsilon=cfg.layer_norm_epsilon)
+        self.attn = CausalSelfAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size,
+                                epsilon=cfg.layer_norm_epsilon)
+        self.mlp = GPTMLP(cfg)
+        self.cfg = cfg
+
+    def forward(self, x):
+        x = x + self.attn(self.ln1(x))
+        x = x + self.mlp(self.ln2(x))
+        return maybe_shard(x, _act_spec(self.cfg))
+
+
+class GPT(nn.Layer):
+    """Backbone: embeddings + blocks + final LN → hidden states."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.wte = VocabParallelEmbedding(config.vocab_size,
+                                          config.hidden_size)
+        self.wpe = nn.Embedding(config.max_seq_len, config.hidden_size)
+        self.drop = nn.Dropout(config.dropout)
+        self.blocks = nn.LayerList([GPTBlock(config)
+                                    for _ in range(config.num_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids):
+        B, T = input_ids.shape
+        pos = creation.arange(0, T, dtype='int64')
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        x = maybe_shard(x, _act_spec(self.config))
+        for blk in self.blocks:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    """GPT + tied LM head; forward returns logits, loss() the LM loss."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.gpt = GPT(config)
+        self.config = config
+
+    def forward(self, input_ids):
+        h = self.gpt(input_ids)
+        # tied head: h @ wte.T — logits [B, T, V/tp-sharded]
+        logits = linalg.matmul(h, self.gpt.wte.weight, transpose_y=True)
+        return maybe_shard(logits, ('dp', None, 'tp'))
+
+    def loss(self, logits, labels):
+        """Causal LM loss: shift-by-one cross entropy."""
+        B, T, V = logits.shape
+        lg = manipulation.reshape(logits[:, :-1, :], [B * (T - 1), V])
+        lb = manipulation.reshape(labels[:, 1:], [B * (T - 1)])
+        return F.cross_entropy(lg, lb)
+
+
+def gpt_tiny(**kw):
+    """4-layer toy config for tests/dryruns."""
+    kw.setdefault('vocab_size', 128)
+    kw.setdefault('hidden_size', 64)
+    kw.setdefault('num_layers', 4)
+    kw.setdefault('num_heads', 4)
+    kw.setdefault('max_seq_len', 128)
+    kw.setdefault('dropout', 0.0)
+    return GPTForCausalLM(GPTConfig(**kw))
+
+
+def gpt_small(**kw):
+    """GPT-2 small (117M)."""
+    kw.setdefault('hidden_size', 768)
+    kw.setdefault('num_layers', 12)
+    kw.setdefault('num_heads', 12)
+    return GPTForCausalLM(GPTConfig(**kw))
+
+
+def gpt_1p3b(**kw):
+    """GPT-3 XL-ish 1.3B — the hybrid-parallel benchmark config
+    (SURVEY.md §3 item 4)."""
+    kw.setdefault('hidden_size', 2048)
+    kw.setdefault('num_layers', 24)
+    kw.setdefault('num_heads', 16)
+    kw.setdefault('max_seq_len', 2048)
+    return GPTForCausalLM(GPTConfig(**kw))

@@ -129,10 +129,15 @@ class Optimizer:
         import jax
         return jax.tree_util.tree_map(self._create_state, params)
 
-    def apply_gradients(self, params, grads, state, step):
-        """Pure pytree update; call inside jit. Returns (params', state')."""
+    def apply_gradients(self, params, grads, state, step, lr=None):
+        """Pure pytree update; call inside jit. Returns (params', state').
+
+        `lr` (traced scalar) overrides the schedule — compiled callers
+        pass the host-side get_lr() so scheduler/set_lr state changes
+        reach the step without recompiling."""
         import jax
-        lr = self._lr_value(step)
+        if lr is None:
+            lr = self._lr_value(step)
         paths_p, treedef = jax.tree_util.tree_flatten_with_path(params)
         leaves_p = [v for _, v in paths_p]
         names = ['/'.join(str(getattr(k, 'key', getattr(k, 'idx', k)))

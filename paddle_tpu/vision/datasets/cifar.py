@@ -39,11 +39,15 @@ class Cifar10(Dataset):
             self.data = [(images[i].transpose(2, 0, 1).reshape(-1),
                           int(labels[i])) for i in range(n)]
 
-    def _load_tar(self, path, mode):
+    def _member_filter(self, name, mode):
         want = 'data_batch' if mode == 'train' else 'test_batch'
+        return want in name
+
+    def _load_tar(self, path, mode):
         out = []
         with tarfile.open(path, mode='r') as tf:
-            names = [n for n in tf.getnames() if want in n]
+            names = [n for n in tf.getnames()
+                     if self._member_filter(n, mode)]
             for name in sorted(names):
                 batch = pickle.load(tf.extractfile(name), encoding='bytes')
                 data = batch[b'data'] if b'data' in batch else batch['data']
@@ -73,19 +77,5 @@ class Cifar100(Cifar10):
     _SYNTH_SEED = 221
     _LABEL_KEYS = (b'fine_labels', 'fine_labels')
 
-    def _load_tar(self, path, mode):
-        out = []
-        with tarfile.open(path, mode='r') as tf:
-            names = [n for n in tf.getnames()
-                     if n.endswith(mode)]  # files named 'train' / 'test'
-            for name in sorted(names):
-                batch = pickle.load(tf.extractfile(name), encoding='bytes')
-                data = batch[b'data'] if b'data' in batch else batch['data']
-                labels = None
-                for k in self._LABEL_KEYS:
-                    if k in batch:
-                        labels = batch[k]
-                        break
-                for i in range(len(labels)):
-                    out.append((data[i], int(labels[i])))
-        return out
+    def _member_filter(self, name, mode):
+        return name.endswith(mode)  # files named 'train' / 'test'

@@ -2,8 +2,8 @@
 
 Reference analogue: python/paddle/vision/transforms/transforms.py:38
 (same __all__).  Each transform is a callable over numpy HWC images;
-randomness uses a module-level numpy Generator seeded by paddle_tpu.seed
-via core.rng.
+randomness uses stdlib `random`, which paddle_tpu.seed reseeds so
+augmentation pipelines are reproducible from the framework seed.
 """
 import numbers
 import random

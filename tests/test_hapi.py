@@ -190,3 +190,24 @@ def test_prepare_resets_compiled_state():
     model.train_batch([xb], [yb])
     after = np.asarray(model._get_fstate()['params']['0.weight'])
     np.testing.assert_allclose(before, after)  # lr=0 ⇒ unchanged
+
+
+def test_set_lr_reaches_compiled_step_without_recompile():
+    model = make_model(lr=0.5)
+    ds = BlobDataset(64)
+    xb = np.stack([ds[i][0] for i in range(32)])
+    yb = np.stack([ds[i][1] for i in range(32)])
+    model.train_batch([xb], [yb])
+    n_compiled = len(model._train_step_cache)
+    model._optimizer.set_lr(0.0)
+    before = np.asarray(model._get_fstate()['params']['0.weight']).copy()
+    model.train_batch([xb], [yb])
+    after = np.asarray(model._get_fstate()['params']['0.weight'])
+    np.testing.assert_allclose(before, after)  # applied lr was 0
+    assert len(model._train_step_cache) == n_compiled  # no retrace
+
+
+def test_evaluate_verbose_progbar_no_crash(capsys):
+    model = make_model()
+    model.evaluate(BlobDataset(64), batch_size=8, verbose=2, log_freq=1)
+    assert 'eval' in capsys.readouterr().out.lower()

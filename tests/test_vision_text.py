@@ -277,3 +277,30 @@ def test_categorical():
     paddle.seed(0)
     s = np.asarray(d.sample([2000]).value)
     assert abs((s == 2).mean() - 0.5) < 0.1
+
+
+def test_seed_reproduces_sampling_and_transforms():
+    paddle.seed(42)
+    a = np.asarray(Normal(0.0, 1.0).sample([4]).value)
+    flip_a = transforms.RandomHorizontalFlip(0.5)
+    img = np.arange(48, dtype=np.uint8).reshape(4, 4, 3)
+    seq_a = [flip_a(img).tobytes() for _ in range(8)]
+
+    paddle.seed(42)
+    b = np.asarray(Normal(0.0, 1.0).sample([4]).value)
+    seq_b = [flip_a(img).tobytes() for _ in range(8)]
+    np.testing.assert_array_equal(a, b)
+    assert seq_a == seq_b
+
+
+def test_auc_vectorized_matches_loop():
+    rng = np.random.RandomState(3)
+    scores = rng.rand(500)
+    labels = (scores + rng.randn(500) * 0.3 > 0.5).astype(int)
+    m = Auc(num_thresholds=255)
+    m.update(scores, labels)
+    # brute-force pairwise AUC
+    pos, neg = scores[labels == 1], scores[labels == 0]
+    brute = (pos[:, None] > neg[None, :]).mean() + \
+        0.5 * (pos[:, None] == neg[None, :]).mean()
+    assert abs(m.accumulate() - brute) < 0.02
