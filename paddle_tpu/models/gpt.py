@@ -73,6 +73,15 @@ class CausalSelfAttention(nn.Layer):
         self.resid_drop = nn.Dropout(cfg.dropout)
         self.cfg = cfg
 
+    def _use_flash(self, T):
+        """Pallas flash attention: single-chip path only for now (under a
+        mesh the einsum path lets GSPMD partition attention; shard_map
+        flash integration is the ring-attention upgrade).  Dropout only
+        blocks it while actually active (training mode)."""
+        from ..ops.flash_attention import can_use_pallas
+        dropout_active = self.training and self.attn_drop.p > 0.0
+        return not dropout_active and can_use_pallas(T, T, self.head_dim)
+
     def forward(self, x):
         B, T, H = x.shape
         # attention needs the full sequence: un-shard T, shard heads on tp
@@ -83,16 +92,28 @@ class CausalSelfAttention(nn.Layer):
         q = manipulation.transpose(qkv[:, :, 0], [0, 2, 1, 3])
         k = manipulation.transpose(qkv[:, :, 1], [0, 2, 1, 3])
         v = manipulation.transpose(qkv[:, :, 2], [0, 2, 1, 3])
-        q = maybe_shard(q, ('dp', 'tp', None, None))
-        k = maybe_shard(k, ('dp', 'tp', None, None))
-        v = maybe_shard(v, ('dp', 'tp', None, None))
-        att = linalg.matmul(q, k, transpose_y=True)     # [B, nh, T, T]
-        att = att * (1.0 / math.sqrt(self.head_dim))
-        mask = creation.tril(creation.ones([T, T], dtype=att.dtype))
-        att = att - (1.0 - mask) * 1e9
-        att = F.softmax(att, axis=-1)
-        att = self.attn_drop(att)
-        y = linalg.matmul(att, v)                        # [B, nh, T, hd]
+        if self._use_flash(T):
+            from ..ops import flash_attention
+            from ..core.dispatch import apply
+            nh, hd = self.n_head, self.head_dim
+            q = manipulation.reshape(q, [B * nh, T, hd])
+            k = manipulation.reshape(k, [B * nh, T, hd])
+            v = manipulation.reshape(v, [B * nh, T, hd])
+            y = apply(lambda qv, kv, vv: flash_attention(
+                qv, kv, vv, causal=True), q, k, v,
+                op_name='flash_attention')
+            y = manipulation.reshape(y, [B, nh, T, hd])
+        else:
+            q = maybe_shard(q, ('dp', 'tp', None, None))
+            k = maybe_shard(k, ('dp', 'tp', None, None))
+            v = maybe_shard(v, ('dp', 'tp', None, None))
+            att = linalg.matmul(q, k, transpose_y=True)  # [B, nh, T, T]
+            att = att * (1.0 / math.sqrt(self.head_dim))
+            mask = creation.tril(creation.ones([T, T], dtype=att.dtype))
+            att = att - (1.0 - mask) * 1e9
+            att = F.softmax(att, axis=-1)
+            att = self.attn_drop(att)
+            y = linalg.matmul(att, v)                    # [B, nh, T, hd]
         y = manipulation.transpose(y, [0, 2, 1, 3])
         y = manipulation.reshape(y, [B, T, H])
         y = maybe_shard(y, ('dp', None, 'tp'))

@@ -47,8 +47,12 @@ def log_sigmoid(x, name=None):
 
 
 def softmax(x, axis=-1, dtype=None, name=None):
-    return apply(lambda v: jax.nn.softmax(v, axis=axis), wrap(x),
-                 op_name='softmax')
+    def fn(v):
+        if axis in (-1, v.ndim - 1):
+            from ...ops import fused_softmax
+            return fused_softmax(v)  # Pallas on TPU, jnp fallback
+        return jax.nn.softmax(v, axis=axis)
+    return apply(fn, wrap(x), op_name='softmax')
 
 
 def log_softmax(x, axis=-1, dtype=None, name=None):

@@ -98,6 +98,10 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
     axes = tuple(range(x.ndim - n_axes, x.ndim))
 
     def fn(v, *wb):
+        if n_axes == 1 and weight is not None and bias is not None:
+            # Pallas-fused on TPU (falls back to jnp off-TPU / odd shapes)
+            from ...ops import fused_layer_norm
+            return fused_layer_norm(v, wb[0], wb[1], eps=epsilon)
         mean = jnp.mean(v, axis=axes, keepdims=True)
         var = jnp.var(v, axis=axes, keepdims=True)
         out = (v - mean) / jnp.sqrt(var + epsilon)

@@ -1,0 +1,21 @@
+"""Single source of truth for "may this op take its Pallas path?".
+
+The kernels run single-chip only for now: under a mesh the GSPMD
+partitioner owns the op (shard_map + ring-attention integration is the
+multi-chip upgrade), and off-TPU the jnp references run.
+"""
+import jax
+
+
+def pallas_backend_ok():
+    from ..distributed import env as _env
+    return jax.default_backend() == 'tpu' and _env.get_mesh() is None
+
+
+def pick_block_rows(n_rows, block_rows):
+    """Largest power-of-two divisor of n_rows up to block_rows, or None
+    when no usable block exists (caller falls back)."""
+    br = block_rows
+    while br > 1 and n_rows % br != 0:
+        br //= 2
+    return br if (n_rows % br == 0 and br >= 8) else None
