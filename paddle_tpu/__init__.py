@@ -39,6 +39,10 @@ from . import text  # noqa: F401
 from . import metric  # noqa: F401
 from . import distribution  # noqa: F401
 from . import hapi  # noqa: F401
+from . import ops  # noqa: F401
+from . import models  # noqa: F401
+from . import profiler  # noqa: F401
+from . import utils  # noqa: F401
 from .hapi import Model, summary, flops  # noqa: F401
 from .hapi import callbacks  # noqa: F401
 

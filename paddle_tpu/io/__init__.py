@@ -327,7 +327,8 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=False, timeout=0, worker_init_fn=None,
-                 persistent_workers=False, to_tensor=True):
+                 persistent_workers=False, to_tensor=True,
+                 use_native_loader=True):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
@@ -335,6 +336,10 @@ class DataLoader:
         self.prefetch_factor = max(2, int(prefetch_factor))
         self.worker_init_fn = worker_init_fn
         self.to_tensor = to_tensor
+        # native ring serializes batches: arrays travel zero-pickle, but
+        # exotic batch objects must be picklable — set False to keep the
+        # in-process threaded path for those
+        self.use_native_loader = use_native_loader
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -455,8 +460,75 @@ class DataLoader:
             yield self._wrap(item)
             next_pos += 1
 
+    def _iter_native(self):
+        """Workers pack collated batches into the C++ in-order ring
+        (paddle_tpu.io.native); the ring enforces sequencing and
+        backpressure in native code — no Python-side reorder dict.
+        Payloads come back as contiguous 64B-aligned buffers, which
+        jax.device_put consumes without re-gathering."""
+        from . import native as _native
+        indices_list = list(self.batch_sampler)
+        n_batches = len(indices_list)
+        ring = _native.NativeRing(self.num_workers * self.prefetch_factor)
+        next_seq = [0]
+        seq_lock = threading.Lock()
+
+        def worker(wid):
+            try:
+                _worker_info.info = _WorkerInfo(wid, self.num_workers,
+                                                self.dataset)
+                if self.worker_init_fn is not None:
+                    self.worker_init_fn(wid)
+            except Exception as e:
+                payload = _native.pack_error(e)
+                while True:
+                    with seq_lock:
+                        if next_seq[0] >= n_batches:
+                            return
+                        seq = next_seq[0]
+                        next_seq[0] += 1
+                    if not ring.push(seq, payload):
+                        return
+            while True:
+                with seq_lock:
+                    if next_seq[0] >= n_batches:
+                        return
+                    seq = next_seq[0]
+                    next_seq[0] += 1
+                try:
+                    payload = _native.pack_batch(
+                        self._fetch(indices_list[seq]))
+                except Exception as e:
+                    payload = _native.pack_error(e)
+                try:
+                    if not ring.push(seq, payload):
+                        return
+                except Exception:
+                    # a claimed-but-unfilled seq would hang the consumer
+                    # forever; closing the ring surfaces the failure
+                    ring.close()
+                    raise
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(n_batches):
+                payload = ring.pop()
+                if payload is None:
+                    break
+                item = _native.unpack_batch(payload)
+                if isinstance(item, Exception):
+                    raise item
+                yield self._wrap(item)
+        finally:
+            ring.close()
+
     def __iter__(self):
         if self.num_workers > 0 and not self._iterable \
                 and self.batch_sampler is not None:
+            from . import native as _native
+            if self.use_native_loader and _native.available():
+                return self._iter_native()
             return self._iter_threaded()
         return self._iter_sync()

@@ -73,6 +73,19 @@ class CausalSelfAttention(nn.Layer):
         self.resid_drop = nn.Dropout(cfg.dropout)
         self.cfg = cfg
 
+    def _ring_mesh(self):
+        """The active mesh, iff sequence-parallel ring attention should
+        run: sp axis > 1, config opted in, and dropout inactive."""
+        if not self.cfg.sequence_parallel:
+            return None
+        if self.training and self.attn_drop.p > 0.0:
+            return None
+        from ..distributed import env as _env
+        mesh = _env.get_mesh()
+        if mesh is not None and dict(mesh.shape).get('sp', 1) > 1:
+            return mesh
+        return None
+
     def _use_flash(self, T):
         """Pallas flash attention: single-chip path only for now (under a
         mesh the einsum path lets GSPMD partition attention; shard_map
@@ -92,7 +105,21 @@ class CausalSelfAttention(nn.Layer):
         q = manipulation.transpose(qkv[:, :, 0], [0, 2, 1, 3])
         k = manipulation.transpose(qkv[:, :, 1], [0, 2, 1, 3])
         v = manipulation.transpose(qkv[:, :, 2], [0, 2, 1, 3])
-        if self._use_flash(T):
+        ring_mesh = self._ring_mesh()
+        if ring_mesh is not None:
+            # sequence parallel: K/V rotate around the sp ICI ring, each
+            # chip holds T/sp of the sequence (SURVEY.md §2 item 35)
+            from ..ops.ring_attention import ring_attention_spmd
+            from ..core.dispatch import apply
+            nh, hd = self.n_head, self.head_dim
+            q = manipulation.reshape(q, [B * nh, T, hd])
+            k = manipulation.reshape(k, [B * nh, T, hd])
+            v = manipulation.reshape(v, [B * nh, T, hd])
+            y = apply(lambda qv, kv, vv: ring_attention_spmd(
+                qv, kv, vv, ring_mesh, causal=True), q, k, v,
+                op_name='ring_attention')
+            y = manipulation.reshape(y, [B, nh, T, hd])
+        elif self._use_flash(T):
             from ..ops import flash_attention
             from ..core.dispatch import apply
             nh, hd = self.n_head, self.head_dim

@@ -1,0 +1,103 @@
+"""Ring attention — causal attention over sequence-sharded K/V.
+
+Reference analogue: none in-tree (the reference caps sequence length per
+GPU); the brief requires long-sequence support.  Design follows the
+ring-attention recipe (Liu et al.; see PAPERS.md): each `sp` shard holds
+a T/sp slice of Q/K/V, K/V blocks rotate around the ring via
+`lax.ppermute` (XLA schedules the transfers over ICI so step i+1's K/V
+moves while step i computes), and a streaming online-softmax merges the
+per-block partials — the full [T, T] score matrix never exists and each
+chip's attention memory is O((T/sp)^2).
+
+The step body is wrapped in jax.checkpoint so the backward pass
+recomputes per-block scores instead of storing every rotated K/V.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ['ring_attention', 'ring_attention_spmd']
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_chunk, k_chunk, t_local, causal):
+    """Partial scores of local q against one rotated K/V block.
+
+    q_chunk/k_chunk are ring positions of the chunks (traced scalars).
+    Returns (m, l, o_unnormalized) for online-softmax merging."""
+    s = jnp.einsum('bqd,bkd->bqk', q.astype(jnp.float32),
+                   k.astype(jnp.float32))
+    if causal:
+        rows = jax.lax.broadcasted_iota(
+            jnp.int32, s.shape[-2:], 0) + q_chunk * t_local
+        cols = jax.lax.broadcasted_iota(
+            jnp.int32, s.shape[-2:], 1) + k_chunk * t_local
+        s = jnp.where(rows[None] >= cols[None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    # fully-masked rows: exp(NEG_INF - NEG_INF) would be 1 — clamp m
+    m = jnp.maximum(m, -1e29)
+    p = jnp.exp(s - m)
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum('bqk,bkd->bqd', p, v.astype(jnp.float32))
+    return m, l, o
+
+
+def ring_attention(q, k, v, axis_name, causal=True, scale=None):
+    """Attention inside shard_map: q/k/v are the LOCAL [B*H, T/sp, D]
+    shards; K/V rotate around `axis_name`.  Returns local output shard.
+    """
+    sp = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    qs = q.astype(jnp.float32) * scale
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def merge(acc, part):
+        m_acc, l_acc, o_acc = acc
+        m, l, o = part
+        m_new = jnp.maximum(m_acc, m)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m - m_new)
+        return (m_new, l_acc * alpha + l * beta,
+                o_acc * alpha + o * beta)
+
+    @jax.checkpoint
+    def step(carry, i):
+        m_acc, l_acc, o_acc, kb, vb = carry
+        # rotate first (step i holds a block i hops from home); the last
+        # block is consumed without a trailing, wasted ppermute
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        k_chunk = (rank - i) % sp
+        part = _block_attend(qs, kb, vb, rank, k_chunk, t_local, causal)
+        m_acc, l_acc, o_acc = merge((m_acc, l_acc, o_acc), part)
+        return (m_acc, l_acc, o_acc, kb, vb), None
+
+    # step 0: the home block, no rotation needed
+    acc = _block_attend(qs, k, v, rank, rank, t_local, causal)
+    (m_acc, l_acc, o_acc, _, _), _ = jax.lax.scan(
+        step, acc + (k, v), jnp.arange(1, sp))
+    out = o_acc / jnp.maximum(l_acc, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention_spmd(q, k, v, mesh, causal=True,
+                        batch_axes=('dp', 'tp'), seq_axis='sp'):
+    """shard_map wrapper: q/k/v are GLOBAL [B*H, T, D] arrays (traced
+    under jit on `mesh`); heads/batch split over `batch_axes`, sequence
+    over `seq_axis`; ring rotation rides the `sp` ICI ring."""
+    axes = tuple(a for a in batch_axes if a in mesh.shape)
+    spec = P(axes if len(axes) > 1 else (axes[0] if axes else None),
+             seq_axis, None)
+    fn = functools.partial(ring_attention, axis_name=seq_axis,
+                           causal=causal)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)

@@ -1,0 +1,135 @@
+"""Profiler (reference: python/paddle/fluid/profiler.py + platform/
+profiler).  TPU-native: wraps jax.profiler traces (viewable in
+TensorBoard/XProf) and adds host-side step timers — the reference's
+nvprof hooks have no TPU meaning.
+"""
+import contextlib
+import time
+
+import jax
+
+__all__ = ['Profiler', 'start_profiler', 'stop_profiler', 'profiler',
+           'StepTimer', 'RecordEvent']
+
+_active_logdir = None
+
+
+def start_profiler(state=None, tracer_option=None,
+                   logdir='/tmp/paddle_tpu_profile'):
+    """Begin a device+host trace (reference: fluid.profiler.start_profiler).
+    View with tensorboard --logdir <logdir>."""
+    global _active_logdir
+    jax.profiler.start_trace(logdir)
+    _active_logdir = logdir
+    return logdir
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    global _active_logdir
+    jax.profiler.stop_trace()
+    out = _active_logdir
+    _active_logdir = None
+    return out
+
+
+@contextlib.contextmanager
+def profiler(state=None, sorted_key=None,
+             logdir='/tmp/paddle_tpu_profile'):
+    start_profiler(state, logdir=logdir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key)
+
+
+class RecordEvent:
+    """Named host-side trace annotation (reference: RecordEvent);
+    shows up in the XProf timeline via jax.profiler.TraceAnnotation."""
+
+    def __init__(self, name):
+        self.name = name
+        self._ctx = None
+
+    def __enter__(self):
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ctx.__exit__(*exc)
+        self._ctx = None
+
+
+class StepTimer:
+    """Rolling step-time statistics for training loops.
+
+    Blocks on `sync` targets (device arrays) so timings reflect device
+    completion, not dispatch."""
+
+    def __init__(self, window=50):
+        self.window = window
+        self._times = []
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self, sync=None):
+        if sync is not None:
+            jax.block_until_ready(sync)
+        dt = time.perf_counter() - self._t0
+        self._times.append(dt)
+        if len(self._times) > self.window:
+            self._times.pop(0)
+        return dt
+
+    @property
+    def mean_ms(self):
+        if not self._times:
+            return 0.0
+        return sum(self._times) / len(self._times) * 1000.0
+
+    def summary(self):
+        if not self._times:
+            return {}
+        ts = sorted(self._times)
+        n = len(ts)
+        return {'mean_ms': self.mean_ms,
+                'p50_ms': ts[n // 2] * 1000.0,
+                'p90_ms': ts[min(n - 1, int(n * 0.9))] * 1000.0,
+                'max_ms': ts[-1] * 1000.0,
+                'steps': n}
+
+
+class Profiler:
+    """paddle.profiler.Profiler-style context (2.x API shape)."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 logdir='/tmp/paddle_tpu_profile'):
+        self.logdir = logdir
+        self.timer = StepTimer()
+        self._running = False
+
+    def start(self):
+        start_profiler(logdir=self.logdir)
+        self._running = True
+        self.timer.start()
+
+    def stop(self):
+        if self._running:
+            stop_profiler()
+            self._running = False
+
+    def step(self, sync=None):
+        self.timer.stop(sync)
+        self.timer.start()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def summary(self, *a, **k):
+        return self.timer.summary()

@@ -1,0 +1,129 @@
+"""Training-robustness utilities (SURVEY.md §2 item 39; reference:
+fleet launch_utils watchdogs + debug tooling).
+
+- NaN/Inf detection: `debug_nans` (XLA-level trap) and `check_numerics`
+  (explicit guard for compiled steps).
+- Watchdog: wall-clock heartbeat monitor for hung steps (a stuck ICI
+  collective or input pipeline shows up as a missed heartbeat).
+- try_load_latest / save_step: step-level checkpoint/resume helpers used
+  with paddle_tpu.save/load for elastic restarts.
+"""
+import os
+import threading
+import time
+import warnings
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ['debug_nans', 'check_numerics', 'Watchdog', 'save_step',
+           'try_load_latest']
+
+
+def debug_nans(enable=True):
+    """XLA-level NaN trap: any op producing NaN raises immediately
+    (reference analogue: FLAGS_check_nan_inf)."""
+    jax.config.update('jax_debug_nans', bool(enable))
+
+
+def check_numerics(tree, name='tensors'):
+    """Host-side finite check over a pytree of arrays; raises
+    FloatingPointError naming the first offending leaf."""
+    from ..core.tensor import Tensor
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(
+        jax.tree_util.tree_map(
+            lambda v: v.value if isinstance(v, Tensor) else v, tree))[0]
+    for path, leaf in leaves_with_paths:
+        arr = np.asarray(leaf)
+        if arr.dtype.kind in 'fc' and not np.isfinite(arr).all():
+            where = '/'.join(str(getattr(k, 'key', getattr(k, 'idx', k)))
+                             for k in path)
+            raise FloatingPointError(
+                f'non-finite values in {name}[{where}]')
+    return True
+
+
+class Watchdog:
+    """Fires `on_stall` if `beat()` is not called within `timeout_s`.
+
+    Use around training loops: a hung collective, a wedged input
+    pipeline or a dead worker surfaces as a stall instead of silence.
+    """
+
+    def __init__(self, timeout_s=300.0, on_stall=None, name='train'):
+        self.timeout_s = timeout_s
+        self.on_stall = on_stall
+        self.name = name
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread = None
+        self.stalled = False
+
+    def _run(self):
+        while not self._stop.wait(min(self.timeout_s / 4, 10.0)):
+            if time.monotonic() - self._last > self.timeout_s:
+                self.stalled = True
+                msg = (f'watchdog[{self.name}]: no heartbeat for '
+                       f'{self.timeout_s:.0f}s')
+                if self.on_stall is not None:
+                    self.on_stall(msg)
+                else:
+                    warnings.warn(msg)
+                self._last = time.monotonic()  # don't spam
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def beat(self):
+        self._last = time.monotonic()
+        self.stalled = False
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def save_step(state_dict, directory, step, keep=3, prefix='ckpt'):
+    """Write `<dir>/<prefix>_<step>.pdparams` and prune old ones."""
+    from ..framework.io import save
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f'{prefix}_{step}.pdparams')
+    save(state_dict, path)
+    # prune
+    ckpts = sorted(
+        (f for f in os.listdir(directory)
+         if f.startswith(prefix + '_') and f.endswith('.pdparams')),
+        key=lambda f: int(f[len(prefix) + 1:-len('.pdparams')]))
+    for old in ckpts[:-keep]:
+        try:
+            os.remove(os.path.join(directory, old))
+        except OSError:
+            pass
+    return path
+
+
+def try_load_latest(directory, prefix='ckpt'):
+    """Return (state_dict, step) for the newest checkpoint, or
+    (None, -1) when none exists — elastic-restart entry point."""
+    from ..framework.io import load
+    if not os.path.isdir(directory):
+        return None, -1
+    ckpts = sorted(
+        (f for f in os.listdir(directory)
+         if f.startswith(prefix + '_') and f.endswith('.pdparams')),
+        key=lambda f: int(f[len(prefix) + 1:-len('.pdparams')]))
+    if not ckpts:
+        return None, -1
+    newest = ckpts[-1]
+    step = int(newest[len(prefix) + 1:-len('.pdparams')])
+    return load(os.path.join(directory, newest)), step
