@@ -513,10 +513,14 @@ class DataLoader:
         for t in threads:
             t.start()
         try:
-            for _ in range(n_batches):
+            for i in range(n_batches):
                 payload = ring.pop()
                 if payload is None:
-                    break
+                    # a worker closed the ring mid-epoch (push failure);
+                    # a silent short epoch would corrupt training
+                    raise RuntimeError(
+                        f'native loader ring closed after {i}/'
+                        f'{n_batches} batches (worker failure)')
                 item = _native.unpack_batch(payload)
                 if isinstance(item, Exception):
                     raise item

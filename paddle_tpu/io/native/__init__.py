@@ -72,14 +72,20 @@ def available():
 # [dtype_len u32][dtype utf8][ndim u32][shape i64*ndim][nbytes i64][data]
 
 def pack_error(exc):
-    """Exceptions cross the ring as a picklable wrapper carrying the
-    original type name + traceback (original exception objects may hold
-    unpicklable state or multi-arg __init__s that explode at loads)."""
+    """Exceptions cross the ring pickled.  The original object is kept
+    when it survives a pickle round-trip (so `except FileNotFoundError`
+    style handlers behave identically to the threaded path); otherwise a
+    RuntimeError wrapper carries type name + traceback."""
     import traceback
-    msg = '{}: {}\n{}'.format(type(exc).__name__, exc,
-                               traceback.format_exc())
-    return b'\x01' + pickle.dumps(RuntimeError(msg),
-                                   protocol=pickle.HIGHEST_PROTOCOL)
+    try:
+        payload = pickle.dumps(exc, protocol=pickle.HIGHEST_PROTOCOL)
+        pickle.loads(payload)  # multi-arg __init__s explode here, not
+        return b'\x01' + payload  # in the consumer
+    except Exception:
+        msg = '{}: {}\n{}'.format(type(exc).__name__, exc,
+                                   traceback.format_exc())
+        return b'\x01' + pickle.dumps(RuntimeError(msg),
+                                       protocol=pickle.HIGHEST_PROTOCOL)
 
 
 def pack_batch(batch):
@@ -112,8 +118,9 @@ def pack_batch(batch):
 
 
 def unpack_batch(buf):
-    if buf[:1] == b'\x01':
-        return pickle.loads(buf[1:])
+    # buf: bytes or a uint8 numpy view (pop() returns the latter)
+    if int(buf[0]) == 1:
+        return pickle.loads(bytes(memoryview(buf)[1:]))
     off = 1
     (n,) = struct.unpack_from('<I', buf, off)
     off += 4
@@ -121,7 +128,7 @@ def unpack_batch(buf):
     for _ in range(n):
         (dl,) = struct.unpack_from('<I', buf, off)
         off += 4
-        dt = np.dtype(buf[off:off + dl].decode())
+        dt = np.dtype(bytes(memoryview(buf)[off:off + dl]).decode())
         off += dl
         (nd,) = struct.unpack_from('<I', buf, off)
         off += 4
@@ -152,18 +159,22 @@ class NativeRing:
         return r == 0  # False → ring closed
 
     def pop(self):
-        """Next in-order payload as a writable bytearray (numpy views
-        into it are writable and the slot->bytearray memcpy is the only
-        consumer-side copy), or None when closed+drained."""
+        """Next in-order payload as a writable, 64B-aligned uint8 view
+        (so the per-array padding from pack_batch yields aligned numpy
+        arrays); the slot->buffer memcpy is the only consumer-side copy.
+        Returns None when closed+drained."""
         n = _lib.rb_wait_next(self._h)
         if n < 0:
             return None
-        buf = bytearray(int(n))
-        c_buf = (ctypes.c_char * int(n)).from_buffer(buf)
+        n = int(n)
+        backing = np.empty(n + 63, dtype=np.uint8)
+        start = (-backing.ctypes.data) % 64
+        view = backing[start:start + n]
+        c_buf = (ctypes.c_char * n).from_buffer(view)
         got = _lib.rb_pop(self._h, c_buf, n)
         if got < 0:
             return None
-        return buf
+        return view
 
     def close(self):
         if not self._closed:

@@ -44,7 +44,11 @@ char* ensure_cap(Slot* s, int64_t n) {
         int64_t cap = 64;
         while (cap < n) cap <<= 1;
         void* p = nullptr;
-        if (posix_memalign(&p, 64, (size_t)cap) != 0) return nullptr;
+        if (posix_memalign(&p, 64, (size_t)cap) != 0) {
+            s->data = nullptr;  // freed above: don't leave it dangling
+            s->cap = 0;
+            return nullptr;
+        }
         s->data = (char*)p;
         s->cap = cap;
     }

@@ -56,10 +56,11 @@ class ParallelTrainer:
     """
 
     def __init__(self, model, optimizer, loss_fn, mesh=None, strategy=None,
-                 donate=True):
+                 donate=True, n_inputs=1):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
+        self.n_inputs = n_inputs  # batch[:n_inputs] feed forward, rest loss
         self.mesh = mesh or _env.get_mesh()
         self.strategy = strategy or getattr(optimizer, '_fleet_strategy',
                                             None)
@@ -107,10 +108,10 @@ class ParallelTrainer:
     # -- step builders -------------------------------------------------------
     def _forward_loss(self, params, buffers, key, batch):
         from ..jit import functional_call
-        x, ys = batch[0], batch[1:]
+        xs, ys = batch[:self.n_inputs], batch[self.n_inputs:]
         amp_on = bool(self.strategy and self.strategy.amp)
 
-        def run(params, x):
+        def run(params, xs):
             import contextlib
             from .. import amp as amp_mod
             cm = amp_mod.auto_cast(level='O2' if (
@@ -119,13 +120,13 @@ class ParallelTrainer:
                 contextlib.nullcontext()
             with cm:
                 out, new_buffers = functional_call(
-                    self.model, params, buffers, (x,), key=key,
+                    self.model, params, buffers, xs, key=key,
                     training=True)
             return out, new_buffers
 
         if self.strategy and self.strategy.recompute:
             run = jax.checkpoint(run)
-        out, new_buffers = run(params, x)
+        out, new_buffers = run(params, xs)
         out_t = jax.tree_util.tree_map(
             lambda v: Tensor._from_value(v), out)
         ys_t = [Tensor._from_value(y) for y in ys]
@@ -214,11 +215,11 @@ class ParallelTrainer:
             def estep(params, buffers, key, *batch):
                 from ..jit import functional_call
                 out, _ = functional_call(self.model, params, buffers,
-                                         (batch[0],), key=key,
+                                         batch[:self.n_inputs], key=key,
                                          training=False)
                 out_t = jax.tree_util.tree_map(
                     lambda v: Tensor._from_value(v), out)
-                ys_t = [Tensor._from_value(y) for y in batch[1:]]
+                ys_t = [Tensor._from_value(y) for y in batch[self.n_inputs:]]
                 from ..core.autograd import no_grad
                 with no_grad():
                     loss = self.loss_fn(out_t, *ys_t)

@@ -99,10 +99,11 @@ def save_step(state_dict, directory, step, keep=3, prefix='ckpt'):
     os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, f'{prefix}_{step}.pdparams')
     save(state_dict, path)
-    # prune
+    # prune (ignore non-numeric suffixes: foreign files in the dir)
     ckpts = sorted(
         (f for f in os.listdir(directory)
-         if f.startswith(prefix + '_') and f.endswith('.pdparams')),
+         if f.startswith(prefix + '_') and f.endswith('.pdparams')
+         and f[len(prefix) + 1:-len('.pdparams')].isdigit()),
         key=lambda f: int(f[len(prefix) + 1:-len('.pdparams')]))
     for old in ckpts[:-keep]:
         try:
@@ -120,7 +121,8 @@ def try_load_latest(directory, prefix='ckpt'):
         return None, -1
     ckpts = sorted(
         (f for f in os.listdir(directory)
-         if f.startswith(prefix + '_') and f.endswith('.pdparams')),
+         if f.startswith(prefix + '_') and f.endswith('.pdparams')
+         and f[len(prefix) + 1:-len('.pdparams')].isdigit()),
         key=lambda f: int(f[len(prefix) + 1:-len('.pdparams')]))
     if not ckpts:
         return None, -1

@@ -1,0 +1,130 @@
+"""Flagship model zoo: GPT (covered in test_ops_kernels), BERT,
+WideDeep/DeepFM (SURVEY.md §3 items 3/5, §2 item 34)."""
+import numpy as np
+import jax
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.models import (
+    bert_tiny, WideDeep, DeepFM, gpt_tiny)
+from paddle_tpu.parallel import ParallelTrainer
+from paddle_tpu.distributed import fleet, env as dist_env
+
+
+@pytest.fixture(autouse=True)
+def clean_mesh():
+    yield
+    dist_env.set_mesh(None)
+
+
+class TestBert:
+    def _data(self):
+        rs = np.random.RandomState(0)
+        ids = rs.randint(3, 128, (4, 32)).astype('int64')
+        mlm = np.where(rs.rand(4, 32) < 0.15, ids, -100).astype('int64')
+        nsp = rs.randint(0, 2, (4,)).astype('int64')
+        return ids, mlm, nsp
+
+    def test_eager_forward_backward(self):
+        ids, mlm, nsp = self._data()
+        paddle.seed(0)
+        m = bert_tiny(num_layers=2)
+        logits, nsp_logits = m(paddle.to_tensor(ids))
+        assert list(logits.shape) == [4, 32, 128]
+        assert list(nsp_logits.shape) == [4, 2]
+        loss = m.loss((logits, nsp_logits), paddle.to_tensor(mlm),
+                      paddle.to_tensor(nsp))
+        loss.backward()
+        g = m.bert.layers[0].attn.qkv.weight.grad
+        assert g is not None and np.isfinite(np.asarray(g.value)).all()
+
+    def test_dp_tp_pretrain_matches_eager_loss(self):
+        ids, mlm, nsp = self._data()
+        paddle.seed(0)
+        m_e = bert_tiny(num_layers=2)
+        m_e.eval()
+        with paddle.no_grad():
+            out = m_e(paddle.to_tensor(ids))
+            l_eager = float(np.asarray(m_e.loss(
+                out, paddle.to_tensor(mlm),
+                paddle.to_tensor(nsp)).value))
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs['dp_degree'] = 4
+        strategy.hybrid_configs['mp_degree'] = 2
+        fleet.init(strategy=strategy)
+        paddle.seed(0)
+        m = bert_tiny(num_layers=2)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        tr = ParallelTrainer(m, opt, lambda o, a, b: m.loss(o, a, b))
+        first = float(np.asarray(tr.step(ids, mlm, nsp)))
+        assert abs(first - l_eager) < 5e-3, (first, l_eager)
+        for _ in range(6):
+            last = tr.step(ids, mlm, nsp)
+        assert float(np.asarray(last)) < first
+
+    def test_mlm_ignore_index(self):
+        ids, _, _ = self._data()
+        paddle.seed(0)
+        m = bert_tiny(num_layers=1)
+        m.eval()
+        with paddle.no_grad():
+            out = m(paddle.to_tensor(ids))
+            all_ignored = np.full_like(ids, -100)
+            l = m.loss(out, paddle.to_tensor(all_ignored))
+        assert np.isfinite(float(np.asarray(l.value)))
+
+
+class TestSparseModels:
+    def _ctr(self, n=256):
+        rs = np.random.RandomState(0)
+        dims = [50, 30, 20]
+        ids = np.stack([rs.randint(0, d, n) for d in dims], 1) \
+            .astype('int64')
+        dense = rs.randn(n, 4).astype('float32')
+        y = ((ids[:, 0] % 2 == 0) ^ (dense.sum(1) > 0)) \
+            .astype('float32')[:, None]
+        return dims, ids, dense, y
+
+    @pytest.mark.parametrize('cls', [WideDeep, DeepFM])
+    def test_trains_to_low_loss(self, cls):
+        dims, ids, dense, y = self._ctr()
+        paddle.seed(0)
+        m = cls(dims, dense_dim=4, embed_dim=8)
+        opt = paddle.optimizer.Adam(0.01, parameters=m.parameters())
+        bce = nn.BCEWithLogitsLoss()
+        tr = ParallelTrainer(m, opt, lambda o, yy: bce(o, yy), n_inputs=2)
+        first = float(np.asarray(tr.step(ids, dense, y)))
+        for _ in range(50):
+            last = tr.step(ids, dense, y)
+        assert float(np.asarray(last)) < first * 0.5
+
+    def test_sharded_vocab_matches_unsharded(self):
+        dims, ids, dense, y = self._ctr(32)
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs['dp_degree'] = 1
+        strategy.hybrid_configs['mp_degree'] = 8
+        fleet.init(strategy=strategy)
+        paddle.seed(0)
+        m_sh = WideDeep(dims, dense_dim=4, embed_dim=8, shard_vocab=True)
+        m_un = WideDeep(dims, dense_dim=4, embed_dim=8)
+        m_un.set_state_dict(m_sh.state_dict())  # same rows, unsharded
+        m_sh.eval()
+        m_un.eval()
+        with paddle.no_grad():
+            a = np.asarray(m_sh(paddle.to_tensor(ids),
+                                paddle.to_tensor(dense)).value)
+            b = np.asarray(m_un(paddle.to_tensor(ids),
+                                paddle.to_tensor(dense)).value)
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+    def test_engine_multi_input_eval(self):
+        dims, ids, dense, y = self._ctr(32)
+        paddle.seed(0)
+        m = DeepFM(dims, dense_dim=4, embed_dim=8)
+        bce = nn.BCEWithLogitsLoss()
+        opt = paddle.optimizer.Adam(0.01, parameters=m.parameters())
+        tr = ParallelTrainer(m, opt, lambda o, yy: bce(o, yy), n_inputs=2)
+        out, loss = tr.eval_step(ids, dense, y)
+        assert np.isfinite(float(np.asarray(loss)))
