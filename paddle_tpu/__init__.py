@@ -43,6 +43,10 @@ from . import ops  # noqa: F401
 from . import models  # noqa: F401
 from . import profiler  # noqa: F401
 from . import utils  # noqa: F401
+from . import hub  # noqa: F401
+from . import inference  # noqa: F401
+from . import onnx  # noqa: F401
+from . import incubate  # noqa: F401
 from .hapi import Model, summary, flops  # noqa: F401
 from .hapi import callbacks  # noqa: F401
 

@@ -1,0 +1,53 @@
+"""paddle.hub — model loading by repo/name.
+
+Reference analogue: python/paddle/hub.py (github/gitee/local sources).
+This build is zero-egress, so only `source='local'` performs real work;
+remote sources raise with a clear message.  A hub repo is a directory
+with an `hubconf.py` exposing callables.
+"""
+import importlib.util
+import os
+
+__all__ = ['list', 'help', 'load']
+
+_HUBCONF = 'hubconf.py'
+
+
+def _load_entry_module(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f'no {_HUBCONF} in {repo_dir}')
+    spec = importlib.util.spec_from_file_location('paddle_tpu_hubconf',
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source != 'local':
+        raise RuntimeError(
+            f'hub source {source!r} needs network egress; this build '
+            f"supports source='local' (a directory with hubconf.py)")
+
+
+def list(repo_dir, source='local', force_reload=False):  # noqa: A001
+    _check_source(source)
+    mod = _load_entry_module(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith('_')]
+
+
+def help(repo_dir, model, source='local', force_reload=False):  # noqa: A001
+    _check_source(source)
+    mod = _load_entry_module(repo_dir)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source='local', force_reload=False, **kwargs):
+    _check_source(source)
+    mod = _load_entry_module(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f'{model!r} not found in {repo_dir}/{_HUBCONF}; '
+                         f'available: {list(repo_dir)}')
+    return getattr(mod, model)(**kwargs)

@@ -1,0 +1,13 @@
+"""paddle.incubate parity namespace.
+
+The reference uses incubate/ for pre-stable features; here the
+TPU-native experimental pieces live in stable modules already
+(ops.flash_attention, ops.ring_attention, parallel.pipeline), so
+incubate re-exports them under the familiar names.
+"""
+from ..ops.flash_attention import flash_attention  # noqa: F401
+from ..ops.ring_attention import ring_attention, ring_attention_spmd  # noqa: F401
+from ..parallel.pipeline import gpipe_spmd  # noqa: F401
+
+__all__ = ['flash_attention', 'ring_attention', 'ring_attention_spmd',
+           'gpipe_spmd']

@@ -1,0 +1,101 @@
+"""Inference API (reference: paddle/fluid/inference + paddle.inference).
+
+The reference's Predictor loads a serialized Program and runs it through
+the C++ analysis/optimization passes; here a saved `paddle_tpu.jit`
+artifact (StableHLO + params) reloads as a jitted callable — XLA is the
+analysis/optimization stack.  Config/Predictor/Tensor mirror the
+reference's surface so deployment scripts port directly.
+"""
+import numpy as np
+
+__all__ = ['Config', 'create_predictor', 'Predictor', 'PredictorTensor']
+
+
+class Config:
+    def __init__(self, prog_file=None, params_file=None):
+        # jit.save writes one prefix; either arg may carry it
+        self.model_path = prog_file
+        self._use_tpu = True
+        self._memory_optim = True
+        self._glog_info = False
+
+    # GPU knobs exist for parity; TPU ignores them
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        pass
+
+    def disable_gpu(self):
+        pass
+
+    def enable_memory_optim(self):
+        self._memory_optim = True
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def model_dir(self):
+        return self.model_path
+
+
+class PredictorTensor:
+    """Input/output handle (reference: paddle_infer::Tensor)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._data = None
+
+    def copy_from_cpu(self, arr):
+        self._data = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._data)
+
+    def reshape(self, shape):
+        if self._data is not None:
+            self._data = self._data.reshape(shape)
+
+
+class Predictor:
+    def __init__(self, config):
+        from .. import jit as _jit
+        self._fn = _jit.load(config.model_path)
+        self._inputs = {}
+        self._outputs = []
+
+    def get_input_names(self):
+        n = getattr(self._fn, 'n_inputs', 1)
+        return [f'input_{i}' for i in range(n)]
+
+    def get_input_handle(self, name):
+        h = self._inputs.get(name)
+        if h is None:
+            h = self._inputs[name] = PredictorTensor(name)
+        return h
+
+    def run(self):
+        args = [self._inputs[n]._data for n in self.get_input_names()
+                if n in self._inputs]
+        out = self._fn(*args)
+        if not isinstance(out, (tuple, list)):
+            out = [out]
+        self._outputs = []
+        for i, o in enumerate(out):
+            t = PredictorTensor(f'output_{i}')
+            t._data = np.asarray(getattr(o, 'value', o))
+            self._outputs.append(t)
+        return True
+
+    def get_output_names(self):
+        return [t.name for t in self._outputs]
+
+    def get_output_handle(self, name):
+        for t in self._outputs:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+
+def create_predictor(config):
+    return Predictor(config)

@@ -1,0 +1,18 @@
+"""ONNX export surface.
+
+Reference analogue: paddle.onnx.export (via paddle2onnx).  Explicit
+non-goal for this TPU build (SURVEY.md §2 note): the portable export
+format here is StableHLO via paddle_tpu.jit.save — it round-trips
+through any XLA-compatible runtime.  export() raises with that pointer
+rather than failing obscurely.
+"""
+
+__all__ = ['export']
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        'ONNX export is not supported in the TPU build; use '
+        'paddle_tpu.jit.save(layer, path, input_spec=...) which writes a '
+        'portable StableHLO module + params, reloadable with '
+        'paddle_tpu.jit.load or any XLA-compatible runtime.')

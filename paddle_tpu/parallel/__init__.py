@@ -10,6 +10,9 @@ XLA inserts/schedules collectives over ICI.
 from .api import (  # noqa: F401
     maybe_shard, collect_param_shardings, named_sharding, make_spec)
 from .engine import ParallelTrainer  # noqa: F401
+from .localsgd import LocalSGDTrainer  # noqa: F401
+from .pipeline import gpipe, gpipe_spmd  # noqa: F401
 
 __all__ = ['maybe_shard', 'collect_param_shardings', 'named_sharding',
-           'make_spec', 'ParallelTrainer']
+           'make_spec', 'ParallelTrainer', 'LocalSGDTrainer', 'gpipe',
+           'gpipe_spmd']

@@ -11,6 +11,8 @@ from .program import (  # noqa: F401
     data, Executor, Variable, in_static_mode, enable_static, disable_static,
     global_scope, scope_guard)
 
-__all__ = ['InputSpec', 'Program', 'program_guard', 'default_main_program',
+from . import nn  # noqa: F401
+
+__all__ = ['InputSpec', 'nn', 'Program', 'program_guard', 'default_main_program',
            'default_startup_program', 'data', 'Executor', 'Variable',
            'enable_static', 'disable_static', 'global_scope', 'scope_guard']

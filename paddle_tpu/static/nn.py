@@ -1,0 +1,101 @@
+"""static.nn — graph-mode layer helpers.
+
+Reference analogue: python/paddle/static/nn/common.py (fc, conv2d,
+batch_norm, embedding, ...).  Each helper builds the live Layer eagerly
+(parameters materialize immediately, like the reference's startup
+program) and applies it to the symbolic Variable, so the op lands in the
+current Program's DAG and compiles into the Executor's XLA module.
+"""
+import numpy as np
+
+from .. import nn as _nn
+from ..nn import functional as F
+from ..tensor import manipulation
+
+__all__ = ['fc', 'conv2d', 'conv3d', 'batch_norm', 'embedding', 'dropout',
+           'layer_norm', 'prelu']
+
+
+def _apply_act(x, act):
+    if act is None:
+        return x
+    fn = getattr(F, act, None)
+    if fn is None:
+        raise ValueError(f'unknown activation {act!r}')
+    return fn(x)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    shape = x.shape
+    in_dim = int(np.prod(shape[num_flatten_dims:]))
+    layer = _nn.Linear(in_dim, size, weight_attr=weight_attr,
+                       bias_attr=bias_attr)
+    if len(shape) > num_flatten_dims + 1:
+        # flatten keeps leading dims symbolic (batch may be None/dynamic)
+        x = manipulation.flatten(x, start_axis=num_flatten_dims,
+                                 stop_axis=-1)
+    return _apply_act(layer(x), activation)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, data_format='NCHW', name=None):
+    ch_axis = 1 if data_format == 'NCHW' else -1
+    in_ch = input.shape[ch_axis]
+    layer = _nn.Conv2D(in_ch, num_filters, filter_size, stride=stride,
+                       padding=padding, dilation=dilation, groups=groups,
+                       weight_attr=param_attr, bias_attr=bias_attr,
+                       data_format=data_format)
+    return _apply_act(layer(input), act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, data_format='NCDHW', name=None):
+    ch_axis = 1 if data_format == 'NCDHW' else -1
+    in_ch = input.shape[ch_axis]
+    layer = _nn.Conv3D(in_ch, num_filters, filter_size, stride=stride,
+                       padding=padding, dilation=dilation, groups=groups,
+                       weight_attr=param_attr, bias_attr=bias_attr,
+                       data_format=data_format)
+    return _apply_act(layer(input), act)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout='NCHW',
+               is_test=False, name=None):
+    ch_axis = 1 if data_layout == 'NCHW' else -1
+    layer = _nn.BatchNorm(input.shape[ch_axis], momentum=momentum,
+                          epsilon=epsilon, weight_attr=param_attr,
+                          bias_attr=bias_attr, data_format=data_layout)
+    if is_test:
+        layer.eval()
+    return _apply_act(layer(input), act)
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype='float32', name=None):
+    layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                          weight_attr=param_attr)
+    return layer(input)
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, name=None):
+    return F.dropout(x, p=dropout_prob, training=not is_test)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = list(input.shape[begin_norm_axis:])
+    layer = _nn.LayerNorm(shape, epsilon=epsilon,
+                          weight_attr=param_attr if scale else False,
+                          bias_attr=bias_attr if shift else False)
+    return _apply_act(layer(input), act)
+
+
+def prelu(x, mode='all', param_attr=None, name=None):
+    ch = 1 if mode == 'all' else x.shape[1]
+    layer = _nn.PReLU(num_parameters=ch, weight_attr=param_attr)
+    return layer(x)
