@@ -18,12 +18,16 @@ from .collective import (  # noqa: F401
 from .parallel import (  # noqa: F401
     init_parallel_env, DataParallel)
 from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    save_sharded, load_sharded, CheckpointManager)
 
 __all__ = ['ParallelEnv', 'get_rank', 'get_world_size', 'get_mesh',
            'set_mesh', 'build_mesh', 'ReduceOp', 'new_group', 'get_group',
            'all_reduce', 'all_gather', 'broadcast', 'reduce', 'scatter',
            'alltoall', 'send', 'recv', 'barrier', 'wait',
-           'init_parallel_env', 'DataParallel', 'fleet', 'spawn', 'launch']
+           'init_parallel_env', 'DataParallel', 'fleet', 'spawn', 'launch',
+           'save_sharded', 'load_sharded', 'CheckpointManager']
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):

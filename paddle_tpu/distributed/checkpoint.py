@@ -1,0 +1,142 @@
+"""Sharded, async checkpointing (orbax-backed).
+
+Reference analogue: /root/reference/python/paddle/framework/io.py:494
+(paddle.save of Program+params) plus fleet's per-rank save utils — on
+GPU clusters every rank pickles its own shard.  TPU-native: a
+mesh-sharded pytree is handed to orbax, which writes per-shard
+tensorstore artifacts directly from device memory WITHOUT gathering the
+full state onto one host, and (async mode) overlaps the device→disk
+copy with the next training steps.  Restore takes an abstract template
+(shapes/dtypes/NamedShardings) and materializes each leaf directly into
+its mesh placement.
+
+    save_sharded(tree, path, async_save=True)   -> wait() handle
+    load_sharded(path, like=tree_or_abstract)   -> restored pytree
+    CheckpointManager(dir, keep)                -> step-level save/
+                                                   restore/latest
+
+The pickle path (framework/io.py) remains for small host-side
+state_dicts; this module is the 1.3B-scale path.
+"""
+import os
+
+import jax
+import numpy as np
+
+__all__ = ['save_sharded', 'load_sharded', 'CheckpointManager']
+
+
+def _checkpointer(async_save):
+    import orbax.checkpoint as ocp
+    handler = ocp.StandardCheckpointHandler()
+    if async_save:
+        return ocp.AsyncCheckpointer(handler)
+    return ocp.Checkpointer(handler)
+
+
+class _SaveHandle:
+    def __init__(self, ckptr):
+        self._ckptr = ckptr
+
+    def wait(self):
+        if hasattr(self._ckptr, 'wait_until_finished'):
+            self._ckptr.wait_until_finished()
+        self._ckptr.close()
+
+
+def save_sharded(tree, path, async_save=True, overwrite=True):
+    """Write a (possibly mesh-sharded) pytree of jax.Arrays as per-shard
+    artifacts under `path`.  Returns a handle; call .wait() before
+    relying on the files (async mode overlaps with compute until then).
+    """
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = _checkpointer(async_save)
+    ckptr.save(path, args=ocp.args.StandardSave(tree), force=overwrite)
+    handle = _SaveHandle(ckptr)
+    if not async_save:
+        handle.wait()
+    return handle
+
+
+def _abstractify(like):
+    def leaf(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x
+        sharding = getattr(x, 'sharding', None)
+        return jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype
+                                    if not hasattr(x, 'dtype') else x.dtype,
+                                    sharding=sharding)
+    return jax.tree_util.tree_map(leaf, like)
+
+
+def load_sharded(path, like):
+    """Restore a pytree saved by save_sharded.  `like` supplies the
+    structure + per-leaf shape/dtype/sharding (live arrays or
+    jax.ShapeDtypeStruct with .sharding set); each leaf lands directly
+    on its mesh shards."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = _checkpointer(False)
+    try:
+        return ckptr.restore(
+            path, args=ocp.args.StandardRestore(_abstractify(like)))
+    finally:
+        ckptr.close()
+
+
+class CheckpointManager:
+    """Step-level sharded checkpoint rotation — the elastic/failure
+    recovery path (SURVEY §5 A3) at model scale.  save() is async by
+    default: step N+1 computes while step N's shards hit disk."""
+
+    def __init__(self, directory, keep=3, prefix='step', async_save=True):
+        self.directory = os.path.abspath(directory)
+        self.keep = keep
+        self.prefix = prefix
+        self.async_save = async_save
+        self._pending = None
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _path(self, step):
+        return os.path.join(self.directory, f'{self.prefix}_{step}')
+
+    def _steps(self):
+        out = []
+        for f in os.listdir(self.directory):
+            tag = f[len(self.prefix) + 1:]
+            if f.startswith(self.prefix + '_') and tag.isdigit():
+                out.append(int(tag))
+        return sorted(out)
+
+    def save(self, tree, step):
+        self.wait()  # one in-flight save at a time
+        self._pending = save_sharded(tree, self._path(step),
+                                     async_save=self.async_save)
+        if not self.async_save:
+            self._prune()
+        return self._pending
+
+    def wait(self):
+        if self._pending is not None:
+            self._pending.wait()
+            self._pending = None
+            self._prune()
+
+    def _prune(self):
+        import shutil
+        for s in self._steps()[:-self.keep]:
+            shutil.rmtree(self._path(s), ignore_errors=True)
+
+    def latest_step(self):
+        steps = self._steps()
+        return steps[-1] if steps else -1
+
+    def restore(self, like, step=None):
+        """Restore `step` (default: latest).  Returns (tree, step) or
+        (None, -1) when no checkpoint exists."""
+        if step is None:
+            step = self.latest_step()
+        if step < 0 or not os.path.isdir(self._path(step)):
+            return None, -1
+        return load_sharded(self._path(step), like), step

@@ -131,6 +131,7 @@ def distributed_optimizer(optimizer, strategy=None):
       gradient_merge → scan-accumulate; recompute → remat policy.
     """
     strategy = strategy or _fleet._strategy or DistributedStrategy()
+    validate_strategy(strategy)
     if strategy.lamb:
         from ...optimizer import Lamb
         if not isinstance(optimizer, Lamb):
@@ -139,8 +140,47 @@ def distributed_optimizer(optimizer, strategy=None):
                 parameters=optimizer._parameter_list,
                 lamb_weight_decay=strategy.lamb_configs.get(
                     'lamb_weight_decay', 0.01))
+    if strategy.dgc:
+        # reference: meta_optimizers/dgc_optimizer.py — only applies to
+        # Momentum; we swap in the semantics-equivalent DGCMomentum
+        # (dense collective on ICI; see optimizer/dgc.py rationale)
+        from ...optimizer import Momentum, DGCMomentum
+        if isinstance(optimizer, Momentum):
+            optimizer = DGCMomentum(
+                learning_rate=optimizer.get_lr(),
+                momentum=optimizer._momentum,
+                parameters=optimizer._parameter_list)
+        else:
+            import warnings
+            warnings.warn(
+                'strategy.dgc only applies to Momentum (reference '
+                'dgc_optimizer.py raises for other optimizers); ignoring',
+                UserWarning, stacklevel=2)
     optimizer._fleet_strategy = strategy
     return optimizer
+
+
+def validate_strategy(strategy):
+    """Reject or loudly flag strategy knobs that have no TPU behavior —
+    a silently-inert perf flag is worse than an error (the reference
+    either rewrites the Program or raises)."""
+    import warnings
+    if strategy is None:
+        return
+    if strategy.a_sync:
+        warnings.warn(
+            'strategy.a_sync (async parameter-server SGD) has no TPU '
+            'collective-mode counterpart; training runs synchronously. '
+            'See fleet/runtime docs: the PS substitute is mesh-sharded '
+            'embeddings (reference: parameter_server_runtime.py).',
+            UserWarning, stacklevel=2)
+    if strategy.sharding:
+        stage = strategy.sharding_configs.get('stage', 1)
+        if stage not in (0, 1, 2):
+            raise NotImplementedError(
+                f'ZeRO sharding stage={stage}: stages 0/1/2 are '
+                'implemented (opt-state + gradient sharding over dp); '
+                'stage-3 parameter sharding is not yet')
 
 
 # -- worker/server role API (parity; collective mode on TPU) -----------------

@@ -232,7 +232,12 @@ class Model:
         if key not in self._train_step_cache:
             self._train_step_cache[key] = self._make_train_step(n_in)
         fn = self._train_step_cache[key]
-        rng = jax.random.fold_in(jax.random.PRNGKey(0), st['step'])
+        # per-step dropout key derived from the user's paddle.seed (the
+        # engine's core.rng), folded with the step counter — NOT a
+        # hard-coded constant, so reseeding changes the dropout streams
+        from ..core import rng as rng_mod
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(rng_mod.get_seed()), st['step'])
         # optimizer rules take t starting at 1 (Adam bias correction)
         new_params, new_buf, new_opt, loss, mres = fn(
             st['params'], st['buffers'], st['opt'], rng,

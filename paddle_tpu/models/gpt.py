@@ -234,6 +234,13 @@ class GPTForCausalLM(nn.Layer):
         lb = manipulation.reshape(labels[:, 1:], [B * (T - 1)])
         return F.cross_entropy(lg, lb)
 
+    def as_pipeline_module(self, num_stages, mesh):
+        """Adapter for the 1F1B pipeline engine (parallel.pipeline_1f1b):
+        repacks parameters into shared/stage-stacked pytrees and exposes
+        pure stage functions.  See models/gpt_pipe.py."""
+        from .gpt_pipe import GPTPipeModule
+        return GPTPipeModule(self, num_stages, mesh)
+
 
 def gpt_tiny(**kw):
     """4-layer toy config for tests/dryruns."""

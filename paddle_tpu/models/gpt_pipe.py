@@ -1,0 +1,211 @@
+"""Pipeline-functional GPT: the flagship model on the 1F1B engine.
+
+Reference analogue: GPTForCausalLMPipe-style models built on
+/root/reference/python/paddle/distributed/fleet/meta_parallel/parallel_layers/pp_layers.py
+(PipelineLayer + LayerDesc segmenting the layer list onto pp ranks) with
+mp_layers.py inside each stage.  TPU-native: the Layer tree's parameters
+are repacked ONCE into a pipeline pytree —
+
+  shared : {wte, wpe, lnf_w, lnf_b}        replicated over pp
+           (wte is tied: embedding on stage 0, LM head on stage S-1;
+           its gradient totals both via the engine's pp-psum)
+  stages : per-block leaves stacked [S, L/S, ...], leading dim sharded
+           on 'pp' so every stage holds ONLY its blocks' weights
+
+— and the stage forward is pure jnp with hand-written tensor-parallel
+collectives: qkv/fc are column-split over 'tp' (no comm), proj/fc2 are
+row-split (one lax.psum each), matching the Megatron split the GSPMD
+path (models/gpt.py) expresses via PartitionSpecs.  The qkv weight is
+repacked [H, 3, nh, hd] with heads on the tp dim so a contiguous shard
+is exactly `nh/tp` complete heads.
+
+Dropout must be 0 in pipeline mode (the engine recomputes forwards in
+the backward tick; stochastic layers would need per-(mb, tick) key
+threading — not wired yet, and the reference disables dropout variance
+across recompute the same way).
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ['GPTPipeModule']
+
+
+def _ln(x, w, b, eps):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) / jnp.sqrt(var + eps) * w + b
+
+
+class GPTPipeModule:
+    """Adapter: GPTForCausalLM -> (params, specs, stage fns) for
+    parallel.pipeline_1f1b.pipeline_value_and_grad."""
+
+    def __init__(self, model, num_stages, mesh, tp_axis='tp'):
+        cfg = model.config
+        assert cfg.num_layers % num_stages == 0, (
+            f'num_layers {cfg.num_layers} % pp {num_stages} != 0')
+        assert cfg.dropout == 0.0, (
+            'pipeline engine requires dropout=0 (recompute-backward)')
+        self.model = model
+        self.cfg = cfg
+        self.S = num_stages
+        self.mesh = mesh
+        self.tp = dict(mesh.shape).get(tp_axis, 1)
+        self.tp_axis = tp_axis
+        assert cfg.num_heads % self.tp == 0
+        assert cfg.intermediate_size % self.tp == 0
+        self.params = self._extract()
+        self.stage_specs = self._specs()
+
+    # -- param repacking -----------------------------------------------------
+    def _extract(self):
+        m, cfg = self.model, self.cfg
+        g = m.gpt
+        nh = cfg.num_heads
+        hd = cfg.hidden_size // nh
+        H, I = cfg.hidden_size, cfg.intermediate_size
+
+        def stack(getter):
+            return jnp.stack([jnp.asarray(getter(blk).value)
+                              for blk in g.blocks])
+
+        blocks = {
+            'ln1_w': stack(lambda b: b.ln1.weight),
+            'ln1_b': stack(lambda b: b.ln1.bias),
+            # [L, H, 3H] -> [L, H, 3, nh, hd]: heads contiguous on dim 3
+            'qkv_w': stack(lambda b: b.attn.qkv.weight).reshape(
+                (-1, H, 3, nh, hd)),
+            'qkv_b': stack(lambda b: b.attn.qkv.bias).reshape(
+                (-1, 3, nh, hd)),
+            # [L, H, H] rows are (nh, hd)-ordered input features
+            'proj_w': stack(lambda b: b.attn.proj.weight).reshape(
+                (-1, nh, hd, H)),
+            'proj_b': stack(lambda b: b.attn.proj.bias),
+            'ln2_w': stack(lambda b: b.ln2.weight),
+            'ln2_b': stack(lambda b: b.ln2.bias),
+            'fc_w': stack(lambda b: b.mlp.fc.weight),
+            'fc_b': stack(lambda b: b.mlp.fc.bias),
+            'fc2_w': stack(lambda b: b.mlp.proj.weight),
+            'fc2_b': stack(lambda b: b.mlp.proj.bias),
+        }
+        S = self.S
+        stages = {k: v.reshape((S, v.shape[0] // S) + v.shape[1:])
+                  for k, v in blocks.items()}
+        shared = {
+            'wte': jnp.asarray(g.wte.weight.value),
+            'wpe': jnp.asarray(g.wpe.weight.value),
+            'lnf_w': jnp.asarray(g.ln_f.weight.value),
+            'lnf_b': jnp.asarray(g.ln_f.bias.value),
+        }
+        return {'shared': shared, 'stages': stages}
+
+    def restore(self, params):
+        """Write a (trained) pipeline pytree back into the live Layer."""
+        m, cfg = self.model, self.cfg
+        g = m.gpt
+        H = cfg.hidden_size
+        sh, st = params['shared'], params['stages']
+        g.wte.weight.value = jnp.asarray(sh['wte'])
+        g.wpe.weight.value = jnp.asarray(sh['wpe'])
+        g.ln_f.weight.value = jnp.asarray(sh['lnf_w'])
+        g.ln_f.bias.value = jnp.asarray(sh['lnf_b'])
+        flat = {k: np.asarray(v).reshape((-1,) + v.shape[2:])
+                for k, v in st.items()}
+        for i, blk in enumerate(g.blocks):
+            blk.ln1.weight.value = jnp.asarray(flat['ln1_w'][i])
+            blk.ln1.bias.value = jnp.asarray(flat['ln1_b'][i])
+            blk.attn.qkv.weight.value = jnp.asarray(
+                flat['qkv_w'][i].reshape(H, -1))
+            blk.attn.qkv.bias.value = jnp.asarray(
+                flat['qkv_b'][i].reshape(-1))
+            blk.attn.proj.weight.value = jnp.asarray(
+                flat['proj_w'][i].reshape(H, H))
+            blk.attn.proj.bias.value = jnp.asarray(flat['proj_b'][i])
+            blk.ln2.weight.value = jnp.asarray(flat['ln2_w'][i])
+            blk.ln2.bias.value = jnp.asarray(flat['ln2_b'][i])
+            blk.mlp.fc.weight.value = jnp.asarray(flat['fc_w'][i])
+            blk.mlp.fc.bias.value = jnp.asarray(flat['fc_b'][i])
+            blk.mlp.proj.weight.value = jnp.asarray(flat['fc2_w'][i])
+            blk.mlp.proj.bias.value = jnp.asarray(flat['fc2_b'][i])
+
+    def _specs(self):
+        """GLOBAL PartitionSpecs for the stage leaves: [S, L/S, ...] with
+        'pp' leading; 'tp' on the head dim (qkv/proj) or the
+        intermediate dim (fc/fc2) — the Megatron column/row split."""
+        t = self.tp_axis
+        return {
+            'ln1_w': P('pp'), 'ln1_b': P('pp'),
+            'qkv_w': P('pp', None, None, None, t, None),
+            'qkv_b': P('pp', None, None, t, None),
+            'proj_w': P('pp', None, t, None, None),
+            'proj_b': P('pp'),
+            'ln2_w': P('pp'), 'ln2_b': P('pp'),
+            'fc_w': P('pp', None, None, t),
+            'fc_b': P('pp', None, t),
+            'fc2_w': P('pp', None, t, None),
+            'fc2_b': P('pp'),
+        }
+
+    # -- stage functions (pure jnp, run inside shard_map) --------------------
+    def first_fn(self, shared, ids_1mb):
+        """Token + position embedding (stage 0 only)."""
+        T = ids_1mb.shape[-1]
+        x = jnp.take(shared['wte'], ids_1mb, axis=0)
+        return x + shared['wpe'][:T]
+
+    def _block(self, bp, x):
+        """One transformer block on the local tp shard of heads/ffn.
+        bp leaves have NO layer dim (scanned out)."""
+        cfg = self.cfg
+        eps = cfg.layer_norm_epsilon
+        hd = cfg.hidden_size // cfg.num_heads
+        tp_on = self.tp > 1
+
+        h = _ln(x, bp['ln1_w'], bp['ln1_b'], eps)
+        y = jnp.einsum('bth,hcnd->btcnd', h, bp['qkv_w']) + bp['qkv_b']
+        q, k, v = y[:, :, 0], y[:, :, 1], y[:, :, 2]  # [mb,T,nh_l,hd]
+        att = jnp.einsum('btnd,bsnd->bnts', q, k) / math.sqrt(hd)
+        T = x.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), att.dtype))
+        att = att - (1.0 - mask) * 1e9
+        att = jax.nn.softmax(att, axis=-1)
+        o = jnp.einsum('bnts,bsnd->btnd', att, v)
+        o = jnp.einsum('btnd,ndh->bth', o, bp['proj_w'])
+        if tp_on:
+            o = jax.lax.psum(o, self.tp_axis)  # row-parallel reduce
+        x = x + o + bp['proj_b']
+
+        h = _ln(x, bp['ln2_w'], bp['ln2_b'], eps)
+        u = jax.nn.gelu(jnp.einsum('bth,hi->bti', h, bp['fc_w'])
+                        + bp['fc_b'], approximate=True)
+        u = jnp.einsum('bti,ih->bth', u, bp['fc2_w'])
+        if tp_on:
+            u = jax.lax.psum(u, self.tp_axis)
+        return x + u + bp['fc2_b']
+
+    def stage_fn(self, shared, stage_p, x, rank):
+        """Apply this stage's L/S blocks via lax.scan over the stacked
+        layer dim (one traced block, the scan-over-layers idiom).
+        `shared`/`rank` unused: GPT stages are homogeneous."""
+        del shared, rank
+        def body(x, layer_p):
+            return self._block(layer_p, x), None
+        x, _ = jax.lax.scan(body, x, stage_p)
+        return x
+
+    def last_fn(self, shared, y, labels_1mb):
+        """Final LN + tied LM head + shifted causal-LM loss (stage S-1)."""
+        cfg = self.cfg
+        h = _ln(y, shared['lnf_w'], shared['lnf_b'],
+                cfg.layer_norm_epsilon)
+        logits = jnp.einsum('bth,vh->btv', h, shared['wte'])
+        lg = logits[:, :-1, :]
+        lb = labels_1mb[:, 1:]
+        logp = jax.nn.log_softmax(lg, axis=-1)
+        nll = -jnp.take_along_axis(logp, lb[..., None].astype(jnp.int32),
+                                   axis=-1)[..., 0]
+        return nll.mean()

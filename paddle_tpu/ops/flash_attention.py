@@ -332,9 +332,10 @@ def flash_attention(q, k, v, causal=False, scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
     """Tiled attention over [B*H, T, D] arrays.
 
-    Uses the Pallas kernel on TPU when T divides the block sizes and
-    D % 128 == 0; otherwise falls back to the jnp reference (identical
-    math, differentiable through XLA)."""
+    Uses the Pallas kernel on TPU when the sequence lengths divide the
+    (>=128) block sizes and D % 64 == 0 (see can_use_pallas); otherwise
+    falls back to the jnp reference (identical math, differentiable
+    through XLA)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     bq = min(block_q, q.shape[1])

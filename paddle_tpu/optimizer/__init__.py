@@ -4,6 +4,8 @@ from .optimizer import Optimizer  # noqa: F401
 from .adam import Adam, AdamW, Adamax, Lamb  # noqa: F401
 from .sgd_family import (  # noqa: F401
     SGD, Momentum, Adagrad, Adadelta, RMSProp, Lars)
+from .dgc import DGCMomentum  # noqa: F401
 
 __all__ = ['Optimizer', 'Adam', 'AdamW', 'Adamax', 'Lamb', 'SGD',
-           'Momentum', 'Adagrad', 'Adadelta', 'RMSProp', 'Lars', 'lr']
+           'Momentum', 'Adagrad', 'Adadelta', 'RMSProp', 'Lars',
+           'DGCMomentum', 'lr']

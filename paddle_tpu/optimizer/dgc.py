@@ -1,0 +1,64 @@
+"""DGC — Deep Gradient Compression momentum optimizer.
+
+Reference analogue:
+/root/reference/python/paddle/distributed/fleet/meta_optimizers/dgc_optimizer.py:21
+(DGCMomentumOptimizer backed by the dgc_op CUDA kernels: top-k gradient
+selection, local error feedback, momentum correction, sparse NCCL
+all-gather).  TPU-native: the *convergence semantics* (Lin et al. 2018 —
+momentum-corrected residual accumulation, top-k masking by magnitude)
+are reproduced as a pure jnp update rule; the *wire format* is not,
+deliberately: XLA reduces dense gradients over ICI, whose bandwidth
+makes sparse encodings counterproductive (gather/scatter breaks MXU
+tiling and XLA fusion for no transfer win).  So `DGCMomentum` trains
+like the reference's DGC run, while the collective stays dense.
+
+Update per parameter (sparsity s, after rampup_begin_step):
+    u <- m * u + g          (momentum correction: accumulate velocity)
+    v <- v + u              (error feedback residual)
+    thr = quantile(|v|, s)
+    mask = |v| >= thr
+    p <- p - lr * (v * mask)
+    v <- v * !mask ; u <- u * !mask
+Before rampup_begin_step it is plain heavy-ball momentum.
+"""
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ['DGCMomentum']
+
+
+class DGCMomentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 rampup_begin_step=0, rampup_step=1,
+                 sparsity=(0.999,), weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._rampup_begin = int(rampup_begin_step)
+        seq = sparsity if isinstance(sparsity, (tuple, list)) else [sparsity]
+        self._sparsity = float(seq[-1])
+
+    def _create_state(self, p):
+        return {'u': jnp.zeros_like(p), 'v': jnp.zeros_like(p)}
+
+    def _rule(self, p, g, state, lr, t):
+        m = self._momentum
+        u = m * state['u'] + g
+        v = state['v'] + u
+        flat = jnp.abs(v.reshape(-1))
+        if flat.size > 1:
+            thr = jnp.quantile(flat, self._sparsity)
+        else:
+            thr = jnp.zeros((), flat.dtype)
+        mask = (jnp.abs(v) >= thr).astype(v.dtype)
+        sparse_step = (p - lr * v * mask,
+                       {'u': u * (1 - mask), 'v': v * (1 - mask)})
+        dense_step = (p - lr * u, {'u': u, 'v': jnp.zeros_like(v)})
+        t_arr = jnp.asarray(t)
+        use_sparse = t_arr > self._rampup_begin
+        new_p = jnp.where(use_sparse, sparse_step[0], dense_step[0])
+        new_state = {
+            k: jnp.where(use_sparse, sparse_step[1][k], dense_step[1][k])
+            for k in ('u', 'v')}
+        return new_p, new_state

@@ -69,6 +69,24 @@ class ParallelTrainer:
         self._compiled = None
         self._eval_compiled = None
 
+        pp = (dict(self.mesh.shape).get('pp', 1)
+              if self.mesh is not None else 1)
+        self._pipeline = bool(self.strategy and self.strategy.pipeline
+                              and pp > 1)
+        if self.strategy is not None:
+            from ..distributed.fleet.fleet_base import validate_strategy
+            validate_strategy(self.strategy)
+            if self.strategy.pipeline and not self._pipeline:
+                import warnings
+                warnings.warn(
+                    'strategy.pipeline=True but the mesh has no pp axis '
+                    '(>1); running without pipeline parallelism. Set '
+                    'hybrid_configs.pp_degree before fleet.init.',
+                    UserWarning, stacklevel=2)
+        if self._pipeline:
+            self._init_pipeline(pp)
+            return
+
         params, buffers = model.functional_state()
         self.param_specs = collect_param_shardings(model)
         self.params = params
@@ -83,6 +101,109 @@ class ParallelTrainer:
                            for n, v in self.params.items()}
             self.buffers = {n: jnp.array(v, copy=True)
                             for n, v in self.buffers.items()}
+
+    # -- pipeline path (strategy.pipeline + pp>1) ----------------------------
+    def _init_pipeline(self, pp):
+        """1F1B engine: the model is repacked into shared/stage pytrees
+        (GPT exposes as_pipeline_module; a fleet PipelineLayer gets the
+        generic heterogeneous adapter).  Reference analogue:
+        fleet/meta_parallel/pipeline_parallel.py:43."""
+        from .pipeline import PipelineLayerModule
+        from ..distributed.fleet.meta_parallel import PipelineLayer
+        model = self.model
+        if hasattr(model, 'as_pipeline_module'):
+            self._pipe = model.as_pipeline_module(pp, self.mesh)
+        elif isinstance(model, PipelineLayer):
+            assert model.num_stages == pp, (
+                f'PipelineLayer has {model.num_stages} stages but '
+                f'pp_degree is {pp}')
+            self._pipe = PipelineLayerModule(model, self.mesh,
+                                             loss_fn=self.loss_fn)
+        else:
+            raise NotImplementedError(
+                'strategy.pipeline needs a model with '
+                'as_pipeline_module() or a fleet PipelineLayer')
+        self.params = self._pipe.params
+        self.opt_state = self.optimizer.init(self.params)
+        self.buffers = {}
+        self._pipe_shardings = self._pipe_sharding_tree()
+        self._pipe_state_shardings = self._state_sharding_tree(
+            self.opt_state)
+        self.params = jax.tree_util.tree_map(
+            jax.device_put, self.params, self._pipe_shardings)
+        self.opt_state = jax.tree_util.tree_map(
+            jax.device_put, self.opt_state, self._pipe_state_shardings)
+
+    def _pipe_sharding_tree(self):
+        repl = NamedSharding(self.mesh, P())
+        shared_sh = jax.tree_util.tree_map(
+            lambda _: repl, self._pipe.params['shared'])
+        stage_sh = jax.tree_util.tree_map(
+            lambda _, spec: NamedSharding(self.mesh, spec),
+            self._pipe.params['stages'], self._pipe.stage_specs)
+        return {'shared': shared_sh, 'stages': stage_sh}
+
+    def _state_sharding_tree(self, state):
+        """Optimizer slots follow their parameter's sharding when they
+        share its shape (Adam moments etc.), else replicate."""
+        repl = NamedSharding(self.mesh, P())
+        flat_p, treedef = jax.tree_util.tree_flatten(self.params)
+        flat_sh = treedef.flatten_up_to(self._pipe_shardings)
+        flat_s = treedef.flatten_up_to(state)
+        out = []
+        for p, sh, st in zip(flat_p, flat_sh, flat_s):
+            out.append({k: (sh if hasattr(v, 'shape')
+                            and v.shape == p.shape else repl)
+                        for k, v in st.items()})
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _build_pipe_step(self):
+        from .pipeline_1f1b import pipeline_value_and_grad
+        pipe = self._pipe
+        opt = self.optimizer
+        mesh = self.mesh
+        cfgs = (self.strategy.pipeline_configs
+                if self.strategy is not None else {})
+        M = max(1, int(cfgs.get('accumulate_steps') or 1))
+
+        def train_step(params, opt_state, step_no, ids, labels):
+            B = ids.shape[0]
+            assert B % M == 0, (B, M)
+            ids_mb = ids.reshape((M, B // M) + ids.shape[1:])
+            lb_mb = labels.reshape((M, B // M) + labels.shape[1:])
+            loss, (d_sh, d_st) = pipeline_value_and_grad(
+                params['shared'], params['stages'], ids_mb, lb_mb,
+                mesh=mesh, first_fn=pipe.first_fn,
+                stage_fn=pipe.stage_fn, last_fn=pipe.last_fn,
+                stage_specs=pipe.stage_specs)
+            grads = {'shared': d_sh, 'stages': d_st}
+            new_params, new_state = opt.apply_gradients(
+                params, grads, opt_state, step_no)
+            return new_params, new_state, loss
+
+        p_sh = self._pipe_shardings
+        repl = NamedSharding(mesh, P())
+        s_sh = self._pipe_state_shardings
+        batch_sh = NamedSharding(mesh, P('dp'))
+        kwargs = {
+            'in_shardings': (p_sh, s_sh, repl, batch_sh, batch_sh),
+            'out_shardings': (p_sh, s_sh, repl),
+        }
+        if self.donate:
+            kwargs['donate_argnums'] = (0, 1)
+        return jax.jit(train_step, **kwargs)
+
+    def _pipe_step(self, *batch):
+        vals = tuple(b.value if isinstance(b, Tensor) else jnp.asarray(b)
+                     for b in batch)
+        assert len(vals) == 2, 'pipeline step expects (inputs, labels)'
+        if self._compiled is None:
+            self._compiled = self._build_pipe_step()
+        self.params, self.opt_state, loss = self._compiled(
+            self.params, self.opt_state, jnp.asarray(self._step_no + 1),
+            *vals)
+        self._step_no += 1
+        return loss
 
     # -- sharding placement --------------------------------------------------
     def _sharding_for(self, name, v, zero=False):
@@ -140,6 +261,26 @@ class ParallelTrainer:
         opt = self.optimizer
         merge_k = (self.strategy.gradient_merge_configs.get('k_steps', 1)
                    if self.strategy and self.strategy.gradient_merge else 1)
+        # ZeRO-2: reduce-scatter gradients over dp instead of all-reduce.
+        # Reference: fleet/meta_optimizers/sharding_optimizer.py:43 —
+        # there a Program rewrite inserts c_reduce_scatter; here a
+        # sharding constraint on the grads makes XLA's SPMD partitioner
+        # emit the reduce-scatter, the update runs on dp-shards, and the
+        # out_sharding on params re-gathers (all-gather) afterwards.
+        zero_stage = (self.strategy.sharding_configs.get('stage', 1)
+                      if self.strategy and self.strategy.sharding else 0)
+        zero2 = zero_stage >= 2 and self.mesh is not None
+        self._grad_shardings = None
+        if zero2:
+            self._grad_shardings = {
+                n: self._sharding_for(n, v, zero=True)
+                for n, v in self.params.items()}
+
+        def shard_grads(grads):
+            if not zero2:
+                return grads
+            return {n: jax.lax.with_sharding_constraint(
+                g, self._grad_shardings[n]) for n, g in grads.items()}
 
         def train_step(params, buffers, opt_state, step_no, key, *batch):
             if merge_k > 1:
@@ -164,6 +305,7 @@ class ParallelTrainer:
                 (loss, new_buffers), grads = jax.value_and_grad(
                     self._forward_loss, has_aux=True)(
                         params, buffers, key, batch)
+            grads = shard_grads(grads)
             new_params, new_state = opt.apply_gradients(
                 params, grads, opt_state, step_no)
             return new_params, new_buffers, new_state, loss
@@ -195,6 +337,8 @@ class ParallelTrainer:
     # -- public API ----------------------------------------------------------
     def step(self, *batch):
         """batch: numpy/jax arrays (x, y, ...). Returns python float loss."""
+        if self._pipeline:
+            return self._pipe_step(*batch)
         vals = tuple(b.value if isinstance(b, Tensor) else jnp.asarray(b)
                      for b in batch)
         if self._compiled is None:
@@ -209,6 +353,11 @@ class ParallelTrainer:
         return loss
 
     def eval_step(self, *batch):
+        if self._pipeline:
+            raise NotImplementedError(
+                'eval under pipeline parallelism: sync_to_model() and '
+                'evaluate on the dp/tp path (the reference also '
+                'evaluates outside the 1F1B schedule)')
         vals = tuple(b.value if isinstance(b, Tensor) else jnp.asarray(b)
                      for b in batch)
         if self._eval_compiled is None:
@@ -233,6 +382,12 @@ class ParallelTrainer:
         """Write compiled-state params/buffers back into the live Layer
         (for state_dict/save after training).  Copies when donating:
         the next step() would otherwise delete the Layer's arrays."""
+        if self._pipeline:
+            params = jax.tree_util.tree_map(
+                lambda v: jnp.array(v, copy=True), self.params) \
+                if self.donate else self.params
+            self._pipe.restore(params)
+            return
         params, buffers = self.params, self.buffers
         if self.donate:
             params = {n: jnp.array(v, copy=True) for n, v in params.items()}
@@ -242,3 +397,38 @@ class ParallelTrainer:
 
     def loss_float(self, loss):
         return float(np.asarray(loss))
+
+    # -- sharded checkpointing ----------------------------------------------
+    def train_state(self):
+        """The full resumable state as one pytree (mesh-sharded leaves
+        stay sharded — no host gather)."""
+        return {'params': self.params, 'buffers': self.buffers,
+                'opt_state': self.opt_state,
+                'step': jnp.asarray(self._step_no)}
+
+    def save_checkpoint(self, directory, keep=3, async_save=True):
+        """Write the sharded train state via orbax (per-shard artifacts,
+        async by default).  Reference: framework/io.py:494 at scale."""
+        import os
+        from ..distributed.checkpoint import CheckpointManager
+        mgr = getattr(self, '_ckpt_mgr', None)
+        if mgr is None or mgr.directory != os.path.abspath(directory):
+            mgr = CheckpointManager(directory, keep=keep,
+                                    async_save=async_save)
+            self._ckpt_mgr = mgr
+        return mgr.save(self.train_state(), self._step_no)
+
+    def restore_checkpoint(self, directory, step=None):
+        """Restore the newest (or given) checkpoint directly onto the
+        mesh; returns the restored step or -1."""
+        from ..distributed.checkpoint import CheckpointManager
+        mgr = CheckpointManager(directory)
+        self._ckpt_mgr = mgr
+        state, got = mgr.restore(self.train_state(), step=step)
+        if state is None:
+            return -1
+        self.params = state['params']
+        self.buffers = state['buffers']
+        self.opt_state = state['opt_state']
+        self._step_no = int(np.asarray(state['step']))
+        return got

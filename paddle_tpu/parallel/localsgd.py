@@ -76,6 +76,9 @@ class LocalSGDTrainer:
         def local_step(params, buffers, state, step_no, key, *batch):
             p_local = jax.tree_util.tree_map(lambda a: a[0], params)
             s_local = jax.tree_util.tree_map(lambda a: a[0], state)
+            # distinct dropout stream per dp replica — LocalSGD's value
+            # comes from replica divergence between syncs
+            key = jax.random.fold_in(key, jax.lax.axis_index(dp_axis))
             loss, grads = jax.value_and_grad(self._local_loss)(
                 p_local, buffers, key, batch)
             new_p, new_s = opt.apply_gradients(p_local, grads, s_local,

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-__all__ = ['gpipe', 'gpipe_spmd']
+__all__ = ['gpipe', 'gpipe_spmd', 'PipelineLayerModule']
 
 
 def gpipe(stage_params, x_mb, stage_fn, axis_name):
@@ -106,3 +106,75 @@ def gpipe_spmd(stacked_params, x, stage_fn, mesh, num_microbatches,
         check_vma=False)(stacked_params, x_mb)
     out_mb = out[sp - 1]  # last stage's buffer
     return out_mb.reshape((b,) + out_mb.shape[2:])
+
+
+class PipelineLayerModule:
+    """Generic pipeline adapter for fleet's PipelineLayer — the engine
+    behind the reference idiom ``PipelineLayer(descs, num_stages=S)`` +
+    ``fleet.distributed_model`` (reference: meta_parallel/pp_layers.py
+    feeding pipeline_parallel.py's schedule).
+
+    Heterogeneous stages are routed with ``lax.switch`` on the pp
+    coordinate inside the 1F1B engine; every device therefore carries a
+    replicated copy of ALL stages' parameters (correctness-first
+    fallback — the flagship memory-efficient path stacks homogeneous
+    blocks P('pp'), see models/gpt_pipe.py).  Constraints: activations
+    entering/leaving every stage share one shape/dtype (the microbatch
+    input's), and tp must be 1 (stage compute is tp-replicated here, so
+    a tp-psum of grads would double count).
+    """
+
+    def __init__(self, pipe_layer, mesh, loss_fn=None, tp_axis='tp'):
+        assert dict(mesh.shape).get(tp_axis, 1) == 1, (
+            'PipelineLayerModule requires tp==1; use a model-specific '
+            'pipeline module (e.g. GPTPipeModule) for tp x pp')
+        self.layer = pipe_layer
+        self.mesh = mesh
+        self.S = pipe_layer.num_stages
+        self.loss_fn = loss_fn or pipe_layer.loss_fn
+        assert self.loss_fn is not None, 'PipelineLayer needs a loss_fn'
+        # per-stage functional param trees, all pp-replicated
+        shared = {}
+        for s in range(self.S):
+            sp = {}
+            for li, sub in enumerate(pipe_layer.stage_layers(s)):
+                params, buffers = sub.functional_state()
+                assert not buffers, (
+                    'pipeline stages with buffers (BN running stats) '
+                    'are not supported in the compiled pipeline step')
+                sp[str(li)] = params
+            shared[f'stage{s}'] = sp
+        self.params = {'shared': shared, 'stages': {}}
+        self.stage_specs = {}
+
+    def restore(self, params):
+        for s in range(self.S):
+            sp = params['shared'][f'stage{s}']
+            for li, sub in enumerate(self.layer.stage_layers(s)):
+                sub.load_functional_state(sp[str(li)], {})
+
+    def _apply_stage(self, shared, s, x):
+        from ..jit import functional_call
+        out = x
+        for li, sub in enumerate(self.layer.stage_layers(s)):
+            out, _ = functional_call(
+                sub, shared[f'stage{s}'][str(li)], {}, (out,),
+                training=True)
+        return out
+
+    def first_fn(self, shared, x_1mb):
+        """The raw microbatch IS the pipeline activation."""
+        del shared
+        return x_1mb
+
+    def stage_fn(self, shared, stage_p, x, rank):
+        del stage_p
+        branches = [functools.partial(self._apply_stage, shared, s)
+                    for s in range(self.S)]
+        return jax.lax.switch(jnp.clip(rank, 0, self.S - 1), branches, x)
+
+    def last_fn(self, shared, y, labels_1mb):
+        del shared
+        loss = self.loss_fn(y, labels_1mb)
+        val = getattr(loss, 'value', loss)
+        return jnp.mean(val).astype(jnp.float32)

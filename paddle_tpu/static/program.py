@@ -35,6 +35,19 @@ __all__ = ['Program', 'program_guard', 'default_main_program',
 _static_mode = False
 
 
+def _param_names(params):
+    """Unique real names for a parameter list (the key set shared by
+    the Executor's pytrees and apply_gradients' name-based hooks)."""
+    names, seen = [], set()
+    for i, p in enumerate(params):
+        n = getattr(p, 'name', None) or f'param_{i}'
+        if n in seen:
+            n = f'{n}_{i}'
+        seen.add(n)
+        names.append(n)
+    return names
+
+
 def enable_static():
     global _static_mode
     _static_mode = True
@@ -342,13 +355,15 @@ class Executor:
         if train is not None:
             loss_var, optimizer = train
             step = optimizer._global_step + 1
-            pvals = [p.value for p in params]
-            svals = [optimizer._accumulators_for(p) for p in params]
+            names = _param_names(params)
+            pvals = {n: p.value for n, p in zip(names, params)}
+            svals = {n: optimizer._accumulators_for(p)
+                     for n, p in zip(names, params)}
             fetched, new_p, new_s, side_vals = compiled(
                 feed_vals, pvals, svals, jnp.asarray(step))
-            for p, nv, ns in zip(params, new_p, new_s):
-                p.value = nv
-                optimizer._accumulators[id(p)] = ns
+            for n, p in zip(names, params):
+                p.value = new_p[n]
+                optimizer._accumulators[id(p)] = new_s[n]
             optimizer._global_step = step
         else:
             fetched, side_vals = compiled(feed_vals)
@@ -378,10 +393,13 @@ class Executor:
 
         loss_var, optimizer = train
 
+        names = _param_names(params)
+
         @jax.jit
         def run_train(feed_vals, pvals, svals, step):
             def loss_fn(pvals):
-                param_env = {id(p): v for p, v in zip(params, pvals)}
+                param_env = {id(p): pvals[n]
+                             for n, p in zip(names, params)}
                 env = {'__params__': param_env}
                 for v, val in zip(feed_var_objs, feed_vals):
                     env[id(v)] = val
@@ -391,9 +409,11 @@ class Executor:
                 return loss.astype(jnp.float32).sum(), (outs, side)
             grads, (outs, side) = jax.grad(loss_fn, has_aux=True)(pvals)
             # apply_gradients applies grad clipping + weight decay exactly
-            # like the eager step() path (clip skipped only if unset)
+            # like the eager step() path; params travel as dicts keyed by
+            # REAL parameter names so name-based exemptions
+            # (apply_decay_param_fun excluding bias/norm) keep working
             new_p, new_s = optimizer.apply_gradients(
-                list(pvals), list(grads), list(svals), step)
+                pvals, grads, svals, step)
             return outs, new_p, new_s, side
 
         return run_train

@@ -115,7 +115,12 @@ def pad(img, padding, fill=0, padding_mode='constant'):
 
 def rotate(img, angle, interpolation='nearest', expand=False,
            center=None, fill=0):
-    """Rotate counter-clockwise by `angle` degrees (inverse-map sampling)."""
+    """Rotate counter-clockwise by `angle` degrees (inverse-map
+    sampling, 'nearest' or 'bilinear')."""
+    if interpolation not in ('nearest', 'bilinear'):
+        raise ValueError(
+            f"rotate: unsupported interpolation '{interpolation}' "
+            "(use 'nearest' or 'bilinear')")
     img = _as_hwc(img)
     h, w = img.shape[:2]
     rad = np.deg2rad(angle)
@@ -132,11 +137,31 @@ def rotate(img, angle, interpolation='nearest', expand=False,
     dy, dx = yy - ocy, xx - ocx
     src_x = cos * dx - sin * dy + cx
     src_y = sin * dx + cos * dy + cy
-    sx = np.round(src_x).astype(int)
-    sy = np.round(src_y).astype(int)
-    valid = (sx >= 0) & (sx < w) & (sy >= 0) & (sy < h)
     out = np.full((oh, ow, img.shape[2]), fill, dtype=img.dtype)
-    out[valid] = img[sy[valid], sx[valid]]
+    if interpolation == 'nearest':
+        sx = np.round(src_x).astype(int)
+        sy = np.round(src_y).astype(int)
+        valid = (sx >= 0) & (sx < w) & (sy >= 0) & (sy < h)
+        out[valid] = img[sy[valid], sx[valid]]
+        return out
+    # bilinear: blend the 4 neighbours of the (fractional) source point
+    x0 = np.floor(src_x).astype(int)
+    y0 = np.floor(src_y).astype(int)
+    fx = (src_x - x0)[..., None]
+    fy = (src_y - y0)[..., None]
+    valid = (src_x >= 0) & (src_x <= w - 1) & \
+            (src_y >= 0) & (src_y <= h - 1)
+    x0c = np.clip(x0, 0, w - 1)
+    y0c = np.clip(y0, 0, h - 1)
+    x1c = np.clip(x0 + 1, 0, w - 1)
+    y1c = np.clip(y0 + 1, 0, h - 1)
+    f = img.astype(np.float64)
+    top = f[y0c, x0c] * (1 - fx) + f[y0c, x1c] * fx
+    bot = f[y1c, x0c] * (1 - fx) + f[y1c, x1c] * fx
+    blend = top * (1 - fy) + bot * fy
+    if np.issubdtype(img.dtype, np.integer):
+        blend = np.round(blend)
+    out[valid] = blend[valid].astype(img.dtype)
     return out
 
 

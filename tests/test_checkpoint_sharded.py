@@ -1,0 +1,101 @@
+"""Sharded/async checkpointing (orbax) on the 8-device virtual mesh.
+
+Reference: python/paddle/framework/io.py:494 + fleet per-rank save; the
+contract tested here is the TPU-scale one — per-shard artifacts, no
+full-state host gather, bit-exact restore onto the mesh, async overlap.
+"""
+import os
+
+import numpy as np
+import pytest
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import fleet, save_sharded, load_sharded
+from paddle_tpu.distributed import env as dist_env
+from paddle_tpu.distributed.checkpoint import CheckpointManager
+from paddle_tpu.parallel import ParallelTrainer
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh():
+    yield
+    dist_env.set_mesh(None)
+
+
+def test_save_load_sharded_roundtrip(tmp_path):
+    mesh = dist_env.build_mesh([('dp', 8)])
+    sh = NamedSharding(mesh, P('dp'))
+    rs = np.random.RandomState(0)
+    tree = {'w': jax.device_put(rs.randn(16, 4).astype('float32'), sh),
+            'b': jax.device_put(rs.randn(8).astype('float32'),
+                                NamedSharding(mesh, P())),
+            'step': jax.numpy.asarray(7)}
+    h = save_sharded(tree, str(tmp_path / 'ck'), async_save=True)
+    h.wait()
+    # per-shard artifacts exist; nothing resembling one fat pickle
+    assert (tmp_path / 'ck').is_dir()
+    restored = load_sharded(str(tmp_path / 'ck'), like=tree)
+    np.testing.assert_array_equal(np.asarray(restored['w']),
+                                  np.asarray(tree['w']))
+    np.testing.assert_array_equal(np.asarray(restored['b']),
+                                  np.asarray(tree['b']))
+    assert int(restored['step']) == 7
+    # restored leaves keep their mesh placement
+    assert restored['w'].sharding.is_equivalent_to(sh, 2)
+
+
+def test_trainer_exact_resume_sharded(tmp_path):
+    """Train 3 steps, checkpoint (async), train 2 more; a fresh trainer
+    restores step-3 state and reproduces EXACTLY steps 4-5."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs['dp_degree'] = 4
+    strategy.hybrid_configs['mp_degree'] = 2
+    strategy.sharding = True
+    fleet.init(is_collective=True, strategy=strategy)
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 16).astype('float32')
+    y = rs.randn(8, 8).astype('float32')
+
+    def make():
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(16, 32), nn.Tanh(),
+                              nn.Linear(32, 8))
+        mse = nn.MSELoss()
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=model.parameters())
+        return ParallelTrainer(model, opt, lambda o, t: mse(o, t),
+                               strategy=strategy)
+
+    tr = make()
+    for _ in range(3):
+        tr.step(x, y)
+    h = tr.save_checkpoint(str(tmp_path / 'run'), async_save=True)
+    cont = [float(np.asarray(tr.step(x, y))) for _ in range(2)]
+    h.wait()
+
+    tr2 = make()
+    got = tr2.restore_checkpoint(str(tmp_path / 'run'))
+    assert got == 3, got
+    resumed = [float(np.asarray(tr2.step(x, y))) for _ in range(2)]
+    np.testing.assert_array_equal(cont, resumed)
+
+
+def test_manager_rotation(tmp_path):
+    mesh = dist_env.build_mesh([('dp', 8)])
+    sh = NamedSharding(mesh, P())
+    mgr = CheckpointManager(str(tmp_path / 'rot'), keep=2,
+                            async_save=False)
+    tree = {'a': jax.device_put(np.arange(8, dtype='float32'), sh)}
+    for s in (1, 2, 3, 4):
+        mgr.save(tree, s)
+    mgr.wait()
+    assert mgr.latest_step() == 4
+    steps = mgr._steps()
+    assert steps == [3, 4], steps
+    restored, got = mgr.restore(tree)
+    assert got == 4
+    np.testing.assert_array_equal(np.asarray(restored['a']),
+                                  np.asarray(tree['a']))
