@@ -39,9 +39,21 @@ BLACK_LIST = frozenset({
     'cross_entropy', 'softmax_with_cross_entropy', 'nll_loss',
     'binary_cross_entropy', 'binary_cross_entropy_with_logits',
     'kl_div', 'cosh', 'sinh', 'tan', 'mean', 'sum', 'norm', 'dist',
-    'layer_norm', 'batch_norm', 'instance_norm', 'group_norm',
     'reduce_mean', 'reduce_sum', 'cumsum', 'logsumexp', 'softplus',
     'erf', 'erfinv', 'lgamma', 'digamma', 'cross_entropy_loss',
+})
+
+# Normalization ops manage their own mixed precision: the functionals in
+# nn/functional/norm.py compute statistics with float32 accumulation and
+# apply the normalization in the input dtype (folded per-channel
+# scale/shift that XLA fuses into the producing conv/matmul epilogue).
+# Casting their inputs here — either direction — would only add HBM
+# traffic: an f32 upcast doubles the activation bytes saved for backward
+# (this was the round-1 ResNet bottleneck: the step was HBM-bound with
+# every BN materializing f32 copies), while a bf16 downcast would round
+# the f32 scale/shift parameters for no gain.
+KEEP_LIST = frozenset({
+    'layer_norm', 'batch_norm', 'instance_norm', 'group_norm',
 })
 
 _FLOATS = (jnp.float32, jnp.float16, jnp.bfloat16, jnp.float64)
@@ -70,6 +82,9 @@ def _cast_all(vals, dtype):
 
 def _amp_hook(op_name, vals):
     if not _state.enabled:
+        return vals
+    if (op_name in KEEP_LIST and op_name not in _state.black
+            and op_name not in _state.white):  # custom lists still win
         return vals
     if op_name in _state.black:
         return _cast_all(vals, jnp.float32)

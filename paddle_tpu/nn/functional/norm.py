@@ -14,6 +14,23 @@ __all__ = ['batch_norm', 'layer_norm', 'instance_norm', 'group_norm',
            'local_response_norm']
 
 
+def _one_pass_var(v, axes, mean, keepdims=False):
+    """E[x²]−E[x]² with f32 accumulation, clamped ≥ 0 (the one-pass
+    form can go slightly negative from f32 cancellation when
+    var ≪ mean², which would NaN the sqrt).
+
+    For bf16 the square stays in bf16 — f32 exponent range, and an f32
+    upcast before the square would make autodiff save an f32 copy of
+    the activations for the square's VJP.  fp16 squares overflow at
+    |x| ≥ 256, so non-bf16 dtypes upcast first."""
+    f32 = jnp.float32
+    sq = jnp.square(v) if v.dtype == jnp.bfloat16 \
+        else jnp.square(v.astype(f32))
+    var = jnp.mean(sq, axis=axes, dtype=f32,
+                   keepdims=keepdims) - jnp.square(mean)
+    return jnp.maximum(var, 0.0)
+
+
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training=False, momentum=0.9, epsilon=1e-5,
                data_format='NCHW', use_global_stats=None, name=None):
@@ -30,14 +47,25 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
 
     if use_batch_stats:
         def fn(v, w, b):
-            mean = jnp.mean(v, axis=red_axes)
-            var = jnp.var(v, axis=red_axes)
-            inv = jnp.reshape(1.0 / jnp.sqrt(var + epsilon), shape)
-            out = (v - mean.reshape(shape)) * inv
-            if w is not None:
-                out = out * w.reshape(shape)
+            # Mixed-precision contract (TPU): statistics accumulate in
+            # float32 regardless of v.dtype, but the normalization is
+            # applied in v.dtype as a folded per-channel scale/shift —
+            # two elementwise ops XLA fuses into the producing conv's
+            # epilogue.  Upcasting v here would double the HBM bytes of
+            # every activation saved for backward (bandwidth-bound).
+            f32 = jnp.float32
+            mean = jnp.mean(v, axis=red_axes, dtype=f32)
+            if v.dtype == f32:
+                var = jnp.var(v, axis=red_axes)
+            else:
+                var = _one_pass_var(v, red_axes, mean)
+            inv = 1.0 / jnp.sqrt(var + epsilon)
+            scale = inv if w is None else inv * w.astype(f32)
+            shift = -mean * scale
             if b is not None:
-                out = out + b.reshape(shape)
+                shift = shift + b.astype(f32)
+            out = (v * scale.reshape(shape).astype(v.dtype)
+                   + shift.reshape(shape).astype(v.dtype))
             return out, mean, var
 
         args = [x]
@@ -71,15 +99,18 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     rm, rv = wrap(running_mean), wrap(running_var)
 
     def fn_eval(v, m, s, *wb):
-        inv = jnp.reshape(1.0 / jnp.sqrt(s + epsilon), shape)
-        out = (v - m.reshape(shape)) * inv
+        f32 = jnp.float32
+        inv = 1.0 / jnp.sqrt(s.astype(f32) + epsilon)
         i = 0
+        scale = inv
         if weight is not None:
-            out = out * wb[i].reshape(shape)
+            scale = inv * wb[i].astype(f32)
             i += 1
+        shift = -m.astype(f32) * scale
         if bias is not None:
-            out = out + wb[i].reshape(shape)
-        return out
+            shift = shift + wb[i].astype(f32)
+        return (v * scale.reshape(shape).astype(v.dtype)
+                + shift.reshape(shape).astype(v.dtype))
 
     ins = [x, rm, rv]
     if weight is not None:
@@ -102,15 +133,20 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
             # Pallas-fused on TPU (falls back to jnp off-TPU / odd shapes)
             from ...ops import fused_layer_norm
             return fused_layer_norm(v, wb[0], wb[1], eps=epsilon)
-        mean = jnp.mean(v, axis=axes, keepdims=True)
-        var = jnp.var(v, axis=axes, keepdims=True)
-        out = (v - mean) / jnp.sqrt(var + epsilon)
+        f32 = jnp.float32
+        mean = jnp.mean(v, axis=axes, keepdims=True, dtype=f32)
+        if v.dtype == f32:
+            var = jnp.var(v, axis=axes, keepdims=True)
+        else:
+            var = _one_pass_var(v, axes, mean, keepdims=True)
+        inv = (1.0 / jnp.sqrt(var + epsilon)).astype(v.dtype)
+        out = (v - mean.astype(v.dtype)) * inv
         i = 0
         if weight is not None:
-            out = out * wb[i]
+            out = out * wb[i].astype(v.dtype)
             i += 1
         if bias is not None:
-            out = out + wb[i]
+            out = out + wb[i].astype(v.dtype)
         return out
 
     ins = [x]
@@ -133,16 +169,23 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None,
     shape[ch_axis] = x.shape[ch_axis]
 
     def fn(v, *wb):
-        mean = jnp.mean(v, axis=red_axes, keepdims=True)
-        var = jnp.var(v, axis=red_axes, keepdims=True)
-        out = (v - mean) / jnp.sqrt(var + eps)
+        f32 = jnp.float32
+        mean = jnp.mean(v, axis=red_axes, keepdims=True, dtype=f32)
+        if v.dtype == f32:
+            var = jnp.var(v, axis=red_axes, keepdims=True)
+        else:
+            var = _one_pass_var(v, red_axes, mean, keepdims=True)
+        # fold into per-(sample,channel) scale/shift applied in v.dtype
+        scale = 1.0 / jnp.sqrt(var + eps)
+        shift = -mean * scale
         i = 0
         if weight is not None:
-            out = out * wb[i].reshape(shape)
+            scale = scale * wb[i].reshape(shape).astype(f32)
+            shift = shift * wb[i].reshape(shape).astype(f32)
             i += 1
         if bias is not None:
-            out = out + wb[i].reshape(shape)
-        return out
+            shift = shift + wb[i].reshape(shape).astype(f32)
+        return v * scale.astype(v.dtype) + shift.astype(v.dtype)
 
     ins = [x]
     if weight is not None:
@@ -166,9 +209,15 @@ def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
         g = num_groups
         grouped = v_t.reshape((n, g, c // g) + v_t.shape[2:])
         axes = tuple(range(2, grouped.ndim))
-        mean = jnp.mean(grouped, axis=axes, keepdims=True)
-        var = jnp.var(grouped, axis=axes, keepdims=True)
-        out = ((grouped - mean) / jnp.sqrt(var + epsilon)).reshape(v_t.shape)
+        f32 = jnp.float32
+        mean = jnp.mean(grouped, axis=axes, keepdims=True, dtype=f32)
+        if grouped.dtype == f32:
+            var = jnp.var(grouped, axis=axes, keepdims=True)
+        else:
+            var = _one_pass_var(grouped, axes, mean, keepdims=True)
+        inv = (1.0 / jnp.sqrt(var + epsilon))
+        out = ((grouped - mean.astype(grouped.dtype))
+               * inv.astype(grouped.dtype)).reshape(v_t.shape)
         shape = [1] * v_t.ndim
         shape[1] = c
         i = 0

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Profile the ResNet-50 bench step on the real TPU chip.
+
+Dumps: compiled cost analysis (flops), optimized-HLO op census
+(conv dtypes, transposes, fusions, all casts), and timed variants
+(fwd-only, fwd+bwd, full step) to locate where step time goes.
+Findings feed bench.py / PERF.md (VERDICT round-1 item 3).
+"""
+import argparse
+import collections
+import os
+import re
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def census(hlo_text):
+    """Count ops by (opcode, dtype) in optimized HLO text."""
+    counts = collections.Counter()
+    for line in hlo_text.splitlines():
+        m = re.match(r'\s*(?:ROOT )?[%\w.-]+ = (\w+)\[([\d,]*)\][^ ]* (\w+)\(',
+                     line)
+        if m:
+            dtype, shape, opcode = m.group(1), m.group(2), m.group(3)
+            counts[(opcode, dtype)] += 1
+    return counts
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--batch', type=int, default=256)
+    p.add_argument('--image', type=int, default=224)
+    p.add_argument('--iters', type=int, default=20)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.vision.models.resnet import ResNet, BottleneckBlock
+    from paddle_tpu.parallel import ParallelTrainer
+    from paddle_tpu.distributed import fleet
+
+    log(f'device: {jax.devices()[0]}')
+    paddle.seed(0)
+    net = ResNet(BottleneckBlock, 50, num_classes=1000, data_format='NHWC')
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=net.parameters())
+    ce = nn.CrossEntropyLoss()
+    strategy = fleet.DistributedStrategy()
+    strategy.amp = True
+    strategy.amp_configs['use_pure_fp16'] = True
+    trainer = ParallelTrainer(net, opt, lambda out, y: ce(out, y),
+                              strategy=strategy)
+
+    rs = np.random.RandomState(0)
+    x = jax.device_put(rs.randn(args.batch, args.image, args.image, 3)
+                       .astype('float32'))
+    y = jax.device_put(rs.randint(0, 1000, size=(args.batch, 1))
+                       .astype('int64'))
+
+    # one step to build + place state
+    loss = trainer.step(x, y)
+    jax.block_until_ready(loss)
+
+    compiled = None
+    try:
+        # trainer caches the jitted fn; re-lower for analysis
+        fn = trainer._compiled
+        lowered = fn.lower(trainer.params, trainer.buffers,
+                           trainer.opt_state, jnp.asarray(1),
+                           jnp.asarray(0, jnp.uint32), x, y)
+        compiled = lowered.compile()
+    except Exception as e:
+        log('lower/compile for analysis failed:', repr(e))
+
+    if compiled is not None:
+        try:
+            ca = compiled.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            log('cost_analysis flops:', ca.get('flops'))
+            log('cost_analysis bytes accessed:', ca.get('bytes accessed'))
+        except Exception as e:
+            log('cost_analysis failed:', repr(e))
+        try:
+            txt = compiled.as_text()
+            c = census(txt)
+            log('--- optimized HLO op census (top 40) ---')
+            for (opcode, dtype), n in c.most_common(40):
+                log(f'{opcode:24s} {dtype:8s} {n}')
+            convs = [(k, v) for k, v in c.items() if k[0] == 'convolution']
+            log('--- convolutions by dtype ---', convs)
+            # biggest fusions / convs with shapes
+            log('--- conv lines (first 10) ---')
+            shown = 0
+            for line in txt.splitlines():
+                if ' convolution(' in line and shown < 10:
+                    log(line.strip()[:200])
+                    shown += 1
+        except Exception as e:
+            log('hlo census failed:', repr(e))
+
+    # timed: full step
+    t0 = time.time()
+    for _ in range(args.iters):
+        loss = trainer.step(x, y)
+    jax.block_until_ready(loss)
+    full = (time.time() - t0) / args.iters
+    log(f'full step: {full * 1000:.2f} ms '
+        f'({args.batch / full:.0f} imgs/s)')
+
+    # fwd-only (same AMP path), jitted separately
+    from paddle_tpu.jit import functional_call
+    from paddle_tpu import amp as amp_mod
+
+    params, buffers = net.functional_state()
+
+    def fwd(params, x):
+        with amp_mod.auto_cast(level='O2'):
+            out, _ = functional_call(net, params, buffers, (x,),
+                                     training=True,
+                                     key=jax.random.PRNGKey(0))
+        return out.astype(jnp.float32).mean()
+
+    jf = jax.jit(fwd)
+    jf(params, x).block_until_ready()
+    t0 = time.time()
+    for _ in range(args.iters):
+        r = jf(params, x)
+    r.block_until_ready()
+    fwd_t = (time.time() - t0) / args.iters
+    log(f'fwd-only: {fwd_t * 1000:.2f} ms')
+
+    # fwd+bwd (no optimizer)
+    jg = jax.jit(jax.grad(fwd))
+    jg(params, x)
+    jax.block_until_ready(jg(params, x))
+    t0 = time.time()
+    for _ in range(args.iters):
+        g = jg(params, x)
+    jax.block_until_ready(g)
+    bwd_t = (time.time() - t0) / args.iters
+    log(f'fwd+bwd: {bwd_t * 1000:.2f} ms')
+    log(f'optimizer+overhead: {(full - bwd_t) * 1000:.2f} ms')
+
+
+if __name__ == '__main__':
+    main()
