@@ -53,6 +53,8 @@ class DistributedStrategy:
         self.localsgd = False
         self.localsgd_configs = _Bag(k_steps=1)
         self.dgc = False
+        self.dgc_configs = _Bag(rampup_begin_step=0, rampup_step=1,
+                                sparsity=[0.999])
         self.a_sync = False
         self.a_sync_configs = _Bag(k_steps=-1)
         self.hybrid_configs = _Bag(dp_degree=-1, mp_degree=1, pp_degree=1,

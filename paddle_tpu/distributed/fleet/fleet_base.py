@@ -136,8 +136,9 @@ def distributed_optimizer(optimizer, strategy=None):
         from ...optimizer import Lamb
         if not isinstance(optimizer, Lamb):
             optimizer = Lamb(
-                learning_rate=optimizer.get_lr(),
+                learning_rate=optimizer._learning_rate,  # live schedule
                 parameters=optimizer._parameter_list,
+                grad_clip=optimizer._grad_clip,
                 lamb_weight_decay=strategy.lamb_configs.get(
                     'lamb_weight_decay', 0.01))
     if strategy.dgc:
@@ -146,10 +147,20 @@ def distributed_optimizer(optimizer, strategy=None):
         # (dense collective on ICI; see optimizer/dgc.py rationale)
         from ...optimizer import Momentum, DGCMomentum
         if isinstance(optimizer, Momentum):
+            cfg = strategy.dgc_configs or {}
+            # preserve the full original configuration: the live LR
+            # schedule object (not a flattened float), weight decay,
+            # grad clip, and nesterov all carry over
             optimizer = DGCMomentum(
-                learning_rate=optimizer.get_lr(),
+                learning_rate=optimizer._learning_rate,
                 momentum=optimizer._momentum,
-                parameters=optimizer._parameter_list)
+                parameters=optimizer._parameter_list,
+                rampup_begin_step=cfg.get('rampup_begin_step', 0),
+                rampup_step=cfg.get('rampup_step', 1),
+                sparsity=cfg.get('sparsity', (0.999,)),
+                use_nesterov=optimizer._nesterov,
+                weight_decay=optimizer._coupled_wd or None,
+                grad_clip=optimizer._grad_clip)
         else:
             import warnings
             warnings.warn(

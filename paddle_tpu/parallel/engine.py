@@ -412,7 +412,10 @@ class ParallelTrainer:
         import os
         from ..distributed.checkpoint import CheckpointManager
         mgr = getattr(self, '_ckpt_mgr', None)
-        if mgr is None or mgr.directory != os.path.abspath(directory):
+        if (mgr is None or mgr.directory != os.path.abspath(directory)
+                or mgr.keep != keep or mgr.async_save != async_save):
+            if mgr is not None:
+                mgr.wait()  # drain in-flight async saves before swapping
             mgr = CheckpointManager(directory, keep=keep,
                                     async_save=async_save)
             self._ckpt_mgr = mgr

@@ -170,16 +170,32 @@ def pipeline_value_and_grad(shared, stages, ids_mb, labels_mb, *, mesh,
         scale = 1.0 / (M * dp)
         d_sh = jax.tree_util.tree_map(lambda g: g * scale, d_sh)
         d_st = jax.tree_util.tree_map(lambda g: g * scale, d_st)
+        # shared params: stage 0 (embedding) and stage S-1 (head)
+        # contribute from different pp ranks — total over pp (this is
+        # also what ties wte's embedding+head gradients together)
+        d_sh = jax.lax.psum(d_sh, pp_axis)
         if dp > 1:
             d_sh = jax.lax.psum(d_sh, dp_axis)
             d_st = jax.lax.psum(d_st, dp_axis)
         if tp > 1:
-            # shared params are tp-replicated: total their partial grads.
-            d_sh = jax.lax.psum(d_sh, tp_axis)
-            # stage leaves: psum only tp-REPLICATED ones (spec w/o 'tp')
+            # Inside shard_map, the hand-rolled jax.vjp transposes the
+            # stage_fn's row-parallel `lax.psum(..., tp)` back into a
+            # psum, so every cotangent strictly upstream of such a psum
+            # arrives multiplied by tp, and cotangents on residual
+            # paths are per-rank partials whose tp-rank-sum is tp x the
+            # true cotangent (verified empirically vs jax.grad; see
+            # tests/test_pipeline.py gradient-parity tests).  Hence:
+            #   - tp-SHARDED leaves (spec mentions tp) sit upstream of
+            #     their block's psum: the local shard gradient is
+            #     exact x tp -> divide by tp;
+            #   - tp-REPLICATED leaves carry per-rank values whose sum
+            #     over tp is tp x the true gradient -> pmean.
+            inv_tp = 1.0 / tp
+            d_sh = jax.lax.pmean(d_sh, tp_axis)
             d_st = jax.tree_util.tree_map(
-                lambda g, spec: g if _spec_mentions(spec, tp_axis)
-                else jax.lax.psum(g, tp_axis),
+                lambda g, spec: g * inv_tp
+                if _spec_mentions(spec, tp_axis)
+                else jax.lax.pmean(g, tp_axis),
                 d_st, stage_specs)
         # re-attach the local pp dim for the out_spec gather
         d_st = jax.tree_util.tree_map(lambda g: g[None], d_st)

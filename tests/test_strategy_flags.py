@@ -90,6 +90,47 @@ class TestDGC:
         opt2 = fleet.distributed_optimizer(opt, strategy=s)
         assert isinstance(opt2, paddle.optimizer.DGCMomentum)
 
+    def test_dgc_swap_preserves_config(self):
+        """The DGC swap must not drop the schedule/decay/clip/nesterov
+        of the original Momentum, and must honor strategy.dgc_configs."""
+        s = fleet.DistributedStrategy()
+        s.dgc = True
+        s.dgc_configs['rampup_begin_step'] = 7
+        s.dgc_configs['rampup_step'] = 20
+        s.dgc_configs['sparsity'] = [0.75, 0.9375]
+        fleet.init(is_collective=True, strategy=s)
+        model = _mlp()
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1,
+                                              step_size=10)
+        clip = paddle.nn.ClipGradByGlobalNorm(1.0)
+        opt = paddle.optimizer.Momentum(
+            learning_rate=sched, momentum=0.9, use_nesterov=True,
+            weight_decay=1e-4, grad_clip=clip,
+            parameters=model.parameters())
+        opt2 = fleet.distributed_optimizer(opt, strategy=s)
+        assert isinstance(opt2, paddle.optimizer.DGCMomentum)
+        assert opt2._learning_rate is sched  # live schedule, not float
+        assert opt2._coupled_wd == 1e-4
+        assert opt2._grad_clip is clip
+        assert opt2._nesterov
+        assert opt2._rampup_begin == 7
+        assert opt2._rampup_step == 20
+        assert opt2._sparsity_seq == (0.75, 0.9375)
+
+    def test_dgc_sparsity_ramp(self):
+        """Sparsity walks the ramp list over rampup_step steps instead
+        of jumping straight to the final value."""
+        w = paddle.create_parameter([8], 'float32')
+        opt = paddle.optimizer.DGCMomentum(
+            learning_rate=0.1, parameters=[w], rampup_begin_step=0,
+            rampup_step=4, sparsity=[0.5, 0.99])
+        # first sparse step is t = rampup_begin + 1 = 1 and must see
+        # ramp entry 0, not jump ahead (off-by-one regression)
+        got = [float(np.asarray(opt._sparsity_at(t)))
+               for t in (1, 2, 3, 4, 5, 100)]
+        np.testing.assert_allclose(
+            got, [0.5, 0.5, 0.99, 0.99, 0.99, 0.99], rtol=1e-6)
+
     def test_dgc_warns_for_adam(self):
         s = fleet.DistributedStrategy()
         s.dgc = True
