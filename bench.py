@@ -1,12 +1,22 @@
 #!/usr/bin/env python
-"""Headline benchmark: ResNet-50 bf16(AMP) training throughput on one
-TPU chip — imgs/sec/chip (SURVEY.md §3 item 2).
+"""SURVEY.md §3 benchmark suite on one TPU chip.
 
-Baseline constant: the reference's V100-class ResNet-50 AMP number is
-~900 imgs/s/chip (no published figure ships in BASELINE.json, see
-SURVEY.md §3); vs_baseline = value / 900.
+Configs (SURVEY §3):
+  1. LeNet MNIST dygraph        — correctness anchor (imgs/sec).
+  2. ResNet-50 bf16(AMP) train  — HEADLINE imgs/sec/chip.
+  3. BERT-base pretrain bf16    — tokens/sec/chip.
+  5. Wide&Deep sparse           — examples/sec/chip.
+(4, GPT hybrid multi-chip, is exercised by __graft_entry__.dryrun_multichip.)
 
-Prints ONE JSON line to stdout; progress goes to stderr.
+Baseline constants (BASELINE.json ships no published numbers; these are
+documented V100-class reference points, vs_baseline = value/baseline):
+  ResNet-50 AMP   ~900    imgs/s/GPU   (reference's headline config)
+  BERT-base s128  ~50_000 tokens/s/GPU (~390 seq/s fp16)
+  Wide&Deep       ~200_000 examples/s  (GPU PS-mode)
+
+Prints ONE JSON line to stdout: the headline ResNet metric, with the
+other configs nested under "extras". Progress goes to stderr.
+Run a single config with --config {lenet,resnet,bert,widedeep}.
 """
 import argparse
 import json
@@ -15,25 +25,32 @@ import time
 
 import numpy as np
 
-BASELINE_IMGS_PER_SEC = 900.0
+BASELINES = {
+    'resnet': 900.0,        # imgs/s
+    'bert': 50_000.0,       # tokens/s
+    'widedeep': 200_000.0,  # examples/s
+    'lenet': 10_000.0,      # imgs/s (anchor only)
+}
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def main():
-    p = argparse.ArgumentParser()
-    p.add_argument('--smoke', action='store_true',
-                   help='tiny shapes, few iters (CI sanity)')
-    p.add_argument('--batch', type=int, default=256)
-    p.add_argument('--image', type=int, default=224)
-    p.add_argument('--iters', type=int, default=30)
-    p.add_argument('--warmup', type=int, default=5)
-    args = p.parse_args()
-    if args.smoke:
-        args.batch, args.image, args.iters, args.warmup = 32, 64, 4, 2
+def _time_steps(step, iters, *args):
+    """Run `step` iters times, force a host sync, return seconds."""
+    import jax
+    t0 = time.time()
+    out = None
+    for _ in range(iters):
+        out = step(*args)
+    jax.block_until_ready(out)
+    # belt & braces: block_until_ready + an actual host readback
+    float(np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[0])
+    return time.time() - t0
 
+
+def bench_resnet(smoke):
     import jax
     import paddle_tpu as paddle
     from paddle_tpu import nn
@@ -41,56 +58,216 @@ def main():
     from paddle_tpu.parallel import ParallelTrainer
     from paddle_tpu.distributed import fleet
 
-    log(f'device: {jax.devices()[0]}  batch={args.batch} '
-        f'image={args.image}')
-
+    batch, image, iters, warmup = (32, 64, 4, 2) if smoke else \
+        (256, 224, 30, 5)
     paddle.seed(0)
     net = ResNet(BottleneckBlock, 50, num_classes=1000,
                  data_format='NHWC')
     opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                                     parameters=net.parameters())
     ce = nn.CrossEntropyLoss()
-
     strategy = fleet.DistributedStrategy()
-    strategy.amp = True                       # bf16 compute (TPU AMP)
+    strategy.amp = True                        # bf16 compute (TPU AMP)
     strategy.amp_configs['use_pure_fp16'] = True   # O2: pure bf16
-
     trainer = ParallelTrainer(net, opt, lambda out, y: ce(out, y),
                               strategy=strategy)
-
     rs = np.random.RandomState(0)
-    # place the batch in HBM once — the bench measures compute, not the
-    # host link (real input pipelines double-buffer via the DataLoader)
+    # batch lives in HBM: the bench measures compute, not the host link
+    # (real input pipelines double-buffer via the DataLoader)
     x = jax.device_put(
-        rs.randn(args.batch, args.image, args.image, 3).astype('float32'))
+        rs.randn(batch, image, image, 3).astype('float32'))
     y = jax.device_put(
-        rs.randint(0, 1000, size=(args.batch, 1)).astype('int64'))
-
+        rs.randint(0, 1000, size=(batch, 1)).astype('int64'))
     t0 = time.time()
     loss = None
-    for i in range(args.warmup):
+    for _ in range(warmup):
         loss = trainer.step(x, y)
     jax.block_until_ready(loss)
-    log(f'warmup ({args.warmup} steps incl. compile): '
-        f'{time.time() - t0:.1f}s  loss={float(np.asarray(loss)):.4f}')
+    log(f'resnet warmup ({warmup} steps incl. compile): '
+        f'{time.time() - t0:.1f}s loss={float(np.asarray(loss)):.4f}')
+    dt = _time_steps(trainer.step, iters, x, y)
+    v = batch * iters / dt
+    log(f'resnet50: {iters} steps in {dt:.2f}s '
+        f'({dt / iters * 1000:.1f} ms/step, {v:.0f} imgs/s)')
+    return v
 
+
+def bench_bert(smoke):
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import nn  # noqa: F401  (keeps import order uniform)
+    from paddle_tpu.models.bert import bert_base, bert_tiny
+    from paddle_tpu.parallel import ParallelTrainer
+    from paddle_tpu.distributed import fleet
+
+    batch, seq, iters, warmup = (4, 64, 3, 2) if smoke else \
+        (64, 128, 20, 4)
+    paddle.seed(0)
+    model = bert_tiny() if smoke else bert_base(max_seq_len=seq,
+                                                dropout=0.0)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    strategy = fleet.DistributedStrategy()
+    strategy.amp = True
+    strategy.amp_configs['use_pure_fp16'] = True
+    trainer = ParallelTrainer(model, opt,
+                              lambda out, y: model.loss(out, y),
+                              strategy=strategy)
+    rs = np.random.RandomState(0)
+    V = model.config.vocab_size
+    ids = jax.device_put(
+        rs.randint(0, V, size=(batch, seq)).astype('int64'))
+    # MLM labels: predict 15% of positions, ignore the rest (-100)
+    lbl = np.where(rs.rand(batch, seq) < 0.15,
+                   rs.randint(0, V, size=(batch, seq)), -100)
+    lbl = jax.device_put(lbl.astype('int64'))
     t0 = time.time()
-    for i in range(args.iters):
+    loss = None
+    for _ in range(warmup):
+        loss = trainer.step(ids, lbl)
+    jax.block_until_ready(loss)
+    log(f'bert warmup ({warmup} steps incl. compile): '
+        f'{time.time() - t0:.1f}s loss={float(np.asarray(loss)):.4f}')
+    dt = _time_steps(trainer.step, iters, ids, lbl)
+    v = batch * seq * iters / dt
+    log(f'bert-base: {iters} steps in {dt:.2f}s '
+        f'({dt / iters * 1000:.1f} ms/step, {v:.0f} tokens/s)')
+    return v
+
+
+def bench_widedeep(smoke):
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.models.widedeep import WideDeep
+    from paddle_tpu.parallel import ParallelTrainer
+
+    batch, iters, warmup = (256, 3, 2) if smoke else (8192, 30, 5)
+    fields = [100_000] * 26          # criteo-like: 26 sparse fields
+    dense_dim = 13
+    paddle.seed(0)
+    model = WideDeep(fields, dense_dim=dense_dim, embed_dim=16,
+                     hidden=(400, 400, 400))
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    bce = nn.BCEWithLogitsLoss()
+    trainer = ParallelTrainer(model, opt,
+                              lambda out, y: bce(out, y), n_inputs=2)
+    rs = np.random.RandomState(0)
+    ids = jax.device_put(np.stack(
+        [rs.randint(0, f, size=batch) for f in fields],
+        axis=1).astype('int64'))
+    dense = jax.device_put(rs.rand(batch, dense_dim).astype('float32'))
+    y = jax.device_put(
+        rs.randint(0, 2, size=(batch, 1)).astype('float32'))
+    t0 = time.time()
+    loss = None
+    for _ in range(warmup):
+        loss = trainer.step(ids, dense, y)
+    jax.block_until_ready(loss)
+    log(f'widedeep warmup ({warmup} steps incl. compile): '
+        f'{time.time() - t0:.1f}s loss={float(np.asarray(loss)):.4f}')
+    dt = _time_steps(trainer.step, iters, ids, dense, y)
+    v = batch * iters / dt
+    log(f'wide&deep: {iters} steps in {dt:.2f}s '
+        f'({dt / iters * 1000:.1f} ms/step, {v:.0f} examples/s)')
+    return v
+
+
+def bench_lenet(smoke):
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.vision.models import LeNet
+    from paddle_tpu.parallel import ParallelTrainer
+
+    batch, iters, warmup = (64, 4, 2) if smoke else (256, 50, 5)
+    paddle.seed(0)
+    net = LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    ce = nn.CrossEntropyLoss()
+    trainer = ParallelTrainer(net, opt, lambda out, y: ce(out, y))
+    rs = np.random.RandomState(0)
+    x = jax.device_put(rs.randn(batch, 1, 28, 28).astype('float32'))
+    y = jax.device_put(
+        rs.randint(0, 10, size=(batch, 1)).astype('int64'))
+    loss = None
+    for _ in range(warmup):
         loss = trainer.step(x, y)
     jax.block_until_ready(loss)
-    dt = time.time() - t0
+    l0 = float(np.asarray(loss))
+    dt = _time_steps(trainer.step, iters, x, y)
+    loss = trainer.step(x, y)
+    l1 = float(np.asarray(loss))
+    assert np.isfinite(l1) and l1 < l0 * 1.5, (l0, l1)  # sanity anchor
+    v = batch * iters / dt
+    log(f'lenet: {iters} steps in {dt:.2f}s ({v:.0f} imgs/s) '
+        f'loss {l0:.3f}->{l1:.3f}')
+    return v
 
-    imgs_per_sec = args.batch * args.iters / dt
-    log(f'{args.iters} steps in {dt:.2f}s  '
-        f'({dt / args.iters * 1000:.1f} ms/step)  '
-        f'final loss={float(np.asarray(loss)):.4f}')
 
-    print(json.dumps({
-        'metric': 'resnet50_bf16_train_throughput',
-        'value': round(imgs_per_sec, 2),
-        'unit': 'imgs/sec/chip',
-        'vs_baseline': round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 4),
-    }))
+CONFIGS = {
+    'lenet': bench_lenet,
+    'resnet': bench_resnet,
+    'bert': bench_bert,
+    'widedeep': bench_widedeep,
+}
+
+UNITS = {
+    'lenet': 'imgs/sec/chip',
+    'resnet': 'imgs/sec/chip',
+    'bert': 'tokens/sec/chip',
+    'widedeep': 'examples/sec/chip',
+}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--smoke', action='store_true',
+                   help='tiny shapes, few iters (CI sanity)')
+    p.add_argument('--config', choices=list(CONFIGS) + ['all'],
+                   default='all')
+    args = p.parse_args()
+
+    import jax
+    log(f'device: {jax.devices()[0]}')
+
+    names = list(CONFIGS) if args.config == 'all' else [args.config]
+    results = {}
+    for name in names:
+        from paddle_tpu.distributed import env as dist_env
+        dist_env.set_mesh(None)
+        try:
+            v = CONFIGS[name](args.smoke)
+            results[name] = {
+                'value': round(v, 2), 'unit': UNITS[name],
+                'vs_baseline': round(v / BASELINES[name], 4)}
+        except Exception as e:  # one config failing must not hide rest
+            log(f'{name} FAILED: {e!r}')
+            results[name] = {'value': None, 'unit': UNITS[name],
+                             'error': repr(e)[:200]}
+
+    metric_names = {
+        'resnet': 'resnet50_bf16_train_throughput',
+        'bert': 'bert_base_bf16_pretrain_throughput',
+        'widedeep': 'widedeep_sparse_train_throughput',
+        'lenet': 'lenet_train_throughput',
+    }
+    # headline = resnet when it produced a number, else the first
+    # config that did (a failed-resnet dict must not win selection)
+    head_name = 'resnet' if (results.get('resnet') or {}).get('value') \
+        else next((k for k, r in results.items() if r.get('value')),
+                  'resnet')
+    head = results.get(head_name, {})
+    out = {
+        'metric': metric_names[head_name],
+        'value': head.get('value'),
+        'unit': head.get('unit', UNITS.get(head_name)),
+        'vs_baseline': head.get('vs_baseline'),
+        'extras': {k: v for k, v in results.items() if k != head_name},
+    }
+    print(json.dumps(out))
 
 
 if __name__ == '__main__':

@@ -23,9 +23,12 @@ from ..core import rng as rng_mod
 from ..core.autograd import no_grad
 from ..core.dtype import convert_dtype
 from ..nn.layer.layers import Layer
+from . import dy2static
+from .dy2static import convert_control_flow
 
 __all__ = ['to_static', 'not_to_static', 'save', 'load', 'functional_call',
-           'TranslatedLayer', 'StaticFunction', 'enable_to_static']
+           'TranslatedLayer', 'StaticFunction', 'enable_to_static',
+           'dy2static']
 
 _to_static_enabled = True
 
@@ -126,7 +129,11 @@ class StaticFunction:
         return tuple(tpos), tvals, tuple(static)
 
     def _make_jitted(self, tpos, static, n_args, training):
-        layer, fn = self._layer, self._dygraph_function
+        layer = self._layer
+        # data-dependent `if`/`while` in the source lower to
+        # lax.cond/lax.while_loop (no-op for unconvertible functions)
+        fn = convert_control_flow(self._dygraph_function) \
+            if layer is None else self._dygraph_function
 
         def pure(params, buffers, key, tvals):
             full = [None] * n_args
@@ -240,7 +247,8 @@ class _BoundForward(Layer):
         self._inner = layer
 
     def forward(self, *args, **kwargs):
-        return type(self._inner).forward(self._inner, *args, **kwargs)
+        fwd = convert_control_flow(type(self._inner).forward)
+        return fwd(self._inner, *args, **kwargs)
 
     # state delegation so functional capture sees the real tree
     def named_parameters(self, prefix='', include_sublayers=True):

@@ -1,0 +1,546 @@
+"""dy2static control-flow conversion: Python `if`/`while` on traced
+tensors -> `lax.cond` / `lax.while_loop`.
+
+Reference analogue:
+/root/reference/python/paddle/fluid/dygraph/dygraph_to_static/
+convert_operators.py:25,190 (`convert_while_loop`, `convert_ifelse`) and
+ast_transformer.py (the AST rewrite pass behind ProgramTranslator).
+There the rewrite emits cond/while ops into a ProgramDesc; TPU-native
+the same source rewrite emits `jax.lax.cond` / `jax.lax.while_loop`
+calls, which XLA compiles to on-device control flow — no host round
+trips, fully inside the jitted module.
+
+Semantics contract (mirrors the reference's converted operators):
+- a predicate that is a CONCRETE Python/numpy/jax value executes the
+  taken branch as plain Python — zero behavior change for static
+  control flow (`if self.training: ...`);
+- a predicate that is a traced tensor lowers to lax.cond/while_loop;
+  both branches then trace, and every variable assigned in either
+  branch must produce matching shapes/dtypes (the reference imposes
+  the same through its merge of branch outputs into select ops).
+
+Supported rewrites (v1): `if`/`elif`/`else` (including branches that
+`return`, with the statement tail folded into the implicit else),
+`while`, and `and`/`or`/`not` inside the tests.  Unsupported (the
+transformer bails out and the function runs with plain tracing, which
+is exactly the pre-conversion behavior): `break`/`continue` in a
+converted `while`, `return` inside a converted `while`, closures over
+free variables, and sources `inspect` cannot retrieve.
+"""
+import ast
+import functools
+import inspect
+import textwrap
+import types
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ['convert_ifelse', 'convert_while_loop', 'convert_logical_and',
+           'convert_logical_or', 'convert_logical_not',
+           'convert_control_flow', 'UNDEFINED']
+
+
+class _Undefined:
+    """Placeholder for names not yet bound when a converted block runs
+    (the reference uses UndefinedVar).  Any use raises a clear error."""
+
+    def __repr__(self):
+        return '<undefined variable>'
+
+    def _die(self, *a, **k):
+        raise NameError(
+            'variable used before assignment inside converted control '
+            'flow (assign it on every path before use)')
+
+    __getattr__ = __call__ = __add__ = __radd__ = __mul__ = _die
+    __bool__ = __iter__ = _die
+
+
+UNDEFINED = _Undefined()
+
+
+def _is_tensor(x):
+    from ..core.tensor import Tensor
+    return isinstance(x, Tensor)
+
+
+def _raw(x):
+    from ..core.tensor import Tensor
+    return x.value if isinstance(x, Tensor) else x
+
+
+def _is_traced(x):
+    x = _raw(x)
+    return isinstance(x, jax.core.Tracer)
+
+
+def _unwrap_tree(tree):
+    """Tensors -> jax arrays; python numbers -> jnp scalars (so they can
+    be loop carries); UNDEFINED flagged by the caller."""
+    from ..core.tensor import Tensor
+
+    def leaf(v):
+        if isinstance(v, Tensor):
+            return v.value
+        if isinstance(v, (bool, int, float, np.ndarray, np.generic)):
+            return jnp.asarray(v)
+        return v
+
+    return jax.tree_util.tree_map(leaf, tree,
+                                  is_leaf=lambda v: isinstance(v, Tensor))
+
+
+def _wrap_tree(tree):
+    from ..core.tensor import Tensor
+    return jax.tree_util.tree_map(
+        lambda v: Tensor._from_value(v) if isinstance(v, jax.Array) else v,
+        tree)
+
+
+def _check_defined(tree, where):
+    leaves = jax.tree_util.tree_leaves(
+        tree, is_leaf=lambda v: v is UNDEFINED)
+    if any(v is UNDEFINED for v in leaves):
+        raise ValueError(
+            f'converted {where}: every variable carried through tensor '
+            'control flow must be assigned before it and on every '
+            'branch (found an unassigned one)')
+
+
+def grab(local_ns, names):
+    """Fetch possibly-unbound locals for branch-function arguments."""
+    return tuple(local_ns.get(n, UNDEFINED) for n in names)
+
+
+def convert_ifelse(pred, true_fn, false_fn, args=()):
+    """`if pred: ... else: ...` -> lax.cond when pred is traced.
+
+    true_fn/false_fn take *args (the variables either branch assigns)
+    and return the tuple of their final values."""
+    p = _raw(pred)
+    if not _is_traced(p):
+        return true_fn(*args) if p else false_fn(*args)
+    p = jnp.asarray(p)
+    if p.ndim:
+        p = p.reshape(())  # single-element tensors act as scalars
+
+    def branch(fn):
+        def run(_):
+            out = fn(*args)
+            _check_defined(out, 'if/else')
+            return _unwrap_tree(out)
+        return run
+
+    out = jax.lax.cond(p.astype(jnp.bool_), branch(true_fn),
+                       branch(false_fn), None)
+    return _wrap_tree(out)
+
+
+def convert_while_loop(cond_fn, body_fn, loop_vars):
+    """`while cond: body` -> lax.while_loop when cond traces.
+
+    cond_fn/body_fn take *loop_vars; body_fn returns their new values.
+    Vars still UNDEFINED at entry are loop-local temporaries: they are
+    recomputed inside each iteration and are NOT part of the lax carry
+    (reading one after the loop, or across iterations before
+    reassignment, raises — the reference's UndefinedVar does the same)."""
+    pred0 = _raw(cond_fn(*loop_vars))
+    if not _is_traced(pred0):
+        while pred0:
+            loop_vars = body_fn(*loop_vars)
+            pred0 = _raw(cond_fn(*loop_vars))
+        return loop_vars
+    carried = [i for i, v in enumerate(loop_vars) if v is not UNDEFINED]
+    n = len(loop_vars)
+
+    def full(vs):
+        out = [UNDEFINED] * n
+        for slot, v in zip(carried, _wrap_tree(vs)):
+            out[slot] = v
+        return out
+
+    init = _unwrap_tree(tuple(loop_vars[i] for i in carried))
+
+    def cond(vs):
+        p = _raw(cond_fn(*full(vs)))
+        p = jnp.asarray(p)
+        return p.reshape(()).astype(jnp.bool_) if p.ndim else \
+            p.astype(jnp.bool_)
+
+    def body(vs):
+        out = body_fn(*full(vs))
+        picked = tuple(out[i] for i in carried)
+        _check_defined(picked, 'while')
+        return _unwrap_tree(picked)
+
+    res = _wrap_tree(jax.lax.while_loop(cond, body, init))
+    final = [UNDEFINED] * n
+    for slot, v in zip(carried, res):
+        final[slot] = v
+    return tuple(final)
+
+
+def convert_logical_and(x_fn, y_fn):
+    x = x_fn()
+    if not _is_traced(x):
+        return y_fn() if _raw(x) else x
+    y = y_fn()  # traced: both sides evaluate (no data-dependent skip)
+    return _wrap_tree(jnp.logical_and(jnp.asarray(_raw(x)),
+                                      jnp.asarray(_raw(y))))
+
+
+def convert_logical_or(x_fn, y_fn):
+    x = x_fn()
+    if not _is_traced(x):
+        return x if _raw(x) else y_fn()
+    y = y_fn()
+    return _wrap_tree(jnp.logical_or(jnp.asarray(_raw(x)),
+                                     jnp.asarray(_raw(y))))
+
+
+def convert_logical_not(x):
+    if not _is_traced(x):
+        return not _raw(x)
+    return _wrap_tree(jnp.logical_not(jnp.asarray(_raw(x))))
+
+
+# -- AST rewrite -------------------------------------------------------------
+
+class _Unsupported(Exception):
+    pass
+
+
+class _StoreCollector(ast.NodeVisitor):
+    """Names assigned within a statement block (not descending into
+    nested function/class definitions)."""
+
+    def __init__(self):
+        self.names = []
+
+    def _add(self, name):
+        if name not in self.names:
+            self.names.append(name)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self._add(node.id)
+
+    def visit_FunctionDef(self, node):
+        self._add(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self._add(node.name)
+
+    def visit_Lambda(self, node):
+        pass  # own scope
+
+
+def _stores(stmts):
+    c = _StoreCollector()
+    for s in stmts:
+        c.visit(s)
+    return c.names
+
+
+def _has(stmts, kinds):
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, kinds):
+                return True
+    return False
+
+
+def _returns_directly(stmts, kinds=(ast.Return,)):
+    """True if the block contains a Return not nested in a def."""
+    for s in stmts:
+        if isinstance(s, ast.Return):
+            return True
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        for node in ast.walk(s):
+            if isinstance(node, ast.Return):
+                return True
+    return False
+
+
+_JST = '__paddle_tpu_jst__'  # collision-safe module-globals binding
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _jst(attr):
+    return ast.Attribute(value=_name(_JST), attr=attr, ctx=ast.Load())
+
+
+def _call(func, args=None, keywords=None):
+    return ast.Call(func=func, args=args or [], keywords=keywords or [])
+
+
+def _tuple_of(names, ctx=None):
+    return ast.Tuple(elts=[_name(n, ctx or ast.Load()) for n in names],
+                     ctx=ctx or ast.Load())
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.n = 0
+
+    def _uid(self):
+        self.n += 1
+        return self.n
+
+    # tests: a and b / a or b / not a -> converted ops
+    def _convert_test(self, node):
+        if isinstance(node, ast.BoolOp):
+            vals = [self._convert_test(v) for v in node.values]
+            fn = ('convert_logical_and'
+                  if isinstance(node.op, ast.And) else 'convert_logical_or')
+            out = vals[0]
+            for v in vals[1:]:
+                out = _call(_jst(fn), [
+                    ast.Lambda(args=ast.arguments(
+                        posonlyargs=[], args=[], vararg=None, kwonlyargs=[],
+                        kw_defaults=[], kwarg=None, defaults=[]), body=out),
+                    ast.Lambda(args=ast.arguments(
+                        posonlyargs=[], args=[], vararg=None, kwonlyargs=[],
+                        kw_defaults=[], kwarg=None, defaults=[]), body=v)])
+            return out
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            return _call(_jst('convert_logical_not'),
+                         [self._convert_test(node.operand)])
+        return self.visit(node)
+
+    def _fn_def(self, name, argnames, body):
+        return ast.FunctionDef(
+            name=name,
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=a) for a in argnames],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=body, decorator_list=[], returns=None, type_params=[])
+
+    def _grab_call(self, names):
+        return _call(_jst('grab'), [
+            _call(_name('locals')),
+            ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                      ctx=ast.Load())])
+
+    def visit_If(self, node):
+        # handled by _transform_block (needs the statement tail)
+        return node
+
+    def visit_While(self, node):
+        return node
+
+    def visit_FunctionDef(self, node):
+        node.body = self._transform_block(node.body, fn_exit=True)
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_For(self, node):
+        node.body = self._transform_block(node.body, fn_exit=False)
+        node.orelse = self._transform_block(node.orelse, fn_exit=False)
+        return node
+
+    def visit_With(self, node):
+        node.body = self._transform_block(node.body, fn_exit=False)
+        return node
+
+    visit_AsyncWith = visit_With
+
+    def visit_Try(self, node):
+        node.body = self._transform_block(node.body, fn_exit=False)
+        node.orelse = self._transform_block(node.orelse, fn_exit=False)
+        node.finalbody = self._transform_block(node.finalbody,
+                                               fn_exit=False)
+        for h in node.handlers:
+            h.body = self._transform_block(h.body, fn_exit=False)
+        return node
+
+    def _rewrite_if(self, node, tail, fn_exit):
+        """Rewrite one If; returns (new_stmts, consumed_tail).
+
+        `fn_exit` is True when falling off the end of the current block
+        returns from the function (function top level, or a branch of an
+        already-return-folded if).  Only there may a partially-returning
+        `if` fold the statement tail into its implicit else — inside a
+        for/while/with/try body, fall-through continues the block, so
+        such an `if` is unconvertible (the whole function falls back)."""
+        uid = self._uid()
+        test = self._convert_test(node.test)
+
+        body_ret = _returns_directly(node.body)
+        else_ret = _returns_directly(node.orelse) if node.orelse else False
+
+        if body_ret or else_ret:
+            both = body_ret and else_ret
+            if not both and not fn_exit:
+                raise _Unsupported(
+                    'early return from an `if` inside a loop/with/try')
+            # fold the statement tail into the non-returning branch so
+            # both end in return; `if p: return X` + tail -> else = tail
+            raw_body, raw_else = list(node.body), list(node.orelse)
+            consumed = False
+            if not both:
+                if not node.orelse:
+                    raw_else = list(tail)
+                elif not body_ret:
+                    raw_body = raw_body + list(tail)
+                else:
+                    raw_else = raw_else + list(tail)
+                consumed = True
+            # params must cover everything either branch (incl. folded
+            # tail) assigns, or reassignments hit UnboundLocalError
+            stores = sorted(set(_stores(raw_body) + _stores(raw_else)))
+            body = self._transform_block(raw_body, fn_exit=True)
+            orelse = self._transform_block(raw_else, fn_exit=True)
+            if not body or not _returns_directly(body):
+                body = body + [ast.Return(value=ast.Constant(value=None))]
+            if not orelse or not _returns_directly(orelse):
+                orelse = orelse + [ast.Return(value=ast.Constant(value=None))]
+            tname, fname = f'__cf_true_{uid}', f'__cf_false_{uid}'
+            stmts = [
+                self._fn_def(tname, stores, body),
+                self._fn_def(fname, stores, orelse),
+                ast.Return(value=_call(_jst('convert_ifelse'), [
+                    test, _name(tname), _name(fname),
+                    self._grab_call(stores)])),
+            ]
+            return stmts, consumed
+
+        body = self._transform_block(node.body, fn_exit=False)
+        orelse = self._transform_block(node.orelse, fn_exit=False)
+        stores = sorted(set(_stores(node.body) + _stores(node.orelse)))
+        if not stores:
+            # pure side-effect-free branches (e.g. asserts) — keep as-is
+            node.test = test
+            node.body = body
+            node.orelse = orelse
+            return [node], False
+        tname, fname = f'__cf_true_{uid}', f'__cf_false_{uid}'
+        ret = ast.Return(value=_tuple_of(stores))
+        stmts = [
+            self._fn_def(tname, stores, body + [ret]),
+            self._fn_def(fname, stores,
+                         (orelse or [ast.Pass()]) + [ast.Return(
+                             value=_tuple_of(stores))]),
+            ast.Assign(
+                targets=[_tuple_of(stores, ast.Store())],
+                value=_call(_jst('convert_ifelse'), [
+                    test, _name(tname), _name(fname),
+                    self._grab_call(stores)])),
+        ]
+        return stmts, False
+
+    def _rewrite_while(self, node):
+        if _has(node.body, (ast.Break, ast.Continue)):
+            raise _Unsupported('break/continue in converted while')
+        if _returns_directly(node.body):
+            raise _Unsupported('return in converted while')
+        if node.orelse:
+            raise _Unsupported('while/else')
+        uid = self._uid()
+        test = self._convert_test(node.test)
+        body = self._transform_block(node.body)
+        stores = sorted(set(_stores(node.body)))
+        if not stores:
+            raise _Unsupported('while body assigns nothing')
+        cname, bname = f'__cf_cond_{uid}', f'__cf_body_{uid}'
+        stmts = [
+            self._fn_def(cname, stores, [ast.Return(value=test)]),
+            self._fn_def(bname, stores,
+                         body + [ast.Return(value=_tuple_of(stores))]),
+            ast.Assign(
+                targets=[_tuple_of(stores, ast.Store())],
+                value=_call(_jst('convert_while_loop'), [
+                    _name(cname), _name(bname), self._grab_call(stores)])),
+        ]
+        return stmts
+
+    def _transform_block(self, stmts, fn_exit=False):
+        out = []
+        i = 0
+        while i < len(stmts):
+            s = stmts[i]
+            if isinstance(s, ast.If):
+                new, consumed = self._rewrite_if(s, stmts[i + 1:],
+                                                 fn_exit)
+                out.extend(new)
+                if consumed:
+                    return out
+                i += 1
+                continue
+            if isinstance(s, ast.While):
+                out.extend(self._rewrite_while(s))
+                i += 1
+                continue
+            out.append(self.visit(s))
+            i += 1
+        return out
+
+
+def _transform_source(fn):
+    src = textwrap.dedent(inspect.getsource(fn))
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise _Unsupported('not a plain function definition')
+    fdef.decorator_list = []  # avoid re-applying @to_static etc.
+    tr = _ControlFlowTransformer()
+    fdef.body = tr._transform_block(fdef.body, fn_exit=True)
+    if tr.n == 0:
+        return None  # nothing to convert
+    ast.fix_missing_locations(tree)
+    module_code = compile(tree, filename=f'<dy2static {fn.__qualname__}>',
+                          mode='exec')
+    inner = next(c for c in module_code.co_consts
+                 if isinstance(c, types.CodeType)
+                 and c.co_name == fdef.name)
+    # bind against the LIVE module globals (not a snapshot) so later
+    # global reassignments / monkeypatches stay visible; only the _JST
+    # helper binding is added
+    import sys
+    g = fn.__globals__
+    g.setdefault(_JST, sys.modules[__name__])
+    new = types.FunctionType(inner, g, fn.__name__, fn.__defaults__)
+    new.__kwdefaults__ = fn.__kwdefaults__
+    new = functools.wraps(fn)(new)
+    return new
+
+
+_cache = {}
+
+
+def convert_control_flow(fn):
+    """AST-convert tensor control flow in `fn`; returns `fn` unchanged
+    when conversion is impossible (no source, closures, unsupported
+    constructs) — plain tracing then behaves exactly as before."""
+    if isinstance(fn, types.MethodType):
+        converted = convert_control_flow(fn.__func__)
+        if converted is fn.__func__:
+            return fn
+        return types.MethodType(converted, fn.__self__)
+    key = getattr(fn, '__code__', None)
+    if key is None:
+        return fn
+    if key in _cache:
+        return _cache[key]
+    out = fn
+    try:
+        if not fn.__code__.co_freevars:  # closures: bail (see docstring)
+            t = _transform_source(fn)
+            if t is not None:
+                out = t
+    except (_Unsupported, OSError, TypeError, SyntaxError, ValueError):
+        out = fn
+    _cache[key] = out
+    return out
