@@ -80,7 +80,7 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 
 def _conv_transpose(x, w, bias, stride, padding, output_padding, groups,
-                    dilation, n, data_format):
+                    dilation, n, data_format, output_size=None):
     channel_last = data_format in ('NHWC', 'NLC', 'NDHWC')
     stride = _tuple(stride, n)
     dilation = _tuple(dilation, n)
@@ -89,6 +89,28 @@ def _conv_transpose(x, w, bias, stride, padding, output_padding, groups,
     dn = (f"N{sp}C", f"OI{sp}", f"N{sp}C") if channel_last else \
         (f"NC{sp}", f"OI{sp}", f"NC{sp}")
     opad = _tuple(output_padding, n) if output_padding else (0,) * n
+    if output_size is not None:
+        # several input sizes map to one transposed-conv output; the
+        # caller disambiguates by requesting the exact size, realized
+        # as extra one-sided output padding over the minimal size
+        output_size = _tuple(output_size, n)
+        ww = wrap(w)
+        ksz_w = [ww.shape[2 + i] for i in range(n)]
+        in_sp = [wrap(x).shape[1 + i if channel_last else 2 + i]
+                 for i in range(n)]
+        pad0 = _padding(padding, n)
+        opad = []
+        for i in range(n):
+            kd = (ksz_w[i] - 1) * dilation[i]
+            base = ((in_sp[i] - 1) * stride[i] - pad0[i][0]
+                    - pad0[i][1] + kd + 1)
+            extra = int(output_size[i]) - base
+            if not 0 <= extra < max(stride[i], 1):
+                raise ValueError(
+                    f'output_size[{i}]={output_size[i]} not reachable '
+                    f'from input size {in_sp[i]} (minimal {base})')
+            opad.append(extra)
+        opad = tuple(opad)
 
     def fn(v, k, *maybe_b):
         # paddle transpose-kernel layout: [in_c, out_c/groups, *sp].
@@ -132,18 +154,21 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
                      output_size=None, data_format='NCL', name=None):
     return _conv_transpose(x, weight, bias, stride, padding, output_padding,
-                           groups, dilation, 1, data_format)
+                           groups, dilation, 1, data_format,
+                           output_size=output_size)
 
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
                      output_size=None, data_format='NCHW', name=None):
     return _conv_transpose(x, weight, bias, stride, padding, output_padding,
-                           groups, dilation, 2, data_format)
+                           groups, dilation, 2, data_format,
+                           output_size=output_size)
 
 
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
                      output_size=None, data_format='NCDHW', name=None):
     return _conv_transpose(x, weight, bias, stride, padding, output_padding,
-                           groups, dilation, 3, data_format)
+                           groups, dilation, 3, data_format,
+                           output_size=output_size)

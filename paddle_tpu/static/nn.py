@@ -1,19 +1,47 @@
 """static.nn — graph-mode layer helpers.
 
-Reference analogue: python/paddle/static/nn/common.py (fc, conv2d,
-batch_norm, embedding, ...).  Each helper builds the live Layer eagerly
-(parameters materialize immediately, like the reference's startup
-program) and applies it to the symbolic Variable, so the op lands in the
-current Program's DAG and compiles into the Executor's XLA module.
+Reference analogue: python/paddle/static/nn/__init__.py (~40 helpers
+from common.py + fluid/layers).  Each helper builds the live Layer
+eagerly (parameters materialize immediately, like the reference's
+startup program) and applies it to the symbolic Variable, so the op
+lands in the current Program's DAG and compiles into the Executor's XLA
+module.  Control flow (cond/while_loop/case/switch_case) lowers to
+lax.cond/lax.while_loop/lax.switch via the dy2static shims instead of
+the reference's conditional_block/while ProgramDesc ops; sequence_* ops
+live in static/sequence.py (padded-dense redesign of LoD).
 """
 import numpy as np
+import jax
+import jax.numpy as jnp
 
 from .. import nn as _nn
 from ..nn import functional as F
+from ..core.dispatch import apply
 from ..tensor import manipulation
+from ..tensor._helpers import wrap
+from ..tensor.creation import create_parameter  # noqa: F401 (re-export)
+from .sequence import (  # noqa: F401 (re-export, reference surface)
+    sequence_mask, sequence_conv, sequence_softmax, sequence_pool,
+    sequence_concat, sequence_first_step, sequence_last_step,
+    sequence_slice, sequence_expand, sequence_expand_as, sequence_pad,
+    sequence_unpad, sequence_reshape, sequence_scatter,
+    sequence_enumerate, sequence_reverse)
 
-__all__ = ['fc', 'conv2d', 'conv3d', 'batch_norm', 'embedding', 'dropout',
-           'layer_norm', 'prelu']
+__all__ = [
+    'fc', 'conv2d', 'conv3d', 'conv2d_transpose', 'conv3d_transpose',
+    'batch_norm', 'embedding', 'sparse_embedding', 'dropout',
+    'layer_norm', 'group_norm', 'instance_norm', 'data_norm',
+    'spectral_norm', 'prelu', 'create_parameter',
+    'bilinear_tensor_product', 'row_conv', 'nce', 'crf_decoding',
+    'deform_conv2d', 'py_func', 'multi_box_head',
+    'cond', 'while_loop', 'case', 'switch_case',
+    'sequence_mask', 'sequence_conv', 'sequence_softmax',
+    'sequence_pool', 'sequence_concat', 'sequence_first_step',
+    'sequence_last_step', 'sequence_slice', 'sequence_expand',
+    'sequence_expand_as', 'sequence_pad', 'sequence_unpad',
+    'sequence_reshape', 'sequence_scatter', 'sequence_enumerate',
+    'sequence_reverse',
+]
 
 
 def _apply_act(x, act):
@@ -99,3 +127,572 @@ def prelu(x, mode='all', param_attr=None, name=None):
     ch = 1 if mode == 'all' else x.shape[1]
     layer = _nn.PReLU(num_parameters=ch, weight_attr=param_attr)
     return layer(x)
+
+
+def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     dilation=1, groups=1, param_attr=None, bias_attr=None,
+                     act=None, data_format='NCHW', name=None,
+                     output_size=None):
+    ch_axis = 1 if data_format == 'NCHW' else -1
+    layer = _nn.Conv2DTranspose(
+        input.shape[ch_axis], num_filters, filter_size, stride=stride,
+        padding=padding, dilation=dilation, groups=groups,
+        weight_attr=param_attr, bias_attr=bias_attr,
+        data_format=data_format)
+    return _apply_act(layer(input, output_size), act)
+
+
+def conv3d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     dilation=1, groups=1, param_attr=None, bias_attr=None,
+                     act=None, data_format='NCDHW', name=None,
+                     output_size=None):
+    ch_axis = 1 if data_format == 'NCDHW' else -1
+    layer = _nn.Conv3DTranspose(
+        input.shape[ch_axis], num_filters, filter_size, stride=stride,
+        padding=padding, dilation=dilation, groups=groups,
+        weight_attr=param_attr, bias_attr=bias_attr,
+        data_format=data_format)
+    return _apply_act(layer(input, output_size), act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout='NCHW', name=None):
+    layer = _nn.GroupNorm(
+        groups, input.shape[1 if data_layout == 'NCHW' else -1],
+        epsilon=epsilon, weight_attr=param_attr, bias_attr=bias_attr,
+        data_format=data_layout)
+    return _apply_act(layer(input), act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    layer = _nn.InstanceNorm2D(input.shape[1], epsilon=epsilon,
+                               weight_attr=param_attr,
+                               bias_attr=bias_attr)
+    return layer(input)
+
+
+def sparse_embedding(input, size, padding_idx=None, param_attr=None,
+                     dtype='float32', is_test=False, name=None):
+    """Large-vocab embedding (reference: fluid/contrib sparse_embedding,
+    backed by the parameter server).  TPU-native: the table is a dense
+    mesh-shardable parameter; fleet's VocabParallelEmbedding (tp-sharded
+    rows) or incubate.HostOffloadEmbedding cover the beyond-HBM case."""
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype, name=name)
+
+
+def data_norm(input, epsilon=1e-4, param_attr=None, name=None,
+              moving_mean=None, moving_var=None,
+              do_model_average_for_mean_and_var=True,
+              slot_dim=-1, summary_decay_rate=0.9999999,
+              accumulators=None, is_test=False):
+    """Normalization by accumulated batch statistics WITHOUT scale/shift
+    (reference: fluid/layers/nn.py::data_norm — used by CTR models where
+    gamma/beta would destroy sparse-feature scale).
+
+    The three accumulators (batch_size, batch_sum, batch_square_sum)
+    normalize the CURRENT batch with the totals of PREVIOUS batches and
+    are then advanced by gradient-free running totals (the batch_norm
+    running-stat pattern).  Pass `accumulators=(n, s, sq)` to share
+    state across calls (each call with accumulators=None creates fresh
+    state); is_test=True freezes them."""
+    from ..nn import initializer as I
+    from ..core.autograd import no_grad
+    x = wrap(input)
+    D = x.shape[-1]
+    if accumulators is None:
+        size = create_parameter([D], 'float32',
+                                default_initializer=I.Constant(1.0))
+        summ = create_parameter([D], 'float32',
+                                default_initializer=I.Constant(0.0))
+        sqsum = create_parameter([D], 'float32',
+                                 default_initializer=I.Constant(1.0))
+    else:
+        size, summ, sqsum = (wrap(a) for a in accumulators)
+
+    def fn(v, n, s, sq):
+        mean = s / n
+        scale = jax.lax.rsqrt(jnp.maximum(sq / n - jnp.square(mean),
+                                          0.0) + epsilon)
+        return (v - mean) * scale
+
+    out = apply(fn, x, size, summ, sqsum, op_name='data_norm')
+    if not is_test:
+        with no_grad():
+            B = x.shape[0]
+            size.set_value(size + float(B))
+            summ.set_value(summ + x.detach().sum(axis=0))
+            sqsum.set_value(sqsum + (x.detach() * x.detach()).sum(axis=0))
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectral normalization: W / sigma_max(W), sigma estimated by
+    `power_iters` rounds of power iteration (reference:
+    fluid/layers/nn.py::spectral_norm with persistent u/v; here u is
+    re-estimated from a fixed seed each call — stateless and traceable,
+    converging to the same sigma)."""
+    w = wrap(weight)
+
+    def fn(wv):
+        mat = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+        u = jax.random.normal(jax.random.PRNGKey(0), (mat.shape[0],),
+                              jnp.float32).astype(mat.dtype)
+        v = None
+        for _ in range(max(int(power_iters), 1)):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ (mat @ v)
+        return wv / sigma
+
+    return apply(fn, w, op_name='spectral_norm')
+
+
+def bilinear_tensor_product(x, y, size, act=None, param_attr=None,
+                            bias_attr=None, name=None):
+    """out[b,k] = x[b] @ W[k] @ y[b] + b[k]
+    (reference: fluid/layers/nn.py::bilinear_tensor_product)."""
+    x, y = wrap(x), wrap(y)
+    dx, dy = x.shape[-1], y.shape[-1]
+    w = create_parameter([size, dx, dy], 'float32')
+    b = create_parameter([size], 'float32', is_bias=True)
+
+    def fn(xv, yv, wv, bv):
+        out = jnp.einsum('bi,kij,bj->bk', xv, wv, yv) + bv
+        return out
+
+    return _apply_act(apply(fn, x, y, wrap(w), wrap(b),
+                            op_name='bilinear_tensor_product'), act)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead convolution (reference: fluid/layers/nn.py::row_conv,
+    Deep Speech 2): out[t] = sum_{i=0..k} W[i] * x[t+i]."""
+    x = wrap(input)
+    D = x.shape[-1]
+    k = int(future_context_size)
+    w = create_parameter([k + 1, D], 'float32')
+
+    def fn(v, wv):
+        out = jnp.zeros_like(v)
+        T = v.shape[1]
+        for i in range(k + 1):
+            shifted = jnp.roll(v, -i, axis=1)
+            valid = (jnp.arange(T) < T - i)[None, :, None]
+            out = out + shifted * valid.astype(v.dtype) * wv[i]
+        return out
+
+    return _apply_act(apply(fn, x, wrap(w), op_name='row_conv'), act)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=5,
+        name=None, sampler='uniform', custom_dist=None, seed=0,
+        is_sparse=False):
+    """Noise-contrastive estimation loss (reference:
+    fluid/layers/nn.py::nce backed by the nce CUDA op).  TPU-native:
+    sample `num_neg_samples` noise classes per batch with jax.random,
+    one [B, 1+S] logits matmul against the gathered class rows, BCE
+    with the true class positive — fully traceable, fixed shapes."""
+    from ..core import rng as rng_mod
+    x, lb = wrap(input), wrap(label)
+    D = x.shape[-1]
+    w = create_parameter([num_total_classes, D], 'float32')
+    b = create_parameter([num_total_classes], 'float32', is_bias=True)
+    S = int(num_neg_samples)
+
+    def fn(v, y, wv, bv):
+        # key drawn inside the traced fn (the codebase's dropout
+        # pattern): eager calls re-sample, functional scopes thread it
+        key = rng_mod.next_key()
+        B = v.shape[0]
+        y = y.reshape(B).astype(jnp.int32)
+        if custom_dist is not None:
+            p = jnp.asarray(np.asarray(custom_dist, 'float32'))
+            neg = jax.random.categorical(
+                key, jnp.log(p + 1e-20), shape=(B, S))
+        elif sampler == 'log_uniform':
+            u = jax.random.uniform(key, (B, S))
+            neg = (jnp.exp(u * jnp.log(num_total_classes + 1.0)) - 1.0)
+            neg = jnp.clip(neg.astype(jnp.int32), 0,
+                           num_total_classes - 1)
+        else:
+            neg = jax.random.randint(key, (B, S), 0, num_total_classes)
+        cls = jnp.concatenate([y[:, None], neg], axis=1)   # [B, 1+S]
+        wc = wv[cls]                                       # [B,1+S,D]
+        bc = bv[cls]
+        logits = jnp.einsum('bd,bsd->bs', v, wc) + bc
+        labels = jnp.concatenate(
+            [jnp.ones((B, 1)), jnp.zeros((B, S))], axis=1)
+        ls = jax.nn.log_sigmoid(logits)
+        loss = -(labels * ls + (1 - labels) * (ls - logits))
+        return loss.sum(axis=1, keepdims=True)
+
+    return apply(fn, x, lb, wrap(w), wrap(b), op_name='nce')
+
+
+def crf_decoding(input, transition, seq_len=None, label=None, name=None):
+    """Viterbi decode (reference: fluid/layers/nn.py::crf_decoding on
+    linear_chain_crf's transition layout: row 0 = start scores, row 1 =
+    stop scores, rows 2.. = [N, N] transitions).  TPU-native: the
+    dynamic program runs as ONE lax.scan over time — no host loop.
+    input: [B, T, N] emissions, padded; seq_len: [B] or None."""
+    x, tr = wrap(input), wrap(transition)
+    B, T, N = x.shape
+    ins = [x, tr]
+    if seq_len is not None:
+        ins.append(wrap(seq_len))
+
+    def fn(em, trans, *rest):
+        start, stop, A = trans[0], trans[1], trans[2:]
+        lens = rest[0] if rest else jnp.full((B,), T, jnp.int32)
+
+        def step(carry, t):
+            alpha, back = carry
+            # alpha: [B, N] best score ending in tag j at prev step
+            scores = alpha[:, :, None] + A[None]        # [B, N, N]
+            best_prev = jnp.argmax(scores, axis=1)      # [B, N]
+            best = jnp.max(scores, axis=1) + em[:, t]
+            live = (t < lens)[:, None]
+            alpha2 = jnp.where(live, best, alpha)
+            return (alpha2, best_prev), best_prev
+
+        alpha0 = start[None] + em[:, 0]
+        (alpha, _), backs = jax.lax.scan(
+            step, (alpha0, jnp.zeros((B, N), jnp.int32)),
+            jnp.arange(1, T))
+        alpha = alpha + stop[None]
+        last = jnp.argmax(alpha, axis=-1)               # [B]
+
+        def walk(carry, t):
+            # t runs T-2 .. 0; backs[t] holds best_prev for step t+1;
+            # the emitted value is tag_{t+1}, the new carry is tag_t
+            tag = carry
+            prev = jnp.take_along_axis(backs[t], tag[:, None],
+                                       axis=1)[:, 0]
+            tag2 = jnp.where(t + 1 < lens, prev, tag)  # freeze padding
+            return tag2, tag
+
+        tag0, path_rev = jax.lax.scan(walk, last,
+                                      jnp.arange(T - 2, -1, -1))
+        # [tag_0] ++ reversed([tag_{T-1} .. tag_1]) = tags for t=0..T-1
+        full = jnp.concatenate([tag0[None], jnp.flip(path_rev, axis=0)],
+                               axis=0)
+        return jnp.swapaxes(full, 0, 1)  # int32 tags (x64 is off)
+
+    return apply(fn, *ins, op_name='crf_decoding')
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  name=None):
+    """Deformable conv v2 (v1 when mask is None).  Reference:
+    static/nn/common.py::deform_conv2d (deformable_conv CUDA op).
+    TPU-native: bilinear sampling at offset positions expressed as 4
+    static gathers per kernel tap, then one einsum over taps×channels —
+    everything batched, no scalar loops.
+
+    x: [B, Cin, H, W]; offset: [B, 2*dg*kh*kw, H, W]; mask (v2):
+    [B, dg*kh*kw, H, W].  Only deformable_groups=1, groups=1 here."""
+    assert groups == 1 and deformable_groups == 1, \
+        'deform_conv2d: groups/deformable_groups > 1 not implemented'
+    x, off = wrap(x), wrap(offset)
+    kh, kw = (filter_size, filter_size) if isinstance(filter_size, int) \
+        else filter_size
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+    Cin = x.shape[1]
+    w = create_parameter([num_filters, Cin, kh, kw], 'float32')
+    b = create_parameter([num_filters], 'float32', is_bias=True)
+    ins = [x, off, wrap(w), wrap(b)]
+    if mask is not None:
+        ins.append(wrap(mask))
+
+    def fn(v, o, wv, bv, *m):
+        B, C, H, W = v.shape
+        Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        o = o.reshape(B, kh * kw, 2, Ho, Wo)
+        base_y = (jnp.arange(Ho) * sh - ph)[None, :, None]
+        base_x = (jnp.arange(Wo) * sw - pw)[None, None, :]
+        taps = []
+        for i in range(kh):
+            for j in range(kw):
+                t = i * kw + j
+                py = base_y + i * dh + o[:, t, 0]
+                px = base_x + j * dw + o[:, t, 1]
+                y0 = jnp.floor(py)
+                x0 = jnp.floor(px)
+                wy = py - y0
+                wx = px - x0
+
+                # gather per corner: v is [B,C,H,W]; advanced indexing
+                # with the slice between index arrays lands [B,Ho,Wo,C]
+                def gather(yy, xx):
+                    yi = jnp.clip(yy.astype(jnp.int32), 0, H - 1)
+                    xi = jnp.clip(xx.astype(jnp.int32), 0, W - 1)
+                    inb = ((yy >= 0) & (yy <= H - 1) & (xx >= 0)
+                           & (xx <= W - 1)).astype(v.dtype)
+                    g = v[jnp.arange(B)[:, None, None], :, yi, xi]
+                    return g * inb[..., None]
+
+                g00 = gather(y0, x0)
+                g01 = gather(y0, x0 + 1)
+                g10 = gather(y0 + 1, x0)
+                g11 = gather(y0 + 1, x0 + 1)
+                wy_ = wy[..., None]
+                wx_ = wx[..., None]
+                tap = (g00 * (1 - wy_) * (1 - wx_)
+                       + g01 * (1 - wy_) * wx_
+                       + g10 * wy_ * (1 - wx_)
+                       + g11 * wy_ * wx_)          # [B,Ho,Wo,C]
+                if m:
+                    tap = tap * m[0].reshape(
+                        B, kh * kw, Ho, Wo)[:, t][..., None]
+                taps.append(tap)
+        stacked = jnp.stack(taps, axis=3)           # [B,Ho,Wo,k,C]
+        out = jnp.einsum('bhwkc,okc->bohw', stacked,
+                         wv.reshape(num_filters, Cin, kh * kw)
+                         .transpose(0, 2, 1)) + bv[None, :, None, None]
+        return out
+
+    return apply(fn, *ins, op_name='deform_conv2d')
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python op inside the compiled graph (reference:
+    fluid/layers/nn.py::py_func).  TPU-native: jax.pure_callback — XLA
+    calls back into the host; `out` provides the result template
+    (a Tensor or (shape, dtype))."""
+    xs = [wrap(v) for v in (x if isinstance(x, (list, tuple)) else [x])]
+
+    if hasattr(out, 'shape'):
+        res_shape = jax.ShapeDtypeStruct(tuple(out.shape),
+                                         np.dtype(str(out.dtype)
+                                                  .replace('paddle.', '')))
+    else:
+        shape, dtype = out
+        res_shape = jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
+
+    def fn(*vals):
+        def host(*arrs):
+            r = func(*arrs)
+            return np.asarray(r, res_shape.dtype)
+
+        if backward_func is None:
+            # no custom gradient: the callback is non-differentiable
+            # (pure_callback has no VJP) — fine off the loss path
+            return jax.pure_callback(host, res_shape, *vals)
+
+        # backward_func(*inputs, out, out_grad) -> grad(s) w.r.t inputs
+        # (the reference feeds x, out, out@GRAD to the backward op)
+        @jax.custom_vjp
+        def cb(*vs):
+            return jax.pure_callback(host, res_shape, *vs)
+
+        def fwd(*vs):
+            y = cb(*vs)
+            return y, (vs, y)
+
+        def bwd(res, ct):
+            vs, y = res
+            in_shapes = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                              for v in vs)
+
+            def bhost(ctv, yv, *arrs):
+                grads = backward_func(*arrs, yv, ctv)
+                if not isinstance(grads, (tuple, list)):
+                    grads = [grads]
+                return tuple(np.asarray(g, s.dtype)
+                             for g, s in zip(grads, in_shapes))
+
+            return jax.pure_callback(bhost, in_shapes, ct, y, *vs)
+
+        cb.defvjp(fwd, bwd)
+        return cb(*vals)
+
+    return apply(fn, *xs, op_name='py_func')
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, offset=0.5, flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None):
+    """SSD detection head (reference: fluid/layers/detection.py::
+    multi_box_head): per feature map a loc conv (4 coords per prior), a
+    conf conv (num_classes per prior) and SSD prior boxes.  Returns
+    (mbox_locs [B, P, 4], mbox_confs [B, P, C], boxes [P, 4],
+    variances [P, 4])."""
+    n = len(inputs)
+    if min_sizes is None:
+        assert min_ratio is not None and max_ratio is not None
+        step = int((max_ratio - min_ratio) / max(n - 2, 1))
+        min_sizes, max_sizes = [], []
+        for r in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes[:n - 1]
+        max_sizes = [base_size * 0.2] + max_sizes[:n - 1]
+
+    locs, confs, boxes, vars_ = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ar = aspect_ratios[i]
+        # priors per cell: min_size box + sqrt(min*max) box + one (two
+        # when flipped) per non-1.0 aspect ratio — must equal what the
+        # width/height generator below emits
+        num_priors = (1 + (1 if max_sizes else 0)
+                      + sum(1 for a in ar if a != 1.0)
+                      * (2 if flip else 1))
+        H, W = feat.shape[2], feat.shape[3]
+        loc = conv2d(feat, num_priors * 4, kernel_size, stride=stride,
+                     padding=pad)
+        conf = conv2d(feat, num_priors * num_classes, kernel_size,
+                      stride=stride, padding=pad)
+        B = feat.shape[0]
+        locs.append(manipulation.reshape(
+            manipulation.transpose(loc, [0, 2, 3, 1]), [B, -1, 4]))
+        confs.append(manipulation.reshape(
+            manipulation.transpose(conf, [0, 2, 3, 1]),
+            [B, -1, num_classes]))
+        # prior boxes (host-side constants, like the reference's
+        # prior_box op output)
+        img_h = image.shape[2] or base_size
+        img_w = image.shape[3] or base_size
+        step_h = steps[i] if steps else img_h / H
+        step_w = steps[i] if steps else img_w / W
+        cy = (np.arange(H) + offset) * step_h
+        cx = (np.arange(W) + offset) * step_w
+        widths, heights = [], []
+        smin, smax = min_sizes[i], (max_sizes[i] if max_sizes else None)
+        widths.append(smin)
+        heights.append(smin)
+        if smax:
+            s = np.sqrt(smin * smax)
+            widths.append(s)
+            heights.append(s)
+        for a in ar:
+            if a == 1.0:
+                continue
+            widths += [smin * np.sqrt(a)]
+            heights += [smin / np.sqrt(a)]
+            if flip:
+                widths += [smin / np.sqrt(a)]
+                heights += [smin * np.sqrt(a)]
+        pw = np.asarray(widths)
+        ph_ = np.asarray(heights)
+        cyg, cxg = np.meshgrid(cy, cx, indexing='ij')
+        bx = np.stack([
+            (cxg[..., None] - pw / 2) / img_w,
+            (cyg[..., None] - ph_ / 2) / img_h,
+            (cxg[..., None] + pw / 2) / img_w,
+            (cyg[..., None] + ph_ / 2) / img_h], axis=-1)
+        bx = bx.reshape(-1, 4).astype('float32')
+        if clip:
+            bx = np.clip(bx, 0.0, 1.0)
+        boxes.append(bx)
+        vars_.append(np.tile(np.asarray([0.1, 0.1, 0.2, 0.2], 'float32'),
+                             (bx.shape[0], 1)))
+
+    from ..tensor.creation import to_tensor
+    mbox_locs = manipulation.concat(locs, axis=1)
+    mbox_confs = manipulation.concat(confs, axis=1)
+    return (mbox_locs, mbox_confs,
+            to_tensor(np.concatenate(boxes, 0)),
+            to_tensor(np.concatenate(vars_, 0)))
+
+
+# -- control flow (lax-backed) ----------------------------------------------
+
+def _reject_program_variable(op, *vals):
+    """The lax-backed control-flow helpers read concrete/traced values;
+    a static-Program Variable has neither at build time.  Recording a
+    lax.cond as a single Program op would need sub-graph capture the
+    DAG doesn't model yet — reject loudly instead of crashing inside
+    dy2static (reference static graphs use their own
+    conditional_block/while ops)."""
+    from .program import Variable
+    for v in vals:
+        if isinstance(v, Variable):
+            raise NotImplementedError(
+                f'static.nn.{op} does not support static-Program '
+                'Variables yet: build the model eagerly or via '
+                'jit.to_static (dy2static), where tensor control flow '
+                'compiles to lax.cond/while_loop/switch')
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None,
+         return_names=None):
+    """paddle.static.nn.cond -> lax.cond (via the dy2static shim, so a
+    concrete python predicate short-circuits to plain execution).
+    Reference: fluid/layers/control_flow.py::cond."""
+    from ..jit.dy2static import convert_ifelse
+    _reject_program_variable('cond', pred)
+    t = true_fn if true_fn is not None else (lambda: None)
+    f = false_fn if false_fn is not None else (lambda: None)
+    return convert_ifelse(pred, t, f)
+
+
+def while_loop(cond_, body, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop -> lax.while_loop.
+    Reference: fluid/layers/control_flow.py::while_loop."""
+    from ..jit.dy2static import convert_while_loop
+    _reject_program_variable('while_loop', *loop_vars)
+    out = convert_while_loop(cond_, body, tuple(loop_vars))
+    return list(out)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First true predicate wins (reference:
+    fluid/layers/control_flow.py::case).  Lowers to a chain of
+    lax.cond; concrete predicates collapse at trace time."""
+    from ..jit.dy2static import convert_ifelse
+    if not pred_fn_pairs:
+        raise ValueError('case: pred_fn_pairs must be non-empty')
+    _reject_program_variable('case', *[p for p, _ in pred_fn_pairs])
+
+    def build(pairs):
+        (p, fn) = pairs[0]
+        if len(pairs) == 1:
+            fallback = default if default is not None else fn
+            return convert_ifelse(p, fn, fallback)
+        return convert_ifelse(p, fn, lambda: build(pairs[1:]))
+
+    return build(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Integer dispatch -> lax.switch (reference:
+    fluid/layers/control_flow.py::switch_case).  branch_fns: dict
+    {index: fn} or list of (index, fn) or list of fns."""
+    from ..jit.dy2static import _is_traced, _raw, _unwrap_tree, _wrap_tree
+    _reject_program_variable('switch_case', branch_index)
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        items = sorted((int(i), f) for i, f in branch_fns)
+    else:
+        items = list(enumerate(branch_fns))
+    idx_of = {i: k for k, (i, _) in enumerate(items)}
+    fns = [f for _, f in items]
+    if default is None:
+        default = fns[-1]
+    n = len(fns)
+
+    bi = _raw(branch_index)
+    if not _is_traced(bi):
+        return fns[idx_of[int(bi)]]() if int(bi) in idx_of else default()
+
+    # map the runtime index onto the dense fn table; unknown -> default
+    keys = jnp.asarray([i for i, _ in items])
+    dense = jnp.argmax(keys == jnp.asarray(bi).astype(keys.dtype))
+    known = jnp.any(keys == jnp.asarray(bi).astype(keys.dtype))
+    sel = jnp.where(known, dense, n)
+
+    branches = [(lambda f: (lambda _: _unwrap_tree(f())))(f)
+                for f in fns + [default]]
+    return _wrap_tree(jax.lax.switch(sel, branches, None))

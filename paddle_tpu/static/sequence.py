@@ -1,0 +1,374 @@
+"""Sequence ops — TPU-native redesign of the reference's LoD-tensor ops.
+
+Reference analogue: /root/reference/python/paddle/fluid/layers/
+sequence_lod.py (sequence_conv, sequence_pool, sequence_expand, ...).
+There variable-length sequences travel as LoD ("level of detail")
+tensors: a flat [sum(len_i), D] buffer plus host-side offsets, and each
+op's CPU/CUDA kernel walks the offsets.  LoD breaks XLA's static-shape
+compilation model, so this redesign uses the TPU idiom instead:
+
+    dense padded [B, T, ...] data  +  an explicit `seq_len` [B] tensor
+
+Every op takes `seq_len` where the reference consulted the LoD, masks
+with `arange(T) < seq_len[:, None]`, and compiles to fully static
+shapes.  `sequence_pad` converts a flat LoD-style buffer into this
+representation; `sequence_unpad` (host-side, eager only) converts back.
+Ops whose reference semantics *require* a data-dependent output shape
+(true LoD expansion) take static python sizes instead and say so in
+their docstrings.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..tensor._helpers import wrap
+
+__all__ = [
+    'sequence_mask', 'sequence_conv', 'sequence_softmax', 'sequence_pool',
+    'sequence_concat', 'sequence_first_step', 'sequence_last_step',
+    'sequence_slice', 'sequence_expand', 'sequence_expand_as',
+    'sequence_pad', 'sequence_unpad', 'sequence_reshape',
+    'sequence_scatter', 'sequence_enumerate', 'sequence_reverse',
+]
+
+
+def _mask(T, seq_len, dtype=jnp.bool_):
+    """[B, T] validity mask from lengths."""
+    return (jnp.arange(T)[None, :] < seq_len[:, None]).astype(dtype)
+
+
+def sequence_mask(seq_len, maxlen=None, dtype='bool'):
+    """[B] lengths -> [B, maxlen] mask (paddle.nn.functional analogue
+    lives here because every sequence_* op builds on it).
+
+    maxlen=None reads the concrete max length, which only exists
+    eagerly — under jit/static the output shape would be data
+    dependent, so pass maxlen explicitly there."""
+    ln = wrap(seq_len)
+    if maxlen is None:
+        try:
+            v = ln.value
+        except RuntimeError:
+            v = None  # static-Program Variable: no build-time value
+        if v is None or isinstance(v, jax.core.Tracer):
+            raise ValueError(
+                'sequence_mask(maxlen=None) needs a concrete seq_len; '
+                'under jit/to_static/static Programs the mask shape '
+                'must be static — pass maxlen explicitly')
+        maxlen = int(np.asarray(jax.device_get(v)).max())
+    maxlen = int(maxlen)
+    return apply(lambda v: _mask(maxlen, v, jnp.dtype(dtype)), ln,
+                 op_name='sequence_mask')
+
+
+def sequence_conv(x, seq_len, num_filters, filter_size=3, weight=None,
+                  bias=None, padding_start=None):
+    """Context-window conv over time.
+
+    Reference: sequence_lod.py::sequence_conv — gathers a window of
+    filter_size timesteps around each position (LoD-aware), multiplies
+    by a [filter_size*D, num_filters] weight.  Here: static pad+stack
+    of the window, positions beyond seq_len zeroed.
+    weight/bias: pass existing params, or None to create them.
+    """
+    from ..tensor.creation import create_parameter
+    x, ln = wrap(x), wrap(seq_len)
+    B, T, D = x.shape
+    if weight is None:
+        weight = create_parameter([filter_size * D, num_filters],
+                                  str(x.dtype))
+    w = wrap(weight)
+    start = -((filter_size - 1) // 2) if padding_start is None \
+        else padding_start
+    ins = [x, ln, w]
+    if bias is not None:
+        ins.append(wrap(bias))
+
+    def fn(v, lens, wv, *b):
+        m = _mask(T, lens, v.dtype)[..., None]
+        v = v * m
+        cols = []
+        for k in range(filter_size):
+            off = start + k
+            rolled = jnp.roll(v, -off, axis=1)
+            if off > 0:       # window reaches past the end: zero tail
+                keep = jnp.arange(T) < (T - off)
+            elif off < 0:     # window reaches before start: zero head
+                keep = jnp.arange(T) >= (-off)
+            else:
+                keep = None
+            if keep is not None:
+                rolled = rolled * keep[None, :, None].astype(v.dtype)
+            cols.append(rolled)
+        win = jnp.concatenate(cols, axis=-1)      # [B,T,filter*D]
+        out = jnp.einsum('btf,fn->btn', win, wv)
+        if b:
+            out = out + b[0]
+        return out * m
+
+    return apply(fn, *ins, op_name='sequence_conv')
+
+
+def sequence_softmax(x, seq_len):
+    """Softmax over the time axis, restricted to valid positions."""
+    x, ln = wrap(x), wrap(seq_len)
+    T = x.shape[1]
+
+    def fn(v, lens):
+        m = _mask(T, lens)
+        if v.ndim > 2:
+            mm = m.reshape(m.shape + (1,) * (v.ndim - 2))
+        else:
+            mm = m
+        neg = jnp.asarray(-1e9, v.dtype)
+        z = jnp.where(mm, v, neg)
+        z = jax.nn.softmax(z, axis=1)
+        return jnp.where(mm, z, 0.0).astype(v.dtype)
+
+    return apply(fn, x, ln, op_name='sequence_softmax')
+
+
+def sequence_pool(x, pool_type, seq_len, pad_value=0.0):
+    """sum/average/sqrt/max/min/first/last over valid timesteps.
+
+    Reference: sequence_lod.py::sequence_pool; empty sequences produce
+    pad_value like the reference."""
+    x, ln = wrap(x), wrap(seq_len)
+    T = x.shape[1]
+    pt = pool_type.lower()
+
+    def fn(v, lens):
+        m = _mask(T, lens, v.dtype)[..., None]
+        mb = _mask(T, lens)[..., None]
+        n = jnp.maximum(lens, 1).astype(v.dtype)[:, None]
+        if pt == 'sum':
+            out = (v * m).sum(axis=1)
+        elif pt == 'average':
+            out = (v * m).sum(axis=1) / n
+        elif pt == 'sqrt':
+            out = (v * m).sum(axis=1) / jnp.sqrt(n)
+        elif pt == 'max':
+            out = jnp.where(mb, v, -jnp.inf).max(axis=1)
+        elif pt == 'min':
+            out = jnp.where(mb, v, jnp.inf).min(axis=1)
+        elif pt == 'first':
+            out = v[:, 0]
+        elif pt == 'last':
+            idx = jnp.maximum(lens - 1, 0)
+            out = jnp.take_along_axis(
+                v, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        else:
+            raise ValueError(f'unknown pool_type {pool_type!r}')
+        empty = (lens == 0)[:, None]
+        return jnp.where(empty, jnp.asarray(pad_value, v.dtype), out)
+
+    return apply(fn, x, ln, op_name='sequence_pool')
+
+
+def sequence_first_step(x, seq_len):
+    return sequence_pool(x, 'first', seq_len)
+
+
+def sequence_last_step(x, seq_len):
+    return sequence_pool(x, 'last', seq_len)
+
+
+def sequence_concat(xs, seq_lens):
+    """Concatenate per-row sequences: row i of the result is
+    xs[0][i, :l0] ++ xs[1][i, :l1] ++ ...  padded to sum(T_k).
+
+    Returns (out, out_len).  Reference: sequence_lod.py::sequence_concat
+    on LoD tensors."""
+    xs = [wrap(x) for x in xs]
+    lns = [wrap(l) for l in seq_lens]
+    T_out = sum(int(x.shape[1]) for x in xs)
+
+    def fn(*args):
+        k = len(args) // 2
+        vs, lens = args[:k], args[k:]
+        total = sum(lens)
+        out = jnp.zeros((vs[0].shape[0], T_out) + vs[0].shape[2:],
+                        vs[0].dtype)
+        pos = jnp.arange(T_out)[None, :]                    # [1, T_out]
+        offset = jnp.zeros_like(lens[0])[:, None]
+        for v, ln in zip(vs, lens):
+            T_k = v.shape[1]
+            # positions [offset, offset+len) come from v[:, pos-offset]
+            rel = pos - offset                              # [B, T_out]
+            inside = (rel >= 0) & (rel < ln[:, None])
+            rel_c = jnp.clip(rel, 0, T_k - 1)
+            gathered = jnp.take_along_axis(
+                v, rel_c.reshape(rel_c.shape + (1,) * (v.ndim - 2))
+                .astype(jnp.int32), axis=1)
+            out = jnp.where(
+                inside.reshape(inside.shape + (1,) * (v.ndim - 2)),
+                gathered, out)
+            offset = offset + ln[:, None]
+        return out, total
+
+    outs = apply(fn, *(xs + lns), op_name='sequence_concat')
+    return outs
+
+
+def sequence_slice(x, seq_len, offset, length):
+    """Per-row slice [offset_i, offset_i+length_i) of the valid part.
+    Returns (out, new_len) with out padded to x's T."""
+    x, ln = wrap(x), wrap(seq_len)
+    off, lth = wrap(offset), wrap(length)
+    T = x.shape[1]
+
+    def fn(v, lens, o, m):
+        o = o.reshape(-1)
+        m_ = m.reshape(-1)
+        new_len = jnp.clip(jnp.minimum(m_, lens - o), 0, T)
+        pos = jnp.arange(T)[None, :]
+        src = jnp.clip(pos + o[:, None], 0, T - 1)
+        g = jnp.take_along_axis(
+            v, src.reshape(src.shape + (1,) * (v.ndim - 2))
+            .astype(jnp.int32), axis=1)
+        keep = pos < new_len[:, None]
+        return (g * keep.reshape(keep.shape + (1,) * (v.ndim - 2))
+                .astype(v.dtype), new_len)
+
+    return apply(fn, x, ln, off, lth, op_name='sequence_slice')
+
+
+def sequence_expand(x, times):
+    """Repeat each row of x `times` (a static python int) along a new
+    time dim.  The reference's LoD-driven per-row expansion has a
+    data-dependent output shape; the static-shape equivalent (each row
+    repeated the same number of times) covers the common broadcast-to-
+    candidates use; per-row counts need sequence_expand_as + masks."""
+    x = wrap(x)
+    t = int(times)
+    return apply(lambda v: jnp.repeat(v, t, axis=0), x,
+                 op_name='sequence_expand')
+
+
+def sequence_expand_as(x, y, y_len=None):
+    """Broadcast x [B, D] (one vector per sequence) over y's time dim:
+    out [B, T_y, D], zeroed past y_len."""
+    x, y = wrap(x), wrap(y)
+    T = y.shape[1]
+    ins = [x]
+    if y_len is not None:
+        ins.append(wrap(y_len))
+
+    def fn(v, *rest):
+        out = jnp.broadcast_to(v[:, None], (v.shape[0], T) + v.shape[1:])
+        if rest:
+            m = _mask(T, rest[0], v.dtype)
+            out = out * m.reshape(m.shape + (1,) * (v.ndim - 1))
+        return out
+
+    return apply(fn, *ins, op_name='sequence_expand_as')
+
+
+def sequence_pad(x_flat, seq_len, maxlen, pad_value=0.0):
+    """Flat LoD-style [sum(len), D] buffer -> padded [B, maxlen, D].
+
+    This is the bridge from ragged host data into the padded-dense
+    representation (reference: sequence_lod.py::sequence_pad).  maxlen
+    must be static (python int)."""
+    x, ln = wrap(x_flat), wrap(seq_len)
+    T = int(maxlen)
+
+    def fn(v, lens):
+        B = lens.shape[0]
+        starts = jnp.cumsum(lens) - lens              # exclusive cumsum
+        pos = jnp.arange(T)[None, :]
+        src = starts[:, None] + pos                   # [B, T]
+        src = jnp.clip(src, 0, v.shape[0] - 1).astype(jnp.int32)
+        out = v[src]                                  # [B, T, ...]
+        keep = pos < lens[:, None]
+        keep = keep.reshape(keep.shape + (1,) * (v.ndim - 1))
+        return jnp.where(keep, out, jnp.asarray(pad_value, v.dtype))
+
+    return apply(fn, x, ln, op_name='sequence_pad')
+
+
+def sequence_unpad(x, seq_len):
+    """Padded [B, T, D] -> flat [sum(len), D].  Output shape is data
+    dependent, so this is an EAGER-ONLY host helper (raises under jit),
+    mirroring how the reference materializes LoD on the host side."""
+    x, ln = wrap(x), wrap(seq_len)
+    if isinstance(x.value, jax.core.Tracer) or \
+            isinstance(ln.value, jax.core.Tracer):
+        raise RuntimeError(
+            'sequence_unpad has a data-dependent output shape and '
+            'cannot run inside jit; call it eagerly (host side), or '
+            'keep the padded representation + seq_len through the '
+            'compiled region')
+    v = np.asarray(jax.device_get(x.value))
+    lens = np.asarray(jax.device_get(ln.value)).astype(np.int64)
+    flat = np.concatenate([v[i, :lens[i]] for i in range(v.shape[0])],
+                          axis=0) if len(lens) else v[:0, 0]
+    from ..core.tensor import Tensor
+    return Tensor._from_value(jnp.asarray(flat))
+
+
+def sequence_reshape(x, new_dim):
+    """[B, T, D] -> [B, T*D/new_dim, new_dim] (reference reshapes the
+    flat LoD buffer; padded rows reshape identically)."""
+    x = wrap(x)
+    B, T, D = x.shape
+    assert (T * D) % int(new_dim) == 0, (T, D, new_dim)
+    return apply(lambda v: v.reshape(B, (T * D) // int(new_dim),
+                                     int(new_dim)),
+                 x, op_name='sequence_reshape')
+
+
+def sequence_scatter(x, index, updates, seq_len=None):
+    """out[b, index[b, k]] += updates[b, k] for valid k.
+
+    Reference: sequence_lod.py::sequence_scatter (LoD-grouped scatter).
+    seq_len masks trailing (padded) update slots."""
+    x, idx, upd = wrap(x), wrap(index), wrap(updates)
+    ins = [x, idx, upd]
+    if seq_len is not None:
+        ins.append(wrap(seq_len))
+
+    def fn(v, ix, up, *rest):
+        if rest:
+            K = ix.shape[1]
+            m = _mask(K, rest[0], up.dtype)
+            up = up * m.reshape(m.shape + (1,) * (up.ndim - 2))
+        B = v.shape[0]
+        bidx = jnp.broadcast_to(jnp.arange(B)[:, None], ix.shape)
+        return v.at[bidx, ix].add(up)
+
+    return apply(fn, *ins, op_name='sequence_scatter')
+
+
+def sequence_enumerate(x, win_size, pad_value=0):
+    """Sliding windows over id sequences: [B, T] -> [B, T, win_size],
+    positions past the end filled with pad_value."""
+    x = wrap(x)
+    T = x.shape[1]
+
+    def fn(v):
+        cols = []
+        for k in range(int(win_size)):
+            shifted = jnp.roll(v, -k, axis=1)
+            valid = jnp.arange(T) < (T - k)
+            cols.append(jnp.where(valid[None, :], shifted,
+                                  jnp.asarray(pad_value, v.dtype)))
+        return jnp.stack(cols, axis=-1)
+
+    return apply(fn, x, op_name='sequence_enumerate')
+
+
+def sequence_reverse(x, seq_len):
+    """Reverse each row's valid prefix; padding stays in place."""
+    x, ln = wrap(x), wrap(seq_len)
+    T = x.shape[1]
+
+    def fn(v, lens):
+        pos = jnp.arange(T)[None, :]
+        rev = lens[:, None] - 1 - pos
+        src = jnp.where(pos < lens[:, None], rev, pos)
+        src = src.reshape(src.shape + (1,) * (v.ndim - 2))
+        return jnp.take_along_axis(v, src.astype(jnp.int32), axis=1)
+
+    return apply(fn, x, ln, op_name='sequence_reverse')
