@@ -109,9 +109,11 @@ class BertLayer(nn.Layer):
 
     def forward(self, x, attn_mask=None):
         x = self.ln1(x + self.drop(self.attn(x, attn_mask)))
-        h = self.fc(x)
-        h = maybe_shard(h, ('dp', None, 'tp'))
-        h = F.gelu(h, approximate=True)
+        # single chip: fused matmul+GELU epilogue kernel whose backward
+        # recomputes the pre-activation instead of saving the [B,T,4H]
+        # tensor (ops/fused_gelu_linear.py); mesh: tp-sharded path
+        from ..ops.fused_gelu_linear import mlp_gelu
+        h = mlp_gelu(x, self.fc, shard_spec=('dp', None, 'tp'))
         h = self.proj(h)
         return self.ln2(x + self.drop(h))
 

@@ -162,9 +162,9 @@ class GPTMLP(nn.Layer):
         self.cfg = cfg
 
     def forward(self, x):
-        h = self.fc(x)
-        h = maybe_shard(h, ('dp', None, 'tp'))
-        h = F.gelu(h, approximate=True)
+        # fused matmul+GELU on single chip, tp-sharded path on a mesh
+        from ..ops.fused_gelu_linear import mlp_gelu
+        h = mlp_gelu(x, self.fc, shard_spec=('dp', None, 'tp'))
         h = self.proj(h)
         h = self.drop(h)
         return maybe_shard(h, _act_spec(self.cfg))
