@@ -180,10 +180,12 @@ def validate_strategy(strategy):
         return
     if strategy.a_sync:
         warnings.warn(
-            'strategy.a_sync (async parameter-server SGD) has no TPU '
-            'collective-mode counterpart; training runs synchronously. '
-            'See fleet/runtime docs: the PS substitute is mesh-sharded '
-            'embeddings (reference: parameter_server_runtime.py).',
+            'strategy.a_sync: the dense (collective) path stays '
+            'synchronous on TPU; asynchronous PS semantics exist for '
+            'SPARSE tables via incubate.HostOffloadEmbedding (host-'
+            'resident table, fire-and-forget host-side sparse update — '
+            'reference: fleet/runtime/the_one_ps.py). Use it for the '
+            'large-vocab embeddings that a_sync existed to serve.',
             UserWarning, stacklevel=2)
     if strategy.sharding:
         stage = strategy.sharding_configs.get('stage', 1)
@@ -252,8 +254,10 @@ def init_server(*args, **kwargs):
 
 def run_server():
     raise NotImplementedError(
-        "parameter-server runtime is replaced by mesh-sharded embeddings "
-        "on TPU (see paddle_tpu.incubate.sparse_embedding)")
+        "there is no separate server process on TPU: the PS runtime is "
+        "replaced by mesh-sharded embeddings (fleet VocabParallelEmbedding) "
+        "for in-HBM tables and incubate.HostOffloadEmbedding (host-resident "
+        "table + async host-side sparse update) for beyond-HBM vocabularies")
 
 
 def stop_worker():

@@ -1,0 +1,190 @@
+"""Host-offloaded large-vocab embedding — the TPU-native
+parameter-server substitute.
+
+Reference analogue:
+/root/reference/python/paddle/distributed/fleet/runtime/the_one_ps.py:417
+and parameter_server_runtime.py:32: sparse tables live on parameter
+servers (host DRAM), workers pull rows for the current batch and push
+gradients back asynchronously (`strategy.a_sync`).  TPU-native mapping:
+
+  * the table is a HOST numpy array — vocab size is bounded by host
+    DRAM, not the chip's HBM (the reason PS mode exists);
+  * the "pull" is a `jax.pure_callback` gather of exactly the batch's
+    rows — the only thing that ever enters HBM is a [B*S, D] slab;
+  * the "push" is an ordered `jax.experimental.io_callback` in the
+    custom VJP: the row gradients leave the device and the HOST applies
+    the optimizer rule (SGD or Adagrad) immediately — the device-side
+    optimizer never sees the table, exactly like a PS worker whose
+    dense step is separate from the server's sparse update;
+  * `a_sync` semantics: the host update is fire-and-forget from the
+    device's point of view (the next lookup may or may not observe it,
+    matching the reference's asynchronous SGD staleness contract).
+
+Works eagerly and inside jit/ParallelTrainer (callbacks ride the
+compiled module). Duplicate ids within a batch accumulate their
+gradients before the update (scatter-add), like the reference's sparse
+gradient merge.  Out-of-range ids raise (like nn.Embedding).
+
+SINGLE-HOST ONLY for now: each process would hold an independent table
+copy with no cross-host aggregation (the reference solves this with a
+central server); the constructor rejects jax.process_count() > 1.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..core.dispatch import apply
+from ..tensor._helpers import wrap
+
+__all__ = ['HostOffloadEmbedding']
+
+
+class HostOffloadEmbedding(Layer):
+    """Embedding with a host-resident table and host-side sparse update.
+
+    Args:
+        num_embeddings: vocab size (host-DRAM bounded).
+        embedding_dim:  row width.
+        learning_rate:  host-side update step size.
+        optimizer:      'sgd' or 'adagrad' (the reference PS's sparse
+                        optimizers; adagrad keeps a host accumulator).
+        trainable:      False freezes the table (pull-only).
+    """
+
+    def __init__(self, num_embeddings, embedding_dim, learning_rate=0.01,
+                 optimizer='sgd', trainable=True, dtype='float32',
+                 seed=None):
+        super().__init__()
+        if optimizer not in ('sgd', 'adagrad'):
+            raise ValueError(f'unsupported host optimizer {optimizer!r}')
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                'HostOffloadEmbedding is single-host: each process '
+                'would hold a divergent table copy (no cross-host '
+                'aggregation server); use fleet VocabParallelEmbedding '
+                'for multi-host sparse tables')
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+        self.learning_rate = float(learning_rate)
+        self.optimizer = optimizer
+        self.trainable = trainable
+        self._np_dtype = np.dtype(dtype)
+        if seed is None:
+            from ..core import rng as rng_mod
+            seed = rng_mod.get_seed()
+        rs = np.random.RandomState(seed)
+        bound = 1.0 / np.sqrt(self.embedding_dim)
+        self.table = rs.uniform(
+            -bound, bound,
+            (self.num_embeddings, self.embedding_dim)).astype(self._np_dtype)
+        self._accum = (np.zeros_like(self.table)
+                       if optimizer == 'adagrad' else None)
+        # a zero scalar device parameter that rides through the lookup:
+        # ids are integers, so without a float input on the op the
+        # autograd tape would mark the output stop_gradient and the
+        # backward push would never fire (it also keeps the op inside
+        # the compiled step's differentiated region under jit)
+        from ..nn import initializer as I
+        self._anchor = self.create_parameter(
+            [1], attr=None, dtype='float32',
+            default_initializer=I.Constant(0.0))
+        self._lookup = self._build_lookup()
+
+    # -- host side -----------------------------------------------------------
+    def _check_ids(self, ids):
+        ids = np.asarray(ids).astype(np.int64)
+        if ids.size and (ids.min() < 0
+                         or ids.max() >= self.num_embeddings):
+            raise ValueError(
+                f'HostOffloadEmbedding: id out of range [0, '
+                f'{self.num_embeddings}) — got '
+                f'[{ids.min()}, {ids.max()}]')
+        return ids
+
+    def _host_gather(self, ids):
+        return self.table[self._check_ids(ids)]
+
+    def _host_push(self, ids, grad):
+        """Sparse update: accumulate duplicate ids, apply the rule."""
+        ids = self._check_ids(ids).reshape(-1)
+        g = np.asarray(grad, self._np_dtype).reshape(
+            -1, self.embedding_dim)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((uniq.shape[0], self.embedding_dim),
+                          self._np_dtype)
+        np.add.at(merged, inv, g)
+        if self.optimizer == 'adagrad':
+            self._accum[uniq] += merged * merged
+            merged = merged / np.sqrt(self._accum[uniq] + 1e-10)
+        self.table[uniq] -= self.learning_rate * merged
+        return np.zeros((), np.int32)  # io_callback wants a result
+
+    # -- device side ---------------------------------------------------------
+    def _build_lookup(self):
+        D = self.embedding_dim
+        dt = jnp.dtype(self._np_dtype)
+
+        @jax.custom_vjp
+        def lookup(ids, anchor):
+            out = jax.ShapeDtypeStruct(ids.shape + (D,), dt)
+            # io_callback, NOT pure_callback: the table mutates between
+            # calls (pushes), so the read must not be CSE'd/cached or
+            # re-executed out of order (e.g. by jax.remat re-running the
+            # forward after later pushes landed)
+            from jax.experimental import io_callback
+            rows = io_callback(self._host_gather, out, ids,
+                               ordered=False)
+            # anchor is 0.0: keeps the op differentiable without
+            # perturbing the rows
+            return rows + anchor.astype(dt)
+
+        def fwd(ids, anchor):
+            return lookup(ids, anchor), ids
+
+        def bwd(ids, g):
+            if self.trainable:
+                from jax.experimental import io_callback
+                io_callback(self._host_push,
+                            jax.ShapeDtypeStruct((), jnp.int32),
+                            ids, g, ordered=True)
+            # integer primal -> float0 cotangent; zero for the anchor
+            ct = np.zeros(np.shape(ids), jax.dtypes.float0)
+            return (ct, jnp.zeros((1,), jnp.float32))
+
+        lookup.defvjp(fwd, bwd)
+        return lookup
+
+    def forward(self, ids):
+        ids = wrap(ids)
+        return apply(self._lookup, ids, self._anchor,
+                     op_name='host_offload_embedding')
+
+    # -- checkpointing (the table is host state, not a device param).
+    # get/set_extra_state is the Layer-system hook: the table travels in
+    # every PARENT model's state_dict under '<path>._extra_state', so
+    # whole-model save/restore keeps the embeddings.
+    def get_extra_state(self):
+        state = {'table': self.table.copy()}  # snapshot: pushes mutate
+        if self._accum is not None:
+            state['accum'] = self._accum.copy()
+        return state
+
+    def set_extra_state(self, state):
+        table = np.asarray(state['table'], self._np_dtype)
+        if table.shape != self.table.shape:
+            raise ValueError(
+                f'HostOffloadEmbedding table shape mismatch: checkpoint '
+                f'{table.shape} vs layer {self.table.shape}')
+        self.table = table.copy()
+        if self._accum is not None and 'accum' in state:
+            accum = np.asarray(state['accum'], self._np_dtype)
+            if accum.shape != self._accum.shape:
+                raise ValueError(
+                    f'HostOffloadEmbedding accum shape mismatch: '
+                    f'{accum.shape} vs {self._accum.shape}')
+            self._accum = accum.copy()
+
+    def extra_repr(self):
+        return (f'{self.num_embeddings}, {self.embedding_dim}, '
+                f'host-offloaded, opt={self.optimizer}')

@@ -222,6 +222,14 @@ class Layer:
             if leaf in owner._non_persistable_buffer_names:
                 continue
             dest[name] = b
+        # torch-style extra state: layers owning non-tensor state (e.g.
+        # a host-resident embedding table) expose it via
+        # get_extra_state/set_extra_state and it travels under
+        # '<prefix>._extra_state' in every parent's state_dict
+        for prefix, layer in self.named_sublayers(include_self=True):
+            if hasattr(layer, 'get_extra_state'):
+                key = (prefix + '.' if prefix else '') + '_extra_state'
+                dest[key] = layer.get_extra_state()
         return dest
 
     def _locate_owner(self, qualname):
@@ -240,6 +248,11 @@ class Layer:
                 missing.append(name)
                 continue
             src = state_dict[name]
+            if name.rsplit('.', 1)[-1] == '_extra_state':
+                owner = self._locate_owner(name)
+                if hasattr(owner, 'set_extra_state'):
+                    owner.set_extra_state(src)
+                continue
             v = src.value if isinstance(src, Tensor) else jnp.asarray(
                 np.asarray(src))
             if tuple(v.shape) != tuple(target.value.shape):

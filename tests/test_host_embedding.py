@@ -1,0 +1,154 @@
+"""HostOffloadEmbedding — the parameter-server substitute.
+
+Reference analogue: the sparse-table tests around
+fleet/runtime/the_one_ps.py (async push/pull of embedding rows);
+here the server is the host process itself.
+"""
+import numpy as np
+import pytest  # noqa: F401
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.incubate import HostOffloadEmbedding
+
+
+def _ids(*shape, hi=50, seed=0):
+    return np.random.RandomState(seed).randint(0, hi, shape) \
+        .astype('int64')
+
+
+class TestHostOffloadEmbedding:
+    def test_forward_matches_table(self):
+        emb = HostOffloadEmbedding(50, 8, seed=0)
+        ids = _ids(4, 3)
+        out = np.asarray(emb(paddle.to_tensor(ids)).numpy())
+        np.testing.assert_allclose(out, emb.table[ids], rtol=1e-6)
+
+    def test_backward_updates_host_table_sgd(self):
+        emb = HostOffloadEmbedding(50, 8, learning_rate=0.5, seed=0)
+        ids = np.asarray([[1, 2]], 'int64')
+        before = emb.table.copy()
+        out = emb(paddle.to_tensor(ids))
+        out.sum().backward()
+        # d(sum)/d(row) = 1 -> row -= lr * 1
+        np.testing.assert_allclose(emb.table[1], before[1] - 0.5,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(emb.table[2], before[2] - 0.5,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(emb.table[3], before[3], rtol=1e-7)
+
+    def test_duplicate_ids_accumulate(self):
+        emb = HostOffloadEmbedding(50, 4, learning_rate=1.0, seed=0)
+        ids = np.asarray([[7, 7, 7]], 'int64')
+        before = emb.table[7].copy()
+        emb(paddle.to_tensor(ids)).sum().backward()
+        np.testing.assert_allclose(emb.table[7], before - 3.0,
+                                   rtol=1e-5)
+
+    def test_adagrad_rule(self):
+        emb = HostOffloadEmbedding(50, 4, learning_rate=1.0,
+                                   optimizer='adagrad', seed=0)
+        ids = np.asarray([[5]], 'int64')
+        before = emb.table[5].copy()
+        emb(paddle.to_tensor(ids)).sum().backward()
+        # g=1: acc=1, step = 1/sqrt(1+eps) ~= 1
+        np.testing.assert_allclose(emb.table[5], before - 1.0,
+                                   rtol=1e-4)
+        emb(paddle.to_tensor(ids)).sum().backward()
+        # second hit: acc=2, step = 1/sqrt(2)
+        np.testing.assert_allclose(
+            emb.table[5], before - 1.0 - 1.0 / np.sqrt(2), rtol=1e-4)
+
+    def test_frozen_table(self):
+        emb = HostOffloadEmbedding(50, 4, trainable=False, seed=0)
+        ids = np.asarray([[3]], 'int64')
+        before = emb.table.copy()
+        emb(paddle.to_tensor(ids)).sum().backward()
+        np.testing.assert_allclose(emb.table, before, rtol=1e-7)
+
+    def test_trains_inside_jitted_trainer(self):
+        """The PS pattern end-to-end: dense params update on device,
+        the sparse table updates host-side through the compiled step's
+        callbacks — loss decreases."""
+        from paddle_tpu.parallel import ParallelTrainer
+        paddle.seed(0)
+
+        class CTR(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = HostOffloadEmbedding(1000, 8,
+                                                learning_rate=0.2,
+                                                seed=1)
+                self.mlp = nn.Sequential(nn.Linear(3 * 8, 16),
+                                         nn.ReLU(), nn.Linear(16, 1))
+
+            def forward(self, ids):
+                e = self.emb(ids)
+                B = e.shape[0]
+                from paddle_tpu.tensor import manipulation
+                return self.mlp(manipulation.reshape(e, [B, -1]))
+
+        model = CTR()
+        opt = paddle.optimizer.Adam(1e-2,
+                                    parameters=model.parameters())
+        bce = nn.BCEWithLogitsLoss()
+        tr = ParallelTrainer(model, opt, lambda o, y: bce(o, y))
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, 1000, (64, 3)).astype('int64')
+        y = (ids.sum(-1, keepdims=True) % 2).astype('float32')
+        table0 = model.emb.table.copy()
+        first = float(np.asarray(tr.step(ids, y)))
+        for _ in range(30):
+            last = float(np.asarray(tr.step(ids, y)))
+        assert last < first, (first, last)
+        assert np.abs(model.emb.table - table0).max() > 1e-4  # host push ran
+
+    def test_state_dict_roundtrip(self):
+        emb = HostOffloadEmbedding(20, 4, optimizer='adagrad', seed=0)
+        emb(paddle.to_tensor(_ids(2, 2, hi=20))).sum().backward()
+        state = emb.state_dict()
+        assert '_extra_state' in state
+        emb2 = HostOffloadEmbedding(20, 4, optimizer='adagrad', seed=9)
+        emb2.set_state_dict(state)
+        np.testing.assert_allclose(emb2.table, emb.table, rtol=1e-7)
+        np.testing.assert_allclose(emb2._accum, emb._accum, rtol=1e-7)
+
+    def test_parent_model_state_dict_carries_table(self):
+        """The table must survive a WHOLE-MODEL save/restore (it rides
+        parents' state_dicts via the extra-state hook), and the saved
+        snapshot must not alias the live mutating table."""
+
+        class M(nn.Layer):
+            def __init__(self, seed):
+                super().__init__()
+                self.emb = HostOffloadEmbedding(30, 4, seed=seed,
+                                                learning_rate=0.5)
+                self.head = nn.Linear(4, 1)
+
+            def forward(self, ids):
+                return self.head(self.emb(ids))
+
+        paddle.seed(0)
+        m = M(seed=1)
+        state = m.state_dict()
+        assert 'emb._extra_state' in state
+        snap = state['emb._extra_state']['table'].copy()
+        # keep training: the snapshot must not follow the live table
+        m(paddle.to_tensor(_ids(4, 2, hi=30))).sum().backward()
+        np.testing.assert_allclose(state['emb._extra_state']['table'],
+                                   snap, rtol=1e-7)
+        m2 = M(seed=7)
+        m2.set_state_dict(state)
+        np.testing.assert_allclose(m2.emb.table, snap, rtol=1e-7)
+
+    def test_oob_ids_raise(self):
+        emb = HostOffloadEmbedding(10, 4, seed=0)
+        with pytest.raises(Exception, match='out of range'):
+            np.asarray(emb(paddle.to_tensor(
+                np.asarray([[11]], 'int64'))).numpy())
+
+    def test_extra_state_shape_mismatch_raises(self):
+        emb = HostOffloadEmbedding(20, 4, seed=0)
+        emb2 = HostOffloadEmbedding(20, 8, seed=0)
+        with pytest.raises(ValueError, match='shape mismatch'):
+            emb2.set_extra_state(emb.get_extra_state())
