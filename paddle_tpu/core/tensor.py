@@ -166,9 +166,15 @@ class Tensor:
         return id(self)
 
     def __repr__(self):
-        grad_flag = f", stop_gradient={self.stop_gradient}"
-        return (f"Tensor(shape={self.shape}, dtype={dtype_name(self.dtype)}"
-                f"{grad_flag},\n       {np.asarray(self.numpy())!r})")
+        try:
+            # honors paddle.set_printoptions (tensor/to_string.py)
+            from ..tensor.to_string import to_string
+            return to_string(self)
+        except Exception:
+            grad_flag = f", stop_gradient={self.stop_gradient}"
+            return (f"Tensor(shape={self.shape}, "
+                    f"dtype={dtype_name(self.dtype)}"
+                    f"{grad_flag},\n       {np.asarray(self.numpy())!r})")
 
     # -- dtype / value management -------------------------------------------
     def astype(self, dtype):

@@ -9,7 +9,9 @@ import numpy as np
 
 from ..core.tensor import Tensor
 
-from . import creation, math, manipulation, linalg, logic, random, search, stat
+from . import (creation, math, manipulation, linalg, logic, random,
+               search, stat, array)
+from . import to_string as _to_string_mod
 from .creation import *      # noqa: F401,F403
 from .math import *          # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
@@ -18,16 +20,20 @@ from .logic import *         # noqa: F401,F403
 from .random import *        # noqa: F401,F403
 from .search import *        # noqa: F401,F403
 from .stat import *          # noqa: F401,F403
+from .array import *         # noqa: F401,F403
+from .to_string import *     # noqa: F401,F403
 
 __all__ = (creation.__all__ + math.__all__ + manipulation.__all__ +
            linalg.__all__ + logic.__all__ + random.__all__ +
-           search.__all__ + stat.__all__)
+           search.__all__ + stat.__all__ + array.__all__ +
+           _to_string_mod.__all__)
 
 # stat wins over math for `mean` etc. — patch order matters (last wins),
 # matching the reference where paddle.mean is the stat reduce_mean.
 _METHOD_MODULES = [math, manipulation, linalg, logic, search, stat]
 
-_SKIP_METHODS = {'is_tensor', 'meshgrid', 'einsum', 'multi_dot'}
+_SKIP_METHODS = {'is_tensor', 'meshgrid', 'einsum', 'multi_dot',
+                 'broadcast_shape'}
 
 
 def _patch_methods():

@@ -1,0 +1,61 @@
+"""TensorArray ops — reference: python/paddle/tensor/array.py
+(create_array / array_read / array_write / array_length over the
+C++ LoDTensorArray).
+
+TPU-native: in eager mode the array is a plain Python list of Tensors.
+Inside traced control flow (lax.while_loop/scan), a Python list cannot
+be a carry of unknown length — use a pre-sized dense Tensor with
+`paddle.zeros([n, ...])` + `scatter_`/indexing instead (static shapes
+are what XLA compiles); these helpers are the dygraph/compatibility
+surface.
+"""
+from ..core.tensor import Tensor
+from ._helpers import wrap
+
+__all__ = ['create_array', 'array_read', 'array_write', 'array_length']
+
+
+def _idx(i):
+    import numpy as np
+    import jax
+    if isinstance(i, Tensor):
+        i = i.value
+    if isinstance(i, jax.core.Tracer):
+        raise ValueError(
+            'TensorArray indices must be concrete (python int or eager '
+            'tensor); inside jit use a pre-sized dense tensor instead '
+            '(see paddle_tpu.tensor.array docstring)')
+    return int(np.asarray(i))
+
+
+def create_array(dtype='float32', initialized_list=None):
+    arr = []
+    if initialized_list is not None:
+        arr.extend(wrap(v) for v in initialized_list)
+    return arr
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = []
+    i = _idx(i)
+    x = wrap(x)
+    if i > len(array):
+        raise IndexError(
+            f'array_write index {i} past the array length {len(array)}: '
+            'TensorArray grows by appending (i == length) or '
+            'overwriting (i < length), like the reference '
+            'LoDTensorArray')
+    if i == len(array):
+        array.append(x)
+    else:
+        array[i] = x
+    return array
+
+
+def array_read(array, i):
+    return array[_idx(i)]
+
+
+def array_length(array):
+    return len(array)

@@ -184,3 +184,12 @@ def cond(x, p=None, name=None):
 def matrix_rank(x, tol=None, hermitian=False, name=None):
     return napply(lambda v: jnp.linalg.matrix_rank(v, tol=tol), wrap(x),
                   op_name='matrix_rank')
+
+
+def inverse(x, name=None):
+    """Matrix inverse (reference: tensor/math.py::inverse); alias of
+    linalg.inv with batched support from jnp."""
+    return inv(x)
+
+
+__all__ += ['inverse']

@@ -302,3 +302,36 @@ def atleast_3d(*inputs, name=None):
     outs = [apply(jnp.atleast_3d, wrap(t), op_name='atleast_3d')
             for t in inputs]
     return outs[0] if len(outs) == 1 else outs
+
+
+# -- reference long-tail: in-place view variants -----------------------------
+# (python/paddle/tensor/manipulation.py — trailing-underscore ops; the
+# tape edge survives via _snapshot/_replace)
+
+def reshape_(x, shape, name=None):
+    x._replace(reshape(x._snapshot(), shape))
+    return x
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    x._replace(flatten(x._snapshot(), start_axis, stop_axis))
+    return x
+
+
+def squeeze_(x, axis=None, name=None):
+    x._replace(squeeze(x._snapshot(), axis))
+    return x
+
+
+def unsqueeze_(x, axis, name=None):
+    x._replace(unsqueeze(x._snapshot(), axis))
+    return x
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    x._replace(scatter(x._snapshot(), index, updates,
+                       overwrite=overwrite))
+    return x
+
+
+__all__ += ['reshape_', 'flatten_', 'squeeze_', 'unsqueeze_', 'scatter_']

@@ -260,3 +260,75 @@ def diff(x, n=1, axis=-1, name=None):
 def take(x, index, mode='raise', name=None):
     return apply(lambda v, i: jnp.take(v.ravel(), i.ravel(), mode=mode)
                  .reshape(i.shape), wrap(x), wrap(index), op_name='take')
+
+
+# -- reference long-tail: in-place variants, complex parts, misc -------------
+# (python/paddle/tensor/math.py — the trailing-underscore ops mutate in
+# place but keep the tape edge via _snapshot/_replace, like multiply_)
+
+def add_(x, y, name=None):
+    x._replace(add(x._snapshot(), y))
+    return x
+
+
+def subtract_(x, y, name=None):
+    x._replace(subtract(x._snapshot(), y))
+    return x
+
+
+def clip_(x, min=None, max=None, name=None):
+    x._replace(clip(x._snapshot(), min=min, max=max))
+    return x
+
+
+_scale_fn = scale
+
+
+def scale_(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+           name=None):
+    x._replace(_scale_fn(x._snapshot(), scale=scale, bias=bias,
+                         bias_after_scale=bias_after_scale, act=act))
+    return x
+
+
+def tanh_(x, name=None):
+    x._replace(tanh(x._snapshot()))
+    return x
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a list of tensors (reference: sum op add_n)."""
+    if not isinstance(inputs, (list, tuple)):
+        return wrap(inputs).clone()
+    out = wrap(inputs[0])
+    for t in inputs[1:]:
+        out = add(out, t)
+    return out
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.trace(v, offset=offset, axis1=axis1,
+                                     axis2=axis2), wrap(x),
+                 op_name='trace')
+
+
+def conj(x, name=None):
+    return apply(jnp.conj, wrap(x), op_name='conj')
+
+
+def real(x, name=None):
+    return apply(jnp.real, wrap(x), op_name='real')
+
+
+def imag(x, name=None):
+    return apply(jnp.imag, wrap(x), op_name='imag')
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Pure shape computation (no tensors)."""
+    import numpy as _np
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+__all__ += ['add_', 'subtract_', 'clip_', 'scale_', 'tanh_', 'add_n',
+            'trace', 'conj', 'real', 'imag', 'broadcast_shape']

@@ -127,3 +127,14 @@ def exponential_(x, lam=1.0):
     z = jax.random.exponential(rng.next_key(), tuple(x.shape), x.dtype)
     x.set_value(z / lam)
     return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype='float32', name=None):
+    """reference: tensor/random.py::gaussian — normal() with the
+    (shape, mean, std, dtype) calling convention."""
+    dt = convert_dtype(dtype) or get_default_dtype()
+    z = jax.random.normal(rng.next_key(), _shape(shape), dt)
+    return Tensor._from_value(mean + std * z)
+
+
+__all__ += ['gaussian']

@@ -1,0 +1,51 @@
+"""Tensor printing — reference: python/paddle/tensor/to_string.py
+(set_printoptions + the Tensor __str__ formatter)."""
+import numpy as np
+
+__all__ = ['set_printoptions', 'to_string']
+
+_options = {
+    'precision': 8,
+    'threshold': 1000,
+    'edgeitems': 3,
+    'linewidth': 80,
+    'sci_mode': None,
+}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Global print formatting for Tensors (mirrors numpy's knobs,
+    which back the formatter)."""
+    if precision is not None:
+        _options['precision'] = int(precision)
+    if threshold is not None:
+        _options['threshold'] = int(threshold)
+    if edgeitems is not None:
+        _options['edgeitems'] = int(edgeitems)
+    if linewidth is not None:
+        _options['linewidth'] = int(linewidth)
+    if sci_mode is not None:
+        _options['sci_mode'] = bool(sci_mode)
+
+
+def to_string(var, prefix='Tensor'):
+    from ..core.tensor import Tensor
+    v = var.value if isinstance(var, Tensor) else var
+    arr = np.asarray(v)
+    kw = dict(precision=_options['precision'],
+              threshold=_options['threshold'],
+              edgeitems=_options['edgeitems'],
+              linewidth=_options['linewidth'])
+    if _options['sci_mode']:
+        prec = _options['precision']
+        kw['formatter'] = {
+            'float_kind': lambda v: np.format_float_scientific(
+                v, precision=prec)}
+    elif _options['sci_mode'] is not None:
+        kw['suppress'] = True
+    with np.printoptions(**kw):
+        body = np.array2string(arr, separator=', ')
+    sg = getattr(var, 'stop_gradient', True)
+    return (f'{prefix}(shape={list(arr.shape)}, dtype={arr.dtype}, '
+            f'stop_gradient={sg},\n       {body})')

@@ -219,3 +219,110 @@ class TestDtypeDevice:
     def test_item_scalar(self):
         assert paddle.to_tensor(3.0).item() == 3.0
         assert int(paddle.to_tensor(7)) == 7
+
+
+class TestLongTailOps:
+    """Round-2 long-tail: in-place variants, complex parts, TensorArray,
+    printing (reference: python/paddle/tensor/{math,manipulation,array,
+    to_string}.py)."""
+
+    def test_inplace_variants_keep_tape(self):
+        x = paddle.to_tensor(np.asarray([1., 2.], 'float32'),
+                             stop_gradient=False)
+        y = x * 2.0
+        y.add_(1.0)          # y = 2x + 1
+        y.subtract_(0.5)     # y = 2x + 0.5
+        y.tanh_()
+        y.sum().backward()
+        ref = 2.0 * (1.0 - np.tanh(2 * np.asarray([1., 2.]) + 0.5) ** 2)
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()), ref,
+                                   rtol=1e-3, atol=1e-6)
+
+    def test_clip_scale_inplace(self):
+        x = paddle.to_tensor(np.asarray([-1., 0.5, 3.], 'float32'))
+        paddle.clip_(x, min=0.0, max=1.0)
+        np.testing.assert_allclose(np.asarray(x.numpy()), [0., 0.5, 1.])
+        paddle.scale_(x, scale=2.0, bias=1.0)
+        np.testing.assert_allclose(np.asarray(x.numpy()), [1., 2., 3.])
+
+    def test_shape_inplace_variants(self):
+        x = paddle.to_tensor(np.arange(6, dtype='float32'))
+        x.reshape_([2, 3])
+        assert list(x.shape) == [2, 3]
+        x.unsqueeze_(0)
+        assert list(x.shape) == [1, 2, 3]
+        x.squeeze_(0)
+        assert list(x.shape) == [2, 3]
+        x.flatten_()
+        assert list(x.shape) == [6]
+
+    def test_scatter_inplace(self):
+        x = paddle.to_tensor(np.zeros((3, 2), 'float32'))
+        paddle.scatter_(x, paddle.to_tensor(np.asarray([1], 'int64')),
+                        paddle.to_tensor(np.ones((1, 2), 'float32')))
+        np.testing.assert_allclose(np.asarray(x.numpy()),
+                                   [[0, 0], [1, 1], [0, 0]])
+
+    def test_add_n_trace_inverse(self):
+        x = paddle.to_tensor(np.asarray([[1., 2.], [3., 4.]], 'float32'))
+        np.testing.assert_allclose(
+            np.asarray(paddle.add_n([x, x, x]).numpy()),
+            3 * np.asarray(x.numpy()))
+        np.testing.assert_allclose(
+            float(np.asarray(paddle.trace(x).numpy())), 5.0)
+        np.testing.assert_allclose(
+            np.asarray(paddle.inverse(x).numpy()),
+            np.linalg.inv(np.asarray(x.numpy())), rtol=1e-5)
+
+    def test_real_imag_conj(self):
+        x = paddle.to_tensor(np.asarray([1. + 2.j, 3. - 1.j],
+                                        'complex64'))
+        np.testing.assert_allclose(np.asarray(paddle.real(x).numpy()),
+                                   [1., 3.])
+        np.testing.assert_allclose(np.asarray(paddle.imag(x).numpy()),
+                                   [2., -1.])
+        np.testing.assert_allclose(np.asarray(paddle.conj(x).numpy()),
+                                   [1. - 2.j, 3. + 1.j])
+
+    def test_broadcast_shape_and_gaussian(self):
+        assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+        g = paddle.tensor.random.gaussian([128, 4], mean=1.0, std=0.1)
+        v = np.asarray(g.numpy())
+        assert abs(v.mean() - 1.0) < 0.05
+
+    def test_tensor_array(self):
+        from paddle_tpu.tensor import (create_array, array_write,
+                                       array_read, array_length)
+        arr = create_array()
+        x = paddle.to_tensor(np.asarray([1.], 'float32'))
+        array_write(x, 0, arr)
+        array_write(x * 2, paddle.to_tensor(np.asarray(1, 'int64')), arr)
+        assert array_length(arr) == 2
+        np.testing.assert_allclose(
+            np.asarray(array_read(arr, 1).numpy()), [2.])
+
+    def test_printing(self):
+        paddle.set_printoptions(precision=2)
+        x = paddle.to_tensor(np.asarray([1.23456], 'float32'))
+        s = paddle.tensor.to_string(x)
+        assert 'shape=[1]' in s and '1.23' in s
+        paddle.set_printoptions(precision=8)
+
+    def test_gaussian_dtype_honored(self):
+        g = paddle.tensor.random.gaussian([4], dtype='bfloat16')
+        assert 'bfloat16' in str(g.dtype)
+
+    def test_array_write_gap_raises(self):
+        from paddle_tpu.tensor import create_array, array_write
+        arr = create_array()
+        x = paddle.to_tensor(np.asarray([1.], 'float32'))
+        with pytest.raises(IndexError, match='past the array length'):
+            array_write(x, 2, arr)
+
+    def test_repr_honors_printoptions(self):
+        paddle.set_printoptions(precision=2, sci_mode=True)
+        try:
+            x = paddle.to_tensor(np.asarray([1.23456], 'float32'))
+            assert 'e+00' in repr(x) or 'e-' in repr(x)
+        finally:
+            paddle.set_printoptions(precision=8, sci_mode=False)
