@@ -4,8 +4,11 @@ from .conv import *          # noqa: F401,F403
 from .norm import *          # noqa: F401,F403
 from .pooling import *       # noqa: F401,F403
 from .loss import *          # noqa: F401,F403
+from .vision import *        # noqa: F401,F403
 
-from . import activation, common, conv, norm, pooling, loss  # noqa: F401
+from . import (activation, common, conv, norm, pooling, loss,  # noqa: F401
+               vision)
 
 __all__ = (activation.__all__ + common.__all__ + conv.__all__ +
-           norm.__all__ + pooling.__all__ + loss.__all__)
+           norm.__all__ + pooling.__all__ + loss.__all__ +
+           vision.__all__)

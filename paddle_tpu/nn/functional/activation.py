@@ -181,3 +181,19 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
             y = jax.lax.stop_gradient(y_hard - y) + y
         return y
     return apply(fn, wrap(x), op_name='gumbel_softmax')
+
+
+# in-place variants (reference activation.py: elu_/softmax_ mutate but
+# keep the tape edge via the _snapshot/_replace pattern)
+
+def elu_(x, alpha=1.0, name=None):
+    x._replace(elu(x._snapshot(), alpha=alpha))
+    return x
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    x._replace(softmax(x._snapshot(), axis=axis, dtype=dtype))
+    return x
+
+
+__all__ += ['elu_', 'softmax_']

@@ -243,3 +243,36 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
         return out.reshape(n, c * ks[0] * ks[1], oh * ow)
 
     return apply(fn, wrap(x), op_name='unfold')
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched diagonal embedding (reference: nn/functional/extension.py
+    ::diag_embed): input [..., N] -> output with N placed on the
+    (dim1, dim2) diagonal at `offset`."""
+    x = wrap(input)
+
+    def fn(v):
+        n = v.shape[-1]
+        size = n + abs(int(offset))
+        out = jnp.zeros(v.shape[:-1] + (size, size), v.dtype)
+        i = jnp.arange(n)
+        r = i + max(-offset, 0)
+        c = i + max(offset, 0)
+        out = out.at[..., r, c].set(v)
+        d1 = dim1 % out.ndim
+        d2 = dim2 % out.ndim
+        if (d1, d2) != (out.ndim - 2, out.ndim - 1):
+            perm = [i for i in range(out.ndim) if i not in
+                    (out.ndim - 2, out.ndim - 1)]
+            # move the two diagonal dims to the requested positions
+            order = perm.copy()
+            for pos, ax in sorted([(d1, out.ndim - 2),
+                                   (d2, out.ndim - 1)]):
+                order.insert(pos, ax)
+            out = jnp.transpose(out, order)
+        return out
+
+    return apply(fn, x, op_name='diag_embed')
+
+
+__all__ += ['diag_embed']

@@ -300,3 +300,75 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
     return apply(fn, wrap(log_probs), wrap(labels), wrap(input_lengths),
                  wrap(label_lengths), op_name='ctc_loss')
+
+
+_hsigmoid_trees = {}
+
+
+def _hsigmoid_default_tree(C):
+    """Complete-binary-tree path tables (heap layout: root=1, leaf for
+    class c at heap index C+c, internal node n -> weight row n-1),
+    cached per num_classes — hierarchical sigmoid exists for huge C,
+    so the O(C log C) host walk must run once, not per step."""
+    import numpy as np_
+    if C in _hsigmoid_trees:
+        return _hsigmoid_trees[C]
+    L = max(int(np_.ceil(np_.log2(max(C, 2)))), 1)
+    tbl = np_.full((C, L), -1, np_.int64)
+    code = np_.zeros((C, L), np_.float32)
+    for c in range(C):
+        node = C + c
+        path = []
+        while node > 1:
+            parent = node // 2
+            path.append((parent - 1, float(node % 2)))
+            node = parent
+        for k, (p, b) in enumerate(reversed(path)):
+            if k < L:
+                tbl[c, k] = p
+                code[c, k] = b
+    _hsigmoid_trees[C] = (tbl, code)
+    return tbl, code
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference: nn/functional/loss.py::
+    hsigmoid_loss over the hsigmoid op).  Default tree: the complete
+    binary tree over num_classes the reference builds — precomputed
+    HOST-side as static [C, L] path-node/code tables, so the on-device
+    work is two gathers + one BCE reduce (no per-class python).
+    Custom trees come in via path_table/path_code [N, L] (or [C, L]),
+    -1 padded."""
+    import numpy as np_
+    x, lb = wrap(input), wrap(label)
+    w = wrap(weight)
+    ins = [x, lb, w]
+    if bias is not None:
+        ins.append(wrap(bias))
+
+    if path_table is None:
+        path_table, path_code = _hsigmoid_default_tree(int(num_classes))
+    pt = jnp.asarray(np_.asarray(path_table, np_.int64))
+    pc = jnp.asarray(np_.asarray(path_code, np_.float32))
+
+    def fn(v, y, wv, *b):
+        y = y.reshape(v.shape[0]).astype(jnp.int32)
+        nodes = pt[y]                       # [B, L]
+        codes = pc[y]                       # [B, L]
+        valid = (nodes >= 0).astype(v.dtype)
+        safe = jnp.maximum(nodes, 0)
+        wrow = wv[safe]                     # [B, L, D]
+        logits = jnp.einsum('bd,bld->bl', v, wrow)
+        if b:
+            logits = logits + b[0].reshape(-1)[safe]
+        # BCE with target = code bit
+        ls = jax.nn.log_sigmoid(logits)
+        per = -(codes * ls + (1 - codes) * (ls - logits))
+        return (per * valid).sum(axis=-1, keepdims=True)
+
+    return apply(fn, *ins, op_name='hsigmoid_loss')
+
+
+__all__ += ['hsigmoid_loss']

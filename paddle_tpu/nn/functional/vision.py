@@ -1,0 +1,97 @@
+"""Spatial-transformer functionals: affine_grid + grid_sample.
+
+Reference analogue: /root/reference/python/paddle/nn/functional/vision.py
+(affine_grid_op / grid_sampler CUDA kernels).  TPU-native: the sampling
+is 4 static gathers + bilinear weights — batched advanced indexing XLA
+lowers to dynamic-gather, no scalar loops.
+"""
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...tensor._helpers import wrap
+
+__all__ = ['affine_grid', 'grid_sample']
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta: [N, 2, 3] affine matrices -> sampling grid
+    [N, H, W, 2] in normalized [-1, 1] coords."""
+    theta = wrap(theta)
+    N, C, H, W = [int(s) for s in out_shape]
+
+    def fn(t):
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, H)
+            xs = jnp.linspace(-1.0, 1.0, W)
+        else:
+            ys = (jnp.arange(H) * 2 + 1) / H - 1.0
+            xs = (jnp.arange(W) * 2 + 1) / W - 1.0
+        gy, gx = jnp.meshgrid(ys, xs, indexing='ij')
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)        # [H, W, 3]
+        return jnp.einsum('hwk,nck->nhwc', base.astype(t.dtype), t)
+
+    return apply(fn, theta, op_name='affine_grid')
+
+
+def grid_sample(x, grid, mode='bilinear', padding_mode='zeros',
+                align_corners=True, name=None):
+    """x: [N, C, H, W]; grid: [N, Ho, Wo, 2] in [-1, 1] (x, y).
+    Returns [N, C, Ho, Wo]."""
+    if mode not in ('bilinear', 'nearest'):
+        raise ValueError(f'grid_sample: unsupported mode {mode!r}')
+    if padding_mode not in ('zeros', 'border', 'reflection'):
+        raise ValueError(
+            f'grid_sample: unsupported padding_mode {padding_mode!r}')
+    x, grid = wrap(x), wrap(grid)
+
+    def unnorm(c, size):
+        if align_corners:
+            return (c + 1.0) / 2.0 * (size - 1)
+        return ((c + 1.0) * size - 1.0) / 2.0
+
+    def reflect(c, size):
+        if align_corners:
+            # reflect over the corner points: period 2*(size-1)
+            span = 2.0 * (size - 1)
+            if span == 0.0:
+                return jnp.zeros_like(c)
+            c = jnp.abs(jnp.mod(c, span))
+            return jnp.where(c > (size - 1), span - c, c)
+        # reflect over the pixel-AREA borders [-0.5, size-0.5]:
+        # period 2*size, then clamp the half-pixel overshoot
+        span = 2.0 * size
+        c = jnp.mod(c + 0.5, span)
+        c = jnp.where(c > size, span - c, c) - 0.5
+        return jnp.clip(c, 0.0, size - 1)
+
+    def fn(v, g):
+        N, C, H, W = v.shape
+        px = unnorm(g[..., 0].astype(jnp.float32), W)
+        py = unnorm(g[..., 1].astype(jnp.float32), H)
+        if padding_mode == 'reflection':
+            px = reflect(px, W)
+            py = reflect(py, H)
+
+        def gather(yy, xx):
+            yi = jnp.clip(yy.astype(jnp.int32), 0, H - 1)
+            xi = jnp.clip(xx.astype(jnp.int32), 0, W - 1)
+            out = v[jnp.arange(N)[:, None, None], :, yi, xi]
+            if padding_mode == 'zeros':
+                inb = ((yy >= 0) & (yy <= H - 1) & (xx >= 0)
+                       & (xx <= W - 1)).astype(v.dtype)
+                out = out * inb[..., None]
+            return out                                   # [N,Ho,Wo,C]
+
+        if mode == 'nearest':
+            out = gather(jnp.round(py), jnp.round(px))
+        else:
+            y0, x0 = jnp.floor(py), jnp.floor(px)
+            wy, wx = (py - y0)[..., None], (px - x0)[..., None]
+            out = (gather(y0, x0) * (1 - wy) * (1 - wx)
+                   + gather(y0, x0 + 1) * (1 - wy) * wx
+                   + gather(y0 + 1, x0) * wy * (1 - wx)
+                   + gather(y0 + 1, x0 + 1) * wy * wx)
+        return jnp.moveaxis(out, -1, 1).astype(v.dtype)  # [N,C,Ho,Wo]
+
+    return apply(fn, x, grid, op_name='grid_sample')

@@ -153,3 +153,34 @@ class SigmoidFocalLoss(Layer):
     def forward(self, logit, label, normalizer=None):
         return F.sigmoid_focal_loss(logit, label, normalizer, self.alpha,
                                     self.gamma, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid (reference: nn/layer/loss.py::HSigmoidLoss):
+    O(log C) loss for huge softmaxes via a binary tree over classes;
+    default tree built host-side, custom trees via path tables."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        n_nodes = num_classes - 1 if not is_custom else num_classes
+        self.weight = self.create_parameter(
+            [max(n_nodes, 1), feature_size], attr=weight_attr)
+        self.bias = self.create_parameter(
+            [max(n_nodes, 1)], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        if self.is_custom and (path_table is None or path_code is None):
+            raise ValueError(
+                'HSigmoidLoss(is_custom=True) requires path_table and '
+                'path_code at forward (the weight is sized for the '
+                'custom tree; the default tree would mis-index it)')
+        return F.hsigmoid_loss(
+            input, label, self.num_classes, self.weight, self.bias,
+            path_table=path_table, path_code=path_code)
+
+
+__all__ += ['HSigmoidLoss']

@@ -182,3 +182,26 @@ class LocalResponseNorm(Layer):
     def forward(self, x):
         return F.local_response_norm(x, self.size, self.alpha, self.beta,
                                      self.k, self.data_format)
+
+
+class SpectralNorm(Layer):
+    """Standalone spectral-normalization layer (reference:
+    nn/layer/norm.py::SpectralNorm): forward(weight) returns
+    weight / sigma_max, sigma estimated by power iteration.  The u/v
+    vectors re-derive from a fixed key per call — stateless and
+    traceable (see static/nn.py::spectral_norm for the rationale)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+
+    def forward(self, weight):
+        from ...static.nn import spectral_norm as _sn
+        return _sn(weight, dim=self._dim, power_iters=self._power_iters,
+                   eps=self._eps)
+
+
+__all__ += ['SpectralNorm']

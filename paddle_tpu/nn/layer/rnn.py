@@ -16,7 +16,7 @@ from ..initializer import Uniform
 from .layers import Layer
 
 __all__ = ['SimpleRNNCell', 'LSTMCell', 'GRUCell', 'RNN', 'BiRNN',
-           'SimpleRNN', 'LSTM', 'GRU']
+           'SimpleRNN', 'LSTM', 'GRU', 'RNNCellBase']
 
 
 class RNNCellBase(Layer):
@@ -364,3 +364,43 @@ class BiRNN(Layer):
         y_fw, s_fw = self.rnn_fw(inputs, st_fw)
         y_bw, s_bw = self.rnn_bw(inputs, st_bw)
         return concat([y_fw, y_bw], axis=-1), (s_fw, s_bw)
+
+
+# reference nn/layer/rnn.py module helpers: flatten/unflatten the
+# [num_layers * num_directions, B, H] stacked state layout
+def split_states(states, bidirectional=False, state_components=1):
+    from ...tensor.manipulation import unbind
+    if state_components == 1:
+        st = list(unbind(states, axis=0))
+        if not bidirectional:
+            return st
+        return [(st[2 * i], st[2 * i + 1]) for i in range(len(st) // 2)]
+    comp = [list(unbind(s, axis=0)) for s in states]
+    rows = list(zip(*comp))
+    if not bidirectional:
+        return [tuple(r) for r in rows]
+    return [(tuple(rows[2 * i]), tuple(rows[2 * i + 1]))
+            for i in range(len(rows) // 2)]
+
+
+def concat_states(states, bidirectional=False, state_components=1):
+    from ...tensor.manipulation import stack
+    flat = []
+
+    def walk(s):
+        if isinstance(s, (list, tuple)):
+            for t in s:
+                walk(t)
+        else:
+            flat.append(s)
+
+    walk(states)
+    if state_components == 1:
+        return stack(flat, axis=0)
+    comps = [flat[k::state_components] for k in range(state_components)]
+    return tuple(stack(c, axis=0) for c in comps)
+
+
+RNNBase = _RNNBase  # reference-name alias
+
+__all__ += ['split_states', 'concat_states', 'RNNBase']

@@ -301,3 +301,102 @@ class TestSchedulers:
         w = paddle.optimizer.lr.LinearWarmup(0.1, 10, 0.0, 0.1)
         w.step(5)
         assert abs(w.get_lr() - 0.05) < 1e-6
+
+
+class TestNNLongTail:
+    """Round-2 nn surface completion: spatial transformer, diag_embed,
+    hierarchical sigmoid, RNN state utils, SpectralNorm layer."""
+
+    def test_grid_sample_identity_and_shift(self):
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(1, 2, 5, 7).astype('float32'))
+        theta = paddle.to_tensor(
+            np.asarray([[[1., 0, 0], [0, 1., 0]]], 'float32'))
+        grid = F.affine_grid(theta, [1, 2, 5, 7])
+        y = np.asarray(F.grid_sample(x, grid).numpy())
+        np.testing.assert_allclose(y, np.asarray(x.numpy()), atol=1e-5)
+        # integer x-shift by one output pixel: column k samples k+1
+        shift = 2.0 / (7 - 1)
+        theta2 = paddle.to_tensor(
+            np.asarray([[[1., 0, shift], [0, 1., 0]]], 'float32'))
+        y2 = np.asarray(F.grid_sample(
+            x, F.affine_grid(theta2, [1, 2, 5, 7])).numpy())
+        np.testing.assert_allclose(y2[..., :-1],
+                                   np.asarray(x.numpy())[..., 1:],
+                                   atol=1e-5)
+        # zeros padding beyond the border
+        np.testing.assert_allclose(y2[..., -1], 0.0, atol=1e-5)
+
+    def test_grid_sample_nearest_and_border(self):
+        rs = np.random.RandomState(1)
+        x = paddle.to_tensor(rs.randn(1, 1, 4, 4).astype('float32'))
+        g = paddle.to_tensor(
+            np.asarray([[[[-2.0, -2.0]]]], 'float32'))  # far outside
+        yb = np.asarray(F.grid_sample(
+            x, g, mode='nearest', padding_mode='border').numpy()).item()
+        assert yb == float(np.asarray(x.numpy())[0, 0, 0, 0])
+        yz = np.asarray(F.grid_sample(
+            x, g, mode='nearest', padding_mode='zeros').numpy()).item()
+        assert yz == 0.0
+
+    def test_diag_embed(self):
+        v = paddle.to_tensor(np.asarray([[1., 2.], [3., 4.]], 'float32'))
+        out = np.asarray(F.diag_embed(v).numpy())
+        np.testing.assert_allclose(out[1], [[3., 0.], [0., 4.]])
+        out2 = np.asarray(F.diag_embed(v, offset=-1).numpy())
+        assert out2.shape == (2, 3, 3) and out2[0][1, 0] == 1.0
+
+    def test_hsigmoid_loss_trains(self):
+        """hsigmoid as classifier: loss decreases and the argmin class
+        probability path tracks the label (convergence sanity)."""
+        paddle.seed(0)
+        C, D = 8, 16
+        hs = nn.HSigmoidLoss(D, C)
+        emb = nn.Linear(C, D)
+        opt = paddle.optimizer.Adam(
+            5e-2, parameters=list(hs.parameters())
+            + list(emb.parameters()))
+        rs = np.random.RandomState(0)
+        onehot = np.eye(C, dtype='float32')
+        lbl = rs.randint(0, C, (32, 1)).astype('int64')
+        x = paddle.to_tensor(onehot[lbl[:, 0]])
+        first = None
+        for i in range(60):
+            loss = hs(emb(x), paddle.to_tensor(lbl)).mean()
+            if first is None:
+                first = float(np.asarray(loss.numpy()))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        last = float(np.asarray(loss.numpy()))
+        assert last < first * 0.3, (first, last)
+
+    def test_rnn_state_utils_roundtrip(self):
+        rs = np.random.RandomState(2)
+        h = paddle.to_tensor(rs.randn(4, 2, 3).astype('float32'))
+        c = paddle.to_tensor(rs.randn(4, 2, 3).astype('float32'))
+        # LSTM-style two-component states, bidirectional
+        parts = nn.split_states((h, c), bidirectional=True,
+                                state_components=2)
+        assert len(parts) == 2  # two layers of (fwd, bwd)
+        h2, c2 = nn.concat_states(parts, bidirectional=True,
+                                  state_components=2)
+        np.testing.assert_allclose(np.asarray(h2.numpy()),
+                                   np.asarray(h.numpy()), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(c2.numpy()),
+                                   np.asarray(c.numpy()), rtol=1e-6)
+        assert nn.RNNBase is not None and nn.RNNCellBase is not None
+
+    def test_spectral_norm_layer(self):
+        rs = np.random.RandomState(3)
+        w = paddle.to_tensor(rs.randn(6, 4).astype('float32'))
+        sn = nn.SpectralNorm([6, 4], power_iters=50)
+        wn = np.asarray(sn(w).numpy())
+        np.testing.assert_allclose(
+            np.linalg.svd(wn, compute_uv=False)[0], 1.0, rtol=1e-3)
+
+    def test_inplace_activations(self):
+        x = paddle.to_tensor(np.asarray([-1., 2.], 'float32'))
+        F.softmax_(x)
+        np.testing.assert_allclose(np.asarray(x.numpy()).sum(), 1.0,
+                                   rtol=1e-6)
