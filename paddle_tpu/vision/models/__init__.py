@@ -1,12 +1,14 @@
 """Vision model zoo (reference: python/paddle/vision/models/__init__.py)."""
 from .lenet import LeNet  # noqa: F401
 from .resnet import (  # noqa: F401
-    ResNet, resnet18, resnet34, resnet50, resnet101, resnet152)
-from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+    ResNet, BasicBlock, BottleneckBlock, resnet18, resnet34, resnet50,
+    resnet101, resnet152)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19, make_layers  # noqa: F401
 from .mobilenetv1 import MobileNetV1, mobilenet_v1  # noqa: F401
-from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
+from .mobilenetv2 import MobileNetV2, mobilenet_v2, InvertedResidual  # noqa: F401
 
-__all__ = ['LeNet', 'ResNet', 'resnet18', 'resnet34', 'resnet50',
-           'resnet101', 'resnet152', 'VGG', 'vgg11', 'vgg13', 'vgg16',
-           'vgg19', 'MobileNetV1', 'mobilenet_v1', 'MobileNetV2',
-           'mobilenet_v2']
+__all__ = ['LeNet', 'ResNet', 'BasicBlock', 'BottleneckBlock',
+           'resnet18', 'resnet34', 'resnet50', 'resnet101', 'resnet152',
+           'VGG', 'vgg11', 'vgg13', 'vgg16', 'vgg19', 'make_layers',
+           'MobileNetV1', 'mobilenet_v1', 'MobileNetV2', 'mobilenet_v2',
+           'InvertedResidual']
