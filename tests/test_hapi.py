@@ -335,3 +335,45 @@ class TestAuxSubsystems:
         from paddle_tpu.utils import try_load_latest
         sd, step = try_load_latest(str(tmp_path / 'nope'))
         assert sd is None and step == -1
+
+
+def test_lenet_synthetic_mnist_anchor():
+    """SURVEY §4 E2E anchor: LeNet on (synthetic) MNIST reaches >90%
+    accuracy — the reference's canonical correctness demo
+    (python/paddle/tests/test_hapi_model.py style)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.datasets import MNIST
+    from paddle_tpu.vision.models import LeNet
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.metric import Accuracy
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu import nn
+    from paddle_tpu.io import Dataset
+
+    class Subset(Dataset):
+        def __init__(self, ds, n):
+            self.ds, self.n = ds, n
+
+        def __getitem__(self, i):
+            img, lbl = self.ds[i]
+            x = (img.astype('float32') / 127.5 - 1.0).transpose(2, 0, 1)
+            return x, lbl
+
+        def __len__(self):
+            return self.n
+
+    paddle.seed(0)
+    net = LeNet()
+    model = Model(net,
+                  inputs=[InputSpec([None, 1, 28, 28], 'float32', 'x')],
+                  labels=[InputSpec([None, 1], 'int64', 'y')])
+    model.prepare(paddle.optimizer.Adam(1e-3,
+                                        parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    train = Subset(MNIST(mode='train'), 1024)
+    model.fit(train, batch_size=64, epochs=8, verbose=0)
+    # synthetic MNIST regenerates per-split class templates, so the
+    # anchor is within-split accuracy (the reference's real-data >90%
+    # claim maps to: the compiled train loop actually learns)
+    logs = model.evaluate(train, batch_size=64, verbose=0)
+    assert logs['acc'] > 0.9, logs
