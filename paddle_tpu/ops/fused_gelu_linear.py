@@ -102,9 +102,12 @@ def _mm_epilogue(x, w, b, dy, approximate, bm, bn, bk):
     in_specs = [
         pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
         pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
-        pl.BlockSpec((bn,), lambda i, j, k: (j,)),
+        # bias rides as (1, N): Mosaic rejects 1-D bf16 operands whose
+        # XLA tiling disagrees with the kernel's (seen on v5e), and a 2-D
+        # row broadcasts against the (bm, bn) accumulator for free
+        pl.BlockSpec((1, bn), lambda i, j, k: (0, j)),
     ]
-    operands = [x, w, b]
+    operands = [x, w, b.reshape(1, -1)]
     if dy is None:
         kernel = functools.partial(_fwd_kernel, nk=nk,
                                    approximate=approximate)
