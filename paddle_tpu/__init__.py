@@ -9,13 +9,19 @@ __version__ = '0.1.0'
 
 from .core import Tensor, no_grad, enable_grad, is_grad_enabled  # noqa: F401
 from .core.tensor import Parameter  # noqa: F401
+from .core.autograd import grad, set_grad_enabled  # noqa: F401
 from .core.dtype import (  # noqa: F401
     float16, bfloat16, float32, float64, int8, int16, int32, int64, uint8,
-    bool_, complex64, complex128, set_default_dtype, get_default_dtype)
+    bool_, complex64, complex128, set_default_dtype, get_default_dtype,
+    dtype)
+from .core.dtype import bool_ as bool  # noqa: F401,A001
 from .core.device import (  # noqa: F401
-    CPUPlace, CUDAPlace, TPUPlace, XPUPlace, set_device, get_device,
-    device_count, is_compiled_with_cuda, is_compiled_with_xpu)
+    CPUPlace, CUDAPlace, TPUPlace, XPUPlace, NPUPlace, CUDAPinnedPlace,
+    set_device, get_device, device_count, is_compiled_with_cuda,
+    is_compiled_with_xpu, is_compiled_with_npu, get_cudnn_version)
 from .core.rng import seed  # noqa: F401
+from .core.rng import get_cuda_rng_state, set_cuda_rng_state  # noqa: F401
+from .batch import batch  # noqa: F401
 
 from .tensor import *  # noqa: F401,F403
 from .tensor import __all__ as _tensor_all
@@ -31,7 +37,16 @@ from . import jit  # noqa: F401
 from . import static  # noqa: F401
 from . import framework  # noqa: F401
 from .framework.io import save, load  # noqa: F401
+from .framework import ComplexTensor  # noqa: F401
 from .static.program import enable_static, disable_static  # noqa: F401
+from .static.program import in_static_mode as _in_static_mode
+from .distributed.parallel import DataParallel  # noqa: F401
+
+
+def in_dynamic_mode():
+    """True unless paddle.enable_static() is active (reference
+    fluid.framework.in_dygraph_mode, exported as in_dynamic_mode)."""
+    return not _in_static_mode()
 from . import distributed  # noqa: F401
 from . import parallel  # noqa: F401
 from . import vision  # noqa: F401
@@ -52,4 +67,9 @@ from .hapi import callbacks  # noqa: F401
 
 __all__ = ['Tensor', 'Parameter', 'no_grad', 'enable_grad', 'seed',
            'set_device', 'get_device', 'save', 'load', 'enable_static',
-           'disable_static', 'Model', 'summary', 'flops'] + list(_tensor_all)
+           'disable_static', 'Model', 'summary', 'flops',
+           'grad', 'set_grad_enabled', 'in_dynamic_mode', 'batch',
+           'DataParallel', 'ComplexTensor', 'dtype', 'bool',
+           'get_cuda_rng_state', 'set_cuda_rng_state',
+           'NPUPlace', 'CUDAPinnedPlace', 'is_compiled_with_npu',
+           'get_cudnn_version'] + list(_tensor_all)

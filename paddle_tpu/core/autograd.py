@@ -121,7 +121,16 @@ def backward(tensor, grad=None, retain_graph=False):
     for node in order:
         if all(g is None for g in node.out_grads):
             continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f'trying to differentiate through op {node.name!r} whose '
+                'graph was already freed by a previous backward()/grad() '
+                'call; pass retain_graph=True to the earlier call')
         in_grads = node.vjp_fn(node.cotangents())
+        # seeds are consumed: clear even under retain_graph, so a later
+        # backward()/grad() on the retained graph starts from zero
+        # instead of double-counting stale cotangents
+        node.out_grads = [None] * len(node.out_avals)
         for t, g in zip(node.inputs, in_grads):
             if t is None or g is None:
                 continue
@@ -132,36 +141,165 @@ def backward(tensor, grad=None, retain_graph=False):
                 t.grad_node.seed_grad(t.grad_index, g)
         if not retain_graph:
             node.vjp_fn = None
-            node.out_grads = [None] * len(node.out_avals)
 
     if not retain_graph:
         _detach_graph(tensor)
 
 
+class set_grad_enabled:
+    """Context manager enabling/disabling the tape, effective immediately.
+
+    Matches paddle.set_grad_enabled (reference
+    python/paddle/framework/__init__.py): the mode flips at construction
+    so it also works as a plain statement, and restores on __exit__.
+    """
+
+    def __init__(self, mode):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        _grad_enabled = bool(mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self._prev
+        return False
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """Sum of gradients of `outputs` w.r.t. each of `inputs`.
+
+    Matches paddle.grad (reference
+    python/paddle/fluid/dygraph/base.py:407): returns a list of Tensors
+    (None for unreachable inputs when allow_unused), WITHOUT touching any
+    `.grad` accumulators.  `no_grad_vars` cuts gradient flow at those
+    tensors.
+
+    create_graph=True (double grad) is not supported on the eager tape —
+    the TPU-fast route for higher-order derivatives is the compiled path,
+    where plain jax.grad composition (jax.grad(jax.grad(f))) applies; see
+    paddle_tpu.jit.
+    """
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            'paddle.grad(create_graph=True) is not supported on the eager '
+            'tape; compose jax.grad via paddle_tpu.jit for higher-order '
+            'derivatives')
+    if not only_inputs:
+        raise NotImplementedError('only_inputs=False is not supported '
+                                  '(matches the reference, which also '
+                                  'rejects it)')
+    outputs = list(outputs) if isinstance(outputs, (list, tuple)) \
+        else [outputs]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) \
+        else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    else:
+        grad_outputs = list(grad_outputs) if isinstance(
+            grad_outputs, (list, tuple)) else [grad_outputs]
+        if len(grad_outputs) != len(outputs):
+            raise ValueError('grad_outputs must match outputs in length')
+    if retain_graph is None:
+        retain_graph = create_graph
+    cut_ids = {id(t) for t in (no_grad_vars or [])}
+    input_ids = {id(t): i for i, t in enumerate(inputs)}
+
+    acc = {}                   # id(input tensor) -> accumulated cotangent
+
+    def _acc_input(t, g):
+        k = id(t)
+        acc[k] = g if k not in acc else acc[k] + g
+
+    roots = []
+    for out, go in zip(outputs, grad_outputs):
+        g = jnp.ones_like(out.value) if go is None else _val(go)
+        if id(out) in input_ids and not out.stop_gradient:
+            _acc_input(out, g)
+        if out.grad_node is not None:
+            out.grad_node.seed_grad(out.grad_index, g)
+            roots.append(out.grad_node)
+
+    order = _topo_order_multi(roots)
+    visited = []
+    for node in order:
+        visited.append(node)
+        if all(g is None for g in node.out_grads):
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f'trying to differentiate through op {node.name!r} whose '
+                'graph was already freed by a previous backward()/grad() '
+                'call; pass retain_graph=True to the earlier call')
+        in_grads = node.vjp_fn(node.cotangents())
+        node.out_grads = [None] * len(node.out_avals)
+        for t, g in zip(node.inputs, in_grads):
+            if t is None or g is None or id(t) in cut_ids:
+                continue
+            if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+                continue
+            if id(t) in input_ids and not t.stop_gradient:
+                _acc_input(t, g)
+            if t.grad_node is not None:
+                t.grad_node.seed_grad(t.grad_index, g)
+    if not retain_graph:
+        for node in visited:
+            node.vjp_fn = None
+
+    results = []
+    for t in inputs:
+        g = acc.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    'one of the inputs is unreachable from outputs (or has '
+                    'stop_gradient=True); pass allow_unused=True to get '
+                    'None instead')
+            results.append(None)
+        else:
+            results.append(Tensor(g, stop_gradient=True))
+    return results
+
+
+def _topo_order_multi(roots):
+    """Reverse-topological order of GradNodes reachable from any root."""
+    order, state = [], {}
+    for r in roots:
+        _visit(r, order, state)
+    return list(reversed(order))
+
+
+def _visit(node, order, state):
+    """Iterative DFS postorder append of GradNodes into `order`."""
+    stack = [(node, iter(_parent_nodes(node)))]
+    while stack:
+        n, it = stack[-1]
+        if state.get(id(n)) == 2:
+            stack.pop()
+            continue
+        state[id(n)] = 1
+        advanced = False
+        for p in it:
+            if state.get(id(p), 0) == 0:
+                stack.append((p, iter(_parent_nodes(p))))
+                advanced = True
+                break
+        if not advanced:
+            state[id(n)] = 2
+            order.append(n)
+            stack.pop()
+
+
 def _topo_order(root):
     """Reverse-topological order of GradNodes reachable from root."""
     order, state = [], {}
-
-    def visit(node):
-        stack = [(node, iter(_parent_nodes(node)))]
-        while stack:
-            n, it = stack[-1]
-            if state.get(id(n)) == 2:
-                stack.pop()
-                continue
-            state[id(n)] = 1
-            advanced = False
-            for p in it:
-                if state.get(id(p), 0) == 0:
-                    stack.append((p, iter(_parent_nodes(p))))
-                    advanced = True
-                    break
-            if not advanced:
-                state[id(n)] = 2
-                order.append(n)
-                stack.pop()
-
-    visit(root)
+    _visit(root, order, state)
     return list(reversed(order))
 
 

@@ -82,12 +82,34 @@ def get_place():
     return _current_place
 
 
+def NPUPlace(device_id=0):
+    """Ascend NPU place — documented non-goal (SURVEY §2); resolves to
+    the accelerator like CUDAPlace so place-typed code still runs."""
+    return Place('tpu', device_id)
+
+
+def CUDAPinnedPlace():
+    """Pinned-host place. XLA owns host staging buffers on TPU; this is
+    an API-compat alias for the CPU place."""
+    return Place('cpu')
+
+
 def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_npu():
     return False
 
 
 def is_compiled_with_xpu():
     return any(_kind_of(d) == 'tpu' for d in jax.devices())
+
+
+def get_cudnn_version():
+    """No cuDNN on TPU (reference device.py returns None when CUDA is
+    absent — same contract here)."""
+    return None
 
 
 def device_count():

@@ -27,6 +27,10 @@ int64 = jnp.int32
 float64 = jnp.float32
 complex128 = jnp.complex64
 
+# paddle.dtype — the reference exposes its VarType enum class under this
+# name; here dtypes ARE numpy/jax dtypes, so the constructor is np.dtype.
+dtype = np.dtype
+
 
 def enable_x64():
     """Opt into true 64-bit dtypes (CPU debugging; not for TPU perf)."""

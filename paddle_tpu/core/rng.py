@@ -79,3 +79,19 @@ def next_key():
 
 def get_seed():
     return _state.seed_value
+
+
+def get_cuda_rng_state():
+    """API-compat shim for paddle.get_cuda_rng_state (reference
+    framework/random.py): there is no CUDA generator on TPU, so this
+    returns the framework generator's state (a list, matching the
+    reference's list-of-states shape — one entry per device class)."""
+    return [_state.key]
+
+
+def set_cuda_rng_state(state_list):
+    """Restore the state captured by get_cuda_rng_state."""
+    if not isinstance(state_list, (list, tuple)) or not state_list:
+        raise ValueError('expected the list returned by '
+                         'get_cuda_rng_state')
+    _state.key = state_list[0]

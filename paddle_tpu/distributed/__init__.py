@@ -21,13 +21,19 @@ from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import (  # noqa: F401
     save_sharded, load_sharded, CheckpointManager)
+from .entry_attr import (  # noqa: F401
+    EntryAttr, ProbabilityEntry, CountFilterEntry)
+from .mp_ops import split  # noqa: F401
+from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
 
 __all__ = ['ParallelEnv', 'get_rank', 'get_world_size', 'get_mesh',
            'set_mesh', 'build_mesh', 'ReduceOp', 'new_group', 'get_group',
            'all_reduce', 'all_gather', 'broadcast', 'reduce', 'scatter',
            'alltoall', 'send', 'recv', 'barrier', 'wait',
            'init_parallel_env', 'DataParallel', 'fleet', 'spawn', 'launch',
-           'save_sharded', 'load_sharded', 'CheckpointManager']
+           'save_sharded', 'load_sharded', 'CheckpointManager',
+           'EntryAttr', 'ProbabilityEntry', 'CountFilterEntry', 'split',
+           'InMemoryDataset', 'QueueDataset']
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):

@@ -1,0 +1,149 @@
+"""Fleet file datasets: InMemoryDataset / QueueDataset.
+
+Reference analogue:
+/root/reference/python/paddle/distributed/fleet/dataset/dataset.py
+(InMemoryDataset:253, QueueDataset:1086) — C++ multi-thread file readers
+feeding the PS trainer by slot; InMemoryDataset additionally loads all
+samples into host memory for local/global shuffle.
+
+TPU-native: the C++ reader pipeline is paddle_tpu.io's prefetch-ring
+DataLoader; these classes keep the fleet-facing API (init/set_filelist/
+load_into_memory/local_shuffle/...) and expose the samples as an
+IterableDataset, so `DataLoader(dataset.as_dataset(), ...)` feeds the
+device the standard way.  File format: one sample per line, whitespace-
+separated float/int fields matching `use_var` order and widths.
+"""
+import glob as _glob
+import random
+
+import numpy as np
+
+from ..io import IterableDataset
+
+__all__ = ['DatasetBase', 'InMemoryDataset', 'QueueDataset']
+
+
+class _SlotSpec:
+    def __init__(self, name, width, dtype):
+        self.name, self.width, self.dtype = name, width, dtype
+
+
+class DatasetBase:
+    """Shared init/filelist handling (reference DatasetBase)."""
+
+    def __init__(self):
+        self._filelist = []
+        self._slots = []
+        self._batch_size = 1
+        self._thread_num = 1
+        self._pipe_command = None
+
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command=None, input_type=0, fs_name='', fs_ugi='',
+             download_cmd='cat', **kwargs):
+        self._batch_size = int(batch_size)
+        self._thread_num = int(thread_num)
+        self._pipe_command = pipe_command
+        self._slots = []
+        for v in (use_var or []):
+            shape = getattr(v, '_declared_shape', None) or \
+                getattr(v, 'shape', [1])
+            width = 1
+            for d in shape[1:] if len(shape) > 1 else shape:
+                if d and d > 0:
+                    width *= int(d)
+            dt = np.dtype(str(getattr(v, 'dtype', 'float32')))
+            self._slots.append(_SlotSpec(
+                getattr(v, 'name', f'slot_{len(self._slots)}'), width, dt))
+
+    def set_filelist(self, filelist):
+        files = []
+        for f in filelist:
+            hits = sorted(_glob.glob(f))
+            files.extend(hits if hits else [f])
+        self._filelist = files
+
+    def _parse_line(self, line):
+        toks = line.split()
+        out, i = [], 0
+        for s in self._slots:
+            vals = toks[i:i + s.width]
+            i += s.width
+            out.append(np.asarray(vals, s.dtype).reshape(
+                (s.width,) if s.width > 1 else (1,)))
+        return tuple(out) if len(out) > 1 else out[0]
+
+    def _iter_files(self):
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield self._parse_line(line)
+
+
+class _IterView(IterableDataset):
+    def __init__(self, it_fn):
+        self._it_fn = it_fn
+
+    def __iter__(self):
+        return iter(self._it_fn())
+
+
+class QueueDataset(DatasetBase):
+    """Streaming file dataset (no shuffle buffer): samples flow straight
+    from the files, like the reference's QueueDataset pipe readers."""
+
+    def as_dataset(self):
+        return _IterView(self._iter_files)
+
+    def __iter__(self):
+        return self._iter_files()
+
+
+class InMemoryDataset(DatasetBase):
+    """Loads every sample into host memory; supports local_shuffle and
+    (API-compat) global_shuffle before iteration."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples = None
+
+    def load_into_memory(self):
+        self._samples = list(self._iter_files())
+
+    def preload_into_memory(self, thread_num=None):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        pass
+
+    def local_shuffle(self):
+        if self._samples is None:
+            raise RuntimeError('call load_into_memory() first')
+        random.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        # one host == one shard here, so a global shuffle IS the local one
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._samples = None
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples or [])
+
+    def get_shuffle_data_size(self, fleet=None):
+        return self.get_memory_data_size(fleet)
+
+    def as_dataset(self):
+        def gen():
+            if self._samples is None:
+                raise RuntimeError('call load_into_memory() first')
+            return iter(self._samples)
+        return _IterView(gen)
+
+    def __iter__(self):
+        if self._samples is None:
+            raise RuntimeError('call load_into_memory() first')
+        return iter(self._samples)

@@ -1,0 +1,57 @@
+"""Sparse-table entry admission configs.
+
+Reference analogue: /root/reference/python/paddle/distributed/entry_attr.py
+(ProbabilityEntry:59, CountFilterEntry:100) — the parameter server admits
+a new sparse feature row into the table only probabilistically or after a
+show-count threshold, bounding table growth on rare features.
+
+TPU-native: the table substitute is incubate.HostOffloadEmbedding (host
+DRAM rows, device pulls); these configs gate its HOST-side sparse update
+the same way — an unadmitted row keeps its initialization and learns
+nothing until admitted.
+"""
+
+__all__ = ['EntryAttr', 'ProbabilityEntry', 'CountFilterEntry']
+
+
+class EntryAttr:
+    """Base class for entry admission policies."""
+
+    def __init__(self):
+        self._name = None
+
+    def _to_attr(self):
+        raise NotImplementedError('EntryAttr is a base class')
+
+
+class ProbabilityEntry(EntryAttr):
+    """Admit each feature row with probability p (decided once per row,
+    on its first gradient push)."""
+
+    def __init__(self, probability):
+        super().__init__()
+        if not isinstance(probability, float):
+            raise ValueError('probability must be a float in (0,1)')
+        if probability <= 0 or probability >= 1:
+            raise ValueError('probability must be a float in (0,1)')
+        self._name = 'probability_entry'
+        self._probability = probability
+
+    def _to_attr(self):
+        return ':'.join([self._name, str(self._probability)])
+
+
+class CountFilterEntry(EntryAttr):
+    """Admit a feature row once it has been seen `count_filter` times."""
+
+    def __init__(self, count_filter):
+        super().__init__()
+        if not isinstance(count_filter, int):
+            raise ValueError('count_filter must be a non-negative integer')
+        if count_filter < 0:
+            raise ValueError('count_filter must be a non-negative integer')
+        self._name = 'count_filter_entry'
+        self._count_filter = count_filter
+
+    def _to_attr(self):
+        return ':'.join([self._name, str(self._count_filter)])

@@ -1,0 +1,57 @@
+"""Model-parallel op: paddle.distributed.split.
+
+Reference analogue: /root/reference/python/paddle/distributed/collective.py:1108
+— splits the weight of a linear/embedding op across ranks (parallel
+embedding, row-parallel linear, column-parallel linear) with NCCL
+gather/allreduce glue.
+
+TPU-native: the three cases ARE fleet.meta_parallel's TP layers with
+'tp'-axis PartitionSpecs; XLA inserts the collectives.  split() builds
+the matching layer once per call site (build-time API, like the
+reference, which creates the program weights on first call) and applies
+it.  num_partitions must match the installed mesh's tp axis (or 1 when
+no mesh is installed — degrades to the dense op, same as the reference
+on one rank).
+"""
+import warnings
+
+from . import env as _env
+
+__all__ = ['split']
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    from .fleet.meta_parallel import (ColumnParallelLinear,
+                                      RowParallelLinear,
+                                      VocabParallelEmbedding)
+
+    mesh = _env.get_mesh()
+    tp = dict(zip(mesh.axis_names, mesh.devices.shape)).get('tp', 1) \
+        if mesh is not None else 1
+    if num_partitions not in (1, tp):
+        warnings.warn(
+            f'distributed.split: num_partitions={num_partitions} does not '
+            f'match the mesh tp axis ({tp}); the sharding follows the '
+            'mesh', stacklevel=2)
+
+    if operation == 'embedding':
+        num_emb, dim = size
+        layer = VocabParallelEmbedding(num_emb, dim,
+                                       weight_attr=weight_attr, name=name)
+        return layer(x)
+    if operation != 'linear':
+        raise ValueError("operation must be 'linear' or 'embedding', "
+                         f"got {operation!r}")
+    in_f, out_f = size
+    if axis == 0:    # weight rows split -> row-parallel
+        layer = RowParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                  has_bias=bias_attr is not False,
+                                  input_is_parallel=False, name=name)
+        return layer(x)
+    if axis == 1:    # weight cols split -> column-parallel
+        layer = ColumnParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                     has_bias=bias_attr is not False,
+                                     gather_output=gather_out, name=name)
+        return layer(x)
+    raise ValueError(f'axis must be 0 or 1, got {axis}')

@@ -5,5 +5,23 @@ random.py, framework.py).
 """
 from .io import save, load  # noqa: F401
 from ..core.rng import seed, get_seed  # noqa: F401
+from ..core.rng import get_cuda_rng_state, set_cuda_rng_state  # noqa: F401
+from ..core.autograd import grad, set_grad_enabled  # noqa: F401
 
-__all__ = ['save', 'load', 'seed', 'get_seed']
+__all__ = ['save', 'load', 'seed', 'get_seed', 'grad', 'set_grad_enabled',
+           'get_cuda_rng_state', 'set_cuda_rng_state', 'ComplexTensor']
+
+
+def ComplexTensor(real, imag=None):
+    """Legacy complex constructor (reference exported paddle.ComplexTensor
+    from the fluid C++ core).  Complex dtypes are native to the Tensor
+    here, so this just pairs real/imag into one complex64 Tensor; read
+    parts back via .real() / .imag()."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    r = np.asarray(real, dtype=np.float32)
+    i = np.zeros_like(r) if imag is None else np.asarray(imag,
+                                                         dtype=np.float32)
+    if i.shape != r.shape:
+        raise ValueError(f'real/imag shape mismatch: {r.shape} vs {i.shape}')
+    return Tensor((r + 1j * i).astype(np.complex64))

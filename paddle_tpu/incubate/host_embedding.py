@@ -54,7 +54,7 @@ class HostOffloadEmbedding(Layer):
 
     def __init__(self, num_embeddings, embedding_dim, learning_rate=0.01,
                  optimizer='sgd', trainable=True, dtype='float32',
-                 seed=None):
+                 seed=None, entry=None):
         super().__init__()
         if optimizer not in ('sgd', 'adagrad'):
             raise ValueError(f'unsupported host optimizer {optimizer!r}')
@@ -80,6 +80,21 @@ class HostOffloadEmbedding(Layer):
             (self.num_embeddings, self.embedding_dim)).astype(self._np_dtype)
         self._accum = (np.zeros_like(self.table)
                        if optimizer == 'adagrad' else None)
+        # entry admission (reference distributed/entry_attr.py): gate the
+        # sparse update per row — see _admitted()
+        from ..distributed.entry_attr import (EntryAttr, ProbabilityEntry,
+                                              CountFilterEntry)
+        if entry is not None and not isinstance(entry, EntryAttr):
+            raise TypeError('entry must be a ProbabilityEntry or '
+                            'CountFilterEntry')
+        self.entry = entry
+        self._entry_rng = np.random.RandomState(
+            (seed if seed is not None else 0) ^ 0x5eed)
+        if isinstance(entry, CountFilterEntry):
+            self._counts = np.zeros((self.num_embeddings,), np.int64)
+        elif isinstance(entry, ProbabilityEntry):
+            # -1 undecided, 0 rejected, 1 admitted
+            self._admit_flag = np.full((self.num_embeddings,), -1, np.int8)
         # a zero scalar device parameter that rides through the lookup:
         # ids are integers, so without a float input on the op the
         # autograd tape would mark the output stop_gradient and the
@@ -105,15 +120,39 @@ class HostOffloadEmbedding(Layer):
     def _host_gather(self, ids):
         return self.table[self._check_ids(ids)]
 
+    def _admitted(self, uniq, counts_in_batch):
+        """Entry-admission mask over the batch's unique rows (reference
+        PS admits features probabilistically or after a show count)."""
+        from ..distributed.entry_attr import (ProbabilityEntry,
+                                              CountFilterEntry)
+        if isinstance(self.entry, CountFilterEntry):
+            self._counts[uniq] += counts_in_batch
+            return self._counts[uniq] >= self.entry._count_filter
+        if isinstance(self.entry, ProbabilityEntry):
+            undecided = self._admit_flag[uniq] == -1
+            if undecided.any():
+                draws = (self._entry_rng.rand(int(undecided.sum()))
+                         < self.entry._probability).astype(np.int8)
+                self._admit_flag[uniq[undecided]] = draws
+            return self._admit_flag[uniq] == 1
+        return np.ones(uniq.shape[0], bool)
+
     def _host_push(self, ids, grad):
         """Sparse update: accumulate duplicate ids, apply the rule."""
         ids = self._check_ids(ids).reshape(-1)
         g = np.asarray(grad, self._np_dtype).reshape(
             -1, self.embedding_dim)
-        uniq, inv = np.unique(ids, return_inverse=True)
+        uniq, inv, cnt = np.unique(ids, return_inverse=True,
+                                   return_counts=True)
         merged = np.zeros((uniq.shape[0], self.embedding_dim),
                           self._np_dtype)
         np.add.at(merged, inv, g)
+        if self.entry is not None:
+            keep = self._admitted(uniq, cnt)
+            if not keep.all():
+                uniq, merged = uniq[keep], merged[keep]
+            if uniq.size == 0:
+                return np.zeros((), np.int32)
         if self.optimizer == 'adagrad':
             self._accum[uniq] += merged * merged
             merged = merged / np.sqrt(self._accum[uniq] + 1e-10)
@@ -168,6 +207,10 @@ class HostOffloadEmbedding(Layer):
         state = {'table': self.table.copy()}  # snapshot: pushes mutate
         if self._accum is not None:
             state['accum'] = self._accum.copy()
+        if getattr(self, '_counts', None) is not None:
+            state['counts'] = self._counts.copy()
+        if getattr(self, '_admit_flag', None) is not None:
+            state['admit_flag'] = self._admit_flag.copy()
         return state
 
     def set_extra_state(self, state):
@@ -184,6 +227,12 @@ class HostOffloadEmbedding(Layer):
                     f'HostOffloadEmbedding accum shape mismatch: '
                     f'{accum.shape} vs {self._accum.shape}')
             self._accum = accum.copy()
+        if 'counts' in state and getattr(self, '_counts', None) is not None:
+            self._counts = np.asarray(state['counts'], np.int64).copy()
+        if 'admit_flag' in state and \
+                getattr(self, '_admit_flag', None) is not None:
+            self._admit_flag = np.asarray(state['admit_flag'],
+                                          np.int8).copy()
 
     def extra_repr(self):
         return (f'{self.num_embeddings}, {self.embedding_dim}, '

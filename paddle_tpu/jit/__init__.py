@@ -359,3 +359,103 @@ def load(path, **configs):
     with open(path + '.pdiparams', 'rb') as f:
         meta = pickle.load(f)
     return TranslatedLayer(exp, meta['state'])
+
+
+# -- dy2static compat surface -------------------------------------------------
+
+class ProgramTranslator:
+    """Reference dy2static/program_translator.py::ProgramTranslator — a
+    process-wide singleton whose enable() toggles dy2static.  Here the
+    translation IS functional capture + jax.jit, so the singleton only
+    carries the global enable flag (enable_to_static)."""
+
+    _instance = None
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static_flag):
+        enable_to_static(enable_to_static_flag)
+
+    @property
+    def enable_to_static(self):
+        return _to_static_enabled
+
+    def get_code(self, dygraph_func):
+        import inspect
+        # no source-to-source rewrite happens: the traced source IS the code
+        return inspect.getsource(dygraph_func)
+
+    def get_func(self, dygraph_func):
+        return StaticFunction(dygraph_func)
+
+
+_verbosity = 0
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Reference dy2static logging_utils.set_verbosity: configure the
+    translation logger.  Tracing here has one phase, so this sets the
+    module logger level (DEBUG when level>0)."""
+    import logging
+    global _verbosity
+    _verbosity = int(level)
+    logger = logging.getLogger('paddle_tpu.jit')
+    logger.setLevel(logging.DEBUG if level > 0 else logging.WARNING)
+    if also_to_stdout and not logger.handlers:
+        logger.addHandler(logging.StreamHandler())
+    return _verbosity
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Reference dy2static set_code_level: print transformed code at a
+    given pass.  There is no AST pipeline here; this enables the same
+    logger as set_verbosity (the "code" is the jaxpr, fetchable via
+    jax.make_jaxpr on the captured function)."""
+    return set_verbosity(1 if level else 0, also_to_stdout)
+
+
+class TracedLayer:
+    """Reference fluid/dygraph/jit.py::TracedLayer — trace a dygraph
+    Layer with example inputs into a static inference function.
+
+    TracedLayer.trace(layer, inputs) runs the layer once, pins the input
+    specs, and returns (outputs, traced); traced(inputs...) replays the
+    compiled XLA module and traced.save_inference_model(path) writes the
+    self-contained StableHLO artifact (loadable with jit.load or
+    static.load_inference_model).
+    """
+
+    def __init__(self, layer, static_fn, input_spec):
+        self._layer = layer
+        self._static_fn = static_fn
+        self._input_spec = input_spec
+
+    @staticmethod
+    def trace(layer, inputs):
+        from ..static.input_spec import InputSpec
+        inputs = list(inputs) if isinstance(inputs, (list, tuple)) \
+            else [inputs]
+        out = layer(*inputs)
+        spec = [InputSpec.from_tensor(t if isinstance(t, Tensor)
+                                      else Tensor(t)) for t in inputs]
+        sf = StaticFunction(_BoundForward(layer))
+        return out, TracedLayer(layer, sf, spec)
+
+    def __call__(self, inputs):
+        inputs = list(inputs) if isinstance(inputs, (list, tuple)) \
+            else [inputs]
+        out = self._static_fn(*inputs)
+        return out if isinstance(out, (list, tuple)) else [out]
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        if isinstance(path, (list, tuple)):  # legacy (dirname, ...) form
+            path = path[0]
+        save(self._static_fn, path, input_spec=self._input_spec)
+
+
+__all__ += ['ProgramTranslator', 'set_verbosity', 'set_code_level',
+            'TracedLayer']

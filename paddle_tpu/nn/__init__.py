@@ -9,3 +9,4 @@ from .layer import *  # noqa: F401,F403
 from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm)
 from .utils import weight_norm, remove_weight_norm, spectral_norm  # noqa: F401
+from .decode import Decoder, BeamSearchDecoder, dynamic_decode  # noqa: F401

@@ -1,0 +1,232 @@
+"""Seq2seq decoding: Decoder protocol, BeamSearchDecoder, dynamic_decode.
+
+Reference analogue: /root/reference/python/paddle/fluid/layers/rnn.py
+(Decoder:753, BeamSearchDecoder:866, dynamic_decode:1581), re-exported as
+paddle.nn.BeamSearchDecoder / paddle.nn.dynamic_decode.
+
+TPU-native design: the per-step beam math (log_softmax, finished-beam
+masking, top-k over beam*vocab, beam reordering) is pure jnp — one fused
+XLA program per step; the backtrace (`finalize`) is a static-trip-count
+`lax.scan` (see nn.functional.gather_tree).  The outer loop is host-side
+like the reference's imperative path, with data-dependent stopping
+(`all(finished)`); for a fully compiled decode, fix `max_step_num` and
+wrap the step in jit.to_static — every step below is trace-safe.
+"""
+import collections
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..tensor._helpers import wrap, raw
+
+__all__ = ['Decoder', 'BeamSearchDecoder', 'dynamic_decode']
+
+
+class Decoder:
+    """Base protocol for dynamic_decode: initialize/step/finalize."""
+
+    def initialize(self, inits):
+        """-> (initial_inputs, initial_states, finished)."""
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        """-> (outputs, next_states, next_inputs, finished)."""
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Optional post-processing of stacked outputs."""
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+def _tree_map(fn, tree):
+    """map over a (possibly nested) structure of Tensors.  Tensors are
+    opaque to jax pytrees, so they land as leaves."""
+    return jax.tree_util.tree_map(
+        fn, tree, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNNCell-like `cell`.
+
+    cell(inputs, states) -> (outputs, next_states); `output_fn` maps cell
+    outputs to vocab logits; `embedding_fn` maps token ids to the next
+    step's inputs.  State/output structures mirror the reference's
+    namedtuples so user code destructures identically.
+    """
+
+    OutputWrapper = collections.namedtuple(
+        'OutputWrapper', ('scores', 'predicted_ids', 'parent_ids'))
+    StateWrapper = collections.namedtuple(
+        'StateWrapper', ('cell_states', 'log_probs', 'finished', 'lengths'))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+        self._batch = None
+
+    # -- beam/batch layout helpers -------------------------------------
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] by repeating each batch entry
+        (reference BeamSearchDecoder.tile_beam_merge_with_batch)."""
+        v = raw(wrap(x))
+        v = jnp.repeat(v[:, None], beam_size, axis=1)
+        return Tensor(v.reshape((-1,) + v.shape[2:]))
+
+    def _split(self, v):
+        return v.reshape((self._batch, self.beam_size) + v.shape[1:])
+
+    def _merge(self, v):
+        return v.reshape((-1,) + v.shape[2:])
+
+    # -- Decoder protocol ----------------------------------------------
+    def initialize(self, initial_cell_states):
+        leaves = [t for t in jax.tree_util.tree_leaves(
+            initial_cell_states,
+            is_leaf=lambda x: isinstance(x, Tensor))]
+        self._batch = wrap(leaves[0]).shape[0]
+        K = self.beam_size
+        cell_states = _tree_map(
+            lambda t: self.tile_beam_merge_with_batch(t, K),
+            initial_cell_states)
+        start = jnp.full((self._batch * K,), self.start_token, jnp.int32)
+        inputs = self.embedding_fn(Tensor(start)) if self.embedding_fn \
+            else Tensor(start)
+        # beam 0 active, others -inf: the first step expands one beam
+        lp = jnp.tile(
+            jnp.array([0.0] + [-np.inf] * (K - 1), jnp.float32)[None, :],
+            (self._batch, 1))
+        finished = jnp.zeros((self._batch, K), bool)
+        lengths = jnp.zeros((self._batch, K), jnp.int32)
+        state = self.StateWrapper(cell_states, Tensor(lp),
+                                  Tensor(finished), Tensor(lengths))
+        return inputs, state, Tensor(finished)
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_out, next_cell_states = self.cell(inputs, states.cell_states,
+                                               **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        B, K = self._batch, self.beam_size
+        logits = raw(wrap(cell_out)).astype(jnp.float32)
+        V = logits.shape[-1]
+        step_lp = jax.nn.log_softmax(logits, axis=-1).reshape(B, K, V)
+        finished = raw(states.finished)
+        # finished beams may only emit end_token, at zero added logprob
+        only_end = jnp.full((V,), -np.inf, jnp.float32) \
+            .at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[..., None], only_end, step_lp)
+        total = raw(states.log_probs)[..., None] + step_lp
+        scores, top_idx = jax.lax.top_k(total.reshape(B, K * V), K)
+        parent = (top_idx // V).astype(jnp.int32)
+        token = (top_idx % V).astype(jnp.int32)
+
+        prev_fin = jnp.take_along_axis(finished, parent, axis=1)
+        next_fin = prev_fin | (token == self.end_token)
+        lengths = jnp.take_along_axis(raw(states.lengths), parent, axis=1) \
+            + (~prev_fin).astype(jnp.int32)
+
+        def reorder(t):
+            v = self._split(raw(wrap(t)))
+            idx = parent.reshape(parent.shape + (1,) * (v.ndim - 2))
+            return Tensor(self._merge(
+                jnp.take_along_axis(v, idx, axis=1)))
+        next_cell_states = _tree_map(reorder, next_cell_states)
+
+        outputs = self.OutputWrapper(Tensor(scores), Tensor(token),
+                                     Tensor(parent))
+        next_state = self.StateWrapper(next_cell_states, Tensor(scores),
+                                       Tensor(next_fin), Tensor(lengths))
+        flat_tok = token.reshape(B * K)
+        next_inputs = self.embedding_fn(Tensor(flat_tok)) \
+            if self.embedding_fn else Tensor(flat_tok)
+        return outputs, next_state, next_inputs, Tensor(next_fin)
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Backtrace the beam tree -> predicted_ids [T, B, beam]."""
+        from .functional import gather_tree
+        predicted_ids = gather_tree(outputs.predicted_ids,
+                                    outputs.parent_ids)
+        return predicted_ids, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run `decoder` until every sequence finishes or max_step_num steps
+    (reference fluid/layers/rnn.py:1581).  Returns (outputs, final_states
+    [, sequence_lengths]) with outputs batch-major unless
+    output_time_major."""
+    inputs, states, finished = decoder.initialize(inits)
+    fin = raw(finished)
+    seq_lengths = jnp.zeros_like(fin, jnp.int32)
+    collected = None
+    step = 0
+    while not bool(jnp.all(fin)):
+        t = Tensor(jnp.asarray([step], jnp.int32))
+        outputs, next_states, next_inputs, next_finished = \
+            decoder.step(t, inputs, states, **kwargs)
+        if not decoder.tracks_own_finished:
+            nf = raw(next_finished) | fin
+            seq_lengths = seq_lengths + (~fin).astype(jnp.int32)
+            if impute_finished:  # hold finished entries' states constant
+                next_states = jax.tree_util.tree_map(
+                    lambda old, new: Tensor(jnp.where(
+                        _bmask(fin, raw(wrap(new))), raw(wrap(old)),
+                        raw(wrap(new)))),
+                    states, next_states,
+                    is_leaf=lambda x: isinstance(x, Tensor))
+            next_finished = Tensor(nf)
+        else:
+            seq_lengths = raw(getattr(next_states, 'lengths', Tensor(
+                seq_lengths)))
+        collected = jax.tree_util.tree_map(
+            lambda x: [x], outputs,
+            is_leaf=lambda x: isinstance(x, Tensor)) if collected is None \
+            else jax.tree_util.tree_map(
+                lambda x, acc: acc + [x], outputs, collected,
+                is_leaf=lambda x: isinstance(x, Tensor))
+        inputs, states = next_inputs, next_states
+        fin = raw(next_finished)
+        step += 1
+        if max_step_num is not None and step > max_step_num:
+            break
+
+    stacked = jax.tree_util.tree_map(
+        lambda acc: Tensor(jnp.stack([raw(t) for t in acc], axis=0)),
+        collected,
+        is_leaf=lambda x: isinstance(x, list) and
+        all(isinstance(t, Tensor) for t in x))
+    final_states = states
+    try:
+        stacked, final_states = decoder.finalize(stacked, final_states,
+                                                 Tensor(seq_lengths))
+    except NotImplementedError:
+        pass
+    if not output_time_major:
+        stacked = jax.tree_util.tree_map(
+            lambda x: Tensor(jnp.moveaxis(raw(x), 0, 1)), stacked,
+            is_leaf=lambda x: isinstance(x, Tensor))
+    if return_length:
+        return stacked, final_states, Tensor(seq_lengths)
+    return stacked, final_states
+
+
+def _bmask(fin, new):
+    """Broadcast the [B(,K)] finished mask against a state leaf."""
+    return fin.reshape(fin.shape + (1,) * (new.ndim - fin.ndim))

@@ -9,6 +9,8 @@ from .vision import *        # noqa: F401,F403
 from . import (activation, common, conv, norm, pooling, loss,  # noqa: F401
                vision)
 
+from ...tensor.math import tanh_  # noqa: F401  (in-place functional alias)
+
 __all__ = (activation.__all__ + common.__all__ + conv.__all__ +
            norm.__all__ + pooling.__all__ + loss.__all__ +
-           vision.__all__)
+           vision.__all__ + ['tanh_'])

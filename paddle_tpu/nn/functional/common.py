@@ -276,3 +276,58 @@ def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
 
 
 __all__ += ['diag_embed']
+
+
+def sequence_mask(x, maxlen=None, dtype='int64', name=None):
+    """y[..., j] = j < x[...] (reference fluid/layers/sequence_lod.py
+    sequence_mask).  maxlen defaults to max(x), which requires a
+    concrete eager value — under jit/to_static/static Programs the mask
+    shape must be static, so pass maxlen explicitly there.  The static
+    sequence_* ops' 2-D mask (static/sequence.py) delegates here."""
+    from ...core.dtype import convert_dtype
+    from ...tensor._helpers import napply
+    x = wrap(x)
+    if maxlen is None:
+        try:
+            v = x.value
+        except RuntimeError:
+            v = None  # static-Program Variable: no build-time value
+        if v is None or isinstance(v, jax.core.Tracer):
+            raise ValueError(
+                'sequence_mask(maxlen=None) needs a concrete x; under '
+                'jit/to_static/static Programs the mask shape must be '
+                'static — pass maxlen explicitly')
+        maxlen = int(np.asarray(jax.device_get(v)).max())
+    maxlen = int(maxlen)
+    d = convert_dtype(dtype)
+
+    def fn(v):
+        j = jnp.arange(maxlen)
+        return (j < v[..., None]).astype(d)
+    return napply(fn, x, op_name='sequence_mask')
+
+
+def gather_tree(ids, parents):
+    """Backtrace beam-search ids along parents (reference
+    fluid/layers/nn.py gather_tree; paddle.nn.functional.gather_tree).
+
+    ids, parents: [max_time, batch, beam] int.  Walks from the last step
+    backwards via a lax.scan (static trip count — compiles to one fused
+    loop on TPU) re-selecting each step's token by the surviving beam.
+    """
+    from ...tensor._helpers import napply
+
+    def fn(idv, parv):
+        T, B, K = idv.shape
+        init = jnp.tile(jnp.arange(K, dtype=parv.dtype)[None, :], (B, 1))
+
+        def body(beams, t):
+            tok = jnp.take_along_axis(idv[t], beams, axis=-1)
+            nxt = jnp.take_along_axis(parv[t], beams, axis=-1)
+            return nxt, tok
+        _, toks = jax.lax.scan(body, init, jnp.arange(T - 1, -1, -1))
+        return toks[::-1]
+    return napply(fn, wrap(ids), wrap(parents), op_name='gather_tree')
+
+
+__all__ += ['sequence_mask', 'gather_tree']

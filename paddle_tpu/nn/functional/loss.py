@@ -372,3 +372,48 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
 
 
 __all__ += ['hsigmoid_loss']
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice loss for segmentation (reference
+    fluid/layers/nn.py dice_loss): label [..., 1] int is one-hotted to
+    input's class dim; per-sample dice over all non-batch dims."""
+    input = wrap(input)
+    label = wrap(label)
+    n_cls = input.shape[-1]
+
+    def fn(x, lab):
+        if lab.shape and lab.shape[-1] == 1:
+            lab = lab.squeeze(-1)
+        oh = jax.nn.one_hot(lab.astype(jnp.int32), n_cls, dtype=x.dtype)
+        red = tuple(range(1, x.ndim))
+        inse = jnp.sum(x * oh, axis=red)
+        denom = jnp.sum(x, axis=red) + jnp.sum(oh, axis=red)
+        return jnp.mean(1.0 - 2.0 * inse / (denom + epsilon))
+    return apply(fn, input, label, op_name='dice_loss')
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair metric-learning loss (reference fluid/layers/loss.py
+    npair_loss): soft-label CE over the anchor@positive.T similarity
+    matrix + Beta*l2_reg embedding regularizer."""
+    anchor = wrap(anchor)
+    positive = wrap(positive)
+    labels = wrap(labels)
+
+    def fn(a, p, lab):
+        beta = 0.25
+        b = lab.shape[0]
+        eq = (lab.reshape(b, 1) == lab.reshape(1, b)).astype(a.dtype)
+        soft = eq / jnp.sum(eq, axis=1, keepdims=True)
+        l2 = (jnp.mean(jnp.sum(a * a, axis=1)) +
+              jnp.mean(jnp.sum(p * p, axis=1))) * beta * l2_reg
+        sim = a @ p.T
+        ce_rows = -jnp.sum(soft * jax.nn.log_softmax(sim, axis=-1),
+                           axis=-1, keepdims=True)
+        ce = jnp.mean(jnp.sum(soft * ce_rows, axis=0))
+        return l2 + ce
+    return apply(fn, anchor, positive, labels, op_name='npair_loss')
+
+
+__all__ += ['dice_loss', 'npair_loss']

@@ -161,3 +161,27 @@ def get_default_init(is_bias):
     if is_bias:
         return _global_bias_init or Constant(0.0)
     return _global_weight_init or XavierNormal()
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed conv (reference
+    fluid/initializer.py::BilinearInitializer): weight[..., y, x] =
+    (1-|x/f - c|)(1-|y/f - c|) with f = ceil(W/2), c = (2f-1-f%2)/(2f),
+    so a ConvTranspose with stride f performs bilinear interpolation."""
+
+    def _generate(self, shape, dtype, key):
+        if len(shape) != 4:
+            raise ValueError('Bilinear initializer expects a 4-D weight '
+                             f'shape, got {shape}')
+        H, W = shape[-2], shape[-1]
+        f = int(np.ceil(W / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        x = jnp.arange(W, dtype=jnp.float32)
+        y = jnp.arange(H, dtype=jnp.float32)
+        vx = 1.0 - jnp.abs(x / f - c)
+        vy = 1.0 - jnp.abs(y / f - c)
+        k = vy[:, None] * vx[None, :]
+        return jnp.broadcast_to(k, shape).astype(dtype)
+
+
+__all__ += ['Bilinear']

@@ -9,10 +9,23 @@ from .input_spec import InputSpec  # noqa: F401
 from .program import (  # noqa: F401
     Program, program_guard, default_main_program, default_startup_program,
     data, Executor, Variable, in_static_mode, enable_static, disable_static,
-    global_scope, scope_guard)
+    global_scope, scope_guard, gradients, append_backward, Print, py_func,
+    name_scope, create_global_var)
+from .io import (  # noqa: F401
+    save, load, save_inference_model, load_inference_model,
+    load_program_state, set_program_state)
+from .compat import (  # noqa: F401
+    BuildStrategy, ExecutionStrategy, CompiledProgram, ParallelExecutor,
+    cpu_places, cuda_places, WeightNormParamAttr)
 
 from . import nn  # noqa: F401
 
 __all__ = ['InputSpec', 'nn', 'Program', 'program_guard', 'default_main_program',
            'default_startup_program', 'data', 'Executor', 'Variable',
-           'enable_static', 'disable_static', 'global_scope', 'scope_guard']
+           'enable_static', 'disable_static', 'global_scope', 'scope_guard',
+           'gradients', 'append_backward', 'Print', 'py_func', 'name_scope',
+           'create_global_var', 'save', 'load', 'save_inference_model',
+           'load_inference_model', 'load_program_state', 'set_program_state',
+           'BuildStrategy', 'ExecutionStrategy', 'CompiledProgram',
+           'ParallelExecutor', 'cpu_places', 'cuda_places',
+           'WeightNormParamAttr']

@@ -1,0 +1,130 @@
+"""Executor-strategy API shims.
+
+Reference analogue: /root/reference/python/paddle/fluid/compiler.py
+(CompiledProgram), framework BuildStrategy/ExecutionStrategy pybinds, and
+parallel_executor.py.  On TPU these are knob objects without an engine
+behind them BY DESIGN: XLA owns scheduling, stream assignment, memory
+reuse and op fusion, and multi-device execution is SPMD via
+paddle_tpu.parallel.ParallelTrainer / fleet — not a per-op graph
+scheduler.  The classes accept the reference's attributes (so ported
+code runs) and warn when a knob that implies a different execution
+engine is turned on.
+"""
+import warnings
+
+from ..core import device as _device
+
+__all__ = ['BuildStrategy', 'ExecutionStrategy', 'CompiledProgram',
+           'ParallelExecutor', 'cpu_places', 'cuda_places',
+           'WeightNormParamAttr']
+
+
+class _KnobBag:
+    """Accepts arbitrary attribute writes like the reference's pybind
+    structs; records them for introspection."""
+
+    def __init__(self):
+        object.__setattr__(self, '_knobs', {})
+
+    def __setattr__(self, k, v):
+        self._knobs[k] = v
+
+    def __getattr__(self, k):
+        if k.startswith('_'):
+            raise AttributeError(k)
+        return self._knobs.get(k)
+
+
+class BuildStrategy(_KnobBag):
+    """Graph-build knobs (fuse_*, memory_optimize, reduce_strategy).
+    XLA performs the equivalent passes unconditionally; values are
+    recorded, never dispatched."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+
+class ExecutionStrategy(_KnobBag):
+    """num_threads / num_iteration_per_drop_scope etc. — scheduling is
+    XLA's; recorded only."""
+
+
+class CompiledProgram:
+    """Reference compiler.py::CompiledProgram.  Executor.run already
+    compiles the whole Program to one XLA module, so this wrapper only
+    carries the program through (and keeps .with_data_parallel for
+    ported code — data parallelism on TPU is ParallelTrainer/fleet)."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        warnings.warn(
+            'CompiledProgram.with_data_parallel is a no-op here: use '
+            'paddle_tpu.parallel.ParallelTrainer or fleet for SPMD data '
+            'parallelism over a device mesh', stacklevel=2)
+        return self
+
+    # Executor.run unwraps this
+    def _unwrap(self):
+        return self._program
+
+
+class ParallelExecutor:
+    """Reference parallel_executor.py — a multi-stream op scheduler.
+    Superseded by SPMD: kept as a thin veneer over Executor so legacy
+    call-sites run single-process."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 build_strategy=None, exec_strategy=None, scope=None,
+                 share_vars_from=None):
+        from .program import Executor, default_main_program
+        warnings.warn(
+            'ParallelExecutor maps to the single XLA Executor on TPU; '
+            'use fleet/ParallelTrainer for real multi-device SPMD',
+            stacklevel=2)
+        self._program = main_program or default_main_program()
+        self._exe = Executor()
+
+    def run(self, fetch_list=None, feed=None, return_numpy=True):
+        return self._exe.run(program=self._program, feed=feed,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+def cpu_places(device_count=None):
+    """List of CPU places (reference framework.cpu_places)."""
+    n = device_count or 1
+    return [_device.CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places; on TPU these resolve to TPU devices
+    (reference framework.cuda_places)."""
+    if device_ids is None:
+        device_ids = range(_device.device_count())
+    return [_device.TPUPlace(i) for i in device_ids]
+
+
+def WeightNormParamAttr(dim=None, name=None, initializer=None,
+                        learning_rate=1.0, regularizer=None,
+                        trainable=True, do_model_average=False,
+                        need_clip=True):
+    """Reference static/param_attr WeightNormParamAttr: requests the
+    weight-norm reparameterization w = g * v / ||v||.  Here the
+    reparameterization is a Layer transform — apply
+    paddle_tpu.nn.utils.weight_norm(layer, dim=dim) — so this returns a
+    plain ParamAttr carrying the trainability knobs and warns that the
+    norm itself must come from the layer transform."""
+    from ..nn.layer.layers import ParamAttr
+    warnings.warn(
+        'WeightNormParamAttr: apply paddle_tpu.nn.utils.weight_norm('
+        'layer, dim=...) for the actual reparameterization; this attr '
+        'carries initializer/trainability only', stacklevel=2)
+    return ParamAttr(name=name, initializer=initializer,
+                     learning_rate=learning_rate, regularizer=regularizer,
+                     trainable=trainable, need_clip=need_clip)

@@ -1,0 +1,165 @@
+"""Static-mode persistence.
+
+Reference analogue: /root/reference/python/paddle/static/io.py
+(save/load, save_inference_model/load_inference_model) and
+fluid/io.py (load_program_state/set_program_state).
+
+TPU-native: a Program's parameters are eager Tensors registered while
+recording (Program._params), so save/load is a named-array dict; the
+inference model is the Program's eval function exported to serialized
+StableHLO via jax.export with the parameters baked in as constants —
+the artifact is self-contained and reloads without Python model code.
+"""
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .program import (Program, Variable, default_main_program,
+                      _param_names)
+
+__all__ = ['save', 'load', 'save_inference_model', 'load_inference_model',
+           'load_program_state', 'set_program_state']
+
+
+def _named_params(program):
+    params = program.all_parameters()
+    return dict(zip(_param_names(params), params))
+
+
+def save(program, model_path, protocol=4):
+    """paddle.static.save — persist every parameter the program read
+    (reference static/io.py::save writes <path>.pdparams + .pdmodel)."""
+    state = {n: np.asarray(p.value)
+             for n, p in _named_params(program).items()}
+    os.makedirs(os.path.dirname(model_path) or '.', exist_ok=True)
+    with open(model_path + '.pdparams', 'wb') as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """paddle.static.load — restore parameters saved by save()."""
+    set_program_state(program, load_program_state(model_path,
+                                                  var_list=var_list))
+
+
+def load_program_state(model_path, var_list=None):
+    """-> {name: ndarray} (reference fluid/io.py::load_program_state)."""
+    with open(model_path + '.pdparams', 'rb') as f:
+        state = pickle.load(f)
+    if var_list is not None:
+        keep = {getattr(v, 'name', v) for v in var_list}
+        state = {k: v for k, v in state.items() if k in keep}
+    return state
+
+
+def set_program_state(program, state_dict):
+    """Assign a load_program_state dict back onto the program's params
+    (reference fluid/io.py::set_program_state)."""
+    named = _named_params(program)
+    missing = set(state_dict) - set(named)
+    if missing:
+        raise KeyError(f'state has no matching program params for '
+                       f'{sorted(missing)[:5]}...')
+    for n, arr in state_dict.items():
+        p = named[n]
+        p.value = jnp.asarray(arr).astype(p.value.dtype)
+
+
+class _LoadedInferenceProgram:
+    """load_inference_model result: wraps the deserialized XLA module.
+    Executor.run detects it and calls straight into the compiled fn."""
+
+    def __init__(self, exported, feed_names, n_fetch):
+        self._exported = exported
+        self._feed_names = list(feed_names)
+        self._n_fetch = n_fetch
+
+    def _run_loaded(self, feed, fetch_list, return_numpy=True):
+        missing = [n for n in self._feed_names if n not in feed]
+        if missing:
+            raise KeyError(f'feed missing inputs: {missing}')
+        vals = [jnp.asarray(feed[n]) for n in self._feed_names]
+        outs = self._exported.call(*vals)
+        outs = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+        if fetch_list:
+            outs = [outs[i if isinstance(i, int) else i._fetch_index]
+                    for i in fetch_list]
+        return [np.asarray(o) for o in outs] if return_numpy else outs
+
+
+class _FetchTarget:
+    def __init__(self, index):
+        self._fetch_index = index
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Export the inference slice of a Program (reference
+    static/io.py::save_inference_model writes __model__+params; here one
+    self-contained serialized StableHLO module with params embedded)."""
+    from jax import export as jexport
+
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    for v in feed_vars:
+        if not isinstance(v, Variable) or v.kind != 'feed':
+            raise TypeError('feed_vars must be static.data Variables')
+
+    def fn(*feed_vals):
+        env = {'__params__': None}
+        for v, val in zip(feed_vars, feed_vals):
+            env[id(v)] = val
+        return tuple(fv._eval(env) for fv in fetch_vars)
+
+    # dynamic (None/-1) feed dims export as jax.export symbolic dims so
+    # the artifact accepts any batch, not the build-time template of 1
+    structs, sym_i = [], 0
+    for v in feed_vars:
+        decl = getattr(v, '_declared_shape', v._feed_shape)
+        if any(d == -1 for d in decl):
+            parts = []
+            for d in decl:
+                if d == -1:
+                    parts.append(f'_dyn{sym_i}')
+                    sym_i += 1
+                else:
+                    parts.append(str(d))
+            shp = jexport.symbolic_shape(', '.join(parts))
+        else:
+            shp = tuple(decl)
+        structs.append(jax.ShapeDtypeStruct(shp, v._feed_dtype))
+    try:
+        exp = jexport.export(jax.jit(fn))(*structs)
+    except Exception as e:
+        if sym_i == 0:
+            raise
+        raise ValueError(
+            'save_inference_model: this program does not support '
+            'shape-polymorphic export over its dynamic feed dims '
+            f'({e}); declare fixed shapes in static.data to export'
+        ) from e
+    os.makedirs(os.path.dirname(path_prefix) or '.', exist_ok=True)
+    with open(path_prefix + '.pdmodel', 'wb') as f:
+        f.write(exp.serialize())
+    with open(path_prefix + '.pdiparams', 'wb') as f:
+        pickle.dump({'feed_names': [v.name for v in feed_vars],
+                     'n_fetch': len(fetch_vars)}, f)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """-> [program, feed_target_names, fetch_targets] (reference
+    static/io.py::load_inference_model contract)."""
+    from jax import export as jexport
+    with open(path_prefix + '.pdmodel', 'rb') as f:
+        exp = jexport.deserialize(f.read())
+    with open(path_prefix + '.pdiparams', 'rb') as f:
+        meta = pickle.load(f)
+    prog = _LoadedInferenceProgram(exp, meta['feed_names'],
+                                   meta['n_fetch'])
+    fetch_targets = [_FetchTarget(i) for i in range(meta['n_fetch'])]
+    return [prog, list(meta['feed_names']), fetch_targets]

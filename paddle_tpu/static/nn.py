@@ -173,11 +173,21 @@ def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
 
 
 def sparse_embedding(input, size, padding_idx=None, param_attr=None,
-                     dtype='float32', is_test=False, name=None):
+                     dtype='float32', is_test=False, entry=None,
+                     name=None):
     """Large-vocab embedding (reference: fluid/contrib sparse_embedding,
     backed by the parameter server).  TPU-native: the table is a dense
     mesh-shardable parameter; fleet's VocabParallelEmbedding (tp-sharded
-    rows) or incubate.HostOffloadEmbedding cover the beyond-HBM case."""
+    rows) or incubate.HostOffloadEmbedding cover the beyond-HBM case.
+    `entry` admission (ProbabilityEntry/CountFilterEntry) is enforced by
+    incubate.HostOffloadEmbedding(entry=...); the dense path warns."""
+    if entry is not None:
+        import warnings
+        warnings.warn(
+            'sparse_embedding(entry=...): admission filtering applies on '
+            'the host-offloaded table — use incubate.HostOffloadEmbedding('
+            'entry=entry) for enforced admission; the dense static path '
+            'ignores it', stacklevel=2)
     return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
                      param_attr=param_attr, dtype=dtype, name=name)
 

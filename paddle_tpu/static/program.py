@@ -174,6 +174,12 @@ class Program:
         self.random_seed = 0
         self._version = 0
         self._cache = {}
+        self._params = {}          # id -> param Tensor seen during record
+
+    def all_parameters(self):
+        """Every parameter Tensor read by recorded ops (reference
+        Program.all_parameters walks the blocks' var list)."""
+        return list(self._params.values())
 
     def bump(self):
         self._version += 1
@@ -253,6 +259,7 @@ def _record_op(fn, args, kwargs, op_name):
             arg_slots.append(('var', a))
         elif isinstance(a, Tensor):
             arg_slots.append(('tensor', a))   # param: read value at run
+            prog._params[id(a)] = a
         else:
             arg_slots.append(('const', a))
     kw_slots = {}
@@ -261,6 +268,7 @@ def _record_op(fn, args, kwargs, op_name):
             kw_slots[k] = ('var', v)
         elif isinstance(v, Tensor):
             kw_slots[k] = ('tensor', v)
+            prog._params[id(v)] = v
         else:
             kw_slots[k] = ('const', v)
 
@@ -281,7 +289,9 @@ def _record_op(fn, args, kwargs, op_name):
         out = fn(*a, **kw)
         return out
 
-    out_var = Variable(prog, f"{op_name or 'op'}_{id(thunk)}", 'op', thunk)
+    prefix = '/'.join(_name_scopes)
+    base = f"{prefix}/{op_name or 'op'}" if prefix else (op_name or 'op')
+    out_var = Variable(prog, f"{base}_{id(thunk)}", 'op', thunk)
     # multi-output ops: build child selector Variables
     try:
         aval = out_var.aval
@@ -328,6 +338,10 @@ class Executor:
         program = program or default_main_program()
         feed = feed or {}
         fetch_list = fetch_list or []
+        if hasattr(program, '_run_loaded'):     # load_inference_model
+            return program._run_loaded(feed, fetch_list, return_numpy)
+        if hasattr(program, '_unwrap'):          # CompiledProgram
+            program = program._unwrap()
         if program is _default_startup or (
                 not program.feed_vars and not fetch_list):
             return []  # startup: params already initialized eagerly
@@ -417,3 +431,228 @@ class Executor:
             return outs, new_p, new_s, side
 
         return run_train
+
+
+# -- graph-surgery-free equivalents of the reference's backward pass ---------
+
+_name_scopes = []
+
+
+@contextlib.contextmanager
+def name_scope(prefix):
+    """Prefix recorded op names (reference fluid.framework.name_scope —
+    there it nests ProgramDesc name scopes; here names are diagnostic)."""
+    _name_scopes.append(str(prefix))
+    try:
+        yield
+    finally:
+        _name_scopes.pop()
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Symbolic grads of sum(targets) w.r.t. each input (reference
+    static/gradient helpers: paddle.static.gradients →
+    fluid/backward.py::gradients).
+
+    TPU-native: instead of appending grad-op descs to the Program, each
+    returned Variable's thunk re-evaluates the recorded subgraph under
+    jax.grad with the input substituted — XLA CSE merges the recompute
+    with the forward, so the compiled module matches a hand-appended
+    backward.
+    """
+    targets = list(targets) if isinstance(targets, (list, tuple)) \
+        else [targets]
+    inputs_l = list(inputs) if isinstance(inputs, (list, tuple)) \
+        else [inputs]
+    if target_gradients is not None:
+        tgs = list(target_gradients) if isinstance(
+            target_gradients, (list, tuple)) else [target_gradients]
+    else:
+        tgs = [None] * len(targets)
+    prog = targets[0].program
+    ng_vars = [v for v in (no_grad_set or []) if isinstance(v, Variable)]
+
+    def make_thunk(inp):
+        def thunk(env):
+            feeds = {id(v): env[id(v)]
+                     for v in prog.feed_vars.values() if id(v) in env}
+
+            def f(val):
+                env2 = dict(feeds)
+                pe = env.get('__params__')
+                if isinstance(inp, Variable):
+                    env2['__params__'] = pe
+                    env2[id(inp)] = val
+                else:               # parameter Tensor
+                    pe2 = dict(pe) if pe else {}
+                    pe2[id(inp)] = val
+                    env2['__params__'] = pe2
+                # no_grad_set: pre-seed those vars with stop_gradient'd
+                # values so flow through them is cut (Paddle contract)
+                for ng in ng_vars:
+                    env2[id(ng)] = jax.lax.stop_gradient(ng._eval(env))
+                total = 0.0
+                for t, g in zip(targets, tgs):
+                    tv = t._eval(env2).astype(jnp.float32)
+                    if g is not None:
+                        gv = g._eval(env2) if isinstance(g, Variable) \
+                            else jnp.asarray(getattr(g, 'value', g))
+                        tv = tv * gv.astype(jnp.float32)
+                    total = total + tv.sum()
+                return total
+
+            if isinstance(inp, Variable):
+                val0 = inp._eval(env)
+            else:
+                pe = env.get('__params__')
+                val0 = pe[id(inp)] if pe and id(inp) in pe else inp.value
+            return jax.grad(f)(val0)
+        return thunk
+
+    outs = []
+    for inp in inputs_l:
+        nm = getattr(inp, 'name', None) or 'x'
+        outs.append(Variable(prog, f'{nm}@GRAD', 'op', make_thunk(inp)))
+    return outs
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Reference fluid/backward.py::append_backward — returns
+    [(param, grad_variable)] for every trainable parameter the Program
+    has read (no graph mutation needed; see gradients())."""
+    params = parameter_list if parameter_list is not None else [
+        p for p in loss.program.all_parameters()
+        if not getattr(p, 'stop_gradient', False)]
+    grads = gradients([loss], params, no_grad_set=no_grad_set)
+    return list(zip(params, grads))
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase='both'):
+    """Debug-print op (reference fluid/layers/control_flow.py::Print):
+    passes `input` through unchanged and prints it when the compiled
+    program executes (jax.debug.print survives jit)."""
+    prog = input.program
+    tag = message or (input.name if print_tensor_name else 'Print')
+
+    def thunk(env):
+        v = input._eval(env)
+        jax.debug.print(tag + ': {x}', x=v)
+        return v
+    return Variable(prog, f'{input.name}.print', 'op', thunk)
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Embed arbitrary host Python into the program (reference
+    fluid/layers/nn.py::py_func, which registers a C++ callback op).
+    TPU-native: jax.pure_callback — XLA yields to the host at this node.
+
+    `out` declares the result spec: an InputSpec, a (shape, dtype)
+    tuple, or a feed Variable template (or a list of those).
+    backward_func(x..., out..., dout...) -> dx... runs on host too, via
+    jax.custom_vjp.
+    """
+    from .input_spec import InputSpec
+
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+    prog = next(a.program for a in xs if isinstance(a, Variable))
+
+    def spec_of(o, batch):
+        """Declared spec -> concrete ShapeDtypeStruct.  A dynamic
+        (None/-1) dim is allowed in position 0 only and resolves to the
+        first input's leading (batch) dim at trace time."""
+        if isinstance(o, InputSpec):
+            shape, dt = o.shape, o.numpy_dtype() or np.float32
+        elif isinstance(o, Variable):
+            shape, dt = getattr(o, '_declared_shape', o._feed_shape), \
+                o._feed_dtype
+        else:
+            shape, dt = o[0], convert_dtype(o[1])
+        resolved = []
+        for i, d in enumerate(shape):
+            if d is None or d == -1:
+                if i != 0:
+                    raise ValueError(
+                        'py_func: dynamic out dims are only supported in '
+                        f'position 0 (batch), got dynamic dim {i} in '
+                        f'{tuple(shape)}')
+                resolved.append(int(batch))
+            else:
+                resolved.append(int(d))
+        return jax.ShapeDtypeStruct(tuple(resolved), dt)
+    single = not isinstance(out, (list, tuple))
+
+    def make_host_fwd(out_specs):
+        def host_fwd(*vals):
+            res = func(*[np.asarray(v) for v in vals])
+            res = res if isinstance(res, (list, tuple)) else [res]
+            return tuple(np.asarray(r, s.dtype).reshape(s.shape)
+                         for r, s in zip(res, out_specs))
+        return host_fwd
+
+    def make_call(out_specs):
+        host_fwd = make_host_fwd(out_specs)
+        if backward_func is None:
+            def call(*vals):
+                return jax.pure_callback(host_fwd, tuple(out_specs), *vals)
+            return call
+
+        @jax.custom_vjp
+        def call(*vals):
+            return jax.pure_callback(host_fwd, tuple(out_specs), *vals)
+
+        def fwd(*vals):
+            res = jax.pure_callback(host_fwd, tuple(out_specs), *vals)
+            return res, (vals, res)
+
+        def bwd(resid, douts):
+            vals, res = resid
+
+            def host_bwd(*flat):
+                grads = backward_func(*[np.asarray(v) for v in flat])
+                grads = grads if isinstance(grads, (list, tuple)) \
+                    else [grads]
+                return tuple(np.asarray(g, v.dtype).reshape(v.shape)
+                             for g, v in zip(grads, vals))
+            in_specs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                             for v in vals)
+            return jax.pure_callback(host_bwd, in_specs,
+                                     *vals, *res, *douts)
+        call.defvjp(fwd, bwd)
+        return call
+
+    def thunk(env):
+        vals = [a._eval(env) if isinstance(a, Variable)
+                else jnp.asarray(getattr(a, 'value', a)) for a in xs]
+        batch = vals[0].shape[0] if vals and vals[0].ndim else 1
+        res = make_call([spec_of(o, batch) for o in outs])(*vals)
+        return res[0] if single else tuple(res)
+    return Variable(prog, f'py_func_{id(func)}', 'op', thunk)
+
+
+_global_var_count = [0]
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Persistent scalar/tensor var (reference
+    fluid/layers/tensor.py::create_global_var).  Lives eagerly as a
+    Tensor (XLA owns placement; force_cpu is advisory) and is registered
+    with the default Program so static save/load picks it up."""
+    _global_var_count[0] += 1
+    t = Tensor(jnp.full(tuple(int(s) for s in shape), value,
+                        convert_dtype(dtype)))
+    t.name = name or f'global_var_{_global_var_count[0]}'
+    t.persistable = persistable
+    t.stop_gradient = True
+    default_main_program()._params[id(t)] = t
+    return t
+
+
+__all__ += ['gradients', 'append_backward', 'Print', 'py_func',
+            'name_scope', 'create_global_var']

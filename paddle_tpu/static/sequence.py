@@ -39,27 +39,11 @@ def _mask(T, seq_len, dtype=jnp.bool_):
 
 
 def sequence_mask(seq_len, maxlen=None, dtype='bool'):
-    """[B] lengths -> [B, maxlen] mask (paddle.nn.functional analogue
-    lives here because every sequence_* op builds on it).
-
-    maxlen=None reads the concrete max length, which only exists
-    eagerly — under jit/static the output shape would be data
-    dependent, so pass maxlen explicitly there."""
-    ln = wrap(seq_len)
-    if maxlen is None:
-        try:
-            v = ln.value
-        except RuntimeError:
-            v = None  # static-Program Variable: no build-time value
-        if v is None or isinstance(v, jax.core.Tracer):
-            raise ValueError(
-                'sequence_mask(maxlen=None) needs a concrete seq_len; '
-                'under jit/to_static/static Programs the mask shape '
-                'must be static — pass maxlen explicitly')
-        maxlen = int(np.asarray(jax.device_get(v)).max())
-    maxlen = int(maxlen)
-    return apply(lambda v: _mask(maxlen, v, jnp.dtype(dtype)), ln,
-                 op_name='sequence_mask')
+    """[B] lengths -> [B, maxlen] mask: the 2-D case of
+    nn.functional.sequence_mask (single implementation, shared guards —
+    maxlen=None needs a concrete eager seq_len)."""
+    from ..nn.functional.common import sequence_mask as _seq_mask
+    return _seq_mask(seq_len, maxlen=maxlen, dtype=dtype)
 
 
 def sequence_conv(x, seq_len, num_filters, filter_size=3, weight=None,

@@ -4,6 +4,8 @@ Reference analogue: /root/reference/python/paddle/tensor/manipulation.py.
 TPU-native note: reshape/transpose/slice are free-ish metadata ops under
 XLA; gather/scatter lower to lax.gather/scatter which tile onto the VPU.
 """
+import builtins
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -19,6 +21,7 @@ __all__ = [
     'take_along_axis', 'put_along_axis', 'numel', 'cast', 'slice',
     'strided_slice', 'rot90', 'as_strided', 'view', 'tolist',
     'tensordot', 'atleast_1d', 'atleast_2d', 'atleast_3d',
+    'reverse', 'crop', 'scatter_nd', 'shard_index', 'shape', 'rank',
 ]
 
 
@@ -307,6 +310,70 @@ def atleast_3d(*inputs, name=None):
 # -- reference long-tail: in-place view variants -----------------------------
 # (python/paddle/tensor/manipulation.py — trailing-underscore ops; the
 # tape edge survives via _snapshot/_replace)
+
+def reverse(x, axis, name=None):
+    """Alias of flip (reference fluid.layers.reverse → paddle.reverse)."""
+    return flip(x, axis)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Crop x to `shape` starting at `offsets` (reference
+    fluid.layers.crop_tensor, exported as paddle.crop). -1 in shape keeps
+    everything from the offset to the end of that dim."""
+    x = wrap(x)
+    nd = x.ndim
+    in_shape = x.shape
+    offs = [0] * nd if offsets is None else list(_resolve_shape(offsets))
+    out = (list(in_shape) if shape is None else list(_resolve_shape(shape)))
+    sizes = [in_shape[d] - offs[d] if out[d] == -1 else out[d]
+             for d in range(nd)]
+    idx = tuple(builtins.slice(o, o + s) for o, s in zip(offs, sizes))
+    return apply(lambda v: v[idx], x, op_name='crop')
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """Zeros of `shape` with `updates` scatter-ADDED at `index` (duplicate
+    indices sum — reference fluid.layers.nn.scatter_nd semantics)."""
+    shp = _resolve_shape(shape)
+
+    def fn(i, u):
+        zeros = jnp.zeros(shp, u.dtype)
+        return zeros.at[tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))] \
+            .add(u)
+    return apply(fn, wrap(index), wrap(updates), op_name='scatter_nd')
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Recompute label indices for the shard_id-th of nshards vocab shards
+    (reference fluid.layers.nn.shard_index): ids belonging to this shard
+    map to their local offset, others to ignore_value.  Pairs with
+    VocabParallelEmbedding / ParallelCrossEntropy on the tp axis."""
+    if not 0 <= shard_id < nshards:
+        raise ValueError(
+            f'shard_id {shard_id} out of range for nshards={nshards}')
+    size = (int(index_num) + int(nshards) - 1) // int(nshards)
+
+    def fn(v):
+        local = v - shard_id * size
+        in_shard = (v // size) == shard_id
+        return jnp.where(in_shard, local,
+                         jnp.asarray(ignore_value, v.dtype))
+    return napply(fn, wrap(input), op_name='shard_index')
+
+
+def shape(input, name=None):
+    """Runtime shape of `input` as a 1-D int32 Tensor (reference
+    tensor/attribute.py: paddle.shape).  Recorded as an op so static
+    Programs report the RUN-time feed shape, not the build-time
+    template (dynamic batch dims would otherwise read as 1)."""
+    return napply(lambda v: jnp.asarray(jnp.shape(v), jnp.int32),
+                  wrap(input), op_name='shape')
+
+
+def rank(input, name=None):
+    """Number of dimensions as a 0-D int32 Tensor (paddle.rank)."""
+    return Tensor(np.asarray(wrap(input).ndim, np.int32))
+
 
 def reshape_(x, shape, name=None):
     x._replace(reshape(x._snapshot(), shape))

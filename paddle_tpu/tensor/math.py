@@ -25,6 +25,7 @@ __all__ = [
     'isinf', 'isnan', 'nan_to_num', 'lerp', 'scale', 'increment', 'all',
     'any', 'heaviside', 'frac', 'rad2deg', 'deg2rad', 'gcd', 'lcm', 'diff',
     'angle', 'count_nonzero', 'sgn', 'take', 'digamma', 'lgamma',
+    'floor_mod', 'stanh', 'multiplex',
 ]
 
 
@@ -63,6 +64,7 @@ divide = _binary(jnp.divide, 'divide')
 floor_divide = _binary(jnp.floor_divide, 'floor_divide')
 mod = _binary(jnp.mod, 'mod')
 remainder = mod
+floor_mod = mod
 maximum = _binary(jnp.maximum, 'maximum')
 minimum = _binary(jnp.minimum, 'minimum')
 fmax = _binary(jnp.fmax, 'fmax')
@@ -322,6 +324,24 @@ def real(x, name=None):
 
 def imag(x, name=None):
     return apply(jnp.imag, wrap(x), op_name='imag')
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    """Scaled tanh: scale_b * tanh(scale_a * x) (reference
+    fluid.layers.nn.stanh → paddle.stanh)."""
+    return apply(lambda v: scale_b * jnp.tanh(scale_a * v), wrap(x),
+                 op_name='stanh')
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select among candidate tensors: out[i] = inputs[index[i]][i]
+    (reference fluid.layers.nn.multiplex → paddle.multiplex)."""
+    def fn(i, *vs):
+        stacked = jnp.stack(vs, axis=0)
+        sel = i.reshape(-1).astype(jnp.int32)
+        return stacked[sel, jnp.arange(stacked.shape[1])]
+    return apply(fn, wrap(index), *[wrap(v) for v in inputs],
+                 op_name='multiplex')
 
 
 def broadcast_shape(x_shape, y_shape):

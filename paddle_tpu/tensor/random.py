@@ -19,9 +19,23 @@ __all__ = [
     'rand', 'randn', 'randint', 'randint_like', 'uniform', 'normal',
     'standard_normal', 'randperm', 'bernoulli', 'multinomial', 'poisson',
     'shuffle', 'seed', 'uniform_', 'normal_', 'exponential_',
+    'check_shape',
 ]
 
 seed = rng.seed
+
+
+def check_shape(shape, op_name='check_shape'):
+    """Validate a shape argument (reference exports
+    fluid.data_feeder.check_shape via tensor.random): accepts an int, a
+    list/tuple of ints / 0-D int Tensors, or a 1-D int Tensor.  Raises
+    TypeError on anything else.  Returns the normalized tuple."""
+    try:
+        return _shape(shape)
+    except (TypeError, ValueError) as e:
+        raise TypeError(
+            f'{op_name}: invalid shape {shape!r} — expected int, '
+            f'sequence of ints, or 1-D integer Tensor') from e
 
 
 def rand(shape, dtype=None, name=None):

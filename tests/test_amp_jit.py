@@ -117,3 +117,43 @@ class TestJit:
         a = np.asarray(snet(x).value)
         b = np.asarray(snet(x).value)
         assert not np.allclose(a, b), "dropout mask must differ per call"
+
+
+class TestJitCompatSurface:
+    """TracedLayer / ProgramTranslator / verbosity (reference
+    fluid/dygraph/jit.py, dy2static/program_translator.py)."""
+
+    def test_traced_layer_roundtrip(self, tmp_path):
+        from paddle_tpu import jit, nn
+        paddle.seed(0)
+        layer = nn.Linear(4, 3)
+        x = paddle.ones([2, 4])
+        out, traced = jit.TracedLayer.trace(layer, [x])
+        got = traced([x])
+        np.testing.assert_allclose(got[0].numpy(), out.numpy(), rtol=1e-6)
+        path = str(tmp_path / 'm')
+        traced.save_inference_model(path)
+        loaded = jit.load(path)
+        np.testing.assert_allclose(loaded(x).numpy(), out.numpy(),
+                                   rtol=1e-5)
+
+    def test_program_translator_toggles(self):
+        from paddle_tpu import jit
+        pt = jit.ProgramTranslator.get_instance()
+        assert pt is jit.ProgramTranslator.get_instance()
+        pt.enable(False)
+        try:
+            assert not pt.enable_to_static
+        finally:
+            pt.enable(True)
+        jit.set_verbosity(0)
+        jit.set_code_level(0)
+
+    def test_bilinear_initializer(self):
+        from paddle_tpu import nn
+        w = nn.initializer.Bilinear()((2, 3, 4, 4), 'float32')
+        wv = w if isinstance(w, np.ndarray) else np.asarray(w)
+        assert wv.shape == (2, 3, 4, 4)
+        # all channels share the interpolation kernel; symmetric
+        np.testing.assert_allclose(wv[0, 0], wv[1, 2])
+        np.testing.assert_allclose(wv[0, 0], wv[0, 0].T)

@@ -115,3 +115,95 @@ class TestBackward:
         loss.backward()
         np.testing.assert_allclose(h.grad.numpy(), [12.0])
         np.testing.assert_allclose(x.grad.numpy(), [36.0])
+
+
+class TestGradAPI:
+    """paddle.grad — partial derivatives without touching .grad
+    (reference python/paddle/fluid/dygraph/base.py:407)."""
+
+    def test_single_output(self):
+        x = mk(2.0)
+        y = x * x
+        dx, = paddle.grad([y], [x])
+        np.testing.assert_allclose(float(dx), 4.0)
+        assert x.grad is None
+
+    def test_multi_output_sum(self):
+        x = mk(2.0)
+        y1 = x * x
+        y2 = x * 3.0
+        dx, = paddle.grad([y1, y2], [x])
+        np.testing.assert_allclose(float(dx), 7.0)
+
+    def test_grad_outputs_seed(self):
+        x = mk(2.0)
+        y = x * x
+        dx, = paddle.grad([y], [x], grad_outputs=[paddle.to_tensor(5.0)])
+        np.testing.assert_allclose(float(dx), 20.0)
+
+    def test_intermediate_input(self):
+        x = mk(3.0)
+        b = x * 2.0
+        c = b * b
+        db, = paddle.grad([c], [b], retain_graph=True)
+        np.testing.assert_allclose(float(db), 12.0)  # 2b at b=6
+        dx, = paddle.grad([c], [x])
+        np.testing.assert_allclose(float(dx), 24.0)  # 8x at x=3
+
+    def test_allow_unused(self):
+        x = mk(2.0)
+        z = mk(1.0)
+        y = x * x
+        with pytest.raises(RuntimeError):
+            paddle.grad([y], [z], retain_graph=True)
+        g = paddle.grad([y], [z], allow_unused=True)
+        assert g[0] is None
+
+    def test_no_grad_vars_cuts_flow(self):
+        a = mk(3.0)
+        b = a * 2.0
+        c = b * a  # c = 2a^2; cutting b leaves only the direct edge: dc/da = b
+        gc, = paddle.grad([c], [a], no_grad_vars=[b])
+        np.testing.assert_allclose(float(gc), 6.0)
+
+    def test_freed_graph_raises(self):
+        x = mk(2.0)
+        y = x * x
+        paddle.grad([y], [x])
+        with pytest.raises(RuntimeError, match='retain_graph'):
+            paddle.grad([y], [x])
+
+    def test_create_graph_unsupported(self):
+        x = mk(2.0)
+        y = x * x
+        with pytest.raises(NotImplementedError):
+            paddle.grad([y], [x], create_graph=True)
+
+    def test_set_grad_enabled(self):
+        x = mk(2.0)
+        with paddle.set_grad_enabled(False):
+            t = x * x
+        assert t.grad_node is None
+        with paddle.set_grad_enabled(True):
+            t = x * x
+        assert t.grad_node is not None
+
+
+class TestRetainedGraphSeeds:
+    """Seeds must be consumed per walk: a retained graph re-walked by
+    backward() or grad() starts from fresh cotangents."""
+
+    def test_grad_after_backward_no_double_count(self):
+        x = mk(2.0)
+        y = x * x
+        y.backward(retain_graph=True)
+        np.testing.assert_allclose(x.grad.numpy(), 4.0)
+        dx, = paddle.grad([y], [x], retain_graph=True)
+        np.testing.assert_allclose(float(dx), 4.0)  # not 8.0
+
+    def test_repeated_backward_accumulates_linearly(self):
+        x = mk(3.0)
+        y = x * x
+        y.backward(retain_graph=True)
+        y.backward(retain_graph=True)
+        np.testing.assert_allclose(x.grad.numpy(), 12.0)  # 6 + 6

@@ -152,3 +152,144 @@ class TestHostOffloadEmbedding:
         emb2 = HostOffloadEmbedding(20, 8, seed=0)
         with pytest.raises(ValueError, match='shape mismatch'):
             emb2.set_extra_state(emb.get_extra_state())
+
+
+class TestEntryAdmission:
+    """Entry admission configs (reference distributed/entry_attr.py)
+    gating the host-side sparse update."""
+
+    def _push_once(self, emb, ids):
+        x = paddle.to_tensor(np.asarray(ids, 'int64'))
+        out = emb(x)
+        out.sum().backward()
+
+    def test_count_filter_blocks_until_threshold(self):
+        from paddle_tpu.distributed import CountFilterEntry
+        paddle.seed(0)
+        emb = HostOffloadEmbedding(10, 4, learning_rate=1.0,
+                                   entry=CountFilterEntry(2))
+        before = emb.table[3].copy()
+        self._push_once(emb, [3])          # count=1 < 2: no learning
+        np.testing.assert_allclose(emb.table[3], before)
+        self._push_once(emb, [3])          # count=2: admitted
+        assert not np.allclose(emb.table[3], before)
+
+    def test_count_filter_counts_duplicates(self):
+        from paddle_tpu.distributed import CountFilterEntry
+        paddle.seed(0)
+        emb = HostOffloadEmbedding(10, 4, learning_rate=1.0,
+                                   entry=CountFilterEntry(2))
+        before = emb.table[5].copy()
+        self._push_once(emb, [5, 5])       # two shows in one batch
+        assert not np.allclose(emb.table[5], before)
+
+    def test_probability_entry_is_sticky(self):
+        from paddle_tpu.distributed import ProbabilityEntry
+        paddle.seed(0)
+        emb = HostOffloadEmbedding(50, 4, learning_rate=1.0,
+                                   entry=ProbabilityEntry(0.5), seed=0)
+        before = emb.table.copy()
+        self._push_once(emb, list(range(50)))
+        changed = ~np.isclose(emb.table, before).all(axis=1)
+        # ~half admitted; and the decision is per-row sticky
+        assert 5 < changed.sum() < 45
+        mid = emb.table.copy()
+        self._push_once(emb, list(range(50)))
+        changed2 = ~np.isclose(emb.table, mid).all(axis=1)
+        np.testing.assert_array_equal(changed, changed2)
+
+    def test_entry_validation(self):
+        from paddle_tpu.distributed import (ProbabilityEntry,
+                                            CountFilterEntry)
+        with pytest.raises(ValueError):
+            ProbabilityEntry(1.5)
+        with pytest.raises(ValueError):
+            CountFilterEntry(-1)
+        with pytest.raises(TypeError):
+            HostOffloadEmbedding(4, 2, entry=object())
+
+
+class TestFleetDatasets:
+    """InMemoryDataset/QueueDataset (reference fleet/dataset/dataset.py)."""
+
+    def _write_files(self, tmp_path):
+        f1 = tmp_path / 'a.txt'
+        f2 = tmp_path / 'b.txt'
+        f1.write_text('1 0.5 0.25\n2 1.5 1.25\n')
+        f2.write_text('3 2.5 2.25\n')
+        return [str(f1), str(f2)]
+
+    def _specs(self):
+        from paddle_tpu.static import InputSpec
+        lab = InputSpec([None, 1], 'int64', 'label')
+        den = InputSpec([None, 2], 'float32', 'dense')
+        return [lab, den]
+
+    def test_queue_dataset_streams(self, tmp_path):
+        from paddle_tpu.distributed import QueueDataset
+        ds = QueueDataset()
+        ds.init(batch_size=2, use_var=self._specs())
+        ds.set_filelist(self._write_files(tmp_path))
+        rows = list(ds)
+        assert len(rows) == 3
+        lab, den = rows[0]
+        np.testing.assert_array_equal(lab, [1])
+        np.testing.assert_allclose(den, [0.5, 0.25])
+
+    def test_inmemory_shuffle_and_sizes(self, tmp_path):
+        from paddle_tpu.distributed import InMemoryDataset
+        ds = InMemoryDataset()
+        ds.init(batch_size=2, use_var=self._specs())
+        ds.set_filelist(self._write_files(tmp_path))
+        with pytest.raises(RuntimeError):
+            iter(ds)
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 3
+        ds.local_shuffle()
+        labels = sorted(int(r[0][0]) for r in ds)
+        assert labels == [1, 2, 3]
+        ds.release_memory()
+        assert ds.get_memory_data_size() == 0
+
+    def test_feeds_dataloader(self, tmp_path):
+        from paddle_tpu.distributed import InMemoryDataset
+        from paddle_tpu.io import DataLoader
+        ds = InMemoryDataset()
+        ds.init(batch_size=2, use_var=self._specs())
+        ds.set_filelist(self._write_files(tmp_path))
+        ds.load_into_memory()
+        dl = DataLoader(ds.as_dataset(), batch_size=2, drop_last=False)
+        batches = list(dl)
+        assert len(batches) == 2
+        assert batches[0][0].shape[0] == 2
+
+
+class TestDistributedSplit:
+    """paddle.distributed.split (reference collective.py:1108) routed
+    through the TP layers."""
+
+    def test_linear_row_and_col(self):
+        from paddle_tpu.distributed import split
+        from paddle_tpu.distributed import env as dist_env
+        dist_env.set_mesh(None)
+        paddle.seed(0)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 8).astype('float32'))
+        y0 = split(x, (8, 6), 'linear', axis=0, num_partitions=2)
+        assert y0.shape == [2, 6]
+        y1 = split(x, (8, 6), 'linear', axis=1, num_partitions=2)
+        assert y1.shape == [2, 6]
+
+    def test_embedding(self):
+        from paddle_tpu.distributed import split
+        from paddle_tpu.distributed import env as dist_env
+        dist_env.set_mesh(None)
+        paddle.seed(0)
+        ids = paddle.to_tensor(np.array([[1, 2]], 'int64'))
+        out = split(ids, (16, 4), 'embedding', num_partitions=2)
+        assert out.shape == [1, 2, 4]
+
+    def test_bad_operation(self):
+        from paddle_tpu.distributed import split
+        with pytest.raises(ValueError):
+            split(paddle.ones([2, 2]), (2, 2), 'conv')

@@ -400,3 +400,78 @@ class TestNNLongTail:
         F.softmax_(x)
         np.testing.assert_allclose(np.asarray(x.numpy()).sum(), 1.0,
                                    rtol=1e-6)
+
+
+class TestDecode:
+    """BeamSearchDecoder + dynamic_decode (reference fluid/layers/rnn.py
+    BeamSearchDecoder:866, dynamic_decode:1581)."""
+
+    def _build(self, vocab=7, hidden=16, beam=3):
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        cell = nn.GRUCell(hidden, hidden)
+        emb = nn.Embedding(vocab, hidden)
+        out = nn.Linear(hidden, vocab)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                   beam_size=beam,
+                                   embedding_fn=emb, output_fn=out)
+        return dec, hidden
+
+    def test_shapes_and_termination(self):
+        dec, hidden = self._build()
+        B, K, T = 2, 3, 5
+        init = paddle.zeros([B, hidden])
+        ids, final_states, lengths = paddle.nn.dynamic_decode(
+            dec, inits=init, max_step_num=T, return_length=True)
+        # batch-major [B, T', K], T' <= T+1
+        assert ids.shape[0] == B and ids.shape[2] == K
+        assert ids.shape[1] <= T + 1
+        assert lengths.shape == [B, K]
+        assert np.asarray(ids.numpy()).dtype.kind == 'i'
+
+    def test_beams_sorted_by_score(self):
+        dec, hidden = self._build()
+        B = 2
+        init = paddle.zeros([B, hidden])
+        out, states = paddle.nn.dynamic_decode(dec, inits=init,
+                                               max_step_num=4)
+        lp = states.log_probs.numpy()
+        assert np.all(np.diff(lp, axis=1) <= 1e-6), lp  # descending beams
+
+    def test_gather_tree_backtrace(self):
+        import paddle_tpu.nn.functional as F
+        # T=3, B=1, K=2; beam 0 at t2 came from beam 1 at t1 from beam 0
+        ids = paddle.to_tensor(np.array(
+            [[[2, 3]], [[4, 5]], [[6, 7]]], 'int32'))
+        parents = paddle.to_tensor(np.array(
+            [[[0, 0]], [[0, 0]], [[1, 0]]], 'int32'))
+        out = F.gather_tree(ids, parents)
+        np.testing.assert_array_equal(
+            out.numpy(), [[[2, 2]], [[5, 4]], [[6, 7]]])
+
+    def test_sequence_mask(self):
+        import paddle_tpu.nn.functional as F
+        m = F.sequence_mask(paddle.to_tensor(np.array([1, 3], 'int32')),
+                            maxlen=4, dtype='int32')
+        np.testing.assert_array_equal(
+            m.numpy(), [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+    def test_dice_loss(self):
+        import paddle_tpu.nn.functional as F
+        probs = paddle.to_tensor(np.array(
+            [[[0.9, 0.1], [0.2, 0.8]]], 'float32'))  # [1, 2, 2]
+        label = paddle.to_tensor(np.array([[[0], [1]]], 'int64'))
+        loss = float(F.dice_loss(probs, label))
+        inse = 0.9 + 0.8
+        denom = (0.9 + 0.1 + 0.2 + 0.8) + 2.0
+        np.testing.assert_allclose(loss, 1 - 2 * inse / (denom + 1e-5),
+                                   rtol=1e-5)
+
+    def test_npair_loss_runs_and_positive(self):
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(0)
+        a = paddle.to_tensor(rs.randn(4, 8).astype('float32'))
+        p = paddle.to_tensor(rs.randn(4, 8).astype('float32'))
+        lab = paddle.to_tensor(np.array([0, 0, 1, 2], 'int64'))
+        v = float(F.npair_loss(a, p, lab))
+        assert np.isfinite(v) and v > 0

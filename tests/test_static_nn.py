@@ -391,3 +391,178 @@ class TestNormAndMisc:
 
         with pytest.raises(ValueError, match='maxlen'):
             jax.jit(fn)(np.asarray([2, 3], 'int32'))
+
+
+class TestStaticGraphHelpers:
+    """paddle.static surface landed for parity: gradients/append_backward,
+    py_func, Print, save/load, inference export, strategy shims."""
+
+    def _in_static(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            paddle.enable_static()
+            try:
+                yield
+            finally:
+                paddle.disable_static()
+        return ctx()
+
+    def test_gradients_wrt_feed_and_param(self):
+        from paddle_tpu import static
+        with self._in_static():
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data('x', [2, 3])
+                w = paddle.to_tensor(np.full((3,), 2.0, 'float32'))
+                y = (x * x * w).sum()
+                dx, dw = static.gradients([y], [x, w])
+            exe = static.Executor()
+            xv = np.arange(6, dtype='float32').reshape(2, 3)
+            gx, gw = exe.run(prog, feed={'x': xv}, fetch_list=[dx, dw])
+        np.testing.assert_allclose(gx, 2 * xv * 2.0, rtol=1e-5)
+        np.testing.assert_allclose(gw, (xv * xv).sum(0), rtol=1e-5)
+
+    def test_append_backward_enumerates_params(self):
+        from paddle_tpu import static
+        with self._in_static():
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data('x', [4, 3])
+                w = paddle.to_tensor(np.ones((3, 2), 'float32'))
+                w.stop_gradient = False
+                loss = (x @ w).sum()
+                pairs = static.append_backward(loss)
+            assert len(pairs) == 1 and pairs[0][0] is w
+            exe = static.Executor()
+            xv = np.random.RandomState(0).randn(4, 3).astype('float32')
+            gw, = exe.run(prog, feed={'x': xv}, fetch_list=[pairs[0][1]])
+        np.testing.assert_allclose(gw, np.tile(xv.sum(0)[:, None], (1, 2)),
+                                   rtol=1e-5)
+
+    def test_py_func_forward_and_backward(self):
+        from paddle_tpu import static
+        with self._in_static():
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data('x', [2, 2])
+                out = static.py_func(
+                    lambda a: a * 3.0, x,
+                    out=static.InputSpec([2, 2], 'float32'),
+                    backward_func=lambda a, o, do: do * 3.0)
+                loss = out.sum()
+                dx, = static.gradients([loss], [x])
+            exe = static.Executor()
+            xv = np.ones((2, 2), 'float32')
+            ov, gv = exe.run(prog, feed={'x': xv}, fetch_list=[out, dx])
+        np.testing.assert_allclose(ov, 3.0)
+        np.testing.assert_allclose(gv, 3.0)
+
+    def test_print_passthrough(self, capfd):
+        from paddle_tpu import static
+        with self._in_static():
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data('x', [2])
+                y = static.Print(x * 2.0, message='dbg')
+            exe = static.Executor()
+            out, = exe.run(prog, feed={'x': np.ones(2, 'float32')},
+                           fetch_list=[y])
+        np.testing.assert_allclose(out, 2.0)
+
+    def test_static_save_load_roundtrip(self, tmp_path):
+        from paddle_tpu import static
+        with self._in_static():
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data('x', [2, 3])
+                w = paddle.to_tensor(np.full((3,), 5.0, 'float32'))
+                y = (x * w).sum()
+            path = str(tmp_path / 'ckpt')
+            static.save(prog, path)
+            state = static.load_program_state(path)
+            assert len(state) == 1
+            w.value = paddle.zeros([3]).value
+            static.load(prog, path)
+        np.testing.assert_allclose(np.asarray(w.value), 5.0)
+
+    def test_inference_model_roundtrip(self, tmp_path):
+        from paddle_tpu import static
+        xv = np.random.RandomState(0).randn(2, 3).astype('float32')
+        with self._in_static():
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data('x', [2, 3])
+                w = paddle.to_tensor(np.full((3, 4), 0.5, 'float32'))
+                out = paddle.tanh(x @ w)
+            exe = static.Executor()
+            ref, = exe.run(prog, feed={'x': xv}, fetch_list=[out])
+            path = str(tmp_path / 'infer')
+            static.save_inference_model(path, [x], [out], exe)
+            loaded, feed_names, fetch_targets = \
+                static.load_inference_model(path, exe)
+            got = exe.run(loaded, feed={feed_names[0]: xv},
+                          fetch_list=fetch_targets)
+        np.testing.assert_allclose(got[0], ref, rtol=1e-5)
+
+    def test_strategy_shims(self):
+        from paddle_tpu import static
+        bs = static.BuildStrategy()
+        bs.fuse_all_reduce_ops = True
+        assert bs.fuse_all_reduce_ops
+        es = static.ExecutionStrategy()
+        es.num_threads = 4
+        assert es.num_threads == 4
+        assert len(static.cpu_places(2)) == 2
+        assert len(static.cuda_places()) >= 1
+        with pytest.warns(UserWarning):
+            static.WeightNormParamAttr(dim=0)
+
+    def test_compiled_program_runs(self):
+        from paddle_tpu import static
+        with self._in_static():
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data('x', [2])
+                y = x * 2.0
+            with pytest.warns(UserWarning):
+                cp = static.CompiledProgram(prog).with_data_parallel()
+            exe = static.Executor()
+            out, = exe.run(cp, feed={'x': np.ones(2, 'float32')},
+                           fetch_list=[y])
+        np.testing.assert_allclose(out, 2.0)
+
+    def test_create_global_var_and_name_scope(self):
+        from paddle_tpu import static
+        g = static.create_global_var([1], 7.0, 'float32', name='counter')
+        np.testing.assert_allclose(np.asarray(g.value), 7.0)
+        with self._in_static():
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data('x', [2])
+                with static.name_scope('block1'):
+                    y = x * 1.0
+            assert 'block1' in y.name
+
+
+class TestStaticNoGradSet:
+    def test_no_grad_set_cuts_flow(self):
+        from paddle_tpu import static
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data('x', [2])
+                y = x * 2.0
+                z = (y * y).sum()
+                dx_cut, = static.gradients([z], [x], no_grad_set={y})
+                dx_full, = static.gradients([z], [x])
+            exe = static.Executor()
+            xv = np.ones(2, 'float32')
+            g_cut, g_full = exe.run(prog, feed={'x': xv},
+                                    fetch_list=[dx_cut, dx_full])
+        finally:
+            paddle.disable_static()
+        np.testing.assert_allclose(g_cut, 0.0)
+        np.testing.assert_allclose(g_full, 8.0 * xv)

@@ -326,3 +326,83 @@ class TestLongTailOps:
             assert 'e+00' in repr(x) or 'e-' in repr(x)
         finally:
             paddle.set_printoptions(precision=8, sci_mode=False)
+
+
+class TestRound2SurfaceOps:
+    """Ops landed for top-level parity (paddle.multiplex/scatter_nd/...)."""
+
+    def test_multiplex(self):
+        a = paddle.to_tensor(np.array([[1., 2.], [3., 4.]], 'float32'))
+        b = paddle.to_tensor(np.array([[5., 6.], [7., 8.]], 'float32'))
+        idx = paddle.to_tensor(np.array([[1], [0]], 'int32'))
+        out = paddle.multiplex([a, b], idx)
+        np.testing.assert_allclose(out.numpy(), [[5, 6], [3, 4]])
+
+    def test_multiplex_grad_routes_rows(self):
+        a = paddle.to_tensor(np.ones((2, 2), 'float32'))
+        b = paddle.to_tensor(np.ones((2, 2), 'float32'))
+        a.stop_gradient = False
+        b.stop_gradient = False
+        idx = paddle.to_tensor(np.array([[1], [0]], 'int32'))
+        paddle.multiplex([a, b], idx).sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(), [[0, 0], [1, 1]])
+        np.testing.assert_allclose(b.grad.numpy(), [[1, 1], [0, 0]])
+
+    def test_scatter_nd_duplicates_sum(self):
+        idx = paddle.to_tensor(np.array([[1], [1], [3]], 'int32'))
+        upd = paddle.to_tensor(np.array([9., 10., 11.], 'float32'))
+        out = paddle.scatter_nd(idx, upd, [5])
+        np.testing.assert_allclose(out.numpy(), [0, 19, 0, 11, 0])
+
+    def test_shard_index(self):
+        x = paddle.to_tensor(np.array([1, 7, 15], 'int32'))
+        out = paddle.shard_index(x, index_num=16, nshards=2, shard_id=1)
+        np.testing.assert_array_equal(out.numpy(), [-1, -1, 7])
+        out0 = paddle.shard_index(x, index_num=16, nshards=2, shard_id=0)
+        np.testing.assert_array_equal(out0.numpy(), [1, 7, -1])
+
+    def test_crop(self):
+        x = paddle.to_tensor(np.arange(12., dtype='float32').reshape(3, 4))
+        out = paddle.crop(x, shape=[2, -1], offsets=[1, 1])
+        np.testing.assert_allclose(out.numpy(), [[5, 6, 7], [9, 10, 11]])
+
+    def test_shape_rank_reverse(self):
+        x = paddle.to_tensor(np.zeros((2, 3), 'float32'))
+        np.testing.assert_array_equal(paddle.shape(x).numpy(), [2, 3])
+        assert int(paddle.rank(x)) == 2
+        r = paddle.reverse(paddle.to_tensor(np.array([1., 2., 3.])), 0)
+        np.testing.assert_allclose(r.numpy(), [3, 2, 1])
+
+    def test_stanh_floor_mod(self):
+        v = float(paddle.stanh(paddle.to_tensor(1.0)))
+        np.testing.assert_allclose(v, 1.7159 * np.tanh(0.67), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(paddle.floor_mod(paddle.to_tensor(7.0),
+                                   paddle.to_tensor(3.0))), 1.0)
+
+    def test_batch_reader(self):
+        rd = paddle.batch(lambda: iter(range(7)), 3)
+        assert [len(b) for b in rd()] == [3, 3, 1]
+        rd = paddle.batch(lambda: iter(range(7)), 3, drop_last=True)
+        assert [len(b) for b in rd()] == [3, 3]
+
+    def test_complex_tensor(self):
+        ct = paddle.ComplexTensor(np.ones((2, 2)), np.full((2, 2), 2.0))
+        np.testing.assert_allclose(ct.real().numpy(), 1.0)
+        np.testing.assert_allclose(ct.imag().numpy(), 2.0)
+
+    def test_rng_state_shims(self):
+        st = paddle.get_cuda_rng_state()
+        a = paddle.rand([4]).numpy()
+        paddle.set_cuda_rng_state(st)
+        b = paddle.rand([4]).numpy()
+        np.testing.assert_allclose(a, b)
+
+    def test_misc_shims(self):
+        assert paddle.in_dynamic_mode()
+        assert paddle.get_cudnn_version() is None
+        assert paddle.CUDAPinnedPlace().kind == 'cpu'
+        assert paddle.dtype('float32') == np.float32
+        paddle.check_shape([2, 3])
+        with pytest.raises(TypeError):
+            paddle.check_shape(object())
