@@ -65,7 +65,15 @@ class Predictor:
         self._outputs = []
 
     def get_input_names(self):
-        n = getattr(self._fn, 'n_inputs', 1)
+        """Real tensor names from the saved InputSpecs (reference
+        deployments feed by name); positional input_i only when the
+        artifact predates named specs."""
+        names = getattr(self._fn, 'input_names', None)
+        if callable(names):
+            got = names()
+            if got:
+                return got
+        n = getattr(self._fn, 'n_inputs', None) or 1
         return [f'input_{i}' for i in range(n)]
 
     def get_input_handle(self, name):
@@ -75,8 +83,14 @@ class Predictor:
         return h
 
     def run(self):
-        args = [self._inputs[n]._data for n in self.get_input_names()
-                if n in self._inputs]
+        names = self.get_input_names()
+        missing = [n for n in names if n not in self._inputs]
+        if missing:
+            raise KeyError(
+                f'inputs {missing} were not fed — call '
+                f'get_input_handle(name).copy_from_cpu(...) for each of '
+                f'{names} before run()')
+        args = [self._inputs[n]._data for n in names]
         out = self._fn(*args)
         if not isinstance(out, (tuple, list)):
             out = [out]

@@ -314,6 +314,11 @@ def save(layer, path, input_spec=None, **configs):
                          "(shapes define the XLA module)")
     spec = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
             for s in input_spec]
+    named = [s.name for s in spec if s.name]
+    if len(named) != len(set(named)):
+        raise ValueError(
+            f'jit.save: duplicate InputSpec names {sorted(named)} — '
+            'deployments feed inputs by name, so names must be unique')
     exp = static_fn.exported(spec)
     blob = exp.serialize()
     os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
@@ -336,13 +341,20 @@ class TranslatedLayer(Layer):
     XLA module (reference: translated_layer.py runs the loaded
     ProgramDesc)."""
 
-    def __init__(self, exported, state):
+    def __init__(self, exported, state, input_specs=None):
         super().__init__()
         self._exported = exported
         self._params_tree = {k: jnp.asarray(v)
                              for k, v in state.get('params', {}).items()}
         self._buffers_tree = {k: jnp.asarray(v)
                               for k, v in state.get('buffers', {}).items()}
+        # (shape, dtype, name) tuples pickled by jit.save — real tensor
+        # names so deployments (inference.Predictor) can feed by name
+        self._input_specs = input_specs or []
+
+    def input_names(self):
+        return [n or f'input_{i}'
+                for i, (_, _, n) in enumerate(self._input_specs)]
 
     def forward(self, *args):
         tvals = [_unwrap(a) for a in args]
@@ -358,7 +370,7 @@ def load(path, **configs):
         exp = jexport.deserialize(f.read())
     with open(path + '.pdiparams', 'rb') as f:
         meta = pickle.load(f)
-    return TranslatedLayer(exp, meta['state'])
+    return TranslatedLayer(exp, meta['state'], meta.get('spec'))
 
 
 # -- dy2static compat surface -------------------------------------------------

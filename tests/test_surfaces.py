@@ -147,3 +147,47 @@ class TestLocalSGD:
         tr.sync()
         w = np.asarray(jax.tree_util.tree_leaves(tr.params)[0])
         np.testing.assert_allclose(w[0], w[-1], rtol=1e-6)
+
+
+class TestPredictorNamedInputs:
+    def test_real_spec_names_surface(self, tmp_path):
+        """Saved InputSpec.name travels into Predictor.get_input_names
+        (reference deployments feed tensors by their real names)."""
+        net = nn.Sequential(nn.Linear(4, 3), nn.Tanh())
+        net.eval()
+        path = str(tmp_path / 'named')
+        from paddle_tpu.static import InputSpec
+        paddle.jit.save(net, path,
+                        input_spec=[InputSpec([1, 4], 'float32',
+                                              name='pixel_values')])
+        pred = paddle.inference.create_predictor(
+            paddle.inference.Config(path))
+        assert pred.get_input_names() == ['pixel_values']
+        h = pred.get_input_handle('pixel_values')
+        x = np.random.randn(1, 4).astype('float32')
+        h.copy_from_cpu(x)
+        assert pred.run()
+        out = pred.get_output_handle(
+            pred.get_output_names()[0]).copy_to_cpu()
+        ref = np.asarray(net(paddle.to_tensor(x)).value)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_duplicate_spec_names_rejected(self, tmp_path):
+        from paddle_tpu.static import InputSpec
+        net = nn.Linear(4, 3)
+        with pytest.raises(ValueError, match='duplicate'):
+            paddle.jit.save(net, str(tmp_path / 'd'), input_spec=[
+                InputSpec([1, 4], 'float32', name='x'),
+                InputSpec([1, 4], 'float32', name='x')])
+
+    def test_unfed_input_raises_clearly(self, tmp_path):
+        from paddle_tpu.static import InputSpec
+        net = nn.Linear(4, 3)
+        net.eval()
+        path = str(tmp_path / 'u')
+        paddle.jit.save(net, path, input_spec=[
+            InputSpec([1, 4], 'float32', name='pixel_values')])
+        pred = paddle.inference.create_predictor(
+            paddle.inference.Config(path))
+        with pytest.raises(KeyError, match='pixel_values'):
+            pred.run()
