@@ -58,6 +58,8 @@ from . import ops  # noqa: F401
 from . import models  # noqa: F401
 from . import profiler  # noqa: F401
 from . import utils  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import autograd  # noqa: F401
 from . import hub  # noqa: F401
 from . import inference  # noqa: F401
 from . import onnx  # noqa: F401

@@ -1,0 +1,135 @@
+"""paddle_tpu.autograd — user-facing autograd namespace.
+
+Reference analogue: /root/reference/python/paddle/autograd/__init__.py
+(grad, backward, PyLayer, PyLayerContext — py_layer.py builds a CFunction
+node into the dygraph engine).
+
+TPU-native PyLayer: forward runs eagerly (its internal ops are NOT
+taped); a single GradNode is recorded whose vjp closure calls the
+user's backward().  Inside backward the cotangents arrive as ordinary
+Tensors, so any paddle_tpu op works there, and the math still lowers to
+XLA when the surrounding step is jitted.
+"""
+import numpy as np
+import jax
+
+from ..core import autograd as _ag
+from ..core.autograd import grad  # noqa: F401
+from ..core.autograd import GradNode
+from ..core.tensor import Tensor
+
+__all__ = ['grad', 'backward', 'PyLayer', 'PyLayerContext']
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """Compute grads of several root tensors (reference
+    autograd/backward_mode.py::backward); cotangents accumulate into
+    `.grad` of every reachable non-stop-gradient tensor."""
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    grad_tensors = grad_tensors if isinstance(grad_tensors, (list, tuple)) \
+        else [grad_tensors]
+    if len(grad_tensors) != len(tensors):
+        raise ValueError('grad_tensors must match tensors in length')
+    _ag.backward_multi(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+class PyLayerContext:
+    """Carried from forward to backward (reference py_layer.py)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.container = None   # legacy alias some reference code pokes
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """User-defined differentiable op:
+
+        class cus_tanh(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                y = paddle.tanh(x)
+                ctx.save_for_backward(y)
+                return y
+
+            @staticmethod
+            def backward(ctx, dy):
+                y, = ctx.saved_tensor()
+                return dy * (1 - y * y)
+
+        z = cus_tanh.apply(x)
+
+    backward() must return one grad per Tensor input of forward (None
+    for non-differentiable ones), matching the reference's contract.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError(
+            'PyLayer subclasses must define a static forward()')
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError(
+            'PyLayer subclasses must define a static backward()')
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tpos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+        requires = (_ag.is_grad_enabled()
+                    and any(not args[i].stop_gradient for i in tpos))
+        with _ag.no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(out, (tuple, list))
+        outs = [out] if single else list(out)
+        for o in outs:
+            if not isinstance(o, Tensor):
+                raise TypeError('PyLayer.forward must return Tensor(s), '
+                                f'got {type(o).__name__}')
+        if not requires:
+            # mark only FRESH outputs non-differentiable — forward may
+            # return an input unchanged, and mutating the caller's
+            # tensor would silently kill its future gradients
+            fresh = [o if o.stop_gradient and o.grad_node is None
+                     else Tensor._from_value(o.value, stop_gradient=True)
+                     for o in outs]
+            return fresh[0] if single else type(out)(fresh)
+
+        avals = [(tuple(o.value.shape), o.value.dtype) for o in outs]
+        n_out = len(outs)
+        in_tensors = [args[i] for i in tpos]
+
+        def vjp_fn(cts):
+            cts = (cts,) if n_out == 1 else tuple(cts)
+            ct_tensors = [Tensor._from_value(c, stop_gradient=True)
+                          for c in cts]
+            with _ag.no_grad():
+                gs = cls.backward(ctx, *ct_tensors)
+            gs = (gs,) if not isinstance(gs, (tuple, list)) else tuple(gs)
+            if len(gs) != len(in_tensors):
+                raise ValueError(
+                    f'{cls.__name__}.backward returned {len(gs)} grads '
+                    f'for {len(in_tensors)} tensor inputs')
+            return [None if g is None else
+                    (g.value if isinstance(g, Tensor) else np.asarray(g))
+                    for g in gs]
+
+        node = GradNode(
+            vjp_fn,
+            [t if not t.stop_gradient else None for t in in_tensors],
+            avals, name=cls.__name__, out_is_seq=n_out > 1)
+        fresh = []
+        for i, o in enumerate(outs):
+            t = Tensor._from_value(o.value, stop_gradient=False)
+            t.grad_node = node
+            t.grad_index = i
+            fresh.append(t)
+        return fresh[0] if single else type(out)(fresh)

@@ -146,6 +146,47 @@ def backward(tensor, grad=None, retain_graph=False):
         _detach_graph(tensor)
 
 
+def backward_multi(tensors, grads=None, retain_graph=False):
+    """backward() from several roots in ONE reverse walk, so shared
+    subgraphs are differentiated once and freed exactly once (no forced
+    graph retention between roots)."""
+    if grads is None:
+        grads = [None] * len(tensors)
+    roots = []
+    for t, g in zip(tensors, grads):
+        g = jnp.ones_like(t.value) if g is None else _val(g)
+        if not t.stop_gradient:
+            t._accumulate_grad(g)
+        if t.grad_node is not None:
+            t.grad_node.seed_grad(t.grad_index, g)
+            roots.append(t.grad_node)
+
+    order = _topo_order_multi(roots)
+    for node in order:
+        if all(g is None for g in node.out_grads):
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f'trying to differentiate through op {node.name!r} whose '
+                'graph was already freed by a previous backward()/grad() '
+                'call; pass retain_graph=True to the earlier call')
+        in_grads = node.vjp_fn(node.cotangents())
+        node.out_grads = [None] * len(node.out_avals)
+        for t, g in zip(node.inputs, in_grads):
+            if t is None or g is None:
+                continue
+            if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+                continue
+            t._accumulate_grad(g)
+            if t.grad_node is not None:
+                t.grad_node.seed_grad(t.grad_index, g)
+        if not retain_graph:
+            node.vjp_fn = None
+    if not retain_graph:
+        for t in tensors:
+            _detach_graph(t)
+
+
 class set_grad_enabled:
     """Context manager enabling/disabling the tape, effective immediately.
 

@@ -21,9 +21,29 @@ from .fleet_base import (  # noqa: F401
 from .meta_parallel import (  # noqa: F401
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     ParallelCrossEntropy, PipelineLayer, LayerDesc, get_rng_state_tracker)
+from .fleet_base import Fleet, HybridCommunicateGroup  # noqa: F401
+from .topology import CommunicateTopology  # noqa: F401
+from .role_maker import (  # noqa: F401
+    Role, PaddleCloudRoleMaker, UserDefinedRoleMaker, UtilBase)
+from .data_generator import (  # noqa: F401
+    MultiSlotDataGenerator, MultiSlotStringDataGenerator)
+from . import utils  # noqa: F401
+
+
+def __getattr__(name):
+    # fleet.util tracks the CURRENT Fleet instance's role maker (a
+    # plain import-time binding would freeze a pre-init UtilBase)
+    if name == 'util':
+        from .fleet_base import get_fleet
+        return get_fleet().util
+    raise AttributeError(name)
 
 __all__ = ['DistributedStrategy', 'init', 'distributed_optimizer',
            'distributed_model', 'worker_index', 'worker_num',
            'is_first_worker', 'ColumnParallelLinear', 'RowParallelLinear',
            'VocabParallelEmbedding', 'ParallelCrossEntropy',
-           'PipelineLayer', 'LayerDesc', 'get_hybrid_communicate_group']
+           'PipelineLayer', 'LayerDesc', 'get_hybrid_communicate_group',
+           'Fleet', 'HybridCommunicateGroup', 'CommunicateTopology',
+           'Role', 'PaddleCloudRoleMaker', 'UserDefinedRoleMaker',
+           'UtilBase', 'MultiSlotDataGenerator',
+           'MultiSlotStringDataGenerator', 'utils', 'util']

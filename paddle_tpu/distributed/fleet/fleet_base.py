@@ -72,8 +72,20 @@ class Fleet:
         self._strategy = None
         self._hcg = None
         self._is_initialized = False
+        self._role_maker = None
+        self._util = None
+
+    @property
+    def util(self):
+        """fleet.util (reference util_factory): host-side helpers."""
+        if self._util is None:
+            from .role_maker import UtilBase
+            self._util = UtilBase(self._role_maker)
+        return self._util
 
     def init(self, role_maker=None, is_collective=True, strategy=None):
+        self._role_maker = role_maker
+        self._util = None   # rebuild fleet.util against this role maker
         self._strategy = strategy or DistributedStrategy()
         hc = self._strategy.hybrid_configs
         import jax

@@ -1,0 +1,143 @@
+"""Role makers + fleet.util.
+
+Reference analogue:
+/root/reference/python/paddle/distributed/fleet/base/role_maker.py
+(PaddleCloudRoleMaker reads cluster env vars; UserDefinedRoleMaker takes
+explicit endpoints; Role.WORKER/SERVER) and base/util_factory.py
+(UtilBase — host-side collectives + file sharding).
+
+TPU-native: "workers" are HOST processes (one per host, driving all its
+chips); there are no parameter-server processes — the PS substitute is
+incubate.HostOffloadEmbedding — so SERVER roles exist for API parity and
+always report zero servers unless explicitly configured.
+"""
+import os
+
+__all__ = ['Role', 'PaddleCloudRoleMaker', 'UserDefinedRoleMaker',
+           'UtilBase']
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class _RoleMakerBase:
+    def __init__(self):
+        self._worker_endpoints = []
+        self._server_endpoints = []
+        self._role = Role.WORKER
+        self._current_id = 0
+
+    def _worker_index(self):
+        import jax
+        return jax.process_index()
+
+    def _worker_num(self):
+        import jax
+        return jax.process_count()
+
+    def _is_worker(self):
+        return self._role == Role.WORKER
+
+    def _is_server(self):
+        return self._role == Role.SERVER
+
+    def _is_first_worker(self):
+        return self._is_worker() and self._worker_index() == 0
+
+    def _get_trainer_endpoints(self):
+        return list(self._worker_endpoints)
+
+    def _get_pserver_endpoints(self):
+        return list(self._server_endpoints)
+
+    def _server_num(self):
+        return len(self._server_endpoints)
+
+
+class PaddleCloudRoleMaker(_RoleMakerBase):
+    """Reads the launch environment (reference reads PADDLE_* env vars
+    set by paddle.distributed.launch; here the JAX distributed runtime
+    already knows process_index/count, and PADDLE_TRAINER_ENDPOINTS is
+    honored when present for parity)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        eps = os.environ.get('PADDLE_TRAINER_ENDPOINTS', '')
+        self._worker_endpoints = [e for e in eps.split(',') if e]
+        seps = os.environ.get('PADDLE_PSERVERS_IP_PORT_LIST', '')
+        self._server_endpoints = [e for e in seps.split(',') if e]
+
+
+class UserDefinedRoleMaker(_RoleMakerBase):
+    """Explicit topology (reference UserDefinedRoleMaker)."""
+
+    def __init__(self, is_collective=False, init_gloo=False,
+                 current_id=0, role=Role.WORKER, worker_num=1,
+                 worker_endpoints=None, server_endpoints=None, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        self._current_id = current_id
+        self._role = role
+        self._worker_endpoints = list(worker_endpoints or [])
+        self._server_endpoints = list(server_endpoints or [])
+        self._user_worker_num = worker_num
+
+    def _worker_index(self):
+        return self._current_id
+
+    def _worker_num(self):
+        return self._user_worker_num
+
+
+class UtilBase:
+    """fleet.util (reference base/util_factory.py::UtilBase): host-side
+    helpers that are NOT part of the compiled step — cross-host reduce
+    of python scalars, barriers, and input-file sharding."""
+
+    def __init__(self, role_maker=None):
+        self._role_maker = role_maker
+
+    def _pcount(self):
+        import jax
+        return jax.process_count()
+
+    def all_reduce(self, input, mode='sum', comm_world='worker'):
+        """Reduce a host value across host processes.  Multi-host rides
+        jax's global collective over a tiny device array; single-host is
+        the identity."""
+        import numpy as np
+        if self._pcount() == 1:
+            arr = np.asarray(input)
+            if mode == 'sum':
+                return arr
+            return arr  # min/max of one participant is itself
+        from jax.experimental import multihost_utils
+        import jax.numpy as jnp
+        arr = jnp.asarray(input)
+        ops = {'sum': jnp.sum, 'min': jnp.min, 'max': jnp.max}
+        stacked = multihost_utils.process_allgather(arr)
+        return np.asarray(ops[mode](stacked, axis=0))
+
+    def barrier(self, comm_world='worker'):
+        if self._pcount() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices('fleet_util_barrier')
+
+    def get_file_shard(self, files):
+        """Split a file list evenly over workers (reference contract:
+        earlier workers take the remainder)."""
+        if not isinstance(files, list):
+            raise TypeError('files should be a list of file paths')
+        import jax
+        n, i = jax.process_count(), jax.process_index()
+        base, rem = divmod(len(files), n)
+        begin = i * base + min(i, rem)
+        return files[begin: begin + base + (1 if i < rem else 0)]
+
+    def print_on_rank(self, message, rank_id=0):
+        import jax
+        if jax.process_index() == rank_id:
+            print(message, flush=True)

@@ -1,0 +1,12 @@
+"""fleet.utils — filesystem helpers, PS-infer shim, recompute.
+
+Reference analogue:
+/root/reference/python/paddle/distributed/fleet/utils/__init__.py
+(LocalFS/HDFSClient from fs.py, DistributedInfer from ps_util.py,
+recompute from recompute.py).
+"""
+from .fs import LocalFS, HDFSClient  # noqa: F401
+from .ps_util import DistributedInfer  # noqa: F401
+from .recompute import recompute  # noqa: F401
+
+__all__ = ['LocalFS', 'HDFSClient', 'recompute', 'DistributedInfer']

@@ -1,0 +1,29 @@
+"""Parameter-server inference shim.
+
+Reference analogue: fleet/utils/ps_util.py::DistributedInfer — in PS
+mode the sparse tables live on remote servers, so inference first pulls
+the needed rows into the local program.
+
+TPU-native: the PS substitute keeps tables on the LOCAL host
+(incubate.HostOffloadEmbedding) or dense on the mesh, so there is
+nothing to pull — init is a no-op and the wrapped program is returned
+unchanged.  The class exists so reference inference scripts run.
+"""
+
+__all__ = ['DistributedInfer']
+
+
+class DistributedInfer:
+    def __init__(self, main_program=None, startup_program=None):
+        self._main = main_program
+        self._startup = startup_program
+
+    def init_distributed_infer_env(self, exe=None, loss=None,
+                                   role_maker=None, dirname=None):
+        """No remote tables to pull on TPU — sparse state is already
+        host-local; load a checkpoint via paddle_tpu.static.load or
+        distributed.load_sharded instead of a PS pull."""
+        return None
+
+    def get_dist_infer_program(self):
+        return self._main

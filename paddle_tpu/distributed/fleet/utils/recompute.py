@@ -1,0 +1,42 @@
+"""Activation recompute as a user-facing function.
+
+Reference analogue: fleet/utils/recompute.py::recompute — wraps a block
+so its activations are NOT stored for backward; they are recomputed
+from the block's inputs during the backward pass (the reference
+re-runs the block under a RecomputeFunction autograd node).
+
+TPU-native: jax.checkpoint over the block.  Inside a compiled train
+step (jit.to_static / ParallelTrainer / hapi) XLA rematerializes the
+block in the backward — the same memory/FLOPs trade, scheduled by the
+compiler.  ParallelTrainer's `strategy.recompute = True` applies this
+per-block automatically; this function is the explicit per-call-site
+form.
+
+Gradient scope: like jax.checkpoint, gradients flow through the
+TENSOR ARGUMENTS.  Layer parameters captured by closure receive
+gradients when the surrounding step is functionally captured (the
+compiled paths above); in eager mode pass them as explicit args if you
+need their `.grad` populated.
+"""
+import jax
+
+from ....core.dispatch import apply
+from ....core.tensor import Tensor
+
+__all__ = ['recompute']
+
+
+def recompute(function, *args, **kwargs):
+    preserve_rng_state = kwargs.pop('preserve_rng_state', True)  # noqa: F841
+    # (jax PRNG keys are explicit values, so they replay identically on
+    # rematerialization — the reference's CUDA RNG stashing is moot)
+
+    def pure(*vals):
+        ts = [Tensor._from_value(v, stop_gradient=False) for v in vals]
+        out = function(*ts, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return tuple(o.value if isinstance(o, Tensor) else o
+                         for o in out)
+        return out.value if isinstance(out, Tensor) else out
+
+    return apply(jax.checkpoint(pure), *args, op_name='recompute')

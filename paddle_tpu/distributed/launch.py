@@ -1,0 +1,73 @@
+"""python -m paddle_tpu.distributed.launch — multi-host entry point.
+
+Reference analogue: /root/reference/python/paddle/distributed/launch
+(fleet launch_utils spawn one worker per GPU and wire NCCL env vars).
+
+TPU-native: ONE process per host drives all its local chips; the hosts
+rendezvous through jax.distributed (GRPC coordination service), after
+which jax.devices() is the GLOBAL device list and every collective in
+this package rides ICI/DCN via GSPMD.  On a TPU pod slice the runtime
+publishes the coordinator automatically, so
+
+    python -m paddle_tpu.distributed.launch train.py --lr 0.1
+
+on every host is all that is needed (same command, every host).  Off-pod
+(CPU/GPU clusters) pass the rendezvous explicitly:
+
+    python -m paddle_tpu.distributed.launch \
+        --coordinator 10.0.0.1:1234 --nnodes 4 --node-rank $I train.py
+"""
+import argparse
+import os
+import runpy
+import sys
+
+__all__ = ['launch_main']
+
+
+def launch_main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog='paddle_tpu.distributed.launch',
+        description='Run a training script with jax.distributed '
+                    'initialized (one process per host).')
+    ap.add_argument('--coordinator', default=None,
+                    help='coordinator host:port (omit on TPU pods — the '
+                         'runtime auto-detects)')
+    ap.add_argument('--nnodes', type=int, default=None,
+                    help='total number of host processes')
+    ap.add_argument('--node-rank', type=int, default=None,
+                    help='this host\'s index in [0, nnodes)')
+    ap.add_argument('script', help='training script to run')
+    ap.add_argument('script_args', nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+
+    import jax
+    explicit = args.coordinator is not None
+    if explicit:
+        if args.nnodes is None or args.node_rank is None:
+            ap.error('--coordinator requires --nnodes and --node-rank')
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.nnodes,
+            process_id=args.node_rank)
+    else:
+        # TPU pod: the runtime supplies coordinator/count/id; single-host
+        # runs (tests, 1 chip, or pod env vars present but stale) fall
+        # through — only the explicit --coordinator path raises hard
+        if os.environ.get('TPU_WORKER_HOSTNAMES') or \
+                os.environ.get('MEGASCALE_COORDINATOR_ADDRESS'):
+            try:
+                jax.distributed.initialize()
+            except Exception as e:
+                import warnings
+                warnings.warn(
+                    f'jax.distributed auto-initialize failed ({e}); '
+                    'continuing single-host — pass --coordinator/'
+                    '--nnodes/--node-rank for an explicit rendezvous')
+
+    sys.argv = [args.script] + args.script_args
+    runpy.run_path(args.script, run_name='__main__')
+
+
+if __name__ == '__main__':
+    launch_main()

@@ -1,0 +1,23 @@
+"""Build-environment paths (reference python/paddle/sysconfig.py).
+
+The reference points at its bundled C++ headers/libs for extension
+builds; here the native pieces are the C++ sources under io/native (and
+any future ones), compiled on demand with the system toolchain.
+"""
+import os
+
+__all__ = ['get_include', 'get_lib']
+
+_PKG = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory containing native sources/headers shipped with the
+    package (reference sysconfig.get_include -> paddle/include)."""
+    return os.path.join(_PKG, 'io', 'native')
+
+
+def get_lib():
+    """Directory holding the compiled native libraries (reference
+    sysconfig.get_lib -> paddle/libs)."""
+    return os.path.join(_PKG, 'io', 'native')

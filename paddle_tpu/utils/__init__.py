@@ -129,3 +129,86 @@ def try_load_latest(directory, prefix='ckpt'):
     newest = ckpts[-1]
     step = int(newest[len(prefix) + 1:-len('.pdparams')])
     return load(os.path.join(directory, newest)), step
+
+
+# -- reference paddle.utils surface ------------------------------------------
+
+def deprecated(update_to='', since='', reason='', level=0):
+    """Decorator marking an API deprecated (reference
+    utils/deprecated.py): appends a note to the docstring and warns on
+    call.  Levels match the reference: 0/1 warn, 2 raises."""
+    import functools
+    import warnings
+
+    def wrap(fn):
+        msg = f'API "{fn.__module__}.{fn.__name__}" is deprecated'
+        if since:
+            msg += f' since {since}'
+        if update_to:
+            msg += f', use "{update_to}" instead'
+        if reason:
+            msg += f'; reason: {reason}'
+        fn.__doc__ = (fn.__doc__ or '') + f'\n\n    .. warning:: {msg}\n'
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            if level >= 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return inner
+    return wrap
+
+
+def run_check():
+    """Installation self-check (reference utils/install_check.py):
+    run a tiny compiled train step on the default device and report."""
+    import jax
+    import jax.numpy as jnp
+    dev = jax.devices()[0]
+    x = jnp.ones((4, 8))
+    w = jnp.ones((8, 2)) * 0.1
+
+    @jax.jit
+    def step(w):
+        loss = ((x @ w) ** 2).mean()
+        return loss, jax.grad(lambda w: ((x @ w) ** 2).mean())(w)
+
+    loss, g = step(w)
+    jax.block_until_ready(g)
+    assert bool(jnp.isfinite(loss)), 'non-finite loss in run_check'
+    print(f'paddle_tpu is installed successfully! '
+          f'(compiled a train step on {dev.platform}:{dev.id})')
+
+
+def require_version(min_version, max_version=None):
+    """Raise unless min_version <= __version__ (<= max_version)
+    (reference utils/__init__.py::require_version)."""
+    from .. import __version__
+
+    def key(v):
+        return [int(p) for p in str(v).replace('-', '.').split('.')
+                if p.isdigit()]
+    cur = key(__version__)
+    if key(min_version) > cur:
+        raise Exception(
+            f'paddle_tpu>={min_version} required, found {__version__}')
+    if max_version is not None and key(max_version) < cur:
+        raise Exception(
+            f'paddle_tpu<={max_version} required, found {__version__}')
+
+
+def try_import(module_name, err_msg=None):
+    """Import a soft dependency with an actionable error (reference
+    utils/lazy_import.py::try_import)."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"Failed to import '{module_name}'; this "
+            f"environment is zero-egress, so only baked-in packages "
+            f"are importable") from e
+
+
+__all__ += ['deprecated', 'run_check', 'require_version', 'try_import']

@@ -207,3 +207,184 @@ class TestRetainedGraphSeeds:
         y.backward(retain_graph=True)
         y.backward(retain_graph=True)
         np.testing.assert_allclose(x.grad.numpy(), 12.0)  # 6 + 6
+
+
+class TestPyLayer:
+    """paddle.autograd.PyLayer — user-defined differentiable ops
+    (reference python/paddle/autograd/py_layer.py)."""
+
+    def _tanh_layer(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class cus_tanh(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                y = paddle.tanh(x)
+                ctx.save_for_backward(y)
+                return y
+
+            @staticmethod
+            def backward(ctx, dy):
+                y, = ctx.saved_tensor()
+                return dy * (1 - y * y)
+        return cus_tanh
+
+    def test_forward_and_custom_backward(self):
+        cus_tanh = self._tanh_layer()
+        x = mk([0.5, -1.0])
+        z = cus_tanh.apply(x)
+        np.testing.assert_allclose(z.numpy(), np.tanh([0.5, -1.0]),
+                                   rtol=1e-6)
+        z.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   1 - np.tanh([0.5, -1.0]) ** 2,
+                                   rtol=1e-5)
+
+    def test_composes_with_taped_ops(self):
+        cus_tanh = self._tanh_layer()
+        x = mk([0.3, 0.7])
+        z = (cus_tanh.apply(x * 2.0)).sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   2 * (1 - np.tanh([0.6, 1.4]) ** 2),
+                                   rtol=1e-5)
+
+    def test_multi_input_output(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class mul_add(PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                ctx.save_for_backward(a, b)
+                return a * b, a + b
+
+            @staticmethod
+            def backward(ctx, dp, ds):
+                a, b = ctx.saved_tensor()
+                return dp * b + ds, dp * a + ds
+        a, b = mk(3.0), mk(4.0)
+        p, s = mul_add.apply(a, b)
+        (p + s).backward()
+        np.testing.assert_allclose(a.grad.numpy(), 5.0)  # b + 1
+        np.testing.assert_allclose(b.grad.numpy(), 4.0)  # a + 1
+
+    def test_wrong_grad_count_raises(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class bad(PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                return a * b
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy  # only one grad for two inputs
+        a, b = mk(1.0), mk(2.0)
+        out = bad.apply(a, b)
+        with pytest.raises(ValueError, match='grads'):
+            out.backward()
+
+    def test_autograd_backward_multi_root(self):
+        from paddle_tpu import autograd as AG
+        x = mk(2.0)
+        y1 = x * x
+        y2 = x * 3.0
+        AG.backward([y1, y2])
+        np.testing.assert_allclose(x.grad.numpy(), 7.0)
+
+
+class TestUtilsSurface:
+    def test_deprecated_warns(self):
+        from paddle_tpu.utils import deprecated
+
+        @deprecated(update_to='paddle.new_api', since='2.0')
+        def old(x):
+            return x + 1
+        with pytest.warns(DeprecationWarning):
+            assert old(1) == 2
+
+    def test_require_version(self):
+        from paddle_tpu.utils import require_version
+        require_version('0.0.1')
+        with pytest.raises(Exception):
+            require_version('99.0')
+
+    def test_try_import(self):
+        from paddle_tpu.utils import try_import
+        assert try_import('json').dumps({}) == '{}'
+        with pytest.raises(ImportError):
+            try_import('definitely_not_a_module_xyz')
+
+    def test_sysconfig_paths(self):
+        import os
+        import paddle_tpu
+        assert os.path.isdir(paddle_tpu.sysconfig.get_include())
+        assert os.path.isdir(paddle_tpu.sysconfig.get_lib())
+
+    def test_run_check(self, capsys):
+        import paddle_tpu
+        paddle_tpu.utils.run_check()
+        assert 'successfully' in capsys.readouterr().out
+
+
+class TestReviewRegressions:
+    def test_multi_root_backward_frees_graph(self):
+        from paddle_tpu import autograd as AG
+        x = mk(2.0)
+        y1 = x * x
+        y2 = x * 3.0
+        AG.backward([y1, y2])
+        np.testing.assert_allclose(x.grad.numpy(), 7.0)
+        # graph freed + roots detached: a second backward on a root
+        # must NOT double-count into x.grad
+        y1.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 7.0)
+
+    def test_pylayer_no_grad_passthrough_keeps_input_differentiable(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class ident(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy
+        x = mk(2.0)
+        with paddle.no_grad():
+            out = ident.apply(x)
+        assert out.stop_gradient
+        assert not x.stop_gradient
+        (x * x).backward()
+        np.testing.assert_allclose(x.grad.numpy(), 4.0)
+
+    def test_deprecated_levels(self):
+        from paddle_tpu.utils import deprecated
+
+        @deprecated(level=1)
+        def soft():
+            return 1
+
+        @deprecated(level=2)
+        def hard():
+            return 1
+        with pytest.warns(DeprecationWarning):
+            assert soft() == 1
+        with pytest.raises(RuntimeError):
+            hard()
+
+    def test_launch_requires_argv(self):
+        import paddle_tpu.distributed as dist
+        with pytest.raises(TypeError, match='argv'):
+            dist.launch()
+
+    def test_fleet_util_rebinds_after_init(self):
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed import env as dist_env
+        rm = fleet.UserDefinedRoleMaker(current_id=0, worker_num=1)
+        fleet.init(role_maker=rm)
+        try:
+            assert fleet.util._role_maker is rm
+        finally:
+            dist_env.set_mesh(None)

@@ -376,3 +376,139 @@ class TestGPipe:
                 p, x, stage, mesh, num_microbatches=m))(params, x)
             np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                        rtol=1e-5, atol=1e-6)
+
+
+class TestLaunchModule:
+    def test_single_host_launch_runs_script(self, tmp_path):
+        """python -m paddle_tpu.distributed.launch runs the script with
+        sys.argv rewritten; single host skips jax.distributed init."""
+        import subprocess, sys, os
+        script = tmp_path / 'train.py'
+        script.write_text(
+            'import sys\n'
+            'import paddle_tpu as paddle\n'
+            "print('RANK', paddle.distributed.get_rank(), sys.argv[1])\n")
+        env = dict(os.environ)
+        env['JAX_PLATFORMS'] = 'cpu'
+        env.pop('PALLAS_AXON_POOL_IPS', None)
+        env['PYTHONPATH'] = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))) + os.pathsep + \
+            env.get('PYTHONPATH', '')
+        out = subprocess.run(
+            [sys.executable, '-m', 'paddle_tpu.distributed.launch',
+             str(script), '--flag'],
+            capture_output=True, text=True, timeout=300, env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert 'RANK 0 --flag' in out.stdout
+
+
+class TestFleetSurface:
+    """Fleet namespace parity: topology, role makers, util, data
+    generators, fleet.utils (reference fleet/base/*, fleet/utils/*)."""
+
+    def test_communicate_topology(self):
+        from paddle_tpu.distributed.fleet import CommunicateTopology
+        topo = CommunicateTopology(['data', 'model'], [2, 3])
+        assert topo.world_size() == 6
+        assert topo.get_dim('model') == 3
+        r = topo.get_rank(data=1, model=2)
+        assert topo.get_coord(r) == (1, 2)
+        assert topo.get_axis_list('data', 0) == [0, 1, 2]
+        comm = topo.get_comm_list('model')
+        assert [0, 1, 2] in comm and [3, 4, 5] in comm
+
+    def test_topology_from_mesh(self):
+        from paddle_tpu.distributed.fleet import (CommunicateTopology,
+                                                  DistributedStrategy)
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed import env as dist_env
+        s = DistributedStrategy()
+        s.hybrid_configs['dp_degree'] = 2
+        s.hybrid_configs['mp_degree'] = 2
+        fleet.init(is_collective=True, strategy=s)
+        try:
+            mesh = dist_env.get_mesh()
+            topo = CommunicateTopology.from_mesh(mesh)
+            assert topo.world_size() == mesh.devices.size
+            assert topo.get_dim('dp') == 2 and topo.get_dim('tp') == 2
+        finally:
+            dist_env.set_mesh(None)
+
+    def test_role_makers(self):
+        from paddle_tpu.distributed.fleet import (PaddleCloudRoleMaker,
+                                                  UserDefinedRoleMaker,
+                                                  Role)
+        rm = PaddleCloudRoleMaker(is_collective=True)
+        assert rm._is_worker() and rm._is_first_worker()
+        u = UserDefinedRoleMaker(current_id=2, worker_num=4,
+                                 role=Role.WORKER,
+                                 worker_endpoints=['a:1', 'b:2'])
+        assert u._worker_index() == 2 and u._worker_num() == 4
+        assert u._get_trainer_endpoints() == ['a:1', 'b:2']
+
+    def test_util_file_shard_and_allreduce(self):
+        from paddle_tpu.distributed import fleet
+        files = [f'f{i}' for i in range(5)]
+        assert fleet.util.get_file_shard(files) == files  # 1 process
+        out = fleet.util.all_reduce(np.asarray([1.0, 2.0]), mode='sum')
+        np.testing.assert_allclose(out, [1.0, 2.0])
+        fleet.util.barrier()
+
+    def test_multislot_data_generators(self):
+        from paddle_tpu.distributed.fleet import (
+            MultiSlotDataGenerator, MultiSlotStringDataGenerator)
+
+        class G(MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def gen():
+                    a, b = line.split(',')
+                    yield [('label', [int(a)]), ('feat', [float(b), 1.0])]
+                return gen
+        out = G().run_from_memory(['1,0.5', '0,2.5'])
+        assert out == ['1 1 2 0.5 1.0', '1 0 2 2.5 1.0']
+
+        class S(MultiSlotStringDataGenerator):
+            def generate_sample(self, line):
+                def gen():
+                    yield [('words', line.split())]
+                return gen
+        assert S().run_from_memory(['a b c']) == ['a b c']
+
+    def test_local_fs(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import LocalFS
+        fs = LocalFS()
+        d = str(tmp_path / 'x')
+        fs.mkdirs(d)
+        assert fs.is_dir(d) and fs.is_exist(d)
+        f = str(tmp_path / 'x' / 'a.txt')
+        fs.touch(f)
+        assert fs.is_file(f)
+        dirs, files = fs.ls_dir(str(tmp_path / 'x'))
+        assert files == ['a.txt']
+        fs.mv(f, str(tmp_path / 'b.txt'))
+        assert fs.is_file(str(tmp_path / 'b.txt'))
+        fs.delete(d)
+        assert not fs.is_exist(d)
+
+    def test_hdfs_requires_hadoop(self):
+        from paddle_tpu.distributed.fleet.utils import HDFSClient
+        import shutil as _sh
+        if _sh.which('hadoop'):
+            pytest.skip('hadoop actually present')
+        with pytest.raises(RuntimeError, match='hadoop'):
+            HDFSClient()
+
+    def test_recompute_matches_plain(self):
+        from paddle_tpu.distributed.fleet.utils import recompute
+        x = paddle.to_tensor(np.linspace(-1, 1, 8).astype('float32'))
+        x.stop_gradient = False
+
+        def block(t):
+            return paddle.tanh(t) * t
+        y = recompute(block, x).sum()
+        y.backward()
+        g_re = x.grad.numpy().copy()
+        x2 = paddle.to_tensor(np.linspace(-1, 1, 8).astype('float32'))
+        x2.stop_gradient = False
+        block(x2).sum().backward()
+        np.testing.assert_allclose(g_re, x2.grad.numpy(), rtol=1e-5)
