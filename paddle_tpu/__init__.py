@@ -60,6 +60,7 @@ from . import profiler  # noqa: F401
 from . import utils  # noqa: F401
 from . import sysconfig  # noqa: F401
 from . import autograd  # noqa: F401
+from . import fluid  # noqa: F401
 from . import hub  # noqa: F401
 from . import inference  # noqa: F401
 from . import onnx  # noqa: F401

@@ -83,6 +83,13 @@ class PyLayer:
     @classmethod
     def apply(cls, *args, **kwargs):
         ctx = PyLayerContext()
+        kw_tensors = [k for k, v in kwargs.items()
+                      if isinstance(v, Tensor)]
+        if kw_tensors:
+            raise TypeError(
+                f'PyLayer.apply: pass differentiable Tensors '
+                f'positionally, not as keywords ({kw_tensors}) — keyword '
+                'tensors would silently drop their gradients')
         tpos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
         requires = (_ag.is_grad_enabled()
                     and any(not args[i].stop_gradient for i in tpos))

@@ -98,58 +98,17 @@ def backward(tensor, grad=None, retain_graph=False):
 
     Matches paddle.Tensor.backward(): scalar outputs seed with ones; the
     resulting cotangents land in `.grad` of every reachable tensor with
-    stop_gradient=False.
+    stop_gradient=False.  (Single-root form of backward_multi.)
     """
-    from .tensor import Tensor
-
-    if tensor.grad_node is None:
-        if not tensor.stop_gradient:
-            g = jnp.ones_like(tensor.value) if grad is None else _val(grad)
-            tensor._accumulate_grad(g)
-        return
-    if grad is None:
-        grad = jnp.ones_like(tensor.value)
-    else:
-        grad = _val(grad)
-
-    if not tensor.stop_gradient:
-        tensor._accumulate_grad(grad)  # root keeps its seed, like the ref
-    root = tensor.grad_node
-    root.seed_grad(tensor.grad_index, grad)
-
-    order = _topo_order(root)
-    for node in order:
-        if all(g is None for g in node.out_grads):
-            continue
-        if node.vjp_fn is None:
-            raise RuntimeError(
-                f'trying to differentiate through op {node.name!r} whose '
-                'graph was already freed by a previous backward()/grad() '
-                'call; pass retain_graph=True to the earlier call')
-        in_grads = node.vjp_fn(node.cotangents())
-        # seeds are consumed: clear even under retain_graph, so a later
-        # backward()/grad() on the retained graph starts from zero
-        # instead of double-counting stale cotangents
-        node.out_grads = [None] * len(node.out_avals)
-        for t, g in zip(node.inputs, in_grads):
-            if t is None or g is None:
-                continue
-            if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
-                continue
-            t._accumulate_grad(g)
-            if t.grad_node is not None:
-                t.grad_node.seed_grad(t.grad_index, g)
-        if not retain_graph:
-            node.vjp_fn = None
-
-    if not retain_graph:
-        _detach_graph(tensor)
+    backward_multi([tensor], [grad], retain_graph=retain_graph)
 
 
 def backward_multi(tensors, grads=None, retain_graph=False):
-    """backward() from several roots in ONE reverse walk, so shared
+    """backward() from one or more roots in ONE reverse walk, so shared
     subgraphs are differentiated once and freed exactly once (no forced
-    graph retention between roots)."""
+    graph retention between roots).  Seeds are consumed per walk — even
+    under retain_graph — so a later backward()/grad() on the retained
+    graph starts from zero instead of double-counting."""
     if grads is None:
         grads = [None] * len(tensors)
     roots = []

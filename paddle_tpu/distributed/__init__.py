@@ -44,16 +44,8 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
     return func(*args)
 
 
-def launch(argv=None):
-    """Programmatic alias of `python -m paddle_tpu.distributed.launch`:
-    rendezvous the hosts through jax.distributed, then run the script
-    (one process per HOST — chips within a host are driven by XLA).
-    argv is required here — implicitly re-parsing the CALLER's
-    sys.argv could runpy-execute an arbitrary file."""
-    if argv is None:
-        raise TypeError(
-            "launch(argv) requires an explicit argument list, e.g. "
-            "launch(['train.py', '--lr', '0.1']); from a shell use "
-            "`python -m paddle_tpu.distributed.launch train.py ...`")
-    from .launch import launch_main
-    return launch_main(argv)
+# `launch` is a MODULE (like the reference: python -m
+# paddle.distributed.launch); importing it here keeps the package
+# attribute stable — a function of the same name would be shadowed by
+# the submodule import.  Programmatic entry: launch.launch_main(argv).
+from . import launch  # noqa: F401,E402

@@ -101,8 +101,16 @@ class UtilBase:
         self._role_maker = role_maker
 
     def _pcount(self):
+        if self._role_maker is not None:
+            return self._role_maker._worker_num()
         import jax
         return jax.process_count()
+
+    def _pindex(self):
+        if self._role_maker is not None:
+            return self._role_maker._worker_index()
+        import jax
+        return jax.process_index()
 
     def all_reduce(self, input, mode='sum', comm_world='worker'):
         """Reduce a host value across host processes.  Multi-host rides
@@ -131,13 +139,11 @@ class UtilBase:
         earlier workers take the remainder)."""
         if not isinstance(files, list):
             raise TypeError('files should be a list of file paths')
-        import jax
-        n, i = jax.process_count(), jax.process_index()
+        n, i = self._pcount(), self._pindex()
         base, rem = divmod(len(files), n)
         begin = i * base + min(i, rem)
         return files[begin: begin + base + (1 if i < rem else 0)]
 
     def print_on_rank(self, message, rank_id=0):
-        import jax
-        if jax.process_index() == rank_id:
+        if self._pindex() == rank_id:
             print(message, flush=True)

@@ -1,0 +1,50 @@
+"""paddle_tpu.fluid — the legacy `import paddle.fluid as fluid` namespace.
+
+Reference analogue: /root/reference/python/paddle/fluid/__init__.py.
+Paddle-1.x-era user code (and much of the reference's own model zoo)
+drives the framework through this namespace; every name here is a REAL
+alias onto the paddle_tpu implementation — fluid.Program is
+static.Program, fluid.layers.fc is static.nn.fc, fluid.dygraph.guard
+flips eager mode — so that code runs unchanged on the TPU-native stack.
+"""
+from ..static.program import (  # noqa: F401
+    Program, program_guard, default_main_program, default_startup_program,
+    Executor, Variable, global_scope, scope_guard, name_scope,
+    in_static_mode)
+from ..static.program import data  # noqa: F401
+from ..static.program import gradients  # noqa: F401
+from ..static.compat import (  # noqa: F401
+    BuildStrategy, ExecutionStrategy, CompiledProgram, ParallelExecutor,
+    cpu_places, cuda_places, WeightNormParamAttr)
+from ..core.device import (  # noqa: F401
+    CPUPlace, CUDAPlace, TPUPlace, XPUPlace, NPUPlace, CUDAPinnedPlace,
+    is_compiled_with_cuda, is_compiled_with_xpu)
+from ..nn.layer.layers import ParamAttr  # noqa: F401
+from ..core.rng import seed  # noqa: F401
+from .. import regularizer  # noqa: F401
+from ..nn import clip  # noqa: F401
+from ..static.nn import embedding  # noqa: F401
+from ..nn.functional import one_hot as _one_hot
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    """fluid/input.py::one_hot — num_classes is called depth there."""
+    return _one_hot(input, depth)
+
+from . import layers  # noqa: F401
+from . import dygraph  # noqa: F401
+from . import initializer  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
+from . import nets  # noqa: F401
+from . import core  # noqa: F401
+
+
+def enable_dygraph(place=None):
+    from ..static.program import disable_static
+    disable_static()
+
+
+def disable_dygraph():
+    from ..static.program import enable_static
+    enable_static()

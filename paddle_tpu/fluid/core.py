@@ -1,0 +1,46 @@
+"""fluid.core — the pybind surface legacy code pokes at.
+
+Reference analogue: paddle/fluid/pybind/ exposing C++ types.  There is
+no C++ scope/LoD machinery here (XLA owns memory; ragged data is
+padded-dense + seq_len — see static/sequence.py), so LoDTensor is the
+minimal value-carrying shim and Scope aliases the Executor scope.
+"""
+import numpy as np
+
+from ..core.device import (  # noqa: F401
+    CPUPlace, CUDAPlace, XPUPlace, NPUPlace, CUDAPinnedPlace)
+from ..static.program import global_scope, _Scope as Scope  # noqa: F401
+
+__all__ = ['LoDTensor', 'LoDTensorArray', 'Scope', 'CPUPlace',
+           'CUDAPlace', 'XPUPlace', 'NPUPlace', 'CUDAPinnedPlace']
+
+
+class LoDTensor:
+    """Value + level-of-detail offsets (reference core LoDTensor).  The
+    TPU-native data path is padded-dense, so this only carries the
+    array and its recursive_sequence_lengths for code that constructs
+    feeds the 1.x way."""
+
+    def __init__(self):
+        self._array = None
+        self._lod = []
+
+    def set(self, array, place=None):
+        self._array = np.asarray(array)
+
+    def set_recursive_sequence_lengths(self, lengths):
+        self._lod = [list(l) for l in lengths]
+
+    def recursive_sequence_lengths(self):
+        return [list(l) for l in self._lod]
+
+    def __array__(self, dtype=None):
+        a = self._array
+        return a.astype(dtype) if dtype is not None else a
+
+    def shape(self):
+        return list(self._array.shape) if self._array is not None else []
+
+
+class LoDTensorArray(list):
+    pass

@@ -1,0 +1,158 @@
+"""fluid.dygraph — legacy eager-mode namespace.
+
+Reference analogue: /root/reference/python/paddle/fluid/dygraph/
+(base.py guard/to_variable, layers.py Layer, nn.py Linear/Conv2D/...).
+Eager IS the default here, so guard() only ensures static mode is off.
+The 1.x layer classes had different constructor signatures (Linear
+took input_dim/output_dim; Conv2D took num_channels/num_filters) —
+adapters below translate them onto the paddle_tpu layers.
+"""
+import contextlib
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.autograd import no_grad  # noqa: F401
+from ..nn.layer.layers import Layer, ParamAttr  # noqa: F401
+from .. import nn as _nn
+
+__all__ = ['guard', 'to_variable', 'no_grad', 'Layer', 'Linear',
+           'Conv2D', 'Pool2D', 'BatchNorm', 'Embedding', 'Dropout',
+           'LayerNorm', 'save_dygraph', 'load_dygraph',
+           'ProgramTranslator', 'TracedLayer']
+
+from ..jit import ProgramTranslator, TracedLayer  # noqa: F401
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """with fluid.dygraph.guard(): — eager mode (the default)."""
+    from ..static.program import in_static_mode, disable_static, \
+        enable_static
+    was_static = in_static_mode()
+    if was_static:
+        disable_static()
+    try:
+        yield
+    finally:
+        if was_static:
+            enable_static()
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    """numpy -> Tensor (reference dygraph/base.py:612)."""
+    if isinstance(value, Tensor):
+        return value
+    return Tensor(np.asarray(value), dtype=dtype)
+
+
+class Linear(_nn.Linear):
+    """1.x signature: Linear(input_dim, output_dim, param_attr=...,
+    bias_attr=..., act=...)."""
+
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype='float32'):
+        super().__init__(input_dim, output_dim, weight_attr=param_attr,
+                         bias_attr=bias_attr)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act:
+            from ..nn import functional as F
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class Conv2D(_nn.Conv2D):
+    """1.x signature: Conv2D(num_channels, num_filters, filter_size)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None,
+                 dtype='float32'):
+        super().__init__(num_channels, num_filters, filter_size,
+                         stride=stride, padding=padding,
+                         dilation=dilation, groups=groups,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act:
+            from ..nn import functional as F
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class Pool2D(Layer):
+    """1.x Pool2D(pool_size, pool_type, pool_stride, pool_padding,
+    global_pooling)."""
+
+    def __init__(self, pool_size=-1, pool_type='max', pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True):
+        super().__init__()
+        self._args = (pool_size, pool_type, pool_stride, pool_padding,
+                      global_pooling, ceil_mode)
+
+    def forward(self, x):
+        size, ptype, stride, pad, global_p, ceil = self._args
+        from ..nn import functional as F
+        if global_p:
+            return F.adaptive_avg_pool2d(x, 1) if ptype == 'avg' \
+                else F.adaptive_max_pool2d(x, 1)
+        fn = F.avg_pool2d if ptype == 'avg' else F.max_pool2d
+        return fn(x, size, stride=stride, padding=pad, ceil_mode=ceil)
+
+
+class BatchNorm(_nn.BatchNorm2D):
+    """1.x BatchNorm(num_channels, act=...)."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype='float32', data_layout='NCHW', in_place=False,
+                 is_test=False, use_global_stats=False,
+                 trainable_statistics=False):
+        super().__init__(num_channels, momentum=momentum,
+                         epsilon=epsilon, weight_attr=param_attr,
+                         bias_attr=bias_attr, data_format=data_layout)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act:
+            from ..nn import functional as F
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class Embedding(_nn.Embedding):
+    """1.x Embedding(size=[vocab, dim], is_sparse=..., param_attr=...)."""
+
+    def __init__(self, size, is_sparse=False, is_distributed=False,
+                 padding_idx=None, param_attr=None, dtype='float32'):
+        super().__init__(size[0], size[1], padding_idx=padding_idx,
+                         sparse=is_sparse, weight_attr=param_attr)
+
+
+Dropout = _nn.Dropout
+LayerNorm = _nn.LayerNorm
+
+
+def save_dygraph(state_dict, model_path):
+    """fluid.dygraph.save_dygraph -> <path>.pdparams (reference
+    checkpoint.py)."""
+    from ..framework.io import save
+    save(state_dict, model_path + '.pdparams')
+
+
+def load_dygraph(model_path):
+    """-> (param_dict, optimizer_dict|None)."""
+    import os
+    from ..framework.io import load
+    params = load(model_path + '.pdparams') \
+        if os.path.exists(model_path + '.pdparams') else None
+    opt = load(model_path + '.pdopt') \
+        if os.path.exists(model_path + '.pdopt') else None
+    return params, opt

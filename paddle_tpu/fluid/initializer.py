@@ -1,0 +1,29 @@
+"""fluid.initializer — legacy initializer aliases (reference
+fluid/initializer.py: MSRA is Kaiming, Xavier covers both modes)."""
+from ..nn.initializer import (  # noqa: F401
+    Constant, Normal, TruncatedNormal, Uniform, Bilinear,
+    set_global_initializer)
+from ..nn.initializer import XavierNormal, XavierUniform  # noqa: F401
+from ..nn.initializer import KaimingNormal, KaimingUniform  # noqa: F401
+
+__all__ = ['Constant', 'ConstantInitializer', 'Normal',
+           'NormalInitializer', 'TruncatedNormal', 'Uniform',
+           'UniformInitializer', 'Xavier', 'XavierInitializer', 'MSRA',
+           'MSRAInitializer', 'Bilinear', 'BilinearInitializer',
+           'set_global_initializer']
+
+
+def Xavier(uniform=True, fan_in=None, fan_out=None, seed=0):
+    return XavierUniform() if uniform else XavierNormal()
+
+
+def MSRA(uniform=True, fan_in=None, seed=0):
+    return KaimingUniform() if uniform else KaimingNormal()
+
+
+ConstantInitializer = Constant
+NormalInitializer = Normal
+UniformInitializer = Uniform
+XavierInitializer = Xavier
+MSRAInitializer = MSRA
+BilinearInitializer = Bilinear

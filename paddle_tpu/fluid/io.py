@@ -1,0 +1,46 @@
+"""fluid.io — legacy persistence entry points (reference fluid/io.py).
+
+The 1.x API took (executor, dirname) pairs; these adapt onto
+paddle_tpu.static's save/load and inference export (StableHLO).
+"""
+import os
+
+from ..static import io as _sio
+from ..static.program import default_main_program
+
+__all__ = ['save_params', 'load_params', 'save_persistables',
+           'load_persistables', 'save_inference_model',
+           'load_inference_model']
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    prog = main_program or default_main_program()
+    _sio.save(prog, os.path.join(dirname, filename or 'params'))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    prog = main_program or default_main_program()
+    _sio.load(prog, os.path.join(dirname, filename or 'params'))
+
+
+save_persistables = save_params
+load_persistables = load_params
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars,
+                         executor, main_program=None,
+                         model_filename=None, params_filename=None,
+                         export_for_deployment=True,
+                         program_only=False):
+    """1.x signature: feed vars are passed by NAME."""
+    prog = main_program or default_main_program()
+    feed_vars = [prog.feed_vars[n] for n in feeded_var_names]
+    _sio.save_inference_model(
+        os.path.join(dirname, model_filename or 'model'),
+        feed_vars, target_vars, executor, program=prog)
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    return _sio.load_inference_model(
+        os.path.join(dirname, model_filename or 'model'), executor)

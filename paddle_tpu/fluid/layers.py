@@ -1,0 +1,248 @@
+"""fluid.layers — legacy op namespace.
+
+Reference analogue: /root/reference/python/paddle/fluid/layers/ (nn.py,
+tensor.py, control_flow.py, sequence_lod.py — ~8k LoC of op wrappers).
+Everything here aliases the paddle_tpu implementation; the handful of
+signature differences the 1.x API had (`dim=` instead of `axis=`,
+`input=` instead of `x=`, fill_constant, elementwise_*) get explicit
+adapters so reference-era model code runs verbatim.
+"""
+import numpy as np
+
+from .. import tensor as _T
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor
+from ..nn import functional as _F
+from ..static.nn import *          # noqa: F401,F403  (fc, conv2d, ...)
+from ..static.nn import cond, while_loop, case, switch_case  # noqa: F401
+from ..static import sequence as _seq
+from ..static.sequence import *    # noqa: F401,F403  (sequence_* ops)
+from ..static.program import (     # noqa: F401
+    data, Print, py_func, create_global_var)
+from ..metric import accuracy      # noqa: F401
+from ..tensor import (             # noqa: F401
+    concat, reshape, transpose, squeeze, unsqueeze, stack, split, cast,
+    gather, gather_nd, scatter, scatter_nd, scatter_nd_add, expand,
+    slice, shape, rank, zeros, ones, full, arange, argmax, argmin,
+    argsort, where, clip, abs, exp, log, sqrt, square, sin, cos, tanh,
+    matmul, topk, multiplex, shard_index, crop, stanh, reverse)
+from ..nn.functional import sigmoid  # noqa: F401
+from ..tensor.creation import assign  # noqa: F401
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None,
+                  name=None):
+    """fluid/layers/tensor.py::fill_constant."""
+    return _T.full(shape, value, dtype=dtype)
+
+
+def zeros_like(x, out=None, name=None):
+    return _T.zeros_like(x)
+
+
+def ones_like(x, out=None, name=None):
+    return _T.ones_like(x)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _T.mean(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _T.sum(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _T.max(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _T.min(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _T.prod(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):
+    return _T.all(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):
+    return _T.any(input, axis=dim, keepdim=keep_dim)
+
+
+def _ew(op, x, y, axis=-1, act=None, name=None):
+    """elementwise_* had an `axis` arg aligning y's dims to x's; with
+    numpy broadcasting the only non-trivial case is right-aligning a
+    smaller y at `axis`, handled by reshaping y with trailing 1s."""
+    from ..tensor._helpers import wrap
+    x, y = wrap(x), wrap(y)
+    if axis != -1 and y.ndim < x.ndim:
+        pad = x.ndim - axis - y.ndim
+        if pad > 0:
+            y = _T.reshape(y, list(y.shape) + [1] * pad)
+    out = op(x, y)
+    if act is not None:
+        out = getattr(_F, act)(out)
+    return out
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return _ew(_T.add, x, y, axis, act)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return _ew(_T.subtract, x, y, axis, act)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return _ew(_T.multiply, x, y, axis, act)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return _ew(_T.divide, x, y, axis, act)
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return _ew(_T.maximum, x, y, axis, act)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return _ew(_T.minimum, x, y, axis, act)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return _ew(_T.pow, x, y, axis, act)
+
+
+def mean(x, name=None):
+    return _T.mean(x)
+
+
+def relu(x, name=None):
+    return _F.relu(x)
+
+
+def softmax(input, use_cudnn=False, name=None, axis=-1):
+    return _F.softmax(input, axis=axis)
+
+
+def log_softmax(input, axis=-1, name=None):
+    return _F.log_softmax(input, axis=axis)
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    """fluid cross_entropy takes PROBABILITIES (softmax applied by the
+    caller), unlike paddle 2.x's logits-based loss.  Returns [N, 1]
+    per-sample losses like the reference op."""
+    eps = 1e-12
+    logp = _T.log(_T.clip(input, eps, 1.0))
+    if soft_label:
+        return -_T.sum(_T.multiply(_T.cast(label, str(input.dtype)),
+                                   logp), axis=-1, keepdim=True)
+    lab = label
+    if lab.ndim == logp.ndim:          # [N, 1] index form
+        lab = _T.squeeze(lab, axis=-1)
+    out = _F.nll_loss(logp, lab, reduction='none',
+                      ignore_index=ignore_index)
+    return _T.unsqueeze(out, axis=-1)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    out = _F.softmax_with_cross_entropy(
+        logits, label, soft_label=soft_label, ignore_index=ignore_index,
+        axis=axis)
+    if return_softmax:
+        return out, _F.softmax(logits, axis=axis)
+    return out
+
+
+def mse_loss(input, label):
+    return _F.mse_loss(input, label)
+
+
+def pool2d(input, pool_size=-1, pool_type='max', pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True,
+           data_format='NCHW'):
+    if global_pooling:
+        return _F.adaptive_avg_pool2d(input, 1) if pool_type == 'avg' \
+            else _F.adaptive_max_pool2d(input, 1)
+    if pool_type == 'avg':
+        return _F.avg_pool2d(input, pool_size, stride=pool_stride,
+                             padding=pool_padding, ceil_mode=ceil_mode)
+    return _F.max_pool2d(input, pool_size, stride=pool_stride,
+                         padding=pool_padding, ceil_mode=ceil_mode)
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from ..tensor.creation import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    return Tensor(np.zeros((), convert_dtype(dtype) or
+                           get_default_dtype()))
+
+
+def increment(x, value=1.0, in_place=True):
+    out = _T.add(x, value)
+    if in_place and hasattr(x, 'set_value'):
+        x.set_value(out)
+        return x
+    return out
+
+
+def array_write(x, i, array=None):
+    from ..tensor.array import array_write as _aw
+    return _aw(x, i, array)
+
+
+def array_read(array, i):
+    from ..tensor.array import array_read as _ar
+    return _ar(array, i)
+
+
+def unsqueeze_(x, axes):
+    return _T.unsqueeze(x, axes)
+
+
+def flatten(x, axis=1, name=None):
+    """fluid flatten: collapse to 2-D at `axis` (unlike 2.x's
+    start/stop_axis form)."""
+    shp = x.shape
+    lead = 1
+    for d in shp[:axis]:
+        lead *= d
+    return _T.reshape(x, [lead, -1])
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation='downgrade_in_infer'):
+    mode = 'downscale_in_infer' \
+        if dropout_implementation == 'downgrade_in_infer' \
+        else 'upscale_in_train'
+    return _F.dropout(x, p=dropout_prob, training=not is_test, mode=mode)
+
+
+def uniform_random(shape, dtype='float32', min=-1.0, max=1.0, seed=0):
+    return _T.uniform(shape, dtype=dtype, min=min, max=max, seed=seed)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype='float32'):
+    return _T.cast(_T.normal(mean=mean, std=std, shape=shape), dtype)
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return _T.clip(_T.add(_T.multiply(x, slope), offset), 0.0, 1.0)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype='float32'):
+    k = label.shape[-1]
+    smoothed = _T.add(_T.multiply(label, 1.0 - epsilon), epsilon / k)
+    return _T.cast(smoothed, dtype)

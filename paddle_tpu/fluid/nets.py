@@ -1,0 +1,94 @@
+"""fluid.nets — the classic composed blocks (reference fluid/nets.py):
+simple_img_conv_pool, img_conv_group, sequence_conv_pool, glu,
+scaled_dot_product_attention — built from static.nn/layers ops."""
+from . import layers
+from ..nn import functional as _F
+from .. import tensor as _T
+
+__all__ = ['simple_img_conv_pool', 'img_conv_group',
+           'sequence_conv_pool', 'glu', 'scaled_dot_product_attention']
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type='max',
+                         global_pooling=False, conv_stride=1,
+                         conv_padding=0, conv_dilation=1, conv_groups=1,
+                         param_attr=None, bias_attr=None, act=None,
+                         use_cudnn=True):
+    conv = layers.conv2d(input, num_filters, filter_size,
+                         stride=conv_stride, padding=conv_padding,
+                         dilation=conv_dilation, groups=conv_groups,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         act=act)
+    return layers.pool2d(conv, pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride,
+                         pool_padding=pool_padding,
+                         global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size,
+                   conv_padding=1, conv_filter_size=3, conv_act=None,
+                   param_attr=None, conv_with_batchnorm=False,
+                   conv_batchnorm_drop_rate=0.0, pool_stride=1,
+                   pool_type='max', use_cudnn=True):
+    tmp = input
+    n = len(conv_num_filter)
+
+    def at(v, i):
+        return v[i] if isinstance(v, (list, tuple)) else v
+    for i in range(n):
+        tmp = layers.conv2d(tmp, conv_num_filter[i],
+                            at(conv_filter_size, i),
+                            padding=at(conv_padding, i),
+                            param_attr=at(param_attr, i)
+                            if isinstance(param_attr, (list, tuple))
+                            else param_attr,
+                            act=None if conv_with_batchnorm
+                            else at(conv_act, i))
+        if conv_with_batchnorm:
+            tmp = layers.batch_norm(tmp, act=conv_act)
+            rate = at(conv_batchnorm_drop_rate, i)
+            if rate:
+                tmp = layers.dropout(tmp, rate)
+    return layers.pool2d(tmp, pool_size=pool_size,
+                         pool_stride=pool_stride, pool_type=pool_type)
+
+
+def sequence_conv_pool(input, seq_len, num_filters, filter_size,
+                       param_attr=None, act='sigmoid', pool_type='max'):
+    """Padded-dense rendering of the reference's LoD
+    sequence_conv+sequence_pool pair (see static/sequence.py)."""
+    from ..static import sequence as S
+    conv = S.sequence_conv(input, seq_len, num_filters, filter_size)
+    if act:
+        conv = getattr(_F, act)(conv)
+    return S.sequence_pool(conv, pool_type, seq_len)
+
+
+def glu(input, dim=-1):
+    a, b = _T.split(input, 2, axis=dim)
+    return _T.multiply(a, _F.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """Multi-head attention over [B, T, D] (reference nets.py; the hot
+    path uses ops.flash_attention — this is the compatibility form)."""
+    import math
+    B, Tq, D = queries.shape
+    if D % num_heads:
+        raise ValueError('hidden size must divide num_heads')
+    hd = D // num_heads
+
+    def split_heads(x):
+        T = x.shape[1]
+        return _T.transpose(_T.reshape(x, [B, T, num_heads, hd]),
+                            [0, 2, 1, 3])
+    q, k, v = map(split_heads, (queries, keys, values))
+    scores = _T.multiply(_T.matmul(q, _T.transpose(k, [0, 1, 3, 2])),
+                         1.0 / math.sqrt(hd))
+    w = _F.softmax(scores, axis=-1)
+    if dropout_rate:
+        w = _F.dropout(w, p=dropout_rate)
+    out = _T.matmul(w, v)
+    return _T.reshape(_T.transpose(out, [0, 2, 1, 3]), [B, Tq, D])

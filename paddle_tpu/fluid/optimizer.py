@@ -1,0 +1,45 @@
+"""fluid.optimizer — legacy optimizer names.
+
+Reference analogue: /root/reference/python/paddle/fluid/optimizer.py:
+classes were named SGDOptimizer/AdamOptimizer/..., took
+`parameter_list=` instead of `parameters=`, and `regularization=`
+instead of `weight_decay=`.  Adapters translate both spellings.
+"""
+from .. import optimizer as _opt
+
+__all__ = ['SGDOptimizer', 'MomentumOptimizer', 'AdagradOptimizer',
+           'AdamOptimizer', 'AdamaxOptimizer', 'RMSPropOptimizer',
+           'AdadeltaOptimizer', 'LambOptimizer', 'SGD', 'Momentum',
+           'Adam', 'AdamW']
+
+
+def _legacy(cls):
+    def make(learning_rate=0.001, parameter_list=None, parameters=None,
+             regularization=None, weight_decay=None, grad_clip=None,
+             **kwargs):
+        kwargs.pop('name', None)
+        wd = weight_decay if weight_decay is not None else regularization
+        extra = {}
+        if wd is not None:
+            extra['weight_decay'] = wd
+        return cls(learning_rate=learning_rate,
+                   parameters=parameters or parameter_list,
+                   grad_clip=grad_clip, **extra, **kwargs)
+    make.__name__ = cls.__name__ + 'Legacy'
+    return make
+
+
+SGDOptimizer = _legacy(_opt.SGD)
+MomentumOptimizer = _legacy(_opt.Momentum)
+AdagradOptimizer = _legacy(_opt.Adagrad)
+AdamOptimizer = _legacy(_opt.Adam)
+AdamaxOptimizer = _legacy(_opt.Adamax)
+RMSPropOptimizer = _legacy(_opt.RMSProp)
+AdadeltaOptimizer = _legacy(_opt.Adadelta)
+LambOptimizer = _legacy(_opt.Lamb)
+
+# 2.x names pass through
+SGD = _opt.SGD
+Momentum = _opt.Momentum
+Adam = _opt.Adam
+AdamW = _opt.AdamW

@@ -148,6 +148,13 @@ class Layer:
         return [p for _, p in self.named_parameters(
             include_sublayers=include_sublayers)]
 
+    def clear_gradients(self):
+        """Clear every parameter's .grad (reference
+        fluid/dygraph/layers.py::Layer.clear_gradients — the 1.x
+        counterpart of optimizer.clear_grad)."""
+        for p in self.parameters():
+            p.clear_grad()
+
     def named_parameters(self, prefix='', include_sublayers=True):
         seen = set()
         for name, layer in self.named_sublayers(prefix=prefix,

@@ -374,10 +374,14 @@ class TestReviewRegressions:
         with pytest.raises(RuntimeError):
             hard()
 
-    def test_launch_requires_argv(self):
+    def test_launch_is_module_with_main(self):
+        # `launch` is a module (reference: python -m
+        # paddle.distributed.launch); a same-named function would be
+        # shadowed by the submodule import on first use
         import paddle_tpu.distributed as dist
-        with pytest.raises(TypeError, match='argv'):
-            dist.launch()
+        import types
+        assert isinstance(dist.launch, types.ModuleType)
+        assert callable(dist.launch.launch_main)
 
     def test_fleet_util_rebinds_after_init(self):
         from paddle_tpu.distributed import fleet

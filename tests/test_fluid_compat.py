@@ -1,0 +1,148 @@
+"""paddle_tpu.fluid — legacy `import paddle.fluid as fluid` namespace.
+
+Reference analogue: the fluid-era unittests under
+/root/reference/python/paddle/fluid/tests/unittests/ that drive models
+through fluid.layers/fluid.dygraph/fluid.io.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+rs = np.random.RandomState(0)
+
+
+class TestFluidDygraph:
+    def test_linear_train_loop(self):
+        with fluid.dygraph.guard():
+            paddle.seed(0)
+            net = fluid.dygraph.Linear(4, 2, act='relu')
+            opt = fluid.optimizer.AdamOptimizer(
+                learning_rate=0.01, parameter_list=net.parameters())
+            x = fluid.dygraph.to_variable(
+                rs.randn(8, 4).astype('float32'))
+            first = None
+            for _ in range(10):
+                loss = fluid.layers.reduce_mean(
+                    fluid.layers.square(net(x) - 1.0))
+                loss.backward()
+                opt.minimize(loss)
+                net.clear_gradients()
+                first = first if first is not None else float(loss)
+            assert float(loss) < first
+
+    def test_legacy_layer_signatures(self):
+        paddle.seed(0)
+        conv = fluid.dygraph.Conv2D(3, 8, 3, padding=1, act='relu')
+        x = fluid.dygraph.to_variable(
+            rs.randn(2, 3, 8, 8).astype('float32'))
+        y = conv(x)
+        assert y.shape == [2, 8, 8, 8]
+        assert float(y.min()) >= 0  # act applied
+        pool = fluid.dygraph.Pool2D(2, 'max', 2)
+        assert pool(y).shape == [2, 8, 4, 4]
+        bn = fluid.dygraph.BatchNorm(8, act='relu')
+        assert bn(y).shape == [2, 8, 8, 8]
+        emb = fluid.dygraph.Embedding(size=[10, 4])
+        ids = fluid.dygraph.to_variable(np.array([1, 2], 'int64'))
+        assert emb(ids).shape == [2, 4]
+
+    def test_save_load_dygraph(self, tmp_path):
+        paddle.seed(0)
+        net = fluid.dygraph.Linear(4, 2)
+        path = str(tmp_path / 'm')
+        fluid.dygraph.save_dygraph(net.state_dict(), path)
+        params, opt = fluid.dygraph.load_dygraph(path)
+        assert opt is None
+        net2 = fluid.dygraph.Linear(4, 2)
+        net2.set_state_dict(params)
+        np.testing.assert_allclose(np.asarray(net2.weight.value),
+                                   np.asarray(net.weight.value))
+
+
+class TestFluidStatic:
+    def test_conv_pool_fc_program(self):
+        paddle.enable_static()
+        try:
+            prog = fluid.Program()
+            with fluid.program_guard(prog):
+                img = fluid.data('img', [None, 1, 8, 8])
+                h = fluid.nets.simple_img_conv_pool(
+                    img, 4, 3, pool_size=2, pool_stride=2, act='relu')
+                out = fluid.layers.softmax(fluid.layers.fc(h, 10))
+            exe = fluid.Executor(fluid.CPUPlace())
+            got, = exe.run(prog,
+                           feed={'img': rs.randn(2, 1, 8, 8)
+                                 .astype('float32')},
+                           fetch_list=[out])
+            assert got.shape == (2, 10)
+            np.testing.assert_allclose(got.sum(1), 1.0, atol=1e-5)
+        finally:
+            paddle.disable_static()
+
+    def test_fluid_io_inference_roundtrip(self, tmp_path):
+        paddle.enable_static()
+        try:
+            prog = fluid.Program()
+            with fluid.program_guard(prog):
+                x = fluid.data('x', [2, 3])
+                out = fluid.layers.tanh(fluid.layers.fc(x, 4))
+            exe = fluid.Executor()
+            xv = rs.randn(2, 3).astype('float32')
+            ref, = exe.run(prog, feed={'x': xv}, fetch_list=[out])
+            fluid.io.save_inference_model(str(tmp_path), ['x'], [out],
+                                          exe, main_program=prog)
+            loaded, names, fts = fluid.io.load_inference_model(
+                str(tmp_path), exe)
+            got = exe.run(loaded, feed={names[0]: xv}, fetch_list=fts)
+            np.testing.assert_allclose(got[0], ref, rtol=1e-5)
+        finally:
+            paddle.disable_static()
+
+
+class TestFluidLayers:
+    def test_legacy_signatures(self):
+        a = fluid.layers.fill_constant([2, 3], 'float32', 2.0)
+        np.testing.assert_allclose(np.asarray(a.value), 2.0)
+        s = fluid.layers.reduce_sum(a, dim=1, keep_dim=True)
+        assert s.shape == [2, 1]
+        b = fluid.layers.elementwise_add(
+            a, fluid.layers.ones([2], 'float32'), axis=0)
+        np.testing.assert_allclose(np.asarray(b.value), 3.0)
+        f = fluid.layers.flatten(
+            fluid.dygraph.to_variable(np.zeros((2, 3, 4), 'float32')),
+            axis=1)
+        assert f.shape == [2, 12]
+
+    def test_fluid_cross_entropy_takes_probs(self):
+        probs = fluid.dygraph.to_variable(
+            np.array([[0.9, 0.1], [0.2, 0.8]], 'float32'))
+        lab = fluid.dygraph.to_variable(np.array([[0], [1]], 'int64'))
+        ce = fluid.layers.cross_entropy(probs, lab)
+        np.testing.assert_allclose(
+            ce.numpy().ravel(), [-np.log(0.9), -np.log(0.8)], rtol=1e-5)
+
+    def test_nets(self):
+        g = fluid.nets.glu(fluid.dygraph.to_variable(
+            np.ones((2, 6), 'float32')))
+        assert g.shape == [2, 3]
+        paddle.seed(0)
+        att = fluid.nets.scaled_dot_product_attention(
+            *[fluid.dygraph.to_variable(rs.randn(2, 5, 8)
+                                        .astype('float32'))
+              for _ in range(3)], num_heads=2)
+        assert att.shape == [2, 5, 8]
+
+    def test_initializer_aliases(self):
+        w = fluid.initializer.MSRA(uniform=False)([4, 4], 'float32')
+        assert np.asarray(w).shape == (4, 4)
+        x = fluid.initializer.Xavier()([4, 4], 'float32')
+        assert np.asarray(x).std() > 0
+
+    def test_lod_tensor_shim(self):
+        t = fluid.core.LoDTensor()
+        t.set(np.eye(3))
+        t.set_recursive_sequence_lengths([[2, 1]])
+        assert t.recursive_sequence_lengths() == [[2, 1]]
+        np.testing.assert_allclose(np.asarray(t), np.eye(3))
