@@ -5,8 +5,10 @@ Configs (SURVEY §3):
   1. LeNet MNIST dygraph        — correctness anchor (imgs/sec).
   2. ResNet-50 bf16(AMP) train  — HEADLINE imgs/sec/chip.
   3. BERT-base pretrain bf16    — tokens/sec/chip.
+  4. GPT-2 small T=1024 train   — tokens/sec/chip (single-chip face of
+     the GPT config; the hybrid multichip path is
+     __graft_entry__.dryrun_multichip).
   5. Wide&Deep sparse           — examples/sec/chip.
-(4, GPT hybrid multi-chip, is exercised by __graft_entry__.dryrun_multichip.)
 
 Baseline constants (BASELINE.json ships no published numbers; these are
 documented V100-class reference points, vs_baseline = value/baseline):
@@ -16,7 +18,7 @@ documented V100-class reference points, vs_baseline = value/baseline):
 
 Prints ONE JSON line to stdout: the headline ResNet metric, with the
 other configs nested under "extras". Progress goes to stderr.
-Run a single config with --config {lenet,resnet,bert,widedeep}.
+Run a single config with --config {lenet,resnet,bert,gpt,widedeep}.
 """
 import argparse
 import json
@@ -30,6 +32,7 @@ BASELINES = {
     'bert': 50_000.0,       # tokens/s
     'widedeep': 200_000.0,  # examples/s
     'lenet': 10_000.0,      # imgs/s (anchor only)
+    'gpt': 20_000.0,        # tokens/s (V100-class GPT-2 small AMP)
 }
 
 
@@ -139,6 +142,47 @@ def bench_bert(smoke):
     return v
 
 
+def bench_gpt(smoke):
+    """GPT-2 small causal-LM train at T=1024 — the long-sequence
+    single-chip face of SURVEY §3 config 4 (the hybrid multichip path
+    is dryrun_multichip); flash attention carries the T^2 term."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import gpt_small, gpt_tiny
+    from paddle_tpu.parallel import ParallelTrainer
+    from paddle_tpu.distributed import fleet
+
+    batch, seq, iters, warmup = (2, 128, 3, 2) if smoke else \
+        (8, 1024, 15, 3)
+    paddle.seed(0)
+    model = gpt_tiny() if smoke else gpt_small(max_seq_len=seq,
+                                               dropout=0.0)
+    opt = paddle.optimizer.AdamW(learning_rate=3e-4,
+                                 parameters=model.parameters())
+    strategy = fleet.DistributedStrategy()
+    strategy.amp = True
+    strategy.amp_configs['use_pure_fp16'] = True
+    trainer = ParallelTrainer(model, opt,
+                              lambda out, y: model.loss(out, y),
+                              strategy=strategy)
+    rs = np.random.RandomState(0)
+    V = model.config.vocab_size
+    ids = jax.device_put(
+        rs.randint(0, V, size=(batch, seq)).astype('int64'))
+    t0 = time.time()
+    loss = None
+    for _ in range(warmup):
+        loss = trainer.step(ids, ids)
+    jax.block_until_ready(loss)
+    log(f'gpt warmup ({warmup} steps incl. compile): '
+        f'{time.time() - t0:.1f}s loss={float(np.asarray(loss)):.4f}')
+    dt = _time_steps(trainer.step, iters, ids, ids)
+    v = batch * seq * iters / dt
+    log(f'gpt2-small: {iters} steps in {dt:.2f}s '
+        f'({dt / iters * 1000:.1f} ms/step, {v:.0f} tokens/s)')
+    return v
+
+
 def bench_widedeep(smoke):
     import jax
     import paddle_tpu as paddle
@@ -223,6 +267,7 @@ CONFIGS = {
     'lenet': bench_lenet,
     'resnet': bench_resnet,
     'bert': bench_bert,
+    'gpt': bench_gpt,
     'widedeep': bench_widedeep,
 }
 
@@ -230,6 +275,7 @@ UNITS = {
     'lenet': 'imgs/sec/chip',
     'resnet': 'imgs/sec/chip',
     'bert': 'tokens/sec/chip',
+    'gpt': 'tokens/sec/chip',
     'widedeep': 'examples/sec/chip',
 }
 
@@ -263,6 +309,7 @@ def main():
     metric_names = {
         'resnet': 'resnet50_bf16_train_throughput',
         'bert': 'bert_base_bf16_pretrain_throughput',
+        'gpt': 'gpt2_small_bf16_train_throughput',
         'widedeep': 'widedeep_sparse_train_throughput',
         'lenet': 'lenet_train_throughput',
     }

@@ -192,14 +192,18 @@ class TestFusedLinearGelu:
                                    np.asarray(fg_ref(x, w, b, True)),
                                    rtol=1e-6)
 
-    def test_mlp_gelu_route_matches_unfused(self):
-        # the Tensor-level apply route (fused on TPU, jnp reference on
-        # CPU) must match explicit fc+gelu in value AND in grads on
-        # both the input and the fc parameters
+    def test_mlp_gelu_route_matches_unfused(self, monkeypatch):
+        # the OPT-IN Tensor-level apply route (fused kernel on TPU, jnp
+        # reference on CPU) must match explicit fc+gelu in value AND in
+        # grads on both the input and the fc parameters.  The default
+        # is the XLA path (USE_PALLAS_MLP=False, PERF.md), so force the
+        # apply route here to keep it covered.
         import paddle_tpu as paddle
         from paddle_tpu import nn
         from paddle_tpu.nn import functional as F
+        from paddle_tpu.ops import fused_gelu_linear as fgl
         from paddle_tpu.ops.fused_gelu_linear import mlp_gelu
+        monkeypatch.setattr(fgl, 'USE_PALLAS_MLP', True)
         paddle.seed(0)
         fc = nn.Linear(32, 64)
         xv = np.random.RandomState(0).randn(4, 32).astype('float32')
@@ -225,12 +229,15 @@ class TestFusedLinearGelu:
                                    np.asarray(fc.weight.grad.numpy()),
                                    rtol=1e-4, atol=1e-5)
 
-    def test_bert_mlp_grad_plumbing(self):
-        # end-to-end: tiny BERT forward+backward through the apply
-        # route reaches the fc parameters (CPU hits the jnp fallback;
-        # kernel parity is covered by the interpret-mode tests above)
+    def test_bert_mlp_grad_plumbing(self, monkeypatch):
+        # end-to-end: tiny BERT forward+backward through the OPT-IN
+        # apply route reaches the fc parameters (CPU hits the jnp
+        # fallback; kernel parity is covered by the interpret-mode
+        # tests above)
         import paddle_tpu as paddle
         from paddle_tpu.models.bert import bert_tiny
+        from paddle_tpu.ops import fused_gelu_linear as fgl
+        monkeypatch.setattr(fgl, 'USE_PALLAS_MLP', True)
         paddle.seed(0)
         m = bert_tiny()
         ids = np.random.RandomState(0).randint(0, 128, (2, 16)) \
