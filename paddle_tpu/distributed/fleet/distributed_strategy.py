@@ -58,7 +58,8 @@ class DistributedStrategy:
         self.a_sync = False
         self.a_sync_configs = _Bag(k_steps=-1)
         self.hybrid_configs = _Bag(dp_degree=-1, mp_degree=1, pp_degree=1,
-                                   sp_degree=1, sharding_degree=1)
+                                   sp_degree=1, ep_degree=1,
+                                   sharding_degree=1)
         self.find_unused_parameters = False
         self.fuse_all_reduce_ops = True   # XLA always fuses; kept for parity
         self.fuse_grad_size_in_MB = 32
@@ -67,7 +68,7 @@ class DistributedStrategy:
     # the reference exposes hybrid_configs via dict-style assignment
     @property
     def hybrid_parallel_order(self):
-        return ['pp', 'dp', 'sp', 'mp']
+        return ['pp', 'dp', 'sp', 'ep', 'mp']
 
     def __repr__(self):
         on = [k for k, v in self.__dict__.items()

@@ -93,10 +93,12 @@ class Fleet:
         mp = max(1, hc.get('mp_degree') or 1)
         pp = max(1, hc.get('pp_degree') or 1)
         sp = max(1, hc.get('sp_degree') or 1)
+        ep = max(1, hc.get('ep_degree') or 1)
         dp = hc.get('dp_degree') or -1
         if dp is None or dp <= 0:
-            dp = max(1, n // (mp * pp * sp))
-        axes = [('pp', pp), ('dp', dp), ('sp', sp), ('tp', mp)]
+            dp = max(1, n // (mp * pp * sp * ep))
+        axes = [('pp', pp), ('dp', dp), ('sp', sp), ('ep', ep),
+                ('tp', mp)]
         # only materialize axes that exist — 1-sized axes still get names
         # so PartitionSpecs stay valid regardless of strategy
         mesh = _env.build_mesh(axes)

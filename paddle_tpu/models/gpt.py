@@ -30,14 +30,16 @@ from ..parallel.api import maybe_shard
 from ..tensor import creation, linalg, manipulation, math as pmath
 
 __all__ = ['GPTConfig', 'GPT', 'GPTForCausalLM', 'gpt_tiny', 'gpt_small',
-           'gpt_1p3b']
+           'gpt_1p3b', 'gpt_moe_tiny']
 
 
 class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, max_seq_len=1024, intermediate_size=None,
                  dropout=0.1, layer_norm_epsilon=1e-5,
-                 sequence_parallel=False, initializer_range=0.02):
+                 sequence_parallel=False, initializer_range=0.02,
+                 moe_num_experts=0, moe_every=2, moe_top_k=1,
+                 moe_capacity_factor=1.25, moe_aux_weight=0.01):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -48,6 +50,15 @@ class GPTConfig:
         self.layer_norm_epsilon = layer_norm_epsilon
         self.sequence_parallel = sequence_parallel
         self.initializer_range = initializer_range
+        # MoE (expert parallelism over the 'ep' mesh axis): when
+        # moe_num_experts > 0, every moe_every-th block's MLP becomes a
+        # SwitchMoE (incubate/moe.py) and loss() adds the load-balance
+        # auxiliary term
+        self.moe_num_experts = moe_num_experts
+        self.moe_every = moe_every
+        self.moe_top_k = moe_top_k
+        self.moe_capacity_factor = moe_capacity_factor
+        self.moe_aux_weight = moe_aux_weight
 
 
 def _act_spec(cfg):
@@ -171,14 +182,21 @@ class GPTMLP(nn.Layer):
 
 
 class GPTBlock(nn.Layer):
-    def __init__(self, cfg):
+    def __init__(self, cfg, use_moe=False):
         super().__init__()
         self.ln1 = nn.LayerNorm(cfg.hidden_size,
                                 epsilon=cfg.layer_norm_epsilon)
         self.attn = CausalSelfAttention(cfg)
         self.ln2 = nn.LayerNorm(cfg.hidden_size,
                                 epsilon=cfg.layer_norm_epsilon)
-        self.mlp = GPTMLP(cfg)
+        if use_moe:
+            from ..incubate.moe import SwitchMoE
+            self.mlp = SwitchMoE(cfg.hidden_size, cfg.intermediate_size,
+                                 cfg.moe_num_experts,
+                                 top_k=cfg.moe_top_k,
+                                 capacity_factor=cfg.moe_capacity_factor)
+        else:
+            self.mlp = GPTMLP(cfg)
         self.cfg = cfg
 
     def forward(self, x):
@@ -197,8 +215,11 @@ class GPT(nn.Layer):
                                           config.hidden_size)
         self.wpe = nn.Embedding(config.max_seq_len, config.hidden_size)
         self.drop = nn.Dropout(config.dropout)
-        self.blocks = nn.LayerList([GPTBlock(config)
-                                    for _ in range(config.num_layers)])
+        self.blocks = nn.LayerList([
+            GPTBlock(config, use_moe=(
+                config.moe_num_experts > 0
+                and i % config.moe_every == config.moe_every - 1))
+            for i in range(config.num_layers)])
         self.ln_f = nn.LayerNorm(config.hidden_size,
                                  epsilon=config.layer_norm_epsilon)
 
@@ -228,11 +249,22 @@ class GPTForCausalLM(nn.Layer):
         return maybe_shard(logits, ('dp', None, 'tp'))
 
     def loss(self, logits, labels):
-        """Causal LM loss: shift-by-one cross entropy."""
+        """Causal LM loss: shift-by-one cross entropy (+ the MoE
+        load-balance auxiliary term when experts are routed)."""
         B, T, V = logits.shape
         lg = manipulation.reshape(logits[:, :-1, :], [B * (T - 1), V])
         lb = manipulation.reshape(labels[:, 1:], [B * (T - 1)])
-        return F.cross_entropy(lg, lb)
+        out = F.cross_entropy(lg, lb)
+        if self.config.moe_num_experts > 0:
+            aux = [blk.mlp.aux_loss for blk in self.gpt.blocks
+                   if getattr(blk.mlp, 'aux_loss', None) is not None]
+            if aux:
+                total = aux[0]
+                for a in aux[1:]:
+                    total = total + a
+                out = out + self.config.moe_aux_weight * \
+                    (total / float(len(aux)))
+        return out
 
     def as_pipeline_module(self, num_stages, mesh):
         """Adapter for the 1F1B pipeline engine (parallel.pipeline_1f1b):
@@ -251,6 +283,14 @@ def gpt_tiny(**kw):
     kw.setdefault('max_seq_len', 128)
     kw.setdefault('dropout', 0.0)
     return GPTForCausalLM(GPTConfig(**kw))
+
+
+def gpt_moe_tiny(**kw):
+    """gpt_tiny with routed experts on alternating blocks — the ep-axis
+    dryrun/test config."""
+    kw.setdefault('moe_num_experts', 4)
+    kw.setdefault('moe_top_k', 1)
+    return gpt_tiny(**kw)
 
 
 def gpt_small(**kw):

@@ -79,16 +79,18 @@ class BeamSearchDecoder(Decoder):
     @staticmethod
     def tile_beam_merge_with_batch(x, beam_size):
         """[B, ...] -> [B*beam, ...] by repeating each batch entry
-        (reference BeamSearchDecoder.tile_beam_merge_with_batch)."""
+        (reference BeamSearchDecoder.tile_beam_merge_with_batch).
+        Leading dims are computed explicitly — a -1 reshape cannot be
+        inferred on zero-size state leaves (e.g. an empty prefix)."""
         v = raw(wrap(x))
         v = jnp.repeat(v[:, None], beam_size, axis=1)
-        return Tensor(v.reshape((-1,) + v.shape[2:]))
+        return Tensor(v.reshape((v.shape[0] * beam_size,) + v.shape[2:]))
 
     def _split(self, v):
         return v.reshape((self._batch, self.beam_size) + v.shape[1:])
 
     def _merge(self, v):
-        return v.reshape((-1,) + v.shape[2:])
+        return v.reshape((v.shape[0] * v.shape[1],) + v.shape[2:])
 
     # -- Decoder protocol ----------------------------------------------
     def initialize(self, initial_cell_states):

@@ -101,7 +101,8 @@ class TestFleetInit:
         strategy.hybrid_configs['pp_degree'] = 2
         fleet.init(is_collective=True, strategy=strategy)
         mesh = dist.get_mesh()
-        assert dict(mesh.shape) == {'pp': 2, 'dp': 2, 'sp': 1, 'tp': 2}
+        assert dict(mesh.shape) == {'pp': 2, 'dp': 2, 'sp': 1,
+                                    'ep': 1, 'tp': 2}
         hcg = fleet.get_hybrid_communicate_group()
         assert hcg.get_model_parallel_world_size() == 2
         assert hcg.get_data_parallel_world_size() == 2

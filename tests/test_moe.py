@@ -1,0 +1,198 @@
+"""SwitchMoE + expert parallelism ('ep' mesh axis).
+
+Design source: Switch Transformer routing (public algorithm); the
+reference tree predates MoE — expert parallelism is first-class here
+per the brief. Single-device correctness + ep-sharded parity on the
+virtual CPU mesh.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import SwitchMoE
+
+rs = np.random.RandomState(0)
+
+
+class TestSwitchMoESingleDevice:
+    def test_single_expert_equals_dense_mlp(self):
+        paddle.seed(0)
+        moe = SwitchMoE(8, 16, num_experts=1, capacity_factor=1.0)
+        x = paddle.to_tensor(rs.randn(4, 6, 8).astype('float32'))
+        y = moe(x)
+        # E=1: every token routes to expert 0 with gate=softmax(...)=1
+        import jax.numpy as jnp
+        import jax
+        xs = np.asarray(x.value).reshape(-1, 8)
+        w1 = np.asarray(moe.w1.value)[0]
+        b1 = np.asarray(moe.b1.value)[0, 0]
+        w2 = np.asarray(moe.w2.value)[0]
+        b2 = np.asarray(moe.b2.value)[0, 0]
+        ref = np.asarray(jax.nn.gelu(jnp.asarray(xs @ w1 + b1))) @ w2 + b2
+        np.testing.assert_allclose(np.asarray(y.value).reshape(-1, 8),
+                                   ref, rtol=1e-4, atol=1e-5)
+        assert float(moe.aux_loss) == pytest.approx(1.0, rel=1e-5)
+
+    def test_routing_is_argmax_of_gate(self):
+        paddle.seed(1)
+        moe = SwitchMoE(4, 8, num_experts=3, capacity_factor=4.0)
+        x = paddle.to_tensor(rs.randn(1, 5, 4).astype('float32'))
+        y = moe(x)
+        assert y.shape == [1, 5, 4]
+        assert np.isfinite(np.asarray(y.value)).all()
+        aux = float(moe.aux_loss)
+        assert aux >= 1.0 - 1e-5  # lower bound at perfect balance
+
+    def test_capacity_drops_tokens(self):
+        paddle.seed(0)
+        # capacity 1 slot/expert; send identical tokens so they all
+        # route to the same expert — overflow must emit zeros
+        moe = SwitchMoE(4, 8, num_experts=2, capacity_factor=0.5)
+        x = paddle.to_tensor(np.ones((1, 8, 4), 'float32'))
+        y = np.asarray(moe(x).value).reshape(8, 4)
+        kept = (np.abs(y) > 1e-7).any(axis=1)
+        assert kept.sum() <= moe._capacity(8)
+
+    def test_top2_runs_and_differs_from_top1(self):
+        paddle.seed(0)
+        m1 = SwitchMoE(8, 16, num_experts=4, top_k=1,
+                       capacity_factor=2.0)
+        paddle.seed(0)
+        m2 = SwitchMoE(8, 16, num_experts=4, top_k=2,
+                       capacity_factor=2.0)
+        x = paddle.to_tensor(rs.randn(2, 6, 8).astype('float32'))
+        y1, y2 = np.asarray(m1(x).value), np.asarray(m2(x).value)
+        assert y1.shape == y2.shape
+        assert not np.allclose(y1, y2)  # second expert contributes
+
+    def test_grads_reach_experts_and_gate(self):
+        paddle.seed(0)
+        moe = SwitchMoE(8, 16, num_experts=2, capacity_factor=2.0)
+        x = paddle.to_tensor(rs.randn(2, 4, 8).astype('float32'))
+        x.stop_gradient = False
+        (moe(x).sum() + moe.aux_loss).backward()
+        for p in (moe.w1, moe.w2, moe.gate_w):
+            assert p.grad is not None
+            assert np.isfinite(np.asarray(p.grad.value)).all()
+        assert np.abs(np.asarray(moe.gate_w.grad.value)).sum() > 0
+        assert x.grad is not None
+
+
+class TestMoEGPT:
+    def test_moe_gpt_trains(self):
+        from paddle_tpu.models import gpt_moe_tiny
+        from paddle_tpu.parallel import ParallelTrainer
+        from paddle_tpu.distributed import env as dist_env
+        dist_env.set_mesh(None)
+        paddle.seed(0)
+        model = gpt_moe_tiny()
+        n_moe = sum(1 for b in model.gpt.blocks
+                    if type(b.mlp).__name__ == 'SwitchMoE')
+        assert n_moe == 2  # every 2nd of 4 blocks
+        opt = paddle.optimizer.AdamW(1e-3,
+                                     parameters=model.parameters())
+        tr = ParallelTrainer(model, opt,
+                             lambda o, y: model.loss(o, y))
+        ids = rs.randint(0, 128, size=(4, 32)).astype('int64')
+        l0 = float(np.asarray(tr.step(ids, ids)))
+        for _ in range(8):
+            l1 = float(np.asarray(tr.step(ids, ids)))
+        assert np.isfinite(l1) and l1 < l0, (l0, l1)
+
+
+class TestExpertParallel:
+    def test_ep_sharded_matches_single_device(self):
+        """dp2 x ep2 x tp2 MoE-GPT step: loss equal to the meshless run
+        (same seed) — the ep all-to-all layout must not change math."""
+        from paddle_tpu.models import gpt_moe_tiny
+        from paddle_tpu.parallel import ParallelTrainer
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed import env as dist_env
+
+        ids = rs.randint(0, 128, size=(4, 32)).astype('int64')
+
+        def run(mesh):
+            dist_env.set_mesh(None)
+            strategy = None
+            if mesh:
+                s = fleet.DistributedStrategy()
+                s.hybrid_configs['dp_degree'] = 2
+                s.hybrid_configs['ep_degree'] = 2
+                s.hybrid_configs['mp_degree'] = 2
+                fleet.init(is_collective=True, strategy=s)
+                strategy = s
+            paddle.seed(0)
+            model = gpt_moe_tiny()
+            opt = paddle.optimizer.AdamW(
+                1e-3, parameters=model.parameters())
+            tr = ParallelTrainer(model, opt,
+                                 lambda o, y: model.loss(o, y),
+                                 strategy=strategy)
+            losses = [float(np.asarray(tr.step(ids, ids)))
+                      for _ in range(3)]
+            dist_env.set_mesh(None)
+            return losses
+
+        single = run(False)
+        sharded = run(True)
+        np.testing.assert_allclose(sharded, single, rtol=2e-3)
+
+    def test_mesh_has_ep_axis(self):
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed import env as dist_env
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs['ep_degree'] = 2
+        fleet.init(is_collective=True, strategy=s)
+        try:
+            mesh = dist_env.get_mesh()
+            assert 'ep' in mesh.axis_names
+            assert dict(zip(mesh.axis_names,
+                            mesh.devices.shape))['ep'] == 2
+        finally:
+            dist_env.set_mesh(None)
+
+
+class TestTop2NoSlotCollision:
+    def test_second_choice_queues_behind_first(self):
+        """A 2nd-choice token of expert e must land in a FRESH slot,
+        after e's 1st-choice tokens — colliding slots would sum tokens
+        before the FFN and hand both the same mixed output."""
+        import jax.numpy as jnp
+        paddle.seed(0)
+        H, E = 4, 2
+        moe = SwitchMoE(H, 8, num_experts=E, top_k=2,
+                        capacity_factor=4.0)
+        # force deterministic routing: token0 prefers e0 then e1;
+        # token1 prefers e1 then e0 — so e1 gets token1 (1st) AND
+        # token0 (2nd): without occupancy both take e1 slot 0
+        gate = np.zeros((H, E), 'float32')
+        gate[0, 0] = 5.0   # feature 0 -> expert 0
+        gate[1, 1] = 5.0   # feature 1 -> expert 1
+        moe.gate_w.set_value(paddle.to_tensor(gate).value)
+        x_np = np.zeros((1, 2, H), 'float32')
+        x_np[0, 0, 0] = 1.0   # token0: logits (5, 0)
+        x_np[0, 1, 1] = 1.0   # token1: logits (0, 5)
+        y = np.asarray(moe(paddle.to_tensor(x_np)).value)[0]
+
+        # reference: run each token through each expert ALONE and
+        # combine with the softmax gates
+        def expert(e, v):
+            import jax
+            w1 = np.asarray(moe.w1.value)[e]
+            b1 = np.asarray(moe.b1.value)[e, 0]
+            w2 = np.asarray(moe.w2.value)[e]
+            b2 = np.asarray(moe.b2.value)[e, 0]
+            return np.asarray(jax.nn.gelu(
+                jnp.asarray(v @ w1 + b1))) @ w2 + b2
+
+        def softmax(v):
+            e = np.exp(v - v.max())
+            return e / e.sum()
+        for t in range(2):
+            v = x_np[0, t]
+            logits = v @ gate
+            p = softmax(logits)
+            order = np.argsort(-p)
+            ref = sum(p[e] * expert(e, v) for e in order[:2])
+            np.testing.assert_allclose(y[t], ref, rtol=1e-4,
+                                       atol=1e-5)
