@@ -7,7 +7,12 @@ from .widedeep import WideDeep, DeepFM  # noqa: F401
 from .bert import (  # noqa: F401
     BertConfig, BertModel, BertForPretraining, bert_tiny, bert_base,
     bert_large)
+from .ernie import (  # noqa: F401
+    ErnieConfig, ErnieModel, ErnieForPretraining, ernie_base,
+    ernie_tiny)
 
 __all__ = ['GPTConfig', 'GPT', 'GPTForCausalLM', 'gpt_tiny', 'gpt_small',
            'gpt_1p3b', 'gpt_moe_tiny', 'WideDeep', 'DeepFM', 'BertConfig', 'BertModel',
-           'BertForPretraining', 'bert_tiny', 'bert_base', 'bert_large']
+           'BertForPretraining', 'bert_tiny', 'bert_base', 'bert_large',
+           'ErnieConfig', 'ErnieModel', 'ErnieForPretraining',
+           'ernie_base', 'ernie_tiny']

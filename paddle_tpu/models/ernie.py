@@ -1,0 +1,48 @@
+"""ERNIE model family (SURVEY §3 config 3: "ERNIE/BERT-base pretrain").
+
+ERNIE 1.0 (Baidu) shares the BERT encoder architecture; what differs is
+the pretraining DATA strategy (phrase/entity-level masking, which lives
+in the input pipeline, not the network) and the Chinese-corpus config:
+vocab 18000, type_vocab 4.  This module therefore configures the BERT
+backbone (models/bert.py) with ERNIE's dimensions rather than
+duplicating the encoder — any masking strategy can be applied by the
+data pipeline feeding it.  (The backbone's GELU MLP and NSP-style
+sentence head are shared with BERT; this is the bench config for
+SURVEY §3 item 3, not a weight-compatible ERNIE 1.0 port.)
+"""
+from .bert import BertConfig, BertModel, BertForPretraining
+
+__all__ = ['ErnieConfig', 'ErnieModel', 'ErnieForPretraining',
+           'ernie_base', 'ernie_tiny']
+
+
+class ErnieConfig(BertConfig):
+    def __init__(self, vocab_size=18000, hidden_size=768, num_layers=12,
+                 num_heads=12, max_seq_len=513, type_vocab_size=4,
+                 **kw):
+        super().__init__(vocab_size=vocab_size, hidden_size=hidden_size,
+                         num_layers=num_layers, num_heads=num_heads,
+                         max_seq_len=max_seq_len,
+                         type_vocab_size=type_vocab_size, **kw)
+
+
+class ErnieModel(BertModel):
+    """ERNIE 1.0 encoder (BERT backbone with ERNIE dims)."""
+
+
+class ErnieForPretraining(BertForPretraining):
+    """MLM + next-sentence head over the ERNIE encoder; phrase/entity
+    masking is the caller's labeling strategy."""
+
+
+def ernie_base(**kw):
+    return ErnieForPretraining(ErnieConfig(**kw))
+
+
+def ernie_tiny(**kw):
+    kw.setdefault('vocab_size', 128)
+    kw.setdefault('hidden_size', 32)
+    kw.setdefault('num_layers', 2)
+    kw.setdefault('num_heads', 2)
+    kw.setdefault('max_seq_len', 64)
+    return ErnieForPretraining(ErnieConfig(**kw))

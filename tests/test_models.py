@@ -229,3 +229,29 @@ class TestSeq2SeqEndToEnd:
         top = np.asarray(ids.numpy())[0, :, 0]
         acc_beam = (top[:T] == src_np[0][:len(top[:T])]).mean()
         assert acc_beam >= 0.5, (top, src_np[0])
+
+
+class TestErnie:
+    """ERNIE family (SURVEY §3 config 3 'ERNIE/BERT-base'): BERT
+    encoder with ERNIE dims; masking strategy is data-side."""
+
+    def test_forward_and_loss(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models import ernie_tiny
+        paddle.seed(0)
+        m = ernie_tiny()
+        assert m.config.type_vocab_size == 4
+        ids = np.random.RandomState(0).randint(0, 128, (2, 16)) \
+            .astype('int64')
+        logits, nsp = m(paddle.to_tensor(ids))
+        assert logits.shape == [2, 16, 128] and nsp.shape == [2, 2]
+        lbl = np.where(np.random.RandomState(1).rand(2, 16) < 0.3,
+                       ids, -100).astype('int64')
+        loss = m.loss((logits, nsp), paddle.to_tensor(lbl))
+        loss.backward()
+        assert np.isfinite(float(loss))
+
+    def test_base_config_defaults(self):
+        from paddle_tpu.models import ErnieConfig
+        cfg = ErnieConfig()
+        assert cfg.vocab_size == 18000 and cfg.type_vocab_size == 4
