@@ -74,7 +74,29 @@ class DatasetBase:
         return tuple(out) if len(out) > 1 else out[0]
 
     def _iter_files(self):
+        """One file at a time; the C++ slot parser (io/native/
+        slotreader — the reference's MultiSlotDataFeed counterpart)
+        bulk-parses each file into columns, Python slices out rows;
+        falls back to the line parser without a compiler."""
+        from ..io.native import slotreader
+        # native columns are exactly float32/int64; any other declared
+        # dtype takes the Python parser so dtypes are honored exactly
+        native_ok = self._slots and all(
+            s.dtype == np.int64 or s.dtype == np.float32
+            for s in self._slots)
         for path in self._filelist:
+            cols = None
+            if native_ok:
+                cols = slotreader.parse_file(
+                    path, [s.width for s in self._slots],
+                    [np.issubdtype(s.dtype, np.integer)
+                     for s in self._slots])
+            if cols is not None:
+                n = cols[0].shape[0] if cols else 0
+                for r in range(n):
+                    row = tuple(c[r] for c in cols)
+                    yield row if len(row) > 1 else row[0]
+                continue
             with open(path) as f:
                 for line in f:
                     line = line.strip()

@@ -5,11 +5,9 @@ source, rebuilt when the source changes); everything degrades to the
 pure-Python queue path when no compiler is available.
 """
 import ctypes
-import hashlib
 import os
 import pickle
 import struct
-import subprocess
 import threading
 
 import numpy as np
@@ -23,17 +21,8 @@ _build_lock = threading.Lock()
 
 
 def _build():
-    with open(_SRC, 'rb') as f:
-        tag = hashlib.sha256(f.read()).hexdigest()[:16]
-    so = os.path.join(_HERE, f'_ringbuf_{tag}.so')
-    if not os.path.exists(so):
-        tmp = f'{so}.{os.getpid()}.tmp'  # unique per process: no race
-        subprocess.run(
-            ['g++', '-O3', '-shared', '-fPIC', '-pthread', '-std=c++17',
-             _SRC, '-o', tmp],
-            check=True, capture_output=True)
-        os.replace(tmp, so)  # atomic: losers overwrite with identical lib
-    lib = ctypes.CDLL(so)
+    from .buildlib import compile_cached
+    lib = compile_cached(_SRC, 'ringbuf', extra_flags=('-pthread',))
     lib.rb_create.restype = ctypes.c_void_p
     lib.rb_create.argtypes = [ctypes.c_int64]
     lib.rb_destroy.argtypes = [ctypes.c_void_p]

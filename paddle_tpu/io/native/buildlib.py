@@ -1,0 +1,29 @@
+"""Shared compile-and-cache for the native components.
+
+One implementation of the hash-tagged .so build (rebuilt when the
+source changes, atomic install, per-process temp) used by both the
+prefetch ring and the slot reader — fixes to flags/caching land once.
+"""
+import ctypes
+import hashlib
+import os
+import subprocess
+
+__all__ = ['compile_cached']
+
+
+def compile_cached(src, prefix, extra_flags=()):
+    """g++-compile `src` into a cached .so next to it; returns CDLL.
+    Raises on any build failure — callers decide their fallback."""
+    here = os.path.dirname(os.path.abspath(src))
+    with open(src, 'rb') as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    so = os.path.join(here, f'_{prefix}_{tag}.so')
+    if not os.path.exists(so):
+        tmp = f'{so}.{os.getpid()}.tmp'  # unique per process: no race
+        subprocess.run(
+            ['g++', '-O3', '-shared', '-fPIC', '-std=c++17',
+             *extra_flags, src, '-o', tmp],
+            check=True, capture_output=True)
+        os.replace(tmp, so)  # atomic: losers overwrite identical lib
+    return ctypes.CDLL(so)

@@ -1,0 +1,91 @@
+"""ctypes bindings for the native MultiSlot file parser.
+
+Reference analogue: the C++ reader threads of
+paddle/fluid/framework/data_feed.cc feeding the fleet datasets.  Build
+follows the ringbuf pattern (hash-cached .so, graceful Python
+fallback).  parse_file() returns per-slot numpy columns for a whole
+file in one native pass — the fleet datasets slice rows out of them.
+"""
+import ctypes
+import os
+import threading
+
+import numpy as np
+
+from .buildlib import compile_cached
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, 'slotreader.cpp')
+
+_lib = None
+_lib_err = None
+_lock = threading.Lock()
+
+__all__ = ['available', 'parse_file']
+
+
+def _build():
+    lib = compile_cached(_SRC, 'slotreader')
+    lib.sr_parse.restype = ctypes.c_void_p
+    lib.sr_parse.argtypes = [ctypes.c_char_p,
+                             ctypes.POINTER(ctypes.c_int64),
+                             ctypes.POINTER(ctypes.c_int32),
+                             ctypes.c_int32]
+    lib.sr_count.restype = ctypes.c_int64
+    lib.sr_count.argtypes = [ctypes.c_void_p]
+    lib.sr_error.restype = ctypes.c_int64
+    lib.sr_error.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                             ctypes.c_int64]
+    lib.sr_read.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                            ctypes.c_void_p]
+    lib.sr_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def available():
+    global _lib, _lib_err
+    if _lib is not None:
+        return True
+    if _lib_err is not None:
+        return False
+    with _lock:
+        if _lib is None and _lib_err is None:
+            try:
+                _lib = _build()
+            except Exception as e:   # no compiler → Python fallback
+                _lib_err = e
+    return _lib is not None
+
+
+def parse_file(path, widths, int_mask):
+    """Parse a slot file natively.
+
+    widths: values per slot per line; int_mask: True for int64 slots.
+    Returns a list of [n_samples, width] arrays (float32/int64), or
+    None when the native parser is unavailable.
+    Raises ValueError on malformed files (same contract as the Python
+    parser).
+    """
+    if not available():
+        return None
+    n = len(widths)
+    w = (ctypes.c_int64 * n)(*[int(x) for x in widths])
+    m = (ctypes.c_int32 * n)(*[1 if b else 0 for b in int_mask])
+    h = _lib.sr_parse(path.encode(), w, m, n)
+    try:
+        buf = ctypes.create_string_buffer(512)
+        elen = _lib.sr_error(h, buf, 512)
+        if elen:
+            msg = buf.raw[:elen].decode(errors='replace')
+            raise ValueError(f'slotreader: {msg} in {path}')
+        count = _lib.sr_count(h)
+        cols = []
+        for k in range(n):
+            dt = np.int64 if int_mask[k] else np.float32
+            arr = np.empty((count, int(widths[k])), dt)
+            if count:
+                _lib.sr_read(h, k, arr.ctypes.data_as(ctypes.c_void_p))
+            cols.append(arr)
+        return cols
+    finally:
+        _lib.sr_free(h)
