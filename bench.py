@@ -22,6 +22,7 @@ Run a single config with --config {lenet,resnet,bert,gpt,widedeep}.
 """
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -280,31 +281,78 @@ UNITS = {
 }
 
 
+def _run_one(name, smoke):
+    """Run one config in-process; returns its result dict."""
+    from paddle_tpu.distributed import env as dist_env
+    dist_env.set_mesh(None)
+    try:
+        v = CONFIGS[name](smoke)
+        return {'value': round(v, 2), 'unit': UNITS[name],
+                'vs_baseline': round(v / BASELINES[name], 4)}
+    except Exception as e:  # one config failing must not hide the rest
+        log(f'{name} FAILED: {e!r}')
+        return {'value': None, 'unit': UNITS[name],
+                'error': repr(e)[:200]}
+
+
+def _run_isolated(name, smoke, timeout_s):
+    """Run one config in a SUBPROCESS with a hard timeout: a wedged
+    accelerator tunnel (or a pathological compile) in one config must
+    not take down the whole artifact."""
+    import subprocess
+    import sys
+    cmd = [sys.executable, os.path.abspath(__file__), '--config', name,
+           '--single-json']
+    if smoke:
+        cmd.append('--smoke')
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        log(f'{name} TIMED OUT after {timeout_s}s')
+        return {'value': None, 'unit': UNITS[name],
+                'error': f'timeout after {timeout_s}s'}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(parsed, dict):   # stray numeric lines don't count
+            return parsed
+    log(f'{name} produced no JSON (rc={proc.returncode}): '
+        f'{proc.stderr[-300:]}')
+    return {'value': None, 'unit': UNITS[name],
+            'error': f'no output (rc={proc.returncode})'}
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument('--smoke', action='store_true',
                    help='tiny shapes, few iters (CI sanity)')
     p.add_argument('--config', choices=list(CONFIGS) + ['all'],
                    default='all')
+    p.add_argument('--single-json', action='store_true',
+                   help='(internal) emit one config result as raw JSON')
+    p.add_argument('--timeout', type=int, default=900,
+                   help='per-config subprocess timeout (seconds)')
     args = p.parse_args()
 
-    import jax
-    log(f'device: {jax.devices()[0]}')
+    if args.single_json:
+        if args.config == 'all':
+            p.error('--single-json needs an explicit --config NAME')
+        res = _run_one(args.config, args.smoke)
+        print(json.dumps(res))
+        return
 
     names = list(CONFIGS) if args.config == 'all' else [args.config]
     results = {}
     for name in names:
-        from paddle_tpu.distributed import env as dist_env
-        dist_env.set_mesh(None)
-        try:
-            v = CONFIGS[name](args.smoke)
-            results[name] = {
-                'value': round(v, 2), 'unit': UNITS[name],
-                'vs_baseline': round(v / BASELINES[name], 4)}
-        except Exception as e:  # one config failing must not hide rest
-            log(f'{name} FAILED: {e!r}')
-            results[name] = {'value': None, 'unit': UNITS[name],
-                             'error': repr(e)[:200]}
+        if args.config == 'all':
+            results[name] = _run_isolated(name, args.smoke, args.timeout)
+        else:
+            import jax
+            log(f'device: {jax.devices()[0]}')
+            results[name] = _run_one(name, args.smoke)
 
     metric_names = {
         'resnet': 'resnet50_bf16_train_throughput',

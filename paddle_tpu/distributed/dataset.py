@@ -74,13 +74,25 @@ class DatasetBase:
         return tuple(out) if len(out) > 1 else out[0]
 
     def _iter_files(self):
-        """One file at a time; the C++ slot parser (io/native/
-        slotreader — the reference's MultiSlotDataFeed counterpart)
-        bulk-parses each file into columns, Python slices out rows;
-        falls back to the line parser without a compiler."""
+        """Streaming line-by-line parse: constant memory, used by
+        QueueDataset (matching the reference's streaming pipe readers)."""
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield self._parse_line(line)
+
+    def _iter_files_bulk(self):
+        """Whole-file parse via the C++ slot parser (io/native/
+        slotreader — the reference's MultiSlotDataFeed counterpart):
+        one native pass per file, columns sliced into rows.  ONLY for
+        consumers that materialize everything anyway
+        (InMemoryDataset.load_into_memory) — a streaming consumer would
+        lose its constant-memory contract.  Falls back to the streaming
+        parser without a compiler or for slot dtypes other than
+        int64/float32 (those keep their declared dtypes)."""
         from ..io.native import slotreader
-        # native columns are exactly float32/int64; any other declared
-        # dtype takes the Python parser so dtypes are honored exactly
         native_ok = self._slots and all(
             s.dtype == np.int64 or s.dtype == np.float32
             for s in self._slots)
@@ -132,7 +144,7 @@ class InMemoryDataset(DatasetBase):
         self._samples = None
 
     def load_into_memory(self):
-        self._samples = list(self._iter_files())
+        self._samples = list(self._iter_files_bulk())
 
     def preload_into_memory(self, thread_num=None):
         self.load_into_memory()

@@ -17,7 +17,9 @@ def compile_cached(src, prefix, extra_flags=()):
     Raises on any build failure — callers decide their fallback."""
     here = os.path.dirname(os.path.abspath(src))
     with open(src, 'rb') as f:
-        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+        hasher = hashlib.sha256(f.read())
+    hasher.update(' '.join(extra_flags).encode())  # flags change → rebuild
+    tag = hasher.hexdigest()[:16]
     so = os.path.join(here, f'_{prefix}_{tag}.so')
     if not os.path.exists(so):
         tmp = f'{so}.{os.getpid()}.tmp'  # unique per process: no race

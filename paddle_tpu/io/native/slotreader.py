@@ -68,6 +68,9 @@ def parse_file(path, widths, int_mask):
     """
     if not available():
         return None
+    if not os.path.exists(path):
+        # match the Python parser's open() contract
+        raise FileNotFoundError(path)
     n = len(widths)
     w = (ctypes.c_int64 * n)(*[int(x) for x in widths])
     m = (ctypes.c_int32 * n)(*[1 if b else 0 for b in int_mask])

@@ -339,11 +339,13 @@ class TestNativeSlotReader:
         f = tmp_path / 'p0'
         f.write_text('\n'.join(
             f'{i} {i + 0.5} {i + 0.25}' for i in range(50)) + '\n')
-        ds = QueueDataset()
+        from paddle_tpu.distributed import InMemoryDataset
+        ds = InMemoryDataset()
         ds.init(batch_size=2, use_var=[
             InputSpec([None, 1], 'int64', 'label'),
             InputSpec([None, 2], 'float32', 'dense')])
         ds.set_filelist([str(f)])
+        ds.load_into_memory()   # the bulk native path
         rows = list(ds)
         assert calls, 'native parser was not invoked'
         assert len(rows) == 50
@@ -353,18 +355,40 @@ class TestNativeSlotReader:
 
     def test_int32_slots_use_python_parser(self, tmp_path):
         # native columns are int64/float32 only; an int32 slot must
-        # keep its declared dtype via the Python path
-        from paddle_tpu.distributed import QueueDataset
+        # keep its declared dtype via the Python path (bulk included)
+        from paddle_tpu.distributed import InMemoryDataset
         from paddle_tpu.static import InputSpec
         f = tmp_path / 'p1'
         f.write_text('7 0.5\n')
-        ds = QueueDataset()
+        ds = InMemoryDataset()
         ds.init(batch_size=1, use_var=[
             InputSpec([None, 1], 'int32', 'label'),
             InputSpec([None, 1], 'float32', 'dense')])
         ds.set_filelist([str(f)])
+        ds.load_into_memory()
         lab, den = next(iter(ds))
         assert lab.dtype == np.int32
+
+    def test_queue_dataset_streams_python_path(self, tmp_path,
+                                               monkeypatch):
+        # QueueDataset must keep constant-memory streaming: the bulk
+        # native parser is NOT consulted on its iteration path
+        from paddle_tpu.io.native import slotreader
+        from paddle_tpu.distributed import QueueDataset
+        from paddle_tpu.static import InputSpec
+        calls = []
+        monkeypatch.setattr(
+            slotreader, 'parse_file',
+            lambda *a, **k: calls.append(a) or None)
+        f = tmp_path / 'p3'
+        f.write_text('7 0.5\n8 1.5\n')
+        ds = QueueDataset()
+        ds.init(batch_size=1, use_var=[
+            InputSpec([None, 1], 'int64', 'label'),
+            InputSpec([None, 1], 'float32', 'dense')])
+        ds.set_filelist([str(f)])
+        rows = list(ds)
+        assert len(rows) == 2 and not calls
 
     def test_native_rejects_float_in_int_slot(self, tmp_path):
         from paddle_tpu.io.native import slotreader
