@@ -300,7 +300,6 @@ def _run_isolated(name, smoke, timeout_s):
     accelerator tunnel (or a pathological compile) in one config must
     not take down the whole artifact."""
     import subprocess
-    import sys
     cmd = [sys.executable, os.path.abspath(__file__), '--config', name,
            '--single-json']
     if smoke:
@@ -315,7 +314,7 @@ def _run_isolated(name, smoke, timeout_s):
     for line in reversed(proc.stdout.strip().splitlines()):
         try:
             parsed = json.loads(line)
-        except (json.JSONDecodeError, ValueError):
+        except ValueError:
             continue
         if isinstance(parsed, dict):   # stray numeric lines don't count
             return parsed

@@ -73,15 +73,54 @@ class DatasetBase:
                 (s.width,) if s.width > 1 else (1,)))
         return tuple(out) if len(out) > 1 else out[0]
 
+    _CHUNK = 1 << 20   # streaming native-parse granularity (1 MB)
+
     def _iter_files(self):
-        """Streaming line-by-line parse: constant memory, used by
-        QueueDataset (matching the reference's streaming pipe readers)."""
+        """Streaming parse with BOUNDED memory, used by QueueDataset
+        (matching the reference's streaming pipe readers): reads ~1 MB
+        chunks of complete lines and hands each to the C++ parser
+        (io/native/slotreader.sr_parse_buf); pure-Python line parse
+        without a compiler or for non-{int64,float32} slot dtypes."""
+        from ..io.native import slotreader
+        native_ok = self._slots and slotreader.available() and all(
+            s.dtype == np.int64 or s.dtype == np.float32
+            for s in self._slots)
+        widths = [s.width for s in self._slots]
+        ints = [np.issubdtype(s.dtype, np.integer) for s in self._slots]
         for path in self._filelist:
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if line:
-                        yield self._parse_line(line)
+            if not native_ok:
+                with open(path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if line:
+                            yield self._parse_line(line)
+                continue
+            with open(path, 'rb') as f:
+                carry = b''
+                while True:
+                    chunk = f.read(self._CHUNK)
+                    if not chunk:
+                        break
+                    chunk = carry + chunk
+                    cut = chunk.rfind(b'\n')
+                    if cut < 0:           # no complete line yet
+                        carry = chunk
+                        continue
+                    carry = chunk[cut + 1:]
+                    cols = slotreader.parse_bytes(
+                        chunk[:cut + 1], widths, ints, origin=path)
+                    yield from self._rows_of(cols)
+                if carry.strip():
+                    cols = slotreader.parse_bytes(carry, widths, ints,
+                                                  origin=path)
+                    yield from self._rows_of(cols)
+
+    @staticmethod
+    def _rows_of(cols):
+        n = cols[0].shape[0] if cols else 0
+        for r in range(n):
+            row = tuple(c[r] for c in cols)
+            yield row if len(row) > 1 else row[0]
 
     def _iter_files_bulk(self):
         """Whole-file parse via the C++ slot parser (io/native/
@@ -104,10 +143,7 @@ class DatasetBase:
                     [np.issubdtype(s.dtype, np.integer)
                      for s in self._slots])
             if cols is not None:
-                n = cols[0].shape[0] if cols else 0
-                for r in range(n):
-                    row = tuple(c[r] for c in cols)
-                    yield row if len(row) > 1 else row[0]
+                yield from self._rows_of(cols)
                 continue
             with open(path) as f:
                 for line in f:

@@ -36,21 +36,31 @@ struct Parsed {
 
 }  // namespace
 
+namespace {
+
+Parsed* make_parsed(const int64_t* widths, const int32_t* is_int,
+                    int32_t n_slots) {
+  auto* p = new Parsed();
+  p->slots.resize(n_slots);
+  for (int32_t k = 0; k < n_slots; ++k) {
+    p->slots[k].width = widths[k];
+    p->slots[k].is_int = is_int[k];
+  }
+  return p;
+}
+
+void parse_buffer(Parsed* p, const char* data, size_t len,
+                  int32_t n_slots);
+
+}  // namespace
+
 extern "C" {
 
 // Parse `path`; widths[k] values per slot k per line; is_int[k] selects
 // the int64 column.  Returns an opaque handle (never null).
 void* sr_parse(const char* path, const int64_t* widths,
                const int32_t* is_int, int32_t n_slots) {
-  auto* p = new Parsed();
-  p->slots.resize(n_slots);
-  int64_t line_vals = 0;
-  for (int32_t k = 0; k < n_slots; ++k) {
-    p->slots[k].width = widths[k];
-    p->slots[k].is_int = is_int[k];
-    line_vals += widths[k];
-  }
-
+  Parsed* p = make_parsed(widths, is_int, n_slots);
   FILE* f = std::fopen(path, "rb");
   if (!f) {
     p->error = std::string("cannot open ") + path;
@@ -62,15 +72,32 @@ void* sr_parse(const char* path, const int64_t* widths,
   std::string buf(static_cast<size_t>(sz), '\0');
   size_t got = std::fread(buf.data(), 1, static_cast<size_t>(sz), f);
   std::fclose(f);
-  buf.resize(got);
+  parse_buffer(p, buf.data(), got, n_slots);
+  return p;
+}
 
+// Parse an in-memory chunk of complete lines (the streaming
+// QueueDataset path: bounded chunks, native speed).
+void* sr_parse_buf(const char* data, int64_t len, const int64_t* widths,
+                   const int32_t* is_int, int32_t n_slots) {
+  Parsed* p = make_parsed(widths, is_int, n_slots);
+  parse_buffer(p, data, static_cast<size_t>(len), n_slots);
+  return p;
+}
+
+}  // extern "C"
+
+namespace {
+
+void parse_buffer(Parsed* p, const char* data, size_t len,
+                  int32_t n_slots) {
   // LINE-based parse matching the Python fallback's contract exactly:
   // each non-blank line is one sample; a line with too few tokens or a
   // token that is not fully numeric ('3.7' in an int slot) is an
   // ERROR, while extra trailing tokens are dropped (the Python parser
   // slices the first sum(widths) tokens).
-  const char* s = buf.c_str();
-  const char* end = s + buf.size();
+  const char* s = data;
+  const char* end = s + len;
   int64_t lineno = 0;
   while (s < end) {
     const char* nl = static_cast<const char*>(
@@ -93,7 +120,7 @@ void* sr_parse(const char* path, const int64_t* widths,
           p->error = "line " + std::to_string(lineno) +
                      ": too few values (slot " + std::to_string(k) +
                      ")";
-          return p;
+          return;
         }
         const char* tok_end = q;
         while (tok_end < line_end && *tok_end != ' ' &&
@@ -106,7 +133,7 @@ void* sr_parse(const char* path, const int64_t* widths,
             p->error = "line " + std::to_string(lineno) +
                        ": bad int token '" +
                        std::string(q, tok_end) + "'";
-            return p;
+            return;
           }
           col.i.push_back(static_cast<int64_t>(val));
         } else {
@@ -115,7 +142,7 @@ void* sr_parse(const char* path, const int64_t* widths,
             p->error = "line " + std::to_string(lineno) +
                        ": bad float token '" +
                        std::string(q, tok_end) + "'";
-            return p;
+            return;
           }
           col.f.push_back(val);
         }
@@ -125,8 +152,11 @@ void* sr_parse(const char* path, const int64_t* widths,
     p->n_samples += 1;
     s = line_end + 1;
   }
-  return p;
 }
+
+}  // namespace
+
+extern "C" {
 
 int64_t sr_count(void* h) { return static_cast<Parsed*>(h)->n_samples; }
 

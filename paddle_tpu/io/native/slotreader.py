@@ -21,11 +21,16 @@ _lib = None
 _lib_err = None
 _lock = threading.Lock()
 
-__all__ = ['available', 'parse_file']
+__all__ = ['available', 'parse_file', 'parse_bytes']
 
 
 def _build():
     lib = compile_cached(_SRC, 'slotreader')
+    lib.sr_parse_buf.restype = ctypes.c_void_p
+    lib.sr_parse_buf.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                 ctypes.POINTER(ctypes.c_int64),
+                                 ctypes.POINTER(ctypes.c_int32),
+                                 ctypes.c_int32]
     lib.sr_parse.restype = ctypes.c_void_p
     lib.sr_parse.argtypes = [ctypes.c_char_p,
                              ctypes.POINTER(ctypes.c_int64),
@@ -75,6 +80,23 @@ def parse_file(path, widths, int_mask):
     w = (ctypes.c_int64 * n)(*[int(x) for x in widths])
     m = (ctypes.c_int32 * n)(*[1 if b else 0 for b in int_mask])
     h = _lib.sr_parse(path.encode(), w, m, n)
+    return _collect(h, path, widths, int_mask)
+
+
+def parse_bytes(data, widths, int_mask, origin='<buffer>'):
+    """Parse an in-memory chunk of complete lines (the streaming
+    bounded-chunk path).  Same return/raise contract as parse_file."""
+    if not available():
+        return None
+    n = len(widths)
+    w = (ctypes.c_int64 * n)(*[int(x) for x in widths])
+    m = (ctypes.c_int32 * n)(*[1 if b else 0 for b in int_mask])
+    h = _lib.sr_parse_buf(data, len(data), w, m, n)
+    return _collect(h, origin, widths, int_mask)
+
+
+def _collect(h, path, widths, int_mask):
+    n = len(widths)
     try:
         buf = ctypes.create_string_buffer(512)
         elen = _lib.sr_error(h, buf, 512)

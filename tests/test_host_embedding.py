@@ -369,26 +369,37 @@ class TestNativeSlotReader:
         lab, den = next(iter(ds))
         assert lab.dtype == np.int32
 
-    def test_queue_dataset_streams_python_path(self, tmp_path,
-                                               monkeypatch):
-        # QueueDataset must keep constant-memory streaming: the bulk
-        # native parser is NOT consulted on its iteration path
+    def test_queue_dataset_streams_bounded_chunks(self, tmp_path,
+                                                  monkeypatch):
+        # QueueDataset streams through BOUNDED native chunks
+        # (sr_parse_buf), never the whole-file parse_file path
         from paddle_tpu.io.native import slotreader
-        from paddle_tpu.distributed import QueueDataset
+        from paddle_tpu.distributed import QueueDataset, dataset as dmod
         from paddle_tpu.static import InputSpec
-        calls = []
+        if not slotreader.available():
+            pytest.skip('no compiler')
+        file_calls, buf_calls = [], []
+        real_pb = slotreader.parse_bytes
         monkeypatch.setattr(
             slotreader, 'parse_file',
-            lambda *a, **k: calls.append(a) or None)
+            lambda *a, **k: file_calls.append(a) or None)
+        monkeypatch.setattr(
+            slotreader, 'parse_bytes',
+            lambda *a, **k: buf_calls.append(a) or real_pb(*a, **k))
+        monkeypatch.setattr(dmod.DatasetBase, '_CHUNK', 32)  # tiny
         f = tmp_path / 'p3'
-        f.write_text('7 0.5\n8 1.5\n')
+        f.write_text('\n'.join(f'{i} {i + 0.5}' for i in range(40))
+                     + '\n')
         ds = QueueDataset()
         ds.init(batch_size=1, use_var=[
             InputSpec([None, 1], 'int64', 'label'),
             InputSpec([None, 1], 'float32', 'dense')])
         ds.set_filelist([str(f)])
         rows = list(ds)
-        assert len(rows) == 2 and not calls
+        assert len(rows) == 40
+        np.testing.assert_array_equal(rows[17][0], [17])
+        assert not file_calls          # whole-file path never used
+        assert len(buf_calls) > 1      # genuinely chunked
 
     def test_native_rejects_float_in_int_slot(self, tmp_path):
         from paddle_tpu.io.native import slotreader

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Transformer step profiler (BERT/GPT) — the transformer counterpart
+of tools/profile_resnet.py.
+
+Measures the EXACT bench.py train step with amortized in-graph chains
+where useful, because single dispatches through the dev tunnel carry
+~100 ms round-trip (PERF.md) and cannot time kernels.
+
+Usage (real chip):
+    python tools/profile_transformer.py --model gpt   [--batch 8 --seq 1024]
+    python tools/profile_transformer.py --model bert  [--batch 64 --seq 128]
+
+Prints: cost_analysis flops/bytes, measured ms/step (best of 3),
+TFLOPS-equivalent (6*N*tokens/s), and the top optimized-HLO op census.
+"""
+import argparse
+import collections
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build(model_name, batch, seq):
+    import paddle_tpu as paddle
+    from paddle_tpu.parallel import ParallelTrainer
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed import env as dist_env
+
+    dist_env.set_mesh(None)
+    paddle.seed(0)
+    if model_name == 'gpt':
+        from paddle_tpu.models.gpt import gpt_small
+        model = gpt_small(max_seq_len=seq, dropout=0.0)
+        n_params = 124e6
+    else:
+        from paddle_tpu.models.bert import bert_base
+        model = bert_base(max_seq_len=seq, dropout=0.0)
+        n_params = 110e6
+    opt = paddle.optimizer.AdamW(learning_rate=3e-4,
+                                 parameters=model.parameters())
+    st = fleet.DistributedStrategy()
+    st.amp = True
+    st.amp_configs['use_pure_fp16'] = True
+    tr = ParallelTrainer(model, opt, lambda o, y: model.loss(o, y),
+                         strategy=st)
+    rs = np.random.RandomState(0)
+    V = model.config.vocab_size
+    ids = rs.randint(0, V, size=(batch, seq)).astype('int64')
+    if model_name == 'gpt':
+        lbl = ids
+    else:
+        lbl = np.where(rs.rand(batch, seq) < 0.15,
+                       rs.randint(0, V, size=(batch, seq)), -100) \
+            .astype('int64')
+    return tr, ids, lbl, n_params
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--model', choices=('gpt', 'bert'), default='gpt')
+    ap.add_argument('--batch', type=int, default=None)
+    ap.add_argument('--seq', type=int, default=None)
+    ap.add_argument('--iters', type=int, default=15)
+    args = ap.parse_args()
+    batch = args.batch or (8 if args.model == 'gpt' else 64)
+    seq = args.seq or (1024 if args.model == 'gpt' else 128)
+
+    import jax
+    print(f'device: {jax.devices()[0]}', flush=True)
+    tr, ids, lbl, n_params = build(args.model, batch, seq)
+    # device-resident inputs, exactly like bench.py: measure compute,
+    # not the host link
+    ids = jax.device_put(ids)
+    lbl = jax.device_put(lbl)
+
+    t0 = time.time()
+    loss = None
+    for _ in range(3):
+        loss = tr.step(ids, lbl)
+    float(np.asarray(loss))
+    print(f'warmup (3 steps incl. compile): {time.time() - t0:.0f}s '
+          f'loss={float(np.asarray(loss)):.4f}', flush=True)
+
+    best = None
+    for _ in range(3):
+        t0 = time.time()
+        for _ in range(args.iters):
+            loss = tr.step(ids, lbl)
+        float(np.asarray(loss))
+        dt = (time.time() - t0) / args.iters
+        best = dt if best is None or dt < best else best
+    toks = batch * seq / best
+    print(f'{args.model} b={batch} T={seq}: {best * 1000:.1f} ms/step '
+          f'{toks:.0f} tokens/s '
+          f'(~{6 * n_params * toks / 1e12:.1f} TFLOPS-eq, '
+          f'{6 * n_params * toks / 1e12 / 197 * 100:.0f}% of v5e peak)',
+          flush=True)
+
+    # cost analysis LAST: lower().compile() goes through the AOT path
+    # and does NOT reuse jit's in-memory executable — it recompiles.
+    # Running it after the timing loop keeps the chip idle while
+    # measuring (PERF.md methodology rule 2)
+    compiled = getattr(tr, '_compiled', None)
+    analysis = None
+    if compiled is not None and hasattr(compiled, 'lower'):
+        try:
+            import jax.numpy as jnp
+            from paddle_tpu.core import rng as rng_mod
+            lowered = compiled.lower(
+                tr.params, tr.buffers, tr.opt_state,
+                jnp.asarray(1), rng_mod.next_key(),
+                *(jnp.asarray(a) for a in (ids, lbl)))
+            analysis = lowered.compile()
+            ca = analysis.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            print(f"cost: {ca.get('flops', 0):.3e} flops/step, "
+                  f"{ca.get('bytes accessed', 0):.3e} bytes/step",
+                  flush=True)
+        except Exception as e:
+            print(f'cost_analysis unavailable: {e!r}', flush=True)
+
+    # optimized-HLO op census (where do the ops go)
+    if analysis is not None:
+        try:
+            import re
+            hlo = analysis.as_text()
+            ops = collections.Counter(
+                m.group(1) for m in re.finditer(
+                    r'^\s*(?:ROOT )?\S+ = \S+ (\w+)\(', hlo,
+                    re.MULTILINE))
+            print('top HLO ops:', ops.most_common(12), flush=True)
+        except Exception as e:
+            print(f'hlo census unavailable: {e!r}', flush=True)
+
+
+if __name__ == '__main__':
+    main()
