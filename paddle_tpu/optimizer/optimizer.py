@@ -22,6 +22,10 @@ class Optimizer:
                  multi_precision=False):
         self._parameter_list = list(parameters) if parameters is not None \
             else None
+        # static-mode minimize() re-resolves _parameter_list; keep the
+        # constructor's explicit choice separate so precedence holds
+        self._ctor_parameter_list = list(parameters) \
+            if parameters is not None else None
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
         if weight_decay is None:
@@ -102,7 +106,21 @@ class Optimizer:
         from ..static.program import Variable
         if isinstance(loss, Variable):
             # static mode: append the training section to the Program;
-            # Executor.run compiles grad+update into the same XLA module
+            # Executor.run compiles grad+update into the same XLA module.
+            # Parameter selection per the reference's precedence:
+            # minimize(parameters=...) > constructor list > every
+            # trainable param the Program read — re-resolved on EVERY
+            # minimize so layers added after an earlier call train too;
+            # no_grad_set always excludes.
+            ng = {id(p) for p in (no_grad_set or [])}
+            if parameters is not None:
+                chosen = [p for p in parameters if id(p) not in ng]
+            elif self._ctor_parameter_list is not None:
+                chosen = [p for p in self._ctor_parameter_list
+                          if id(p) not in ng]
+            else:
+                chosen = loss.program.trainable_parameters(no_grad_set)
+            self._parameter_list = list(chosen)
             loss.program.train_section = (loss, self)
             loss.program.bump()
             return None, []

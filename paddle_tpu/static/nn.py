@@ -54,7 +54,9 @@ def _apply_act(x, act):
 
 
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
-       activation=None, name=None):
+       activation=None, act=None, name=None):
+    # `act` is the fluid-1.x spelling of `activation`
+    activation = activation if activation is not None else act
     shape = x.shape
     in_dim = int(np.prod(shape[num_flatten_dims:]))
     layer = _nn.Linear(in_dim, size, weight_attr=weight_attr,

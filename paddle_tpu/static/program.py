@@ -181,6 +181,14 @@ class Program:
         Program.all_parameters walks the blocks' var list)."""
         return list(self._params.values())
 
+    def trainable_parameters(self, no_grad_set=None):
+        """all_parameters minus stop_gradient and no_grad_set — the
+        selection both append_backward and optimizer.minimize use."""
+        ng = {id(p) for p in (no_grad_set or [])}
+        return [p for p in self._params.values()
+                if not getattr(p, 'stop_gradient', False)
+                and id(p) not in ng]
+
     def bump(self):
         self._version += 1
         self._cache.clear()
@@ -521,9 +529,8 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     """Reference fluid/backward.py::append_backward — returns
     [(param, grad_variable)] for every trainable parameter the Program
     has read (no graph mutation needed; see gradients())."""
-    params = parameter_list if parameter_list is not None else [
-        p for p in loss.program.all_parameters()
-        if not getattr(p, 'stop_gradient', False)]
+    params = parameter_list if parameter_list is not None else \
+        loss.program.trainable_parameters(no_grad_set)
     grads = gradients([loss], params, no_grad_set=no_grad_set)
     return list(zip(params, grads))
 

@@ -566,3 +566,98 @@ class TestStaticNoGradSet:
             paddle.disable_static()
         np.testing.assert_allclose(g_cut, 0.0)
         np.testing.assert_allclose(g_full, 8.0 * xv)
+
+
+class TestStaticTraining:
+    """The whole static train section — forward + jax.grad backward +
+    optimizer update compiled as ONE module by Executor.run (reference:
+    Program + optimizer.minimize + Executor train loop)."""
+
+    def test_minimize_trains_regression(self):
+        from paddle_tpu import static
+        paddle.enable_static()
+        try:
+            paddle.seed(0)
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data('x', [None, 4])
+                y = static.data('y', [None, 1])
+                pred = static.nn.fc(x, 1)
+                loss = ((pred - y) * (pred - y)).mean()
+                opt = paddle.optimizer.SGD(learning_rate=0.1)
+                opt.minimize(loss)
+            exe = static.Executor()
+            rs = np.random.RandomState(0)
+            w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], 'float32')
+            X = rs.randn(64, 4).astype('float32')
+            Y = X @ w_true
+            losses = []
+            for _ in range(60):
+                lv, = exe.run(prog, feed={'x': X, 'y': Y},
+                              fetch_list=[loss])
+                losses.append(float(lv))
+            assert losses[-1] < losses[0] * 0.05, (losses[0], losses[-1])
+        finally:
+            paddle.disable_static()
+
+    def test_minimize_with_bn_updates_running_stats(self):
+        from paddle_tpu import static
+        paddle.enable_static()
+        try:
+            paddle.seed(0)
+            prog = static.Program()
+            with static.program_guard(prog):
+                img = static.data('img', [None, 2, 4, 4])
+                h = static.nn.conv2d(img, 4, 3, padding=1, act='relu')
+                h = static.nn.batch_norm(h)
+                out = static.nn.fc(h, 2)
+                lbl = static.data('lbl', [None, 1], dtype='int64')
+                from paddle_tpu.nn import functional as F
+                loss = F.cross_entropy(out, lbl).mean()
+                opt = paddle.optimizer.Adam(learning_rate=1e-2)
+                opt.minimize(loss)
+            exe = static.Executor()
+            rs = np.random.RandomState(0)
+            X = rs.randn(16, 2, 4, 4).astype('float32')
+            Yl = rs.randint(0, 2, size=(16, 1)).astype('int64')
+            l0 = None
+            for _ in range(15):
+                lv, = exe.run(prog, feed={'img': X, 'lbl': Yl},
+                              fetch_list=[loss])
+                l0 = l0 if l0 is not None else float(lv)
+            assert float(lv) < l0, (l0, float(lv))
+            # running statistics must have moved off their init
+            stats = [t for t in prog.all_parameters()
+                     if getattr(t, 'stop_gradient', False)
+                     and t.value.ndim == 1 and t.value.shape[0] == 4]
+            moved = [t for t in stats
+                     if not (np.allclose(np.asarray(t.value), 0.0)
+                             or np.allclose(np.asarray(t.value), 1.0))]
+            assert moved, 'BN running stats never updated'
+        finally:
+            paddle.disable_static()
+
+    def test_minimize_no_grad_set_freezes_param(self):
+        from paddle_tpu import static
+        paddle.enable_static()
+        try:
+            paddle.seed(0)
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data('x', [None, 2])
+                frozen = paddle.to_tensor(np.ones((2, 1), 'float32'))
+                frozen.stop_gradient = False
+                free = paddle.to_tensor(np.zeros((2, 1), 'float32'))
+                free.stop_gradient = False
+                loss = ((x @ frozen + x @ free) ** 2).mean()
+                opt = paddle.optimizer.SGD(learning_rate=0.5)
+                opt.minimize(loss, no_grad_set=[frozen])
+            exe = static.Executor()
+            X = np.random.RandomState(0).randn(8, 2).astype('float32')
+            before = np.asarray(frozen.value).copy()
+            for _ in range(3):
+                exe.run(prog, feed={'x': X}, fetch_list=[loss])
+            np.testing.assert_allclose(np.asarray(frozen.value), before)
+            assert not np.allclose(np.asarray(free.value), 0.0)
+        finally:
+            paddle.disable_static()
