@@ -324,6 +324,29 @@ def _run_isolated(name, smoke, timeout_s):
             'error': f'no output (rc={proc.returncode})'}
 
 
+def _device_preflight(timeout_s=180):
+    """Run one tiny jitted op in a subprocess: True iff the device
+    stack (incl. a possibly-wedged dev tunnel) answers within
+    timeout_s.  Executed in a child so a hang cannot wedge US."""
+    import subprocess
+    code = ('import jax, jax.numpy as jnp, numpy as np;'
+            'v = float(np.asarray(jax.jit(lambda a: a.sum())'
+            '(jnp.ones((8, 8)))));'
+            'print("PREFLIGHT_OK", v)')
+    try:
+        proc = subprocess.run([sys.executable, '-c', code],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        log(f'device preflight timed out after {timeout_s}s')
+        return False
+    ok = 'PREFLIGHT_OK' in proc.stdout
+    if not ok:
+        log(f'device preflight failed (rc={proc.returncode}): '
+            f'{proc.stderr[-300:]}')
+    return ok
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument('--smoke', action='store_true',
@@ -345,6 +368,14 @@ def main():
 
     names = list(CONFIGS) if args.config == 'all' else [args.config]
     results = {}
+    preflight_s = min(180, args.timeout * len(names))
+    if args.config == 'all' and not _device_preflight(preflight_s):
+        # dead accelerator tunnel: emit the artifact immediately with
+        # errors instead of hanging 5 subprocesses to their timeouts
+        results = {n: {'value': None, 'unit': UNITS[n],
+                       'error': 'device preflight failed (accelerator '
+                                'runtime unreachable)'} for n in names}
+        names = []
     for name in names:
         if args.config == 'all':
             results[name] = _run_isolated(name, args.smoke, args.timeout)
