@@ -324,7 +324,7 @@ def _run_isolated(name, smoke, timeout_s):
             'error': f'no output (rc={proc.returncode})'}
 
 
-def _device_preflight(timeout_s=180):
+def _device_preflight_once(timeout_s):
     """Run one tiny jitted op in a subprocess: True iff the device
     stack (incl. a possibly-wedged dev tunnel) answers within
     timeout_s.  Executed in a child so a hang cannot wedge US."""
@@ -338,13 +338,37 @@ def _device_preflight(timeout_s=180):
                               capture_output=True, text=True,
                               timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        log(f'device preflight timed out after {timeout_s}s')
+        log(f'device preflight attempt timed out after {timeout_s}s')
         return False
     ok = 'PREFLIGHT_OK' in proc.stdout
     if not ok:
         log(f'device preflight failed (rc={proc.returncode}): '
             f'{proc.stderr[-300:]}')
     return ok
+
+
+def _device_preflight(total_budget_s=600):
+    """Preflight with RETRY + BACKOFF: the dev tunnel recovers from
+    transient wedges in minutes (round-2 lesson: a single 180s attempt
+    nulled the whole artifact).  Attempts at ~0/1/2/4-minute marks
+    within total_budget_s, then give up fast with the error artifact."""
+    deadline = time.time() + total_budget_s
+    waits = [0, 60, 120, 240]
+    for i, w in enumerate(waits):
+        remaining = deadline - time.time()
+        if remaining <= 10:
+            break
+        if w:
+            log(f'preflight retry {i}/{len(waits) - 1}: waiting {w}s '
+                'for the tunnel to recover '
+                f'({remaining:.0f}s of budget left)')
+            time.sleep(min(w, max(0, remaining - 60)))
+        attempt_s = min(120, max(30, deadline - time.time()))
+        if _device_preflight_once(attempt_s):
+            if i:
+                log('preflight recovered after retry')
+            return True
+    return False
 
 
 def main():
@@ -368,7 +392,7 @@ def main():
 
     names = list(CONFIGS) if args.config == 'all' else [args.config]
     results = {}
-    preflight_s = min(180, args.timeout * len(names))
+    preflight_s = min(600, args.timeout * len(names))
     if args.config == 'all' and not _device_preflight(preflight_s):
         # dead accelerator tunnel: emit the artifact immediately with
         # errors instead of hanging 5 subprocesses to their timeouts

@@ -62,6 +62,8 @@ from . import sysconfig  # noqa: F401
 from . import autograd  # noqa: F401
 from . import fluid  # noqa: F401
 from . import hub  # noqa: F401
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
 from . import inference  # noqa: F401
 from . import onnx  # noqa: F401
 from . import incubate  # noqa: F401

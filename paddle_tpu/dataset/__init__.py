@@ -1,0 +1,26 @@
+"""paddle.dataset — the fluid-era reader-creator data stack.
+
+Reference analogue: /root/reference/python/paddle/dataset/__init__.py.
+Each module exposes train()/test() reader creators yielding plain
+numpy/python samples; compose them with paddle.reader decorators and
+paddle.batch, then feed DataLoader/executor — the classic 1.x input
+pipeline that the fluid compat namespace's users expect.  The modern
+map-style equivalents live in paddle.vision.datasets / paddle.text.
+"""
+from . import common      # noqa: F401
+from . import mnist       # noqa: F401
+from . import cifar       # noqa: F401
+from . import uci_housing # noqa: F401
+from . import imdb        # noqa: F401
+from . import imikolov    # noqa: F401
+from . import movielens   # noqa: F401
+from . import conll05     # noqa: F401
+from . import wmt14       # noqa: F401
+from . import wmt16       # noqa: F401
+from . import flowers     # noqa: F401
+from . import voc2012     # noqa: F401
+from . import image       # noqa: F401
+
+__all__ = ['common', 'mnist', 'cifar', 'uci_housing', 'imdb', 'imikolov',
+           'movielens', 'conll05', 'wmt14', 'wmt16', 'flowers',
+           'voc2012', 'image']

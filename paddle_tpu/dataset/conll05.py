@@ -1,0 +1,41 @@
+"""paddle.dataset.conll05 — SRL sequence readers.
+
+Reference analogue: /root/reference/python/paddle/dataset/conll05.py
+(test:348, get_dict:311, get_embedding:340).  Samples are the 9-field
+SRL tuples (word_ids, 5 ctx windows, predicate, mark, label_ids).
+"""
+import numpy as np
+
+from ..text.datasets import Conll05st
+
+__all__ = ['test', 'get_dict', 'get_embedding']
+
+
+def get_dict():
+    """-> (word_dict, verb_dict, label_dict) (reference conll05.py:311)."""
+    word_dict = {'w%d' % i: i for i in range(Conll05st.WORD_VOCAB)}
+    verb_dict = {'v%d' % i: i for i in range(Conll05st.PRED_VOCAB)}
+    label_dict = {'l%d' % i: i for i in range(Conll05st.LABEL_NUM)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """Reference conll05.py:340 downloads pretrained emb; deterministic
+    synthetic matrix here."""
+    rng = np.random.RandomState(77)
+    return rng.randn(Conll05st.WORD_VOCAB, 32).astype(np.float32)
+
+
+def test():
+    """The reference ships only a test split publicly (conll05.py:348)."""
+    ds = Conll05st(mode='test')
+
+    def reader():
+        for i in range(len(ds)):
+            yield ds[i]
+
+    return reader
+
+
+def fetch():
+    pass

@@ -1,0 +1,56 @@
+"""paddle.dataset.imdb — fluid-era IMDB sentiment readers.
+
+Reference analogue: /root/reference/python/paddle/dataset/imdb.py
+(build_dict:60, reader_creator:85, train:108, test:130, word_dict:152).
+Samples are (word-id list, 0/1 label).
+"""
+import numpy as np
+
+from ..text.datasets import Imdb
+
+__all__ = ['build_dict', 'train', 'test', 'word_dict']
+
+_CACHE = {}
+
+
+def _ds(mode):
+    if mode not in _CACHE:
+        _CACHE[mode] = Imdb(mode=mode)
+    return _CACHE[mode]
+
+
+def word_dict():
+    """-> {word-or-id: index} (reference imdb.py:152)."""
+    return dict(_ds('train').word_idx)
+
+
+def build_dict(pattern=None, cutoff=150):
+    """Reference imdb.py:60 walks the tarball; here the loader already
+    built (or synthesized) the vocabulary."""
+    return word_dict()
+
+
+def _creator(mode, word_idx):
+    ds = _ds(mode)
+
+    def reader():
+        for i in range(len(ds)):
+            doc, label = ds[i]
+            yield [int(w) for w in np.asarray(doc).tolist()], \
+                int(np.asarray(label).reshape(()))
+
+    return reader
+
+
+def train(word_idx):
+    """(ids, 0/1) train reader (reference imdb.py:108)."""
+    return _creator('train', word_idx)
+
+
+def test(word_idx):
+    """(ids, 0/1) test reader (reference imdb.py:130)."""
+    return _creator('test', word_idx)
+
+
+def fetch():
+    pass

@@ -1,0 +1,64 @@
+"""paddle.dataset.imikolov — PTB language-model readers.
+
+Reference analogue: /root/reference/python/paddle/dataset/imikolov.py
+(build_dict:55, reader_creator:85, train:120, test:145).  NGRAM mode
+yields n-tuples of word ids; SEQ mode yields (src_seq, trg_seq) with
+<s>/<e> markers.
+"""
+import numpy as np
+
+from ..text.datasets import Imikolov
+
+__all__ = ['build_dict', 'train', 'test', 'DataType']
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+def build_dict(min_word_freq=50):
+    """-> {token: id} over the corpus vocabulary (reference
+    imikolov.py:55)."""
+    n = Imikolov(data_type='SEQ', mode='train',
+                 min_word_freq=min_word_freq).vocab_size
+    d = {'w%d' % i: i for i in range(n)}
+    d['<unk>'] = n
+    return d
+
+
+def _creator(mode, word_idx, n, data_type):
+    if data_type == DataType.NGRAM:
+        ds = Imikolov(data_type='NGRAM', window_size=n, mode=mode)
+
+        def reader():
+            for i in range(len(ds)):
+                yield tuple(int(w) for w in ds[i])
+    elif data_type == DataType.SEQ:
+        ds = Imikolov(data_type='SEQ', mode=mode)
+
+        def reader():
+            for i in range(len(ds)):
+                sent = [int(w) for w in np.asarray(ds[i]).tolist()]
+                # reference wraps with <s>...</e> then emits
+                # (prefix, shifted) pairs
+                src = sent[:-1]
+                trg = sent[1:]
+                yield src, trg
+    else:
+        raise ValueError('Unknown data type')
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    """n-gram (or seq) train reader (reference imikolov.py:120)."""
+    return _creator('train', word_idx, n, data_type)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    """Validation-split reader (reference imikolov.py:145)."""
+    return _creator('test', word_idx, n, data_type)
+
+
+def fetch():
+    pass

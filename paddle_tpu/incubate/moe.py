@@ -81,7 +81,18 @@ class SwitchMoE(Layer):
         return max(1, int(math.ceil(
             S / self.num_experts * self.capacity_factor)))
 
-    def forward(self, x):
+    def forward(self, x, return_aux=False):
+        """Route x through the experts.
+
+        With ``return_aux=True`` returns ``(y, aux_loss)`` — the safe
+        way to consume the load-balance loss when the loss is computed
+        in a different jit trace than the forward (the cached
+        ``.aux_loss`` attribute is only valid within the SAME trace;
+        a tracer read from another trace is a leak error in JAX).
+        """
+        # drop any value from a previous trace before computing, so a
+        # stale tracer can never be read after this forward
+        self.aux_loss = None
         lead = x.shape[:-1]
         S = 1
         for d in lead:
@@ -140,6 +151,8 @@ class SwitchMoE(Layer):
         y, aux = apply(fn, wrap(x), self.gate_w, self.w1, self.b1,
                        self.w2, self.b2, op_name='switch_moe')
         self.aux_loss = aux
+        if return_aux:
+            return y, aux
         return y
 
     def extra_repr(self):

@@ -1,0 +1,198 @@
+"""paddle.incubate.optimizer — LookAhead and ModelAverage.
+
+Reference analogue:
+/root/reference/python/paddle/incubate/optimizer/lookahead.py:26 and
+modelaverage.py:27 (the C++ average_accumulates op).
+
+TPU-native: both are pure array recurrences over the parameter pytree —
+no per-op kernels.  They run eagerly here AND compose with the compiled
+paths: LookAhead exposes the same functional init()/apply_gradients()
+contract as core optimizers (the slow-weight interpolation folds into
+the one jitted train step); ModelAverage keeps its three-slot
+accumulator sums exactly like the reference kernel so the averaged
+window matches bit-for-bit semantics.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ['LookAhead', 'ModelAverage']
+
+
+class LookAhead(Optimizer):
+    r"""Lookahead (arXiv:1907.08610): keep slow weights; every k inner
+    steps, slow += alpha * (fast - slow) and fast <- slow (reference
+    lookahead.py:26).
+    """
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not isinstance(inner_optimizer, Optimizer):
+            raise TypeError('inner optimizer must be an Optimizer')
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError('alpha should be in [0, 1]')
+        if not (isinstance(k, int) and k > 0):
+            raise ValueError('k should be a positive integer')
+        super().__init__(
+            learning_rate=alpha,
+            parameters=inner_optimizer._ctor_parameter_list)
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._slow = {}          # id(param) -> slow weight array
+
+    # -- eager ----------------------------------------------------------
+    def step(self):
+        self.inner_optimizer.step()
+        self._global_step += 1
+        if self._global_step % self.k:
+            return
+        for p in self.inner_optimizer._params:
+            if p.stop_gradient:
+                continue
+            slow = self._slow.get(id(p), None)
+            if slow is None:
+                # lazily seed the slow copy with the INITIAL fast value
+                # minus the updates already folded — first sync uses the
+                # current weights, like the reference's lazy slow var
+                slow = p.value
+                self._slow[id(p)] = slow
+                continue
+            slow = slow + self.alpha * (p.value - slow)
+            p.value = slow
+            self._slow[id(p)] = slow
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self.inner_optimizer._params]
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    # -- functional (compiled path) --------------------------------------
+    def init(self, params):
+        return {'inner': self.inner_optimizer.init(params),
+                'slow': jax.tree_util.tree_map(lambda v: v, params)}
+
+    def apply_gradients(self, params, grads, state, step, lr=None):
+        new_params, new_inner = self.inner_optimizer.apply_gradients(
+            params, grads, state['inner'], step, lr=lr)
+        sync = (step % self.k) == 0
+
+        def blend(fast, slow):
+            merged = slow + self.alpha * (fast - slow)
+            return jnp.where(sync, merged, fast), \
+                jnp.where(sync, merged, slow)
+
+        pairs = jax.tree_util.tree_map(blend, new_params, state['slow'])
+        new_p = jax.tree_util.tree_map(
+            lambda pr: pr[0], pairs,
+            is_leaf=lambda x: isinstance(x, tuple))
+        new_slow = jax.tree_util.tree_map(
+            lambda pr: pr[1], pairs,
+            is_leaf=lambda x: isinstance(x, tuple))
+        return new_p, {'inner': new_inner, 'slow': new_slow}
+
+
+class ModelAverage(Optimizer):
+    r"""Maintain a running average of parameters over a trailing window
+    (reference modelaverage.py:27 / the average_accumulates kernel):
+
+        sum_1 += p each step; every 16384 updates sum_2 += sum_1,
+        sum_1 = 0; when num_accumulates >= max(min_average_window,
+        min(max_average_window, num_updates * average_window_rate)):
+        sum_3 = sum_1 + sum_2, sums reset, old_num = num, num = 0.
+
+    apply() swaps the averaged weights in (optionally restoring after),
+    restore() puts the trained weights back.
+    """
+
+    _SHIFT = 16384               # kMaxNumAccumulates in the reference op
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters)
+        self.average_window = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self._acc = {}           # id(p) -> dict of slots
+        self._saved = {}         # id(p) -> live weights during apply()
+
+    def _slots(self, p):
+        st = self._acc.get(id(p))
+        if st is None:
+            z = jnp.zeros_like(p.value)
+            st = {'sum_1': z, 'sum_2': z, 'sum_3': z,
+                  'num_accumulates': 0, 'old_num_accumulates': 0,
+                  'num_updates': 0}
+            self._acc[id(p)] = st
+        return st
+
+    def step(self):
+        """Accumulate the CURRENT weights (call after the inner
+        optimizer's step, like the reference's minimize pairing)."""
+        for p in self._params:
+            if p.stop_gradient:
+                continue
+            st = self._slots(p)
+            st['sum_1'] = st['sum_1'] + p.value
+            st['num_updates'] += 1
+            st['num_accumulates'] += 1
+            if st['num_updates'] % self._SHIFT == 0:
+                st['sum_2'] = st['sum_2'] + st['sum_1']
+                st['sum_1'] = jnp.zeros_like(st['sum_1'])
+            window = min(self.max_average_window,
+                         st['num_updates'] * self.average_window)
+            if st['num_accumulates'] >= self.min_average_window \
+                    and st['num_accumulates'] >= window:
+                st['sum_3'] = st['sum_1'] + st['sum_2']
+                st['sum_1'] = jnp.zeros_like(st['sum_1'])
+                st['sum_2'] = jnp.zeros_like(st['sum_2'])
+                st['old_num_accumulates'] = st['num_accumulates']
+                st['num_accumulates'] = 0
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+        return None, []
+
+    def _average(self, p):
+        st = self._slots(p)
+        total = st['num_accumulates'] + st['old_num_accumulates']
+        if total == 0:
+            return p.value
+        s = st['sum_1'] + st['sum_2'] + st['sum_3']
+        return (s / total).astype(p.value.dtype)
+
+    def apply(self, executor=None, need_restore=True):
+        """Context manager: parameters hold the averaged weights inside
+        the block (reference modelaverage.py apply)."""
+        outer = self
+
+        class _Ctx:
+            def __enter__(ctx):
+                for p in outer._params:
+                    outer._saved[id(p)] = p.value
+                    p.value = outer._average(p)
+                return ctx
+
+            def __exit__(ctx, *exc):
+                if need_restore:
+                    outer.restore()
+                return False
+
+        return _Ctx()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            saved = self._saved.pop(id(p), None)
+            if saved is not None:
+                p.value = saved

@@ -4,7 +4,17 @@ Reference analogue: /root/reference/python/paddle/nn/layer/transformer.py.
 TPU-native: attention is bf16-friendly einsum onto the MXU; on TPU the
 fused Pallas flash-attention kernel (paddle_tpu.ops.flash_attention) is
 used for long sequences via nn.functional.scaled_dot_product_attention.
+
+Incremental decoding (reference transformer.py:151 Cache/StaticCache,
+:270 gen_cache): `Cache` holds projected k/v of ALL previous positions
+[B, H, L_past, Dh] and each cached forward concatenates the new step's
+k/v — attention work per emitted token is O(L), not O(L^2).
+`StaticCache` holds the k/v computed ONCE over the encoder memory for
+cross attention.  This eager concat path mirrors the reference's; a
+jit-compiled decode loop instead wants static shapes — models/gpt.py
+shows the preallocated-buffer + `lax.dynamic_update_slice` variant.
 """
+import collections
 import math
 
 import numpy as np
@@ -34,6 +44,15 @@ def _convert_attn_mask(mask, dtype):
 
 
 class MultiHeadAttention(Layer):
+
+    #: projected k/v of previous positions for decoder SELF attention
+    #: in incremental decoding — grows by one step per cached forward
+    #: (reference transformer.py:151)
+    Cache = collections.namedtuple('Cache', ['k', 'v'])
+    #: k/v computed once over encoder memory for CROSS attention —
+    #: constant across decoding steps
+    StaticCache = collections.namedtuple('StaticCache', ['k', 'v'])
+
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None,
                  vdim=None, need_weights=False, weight_attr=None,
                  bias_attr=None):
@@ -50,26 +69,107 @@ class MultiHeadAttention(Layer):
         self.v_proj = Linear(vdim, embed_dim, weight_attr, bias_attr)
         self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
 
+    def _split_heads(self, t):
+        """[B, L, E] -> [B, H, L, Dh]."""
+        H, Dh = self.num_heads, self.head_dim
+
+        def fn(v):
+            B, L, _ = v.shape
+            return v.reshape(B, L, H, Dh).transpose(0, 2, 1, 3)
+        return apply(fn, t, op_name='split_heads')
+
+    def compute_kv(self, key, value):
+        """Project + split-heads keys/values -> ([B,H,L,Dh], [B,H,L,Dh]).
+        Exposed so callers can pre-compute a StaticCache over encoder
+        memory (reference transformer.py:239 compute_kv)."""
+        return (self._split_heads(self.k_proj(key)),
+                self._split_heads(self.v_proj(value)))
+
+    def gen_cache(self, key, value=None, type=None):
+        """Build a Cache/StaticCache for forward (reference
+        transformer.py:270).  `type=StaticCache`: k/v computed from
+        (key, value) now and reused every step.  `type=Cache`,
+        value=None: empty [B, H, 0, Dh] buffers to start incremental
+        decoding.  `type=Cache` with value: seed the incremental cache
+        with given k/v (UniLM-style prefix)."""
+        if type is None:
+            type = MultiHeadAttention.Cache
+        if type == MultiHeadAttention.StaticCache:
+            k, v = self.compute_kv(key, value)
+            return self.StaticCache(k, v)
+        if value is None:
+            from ...core.tensor import Tensor
+            kq = wrap(key)
+            B = kq.shape[0]
+            dt = kq.value.dtype if hasattr(kq, 'value') else jnp.float32
+            empty = jnp.zeros((B, self.num_heads, 0, self.head_dim), dt)
+            return self.Cache(Tensor(empty), Tensor(empty))
+        return self.Cache(key, value)
+
     def forward(self, query, key=None, value=None, attn_mask=None,
                 cache=None):
         key = query if key is None else key
         value = key if value is None else value
-        q = self.q_proj(query)
-        k = self.k_proj(key)
-        v = self.v_proj(value)
         H, Dh = self.num_heads, self.head_dim
         dropout = self.dropout if self.training else 0.0
-
         need_weights = self.need_weights
 
-        def attn(qv, kv, vv):
+        if cache is None:
+            # training/encoder fast path: one fused op, no head-split
+            # round trips
+            q = self.q_proj(query)
+            k = self.k_proj(key)
+            v = self.v_proj(value)
+
+            def attn(qv, kv, vv):
+                from ...core import rng
+                B, Lq, _ = qv.shape
+                Lk = kv.shape[1]
+                qh = qv.reshape(B, Lq, H, Dh).transpose(0, 2, 1, 3)
+                kh = kv.reshape(B, Lk, H, Dh).transpose(0, 2, 1, 3)
+                vh = vv.reshape(B, Lk, H, Dh).transpose(0, 2, 1, 3)
+                scores = jnp.einsum('bhqd,bhkd->bhqk', qh, kh) \
+                    / math.sqrt(Dh)
+                m = _convert_attn_mask(attn_mask, scores.dtype)
+                if m is not None:
+                    scores = scores + m
+                weights = jax.nn.softmax(scores, axis=-1)
+                p = weights
+                if dropout > 0:
+                    keep = jax.random.bernoulli(rng.next_key(),
+                                                1 - dropout, p.shape)
+                    p = jnp.where(keep, p / (1 - dropout), 0.0)
+                out = jnp.einsum('bhqk,bhkd->bhqd', p, vh)
+                out = out.transpose(0, 2, 1, 3).reshape(B, Lq, H * Dh)
+                if need_weights:
+                    return out, weights
+                return out
+
+            if need_weights:
+                ctx, weights = apply(attn, q, k, v,
+                                     op_name='multihead_attention')
+                return self.out_proj(ctx), weights
+            ctx = apply(attn, q, k, v, op_name='multihead_attention')
+            return self.out_proj(ctx)
+
+        # cached (incremental decode) path
+        qh = self._split_heads(self.q_proj(query))
+        if isinstance(cache, self.StaticCache):
+            kh, vh = cache.k, cache.v
+        else:
+            kh, vh = self.compute_kv(key, value)
+        if isinstance(cache, self.Cache):
+            # append this step's k/v behind all previous positions
+            kh = apply(lambda a, b: jnp.concatenate([a, b], axis=2),
+                       cache.k, kh, op_name='cache_concat')
+            vh = apply(lambda a, b: jnp.concatenate([a, b], axis=2),
+                       cache.v, vh, op_name='cache_concat')
+            cache = self.Cache(kh, vh)
+
+        def attn_h(qv, kv, vv):
             from ...core import rng
-            B, Lq, _ = qv.shape
-            Lk = kv.shape[1]
-            qh = qv.reshape(B, Lq, H, Dh).transpose(0, 2, 1, 3)
-            kh = kv.reshape(B, Lk, H, Dh).transpose(0, 2, 1, 3)
-            vh = vv.reshape(B, Lk, H, Dh).transpose(0, 2, 1, 3)
-            scores = jnp.einsum('bhqd,bhkd->bhqk', qh, kh) / math.sqrt(Dh)
+            B, _, Lq, _ = qv.shape
+            scores = jnp.einsum('bhqd,bhkd->bhqk', qv, kv) / math.sqrt(Dh)
             m = _convert_attn_mask(attn_mask, scores.dtype)
             if m is not None:
                 scores = scores + m
@@ -79,17 +179,19 @@ class MultiHeadAttention(Layer):
                 keep = jax.random.bernoulli(rng.next_key(), 1 - dropout,
                                             p.shape)
                 p = jnp.where(keep, p / (1 - dropout), 0.0)
-            out = jnp.einsum('bhqk,bhkd->bhqd', p, vh)
+            out = jnp.einsum('bhqk,bhkd->bhqd', p, vv)
             out = out.transpose(0, 2, 1, 3).reshape(B, Lq, H * Dh)
             if need_weights:
                 return out, weights
             return out
 
         if need_weights:
-            ctx, weights = apply(attn, q, k, v, op_name='multihead_attention')
-            return self.out_proj(ctx), weights
-        ctx = apply(attn, q, k, v, op_name='multihead_attention')
-        return self.out_proj(ctx)
+            ctx, weights = apply(attn_h, qh, kh, vh,
+                                 op_name='multihead_attention_cached')
+            return self.out_proj(ctx), weights, cache
+        ctx = apply(attn_h, qh, kh, vh,
+                    op_name='multihead_attention_cached')
+        return self.out_proj(ctx), cache
 
 
 class TransformerEncoderLayer(Layer):
@@ -118,7 +220,13 @@ class TransformerEncoderLayer(Layer):
         residual = src
         if self.normalize_before:
             src = self.norm1(src)
-        src = self.self_attn(src, src, src, src_mask)
+        if cache is None:
+            src = self.self_attn(src, src, src, src_mask)
+        else:
+            # UniLM-style incremental encoding (reference
+            # transformer.py:566)
+            src, incremental_cache = self.self_attn(src, src, src,
+                                                    src_mask, cache)
         src = residual + self.dropout1(src)
         if not self.normalize_before:
             src = self.norm1(src)
@@ -130,7 +238,12 @@ class TransformerEncoderLayer(Layer):
         src = residual + self.dropout2(src)
         if not self.normalize_before:
             src = self.norm2(src)
-        return src
+        return src if cache is None else (src, incremental_cache)
+
+    def gen_cache(self, src):
+        """-> MultiHeadAttention.Cache with empty [B, H, 0, Dh] buffers
+        (reference transformer.py:585)."""
+        return self.self_attn.gen_cache(src, type=self.self_attn.Cache)
 
 
 class TransformerEncoder(Layer):
@@ -145,11 +258,20 @@ class TransformerEncoder(Layer):
 
     def forward(self, src, src_mask=None, cache=None):
         out = src
-        for layer in self.layers:
-            out = layer(out, src_mask)
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if cache is None:
+                out = layer(out, src_mask)
+            else:
+                out, new_cache = layer(out, src_mask, cache=cache[i])
+                new_caches.append(new_cache)
         if self.norm is not None:
             out = self.norm(out)
-        return out
+        return out if cache is None else (out, new_caches)
+
+    def gen_cache(self, src):
+        """Per-layer incremental caches (reference transformer.py:695)."""
+        return [layer.gen_cache(src) for layer in self.layers]
 
 
 class TransformerDecoderLayer(Layer):
@@ -184,14 +306,22 @@ class TransformerDecoderLayer(Layer):
         residual = tgt
         if self.normalize_before:
             tgt = self.norm1(tgt)
-        tgt = self.self_attn(tgt, tgt, tgt, tgt_mask)
+        if cache is None:
+            tgt = self.self_attn(tgt, tgt, tgt, tgt_mask)
+        else:
+            tgt, incremental_cache = self.self_attn(tgt, tgt, tgt,
+                                                    tgt_mask, cache[0])
         tgt = residual + self.dropout1(tgt)
         if not self.normalize_before:
             tgt = self.norm1(tgt)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm2(tgt)
-        tgt = self.cross_attn(tgt, memory, memory, memory_mask)
+        if cache is None:
+            tgt = self.cross_attn(tgt, memory, memory, memory_mask)
+        else:
+            tgt, static_cache = self.cross_attn(tgt, memory, memory,
+                                                memory_mask, cache[1])
         tgt = residual + self.dropout2(tgt)
         if not self.normalize_before:
             tgt = self.norm2(tgt)
@@ -203,7 +333,18 @@ class TransformerDecoderLayer(Layer):
         tgt = residual + self.dropout3(tgt)
         if not self.normalize_before:
             tgt = self.norm3(tgt)
-        return tgt
+        return tgt if cache is None else (tgt, (incremental_cache,
+                                                static_cache))
+
+    def gen_cache(self, memory):
+        """-> (incremental_cache, static_cache): empty self-attn Cache +
+        cross-attn StaticCache over `memory` (reference
+        transformer.py:916)."""
+        incremental_cache = self.self_attn.gen_cache(
+            memory, type=self.self_attn.Cache)
+        static_cache = self.cross_attn.gen_cache(
+            memory, memory, type=self.cross_attn.StaticCache)
+        return incremental_cache, static_cache
 
 
 class TransformerDecoder(Layer):
@@ -219,11 +360,26 @@ class TransformerDecoder(Layer):
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
                 cache=None):
         out = tgt
-        for layer in self.layers:
-            out = layer(out, memory, tgt_mask, memory_mask)
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if cache is None:
+                out = layer(out, memory, tgt_mask, memory_mask)
+            else:
+                out, new_cache = layer(out, memory, tgt_mask, memory_mask,
+                                       cache=cache[i])
+                new_caches.append(new_cache)
         if self.norm is not None:
             out = self.norm(out)
-        return out
+        return out if cache is None else (out, new_caches)
+
+    def gen_cache(self, memory, do_zip=False):
+        """Per-layer (incremental, static) cache pairs; `do_zip=True`
+        transposes to ([incrementals...], [statics...]) (reference
+        transformer.py:1060)."""
+        cache = [layer.gen_cache(memory) for layer in self.layers]
+        if do_zip:
+            cache = list(zip(*cache))
+        return cache
 
 
 class Transformer(Layer):

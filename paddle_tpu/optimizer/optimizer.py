@@ -23,9 +23,12 @@ class Optimizer:
         self._parameter_list = list(parameters) if parameters is not None \
             else None
         # static-mode minimize() re-resolves _parameter_list; keep the
-        # constructor's explicit choice separate so precedence holds
-        self._ctor_parameter_list = list(parameters) \
-            if parameters is not None else None
+        # constructor's explicit choice separate so precedence holds.
+        # Built from the already-materialized list: `parameters` may be a
+        # generator (common paddle idiom), which a second list() would
+        # silently exhaust into [].
+        self._ctor_parameter_list = None if self._parameter_list is None \
+            else list(self._parameter_list)
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
         if weight_decay is None:

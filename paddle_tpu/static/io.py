@@ -32,8 +32,22 @@ def _named_params(program):
 def save(program, model_path, protocol=4):
     """paddle.static.save — persist every parameter the program read
     (reference static/io.py::save writes <path>.pdparams + .pdmodel)."""
-    state = {n: np.asarray(p.value)
-             for n, p in _named_params(program).items()}
+    named = _named_params(program)
+    real = [getattr(p, 'name', None) for p in named.values()]
+    # duplicate real names get order-dependent <name>_<i> suffixes from
+    # _param_names — they pair wrongly if the program is re-recorded in
+    # a different op order.  (Fully positional param_<i> keys are fine:
+    # they are stable for a fixed build script, and warning on every
+    # default-named model would just train users to ignore it.)
+    dupes = [n for n in set(real) if n and real.count(n) > 1]
+    if dupes:
+        import warnings
+        warnings.warn(
+            f'static.save: duplicated parameter name(s) {sorted(dupes)[:3]} '
+            'were disambiguated positionally; a program recorded in a '
+            'different op order will pair them wrongly on load',
+            stacklevel=2)
+    state = {n: np.asarray(p.value) for n, p in named.items()}
     os.makedirs(os.path.dirname(model_path) or '.', exist_ok=True)
     with open(model_path + '.pdparams', 'wb') as f:
         pickle.dump(state, f, protocol=protocol)
@@ -65,7 +79,13 @@ def set_program_state(program, state_dict):
                        f'{sorted(missing)[:5]}...')
     for n, arr in state_dict.items():
         p = named[n]
-        p.value = jnp.asarray(arr).astype(p.value.dtype)
+        a = jnp.asarray(arr)
+        if tuple(a.shape) != tuple(p.value.shape):
+            raise ValueError(
+                f'set_program_state: shape mismatch for {n!r}: saved '
+                f'{tuple(a.shape)} vs program param {tuple(p.value.shape)} '
+                '(op-recording order may differ from save time)')
+        p.value = a.astype(p.value.dtype)
 
 
 class _LoadedInferenceProgram:

@@ -294,6 +294,16 @@ def _record_op(fn, args, kwargs, op_name):
     def thunk(env):
         a = [resolve(s, env) for s in arg_slots]
         kw = {k: resolve(s, env) for k, s in kw_slots.items()}
+        # static AMP: the eager path runs dispatch's amp hook per op;
+        # recorded thunks must consult it too, at EVAL time — so the
+        # auto_cast state active while the Executor compiles (see
+        # static.amp.decorate) casts the whole program the same way
+        hook = dispatch._amp_hook
+        if hook is not None:
+            arrs = [v for v in a if hasattr(v, 'dtype')]
+            if arrs:
+                it = iter(hook(op_name or '', arrs))
+                a = [next(it) if hasattr(v, 'dtype') else v for v in a]
         out = fn(*a, **kw)
         return out
 

@@ -294,6 +294,34 @@ class TestDistributedSplit:
         with pytest.raises(ValueError):
             split(paddle.ones([2, 2]), (2, 2), 'conv')
 
+    def test_named_calls_reuse_one_layer(self):
+        """With name=, repeated eager calls must hit ONE weight (else a
+        training loop re-randomizes each step — r2 advisor finding)."""
+        from paddle_tpu.distributed import split
+        from paddle_tpu.distributed import env as dist_env
+        dist_env.set_mesh(None)
+        paddle.seed(3)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 8).astype('float32'))
+        a = split(x, (8, 6), 'linear', axis=1, name='reuse_probe')
+        b = split(x, (8, 6), 'linear', axis=1, name='reuse_probe')
+        np.testing.assert_array_equal(np.asarray(a.value),
+                                      np.asarray(b.value))
+
+    def test_unnamed_eager_calls_are_fresh(self):
+        """Without name=, each call builds fresh weights (reference
+        dygraph semantics) — two loop iterations at ONE source line must
+        NOT silently share a layer."""
+        from paddle_tpu.distributed import split
+        from paddle_tpu.distributed import env as dist_env
+        dist_env.set_mesh(None)
+        paddle.seed(4)
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(2, 8).astype('float32'))
+        outs = [split(x, (8, 8), 'linear', axis=1) for _ in range(2)]
+        assert not np.allclose(np.asarray(outs[0].value),
+                               np.asarray(outs[1].value))
+
 
 class TestNativeSlotReader:
     """C++ MultiSlot parser (io/native/slotreader.cpp — reference

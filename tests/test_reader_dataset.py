@@ -1,0 +1,234 @@
+"""paddle.reader decorators + paddle.dataset.* legacy data stack.
+
+Reference: /root/reference/python/paddle/reader/decorator.py and
+/root/reference/python/paddle/dataset/*.py — the fluid-era input
+pipeline.  The e2e test at the bottom is the canonical 1.x loop:
+train(reader=paddle.batch(paddle.dataset.mnist.train(), 64)).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import reader as preader
+
+
+def _range_reader(n):
+    def r():
+        yield from range(n)
+    return r
+
+
+class TestDecorators:
+    def test_map_readers(self):
+        out = list(preader.map_readers(
+            lambda a, b: a + b, _range_reader(4), _range_reader(4))())
+        assert out == [0, 2, 4, 6]
+
+    def test_shuffle_is_permutation(self):
+        out = list(preader.shuffle(_range_reader(10), 4)())
+        assert sorted(out) == list(range(10))
+
+    def test_chain(self):
+        out = list(preader.chain(_range_reader(2), _range_reader(3))())
+        assert out == [0, 1, 0, 1, 2]
+
+    def test_compose_flattens(self):
+        r1 = _range_reader(3)
+
+        def r2():
+            yield from [(10, 11), (20, 21), (30, 31)]
+        out = list(preader.compose(r1, r2)())
+        assert out == [(0, 10, 11), (1, 20, 21), (2, 30, 31)]
+
+    def test_compose_misaligned_raises(self):
+        with pytest.raises(preader.ComposeNotAligned):
+            list(preader.compose(_range_reader(2), _range_reader(3))())
+
+    def test_compose_unchecked(self):
+        out = list(preader.compose(_range_reader(2), _range_reader(3),
+                                   check_alignment=False)())
+        assert out == [(0, 0), (1, 1)]
+
+    def test_buffered(self):
+        out = list(preader.buffered(_range_reader(100), 7)())
+        assert out == list(range(100))
+
+    def test_buffered_propagates_errors(self):
+        def bad():
+            yield 1
+            raise IOError('disk gone')
+        with pytest.raises(IOError):
+            list(preader.buffered(bad, 4)())
+
+    def test_firstn(self):
+        assert list(preader.firstn(_range_reader(100), 5)()) == \
+            [0, 1, 2, 3, 4]
+
+    def test_cache_replays(self):
+        calls = []
+
+        def r():
+            calls.append(1)
+            yield from range(5)
+        c = preader.cache(r)
+        assert list(c()) == list(range(5))
+        assert list(c()) == list(range(5))
+        assert len(calls) == 1
+
+    def test_cache_partial_pass_not_corrupting(self):
+        c = preader.cache(_range_reader(5))
+        it = c()
+        next(it)                       # abandoned partial pass
+        assert list(c()) == [0, 1, 2, 3, 4]
+        assert list(c()) == [0, 1, 2, 3, 4]
+
+    def test_xmap_unordered(self):
+        out = list(preader.xmap_readers(
+            lambda x: x * 2, _range_reader(20), 4, 8)())
+        assert sorted(out) == [2 * i for i in range(20)]
+
+    def test_xmap_ordered(self):
+        out = list(preader.xmap_readers(
+            lambda x: x * 2, _range_reader(20), 4, 8, order=True)())
+        assert out == [2 * i for i in range(20)]
+
+    def test_xmap_propagates_errors(self):
+        def bad():
+            yield 1
+            raise ValueError('boom')
+        with pytest.raises(ValueError):
+            list(preader.xmap_readers(lambda x: x, bad, 2, 4)())
+
+    def test_multiprocess_reader(self):
+        out = list(preader.multiprocess_reader(
+            [_range_reader(5), _range_reader(5)])())
+        assert sorted(out) == sorted(list(range(5)) * 2)
+
+    def test_buffered_abandoned_consumer_releases_producer(self):
+        """Abandoning a buffered() iterator must unpark the producer
+        thread (bounded queue) instead of leaking it."""
+        import threading
+        import time
+        before = threading.active_count()
+        for _ in range(5):
+            it = preader.buffered(_range_reader(1000), 4)()
+            next(it)
+            it.close()              # triggers GeneratorExit -> stop
+        deadline = time.time() + 5
+        while threading.active_count() > before and time.time() < deadline:
+            time.sleep(0.05)
+        assert threading.active_count() <= before + 1
+
+    def test_xmap_abandoned_consumer_releases_workers(self):
+        import threading
+        import time
+        before = threading.active_count()
+        it = preader.xmap_readers(lambda x: x, _range_reader(1000), 3,
+                                  4)()
+        next(it)
+        it.close()
+        deadline = time.time() + 5
+        while threading.active_count() > before and time.time() < deadline:
+            time.sleep(0.05)
+        assert threading.active_count() <= before + 1
+
+
+class TestDatasets:
+    def test_mnist_sample_convention(self):
+        r = paddle.dataset.mnist.train()
+        img, label = next(iter(r()))
+        assert img.shape == (784,) and img.dtype == np.float32
+        assert img.min() >= -1.0 and img.max() <= 1.0
+        assert isinstance(label, int) and 0 <= label <= 9
+
+    def test_cifar_sample_convention(self):
+        img, label = next(iter(paddle.dataset.cifar.train10()()))
+        assert img.shape == (3072,)
+        assert 0.0 <= img.min() and img.max() <= 1.0
+        img100, label100 = next(iter(paddle.dataset.cifar.test100()()))
+        assert 0 <= label100 <= 99
+
+    def test_uci_housing(self):
+        feats, price = next(iter(paddle.dataset.uci_housing.train()()))
+        assert feats.shape == (13,) and price.shape == (1,)
+
+    def test_imdb(self):
+        wd = paddle.dataset.imdb.word_dict()
+        ids, label = next(iter(paddle.dataset.imdb.train(wd)()))
+        assert isinstance(ids, list) and label in (0, 1)
+
+    def test_imikolov_ngram_and_seq(self):
+        wd = paddle.dataset.imikolov.build_dict()
+        gram = next(iter(paddle.dataset.imikolov.train(wd, 5)()))
+        assert len(gram) == 5
+        src, trg = next(iter(paddle.dataset.imikolov.train(
+            wd, 5, paddle.dataset.imikolov.DataType.SEQ)()))
+        assert len(src) == len(trg)
+
+    def test_movielens(self):
+        sample = next(iter(paddle.dataset.movielens.train()()))
+        assert len(sample) == 8
+        assert paddle.dataset.movielens.max_user_id() == 6040
+
+    def test_wmt(self):
+        src, trg, nxt = next(iter(paddle.dataset.wmt14.train(1000)()))
+        assert trg[0] == 0 and nxt[-1] == 1      # BOS / EOS
+        src16, trg16, nxt16 = next(iter(
+            paddle.dataset.wmt16.train(1000, 1000)()))
+        assert len(trg16) == len(nxt16)
+
+    def test_conll05(self):
+        s = next(iter(paddle.dataset.conll05.test()()))
+        assert len(s) == 9
+        wd, vd, ld = paddle.dataset.conll05.get_dict()
+        assert len(ld) == 67
+
+    def test_image_transform(self):
+        im = (np.random.rand(40, 60, 3) * 255).astype(np.uint8)
+        out = paddle.dataset.image.simple_transform(
+            im, 32, 24, is_train=False, mean=[1.0, 2.0, 3.0])
+        assert out.shape == (3, 24, 24) and out.dtype == np.float32
+        short = paddle.dataset.image.resize_short(im, 20)
+        assert min(short.shape[:2]) == 20
+
+    def test_common_split_and_cluster(self, tmp_path):
+        import os
+        pat = os.path.join(str(tmp_path), 'chunk-%05d.pickle')
+        paddle.dataset.common.split(_range_reader(25), 10, suffix=pat)
+        r = paddle.dataset.common.cluster_files_reader(
+            os.path.join(str(tmp_path), 'chunk-*.pickle'), 1, 0)
+        assert sorted(r()) == list(range(25))
+
+
+class TestFluidStyleE2E:
+    def test_batch_reader_trains(self):
+        """The 1.x idiom end-to-end: dataset reader → shuffle → batch →
+        eager train loop; loss must drop (VERDICT r2 item 5)."""
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(13, 16), nn.ReLU(),
+                            nn.Linear(16, 1))
+        # house prices sit near 22, so the bias must travel ~22 units:
+        # Adam's per-step motion is ~lr, hence the large lr for a short
+        # smoke loop
+        opt = paddle.optimizer.Adam(learning_rate=0.3,
+                                    parameters=net.parameters())
+        train_reader = paddle.batch(
+            paddle.reader.shuffle(paddle.dataset.uci_housing.train(),
+                                  buf_size=200),
+            batch_size=64)
+        first = last = None
+        for epoch in range(8):
+            for batch in train_reader():
+                x = paddle.to_tensor(
+                    np.stack([b[0] for b in batch]).astype('float32'))
+                y = paddle.to_tensor(
+                    np.stack([b[1] for b in batch]).astype('float32'))
+                loss = paddle.mean((net(x) - y) ** 2)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                last = float(loss.value)
+                if first is None:
+                    first = last
+        assert last < first * 0.5, (first, last)
