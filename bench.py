@@ -34,6 +34,8 @@ BASELINES = {
     'widedeep': 200_000.0,  # examples/s
     'lenet': 10_000.0,      # imgs/s (anchor only)
     'gpt': 20_000.0,        # tokens/s (V100-class GPT-2 small AMP)
+    'gptgen': 2_000.0,      # decoded tokens/s (V100-class KV-cache
+                            # batch-8 GPT-2 small generation)
 }
 
 
@@ -231,6 +233,41 @@ def bench_widedeep(smoke):
     return v
 
 
+def bench_gptgen(smoke):
+    """Incremental decoding throughput on the KV-cache generate path:
+    whole prefill+scan decode is ONE compiled XLA module
+    (models/gpt.py::generate), so per-token cost is O(T) attention —
+    reference decode goes through fluid's host-side beam loop."""
+    import numpy as np  # noqa: F811
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import gpt_small, gpt_tiny
+
+    batch, prompt, new, iters = (2, 8, 8, 2) if smoke else \
+        (8, 128, 128, 5)
+    paddle.seed(0)
+    model = gpt_tiny() if smoke else gpt_small(max_seq_len=prompt + new,
+                                               dropout=0.0)
+    model.eval()
+    rs = np.random.RandomState(0)
+    V = model.config.vocab_size
+    ids = rs.randint(0, V, size=(batch, prompt)).astype('int64')
+    t0 = time.time()
+    out = model.generate(paddle.to_tensor(ids), max_new_tokens=new,
+                         temperature=0)
+    np.asarray(out.value)
+    log(f'gptgen warmup (incl. compile): {time.time() - t0:.1f}s')
+    t0 = time.time()
+    for i in range(iters):
+        out = model.generate(paddle.to_tensor(ids), max_new_tokens=new,
+                             temperature=0, seed=i)
+        np.asarray(out.value)   # force readback
+    dt = time.time() - t0
+    v = batch * new * iters / dt
+    log(f'gpt-generate: {iters} x {new} tokens in {dt:.2f}s '
+        f'({v:.0f} tokens/s decoded)')
+    return v
+
+
 def bench_lenet(smoke):
     import jax
     import paddle_tpu as paddle
@@ -269,6 +306,7 @@ CONFIGS = {
     'resnet': bench_resnet,
     'bert': bench_bert,
     'gpt': bench_gpt,
+    'gptgen': bench_gptgen,
     'widedeep': bench_widedeep,
 }
 
@@ -277,6 +315,7 @@ UNITS = {
     'resnet': 'imgs/sec/chip',
     'bert': 'tokens/sec/chip',
     'gpt': 'tokens/sec/chip',
+    'gptgen': 'decoded tokens/sec/chip',
     'widedeep': 'examples/sec/chip',
 }
 
@@ -412,6 +451,7 @@ def main():
         'resnet': 'resnet50_bf16_train_throughput',
         'bert': 'bert_base_bf16_pretrain_throughput',
         'gpt': 'gpt2_small_bf16_train_throughput',
+        'gptgen': 'gpt2_small_kvcache_decode_throughput',
         'widedeep': 'widedeep_sparse_train_throughput',
         'lenet': 'lenet_train_throughput',
     }

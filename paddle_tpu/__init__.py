@@ -64,6 +64,9 @@ from . import fluid  # noqa: F401
 from . import hub  # noqa: F401
 from . import reader  # noqa: F401
 from . import dataset  # noqa: F401
+from . import quantization  # noqa: F401
+from . import compat  # noqa: F401
+from . import device  # noqa: F401
 from . import inference  # noqa: F401
 from . import onnx  # noqa: F401
 from . import incubate  # noqa: F401

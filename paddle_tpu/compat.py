@@ -1,0 +1,102 @@
+"""paddle.compat — py2/3 string/number helpers of the fluid era.
+
+Reference analogue: /root/reference/python/paddle/compat.py (to_text,
+to_bytes, round, floor_division, get_exception_message).  Python-3-only
+build: the py2 branches collapse.
+"""
+import math
+
+__all__ = ['long_type', 'to_text', 'to_bytes', 'round',
+           'floor_division', 'get_exception_message']
+
+int_type = int
+long_type = int
+
+
+def _to_text(obj, encoding):
+    if obj is None:
+        return obj
+    if isinstance(obj, bytes):
+        return obj.decode(encoding)
+    if isinstance(obj, str):
+        return obj
+    return str(obj)
+
+
+def to_text(obj, encoding='utf-8', inplace=False):
+    """Convert str/bytes (or containers of them) to literal strings
+    (reference compat.py::to_text)."""
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [_to_text(i, encoding) for i in obj]
+            return obj
+        return [to_text(i, encoding) for i in obj]
+    if isinstance(obj, set):
+        if inplace:
+            vals = [_to_text(i, encoding) for i in obj]
+            obj.clear()
+            obj.update(vals)
+            return obj
+        return {to_text(i, encoding) for i in obj}
+    if isinstance(obj, dict):
+        if inplace:
+            for k in list(obj):
+                obj[k] = to_text(obj[k], encoding)
+            return obj
+        return {k: to_text(v, encoding) for k, v in obj.items()}
+    return _to_text(obj, encoding)
+
+
+def _to_bytes(obj, encoding):
+    if obj is None:
+        return obj
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    if isinstance(obj, bytes):
+        return obj
+    return str(obj).encode(encoding)
+
+
+def to_bytes(obj, encoding='utf-8', inplace=False):
+    """Convert str (or containers of str) to bytes (reference
+    compat.py::to_bytes)."""
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [_to_bytes(i, encoding) for i in obj]
+            return obj
+        return [to_bytes(i, encoding) for i in obj]
+    if isinstance(obj, set):
+        if inplace:
+            vals = [_to_bytes(i, encoding) for i in obj]
+            obj.clear()
+            obj.update(vals)
+            return obj
+        return {to_bytes(i, encoding) for i in obj}
+    if isinstance(obj, dict):
+        if inplace:
+            for k in list(obj):
+                obj[k] = to_bytes(obj[k], encoding)
+            return obj
+        return {k: to_bytes(v, encoding) for k, v in obj.items()}
+    return _to_bytes(obj, encoding)
+
+
+def round(x, d=0):
+    """Python-2-style round (half away from zero) — the reference keeps
+    the py2 semantics for reproducibility (compat.py::round)."""
+    if x == 0.0:
+        return 0.0
+    p = 10 ** d
+    if x >= 0:
+        return float(math.floor((x * p) + 0.5)) / p
+    return float(math.ceil((x * p) - 0.5)) / p
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    """-> the exception's message string (reference
+    compat.py::get_exception_message)."""
+    return str(exc)

@@ -19,11 +19,14 @@ def _creator(cls, mode, cycle=False):
         while True:
             for i in range(len(ds)):
                 img, label = ds[i]
-                flat = np.asarray(img, np.float32).reshape(-1)
-                if flat.max() > 1.5:      # raw 0..255 pixels
+                arr = np.asarray(img)
+                # scaling decided by DTYPE, not per-image content: the
+                # loader serves raw uint8 pixels; a float transform
+                # output is assumed already scaled
+                flat = arr.astype(np.float32).reshape(-1)
+                if np.issubdtype(arr.dtype, np.integer):
                     flat = flat / 255.0
-                yield flat.astype(np.float32), \
-                    int(np.asarray(label).reshape(()))
+                yield flat, int(np.asarray(label).reshape(()))
             if not cycle:
                 break
 

@@ -35,8 +35,7 @@ def gen(dict_size):
 def get_dict(dict_size, reverse=True):
     """-> (src_dict, trg_dict) id→word (or word→id when reverse=False)
     (reference wmt14.py:178; note the reference's `reverse` default
-    returns id→word)."""
-    ds = WMT14(mode='test', dict_size=dict_size)
+    returns id→word).  Synthetic corpus: vocab is w0..w<n>."""
     d = {i: 'w%d' % i for i in range(dict_size)}
     if not reverse:
         d = {v: k for k, v in d.items()}

@@ -1,0 +1,17 @@
+"""paddle.device namespace.
+
+Reference analogue: /root/reference/python/paddle/device.py (set_device,
+get_device, XPUPlace, is_compiled_with_*).  The implementations live in
+core/device.py (TPU is the native accelerator; cuda/xpu/npu report not
+compiled); this module is the public namespace the reference exposes as
+`paddle.device`.
+"""
+from .core.device import (  # noqa: F401
+    set_device, get_device, XPUPlace, is_compiled_with_xpu,
+    is_compiled_with_npu, is_compiled_with_cuda, get_cudnn_version,
+    device_count, CPUPlace, CUDAPlace, TPUPlace, NPUPlace,
+    CUDAPinnedPlace)
+
+__all__ = ['get_cudnn_version', 'XPUPlace', 'is_compiled_with_xpu',
+           'is_compiled_with_cuda', 'is_compiled_with_npu',
+           'get_device', 'set_device']

@@ -66,7 +66,13 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
             f'match the mesh tp axis ({tp}); the sharding follows the '
             'mesh', stacklevel=2)
 
-    if name is not None:
+    from ..static.program import in_static_mode
+    # static recording ALWAYS builds fresh weights per call — each
+    # recorded op owns its parameters, like the reference's program
+    # build.  The eager name-cache also skips custom weight_attr: a
+    # ParamAttr has no value-based identity, so caching on it would
+    # either poison (id reuse) or silently ignore a new initializer.
+    if name is not None and not in_static_mode() and weight_attr is None:
         from ..core import rng as _rng
         key = (name, operation, tuple(size), axis, num_partitions,
                gather_out, bias_attr is not False, _rng.get_seed())
@@ -77,8 +83,7 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
                 bias_attr, name)
         return layer(x)
 
-    from ..static.program import in_static_mode
-    if not in_static_mode() and not _WARNED_UNNAMED[0]:
+    if name is None and not in_static_mode() and not _WARNED_UNNAMED[0]:
         _WARNED_UNNAMED[0] = True
         warnings.warn(
             'distributed.split without name= creates FRESH weights on '

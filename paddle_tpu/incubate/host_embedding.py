@@ -25,9 +25,26 @@ compiled module). Duplicate ids within a batch accumulate their
 gradients before the update (scatter-add), like the reference's sparse
 gradient merge.  Out-of-range ids raise (like nn.Embedding).
 
-SINGLE-HOST ONLY for now: each process would hold an independent table
-copy with no cross-host aggregation (the reference solves this with a
-central server); the constructor rejects jax.process_count() > 1.
+MULTI-HOST (r3): the table is PROCESS-SHARDED — process p owns vocab
+rows [p*V/P, (p+1)*V/P), exactly the reference PS's table distribution
+over server instances (the_one_ps.py:417 _get_tables splits by id mod/
+range).  Routing is TPU-native instead of brpc RPC:
+
+  * under `shard_map` over a mesh axis spanning the processes, each
+    shard `all_gather`s the batch ids over the axis;
+  * every process's host callback contributes rows IT OWNS (zeros for
+    the rest), and one `psum` over the axis fills every row — the id
+    exchange and row return ride the same ICI/DCN collectives as the
+    rest of the step, no separate server RPC fabric;
+  * the backward all_gathers row grads the same way and each host
+    applies its owned updates (dup-id merge + SGD/Adagrad + entry
+    admission) locally.
+
+Single-process (including the 8-virtual-device CPU mesh) runs the same
+sharded code path when the axis is bound — partitions then share one
+host table and partition 0 does the contribution, so psum semantics
+match the multi-process case bit for bit.  Without a bound axis the
+original single-host fast path runs unchanged.
 """
 import numpy as np
 import jax
@@ -54,30 +71,40 @@ class HostOffloadEmbedding(Layer):
 
     def __init__(self, num_embeddings, embedding_dim, learning_rate=0.01,
                  optimizer='sgd', trainable=True, dtype='float32',
-                 seed=None, entry=None):
+                 seed=None, entry=None, shard_axis='dp'):
         super().__init__()
         if optimizer not in ('sgd', 'adagrad'):
             raise ValueError(f'unsupported host optimizer {optimizer!r}')
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                'HostOffloadEmbedding is single-host: each process '
-                'would hold a divergent table copy (no cross-host '
-                'aggregation server); use fleet VocabParallelEmbedding '
-                'for multi-host sparse tables')
         self.num_embeddings = int(num_embeddings)
         self.embedding_dim = int(embedding_dim)
         self.learning_rate = float(learning_rate)
         self.optimizer = optimizer
         self.trainable = trainable
+        self.shard_axis = shard_axis
         self._np_dtype = np.dtype(dtype)
         if seed is None:
             from ..core import rng as rng_mod
             seed = rng_mod.get_seed()
         rs = np.random.RandomState(seed)
         bound = 1.0 / np.sqrt(self.embedding_dim)
-        self.table = rs.uniform(
+        # process sharding: every process generates the SAME full table
+        # (shared seed) and keeps only its own row range — cheap at init
+        # and guarantees cross-host agreement on the initial values
+        self._nproc = jax.process_count()
+        self._pid = jax.process_index()
+        full = rs.uniform(
             -bound, bound,
             (self.num_embeddings, self.embedding_dim)).astype(self._np_dtype)
+        if self._nproc > 1:
+            rpp = -(-self.num_embeddings // self._nproc)  # ceil
+            self._row0 = self._pid * rpp
+            row1 = min(self._row0 + rpp, self.num_embeddings)
+            self._rows_per_proc = rpp
+            self.table = full[self._row0:max(row1, self._row0)].copy()
+        else:
+            self._row0 = 0
+            self._rows_per_proc = self.num_embeddings
+            self.table = full
         self._accum = (np.zeros_like(self.table)
                        if optimizer == 'adagrad' else None)
         # entry admission (reference distributed/entry_attr.py): gate the
@@ -90,11 +117,12 @@ class HostOffloadEmbedding(Layer):
         self.entry = entry
         self._entry_rng = np.random.RandomState(
             (seed if seed is not None else 0) ^ 0x5eed)
+        # admission state is per OWNED row (storage-local indexing)
         if isinstance(entry, CountFilterEntry):
-            self._counts = np.zeros((self.num_embeddings,), np.int64)
+            self._counts = np.zeros((len(self.table),), np.int64)
         elif isinstance(entry, ProbabilityEntry):
             # -1 undecided, 0 rejected, 1 admitted
-            self._admit_flag = np.full((self.num_embeddings,), -1, np.int8)
+            self._admit_flag = np.full((len(self.table),), -1, np.int8)
         # a zero scalar device parameter that rides through the lookup:
         # ids are integers, so without a float input on the op the
         # autograd tape would mark the output stop_gradient and the
@@ -105,6 +133,7 @@ class HostOffloadEmbedding(Layer):
             [1], attr=None, dtype='float32',
             default_initializer=I.Constant(0.0))
         self._lookup = self._build_lookup()
+        self._lookup_mp = self._build_lookup_mp()
 
     # -- host side -----------------------------------------------------------
     def _check_ids(self, ids):
@@ -137,12 +166,10 @@ class HostOffloadEmbedding(Layer):
             return self._admit_flag[uniq] == 1
         return np.ones(uniq.shape[0], bool)
 
-    def _host_push(self, ids, grad):
-        """Sparse update: accumulate duplicate ids, apply the rule."""
-        ids = self._check_ids(ids).reshape(-1)
-        g = np.asarray(grad, self._np_dtype).reshape(
-            -1, self.embedding_dim)
-        uniq, inv, cnt = np.unique(ids, return_inverse=True,
+    def _apply_update(self, local_rows, g):
+        """Shared sparse-update core over STORAGE-LOCAL row indices:
+        merge duplicate rows, gate by entry admission, apply the rule."""
+        uniq, inv, cnt = np.unique(local_rows, return_inverse=True,
                                    return_counts=True)
         merged = np.zeros((uniq.shape[0], self.embedding_dim),
                           self._np_dtype)
@@ -152,12 +179,54 @@ class HostOffloadEmbedding(Layer):
             if not keep.all():
                 uniq, merged = uniq[keep], merged[keep]
             if uniq.size == 0:
-                return np.zeros((), np.int32)
+                return
         if self.optimizer == 'adagrad':
             self._accum[uniq] += merged * merged
             merged = merged / np.sqrt(self._accum[uniq] + 1e-10)
         self.table[uniq] -= self.learning_rate * merged
+
+    def _host_push(self, ids, grad):
+        """Single-host sparse update (storage holds the full table)."""
+        ids = self._check_ids(ids).reshape(-1)
+        g = np.asarray(grad, self._np_dtype).reshape(
+            -1, self.embedding_dim)
+        self._apply_update(ids, g)
         return np.zeros((), np.int32)  # io_callback wants a result
+
+    # -- process-sharded host side (multi-host PS semantics) ------------
+    def _owned_mask(self, ids):
+        """Bool mask of global ids whose rows live in THIS storage."""
+        return (ids >= self._row0) & (ids < self._row0 + len(self.table))
+
+    def _mp_gather(self, first_local, all_ids):
+        """Contribution of this host to the axis-wide psum: rows it
+        owns, zeros elsewhere.  `first_local` is 1 on exactly one
+        partition per process (see _build_lookup_mp) so multi-device
+        hosts don't contribute the same row L times."""
+        all_ids = self._check_ids(all_ids)         # [P, B]
+        P, B = all_ids.shape
+        out = np.zeros((P, B, self.embedding_dim), self._np_dtype)
+        if int(first_local):
+            flat = all_ids.reshape(-1)
+            mask = self._owned_mask(flat)
+            if mask.any():
+                rows = np.zeros((flat.shape[0], self.embedding_dim),
+                                self._np_dtype)
+                rows[mask] = self.table[flat[mask] - self._row0]
+                out = rows.reshape(P, B, self.embedding_dim)
+        return out
+
+    def _mp_push(self, first_local, all_ids, all_g):
+        """Apply this host's owned slice of the axis-wide grads."""
+        if not int(first_local):
+            return np.zeros((), np.int32)
+        flat = self._check_ids(all_ids).reshape(-1)
+        g = np.asarray(all_g, self._np_dtype).reshape(
+            -1, self.embedding_dim)
+        mask = self._owned_mask(flat)
+        if mask.any():
+            self._apply_update(flat[mask] - self._row0, g[mask])
+        return np.zeros((), np.int32)
 
     # -- device side ---------------------------------------------------------
     def _build_lookup(self):
@@ -194,9 +263,82 @@ class HostOffloadEmbedding(Layer):
         lookup.defvjp(fwd, bwd)
         return lookup
 
+    def _build_lookup_mp(self):
+        """Sharded lookup for use INSIDE shard_map over `shard_axis`:
+        all_gather ids → per-host owned-row contributions → psum."""
+        D = self.embedding_dim
+        dt = jnp.dtype(self._np_dtype)
+        axis = self.shard_axis
+
+        def first_local_flag():
+            # exactly one partition per PROCESS contributes (psum must
+            # see each owned row once even when a host drives several
+            # devices on the axis)
+            sidx = jax.lax.axis_index(axis)
+            P = jax.lax.psum(1, axis)
+            local = max(1, P // max(1, self._nproc))
+            return (sidx % local) == 0
+
+        def pull(ids):
+            from jax.experimental import io_callback
+            flat = ids.reshape(-1)
+            all_ids = jax.lax.all_gather(flat, axis)        # [P, B]
+            P = all_ids.shape[0]
+            contrib = io_callback(
+                self._mp_gather,
+                jax.ShapeDtypeStruct((P, flat.shape[0], D), dt),
+                first_local_flag(), all_ids, ordered=False)
+            rows = jax.lax.psum(contrib, axis)
+            mine = rows[jax.lax.axis_index(axis)]
+            return mine.reshape(ids.shape + (D,))
+
+        @jax.custom_vjp
+        def lookup_mp(ids, anchor):
+            return pull(ids) + anchor.astype(dt)
+
+        def fwd(ids, anchor):
+            return lookup_mp(ids, anchor), ids
+
+        def bwd(ids, g):
+            if self.trainable:
+                from jax.experimental import io_callback
+                flat = ids.reshape(-1)
+                gf = g.reshape(-1, D)
+                all_ids = jax.lax.all_gather(flat, axis)
+                all_g = jax.lax.all_gather(gf, axis)
+                io_callback(self._mp_push,
+                            jax.ShapeDtypeStruct((), jnp.int32),
+                            first_local_flag(), all_ids, all_g,
+                            ordered=True)
+            ct = np.zeros(np.shape(ids), jax.dtypes.float0)
+            return (ct, jnp.zeros((1,), jnp.float32))
+
+        lookup_mp.defvjp(fwd, bwd)
+        return lookup_mp
+
+    def _axis_bound(self):
+        """True iff shard_axis is a mapped axis in the current trace."""
+        try:
+            jax.lax.axis_index(self.shard_axis)
+            return True
+        except Exception:
+            return False
+
     def forward(self, ids):
         ids = wrap(ids)
-        return apply(self._lookup, ids, self._anchor,
+
+        def op(idv, anchor):
+            if self._axis_bound():
+                return self._lookup_mp(idv, anchor)
+            if self._nproc > 1:
+                raise RuntimeError(
+                    'HostOffloadEmbedding with process-sharded table '
+                    f'must run inside shard_map over axis '
+                    f'{self.shard_axis!r} (multi-host PS routing needs '
+                    'the axis collectives)')
+            return self._lookup(idv, anchor)
+
+        return apply(op, ids, self._anchor,
                      op_name='host_offload_embedding')
 
     # -- checkpointing (the table is host state, not a device param).

@@ -43,6 +43,13 @@ class LookAhead(Optimizer):
 
     # -- eager ----------------------------------------------------------
     def step(self):
+        if not self._slow:
+            # seed slow copies from the weights BEFORE any inner update
+            # (reference lookahead.py seeds the slow var from the
+            # initial param; keeps eager == functional init(params))
+            for p in self.inner_optimizer._params:
+                if not p.stop_gradient:
+                    self._slow[id(p)] = p.value
         self.inner_optimizer.step()
         self._global_step += 1
         if self._global_step % self.k:
@@ -50,14 +57,7 @@ class LookAhead(Optimizer):
         for p in self.inner_optimizer._params:
             if p.stop_gradient:
                 continue
-            slow = self._slow.get(id(p), None)
-            if slow is None:
-                # lazily seed the slow copy with the INITIAL fast value
-                # minus the updates already folded — first sync uses the
-                # current weights, like the reference's lazy slow var
-                slow = p.value
-                self._slow[id(p)] = slow
-                continue
+            slow = self._slow[id(p)]
             slow = slow + self.alpha * (p.value - slow)
             p.value = slow
             self._slow[id(p)] = slow

@@ -19,13 +19,17 @@ Semantics contract (mirrors the reference's converted operators):
   branch must produce matching shapes/dtypes (the reference imposes
   the same through its merge of branch outputs into select ops).
 
-Supported rewrites (v1): `if`/`elif`/`else` (including branches that
+Supported rewrites: `if`/`elif`/`else` (including branches that
 `return`, with the statement tail folded into the implicit else),
-`while`, and `and`/`or`/`not` inside the tests.  Unsupported (the
-transformer bails out and the function runs with plain tracing, which
-is exactly the pre-conversion behavior): `break`/`continue` in a
-converted `while`, `return` inside a converted `while`, closures over
-free variables, and sources `inspect` cannot retrieve.
+`while` — including `break`/`continue`, desugared into carried/local
+flags folded into the loop condition and lax.cond guards (matching the
+reference's convert_while_loop flag technique at
+convert_operators.py:25) — and `and`/`or`/`not` inside the tests.
+Unsupported (the transformer bails out and the function runs with plain
+tracing, which is exactly the pre-conversion behavior): `return` inside
+a converted `while`, `break`/`continue` under with/try inside a
+converted while, closures over free variables, and sources `inspect`
+cannot retrieve.
 """
 import ast
 import functools
@@ -441,17 +445,96 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         ]
         return stmts, False
 
+    # -- break/continue desugaring (reference convert_operators.py:25
+    # handles these through while-op flags; same flag technique here) --
+
+    @staticmethod
+    def _contains_bc(node):
+        """break/continue belonging to THIS loop level (not descending
+        into nested loops or function definitions)."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.While, ast.For, ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda,
+                                  ast.ClassDef)):
+                continue
+            if isinstance(child, (ast.Break, ast.Continue)) \
+                    or _ControlFlowTransformer._contains_bc(child):
+                return True
+        return False
+
+    def _desugar_bc(self, stmts, brk, cont):
+        """Rewrite break -> brk=True, continue -> cont=True, and guard
+        every statement that follows a potential flag set with
+        `if not (brk or cont):` — the guards become lax.cond via the
+        normal if conversion, so `while` bodies with break/continue
+        compile into the SAME lax.while_loop (flag folded into the
+        loop condition)."""
+
+        def set_flag(name):
+            return ast.Assign(targets=[_name(name, ast.Store())],
+                              value=ast.Constant(value=True))
+
+        def not_skipping():
+            return ast.UnaryOp(op=ast.Not(), operand=ast.BoolOp(
+                op=ast.Or(), values=[_name(brk), _name(cont)]))
+
+        def rewrite(block):
+            out = []
+            for idx, s in enumerate(block):
+                rest = block[idx + 1:]
+                if isinstance(s, ast.Break):
+                    out.append(set_flag(brk))
+                    return out          # rest is unreachable
+                if isinstance(s, ast.Continue):
+                    out.append(set_flag(cont))
+                    return out
+                if isinstance(s, ast.If) and self._contains_bc(s):
+                    new_if = ast.If(
+                        test=s.test,
+                        body=rewrite(s.body) or [ast.Pass()],
+                        orelse=rewrite(s.orelse))
+                    out.append(new_if)
+                    if rest:
+                        tail = rewrite(rest)
+                        if tail:
+                            out.append(ast.If(test=not_skipping(),
+                                              body=tail, orelse=[]))
+                    return out
+                if isinstance(s, (ast.With, ast.AsyncWith, ast.Try)) \
+                        and self._contains_bc(s):
+                    raise _Unsupported(
+                        'break/continue inside with/try in a converted '
+                        'while')
+                out.append(s)
+            return out
+
+        return rewrite(stmts)
+
     def _rewrite_while(self, node):
-        if _has(node.body, (ast.Break, ast.Continue)):
-            raise _Unsupported('break/continue in converted while')
         if _returns_directly(node.body):
             raise _Unsupported('return in converted while')
         if node.orelse:
             raise _Unsupported('while/else')
         uid = self._uid()
-        test = self._convert_test(node.test)
-        body = self._transform_block(node.body)
-        stores = sorted(set(_stores(node.body)))
+        pre = []
+        body_stmts = list(node.body)
+        test_ast = node.test
+        if _has(node.body, (ast.Break, ast.Continue)):
+            brk, cont = f'__cf_brk_{uid}', f'__cf_cont_{uid}'
+            body_stmts = self._desugar_bc(body_stmts, brk, cont)
+            # cont resets every iteration (loop-local); brk is carried
+            # and folds into the loop condition
+            body_stmts = [ast.Assign(
+                targets=[_name(cont, ast.Store())],
+                value=ast.Constant(value=False))] + body_stmts
+            pre = [ast.Assign(targets=[_name(brk, ast.Store())],
+                              value=ast.Constant(value=False))]
+            test_ast = ast.BoolOp(op=ast.And(), values=[
+                ast.UnaryOp(op=ast.Not(), operand=_name(brk)),
+                node.test])
+        test = self._convert_test(test_ast)
+        body = self._transform_block(body_stmts)
+        stores = sorted(set(_stores(body_stmts)))
         if not stores:
             raise _Unsupported('while body assigns nothing')
         cname, bname = f'__cf_cond_{uid}', f'__cf_body_{uid}'
@@ -464,7 +547,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 value=_call(_jst('convert_while_loop'), [
                     _name(cname), _name(bname), self._grab_call(stores)])),
         ]
-        return stmts
+        return pre + stmts
 
     def _transform_block(self, stmts, fn_exit=False):
         out = []

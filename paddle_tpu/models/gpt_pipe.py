@@ -44,7 +44,8 @@ class GPTPipeModule:
     """Adapter: GPTForCausalLM -> (params, specs, stage fns) for
     parallel.pipeline_1f1b.pipeline_value_and_grad."""
 
-    def __init__(self, model, num_stages, mesh, tp_axis='tp'):
+    def __init__(self, model, num_stages, mesh, tp_axis='tp',
+                 ep_axis='ep'):
         cfg = model.config
         assert cfg.num_layers % num_stages == 0, (
             f'num_layers {cfg.num_layers} % pp {num_stages} != 0')
@@ -56,8 +57,24 @@ class GPTPipeModule:
         self.mesh = mesh
         self.tp = dict(mesh.shape).get(tp_axis, 1)
         self.tp_axis = tp_axis
+        self.ep = dict(mesh.shape).get(ep_axis, 1)
+        self.ep_axis = ep_axis
         assert cfg.num_heads % self.tp == 0
         assert cfg.intermediate_size % self.tp == 0
+        # MoE in the pipeline: every block routed (homogeneous lax.scan
+        # over layers), experts sharded on 'ep'.  The load-balance aux
+        # loss is NOT emitted on this path — the 1F1B engine
+        # differentiates the last stage's loss only; capacity dropping
+        # still bounds expert load.  (The GSPMD path carries aux.)
+        self.moe = cfg.moe_num_experts > 0
+        if self.moe:
+            assert cfg.moe_every == 1, (
+                'pipeline MoE needs moe_every=1 (homogeneous stages for '
+                'the scan-over-layers); got moe_every='
+                f'{cfg.moe_every}')
+            assert cfg.moe_top_k == 1, 'pipeline MoE is top-1 (Switch)'
+            assert cfg.moe_num_experts % self.ep == 0, (
+                f'experts {cfg.moe_num_experts} % ep {self.ep} != 0')
         self.params = self._extract()
         self.stage_specs = self._specs()
 
@@ -87,11 +104,22 @@ class GPTPipeModule:
             'proj_b': stack(lambda b: b.attn.proj.bias),
             'ln2_w': stack(lambda b: b.ln2.weight),
             'ln2_b': stack(lambda b: b.ln2.bias),
-            'fc_w': stack(lambda b: b.mlp.fc.weight),
-            'fc_b': stack(lambda b: b.mlp.fc.bias),
-            'fc2_w': stack(lambda b: b.mlp.proj.weight),
-            'fc2_b': stack(lambda b: b.mlp.proj.bias),
         }
+        if self.moe:
+            blocks.update({
+                'gate_w': stack(lambda b: b.mlp.gate_w),
+                'moe_w1': stack(lambda b: b.mlp.w1),
+                'moe_b1': stack(lambda b: b.mlp.b1),
+                'moe_w2': stack(lambda b: b.mlp.w2),
+                'moe_b2': stack(lambda b: b.mlp.b2),
+            })
+        else:
+            blocks.update({
+                'fc_w': stack(lambda b: b.mlp.fc.weight),
+                'fc_b': stack(lambda b: b.mlp.fc.bias),
+                'fc2_w': stack(lambda b: b.mlp.proj.weight),
+                'fc2_b': stack(lambda b: b.mlp.proj.bias),
+            })
         S = self.S
         stages = {k: v.reshape((S, v.shape[0] // S) + v.shape[1:])
                   for k, v in blocks.items()}
@@ -127,28 +155,48 @@ class GPTPipeModule:
             blk.attn.proj.bias.value = jnp.asarray(flat['proj_b'][i])
             blk.ln2.weight.value = jnp.asarray(flat['ln2_w'][i])
             blk.ln2.bias.value = jnp.asarray(flat['ln2_b'][i])
-            blk.mlp.fc.weight.value = jnp.asarray(flat['fc_w'][i])
-            blk.mlp.fc.bias.value = jnp.asarray(flat['fc_b'][i])
-            blk.mlp.proj.weight.value = jnp.asarray(flat['fc2_w'][i])
-            blk.mlp.proj.bias.value = jnp.asarray(flat['fc2_b'][i])
+            if self.moe:
+                blk.mlp.gate_w.value = jnp.asarray(flat['gate_w'][i])
+                blk.mlp.w1.value = jnp.asarray(flat['moe_w1'][i])
+                blk.mlp.b1.value = jnp.asarray(flat['moe_b1'][i])
+                blk.mlp.w2.value = jnp.asarray(flat['moe_w2'][i])
+                blk.mlp.b2.value = jnp.asarray(flat['moe_b2'][i])
+            else:
+                blk.mlp.fc.weight.value = jnp.asarray(flat['fc_w'][i])
+                blk.mlp.fc.bias.value = jnp.asarray(flat['fc_b'][i])
+                blk.mlp.proj.weight.value = jnp.asarray(flat['fc2_w'][i])
+                blk.mlp.proj.bias.value = jnp.asarray(flat['fc2_b'][i])
 
     def _specs(self):
         """GLOBAL PartitionSpecs for the stage leaves: [S, L/S, ...] with
         'pp' leading; 'tp' on the head dim (qkv/proj) or the
         intermediate dim (fc/fc2) — the Megatron column/row split."""
         t = self.tp_axis
-        return {
+        specs = {
             'ln1_w': P('pp'), 'ln1_b': P('pp'),
             'qkv_w': P('pp', None, None, None, t, None),
             'qkv_b': P('pp', None, None, t, None),
             'proj_w': P('pp', None, t, None, None),
             'proj_b': P('pp'),
             'ln2_w': P('pp'), 'ln2_b': P('pp'),
-            'fc_w': P('pp', None, None, t),
-            'fc_b': P('pp', None, t),
-            'fc2_w': P('pp', None, t, None),
-            'fc2_b': P('pp'),
         }
+        if self.moe:
+            e = self.ep_axis
+            specs.update({
+                'gate_w': P('pp'),                       # replicated gate
+                'moe_w1': P('pp', None, e, None, None),  # [S,L/S,E,H,F]
+                'moe_b1': P('pp', None, e, None, None),
+                'moe_w2': P('pp', None, e, None, None),
+                'moe_b2': P('pp', None, e, None, None),
+            })
+        else:
+            specs.update({
+                'fc_w': P('pp', None, None, t),
+                'fc_b': P('pp', None, t),
+                'fc2_w': P('pp', None, t, None),
+                'fc2_b': P('pp'),
+            })
+        return specs
 
     # -- stage functions (pure jnp, run inside shard_map) --------------------
     def first_fn(self, shared, ids_1mb):
@@ -180,12 +228,62 @@ class GPTPipeModule:
         x = x + o + bp['proj_b']
 
         h = _ln(x, bp['ln2_w'], bp['ln2_b'], eps)
+        if self.moe:
+            return x + self._moe_mlp(bp, h)
         u = jax.nn.gelu(jnp.einsum('bth,hi->bti', h, bp['fc_w'])
                         + bp['fc_b'], approximate=True)
         u = jnp.einsum('bti,ih->bth', u, bp['fc2_w'])
         if tp_on:
             u = jax.lax.psum(u, self.tp_axis)
         return x + u + bp['fc2_b']
+
+    def _moe_mlp(self, bp, h):
+        """Switch (top-1) expert MLP on the LOCAL ep shard of experts.
+
+        Same routing math as incubate.moe.SwitchMoE (dense dispatch/
+        combine, capacity drop), but with HAND-WRITTEN sharding: the
+        tokens are replicated over 'ep' inside the pipeline's shard_map,
+        each shard computes only its E/ep experts' slice of the dispatch
+        einsum, and ONE psum('ep') combines — the manual form of the
+        all-to-all XLA infers on the GSPMD path."""
+        cfg = self.cfg
+        E = cfg.moe_num_experts
+        E_l = E // self.ep
+        act = jax.nn.gelu      # SwitchMoE's default (incubate/moe.py)
+
+        mb, T, H = h.shape
+        S = mb * T
+        import math as _math
+        C = max(1, int(_math.ceil(S / E * cfg.moe_capacity_factor)))
+        xs = h.reshape(S, H)
+        logits = xs.astype(jnp.float32) @ bp['gate_w'].astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)          # [S, E]
+        idx = jnp.argmax(probs, axis=-1)
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        gate = jnp.sum(probs * onehot, axis=-1)          # [S]
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
+        keep = (pos < C) & (onehot > 0)
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)
+        sel = slot * keep.astype(jnp.float32)[..., None]  # [S, E, C]
+        dispatch = sel.astype(xs.dtype)
+        combine = sel * gate[:, None, None]
+
+        if self.ep > 1:
+            e0 = jax.lax.axis_index(self.ep_axis) * E_l
+            dispatch_l = jax.lax.dynamic_slice_in_dim(dispatch, e0, E_l, 1)
+            combine_l = jax.lax.dynamic_slice_in_dim(combine, e0, E_l, 1)
+        else:
+            dispatch_l, combine_l = dispatch, combine
+
+        ein = jnp.einsum('sec,sh->ech', dispatch_l, xs)  # [E_l, C, H]
+        u = act(jnp.einsum('ech,ehf->ecf', ein, bp['moe_w1'])
+                + bp['moe_b1'].astype(ein.dtype))
+        out = jnp.einsum('ecf,efh->ech', u, bp['moe_w2']) \
+            + bp['moe_b2'].astype(u.dtype)
+        y = jnp.einsum('ech,sec->sh', out, combine_l.astype(out.dtype))
+        if self.ep > 1:
+            y = jax.lax.psum(y, self.ep_axis)
+        return y.reshape(mb, T, H)
 
     def stage_fn(self, shared, stage_p, x, rank):
         """Apply this stage's L/S blocks via lax.scan over the stacked

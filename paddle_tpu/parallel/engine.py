@@ -145,14 +145,29 @@ class ParallelTrainer:
 
     def _state_sharding_tree(self, state):
         """Optimizer slots follow their parameter's sharding when they
-        share its shape (Adam moments etc.), else replicate."""
+        share its shape (Adam moments etc.), else replicate.  With
+        strategy.sharding (ZeRO composed with pipeline — reference
+        sharding_optimizer stacking under pipeline), slots of
+        pp-REPLICATED leaves (the shared embedding/LN — the vocab table
+        dominates state bytes) additionally shard dim 0 over dp."""
         repl = NamedSharding(self.mesh, P())
+        zero = bool(self.strategy and self.strategy.sharding)
+        dp = dict(self.mesh.shape).get('dp', 1)
+
+        def slot_sharding(p, sh):
+            if not zero or dp <= 1:
+                return sh
+            spec = list(sh.spec) + [None] * (p.ndim - len(sh.spec))
+            if p.ndim and spec[0] is None and p.shape[0] % dp == 0:
+                return NamedSharding(self.mesh, P('dp', *spec[1:]))
+            return sh
+
         flat_p, treedef = jax.tree_util.tree_flatten(self.params)
         flat_sh = treedef.flatten_up_to(self._pipe_shardings)
         flat_s = treedef.flatten_up_to(state)
         out = []
         for p, sh, st in zip(flat_p, flat_sh, flat_s):
-            out.append({k: (sh if hasattr(v, 'shape')
+            out.append({k: (slot_sharding(p, sh) if hasattr(v, 'shape')
                             and v.shape == p.shape else repl)
                         for k, v in st.items()})
         return jax.tree_util.tree_unflatten(treedef, out)
@@ -166,6 +181,24 @@ class ParallelTrainer:
                 if self.strategy is not None else {})
         M = max(1, int(cfgs.get('accumulate_steps') or 1))
 
+        # ZeRO-2 under pipeline: reduce-scatter the pp-replicated shared
+        # grads over dp (constraint -> XLA emits reduce-scatter), update
+        # on dp shards, params' out_sharding re-gathers
+        zero2 = bool(self.strategy and self.strategy.sharding
+                     and int(self.strategy.sharding_configs.get(
+                         'stage', 1)) >= 2)
+        dp_n = dict(mesh.shape).get('dp', 1)
+
+        def shard_shared_grads(d_sh):
+            if not zero2 or dp_n <= 1:
+                return d_sh
+            return {
+                k: (jax.lax.with_sharding_constraint(
+                    g, NamedSharding(mesh, P(
+                        'dp', *([None] * (g.ndim - 1)))))
+                    if g.ndim and g.shape[0] % dp_n == 0 else g)
+                for k, g in d_sh.items()}
+
         def train_step(params, opt_state, step_no, ids, labels):
             B = ids.shape[0]
             assert B % M == 0, (B, M)
@@ -176,7 +209,7 @@ class ParallelTrainer:
                 mesh=mesh, first_fn=pipe.first_fn,
                 stage_fn=pipe.stage_fn, last_fn=pipe.last_fn,
                 stage_specs=pipe.stage_specs)
-            grads = {'shared': d_sh, 'stages': d_st}
+            grads = {'shared': shard_shared_grads(d_sh), 'stages': d_st}
             new_params, new_state = opt.apply_gradients(
                 params, grads, opt_state, step_no)
             return new_params, new_state, loss

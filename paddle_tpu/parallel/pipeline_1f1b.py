@@ -57,7 +57,8 @@ def _spec_mentions(spec, axis):
 
 def pipeline_value_and_grad(shared, stages, ids_mb, labels_mb, *, mesh,
                             first_fn, stage_fn, last_fn, stage_specs,
-                            pp_axis='pp', dp_axis='dp', tp_axis='tp'):
+                            pp_axis='pp', dp_axis='dp', tp_axis='tp',
+                            ep_axis='ep'):
     """Compute (mean loss, (d_shared, d_stages)) with 1F1B pipelining.
 
     shared      : pytree of pp-replicated params (embedding, final LN…).
@@ -76,6 +77,7 @@ def pipeline_value_and_grad(shared, stages, ids_mb, labels_mb, *, mesh,
     S = shape.get(pp_axis, 1)
     dp = shape.get(dp_axis, 1)
     tp = shape.get(tp_axis, 1)
+    ep = shape.get(ep_axis, 1)
     M = ids_mb.shape[0]
     ticks = 2 * M + 2 * S - 2
     perm_dn = [(i, (i + 1) % S) for i in range(S)]   # acts: s -> s+1
@@ -177,25 +179,29 @@ def pipeline_value_and_grad(shared, stages, ids_mb, labels_mb, *, mesh,
         if dp > 1:
             d_sh = jax.lax.psum(d_sh, dp_axis)
             d_st = jax.lax.psum(d_st, dp_axis)
-        if tp > 1:
-            # Inside shard_map, the hand-rolled jax.vjp transposes the
-            # stage_fn's row-parallel `lax.psum(..., tp)` back into a
-            # psum, so every cotangent strictly upstream of such a psum
-            # arrives multiplied by tp, and cotangents on residual
-            # paths are per-rank partials whose tp-rank-sum is tp x the
-            # true cotangent (verified empirically vs jax.grad; see
-            # tests/test_pipeline.py gradient-parity tests).  Hence:
-            #   - tp-SHARDED leaves (spec mentions tp) sit upstream of
-            #     their block's psum: the local shard gradient is
-            #     exact x tp -> divide by tp;
-            #   - tp-REPLICATED leaves carry per-rank values whose sum
-            #     over tp is tp x the true gradient -> pmean.
-            inv_tp = 1.0 / tp
-            d_sh = jax.lax.pmean(d_sh, tp_axis)
+        # Model-parallel axes (tp: Megatron row/col split; ep: expert
+        # shards).  Inside shard_map, the hand-rolled jax.vjp transposes
+        # the stage_fn's `lax.psum(..., axis)` back into a psum, so
+        # every cotangent strictly upstream of such a psum arrives
+        # multiplied by the axis size, and cotangents on residual paths
+        # are per-rank partials whose rank-sum is size x the true
+        # cotangent (verified empirically vs jax.grad; see
+        # tests/test_pipeline.py gradient-parity tests).  Hence, per
+        # axis:
+        #   - leaves SHARDED on the axis (spec mentions it) sit
+        #     upstream of their block's psum: local shard gradient is
+        #     exact x size -> divide by size;
+        #   - leaves REPLICATED on the axis carry per-rank values whose
+        #     sum over the axis is size x the true gradient -> pmean.
+        for axis, size in ((tp_axis, tp), (ep_axis, ep)):
+            if size <= 1:
+                continue
+            inv = 1.0 / size
+            d_sh = jax.lax.pmean(d_sh, axis)
             d_st = jax.tree_util.tree_map(
-                lambda g, spec: g * inv_tp
-                if _spec_mentions(spec, tp_axis)
-                else jax.lax.pmean(g, tp_axis),
+                lambda g, spec, a=axis, iv=inv: g * iv
+                if _spec_mentions(spec, a)
+                else jax.lax.pmean(g, a),
                 d_st, stage_specs)
         # re-attach the local pp dim for the out_spec gather
         d_st = jax.tree_util.tree_map(lambda g: g[None], d_st)

@@ -1,0 +1,313 @@
+"""paddle_tpu.quantization — QAT + post-training quantization.
+
+Reference analogue:
+/root/reference/python/paddle/fluid/contrib/slim/quantization/
+(imperative/qat.py:40 ImperativeQuantAware,
+post_training_quantization.py PostTrainingQuantization,
+quantization_pass.py's fake_quantize_* ops).
+
+TPU-native redesign: no graph passes, no per-op CUDA fake-quant
+kernels.  Fake quantization is a pure function with a straight-through
+estimator (custom_vjp identity gradient) inserted by WRAPPING layers —
+the wrapped model stays an ordinary Layer tree that jit/hapi/
+ParallelTrainer compile as usual, and XLA folds the quant-dequant
+chains into the surrounding matmuls.  The int8 artifact for inference
+is a state_dict of int8 weights + scales.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..core.dispatch import apply
+from ..tensor._helpers import wrap
+
+__all__ = ['fake_quant', 'FakeQuantAbsMax',
+           'FakeQuantMovingAverageAbsMax', 'QuantedLayer',
+           'ImperativeQuantAware', 'PostTrainingQuantization',
+           'quant_post_dynamic']
+
+
+def _make_fake_quant():
+    """quantize-dequantize with a straight-through gradient."""
+
+    @jax.custom_vjp
+    def fq(x, scale, qmax):
+        s = jnp.maximum(scale, 1e-8)
+        q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+        return q * s / qmax
+
+    def fwd(x, scale, qmax):
+        return fq(x, scale, qmax), (x, scale, qmax)
+
+    def bwd(res, g):
+        x, scale, qmax = res
+        # STE: pass gradients through inside the clip range, zero outside
+        s = jnp.maximum(scale, 1e-8)
+        inside = (jnp.abs(x) <= s).astype(g.dtype)
+        return (g * inside, jnp.zeros_like(scale),
+                jnp.zeros_like(qmax))
+
+    fq.defvjp(fwd, bwd)
+    return fq
+
+
+_fq = _make_fake_quant()
+
+
+def fake_quant(x, scale, bits=8):
+    """Public fake-quant op: quantize to `bits` and dequantize, with a
+    straight-through estimator for training (reference
+    fake_quantize_dequantize_abs_max)."""
+    qmax = float(2 ** (bits - 1) - 1)
+    return apply(lambda v, s: _fq(v, s, jnp.asarray(qmax, v.dtype)),
+                 wrap(x), wrap(scale), op_name='fake_quant')
+
+
+class FakeQuantAbsMax(Layer):
+    """Per-tensor dynamic abs-max fake quant (reference
+    quantization_pass.py fake_quantize_abs_max)."""
+
+    def __init__(self, bits=8, channel_wise=False, axis=0):
+        super().__init__()
+        self.bits = bits
+        self.channel_wise = channel_wise
+        self.axis = axis
+
+    def forward(self, x):
+        qmax = float(2 ** (self.bits - 1) - 1)
+
+        def fn(v):
+            if self.channel_wise:
+                red = tuple(d for d in range(v.ndim) if d != self.axis)
+                shape = [1] * v.ndim
+                shape[self.axis] = v.shape[self.axis]
+                s = jnp.max(jnp.abs(v), axis=red).reshape(shape)
+            else:
+                s = jnp.max(jnp.abs(v))
+            return _fq(v, s, jnp.asarray(qmax, v.dtype))
+
+        return apply(fn, wrap(x), op_name='fake_quant_abs_max')
+
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    """Activation fake quant with a moving-average scale (reference
+    fake_quantize_moving_average_abs_max): the scale is LEARNED state
+    during training and frozen for eval."""
+
+    def __init__(self, bits=8, moving_rate=0.9):
+        super().__init__()
+        self.bits = bits
+        self.moving_rate = moving_rate
+        self.scale = self.create_buffer(
+            'scale', jnp.asarray([0.0], jnp.float32))
+
+    def forward(self, x):
+        qmax = float(2 ** (self.bits - 1) - 1)
+        r = self.moving_rate
+        training = self.training
+
+        def fn(v, scale):
+            cur = jnp.max(jnp.abs(v)).astype(jnp.float32)
+            if training:
+                new_scale = jnp.where(scale[0] > 0,
+                                      r * scale[0] + (1 - r) * cur, cur)
+            else:
+                new_scale = jnp.where(scale[0] > 0, scale[0], cur)
+            out = _fq(v, new_scale.astype(v.dtype),
+                      jnp.asarray(qmax, v.dtype))
+            return out, new_scale[None]
+
+        out, new_scale = apply(fn, wrap(x), self.scale,
+                               op_name='fake_quant_moving_avg')
+        if self.training:
+            self.scale.value = new_scale.value \
+                if hasattr(new_scale, 'value') else new_scale
+        return out
+
+    def create_buffer(self, name, value):
+        from ..core.tensor import Tensor
+        buf = Tensor(value)
+        buf.stop_gradient = True
+        self.register_buffer(name, buf)
+        return buf
+
+
+class QuantedLayer(Layer):
+    """Wrapper installing fake quant on a layer's weight and input —
+    the dygraph QuantizedConv2D/QuantizedLinear equivalent (reference
+    imperative/quant_layers.py)."""
+
+    def __init__(self, layer, weight_bits=8, act_bits=8,
+                 weight_quantize_type='abs_max',
+                 activation_quantize_type='moving_average_abs_max',
+                 moving_rate=0.9):
+        super().__init__()
+        self.inner = layer
+        channel_wise = weight_quantize_type == 'channel_wise_abs_max'
+        # Linear weights are [in, out] -> channel axis 1; Conv [O, I, kh,
+        # kw] -> axis 0
+        w = getattr(layer, 'weight', None)
+        axis = 1 if (w is not None and len(w.shape) == 2) else 0
+        self.weight_fq = FakeQuantAbsMax(weight_bits,
+                                         channel_wise=channel_wise,
+                                         axis=axis)
+        if activation_quantize_type == 'moving_average_abs_max':
+            self.act_fq = FakeQuantMovingAverageAbsMax(act_bits,
+                                                       moving_rate)
+        else:
+            self.act_fq = FakeQuantAbsMax(act_bits)
+
+    def forward(self, x):
+        x = self.act_fq(x)
+        inner = self.inner
+        w = inner.weight
+        orig = w.value
+        # fake-quant the weight for this call; restore after (the
+        # optimizer keeps training the fp master weight)
+        fq_w = self.weight_fq(w)
+        w.value = fq_w.value if hasattr(fq_w, 'value') else fq_w
+        try:
+            out = inner(x)
+        finally:
+            w.value = orig
+        return out
+
+
+_DEFAULT_QUANTIZABLE = ('Conv2D', 'Linear')
+
+
+class ImperativeQuantAware:
+    """Rewrite a dygraph model's quantizable sublayers in place for QAT
+    (reference imperative/qat.py:40)."""
+
+    def __init__(self, quantizable_layer_type=_DEFAULT_QUANTIZABLE,
+                 weight_quantize_type='abs_max',
+                 activation_quantize_type='moving_average_abs_max',
+                 weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 **unused):
+        self.types = tuple(t if isinstance(t, str) else t.__name__
+                           for t in quantizable_layer_type)
+        self.wq = weight_quantize_type
+        self.aq = activation_quantize_type
+        self.wbits = weight_bits
+        self.abits = activation_bits
+        self.moving_rate = moving_rate
+
+    def quantize(self, model):
+        """Swap every matching sublayer for its QuantedLayer wrapper
+        in place (the reference mutates the dygraph tree the same way)."""
+        self._quantize_tree(model)
+        return model
+
+    def _quantize_tree(self, layer):
+        for name, child in list(getattr(layer, '_sub_layers',
+                                        {}).items()):
+            if type(child).__name__ in self.types \
+                    and getattr(child, 'weight', None) is not None:
+                wrapped = QuantedLayer(
+                    child, self.wbits, self.abits, self.wq, self.aq,
+                    self.moving_rate)
+                layer._sub_layers[name] = wrapped
+            else:
+                self._quantize_tree(child)
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        """Persist int8 weights + scales (the deploy artifact; the
+        reference emits a quantized inference Program)."""
+        state = {}
+        for name, layer in _named_sublayers(model):
+            if isinstance(layer, QuantedLayer):
+                w = np.asarray(layer.inner.weight.value)
+                scale = float(np.abs(w).max()) or 1e-8
+                q = np.clip(np.round(w / scale * 127), -127,
+                            127).astype(np.int8)
+                state[f'{name}.qweight'] = q
+                state[f'{name}.scale'] = np.float32(scale)
+                act_scale = getattr(layer.act_fq, 'scale', None)
+                if act_scale is not None:
+                    state[f'{name}.act_scale'] = np.asarray(
+                        act_scale.value)
+        import pickle
+        with open(path + '.quant', 'wb') as f:
+            pickle.dump(state, f)
+        return state
+
+
+def _named_sublayers(model, prefix=''):
+    for name, child in getattr(model, '_sub_layers', {}).items():
+        full = f'{prefix}.{name}' if prefix else name
+        yield full, child
+        yield from _named_sublayers(child, full)
+
+
+class PostTrainingQuantization:
+    """PTQ: run calibration batches through the model, record per-layer
+    abs-max activation scales, emit int8 weights + scales (reference
+    post_training_quantization.py, abs_max algo)."""
+
+    def __init__(self, model, data_loader=None, batch_nums=10,
+                 algo='abs_max', quantizable_op_type=_DEFAULT_QUANTIZABLE):
+        if algo not in ('abs_max',):
+            raise NotImplementedError(f'PTQ algo {algo!r}; abs_max only')
+        self.model = model
+        self.loader = data_loader
+        self.batch_nums = batch_nums
+        self.types = tuple(t if isinstance(t, str) else t.__name__
+                           for t in quantizable_op_type)
+        self._act_scales = {}
+
+    def quantize(self):
+        """Calibrate + build the quantized state dict."""
+        hooks = []
+        for name, layer in _named_sublayers(self.model):
+            if type(layer).__name__ in self.types \
+                    and getattr(layer, 'weight', None) is not None:
+                def make_hook(nm):
+                    def hook(layer, inputs):
+                        x = inputs[0]
+                        v = float(np.abs(np.asarray(
+                            x.value if hasattr(x, 'value') else x)).max())
+                        self._act_scales[nm] = max(
+                            self._act_scales.get(nm, 0.0), v)
+                    return hook
+                hooks.append(layer.register_forward_pre_hook(
+                    make_hook(name)))
+        try:
+            if self.loader is not None:
+                for i, batch in enumerate(self.loader):
+                    if i >= self.batch_nums:
+                        break
+                    xs = batch[0] if isinstance(batch, (list, tuple)) \
+                        else batch
+                    from ..core.tensor import Tensor
+                    self.model(Tensor(jnp.asarray(np.asarray(xs))))
+        finally:
+            for h in hooks:
+                h.remove()
+        out = {}
+        for name, layer in _named_sublayers(self.model):
+            if type(layer).__name__ in self.types \
+                    and getattr(layer, 'weight', None) is not None:
+                w = np.asarray(layer.weight.value)
+                scale = float(np.abs(w).max()) or 1e-8
+                out[f'{name}.qweight'] = np.clip(
+                    np.round(w / scale * 127), -127, 127).astype(np.int8)
+                out[f'{name}.scale'] = np.float32(scale)
+                if name in self._act_scales:
+                    out[f'{name}.act_scale'] = np.float32(
+                        self._act_scales[name])
+        return out
+
+    def save_quantized_model(self, save_model_path, **kw):
+        state = self.quantize()
+        import pickle
+        with open(save_model_path + '.quant', 'wb') as f:
+            pickle.dump(state, f)
+        return state
+
+
+def quant_post_dynamic(model):
+    """Weight-only dynamic quantization: int8 weights + scales, no
+    calibration (reference's WeightQuantization.quantize_weight_to_int)."""
+    return PostTrainingQuantization(model, data_loader=None).quantize()

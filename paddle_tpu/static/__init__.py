@@ -19,8 +19,9 @@ from .compat import (  # noqa: F401
     cpu_places, cuda_places, WeightNormParamAttr)
 
 from . import nn  # noqa: F401
+from . import amp  # noqa: F401
 
-__all__ = ['InputSpec', 'nn', 'Program', 'program_guard', 'default_main_program',
+__all__ = ['InputSpec', 'nn', 'amp', 'Program', 'program_guard', 'default_main_program',
            'default_startup_program', 'data', 'Executor', 'Variable',
            'enable_static', 'disable_static', 'global_scope', 'scope_guard',
            'gradients', 'append_backward', 'Print', 'py_func', 'name_scope',

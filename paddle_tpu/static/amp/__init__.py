@@ -1,0 +1,131 @@
+"""paddle.static.amp — mixed precision for static-graph Programs.
+
+Reference analogue: /root/reference/python/paddle/static/amp/__init__.py
+re-exporting fluid.contrib.mixed_precision (decorate,
+AutoMixedPrecisionLists, cast_model_to_fp16, ...).  There, decorate()
+wraps the optimizer in OptimizerWithMixedPrecision which rewrites the
+ProgramDesc with cast ops + loss scaling.
+
+TPU-native: no graph rewrite.  The recorded thunks consult the same
+dispatch-level AMP hook the eager path uses (program.py::_record_op), so
+wrapping the optimizer just pins an auto_cast policy that the Executor
+activates while it TRACES the program — XLA sees bf16 matmuls directly.
+Loss scaling is a numeric no-op in bfloat16 (8-bit exponent = fp32
+range), so the scaler settings are accepted for API parity; the
+non-finite guard lives in the trainer (parallel/engine.py).
+"""
+from ...amp import auto_cast as _auto_cast
+from ...amp import WHITE_LIST, BLACK_LIST
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ['decorate', 'AutoMixedPrecisionLists', 'CustomOpLists',
+           'fp16_guard', 'cast_model_to_fp16', 'cast_parameters_to_fp16']
+
+
+class AutoMixedPrecisionLists:
+    """White/black op lists (reference
+    fluid/contrib/mixed_precision/fp16_lists.py::AutoMixedPrecisionLists)."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        self.white_list = set(WHITE_LIST)
+        self.black_list = set(BLACK_LIST)
+        if custom_white_list:
+            self.white_list |= set(custom_white_list)
+            self.black_list -= set(custom_white_list)
+        if custom_black_list:
+            self.black_list |= set(custom_black_list)
+            self.white_list -= set(custom_black_list)
+        self.black_varnames = set(custom_black_varnames or [])
+
+
+CustomOpLists = AutoMixedPrecisionLists
+
+
+class OptimizerWithMixedPrecision(Optimizer):
+    """Decorated optimizer: minimize() records the training section as
+    usual and attaches the AMP policy to the Program; Executor._compile
+    traces under that policy (reference
+    fluid/contrib/mixed_precision/decorator.py:51)."""
+
+    def __init__(self, inner, amp_lists, level, dtype,
+                 init_loss_scaling, use_dynamic_loss_scaling):
+        self._inner = inner
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._amp_level = level
+        self._amp_dtype = dtype
+        self._init_loss_scaling = init_loss_scaling
+        self._use_dynamic_loss_scaling = use_dynamic_loss_scaling
+
+    # everything not overridden delegates to the wrapped optimizer
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def amp_policy(self):
+        return dict(enable=True,
+                    custom_white_list=self._amp_lists.white_list,
+                    custom_black_list=self._amp_lists.black_list,
+                    level=self._amp_level, dtype=self._amp_dtype)
+
+    def amp_init(self, place=None, scope=None, test_program=None,
+                 use_fp16_test=False):
+        """Reference decorator.py::amp_init casts trained fp32 params
+        for pure-fp16 runs; pure-bf16 Programs read fp32 master params
+        and cast in-graph, so this is a documented no-op."""
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        out = self._inner.minimize(loss, startup_program=startup_program,
+                                   parameters=parameters,
+                                   no_grad_set=no_grad_set)
+        prog = getattr(loss, 'program', None)
+        if prog is not None:
+            prog.amp_policy = self.amp_policy()
+            # the policy changes compiled numerics: invalidate cache
+            prog.bump()
+        return out
+
+    def step(self):
+        with _auto_cast(**self.amp_policy()):
+            self._inner.step()
+
+    def apply_gradients(self, params, grads, state, step, lr=None):
+        return self._inner.apply_gradients(params, grads, state, step,
+                                           lr=lr)
+
+    def init(self, params):
+        return self._inner.init(params)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=True, use_pure_fp16=False,
+             use_fp16_guard=None, use_bf16=None, level=None):
+    """Wrap `optimizer` for static-graph mixed precision (reference
+    static/amp re-export of mixed_precision.decorate).  use_pure_fp16
+    maps to O2 (everything not blacklisted runs low precision); default
+    is O1 white-list casting.  TPU low dtype is bfloat16."""
+    lvl = level or ('O2' if use_pure_fp16 else 'O1')
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists, lvl, 'bfloat16',
+        init_loss_scaling, use_dynamic_loss_scaling)
+
+
+def fp16_guard():
+    """Reference mixed_precision.fp16_guard marks a region for casting
+    under use_fp16_guard; equivalent here is amp.auto_cast."""
+    return _auto_cast(enable=True, level='O1')
+
+
+def cast_model_to_fp16(program, amp_lists=None, use_fp16_guard=True):
+    """Graph-rewrite API with no TPU analogue: the policy casts at
+    trace time instead.  Attach an O2 policy to the program."""
+    program.amp_policy = dict(enable=True, level='O2', dtype='bfloat16')
+    program.bump()
+    return program
+
+
+def cast_parameters_to_fp16(place, program, scope=None, to_fp16_var_names=None):
+    """No-op: parameters stay fp32 masters; in-graph casts produce the
+    bf16 compute (see module docstring)."""

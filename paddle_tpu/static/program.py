@@ -171,6 +171,7 @@ class Program:
         self.feed_vars = {}        # name -> Variable(kind='feed')
         self.side_effects = []     # [(target Variable/Tensor, source Var)]
         self.train_section = None  # (loss_var, optimizer)
+        self.amp_policy = None     # auto_cast kwargs (static.amp)
         self.random_seed = 0
         self._version = 0
         self._cache = {}
@@ -382,23 +383,32 @@ class Executor:
             compiled = self._compile(program, feed_names, fetch_vars,
                                      params)
             program._cache[key] = compiled
+        if program.amp_policy:
+            # jit traces lazily on first call: the policy must be live
+            # while the thunks run so their amp-hook consult casts the
+            # recorded ops (static.amp.decorate semantics)
+            from ..amp import auto_cast
+            amp_ctx = auto_cast(**program.amp_policy)
+        else:
+            amp_ctx = contextlib.nullcontext()
 
         side_targets = [t for t, _ in program.side_effects]
-        if train is not None:
-            loss_var, optimizer = train
-            step = optimizer._global_step + 1
-            names = _param_names(params)
-            pvals = {n: p.value for n, p in zip(names, params)}
-            svals = {n: optimizer._accumulators_for(p)
-                     for n, p in zip(names, params)}
-            fetched, new_p, new_s, side_vals = compiled(
-                feed_vals, pvals, svals, jnp.asarray(step))
-            for n, p in zip(names, params):
-                p.value = new_p[n]
-                optimizer._accumulators[id(p)] = new_s[n]
-            optimizer._global_step = step
-        else:
-            fetched, side_vals = compiled(feed_vals)
+        with amp_ctx:
+            if train is not None:
+                loss_var, optimizer = train
+                step = optimizer._global_step + 1
+                names = _param_names(params)
+                pvals = {n: p.value for n, p in zip(names, params)}
+                svals = {n: optimizer._accumulators_for(p)
+                         for n, p in zip(names, params)}
+                fetched, new_p, new_s, side_vals = compiled(
+                    feed_vals, pvals, svals, jnp.asarray(step))
+                for n, p in zip(names, params):
+                    p.value = new_p[n]
+                    optimizer._accumulators[id(p)] = new_s[n]
+                optimizer._global_step = step
+            else:
+                fetched, side_vals = compiled(feed_vals)
         # apply recorded buffer write-backs (e.g. BN running stats)
         for t, v in zip(side_targets, side_vals):
             t.value = v.astype(t.value.dtype)

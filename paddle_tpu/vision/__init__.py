@@ -2,11 +2,12 @@
 from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
+from . import ops  # noqa: F401
 
 from .models import *  # noqa: F401,F403
 from .models import __all__ as _models_all
 
-__all__ = ['datasets', 'models', 'transforms'] + list(_models_all)
+__all__ = ['datasets', 'models', 'transforms', 'ops'] + list(_models_all)
 
 
 def set_image_backend(backend):

@@ -1,0 +1,377 @@
+"""paddle.vision.ops — detection ops.
+
+Reference analogue: /root/reference/python/paddle/vision/ops.py
+(yolo_loss:31, yolo_box:242, deform_conv2d:397, DeformConv2D:731,
+read_file:790, decode_jpeg:835) — there each is a C++/CUDA op
+(yolov3_loss_op.h, yolo_box_op.h, deformable_conv_op.cu).
+
+TPU-native: every op is a batched jnp computation — the YOLO grid
+decode/target assignment vectorizes over [N, S, H, W] with no scalar
+loops (the CUDA kernels' per-thread body becomes array ops XLA tiles
+onto the VPU/MXU), and deformable conv is 4 static gathers per kernel
+tap + one einsum (see static/nn.py analogue).
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..tensor._helpers import wrap
+from ..nn.layer.layers import Layer
+from ..nn import initializer as I
+
+__all__ = ['yolo_loss', 'yolo_box', 'deform_conv2d', 'DeformConv2D',
+           'read_file', 'decode_jpeg']
+
+
+def _sce(logit, target):
+    """Sigmoid cross entropy (the reference op's SCE helper)."""
+    return jnp.maximum(logit, 0.) - logit * target \
+        + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0):
+    """Decode YOLOv3 head output into boxes+scores (reference
+    vision/ops.py:242 / yolo_box_op.h).
+
+    x: [N, S*(5+C), H, W]; img_size: [N, 2] (h, w).
+    Returns (boxes [N, H*W*S, 4] xyxy in image pixels,
+             scores [N, H*W*S, C]).
+    """
+    S = len(anchors) // 2
+    C = int(class_num)
+    anc = np.asarray(anchors, np.float32).reshape(S, 2)  # (w, h)
+
+    def fn(xv, imgs):
+        N, _, H, W = xv.shape
+        p = xv.reshape(N, S, 5 + C, H, W)
+        tx, ty = p[:, :, 0], p[:, :, 1]
+        tw, th = p[:, :, 2], p[:, :, 3]
+        conf = jax.nn.sigmoid(p[:, :, 4])                # [N,S,H,W]
+        cls = jax.nn.sigmoid(p[:, :, 5:])                # [N,S,C,H,W]
+
+        gx = jnp.arange(W, dtype=xv.dtype)[None, None, None, :]
+        gy = jnp.arange(H, dtype=xv.dtype)[None, None, :, None]
+        bias = -0.5 * (scale_x_y - 1.0)
+        cx = (jax.nn.sigmoid(tx) * scale_x_y + bias + gx) / W
+        cy = (jax.nn.sigmoid(ty) * scale_x_y + bias + gy) / H
+        in_w = downsample_ratio * W
+        in_h = downsample_ratio * H
+        aw = anc[:, 0][None, :, None, None]
+        ah = anc[:, 1][None, :, None, None]
+        bw = jnp.exp(tw) * aw / in_w
+        bh = jnp.exp(th) * ah / in_h
+
+        img_h = imgs[:, 0].astype(xv.dtype)[:, None, None, None]
+        img_w = imgs[:, 1].astype(xv.dtype)[:, None, None, None]
+        x0 = (cx - bw / 2.) * img_w
+        y0 = (cy - bh / 2.) * img_h
+        x1 = (cx + bw / 2.) * img_w
+        y1 = (cy + bh / 2.) * img_h
+        if clip_bbox:
+            x0 = jnp.clip(x0, 0., img_w - 1.)
+            y0 = jnp.clip(y0, 0., img_h - 1.)
+            x1 = jnp.clip(x1, 0., img_w - 1.)
+            y1 = jnp.clip(y1, 0., img_h - 1.)
+        keep = (conf >= conf_thresh).astype(xv.dtype)    # [N,S,H,W]
+        boxes = jnp.stack([x0, y0, x1, y1], axis=-1) \
+            * keep[..., None]                            # [N,S,H,W,4]
+        scores = cls.transpose(0, 1, 3, 4, 2) \
+            * (conf * keep)[..., None]                   # [N,S,H,W,C]
+        # reference layout: rows ordered (s, h, w)
+        return (boxes.reshape(N, S * H * W, 4),
+                scores.reshape(N, S * H * W, C))
+
+    return apply(fn, wrap(x), wrap(img_size), op_name='yolo_box')
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (reference vision/ops.py:31 /
+    yolov3_loss_op.h), fully vectorized:
+
+      * each gt box matches its best ANCHOR by wh-IoU; if that anchor
+        is in this head's anchor_mask, the gt's grid cell becomes a
+        positive: SCE on (x, y), L1 on (w, h) — both scaled by
+        2 - gw*gh — SCE objectness target 1, smoothed one-hot classes;
+      * predictions whose best IoU over the image's gt boxes exceeds
+        ignore_thresh are excluded from the negative objectness term.
+
+    x: [N, S*(5+C), H, W]; gt_box: [N, B, 4] (cx, cy, w, h in [0, 1]);
+    gt_label: [N, B] int; gt_score: [N, B] mixup weights.
+    Returns loss [N].
+    """
+    full = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask = list(anchor_mask)
+    S = len(mask)
+    C = int(class_num)
+    masked = full[mask]                                  # [S, 2]
+    smooth_pos = 1.0 - 1.0 / C if use_label_smooth and C > 1 else 1.0
+    smooth_neg = 1.0 / C if use_label_smooth and C > 1 else 0.0
+
+    ins = [wrap(x), wrap(gt_box), wrap(gt_label)]
+    if gt_score is not None:
+        ins.append(wrap(gt_score))
+
+    def fn(xv, gb, gl, *gs):
+        N, _, H, W = xv.shape
+        B = gb.shape[1]
+        in_w = float(downsample_ratio * W)
+        in_h = float(downsample_ratio * H)
+        p = xv.reshape(N, S, 5 + C, H, W)
+        px, py = p[:, :, 0], p[:, :, 1]
+        pw, ph = p[:, :, 2], p[:, :, 3]
+        pobj = p[:, :, 4]
+        pcls = p[:, :, 5:]                               # [N,S,C,H,W]
+        score = gs[0].astype(xv.dtype) if gs \
+            else jnp.ones((N, B), xv.dtype)
+
+        valid = (gb[:, :, 2] > 0.) & (gb[:, :, 3] > 0.)  # [N,B]
+
+        # ---- best anchor per gt: IoU of (w, h) at common origin -----
+        gw_pix = gb[:, :, 2] * in_w                      # [N,B]
+        gh_pix = gb[:, :, 3] * in_h
+        aw = full[:, 0][None, None, :]
+        ah = full[:, 1][None, None, :]
+        inter = jnp.minimum(gw_pix[..., None], aw) \
+            * jnp.minimum(gh_pix[..., None], ah)
+        union = gw_pix[..., None] * gh_pix[..., None] + aw * ah - inter
+        an_iou = inter / jnp.maximum(union, 1e-9)        # [N,B,A]
+        best = jnp.argmax(an_iou, axis=-1)               # [N,B]
+        mask_arr = jnp.asarray(mask)
+        in_head = (best[..., None] == mask_arr[None, None]).any(-1)
+        slot = jnp.argmax(
+            best[..., None] == mask_arr[None, None], -1)  # [N,B]
+        pos = valid & in_head
+
+        gi = jnp.clip((gb[:, :, 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gb[:, :, 1] * H).astype(jnp.int32), 0, H - 1)
+
+        # per-gt regression targets
+        tx = gb[:, :, 0] * W - gi                        # [N,B]
+        ty = gb[:, :, 1] * H - gj
+        best_aw = jnp.asarray(full[:, 0])[best]          # [N,B]
+        best_ah = jnp.asarray(full[:, 1])[best]
+        tw = jnp.log(jnp.maximum(gw_pix, 1e-9)
+                     / jnp.maximum(best_aw, 1e-9))
+        th = jnp.log(jnp.maximum(gh_pix, 1e-9)
+                     / jnp.maximum(best_ah, 1e-9))
+        box_w = 2.0 - gb[:, :, 2] * gb[:, :, 3]          # [N,B]
+
+        # gather this head's predictions at each gt's cell
+        bidx = jnp.arange(N)[:, None]
+        sel = (bidx, slot, gj, gi)
+        px_g = px[sel]                                   # [N,B]
+        py_g = py[sel]
+        pw_g = pw[sel]
+        ph_g = ph[sel]
+        pobj_g = pobj[sel]
+        pcls_g = pcls[bidx, slot, :, gj, gi]             # [N,B,C]
+
+        wpos = pos.astype(xv.dtype) * score
+        loss_xy = (_sce(px_g, tx) + _sce(py_g, ty)) * box_w * wpos
+        loss_wh = (jnp.abs(pw_g - tw) + jnp.abs(ph_g - th)) \
+            * box_w * wpos
+        onehot = jax.nn.one_hot(gl.astype(jnp.int32), C,
+                                dtype=xv.dtype)
+        target_cls = onehot * smooth_pos + (1 - onehot) * smooth_neg
+        loss_cls = _sce(pcls_g, target_cls).sum(-1) * wpos
+        loss_obj_pos = _sce(pobj_g, jnp.ones_like(pobj_g)) * wpos
+
+        # ---- negative objectness with ignore region ------------------
+        # decoded predictions [N,S,H,W,4] (normalized xywh)
+        gx = jnp.arange(W, dtype=xv.dtype)[None, None, None, :]
+        gy = jnp.arange(H, dtype=xv.dtype)[None, None, :, None]
+        bias = -0.5 * (scale_x_y - 1.0)
+        cx = (jax.nn.sigmoid(px) * scale_x_y + bias + gx) / W
+        cy = (jax.nn.sigmoid(py) * scale_x_y + bias + gy) / H
+        bw = jnp.exp(pw) * masked[:, 0][None, :, None, None] / in_w
+        bh = jnp.exp(ph) * masked[:, 1][None, :, None, None] / in_h
+        # IoU of each prediction with each gt (xywh, normalized)
+        p0x, p0y = cx - bw / 2, cy - bh / 2
+        p1x, p1y = cx + bw / 2, cy + bh / 2
+        g0x = (gb[:, :, 0] - gb[:, :, 2] / 2)
+        g0y = (gb[:, :, 1] - gb[:, :, 3] / 2)
+        g1x = (gb[:, :, 0] + gb[:, :, 2] / 2)
+        g1y = (gb[:, :, 1] + gb[:, :, 3] / 2)
+
+        def exp_pred(t):  # [N,S,H,W] -> [N,S,H,W,1]
+            return t[..., None]
+
+        def exp_gt(t):    # [N,B] -> [N,1,1,1,B]
+            return t[:, None, None, None, :]
+
+        ix0 = jnp.maximum(exp_pred(p0x), exp_gt(g0x))
+        iy0 = jnp.maximum(exp_pred(p0y), exp_gt(g0y))
+        ix1 = jnp.minimum(exp_pred(p1x), exp_gt(g1x))
+        iy1 = jnp.minimum(exp_pred(p1y), exp_gt(g1y))
+        iw = jnp.maximum(ix1 - ix0, 0.)
+        ih = jnp.maximum(iy1 - iy0, 0.)
+        inter_p = iw * ih
+        area_p = exp_pred(bw * bh)
+        area_g = exp_gt(gb[:, :, 2] * gb[:, :, 3])
+        iou = inter_p / jnp.maximum(area_p + area_g - inter_p, 1e-9)
+        iou = jnp.where(exp_gt(valid.astype(xv.dtype)) > 0, iou, 0.)
+        best_iou = iou.max(-1)                            # [N,S,H,W]
+        noobj = (best_iou <= ignore_thresh).astype(xv.dtype)
+        # positives excluded from the negative term
+        pos_map = jnp.zeros((N, S, H, W), xv.dtype)
+        pos_map = pos_map.at[sel].max(pos.astype(xv.dtype))
+        neg_w = noobj * (1.0 - pos_map)
+        loss_obj_neg = (_sce(pobj, jnp.zeros_like(pobj)) * neg_w) \
+            .sum((1, 2, 3))
+
+        per_gt = (loss_xy + loss_wh + loss_cls + loss_obj_pos).sum(-1)
+        return per_gt + loss_obj_neg
+
+    return apply(fn, *ins, op_name='yolo_loss')
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v2 (v1 when mask is None) — reference
+    vision/ops.py:397 (deformable_conv_op.cu).  Bilinear sampling at
+    offset taps = 4 static gathers per tap + one einsum (same core as
+    static.nn.deform_conv2d, but weight/bias come in as tensors).
+
+    x: [B, Cin, H, W]; offset: [B, 2*kh*kw, Ho, Wo];
+    weight: [Cout, Cin, kh, kw]; mask: [B, kh*kw, Ho, Wo].
+    """
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError(
+            'deform_conv2d: groups/deformable_groups > 1 not supported')
+    wv = wrap(weight)
+    Cout, Cin, kh, kw = wv.shape
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) \
+        else dilation
+    ins = [wrap(x), wrap(offset), wv]
+    if bias is not None:
+        ins.append(wrap(bias))
+    has_bias = bias is not None
+    if mask is not None:
+        ins.append(wrap(mask))
+    has_mask = mask is not None
+
+    def fn(v, o, wgt, *rest):
+        bv = rest[0] if has_bias else None
+        mk = rest[-1] if has_mask else None
+        B, C, H, W = v.shape
+        Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        o = o.reshape(B, kh * kw, 2, Ho, Wo)
+        base_y = (jnp.arange(Ho) * sh - ph)[None, :, None]
+        base_x = (jnp.arange(Wo) * sw - pw)[None, None, :]
+        taps = []
+        for i in range(kh):
+            for j in range(kw):
+                t = i * kw + j
+                py = base_y + i * dh + o[:, t, 0]
+                px = base_x + j * dw + o[:, t, 1]
+                y0 = jnp.floor(py)
+                x0 = jnp.floor(px)
+                wy = py - y0
+                wx = px - x0
+
+                def gather(yy, xx):
+                    yi = jnp.clip(yy.astype(jnp.int32), 0, H - 1)
+                    xi = jnp.clip(xx.astype(jnp.int32), 0, W - 1)
+                    inb = ((yy >= 0) & (yy <= H - 1) & (xx >= 0)
+                           & (xx <= W - 1)).astype(v.dtype)
+                    g = v[jnp.arange(B)[:, None, None], :, yi, xi]
+                    return g * inb[..., None]
+
+                g00 = gather(y0, x0)
+                g01 = gather(y0, x0 + 1)
+                g10 = gather(y0 + 1, x0)
+                g11 = gather(y0 + 1, x0 + 1)
+                wy_ = wy[..., None]
+                wx_ = wx[..., None]
+                tap = (g00 * (1 - wy_) * (1 - wx_)
+                       + g01 * (1 - wy_) * wx_
+                       + g10 * wy_ * (1 - wx_)
+                       + g11 * wy_ * wx_)               # [B,Ho,Wo,C]
+                if mk is not None:
+                    tap = tap * mk.reshape(
+                        B, kh * kw, Ho, Wo)[:, t][..., None]
+                taps.append(tap)
+        stacked = jnp.stack(taps, axis=3)                # [B,Ho,Wo,k,C]
+        out = jnp.einsum('bhwkc,okc->bohw', stacked,
+                         wgt.reshape(Cout, Cin, kh * kw)
+                         .transpose(0, 2, 1))
+        if bv is not None:
+            out = out + bv[None, :, None, None]
+        return out
+
+    return apply(fn, *ins, op_name='deform_conv2d')
+
+
+class DeformConv2D(Layer):
+    """Deformable conv layer (reference vision/ops.py:731): owns
+    weight/bias; offset (and mask for v2) come from a sibling conv at
+    call time."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        kh, kw = (kernel_size, kernel_size) \
+            if isinstance(kernel_size, int) else kernel_size
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        fan_in = in_channels * kh * kw
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, kh, kw],
+            attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True,
+            default_initializer=I.Uniform(-bound, bound))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, bias=self.bias, stride=self._stride,
+            padding=self._padding, dilation=self._dilation,
+            deformable_groups=self._deformable_groups,
+            groups=self._groups, mask=mask)
+
+
+def read_file(filename, name=None):
+    """Read a file's raw bytes as a uint8 tensor (reference
+    vision/ops.py:790)."""
+    from ..core.tensor import Tensor
+    with open(filename, 'rb') as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode='unchanged', name=None):
+    """Decode JPEG bytes to a [C, H, W] uint8 tensor (reference
+    vision/ops.py:835 uses nvjpeg; PIL on host here)."""
+    from ..core.tensor import Tensor
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError('decode_jpeg needs pillow in this build') from e
+    import io as _io
+    raw = np.asarray(x.value if hasattr(x, 'value') else x,
+                     np.uint8).tobytes()
+    img = Image.open(_io.BytesIO(raw))
+    if mode != 'unchanged':
+        img = img.convert(mode.upper())
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

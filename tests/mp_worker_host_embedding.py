@@ -1,0 +1,105 @@
+"""Worker script for the two-process multi-host tests.
+
+Launched (twice) by tests/test_multiprocess.py through
+`python -m paddle_tpu.distributed.launch --coordinator ...` — the
+jax.distributed rendezvous the reference covers with its fleet
+multi-process unittests (test_collective_*).  Each process drives one
+CPU device; the pair forms a global 2-device 'dp' mesh.
+
+Exercises:
+  * rendezvous: process_count()==2, global device list visible;
+  * HostOffloadEmbedding process-sharded PS semantics: each host owns
+    half the vocab, lookups route cross-host through
+    all_gather+psum, pushes land only on the owner;
+  * convergent updates: both hosts observe identical lookups after the
+    update round.
+
+Writes '<out_dir>/rank<j>.json' with the observations; the parent
+asserts.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    out_dir = sys.argv[1]
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    import paddle_tpu  # noqa: F401  (registers dispatch machinery)
+    from paddle_tpu.incubate import HostOffloadEmbedding
+
+    rank = jax.process_index()
+    res = {'rank': rank,
+           'nproc': jax.process_count(),
+           'ndevices': len(jax.devices())}
+
+    V, D = 32, 4
+    emb = HostOffloadEmbedding(V, D, learning_rate=1.0, seed=11)
+    res['row0'] = int(emb._row0)
+    res['owned_rows'] = int(len(emb.table))
+
+    # the full reference table (same seed on both hosts at init)
+    rs = np.random.RandomState(11)
+    bound = 1.0 / np.sqrt(D)
+    ref = rs.uniform(-bound, bound, (V, D)).astype('float32')
+
+    from jax.sharding import NamedSharding
+    mesh = Mesh(np.array(jax.devices()).reshape(2), ('dp',))
+    shard = NamedSharding(mesh, P('dp'))
+    repl = NamedSharding(mesh, P())
+    # each rank's batch deliberately hits BOTH halves of the vocab so
+    # every lookup exercises the cross-host route
+    my_ids = np.array([1, 17, 2, 30] if rank == 0 else
+                      [16, 3, 31, 4], dtype='int64')
+    gids = jax.make_array_from_process_local_data(shard, my_ids)
+    anchor = jax.make_array_from_process_local_data(
+        repl, np.zeros((1,), np.float32))
+
+    def fwd(idv, anchor):
+        return emb._lookup_mp(idv, anchor)
+
+    f = shard_map(fwd, mesh=mesh, in_specs=(P('dp'), P()),
+                  out_specs=P('dp'))
+    rows = jax.jit(f)(gids, anchor)
+    # the addressable output shard is THIS process's slice
+    local = np.asarray(
+        list(rows.addressable_shards)[0].data).reshape(-1, D)
+    res['lookup_ok'] = bool(np.allclose(local, ref[my_ids], atol=1e-6))
+
+    # one training push: d(sum)/d(rows) = 1 → owner subtracts lr*1
+    def loss(anchor, idv):
+        out = emb._lookup_mp(idv, anchor)
+        return jax.lax.psum(out.sum(), 'dp')
+
+    g = shard_map(loss, mesh=mesh, in_specs=(P(), P('dp')),
+                  out_specs=P())
+    jax.jit(jax.grad(g))(anchor, gids)
+    jax.effects_barrier()
+
+    # every id touched above, owned by THIS host, must have moved -1.0
+    all_ids = np.array([1, 17, 2, 30, 16, 3, 31, 4], dtype='int64')
+    mine = all_ids[(all_ids >= emb._row0)
+                   & (all_ids < emb._row0 + len(emb.table))]
+    moved = emb.table[mine - emb._row0]
+    res['push_ok'] = bool(np.allclose(moved, ref[mine] - 1.0, atol=1e-6))
+
+    # lookups AFTER the push agree across hosts (each host serves its
+    # owned, updated rows to both)
+    rows2 = jax.jit(f)(gids, anchor)
+    local2 = np.asarray(
+        list(rows2.addressable_shards)[0].data).reshape(-1, D)
+    res['post_update_ok'] = bool(
+        np.allclose(local2, ref[my_ids] - 1.0, atol=1e-6))
+
+    with open(os.path.join(out_dir, f'rank{rank}.json'), 'w') as fh:
+        json.dump(res, fh)
+
+
+if __name__ == '__main__':
+    main()

@@ -437,3 +437,110 @@ class TestNativeSlotReader:
         f.write_text('3.7 1.0\n')
         with pytest.raises(ValueError, match='bad int'):
             slotreader.parse_file(str(f), [1, 1], [True, False])
+
+
+class TestShardedHostEmbedding:
+    """Process-sharded PS path on the single-process virtual mesh: the
+    same all_gather+psum routing the two-process test
+    (test_multiprocess.py) exercises across real processes (reference
+    the_one_ps.py:417 table distribution)."""
+
+    def _mesh(self, n=8):
+        import jax
+        from jax.sharding import Mesh
+        return Mesh(np.array(jax.devices()[:n]).reshape(n), ('dp',))
+
+    def test_sharded_lookup_matches_table(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+        from paddle_tpu.incubate import HostOffloadEmbedding
+
+        emb = HostOffloadEmbedding(64, 4, learning_rate=1.0, seed=7)
+        ref = emb.table.copy()
+        mesh = self._mesh()
+        ids = np.arange(16).astype('int64')
+
+        f = shard_map(lambda i, a: emb._lookup_mp(i, a), mesh=mesh,
+                      in_specs=(P('dp'), P()), out_specs=P('dp'))
+        rows = jax.jit(f)(jnp.asarray(ids), jnp.zeros((1,), jnp.float32))
+        np.testing.assert_allclose(np.asarray(rows), ref[ids], rtol=1e-6)
+
+    def test_sharded_push_updates_owner_once(self):
+        """Each touched row moves by exactly -lr (sum loss, grad 1):
+        the first-local-partition gate must prevent double counting."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+        from paddle_tpu.incubate import HostOffloadEmbedding
+
+        emb = HostOffloadEmbedding(64, 4, learning_rate=1.0, seed=9)
+        ref = emb.table.copy()
+        mesh = self._mesh()
+        ids = np.arange(16).astype('int64')
+
+        def loss(anchor, idv):
+            out = emb._lookup_mp(idv, anchor)
+            return jax.lax.psum(out.sum(), 'dp')
+
+        f = shard_map(loss, mesh=mesh, in_specs=(P(), P('dp')),
+                      out_specs=P())
+        jax.jit(jax.grad(f))(jnp.zeros((1,), jnp.float32),
+                             jnp.asarray(ids))
+        jax.effects_barrier()
+        np.testing.assert_allclose(emb.table[ids], ref[ids] - 1.0,
+                                   rtol=1e-6)
+        # untouched rows unchanged
+        np.testing.assert_allclose(emb.table[32:], ref[32:], rtol=1e-6)
+
+    def test_duplicate_ids_accumulate(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+        from paddle_tpu.incubate import HostOffloadEmbedding
+
+        emb = HostOffloadEmbedding(64, 4, learning_rate=1.0, seed=3)
+        ref = emb.table.copy()
+        mesh = self._mesh()
+        ids = np.full((16,), 5, dtype='int64')   # one row, 16 refs
+
+        def loss(anchor, idv):
+            out = emb._lookup_mp(idv, anchor)
+            return jax.lax.psum(out.sum(), 'dp')
+
+        f = shard_map(loss, mesh=mesh, in_specs=(P(), P('dp')),
+                      out_specs=P())
+        jax.jit(jax.grad(f))(jnp.zeros((1,), jnp.float32),
+                             jnp.asarray(ids))
+        jax.effects_barrier()
+        np.testing.assert_allclose(emb.table[5], ref[5] - 16.0,
+                                   rtol=1e-5)
+
+    def test_forward_routes_by_axis_binding(self):
+        """Layer.forward picks the sharded path inside shard_map and the
+        plain path outside — same layer object."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+        from paddle_tpu.incubate import HostOffloadEmbedding
+
+        emb = HostOffloadEmbedding(32, 4, seed=5)
+        ref = emb.table.copy()
+        ids = np.arange(8).astype('int64')
+        # eager (no axis): plain path
+        out = emb(paddle.to_tensor(ids))
+        np.testing.assert_allclose(np.asarray(out.value), ref[ids],
+                                   rtol=1e-6)
+        # inside shard_map: sharded path via the same forward()
+        mesh = self._mesh()
+
+        def fn(idv, anchor):
+            return emb._lookup_mp(idv, anchor)
+        f = shard_map(fn, mesh=mesh, in_specs=(P('dp'), P()),
+                      out_specs=P('dp'))
+        rows = jax.jit(f)(jnp.asarray(ids), jnp.zeros((1,), jnp.float32))
+        np.testing.assert_allclose(np.asarray(rows), ref[ids], rtol=1e-6)

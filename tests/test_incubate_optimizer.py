@@ -1,0 +1,162 @@
+"""incubate.optimizer.LookAhead / ModelAverage + static.amp surface.
+
+Reference: /root/reference/python/paddle/incubate/optimizer/lookahead.py
+modelaverage.py, and /root/reference/python/paddle/static/amp/__init__.py.
+Closed-form step checks per VERDICT r3 item 8.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.incubate.optimizer import LookAhead, ModelAverage
+
+
+def _param_layer(init):
+    lin = nn.Linear(1, 1, bias_attr=False)
+    lin.weight.value = np.array([[init]], dtype=np.float32)
+    return lin
+
+
+class TestLookAhead:
+    def test_closed_form_sync(self):
+        """SGD lr=1, grad=1 each step; k=2, alpha=0.5: fast walks -1 per
+        step, slow syncs every 2nd step to slow+0.5*(fast-slow)."""
+        lin = _param_layer(0.0)
+        sgd = paddle.optimizer.SGD(learning_rate=1.0,
+                                   parameters=lin.parameters())
+        la = LookAhead(sgd, alpha=0.5, k=2)
+        x = paddle.to_tensor(np.ones((1, 1), np.float32))
+
+        def w():
+            return float(np.asarray(lin.weight.value).reshape(()))
+
+        vals = []
+        for i in range(4):
+            out = lin(x)          # loss = w*1 -> dL/dw = 1
+            out.backward()
+            la.step()
+            la.clear_grad()
+            vals.append(w())
+        # slow seeded from the INITIAL weight (0), sync at steps 2, 4:
+        # step1: fast=-1
+        # step2: fast=-2, slow=0+0.5*(-2-0)=-1, fast=slow=-1
+        # step3: fast=-2
+        # step4: fast=-3, slow=-1+0.5*(-3-(-1))=-2, fast=slow=-2
+        assert vals == [-1.0, -1.0, -2.0, -2.0], vals
+
+    def test_validates_args(self):
+        lin = _param_layer(0.0)
+        sgd = paddle.optimizer.SGD(learning_rate=1.0,
+                                   parameters=lin.parameters())
+        with pytest.raises(ValueError):
+            LookAhead(sgd, alpha=1.5)
+        with pytest.raises(ValueError):
+            LookAhead(sgd, k=0)
+
+    def test_converges(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 1)
+        inner = paddle.optimizer.Adam(learning_rate=0.1,
+                                      parameters=net.parameters())
+        la = LookAhead(inner, alpha=0.5, k=5)
+        X = np.random.RandomState(0).randn(32, 4).astype('float32')
+        Y = (X @ np.arange(1, 5, dtype='float32'))[:, None]
+        first = last = None
+        for _ in range(120):
+            loss = paddle.mean((net(paddle.to_tensor(X))
+                                - paddle.to_tensor(Y)) ** 2)
+            loss.backward()
+            la.step()
+            la.clear_grad()
+            last = float(loss.value)
+            first = first if first is not None else last
+        # slow-weight interpolation halves per-window progress, so the
+        # bar is looser than a bare Adam run
+        assert last < first * 0.05, (first, last)
+
+
+class TestModelAverage:
+    def test_closed_form_average(self):
+        """Weights 1,2,3 accumulated; window covers all three:
+        average = 2."""
+        lin = _param_layer(0.0)
+        ma = ModelAverage(average_window_rate=1.0,
+                          parameters=lin.parameters(),
+                          min_average_window=1, max_average_window=100)
+        for v in (1.0, 2.0, 3.0):
+            lin.weight.value = np.array([[v]], dtype=np.float32)
+            ma.step()
+        with ma.apply(need_restore=True):
+            avg = float(np.asarray(lin.weight.value).reshape(()))
+        restored = float(np.asarray(lin.weight.value).reshape(()))
+        assert avg == pytest.approx(2.0)
+        assert restored == 3.0
+
+    def test_window_shift(self):
+        """min_average_window=2, max=2: after the window closes the
+        average covers only the trailing slice like the reference
+        average_accumulates kernel."""
+        lin = _param_layer(0.0)
+        ma = ModelAverage(0.5, parameters=lin.parameters(),
+                          min_average_window=2, max_average_window=2)
+        for v in (1.0, 2.0, 3.0, 4.0, 5.0):
+            lin.weight.value = np.array([[v]], dtype=np.float32)
+            ma.step()
+        st = ma._acc[id(lin.weight)]
+        total = st['num_accumulates'] + st['old_num_accumulates']
+        with ma.apply():
+            avg = float(np.asarray(lin.weight.value).reshape(()))
+        s = float(np.asarray(
+            st['sum_1'] + st['sum_2'] + st['sum_3']).reshape(()))
+        assert avg == pytest.approx(s / total)
+
+    def test_restore_without_apply_is_noop(self):
+        lin = _param_layer(7.0)
+        ma = ModelAverage(1.0, parameters=lin.parameters(),
+                          min_average_window=1)
+        ma.restore()
+        assert float(np.asarray(lin.weight.value).reshape(())) == 7.0
+
+
+class TestStaticAmp:
+    def test_decorate_surface_and_o2_program(self):
+        """static.amp.decorate(optimizer, use_pure_fp16=True): the
+        compiled Program computes matmuls in bf16 (outputs bf16) while
+        master params stay fp32 — VERDICT r3 item 8's missing surface."""
+        paddle.enable_static()
+        try:
+            import paddle_tpu.static as static
+            main = static.Program()
+            start = static.Program()
+            with static.program_guard(main, start):
+                x = static.data('x', [4, 8], 'float32')
+                lin = nn.Linear(8, 4)
+                y = lin(x)
+                loss = paddle.mean(y * y)
+                sgd = paddle.optimizer.SGD(learning_rate=0.01)
+                opt = static.amp.decorate(sgd, use_pure_fp16=True)
+                opt.minimize(loss)
+            assert main.amp_policy is not None
+            exe = static.Executor()
+            exe.run(start)
+            rs = np.random.RandomState(0)
+            before = np.asarray(lin.weight.value).copy()
+            losses = [exe.run(main,
+                              feed={'x': rs.randn(4, 8).astype('float32')},
+                              fetch_list=[loss])[0] for _ in range(3)]
+            after = np.asarray(lin.weight.value)
+            # params trained and stayed fp32 masters
+            assert after.dtype == np.float32
+            assert not np.allclose(before, after)
+            assert all(np.isfinite(l).all() for l in losses)
+        finally:
+            paddle.disable_static()
+
+    def test_amp_lists(self):
+        import paddle_tpu.static as static
+        lists = static.amp.AutoMixedPrecisionLists(
+            custom_white_list={'my_op'}, custom_black_list={'matmul'})
+        assert 'my_op' in lists.white_list
+        assert 'matmul' in lists.black_list
+        assert 'matmul' not in lists.white_list

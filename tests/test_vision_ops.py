@@ -1,0 +1,220 @@
+"""paddle.vision.ops — yolo_box / yolo_loss / deform_conv2d.
+
+Reference: /root/reference/python/paddle/vision/ops.py:31,242,397,731
+(yolov3_loss_op.h, yolo_box_op.h, deformable_conv ops).  Numeric checks
+against closed-form decodes and plain-conv equivalence.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+def _sigmoid(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+class TestYoloBox:
+    def test_single_cell_closed_form(self):
+        """One 1x1 grid, one anchor: decode matches hand computation."""
+        C = 3
+        anchors = [32, 64]
+        x = np.zeros((1, 5 + C, 1, 1), np.float32)
+        x[0, 0, 0, 0] = 0.2     # tx
+        x[0, 1, 0, 0] = -0.4    # ty
+        x[0, 2, 0, 0] = 0.5     # tw
+        x[0, 3, 0, 0] = 0.1     # th
+        x[0, 4, 0, 0] = 2.0     # conf
+        x[0, 5:, 0, 0] = [1.0, -1.0, 0.0]
+        img = np.array([[128, 256]], np.int32)  # (h, w)
+        boxes, scores = vops.yolo_box(
+            paddle.to_tensor(x), paddle.to_tensor(img), anchors, C,
+            conf_thresh=0.01, downsample_ratio=32, clip_bbox=False)
+        boxes = np.asarray(boxes.value)
+        scores = np.asarray(scores.value)
+        cx = _sigmoid(0.2) / 1.0                 # grid W=1
+        cy = _sigmoid(-0.4) / 1.0
+        bw = np.exp(0.5) * 32 / 32.0             # input = 32*1
+        bh = np.exp(0.1) * 64 / 32.0
+        exp_box = [(cx - bw / 2) * 256, (cy - bh / 2) * 128,
+                   (cx + bw / 2) * 256, (cy + bh / 2) * 128]
+        np.testing.assert_allclose(boxes[0, 0], exp_box, rtol=1e-5)
+        exp_scores = _sigmoid(2.0) * _sigmoid(np.array([1.0, -1.0, 0.0]))
+        np.testing.assert_allclose(scores[0, 0], exp_scores, rtol=1e-5)
+
+    def test_conf_thresh_zeroes(self):
+        C = 2
+        x = np.zeros((1, (5 + C), 2, 2), np.float32)
+        x[0, 4] = -10.0                           # conf ~ 0
+        img = np.array([[64, 64]], np.int32)
+        boxes, scores = vops.yolo_box(
+            paddle.to_tensor(x), paddle.to_tensor(img), [16, 16], C,
+            conf_thresh=0.5, downsample_ratio=32)
+        assert np.abs(np.asarray(boxes.value)).max() == 0.0
+        assert np.abs(np.asarray(scores.value)).max() == 0.0
+
+    def test_clip_bbox(self):
+        C = 1
+        x = np.zeros((1, 5 + C, 1, 1), np.float32)
+        x[0, 2, 0, 0] = 3.0                       # huge w
+        x[0, 4, 0, 0] = 5.0
+        img = np.array([[32, 32]], np.int32)
+        boxes, _ = vops.yolo_box(
+            paddle.to_tensor(x), paddle.to_tensor(img), [16, 16], C,
+            conf_thresh=0.01, downsample_ratio=32, clip_bbox=True)
+        b = np.asarray(boxes.value)
+        assert b.min() >= 0.0 and b.max() <= 31.0
+
+    def test_shapes_multi_anchor(self):
+        S, C, H, W = 3, 4, 5, 5
+        x = np.random.RandomState(0).randn(
+            2, S * (5 + C), H, W).astype('float32')
+        img = np.array([[160, 160], [320, 320]], np.int32)
+        anchors = [10, 13, 16, 30, 33, 23]
+        boxes, scores = vops.yolo_box(
+            paddle.to_tensor(x), paddle.to_tensor(img), anchors, C,
+            conf_thresh=0.005, downsample_ratio=32)
+        assert list(boxes.shape) == [2, S * H * W, 4]
+        assert list(scores.shape) == [2, S * H * W, C]
+
+
+class TestYoloLoss:
+    def _setup(self, seed=0):
+        rs = np.random.RandomState(seed)
+        S, C, H, W = 3, 5, 4, 4
+        x = rs.randn(2, S * (5 + C), H, W).astype('float32') * 0.1
+        gt = np.zeros((2, 3, 4), np.float32)
+        gt[0, 0] = [0.3, 0.4, 0.2, 0.3]
+        gt[0, 1] = [0.7, 0.6, 0.4, 0.5]
+        gt[1, 0] = [0.5, 0.5, 0.1, 0.1]
+        lbl = np.array([[1, 3, 0], [2, 0, 0]], np.int64)
+        anchors = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119]
+        mask = [0, 1, 2]
+        return x, gt, lbl, anchors, mask, C
+
+    def test_loss_positive_finite_and_grad(self):
+        x, gt, lbl, anchors, mask, C = self._setup()
+        xt = paddle.to_tensor(x)
+        xt.stop_gradient = False
+        loss = vops.yolo_loss(xt, paddle.to_tensor(gt),
+                              paddle.to_tensor(lbl), anchors, mask, C,
+                              ignore_thresh=0.7, downsample_ratio=32)
+        v = np.asarray(loss.value)
+        assert v.shape == (2,)
+        assert np.isfinite(v).all() and (v > 0).all()
+        loss.sum().backward()
+        g = np.asarray(xt.grad.value)
+        assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+    def test_empty_gt_only_negative_objectness(self):
+        """No gt boxes: loss is exactly the all-negative objectness
+        term (every other part needs a positive match)."""
+        x, _, _, anchors, mask, C = self._setup()
+        gt = np.zeros((2, 3, 4), np.float32)
+        lbl = np.zeros((2, 3), np.int64)
+        loss = vops.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt),
+                              paddle.to_tensor(lbl), anchors, mask, C,
+                              ignore_thresh=0.7, downsample_ratio=32)
+        S, H, W = 3, 4, 4
+        p = x.reshape(2, S, 5 + C, H, W)
+        obj = p[:, :, 4]
+        sce = np.maximum(obj, 0) + np.log1p(np.exp(-np.abs(obj)))
+        np.testing.assert_allclose(np.asarray(loss.value),
+                                   sce.sum((1, 2, 3)), rtol=1e-5)
+
+    def test_training_reduces_loss(self):
+        """A few SGD steps on the head must reduce the loss."""
+        x, gt, lbl, anchors, mask, C = self._setup(3)
+        xt = paddle.to_tensor(x)
+        xt.stop_gradient = False
+        vals = []
+        cur = xt
+        for _ in range(12):
+            loss = vops.yolo_loss(cur, paddle.to_tensor(gt),
+                                  paddle.to_tensor(lbl), anchors, mask,
+                                  C, ignore_thresh=0.7,
+                                  downsample_ratio=32)
+            total = loss.sum()
+            total.backward()
+            vals.append(float(total.value))
+            nxt = np.asarray(cur.value) - 0.1 * np.asarray(cur.grad.value)
+            cur = paddle.to_tensor(nxt)
+            cur.stop_gradient = False
+        assert vals[-1] < vals[0] * 0.9
+
+    def test_mixup_score_scales_positive_terms(self):
+        x, gt, lbl, anchors, mask, C = self._setup()
+        kw = dict(anchors=anchors, anchor_mask=mask, class_num=C,
+                  ignore_thresh=0.7, downsample_ratio=32)
+        l1 = vops.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt),
+                            paddle.to_tensor(lbl),
+                            gt_score=paddle.to_tensor(
+                                np.ones((2, 3), np.float32)), **kw)
+        l0 = vops.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt),
+                            paddle.to_tensor(lbl), **kw)
+        np.testing.assert_allclose(np.asarray(l1.value),
+                                   np.asarray(l0.value), rtol=1e-6)
+        lz = vops.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt),
+                            paddle.to_tensor(lbl),
+                            gt_score=paddle.to_tensor(
+                                np.zeros((2, 3), np.float32)), **kw)
+        # zero mixup weight: positives vanish, negatives remain — strict
+        # drop wherever the sample had a matched gt, never an increase
+        a, b = np.asarray(lz.value), np.asarray(l0.value)
+        assert (a <= b + 1e-6).all() and (a < b - 1e-6).any()
+
+
+class TestDeformConv2D:
+    def test_zero_offset_equals_plain_conv(self):
+        """Offsets=0, mask=1 must reproduce a standard convolution."""
+        import torch
+        import torch.nn.functional as TF
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 3, 8, 8).astype('float32')
+        w = rs.randn(4, 3, 3, 3).astype('float32')
+        b = rs.randn(4).astype('float32')
+        off = np.zeros((2, 2 * 9, 8, 8), np.float32)
+        msk = np.ones((2, 9, 8, 8), np.float32)
+        out = vops.deform_conv2d(
+            paddle.to_tensor(x), paddle.to_tensor(off),
+            paddle.to_tensor(w), bias=paddle.to_tensor(b), padding=1,
+            mask=paddle.to_tensor(msk))
+        ref = TF.conv2d(torch.tensor(x), torch.tensor(w),
+                        torch.tensor(b), padding=1).numpy()
+        np.testing.assert_allclose(np.asarray(out.value), ref,
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_integer_shift_offset(self):
+        """A +1 x-offset on every tap equals convolving the shifted
+        image (interior pixels)."""
+        rs = np.random.RandomState(1)
+        x = rs.randn(1, 1, 6, 6).astype('float32')
+        w = rs.randn(1, 1, 1, 1).astype('float32')
+        off = np.zeros((1, 2, 6, 6), np.float32)
+        off[0, 1] = 1.0                           # x-offset +1
+        out = vops.deform_conv2d(
+            paddle.to_tensor(x), paddle.to_tensor(off),
+            paddle.to_tensor(w))
+        o = np.asarray(out.value)[0, 0]
+        exp = x[0, 0] * w[0, 0, 0, 0]
+        np.testing.assert_allclose(o[:, :-1], exp[:, 1:], rtol=1e-5)
+
+    def test_layer_and_grad(self):
+        paddle.seed(0)
+        layer = vops.DeformConv2D(3, 4, 3, padding=1)
+        rs = np.random.RandomState(2)
+        x = paddle.to_tensor(rs.randn(1, 3, 5, 5).astype('float32'))
+        off = paddle.to_tensor(
+            (rs.randn(1, 18, 5, 5) * 0.1).astype('float32'))
+        out = layer(x, off)
+        assert list(out.shape) == [1, 4, 5, 5]
+        out.sum().backward()
+        g = np.asarray(layer.weight.grad.value)
+        assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+    def test_read_file_and_decode(self, tmp_path):
+        p = tmp_path / 'f.bin'
+        p.write_bytes(b'\x01\x02\x03')
+        t = vops.read_file(str(p))
+        np.testing.assert_array_equal(np.asarray(t.value), [1, 2, 3])
