@@ -83,12 +83,16 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
                 bias_attr, name)
         return layer(x)
 
-    if name is None and not in_static_mode() and not _WARNED_UNNAMED[0]:
+    if not in_static_mode() and not _WARNED_UNNAMED[0]:
         _WARNED_UNNAMED[0] = True
+        why = ('without name=' if name is None
+               else 'with a custom weight_attr (no value-based cache '
+                    'identity)')
         warnings.warn(
-            'distributed.split without name= creates FRESH weights on '
-            'every eager call (reference dygraph semantics) — pass '
-            'name= to reuse one layer across steps, or use the '
-            'fleet.meta_parallel layer classes directly', stacklevel=2)
+            f'distributed.split {why} creates FRESH weights on every '
+            'eager call (reference dygraph semantics) — pass name= '
+            'without weight_attr to reuse one layer across steps, or '
+            'use the fleet.meta_parallel layer classes directly',
+            stacklevel=2)
     return _build(operation, size, axis, gather_out, weight_attr,
                   bias_attr, name)(x)

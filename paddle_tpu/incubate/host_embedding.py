@@ -271,13 +271,31 @@ class HostOffloadEmbedding(Layer):
         axis = self.shard_axis
 
         def first_local_flag():
-            # exactly one partition per PROCESS contributes (psum must
-            # see each owned row once even when a host drives several
-            # devices on the axis)
+            # GATHER dedup: exactly one partition per PROCESS on the
+            # shard axis contributes to the psum (reads are idempotent,
+            # so replicas on OTHER mesh axes may all gather their own
+            # copy — their psum is over `axis` only)
             sidx = jax.lax.axis_index(axis)
             P = jax.lax.psum(1, axis)
             local = max(1, P // max(1, self._nproc))
             return (sidx % local) == 0
+
+        def first_push_flag():
+            # PUSH dedup is stricter: the host table must update ONCE,
+            # but every device shard runs the io_callback — so also
+            # require index 0 on any other mesh axis the computation is
+            # replicated over (tp/sp/ep/pp in a hybrid mesh), else the
+            # sparse update applies once per replica (lr x tp, adagrad
+            # accumulators double-counted)
+            flag = first_local_flag()
+            for other in ('tp', 'sp', 'ep', 'pp', 'dp'):
+                if other == axis:
+                    continue
+                try:
+                    flag = flag & (jax.lax.axis_index(other) == 0)
+                except Exception:
+                    pass  # axis not bound in this trace
+            return flag
 
         def pull(ids):
             from jax.experimental import io_callback
@@ -308,7 +326,7 @@ class HostOffloadEmbedding(Layer):
                 all_g = jax.lax.all_gather(gf, axis)
                 io_callback(self._mp_push,
                             jax.ShapeDtypeStruct((), jnp.int32),
-                            first_local_flag(), all_ids, all_g,
+                            first_push_flag(), all_ids, all_g,
                             ordered=True)
             ct = np.zeros(np.shape(ids), jax.dtypes.float0)
             return (ct, jnp.zeros((1,), jnp.float32))

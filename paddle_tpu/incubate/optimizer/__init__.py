@@ -46,10 +46,10 @@ class LookAhead(Optimizer):
         if not self._slow:
             # seed slow copies from the weights BEFORE any inner update
             # (reference lookahead.py seeds the slow var from the
-            # initial param; keeps eager == functional init(params))
+            # initial param; keeps eager == functional init(params)).
+            # ALL params seed — a frozen one may unfreeze later.
             for p in self.inner_optimizer._params:
-                if not p.stop_gradient:
-                    self._slow[id(p)] = p.value
+                self._slow[id(p)] = p.value
         self.inner_optimizer.step()
         self._global_step += 1
         if self._global_step % self.k:
@@ -57,7 +57,12 @@ class LookAhead(Optimizer):
         for p in self.inner_optimizer._params:
             if p.stop_gradient:
                 continue
-            slow = self._slow[id(p)]
+            slow = self._slow.get(id(p))
+            if slow is None:
+                # param added after training started: seed now, first
+                # interpolation happens at the NEXT window
+                self._slow[id(p)] = p.value
+                continue
             slow = slow + self.alpha * (p.value - slow)
             p.value = slow
             self._slow[id(p)] = slow

@@ -20,13 +20,103 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ['flash_attention', 'can_use_pallas']
+__all__ = ['flash_attention', 'can_use_pallas', 'autotune_blocks']
 
 # tuned on v5e at T=4096 D=128: (256, 512) beats XLA's fused einsum
 # attention by ~21%; see bench history
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
+
+# -- per-shape block tuning (PERF.md round-3 lead 4) -------------------------
+# key "tq,tk,d,causal" -> [bq, bk]; populated by tools/tune_flash.py on
+# the real chip and persisted next to this module, so tuned choices
+# survive across processes.  Explicit block_q/block_k args always win.
+_TUNE_FILE = __file__.rsplit('.', 1)[0] + '_tuning.json'
+_tune_table = None
+
+
+def _load_tune_table():
+    global _tune_table
+    if _tune_table is None:
+        import json
+        import os
+        _tune_table = {}
+        if os.path.exists(_TUNE_FILE):
+            try:
+                with open(_TUNE_FILE) as f:
+                    _tune_table = {k: tuple(v)
+                                   for k, v in json.load(f).items()}
+            except (ValueError, OSError):
+                _tune_table = {}
+    return _tune_table
+
+
+def _tuned_blocks(tq, tk, d, causal):
+    table = _load_tune_table()
+    got = table.get(f'{tq},{tk},{d},{int(bool(causal))}')
+    return got if got else (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+
+
+def autotune_blocks(tq, tk, d, causal=True, dtype=jnp.bfloat16,
+                    bh=8, candidates=None, iters=8, persist=True):
+    """Time the kernel per (bq, bk) candidate ON THE LIVE DEVICE and
+    record the winner in the tuning table (the cuDNN-style heuristic
+    table the reference gets from NVIDIA, built empirically here).
+    Returns ((bq, bk), ms)."""
+    import time
+    import numpy as np
+
+    cands = candidates or [(bq, bk)
+                           for bq in (128, 256, 512)
+                           for bk in (128, 256, 512, 1024)]
+    cands = [(bq, bk) for bq, bk in cands
+             if tq % min(bq, tq) == 0 and tk % min(bk, tk) == 0
+             and can_use_pallas(tq, tk, d, bq, bk)]
+    if not cands:
+        return (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K), float('nan')
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(bh, tq, d), dtype)
+    k = jnp.asarray(rs.randn(bh, tk, d), dtype)
+    v = jnp.asarray(rs.randn(bh, tk, d), dtype)
+    scale = 1.0 / math.sqrt(d)
+    best, best_ms = None, float('inf')
+    for bq, bk in cands:
+        bq_, bk_ = min(bq, tq), min(bk, tk)
+
+        # amortize dispatch: chain the kernel in-graph (PERF.md
+        # methodology — single calls through the tunnel mis-time)
+        @jax.jit
+        def run(q, k, v, bq_=bq_, bk_=bk_):
+            # chain on Q (output shape == Q shape) so the scan carries
+            # a real data dependency between kernel invocations
+            def body(c, _):
+                return _flash(c, k, v, causal, scale, bq_, bk_), None
+            out, _ = jax.lax.scan(body, q, None, length=iters)
+            return out
+
+        try:
+            float(np.asarray(run(q, k, v)).ravel()[0])   # compile+warm
+            t0 = time.perf_counter()
+            float(np.asarray(run(q, k, v)).ravel()[0])
+            ms = (time.perf_counter() - t0) * 1000 / iters
+        except Exception:
+            continue
+        if ms < best_ms:
+            best, best_ms = (bq_, bk_), ms
+    if best is None:
+        return (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K), float('nan')
+    table = _load_tune_table()
+    table[f'{tq},{tk},{d},{int(bool(causal))}'] = best
+    if persist:
+        import json
+        try:
+            with open(_TUNE_FILE, 'w') as f:
+                json.dump({k: list(v) for k, v in table.items()}, f,
+                          indent=1)
+        except OSError:
+            pass
+    return best, best_ms
 
 
 def _reference(q, k, v, causal, scale):
@@ -329,15 +419,21 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal=False, scale=None,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+                    block_q=None, block_k=None):
     """Tiled attention over [B*H, T, D] arrays.
 
     Uses the Pallas kernel on TPU when the sequence lengths divide the
     (>=128) block sizes and D % 64 == 0 (see can_use_pallas); otherwise
     falls back to the jnp reference (identical math, differentiable
-    through XLA)."""
+    through XLA).  Block sizes resolve per shape from the autotune
+    table (tools/tune_flash.py) unless given explicitly."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if block_q is None or block_k is None:
+        tbq, tbk = _tuned_blocks(q.shape[1], k.shape[1], q.shape[2],
+                                 causal)
+        block_q = block_q or tbq
+        block_k = block_k or tbk
     bq = min(block_q, q.shape[1])
     bk = min(block_k, k.shape[1])
     if not can_use_pallas(q.shape[1], k.shape[1], q.shape[2], bq, bk):

@@ -234,11 +234,10 @@ class ImperativeQuantAware:
         return state
 
 
-def _named_sublayers(model, prefix=''):
-    for name, child in getattr(model, '_sub_layers', {}).items():
-        full = f'{prefix}.{name}' if prefix else name
-        yield full, child
-        yield from _named_sublayers(child, full)
+def _named_sublayers(model):
+    """Dotted (name, layer) pairs — the Layer system's own traversal
+    (layers.py::named_sublayers), excluding the root."""
+    return model.named_sublayers()
 
 
 class PostTrainingQuantization:

@@ -211,4 +211,12 @@ def try_import(module_name, err_msg=None):
             f"are importable") from e
 
 
-__all__ += ['deprecated', 'run_check', 'require_version', 'try_import']
+from . import unique_name  # noqa: E402,F401
+from . import download  # noqa: E402,F401
+from . import cpp_extension  # noqa: E402,F401
+from ..dataset import image as image_util  # noqa: E402,F401
+from ..profiler import Profiler  # noqa: E402,F401
+
+__all__ += ['deprecated', 'run_check', 'require_version', 'try_import',
+            'unique_name', 'download', 'cpp_extension', 'image_util',
+            'Profiler']

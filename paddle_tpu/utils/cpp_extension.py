@@ -1,0 +1,63 @@
+"""paddle.utils.cpp_extension — build/load native extensions.
+
+Reference analogue:
+/root/reference/python/paddle/utils/cpp_extension/cpp_extension.py
+(setup/CppExtension/CUDAExtension building pybind11 custom-op modules).
+
+TPU-native: the compute path is XLA — custom device kernels are Pallas,
+not C++.  What native code still buys is HOST-side speed (parsers,
+ring buffers, schedulers — see io/native/), so `load()` here compiles
+C++ sources into a shared library with the system toolchain and hands
+back a ctypes.CDLL (the same mechanism io/native uses).  pybind11 isn't
+in this image; exported functions use extern "C".
+"""
+import os
+import subprocess
+import tempfile
+
+__all__ = ['CppExtension', 'CUDAExtension', 'load', 'setup']
+
+
+def CppExtension(sources, *args, **kwargs):
+    """Describe a host C++ extension (reference cpp_extension.py
+    CppExtension); consumed by load()/setup()."""
+    return {'sources': list(sources), 'kind': 'cpp', **kwargs}
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    raise RuntimeError(
+        'CUDAExtension: no CUDA in the TPU-native build. Device kernels '
+        'are Pallas (paddle_tpu.ops); host-side native code uses '
+        'CppExtension/load.')
+
+
+def load(name, sources, extra_cxx_cflags=None, build_directory=None,
+         verbose=False, **kwargs):
+    """Compile `sources` to <name>.so and return a ctypes.CDLL
+    (reference cpp_extension.py::load builds+imports a pybind module;
+    here: extern \"C\" symbols over ctypes — zero non-baked deps)."""
+    import ctypes
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), 'paddle_tpu_extensions')
+    os.makedirs(build_dir, exist_ok=True)
+    out = os.path.join(build_dir, f'{name}.so')
+    srcs = [os.path.abspath(s) for s in sources]
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if not os.path.exists(out) or os.path.getmtime(out) < newest_src:
+        cmd = ['g++', '-O2', '-shared', '-fPIC', '-std=c++17',
+               *(extra_cxx_cflags or []), *srcs, '-o', out]
+        if verbose:
+            print(' '.join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f'cpp_extension build failed:\n{proc.stderr[-2000:]}')
+    return ctypes.CDLL(out)
+
+
+def setup(**kwargs):
+    """The reference's setuptools entry point for shipping custom-op
+    wheels; out of scope for the in-process build — use load()."""
+    raise NotImplementedError(
+        'cpp_extension.setup: package with your own setup.py; for '
+        'in-process native code use paddle_tpu.utils.cpp_extension.load')

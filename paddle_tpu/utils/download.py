@@ -1,0 +1,29 @@
+"""paddle.utils.download — weight-file cache resolution.
+
+Reference analogue: /root/reference/python/paddle/utils/download.py
+(get_weights_path_from_url downloads to ~/.cache/paddle/hapi/weights).
+Zero-egress build: resolves against the local cache and raises with the
+expected path when absent (the vision/text model zoos initialize
+randomly instead of fetching pretrained weights).
+"""
+import os
+
+__all__ = ['get_weights_path_from_url']
+
+WEIGHTS_HOME = os.path.expanduser('~/.cache/paddle/hapi/weights')
+
+
+def get_path_from_url(url, root_dir, md5sum=None, check_exist=True):
+    fname = url.split('/')[-1]
+    path = os.path.join(root_dir, fname)
+    if os.path.exists(path):
+        return path
+    raise RuntimeError(
+        f'{fname} not in local cache ({root_dir}) and this build has no '
+        f'egress to fetch {url}; place the file there manually')
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """-> local path of the cached weight file (reference
+    download.py::get_weights_path_from_url)."""
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

@@ -1,0 +1,58 @@
+"""paddle.utils.unique_name — prefix-numbered name generation.
+
+Reference analogue: /root/reference/python/paddle/fluid/unique_name.py
+(UniqueNameGenerator:25, generate:84, guard:160, switch:134) — there it
+names ProgramDesc vars; here it names parameters/ops in the lazy DAG
+and anywhere user code expects `fc_0, fc_1, ...` numbering.
+"""
+import contextlib
+
+__all__ = ['generate', 'switch', 'guard']
+
+
+class UniqueNameGenerator:
+    """Numbered names per prefix: generate('fc') -> fc_0, fc_1, ..."""
+
+    def __init__(self, prefix=''):
+        self.prefix = prefix
+        self.ids = {}
+
+    def __call__(self, key):
+        n = self.ids.get(key, 0)
+        self.ids[key] = n + 1
+        return '_'.join([self.prefix, key, str(n)]) if self.prefix \
+            else '_'.join([key, str(n)])
+
+
+generator = UniqueNameGenerator()
+
+
+def generate(key):
+    """-> '<key>_<i>' with i counting per key (reference
+    unique_name.py:84)."""
+    return generator(key)
+
+
+def switch(new_generator=None):
+    """Replace the global generator; returns the old one (reference
+    unique_name.py:134)."""
+    global generator
+    old = generator
+    generator = new_generator if new_generator is not None \
+        else UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Scope a fresh (or given) generator; restores on exit (reference
+    unique_name.py:160).  A string/bytes argument becomes the prefix."""
+    if isinstance(new_generator, str):
+        new_generator = UniqueNameGenerator(new_generator)
+    elif isinstance(new_generator, bytes):
+        new_generator = UniqueNameGenerator(new_generator.decode())
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -249,3 +249,33 @@ class TestFusedLinearGelu:
         loss.backward()
         g = m.bert.layers[0].fc.weight.grad
         assert g is not None and np.isfinite(np.asarray(g.value)).all()
+
+
+class TestFlashAutotuneTable:
+    """Per-shape block tuning table (tools/tune_flash.py populates it on
+    the real chip; here: lookup/override semantics)."""
+
+    def test_default_when_untupled(self):
+        import importlib
+        fa = importlib.import_module('paddle_tpu.ops.flash_attention')
+        assert fa._tuned_blocks(1024, 1024, 64, True) == \
+            (fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K)
+
+    def test_table_lookup_and_explicit_override(self, monkeypatch):
+        import importlib
+        fa = importlib.import_module('paddle_tpu.ops.flash_attention')
+        monkeypatch.setattr(fa, '_tune_table',
+                            {'2048,2048,128,1': (128, 256)})
+        assert fa._tuned_blocks(2048, 2048, 128, True) == (128, 256)
+        # other shapes still default
+        assert fa._tuned_blocks(4096, 4096, 128, True) == \
+            (fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K)
+
+    def test_autotune_on_cpu_is_safe(self):
+        """Without a TPU the pallas gate rejects every candidate and
+        autotune returns the defaults without touching the table."""
+        import importlib
+        fa = importlib.import_module('paddle_tpu.ops.flash_attention')
+        best, ms = fa.autotune_blocks(256, 256, 64, bh=1, iters=1,
+                                      persist=False)
+        assert best == (fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K)

@@ -224,3 +224,48 @@ class TestCompatDeviceNamespaces:
         import paddle_tpu as paddle
         assert hasattr(paddle.callbacks, 'EarlyStopping')
         assert hasattr(paddle.callbacks, 'ModelCheckpoint')
+
+
+class TestUtilsNamespace:
+    """paddle.utils additions: unique_name / cpp_extension / download
+    (reference utils/ package)."""
+
+    def test_unique_name(self):
+        from paddle_tpu.utils import unique_name
+        with unique_name.guard():
+            a = unique_name.generate('fc')
+            b = unique_name.generate('fc')
+            c = unique_name.generate('conv')
+        assert (a, b, c) == ('fc_0', 'fc_1', 'conv_0')
+        with unique_name.guard('pre'):
+            assert unique_name.generate('fc') == 'pre_fc_0'
+        # guard restored the outer generator's counters
+        with unique_name.guard():
+            assert unique_name.generate('fc') == 'fc_0'
+
+    def test_cpp_extension_load(self, tmp_path):
+        import shutil
+        import pytest as _pytest
+        if shutil.which('g++') is None:
+            _pytest.skip('no g++')
+        from paddle_tpu.utils import cpp_extension
+        src = tmp_path / 'ext.cc'
+        src.write_text(
+            'extern "C" int add3(int a) { return a + 3; }\n')
+        lib = cpp_extension.load('t_ext', [str(src)],
+                                 build_directory=str(tmp_path))
+        assert lib.add3(4) == 7
+        with _pytest.raises(RuntimeError):
+            cpp_extension.CUDAExtension(['x.cu'])
+
+    def test_download_cache_miss_raises(self):
+        import pytest as _pytest
+        from paddle_tpu.utils import download
+        with _pytest.raises(RuntimeError, match='no .*egress|not in'):
+            download.get_weights_path_from_url(
+                'https://example.com/definitely_not_cached_weights.pdparams')
+
+    def test_run_check(self, capsys):
+        import paddle_tpu as paddle
+        paddle.utils.run_check()
+        assert 'successfully' in capsys.readouterr().out
