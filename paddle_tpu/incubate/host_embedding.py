@@ -168,7 +168,17 @@ class HostOffloadEmbedding(Layer):
 
     def _apply_update(self, local_rows, g):
         """Shared sparse-update core over STORAGE-LOCAL row indices:
-        merge duplicate rows, gate by entry admission, apply the rule."""
+        merge duplicate rows, gate by entry admission, apply the rule.
+        Without admission gates the whole merge+rule runs in the native
+        C++ pass (io/native/sparse_update.cpp — the host-PS analogue of
+        the reference's C++ sparse-table optimizers); the numpy path
+        remains for entry-gated tables and odd dtypes."""
+        if self.entry is None:
+            from ..io.native import sparse_update as _native
+            if _native.apply_update(self.table, self._accum, local_rows,
+                                    g, self.learning_rate,
+                                    self.optimizer):
+                return
         uniq, inv, cnt = np.unique(local_rows, return_inverse=True,
                                    return_counts=True)
         merged = np.zeros((uniq.shape[0], self.embedding_dim),

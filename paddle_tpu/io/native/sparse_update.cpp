@@ -1,0 +1,74 @@
+// Native sparse-embedding update for the host parameter-server path.
+//
+// Reference analogue: the C++ sparse-table optimizers behind
+// fleet/runtime/the_one_ps.py (paddle's distributed table
+// sgd/adagrad rules run inside the brpc PS server).  Here the "server"
+// is the host process (incubate/host_embedding.py); its Python/numpy
+// merge (np.unique + np.add.at) dominates push latency at
+// Wide&Deep-scale batches, so the merge + rule runs natively:
+//
+//   1. argsort ids (counting via std::sort over an index array),
+//   2. merge duplicate rows' gradients in registers per run,
+//   3. apply SGD or Adagrad in place on the table (and accumulator).
+//
+// Exported with extern "C"; loaded via ctypes (buildlib.compile_cached).
+#include <algorithm>
+#include <cstdint>
+#include <cmath>
+#include <vector>
+
+extern "C" {
+
+// ids[n] (already validated in range), grads[n*D] float32.
+// opt: 0 = SGD, 1 = Adagrad (accum must be non-null, same shape as
+// table).  Returns the number of distinct rows updated.
+int64_t sparse_apply(float* table, float* accum, const int64_t* ids,
+                     const float* grads, int64_t n, int64_t D,
+                     float lr, int opt) {
+    if (n <= 0) return 0;
+    std::vector<int64_t> order(n);
+    for (int64_t i = 0; i < n; ++i) order[i] = i;
+    std::sort(order.begin(), order.end(),
+              [ids](int64_t a, int64_t b) { return ids[a] < ids[b]; });
+
+    std::vector<float> merged(D);
+    int64_t updated = 0;
+    int64_t i = 0;
+    while (i < n) {
+        const int64_t row = ids[order[i]];
+        for (int64_t d = 0; d < D; ++d) merged[d] = 0.f;
+        while (i < n && ids[order[i]] == row) {
+            const float* g = grads + order[i] * D;
+            for (int64_t d = 0; d < D; ++d) merged[d] += g[d];
+            ++i;
+        }
+        float* trow = table + row * D;
+        if (opt == 1) {
+            float* arow = accum + row * D;
+            for (int64_t d = 0; d < D; ++d) {
+                arow[d] += merged[d] * merged[d];
+                trow[d] -= lr * merged[d] /
+                           std::sqrt(arow[d] + 1e-10f);
+            }
+        } else {
+            for (int64_t d = 0; d < D; ++d)
+                trow[d] -= lr * merged[d];
+        }
+        ++updated;
+    }
+    return updated;
+}
+
+// Gather rows: out[i] = table[ids[i]] — the pull half of the PS
+// round trip (numpy fancy indexing copies through take(); this is a
+// straight memcpy per row).
+void sparse_gather(const float* table, const int64_t* ids, float* out,
+                   int64_t n, int64_t D) {
+    for (int64_t i = 0; i < n; ++i) {
+        const float* src = table + ids[i] * D;
+        float* dst = out + i * D;
+        for (int64_t d = 0; d < D; ++d) dst[d] = src[d];
+    }
+}
+
+}  // extern "C"

@@ -1,0 +1,88 @@
+"""ctypes binding for the native sparse-table update (sparse_update.cpp).
+
+Used by incubate.HostOffloadEmbedding's host push: merge duplicate ids
++ SGD/Adagrad in one native pass instead of np.unique + np.add.at.
+Degrades to the numpy path when no compiler is available.
+"""
+import ctypes
+import threading
+
+import numpy as np
+
+_lib = None
+_lib_err = None
+_lock = threading.Lock()
+
+
+def _build():
+    import os
+    from .buildlib import compile_cached
+    here = os.path.dirname(os.path.abspath(__file__))
+    lib = compile_cached(os.path.join(here, 'sparse_update.cpp'),
+                         'sparse_update')
+    lib.sparse_apply.restype = ctypes.c_int64
+    lib.sparse_apply.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_float, ctypes.c_int]
+    lib.sparse_gather.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int64, ctypes.c_int64]
+    return lib
+
+
+def available():
+    global _lib, _lib_err
+    if _lib is not None:
+        return True
+    if _lib_err is not None:
+        return False
+    with _lock:
+        if _lib is not None:
+            return True
+        try:
+            _lib = _build()
+            return True
+        except Exception as e:
+            _lib_err = e
+            return False
+
+
+def apply_update(table, accum, ids, grads, lr, optimizer):
+    """In-place merged sparse update; True when the native path ran.
+
+    table: [V, D] float32 C-contiguous; accum: same or None;
+    ids: [n] int64; grads: [n, D] float32.
+    """
+    if not available():
+        return False
+    if table.dtype != np.float32 or not table.flags['C_CONTIGUOUS']:
+        return False
+    ids = np.ascontiguousarray(ids, np.int64)
+    grads = np.ascontiguousarray(grads, np.float32)
+    opt = 1 if optimizer == 'adagrad' else 0
+    if opt == 1 and (accum is None or accum.dtype != np.float32
+                     or not accum.flags['C_CONTIGUOUS']):
+        return False
+    _lib.sparse_apply(
+        table.ctypes.data_as(ctypes.c_void_p),
+        accum.ctypes.data_as(ctypes.c_void_p) if accum is not None
+        else None,
+        ids.ctypes.data_as(ctypes.c_void_p),
+        grads.ctypes.data_as(ctypes.c_void_p),
+        ids.shape[0], table.shape[1], float(lr), opt)
+    return True
+
+
+def gather(table, ids):
+    """-> rows [n, D]; None when the native path is unavailable."""
+    if not available() or table.dtype != np.float32 \
+            or not table.flags['C_CONTIGUOUS']:
+        return None
+    ids = np.ascontiguousarray(ids, np.int64)
+    out = np.empty((ids.shape[0], table.shape[1]), np.float32)
+    _lib.sparse_gather(table.ctypes.data_as(ctypes.c_void_p),
+                       ids.ctypes.data_as(ctypes.c_void_p),
+                       out.ctypes.data_as(ctypes.c_void_p),
+                       ids.shape[0], table.shape[1])
+    return out

@@ -98,13 +98,25 @@ class CausalSelfAttention(nn.Layer):
         return None
 
     def _use_flash(self, T):
-        """Pallas flash attention: single-chip path only for now (under a
-        mesh the einsum path lets GSPMD partition attention; shard_map
-        flash integration is the ring-attention upgrade).  Dropout only
+        """Pallas flash attention on the single chip.  Dropout only
         blocks it while actually active (training mode)."""
         from ..ops.flash_attention import can_use_pallas
         dropout_active = self.training and self.attn_drop.p > 0.0
         return not dropout_active and can_use_pallas(T, T, self.head_dim)
+
+    def _flash_mesh(self, B, T):
+        """The active mesh iff flash should run UNDER it (shard_map over
+        dp/tp — ops.flash_attention.flash_attention_spmd)."""
+        dropout_active = self.training and self.attn_drop.p > 0.0
+        if dropout_active:
+            return None
+        from ..distributed import env as _env
+        from ..ops.flash_attention import can_use_pallas_spmd
+        mesh = _env.get_mesh()
+        if mesh is not None and can_use_pallas_spmd(
+                B, self.n_head, T, self.head_dim, mesh):
+            return mesh
+        return None
 
     def forward(self, x, cache=None, pos=None):
         B, T, H = x.shape
@@ -175,6 +187,14 @@ class CausalSelfAttention(nn.Layer):
                 qv, kv, vv, causal=True), q, k, v,
                 op_name='flash_attention')
             y = manipulation.reshape(y, [B, nh, T, hd])
+        elif (fmesh := self._flash_mesh(B, T)) is not None:
+            # hybrid mesh: the Pallas kernel rides dp/tp via shard_map
+            # (batch and heads shard; attention is head-independent)
+            from ..ops.flash_attention import flash_attention_spmd
+            from ..core.dispatch import apply
+            y = apply(lambda qv, kv, vv: flash_attention_spmd(
+                qv, kv, vv, fmesh, causal=True), q, k, v,
+                op_name='flash_attention_spmd')
         else:
             q = maybe_shard(q, ('dp', 'tp', None, None))
             k = maybe_shard(k, ('dp', 'tp', None, None))

@@ -1,15 +1,28 @@
 """Single source of truth for "may this op take its Pallas path?".
 
-The kernels run single-chip only for now: under a mesh the GSPMD
-partitioner owns the op (shard_map + ring-attention integration is the
-multi-chip upgrade), and off-TPU the jnp references run.
+Single-chip: kernels run directly (`pallas_backend_ok`).  Under a mesh
+the GSPMD partitioner owns most ops, but attention composes with the
+mesh through an explicit shard_map (flash_attention_spmd) — gate that
+with `pallas_tpu_ok`, which drops the no-mesh condition.
+
+PADDLE_TPU_PALLAS_INTERPRET=1 runs every kernel in Pallas interpret
+mode (pure Python, any backend) — correctness testing on the CPU mesh.
 """
+import os
+
 import jax
+
+INTERPRET = os.environ.get('PADDLE_TPU_PALLAS_INTERPRET') == '1'
+
+
+def pallas_tpu_ok():
+    """Pallas kernels may run (mesh or not)."""
+    return jax.default_backend() == 'tpu' or INTERPRET
 
 
 def pallas_backend_ok():
     from ..distributed import env as _env
-    return jax.default_backend() == 'tpu' and _env.get_mesh() is None
+    return pallas_tpu_ok() and _env.get_mesh() is None
 
 
 def pick_block_rows(n_rows, block_rows):

@@ -20,6 +20,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import _gating
+
 __all__ = ['flash_attention', 'can_use_pallas', 'autotune_blocks']
 
 # tuned on v5e at T=4096 D=128: (256, 512) beats XLA's fused einsum
@@ -196,6 +198,7 @@ def _fwd_pallas(q, k, v, scale, causal, block_q, block_k):
         block_k=block_k, num_k_blocks=tk // block_k)
     out, lse = pl.pallas_call(
         kernel,
+        interpret=_gating.INTERPRET,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
@@ -337,6 +340,7 @@ def _bwd_pallas(res, g, scale, causal, block_q, block_k):
         block_k=block_k, num_k_blocks=tk // block_k)
     dq = pl.pallas_call(
         dq_kernel,
+        interpret=_gating.INTERPRET,
         grid=(bh, tq // block_q, tk // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
@@ -357,6 +361,7 @@ def _bwd_pallas(res, g, scale, causal, block_q, block_k):
         block_k=block_k, num_q_blocks=tq // block_q)
     dk, dv = pl.pallas_call(
         dkv_kernel,
+        interpret=_gating.INTERPRET,
         grid=(bh, tk // block_k, tq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
@@ -439,3 +444,67 @@ def flash_attention(q, k, v, causal=False, scale=None,
     if not can_use_pallas(q.shape[1], k.shape[1], q.shape[2], bq, bk):
         return _reference(q, k, v, causal, scale)
     return _flash(q, k, v, causal, scale, bq, bk)
+
+
+def flash_attention_spmd(q, k, v, mesh, causal=False, scale=None,
+                         dp_axis='dp', tp_axis='tp'):
+    """Flash attention COMPOSED WITH THE MESH: q/k/v are [B, H, T, D]
+    global (GSPMD-traced) arrays; batch shards over dp, heads over tp,
+    and each shard runs the Pallas kernel on its local [B/dp * H/tp,
+    T, D] slab — attention is head-independent, so no collectives.
+
+    This closes the "single-chip only" gating of round 2: the einsum
+    attention XLA partitions automatically, but the flash kernel needs
+    this explicit shard_map to ride a hybrid mesh.
+    """
+    from jax.sharding import PartitionSpec as P
+    shard_map = jax.shard_map
+
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    shape = dict(mesh.shape)
+    dp = shape.get(dp_axis, 1)
+    tp = shape.get(tp_axis, 1)
+    spec = P(dp_axis if dp > 1 else None, tp_axis if tp > 1 else None,
+             None, None)
+
+    # resolve blocks from the tuning table against the GLOBAL T (the
+    # per-shard T is the same — only batch/heads shard)
+    T_, D_ = q.shape[2], q.shape[3]
+    bq, bk = _tuned_blocks(T_, k.shape[2], D_, causal)
+    bq, bk = min(bq, T_), min(bk, k.shape[2])
+
+    def local(qv, kv, vv):
+        B, H, T, D = qv.shape
+        # call the KERNEL directly: the caller already gated via
+        # can_use_pallas_spmd, and flash_attention's own gate would see
+        # the installed global mesh and silently fall back to the slow
+        # reference inside every shard (r3 review finding)
+        o = _flash(qv.reshape(B * H, T, D),
+                   kv.reshape(B * H, kv.shape[2], D),
+                   vv.reshape(B * H, vv.shape[2], D),
+                   causal, scale, bq, bk)
+        return o.reshape(B, H, T, D)
+
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)
+
+
+def can_use_pallas_spmd(B, H, T, d, mesh, dp_axis='dp', tp_axis='tp'):
+    """Gate for flash_attention_spmd: pallas available (mesh allowed),
+    batch/heads divide the mesh axes, and the LOCAL shapes tile."""
+    from ._gating import pallas_tpu_ok
+    if mesh is None or not pallas_tpu_ok():
+        return False
+    shape = dict(mesh.shape)
+    dp = shape.get(dp_axis, 1)
+    tp = shape.get(tp_axis, 1)
+    # other model-parallel axes must not shard attention inputs
+    if shape.get('sp', 1) > 1 or shape.get('pp', 1) > 1:
+        return False
+    if B % dp or H % tp:
+        return False
+    bq = min(DEFAULT_BLOCK_Q, T)
+    bk = min(DEFAULT_BLOCK_K, T)
+    return (T % bq == 0 and T % bk == 0 and d % 64 == 0
+            and bq >= 128 and bk >= 128)

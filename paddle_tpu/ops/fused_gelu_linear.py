@@ -25,6 +25,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import _gating
+
 __all__ = ['fused_linear_gelu']
 
 _BM, _BN, _BK = 256, 256, 512
@@ -118,6 +120,7 @@ def _mm_epilogue(x, w, b, dy, approximate, bm, bn, bk):
         operands.append(dy)
     return pl.pallas_call(
         kernel,
+        interpret=_gating.INTERPRET,
         grid=grid,
         in_specs=in_specs,
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),

@@ -12,6 +12,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import _gating
+
 __all__ = ['fused_layer_norm']
 
 _BLOCK_ROWS = 256
@@ -47,6 +49,7 @@ def _fwd_pallas(x2d, gamma, beta, eps, block_rows):
     kernel = functools.partial(_fwd_kernel, eps=eps)
     y, mean, rstd = pl.pallas_call(
         kernel,
+        interpret=_gating.INTERPRET,
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_rows, h), lambda i: (i, 0)),

@@ -9,6 +9,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from . import _gating
+
 __all__ = ['fused_softmax']
 
 _BLOCK_ROWS = 256
@@ -41,6 +43,7 @@ def _fwd_pallas(x2d, mask2d, block_rows):
     if mask2d is None:
         return pl.pallas_call(
             _kernel,
+            interpret=_gating.INTERPRET,
             grid=grid,
             in_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0))],
             out_specs=pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
@@ -48,6 +51,7 @@ def _fwd_pallas(x2d, mask2d, block_rows):
         )(x2d)
     return pl.pallas_call(
         _masked_kernel,
+        interpret=_gating.INTERPRET,
         grid=grid,
         in_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
                   pl.BlockSpec((block_rows, h), lambda i: (i, 0))],

@@ -1,0 +1,142 @@
+"""flash_attention_spmd — the Pallas kernel composed with a hybrid
+mesh (shard_map over dp/tp), validated on the virtual CPU mesh in
+Pallas INTERPRET mode (PADDLE_TPU_PALLAS_INTERPRET=1 runs the real
+kernel bodies in Python on any backend).
+
+Reference analogue: the reference's fused attention composes with its
+NCCL process groups implicitly (each rank holds its heads); here the
+shard_map makes the same head-locality explicit on the mesh.
+"""
+import importlib
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle  # noqa: F401
+
+fa = importlib.import_module('paddle_tpu.ops.flash_attention')
+
+
+@pytest.fixture()
+def interpret_mode(monkeypatch):
+    from paddle_tpu.ops import _gating
+    monkeypatch.setattr(_gating, 'INTERPRET', True)
+    yield
+
+
+def _mesh(dp, tp):
+    devs = np.array(jax.devices()[:dp * tp]).reshape(dp, tp)
+    return Mesh(devs, ('dp', 'tp'))
+
+
+class TestFlashSpmd:
+    def test_gate(self, interpret_mode):
+        mesh = _mesh(2, 2)
+        assert fa.can_use_pallas_spmd(4, 4, 256, 64, mesh)
+        assert not fa.can_use_pallas_spmd(3, 4, 256, 64, mesh)  # B%dp
+        assert not fa.can_use_pallas_spmd(4, 3, 256, 64, mesh)  # H%tp
+        assert not fa.can_use_pallas_spmd(4, 4, 100, 64, mesh)  # tile
+        assert not fa.can_use_pallas_spmd(4, 4, 256, 32, mesh)  # d
+        assert not fa.can_use_pallas_spmd(4, 4, 256, 64, None)
+
+    def test_parity_vs_reference(self, interpret_mode):
+        """Sharded kernel == unsharded reference math, causal + not."""
+        mesh = _mesh(2, 2)
+        rs = np.random.RandomState(0)
+        B, H, T, D = 2, 4, 256, 64
+        q = jnp.asarray(rs.randn(B, H, T, D), jnp.float32)
+        k = jnp.asarray(rs.randn(B, H, T, D), jnp.float32)
+        v = jnp.asarray(rs.randn(B, H, T, D), jnp.float32)
+        for causal in (True, False):
+            out = jax.jit(lambda q, k, v, c=causal: fa.flash_attention_spmd(
+                q, k, v, mesh, causal=c))(q, k, v)
+            ref = fa._reference(q.reshape(B * H, T, D),
+                                k.reshape(B * H, T, D),
+                                v.reshape(B * H, T, D), causal,
+                                1.0 / np.sqrt(D)).reshape(B, H, T, D)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=3e-5,
+                                       err_msg=f'causal={causal}')
+
+    def test_grad_parity(self, interpret_mode):
+        mesh = _mesh(2, 2)
+        rs = np.random.RandomState(1)
+        B, H, T, D = 2, 2, 128, 64
+        q = jnp.asarray(rs.randn(B, H, T, D), jnp.float32)
+        k = jnp.asarray(rs.randn(B, H, T, D), jnp.float32)
+        v = jnp.asarray(rs.randn(B, H, T, D), jnp.float32)
+
+        def f_spmd(q):
+            return fa.flash_attention_spmd(q, k, v, mesh,
+                                           causal=True).sum()
+
+        def f_ref(q):
+            return fa._reference(
+                q.reshape(B * H, T, D), k.reshape(B * H, T, D),
+                v.reshape(B * H, T, D), True, 1.0 / np.sqrt(D)).sum()
+
+        g1 = jax.jit(jax.grad(f_spmd))(q)
+        g2 = jax.grad(f_ref)(q)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   atol=3e-4)
+
+
+class TestSpmdGating:
+    def test_kernel_runs_with_global_mesh_installed(self, interpret_mode,
+                                                    monkeypatch):
+        """The r3 review's critical finding: with the GLOBAL mesh
+        installed (the production configuration), the shard_map body
+        must execute the Pallas kernel — not silently fall back to the
+        jnp reference because flash_attention's single-chip gate sees
+        the mesh."""
+        from paddle_tpu.distributed import env as dist_env
+        mesh = _mesh(2, 2)
+        monkeypatch.setattr(
+            fa, '_reference',
+            lambda *a, **k: (_ for _ in ()).throw(
+                AssertionError('reference path ran inside '
+                               'flash_attention_spmd')))
+        dist_env.set_mesh(mesh)
+        try:
+            rs = np.random.RandomState(3)
+            q = jnp.asarray(rs.randn(2, 4, 256, 64), jnp.float32)
+            out = jax.jit(lambda q: fa.flash_attention_spmd(
+                q, q, q, mesh, causal=True))(q)
+            assert np.isfinite(np.asarray(out)).all()
+        finally:
+            dist_env.set_mesh(None)
+
+    def test_gpt_attention_routes_to_spmd_flash(self, interpret_mode,
+                                                monkeypatch):
+        """GPT's attention takes the spmd-flash branch under a dp/tp
+        mesh when shapes allow (head_dim 64, T tiles)."""
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import env as dist_env
+        from paddle_tpu.models.gpt import gpt_tiny
+
+        calls = []
+        real = fa.flash_attention_spmd
+
+        def spy(*a, **k):
+            calls.append(1)
+            return real(*a, **k)
+        monkeypatch.setattr(fa, 'flash_attention_spmd', spy)
+
+        mesh = _mesh(2, 2)
+        dist_env.set_mesh(mesh)
+        try:
+            paddle.seed(0)
+            # head_dim = 256/4 = 64; T=128 tiles with bq=bk=128
+            m = gpt_tiny(hidden_size=256, num_heads=4, max_seq_len=128,
+                         dropout=0.0)
+            m.eval()
+            ids = np.random.RandomState(0).randint(
+                0, 128, (2, 128)).astype('int64')
+            out = m(paddle.to_tensor(ids))
+            assert calls, 'GPT attention never took the spmd-flash path'
+            assert np.isfinite(np.asarray(out.value)).all()
+        finally:
+            dist_env.set_mesh(None)
