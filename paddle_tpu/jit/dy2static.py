@@ -24,7 +24,9 @@ Supported rewrites: `if`/`elif`/`else` (including branches that
 `while` — including `break`/`continue`, desugared into carried/local
 flags folded into the loop condition and lax.cond guards (matching the
 reference's convert_while_loop flag technique at
-convert_operators.py:25) — and `and`/`or`/`not` inside the tests.
+convert_operators.py:25) — `for ... in range(...)` (desugared to a
+counter while; tensor bounds lower to lax.while_loop, literal steps
+only), and `and`/`or`/`not` inside the tests.
 Unsupported (the transformer bails out and the function runs with plain
 tracing, which is exactly the pre-conversion behavior): `return` inside
 a converted `while`, `break`/`continue` under with/try inside a
@@ -355,6 +357,73 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         node.orelse = self._transform_block(node.orelse, fn_exit=False)
         return node
 
+    # -- for-range desugaring (reference convert_operators.py converts
+    # tensor-ranged `for` through the same while machinery) -----------
+
+    @staticmethod
+    def _is_range_for(node):
+        return (isinstance(node, ast.For)
+                and isinstance(node.target, ast.Name)
+                and isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == 'range'
+                and not node.iter.keywords
+                and 1 <= len(node.iter.args) <= 3)
+
+    def _desugar_range_for(self, node):
+        """`for i in range(a, b, s): body` -> counter while.
+
+        The increment runs BEFORE the user body so `continue` keeps
+        python-for semantics (next item, not an infinite loop); the
+        loop variable is assigned from the counter at body entry, so
+        it holds the last executed value after the loop, like python.
+        The resulting While then converts through _rewrite_while when
+        its predicate traces (tensor range bound), or runs as plain
+        python when concrete.
+        """
+        if node.orelse:
+            raise _Unsupported('for/else on a converted range loop')
+        uid = self._uid()
+        it = f'__cf_it_{uid}'
+        args = node.iter.args
+        if len(args) == 1:
+            start, stop, step = ast.Constant(value=0), args[0], 1
+        elif len(args) == 2:
+            start, stop, step = args[0], args[1], 1
+        else:
+            start, stop = args[0], args[1]
+            s = args[2]
+            neg = (isinstance(s, ast.UnaryOp)
+                   and isinstance(s.op, ast.USub)
+                   and isinstance(s.operand, ast.Constant))
+            if isinstance(s, ast.Constant) and isinstance(s.value, int):
+                step = s.value
+            elif neg and isinstance(s.operand.value, int):
+                step = -s.operand.value
+            else:
+                raise _Unsupported(
+                    'range() step must be an integer literal in a '
+                    'converted for')
+            if step == 0:
+                raise _Unsupported('range() step of 0')
+        step_const = step if isinstance(step, int) else 1
+        cmp_op = ast.Lt() if step_const > 0 else ast.Gt()
+        test = ast.Compare(left=_name(it), ops=[cmp_op],
+                           comparators=[stop])
+        body = [
+            ast.Assign(targets=[ast.Name(id=node.target.id,
+                                         ctx=ast.Store())],
+                       value=_name(it)),
+            ast.Assign(targets=[_name(it, ast.Store())],
+                       value=ast.BinOp(left=_name(it), op=ast.Add(),
+                                       right=ast.Constant(
+                                           value=step_const))),
+        ] + list(node.body)
+        return [
+            ast.Assign(targets=[_name(it, ast.Store())], value=start),
+            ast.While(test=test, body=body, orelse=[]),
+        ]
+
     def visit_With(self, node):
         node.body = self._transform_block(node.body, fn_exit=False)
         return node
@@ -564,6 +633,14 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 continue
             if isinstance(s, ast.While):
                 out.extend(self._rewrite_while(s))
+                i += 1
+                continue
+            if self._is_range_for(s):
+                # desugar to a counter while and convert THAT (tensor
+                # range bounds lower to lax.while_loop; concrete ones
+                # run as plain python inside convert_while_loop)
+                out.extend(self._transform_block(
+                    self._desugar_range_for(s), fn_exit=False))
                 i += 1
                 continue
             out.append(self.visit(s))

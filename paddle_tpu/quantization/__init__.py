@@ -25,7 +25,7 @@ from ..tensor._helpers import wrap
 __all__ = ['fake_quant', 'FakeQuantAbsMax',
            'FakeQuantMovingAverageAbsMax', 'QuantedLayer',
            'ImperativeQuantAware', 'PostTrainingQuantization',
-           'quant_post_dynamic']
+           'quant_post_dynamic', 'load_quantized_model']
 
 
 def _make_fake_quant():
@@ -310,3 +310,34 @@ def quant_post_dynamic(model):
     """Weight-only dynamic quantization: int8 weights + scales, no
     calibration (reference's WeightQuantization.quantize_weight_to_int)."""
     return PostTrainingQuantization(model, data_loader=None).quantize()
+
+
+def load_quantized_model(model, path):
+    """Load a `.quant` artifact back onto `model`: int8 weights
+    dequantize through their scales into the live fp parameters —
+    weight-only int8 inference (the reference's quantized inference
+    Program reads the same scales from its ProgramDesc attrs).
+
+    `model` must have the same layer names as the saver (wrapped
+    QuantedLayers load into `<name>.inner`)."""
+    import pickle
+    with open(path + '.quant', 'rb') as f:
+        state = pickle.load(f)
+    layers = dict(_named_sublayers(model))
+    n = 0
+    for key, q in state.items():
+        if not key.endswith('.qweight'):
+            continue
+        name = key[:-len('.qweight')]
+        scale = state[name + '.scale']
+        target = layers.get(name)
+        if target is None:
+            raise KeyError(f'{name!r} not found in model')
+        if isinstance(target, QuantedLayer):
+            target = target.inner
+        w = np.asarray(q, np.float32) * float(scale) / 127.0
+        target.weight.value = jnp.asarray(w, target.weight.value.dtype)
+        n += 1
+    if n == 0:
+        raise ValueError(f'no quantized weights in {path}.quant')
+    return model

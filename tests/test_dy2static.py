@@ -351,3 +351,90 @@ class TestFallbacks:
         # conversion bails; plain tracing of a tensor `if` raises the
         # standard tracer-bool error
         assert convert_control_flow(fn) is fn
+
+
+class TestForRangeConversion:
+    """Tensor-ranged `for` loops convert through the while machinery
+    (reference convert_operators converts for-range the same way)."""
+
+    def test_concrete_range_unchanged_semantics(self):
+        def fn(x):
+            s = x * 0.0
+            for i in range(4):
+                s = s + x * i
+            return s + i  # loop var visible after, python semantics
+
+        st = to_static(fn)
+        np.testing.assert_allclose(
+            np.asarray(st(_t([1.0])).numpy()), [1.0 * 6 + 3])
+
+    def test_tensor_range_compiles(self):
+        def fn(x):
+            n = x.sum()            # traced bound
+            s = x.sum() * 0.0
+            for i in range(n):
+                s = s + i
+            return s
+
+        st = to_static(fn)
+        out = float(np.asarray(st(_t([2.0, 3.0])).numpy()).reshape(()))
+        assert out == sum(range(5))
+
+    def test_tensor_range_with_break(self):
+        def fn(x):
+            s = x.sum() * 0.0
+            for i in range(x.sum()):
+                if i > 2.0:
+                    break
+                s = s + i
+            return s
+
+        st = to_static(fn)
+        out = float(np.asarray(st(_t([10.0])).numpy()).reshape(()))
+        assert out == 0 + 1 + 2
+
+    def test_tensor_range_with_continue(self):
+        def fn(x):
+            s = x.sum() * 0.0
+            for i in range(x.sum()):
+                if i == 1.0:
+                    continue
+                s = s + i
+            return s
+
+        st = to_static(fn)
+        out = float(np.asarray(st(_t([4.0])).numpy()).reshape(()))
+        assert out == 0 + 2 + 3
+
+    def test_range_start_stop_step(self):
+        def fn(x):
+            s = x.sum() * 0.0
+            for i in range(1, x.sum(), 2):
+                s = s + i
+            return s
+
+        st = to_static(fn)
+        out = float(np.asarray(st(_t([4.0, 4.0])).numpy()).reshape(()))
+        assert out == 1 + 3 + 5 + 7
+
+    def test_negative_literal_step(self):
+        def fn(x):
+            s = x.sum() * 0.0
+            for i in range(x.sum(), 0.0, -1):
+                s = s + i
+            return s
+
+        st = to_static(fn)
+        out = float(np.asarray(st(_t([2.0, 2.0])).numpy()).reshape(()))
+        assert out == 4 + 3 + 2 + 1
+
+    def test_non_range_for_untouched(self):
+        def fn(x):
+            s = x * 0.0
+            for v in [1.0, 2.0]:   # list iteration: plain python
+                s = s + v * x
+            return s
+
+        st = to_static(fn)
+        np.testing.assert_allclose(
+            np.asarray(st(_t([1.0])).numpy()), [3.0])

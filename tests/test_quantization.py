@@ -134,3 +134,34 @@ class TestPTQ:
         m2 = nn.Sequential(nn.Linear(4, 4))
         state = Q.quant_post_dynamic(m2)
         assert any(k.endswith('.qweight') for k in state)
+
+
+class TestLoadQuantized:
+    def test_roundtrip_load(self, tmp_path):
+        paddle.seed(3)
+        m = nn.Sequential(nn.Linear(6, 6), nn.ReLU(), nn.Linear(6, 2))
+        qat = Q.ImperativeQuantAware()
+        qat.quantize(m)
+        x = np.random.RandomState(3).randn(4, 6).astype('float32')
+        m(paddle.to_tensor(x))
+        path = str(tmp_path / 'm')
+        qat.save_quantized_model(m, path)
+
+        paddle.seed(99)  # different init
+        m2 = nn.Sequential(nn.Linear(6, 6), nn.ReLU(), nn.Linear(6, 2))
+        Q.ImperativeQuantAware().quantize(m2)
+        Q.load_quantized_model(m2, path)
+        # dequantized weights ≈ the saved model's (within int8 grid)
+        w1 = np.asarray(m.sublayers()[0].inner.weight.value)
+        w2 = np.asarray(m2.sublayers()[0].inner.weight.value)
+        assert np.abs(w1 - w2).max() <= np.abs(w1).max() / 100
+
+    def test_load_missing_layer_raises(self, tmp_path):
+        import pickle
+        path = str(tmp_path / 'x')
+        with open(path + '.quant', 'wb') as f:
+            pickle.dump({'ghost.qweight': np.zeros((2, 2), np.int8),
+                         'ghost.scale': np.float32(1.0)}, f)
+        m = nn.Sequential(nn.Linear(2, 2))
+        with pytest.raises(KeyError):
+            Q.load_quantized_model(m, path)
