@@ -15,10 +15,29 @@ __all__ = ['ParallelEnv', 'get_rank', 'get_world_size', 'get_mesh',
            'set_mesh', 'build_mesh', 'default_mesh_devices']
 
 _global_mesh = None
+_recent_real = []   # last ≤2 real meshes set to None, bridges A→None→B
 
 
 def set_mesh(mesh):
-    global _global_mesh
+    global _global_mesh, _recent_real
+    if mesh is not _global_mesh:
+        # bound the eager split() layer cache: correctness comes from
+        # the mesh in the cache KEY; eviction only stops unbounded
+        # growth across many topologies.  Entries for the incoming and
+        # outgoing meshes are KEPT so a program alternating between a
+        # train and an aux mesh does not lose trained weights — and
+        # meshes torn down via set_mesh(None) (the finally-block
+        # pattern the dryruns use) stay in a 2-deep recent window, so
+        # A → None → B → None → A keeps A's trained weights too
+        from . import mp_ops as _mp_ops
+        keep = {mesh, _global_mesh, None} | set(_recent_real)
+        for k in [k for k in _mp_ops._LAYER_CACHE
+                  if k[-1] not in keep]:
+            del _mp_ops._LAYER_CACHE[k]
+        if mesh is None and _global_mesh is not None:
+            _recent_real = ([_global_mesh]
+                            + [m for m in _recent_real
+                               if m is not _global_mesh])[:2]
     _global_mesh = mesh
 
 

@@ -74,8 +74,13 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
     # either poison (id reuse) or silently ignore a new initializer.
     if name is not None and not in_static_mode() and weight_attr is None:
         from ..core import rng as _rng
+        # mesh identity in the key: after re-init with another tp
+        # degree, a cached layer would keep stale per-shard weight
+        # shapes/shardings (Mesh hashes by devices+axis names; set_mesh
+        # additionally evicts the cache on every topology change)
         key = (name, operation, tuple(size), axis, num_partitions,
-               gather_out, bias_attr is not False, _rng.get_seed())
+               gather_out, bias_attr is not False, _rng.get_seed(),
+               mesh)
         layer = _LAYER_CACHE.get(key)
         if layer is None:
             layer = _LAYER_CACHE[key] = _build(

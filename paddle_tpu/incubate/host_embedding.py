@@ -57,6 +57,17 @@ from ..tensor._helpers import wrap
 __all__ = ['HostOffloadEmbedding']
 
 
+def first_flags_from_procs(procs):
+    """Given the owning process index of every shard along an axis
+    (`procs`: int32 [P]), return bool [P]: True where that position is
+    the FIRST along the axis owned by its process.  Pure jnp so the
+    dedup flags can be derived in-graph from the actual runtime layout
+    (any device order, per-psum-group) instead of assuming contiguous
+    process blocks."""
+    eq = procs[:, None] == procs[None, :]            # [P, P]
+    return ~jnp.any(jnp.tril(eq, -1), axis=1)
+
+
 class HostOffloadEmbedding(Layer):
     """Embedding with a host-resident table and host-side sparse update.
 
@@ -71,16 +82,31 @@ class HostOffloadEmbedding(Layer):
 
     def __init__(self, num_embeddings, embedding_dim, learning_rate=0.01,
                  optimizer='sgd', trainable=True, dtype='float32',
-                 seed=None, entry=None, shard_axis='dp'):
+                 seed=None, entry=None, shard_axis='dp',
+                 replicated_axes=('tp', 'ep', 'pp')):
         super().__init__()
         if optimizer not in ('sgd', 'adagrad'):
             raise ValueError(f'unsupported host optimizer {optimizer!r}')
+        for ax in ('dp', 'sp'):
+            if ax in replicated_axes:
+                raise ValueError(
+                    f'{ax!r} cannot be a replicated axis: its shards '
+                    'hold different data (dp: different batches, sp: '
+                    'different sequence chunks), so their embedding '
+                    'gradients are distinct updates, not replicas')
         self.num_embeddings = int(num_embeddings)
         self.embedding_dim = int(embedding_dim)
         self.learning_rate = float(learning_rate)
         self.optimizer = optimizer
         self.trainable = trainable
         self.shard_axis = shard_axis
+        # axes whose shards compute IDENTICAL embedding grads (the
+        # push dedups over them).  Default: tp (Megatron activations
+        # are tp-replicated at the embedding), ep (experts shard, the
+        # surrounding activations are replicated), pp (stage-gated
+        # replicas).  dp and sp are NEVER replicated — their shards
+        # see different batches / sequence chunks.
+        self.replicated_axes = tuple(replicated_axes)
         self._np_dtype = np.dtype(dtype)
         if seed is None:
             from ..core import rng as rng_mod
@@ -208,11 +234,22 @@ class HostOffloadEmbedding(Layer):
         """Bool mask of global ids whose rows live in THIS storage."""
         return (ids >= self._row0) & (ids < self._row0 + len(self.table))
 
-    def _mp_gather(self, first_local, all_ids):
+    def _mp_gather(self, first_local, nseen, all_ids):
         """Contribution of this host to the axis-wide psum: rows it
         owns, zeros elsewhere.  `first_local` is 1 on exactly one
         partition per process (see _build_lookup_mp) so multi-device
-        hosts don't contribute the same row L times."""
+        hosts don't contribute the same row L times.  `nseen` is the
+        number of distinct processes visible along the shard axis in
+        this psum group — every process owns table rows, so fewer than
+        `_nproc` means some rows are unreachable from this group."""
+        if int(nseen) != max(1, self._nproc):
+            raise RuntimeError(
+                f'HostOffloadEmbedding: only {int(nseen)} of '
+                f'{self._nproc} table-owning processes have a device '
+                f'on mesh axis {self.shard_axis!r} in this psum '
+                'group; their rows would be missing from the lookup. '
+                'Lay out the mesh so every process appears along the '
+                'shard axis in every slice of the other axes.')
         all_ids = self._check_ids(all_ids)         # [P, B]
         P, B = all_ids.shape
         out = np.zeros((P, B, self.embedding_dim), self._np_dtype)
@@ -230,6 +267,14 @@ class HostOffloadEmbedding(Layer):
         """Apply this host's owned slice of the axis-wide grads."""
         if not int(first_local):
             return np.zeros((), np.int32)
+        all_ids = np.asarray(all_ids)
+        all_g = np.asarray(all_g)
+        if self.shard_axis in self.replicated_axes:
+            # shards along a REPLICATED axis computed identical grads,
+            # so the axis-gather holds P copies of one update — apply
+            # a single slice (distinct-data axes like 'dp' keep every
+            # slice: each is a different batch's gradient)
+            all_ids, all_g = all_ids[:1], all_g[:1]
         flat = self._check_ids(all_ids).reshape(-1)
         g = np.asarray(all_g, self._np_dtype).reshape(
             -1, self.embedding_dim)
@@ -280,25 +325,41 @@ class HostOffloadEmbedding(Layer):
         dt = jnp.dtype(self._np_dtype)
         axis = self.shard_axis
 
-        def first_local_flag():
-            # GATHER dedup: exactly one partition per PROCESS on the
-            # shard axis contributes to the psum (reads are idempotent,
-            # so replicas on OTHER mesh axes may all gather their own
-            # copy — their psum is over `axis` only)
-            sidx = jax.lax.axis_index(axis)
-            P = jax.lax.psum(1, axis)
-            local = max(1, P // max(1, self._nproc))
-            return (sidx % local) == 0
+        def axis_first_flags():
+            """(my_flag, nseen): GATHER dedup — exactly one partition
+            per PROCESS on the shard axis contributes to the psum
+            (reads are idempotent, so replicas on OTHER mesh axes may
+            all gather their own copy — their psum is over `axis`
+            only).  The flags are derived at RUNTIME from the shards'
+            actual owning processes (io_callback → all_gather), so any
+            device→process layout is handled — including orders that
+            interleave processes or differ between psum groups, where
+            a contiguous-block assumption would silently double- or
+            zero-count rows.  `nseen` (distinct processes visible on
+            the axis in this group) lets the host validate that no
+            table shard is unreachable."""
+            from jax.experimental import io_callback
+            pid = io_callback(
+                lambda: np.int32(jax.process_index()),
+                jax.ShapeDtypeStruct((), jnp.int32), ordered=False)
+            procs = jax.lax.all_gather(pid, axis)        # [P]
+            firsts = first_flags_from_procs(procs)
+            nseen = jnp.sum(firsts).astype(jnp.int32)
+            return firsts[jax.lax.axis_index(axis)], nseen
 
         def first_push_flag():
-            # PUSH dedup is stricter: the host table must update ONCE,
-            # but every device shard runs the io_callback — so also
-            # require index 0 on any other mesh axis the computation is
-            # replicated over (tp/sp/ep/pp in a hybrid mesh), else the
-            # sparse update applies once per replica (lr x tp, adagrad
-            # accumulators double-counted)
-            flag = first_local_flag()
-            for other in ('tp', 'sp', 'ep', 'pp', 'dp'):
+            # PUSH dedup is stricter: the host table must update ONCE
+            # per DISTINCT gradient, but every device shard runs the
+            # io_callback — so also require index 0 on mesh axes the
+            # computation is REPLICATED over (self.replicated_axes,
+            # default tp/sp/ep/pp), else the sparse update applies once
+            # per replica (lr x tp, adagrad accumulators
+            # double-counted).  'dp' is never in the set: data-parallel
+            # ranks hold DIFFERENT batches, so each rank's grads are a
+            # distinct update that must land (gating on dp==0 would
+            # silently train on 1/dp of the data)
+            flag, _ = axis_first_flags()
+            for other in self.replicated_axes:
                 if other == axis:
                     continue
                 try:
@@ -312,10 +373,11 @@ class HostOffloadEmbedding(Layer):
             flat = ids.reshape(-1)
             all_ids = jax.lax.all_gather(flat, axis)        # [P, B]
             P = all_ids.shape[0]
+            flag, nseen = axis_first_flags()
             contrib = io_callback(
                 self._mp_gather,
                 jax.ShapeDtypeStruct((P, flat.shape[0], D), dt),
-                first_local_flag(), all_ids, ordered=False)
+                flag, nseen, all_ids, ordered=False)
             rows = jax.lax.psum(contrib, axis)
             mine = rows[jax.lax.axis_index(axis)]
             return mine.reshape(ids.shape + (D,))

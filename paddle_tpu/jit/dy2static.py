@@ -408,8 +408,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 raise _Unsupported('range() step of 0')
         step_const = step if isinstance(step, int) else 1
         cmp_op = ast.Lt() if step_const > 0 else ast.Gt()
+        # hoist the stop into a temp evaluated ONCE before the loop —
+        # python evaluates range() bounds once, so a body that mutates
+        # a variable used in the bound must not change iteration count
+        stop_name = f'__cf_stop_{uid}'
         test = ast.Compare(left=_name(it), ops=[cmp_op],
-                           comparators=[stop])
+                           comparators=[_name(stop_name)])
         body = [
             ast.Assign(targets=[ast.Name(id=node.target.id,
                                          ctx=ast.Store())],
@@ -421,6 +425,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         ] + list(node.body)
         return [
             ast.Assign(targets=[_name(it, ast.Store())], value=start),
+            ast.Assign(targets=[_name(stop_name, ast.Store())],
+                       value=stop),
             ast.While(test=test, body=body, orelse=[]),
         ]
 

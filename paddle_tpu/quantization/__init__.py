@@ -55,6 +55,19 @@ def _make_fake_quant():
 _fq = _make_fake_quant()
 
 
+def _channel_scale(w, axis, xp=jnp):
+    """Per-channel abs-max scale along `axis`, shaped for broadcast
+    against `w`.  The SINGLE definition of the channel-wise grid: both
+    the QAT fake-quant (training) and the deploy artifact (save) use
+    it, so the deployed quantization provably matches what training
+    simulated."""
+    red = tuple(d for d in range(w.ndim) if d != axis)
+    shape = [1] * w.ndim
+    shape[axis] = w.shape[axis]
+    return xp.maximum(xp.max(xp.abs(w), axis=red),
+                      1e-8).reshape(shape)
+
+
 def fake_quant(x, scale, bits=8):
     """Public fake-quant op: quantize to `bits` and dequantize, with a
     straight-through estimator for training (reference
@@ -79,10 +92,7 @@ class FakeQuantAbsMax(Layer):
 
         def fn(v):
             if self.channel_wise:
-                red = tuple(d for d in range(v.ndim) if d != self.axis)
-                shape = [1] * v.ndim
-                shape[self.axis] = v.shape[self.axis]
-                s = jnp.max(jnp.abs(v), axis=red).reshape(shape)
+                s = _channel_scale(v, self.axis)
             else:
                 s = jnp.max(jnp.abs(v))
             return _fq(v, s, jnp.asarray(qmax, v.dtype))
@@ -219,11 +229,29 @@ class ImperativeQuantAware:
         for name, layer in _named_sublayers(model):
             if isinstance(layer, QuantedLayer):
                 w = np.asarray(layer.inner.weight.value)
-                scale = float(np.abs(w).max()) or 1e-8
-                q = np.clip(np.round(w / scale * 127), -127,
-                            127).astype(np.int8)
+                if layer.weight_fq.channel_wise:
+                    # per-channel scales along the SAME axis (and via
+                    # the same helper) the QAT fake-quant simulated —
+                    # a single per-tensor scale here would deploy
+                    # coarser quantization than was trained for
+                    scale = _channel_scale(
+                        w, layer.weight_fq.axis,
+                        xp=np).astype(np.float32)
+                else:
+                    scale = np.float32(float(np.abs(w).max()) or 1e-8)
+                # the artifact's grid must be the one QAT simulated:
+                # qmax from the layer's weight_bits, not a fixed 127
+                bits = layer.weight_fq.bits
+                if bits > 8:
+                    raise ValueError(
+                        f'cannot store {bits}-bit weights in the int8 '
+                        'artifact')
+                qmax = float(2 ** (bits - 1) - 1)
+                q = np.clip(np.round(w / scale * qmax), -qmax,
+                            qmax).astype(np.int8)
                 state[f'{name}.qweight'] = q
-                state[f'{name}.scale'] = np.float32(scale)
+                state[f'{name}.scale'] = scale
+                state[f'{name}.qmax'] = np.float32(qmax)
                 act_scale = getattr(layer.act_fq, 'scale', None)
                 if act_scale is not None:
                     state[f'{name}.act_scale'] = np.asarray(
@@ -335,7 +363,12 @@ def load_quantized_model(model, path):
             raise KeyError(f'{name!r} not found in model')
         if isinstance(target, QuantedLayer):
             target = target.inner
-        w = np.asarray(q, np.float32) * float(scale) / 127.0
+        # scale is a scalar (per-tensor) or a broadcast-shaped vector
+        # (channel_wise_abs_max: one scale per output channel); qmax
+        # defaults to 127 for artifacts predating the qmax field
+        qmax = float(state.get(name + '.qmax', 127.0))
+        w = (np.asarray(q, np.float32)
+             * np.asarray(scale, np.float32) / qmax)
         target.weight.value = jnp.asarray(w, target.weight.value.dtype)
         n += 1
     if n == 0:

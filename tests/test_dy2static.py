@@ -368,6 +368,21 @@ class TestForRangeConversion:
         np.testing.assert_allclose(
             np.asarray(st(_t([1.0])).numpy()), [1.0 * 6 + 3])
 
+    def test_range_bound_evaluated_once(self):
+        # python evaluates range() bounds ONCE; a body mutating a
+        # variable used in the bound must not change iteration count
+        def fn(x):
+            n = 4
+            s = x * 0.0
+            for i in range(n):
+                n -= 1
+                s = s + i
+            return s
+
+        st = to_static(fn)
+        out = float(np.asarray(st(_t([0.0])).numpy()).reshape(()))
+        assert out == float(sum(range(4)))  # NOT the re-evaluated 0+1
+
     def test_tensor_range_compiles(self):
         def fn(x):
             n = x.sum()            # traced bound

@@ -657,3 +657,105 @@ class TestNativeSparseUpdate:
                                    rtol=1e-5)
         np.testing.assert_allclose(emb.table[7], before[7] - 1.0,
                                    rtol=1e-5)
+
+
+class TestFirstLocalOwnership:
+    """The gather/push dedup flags are derived at runtime from each
+    shard's ACTUAL owning process (io_callback + all_gather), not a
+    contiguous-block assumption (advisor r3: interleaved process order
+    silently doubled/dropped psum rows)."""
+
+    def test_first_flags_interleaved(self):
+        import jax.numpy as jnp
+        from paddle_tpu.incubate.host_embedding import \
+            first_flags_from_procs
+        procs = jnp.asarray(np.array([0, 1, 0, 1], np.int32))
+        flags = np.asarray(first_flags_from_procs(procs))
+        # first device of proc0 is idx 0, of proc1 is idx 1 — NOT the
+        # contiguous heuristic's {0, 2}
+        assert flags.tolist() == [True, True, False, False]
+
+    def test_first_flags_contiguous(self):
+        import jax.numpy as jnp
+        from paddle_tpu.incubate.host_embedding import \
+            first_flags_from_procs
+        procs = jnp.asarray(np.array([0, 0, 1, 1], np.int32))
+        flags = np.asarray(first_flags_from_procs(procs))
+        assert flags.tolist() == [True, False, True, False]
+
+    def test_first_flags_single_process(self):
+        import jax.numpy as jnp
+        from paddle_tpu.incubate.host_embedding import \
+            first_flags_from_procs
+        procs = jnp.zeros(8, jnp.int32)
+        flags = np.asarray(first_flags_from_procs(procs))
+        assert flags.tolist() == [True] + [False] * 7
+
+    def test_missing_process_raises_in_gather(self):
+        # a psum group that sees fewer distinct processes than own a
+        # table shard would silently drop the unseen hosts' rows
+        emb = HostOffloadEmbedding(8, 2, seed=0)
+        emb._nproc = 2
+        with pytest.raises(RuntimeError, match='missing'):
+            emb._mp_gather(np.int32(1), np.int32(1),
+                           np.zeros((2, 3), np.int64))
+
+    def test_sharded_lookup_on_virtual_mesh(self):
+        # end-to-end through shard_map on the 8-device CPU mesh: the
+        # runtime flags must reduce to "axis index 0 contributes" for
+        # a single process, and the lookup must return exact rows
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ('dp',))
+        emb = HostOffloadEmbedding(32, 4, seed=11)
+        ids = np.arange(8, dtype='int64')
+
+        def fwd(idv, anchor):
+            return emb._lookup_mp(idv, anchor)
+
+        f = jax.shard_map(fwd, mesh=mesh, in_specs=(P('dp'), P()),
+                          out_specs=P('dp'))
+        rows = np.asarray(jax.jit(f)(jnp.asarray(ids),
+                                     jnp.zeros((1,), jnp.float32)))
+        np.testing.assert_allclose(rows, emb.table[ids], atol=1e-6)
+
+    def test_dp_ranks_push_distinct_grads(self):
+        # shard_axis='tp' under a (dp, tp) mesh: dp ranks hold
+        # DIFFERENT batches, so BOTH their sparse updates must land
+        # (gating the push on dp==0 would silently drop half the data)
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ('dp', 'tp'))
+        emb = HostOffloadEmbedding(16, 4, learning_rate=1.0, seed=3,
+                                   shard_axis='tp')
+        before = emb.table.copy()
+        # dp rank 0 looks up ids [1, 2]; dp rank 1 looks up [2, 3]
+        ids = np.array([[1, 2], [2, 3]], dtype='int64')
+
+        def loss(anchor, idv):
+            out = emb._lookup_mp(idv, anchor)
+            return jax.lax.psum(out.sum(), 'dp')
+
+        g = jax.shard_map(jax.grad(loss), mesh=mesh,
+                          in_specs=(P(), P('dp')), out_specs=P())
+        jax.jit(g)(jnp.zeros((1,), jnp.float32), jnp.asarray(ids))
+        jax.effects_barrier()   # pushes are async io_callbacks
+        # psum's transpose psums the replicated cotangent, so each
+        # row's grad is dp_degree = 2.  id 1 and 3 are hit by one dp
+        # rank, id 2 by BOTH (and each rank's tp-replicated copies
+        # dedup to a single push)
+        np.testing.assert_allclose(emb.table[1], before[1] - 2.0,
+                                   atol=1e-5)
+        np.testing.assert_allclose(emb.table[3], before[3] - 2.0,
+                                   atol=1e-5)
+        np.testing.assert_allclose(emb.table[2], before[2] - 4.0,
+                                   atol=1e-5)
+
+    def test_distinct_data_axes_rejected_as_replicated(self):
+        with pytest.raises(ValueError, match='different data'):
+            HostOffloadEmbedding(8, 2, replicated_axes=('dp', 'tp'))
+        with pytest.raises(ValueError, match='different data'):
+            HostOffloadEmbedding(8, 2, replicated_axes=('tp', 'sp'))
