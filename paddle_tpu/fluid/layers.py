@@ -246,3 +246,48 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, dtype='float32'):
     k = label.shape[-1]
     smoothed = _T.add(_T.multiply(label, 1.0 - epsilon), epsilon / k)
     return _T.cast(smoothed, dtype)
+
+
+# -- detection ops (reference fluid/layers/detection.py; implemented in
+# vision/detection.py, TPU-native fixed-shape redesign) ------------------
+from ..vision.detection import (    # noqa: F401,E402
+    iou_similarity, prior_box, anchor_generator, box_coder, box_clip,
+    multiclass_nms, generate_proposals)
+from ..vision.detection import roi_align as _roi_align          # noqa: E402
+from ..vision.detection import roi_pool as _roi_pool            # noqa: E402
+
+
+def _uniform_rois_num(input, rois):
+    """The legacy LoD-free fallback assumes rois split EVENLY over the
+    batch; anything else needs an explicit rois_num (silently guessing
+    would pool rois against the wrong image)."""
+    n, r = input.shape[0], rois.shape[0]
+    if r % n != 0:
+        raise ValueError(
+            f'{r} rois cannot be split evenly over batch {n}; pass '
+            'rois_num=[...] with the per-image counts (the LoD the '
+            'reference op carried)')
+    return _T.full([n], r // n, 'int32')
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_num=None,
+              name=None):
+    """Legacy 1.x signature over vision.detection.roi_align (the
+    reference's LoD rois become rois + rois_num)."""
+    if rois_num is None:
+        rois_num = _uniform_rois_num(input, rois)
+    return _roi_align(input, rois, rois_num,
+                      (pooled_height, pooled_width),
+                      spatial_scale=spatial_scale,
+                      sampling_ratio=sampling_ratio, aligned=False)
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_num=None, name=None):
+    """Legacy 1.x signature over vision.detection.roi_pool."""
+    if rois_num is None:
+        rois_num = _uniform_rois_num(input, rois)
+    return _roi_pool(input, rois, rois_num,
+                     (pooled_height, pooled_width),
+                     spatial_scale=spatial_scale)

@@ -375,3 +375,14 @@ def decode_jpeg(x, mode='unchanged', name=None):
     else:
         arr = arr.transpose(2, 0, 1)
     return Tensor(jnp.asarray(arr))
+
+
+# -- detection suite (vision/detection.py): priors/anchors, box coding,
+# NMS, proposals, RoI pooling --------------------------------------------
+from .detection import (       # noqa: F401,E402
+    iou_similarity, prior_box, anchor_generator, box_coder, box_clip,
+    multiclass_nms, generate_proposals, roi_align, roi_pool, nms)
+
+__all__ += ['iou_similarity', 'prior_box', 'anchor_generator',
+            'box_coder', 'box_clip', 'multiclass_nms',
+            'generate_proposals', 'roi_align', 'roi_pool', 'nms']

@@ -1,0 +1,636 @@
+"""Detection op suite: numeric parity vs independent numpy references.
+
+Reference analogue: the detection unittests in
+/root/reference/python/paddle/fluid/tests/unittests/
+(test_prior_box_op.py, test_anchor_generator_op.py,
+test_box_coder_op.py, test_multiclass_nms_op.py,
+test_generate_proposals_op.py, test_roi_align_op.py) — each checks the
+op against a pure-python emulation of the kernel; same approach here.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import detection as D
+
+
+def _np_iou(a, b, off=0.0):
+    ix1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    iw = np.clip(ix2 - ix1 + off, 0, None)
+    ih = np.clip(iy2 - iy1 + off, 0, None)
+    inter = iw * ih
+    aa = (a[:, 2] - a[:, 0] + off) * (a[:, 3] - a[:, 1] + off)
+    ab = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    union = aa[:, None] + ab[None, :] - inter
+    out = np.zeros_like(inter)
+    np.divide(inter, union, out=out, where=union > 0)
+    return out
+
+
+def _np_nms(boxes, scores, thresh, score_thresh=-np.inf, eta=1.0,
+            off=0.0):
+    """Greedy NMS exactly as multiclass_nms_op.cc NMSFast."""
+    order = np.argsort(-scores, kind='stable')
+    order = [i for i in order if scores[i] > score_thresh]
+    kept = []
+    adaptive = thresh
+    for i in order:
+        keep = True
+        for j in kept:
+            iou = _np_iou(boxes[i:i + 1], boxes[j:j + 1], off)[0, 0]
+            if iou > adaptive:
+                keep = False
+                break
+        if keep:
+            kept.append(i)
+            if eta < 1 and adaptive > 0.5:
+                adaptive *= eta
+    return kept
+
+
+class TestIouSimilarity:
+    def test_matches_numpy(self):
+        rs = np.random.RandomState(0)
+        a = rs.rand(5, 4).astype('float32')
+        b = rs.rand(7, 4).astype('float32')
+        a[:, 2:] += a[:, :2]
+        b[:, 2:] += b[:, :2]
+        out = np.asarray(D.iou_similarity(
+            paddle.to_tensor(a), paddle.to_tensor(b)).numpy())
+        np.testing.assert_allclose(out, _np_iou(a, b), rtol=1e-5)
+
+    def test_unnormalized(self):
+        a = np.array([[0, 0, 3, 3]], 'float32')
+        b = np.array([[2, 2, 5, 5]], 'float32')
+        out = np.asarray(D.iou_similarity(
+            paddle.to_tensor(a), paddle.to_tensor(b),
+            box_normalized=False).numpy())
+        np.testing.assert_allclose(out, _np_iou(a, b, off=1.0),
+                                   rtol=1e-5)
+
+
+def _np_prior_box(H, W, imH, imW, min_sizes, max_sizes, ars, flip,
+                  clip, steps, offset, mmorder):
+    """Direct emulation of prior_box_op.h."""
+    out_ars = [1.0]
+    for ar in ars:
+        if any(abs(ar - e) < 1e-6 for e in out_ars):
+            continue
+        out_ars.append(ar)
+        if flip:
+            out_ars.append(1.0 / ar)
+    sw = steps[0] or imW / W
+    sh = steps[1] or imH / H
+    boxes = []
+    for h in range(H):
+        row = []
+        for w in range(W):
+            cx = (w + offset) * sw
+            cy = (h + offset) * sh
+            cell = []
+
+            def emit(bw, bh):
+                cell.append([(cx - bw) / imW, (cy - bh) / imH,
+                             (cx + bw) / imW, (cy + bh) / imH])
+
+            for s, mn in enumerate(min_sizes):
+                if mmorder:
+                    emit(mn / 2, mn / 2)
+                    if max_sizes:
+                        q = math.sqrt(mn * max_sizes[s]) / 2
+                        emit(q, q)
+                    for ar in out_ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        emit(mn * math.sqrt(ar) / 2,
+                             mn / math.sqrt(ar) / 2)
+                else:
+                    for ar in out_ars:
+                        emit(mn * math.sqrt(ar) / 2,
+                             mn / math.sqrt(ar) / 2)
+                    if max_sizes:
+                        q = math.sqrt(mn * max_sizes[s]) / 2
+                        emit(q, q)
+            row.append(cell)
+        boxes.append(row)
+    b = np.asarray(boxes, 'float32')
+    if clip:
+        b = np.clip(b, 0, 1)
+    return b
+
+
+class TestPriorBox:
+    @pytest.mark.parametrize('mmorder', [False, True])
+    def test_matches_reference_loop(self, mmorder):
+        feat = paddle.to_tensor(np.zeros((1, 8, 4, 6), 'float32'))
+        img = paddle.to_tensor(np.zeros((1, 3, 32, 48), 'float32'))
+        boxes, vs = D.prior_box(
+            feat, img, min_sizes=[4.0], max_sizes=[8.0],
+            aspect_ratios=[2.0], flip=True, clip=True,
+            min_max_aspect_ratios_order=mmorder)
+        ref = _np_prior_box(4, 6, 32, 48, [4.0], [8.0], [2.0], True,
+                            True, (0.0, 0.0), 0.5, mmorder)
+        np.testing.assert_allclose(np.asarray(boxes.numpy()), ref,
+                                   rtol=1e-5, atol=1e-6)
+        v = np.asarray(vs.numpy())
+        assert v.shape == ref.shape
+        np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+    def test_explicit_steps(self):
+        feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), 'float32'))
+        img = paddle.to_tensor(np.zeros((1, 3, 20, 20), 'float32'))
+        boxes, _ = D.prior_box(feat, img, min_sizes=[2.0],
+                               aspect_ratios=[1.0], steps=(5.0, 5.0),
+                               offset=0.5)
+        ref = _np_prior_box(2, 2, 20, 20, [2.0], [], [1.0], False,
+                            False, (5.0, 5.0), 0.5, False)
+        np.testing.assert_allclose(np.asarray(boxes.numpy()), ref,
+                                   rtol=1e-5)
+
+
+class TestAnchorGenerator:
+    def test_matches_reference_loop(self):
+        feat = paddle.to_tensor(np.zeros((1, 8, 3, 5), 'float32'))
+        sizes, ratios = [32.0, 64.0], [0.5, 1.0]
+        stride, offset = (16.0, 16.0), 0.5
+        anchors, vs = D.anchor_generator(
+            feat, anchor_sizes=sizes, aspect_ratios=ratios,
+            variances=[0.1, 0.1, 0.2, 0.2], stride=stride,
+            offset=offset)
+        a = np.asarray(anchors.numpy())
+        assert a.shape == (3, 5, 4, 4)
+        # emulate anchor_generator_op.h at one cell
+        h_idx, w_idx = 2, 3
+        got = a[h_idx, w_idx]
+        exp = []
+        xc = w_idx * 16.0 + offset * 15.0
+        yc = h_idx * 16.0 + offset * 15.0
+        for ar in ratios:
+            for s in sizes:
+                area = 16.0 * 16.0
+                base_w = round(math.sqrt(area / ar))
+                base_h = round(base_w * ar)
+                aw = s / 16.0 * base_w
+                ah = s / 16.0 * base_h
+                exp.append([xc - 0.5 * (aw - 1), yc - 0.5 * (ah - 1),
+                            xc + 0.5 * (aw - 1), yc + 0.5 * (ah - 1)])
+        np.testing.assert_allclose(got, np.asarray(exp), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(vs.numpy())[0, 0, 0],
+                                   [0.1, 0.1, 0.2, 0.2])
+
+
+class TestBoxCoder:
+    def _data(self):
+        rs = np.random.RandomState(3)
+        prior = rs.rand(6, 4).astype('float32')
+        prior[:, 2:] += prior[:, :2] + 0.1
+        var = (rs.rand(6, 4).astype('float32') + 0.5)
+        target = rs.rand(4, 4).astype('float32')
+        target[:, 2:] += target[:, :2] + 0.1
+        return prior, var, target
+
+    def test_encode_matches_numpy(self):
+        prior, var, target = self._data()
+        out = np.asarray(D.box_coder(
+            paddle.to_tensor(prior), paddle.to_tensor(var),
+            paddle.to_tensor(target),
+            code_type='encode_center_size').numpy())
+        pw = prior[:, 2] - prior[:, 0]
+        ph = prior[:, 3] - prior[:, 1]
+        pcx = prior[:, 0] + pw / 2
+        pcy = prior[:, 1] + ph / 2
+        tcx = (target[:, 0] + target[:, 2]) / 2
+        tcy = (target[:, 1] + target[:, 3]) / 2
+        tw = target[:, 2] - target[:, 0]
+        th = target[:, 3] - target[:, 1]
+        ref = np.stack([
+            (tcx[:, None] - pcx) / pw / var[:, 0],
+            (tcy[:, None] - pcy) / ph / var[:, 1],
+            np.log(np.abs(tw[:, None] / pw)) / var[:, 2],
+            np.log(np.abs(th[:, None] / ph)) / var[:, 3]], axis=-1)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_decode_roundtrip(self):
+        # decode(encode(t)) recovers the target boxes
+        prior, var, target = self._data()
+        enc = D.box_coder(paddle.to_tensor(prior),
+                          paddle.to_tensor(var),
+                          paddle.to_tensor(target),
+                          code_type='encode_center_size')
+        dec = np.asarray(D.box_coder(
+            paddle.to_tensor(prior), paddle.to_tensor(var), enc,
+            code_type='decode_center_size', axis=0).numpy())
+        ref = np.broadcast_to(target[:, None, :], dec.shape)
+        np.testing.assert_allclose(dec, ref, rtol=1e-4, atol=1e-4)
+
+    def test_list_variance_and_none(self):
+        prior, _, target = self._data()
+        out_l = np.asarray(D.box_coder(
+            paddle.to_tensor(prior), [0.1, 0.1, 0.2, 0.2],
+            paddle.to_tensor(target)).numpy())
+        out_n = np.asarray(D.box_coder(
+            paddle.to_tensor(prior), None,
+            paddle.to_tensor(target)).numpy())
+        np.testing.assert_allclose(
+            out_l[..., 0], out_n[..., 0] / 0.1, rtol=1e-4)
+        np.testing.assert_allclose(
+            out_l[..., 2], out_n[..., 2] / 0.2, rtol=1e-4)
+
+    def test_unnormalized_offset(self):
+        prior = np.array([[0, 0, 4, 4]], 'float32')
+        target = np.array([[1, 1, 3, 3]], 'float32')
+        out = np.asarray(D.box_coder(
+            paddle.to_tensor(prior), None, paddle.to_tensor(target),
+            box_normalized=False).numpy())
+        # widths get +1: pw=5, tw=3
+        np.testing.assert_allclose(out[0, 0, 2], np.log(3 / 5),
+                                   rtol=1e-5)
+
+
+class TestNms:
+    def test_matches_reference_greedy(self):
+        rs = np.random.RandomState(7)
+        boxes = rs.rand(40, 4).astype('float32') * 10
+        boxes[:, 2:] = boxes[:, :2] + rs.rand(40, 2) * 5 + 0.5
+        scores = rs.rand(40).astype('float32')
+        got = np.asarray(D.nms(paddle.to_tensor(boxes),
+                               paddle.to_tensor(scores),
+                               iou_threshold=0.4).numpy())
+        ref = _np_nms(boxes, scores, 0.4)
+        got_valid = [i for i in got.tolist() if i >= 0]
+        assert got_valid == ref
+
+    def test_top_k_and_score_threshold(self):
+        rs = np.random.RandomState(8)
+        boxes = rs.rand(30, 4).astype('float32') * 10
+        boxes[:, 2:] = boxes[:, :2] + 1.0
+        scores = rs.rand(30).astype('float32')
+        got = np.asarray(D.nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            iou_threshold=0.4, top_k=3, score_threshold=0.3).numpy())
+        ref = _np_nms(boxes, scores, 0.4, score_thresh=0.3)[:3]
+        assert got.shape == (3,)
+        assert [i for i in got.tolist() if i >= 0] == ref
+
+    def test_categories(self):
+        # same boxes in different categories never suppress each other
+        boxes = np.array([[0, 0, 2, 2], [0, 0, 2, 2]], 'float32')
+        scores = np.array([0.9, 0.8], 'float32')
+        cats = np.array([0, 1], 'int32')
+        got = np.asarray(D.nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            iou_threshold=0.5, category_idxs=paddle.to_tensor(cats),
+            categories=[0, 1]).numpy())
+        assert sorted(i for i in got.tolist() if i >= 0) == [0, 1]
+
+
+class TestMulticlassNms:
+    def _np_multiclass(self, bboxes, scores, score_th, nms_top_k,
+                       keep_top_k, nms_th, bg):
+        """Emulate MultiClassNMS + keep_top_k (output as a set of
+        (label, score, box) rows; cross-class ordering differs from
+        the fixed-shape op, so compare sets)."""
+        C, M = scores.shape
+        rows = []
+        for c in range(C):
+            if c == bg:
+                continue
+            order = np.argsort(-scores[c], kind='stable')[:nms_top_k]
+            kept = _np_nms(bboxes[order], scores[c][order], nms_th,
+                           score_thresh=score_th)
+            for k in kept:
+                i = order[k]
+                rows.append((c, scores[c][i], tuple(bboxes[i])))
+        rows.sort(key=lambda r: -r[1])
+        if keep_top_k > 0:
+            rows = rows[:keep_top_k]
+        return rows
+
+    def test_matches_reference(self):
+        rs = np.random.RandomState(5)
+        M, C = 30, 4
+        bboxes = rs.rand(1, M, 4).astype('float32') * 8
+        bboxes[..., 2:] = bboxes[..., :2] + rs.rand(1, M, 2) * 4 + 0.5
+        scores = rs.rand(1, C, M).astype('float32')
+        out, num = D.multiclass_nms(
+            paddle.to_tensor(bboxes), paddle.to_tensor(scores),
+            score_threshold=0.2, nms_top_k=20, keep_top_k=10,
+            nms_threshold=0.4, background_label=0)
+        out = np.asarray(out.numpy())[0]
+        n = int(np.asarray(num.numpy())[0])
+        ref = self._np_multiclass(bboxes[0], scores[0], 0.2, 20, 10,
+                                  0.4, 0)
+        assert n == len(ref)
+        got = {(int(r[0]), round(float(r[1]), 5)) for r in out[:n]}
+        exp = {(c, round(float(s), 5)) for c, s, _ in ref}
+        assert got == exp
+        # padding rows are labelled -1
+        assert (out[n:, 0] == -1).all()
+
+    def test_return_index(self):
+        rs = np.random.RandomState(6)
+        bboxes = rs.rand(2, 10, 4).astype('float32') * 4
+        bboxes[..., 2:] = bboxes[..., :2] + 1.0
+        scores = rs.rand(2, 3, 10).astype('float32')
+        out, num, idx = D.multiclass_nms(
+            paddle.to_tensor(bboxes), paddle.to_tensor(scores),
+            score_threshold=0.1, nms_top_k=5, keep_top_k=4,
+            nms_threshold=0.3, background_label=-1,
+            return_index=True)
+        out = np.asarray(out.numpy())
+        idx = np.asarray(idx.numpy())
+        num = np.asarray(num.numpy())
+        for b in range(2):
+            for r in range(int(num[b])):
+                gi = idx[b, r]
+                assert gi >= 0
+                np.testing.assert_allclose(
+                    out[b, r, 2:], bboxes.reshape(-1, 4)[gi],
+                    rtol=1e-5)
+
+
+class TestGenerateProposals:
+    def test_pipeline_semantics(self):
+        rs = np.random.RandomState(9)
+        A, H, W = 3, 4, 4
+        scores = rs.rand(1, A, H, W).astype('float32')
+        deltas = (rs.rand(1, A * 4, H, W).astype('float32') - 0.5)
+        im_info = np.array([[32.0, 32.0, 1.0]], 'float32')
+        feat = paddle.to_tensor(np.zeros((1, 8, H, W), 'float32'))
+        anchors, variances = D.anchor_generator(
+            feat, anchor_sizes=[8.0, 16.0, 24.0],
+            aspect_ratios=[1.0], variances=[1.0, 1.0, 1.0, 1.0],
+            stride=(8.0, 8.0))
+        rois, probs, num = D.generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(im_info), anchors, variances,
+            pre_nms_top_n=30, post_nms_top_n=10, nms_thresh=0.7,
+            min_size=2.0)
+        rois = np.asarray(rois.numpy())[0]
+        probs = np.asarray(probs.numpy())[0]
+        n = int(np.asarray(num.numpy())[0])
+        assert 0 < n <= 10
+        valid = rois[:n]
+        # inside image, min_size respected
+        assert (valid[:, 0] >= 0).all() and (valid[:, 1] >= 0).all()
+        assert (valid[:, 2] <= 31).all() and (valid[:, 3] <= 31).all()
+        ws = valid[:, 2] - valid[:, 0] + 1
+        hs = valid[:, 3] - valid[:, 1] + 1
+        assert (ws >= 2.0).all() and (hs >= 2.0).all()
+        # scores are the top candidates, descending
+        p = probs[:n, 0]
+        assert (np.diff(p) <= 1e-6).all()
+        # kept boxes mutually below the NMS threshold
+        iou = _np_iou(valid, valid, off=1.0)
+        np.fill_diagonal(iou, 0.0)
+        assert (iou <= 0.7 + 1e-5).all()
+        # padding is zero
+        assert (rois[n:] == 0).all()
+
+
+def _np_roi_align(x, rois, bids, ph, pw, scale, ratio, aligned):
+    """Direct emulation of roi_align_op.h (adaptive or fixed grid)."""
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    out = np.zeros((R, C, ph, pw), np.float64)
+    off = 0.5 if aligned else 0.0
+    for r in range(R):
+        img = x[bids[r]]
+        x1 = rois[r, 0] * scale - off
+        y1 = rois[r, 1] * scale - off
+        x2 = rois[r, 2] * scale - off
+        y2 = rois[r, 3] * scale - off
+        rw = max(x2 - x1, 1.0)
+        rh = max(y2 - y1, 1.0)
+        bh, bw = rh / ph, rw / pw
+        gh = ratio if ratio > 0 else int(np.ceil(rh / ph))
+        gw = ratio if ratio > 0 else int(np.ceil(rw / pw))
+        gh, gw = max(gh, 1), max(gw, 1)
+        for p in range(ph):
+            for q in range(pw):
+                acc = np.zeros(C)
+                for iy in range(gh):
+                    for ix in range(gw):
+                        y = y1 + p * bh + (iy + 0.5) * bh / gh
+                        xq = x1 + q * bw + (ix + 0.5) * bw / gw
+                        if y < -1 or y > H or xq < -1 or xq > W:
+                            continue
+                        y_, x_ = max(y, 0), max(xq, 0)
+                        y0, x0 = int(y_), int(x_)
+                        if y0 >= H - 1:
+                            y0 = yh = H - 1
+                            y_ = float(y0)
+                        else:
+                            yh = y0 + 1
+                        if x0 >= W - 1:
+                            x0 = xh = W - 1
+                            x_ = float(x0)
+                        else:
+                            xh = x0 + 1
+                        ly, lx = y_ - y0, x_ - x0
+                        hy, hx = 1 - ly, 1 - lx
+                        acc += (hy * hx * img[:, y0, x0]
+                                + hy * lx * img[:, y0, xh]
+                                + ly * hx * img[:, yh, x0]
+                                + ly * lx * img[:, yh, xh])
+                out[r, :, p, q] = acc / (gh * gw)
+    return out.astype('float32')
+
+
+class TestRoiAlign:
+    @pytest.mark.parametrize('ratio,aligned', [(2, True), (2, False),
+                                               (-1, True)])
+    def test_matches_numpy(self, ratio, aligned):
+        rs = np.random.RandomState(11)
+        x = rs.rand(2, 3, 8, 8).astype('float32')
+        rois = np.array([[0, 0, 12, 12], [4, 2, 14, 10],
+                         [1, 1, 6, 6]], 'float32')
+        bn = np.array([2, 1], 'int32')
+        out = np.asarray(D.roi_align(
+            paddle.to_tensor(x), paddle.to_tensor(rois),
+            paddle.to_tensor(bn), output_size=2, spatial_scale=0.5,
+            sampling_ratio=ratio, aligned=aligned).numpy())
+        ref = _np_roi_align(x, rois, [0, 0, 1], 2, 2, 0.5, ratio,
+                            aligned)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_differentiable(self):
+        import jax
+        import jax.numpy as jnp
+        rs = np.random.RandomState(12)
+        x = jnp.asarray(rs.rand(1, 2, 6, 6).astype('float32'))
+        rois = jnp.asarray(np.array([[0, 0, 5, 5]], 'float32'))
+        bn = jnp.asarray(np.array([1], 'int32'))
+
+        def f(xv):
+            out = D.roi_align(xv, rois, bn, output_size=2,
+                              sampling_ratio=2)
+            ov = out.value if hasattr(out, 'value') else out
+            return jnp.sum(ov)
+
+        grads = jax.grad(f)(x)
+        assert np.isfinite(np.asarray(grads)).all()
+        assert float(jnp.abs(grads).sum()) > 0
+
+
+class TestBoxClip:
+    def test_clips_to_scaled_image(self):
+        boxes = np.array([[-2.0, -3.0, 50.0, 40.0],
+                          [1.0, 2.0, 3.0, 4.0]], 'float32')
+        im_info = np.array([20.0, 30.0, 1.0], 'float32')
+        out = np.asarray(D.box_clip(
+            paddle.to_tensor(boxes),
+            paddle.to_tensor(im_info)).numpy())
+        np.testing.assert_allclose(out[0], [0.0, 0.0, 29.0, 19.0])
+        np.testing.assert_allclose(out[1], boxes[1])
+
+
+def _np_roi_pool(x, rois, bids, ph, pw, scale):
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    out = np.zeros((R, C, ph, pw), np.float32)
+    for r in range(R):
+        img = x[bids[r]]
+        x1 = int(round(rois[r, 0] * scale))
+        y1 = int(round(rois[r, 1] * scale))
+        x2 = int(round(rois[r, 2] * scale))
+        y2 = int(round(rois[r, 3] * scale))
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        bh, bw = rh / ph, rw / pw
+        for p in range(ph):
+            for q in range(pw):
+                hs = min(max(int(np.floor(p * bh)) + y1, 0), H)
+                he = min(max(int(np.ceil((p + 1) * bh)) + y1, 0), H)
+                ws = min(max(int(np.floor(q * bw)) + x1, 0), W)
+                we = min(max(int(np.ceil((q + 1) * bw)) + x1, 0), W)
+                if he <= hs or we <= ws:
+                    continue
+                out[r, :, p, q] = img[:, hs:he, ws:we].max(
+                    axis=(1, 2))
+    return out
+
+
+class TestRoiPool:
+    def test_matches_numpy(self):
+        rs = np.random.RandomState(13)
+        x = rs.rand(2, 3, 8, 8).astype('float32')
+        rois = np.array([[0, 0, 14, 14], [2, 4, 10, 12],
+                         [0, 0, 4, 4]], 'float32')
+        bn = np.array([1, 2], 'int32')
+        out = np.asarray(D.roi_pool(
+            paddle.to_tensor(x), paddle.to_tensor(rois),
+            paddle.to_tensor(bn), output_size=2,
+            spatial_scale=0.5).numpy())
+        ref = _np_roi_pool(x, rois, [0, 1, 1], 2, 2, 0.5)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+class TestJitAndHeads:
+    def test_ops_compile_under_jit(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.vision.detection import (
+            multiclass_nms, generate_proposals)
+        rs = np.random.RandomState(21)
+        bboxes = jnp.asarray(rs.rand(1, 16, 4).astype('float32') * 4)
+        scores = jnp.asarray(rs.rand(1, 3, 16).astype('float32'))
+
+        @jax.jit
+        def f(bb, sc):
+            out = multiclass_nms(bb, sc, score_threshold=0.1,
+                                 nms_top_k=8, keep_top_k=5,
+                                 nms_threshold=0.4)
+            o, n = (out[0], out[1])
+            ov = o.value if hasattr(o, 'value') else o
+            nv = n.value if hasattr(n, 'value') else n
+            return ov, nv
+
+        o, n = f(bboxes, scores)
+        assert o.shape == (1, 5, 6)
+        assert n.shape == (1,)
+
+    def test_ssd_head_smoke(self):
+        """SSD postprocess chain: multi_box_head priors -> box_coder
+        decode -> multiclass_nms (reference SSD eval path)."""
+        import paddle_tpu.static.nn as snn
+        rs = np.random.RandomState(22)
+        feat = paddle.to_tensor(
+            rs.rand(1, 8, 4, 4).astype('float32'))
+        img = paddle.to_tensor(np.zeros((1, 3, 32, 32), 'float32'))
+        locs, confs, boxes, vars_ = snn.multi_box_head(
+            [feat], img, base_size=32, num_classes=3,
+            aspect_ratios=[[2.0]], min_sizes=[8.0], max_sizes=[16.0])
+        # decode the [1, P, 4] loc deltas against the P priors
+        # (axis=0: prior m decodes delta [:, m, :])
+        dec = D.box_coder(boxes, vars_, locs,
+                          code_type='decode_center_size', axis=0)
+        dv = np.asarray(dec.numpy())
+        P = dv.shape[1]
+        diag = dv[0][None]
+        sc = rs.rand(1, 3, P).astype('float32')
+        out, num = D.multiclass_nms(
+            paddle.to_tensor(diag.astype('float32')),
+            paddle.to_tensor(sc), score_threshold=0.3, nms_top_k=10,
+            keep_top_k=5, nms_threshold=0.45)
+        assert np.asarray(out.numpy()).shape == (1, 5, 6)
+
+    def test_rcnn_head_smoke(self):
+        """FasterRCNN front half: anchors -> proposals -> roi_align
+        (reference RPN + RoIHead path)."""
+        rs = np.random.RandomState(23)
+        A, H, W = 3, 4, 4
+        feat_np = rs.rand(1, 8, H, W).astype('float32')
+        feat = paddle.to_tensor(feat_np)
+        anchors, variances = D.anchor_generator(
+            feat, anchor_sizes=[8.0, 16.0, 24.0],
+            aspect_ratios=[1.0], variances=[1.0, 1.0, 1.0, 1.0],
+            stride=(8.0, 8.0))
+        scores = rs.rand(1, A, H, W).astype('float32')
+        deltas = (rs.rand(1, A * 4, H, W).astype('float32') - 0.5)
+        im_info = np.array([[32.0, 32.0, 1.0]], 'float32')
+        rois, probs, num = D.generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(im_info), anchors, variances,
+            pre_nms_top_n=20, post_nms_top_n=6, nms_thresh=0.7,
+            min_size=2.0)
+        pooled = D.roi_align(
+            feat, paddle.to_tensor(
+                np.asarray(rois.numpy())[0].astype('float32')),
+            paddle.to_tensor(np.array([6], 'int32')),
+            output_size=2, spatial_scale=H / 32.0, sampling_ratio=2)
+        assert np.asarray(pooled.numpy()).shape == (6, 8, 2, 2)
+        assert np.isfinite(np.asarray(pooled.numpy())).all()
+
+
+class TestFluidAliases:
+    def test_fluid_exposes_detection(self):
+        import paddle_tpu.fluid as fluid
+        for name in ('prior_box', 'anchor_generator', 'box_coder',
+                     'multiclass_nms', 'generate_proposals',
+                     'roi_align', 'roi_pool', 'iou_similarity',
+                     'box_clip'):
+            assert hasattr(fluid.layers, name), name
+
+    def test_fluid_roi_align_legacy_signature(self):
+        import paddle_tpu.fluid as fluid
+        rs = np.random.RandomState(31)
+        x = paddle.to_tensor(rs.rand(1, 2, 6, 6).astype('float32'))
+        rois = paddle.to_tensor(
+            np.array([[0, 0, 10, 10]], 'float32'))
+        out = fluid.layers.roi_align(x, rois, pooled_height=2,
+                                     pooled_width=2,
+                                     spatial_scale=0.5,
+                                     sampling_ratio=2)
+        assert np.asarray(out.numpy()).shape == (1, 2, 2, 2)
+
+    def test_vision_ops_exposes_detection(self):
+        from paddle_tpu.vision import ops
+        for name in ('prior_box', 'multiclass_nms', 'roi_align',
+                     'nms'):
+            assert hasattr(ops, name), name
