@@ -28,6 +28,7 @@ from .role_maker import (  # noqa: F401
 from .data_generator import (  # noqa: F401
     MultiSlotDataGenerator, MultiSlotStringDataGenerator)
 from . import utils  # noqa: F401
+from . import metrics  # noqa: F401
 
 
 def __getattr__(name):
