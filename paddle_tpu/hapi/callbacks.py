@@ -102,7 +102,13 @@ def _fmt(v):
         return '{:.4f}'.format(v)
     if isinstance(v, (list, tuple)):
         return '[' + ', '.join(_fmt(x) for x in v) + ']'
-    return str(v)
+    # lazy eval path: logs carry DEVICE scalars (jnp arrays / Tensors)
+    # so the host sync happens here, only when a logger actually
+    # prints — float() them for the same formatting as plain numbers
+    try:
+        return '{:.4f}'.format(float(getattr(v, 'value', v)))
+    except (TypeError, ValueError):
+        return str(v)
 
 
 class ProgBarLogger(Callback):

@@ -252,9 +252,11 @@ class Model:
                        for m, r in zip(self._metrics, mres)]
         return float(loss), metric_logs
 
-    def eval_batch(self, inputs, labels=None):
-        batch = _as_list(inputs) + _as_list(labels)
-        arrays, n_in = self._split_batch(batch)
+    def _eval_batch_lazy(self, arrays, n_in):
+        """One compiled eval step with NO host readback: the returned
+        loss is a device array and metric updates are lazy jnp adds
+        (SURVEY §2#21 — a sync per batch is a ~100 ms tunnel round
+        trip on the real chip)."""
         st = self._get_fstate() if self._optimizer is not None else None
         if st is None:
             params, buffers = self.network.functional_state()
@@ -273,6 +275,12 @@ class Model:
         for m, r in zip(self._metrics, mres):
             m.update(r) if not isinstance(r, (tuple, list)) \
                 else m.update(*r)
+        return outs, loss
+
+    def eval_batch(self, inputs, labels=None):
+        batch = _as_list(inputs) + _as_list(labels)
+        arrays, n_in = self._split_batch(batch)
+        outs, loss = self._eval_batch_lazy(arrays, n_in)
         return float(loss), [np.asarray(o) for o in outs]
 
     def predict_batch(self, inputs):
@@ -364,11 +372,15 @@ class Model:
             cbks.on_eval_begin({})
         for step, batch in enumerate(loader):
             arrays, n_in = self._split_batch(batch)
-            loss, _ = self.eval_batch(arrays[:n_in], arrays[n_in:])
-            total_loss += loss
+            # lazy path: the loss stays a device array and the metric
+            # updates are jnp adds — zero per-batch host syncs; a
+            # callback that formats the loss pays the sync itself,
+            # and only when it actually logs
+            _, loss = self._eval_batch_lazy(arrays, n_in)
+            total_loss = total_loss + loss
             n_batches += 1
             cbks.on_eval_batch_end(step, {'loss': loss})
-        logs = {'loss': total_loss / max(1, n_batches)}
+        logs = {'loss': float(total_loss) / max(1, n_batches)}
         for m in self._metrics:
             logs[str(m.name())] = m.accumulate()
         if _callbacks is None:

@@ -1,9 +1,17 @@
 """Metrics: Accuracy / Precision / Recall / Auc.
 
 Reference analogue: python/paddle/metric/metrics.py (Metric, Accuracy,
-Precision, Recall, Auc, paddle.metric.accuracy).  `compute` is jit-safe
-(pure jnp on device); `update` accumulates small host-side scalars so
-the compiled train step never materialises metric state on device.
+Precision, Recall, Auc, paddle.metric.accuracy).
+
+Jit-safe state discipline (SURVEY §2#21): `compute` runs INSIDE the
+compiled eval step and reduces the batch to a tiny statistic array
+(correct-counts, tp/fp, AUC histogram buckets); `update` adds that
+statistic into a device-resident jnp state with NO host readback —
+lazy device ops only, so `hapi.Model.evaluate` performs zero
+device→host syncs per batch (each one is a ~100 ms round trip through
+the TPU tunnel).  The only host sync is `accumulate()` at the end of
+evaluation.  The legacy eager signatures (`update(preds, labels)`
+with raw predictions) still work and route through the same compute.
 """
 import abc
 
@@ -19,6 +27,43 @@ def _to_np(x):
     if isinstance(x, Tensor):
         return np.asarray(x.value)
     return np.asarray(x)
+
+
+def _to_jnp(x):
+    if isinstance(x, Tensor):
+        return x.value
+    return jnp.asarray(x)
+
+
+class _LongCounter:
+    """Device-resident EXACT integer accumulator for streaming metric
+    states: two int32 limbs (`hi` in units of 2^16), with the carry
+    fold every `_FOLD_EVERY` adds done ON DEVICE — `add` is always a
+    lazy jnp op, never a host sync, and the representable total
+    (~1.4e14 per element) outlives any eval stream.  (A single f32
+    state saturates at 2^24 and a single int32 wraps at 2^31; the one
+    host sync is `read()` at accumulate time.)"""
+
+    _FOLD_EVERY = 1024
+
+    def __init__(self, shape):
+        self.lo = jnp.zeros(shape, jnp.int32)
+        self.hi = jnp.zeros(shape, jnp.int32)
+        self._adds = 0
+
+    def add(self, x):
+        self.lo = self.lo + x.astype(jnp.int32)
+        self._adds += 1
+        if self._adds >= self._FOLD_EVERY:
+            carry = self.lo >> 16          # still lazy device math
+            self.hi = self.hi + carry
+            self.lo = self.lo - (carry << 16)
+            self._adds = 0
+
+    def read(self):
+        """Host int64 totals — the single device→host sync."""
+        return ((np.asarray(self.hi).astype(np.int64) << 16)
+                + np.asarray(self.lo).astype(np.int64))
 
 
 class Metric(abc.ABC):
@@ -56,9 +101,8 @@ class Accuracy(Metric):
 
     def compute(self, pred, label, *args):
         """Return correctness matrix [N, maxk] (jit-safe)."""
-        pred = pred.value if isinstance(pred, Tensor) else jnp.asarray(pred)
-        label = label.value if isinstance(label, Tensor) \
-            else jnp.asarray(label)
+        pred = _to_jnp(pred)
+        label = _to_jnp(label)
         pred_idx = jnp.argsort(pred, axis=-1)[..., ::-1][..., :self.maxk]
         if label.ndim == pred.ndim:  # one-hot or column labels
             if label.shape[-1] == 1:
@@ -68,21 +112,24 @@ class Accuracy(Metric):
         return (pred_idx == label[..., None]).astype(jnp.float32)
 
     def update(self, correct, *args):
-        correct = _to_np(correct)
-        accs = []
-        for k in self.topk:
-            num = correct[..., :k].sum()
-            accs.append(float(num) / max(1, correct.shape[0]))
-            self.total[self.topk.index(k)] += float(num)
-        self.count += correct.shape[0]
+        """Accumulate per-topk correct counts as LAZY device adds (no
+        float() readback); returns the batch accuracies as jnp scalars
+        (callers that print force the sync, not the update)."""
+        correct = _to_jnp(correct)
+        n = correct.shape[0]
+        nums = jnp.stack([jnp.sum(correct[..., :k]) for k in self.topk])
+        self.total.add(jnp.round(nums))
+        self.count += n
+        accs = [nums[i] / max(1, n) for i in range(len(self.topk))]
         return accs[0] if len(accs) == 1 else accs
 
     def reset(self):
-        self.total = [0.0] * len(self.topk)
+        self.total = _LongCounter(len(self.topk))
         self.count = 0
 
     def accumulate(self):
-        res = [t / max(1, self.count) for t in self.total]
+        tot = self.total.read()   # the single host sync
+        res = [float(t) / max(1, self.count) for t in tot]
         return res[0] if len(res) == 1 else res
 
     def name(self):
@@ -94,25 +141,36 @@ class Accuracy(Metric):
 class Precision(Metric):
     """Binary precision over thresholded predictions."""
 
+    _STAT_LEN = 2   # (tp, fp)
+
     def __init__(self, name='precision', *args, **kwargs):
         super().__init__()
         self._name = name
         self.reset()
 
-    def update(self, preds, labels):
-        preds = _to_np(preds).reshape(-1)
-        labels = _to_np(labels).reshape(-1)
-        pred_pos = preds > 0.5
-        self.tp += int(np.sum(pred_pos & (labels == 1)))
-        self.fp += int(np.sum(pred_pos & (labels != 1)))
+    def compute(self, preds, labels, *args):
+        """[tp, fp] of the batch as a jnp stat (jit-safe)."""
+        p = _to_jnp(preds).reshape(-1)
+        y = _to_jnp(labels).reshape(-1)
+        pred_pos = p > 0.5
+        tp = jnp.sum(pred_pos & (y == 1))
+        fp = jnp.sum(pred_pos & (y != 1))
+        return jnp.stack([tp, fp]).astype(jnp.int32)
+
+    def update(self, stat, labels=None):
+        """`stat` is compute()'s [tp, fp]; the legacy eager call
+        update(preds, labels) routes through compute first."""
+        if labels is not None:
+            stat = self.compute(stat, labels)
+        self._stat.add(_to_jnp(stat))
 
     def reset(self):
-        self.tp = 0
-        self.fp = 0
+        self._stat = _LongCounter(2)
 
     def accumulate(self):
-        denom = self.tp + self.fp
-        return self.tp / denom if denom else 0.0
+        tp, fp = self._stat.read()
+        denom = tp + fp
+        return float(tp / denom) if denom else 0.0
 
     def name(self):
         return self._name
@@ -126,27 +184,36 @@ class Recall(Metric):
         self._name = name
         self.reset()
 
-    def update(self, preds, labels):
-        preds = _to_np(preds).reshape(-1)
-        labels = _to_np(labels).reshape(-1)
-        pred_pos = preds > 0.5
-        self.tp += int(np.sum(pred_pos & (labels == 1)))
-        self.fn += int(np.sum(~pred_pos & (labels == 1)))
+    def compute(self, preds, labels, *args):
+        """[tp, fn] of the batch as a jnp stat (jit-safe)."""
+        p = _to_jnp(preds).reshape(-1)
+        y = _to_jnp(labels).reshape(-1)
+        pred_pos = p > 0.5
+        tp = jnp.sum(pred_pos & (y == 1))
+        fn = jnp.sum(~pred_pos & (y == 1))
+        return jnp.stack([tp, fn]).astype(jnp.int32)
+
+    def update(self, stat, labels=None):
+        if labels is not None:
+            stat = self.compute(stat, labels)
+        self._stat.add(_to_jnp(stat))
 
     def reset(self):
-        self.tp = 0
-        self.fn = 0
+        self._stat = _LongCounter(2)
 
     def accumulate(self):
-        denom = self.tp + self.fn
-        return self.tp / denom if denom else 0.0
+        tp, fn = self._stat.read()
+        denom = tp + fn
+        return float(tp / denom) if denom else 0.0
 
     def name(self):
         return self._name
 
 
 class Auc(Metric):
-    """ROC AUC via histogram buckets (streaming-friendly)."""
+    """ROC AUC via histogram buckets (streaming-friendly).  The bucket
+    histograms are jnp state summed in-place per batch; the trapezoid
+    walk happens once, at accumulate()."""
 
     def __init__(self, curve='ROC', num_thresholds=4095, name='auc',
                  *args, **kwargs):
@@ -156,36 +223,60 @@ class Auc(Metric):
         self._name = name
         self.reset()
 
-    def update(self, preds, labels):
-        preds = _to_np(preds)
-        labels = _to_np(labels).reshape(-1)
-        if preds.ndim == 2 and preds.shape[1] == 2:
-            scores = preds[:, 1]
+    def compute(self, preds, labels, *args):
+        """Batch bucket histograms stacked [2, T+1] (pos, neg) — a
+        scatter-add inside the compiled step."""
+        p = _to_jnp(preds)
+        y = _to_jnp(labels).reshape(-1)
+        if p.ndim == 2 and p.shape[1] == 2:
+            scores = p[:, 1]
         else:
-            scores = preds.reshape(-1)
-        buckets = np.clip((scores * self.num_thresholds).astype(int),
-                          0, self.num_thresholds)
-        pos = labels.astype(bool)
+            scores = p.reshape(-1)
         n = self.num_thresholds + 1
-        self._stat_pos += np.bincount(buckets[pos], minlength=n)
-        self._stat_neg += np.bincount(buckets[~pos], minlength=n)
+        buckets = jnp.clip(
+            (scores * self.num_thresholds).astype(jnp.int32),
+            0, self.num_thresholds)
+        pos = (y != 0).astype(jnp.int32)
+        pos_hist = jnp.zeros(n, jnp.int32).at[buckets].add(pos)
+        neg_hist = jnp.zeros(n, jnp.int32).at[buckets].add(1 - pos)
+        return jnp.stack([pos_hist, neg_hist])
+
+    def update(self, stat, labels=None):
+        """`stat` is compute()'s [2, T+1] histogram pair; the legacy
+        eager call update(preds, labels) routes through compute.  The
+        state is a _LongCounter: exact int64-range totals with every
+        add (and the periodic carry fold) staying ON device."""
+        if labels is not None:
+            stat = self.compute(stat, labels)
+        self._stat.add(_to_jnp(stat))
 
     def reset(self):
-        self._stat_pos = np.zeros(self.num_thresholds + 1, dtype=np.int64)
-        self._stat_neg = np.zeros(self.num_thresholds + 1, dtype=np.int64)
+        self._stat = _LongCounter((2, self.num_thresholds + 1))
+
+    @property
+    def _stat_pos(self):
+        """Host view of the positive buckets (fleet.metrics.auc and
+        legacy consumers read these)."""
+        return self._stat.read()[0]
+
+    @property
+    def _stat_neg(self):
+        return self._stat.read()[1]
 
     def accumulate(self):
         # walk thresholds high->low accumulating TP/FP; trapezoid rule
-        tot_pos = float(self._stat_pos.sum())
-        tot_neg = float(self._stat_neg.sum())
+        stat = self._stat.read()   # the single host sync
+        stat_pos, stat_neg = stat[0], stat[1]
+        tot_pos = float(stat_pos.sum())
+        tot_neg = float(stat_neg.sum())
         if tot_pos == 0 or tot_neg == 0:
             return 0.0
         tp = fp = 0.0
         auc = 0.0
         prev_tpr = prev_fpr = 0.0
         for b in range(self.num_thresholds, -1, -1):
-            tp += float(self._stat_pos[b])
-            fp += float(self._stat_neg[b])
+            tp += float(stat_pos[b])
+            fp += float(stat_neg[b])
             tpr, fpr = tp / tot_pos, fp / tot_neg
             auc += (fpr - prev_fpr) * (tpr + prev_tpr) / 2.0
             prev_tpr, prev_fpr = tpr, fpr

@@ -1,0 +1,222 @@
+"""Jit-safe metric states (SURVEY §2#21 / VERDICT r3 item 6).
+
+Reference analogue: python/paddle/metric/metrics.py unittests
+(test_metrics.py) check Accuracy/Precision/Recall/Auc numerics; here
+additionally the TPU contract: update() must be lazy device math with
+ZERO device→host readbacks per batch — proven with jax's
+transfer_guard — and the host sync happens once, in accumulate().
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.metric import Accuracy, Precision, Recall, Auc
+
+
+def _np_auc(scores, labels, num_thresholds):
+    """The previous host-numpy implementation, verbatim semantics."""
+    buckets = np.clip((scores * num_thresholds).astype(int),
+                      0, num_thresholds)
+    pos = labels.astype(bool)
+    n = num_thresholds + 1
+    stat_pos = np.bincount(buckets[pos], minlength=n)
+    stat_neg = np.bincount(buckets[~pos], minlength=n)
+    tot_pos, tot_neg = float(stat_pos.sum()), float(stat_neg.sum())
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.0
+    tp = fp = auc = 0.0
+    prev_tpr = prev_fpr = 0.0
+    for b in range(num_thresholds, -1, -1):
+        tp += float(stat_pos[b])
+        fp += float(stat_neg[b])
+        tpr, fpr = tp / tot_pos, fp / tot_neg
+        auc += (fpr - prev_fpr) * (tpr + prev_tpr) / 2.0
+        prev_tpr, prev_fpr = tpr, fpr
+    return auc
+
+
+class TestNumericParity:
+    def test_auc_matches_host_implementation(self):
+        rs = np.random.RandomState(0)
+        m = Auc(num_thresholds=255)
+        all_s, all_l = [], []
+        for _ in range(4):
+            s = rs.rand(100).astype('float32')
+            y = (rs.rand(100) > 0.5).astype('int64')
+            m.update(s[:, None], y[:, None])
+            all_s.append(s)
+            all_l.append(y)
+        want = _np_auc(np.concatenate(all_s), np.concatenate(all_l),
+                       255)
+        np.testing.assert_allclose(m.accumulate(), want, rtol=1e-9)
+
+    def test_auc_two_column_preds(self):
+        rs = np.random.RandomState(1)
+        p = rs.rand(64, 2).astype('float32')
+        y = (rs.rand(64) > 0.5).astype('int64')
+        m = Auc(num_thresholds=127)
+        m.update(p, y)
+        want = _np_auc(p[:, 1], y, 127)
+        np.testing.assert_allclose(m.accumulate(), want, rtol=1e-9)
+
+    def test_precision_recall_legacy_signature(self):
+        preds = np.array([0.9, 0.2, 0.7, 0.1], 'float32')
+        labels = np.array([1, 1, 0, 0], 'int64')
+        p, r = Precision(), Recall()
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert p.accumulate() == 0.5   # tp=1 fp=1
+        assert r.accumulate() == 0.5   # tp=1 fn=1
+
+    def test_accuracy_topk(self):
+        pred = np.array([[0.1, 0.2, 0.7], [0.5, 0.4, 0.1]], 'float32')
+        label = np.array([[2], [1]], 'int64')
+        m = Accuracy(topk=(1, 2))
+        m.update(m.compute(paddle.to_tensor(pred),
+                           paddle.to_tensor(label)))
+        top1, top2 = m.accumulate()
+        assert top1 == 0.5
+        assert top2 == 1.0
+
+    def test_reset_clears_state(self):
+        m = Auc(num_thresholds=31)
+        m.update(np.array([0.9], 'float32'), np.array([1], 'int64'))
+        m.reset()
+        assert m.accumulate() == 0.0
+
+
+class TestComputeInsideJit:
+    def test_all_metric_computes_jit(self):
+        ms = [Accuracy(), Precision(), Recall(), Auc(num_thresholds=63)]
+        rs = np.random.RandomState(2)
+        pred2 = rs.rand(16, 2).astype('float32')
+        score = pred2[:, 1].copy()
+        label = (rs.rand(16) > 0.5).astype('int64')
+
+        for m in ms:
+            arg = pred2 if isinstance(m, (Accuracy, Auc)) else score
+
+            @jax.jit
+            def step(p, y, m=m):
+                return m.compute(p, y)
+
+            stat = step(jnp.asarray(arg), jnp.asarray(label))
+            m.update(stat)
+        # Auc numeric check through the jit route
+        np.testing.assert_allclose(
+            ms[3].accumulate(), _np_auc(score, label, 63), rtol=1e-9)
+
+    def test_update_has_no_host_readback(self):
+        """The batch-loop contract: compute (jitted) + update run
+        under a device→host transfer guard — any readback raises."""
+        m_acc, m_auc = Accuracy(), Auc(num_thresholds=63)
+        rs = np.random.RandomState(3)
+
+        @jax.jit
+        def step(p, s, y):
+            return m_acc.compute(p, y), m_auc.compute(s, y > 1)
+
+        for _ in range(3):
+            p = jnp.asarray(rs.rand(8, 4).astype('float32'))
+            s = jnp.asarray(rs.rand(8).astype('float32'))
+            y = jnp.asarray(rs.randint(0, 4, 8).astype('int64'))
+            s_acc, s_auc = step(p, s, y)
+            with jax.transfer_guard_device_to_host('disallow'):
+                m_acc.update(s_acc)
+                m_auc.update(s_auc)
+        # sync happens here, outside the guarded region
+        assert 0.0 <= m_acc.accumulate() <= 1.0
+        assert 0.0 <= m_auc.accumulate() <= 1.0
+
+    def test_stat_pos_neg_views_for_fleet(self):
+        rs = np.random.RandomState(4)
+        s = rs.rand(128).astype('float32')
+        y = (rs.rand(128) > 0.3).astype('int64')
+        m = Auc(num_thresholds=63)
+        m.update(s, y)
+        assert m._stat_pos.sum() == int(y.sum())
+        assert m._stat_neg.sum() == int((1 - y).sum())
+        from paddle_tpu.distributed.fleet import metrics as FM
+        np.testing.assert_allclose(FM.auc(m._stat_pos, m._stat_neg),
+                                   m.accumulate(), rtol=1e-9)
+
+
+class TestHapiEvaluateLazy:
+    def _model(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Flatten(), nn.Linear(16, 4))
+        from paddle_tpu.hapi import Model
+        m = Model(net)
+        m.prepare(None, nn.CrossEntropyLoss(), Accuracy())
+        return m
+
+    def _data(self, n=32):
+        rs = np.random.RandomState(5)
+        return [(rs.rand(4, 16).astype('float32'),
+                 rs.randint(0, 4, (4, 1)).astype('int64'))
+                for _ in range(n // 4)]
+
+    def test_evaluate_matches_eager_accuracy(self):
+        m = self._model()
+        data = self._data()
+        logs = m.evaluate(data, batch_size=None, verbose=0)
+        # recompute accuracy eagerly
+        ref = Accuracy()
+        for x, y in data:
+            out = m.network(paddle.to_tensor(x))
+            ref.update(ref.compute(out, paddle.to_tensor(y)))
+        np.testing.assert_allclose(logs['acc'], ref.accumulate(),
+                                   rtol=1e-6)
+
+    def test_eval_batches_no_readback(self):
+        """Drive the internal lazy eval path under the transfer guard:
+        N batches, zero device→host transfers."""
+        m = self._model()
+        data = self._data()
+        # warm up compile outside the guard (compilation is allowed
+        # to sync; steady-state batches are not)
+        arrays, n_in = m._split_batch(list(data[0]))
+        m._eval_batch_lazy(arrays, n_in)
+        for mm in m._metrics:
+            mm.reset()
+        with jax.transfer_guard_device_to_host('disallow'):
+            for batch in data:
+                arrays, n_in = m._split_batch(list(batch))
+                m._eval_batch_lazy(arrays, n_in)
+        acc = m._metrics[0].accumulate()
+        assert 0.0 <= acc <= 1.0
+
+    def test_auc_fold_exact_across_window(self):
+        # the two-limb device counter folds carries ON DEVICE every
+        # _FOLD_EVERY adds without losing counts (and without a sync)
+        m = Auc(num_thresholds=15)
+        m._stat._FOLD_EVERY = 4
+        rs = np.random.RandomState(6)
+        all_s, all_l = [], []
+        with jax.transfer_guard_device_to_host('disallow'):
+            for _ in range(10):   # crosses two fold boundaries
+                s = jnp.asarray(rs.rand(32).astype('float32'))
+                y = jnp.asarray((rs.rand(32) > 0.5).astype('int64'))
+                m.update(s, y)
+                all_s.append(np.asarray(s))
+                all_l.append(np.asarray(y))
+        want = _np_auc(np.concatenate(all_s), np.concatenate(all_l),
+                       15)
+        np.testing.assert_allclose(m.accumulate(), want, rtol=1e-9)
+        read = m._stat.read()
+        assert read.dtype == np.int64
+        assert int(read.sum()) == 320
+
+    def test_long_counter_exact_past_int32(self):
+        from paddle_tpu.metric import _LongCounter
+        c = _LongCounter(1)
+        c._FOLD_EVERY = 2
+        # per-window bound: _FOLD_EVERY * per-add must stay < 2^31;
+        # the TOTAL may exceed int32 range thanks to the hi limb
+        big = jnp.asarray([2 ** 29], jnp.int32)
+        for _ in range(16):     # 16 * 2^29 = 2^33 > int32 range
+            c.add(big)
+        assert int(c.read()[0]) == 16 * (2 ** 29)
