@@ -1,0 +1,190 @@
+"""Trainer supervision: watch, restart, clean up local workers.
+
+Reference analogue:
+/root/reference/python/paddle/distributed/fleet/launch_utils.py
+(`start_local_trainers`:452 spawns one proc per device and the pod
+watch loop polls them; `terminate_local_procs`:308 terminates then
+SIGKILLs stragglers) and the elastic restart behaviour of
+paddle.distributed.fleet.elastic.
+
+TPU-native: one worker process drives all of a host's chips, so the
+supervisor watches ONE child per host (more are supported for API
+parity).  A dead or wedged worker is restarted up to `max_restarts`
+times with `PADDLE_ELASTIC_RESTART_COUNT` exported, and the training
+loop resumes from the last auto-checkpoint
+(incubate.checkpoint.auto_checkpoint) — together they give the
+kill-a-worker-mid-training recovery the reference's pod watcher
+provides.  Wedge detection is a heartbeat FILE (the worker's
+auto-checkpoint saves touch it): a stale mtime beyond
+`heartbeat_timeout` kills and restarts the worker, mirroring the
+reference watchdog's hung-trainer path.
+"""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ['TrainerProc', 'start_local_trainers',
+           'terminate_local_procs', 'watch_local_trainers', 'supervise']
+
+
+class TrainerProc:
+    """Reference launch_utils.py TrainerProc: one supervised worker."""
+
+    def __init__(self):
+        self.proc = None
+        self.log_fn = None
+        self.rank = None
+        self.local_rank = None
+        self.cmd = None
+        self.env = None
+        self.restarts = 0
+
+
+def start_local_trainers(cmds, log_dir=None, envs=None):
+    """Spawn one TrainerProc per command (reference
+    launch_utils.py:452).  `cmds`: list of argv lists."""
+    procs = []
+    for rank, cmd in enumerate(cmds):
+        env = dict(os.environ if envs is None else envs)
+        env['PADDLE_TRAINER_ID'] = str(rank)
+        env['PADDLE_RANK_IN_NODE'] = str(rank)
+        t = TrainerProc()
+        t.rank = t.local_rank = rank
+        t.cmd = list(cmd)
+        t.env = env
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            t.log_fn = open(os.path.join(
+                log_dir, f'workerlog.{rank}'), 'ab')
+        t.proc = subprocess.Popen(
+            cmd, env=env, stdout=t.log_fn or None,
+            stderr=subprocess.STDOUT if t.log_fn else None)
+        procs.append(t)
+    return procs
+
+
+def terminate_local_procs(procs, grace=3.0):
+    """Terminate, wait, then SIGKILL stragglers (reference
+    launch_utils.py:308 — same escalation, shorter waits)."""
+    for p in procs:
+        if p.proc is not None and p.proc.poll() is None:
+            p.proc.terminate()
+        if p.log_fn:
+            try:
+                p.log_fn.close()
+            except Exception:
+                pass
+            p.log_fn = None
+    deadline = time.time() + grace
+    while time.time() < deadline:
+        if all(p.proc is None or p.proc.poll() is not None
+               for p in procs):
+            return
+        time.sleep(0.05)
+    for p in procs:
+        if p.proc is not None and p.proc.poll() is None:
+            try:
+                os.kill(p.proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+    for p in procs:
+        if p.proc is not None:
+            try:
+                p.proc.wait(timeout=grace)
+            except Exception:
+                pass
+
+
+def _restart(t, log_dir=None):
+    t.restarts += 1
+    env = dict(t.env)
+    env['PADDLE_ELASTIC_RESTART_COUNT'] = str(t.restarts)
+    t.env = env
+    if log_dir and t.log_fn is None:
+        t.log_fn = open(os.path.join(
+            log_dir, f'workerlog.{t.rank}'), 'ab')
+    t.proc = subprocess.Popen(
+        t.cmd, env=env, stdout=t.log_fn or None,
+        stderr=subprocess.STDOUT if t.log_fn else None)
+
+
+def watch_local_trainers(procs, max_restarts=3, poll=0.2,
+                         heartbeat_file=None, heartbeat_timeout=None,
+                         log_dir=None, on_event=None):
+    """The pod watch loop: poll workers, restart the dead, kill the
+    wedged (stale heartbeat), stop everything when one fails beyond
+    `max_restarts`.
+
+    Returns 0 when every worker exited cleanly; the failing worker's
+    exit code otherwise.  `on_event(kind, trainer)` (kinds 'exit',
+    'restart', 'hang') observes transitions — tests and progress
+    loggers hook it.
+    """
+    if bool(heartbeat_file) != bool(heartbeat_timeout):
+        raise ValueError(
+            'heartbeat_file and heartbeat_timeout must be set '
+            'together — one without the other silently disables hang '
+            'detection')
+    if heartbeat_file:
+        # seed the heartbeat at supervision start: a worker that
+        # wedges BEFORE its first checkpoint touch must still trip
+        # the stale-mtime detector
+        with open(heartbeat_file, 'a'):
+            os.utime(heartbeat_file, None)
+    try:
+        while True:
+            alive = False
+            for t in procs:
+                rc = t.proc.poll()
+                if rc is None:
+                    alive = True
+                    if heartbeat_file and heartbeat_timeout and \
+                            os.path.exists(heartbeat_file):
+                        age = time.time() - os.path.getmtime(
+                            heartbeat_file)
+                        if age > heartbeat_timeout:
+                            if on_event:
+                                on_event('hang', t)
+                            t.proc.kill()
+                            t.proc.wait()
+                            rc = t.proc.returncode
+                        else:
+                            continue
+                    else:
+                        continue
+                if rc == 0:
+                    continue
+                # dead worker: restart or give up
+                if on_event:
+                    on_event('exit', t)
+                if t.restarts >= max_restarts:
+                    terminate_local_procs(
+                        [p for p in procs if p is not t])
+                    return rc if rc is not None else 1
+                if heartbeat_file:
+                    # a fresh heartbeat marks the NEW incarnation live
+                    with open(heartbeat_file, 'a'):
+                        os.utime(heartbeat_file, None)
+                _restart(t, log_dir)
+                if on_event:
+                    on_event('restart', t)
+                alive = True
+            if not alive:
+                return 0
+            time.sleep(poll)
+    except KeyboardInterrupt:
+        terminate_local_procs(procs)
+        raise
+
+
+def supervise(cmd, max_restarts=3, log_dir=None, heartbeat_file=None,
+              heartbeat_timeout=None, on_event=None):
+    """Run ONE worker command under supervision (the per-host elastic
+    entry the launcher's --elastic flag uses)."""
+    procs = start_local_trainers([cmd], log_dir=log_dir)
+    return watch_local_trainers(
+        procs, max_restarts=max_restarts, log_dir=log_dir,
+        heartbeat_file=heartbeat_file,
+        heartbeat_timeout=heartbeat_timeout, on_event=on_event)

@@ -37,15 +37,59 @@ def launch_main(argv=None):
                     help='total number of host processes')
     ap.add_argument('--node-rank', type=int, default=None,
                     help='this host\'s index in [0, nnodes)')
+    ap.add_argument('--elastic', type=int, default=None,
+                    metavar='MAX_RESTARTS',
+                    help='supervise the worker: restart it up to '
+                         'MAX_RESTARTS times on failure (reference '
+                         'launch_utils pod watch); pair with incubate.'
+                         'checkpoint.auto_checkpoint so the restarted '
+                         'worker resumes from the last snapshot')
+    ap.add_argument('--elastic-log-dir', default=None,
+                    help='worker log dir in elastic mode')
+    ap.add_argument('--heartbeat-file', default=None,
+                    help='worker heartbeat file; a stale mtime beyond '
+                         '--heartbeat-timeout restarts the worker')
+    ap.add_argument('--heartbeat-timeout', type=float, default=None)
     ap.add_argument('script', help='training script to run')
     ap.add_argument('script_args', nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
 
+    # usage errors must fail HERE, not burn the elastic restart budget
+    # on a worker that exits 2 every incarnation
+    if args.coordinator is not None and (args.nnodes is None
+                                         or args.node_rank is None):
+        ap.error('--coordinator requires --nnodes and --node-rank')
+    if (args.heartbeat_file is None) != (args.heartbeat_timeout is None):
+        ap.error('--heartbeat-file and --heartbeat-timeout must be '
+                 'passed together')
+
+    if args.elastic is not None:
+        # per-host supervision: re-exec this launcher WITHOUT --elastic
+        # as the worker, watch it, restart on failure
+        from .elastic import supervise
+        cmd = [sys.executable, '-u', '-m',
+               'paddle_tpu.distributed.launch']
+        if args.coordinator is not None:
+            cmd += ['--coordinator', args.coordinator,
+                    '--nnodes', str(args.nnodes),
+                    '--node-rank', str(args.node_rank)]
+        cmd += [args.script] + args.script_args
+        if args.heartbeat_file is not None:
+            # the worker must KNOW the heartbeat path or it can never
+            # touch it and the supervisor would kill a healthy worker
+            # every heartbeat_timeout; auto_checkpoint reads this env
+            # var when no explicit heartbeat_file is configured
+            os.environ['PADDLE_TPU_HEARTBEAT_FILE'] = \
+                args.heartbeat_file
+        rc = supervise(cmd, max_restarts=args.elastic,
+                       log_dir=args.elastic_log_dir,
+                       heartbeat_file=args.heartbeat_file,
+                       heartbeat_timeout=args.heartbeat_timeout)
+        sys.exit(rc)
+
     import jax
     explicit = args.coordinator is not None
     if explicit:
-        if args.nnodes is None or args.node_rank is None:
-            ap.error('--coordinator requires --nnodes and --node-rank')
         jax.distributed.initialize(
             coordinator_address=args.coordinator,
             num_processes=args.nnodes,

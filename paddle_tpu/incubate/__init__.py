@@ -15,3 +15,4 @@ from . import optimizer  # noqa: F401
 __all__ = ['flash_attention', 'ring_attention', 'ring_attention_spmd',
            'gpipe_spmd', 'HostOffloadEmbedding', 'SwitchMoE',
            'optimizer']
+from . import checkpoint  # noqa: F401

@@ -1,0 +1,3 @@
+from . import auto_checkpoint  # noqa: F401
+
+__all__ = ['auto_checkpoint']

@@ -1,0 +1,64 @@
+"""Worker for the elastic-training test (tests/test_elastic.py).
+
+Deterministic tiny training under incubate.checkpoint.auto_checkpoint:
+fixed data, SGD, `train_step_range` with a snapshot every step.  When
+KILL_AT_STEP is set and this is the FIRST incarnation (no
+PADDLE_ELASTIC_RESTART_COUNT), the process SIGKILLs itself mid-loop —
+the supervisor (distributed.launch --elastic) restarts it and the
+range resumes from the snapshot.  On completion writes final loss +
+parameters to OUT_JSON; the parent asserts they equal an
+uninterrupted run's.
+"""
+import json
+import os
+import signal
+import sys
+
+import numpy as np
+
+
+def main():
+    out_json = sys.argv[1]
+    ckpt_dir = sys.argv[2]
+    kill_at = int(os.environ.get('KILL_AT_STEP', '-1'))
+    incarnation = int(os.environ.get('PADDLE_ELASTIC_RESTART_COUNT',
+                                     '0'))
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.incubate.checkpoint import auto_checkpoint as acp
+
+    paddle.seed(42)
+    model = nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    acp.configure(checkpoint_dir=ckpt_dir, model=model, optimizer=opt,
+                  save_checkpoint_inter=0)
+
+    rs = np.random.RandomState(0)
+    xs = rs.rand(20, 4).astype('float32')
+    ys = (xs.sum(axis=1, keepdims=True) * 0.5).astype('float32')
+
+    losses = []
+    for step in acp.train_step_range(12):
+        if step == kill_at and incarnation == 0:
+            os.kill(os.getpid(), signal.SIGKILL)
+        x = paddle.to_tensor(xs[step % 5 * 4:(step % 5) * 4 + 4])
+        y = paddle.to_tensor(ys[step % 5 * 4:(step % 5) * 4 + 4])
+        loss = nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss.value)))
+
+    with open(out_json, 'w') as f:
+        json.dump({
+            'final_loss': losses[-1],
+            'weight': np.asarray(model.weight.value).ravel().tolist(),
+            'bias': np.asarray(model.bias.value).ravel().tolist(),
+            'incarnation': incarnation,
+        }, f)
+
+
+if __name__ == '__main__':
+    main()
