@@ -75,7 +75,39 @@ class SwitchMoE(Layer):
         self._param_shardings = {'w1': ('ep',), 'b1': ('ep',),
                                  'w2': ('ep',), 'b2': ('ep',),
                                  'gate_w': None}
-        self.aux_loss = None
+        self._aux_loss = None
+        self._aux_trace = None
+
+    @property
+    def aux_loss(self):
+        """Load-balance loss of the LAST forward — valid only inside
+        the same trace that ran the forward.  A read from another
+        trace (e.g. a separately-compiled eval step) raises here with
+        a clear fix instead of leaking a dead tracer into JAX
+        internals; pass ``return_aux=True`` to forward and thread the
+        value explicitly instead."""
+        if self._aux_loss is None:
+            return None
+        import jax
+        val = getattr(self._aux_loss, 'value', self._aux_loss)
+        if isinstance(val, jax.core.Tracer) \
+                and self._aux_trace is not None \
+                and self._aux_trace != jax.core.get_opaque_trace_state():
+            raise RuntimeError(
+                'SwitchMoE.aux_loss was computed in a different jit '
+                'trace than the one reading it (e.g. forward and loss '
+                'compiled separately). Reading it here would leak a '
+                'JAX tracer. Call forward(x, return_aux=True) and '
+                'pass the aux loss to the loss computation '
+                'explicitly.')
+        return self._aux_loss
+
+    @aux_loss.setter
+    def aux_loss(self, value):
+        import jax
+        self._aux_loss = value
+        self._aux_trace = (None if value is None
+                           else jax.core.get_opaque_trace_state())
 
     def _capacity(self, S):
         return max(1, int(math.ceil(

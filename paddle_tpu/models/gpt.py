@@ -39,7 +39,8 @@ class GPTConfig:
                  dropout=0.1, layer_norm_epsilon=1e-5,
                  sequence_parallel=False, initializer_range=0.02,
                  moe_num_experts=0, moe_every=2, moe_top_k=1,
-                 moe_capacity_factor=1.25, moe_aux_weight=0.01):
+                 moe_capacity_factor=1.25, moe_aux_weight=0.01,
+                 fused_head=False, fused_head_chunks=8):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -59,6 +60,13 @@ class GPTConfig:
         self.moe_top_k = moe_top_k
         self.moe_capacity_factor = moe_capacity_factor
         self.moe_aux_weight = moe_aux_weight
+        # fused LM head (ops/fused_ce.py): training forward returns
+        # the final HIDDEN states and loss() computes linear+softmax+CE
+        # chunked over the vocab — the f32 [B·T, V] logits are never
+        # materialized.  Single-chip / dp paths; keep off under tp
+        # (the head matmul then wants the V-sharded parallel CE).
+        self.fused_head = fused_head
+        self.fused_head_chunks = fused_head_chunks
 
 
 def _act_spec(cfg):
@@ -323,20 +331,56 @@ class GPTForCausalLM(nn.Layer):
                                    transpose_y=True)
             return logits, new_caches
         h = self.gpt(input_ids)
+        if self.config.fused_head and self.training:
+            # fused-head training: the head matmul happens inside
+            # loss() (ops/fused_ce.py) — return the hidden states
+            return h
         # tied head: h @ wte.T — logits [B, T, V/tp-sharded]
         logits = linalg.matmul(h, self.gpt.wte.weight, transpose_y=True)
         return maybe_shard(logits, ('dp', None, 'tp'))
 
-    def loss(self, logits, labels):
+    def loss(self, logits, labels, aux_losses=None):
         """Causal LM loss: shift-by-one cross entropy (+ the MoE
-        load-balance auxiliary term when experts are routed)."""
-        B, T, V = logits.shape
-        lg = manipulation.reshape(logits[:, :-1, :], [B * (T - 1), V])
-        lb = manipulation.reshape(labels[:, 1:], [B * (T - 1)])
-        out = F.cross_entropy(lg, lb)
+        load-balance auxiliary term when experts are routed).
+
+        `aux_losses`: explicit list of per-block MoE aux losses (from
+        `SwitchMoE.forward(..., return_aux=True)`).  REQUIRED when
+        this loss is compiled in a different trace than the forward —
+        the fallback reads each block's `.aux_loss` attribute, which
+        is only valid within the same trace (it raises a clear error
+        otherwise instead of leaking a tracer).
+
+        With `config.fused_head` the training forward returns HIDDEN
+        states [B, T, H] and the linear+softmax+CE fuse here via
+        ops/fused_ce.py — no [B·T, V] logits tensor exists."""
+        B, T, D = logits.shape
+        if self.config.fused_head and \
+                D == self.config.hidden_size and self.training:
+            from ..core.dispatch import apply as _apply
+            from ..ops.fused_ce import fused_linear_cross_entropy
+
+            def _fce(h, w, lb):
+                hh = h[:, :-1, :].reshape(B * (T - 1), D)
+                yy = lb[:, 1:].reshape(B * (T - 1))
+                losses = fused_linear_cross_entropy(
+                    hh, w.T, yy,
+                    num_chunks=self.config.fused_head_chunks)
+                return losses.mean()
+
+            out = _apply(_fce, logits, self.gpt.wte.weight,
+                         labels, op_name='fused_lm_head_ce')
+        else:
+            lg = manipulation.reshape(logits[:, :-1, :],
+                                      [B * (T - 1), D])
+            lb = manipulation.reshape(labels[:, 1:], [B * (T - 1)])
+            out = F.cross_entropy(lg, lb)
         if self.config.moe_num_experts > 0:
-            aux = [blk.mlp.aux_loss for blk in self.gpt.blocks
-                   if getattr(blk.mlp, 'aux_loss', None) is not None]
+            if aux_losses is not None:
+                aux = list(aux_losses)
+            else:
+                aux = [blk.mlp.aux_loss for blk in self.gpt.blocks
+                       if getattr(blk.mlp, 'aux_loss', None)
+                       is not None]
             if aux:
                 total = aux[0]
                 for a in aux[1:]:

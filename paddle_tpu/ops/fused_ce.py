@@ -1,0 +1,138 @@
+"""Fused linear + softmax cross-entropy head.
+
+Reference analogue: the reference fuses softmax+CE
+(softmax_with_cross_entropy,
+/root/reference/python/paddle/nn/functional/loss.py and the
+softmax_with_cross_entropy_op.cu kernel) but still materializes the
+full [N, V] logits from the LM head matmul.
+
+TPU-native: the head matmul itself is fused INTO the loss.  The f32
+[N, V] logits tensor — at GPT-2 scale (8x1024, 50257) ≈ 1.6 GB of HBM
+traffic per step for logits+softmax+grad — is never written.  The
+vocab dimension is processed in chunks with an ONLINE logsumexp
+(the flash-attention recurrence applied to the vocab axis):
+
+    m' = max(m, max_j z_j)       s' = s·e^(m-m') + Σ_j e^(z_j - m')
+
+per chunk, plus a label-logit gather.  Each chunk is one
+[N, H] x [H, Vc] MXU matmul (bf16 inputs, f32 accumulation via
+preferred_element_type) followed by elementwise work XLA fuses into
+it; live memory is [N, Vc].  The backward recomputes each chunk's
+logits (flash-style rematerialisation — FLOPs are cheap, HBM is not)
+and emits dx and dw chunkwise.
+
+Exact to the unfused computation up to f32 associativity: the
+correctness tests assert ≤1e-5 against log_softmax on the
+materialized logits.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ['fused_linear_cross_entropy']
+
+
+def _chunk_w(w, num_chunks):
+    H, V = w.shape
+    Vc = -(-V // num_chunks)
+    pad = num_chunks * Vc - V
+    if pad:
+        w = jnp.pad(w, ((0, 0), (0, pad)))
+    return w.reshape(H, num_chunks, Vc).transpose(1, 0, 2), Vc, pad
+
+
+def _fwd_scan(x, w, labels, num_chunks):
+    N, H = x.shape
+    V = w.shape[1]
+    wc, Vc, pad = _chunk_w(w, num_chunks)
+    xf = x
+
+    def body(carry, args):
+        m, s, zl = carry
+        w_c, c = args
+        z = jnp.dot(xf, w_c,
+                    preferred_element_type=jnp.float32)   # [N, Vc]
+        col0 = c * Vc
+        valid = (col0 + jnp.arange(Vc)) < V
+        z = jnp.where(valid[None, :], z, -jnp.inf)
+        new_m = jnp.maximum(m, jnp.max(z, axis=-1))
+        s = s * jnp.exp(m - new_m) \
+            + jnp.sum(jnp.exp(z - new_m[:, None]), axis=-1)
+        # label logit if it lives in this chunk
+        loc = labels - col0
+        mine = (loc >= 0) & (loc < Vc)
+        zl = zl + jnp.where(
+            mine,
+            jnp.take_along_axis(
+                z, jnp.clip(loc, 0, Vc - 1)[:, None], axis=1)[:, 0],
+            0.0)
+        return (new_m, s, zl), None
+
+    init = (jnp.full((N,), -jnp.inf, jnp.float32),
+            jnp.zeros((N,), jnp.float32),
+            jnp.zeros((N,), jnp.float32))
+    (m, s, zl), _ = lax.scan(
+        body, init, (wc, jnp.arange(num_chunks)))
+    lse = jnp.log(s) + m
+    return lse - zl, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_linear_cross_entropy(x, w, labels, num_chunks=8):
+    """Per-example CE of softmax(x @ w) against integer labels,
+    WITHOUT materializing the [N, V] logits.
+
+    x: [N, H] (any float dtype; bf16 recommended), w: [H, V],
+    labels: [N] int.  Returns f32 [N] losses (caller reduces).
+    `num_chunks` (static) splits V; live memory is [N, ceil(V/num_
+    chunks)].
+    """
+    loss, _ = _fwd_scan(x, w, labels, num_chunks)
+    return loss
+
+
+def _fwd(x, w, labels, num_chunks):
+    loss, lse = _fwd_scan(x, w, labels, num_chunks)
+    return loss, (x, w, labels, lse)
+
+
+def _bwd(num_chunks, res, g):
+    x, w, labels, lse = res
+    N, H = x.shape
+    V = w.shape[1]
+    wc, Vc, pad = _chunk_w(w, num_chunks)
+
+    def body(dx, args):
+        w_c, c = args
+        z = jnp.dot(x, w_c, preferred_element_type=jnp.float32)
+        col0 = c * Vc
+        valid = (col0 + jnp.arange(Vc)) < V
+        p = jnp.where(valid[None, :],
+                      jnp.exp(z - lse[:, None]), 0.0)      # [N, Vc]
+        loc = labels - col0
+        mine = (loc >= 0) & (loc < Vc)
+        onehot_col = jnp.clip(loc, 0, Vc - 1)
+        p = p.at[jnp.arange(N), onehot_col].add(
+            jnp.where(mine, -1.0, 0.0))
+        d = p * g[:, None]                                  # [N, Vc]
+        # dW chunk: [H, Vc]; dx accumulates over chunks
+        dw_c = jnp.dot(x.astype(jnp.float32).T, d,
+                       preferred_element_type=jnp.float32)
+        dx = dx + jnp.dot(d, w_c.astype(jnp.float32).T,
+                          preferred_element_type=jnp.float32)
+        return dx, dw_c
+
+    dx0 = jnp.zeros((N, H), jnp.float32)
+    dx, dw_chunks = lax.scan(
+        body, dx0, (wc, jnp.arange(num_chunks)))
+    dw = dw_chunks.transpose(1, 0, 2).reshape(H, -1)
+    if pad:
+        dw = dw[:, :V]
+    import numpy as np
+    ct = np.zeros(np.shape(labels), jax.dtypes.float0)
+    return dx.astype(x.dtype), dw.astype(w.dtype), ct
+
+
+fused_linear_cross_entropy.defvjp(_fwd, _bwd)
