@@ -38,6 +38,7 @@ from . import optimizer  # noqa: F401
 from . import io  # noqa: F401
 from . import nets  # noqa: F401
 from . import core  # noqa: F401
+from . import contrib  # noqa: F401
 
 
 def enable_dygraph(place=None):

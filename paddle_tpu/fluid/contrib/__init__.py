@@ -1,0 +1,34 @@
+"""fluid.contrib — the reference's incubating utilities.
+
+Reference analogue: /root/reference/python/paddle/fluid/contrib/
+(layers/, extend_optimizer/, memory_usage_calc.py, op_frequence.py,
+slim/, mixed_precision/, quantize/, decoder/).
+
+What ships here (TPU-native implementations): `layers`
+(ctr_metric_bundle, shuffle_batch, partial_concat, partial_sum,
+multiclass_nms2, sparse_embedding, fused_elemwise_activation),
+`extend_optimizer` (extend_with_decoupled_weight_decay),
+`memory_usage_calc.memory_usage` and `op_frequence.op_freq_statistic`.
+
+Explicit NON-GOALS (each already covered by a first-class subsystem or
+tied to deleted machinery — see SURVEY.md non-goals):
+  * contrib.slim / contrib.quantize → `paddle_tpu.quantization`
+    (QAT + PTQ with STE custom_vjp) is the supported toolkit;
+  * contrib.mixed_precision → `paddle_tpu.amp` / `static.amp`;
+  * contrib.decoder (beam search) → `nn.decode.BeamSearchDecoder`;
+  * tdm_child/tdm_sampler, search_pyramid_hash, var_conv_2d,
+    match_matrix_tensor, tree_conv, bilateral_slice, correlation,
+    rank_attention, batch_fc, _pull_box_extended_sparse → tree-index
+    retrieval / LoD-sequence / BoxPS ops with no public users in the
+    reference's 2.x API surface and no TPU-side demand; they raise
+    with pointers when imported via __getattr__.
+"""
+from . import layers  # noqa: F401
+from . import extend_optimizer  # noqa: F401
+from . import memory_usage_calc  # noqa: F401
+from . import op_frequence  # noqa: F401
+from .memory_usage_calc import memory_usage  # noqa: F401
+from .op_frequence import op_freq_statistic  # noqa: F401
+
+__all__ = ['layers', 'extend_optimizer', 'memory_usage_calc',
+           'op_frequence', 'memory_usage', 'op_freq_statistic']

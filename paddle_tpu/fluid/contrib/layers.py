@@ -1,0 +1,203 @@
+"""fluid.contrib.layers — incubating layer ops.
+
+Reference analogue:
+/root/reference/python/paddle/fluid/contrib/layers/metric_op.py:30
+(ctr_metric_bundle) and layers/nn.py (shuffle_batch:784,
+partial_concat:848, partial_sum:911, multiclass_nms2:539,
+sparse_embedding:965, fused_elemwise_activation:64).
+
+All vectorized jnp; the LoD inputs of the reference become dense
+tensors.  The long tail of tree-index / BoxPS ops is a documented
+non-goal (see package docstring) and raises with a pointer.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...tensor._helpers import wrap
+
+__all__ = ['ctr_metric_bundle', 'shuffle_batch', 'partial_concat',
+           'partial_sum', 'multiclass_nms2', 'sparse_embedding',
+           'fused_elemwise_activation']
+
+_NON_GOALS = {
+    'tdm_child', 'tdm_sampler', 'search_pyramid_hash', 'var_conv_2d',
+    'match_matrix_tensor', 'tree_conv', 'bilateral_slice',
+    'correlation', 'rank_attention', 'batch_fc',
+    'fused_embedding_seq_pool', 'sequence_topk_avg_pooling',
+    'fused_bn_add_act', '_pull_box_extended_sparse',
+}
+
+
+def __getattr__(name):
+    if name in _NON_GOALS:
+        raise NotImplementedError(
+            f'fluid.contrib.layers.{name} is an explicit non-goal: '
+            'tree-index retrieval / LoD-sequence / BoxPS machinery '
+            'with no 2.x public API surface. See '
+            'paddle_tpu/fluid/contrib/__init__.py for the supported '
+            'equivalents.')
+    raise AttributeError(name)
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """Per-batch CTR metric sums (reference metric_op.py:30): returns
+    (local_sqrerr, local_abserr, local_prob, local_q, local_pos_num,
+    local_ins_num) as 1-element tensors.  The reference accumulates
+    into persistable vars; here each call returns THIS batch's sums —
+    accumulate across batches, then allreduce via
+    fleet.metrics.mae/rmse exactly like the reference's workflow."""
+    def fn(p, y):
+        p = p.reshape(-1).astype(jnp.float32)
+        y = y.reshape(-1).astype(jnp.float32)
+        err = p - y
+        sqrerr = jnp.sum(err * err)[None]
+        abserr = jnp.sum(jnp.abs(err))[None]
+        prob = jnp.sum(p)[None]
+        q = jnp.sum(p / jnp.maximum(1.0 - p, 1e-8))[None]
+        pos = jnp.sum(y)[None]
+        total = jnp.asarray([p.shape[0]], jnp.float32)
+        return sqrerr, abserr, prob, q, pos, total
+    return apply(fn, wrap(input), wrap(label),
+                 op_name='ctr_metric_bundle')
+
+
+_SHUFFLE_CALLS = [0]
+
+
+def shuffle_batch(x, seed=None):
+    """Shuffle rows (all dims but the last) of x (reference
+    nn.py:784).  With seed=None each EAGER call draws a fresh
+    permutation (a per-call counter folded into the global seed — the
+    reference generates a fresh engine seed per execution); inside a
+    compiled step pass an explicit traced-varying seed, since a jit
+    trace bakes the counter value."""
+    if seed is None:
+        from ...core import rng as rng_mod
+        _SHUFFLE_CALLS[0] += 1
+        seed = rng_mod.get_seed() + 0x9e37 * _SHUFFLE_CALLS[0]
+
+    def fn(v):
+        lead = v.shape[:-1]
+        n = 1
+        for d in lead:
+            n *= d
+        flat = v.reshape(n, v.shape[-1])
+        perm = jax.random.permutation(
+            jax.random.PRNGKey(int(seed)), n)
+        return flat[perm].reshape(v.shape)
+    return apply(fn, wrap(x), op_name='shuffle_batch')
+
+
+def partial_concat(input, start_index=0, length=-1):
+    """Concat a column slice [start_index:start_index+length) of each
+    input along axis 1 (reference nn.py:848)."""
+    def fn(*vs):
+        outs = []
+        for v in vs:
+            end = v.shape[1] if length < 0 else start_index + length
+            outs.append(v[:, start_index:end])
+        return jnp.concatenate(outs, axis=1)
+    return apply(fn, *[wrap(v) for v in input],
+                 op_name='partial_concat')
+
+
+def partial_sum(input, start_index=0, length=-1):
+    """Sum the same column slice across inputs (reference
+    nn.py:911)."""
+    def fn(*vs):
+        acc = None
+        for v in vs:
+            end = v.shape[1] if length < 0 else start_index + length
+            s = v[:, start_index:end]
+            acc = s if acc is None else acc + s
+        return acc
+    return apply(fn, *[wrap(v) for v in input], op_name='partial_sum')
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k,
+                    keep_top_k, nms_threshold=0.3, normalized=True,
+                    nms_eta=1.0, background_label=0,
+                    return_index=False, name=None):
+    """Reference nn.py:539 — multiclass NMS that also returns the
+    selected box indices.  Routes to the detection suite's
+    fixed-shape implementation."""
+    from ...vision.detection import multiclass_nms
+    return multiclass_nms(
+        bboxes, scores, score_threshold=score_threshold,
+        nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+        nms_threshold=nms_threshold, normalized=normalized,
+        nms_eta=nms_eta, background_label=background_label,
+        return_index=return_index, name=name)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, param_attr=None, dtype='float32',
+                     **unused):
+    """Reference nn.py:965: a parameter-server-backed sparse embedding
+    lookup.  The TPU-native PS substitute is
+    incubate.HostOffloadEmbedding (host-resident table + async host
+    sparse update); this builds one per call-site name and applies it.
+    `padding_idx` rows read as zero and receive no updates (the output
+    mask zeroes both the row and its gradient, reference semantics).
+    For in-HBM tables use fleet.VocabParallelEmbedding instead."""
+    from ...incubate import HostOffloadEmbedding
+    key = ('sparse_embedding',
+           getattr(param_attr, 'name', None) or 'default',
+           tuple(size), dtype, bool(is_test))
+    layer = _SPARSE_CACHE.get(key)
+    if layer is None:
+        layer = _SPARSE_CACHE[key] = HostOffloadEmbedding(
+            size[0], size[1], dtype=dtype, entry=entry,
+            trainable=not is_test)
+    out = layer(input)
+    if padding_idx is not None:
+        if padding_idx < 0:
+            padding_idx = size[0] + padding_idx
+        def mask_fn(o, ids):
+            keep = (ids != padding_idx).astype(o.dtype)
+            return o * keep[..., None]
+        out = apply(mask_fn, wrap(out), wrap(input),
+                    op_name='sparse_embedding_pad_mask')
+    return out
+
+
+_SPARSE_CACHE = {}
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """Reference nn.py:64: ['unary', 'binary'] computes
+    unary(binary(x, y)); ['binary', 'unary'] computes
+    binary(x, unary(y)).  XLA fuses elementwise chains automatically,
+    so this is the plain functional composition — same result,
+    compiler-fused."""
+    binaries = {
+        'elementwise_add': lambda a, b: a + b,
+        'elementwise_mul': lambda a, b: a * b,
+    }
+    unaries = {
+        'relu': lambda a: jnp.maximum(a, 0),
+        'sigmoid': jax.nn.sigmoid,
+        'tanh': jnp.tanh,
+        'scale': lambda a: a * scale,
+    }
+    if isinstance(functor_list, str):
+        functor_list = functor_list.split(',')
+    if not isinstance(functor_list, (list, tuple)) \
+            or len(functor_list) != 2:
+        raise ValueError('functor_list should be 2 operator names')
+    f0, f1 = functor_list
+    if f0 in binaries and f1 in unaries:
+        def fn(a, b):
+            return binaries[f0](a, unaries[f1](b))
+    elif f0 in unaries and f1 in binaries:
+        def fn(a, b):
+            return unaries[f0](binaries[f1](a, b))
+    else:
+        raise ValueError(
+            f'functor_list must pair one of {sorted(binaries)} with '
+            f'one of {sorted(unaries)}, got {functor_list}')
+    return apply(fn, wrap(x), wrap(y),
+                 op_name='fused_elemwise_activation')

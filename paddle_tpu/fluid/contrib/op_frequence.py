@@ -1,0 +1,63 @@
+"""fluid.contrib.op_frequence — op histogram of a program.
+
+Reference analogue:
+/root/reference/python/paddle/fluid/contrib/op_frequence.py
+(op_freq_statistic walks Program.blocks counting op types, plus
+adjacent op-pair frequencies).
+
+TPU-native: the unit of execution is a jaxpr, not a ProgramDesc — the
+count walks either a static Program's recorded op DAG or the jaxpr of
+any traceable callable (`jax.make_jaxpr`), so it also sees what XLA
+will actually compile."""
+from collections import OrderedDict
+
+__all__ = ['op_freq_statistic']
+
+
+def _count_jaxpr(jaxpr, uni, pair):
+    prev = None
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        uni[name] = uni.get(name, 0) + 1
+        if prev is not None:
+            key = f'{prev}->{name}'
+            pair[key] = pair.get(key, 0) + 1
+        prev = name
+        # recurse into sub-jaxprs (scan/cond/while/pjit bodies)
+        for v in eqn.params.values():
+            sub = getattr(v, 'jaxpr', None)
+            if sub is not None:
+                _count_jaxpr(sub, uni, pair)
+
+
+def op_freq_statistic(program, *example_args):
+    """Return (uni_op_freq, adj_2_op_freq) OrderedDicts sorted by
+    count desc (the reference's exact return contract).
+
+    `program` may be a static Program (counts its recorded ops) or a
+    callable (its jaxpr is traced with `example_args`)."""
+    uni, pair = {}, {}
+    if hasattr(program, 'ops') or hasattr(program, '_ops'):
+        ops = getattr(program, 'ops', None) or getattr(program, '_ops')
+        prev = None
+        for op in ops:
+            name = getattr(op, 'type', None) or getattr(
+                op, 'op_name', type(op).__name__)
+            uni[name] = uni.get(name, 0) + 1
+            if prev is not None:
+                key = f'{prev}->{name}'
+                pair[key] = pair.get(key, 0) + 1
+            prev = name
+    elif callable(program):
+        import jax
+        jaxpr = jax.make_jaxpr(program)(*example_args)
+        _count_jaxpr(jaxpr.jaxpr, uni, pair)
+    else:
+        raise TypeError(
+            'op_freq_statistic expects a static Program or a '
+            f'callable, got {type(program).__name__}')
+    uni_sorted = OrderedDict(
+        sorted(uni.items(), key=lambda kv: -kv[1]))
+    pair_sorted = OrderedDict(
+        sorted(pair.items(), key=lambda kv: -kv[1]))
+    return uni_sorted, pair_sorted

@@ -1,0 +1,204 @@
+"""fluid.contrib: incubating utilities.
+
+Reference analogue: /root/reference/python/paddle/fluid/contrib/
+(layers/metric_op.py, layers/nn.py, extend_optimizer/,
+memory_usage_calc.py, op_frequence.py) and their unittests
+(contrib/tests/).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.fluid as fluid
+
+
+class TestCtrMetricBundle:
+    def test_sums_match_numpy(self):
+        rs = np.random.RandomState(0)
+        p = rs.rand(16, 1).astype('float32')
+        y = (rs.rand(16, 1) > 0.5).astype('float32')
+        sqe, abe, prob, q, pos, total = \
+            fluid.contrib.layers.ctr_metric_bundle(
+                paddle.to_tensor(p), paddle.to_tensor(y))
+        np.testing.assert_allclose(np.asarray(sqe.numpy()),
+                                   [((p - y) ** 2).sum()], rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(abe.numpy()),
+                                   [np.abs(p - y).sum()], rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(prob.numpy()),
+                                   [p.sum()], rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(pos.numpy()),
+                                   [y.sum()], rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(total.numpy()), [16.0])
+
+    def test_feeds_fleet_metrics(self):
+        # the reference workflow: bundle sums -> fleet.metrics.mae
+        from paddle_tpu.distributed.fleet import metrics as FM
+        p = np.array([[0.5], [0.0]], 'float32')
+        y = np.array([[1.0], [0.0]], 'float32')
+        _, abe, _, _, _, total = fluid.contrib.layers.ctr_metric_bundle(
+            paddle.to_tensor(p), paddle.to_tensor(y))
+        mae = FM.mae(np.asarray(abe.numpy()),
+                     np.asarray(total.numpy()))
+        assert mae == 0.25
+
+
+class TestContribLayers:
+    def test_shuffle_batch_permutes_rows(self):
+        x = np.arange(12, dtype='float32').reshape(6, 2)
+        out = np.asarray(fluid.contrib.layers.shuffle_batch(
+            paddle.to_tensor(x), seed=7).numpy())
+        assert out.shape == x.shape
+        assert sorted(map(tuple, out)) == sorted(map(tuple, x))
+
+    def test_partial_concat_and_sum(self):
+        a = np.arange(8, dtype='float32').reshape(2, 4)
+        b = a + 10
+        cat = np.asarray(fluid.contrib.layers.partial_concat(
+            [paddle.to_tensor(a), paddle.to_tensor(b)],
+            start_index=1, length=2).numpy())
+        np.testing.assert_allclose(
+            cat, np.concatenate([a[:, 1:3], b[:, 1:3]], axis=1))
+        s = np.asarray(fluid.contrib.layers.partial_sum(
+            [paddle.to_tensor(a), paddle.to_tensor(b)],
+            start_index=1, length=2).numpy())
+        np.testing.assert_allclose(s, a[:, 1:3] + b[:, 1:3])
+
+    def test_fused_elemwise_activation(self):
+        a = np.array([[-1.0, 2.0]], 'float32')
+        b = np.array([[3.0, -4.0]], 'float32')
+        # unary(binary(x, y)): relu(a + b)
+        out = np.asarray(fluid.contrib.layers.fused_elemwise_activation(
+            paddle.to_tensor(a), paddle.to_tensor(b),
+            ['relu', 'elementwise_add']).numpy())
+        np.testing.assert_allclose(out, np.maximum(a + b, 0))
+        # binary(x, unary(y)): a * relu(b)
+        out = np.asarray(fluid.contrib.layers.fused_elemwise_activation(
+            paddle.to_tensor(a), paddle.to_tensor(b),
+            ['elementwise_mul', 'relu']).numpy())
+        np.testing.assert_allclose(out, a * np.maximum(b, 0))
+
+    def test_multiclass_nms2_returns_index(self):
+        rs = np.random.RandomState(1)
+        bboxes = rs.rand(1, 8, 4).astype('float32') * 4
+        bboxes[..., 2:] = bboxes[..., :2] + 1.0
+        scores = rs.rand(1, 2, 8).astype('float32')
+        out, num, idx = fluid.contrib.layers.multiclass_nms2(
+            paddle.to_tensor(bboxes), paddle.to_tensor(scores),
+            score_threshold=0.1, nms_top_k=4, keep_top_k=3,
+            background_label=-1, return_index=True)
+        assert np.asarray(idx.numpy()).shape == (1, 3)
+
+    def test_sparse_embedding_routes_to_host_table(self):
+        out = fluid.contrib.layers.sparse_embedding(
+            paddle.to_tensor(np.array([1, 3], 'int64')), size=(8, 4))
+        assert np.asarray(out.numpy()).shape == (2, 4)
+
+    def test_non_goal_raises_with_pointer(self):
+        with pytest.raises(NotImplementedError, match='non-goal'):
+            fluid.contrib.layers.tdm_sampler
+
+
+class TestExtendOptimizer:
+    def test_decoupled_decay_matches_manual(self):
+        from paddle_tpu.fluid.contrib.extend_optimizer import \
+            extend_with_decoupled_weight_decay
+        paddle.seed(0)
+        lin = nn.Linear(3, 3)
+        w0 = np.asarray(lin.weight.value).copy()
+        SGDWD = extend_with_decoupled_weight_decay(
+            paddle.optimizer.SGD)
+        opt = SGDWD(weight_decay=0.1, learning_rate=0.5,
+                    parameters=lin.parameters())
+        x = paddle.to_tensor(np.ones((2, 3), 'float32'))
+        loss = lin(x).sum()
+        loss.backward()
+        g = np.asarray(lin.weight.grad.value)
+        opt.step()
+        w1 = np.asarray(lin.weight.value)
+        # sgd step then decoupled decay: w - lr*g - lr*coeff*w
+        np.testing.assert_allclose(
+            w1, w0 - 0.5 * g - 0.5 * 0.1 * w0, rtol=1e-5)
+
+    def test_apply_decay_param_fun(self):
+        from paddle_tpu.fluid.contrib.extend_optimizer import \
+            extend_with_decoupled_weight_decay
+        paddle.seed(0)
+        lin = nn.Linear(2, 2)
+        b0 = np.asarray(lin.bias.value).copy()
+        SGDWD = extend_with_decoupled_weight_decay(
+            paddle.optimizer.SGD)
+        opt = SGDWD(weight_decay=0.5, learning_rate=0.1,
+                    parameters=lin.parameters(),
+                    apply_decay_param_fun=lambda n: n and 'w' in n)
+        loss = lin(paddle.to_tensor(np.ones((1, 2), 'float32'))).sum()
+        loss.backward()
+        gb = np.asarray(lin.bias.grad.value)
+        opt.step()
+        # bias excluded from decay: plain sgd only
+        np.testing.assert_allclose(np.asarray(lin.bias.value),
+                                   b0 - 0.1 * gb, rtol=1e-5)
+
+    def test_type_error(self):
+        from paddle_tpu.fluid.contrib.extend_optimizer import \
+            extend_with_decoupled_weight_decay
+        with pytest.raises(TypeError):
+            extend_with_decoupled_weight_decay(object)
+
+
+class TestMemoryAndOpFreq:
+    def test_memory_usage_layer(self):
+        m = nn.Linear(10, 20)   # 10*20 + 20 = 220 floats
+        lo, hi = fluid.contrib.memory_usage(m, batch_size=4)
+        assert lo < 220 * 4 < hi
+
+    def test_memory_usage_bad_type(self):
+        with pytest.raises(TypeError):
+            fluid.contrib.memory_usage(42)
+
+    def test_op_freq_statistic_callable(self):
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.sin(x) + jnp.sin(x) * jnp.cos(x)
+
+        uni, pair = fluid.contrib.op_freq_statistic(
+            f, np.ones(3, 'float32'))
+        assert uni.get('sin', 0) >= 1
+        assert uni.get('cos', 0) >= 1
+        assert any('->' in k for k in pair)
+
+
+class TestContribReviewFixes:
+    def test_sparse_embedding_padding_idx_zero_and_frozen(self):
+        import paddle_tpu.fluid.contrib.layers as CL
+        CL._SPARSE_CACHE.clear()
+        ids = paddle.to_tensor(np.array([0, 3], 'int64'))
+        out = CL.sparse_embedding(ids, size=(8, 4), padding_idx=0,
+                                  param_attr=None)
+        o = np.asarray(out.numpy())
+        assert (o[0] == 0).all() and not (o[1] == 0).all()
+        # gradient through the pad row is zero -> its table row does
+        # not learn
+        layer = next(iter(CL._SPARSE_CACHE.values()))
+        row0 = layer.table[0].copy()
+        out2 = CL.sparse_embedding(ids, size=(8, 4), padding_idx=0)
+        out2.sum().backward()
+        np.testing.assert_allclose(layer.table[0], row0)
+
+    def test_sparse_embedding_is_test_not_shared(self):
+        import paddle_tpu.fluid.contrib.layers as CL
+        CL._SPARSE_CACHE.clear()
+        ids = paddle.to_tensor(np.array([1], 'int64'))
+        CL.sparse_embedding(ids, size=(8, 4), is_test=True)
+        CL.sparse_embedding(ids, size=(8, 4), is_test=False)
+        trainables = {k[-1]: v.trainable
+                      for k, v in CL._SPARSE_CACHE.items()}
+        assert trainables == {True: False, False: True}
+
+    def test_shuffle_batch_fresh_permutation_per_call(self):
+        x = np.arange(64, dtype='float32').reshape(32, 2)
+        outs = [np.asarray(fluid.contrib.layers.shuffle_batch(
+            paddle.to_tensor(x)).numpy()) for _ in range(3)]
+        assert not np.array_equal(outs[0], outs[1]) or \
+            not np.array_equal(outs[1], outs[2])
