@@ -158,8 +158,12 @@ def bench_gpt(smoke):
     batch, seq, iters, warmup = (2, 128, 3, 2) if smoke else \
         (8, 1024, 15, 3)
     paddle.seed(0)
-    model = gpt_tiny() if smoke else gpt_small(max_seq_len=seq,
-                                               dropout=0.0)
+    # fused_head: the LM-head matmul fuses into the loss (ops/
+    # fused_ce.py) — no f32 [B·T, V] logits tensor, the top HBM
+    # consumer of the unfused step
+    model = gpt_tiny(fused_head=True) if smoke else \
+        gpt_small(max_seq_len=seq, dropout=0.0, fused_head=True,
+                  fused_head_chunks=8)
     opt = paddle.optimizer.AdamW(learning_rate=3e-4,
                                  parameters=model.parameters())
     strategy = fleet.DistributedStrategy()
@@ -410,6 +414,17 @@ def _device_preflight(total_budget_s=600):
     return False
 
 
+def _write_partial(results):
+    """Checkpoint the artifact-so-far next to this script."""
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            'BENCH_partial.json')
+        with open(path, 'w') as f:
+            json.dump(results, f, indent=1)
+    except OSError as e:
+        log(f'could not write partial artifact: {e}')
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument('--smoke', action='store_true',
@@ -439,9 +454,29 @@ def main():
                        'error': 'device preflight failed (accelerator '
                                 'runtime unreachable)'} for n in names}
         names = []
-    for name in names:
+    for i, name in enumerate(names):
         if args.config == 'all':
             results[name] = _run_isolated(name, args.smoke, args.timeout)
+            # partial artifact after EVERY config: a tunnel death (or
+            # driver kill) mid-run keeps the finished configs' numbers
+            _write_partial(results)
+            if 'timeout' in str(results[name].get('error', '')) and \
+                    i + 1 < len(names):
+                # a timed-out config usually means the tunnel wedged
+                # mid-run: one quick probe decides between burning the
+                # full timeout on every remaining config or failing
+                # them fast with a diagnosable error
+                if not _device_preflight_once(90):
+                    log('tunnel unresponsive after timeout; '
+                        'fast-failing remaining configs')
+                    for rest in names[i + 1:]:
+                        results[rest] = {
+                            'value': None, 'unit': UNITS[rest],
+                            'error': 'accelerator runtime died '
+                                     'mid-run (previous config '
+                                     'timed out, preflight failed)'}
+                    _write_partial(results)
+                    break
         else:
             import jax
             log(f'device: {jax.devices()[0]}')
