@@ -513,3 +513,123 @@ class TestFleetSurface:
         x2.stop_gradient = False
         block(x2).sum().backward()
         np.testing.assert_allclose(g_re, x2.grad.numpy(), rtol=1e-5)
+
+
+class TestSplitLayerCache:
+    """The eager name-keyed split() cache must not survive a fleet
+    re-init with a different topology (advisor r3: stale per-shard
+    weight shapes, cross-test weight leaks)."""
+
+    def test_reinit_new_topology_clears_cache(self):
+        from paddle_tpu.distributed import mp_ops
+        import paddle_tpu.distributed as dist
+        strategy = fleet.DistributedStrategy()
+        fleet.init(is_collective=True, strategy=strategy)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 8).astype('float32'))
+        dist.split(x, (8, 4), 'linear', axis=1, name='cache_probe')
+        assert any(k[0] == 'cache_probe' for k in mp_ops._LAYER_CACHE)
+        # same topology re-init: jax interns the Mesh, cache survives
+        # (name-keyed reuse is the documented feature)
+        fleet.init(is_collective=True,
+                   strategy=fleet.DistributedStrategy())
+        assert any(k[0] == 'cache_probe' for k in mp_ops._LAYER_CACHE)
+        # switching topology keeps the outgoing mesh's entries (a
+        # program alternating train/aux meshes must not lose trained
+        # weights) but a SECOND switch away evicts them
+        s2 = fleet.DistributedStrategy()
+        s2.hybrid_configs = {'mp_degree': 2}
+        fleet.init(is_collective=True, strategy=s2)
+        assert any(k[0] == 'cache_probe' for k in mp_ops._LAYER_CACHE)
+        s3 = fleet.DistributedStrategy()
+        s3.hybrid_configs = {'mp_degree': 4}
+        fleet.init(is_collective=True, strategy=s3)
+        assert not any(k[0] == 'cache_probe'
+                       for k in mp_ops._LAYER_CACHE)
+
+    def test_cache_key_includes_mesh(self):
+        from paddle_tpu.distributed import mp_ops
+        import paddle_tpu.distributed as dist
+        fleet.init(is_collective=True,
+                   strategy=fleet.DistributedStrategy())
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 8).astype('float32'))
+        dist.split(x, (8, 4), 'linear', axis=1, name='mesh_probe')
+        key = next(k for k in mp_ops._LAYER_CACHE
+                   if k[0] == 'mesh_probe')
+        assert dist_env.get_mesh() in key
+
+    def test_set_mesh_bounds_cache(self):
+        from paddle_tpu.distributed import mp_ops
+        import paddle_tpu.distributed as dist
+        # isolate the direct-switch policy from meshes other tests
+        # parked in the None-gap recent window
+        dist_env._recent_real = []
+        fleet.init(is_collective=True,
+                   strategy=fleet.DistributedStrategy())
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 8).astype('float32'))
+        dist.split(x, (8, 4), 'linear', axis=1, name='evict_probe')
+        assert mp_ops._LAYER_CACHE
+        mesh = dist_env.get_mesh()
+        dist_env.set_mesh(mesh)          # same mesh: cache survives
+        assert mp_ops._LAYER_CACHE
+        # A → B: outgoing mesh's entries survive (weights preserved
+        # for a program that returns to A) …
+        mesh_b = Mesh(np.array(jax.devices()).reshape(4, 2),
+                      ('dp', 'tp'))
+        dist_env.set_mesh(mesh_b)
+        assert any(k[0] == 'evict_probe' for k in mp_ops._LAYER_CACHE)
+        # … but B → C evicts A's entries: growth is bounded to the
+        # current + previous meshes
+        mesh_c = Mesh(np.array(jax.devices()).reshape(2, 4),
+                      ('dp', 'tp'))
+        dist_env.set_mesh(mesh_c)
+        assert not any(k[0] == 'evict_probe'
+                       for k in mp_ops._LAYER_CACHE)
+        dist_env.set_mesh(mesh)
+
+    def test_none_bridge_preserves_train_mesh_entries(self):
+        # A → None (teardown) → B must NOT evict A's trained layers
+        from paddle_tpu.distributed import mp_ops
+        import paddle_tpu.distributed as dist
+        fleet.init(is_collective=True,
+                   strategy=fleet.DistributedStrategy())
+        mesh_a = dist_env.get_mesh()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 8).astype('float32'))
+        dist.split(x, (8, 4), 'linear', axis=1, name='bridge_probe')
+        dist_env.set_mesh(None)
+        mesh_b = Mesh(np.array(jax.devices()).reshape(4, 2),
+                      ('dp', 'tp'))
+        dist_env.set_mesh(mesh_b)
+        assert any(k[0] == 'bridge_probe'
+                   for k in mp_ops._LAYER_CACHE)
+        # returning to A reuses the SAME trained layer
+        dist_env.set_mesh(mesh_a)
+        key = next(k for k in mp_ops._LAYER_CACHE
+                   if k[0] == 'bridge_probe')
+        layer = mp_ops._LAYER_CACHE[key]
+        dist.split(x, (8, 4), 'linear', axis=1, name='bridge_probe')
+        assert mp_ops._LAYER_CACHE[key] is layer
+        dist_env.set_mesh(mesh_a)
+
+    def test_double_none_gap_preserves_entries(self):
+        # A → None → B → None → A must keep A's trained layers
+        from paddle_tpu.distributed import mp_ops
+        import paddle_tpu.distributed as dist
+        fleet.init(is_collective=True,
+                   strategy=fleet.DistributedStrategy())
+        mesh_a = dist_env.get_mesh()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 8).astype('float32'))
+        dist.split(x, (8, 4), 'linear', axis=1, name='gap2_probe')
+        key = next(k for k in mp_ops._LAYER_CACHE
+                   if k[0] == 'gap2_probe')
+        layer = mp_ops._LAYER_CACHE[key]
+        dist_env.set_mesh(None)
+        dist_env.set_mesh(Mesh(np.array(jax.devices()).reshape(4, 2),
+                               ('dp', 'tp')))
+        dist_env.set_mesh(None)
+        dist_env.set_mesh(mesh_a)
+        assert mp_ops._LAYER_CACHE.get(key) is layer
