@@ -113,8 +113,11 @@ def bench_bert(smoke):
     batch, seq, iters, warmup = (4, 64, 3, 2) if smoke else \
         (64, 128, 20, 4)
     paddle.seed(0)
-    model = bert_tiny() if smoke else bert_base(max_seq_len=seq,
-                                                dropout=0.0)
+    # fused_head: the tied-decoder matmul fuses into the MLM loss
+    # (ops/fused_ce.py) — no [B·T, V] logits tensor
+    model = bert_tiny(fused_head=True) if smoke else \
+        bert_base(max_seq_len=seq, dropout=0.0, fused_head=True,
+                  fused_head_chunks=8)
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters())
     strategy = fleet.DistributedStrategy()

@@ -291,3 +291,8 @@ def roi_pool(input, rois, pooled_height=1, pooled_width=1,
     return _roi_pool(input, rois, rois_num,
                      (pooled_height, pooled_width),
                      spatial_scale=spatial_scale)
+
+from ..vision.detection import (    # noqa: F401,E402
+    density_prior_box, bipartite_match, target_assign,
+    detection_output, ssd_loss, distribute_fpn_proposals,
+    collect_fpn_proposals)

@@ -22,7 +22,8 @@ class BertConfig:
     def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
                  num_heads=12, max_seq_len=512, type_vocab_size=2,
                  intermediate_size=None, dropout=0.1,
-                 layer_norm_epsilon=1e-12):
+                 layer_norm_epsilon=1e-12, fused_head=False,
+                 fused_head_chunks=8):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -32,6 +33,13 @@ class BertConfig:
         self.intermediate_size = intermediate_size or 4 * hidden_size
         self.dropout = dropout
         self.layer_norm_epsilon = layer_norm_epsilon
+        # fused MLM head (ops/fused_ce.py): training forward returns
+        # the transformed hidden states and loss() fuses the tied
+        # decoder matmul + softmax + CE over vocab chunks — the
+        # [B·T, V] logits never materialize (single-chip / dp paths;
+        # keep off under tp)
+        self.fused_head = fused_head
+        self.fused_head_chunks = fused_head_chunks
 
 
 class BertSelfAttention(nn.Layer):
@@ -166,18 +174,47 @@ class BertForPretraining(nn.Layer):
         seq, pooled = self.bert(input_ids, token_type_ids)
         h = self.mlm_ln(F.gelu(self.mlm_transform(seq),
                                approximate=True))
+        nsp_logits = self.nsp(pooled)
+        if self.config.fused_head and self.training:
+            # fused MLM head: the tied-decoder matmul happens inside
+            # loss() (ops/fused_ce.py) — return the hidden states
+            return h, nsp_logits
         logits = linalg.matmul(h, self.bert.word_emb.weight,
                                transpose_y=True)
         logits = maybe_shard(logits, ('dp', None, 'tp'))
-        nsp_logits = self.nsp(pooled)
         return logits, nsp_logits
 
     def loss(self, outputs, mlm_labels, nsp_labels=None):
         logits, nsp_logits = outputs
-        B, T, V = logits.shape
-        lg = manipulation.reshape(logits, [B * T, V])
-        lb = manipulation.reshape(mlm_labels, [B * T])
-        mlm = F.cross_entropy(lg, lb, ignore_index=-100)
+        B, T, D = logits.shape
+        # keyed off the SHAPE the forward actually produced, not
+        # self.training — a train-forward/eval-loss toggle must not
+        # feed hidden states into the unfused CE branch
+        if self.config.fused_head and D == self.config.hidden_size \
+                and D != self.config.vocab_size:
+            from ..core.dispatch import apply as _apply
+            from ..ops.fused_ce import fused_linear_cross_entropy
+            import jax.numpy as jnp
+
+            def _fce(h, w, lb):
+                hh = h.reshape(B * T, D)
+                yy = lb.reshape(B * T)
+                losses = fused_linear_cross_entropy(
+                    hh, w.T, yy,
+                    num_chunks=self.config.fused_head_chunks)
+                # ignore_index=-100: those labels land in no vocab
+                # chunk, so masking the loss zeroes both the value
+                # and (through the where) the gradient
+                valid = yy != -100
+                n = jnp.maximum(jnp.sum(valid), 1)
+                return jnp.sum(jnp.where(valid, losses, 0.0)) / n
+
+            mlm = _apply(_fce, logits, self.bert.word_emb.weight,
+                         mlm_labels, op_name='fused_mlm_head_ce')
+        else:
+            lg = manipulation.reshape(logits, [B * T, D])
+            lb = manipulation.reshape(mlm_labels, [B * T])
+            mlm = F.cross_entropy(lg, lb, ignore_index=-100)
         if nsp_labels is None:
             return mlm
         return mlm + F.cross_entropy(nsp_logits, nsp_labels)

@@ -354,8 +354,11 @@ class GPTForCausalLM(nn.Layer):
         states [B, T, H] and the linear+softmax+CE fuse here via
         ops/fused_ce.py — no [B·T, V] logits tensor exists."""
         B, T, D = logits.shape
-        if self.config.fused_head and \
-                D == self.config.hidden_size and self.training:
+        # keyed off the SHAPE the forward actually produced, not
+        # self.training — a train-forward/eval-loss toggle must not
+        # feed hidden states into the unfused CE branch
+        if self.config.fused_head and D == self.config.hidden_size \
+                and D != self.config.vocab_size:
             from ..core.dispatch import apply as _apply
             from ..ops.fused_ce import fused_linear_cross_entropy
 

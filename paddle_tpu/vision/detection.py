@@ -707,3 +707,410 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
     return apply(fn, wrap(x), wrap(boxes), wrap(boxes_num),
                  op_name='roi_pool')
+
+
+# -- SSD training path + FPN routing (batch 2) ---------------------------
+
+__all__ += ['density_prior_box', 'bipartite_match', 'target_assign',
+            'detection_output', 'ssd_loss',
+            'distribute_fpn_proposals', 'collect_fpn_proposals']
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    """SSD density prior boxes (reference detection.py:1925 /
+    density_prior_box_op.h): each fixed_size s with density d places a
+    d x d grid of shifted centers per cell, one box per fixed_ratio.
+    Returns (boxes [H, W, P, 4] or [H*W*P, 4], variances same)."""
+    densities = [int(d) for d in (densities or [])]
+    fixed_sizes = [float(s) for s in (fixed_sizes or [])]
+    fixed_ratios = [float(r) for r in (fixed_ratios or [])]
+    if len(densities) != len(fixed_sizes):
+        raise ValueError('densities and fixed_sizes must pair up')
+    if not fixed_ratios:
+        raise ValueError('fixed_ratios must be provided')
+    var = [float(v) for v in variance]
+
+    def fn(feat, img):
+        H, W = feat.shape[2], feat.shape[3]
+        imH, imW = img.shape[2], img.shape[3]
+        step_w = float(steps[0]) or imW / W
+        step_h = float(steps[1]) or imH / H
+        step_avg = int((step_w + step_h) * 0.5)
+        dt = jnp.promote_types(feat.dtype, jnp.float32)
+        cx = (jnp.arange(W, dtype=dt) + offset) * step_w     # [W]
+        cy = (jnp.arange(H, dtype=dt) + offset) * step_h     # [H]
+        # per-cell offsets and box extents, in reference emit order
+        offs_x, offs_y, half_w, half_h = [], [], [], []
+        for s, d in zip(fixed_sizes, densities):
+            shift = step_avg // d
+            base = -step_avg / 2.0 + shift / 2.0
+            for r in fixed_ratios:
+                bw = s * math.sqrt(r) / 2.0
+                bh = s / math.sqrt(r) / 2.0
+                for di in range(d):
+                    for dj in range(d):
+                        offs_x.append(base + dj * shift)
+                        offs_y.append(base + di * shift)
+                        half_w.append(bw)
+                        half_h.append(bh)
+        ox = jnp.asarray(offs_x, dt)                         # [P]
+        oy = jnp.asarray(offs_y, dt)
+        hw = jnp.asarray(half_w, dt)
+        hh = jnp.asarray(half_h, dt)
+        P = ox.shape[0]
+        ctr_x = cx[None, :, None] + ox                       # [1,W,P]
+        ctr_y = cy[:, None, None] + oy                       # [H,1,P]
+        # the kernel clamps into [0, 1] at assignment time
+        parts = [jnp.maximum((ctr_x - hw) / imW, 0.0),
+                 jnp.maximum((ctr_y - hh) / imH, 0.0),
+                 jnp.minimum((ctr_x + hw) / imW, 1.0),
+                 jnp.minimum((ctr_y + hh) / imH, 1.0)]
+        boxes = jnp.stack([jnp.broadcast_to(p, (H, W, P))
+                           for p in parts], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        vs = jnp.broadcast_to(jnp.asarray(var, dt), boxes.shape)
+        if flatten_to_2d:
+            boxes = boxes.reshape(-1, 4)
+            vs = vs.reshape(-1, 4)
+        return boxes, vs
+
+    return apply(fn, wrap(input), wrap(image),
+                 op_name='density_prior_box')
+
+
+def _bipartite_core(dist, match_type, dist_threshold):
+    """dist [R, C] -> (col_to_row [C] int32, col_dist [C]).  Greedy
+    global matching exactly like bipartite_match_op.cc: repeatedly
+    take the largest remaining (row, col) pair — as a fori_loop of R
+    argmax steps over a masked matrix; then the per_prediction pass
+    argmaxes each unmatched column over rows with dist >= threshold."""
+    R, C = dist.shape
+    NEG = jnp.asarray(-1.0, dist.dtype)
+
+    def body(_, st):
+        m, row_used, col_used = st
+        masked = jnp.where(row_used[:, None] | col_used[None, :],
+                           NEG, dist)
+        flat = jnp.argmax(masked)
+        i, j = flat // C, flat % C
+        ok = masked[i, j] > 0
+        m = m.at[j].set(jnp.where(ok, i.astype(jnp.int32), m[j]))
+        row_used = row_used.at[i].set(row_used[i] | ok)
+        col_used = col_used.at[j].set(col_used[j] | ok)
+        return m, row_used, col_used
+
+    m0 = jnp.full((C,), -1, jnp.int32)
+    m, _, _ = lax.fori_loop(
+        0, R, body, (m0, jnp.zeros(R, bool), jnp.zeros(C, bool)))
+
+    if match_type == 'per_prediction':
+        thr = 0.5 if dist_threshold is None else float(dist_threshold)
+        best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)   # [C]
+        best = jnp.max(dist, axis=0)
+        extra = (m == -1) & (best >= thr) & (best >= 1e-6)
+        m = jnp.where(extra, best_row, m)
+    col_dist = jnp.where(
+        m >= 0,
+        jnp.take_along_axis(dist, jnp.clip(m, 0, R - 1)[None, :],
+                            axis=0)[0],
+        0.0)
+    return m, col_dist
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """Greedy bipartite (+ optional per-prediction argmax) matching
+    (reference detection.py bipartite_match / bipartite_match_op.cc).
+
+    dist_matrix: [R, C] or batched [N, R, C] (the reference's LoD
+    instances become a leading batch dim).  Returns
+    (match_indices [.., C] int32 with -1 for unmatched,
+    match_dist [.., C])."""
+    def fn(d):
+        if d.ndim == 2:
+            return _bipartite_core(d, match_type, dist_threshold)
+        return jax.vmap(
+            lambda x: _bipartite_core(x, match_type, dist_threshold)
+        )(d)
+    return apply(fn, wrap(dist_matrix), op_name='bipartite_match')
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    """Assign per-prior targets from per-instance rows (reference
+    detection.py:1407 / target_assign_op.h).
+
+    input: [N, G, K] per-instance rows (the reference's LoD rows,
+    dense-padded); matched_indices: [N, P] int32 (-1 = unmatched).
+    negative_indices: [N, Q] int32 padded with -1 (the reference's
+    LoD negative list).  Returns (out [N, P, K], weight [N, P, 1])."""
+    mv = 0.0 if mismatch_value is None else mismatch_value
+
+    def fn(x, m, *neg):
+        N, P = m.shape
+        K = x.shape[-1]
+        idx = jnp.clip(m, 0, x.shape[1] - 1)
+        gathered = jnp.take_along_axis(
+            x, idx[..., None].astype(jnp.int32).repeat(K, -1), axis=1)
+        matched = (m >= 0)
+        out = jnp.where(matched[..., None], gathered,
+                        jnp.asarray(mv, x.dtype))
+        w = matched.astype(jnp.float32)
+        if neg:
+            ni = neg[0]                                   # [N, Q]
+            valid = ni >= 0
+            one = jnp.zeros((N, P), jnp.float32)
+            rows = jnp.broadcast_to(
+                jnp.arange(N)[:, None], ni.shape)
+            one = one.at[rows.reshape(-1),
+                         jnp.clip(ni, 0, P - 1).reshape(-1)].max(
+                             valid.reshape(-1).astype(jnp.float32))
+            out = jnp.where((one > 0)[..., None],
+                            jnp.asarray(mv, x.dtype), out)
+            w = jnp.maximum(w, one)
+        return out, w[..., None]
+
+    args = [wrap(input), wrap(matched_indices)]
+    if negative_indices is not None:
+        args.append(wrap(negative_indices))
+    return apply(fn, *args, op_name='target_assign')
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3,
+                     nms_top_k=400, keep_top_k=200,
+                     score_threshold=0.01, nms_eta=1.0,
+                     return_index=False, name=None):
+    """SSD postprocess: decode loc deltas against priors, softmax the
+    class scores, then multiclass NMS (reference detection.py:621 —
+    it applies nn.softmax(scores) before the NMS op, so thresholds
+    compare against probabilities, not raw logits).  scores are
+    [N, M, C] per-box class logits.  Returns the fixed-shape padded
+    (out [N, keep_top_k, 6], nms_rois_num [N][, index])."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type='decode_center_size', axis=0)
+
+    def tr(s):
+        return jnp.transpose(jax.nn.softmax(s, axis=-1), (0, 2, 1))
+    sc = apply(tr, wrap(scores), op_name='detection_output_softmax')
+    return multiclass_nms(decoded, sc,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          normalized=True, nms_eta=nms_eta,
+                          background_label=background_label,
+                          return_index=return_index, name=name)
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0,
+             overlap_threshold=0.5, neg_pos_ratio=3.0,
+             neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type='per_prediction',
+             mining_type='max_negative', normalize=True,
+             sample_size=None, name=None):
+    """SSD multibox loss (reference detection.py:1513): match priors
+    to ground truth (bipartite + per-prediction argmax), assign conf/
+    loc targets, hard-negative-mine the confidence loss, smooth-L1 the
+    matched locations.
+
+    Dense redesign of the LoD contract: gt_box [N, G, 4] and gt_label
+    [N, G] are PADDED per image — padding rows have all-zero boxes
+    (zero IoU with everything, so they can never match).  location
+    [N, P, 4], confidence [N, P, C], prior_box [P, 4].
+    Returns the scalar weighted loss (normalize=True divides by the
+    total matched count, like the reference)."""
+    if mining_type != 'max_negative':
+        raise NotImplementedError(
+            'only max_negative mining is supported (the reference '
+            'deprecated mining_type=hard_example)')
+    var_list = None
+    if prior_box_var is None:
+        var_list = [1.0, 1.0, 1.0, 1.0]
+    elif isinstance(prior_box_var, (list, tuple)):
+        var_list = [float(v) for v in prior_box_var]
+
+    def fn(locp, conf, gtb, gtl, prior, *maybe_var):
+        N, P, C = conf.shape
+        G = gtb.shape[1]
+        pvar = (maybe_var[0] if maybe_var
+                else jnp.asarray(var_list, locp.dtype))
+
+        def one_image(lp, cf, gb, gl):
+            iou = _iou_matrix(gb, prior)                  # [G, P]
+            m, mdist = _bipartite_core(iou, match_type,
+                                       overlap_threshold)
+            matched = m >= 0                              # [P]
+            gidx = jnp.clip(m, 0, G - 1)
+            # conf target: matched -> gt label, else background
+            tgt_lab = jnp.where(matched, gl[gidx],
+                                background_label).astype(jnp.int32)
+            logp = jax.nn.log_softmax(cf.astype(jnp.float32), -1)
+            ce = -jnp.take_along_axis(
+                logp, tgt_lab[:, None], axis=1)[:, 0]     # [P]
+            # hard negative mining: negatives ranked by THEIR loss
+            # (conf loss against background), top neg_pos_ratio*npos
+            npos = jnp.sum(matched)
+            nneg_cap = jnp.minimum(
+                (neg_pos_ratio * npos).astype(jnp.int32),
+                P - npos.astype(jnp.int32))
+            if sample_size is not None:
+                nneg_cap = jnp.minimum(nneg_cap, int(sample_size))
+            neg_scores = jnp.where(matched, -jnp.inf, ce)
+            order = jnp.argsort(-neg_scores)
+            rank = jnp.zeros(P, jnp.int32).at[order].set(
+                jnp.arange(P, dtype=jnp.int32))
+            neg_sel = (~matched) & (rank < nneg_cap)
+            conf_loss = jnp.sum(jnp.where(matched | neg_sel, ce, 0.0))
+            # loc loss on matched priors: encode gt against priors
+            pw = prior[:, 2] - prior[:, 0]
+            ph = prior[:, 3] - prior[:, 1]
+            pcx = prior[:, 0] + pw / 2
+            pcy = prior[:, 1] + ph / 2
+            g = gb[gidx]                                  # [P, 4]
+            gw = g[:, 2] - g[:, 0]
+            gh = g[:, 3] - g[:, 1]
+            gcx = (g[:, 0] + g[:, 2]) / 2
+            gcy = (g[:, 1] + g[:, 3]) / 2
+            vx, vy, vw, vh = (pvar[..., 0], pvar[..., 1],
+                              pvar[..., 2], pvar[..., 3])
+            tx = (gcx - pcx) / pw / vx
+            ty = (gcy - pcy) / ph / vy
+            tw = jnp.log(jnp.maximum(gw / pw, 1e-10)) / vw
+            th = jnp.log(jnp.maximum(gh / ph, 1e-10)) / vh
+            tgt = jnp.stack([tx, ty, tw, th], -1)         # [P, 4]
+            diff = jnp.abs(lp.astype(jnp.float32) - tgt)
+            sl1 = jnp.where(diff < 1.0, 0.5 * diff * diff,
+                            diff - 0.5).sum(-1)
+            loc_loss = jnp.sum(jnp.where(matched, sl1, 0.0))
+            return conf_loss, loc_loss, npos
+
+        cl, ll, np_ = jax.vmap(one_image)(locp, conf, gtb, gtl)
+        total = (conf_loss_weight * jnp.sum(cl)
+                 + loc_loss_weight * jnp.sum(ll))
+        if normalize:
+            total = total / jnp.maximum(
+                jnp.sum(np_).astype(jnp.float32), 1.0)
+        return total
+
+    args = [wrap(location), wrap(confidence), wrap(gt_box),
+            wrap(gt_label), wrap(prior_box)]
+    if prior_box_var is not None and var_list is None:
+        args.append(wrap(prior_box_var))
+    return apply(fn, *args, op_name='ssd_loss')
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level,
+                             refer_level, refer_scale, rois_num=None,
+                             pixel_offset=True, name=None):
+    """Route RoIs to FPN levels by scale (reference detection.py:3673 /
+    distribute_fpn_proposals_op.h):
+    level = floor(log2(sqrt(area)/refer_scale + eps) + refer_level).
+
+    fpn_rois: [R, 4].  Returns (multi_rois — one [R, 4] padded array
+    per level, restore_ind [R, 1] int32 mapping each input roi to its
+    slot in the PADDED concat(multi_rois) (level li's block starts at
+    li*R — jit-usable, unlike offsets that depend on traced counts),
+    rois_num_per_level — [num_levels] int32 counts).  Fixed [R, 4]
+    per level instead of the reference's variable slices.  The
+    reference's per-image rois_num split is not implemented — pass
+    rois of ONE image at a time (or vmap)."""
+    if rois_num is not None:
+        raise NotImplementedError(
+            'distribute_fpn_proposals: per-image rois_num splitting '
+            'is not implemented — route each image separately (the '
+            'fixed-shape outputs vmap cleanly)')
+    levels = list(range(int(min_level), int(max_level) + 1))
+    L = len(levels)
+
+    def fn(rois):
+        R = rois.shape[0]
+        off = 1.0 if pixel_offset else 0.0
+        area = ((rois[:, 2] - rois[:, 0] + off)
+                * (rois[:, 3] - rois[:, 1] + off))
+        scale = jnp.sqrt(jnp.maximum(area, 0.0))
+        lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)
+                        + refer_level)
+        lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+        multi, counts, orders = [], [], []
+        for li, level in enumerate(levels):
+            mine = lvl == level
+            pos = jnp.where(mine, jnp.cumsum(mine) - 1, R)
+            out = jnp.zeros((R, 4), rois.dtype).at[pos].set(
+                rois, mode='drop')
+            # original input index of each packed slot
+            ordr = jnp.full((R,), -1, jnp.int32).at[pos].set(
+                jnp.arange(R, dtype=jnp.int32), mode='drop')
+            multi.append(out)
+            orders.append(ordr)
+            counts.append(jnp.sum(mine).astype(jnp.int32))
+        counts = jnp.stack(counts)
+        # restore_ind: original roi index -> its slot in the PADDED
+        # concatenation (level li's block = [li*R, (li+1)*R)); static
+        # offsets keep the mapping valid inside jit
+        packed = jnp.concatenate(orders)              # [L*R]
+        slot = jnp.arange(L * R, dtype=jnp.int32)
+        # padding slots (packed == -1) scatter out of bounds and drop
+        # (a clipped index would clobber roi 0's entry)
+        idx = jnp.where(packed >= 0, packed, L * R)
+        restore = jnp.zeros((R,), jnp.int32).at[idx].set(
+            slot, mode='drop')
+        return tuple(multi) + (restore[:, None], counts)
+
+    return apply(fn, wrap(fpn_rois),
+                 op_name='distribute_fpn_proposals')
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level,
+                          max_level, post_nms_top_n,
+                          level_counts=None, rois_nums=None,
+                          name=None):
+    """Merge per-level RoIs back by score (reference
+    collect_fpn_proposals_op.h): concat all levels, keep the top
+    post_nms_top_n by score.  multi_rois: list of [Ri, 4];
+    multi_scores: list of [Ri] (or [Ri, 1]).
+
+    `level_counts` ([num_levels] int, e.g. distribute_fpn_proposals'
+    rois_num_per_level) marks the VALID prefix of each padded level —
+    padding rows are excluded from the top-k and from `num`.  Without
+    it every row competes (pass exact-length arrays).  Returns
+    (rois [K, 4], scores [K], num int32) padded fixed-shape.  The
+    reference's per-image rois_nums split is not implemented."""
+    if rois_nums is not None:
+        raise NotImplementedError(
+            'collect_fpn_proposals: per-image rois_nums splitting is '
+            'not implemented — collect each image separately')
+
+    def fn(*arrs):
+        if level_counts is not None:
+            L = (len(arrs) - 1) // 2
+            counts = arrs[-1]
+            arrs = arrs[:-1]
+        else:
+            L = len(arrs) // 2
+            counts = None
+        rois = jnp.concatenate(arrs[:L], axis=0)
+        score_list = [a.reshape(-1) for a in arrs[L:]]
+        if counts is not None:
+            score_list = [
+                jnp.where(jnp.arange(s.shape[0]) < counts[i],
+                          s, -jnp.inf)
+                for i, s in enumerate(score_list)]
+        scores = jnp.concatenate(score_list, axis=0)
+        K = min(int(post_nms_top_n), scores.shape[0])
+        top_s, top_i = lax.top_k(scores, K)
+        valid = jnp.isfinite(top_s)
+        return (jnp.where(valid[:, None], rois[top_i], 0.0),
+                jnp.where(valid, top_s, 0.0),
+                jnp.sum(valid).astype(jnp.int32))
+
+    args = [wrap(r) for r in multi_rois] + \
+        [wrap(s) for s in multi_scores]
+    if level_counts is not None:
+        args.append(wrap(level_counts))
+    return apply(fn, *args, op_name='collect_fpn_proposals')

@@ -386,3 +386,12 @@ from .detection import (       # noqa: F401,E402
 __all__ += ['iou_similarity', 'prior_box', 'anchor_generator',
             'box_coder', 'box_clip', 'multiclass_nms',
             'generate_proposals', 'roi_align', 'roi_pool', 'nms']
+
+from .detection import (       # noqa: F401,E402
+    density_prior_box, bipartite_match, target_assign,
+    detection_output, ssd_loss, distribute_fpn_proposals,
+    collect_fpn_proposals)
+
+__all__ += ['density_prior_box', 'bipartite_match', 'target_assign',
+            'detection_output', 'ssd_loss',
+            'distribute_fpn_proposals', 'collect_fpn_proposals']

@@ -1,0 +1,314 @@
+"""Detection suite batch 2: SSD training path + FPN routing.
+
+Reference analogue:
+/root/reference/python/paddle/fluid/tests/unittests/
+test_bipartite_match_op.py, test_target_assign_op.py,
+test_density_prior_box_op.py, test_detection_output_op (via
+test_detection.py), test_ssd_loss (detection.py:1513) and
+test_distribute_fpn_proposals_op.py — numpy emulations of the kernels.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import detection as D
+
+
+def _np_bipartite(dist):
+    """bipartite_match_op.cc greedy global matching."""
+    R, C = dist.shape
+    m = np.full(C, -1, np.int32)
+    row_used = np.zeros(R, bool)
+    col_used = np.zeros(C, bool)
+    for _ in range(R):
+        masked = dist.copy()
+        masked[row_used, :] = -1
+        masked[:, col_used] = -1
+        i, j = np.unravel_index(np.argmax(masked), masked.shape)
+        if masked[i, j] <= 0:
+            break
+        m[j] = i
+        row_used[i] = True
+        col_used[j] = True
+    return m
+
+
+class TestBipartiteMatch:
+    def test_matches_reference_greedy(self):
+        rs = np.random.RandomState(0)
+        dist = rs.rand(4, 10).astype('float32')
+        m, md = D.bipartite_match(paddle.to_tensor(dist))
+        m = np.asarray(m.numpy())
+        ref = _np_bipartite(dist)
+        np.testing.assert_array_equal(m, ref)
+        for j in range(10):
+            if m[j] >= 0:
+                np.testing.assert_allclose(
+                    np.asarray(md.numpy())[j], dist[m[j], j],
+                    rtol=1e-6)
+
+    def test_per_prediction_extends_matches(self):
+        rs = np.random.RandomState(1)
+        dist = rs.rand(3, 12).astype('float32')
+        m_b, _ = D.bipartite_match(paddle.to_tensor(dist))
+        m_p, _ = D.bipartite_match(paddle.to_tensor(dist),
+                                   match_type='per_prediction',
+                                   dist_threshold=0.5)
+        m_b = np.asarray(m_b.numpy())
+        m_p = np.asarray(m_p.numpy())
+        # bipartite matches preserved; extra cols matched where the
+        # best row IoU clears the threshold
+        keep = m_b >= 0
+        np.testing.assert_array_equal(m_p[keep], m_b[keep])
+        for j in np.where(~keep)[0]:
+            if dist[:, j].max() >= 0.5:
+                assert m_p[j] == dist[:, j].argmax()
+            else:
+                assert m_p[j] == -1
+
+    def test_batched(self):
+        rs = np.random.RandomState(2)
+        dist = rs.rand(3, 4, 8).astype('float32')
+        m, _ = D.bipartite_match(paddle.to_tensor(dist))
+        m = np.asarray(m.numpy())
+        for n in range(3):
+            np.testing.assert_array_equal(m[n], _np_bipartite(dist[n]))
+
+
+class TestTargetAssign:
+    def test_assignment_and_weights(self):
+        x = np.arange(24, dtype='float32').reshape(2, 3, 4)  # [N,G,K]
+        m = np.array([[1, -1, 2, 0], [-1, 0, -1, 1]], 'int32')
+        out, w = D.target_assign(paddle.to_tensor(x),
+                                 paddle.to_tensor(m),
+                                 mismatch_value=9.0)
+        out = np.asarray(out.numpy())
+        w = np.asarray(w.numpy())
+        np.testing.assert_allclose(out[0, 0], x[0, 1])
+        np.testing.assert_allclose(out[0, 1], [9.0] * 4)
+        np.testing.assert_allclose(out[1, 3], x[1, 1])
+        np.testing.assert_allclose(
+            w[..., 0], [[1, 0, 1, 1], [0, 1, 0, 1]])
+
+    def test_negative_indices(self):
+        x = np.ones((1, 2, 3), 'float32')
+        m = np.array([[0, -1, -1, 1]], 'int32')
+        neg = np.array([[1, 2, -1]], 'int32')   # -1 = padding
+        out, w = D.target_assign(paddle.to_tensor(x),
+                                 paddle.to_tensor(m),
+                                 negative_indices=paddle.to_tensor(neg),
+                                 mismatch_value=0.0)
+        w = np.asarray(w.numpy())[..., 0]
+        out = np.asarray(out.numpy())
+        # negatives get weight 1 and mismatch value
+        np.testing.assert_allclose(w, [[1, 1, 1, 1]])
+        np.testing.assert_allclose(out[0, 1], [0.0] * 3)
+        np.testing.assert_allclose(out[0, 2], [0.0] * 3)
+
+
+class TestDensityPriorBox:
+    def test_matches_reference_loop(self):
+        feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), 'float32'))
+        img = paddle.to_tensor(np.zeros((1, 3, 16, 16), 'float32'))
+        densities, fixed_sizes = [2], [4.0]
+        fixed_ratios = [1.0, 2.0]
+        boxes, vs = D.density_prior_box(
+            feat, img, densities=densities, fixed_sizes=fixed_sizes,
+            fixed_ratios=fixed_ratios)
+        b = np.asarray(boxes.numpy())
+        P = sum(len(fixed_ratios) * d * d for d in densities)
+        assert b.shape == (2, 2, P, 4)
+        # emulate density_prior_box_op.h at cell (0, 0)
+        step_w = step_h = 8.0
+        step_avg = int((step_w + step_h) * 0.5)
+        cx = cy = 0.5 * 8.0
+        exp = []
+        for s, d in zip(fixed_sizes, densities):
+            shift = step_avg // d
+            for r in fixed_ratios:
+                bw = s * math.sqrt(r)
+                bh = s / math.sqrt(r)
+                dcx = cx - step_avg / 2.0 + shift / 2.0
+                dcy = cy - step_avg / 2.0 + shift / 2.0
+                for di in range(d):
+                    for dj in range(d):
+                        x = dcx + dj * shift
+                        y = dcy + di * shift
+                        exp.append([max((x - bw / 2) / 16, 0),
+                                    max((y - bh / 2) / 16, 0),
+                                    min((x + bw / 2) / 16, 1),
+                                    min((y + bh / 2) / 16, 1)])
+        np.testing.assert_allclose(b[0, 0], np.asarray(exp),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_flatten_to_2d(self):
+        feat = paddle.to_tensor(np.zeros((1, 8, 2, 3), 'float32'))
+        img = paddle.to_tensor(np.zeros((1, 3, 16, 16), 'float32'))
+        boxes, vs = D.density_prior_box(
+            feat, img, densities=[1], fixed_sizes=[4.0],
+            fixed_ratios=[1.0], flatten_to_2d=True)
+        assert np.asarray(boxes.numpy()).shape == (6, 4)
+        assert np.asarray(vs.numpy()).shape == (6, 4)
+
+
+class TestDetectionOutput:
+    def test_ssd_postprocess_chain(self):
+        rs = np.random.RandomState(3)
+        N, M, C = 1, 12, 3
+        prior = rs.rand(M, 4).astype('float32')
+        prior[:, 2:] += prior[:, :2] + 0.1
+        pvar = np.full((M, 4), 0.1, 'float32')
+        loc = (rs.rand(N, M, 4).astype('float32') - 0.5) * 0.2
+        scores = rs.rand(N, M, C).astype('float32')
+        out, num = D.detection_output(
+            paddle.to_tensor(loc), paddle.to_tensor(scores),
+            paddle.to_tensor(prior), paddle.to_tensor(pvar),
+            score_threshold=0.2, nms_top_k=10, keep_top_k=5)
+        o = np.asarray(out.numpy())
+        n = int(np.asarray(num.numpy())[0])
+        assert o.shape == (1, 5, 6)
+        assert 0 <= n <= 5
+        # background (label 0) excluded
+        assert (o[0, :n, 0] != 0).all()
+
+
+class TestSsdLoss:
+    def _data(self, N=2, G=3, P=16, C=4, seed=5):
+        rs = np.random.RandomState(seed)
+        prior = np.sort(rs.rand(P, 2, 2), axis=1).reshape(P, 4) \
+            .astype('float32')
+        gt = np.sort(rs.rand(N, G, 2, 2), axis=2).reshape(N, G, 4) \
+            .astype('float32')
+        gtl = rs.randint(1, C, (N, G)).astype('int64')
+        loc = (rs.rand(N, P, 4).astype('float32') - 0.5)
+        conf = rs.rand(N, P, C).astype('float32')
+        return loc, conf, gt, gtl, prior
+
+    def test_scalar_finite_and_positive(self):
+        loc, conf, gt, gtl, prior = self._data()
+        loss = D.ssd_loss(paddle.to_tensor(loc),
+                          paddle.to_tensor(conf),
+                          paddle.to_tensor(gt),
+                          paddle.to_tensor(gtl),
+                          paddle.to_tensor(prior))
+        v = float(np.asarray(loss.numpy()))
+        assert np.isfinite(v) and v > 0
+
+    def test_trains_ssd_head(self):
+        """End-to-end: ssd_loss gradients reduce the loss of a tiny
+        SSD head (the reference's multibox training contract)."""
+        import jax
+        import jax.numpy as jnp
+        loc, conf, gt, gtl, prior = self._data()
+
+        def loss_fn(params):
+            lp = jnp.asarray(loc) + params['dloc']
+            cf = jnp.asarray(conf) + params['dconf']
+            out = D.ssd_loss(lp, cf, jnp.asarray(gt),
+                             jnp.asarray(gtl), jnp.asarray(prior))
+            return out.value if hasattr(out, 'value') else out
+
+        params = {'dloc': jnp.zeros_like(jnp.asarray(loc)),
+                  'dconf': jnp.zeros_like(jnp.asarray(conf))}
+        l0 = float(loss_fn(params))
+        g = jax.grad(loss_fn)(params)
+        params = jax.tree_util.tree_map(
+            lambda p, gr: p - 0.5 * gr, params, g)
+        l1 = float(loss_fn(params))
+        assert l1 < l0
+
+    def test_zero_padding_gt_never_matches(self):
+        loc, conf, gt, gtl, prior = self._data()
+        gt_padded = np.concatenate(
+            [gt, np.zeros((2, 2, 4), 'float32')], axis=1)
+        gtl_padded = np.concatenate(
+            [gtl, np.zeros((2, 2), 'int64')], axis=1)
+        a = float(np.asarray(D.ssd_loss(
+            paddle.to_tensor(loc), paddle.to_tensor(conf),
+            paddle.to_tensor(gt), paddle.to_tensor(gtl),
+            paddle.to_tensor(prior)).numpy()))
+        b = float(np.asarray(D.ssd_loss(
+            paddle.to_tensor(loc), paddle.to_tensor(conf),
+            paddle.to_tensor(gt_padded), paddle.to_tensor(gtl_padded),
+            paddle.to_tensor(prior)).numpy()))
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+class TestFpnRouting:
+    def test_distribute_levels_and_restore(self):
+        # areas chosen to land on distinct levels for refer 4/224:
+        # level = floor(log2(sqrt(area)/224) + 4), clipped to [2, 5]
+        rois = np.array([
+            [0, 0, 56, 56],      # scale ~57 -> level 2
+            [0, 0, 112, 112],    # ~113 -> level 3
+            [0, 0, 224, 224],    # ~225 -> level 4
+            [0, 0, 448, 448],    # ~449 -> level 5
+            [0, 0, 50, 50],      # -> level 2
+        ], 'float32')
+        out = D.distribute_fpn_proposals(
+            paddle.to_tensor(rois), min_level=2, max_level=5,
+            refer_level=4, refer_scale=224)
+        multi = [np.asarray(m.numpy()) for m in out[:4]]
+        restore = np.asarray(out[4].numpy()).ravel()
+        counts = np.asarray(out[5].numpy())
+        np.testing.assert_array_equal(counts, [2, 1, 1, 1])
+        np.testing.assert_allclose(multi[0][0], rois[0])
+        np.testing.assert_allclose(multi[0][1], rois[4])
+        np.testing.assert_allclose(multi[1][0], rois[1])
+        # restore maps original order -> slot in the PADDED concat
+        # (jit-usable: level li's block starts at li*R)
+        packed = np.concatenate(multi, axis=0)
+        for i in range(len(rois)):
+            np.testing.assert_allclose(packed[restore[i]], rois[i])
+
+    def test_collect_top_by_score(self):
+        r1 = np.array([[0, 0, 1, 1], [1, 1, 2, 2]], 'float32')
+        r2 = np.array([[2, 2, 3, 3]], 'float32')
+        s1 = np.array([0.9, 0.1], 'float32')
+        s2 = np.array([0.5], 'float32')
+        rois, scores, num = D.collect_fpn_proposals(
+            [paddle.to_tensor(r1), paddle.to_tensor(r2)],
+            [paddle.to_tensor(s1), paddle.to_tensor(s2)],
+            min_level=2, max_level=3, post_nms_top_n=2)
+        np.testing.assert_allclose(np.asarray(scores.numpy()),
+                                   [0.9, 0.5])
+        np.testing.assert_allclose(np.asarray(rois.numpy())[0], r1[0])
+        np.testing.assert_allclose(np.asarray(rois.numpy())[1], r2[0])
+
+    def test_collect_respects_level_counts(self):
+        # padded level arrays: only the valid prefix competes
+        r1 = np.array([[0, 0, 1, 1], [9, 9, 9, 9]], 'float32')
+        r2 = np.array([[2, 2, 3, 3], [8, 8, 8, 8]], 'float32')
+        s1 = np.array([0.4, 0.99], 'float32')   # 0.99 is PADDING
+        s2 = np.array([0.5, 0.98], 'float32')   # 0.98 is PADDING
+        counts = np.array([1, 1], 'int32')
+        rois, scores, num = D.collect_fpn_proposals(
+            [paddle.to_tensor(r1), paddle.to_tensor(r2)],
+            [paddle.to_tensor(s1), paddle.to_tensor(s2)],
+            min_level=2, max_level=3, post_nms_top_n=3,
+            level_counts=paddle.to_tensor(counts))
+        assert int(np.asarray(num.numpy())) == 2
+        np.testing.assert_allclose(np.asarray(scores.numpy())[:2],
+                                   [0.5, 0.4])
+        np.testing.assert_allclose(np.asarray(rois.numpy())[0], r2[0])
+
+    def test_rois_num_raises(self):
+        rois = np.zeros((2, 4), 'float32')
+        with pytest.raises(NotImplementedError):
+            D.distribute_fpn_proposals(
+                paddle.to_tensor(rois), 2, 5, 4, 224,
+                rois_num=paddle.to_tensor(np.array([2], 'int32')))
+
+
+class TestSurface:
+    def test_fluid_and_vision_expose_batch2(self):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.vision import ops
+        for name in ('density_prior_box', 'bipartite_match',
+                     'target_assign', 'detection_output', 'ssd_loss',
+                     'distribute_fpn_proposals',
+                     'collect_fpn_proposals'):
+            assert hasattr(fluid.layers, name), name
+            assert hasattr(ops, name), name

@@ -143,3 +143,69 @@ class TestGPTFusedHead:
             assert l2 < l1   # it actually optimizes through the head
         finally:
             dist_env.set_mesh(None)
+
+
+class TestBertFusedHead:
+    def test_mlm_loss_and_grads_match_unfused(self):
+        from paddle_tpu.models.bert import bert_tiny
+        paddle.seed(0)
+        model = bert_tiny(fused_head=True, fused_head_chunks=4)
+        model.train()
+        rs = np.random.RandomState(0)
+        ids = paddle.to_tensor(
+            rs.randint(0, 128, size=(2, 16)).astype('int64'))
+        labels = rs.randint(0, 128, size=(2, 16)).astype('int64')
+        labels[rs.rand(2, 16) > 0.3] = -100    # MLM ignore mask
+        lb = paddle.to_tensor(labels)
+
+        loss_f = model.loss(model(ids), lb)
+        loss_f.backward()
+        gf = np.asarray(
+            model.bert.word_emb.weight.grad.value).copy()
+        lf = float(np.asarray(loss_f.value))
+        for p in model.parameters():
+            if p.grad is not None:
+                p.clear_grad()
+
+        model.config.fused_head = False
+        loss_u = model.loss(model(ids), lb)
+        loss_u.backward()
+        gu = np.asarray(model.bert.word_emb.weight.grad.value)
+        lu = float(np.asarray(loss_u.value))
+
+        np.testing.assert_allclose(lf, lu, rtol=1e-5)
+        np.testing.assert_allclose(gf, gu, rtol=1e-4, atol=1e-6)
+
+    def test_all_ignored_is_finite(self):
+        from paddle_tpu.models.bert import bert_tiny
+        paddle.seed(0)
+        model = bert_tiny(fused_head=True, fused_head_chunks=4)
+        model.train()
+        ids = paddle.to_tensor(np.ones((1, 8), 'int64'))
+        lb = paddle.to_tensor(np.full((1, 8), -100, 'int64'))
+        loss = model.loss(model(ids), lb)
+        assert np.isfinite(float(np.asarray(loss.value)))
+
+    def test_eval_returns_logits(self):
+        from paddle_tpu.models.bert import bert_tiny
+        paddle.seed(0)
+        model = bert_tiny(fused_head=True)
+        model.eval()
+        ids = paddle.to_tensor(np.ones((1, 8), 'int64'))
+        logits, nsp = model(ids)
+        assert logits.shape[-1] == model.config.vocab_size
+
+    def test_train_forward_eval_loss_toggle_stays_fused(self):
+        # loss() keys off the produced SHAPE, not self.training: a
+        # train-forward followed by eval-mode loss must not feed
+        # hidden states into the unfused CE branch
+        from paddle_tpu.models.bert import bert_tiny
+        paddle.seed(0)
+        model = bert_tiny(fused_head=True, fused_head_chunks=4)
+        model.train()
+        ids = paddle.to_tensor(np.ones((1, 8), 'int64'))
+        out = model(ids)
+        model.eval()
+        lb = paddle.to_tensor(np.zeros((1, 8), 'int64'))
+        loss = model.loss(out, lb)
+        assert np.isfinite(float(np.asarray(loss.value)))
