@@ -296,3 +296,20 @@ from ..vision.detection import (    # noqa: F401,E402
     density_prior_box, bipartite_match, target_assign,
     detection_output, ssd_loss, distribute_fpn_proposals,
     collect_fpn_proposals)
+
+from ..vision.detection import (    # noqa: F401,E402
+    sigmoid_focal_loss, matrix_nms, polygon_box_transform,
+    box_decoder_and_assign, rpn_target_assign,
+    generate_proposal_labels, retinanet_target_assign,
+    retinanet_detection_output)
+from ..vision.ops import yolo_box, yolo_loss  # noqa: F401,E402
+yolov3_loss = yolo_loss
+
+
+def __getattr__(name):
+    # the polygon-machinery long tail raises with pointers (see
+    # vision/detection.py batch-3 non-goals)
+    from ..vision import detection as _det
+    if name in _det._POLY_NON_GOALS:
+        return getattr(_det, name)   # raises NotImplementedError
+    raise AttributeError(name)

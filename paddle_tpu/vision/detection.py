@@ -400,45 +400,13 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
     is the jit-safe equivalent (its own *_v2/RoisNum contract).
     """
     def fn(bb, sc):
-        _check_boxes4(bb, 'multiclass_nms')
-        N, C, M = sc.shape
-        K = min(int(nms_top_k), M) if nms_top_k > 0 else M
-
-        def one_class(b, s):
-            # top-K candidates by score, then greedy NMS
-            top_s, top_i = lax.top_k(s, K)
-            keep = _nms_core(b[top_i], top_s, nms_threshold, None,
-                             score_threshold, eta=nms_eta,
-                             normalized=normalized)
-            return top_s, top_i, keep
-
-        def one_image(b, s):
-            ts, ti, kp = jax.vmap(one_class, in_axes=(None, 0))(b, s)
-            # [C, K] each
-            cls = jnp.broadcast_to(
-                jnp.arange(C)[:, None], (C, K))
-            if background_label >= 0:
-                kp = kp & (cls != background_label)
-            flat_s = jnp.where(kp, ts, -jnp.inf).reshape(-1)
-            kk = min(int(keep_top_k), flat_s.shape[0]) \
-                if keep_top_k > 0 else flat_s.shape[0]
-            sel_s, sel = lax.top_k(flat_s, kk)
-            valid = jnp.isfinite(sel_s)
-            lab = jnp.where(valid, cls.reshape(-1)[sel], -1)
-            bidx = ti.reshape(-1)[sel]
-            bsel = b[bidx]
-            out = jnp.concatenate([
-                lab[:, None].astype(b.dtype),
-                jnp.where(valid, sel_s, 0.0)[:, None],
-                jnp.where(valid[:, None], bsel, 0.0)], axis=1)
-            num = jnp.sum(valid).astype(jnp.int32)
-            return out, num, jnp.where(valid, bidx, -1).astype(
-                jnp.int32)
-
-        out, num, idx = jax.vmap(one_image)(bb, sc)
+        out, num, idx = _mcnms_core(
+            bb, sc, score_threshold, nms_top_k, keep_top_k,
+            nms_threshold, normalized, nms_eta, background_label)
         if return_index:
             # flatten per-image box index into the [N*M] space like
             # the reference's Index output
+            M = sc.shape[2]
             base = (jnp.arange(out.shape[0]) * M)[:, None]
             idx = jnp.where(idx >= 0, idx + base, -1)
             return out, num, idx
@@ -446,6 +414,51 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
 
     return apply(fn, wrap(bboxes), wrap(scores),
                  op_name='multiclass_nms')
+
+
+def _mcnms_core(bb, sc, score_threshold, nms_top_k, keep_top_k,
+                nms_threshold, normalized, nms_eta,
+                background_label):
+    """Batched per-class NMS + cross-class top-k; the shared engine
+    behind multiclass_nms, detection_output and
+    retinanet_detection_output.  Returns (out [N, kk, 6], num [N],
+    bidx [N, kk])."""
+    _check_boxes4(bb, 'multiclass_nms')
+    N, C, M = sc.shape
+    K = min(int(nms_top_k), M) if nms_top_k > 0 else M
+
+    def one_class(b, s):
+        # top-K candidates by score, then greedy NMS
+        top_s, top_i = lax.top_k(s, K)
+        keep = _nms_core(b[top_i], top_s, nms_threshold, None,
+                         score_threshold, eta=nms_eta,
+                         normalized=normalized)
+        return top_s, top_i, keep
+
+    def one_image(b, s):
+        ts, ti, kp = jax.vmap(one_class, in_axes=(None, 0))(b, s)
+        # [C, K] each
+        cls = jnp.broadcast_to(
+            jnp.arange(C)[:, None], (C, K))
+        if background_label >= 0:
+            kp = kp & (cls != background_label)
+        flat_s = jnp.where(kp, ts, -jnp.inf).reshape(-1)
+        kk = min(int(keep_top_k), flat_s.shape[0]) \
+            if keep_top_k > 0 else flat_s.shape[0]
+        sel_s, sel = lax.top_k(flat_s, kk)
+        valid = jnp.isfinite(sel_s)
+        lab = jnp.where(valid, cls.reshape(-1)[sel], -1)
+        bidx = ti.reshape(-1)[sel]
+        bsel = b[bidx]
+        out = jnp.concatenate([
+            lab[:, None].astype(b.dtype),
+            jnp.where(valid, sel_s, 0.0)[:, None],
+            jnp.where(valid[:, None], bsel, 0.0)], axis=1)
+        num = jnp.sum(valid).astype(jnp.int32)
+        return out, num, jnp.where(valid, bidx, -1).astype(
+            jnp.int32)
+
+    return jax.vmap(one_image)(bb, sc)
 
 
 def generate_proposals(scores, bbox_deltas, im_info, anchors,
@@ -1114,3 +1127,505 @@ def collect_fpn_proposals(multi_rois, multi_scores, min_level,
     if level_counts is not None:
         args.append(wrap(level_counts))
     return apply(fn, *args, op_name='collect_fpn_proposals')
+
+
+# -- focal/matrix NMS + RCNN/RetinaNet target machinery (batch 3) --------
+
+__all__ += ['sigmoid_focal_loss', 'matrix_nms', 'polygon_box_transform',
+            'box_decoder_and_assign', 'rpn_target_assign',
+            'generate_proposal_labels', 'retinanet_target_assign',
+            'retinanet_detection_output']
+
+_POLY_NON_GOALS = {
+    'locality_aware_nms': 'polygon IoU merging (gpc.cc)',
+    'roi_perspective_transform': 'quadrilateral perspective warps',
+    'generate_mask_labels': 'polygon rasterization (mask_util.cc)',
+}
+
+
+def __getattr__(name):
+    if name in _POLY_NON_GOALS:
+        raise NotImplementedError(
+            f'{name} is an explicit non-goal: it needs '
+            f'{_POLY_NON_GOALS[name]}, polygon machinery with no '
+            'axis-aligned-box equivalent. See SURVEY.md non-goals.')
+    raise AttributeError(name)
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25,
+                       name=None):
+    """Focal loss over per-class sigmoid scores (reference
+    detection.py:474 / sigmoid_focal_loss_op.h): positives are class
+    j == label-1 (labels are 1..C, 0 = background, -1 = ignored), and
+    everything is scaled by 1/fg_num.  x: [N, C] logits; label:
+    [N, 1] int; fg_num: [1] int.  Returns [N, C] losses
+    (differentiable through jax.grad; the reference ships a
+    hand-written backward)."""
+    def fn(xv, lab, fg):
+        N, C = xv.shape
+        lab = lab.reshape(-1)
+        j = jnp.arange(C)
+        c_pos = (lab[:, None] == (j[None, :] + 1)).astype(jnp.float32)
+        c_neg = ((lab[:, None] != -1).astype(jnp.float32)
+                 * (1.0 - c_pos))
+        fgn = jnp.maximum(fg.reshape(()), 1).astype(jnp.float32)
+        p = jax.nn.sigmoid(xv.astype(jnp.float32))
+        logp = jax.nn.log_sigmoid(xv.astype(jnp.float32))
+        log1mp = jax.nn.log_sigmoid(-xv.astype(jnp.float32))
+        term_pos = jnp.power(1.0 - p, gamma) * logp
+        term_neg = jnp.power(p, gamma) * log1mp
+        return (-c_pos * term_pos * (alpha / fgn)
+                - c_neg * term_neg * ((1.0 - alpha) / fgn))
+    return apply(fn, wrap(x), wrap(label), wrap(fg_num),
+                 op_name='sigmoid_focal_loss')
+
+
+def polygon_box_transform(input, name=None):
+    """EAST geometry-map conversion (reference
+    polygon_box_transform_op.cc): even channels hold x offsets, odd
+    channels y offsets; output is the absolute coordinate
+    4*cell - offset.  input: [N, G, H, W]."""
+    def fn(v):
+        N, G, H, W = v.shape
+        xs = 4.0 * jnp.arange(W, dtype=v.dtype)[None, None, None, :]
+        ys = 4.0 * jnp.arange(H, dtype=v.dtype)[None, None, :, None]
+        even = (jnp.arange(G) % 2 == 0)[None, :, None, None]
+        return jnp.where(even, xs - v, ys - v)
+    return apply(fn, wrap(input), op_name='polygon_box_transform')
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0,
+               normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (reference detection.py matrix_nms /
+    matrix_nms_op.cc, SOLOv2): instead of a sequential suppression
+    loop, every candidate's score decays by
+    min_j decay(iou_ij, max_iou_j) over all higher-scored j — pure
+    matrix math, embarrassingly TPU-parallel (the one NMS variant
+    with NO loop at all).
+
+    bboxes [N, M, 4], scores [N, C, M].  Returns (out [N, keep_top_k,
+    6] rows (label, decayed_score, box) padded with label -1,
+    rois_num [N][, index])."""
+    def fn(bb, sc):
+        _check_boxes4(bb, 'matrix_nms')
+        N, C, M = sc.shape
+        K = min(int(nms_top_k), M) if nms_top_k > 0 else M
+
+        def one_class(b, s):
+            s = jnp.where(s > score_threshold, s, -jnp.inf)
+            top_s, top_i = lax.top_k(s, K)
+            bt = b[top_i]
+            iou = _iou_matrix(bt, bt, normalized=normalized)
+            lower = jnp.tril(jnp.ones((K, K), bool), -1)  # j < i
+            iou_l = jnp.where(lower, iou, 0.0)
+            # iou_max[j] = max_{l<j} iou[j, l]
+            iou_max = jnp.max(iou_l, axis=1)              # [K]
+            if use_gaussian:
+                decay = jnp.exp((iou_max[None, :] ** 2
+                                 - iou_l ** 2) * gaussian_sigma)
+            else:
+                decay = (1.0 - iou_l) / (1.0 - iou_max[None, :])
+            decay = jnp.where(lower, decay, 1.0)
+            min_decay = jnp.min(decay, axis=1)            # [K]
+            ds = min_decay * top_s
+            ds = jnp.where(jnp.isfinite(top_s), ds, -jnp.inf)
+            ds = jnp.where(ds > post_threshold, ds, -jnp.inf)
+            return ds, top_i
+
+        def one_image(b, s):
+            ds, ti = jax.vmap(one_class, in_axes=(None, 0))(b, s)
+            cls = jnp.broadcast_to(jnp.arange(C)[:, None], (C, K))
+            if background_label >= 0:
+                ds = jnp.where(cls == background_label, -jnp.inf, ds)
+            flat = ds.reshape(-1)
+            kk = min(int(keep_top_k), flat.shape[0]) \
+                if keep_top_k > 0 else flat.shape[0]
+            sel_s, sel = lax.top_k(flat, kk)
+            valid = jnp.isfinite(sel_s)
+            lab = jnp.where(valid, cls.reshape(-1)[sel], -1)
+            bidx = ti.reshape(-1)[sel]
+            out = jnp.concatenate([
+                lab[:, None].astype(b.dtype),
+                jnp.where(valid, sel_s, 0.0)[:, None],
+                jnp.where(valid[:, None], b[bidx], 0.0)], axis=1)
+            return (out, jnp.sum(valid).astype(jnp.int32),
+                    jnp.where(valid, bidx, -1).astype(jnp.int32))
+
+        out, num, idx = jax.vmap(one_image)(bb, sc)
+        if return_index:
+            base = (jnp.arange(out.shape[0]) * M)[:, None]
+            idx = jnp.where(idx >= 0, idx + base, -1)
+            return out, num, idx
+        return out, num
+
+    return apply(fn, wrap(bboxes), wrap(scores), op_name='matrix_nms')
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box,
+                           box_score, box_clip=None, name=None):
+    """Cascade-RCNN per-class decode + best-class assignment
+    (reference box_decoder_and_assign_op.h): decode each class's
+    deltas against the roi, then pick the box of the highest-scoring
+    NON-background class.  prior_box [R, 4], prior_box_var [4],
+    target_box [R, C*4], box_score [R, C].
+    Returns (decode_box [R, C*4], assign_box [R, 4])."""
+    clip = _BBOX_CLIP if box_clip is None else float(box_clip)
+
+    def fn(p, pv, t, s):
+        R = p.shape[0]
+        C = s.shape[1]
+        td = t.reshape(R, C, 4)
+        pw = p[:, 2] - p[:, 0] + 1
+        ph = p[:, 3] - p[:, 1] + 1
+        pcx = p[:, 0] + pw / 2
+        pcy = p[:, 1] + ph / 2
+        dw = jnp.minimum(pv[2] * td[..., 2], clip)
+        dh = jnp.minimum(pv[3] * td[..., 3], clip)
+        cx = pv[0] * td[..., 0] * pw[:, None] + pcx[:, None]
+        cy = pv[1] * td[..., 1] * ph[:, None] + pcy[:, None]
+        w = jnp.exp(dw) * pw[:, None]
+        h = jnp.exp(dh) * ph[:, None]
+        dec = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - 1, cy + h / 2 - 1],
+                        axis=-1)                         # [R, C, 4]
+        # best non-background class (j > 0); rois whose best is the
+        # background keep their ORIGINAL prior box (max_j == -1 path)
+        s_fg = s.at[:, 0].set(-jnp.inf) if C > 0 else s
+        best = jnp.argmax(s_fg, axis=1)
+        has_fg = jnp.isfinite(jnp.max(s_fg, axis=1)) & (C > 1)
+        assign = jnp.take_along_axis(
+            dec, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+        assign = jnp.where(has_fg[:, None], assign, p[:, :4])
+        return dec.reshape(R, C * 4), assign
+
+    return apply(fn, wrap(prior_box), wrap(prior_box_var),
+                 wrap(target_box), wrap(box_score),
+                 op_name='box_decoder_and_assign')
+
+
+def _anchor_gt_match(anchors, gt, pos_thr, neg_thr):
+    """Shared RPN/RetinaNet matching: per-anchor max IoU + the
+    per-gt-argmax force-match (reference rpn_target_assign semantics).
+    Returns (labels [A] {1 fg, 0 bg, -1 ignore}, matched_gt [A])."""
+    iou = _iou_matrix(gt, anchors)                 # [G, A]
+    anchor_best = jnp.max(iou, axis=0)             # [A]
+    anchor_arg = jnp.argmax(iou, axis=0)
+    labels = jnp.full(anchors.shape[0], -1, jnp.int32)
+    labels = jnp.where(anchor_best < neg_thr, 0, labels)
+    labels = jnp.where(anchor_best >= pos_thr, 1, labels)
+    # every gt's best anchor is positive even below the threshold
+    gt_best_anchor = jnp.argmax(iou, axis=1)       # [G]
+    gt_has_area = (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
+    labels = labels.at[gt_best_anchor].set(
+        jnp.where(gt_has_area, 1, labels[gt_best_anchor]))
+    return labels, anchor_arg
+
+
+def _encode_against(anchors, g, weights=None):
+    aw = anchors[:, 2] - anchors[:, 0] + 1
+    ah = anchors[:, 3] - anchors[:, 1] + 1
+    acx = anchors[:, 0] + aw / 2
+    acy = anchors[:, 1] + ah / 2
+    gw = g[:, 2] - g[:, 0] + 1
+    gh = g[:, 3] - g[:, 1] + 1
+    gcx = (g[:, 0] + g[:, 2]) / 2
+    gcy = (g[:, 1] + g[:, 3]) / 2
+    t = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                   jnp.log(jnp.maximum(gw / aw, 1e-10)),
+                   jnp.log(jnp.maximum(gh / ah, 1e-10))], axis=-1)
+    if weights is not None:
+        t = t / jnp.asarray(weights, t.dtype)
+    return t
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256,
+                      rpn_straddle_thresh=0.0, rpn_fg_fraction=0.5,
+                      rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True,
+                      seed=None, name=None):
+    """RPN training targets (reference detection.py:311 /
+    rpn_target_assign_op.cc): label anchors fg/bg by IoU (plus the
+    per-gt argmax force match), subsample to rpn_batch_size_per_im
+    with at most rpn_fg_fraction foreground, and return the sampled
+    predictions + targets.
+
+    Dense single-image redesign (vmap for batches): bbox_pred [A, 4],
+    cls_logits [A, 1], anchor_box [A, 4], gt_boxes [G, 4] (zero-area
+    rows are padding).  Returns fixed-shape
+    (pred_loc [S, 4], pred_score [S, 1], target_loc [S, 4],
+    target_label [S, 1] int32 {1, 0, -1 padding},
+    bbox_inside_weight [S, 4]) with S = rpn_batch_size_per_im; rows
+    with label -1 are padding and carry zero weights.  Sampling uses
+    jax PRNG from `seed` when use_random; seed=None draws a FRESH
+    seed per eager call (the reference's per-step np.random) — inside
+    a jit trace pass a distinct seed per step, or one permutation is
+    baked in.  `is_crowd` ([G] int) excludes crowd gt from matching;
+    with `im_info` ([3] h/w/scale), anchors straddling the image
+    beyond rpn_straddle_thresh are ignored (label -1) — the
+    reference's straddle filter."""
+    S = int(rpn_batch_size_per_im)
+    fg_cap = int(S * rpn_fg_fraction)
+    if seed is None:
+        from ..core import rng as _rng
+        _SAMPLER_CALLS[0] += 1
+        seed = _rng.get_seed() + 0x5bd1 * _SAMPLER_CALLS[0]
+
+    has_crowd = is_crowd is not None
+    has_im = im_info is not None
+
+    def fn(bp, cl, anc, gtb, *extra):
+        A = anc.shape[0]
+        crowd = extra[0] if has_crowd else None
+        im = extra[1 if has_crowd else 0] if has_im else None
+        if crowd is not None:
+            # crowd gt rows zero out -> zero area -> never match
+            gtb = jnp.where((crowd.reshape(-1) != 0)[:, None],
+                            0.0, gtb)
+        labels, arg = _anchor_gt_match(anc, gtb,
+                                       rpn_positive_overlap,
+                                       rpn_negative_overlap)
+        if im is not None:
+            t = rpn_straddle_thresh
+            inside = ((anc[:, 0] >= -t) & (anc[:, 1] >= -t)
+                      & (anc[:, 2] < im[1] + t)
+                      & (anc[:, 3] < im[0] + t))
+            labels = jnp.where(inside, labels, -1)
+        key = jax.random.PRNGKey(seed)
+        kf, kb = jax.random.split(key)
+        # random priority within each pool, top-k to sample
+        def pick(mask, k, prio_key):
+            prio = jax.random.uniform(prio_key, (A,)) if use_random \
+                else -jnp.arange(A, dtype=jnp.float32)
+            prio = jnp.where(mask, prio, -jnp.inf)
+            _, idx = lax.top_k(prio, min(k, A))
+            ok = jnp.take(mask, idx)
+            return idx, ok
+
+        fg_idx, fg_ok = pick(labels == 1, fg_cap, kf)
+        n_fg = jnp.sum(fg_ok)
+        bg_idx, bg_ok0 = pick(labels == 0, S, kb)
+        # backgrounds fill the remaining S - n_fg slots
+        bg_ok = bg_ok0 & (jnp.cumsum(bg_ok0) <= S - n_fg)
+        idx = jnp.concatenate([fg_idx, bg_idx])
+        ok = jnp.concatenate([fg_ok, bg_ok])
+        # compact the selected rows into S slots
+        pos = jnp.where(ok, jnp.cumsum(ok) - 1, S)
+        slot_src = jnp.full((S,), A, jnp.int32).at[pos].set(
+            idx.astype(jnp.int32), mode='drop')
+        valid = slot_src < A
+        src = jnp.clip(slot_src, 0, A - 1)
+        lab = jnp.where(valid, jnp.take(labels, src), -1)
+        g = gtb[jnp.take(arg, src)]
+        tloc = _encode_against(anc[src], g)
+        inside = ((lab == 1).astype(jnp.float32))[:, None] \
+            * jnp.ones((1, 4), jnp.float32)
+        return (jnp.where(valid[:, None], bp[src], 0.0),
+                jnp.where(valid[:, None], cl[src], 0.0),
+                jnp.where((lab == 1)[:, None], tloc, 0.0),
+                lab[:, None],
+                inside)
+
+    args = [wrap(bbox_pred), wrap(cls_logits), wrap(anchor_box),
+            wrap(gt_boxes)]
+    if is_crowd is not None:
+        args.append(wrap(is_crowd))
+    if im_info is not None:
+        args.append(wrap(im_info))
+    return apply(fn, *args, op_name='rpn_target_assign')
+
+
+_SAMPLER_CALLS = [0]
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, seed=None,
+                             name=None):
+    """Sample RoIs + build RCNN head targets (reference
+    detection.py:2596 / generate_proposal_labels_op.cc): gt boxes
+    join the proposal pool, fg = max IoU >= fg_thresh (sampled to
+    fg_fraction), bg = IoU in [bg_thresh_lo, bg_thresh_hi), targets
+    encoded with bbox_reg_weights into per-class slots.
+
+    Dense single-image redesign (vmap for batches): rpn_rois [R, 4],
+    gt_classes [G], gt_boxes [G, 4] (zero-area rows padding).
+    Returns fixed-shape (rois [S, 4], labels [S] int32 (0 =
+    background, -1 = padding), bbox_targets [S, 4*class_nums],
+    inside_weights, outside_weights same shape) with
+    S = batch_size_per_im.  seed=None draws a fresh seed per eager
+    call; `is_crowd` rows are excluded from matching AND the pool."""
+    if class_nums is None:
+        raise ValueError('class_nums is required')
+    S = int(batch_size_per_im)
+    fg_cap = int(S * fg_fraction)
+    C = int(class_nums)
+    if seed is None:
+        from ..core import rng as _rng
+        _SAMPLER_CALLS[0] += 1
+        seed = _rng.get_seed() + 0x5bd1 * _SAMPLER_CALLS[0]
+    has_crowd = is_crowd is not None
+
+    def fn(rois, gcls, gtb, *extra):
+        if has_crowd:
+            gtb = jnp.where((extra[0].reshape(-1) != 0)[:, None],
+                            0.0, gtb)
+        pool = jnp.concatenate([rois, gtb], axis=0)   # gt join pool
+        P = pool.shape[0]
+        # padding / crowd gt rows (zero area) must not enter the
+        # sample as degenerate background RoIs
+        gt_valid = (gtb[:, 2] > gtb[:, 0]) & (gtb[:, 3] > gtb[:, 1])
+        pool_valid = jnp.concatenate(
+            [jnp.ones(rois.shape[0], bool), gt_valid])
+        iou = _iou_matrix(gtb, pool)                  # [G, P]
+        iou = jnp.where(gt_valid[:, None], iou, 0.0)
+        best = jnp.max(iou, axis=0)
+        arg = jnp.argmax(iou, axis=0)
+        fg_mask = (best >= fg_thresh) & pool_valid
+        bg_mask = ((best < bg_thresh_hi) & (best >= bg_thresh_lo)
+                   & pool_valid)
+        key = jax.random.PRNGKey(seed)
+        kf, kb = jax.random.split(key)
+
+        def pick(mask, k, prio_key):
+            prio = jax.random.uniform(prio_key, (P,)) if use_random \
+                else -jnp.arange(P, dtype=jnp.float32)
+            prio = jnp.where(mask, prio, -jnp.inf)
+            _, idx = lax.top_k(prio, k)
+            return idx, jnp.take(mask, idx)
+
+        fg_idx, fg_ok = pick(fg_mask, min(fg_cap, P), kf)
+        n_fg = jnp.sum(fg_ok)
+        bg_idx, bg_ok0 = pick(bg_mask, min(S, P), kb)
+        bg_ok = bg_ok0 & (jnp.cumsum(bg_ok0) <= S - n_fg)
+        idx = jnp.concatenate([fg_idx, bg_idx])
+        ok = jnp.concatenate([fg_ok, bg_ok])
+        pos = jnp.where(ok, jnp.cumsum(ok) - 1, S)
+        slot_src = jnp.full((S,), P, jnp.int32).at[pos].set(
+            idx.astype(jnp.int32), mode='drop')
+        valid = slot_src < P
+        src = jnp.clip(slot_src, 0, P - 1)
+        out_rois = jnp.where(valid[:, None], pool[src], 0.0)
+        is_fg = valid & jnp.take(fg_mask, src)
+        lab = jnp.where(is_fg, gcls[jnp.take(arg, src)], 0)
+        lab = jnp.where(valid, lab, -1).astype(jnp.int32)
+        t = _encode_against(pool[src], gtb[jnp.take(arg, src)],
+                            bbox_reg_weights)
+        cls_slot = jnp.where(is_cls_agnostic, 1,
+                             jnp.clip(lab, 0, C - 1))
+        onehot = jax.nn.one_hot(cls_slot, C,
+                                dtype=t.dtype) \
+            * is_fg[:, None].astype(t.dtype)          # [S, C]
+        targets = (onehot[:, :, None] * t[:, None, :]).reshape(S,
+                                                               C * 4)
+        inside = jnp.repeat(onehot, 4, axis=1)
+        return out_rois, lab, targets, inside, inside
+
+    args = [wrap(rpn_rois), wrap(gt_classes), wrap(gt_boxes)]
+    if is_crowd is not None:
+        args.append(wrap(is_crowd))
+    return apply(fn, *args, op_name='generate_proposal_labels')
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box,
+                            anchor_var, gt_boxes, gt_labels,
+                            is_crowd=None, im_info=None,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4, name=None):
+    """RetinaNet training targets (reference detection.py:108):
+    like rpn_target_assign but NO subsampling (focal loss handles the
+    imbalance) and class targets are the matched gt labels.
+
+    Dense single-image redesign: returns (pred_loc [A, 4],
+    pred_cls [A, num_classes], target_loc [A, 4], target_label
+    [A, 1] int32 {1..C fg, 0 bg, -1 ignore}, bbox_inside_weight
+    [A, 4], fg_num [1] int32)."""
+    def fn(bp, cl, anc, gtb, gtl):
+        labels01, arg = _anchor_gt_match(anc, gtb, positive_overlap,
+                                         negative_overlap)
+        fg = labels01 == 1
+        lab = jnp.where(fg, gtl[arg].astype(jnp.int32),
+                        labels01)
+        tloc = _encode_against(anc, gtb[arg])
+        inside = fg.astype(jnp.float32)[:, None] * jnp.ones(
+            (1, 4), jnp.float32)
+        fg_num = (jnp.sum(fg) + 1).astype(jnp.int32)[None]
+        return (bp, cl, jnp.where(fg[:, None], tloc, 0.0),
+                lab[:, None], inside, fg_num)
+
+    return apply(fn, wrap(bbox_pred), wrap(cls_logits),
+                 wrap(anchor_box), wrap(gt_boxes), wrap(gt_labels),
+                 op_name='retinanet_target_assign')
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.45,
+                               nms_eta=1.0, name=None):
+    """RetinaNet postprocess (reference detection.py:191): per FPN
+    level, take top nms_top_k anchor predictions by sigmoid score,
+    decode against that level's anchors, then one multiclass NMS over
+    the union.  bboxes/scores/anchors: lists per level
+    ([A_l, 4] deltas, [A_l, C] logits, [A_l, 4] anchors) for ONE
+    image (vmap for batches).  Returns (out [keep_top_k, 6],
+    num int32)."""
+    L = len(bboxes)
+
+    def fn(info, *arrs):
+        bs = arrs[:L]
+        ss = arrs[L:2 * L]
+        ans = arrs[2 * L:]
+        dec_all, sc_all = [], []
+        for b, s, a in zip(bs, ss, ans):
+            p = jax.nn.sigmoid(s.astype(jnp.float32))   # [A, C]
+            best = jnp.max(p, axis=1)
+            k = min(int(nms_top_k), b.shape[0])
+            _, ti = lax.top_k(best, k)
+            aw = a[ti, 2] - a[ti, 0] + 1
+            ah = a[ti, 3] - a[ti, 1] + 1
+            acx = a[ti, 0] + aw / 2
+            acy = a[ti, 1] + ah / 2
+            d = b[ti]
+            cx = d[:, 0] * aw + acx
+            cy = d[:, 1] * ah + acy
+            w = jnp.exp(jnp.minimum(d[:, 2], _BBOX_CLIP)) * aw
+            h = jnp.exp(jnp.minimum(d[:, 3], _BBOX_CLIP)) * ah
+            box = jnp.stack([cx - w / 2, cy - h / 2,
+                             cx + w / 2 - 1, cy + h / 2 - 1], -1)
+            # the reference rescales predictions back to the ORIGINAL
+            # image frame (pred / im_scale) and clips against
+            # round(resized_dim / im_scale) - 1
+            box = box / info[2]
+            imh = jnp.round(info[0] / info[2])
+            imw = jnp.round(info[1] / info[2])
+            box = jnp.stack([
+                jnp.clip(box[:, 0], 0, imw - 1),
+                jnp.clip(box[:, 1], 0, imh - 1),
+                jnp.clip(box[:, 2], 0, imw - 1),
+                jnp.clip(box[:, 3], 0, imh - 1)], -1)
+            dec_all.append(box)
+            sc_all.append(p[ti])
+        boxes = jnp.concatenate(dec_all, axis=0)[None]   # [1, M, 4]
+        probs = jnp.transpose(
+            jnp.concatenate(sc_all, axis=0))[None]       # [1, C, M]
+        # un-normalized (+1 pixel) IoU like the reference's
+        # JaccardOverlap(..., false) — normalized=True would give
+        # 1-pixel boxes zero area and never suppress duplicates
+        out, num, _ = _mcnms_core(boxes, probs, score_threshold,
+                                  -1, keep_top_k, nms_threshold,
+                                  False, nms_eta, -1)
+        # reference labels are 1..C (0 is background in the head's
+        # label space); our classes are already foreground-only
+        return out[0], num[0]
+
+    args = [wrap(im_info)] + [wrap(b) for b in bboxes] \
+        + [wrap(s) for s in scores] + [wrap(a) for a in anchors]
+    return apply(fn, *args, op_name='retinanet_detection_output')

@@ -395,3 +395,14 @@ from .detection import (       # noqa: F401,E402
 __all__ += ['density_prior_box', 'bipartite_match', 'target_assign',
             'detection_output', 'ssd_loss',
             'distribute_fpn_proposals', 'collect_fpn_proposals']
+
+from .detection import (       # noqa: F401,E402
+    sigmoid_focal_loss, matrix_nms, polygon_box_transform,
+    box_decoder_and_assign, rpn_target_assign,
+    generate_proposal_labels, retinanet_target_assign,
+    retinanet_detection_output)
+
+__all__ += ['sigmoid_focal_loss', 'matrix_nms',
+            'polygon_box_transform', 'box_decoder_and_assign',
+            'rpn_target_assign', 'generate_proposal_labels',
+            'retinanet_target_assign', 'retinanet_detection_output']

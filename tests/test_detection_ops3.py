@@ -1,0 +1,476 @@
+"""Detection suite batch 3: focal loss, matrix NMS, RCNN/RetinaNet
+target machinery.
+
+Reference analogue:
+/root/reference/python/paddle/fluid/tests/unittests/
+test_sigmoid_focal_loss_op.py, test_matrix_nms_op.py,
+test_rpn_target_assign_op.py, test_generate_proposal_labels_op.py,
+test_retinanet_detection_output.py — numpy emulations of the kernels.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import detection as D
+
+
+class TestSigmoidFocalLoss:
+    def test_matches_kernel_formula(self):
+        rs = np.random.RandomState(0)
+        N, C = 6, 4
+        x = rs.randn(N, C).astype('float32')
+        label = rs.randint(-1, C + 1, (N, 1)).astype('int32')
+        fg = np.array([3], 'int32')
+        out = np.asarray(D.sigmoid_focal_loss(
+            paddle.to_tensor(x), paddle.to_tensor(label),
+            paddle.to_tensor(fg), gamma=2.0, alpha=0.25).numpy())
+        # numpy emulation of sigmoid_focal_loss_op.h
+        ref = np.zeros((N, C), np.float64)
+        for i in range(N):
+            for d in range(C):
+                g = label[i, 0]
+                p = 1.0 / (1.0 + math.exp(-x[i, d]))
+                fgn = max(int(fg[0]), 1)
+                if g == d + 1:
+                    ref[i, d] = -(0.25 / fgn) * (1 - p) ** 2 \
+                        * math.log(max(p, 1e-38))
+                elif g != -1:
+                    ref[i, d] = -((1 - 0.25) / fgn) * p ** 2 \
+                        * math.log(max(1 - p, 1e-38))
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-6)
+
+    def test_differentiable(self):
+        import jax
+        import jax.numpy as jnp
+        x = jnp.asarray(np.random.RandomState(1).randn(4, 3)
+                        .astype('float32'))
+        lab = jnp.asarray(np.array([[1], [2], [0], [3]], 'int32'))
+        fg = jnp.asarray(np.array([2], 'int32'))
+
+        def f(xv):
+            o = D.sigmoid_focal_loss(xv, lab, fg)
+            return jnp.sum(o.value if hasattr(o, 'value') else o)
+
+        g = jax.grad(f)(x)
+        assert np.isfinite(np.asarray(g)).all()
+        assert float(jnp.abs(g).sum()) > 0
+
+
+def _np_matrix_nms_class(boxes, scores, score_th, post_th, top_k,
+                         gaussian, sigma):
+    """NMSMatrix (matrix_nms_op.cc) for one class."""
+    idx = [i for i in np.argsort(-scores, kind='stable')
+           if scores[i] > score_th][:top_k]
+    if not idx:
+        return [], []
+    ious = np.zeros((len(idx), len(idx)))
+    for a in range(len(idx)):
+        for b in range(a):
+            x1 = max(boxes[idx[a], 0], boxes[idx[b], 0])
+            y1 = max(boxes[idx[a], 1], boxes[idx[b], 1])
+            x2 = min(boxes[idx[a], 2], boxes[idx[b], 2])
+            y2 = min(boxes[idx[a], 3], boxes[idx[b], 3])
+            inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+            aa = ((boxes[idx[a], 2] - boxes[idx[a], 0])
+                  * (boxes[idx[a], 3] - boxes[idx[a], 1]))
+            ab = ((boxes[idx[b], 2] - boxes[idx[b], 0])
+                  * (boxes[idx[b], 3] - boxes[idx[b], 1]))
+            ious[a, b] = inter / max(aa + ab - inter, 1e-10)
+    iou_max = np.array(
+        [ious[a, :a].max() if a else 0.0 for a in range(len(idx))])
+    kept, ds = [], []
+    for a in range(len(idx)):
+        min_decay = 1.0
+        for b in range(a):
+            if gaussian:
+                dec = math.exp((iou_max[b] ** 2 - ious[a, b] ** 2)
+                               * sigma)
+            else:
+                dec = (1 - ious[a, b]) / (1 - iou_max[b])
+            min_decay = min(min_decay, dec)
+        v = min_decay * scores[idx[a]]
+        if v > post_th:
+            kept.append(idx[a])
+            ds.append(v)
+    return kept, ds
+
+
+class TestMatrixNms:
+    @pytest.mark.parametrize('gaussian', [False, True])
+    def test_matches_reference(self, gaussian):
+        rs = np.random.RandomState(2)
+        M, C = 20, 3
+        boxes = rs.rand(1, M, 4).astype('float32') * 8
+        boxes[..., 2:] = boxes[..., :2] + rs.rand(1, M, 2) * 4 + 0.5
+        scores = rs.rand(1, C, M).astype('float32')
+        out, num = D.matrix_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.3, post_threshold=0.2, nms_top_k=10,
+            keep_top_k=8, use_gaussian=gaussian, gaussian_sigma=2.0,
+            background_label=0)
+        o = np.asarray(out.numpy())[0]
+        n = int(np.asarray(num.numpy())[0])
+        rows = []
+        for c in range(1, C):   # background 0 excluded
+            kept, ds = _np_matrix_nms_class(
+                boxes[0], scores[0, c], 0.3, 0.2, 10, gaussian, 2.0)
+            rows += [(c, v) for v in ds]
+        rows.sort(key=lambda r: -r[1])
+        rows = rows[:8]
+        assert n == len(rows)
+        got = sorted((int(o[i, 0]), round(float(o[i, 1]), 5))
+                     for i in range(n))
+        exp = sorted((c, round(float(v), 5)) for c, v in rows)
+        assert got == exp
+
+    def test_jit_compiles(self):
+        import jax
+        import jax.numpy as jnp
+        rs = np.random.RandomState(3)
+        b = jnp.asarray(rs.rand(1, 8, 4).astype('float32'))
+        s = jnp.asarray(rs.rand(1, 2, 8).astype('float32'))
+
+        @jax.jit
+        def f(b, s):
+            o = D.matrix_nms(b, s, score_threshold=0.1,
+                             post_threshold=0.05, nms_top_k=8,
+                             keep_top_k=4, background_label=-1)
+            return tuple(getattr(x, 'value', x) for x in o)
+
+        out, num = f(b, s)
+        assert out.shape == (1, 4, 6)
+
+
+class TestPolygonBoxTransform:
+    def test_formula(self):
+        rs = np.random.RandomState(4)
+        x = rs.rand(1, 4, 2, 3).astype('float32')
+        out = np.asarray(D.polygon_box_transform(
+            paddle.to_tensor(x)).numpy())
+        for g in range(4):
+            for h in range(2):
+                for w in range(3):
+                    exp = (w * 4 - x[0, g, h, w]) if g % 2 == 0 \
+                        else (h * 4 - x[0, g, h, w])
+                    np.testing.assert_allclose(out[0, g, h, w], exp,
+                                               rtol=1e-6)
+
+
+class TestBoxDecoderAndAssign:
+    def test_decode_and_best_class(self):
+        rs = np.random.RandomState(5)
+        R, C = 4, 3
+        prior = np.sort(rs.rand(R, 2, 2) * 8, axis=1) \
+            .reshape(R, 4).astype('float32')
+        pvar = np.array([0.1, 0.1, 0.2, 0.2], 'float32')
+        deltas = (rs.rand(R, C * 4).astype('float32') - 0.5)
+        score = rs.rand(R, C).astype('float32')
+        dec, assign = D.box_decoder_and_assign(
+            paddle.to_tensor(prior), paddle.to_tensor(pvar),
+            paddle.to_tensor(deltas), paddle.to_tensor(score))
+        dec = np.asarray(dec.numpy())
+        assign = np.asarray(assign.numpy())
+        # emulate the kernel for roi 0, class 1
+        i, j = 0, 1
+        pw = prior[i, 2] - prior[i, 0] + 1
+        ph = prior[i, 3] - prior[i, 1] + 1
+        pcx = prior[i, 0] + pw / 2
+        pcy = prior[i, 1] + ph / 2
+        off = j * 4
+        dw = min(0.2 * deltas[i, off + 2], math.log(1000 / 16))
+        dh = min(0.2 * deltas[i, off + 3], math.log(1000 / 16))
+        cx = 0.1 * deltas[i, off] * pw + pcx
+        cy = 0.1 * deltas[i, off + 1] * ph + pcy
+        w, h = math.exp(dw) * pw, math.exp(dh) * ph
+        np.testing.assert_allclose(
+            dec[i, off:off + 4],
+            [cx - w / 2, cy - h / 2, cx + w / 2 - 1, cy + h / 2 - 1],
+            rtol=1e-4)
+        # assign row = decode of best non-background class
+        best = 1 + score[i, 1:].argmax()
+        np.testing.assert_allclose(assign[i],
+                                   dec[i, best * 4:best * 4 + 4],
+                                   rtol=1e-5)
+
+
+class TestRpnTargetAssign:
+    def _data(self, A=32, G=3, seed=6):
+        rs = np.random.RandomState(seed)
+        anchors = np.sort(rs.rand(A, 2, 2) * 20, axis=1) \
+            .reshape(A, 4).astype('float32')
+        gt = np.sort(rs.rand(G, 2, 2) * 20, axis=1) \
+            .reshape(G, 4).astype('float32')
+        bp = rs.randn(A, 4).astype('float32')
+        cl = rs.randn(A, 1).astype('float32')
+        return bp, cl, anchors, gt
+
+    def test_labels_and_shapes(self):
+        bp, cl, anchors, gt = self._data()
+        S = 16
+        loc, score, tloc, tlab, iw = D.rpn_target_assign(
+            paddle.to_tensor(bp), paddle.to_tensor(cl),
+            paddle.to_tensor(anchors), None, paddle.to_tensor(gt),
+            rpn_batch_size_per_im=S, rpn_positive_overlap=0.5,
+            rpn_negative_overlap=0.3, use_random=False)
+        lab = np.asarray(tlab.numpy()).ravel()
+        iw = np.asarray(iw.numpy())
+        assert lab.shape == (S,)
+        assert set(np.unique(lab)) <= {-1, 0, 1}
+        # every gt's best anchor is positive -> at least G positives
+        assert (lab == 1).sum() >= 1
+        # inside weights only on positives
+        np.testing.assert_allclose(iw[:, 0], (lab == 1).astype('f4'))
+        # fg <= fg_fraction * S
+        assert (lab == 1).sum() <= S // 2
+
+    def test_targets_encode_matched_gt(self):
+        bp, cl, anchors, gt = self._data()
+        loc, score, tloc, tlab, iw = D.rpn_target_assign(
+            paddle.to_tensor(bp), paddle.to_tensor(cl),
+            paddle.to_tensor(anchors), None, paddle.to_tensor(gt),
+            rpn_batch_size_per_im=16, rpn_positive_overlap=0.5,
+            use_random=False)
+        lab = np.asarray(tlab.numpy()).ravel()
+        tloc = np.asarray(tloc.numpy())
+        # positives carry finite encodings; negatives zeros
+        assert np.isfinite(tloc).all()
+        assert (tloc[lab != 1] == 0).all()
+
+
+class TestGenerateProposalLabels:
+    def test_sampling_and_targets(self):
+        rs = np.random.RandomState(7)
+        R, G, C, S = 24, 3, 5, 12
+        rois = np.sort(rs.rand(R, 2, 2) * 30, axis=1) \
+            .reshape(R, 4).astype('float32')
+        gt = np.sort(rs.rand(G, 2, 2) * 30, axis=1) \
+            .reshape(G, 4).astype('float32')
+        gcls = rs.randint(1, C, G).astype('int64')
+        out = D.generate_proposal_labels(
+            paddle.to_tensor(rois), paddle.to_tensor(gcls), None,
+            paddle.to_tensor(gt), None, batch_size_per_im=S,
+            fg_fraction=0.25, fg_thresh=0.5, bg_thresh_hi=0.5,
+            bg_thresh_lo=0.0, class_nums=C, use_random=False)
+        srois, lab, tgt, inw, outw = [np.asarray(o.numpy())
+                                      for o in out]
+        assert srois.shape == (S, 4)
+        assert tgt.shape == (S, 4 * C)
+        # gt boxes join the pool: the gt rows match themselves with
+        # IoU 1 -> foreground with their own class
+        fg = lab > 0
+        assert fg.sum() >= 1
+        assert fg.sum() <= S // 4 + 1
+        # inside weights live only in the labeled class's 4-slot
+        for i in np.where(fg)[0]:
+            c = lab[i]
+            row = inw[i].reshape(C, 4)
+            assert (row[c] == 1).all()
+            assert row.sum() == 4
+
+    def test_background_rows_have_zero_targets(self):
+        rs = np.random.RandomState(8)
+        rois = np.sort(rs.rand(10, 2, 2) * 30, axis=1) \
+            .reshape(10, 4).astype('float32')
+        gt = np.zeros((1, 4), 'float32')   # no valid gt
+        out = D.generate_proposal_labels(
+            paddle.to_tensor(rois),
+            paddle.to_tensor(np.array([1], 'int64')), None,
+            paddle.to_tensor(gt), None, batch_size_per_im=8,
+            class_nums=3, use_random=False)
+        lab = np.asarray(out[1].numpy())
+        tgt = np.asarray(out[2].numpy())
+        assert (lab <= 0).all()
+        assert (tgt == 0).all()
+
+
+class TestRetinanet:
+    def test_target_assign_no_sampling(self):
+        rs = np.random.RandomState(9)
+        A, G, C = 20, 2, 4
+        anchors = np.sort(rs.rand(A, 2, 2) * 16, axis=1) \
+            .reshape(A, 4).astype('float32')
+        gt = np.sort(rs.rand(G, 2, 2) * 16, axis=1) \
+            .reshape(G, 4).astype('float32')
+        gtl = np.array([2, 3], 'int64')
+        bp = rs.randn(A, 4).astype('float32')
+        cl = rs.randn(A, C).astype('float32')
+        out = D.retinanet_target_assign(
+            paddle.to_tensor(bp), paddle.to_tensor(cl),
+            paddle.to_tensor(anchors), None, paddle.to_tensor(gt),
+            paddle.to_tensor(gtl), num_classes=C,
+            positive_overlap=0.5, negative_overlap=0.4)
+        loc, cls, tloc, tlab, iw, fg_num = [np.asarray(o.numpy())
+                                            for o in out]
+        assert loc.shape == (A, 4) and cls.shape == (A, C)
+        lab = tlab.ravel()
+        # fg labels are the matched GT CLASSES, not 1
+        fgs = lab[(lab != 0) & (lab != -1)]
+        assert set(fgs.tolist()) <= {2, 3}
+        assert int(fg_num[0]) == (lab > 0).sum() + 1
+
+    def test_detection_output_chain(self):
+        rs = np.random.RandomState(10)
+        C = 3
+        anchors = [np.sort(rs.rand(12, 2, 2) * 32, axis=1)
+                   .reshape(12, 4).astype('float32'),
+                   np.sort(rs.rand(6, 2, 2) * 32, axis=1)
+                   .reshape(6, 4).astype('float32')]
+        deltas = [(rs.rand(12, 4).astype('float32') - 0.5) * 0.2,
+                  (rs.rand(6, 4).astype('float32') - 0.5) * 0.2]
+        logits = [rs.randn(12, C).astype('float32'),
+                  rs.randn(6, C).astype('float32')]
+        im_info = np.array([32.0, 32.0, 1.0], 'float32')
+        out, num = D.retinanet_detection_output(
+            [paddle.to_tensor(d) for d in deltas],
+            [paddle.to_tensor(s) for s in logits],
+            [paddle.to_tensor(a) for a in anchors],
+            paddle.to_tensor(im_info), score_threshold=0.05,
+            nms_top_k=10, keep_top_k=6, nms_threshold=0.45)
+        o = np.asarray(out.numpy())
+        n = int(np.asarray(num.numpy()))
+        assert o.shape == (6, 6)
+        assert 0 <= n <= 6
+        # boxes clipped inside the image
+        valid = o[:n]
+        assert (valid[:, 2] >= 0).all() and (valid[:, 4] <= 31).all()
+
+
+class TestNonGoals:
+    def test_poly_ops_raise_with_pointer(self):
+        for n in ('locality_aware_nms', 'roi_perspective_transform',
+                  'generate_mask_labels'):
+            with pytest.raises(NotImplementedError, match='non-goal'):
+                getattr(D, n)
+
+    def test_fluid_surface_complete(self):
+        """Every name in the reference detection __all__ resolves (or
+        raises the documented non-goal error)."""
+        import paddle_tpu.fluid as fluid
+        names = ['prior_box', 'density_prior_box', 'multi_box_head',
+                 'bipartite_match', 'target_assign',
+                 'detection_output', 'ssd_loss', 'rpn_target_assign',
+                 'retinanet_target_assign', 'sigmoid_focal_loss',
+                 'anchor_generator', 'generate_proposal_labels',
+                 'generate_proposals', 'iou_similarity', 'box_coder',
+                 'polygon_box_transform', 'yolov3_loss', 'yolo_box',
+                 'box_clip', 'multiclass_nms', 'matrix_nms',
+                 'retinanet_detection_output',
+                 'distribute_fpn_proposals', 'box_decoder_and_assign',
+                 'collect_fpn_proposals']
+        for n in names:
+            assert hasattr(fluid.layers, n), n
+        for n in ('locality_aware_nms', 'roi_perspective_transform',
+                  'generate_mask_labels'):
+            with pytest.raises(NotImplementedError):
+                getattr(fluid.layers, n)
+
+
+class TestReviewFixes:
+    def test_rpn_small_anchor_count(self):
+        # A < rpn_batch_size_per_im must not crash top_k
+        rs = np.random.RandomState(11)
+        A = 8
+        anchors = np.sort(rs.rand(A, 2, 2) * 20, axis=1) \
+            .reshape(A, 4).astype('float32')
+        gt = np.sort(rs.rand(2, 2, 2) * 20, axis=1) \
+            .reshape(2, 4).astype('float32')
+        out = D.rpn_target_assign(
+            paddle.to_tensor(rs.randn(A, 4).astype('float32')),
+            paddle.to_tensor(rs.randn(A, 1).astype('float32')),
+            paddle.to_tensor(anchors), None, paddle.to_tensor(gt),
+            rpn_batch_size_per_im=256, use_random=False)
+        assert np.asarray(out[3].numpy()).shape == (256, 1)
+
+    def test_rpn_straddle_filter(self):
+        anchors = np.array([[2, 2, 6, 6],        # inside
+                            [-5, -5, 40, 40]],   # straddles
+                           'float32')
+        gt = np.array([[2, 2, 6, 6]], 'float32')
+        bp = np.zeros((2, 4), 'float32')
+        cl = np.zeros((2, 1), 'float32')
+        im_info = np.array([16.0, 16.0, 1.0], 'float32')
+        out = D.rpn_target_assign(
+            paddle.to_tensor(bp), paddle.to_tensor(cl),
+            paddle.to_tensor(anchors), None, paddle.to_tensor(gt),
+            im_info=paddle.to_tensor(im_info),
+            rpn_batch_size_per_im=4, rpn_straddle_thresh=0.0,
+            rpn_positive_overlap=0.5, use_random=False)
+        lab = np.asarray(out[3].numpy()).ravel()
+        # only the inside anchor enters (the straddler is ignored)
+        assert (lab == 1).sum() == 1
+        assert (lab != -1).sum() == 1
+
+    def test_rpn_crowd_excluded(self):
+        anchors = np.array([[2, 2, 6, 6], [10, 10, 14, 14]],
+                           'float32')
+        gt = np.array([[2, 2, 6, 6], [10, 10, 14, 14]], 'float32')
+        crowd = np.array([0, 1], 'int32')   # gt 1 is a crowd
+        out = D.rpn_target_assign(
+            paddle.to_tensor(np.zeros((2, 4), 'float32')),
+            paddle.to_tensor(np.zeros((2, 1), 'float32')),
+            paddle.to_tensor(anchors), None, paddle.to_tensor(gt),
+            is_crowd=paddle.to_tensor(crowd),
+            rpn_batch_size_per_im=4, rpn_positive_overlap=0.5,
+            rpn_negative_overlap=0.3, use_random=False)
+        lab = np.asarray(out[3].numpy()).ravel()
+        assert (lab == 1).sum() == 1   # only the non-crowd match
+
+    def test_proposal_labels_exclude_padding_gt(self):
+        rs = np.random.RandomState(12)
+        rois = np.sort(rs.rand(6, 2, 2) * 30, axis=1) \
+            .reshape(6, 4).astype('float32')
+        gt = np.concatenate([
+            np.sort(rs.rand(1, 2, 2) * 30, axis=1).reshape(1, 4),
+            np.zeros((5, 4))]).astype('float32')   # 5 padding rows
+        out = D.generate_proposal_labels(
+            paddle.to_tensor(rois),
+            paddle.to_tensor(np.array([1] * 6, 'int64')), None,
+            paddle.to_tensor(gt), None, batch_size_per_im=12,
+            class_nums=3, use_random=False)
+        srois = np.asarray(out[0].numpy())
+        lab = np.asarray(out[1].numpy())
+        # padding gt rows must never appear as sampled [0,0,0,0] RoIs
+        for i in np.where(lab >= 0)[0]:
+            assert srois[i].max() > 0, (i, srois[i])
+
+    def test_fresh_sampling_per_call(self):
+        rs = np.random.RandomState(13)
+        A = 64
+        anchors = np.sort(rs.rand(A, 2, 2) * 20, axis=1) \
+            .reshape(A, 4).astype('float32')
+        gt = np.sort(rs.rand(4, 2, 2) * 20, axis=1) \
+            .reshape(4, 4).astype('float32')
+        bp = rs.randn(A, 4).astype('float32')
+        cl = rs.randn(A, 1).astype('float32')
+
+        def run():
+            out = D.rpn_target_assign(
+                paddle.to_tensor(bp), paddle.to_tensor(cl),
+                paddle.to_tensor(anchors), None,
+                paddle.to_tensor(gt), rpn_batch_size_per_im=8,
+                rpn_positive_overlap=0.3, rpn_negative_overlap=0.2,
+                use_random=True)
+            return np.asarray(out[0].numpy())
+
+        draws = [run() for _ in range(4)]
+        assert any(not np.array_equal(draws[0], d)
+                   for d in draws[1:])
+
+    def test_retinanet_output_rescales_by_im_scale(self):
+        rs = np.random.RandomState(14)
+        anchors = [np.array([[8, 8, 24, 24]], 'float32')]
+        deltas = [np.zeros((1, 4), 'float32')]
+        logits = [np.full((1, 2), 3.0, 'float32')]
+        im_info = np.array([64.0, 64.0, 2.0], 'float32')
+        out, num = D.retinanet_detection_output(
+            [paddle.to_tensor(d) for d in deltas],
+            [paddle.to_tensor(s) for s in logits],
+            [paddle.to_tensor(a) for a in anchors],
+            paddle.to_tensor(im_info), score_threshold=0.05,
+            nms_top_k=1, keep_top_k=1)
+        o = np.asarray(out.numpy())
+        assert int(np.asarray(num.numpy())) == 1
+        # decoded box [8,8,24,24]±: /scale 2 -> coords ~[4,4,11.5,...]
+        assert o[0, 2] < 8 and o[0, 4] < 16
