@@ -16,6 +16,7 @@ with raw predictions) still work and route through the same compute.
 import abc
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
@@ -103,7 +104,13 @@ class Accuracy(Metric):
         """Return correctness matrix [N, maxk] (jit-safe)."""
         pred = _to_jnp(pred)
         label = _to_jnp(label)
-        pred_idx = jnp.argsort(pred, axis=-1)[..., ::-1][..., :self.maxk]
+        # lax.top_k, not a full argsort: O(C log k) and no [.., C]
+        # sorted-index tensor on the eval step's critical path.
+        # k clamps to the class count (top_k raises where the old
+        # argsort slice silently clamped, e.g. topk=(1,5) on a
+        # 2-class head)
+        _, pred_idx = jax.lax.top_k(
+            pred, min(self.maxk, pred.shape[-1]))
         if label.ndim == pred.ndim:  # one-hot or column labels
             if label.shape[-1] == 1:
                 label = label[..., 0]
@@ -290,7 +297,7 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
     """Functional top-k accuracy (reference: paddle.metric.accuracy)."""
     x = input.value if isinstance(input, Tensor) else jnp.asarray(input)
     y = label.value if isinstance(label, Tensor) else jnp.asarray(label)
-    pred_idx = jnp.argsort(x, axis=-1)[..., ::-1][..., :k]
+    _, pred_idx = jax.lax.top_k(x, min(k, x.shape[-1]))
     if y.ndim == x.ndim:
         if y.shape[-1] == 1:
             y = y[..., 0]

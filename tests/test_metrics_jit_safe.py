@@ -220,3 +220,19 @@ class TestHapiEvaluateLazy:
         for _ in range(16):     # 16 * 2^29 = 2^33 > int32 range
             c.add(big)
         assert int(c.read()[0]) == 16 * (2 ** 29)
+
+    def test_topk_clamps_to_class_count(self):
+        # topk=(1, 5) on a 2-class head must not crash (top_k raises
+        # where the old argsort slice clamped)
+        m = Accuracy(topk=(1, 5))
+        pred = np.array([[0.9, 0.1], [0.2, 0.8]], 'float32')
+        lab = np.array([[0], [1]], 'int64')
+        m.update(m.compute(paddle.to_tensor(pred),
+                           paddle.to_tensor(lab)))
+        t1, t5 = m.accumulate()
+        assert t1 == 1.0 and t5 == 1.0
+        from paddle_tpu.metric import accuracy
+        f = float(np.asarray(accuracy(
+            paddle.to_tensor(pred), paddle.to_tensor(lab),
+            k=5).numpy()))
+        assert f == 1.0
