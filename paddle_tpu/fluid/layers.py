@@ -306,10 +306,17 @@ from ..vision.ops import yolo_box, yolo_loss  # noqa: F401,E402
 yolov3_loss = yolo_loss
 
 
+from .layers_compat import *       # noqa: F401,F403,E402
+from . import layers_compat as _compat  # noqa: E402
+
+
 def __getattr__(name):
     # the polygon-machinery long tail raises with pointers (see
-    # vision/detection.py batch-3 non-goals)
+    # vision/detection.py batch-3 non-goals); ditto the LoD-era /
+    # SelectedRows names (layers_compat non-goals)
     from ..vision import detection as _det
     if name in _det._POLY_NON_GOALS:
         return getattr(_det, name)   # raises NotImplementedError
+    if name in _compat._LEGACY_NON_GOALS:
+        return getattr(_compat, name)
     raise AttributeError(name)

@@ -1,0 +1,1016 @@
+"""fluid.layers 1.x long-tail compat — the remaining reference names.
+
+Reference analogue: /root/reference/python/paddle/fluid/layers/
+(nn.py, tensor.py, control_flow.py __all__ names not yet covered by
+fluid/layers.py).  Almost everything here adapts a legacy 1.x
+signature onto the existing TPU-native implementation; the genuinely
+new math (cos_sim, dice_loss, mean_iou, smooth_l1, log_loss,
+add_position_encoding, space_to_depth, shuffle_channel,
+temporal_shift, affine_channel, affine_grid, fsp_matrix, maxout,
+ctc_greedy_decoder, linear_chain_crf/crf_decoding, psroi_pool, …) is
+implemented as vectorized jnp here.
+
+LoD-era machinery (DynamicRNN/StaticRNN/IfElse/While/Switch builders,
+lod_reset/lod_append/reorder_lod_tensor_by_rank, im2sequence) and
+SelectedRows/instag plumbing raise with pointers to the padded-dense
+TPU-native equivalents — the same policy as SURVEY.md's LoD note.
+"""
+import builtins
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import tensor as _T
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+from ..nn import functional as _F
+from ..tensor._helpers import wrap
+
+__all__ = []
+
+
+def _register(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+# -- activations / simple math (legacy signatures) -----------------------
+
+@_register
+def one_hot(input, depth, allow_out_of_range=False):
+    return _F.one_hot(input, depth)
+
+
+@_register
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    return _F.normalize(x, p=2, axis=axis, epsilon=epsilon)
+
+
+@_register
+def elu(x, alpha=1.0, name=None):
+    return _F.elu(x, alpha)
+
+
+@_register
+def relu6(x, threshold=6.0, name=None):
+    return _F.relu6(x)
+
+
+@_register
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772,
+         name=None):
+    def fn(v):
+        return scale * jnp.where(v > 0, v,
+                                 alpha * (jnp.exp(v) - 1.0))
+    return apply(fn, wrap(x), op_name='selu')
+
+
+@_register
+def swish(x, beta=1.0, name=None):
+    def fn(v):
+        return v * jax.nn.sigmoid(beta * v)
+    return apply(fn, wrap(x), op_name='swish')
+
+
+@_register
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    def fn(v):
+        return v * jnp.clip(v + offset, 0.0, threshold) / scale
+    return apply(fn, wrap(x), op_name='hard_swish')
+
+
+@_register
+def mish(x, threshold=20, name=None):
+    return _F.mish(x)
+
+
+@_register
+def leaky_relu(x, alpha=0.02, name=None):
+    return _F.leaky_relu(x, negative_slope=alpha)
+
+
+@_register
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _T.clip(x, t_min, t_max)
+
+
+@_register
+def soft_relu(x, threshold=40.0, name=None):
+    def fn(v):
+        return jnp.log1p(jnp.exp(jnp.clip(v, -threshold, threshold)))
+    return apply(fn, wrap(x), op_name='soft_relu')
+
+
+@_register
+def pow(x, factor=1.0, name=None):
+    return _T.pow(x, factor)
+
+
+@_register
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+          name=None):
+    s = scale
+
+    def fn(v):
+        sv = getattr(s, 'value', s)
+        out = v * sv + bias if bias_after_scale else (v + bias) * sv
+        return out
+    out = apply(fn, wrap(x), op_name='scale')
+    if act is not None:
+        out = getattr(_F, act)(out)
+    return out
+
+
+@_register
+def sign(x, name=None):
+    return _T.sign(x)
+
+
+@_register
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    """Legacy mul op: flatten x to 2-D at x_num_col_dims, y at
+    y_num_col_dims, matmul."""
+    def fn(a, b):
+        am = a.reshape(int(np.prod(a.shape[:x_num_col_dims])), -1)
+        bm = b.reshape(int(np.prod(b.shape[:y_num_col_dims])), -1)
+        out = am @ bm
+        # reference output keeps the leading/trailing dims:
+        # x.shape[:xd] + y.shape[yd:]
+        return out.reshape(a.shape[:x_num_col_dims]
+                           + b.shape[y_num_col_dims:])
+    return apply(fn, wrap(x), wrap(y), op_name='mul')
+
+
+@_register
+def sum(x, name=None):
+    return _T.add_n(x) if isinstance(x, (list, tuple)) else x
+
+
+@_register
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    from .layers import _ew
+    return _ew(_T.mod, x, y, axis, act)
+
+
+@_register
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    from .layers import _ew
+    return _ew(_T.floor_divide, x, y, axis, act)
+
+
+@_register
+def logical_and(x, y, out=None, name=None):
+    return _T.logical_and(x, y)
+
+
+@_register
+def logical_or(x, y, out=None, name=None):
+    return _T.logical_or(x, y)
+
+
+@_register
+def logical_xor(x, y, out=None, name=None):
+    return _T.logical_xor(x, y)
+
+
+@_register
+def logical_not(x, out=None, name=None):
+    return _T.logical_not(x)
+
+
+@_register
+def clip_by_norm(x, max_norm, name=None):
+    def fn(v):
+        norm = jnp.sqrt(jnp.maximum(jnp.sum(v * v), 1e-12))
+        return jnp.where(norm > max_norm, v * (max_norm / norm), v)
+    return apply(fn, wrap(x), op_name='clip_by_norm')
+
+
+@_register
+def maxout(x, groups, name=None, axis=1):
+    return _F.maxout(x, groups, axis=axis)
+
+
+@_register
+def unbind(input, axis=0):
+    return _T.unbind(input, axis)
+
+
+@_register
+def unstack(x, axis=0, num=None):
+    return _T.unstack(x, axis, num)
+
+
+@_register
+def unique(x, dtype='int32'):
+    """Eager-only (dynamic output shape): (unique values, index map
+    such that x = out[index]) like the reference op."""
+    v = np.asarray(getattr(x, 'value', x))
+    out, index = np.unique(v, return_inverse=True)
+    return (Tensor(jnp.asarray(out)),
+            Tensor(jnp.asarray(index.astype(dtype))))
+
+
+@_register
+def unique_with_counts(x, dtype='int32'):
+    v = np.asarray(getattr(x, 'value', x))
+    out, index, count = np.unique(v, return_inverse=True,
+                                  return_counts=True)
+    return (Tensor(jnp.asarray(out)),
+            Tensor(jnp.asarray(index.astype(dtype))),
+            Tensor(jnp.asarray(count.astype(dtype))))
+
+
+@_register
+def expand_as(x, target_tensor, name=None):
+    return _T.expand_as(x, target_tensor)
+
+
+@_register
+def strided_slice(input, axes, starts, ends, strides):
+    return _T.strided_slice(input, axes, starts, ends, strides)
+
+
+@_register
+def size(input):
+    return _T.numel(input)
+
+
+@_register
+def gather_tree(ids, parents):
+    from ..nn.decode import gather_tree as _gt
+    return _gt(ids, parents)
+
+
+# -- padding / resize / crop ---------------------------------------------
+
+@_register
+def pad(x, paddings, pad_value=0.0, name=None):
+    """Legacy pad: flat [before0, after0, before1, after1, ...]."""
+    def fn(v):
+        cfg = [(int(paddings[2 * i]), int(paddings[2 * i + 1]))
+               for i in builtins.range(v.ndim)]
+        return jnp.pad(v, cfg, constant_values=pad_value)
+    return apply(fn, wrap(x), op_name='pad')
+
+
+@_register
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    def fn(xv, yv):
+        cfg = [(0, xs - ys) for xs, ys in zip(xv.shape, yv.shape)]
+        return jnp.pad(yv, cfg, constant_values=pad_value)
+    return apply(fn, wrap(x), wrap(y), op_name='pad_constant_like')
+
+
+@_register
+def pad2d(input, paddings=(0, 0, 0, 0), mode='constant',
+          pad_value=0.0, data_format='NCHW', name=None):
+    t, b, l, r = [int(p) for p in paddings]
+    if data_format == 'NCHW':
+        pad_cfg = [0, 0, 0, 0, t, b, l, r]
+    else:
+        pad_cfg = [0, 0, t, b, l, r, 0, 0]
+    jmode = {'constant': 'constant', 'reflect': 'reflect',
+             'edge': 'edge'}[mode]
+
+    def fn(v):
+        cfg = [(pad_cfg[2 * i], pad_cfg[2 * i + 1])
+               for i in builtins.range(4)]
+        if jmode == 'constant':
+            return jnp.pad(v, cfg, constant_values=pad_value)
+        return jnp.pad(v, cfg, mode=jmode)
+    return apply(fn, wrap(input), op_name='pad2d')
+
+
+@_register
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    def fn(v):
+        shp = [int(s) for s in shape]
+        offs = [int(o) for o in (offsets or [0] * v.ndim)]
+        sl = tuple(slice(o, o + s) for o, s in zip(offs, shp))
+        return v[sl]
+    return apply(fn, wrap(x), op_name='crop_tensor')
+
+
+@_register
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample='BILINEAR', actual_shape=None,
+                 align_corners=True, align_mode=1,
+                 data_format='NCHW'):
+    mode = {'BILINEAR': 'bilinear', 'NEAREST': 'nearest',
+            'TRILINEAR': 'trilinear', 'LINEAR': 'linear',
+            'BICUBIC': 'bicubic'}[resample.upper()]
+    return _F.interpolate(input, size=out_shape, scale_factor=scale,
+                          mode=mode, align_corners=align_corners,
+                          align_mode=align_mode,
+                          data_format=data_format)
+
+
+@_register
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True,
+                    align_mode=1, data_format='NCHW'):
+    return image_resize(input, out_shape, scale, name, 'BILINEAR',
+                        actual_shape, align_corners, align_mode,
+                        data_format)
+
+
+@_register
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True,
+                   data_format='NCHW'):
+    return image_resize(input, out_shape, scale, name, 'NEAREST',
+                        actual_shape, align_corners, 1, data_format)
+
+
+@_register
+def resize_linear(input, out_shape=None, scale=None, name=None,
+                  actual_shape=None, align_corners=True, align_mode=1,
+                  data_format='NCW'):
+    return image_resize(input, out_shape, scale, name, 'LINEAR',
+                        actual_shape, align_corners, align_mode,
+                        data_format)
+
+
+@_register
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True,
+                     align_mode=1, data_format='NCDHW'):
+    return image_resize(input, out_shape, scale, name, 'TRILINEAR',
+                        actual_shape, align_corners, align_mode,
+                        data_format)
+
+
+@_register
+def image_resize_short(input, out_short_len, resample='BILINEAR'):
+    H, W = input.shape[2], input.shape[3]
+    if H <= W:
+        new = (out_short_len, int(round(W * out_short_len / H)))
+    else:
+        new = (int(round(H * out_short_len / W)), out_short_len)
+    return image_resize(input, out_shape=new, resample=resample)
+
+
+@_register
+def random_crop(x, shape, seed=None):
+    """Eager random crop of the trailing len(shape) dims."""
+    if seed is None:
+        from ..core import rng as rng_mod
+        # next_key advances the global stream: a fresh crop per call
+        seed = int(np.asarray(rng_mod.next_key())[-1])
+    rs = np.random.RandomState(int(seed) & 0x7fffffff)
+    v = getattr(x, 'value', x)
+    nd = len(shape)
+    lead = v.ndim - nd
+    offs = [rs.randint(0, v.shape[lead + i] - shape[i] + 1)
+            for i in builtins.range(nd)]
+    sl = (slice(None),) * lead + tuple(
+        slice(o, o + s) for o, s in zip(offs, shape))
+
+    def fn(vv):
+        return vv[sl]
+    return apply(fn, wrap(x), op_name='random_crop')
+
+
+# -- pooling / layout ops ------------------------------------------------
+
+@_register
+def pool3d(input, pool_size=-1, pool_type='max', pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True,
+           data_format='NCDHW'):
+    if global_pooling:
+        ps = input.shape[2:]
+        return (_F.avg_pool3d(input, ps) if pool_type == 'avg'
+                else _F.max_pool3d(input, ps))
+    if pool_type == 'avg':
+        return _F.avg_pool3d(input, pool_size, stride=pool_stride,
+                             padding=pool_padding,
+                             ceil_mode=ceil_mode)
+    return _F.max_pool3d(input, pool_size, stride=pool_stride,
+                         padding=pool_padding, ceil_mode=ceil_mode)
+
+
+@_register
+def adaptive_pool2d(input, pool_size, pool_type='max',
+                    require_index=False, name=None):
+    if require_index:
+        raise NotImplementedError('require_index is not supported')
+    return (_F.adaptive_avg_pool2d(input, pool_size)
+            if pool_type == 'avg'
+            else _F.adaptive_max_pool2d(input, pool_size))
+
+
+@_register
+def adaptive_pool3d(input, pool_size, pool_type='max',
+                    require_index=False, name=None):
+    if require_index:
+        raise NotImplementedError('require_index is not supported')
+    return (_F.adaptive_avg_pool3d(input, pool_size)
+            if pool_type == 'avg'
+            else _F.adaptive_max_pool3d(input, pool_size))
+
+
+@_register
+def space_to_depth(x, blocksize, name=None):
+    def fn(v):
+        N, C, H, W = v.shape
+        b = int(blocksize)
+        v = v.reshape(N, C, H // b, b, W // b, b)
+        v = v.transpose(0, 3, 5, 1, 2, 4)
+        return v.reshape(N, C * b * b, H // b, W // b)
+    return apply(fn, wrap(x), op_name='space_to_depth')
+
+
+@_register
+def shuffle_channel(x, group, name=None):
+    def fn(v):
+        N, C, H, W = v.shape
+        g = int(group)
+        return v.reshape(N, g, C // g, H, W).transpose(
+            0, 2, 1, 3, 4).reshape(N, C, H, W)
+    return apply(fn, wrap(x), op_name='shuffle_channel')
+
+
+@_register
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    """TSM shift (reference temporal_shift_op): shift the first
+    C*ratio channels backward in time, the next C*ratio forward."""
+    def fn(v):
+        NT, C, H, W = v.shape
+        N = NT // seg_num
+        v = v.reshape(N, seg_num, C, H, W)
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        pad = jnp.pad(v, ((0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
+        back = pad[:, :seg_num, :c1]          # t-1 -> t
+        fwd = pad[:, 2:, c1:c2]               # t+1 -> t
+        keep = v[:, :, c2:]
+        out = jnp.concatenate([back, fwd, keep], axis=2)
+        return out.reshape(NT, C, H, W)
+    return apply(fn, wrap(x), op_name='temporal_shift')
+
+
+@_register
+def pixel_shuffle(x, upscale_factor):
+    return _F.pixel_shuffle(x, upscale_factor)
+
+
+@_register
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1,
+           name=None):
+    return _F.unfold(x, kernel_sizes, strides=strides,
+                     paddings=paddings, dilations=dilations)
+
+
+@_register
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=None,
+                    deformable_groups=None, im2col_step=None,
+                    param_attr=None, bias_attr=None,
+                    modulated=True, name=None):
+    from ..static.nn import deform_conv2d as _dc
+    return _dc(input, offset, mask if modulated else None,
+               num_filters, filter_size, stride=stride,
+               padding=padding, dilation=dilation,
+               param_attr=param_attr, bias_attr=bias_attr)
+
+
+# -- losses / metrics ----------------------------------------------------
+
+@_register
+def cos_sim(X, Y):
+    def fn(a, b):
+        a2 = a.reshape(a.shape[0], -1)
+        b2 = b.reshape(b.shape[0], -1) if b.shape[0] == a.shape[0] \
+            else jnp.broadcast_to(b.reshape(1, -1),
+                                  (a.shape[0], b.size))
+        num = jnp.sum(a2 * b2, axis=1, keepdims=True)
+        den = (jnp.linalg.norm(a2, axis=1, keepdims=True)
+               * jnp.linalg.norm(b2, axis=1, keepdims=True))
+        return num / jnp.maximum(den, 1e-12)
+    return apply(fn, wrap(X), wrap(Y), op_name='cos_sim')
+
+
+@_register
+def smooth_l1(x, y, inside_weight=None, outside_weight=None,
+              sigma=None):
+    """Legacy smooth_l1 op: per-sample SUM of the huber terms with
+    the sigma^2 transition point, [N, 1]."""
+    s2 = 1.0 if sigma is None else float(sigma) ** 2
+
+    def fn(a, b, *ws):
+        iw = ws[0] if ws else jnp.ones_like(a)
+        ow = ws[1] if len(ws) > 1 else jnp.ones_like(a)
+        d = (a - b) * iw
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < 1.0 / s2, 0.5 * d * d * s2,
+                         ad - 0.5 / s2)
+        loss = loss * ow
+        return jnp.sum(loss.reshape(a.shape[0], -1), axis=1,
+                       keepdims=True)
+    args = [wrap(x), wrap(y)]
+    if inside_weight is not None or outside_weight is not None:
+        args.append(wrap(inside_weight)
+                    if inside_weight is not None
+                    else wrap(_T.ones_like(x)))
+        args.append(wrap(outside_weight)
+                    if outside_weight is not None
+                    else wrap(_T.ones_like(x)))
+    return apply(fn, *args, op_name='smooth_l1')
+
+
+@_register
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def fn(p, y):
+        if y.ndim == p.ndim and y.shape[-1] == 1:
+            y = y[..., 0]
+        y1 = jax.nn.one_hot(y.reshape(-1), p.shape[-1], dtype=p.dtype)
+        pf = p.reshape(-1, p.shape[-1])
+        inter = 2.0 * jnp.sum(pf * y1)
+        union = jnp.sum(pf) + jnp.sum(y1)
+        return 1.0 - inter / (union + epsilon)
+    return apply(fn, wrap(input), wrap(label), op_name='dice_loss')
+
+
+@_register
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def fn(p, y):
+        return (-y * jnp.log(p + epsilon)
+                - (1.0 - y) * jnp.log(1.0 - p + epsilon))
+    return apply(fn, wrap(input), wrap(label), op_name='log_loss')
+
+
+@_register
+def mean_iou(input, label, num_classes):
+    """(mean_iou, out_wrong, out_correct) over a class-id prediction
+    map (reference mean_iou_op)."""
+    def fn(p, y):
+        p = p.reshape(-1)
+        y = y.reshape(-1)
+        n = int(num_classes)
+        correct = jnp.zeros(n, jnp.int32).at[y].add(
+            (p == y).astype(jnp.int32))
+        pred_cnt = jnp.zeros(n, jnp.int32).at[p].add(1)
+        label_cnt = jnp.zeros(n, jnp.int32).at[y].add(1)
+        union = pred_cnt + label_cnt - correct
+        present = union > 0
+        iou = jnp.where(present,
+                        correct / jnp.maximum(union, 1), 0.0)
+        miou = jnp.sum(iou) / jnp.maximum(
+            jnp.sum(present.astype(jnp.int32)), 1)
+        wrong = label_cnt - correct
+        return miou.astype(jnp.float32), wrong, correct
+    return apply(fn, wrap(input), wrap(label), op_name='mean_iou')
+
+
+@_register
+def fsp_matrix(x, y):
+    """Flow-of-solution-procedure matrix (reference fsp_op): [N, Cx,
+    Cy] = x·y^T over the spatial dims, normalized by H*W."""
+    def fn(a, b):
+        N, Cx, H, W = a.shape
+        Cy = b.shape[1]
+        am = a.reshape(N, Cx, H * W)
+        bm = b.reshape(N, Cy, H * W)
+        return jnp.einsum('nch,ndh->ncd', am, bm) / (H * W)
+    return apply(fn, wrap(x), wrap(y), op_name='fsp_matrix')
+
+
+# -- misc ----------------------------------------------------------------
+
+@_register
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format='NCHW'):
+    return _F.local_response_norm(input, n, alpha=alpha, beta=beta,
+                                  k=k, data_format=data_format)
+
+
+@_register
+def grid_sampler(x, grid, name=None):
+    return _F.grid_sample(x, grid)
+
+
+@_register
+def affine_channel(x, scale=None, bias=None, data_layout='NCHW',
+                   act=None, name=None):
+    def fn(v, s, b):
+        if data_layout == 'NCHW':
+            s = s.reshape(1, -1, 1, 1)
+            b = b.reshape(1, -1, 1, 1)
+        return v * s + b
+    out = apply(fn, wrap(x), wrap(scale), wrap(bias),
+                op_name='affine_channel')
+    if act is not None:
+        out = getattr(_F, act)(out)
+    return out
+
+
+@_register
+def affine_grid(theta, out_shape, name=None):
+    """2-D affine sampling grid (reference affine_grid_op): theta
+    [N, 2, 3] x normalized target coords -> grid [N, H, W, 2]."""
+    def fn(t):
+        N = t.shape[0]
+        shp = [int(s) for s in out_shape]
+        H, W = shp[2], shp[3]
+        ys = jnp.linspace(-1.0, 1.0, H)
+        xs = jnp.linspace(-1.0, 1.0, W)
+        xg, yg = jnp.meshgrid(xs, ys)           # [H, W]
+        ones = jnp.ones_like(xg)
+        coords = jnp.stack([xg, yg, ones], -1)  # [H, W, 3]
+        return jnp.einsum('nij,hwj->nhwi', t.astype(jnp.float32),
+                          coords)
+    return apply(fn, wrap(theta), op_name='affine_grid')
+
+
+@_register
+def add_position_encoding(input, alpha, beta, name=None):
+    """Sinusoidal position encoding mixed in (reference
+    add_position_encoding_op): out = alpha*x + beta*pe."""
+    def fn(v):
+        N, T, C = v.shape
+        half = C // 2
+        pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+        div = jnp.power(10000.0,
+                        jnp.arange(half, dtype=jnp.float32) / half)
+        ang = pos / div                          # [T, half]
+        pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=1)
+        if pe.shape[1] < C:   # odd C: pad the last channel with 0
+            pe = jnp.pad(pe, ((0, 0), (0, C - pe.shape[1])))
+        return alpha * v + beta * pe[None, :, :C].astype(v.dtype)
+    return apply(fn, wrap(input), op_name='add_position_encoding')
+
+
+@_register
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype='float32'):
+    """Sample one category id per row of a probability matrix."""
+    if seed == 0:
+        from ..core import rng as rng_mod
+        seed = int(np.asarray(rng_mod.next_key())[-1])
+
+    def fn(p):
+        key = jax.random.PRNGKey(int(seed))
+        return jax.random.categorical(
+            key, jnp.log(jnp.maximum(p, 1e-20)), axis=-1)
+    return apply(fn, wrap(x), op_name='sampling_id')
+
+
+@_register
+def uniform_random_batch_size_like(input, shape, dtype='float32',
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    shp = list(shape)
+    shp[output_dim_idx] = input.shape[input_dim_idx]
+    return _T.uniform(shp, dtype=dtype, min=min, max=max, seed=seed)
+
+
+@_register
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0,
+                                    std=1.0, seed=0, dtype='float32'):
+    shp = list(shape)
+    shp[output_dim_idx] = input.shape[input_dim_idx]
+    return _T.cast(_T.normal(mean=mean, std=std, shape=shp), dtype)
+
+
+@_register
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  force_cpu=False):
+    shp = list(shape)
+    shp[output_dim_idx] = input.shape[input_dim_idx]
+    return _T.full(shp, value, dtype=dtype)
+
+
+@_register
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """A persistent step counter (reference uses a global var; eager
+    equivalent: a module-level counter per name)."""
+    name = counter_name or '@STEP_COUNTER@'
+    cur = _STEP_COUNTERS.get(name)
+    if cur is None:
+        cur = begin
+    else:
+        cur = cur + step
+    _STEP_COUNTERS[name] = cur
+    return Tensor(jnp.asarray([cur], jnp.int64))
+
+
+_STEP_COUNTERS = {}
+
+
+@_register
+def ctc_greedy_decoder(input, blank, input_length=None,
+                       padding_value=0, name=None):
+    """Greedy CTC decode (reference ctc_greedy_decoder): argmax per
+    step, merge repeats, drop blanks.  Padded-dense redesign: input
+    [N, T, C] (batch-major), returns (decoded [N, T] padded with
+    padding_value, seq_len [N])."""
+    def fn(p, *ls):
+        N, T, C = p.shape
+        ids = jnp.argmax(p, axis=-1)             # [N, T]
+        prev = jnp.concatenate(
+            [jnp.full((N, 1), -1, ids.dtype), ids[:, :-1]], axis=1)
+        keep = (ids != blank) & (ids != prev)
+        if ls:
+            tmask = jnp.arange(T)[None, :] < ls[0].reshape(-1, 1)
+            keep = keep & tmask
+        pos = jnp.where(keep, jnp.cumsum(keep, axis=1) - 1, T)
+        rows = jnp.broadcast_to(jnp.arange(N)[:, None], (N, T))
+        # drop-mode scatter: dropped steps target column T (OOB)
+        out = jnp.full((N, T), padding_value, ids.dtype).at[
+            rows.reshape(-1),
+            jnp.where(pos < T, pos, T).reshape(-1)].set(
+                ids.reshape(-1), mode='drop')
+        lens = jnp.sum(keep, axis=1)
+        return out, lens
+    args = [wrap(input)]
+    if input_length is not None:
+        args.append(wrap(input_length))
+    return apply(fn, *args, op_name='ctc_greedy_decoder')
+
+
+@_register
+def linear_chain_crf(input, label, param_attr=None, length=None,
+                     transition=None):
+    """Linear-chain CRF negative log-likelihood (reference
+    linear_chain_crf_op): transition params [C+2, C] (start/stop rows
+    first), emissions [N, T, C], labels [N, T].  Returns per-sequence
+    NLL [N, 1]; the forward algorithm is one lax.scan (log-space).
+    Dense redesign of the reference's LoD sequences (use `length` for
+    ragged batches)."""
+    C = input.shape[-1]
+    if transition is None:
+        from ..tensor.creation import create_parameter
+        transition = create_parameter(
+            [C + 2, C], str(input.dtype).replace('paddle.', ''),
+            attr=param_attr)
+
+    def fn(emit, lab, trans, *ls):
+        N, T, Cc = emit.shape
+        emit = emit.astype(jnp.float32)
+        start = trans[0]
+        stop = trans[1]
+        A = trans[2:].astype(jnp.float32)       # [C, C]
+        lens = ls[0].reshape(-1) if ls else jnp.full((N,), T)
+
+        def step(carry, xs):
+            alpha, t = carry
+            e_t = xs                              # [N, C]
+            nxt = jax.scipy.special.logsumexp(
+                alpha[:, :, None] + A[None], axis=1) + e_t
+            alive = (t < lens)[:, None]
+            alpha = jnp.where(alive, nxt, alpha)
+            return (alpha, t + 1), None
+
+        alpha0 = start[None] + emit[:, 0]
+        (alphaT, _), _ = lax.scan(
+            step, (alpha0, jnp.ones((), jnp.int32)),
+            jnp.swapaxes(emit[:, 1:], 0, 1))
+        logZ = jax.scipy.special.logsumexp(alphaT + stop[None],
+                                           axis=1)
+        # score of the gold path
+        tmask = jnp.arange(T)[None, :] < lens[:, None]
+        lab_c = jnp.clip(lab, 0, Cc - 1)
+        e_score = jnp.sum(
+            jnp.take_along_axis(emit, lab_c[..., None],
+                                axis=2)[..., 0] * tmask, axis=1)
+        pair_mask = (jnp.arange(1, T)[None, :]
+                     < lens[:, None])            # [N, T-1]
+        t_score = jnp.sum(
+            A[lab_c[:, :-1], lab_c[:, 1:]] * pair_mask, axis=1)
+        last = jnp.clip(lens - 1, 0, T - 1)
+        lab_last = jnp.take_along_axis(lab_c, last[:, None],
+                                       axis=1)[:, 0]
+        gold = (start[lab_c[:, 0]] + e_score + t_score
+                + stop[lab_last])
+        return (logZ - gold)[:, None]
+
+    args = [wrap(input), wrap(label), transition]
+    if length is not None:
+        args.append(wrap(length))
+    return apply(fn, *args, op_name='linear_chain_crf')
+
+
+@_register
+def psroi_pool(input, rois, output_channels, spatial_scale,
+               pooled_height, pooled_width, rois_num=None, name=None):
+    """Position-sensitive RoI average pooling (reference
+    psroi_pool_op): input channels C = output_channels*ph*pw, each
+    output bin averages ITS OWN channel slice over the bin region."""
+    # roi_pool's mask machinery with a position-sensitive mean
+    from ..vision.detection import _roi_batch_ids
+
+    def fn2(x, bx, bn):
+        N, C, H, W = x.shape
+        R = bx.shape[0]
+        ph, pw = int(pooled_height), int(pooled_width)
+        oc = int(output_channels)
+        bids = _roi_batch_ids(bn, R)
+
+        def one_roi(roi, bid):
+            x1 = jnp.round(roi[0] * spatial_scale)
+            y1 = jnp.round(roi[1] * spatial_scale)
+            x2 = jnp.round(roi[2] * spatial_scale)
+            y2 = jnp.round(roi[3] * spatial_scale)
+            rh = jnp.maximum(y2 - y1, 0.1)
+            rw = jnp.maximum(x2 - x1, 0.1)
+            bin_h = rh / ph
+            bin_w = rw / pw
+            pidx = jnp.arange(ph)[:, None]
+            hh = jnp.arange(H)[None, :]
+            hstart = jnp.clip(jnp.floor(pidx * bin_h + y1), 0, H)
+            hend = jnp.clip(jnp.ceil((pidx + 1) * bin_h + y1), 0, H)
+            mask_h = ((hh >= hstart) & (hh < hend)).astype(x.dtype)
+            qidx = jnp.arange(pw)[:, None]
+            ww = jnp.arange(W)[None, :]
+            wstart = jnp.clip(jnp.floor(qidx * bin_w + x1), 0, W)
+            wend = jnp.clip(jnp.ceil((qidx + 1) * bin_w + x1), 0, W)
+            mask_w = ((ww >= wstart) & (ww < wend)).astype(x.dtype)
+            img = x[bid].reshape(oc, ph, pw, H, W)
+            # bin (i, j) of output channel k reads input channel
+            # k*ph*pw + i*pw + j — the position-sensitive layout
+            sums = jnp.einsum('opqhw,ph,qw->opq', img, mask_h,
+                              mask_w)
+            area = (jnp.einsum('ph,qw->pq', mask_h, mask_w))
+            return sums / jnp.maximum(area, 1.0)
+
+        return jax.vmap(one_roi)(bx, bids)
+
+    if rois_num is None:
+        rois_num = _T.full([input.shape[0]],
+                           rois.shape[0] // input.shape[0], 'int32')
+    return apply(fn2, wrap(input), wrap(rois), wrap(rois_num),
+                 op_name='psroi_pool')
+
+
+# -- tensor.py names -----------------------------------------------------
+
+@_register
+def tensor_array_to_tensor(input, axis=1, name=None,
+                           use_stack=False):
+    arrs = list(input) if isinstance(input, (list, tuple)) \
+        else input.to_list()
+    out = _T.stack(arrs, axis=axis) if use_stack else \
+        _T.concat(arrs, axis=axis)
+    sizes = _T.full([len(arrs)],
+                    1 if use_stack else arrs[0].shape[axis], 'int32')
+    return out, sizes
+
+
+@_register
+def sums(input, out=None):
+    res = _T.add_n(list(input))
+    if out is not None:
+        out.set_value(res.value)
+        return out
+    return res
+
+
+@_register
+def has_inf(x):
+    return _T.any(_T.isinf(x))
+
+
+@_register
+def has_nan(x):
+    return _T.any(_T.isnan(x))
+
+
+@_register
+def isfinite(x):
+    return _T.all(_T.isfinite(x))
+
+
+@_register
+def range(start, end, step, dtype, name=None):
+    return _T.arange(start, end, step, dtype)
+
+
+@_register
+def linspace(start, stop, num, dtype=None, name=None):
+    return _T.linspace(start, stop, num, dtype)
+
+
+@_register
+def diag(diagonal):
+    return _T.diag(diagonal)
+
+
+@_register
+def eye(num_rows, num_columns=None, batch_shape=None,
+        dtype='float32', name=None):
+    out = _T.eye(num_rows, num_columns, dtype=dtype)
+    if batch_shape:
+        for _ in batch_shape:
+            out = _T.unsqueeze(out, axis=0)
+        out = _T.expand(out, list(batch_shape) + list(out.shape[-2:]))
+    return out
+
+
+@_register
+def triu(input, diagonal=0, name=None):
+    return _T.triu(input, diagonal)
+
+
+# -- control_flow.py names -----------------------------------------------
+
+@_register
+def create_array(dtype):
+    from ..tensor.array import create_array as _ca
+    return _ca(dtype)
+
+
+@_register
+def array_length(array):
+    from ..tensor.array import array_length as _al
+    return _al(array)
+
+
+@_register
+def less_than(x, y, force_cpu=None, cond=None, name=None):
+    return _T.less_than(x, y)
+
+
+@_register
+def less_equal(x, y, cond=None, name=None):
+    return _T.less_equal(x, y)
+
+
+@_register
+def greater_than(x, y, cond=None, name=None):
+    return _T.greater_than(x, y)
+
+
+@_register
+def greater_equal(x, y, cond=None, name=None):
+    return _T.greater_equal(x, y)
+
+
+@_register
+def equal(x, y, cond=None, name=None):
+    return _T.equal(x, y)
+
+
+@_register
+def not_equal(x, y, cond=None, name=None):
+    return _T.not_equal(x, y)
+
+
+@_register
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) == 0))
+
+
+@_register
+def Assert(cond, data=None, summarize=20, name=None):
+    """Eager assert (the reference op halts the Executor)."""
+    v = np.asarray(getattr(cond, 'value', cond))
+    if not bool(v.all()):
+        payload = [np.asarray(getattr(d, 'value', d))[:summarize]
+                   for d in (data or [])]
+        raise AssertionError(f'fluid.layers.Assert failed: {payload}')
+    return cond
+
+
+# -- LoD-era / SelectedRows non-goals ------------------------------------
+
+_LEGACY_NON_GOALS = {
+    'DynamicRNN': 'use nn.RNN / lax.scan (LoD loop builder)',
+    'StaticRNN': 'use nn.RNN / lax.scan (graph loop builder)',
+    'IfElse': 'use fluid.layers.cond',
+    'While': 'use fluid.layers.while_loop',
+    'Switch': 'use fluid.layers.case/switch_case',
+    'lod_reset': 'LoD is redesigned away (padded-dense + lengths)',
+    'lod_append': 'LoD is redesigned away (padded-dense + lengths)',
+    'reorder_lod_tensor_by_rank': 'LoD is redesigned away',
+    'im2sequence': 'use fluid.layers.unfold (padded-dense)',
+    'merge_selected_rows': 'SelectedRows does not exist here',
+    'get_tensor_from_selected_rows': 'SelectedRows does not exist '
+                                     'here',
+    'continuous_value_model': 'BoxPS/CVM parameter-server machinery',
+    'filter_by_instag': 'instag PS-era filtering',
+    'similarity_focus': 'niche op with no 2.x surface',
+    'hash': 'pyramid-hash machinery (documented non-goal)',
+    'prroi_pool': 'precise-RoI integral pooling; use roi_align',
+    'deformable_roi_pooling': 'offset-deformed RoI pooling; use '
+                              'roi_align (+ deform_conv2d for the '
+                              'deformable pathway)',
+    'inplace_abn': 'use batch_norm + activation (no in-place '
+                   'semantics on TPU)',
+    'chunk_eval': 'host-side chunking metric; compute F1 from '
+                  'crf_decoding output with sklearn-style tooling',
+}
+
+
+def __getattr__(name):
+    if name in _LEGACY_NON_GOALS:
+        raise NotImplementedError(
+            f'fluid.layers.{name} is a documented non-goal: '
+            f'{_LEGACY_NON_GOALS[name]}.')
+    raise AttributeError(name)
+
+
+from jax import lax  # noqa: E402  (used by crf/ctc above)
