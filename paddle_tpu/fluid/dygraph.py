@@ -156,3 +156,313 @@ def load_dygraph(model_path):
     opt = load(model_path + '.pdopt') \
         if os.path.exists(model_path + '.pdopt') else None
     return params, opt
+
+
+class Conv3D(_nn.Conv3D):
+    """1.x signature: Conv3D(num_channels, num_filters, filter_size)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None,
+                 dtype='float32'):
+        super().__init__(num_channels, num_filters, filter_size,
+                         stride=stride, padding=padding,
+                         dilation=dilation, groups=groups,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act:
+            from ..nn import functional as F
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class Conv2DTranspose(_nn.Conv2DTranspose):
+    """1.x signature: Conv2DTranspose(num_channels, num_filters,
+    filter_size)."""
+
+    def __init__(self, num_channels, num_filters, filter_size,
+                 output_size=None, padding=0, stride=1, dilation=1,
+                 groups=1, param_attr=None, bias_attr=None,
+                 use_cudnn=True, act=None, dtype='float32'):
+        super().__init__(num_channels, num_filters, filter_size,
+                         stride=stride, padding=padding,
+                         dilation=dilation, groups=groups,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+        self._act = act
+        self._output_size = output_size
+
+    def forward(self, x):
+        out = super().forward(x, output_size=self._output_size)
+        if self._act:
+            from ..nn import functional as F
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class Conv3DTranspose(_nn.Conv3DTranspose):
+    def __init__(self, num_channels, num_filters, filter_size,
+                 padding=0, stride=1, dilation=1, groups=1,
+                 param_attr=None, bias_attr=None, use_cudnn=True,
+                 act=None, dtype='float32'):
+        super().__init__(num_channels, num_filters, filter_size,
+                         stride=stride, padding=padding,
+                         dilation=dilation, groups=groups,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act:
+            from ..nn import functional as F
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class InstanceNorm(_nn.InstanceNorm2D):
+    """1.x InstanceNorm(num_channels, epsilon=1e-5, param_attr=...,
+    bias_attr=...)."""
+
+    def __init__(self, num_channels, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, dtype='float32'):
+        super().__init__(num_channels, epsilon=epsilon,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+
+
+class GroupNorm(_nn.GroupNorm):
+    """1.x GroupNorm(channels, groups, epsilon, param_attr,
+    bias_attr)."""
+
+    def __init__(self, channels, groups, epsilon=1e-05,
+                 param_attr=None, bias_attr=None, act=None,
+                 data_layout='NCHW', dtype='float32'):
+        super().__init__(num_groups=groups, num_channels=channels,
+                         epsilon=epsilon, weight_attr=param_attr,
+                         bias_attr=bias_attr)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act:
+            from ..nn import functional as F
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class SpectralNorm(_nn.SpectralNorm):
+    """1.x SpectralNorm(weight_shape, dim, power_iters, eps)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype='float32'):
+        super().__init__(weight_shape, dim=dim,
+                         power_iters=power_iters, eps=eps)
+
+
+class PRelu(Layer):
+    """1.x PRelu(mode, channel=None, input_shape=None, param_attr=...):
+    mode 'all' (one alpha), 'channel', or 'element'."""
+
+    def __init__(self, mode, channel=None, input_shape=None,
+                 param_attr=None, dtype='float32'):
+        super().__init__()
+        if mode == 'all':
+            n = 1
+        elif mode == 'channel':
+            n = int(channel)
+        elif mode == 'element':
+            n = int(np.prod(input_shape[1:]))
+        else:
+            raise ValueError(f'unknown PRelu mode {mode!r}')
+        self._mode = mode
+        self._input_shape = input_shape
+        from ..nn import initializer as I
+        self.weight = self.create_parameter(
+            [n], attr=param_attr, dtype=dtype,
+            default_initializer=I.Constant(0.25))
+
+    def forward(self, x):
+        from ..core.dispatch import apply as _apply
+        import jax.numpy as jnp
+
+        mode, shp = self._mode, self._input_shape
+
+        def fn(v, a):
+            if mode == 'channel':
+                a = a.reshape((1, -1) + (1,) * (v.ndim - 2))
+            elif mode == 'element':
+                a = a.reshape((1,) + tuple(shp[1:]))
+            return jnp.where(v > 0, v, a * v)
+        return _apply(fn, x, self.weight, op_name='prelu')
+
+
+class BilinearTensorProduct(_nn.Bilinear):
+    """1.x BilinearTensorProduct(input1_dim, input2_dim, output_dim)."""
+
+    def __init__(self, input1_dim, input2_dim, output_dim,
+                 name=None, act=None, param_attr=None, bias_attr=None,
+                 dtype='float32'):
+        super().__init__(input1_dim, input2_dim, output_dim,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+        self._act = act
+
+    def forward(self, x, y):
+        out = super().forward(x, y)
+        if self._act:
+            from ..nn import functional as F
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class Flatten(_nn.Flatten):
+    """Reference dygraph Flatten uses the 2.x (start_axis,
+    stop_axis) signature."""
+
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__(start_axis=start_axis, stop_axis=stop_axis)
+
+
+class GRUUnit(Layer):
+    """One GRU step (reference dygraph/nn.py:1841 / gru_unit op):
+    input is the PRE-PROJECTED [N, 3D] (x @ W_x done by the caller),
+    hidden [N, D].  Returns (hidden', reset_hidden_pre, gate) like the
+    reference op.  h' = u*h + (1-u)*c (the fluid update rule)."""
+
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation='tanh', gate_activation='sigmoid',
+                 origin_mode=False, dtype='float32'):
+        super().__init__()
+        D = size // 3
+        self._D = D
+        self._origin = origin_mode
+        self._act = activation
+        self._gate_act = gate_activation
+        from ..nn import initializer as I
+        self.weight = self.create_parameter(
+            [D, 3 * D], attr=param_attr, dtype=dtype,
+            default_initializer=I.XavierUniform())
+        self.bias = self.create_parameter(
+            [1, 3 * D], attr=bias_attr, dtype=dtype, is_bias=True,
+            default_initializer=I.Constant(0.0))
+
+    def forward(self, input, hidden):
+        from ..core.dispatch import apply as _apply
+        import jax
+        import jax.numpy as jnp
+        D = self._D
+        act = getattr(jax.nn, self._act) if self._act != 'tanh' \
+            else jnp.tanh
+        gate_act = getattr(jax.nn, self._gate_act)
+        origin = self._origin
+
+        def fn(x, h, w, b):
+            xu, xr, xc = x[:, :D], x[:, D:2 * D], x[:, 2 * D:]
+            wu, wr, wc = w[:, :D], w[:, D:2 * D], w[:, 2 * D:]
+            bu, br, bc = b[:, :D], b[:, D:2 * D], b[:, 2 * D:]
+            u = gate_act(xu + h @ wu + bu)
+            r = gate_act(xr + h @ wr + br)
+            rhp = r * h
+            c = act(xc + rhp @ wc + bc)
+            if origin:
+                h2 = u * h + (1.0 - u) * c
+            else:
+                h2 = (1.0 - u) * h + u * c
+            gate = jnp.concatenate([u, r, c], axis=1)
+            return h2, rhp, gate
+        return _apply(fn, input, hidden, self.weight, self.bias,
+                      op_name='gru_unit')
+
+
+class NCE(Layer):
+    """Noise-contrastive estimation loss (reference dygraph/nn.py:2019,
+    Gutmann & Hyvärinen): logistic discrimination of the true class
+    against num_neg_samples uniformly sampled noise classes.  The
+    'uniform' and 'log_uniform' samplers are supported; custom_dist
+    raises (SelectedRows-era machinery)."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=10,
+                 sampler='uniform', custom_dist=None, seed=0,
+                 is_sparse=False, dtype='float32'):
+        super().__init__()
+        if sampler not in ('uniform', 'log_uniform'):
+            raise NotImplementedError(
+                f'NCE sampler {sampler!r}: only uniform/log_uniform '
+                '(custom_dist is SelectedRows-era machinery)')
+        self._C = int(num_total_classes)
+        self._k = int(num_neg_samples)
+        self._sampler = sampler
+        self._seed = seed
+        from ..nn import initializer as I
+        self.weight = self.create_parameter(
+            [self._C, dim], attr=param_attr, dtype=dtype,
+            default_initializer=I.XavierUniform())
+        self.bias = None if bias_attr is False else \
+            self.create_parameter(
+                [self._C, 1], attr=bias_attr, dtype=dtype,
+                is_bias=True, default_initializer=I.Constant(0.0))
+
+    def forward(self, input, label, sample_weight=None):
+        from ..core.dispatch import apply as _apply
+        from ..core import rng as rng_mod
+        import jax
+        import jax.numpy as jnp
+        C, k = self._C, self._k
+        seed = self._seed or int(np.asarray(rng_mod.next_key())[-1])
+        sampler = self._sampler
+        has_bias = self.bias is not None
+        has_sw = sample_weight is not None
+
+        def fn(x, y, w, *rest):
+            N = x.shape[0]
+            key = jax.random.PRNGKey(seed)
+            if sampler == 'uniform':
+                noise = jax.random.randint(key, (N, k), 0, C)
+            else:   # log_uniform (Zipf-ish)
+                u = jax.random.uniform(key, (N, k))
+                noise = (jnp.exp(u * jnp.log(C + 1.0)) - 1.0) \
+                    .astype(jnp.int32)
+                noise = jnp.clip(noise, 0, C - 1)
+            y = y.reshape(-1)
+            ids = jnp.concatenate([y[:, None], noise], axis=1)
+            ws = w[ids]                           # [N, 1+k, D]
+            logits = jnp.einsum('nd,nkd->nk', x, ws)
+            ri = 0
+            if has_bias:
+                logits = logits + rest[ri][ids][..., 0]
+                ri += 1
+            # NCE noise correction (reference nce_op.h:204): the
+            # discriminator is o/(o+b) with b = q(class) * k, i.e.
+            # sigmoid(logit - log b) — without it the estimator
+            # loses its consistency guarantee
+            if sampler == 'uniform':
+                q = jnp.full(ids.shape, 1.0 / C)
+            else:
+                cid = ids.astype(jnp.float32)
+                q = (jnp.log((cid + 2.0) / (cid + 1.0))
+                     / jnp.log(C + 1.0))
+            logits = logits - jnp.log(q * k)
+            labels = jnp.concatenate(
+                [jnp.ones((N, 1)), jnp.zeros((N, k))], axis=1)
+            # logistic loss, summed over the 1+k discriminations
+            ll = jnp.maximum(logits, 0) - logits * labels \
+                + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+            out = jnp.sum(ll, axis=1, keepdims=True)
+            if has_sw:
+                out = out * rest[ri].reshape(-1, 1)
+            return out
+
+        args = [input, label, self.weight]
+        if has_bias:
+            args.append(self.bias)
+        if has_sw:
+            args.append(sample_weight)
+        return _apply(fn, *args, op_name='nce')
+
+
+class TreeConv(Layer):
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            'TreeConv is a documented non-goal (tree-index machinery; '
+            'see fluid.contrib.layers non-goals)')

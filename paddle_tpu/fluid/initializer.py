@@ -27,3 +27,19 @@ UniformInitializer = Uniform
 XavierInitializer = Xavier
 MSRAInitializer = MSRA
 BilinearInitializer = Bilinear
+
+
+class NumpyArrayInitializer:
+    """Initialize from a literal array (reference
+    initializer.py::NumpyArrayInitializer) — the Assign initializer."""
+
+    def __init__(self, value):
+        from ..nn.initializer import Assign
+        self._inner = Assign(value)
+
+    def __call__(self, shape, dtype, key=None):
+        return self._inner(shape, dtype, key)
+
+
+TruncatedNormalInitializer = TruncatedNormal
+__all__ += ['TruncatedNormalInitializer', 'NumpyArrayInitializer']

@@ -44,3 +44,82 @@ def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None):
     return _sio.load_inference_model(
         os.path.join(dirname, model_filename or 'model'), executor)
+
+
+def save(program, model_path, protocol=4, **configs):
+    """fluid.io.save: persist a Program's parameters (reference
+    io.py::save — pickled params + opt state)."""
+    _sio.save(program, model_path, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    _sio.load(program, model_path, executor, var_list)
+
+
+def load_program_state(model_path, var_list=None):
+    return _sio.load_program_state(model_path, var_list)
+
+
+def set_program_state(program, state_dict):
+    return _sio.set_program_state(program, state_dict)
+
+
+def get_program_parameter(program):
+    """All parameters of the program (reference returns the var
+    list)."""
+    return program.all_parameters()
+
+
+def get_program_persistable_vars(program):
+    """Parameters + persistable buffers; in the TPU-native Program the
+    persistable set IS the parameter set (no scope-resident temps)."""
+    return program.all_parameters()
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """Reference io.py::save_vars: persist a subset.  The subset
+    (vars/predicate) filters the program's parameters by name."""
+    import os
+    prog = main_program or default_main_program()
+    params = prog.all_parameters()
+    if vars is not None:
+        names = {getattr(v, 'name', v) for v in vars}
+        params = [p for p in params if p.name in names]
+    elif predicate is not None:
+        params = [p for p in params if predicate(p)]
+    import pickle
+    import numpy as np
+    state = {p.name or str(i): np.asarray(p.value)
+             for i, p in enumerate(params)}
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, filename or '__all_vars__'),
+              'wb') as f:
+        pickle.dump(state, f)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    import os
+    import pickle
+    prog = main_program or default_main_program()
+    with open(os.path.join(dirname, filename or '__all_vars__'),
+              'rb') as f:
+        state = pickle.load(f)
+    params = prog.all_parameters()
+    if vars is not None:
+        names = {getattr(v, 'name', v) for v in vars}
+        params = [p for p in params if p.name in names]
+    elif predicate is not None:
+        params = [p for p in params if predicate(p)]
+    import jax.numpy as jnp
+    for i, p in enumerate(params):
+        key = p.name or str(i)
+        if key in state:
+            p.set_value(jnp.asarray(state[key]))
+
+
+def batch(reader, batch_size, drop_last=False):
+    """fluid.io.batch — the reader-decorator alias."""
+    from ..batch import batch as _batch
+    return _batch(reader, batch_size, drop_last=drop_last)

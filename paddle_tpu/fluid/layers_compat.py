@@ -207,19 +207,29 @@ def unique(x, dtype='int32'):
     """Eager-only (dynamic output shape): (unique values, index map
     such that x = out[index]) like the reference op."""
     v = np.asarray(getattr(x, 'value', x))
-    out, index = np.unique(v, return_inverse=True)
+    vals, first, inv = np.unique(v, return_index=True,
+                                 return_inverse=True)
+    # reference preserves FIRST-OCCURRENCE order, not sorted order
+    order = np.argsort(first)
+    out = vals[order]
+    remap = np.empty_like(order)
+    remap[order] = np.arange(len(order))
     return (Tensor(jnp.asarray(out)),
-            Tensor(jnp.asarray(index.astype(dtype))))
+            Tensor(jnp.asarray(remap[inv].astype(dtype))))
 
 
 @_register
 def unique_with_counts(x, dtype='int32'):
     v = np.asarray(getattr(x, 'value', x))
-    out, index, count = np.unique(v, return_inverse=True,
-                                  return_counts=True)
-    return (Tensor(jnp.asarray(out)),
-            Tensor(jnp.asarray(index.astype(dtype))),
-            Tensor(jnp.asarray(count.astype(dtype))))
+    vals, first, inv, count = np.unique(
+        v, return_index=True, return_inverse=True,
+        return_counts=True)
+    order = np.argsort(first)
+    remap = np.empty_like(order)
+    remap[order] = np.arange(len(order))
+    return (Tensor(jnp.asarray(vals[order])),
+            Tensor(jnp.asarray(remap[inv].astype(dtype))),
+            Tensor(jnp.asarray(count[order].astype(dtype))))
 
 
 @_register
@@ -526,11 +536,12 @@ def dice_loss(input, label, epsilon=1e-5, name=None):
     def fn(p, y):
         if y.ndim == p.ndim and y.shape[-1] == 1:
             y = y[..., 0]
-        y1 = jax.nn.one_hot(y.reshape(-1), p.shape[-1], dtype=p.dtype)
-        pf = p.reshape(-1, p.shape[-1])
-        inter = 2.0 * jnp.sum(pf * y1)
-        union = jnp.sum(pf) + jnp.sum(y1)
-        return 1.0 - inter / (union + epsilon)
+        y1 = jax.nn.one_hot(y, p.shape[-1], dtype=p.dtype)
+        red = tuple(builtins.range(1, p.ndim))
+        inse = jnp.sum(p * y1, axis=red)
+        denom = jnp.sum(p, axis=red) + jnp.sum(y1, axis=red)
+        # per-sample dice, then the batch mean (reference nn.py:7104)
+        return jnp.mean(1.0 - 2.0 * inse / (denom + epsilon))
     return apply(fn, wrap(input), wrap(label), op_name='dice_loss')
 
 
@@ -550,17 +561,18 @@ def mean_iou(input, label, num_classes):
         p = p.reshape(-1)
         y = y.reshape(-1)
         n = int(num_classes)
-        correct = jnp.zeros(n, jnp.int32).at[y].add(
-            (p == y).astype(jnp.int32))
-        pred_cnt = jnp.zeros(n, jnp.int32).at[p].add(1)
-        label_cnt = jnp.zeros(n, jnp.int32).at[y].add(1)
-        union = pred_cnt + label_cnt - correct
+        hit = (p == y).astype(jnp.int32)
+        correct = jnp.zeros(n, jnp.int32).at[y].add(hit)
+        # the reference increments wrong at BOTH the label and the
+        # prediction class of each mismatch (mean_iou_op.h)
+        wrong = (jnp.zeros(n, jnp.int32).at[y].add(1 - hit)
+                 .at[p].add(1 - hit))
+        union = wrong + correct
         present = union > 0
         iou = jnp.where(present,
                         correct / jnp.maximum(union, 1), 0.0)
         miou = jnp.sum(iou) / jnp.maximum(
             jnp.sum(present.astype(jnp.int32)), 1)
-        wrong = label_cnt - correct
         return miou.astype(jnp.float32), wrong, correct
     return apply(fn, wrap(input), wrap(label), op_name='mean_iou')
 
@@ -855,8 +867,8 @@ def tensor_array_to_tensor(input, axis=1, name=None,
         else input.to_list()
     out = _T.stack(arrs, axis=axis) if use_stack else \
         _T.concat(arrs, axis=axis)
-    sizes = _T.full([len(arrs)],
-                    1 if use_stack else arrs[0].shape[axis], 'int32')
+    per = [1 if use_stack else a.shape[axis] for a in arrs]
+    sizes = Tensor(jnp.asarray(per, jnp.int32))
     return out, sizes
 
 

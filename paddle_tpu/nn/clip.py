@@ -90,3 +90,42 @@ class ClipGradByGlobalNorm(ClipGradBase):
             ng.value = g.value * scale
             out.append((p, ng))
         return out
+
+
+class ErrorClipByValue:
+    """Legacy error (gradient-of-output) clip attr (reference
+    fluid/clip.py): kept for API parity — in the TPU-native stack it
+    behaves like ClipGradByValue applied to the op's output grads,
+    which the global clip path covers."""
+
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    """Legacy global clip setter (reference fluid/clip.py:
+    set_gradient_clip writes the clip attr onto params).  The 2.x way
+    — passing grad_clip= to the optimizer — is what our optimizers
+    implement; this stores the clip per param for optimizers that
+    consult it."""
+    import warnings
+    warnings.warn(
+        'set_gradient_clip is the deprecated 1.x API: prefer '
+        'passing grad_clip= to the optimizer (reference deprecated '
+        'it the same way)', stacklevel=2)
+    if param_list:
+        for p in param_list:
+            p.grad_clip = clip
+    else:
+        _GLOBAL_CLIP[0] = clip
+
+
+_GLOBAL_CLIP = [None]
+
+
+def get_gradient_clip():
+    return _GLOBAL_CLIP[0]
+
+
+__all__ += ['ErrorClipByValue', 'set_gradient_clip']

@@ -124,10 +124,14 @@ class TestSimpleOps:
         assert e.shape == (4, 2, 2)
         np.testing.assert_allclose(
             np.asarray(L.range(0, 6, 2, 'int32').numpy()), [0, 2, 4])
+        # FIRST-OCCURRENCE order like the reference, not sorted
         u, idx = L.unique(_t([2, 3, 3, 1], 'int64'))
-        np.testing.assert_allclose(np.asarray(u.numpy()), [1, 2, 3])
+        np.testing.assert_allclose(np.asarray(u.numpy()), [2, 3, 1])
+        np.testing.assert_allclose(np.asarray(idx.numpy()),
+                                   [0, 1, 1, 2])
         u, idx, cnt = L.unique_with_counts(_t([2, 3, 3, 1], 'int64'))
-        np.testing.assert_allclose(np.asarray(cnt.numpy()), [1, 1, 2])
+        np.testing.assert_allclose(np.asarray(u.numpy()), [2, 3, 1])
+        np.testing.assert_allclose(np.asarray(cnt.numpy()), [1, 2, 1])
 
     def test_control_flow_helpers(self):
         a, b = _t([1.0]), _t([2.0])
@@ -177,6 +181,20 @@ class TestLossesAndMetrics:
                                            _t(y, 'int64')).numpy()))
         np.testing.assert_allclose(out, 0.0, atol=1e-5)
 
+    def test_dice_loss_per_sample_mean(self):
+        # per-sample dice averaged over the batch (reference
+        # nn.py:7102), NOT a global pool
+        p = np.array([[0.9, 0.9], [0.1, 0.05], [0.3, 0.2]],
+                     'float32')[:, :, None].transpose(0, 2, 1)
+        # shape [3, 1, 2]: one position, two classes
+        y = np.array([[[0]], [[1]], [[1]]], 'int64')
+        out = float(np.asarray(L.dice_loss(
+            _t(p), _t(y, 'int64')).numpy()))
+        ref = np.mean([1 - 2 * 0.9 / (0.9 + 0.9 + 1 + 1e-5),
+                       1 - 2 * 0.05 / (0.1 + 0.05 + 1 + 1e-5),
+                       1 - 2 * 0.2 / (0.3 + 0.2 + 1 + 1e-5)])
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
     def test_mean_iou(self):
         pred = np.array([0, 1, 1, 2], 'int64')
         lab = np.array([0, 1, 0, 2], 'int64')
@@ -187,6 +205,10 @@ class TestLossesAndMetrics:
                                    (0.5 + 0.5 + 1.0) / 3, rtol=1e-6)
         np.testing.assert_allclose(np.asarray(correct.numpy()),
                                    [1, 1, 1])
+        # the reference counts BOTH sides of a mismatch (the [1,0]
+        # miss adds wrong[0] AND wrong[1])
+        np.testing.assert_allclose(np.asarray(wrong.numpy()),
+                                   [1, 1, 0])
 
     def test_fsp_matrix(self):
         rs = np.random.RandomState(1)
