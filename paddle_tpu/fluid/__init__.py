@@ -49,3 +49,77 @@ def enable_dygraph(place=None):
 def disable_dygraph():
     from ..static.program import enable_static
     enable_static()
+
+# reader surface at the fluid top level (reference fluid/reader.py)
+from ..io import DataLoader, default_collate_fn  # noqa: F401,E402
+
+
+class PyReader:
+    """Legacy PyReader (reference fluid/reader.py): feed a Program
+    from a python generator.  The TPU-native DataLoader covers the
+    same contract; this adapter keeps decorate_* API parity."""
+
+    def __init__(self, feed_list=None, capacity=64,
+                 use_double_buffer=True, iterable=True,
+                 return_list=False):
+        self._feed_list = feed_list
+        self._reader = None
+        self._iterable = iterable
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        self._reader = reader
+
+    decorate_batch_generator = decorate_sample_list_generator
+
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        """Per-SAMPLE generator: batch it here (the reference's
+        contract), stacking each field across batch_size samples."""
+        import numpy as np
+
+        def batched():
+            buf = []
+            for sample in sample_generator():
+                buf.append(sample)
+                if len(buf) == batch_size:
+                    yield [np.stack([s[i] for s in buf])
+                           for i in range(len(buf[0]))]
+                    buf = []
+            if buf and not drop_last:
+                yield [np.stack([s[i] for s in buf])
+                       for i in range(len(buf[0]))]
+        self._reader = batched
+
+    def __iter__(self):
+        if self._reader is None:
+            raise RuntimeError('call decorate_*_generator first')
+        return iter(self._reader())
+
+    def start(self):
+        pass
+
+    def reset(self):
+        pass
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Reference fluid/backward.py:1363: append grad ops to the
+    Program.  The TPU-native Program lowers fwd+grad+optim to ONE
+    XLA module at Executor.run, so this only RECORDS the request —
+    it returns (param, grad_var) pairs whose grads materialize when
+    the program runs (the static gradients machinery)."""
+    from ..static.program import gradients as _gradients
+    prog = getattr(loss, 'program', None)
+    if prog is None and hasattr(loss, 'block'):
+        prog = loss.block.program
+    params = parameter_list
+    if params is None:
+        from ..static.program import default_main_program
+        p = prog or default_main_program()
+        params = p.trainable_parameters(no_grad_set)
+    grads = _gradients([loss], params)
+    return list(zip(params, grads))
+
+
+from . import metrics  # noqa: F401,E402

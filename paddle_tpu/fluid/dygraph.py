@@ -466,3 +466,36 @@ class TreeConv(Layer):
         raise NotImplementedError(
             'TreeConv is a documented non-goal (tree-index machinery; '
             'see fluid.contrib.layers non-goals)')
+
+
+# -- dygraph/base.py names (reference fluid/dygraph/base.py) -------------
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """fluid.dygraph.grad — the partial-grad API."""
+    from ..autograd import grad as _grad
+    return _grad(outputs, inputs, grad_outputs=grad_outputs,
+                 retain_graph=retain_graph, create_graph=create_graph,
+                 only_inputs=only_inputs, allow_unused=allow_unused,
+                 no_grad_vars=no_grad_vars)
+
+
+no_grad_ = no_grad   # decorator-style alias the reference exports
+
+
+def enable_dygraph(place=None):
+    """Dygraph is the default mode here; kept for API parity."""
+    from ..static.program import disable_static
+    disable_static()
+
+
+def disable_dygraph():
+    from ..static.program import enable_static
+    enable_static()
+
+
+def enabled():
+    """True iff imperative (dygraph) mode is active."""
+    from ..static.program import in_static_mode
+    return not in_static_mode()

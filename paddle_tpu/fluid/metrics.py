@@ -1,0 +1,224 @@
+"""fluid.metrics — the legacy streaming metric classes.
+
+Reference analogue: /root/reference/python/paddle/fluid/metrics.py
+(MetricBase, CompositeMetric, Precision, Recall, Accuracy,
+ChunkEvaluator, EditDistance, DetectionMAP, Auc).  Precision/Recall/
+Auc route to the jit-safe paddle_tpu.metric implementations; the
+value-streaming Accuracy, EditDistance and DetectionMAP are host-side
+accumulators like the reference's (they consume already-computed
+per-batch values).  ChunkEvaluator is a documented non-goal
+(chunk-scheme parsing; see fluid.contrib chunk_eval)."""
+import numpy as np
+
+from ..metric import Precision, Recall, Auc   # noqa: F401
+
+__all__ = ['MetricBase', 'CompositeMetric', 'Precision', 'Recall',
+           'Accuracy', 'ChunkEvaluator', 'EditDistance',
+           'DetectionMAP', 'Auc']
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__
+
+    def get_config(self):
+        return {'name': self._name}
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class Accuracy(MetricBase):
+    """VALUE-streaming accuracy (reference fluid/metrics.py::Accuracy):
+    update(value, weight) accumulates pre-computed batch accuracies —
+    unlike paddle.metric.Accuracy, which consumes predictions."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight=1):
+        if weight < 0:
+            raise ValueError('weight must be nonnegative')
+        self.value += float(np.asarray(value).ravel()[0]) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError('no batches accumulated')
+        return self.value / self.weight
+
+
+class CompositeMetric(MetricBase):
+    """Bundle several metrics updated with the same inputs."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        if not isinstance(metric, MetricBase) and \
+                not hasattr(metric, 'update'):
+            raise ValueError('metric must expose update/eval')
+        self._metrics.append(metric)
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def eval(self):
+        out = []
+        for m in self._metrics:
+            out.append(m.eval() if hasattr(m, 'eval')
+                       else m.accumulate())
+        return out
+
+
+class EditDistance(MetricBase):
+    """Streaming (average edit distance, instance error rate)
+    (reference fluid/metrics.py::EditDistance): update() takes the
+    per-batch distances the edit-distance op computed plus the
+    sequence count."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        d = np.asarray(distances, np.float64).reshape(-1)
+        self.total_distance += float(d.sum())
+        self.seq_num += int(seq_num)
+        self.instance_error += int((d > 0).sum())
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError('no sequences accumulated')
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)
+
+
+class DetectionMAP(MetricBase):
+    """Streaming mean-average-precision over padded detection
+    outputs (reference fluid/metrics.py::DetectionMAP +
+    detection_map_op): update() takes one batch's detections
+    [(label, score, x1, y1, x2, y2)] and ground truths
+    [(label, x1, y1, x2, y2)]; eval() computes mAP with the
+    '11point' or 'integral' rule."""
+
+    def __init__(self, name=None, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version='integral'):
+        super().__init__(name)
+        if ap_version not in ('integral', '11point'):
+            raise ValueError(f'unknown ap_version {ap_version!r}')
+        self._thr = float(overlap_threshold)
+        self._ap = ap_version
+        self.reset()
+
+    def reset(self):
+        self._dets = []     # (label, score, box, image_id)
+        self._gts = []      # (label, box, image_id)
+        self._img = 0
+
+    @staticmethod
+    def _iou(a, b):
+        x1 = max(a[0], b[0])
+        y1 = max(a[1], b[1])
+        x2 = min(a[2], b[2])
+        y2 = min(a[3], b[3])
+        iw, ih = max(x2 - x1, 0.0), max(y2 - y1, 0.0)
+        inter = iw * ih
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def update(self, detections, gts):
+        """One image's detections [[label, score, 4 coords]] and
+        ground truths [[label, 4 coords]] (padded rows with label < 0
+        are skipped)."""
+        img = self._img
+        for d in np.asarray(detections, np.float64).reshape(-1, 6):
+            if d[0] >= 0:
+                self._dets.append((int(d[0]), float(d[1]),
+                                   tuple(d[2:6]), img))
+        for g in np.asarray(gts, np.float64).reshape(-1, 5):
+            if g[0] >= 0:
+                self._gts.append((int(g[0]), tuple(g[1:5]), img))
+        self._img += 1
+
+    def eval(self):
+        classes = sorted({g[0] for g in self._gts})
+        if not classes:
+            raise ValueError('no ground truths accumulated')
+        aps = []
+        for c in classes:
+            gts_c = [(g[2], g[1]) for g in self._gts if g[0] == c]
+            npos = len(gts_c)
+            dets_c = sorted((d for d in self._dets if d[0] == c),
+                            key=lambda d: -d[1])
+            matched = set()
+            tps, fps = [], []
+            for _, score, box, img in dets_c:
+                best, best_g = 0.0, None
+                # VOC protocol: the detection is judged against its
+                # MAX-IoU gt (matched or not) — a duplicate of an
+                # already-claimed gt is a false positive, it may not
+                # steal the next-best gt
+                for gi, (gimg, gbox) in enumerate(gts_c):
+                    if gimg != img:
+                        continue
+                    iou = self._iou(box, gbox)
+                    if iou > best:
+                        best, best_g = iou, gi
+                if best >= self._thr and best_g is not None \
+                        and best_g not in matched:
+                    matched.add(best_g)
+                    tps.append(1)
+                    fps.append(0)
+                else:
+                    tps.append(0)
+                    fps.append(1)
+            tp = np.cumsum(tps) if tps else np.zeros(0)
+            fp = np.cumsum(fps) if fps else np.zeros(0)
+            rec = tp / max(npos, 1)
+            prec = tp / np.maximum(tp + fp, 1e-12)
+            if self._ap == '11point':
+                ap = 0.0
+                for t in np.linspace(0, 1, 11):
+                    p = prec[rec >= t].max() if (rec >= t).any() \
+                        else 0.0
+                    ap += p / 11.0
+            else:
+                ap = 0.0
+                prev_r = 0.0
+                for r, p in zip(rec, prec):
+                    ap += (r - prev_r) * p
+                    prev_r = r
+            aps.append(ap)
+        return float(np.mean(aps))
+
+
+class ChunkEvaluator(MetricBase):
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            'ChunkEvaluator is a documented non-goal (chunk-scheme '
+            'parsing, see fluid.contrib chunk_eval): compute chunk F1 '
+            'from crf_decoding output host-side')

@@ -43,3 +43,267 @@ SGD = _opt.SGD
 Momentum = _opt.Momentum
 Adam = _opt.Adam
 AdamW = _opt.AdamW
+
+
+# bare legacy names (the reference exports both spellings)
+Adagrad = _legacy(_opt.Adagrad)
+Adamax = _legacy(_opt.Adamax)
+Adadelta = _legacy(_opt.Adadelta)
+def LarsMomentum(learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameter_list=None, parameters=None,
+                 regularization=None, grad_clip=None, name=None):
+    wd = lars_weight_decay
+    if regularization is not None:
+        # the reference folds the L2 regularizer into the lars decay
+        wd = getattr(regularization, '_coeff', regularization)
+    return _opt.Lars(learning_rate=learning_rate, momentum=momentum,
+                     lars_coeff=lars_coeff, lars_weight_decay=wd,
+                     parameters=parameters or parameter_list,
+                     grad_clip=grad_clip)
+
+
+LarsMomentumOptimizer = LarsMomentum
+
+
+def _incubate_alias(name):
+    def make(*args, **kwargs):
+        from ..incubate import optimizer as _iopt
+        return getattr(_iopt, name)(*args, **kwargs)
+    make.__name__ = name
+    return make
+
+
+ModelAverage = _incubate_alias('ModelAverage')
+LookaheadOptimizer = _incubate_alias('LookAhead')
+
+
+class DecayedAdagrad(_opt.Adagrad):
+    """Adagrad whose accumulator decays (reference
+    DecayedAdagradOptimizer): acc = decay*acc + (1-decay)*g^2."""
+
+    def __init__(self, learning_rate=0.001, decay=0.95, epsilon=1e-6,
+                 parameter_list=None, parameters=None,
+                 regularization=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(
+            learning_rate=learning_rate, epsilon=epsilon,
+            parameters=parameters or parameter_list,
+            weight_decay=weight_decay if weight_decay is not None
+            else regularization, grad_clip=grad_clip)
+        self._decay = float(decay)
+
+    def _rule(self, p, g, state, lr, t):
+        import jax.numpy as jnp
+        acc = state['moment']
+        acc = self._decay * acc + (1.0 - self._decay) * g * g
+        new_p = p - lr * g / (jnp.sqrt(acc) + self._epsilon)
+        return new_p, {'moment': acc}
+
+
+DecayedAdagradOptimizer = DecayedAdagrad
+
+
+class Ftrl(_opt.Optimizer):
+    """FTRL-proximal (reference FtrlOptimizer / ftrl_op): the
+    squared-gradient accumulator plus the linear term with L1/L2
+    shrinkage."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0,
+                 lr_power=-0.5, parameter_list=None, parameters=None,
+                 regularization=None, grad_clip=None, name=None):
+        super().__init__(learning_rate=learning_rate,
+                         parameters=parameters or parameter_list,
+                         weight_decay=regularization,
+                         grad_clip=grad_clip)
+        self._l1 = float(l1)
+        self._l2 = float(l2)
+        self._lr_power = float(lr_power)
+
+    def _create_state(self, p_value):
+        import jax.numpy as jnp
+        return {'squared': jnp.zeros_like(p_value),
+                'linear': jnp.zeros_like(p_value)}
+
+    def _rule(self, p, g, state, lr, t):
+        import jax.numpy as jnp
+        n, z = state['squared'], state['linear']
+        new_n = n + g * g
+        sigma = (jnp.power(new_n, -self._lr_power)
+                 - jnp.power(jnp.maximum(n, 1e-38),
+                             -self._lr_power)) / lr
+        # first step: n was 0 -> sigma reduces to n_new^{-power}/lr
+        sigma = jnp.where(n > 0, sigma,
+                          jnp.power(new_n, -self._lr_power) / lr)
+        new_z = z + g - sigma * p
+        pre = jnp.clip(new_z, -self._l1, self._l1) - new_z
+        denom = (jnp.power(new_n, -self._lr_power) / lr) + 2 * self._l2
+        new_p = jnp.where(jnp.abs(new_z) > self._l1,
+                          pre / denom, jnp.zeros_like(p))
+        return new_p, {'squared': new_n, 'linear': new_z}
+
+
+FtrlOptimizer = Ftrl
+
+
+class Dpsgd(_opt.SGD):
+    """Differentially-private SGD (reference DpsgdOptimizer /
+    dpsgd_op): per-update clip to `clip` then Gaussian noise scaled
+    by sigma = sqrt(2 log(1.25/delta)) / batch_size."""
+
+    def __init__(self, learning_rate=0.001, clip=0.9,
+                 batch_size=0.999, sigma=1.0, parameter_list=None,
+                 parameters=None, seed=0, name=None):
+        super().__init__(learning_rate=learning_rate,
+                         parameters=parameters or parameter_list)
+        self._dp_clip = float(clip)
+        self._dp_batch = float(batch_size)
+        self._dp_sigma = float(sigma)
+        self._dp_seed = seed
+
+    def _rule(self, p, g, state, lr, t):
+        import jax
+        import jax.numpy as jnp
+        import zlib
+        norm = jnp.sqrt(jnp.maximum(jnp.sum(g * g), 1e-20))
+        g = g * jnp.minimum(1.0, self._dp_clip / norm)
+        # fold in a per-parameter identity: same-shaped params must
+        # NOT share a noise draw (correlated noise breaks the DP
+        # accounting)
+        pid = zlib.crc32(str(self._ctx_param_name).encode())
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(self._dp_seed),
+                               jnp.asarray(t, jnp.int32)),
+            pid & 0x7fffffff)
+        noise = jax.random.normal(key, g.shape, g.dtype) \
+            * (self._dp_sigma * self._dp_clip / self._dp_batch)
+        return p - lr * (g + noise), state
+
+
+DpsgdOptimizer = Dpsgd
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference fluid/optimizer.py
+    ExponentialMovingAverage): update() refreshes the shadow values,
+    apply() swaps them in (a context manager restores on exit)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._thres_steps = thres_steps
+        self._shadow = {}
+        self._backup = {}
+        self._step = 0
+        self._params = None
+
+    def _ensure(self, params):
+        import numpy as np
+        params = list(params)
+        if not self._params and params:
+            self._params = params
+            for i, p in enumerate(self._params):
+                self._shadow[i] = np.asarray(p.value).copy()
+
+    def update(self, parameters=None):
+        """Refresh the shadow from the live parameters.  Call after
+        each optimizer step (the reference hooks the train program)."""
+        import numpy as np
+        if parameters is not None:
+            self._ensure(parameters)
+        if not self._params:
+            raise ValueError(
+                'ExponentialMovingAverage has no parameters: pass '
+                'parameters= to update() (or _ensure) first')
+        self._step += 1
+        if self._thres_steps is not None:
+            # the reference ramps the decay only when thres_steps is
+            # given; otherwise the configured decay applies as-is
+            d = min(self._decay,
+                    (1.0 + self._step) / (10.0 + self._step))
+        else:
+            d = self._decay
+        for i, p in enumerate(self._params):
+            self._shadow[i] = (d * self._shadow[i]
+                               + (1.0 - d) * np.asarray(p.value))
+
+    def apply(self, executor=None, need_restore=True):
+        """Context manager: params take their EMA values inside."""
+        import contextlib
+        if not self._params:
+            raise ValueError(
+                'ExponentialMovingAverage has no parameters '
+                'registered; call update(parameters=...) first')
+
+        @contextlib.contextmanager
+        def _ctx():
+            import numpy as np
+            import jax.numpy as jnp
+            if not self._params:
+                raise ValueError(
+                    'ExponentialMovingAverage has no parameters '
+                    'registered; call update(parameters=...) first')
+            for i, p in enumerate(self._params):
+                self._backup[i] = np.asarray(p.value).copy()
+                p.set_value(jnp.asarray(self._shadow[i]))
+            try:
+                yield self
+            finally:
+                if need_restore:
+                    self.restore()
+        return _ctx()
+
+    def restore(self, executor=None):
+        import jax.numpy as jnp
+        for i, p in enumerate(self._params):
+            if i in self._backup:
+                p.set_value(jnp.asarray(self._backup[i]))
+        self._backup = {}
+
+
+class PipelineOptimizer:
+    """Reference PipelineOptimizer wraps an optimizer for pipeline
+    sections.  In the TPU-native stack pipelining is a
+    DistributedStrategy flag consumed by ParallelTrainer (the 1F1B
+    engine); this wrapper keeps the API and forwards to the inner
+    optimizer."""
+
+    def __init__(self, optimizer, num_microbatches=1, start_cpu_core_id=0):
+        self._inner = optimizer
+        self.num_microbatches = num_microbatches
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._inner.minimize(loss, startup_program,
+                                    parameter_list, no_grad_set)
+
+
+class RecomputeOptimizer:
+    """Reference RecomputeOptimizer: activation recompute is a
+    strategy flag here (strategy.recompute -> jax.checkpoint in
+    ParallelTrainer); the wrapper keeps API parity."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._inner.minimize(loss, startup_program,
+                                    parameter_list, no_grad_set)
+
+
+__all__ += ['Adagrad', 'Adamax', 'Adadelta', 'LarsMomentum',
+            'LarsMomentumOptimizer', 'ModelAverage',
+            'LookaheadOptimizer', 'DecayedAdagrad',
+            'DecayedAdagradOptimizer', 'Ftrl', 'FtrlOptimizer',
+            'Dpsgd', 'DpsgdOptimizer', 'ExponentialMovingAverage',
+            'PipelineOptimizer', 'RecomputeOptimizer']

@@ -15,3 +15,8 @@ class L2Decay:
 
     def __init__(self, coeff=0.0):
         self._coeff = float(coeff)
+
+
+# legacy 1.x spellings (reference fluid/regularizer.py)
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay

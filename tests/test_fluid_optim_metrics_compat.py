@@ -1,0 +1,312 @@
+"""fluid.optimizer/metrics/dygraph-base/backward/reader long tail.
+
+Reference analogue: fluid/optimizer.py (DecayedAdagrad, Ftrl, Dpsgd,
+ExponentialMovingAverage, Pipeline/Recompute wrappers),
+fluid/metrics.py, fluid/dygraph/base.py, fluid/backward.py,
+fluid/reader.py — checked against the reference unittests
+(test_ftrl_op, test_decayed_adagrad_op, test_ema, test_metrics).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu import nn
+
+
+def _t(a, dt='float32'):
+    return paddle.to_tensor(np.asarray(a, dt))
+
+
+class TestLegacyOptimizers:
+    def _fit(self, opt_factory, steps=25):
+        paddle.seed(0)
+        lin = nn.Linear(4, 1)
+        opt = opt_factory(lin.parameters())
+        rs = np.random.RandomState(0)
+        x = _t(rs.rand(16, 4))
+        y = _t(rs.rand(16, 1))
+        first = last = None
+        for _ in range(steps):
+            loss = nn.functional.mse_loss(lin(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            last = float(np.asarray(loss.value))
+            first = first if first is not None else last
+        return first, last
+
+    def test_decayed_adagrad_converges(self):
+        f, l = self._fit(lambda p: fluid.optimizer.DecayedAdagrad(
+            learning_rate=0.1, parameters=p))
+        assert l < f
+
+    def test_decayed_adagrad_rule(self):
+        opt = fluid.optimizer.DecayedAdagrad(learning_rate=0.1,
+                                             decay=0.5)
+        import jax.numpy as jnp
+        p = jnp.asarray([1.0])
+        g = jnp.asarray([2.0])
+        new_p, st = opt._rule(p, g, {'moment': jnp.asarray([1.0])},
+                              0.1, 1)
+        # acc = .5*1 + .5*4 = 2.5 ; p - .1*2/(sqrt(2.5)+eps)
+        np.testing.assert_allclose(np.asarray(st['moment']), [2.5])
+        np.testing.assert_allclose(
+            np.asarray(new_p), [1.0 - 0.2 / np.sqrt(2.5)], rtol=1e-4)
+
+    def test_ftrl_converges_and_l1_sparsifies(self):
+        f, l = self._fit(lambda p: fluid.optimizer.Ftrl(
+            learning_rate=0.5, parameters=p))
+        assert l < f
+        # strong l1 drives weights to exact zero
+        paddle.seed(0)
+        lin = nn.Linear(4, 1)
+        opt = fluid.optimizer.Ftrl(learning_rate=0.5, l1=100.0,
+                                   parameters=lin.parameters())
+        x = _t(np.random.RandomState(1).rand(8, 4))
+        for _ in range(5):
+            loss = lin(x).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert (np.asarray(lin.weight.value) == 0).all()
+
+    def test_dpsgd_runs(self):
+        f, l = self._fit(lambda p: fluid.optimizer.Dpsgd(
+            learning_rate=0.05, clip=5.0, batch_size=16.0,
+            sigma=0.01, parameters=p), steps=30)
+        assert np.isfinite(l)
+
+    def test_ema_apply_restore(self):
+        paddle.seed(0)
+        lin = nn.Linear(2, 1)
+        ema = fluid.optimizer.ExponentialMovingAverage(decay=0.0)
+        ema._ensure(lin.parameters())
+        import jax.numpy as jnp
+        w0 = np.asarray(lin.weight.value).copy()
+        lin.weight.set_value(jnp.asarray(w0 + 1.0))
+        ema.update()
+        # decay 0 + ramp: d = min(0, (1+1)/(10+1)) = 0 -> shadow = live
+        with ema.apply():
+            np.testing.assert_allclose(np.asarray(lin.weight.value),
+                                       w0 + 1.0, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(lin.weight.value),
+                                   w0 + 1.0, rtol=1e-6)
+
+    def test_wrappers_forward(self):
+        paddle.seed(0)
+        lin = nn.Linear(2, 1)
+        inner = fluid.optimizer.SGDOptimizer(
+            learning_rate=0.1, parameter_list=lin.parameters())
+        for wrapper in (fluid.optimizer.PipelineOptimizer(inner),
+                        fluid.optimizer.RecomputeOptimizer(inner)):
+            loss = lin(_t(np.ones((2, 2)))).sum()
+            loss.backward()
+            wrapper.step()
+            wrapper.clear_grad()
+
+    def test_bare_legacy_names_exist(self):
+        for n in ('Adagrad', 'Adamax', 'Adadelta', 'LarsMomentum',
+                  'ModelAverage', 'LookaheadOptimizer'):
+            assert hasattr(fluid.optimizer, n), n
+
+
+class TestFluidMetrics:
+    def test_accuracy_streaming(self):
+        m = fluid.metrics.Accuracy()
+        m.update(0.8, weight=10)
+        m.update(0.6, weight=10)
+        np.testing.assert_allclose(m.eval(), 0.7)
+        with pytest.raises(ValueError):
+            m.update(0.5, weight=-1)
+
+    def test_edit_distance(self):
+        m = fluid.metrics.EditDistance()
+        m.update([2.0, 0.0], 2)
+        m.update([1.0], 1)
+        avg, err = m.eval()
+        np.testing.assert_allclose(avg, 1.0)
+        np.testing.assert_allclose(err, 2 / 3)
+
+    def test_detection_map_perfect_and_miss(self):
+        m = fluid.metrics.DetectionMAP(overlap_threshold=0.5)
+        det = [[0, 0.9, 0, 0, 10, 10], [1, 0.8, 20, 20, 30, 30]]
+        gt = [[0, 0, 0, 10, 10], [1, 20, 20, 30, 30]]
+        m.update(det, gt)
+        np.testing.assert_allclose(m.eval(), 1.0)
+        m.reset()
+        # detector misses entirely
+        m.update([[0, 0.9, 50, 50, 60, 60]], [[0, 0, 0, 10, 10]])
+        np.testing.assert_allclose(m.eval(), 0.0)
+
+    def test_composite(self):
+        from paddle_tpu.fluid.metrics import (CompositeMetric,
+                                              Precision, Recall)
+        c = CompositeMetric()
+        c.add_metric(Precision())
+        c.add_metric(Recall())
+        preds = np.array([0.9, 0.2], 'float32')
+        labels = np.array([1, 0], 'int64')
+        c.update(preds, labels)
+        p, r = c.eval()
+        assert p == 1.0 and r == 1.0
+
+    def test_chunk_evaluator_non_goal(self):
+        with pytest.raises(NotImplementedError):
+            fluid.metrics.ChunkEvaluator()
+
+
+class TestDygraphBaseAndBackward:
+    def test_dygraph_grad_alias(self):
+        x = _t([[2.0]])
+        x.stop_gradient = False
+        y = x * x
+        (g,) = fluid.dygraph.grad([y], [x])
+        np.testing.assert_allclose(np.asarray(g.value), [[4.0]])
+
+    def test_enabled_toggles(self):
+        assert fluid.dygraph.enabled()
+        fluid.dygraph.disable_dygraph()
+        try:
+            assert not fluid.dygraph.enabled()
+        finally:
+            fluid.dygraph.enable_dygraph()
+        assert fluid.dygraph.enabled()
+
+    def test_append_backward(self):
+        import paddle_tpu.static as static
+        fluid.dygraph.disable_dygraph()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data('x', [4, 2], 'float32')
+                y = fluid.layers.fc(x, 1)
+                loss = fluid.layers.reduce_mean(y)
+                pairs = fluid.append_backward(loss)
+            assert pairs
+            exe = static.Executor()
+            exe.run(static.default_startup_program())
+            outs = exe.run(
+                prog, feed={'x': np.ones((4, 2), 'float32')},
+                fetch_list=[pairs[0][1]])
+            assert np.isfinite(np.asarray(outs[0])).all()
+        finally:
+            fluid.dygraph.enable_dygraph()
+
+    def test_pyreader(self):
+        r = fluid.PyReader(capacity=4)
+
+        def gen():
+            for i in range(3):
+                yield [np.full((1,), i, 'float32')]
+        r.decorate_sample_list_generator(gen)
+        out = list(iter(r))
+        assert len(out) == 3
+        assert hasattr(fluid, 'DataLoader')
+        assert hasattr(fluid, 'default_collate_fn')
+
+
+class TestReviewFixes2:
+    def test_lars_momentum_accepts_regularization(self):
+        from paddle_tpu import nn
+        paddle.seed(0)
+        lin = nn.Linear(2, 1)
+        from paddle_tpu.regularizer import L2Decay
+        opt = fluid.optimizer.LarsMomentum(
+            learning_rate=0.1, parameter_list=lin.parameters(),
+            regularization=L2Decay(1e-4))
+        loss = lin(_t(np.ones((2, 2)))).sum()
+        loss.backward()
+        opt.step()
+
+    def test_dpsgd_noise_differs_per_param(self):
+        from paddle_tpu import nn
+        paddle.seed(0)
+
+        class Two(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a = nn.Linear(3, 3, bias_attr=False)
+                self.b = nn.Linear(3, 3, bias_attr=False)
+
+            def forward(self, x):
+                return self.a(x).sum() + self.b(x).sum()
+
+        m = Two()
+        wa0 = np.asarray(m.a.weight.value).copy()
+        wb0 = np.asarray(m.b.weight.value).copy()
+        opt = fluid.optimizer.Dpsgd(learning_rate=0.1, clip=1.0,
+                                    batch_size=4.0, sigma=5.0,
+                                    parameters=m.parameters())
+        loss = m(_t(np.ones((4, 3))))
+        loss.backward()
+        opt.step()
+        da = np.asarray(m.a.weight.value) - wa0
+        db = np.asarray(m.b.weight.value) - wb0
+        # identical grads but DIFFERENT noise draws per parameter
+        assert not np.allclose(da, db)
+
+    def test_ema_registration_recovers(self):
+        from paddle_tpu import nn
+        paddle.seed(0)
+        lin = nn.Linear(2, 1)
+        ema = fluid.optimizer.ExponentialMovingAverage(0.5)
+        with pytest.raises(ValueError):
+            ema.update()
+        ema.update(parameters=lin.parameters())   # registers + steps
+        assert ema._params
+        with pytest.raises(ValueError):
+            fluid.optimizer.ExponentialMovingAverage(0.5).apply()
+
+    def test_ema_constant_decay_without_thres_steps(self):
+        from paddle_tpu import nn
+        import jax.numpy as jnp
+        paddle.seed(0)
+        lin = nn.Linear(1, 1, bias_attr=False)
+        lin.weight.set_value(jnp.asarray([[0.0]]))
+        ema = fluid.optimizer.ExponentialMovingAverage(decay=0.9)
+        ema._ensure(lin.parameters())
+        lin.weight.set_value(jnp.asarray([[1.0]]))
+        ema.update()
+        # constant decay: shadow = .9*0 + .1*1 (no (1+t)/(10+t) ramp)
+        np.testing.assert_allclose(ema._shadow[0], [[0.1]],
+                                   rtol=1e-6)
+
+    def test_detection_map_duplicate_is_fp(self):
+        m = fluid.metrics.DetectionMAP(overlap_threshold=0.5)
+        # two detections on gt A (second is a duplicate), gt B missed
+        det = [[0, 0.9, 0, 0, 10, 10], [0, 0.8, 0, 0, 10, 10]]
+        gt = [[0, 0, 0, 10, 10], [0, 0, 0.5, 10, 10.5]]
+        m.update(det, gt)
+        # TP=1 of 2 gts; duplicate counts FP even though gt B
+        # overlaps it above threshold
+        ap = m.eval()
+        assert ap < 1.0
+
+    def test_pyreader_sample_generator_batches(self):
+        r = fluid.PyReader()
+
+        def gen():
+            for i in range(5):
+                yield [np.full((2,), i, 'float32')]
+        r.decorate_sample_generator(gen, batch_size=2,
+                                    drop_last=True)
+        out = list(iter(r))
+        assert len(out) == 2
+        assert out[0][0].shape == (2, 2)
+
+    def test_append_backward_uses_loss_program(self):
+        import paddle_tpu.static as static
+        fluid.dygraph.disable_dygraph()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data('x', [2, 2], 'float32')
+                y = fluid.layers.fc(x, 1)
+                loss = fluid.layers.reduce_mean(y)
+            # called OUTSIDE the guard: must use loss's own program
+            pairs = fluid.append_backward(loss)
+            assert pairs
+            assert pairs[0][0] in prog.all_parameters()
+        finally:
+            fluid.dygraph.enable_dygraph()
