@@ -102,24 +102,8 @@ class PyReader:
         pass
 
 
-def append_backward(loss, parameter_list=None, no_grad_set=None,
-                    callbacks=None, checkpoints=None):
-    """Reference fluid/backward.py:1363: append grad ops to the
-    Program.  The TPU-native Program lowers fwd+grad+optim to ONE
-    XLA module at Executor.run, so this only RECORDS the request —
-    it returns (param, grad_var) pairs whose grads materialize when
-    the program runs (the static gradients machinery)."""
-    from ..static.program import gradients as _gradients
-    prog = getattr(loss, 'program', None)
-    if prog is None and hasattr(loss, 'block'):
-        prog = loss.block.program
-    params = parameter_list
-    if params is None:
-        from ..static.program import default_main_program
-        p = prog or default_main_program()
-        params = p.trainable_parameters(no_grad_set)
-    grads = _gradients([loss], params)
-    return list(zip(params, grads))
-
-
+# fluid.backward / fluid.append_backward: the static machinery
+# already implements the full contract (no_grad_set included)
+from ..static.program import append_backward  # noqa: F401,E402
+from . import backward  # noqa: F401,E402
 from . import metrics  # noqa: F401,E402

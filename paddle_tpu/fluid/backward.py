@@ -1,0 +1,5 @@
+"""fluid.backward — the canonical 1.x spelling
+(reference fluid/backward.py: append_backward:1363, gradients)."""
+from ..static.program import append_backward, gradients  # noqa: F401
+
+__all__ = ['append_backward', 'gradients']

@@ -129,6 +129,12 @@ class DetectionMAP(MetricBase):
         super().__init__(name)
         if ap_version not in ('integral', '11point'):
             raise ValueError(f'unknown ap_version {ap_version!r}')
+        if not evaluate_difficult:
+            raise NotImplementedError(
+                'evaluate_difficult=False needs a difficult flag in '
+                'the gt rows, which the padded 5-column format does '
+                'not carry — filter difficult gts before update() '
+                'instead')
         self._thr = float(overlap_threshold)
         self._ap = ap_version
         self.reset()

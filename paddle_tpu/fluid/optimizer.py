@@ -97,7 +97,8 @@ class DecayedAdagrad(_opt.Adagrad):
         import jax.numpy as jnp
         acc = state['moment']
         acc = self._decay * acc + (1.0 - self._decay) * g * g
-        new_p = p - lr * g / (jnp.sqrt(acc) + self._epsilon)
+        new_p = p - (lr * g / (jnp.sqrt(acc)
+                               + self._epsilon)).astype(p.dtype)
         return new_p, {'moment': acc}
 
 
@@ -139,7 +140,8 @@ class Ftrl(_opt.Optimizer):
         pre = jnp.clip(new_z, -self._l1, self._l1) - new_z
         denom = (jnp.power(new_n, -self._lr_power) / lr) + 2 * self._l2
         new_p = jnp.where(jnp.abs(new_z) > self._l1,
-                          pre / denom, jnp.zeros_like(p))
+                          pre / denom,
+                          jnp.zeros_like(p)).astype(p.dtype)
         return new_p, {'squared': new_n, 'linear': new_z}
 
 
@@ -177,7 +179,7 @@ class Dpsgd(_opt.SGD):
             pid & 0x7fffffff)
         noise = jax.random.normal(key, g.shape, g.dtype) \
             * (self._dp_sigma * self._dp_clip / self._dp_batch)
-        return p - lr * (g + noise), state
+        return (p - lr * (g + noise)).astype(p.dtype), state
 
 
 DpsgdOptimizer = Dpsgd

@@ -134,10 +134,17 @@ class TestDygraphAdapters:
 
 
 class TestFluidIo:
+    @pytest.fixture(autouse=True)
+    def _static_mode(self):
+        # static mode must NOT leak into later tests (it flips
+        # split()'s eager cache into per-call fresh weights and
+        # fluid.dygraph.enabled() to False)
+        paddle.enable_static()
+        yield
+        paddle.disable_static()
+
     def _prog(self):
         import paddle_tpu.static as static
-        paddle.enable_static() if hasattr(paddle, 'enable_static') \
-            else None
         prog = static.Program()
         with static.program_guard(prog):
             x = static.data('x', [None, 4], 'float32')

@@ -310,3 +310,41 @@ class TestReviewFixes2:
             assert pairs[0][0] in prog.all_parameters()
         finally:
             fluid.dygraph.enable_dygraph()
+
+
+class TestReviewFixes3:
+    def test_fluid_backward_module(self):
+        assert hasattr(fluid.backward, 'append_backward')
+        assert hasattr(fluid.backward, 'gradients')
+
+    def test_append_backward_respects_no_grad_set(self):
+        import paddle_tpu.static as static
+        fluid.dygraph.disable_dygraph()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data('x', [2, 2], 'float32')
+                y = fluid.layers.fc(x, 1)
+                loss = fluid.layers.reduce_mean(y)
+                params = prog.all_parameters()
+                pairs = fluid.append_backward(
+                    loss, no_grad_set=[params[0]])
+            assert all(p is not params[0] for p, _ in pairs)
+        finally:
+            fluid.dygraph.enable_dygraph()
+
+    def test_legacy_rules_preserve_dtype(self):
+        import jax.numpy as jnp
+        for opt in (fluid.optimizer.DecayedAdagrad(0.1),
+                    fluid.optimizer.Ftrl(0.1),
+                    fluid.optimizer.Dpsgd(0.1)):
+            opt._ctx_param_name = 'w'
+            p = jnp.asarray([1.0], jnp.bfloat16)
+            g = jnp.asarray([0.5], jnp.bfloat16)
+            st = opt._create_state(p)
+            new_p, _ = opt._rule(p, g, st, jnp.asarray(0.1), 1)
+            assert new_p.dtype == jnp.bfloat16
+
+    def test_detection_map_difficult_raises(self):
+        with pytest.raises(NotImplementedError):
+            fluid.metrics.DetectionMAP(evaluate_difficult=False)
