@@ -21,9 +21,18 @@ it; live memory is [N, Vc].  The backward recomputes each chunk's
 logits (flash-style rematerialisation — FLOPs are cheap, HBM is not)
 and emits dx and dw chunkwise.
 
+ONE recurrence serves both heads: the single-device op is the
+column-offset-0 case of the core; the tensor-parallel op
+(`fused_linear_cross_entropy_tp`, for shard_map contexts like the
+pipeline engine) runs the same core on its vocab shard at offset
+r*Vs and composes the (max, sumexp, label-logit) triples across the
+axis with one pmax + two psums — the ParallelCrossEntropy contract,
+fused with the matmul.
+
 Exact to the unfused computation up to f32 associativity: the
 correctness tests assert ≤1e-5 against log_softmax on the
-materialized logits.
+materialized logits, including shard-boundary and ragged-chunk
+labels.
 """
 import functools
 
@@ -31,7 +40,21 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ['fused_linear_cross_entropy']
+__all__ = ['fused_linear_cross_entropy',
+           'fused_linear_cross_entropy_tp']
+
+
+def _varying(v, axis):
+    """Mark a replicated value as axis-varying for shard_map's
+    manual-axes check (pvary was renamed to pcast)."""
+    if axis is None:
+        return v
+    if hasattr(lax, 'pcast'):
+        try:
+            return lax.pcast(v, to='varying')
+        except TypeError:
+            pass
+    return lax.pvary(v, axis)
 
 
 def _chunk_w(w, num_chunks):
@@ -43,26 +66,33 @@ def _chunk_w(w, num_chunks):
     return w.reshape(H, num_chunks, Vc).transpose(1, 0, 2), Vc, pad
 
 
-def _fwd_scan(x, w, labels, num_chunks):
-    N, H = x.shape
+def _scan_core(x, w, labels, num_chunks, col0, axis=None):
+    """Online logsumexp over w's columns (one shard's slice of the
+    full vocab, starting at GLOBAL column col0).  Returns (m, s, zl):
+    running max, sumexp (relative to m), and this shard's label-logit
+    contribution (zero when the label belongs to another shard)."""
+    N = x.shape[0]
     V = w.shape[1]
-    wc, Vc, pad = _chunk_w(w, num_chunks)
-    xf = x
+    wc, Vc, _ = _chunk_w(w, num_chunks)
+    # this shard owns GLOBAL ids [col0, col0 + V)
+    local = labels - col0
+    owned = (local >= 0) & (local < V)
 
     def body(carry, args):
         m, s, zl = carry
         w_c, c = args
-        z = jnp.dot(xf, w_c,
+        z = jnp.dot(x, w_c,
                     preferred_element_type=jnp.float32)   # [N, Vc]
-        col0 = c * Vc
-        valid = (col0 + jnp.arange(Vc)) < V
+        # padded chunk columns (V % num_chunks != 0) must not leak
+        # zeros into the logsumexp — and a label owned by the NEXT
+        # shard must not gather from this shard's pad cells
+        valid = (c * Vc + jnp.arange(Vc)) < V
         z = jnp.where(valid[None, :], z, -jnp.inf)
         new_m = jnp.maximum(m, jnp.max(z, axis=-1))
         s = s * jnp.exp(m - new_m) \
             + jnp.sum(jnp.exp(z - new_m[:, None]), axis=-1)
-        # label logit if it lives in this chunk
-        loc = labels - col0
-        mine = (loc >= 0) & (loc < Vc)
+        loc = local - c * Vc
+        mine = owned & (loc >= 0) & (loc < Vc)
         zl = zl + jnp.where(
             mine,
             jnp.take_along_axis(
@@ -73,10 +103,51 @@ def _fwd_scan(x, w, labels, num_chunks):
     init = (jnp.full((N,), -jnp.inf, jnp.float32),
             jnp.zeros((N,), jnp.float32),
             jnp.zeros((N,), jnp.float32))
+    init = jax.tree_util.tree_map(lambda v: _varying(v, axis), init)
     (m, s, zl), _ = lax.scan(
         body, init, (wc, jnp.arange(num_chunks)))
-    lse = jnp.log(s) + m
-    return lse - zl, lse
+    return m, s, zl
+
+
+def _bwd_core(x, w, labels, lse, g, num_chunks, col0, axis=None):
+    """Chunked recompute backward for one shard's columns: returns
+    (dx_partial, dw).  dx_partial covers only this shard's columns —
+    the tp caller psums it over the axis."""
+    N = x.shape[0]
+    V = w.shape[1]
+    wc, Vc, pad = _chunk_w(w, num_chunks)
+    local = labels - col0
+    owned = (local >= 0) & (local < V)
+
+    def body(dx, args):
+        w_c, c = args
+        z = jnp.dot(x, w_c, preferred_element_type=jnp.float32)
+        valid = (c * Vc + jnp.arange(Vc)) < V
+        p = jnp.where(valid[None, :],
+                      jnp.exp(z - lse[:, None]), 0.0)      # [N, Vc]
+        loc = local - c * Vc
+        mine = owned & (loc >= 0) & (loc < Vc)
+        p = p.at[jnp.arange(N), jnp.clip(loc, 0, Vc - 1)].add(
+            jnp.where(mine, -1.0, 0.0))
+        d = p * g[:, None]                                  # [N, Vc]
+        dw_c = jnp.dot(x.astype(jnp.float32).T, d,
+                       preferred_element_type=jnp.float32)
+        dx = dx + jnp.dot(d, w_c.astype(jnp.float32).T,
+                          preferred_element_type=jnp.float32)
+        return dx, dw_c
+
+    dx0 = _varying(jnp.zeros((N, x.shape[1]), jnp.float32), axis)
+    dx, dw_chunks = lax.scan(
+        body, dx0, (wc, jnp.arange(num_chunks)))
+    dw = dw_chunks.transpose(1, 0, 2).reshape(x.shape[1], -1)
+    if pad:
+        dw = dw[:, :V]
+    return dx, dw
+
+
+def _label_ct(labels):
+    import numpy as np
+    return np.zeros(np.shape(labels), jax.dtypes.float0)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -89,50 +160,70 @@ def fused_linear_cross_entropy(x, w, labels, num_chunks=8):
     `num_chunks` (static) splits V; live memory is [N, ceil(V/num_
     chunks)].
     """
-    loss, _ = _fwd_scan(x, w, labels, num_chunks)
-    return loss
+    m, s, zl = _scan_core(x, w, labels, num_chunks, 0)
+    return (jnp.log(s) + m) - zl
 
 
 def _fwd(x, w, labels, num_chunks):
-    loss, lse = _fwd_scan(x, w, labels, num_chunks)
-    return loss, (x, w, labels, lse)
+    m, s, zl = _scan_core(x, w, labels, num_chunks, 0)
+    lse = jnp.log(s) + m
+    return lse - zl, (x, w, labels, lse)
 
 
 def _bwd(num_chunks, res, g):
     x, w, labels, lse = res
-    N, H = x.shape
-    V = w.shape[1]
-    wc, Vc, pad = _chunk_w(w, num_chunks)
-
-    def body(dx, args):
-        w_c, c = args
-        z = jnp.dot(x, w_c, preferred_element_type=jnp.float32)
-        col0 = c * Vc
-        valid = (col0 + jnp.arange(Vc)) < V
-        p = jnp.where(valid[None, :],
-                      jnp.exp(z - lse[:, None]), 0.0)      # [N, Vc]
-        loc = labels - col0
-        mine = (loc >= 0) & (loc < Vc)
-        onehot_col = jnp.clip(loc, 0, Vc - 1)
-        p = p.at[jnp.arange(N), onehot_col].add(
-            jnp.where(mine, -1.0, 0.0))
-        d = p * g[:, None]                                  # [N, Vc]
-        # dW chunk: [H, Vc]; dx accumulates over chunks
-        dw_c = jnp.dot(x.astype(jnp.float32).T, d,
-                       preferred_element_type=jnp.float32)
-        dx = dx + jnp.dot(d, w_c.astype(jnp.float32).T,
-                          preferred_element_type=jnp.float32)
-        return dx, dw_c
-
-    dx0 = jnp.zeros((N, H), jnp.float32)
-    dx, dw_chunks = lax.scan(
-        body, dx0, (wc, jnp.arange(num_chunks)))
-    dw = dw_chunks.transpose(1, 0, 2).reshape(H, -1)
-    if pad:
-        dw = dw[:, :V]
-    import numpy as np
-    ct = np.zeros(np.shape(labels), jax.dtypes.float0)
-    return dx.astype(x.dtype), dw.astype(w.dtype), ct
+    dx, dw = _bwd_core(x, w, labels, lse, g, num_chunks, 0)
+    return dx.astype(x.dtype), dw.astype(w.dtype), _label_ct(labels)
 
 
 fused_linear_cross_entropy.defvjp(_fwd, _bwd)
+
+
+def fused_linear_cross_entropy_tp(x, w_shard, labels, axis='tp',
+                                  num_chunks=4):
+    """Vocab-PARALLEL fused head for shard_map contexts (the pipeline
+    engine, manual tp): each shard holds w_shard [H, V/tp] — the
+    columns [r*Vs, (r+1)*Vs) of the full weight for axis index r.
+
+    x [N, H] replicated over `axis`; labels [N] GLOBAL ids,
+    replicated.  Returns per-example f32 losses [N], replicated.
+    Differentiable: the backward recomputes local chunk logits; dx
+    psums over the axis, dW stays shard-local.
+    """
+    Vs = w_shard.shape[1]
+
+    def _shard_col0():
+        return lax.axis_index(axis) * Vs
+
+    @jax.custom_vjp
+    def _op(xv, wv, yv):
+        loss, _ = _tp_fwd(xv, wv, yv)
+        return loss
+
+    def _tp_fwd(xv, wv, yv):
+        col0 = _shard_col0()
+        m, s, zl = _scan_core(xv, wv, yv, num_chunks, col0,
+                              axis=axis)
+        # compose the shard-local (max, sumexp) pairs globally
+        M = lax.pmax(m, axis)
+        S = lax.psum(s * jnp.exp(m - M), axis)
+        lse = jnp.log(S) + M
+        zl_g = lax.psum(zl, axis)   # the label lives in ONE shard
+        return lse - zl_g, lse
+
+    def _fwd_tp(xv, wv, yv):
+        loss, lse = _tp_fwd(xv, wv, yv)
+        return loss, (xv, wv, yv, lse)
+
+    def _bwd_tp(res, g):
+        xv, wv, yv, lse = res
+        dx, dw = _bwd_core(xv, wv, yv, lse, g, num_chunks,
+                           _shard_col0(), axis=axis)
+        # x is replicated over the axis but each shard saw only its
+        # vocab columns: the full dz @ W^T sums over shards
+        dx = lax.psum(dx, axis)
+        return dx.astype(xv.dtype), dw.astype(wv.dtype), \
+            _label_ct(yv)
+
+    _op.defvjp(_fwd_tp, _bwd_tp)
+    return _op(x, w_shard, labels)
