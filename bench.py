@@ -313,8 +313,11 @@ CONFIGS = {
     'resnet': bench_resnet,
     'bert': bench_bert,
     'gpt': bench_gpt,
-    'gptgen': bench_gptgen,
     'widedeep': bench_widedeep,
+    # gptgen runs LAST: it is the only config that has ever wedged the
+    # dev tunnel mid-run (r4: 900s timeout, tunnel dead afterwards) —
+    # a repeat must not cost the other configs their numbers.
+    'gptgen': bench_gptgen,
 }
 
 UNITS = {
@@ -353,10 +356,16 @@ def _run_isolated(name, smoke, timeout_s):
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        log(f'{name} TIMED OUT after {timeout_s}s')
+    except subprocess.TimeoutExpired as exc:
+        # the child's progress log says where it hung (compile vs iters)
+        tail = (exc.stderr or '')
+        if isinstance(tail, bytes):
+            tail = tail.decode('utf-8', 'replace')
+        log(f'{name} TIMED OUT after {timeout_s}s; child stderr tail: '
+            f'{tail[-400:]}')
         return {'value': None, 'unit': UNITS[name],
-                'error': f'timeout after {timeout_s}s'}
+                'error': f'timeout after {timeout_s}s',
+                'stderr_tail': tail[-400:]}
     for line in reversed(proc.stdout.strip().splitlines()):
         try:
             parsed = json.loads(line)
