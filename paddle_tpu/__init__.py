@@ -71,7 +71,7 @@ from . import inference  # noqa: F401
 from . import onnx  # noqa: F401
 from . import incubate  # noqa: F401
 from .hapi import Model, summary, flops  # noqa: F401
-from .hapi import callbacks  # noqa: F401
+from . import callbacks  # noqa: F401
 
 __all__ = ['Tensor', 'Parameter', 'no_grad', 'enable_grad', 'seed',
            'set_device', 'get_device', 'save', 'load', 'enable_static',

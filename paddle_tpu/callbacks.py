@@ -1,0 +1,11 @@
+"""`paddle.callbacks` namespace (reference: python/paddle/callbacks.py,
+re-exporting hapi/callbacks.py).  The implementations live in
+paddle_tpu/hapi/callbacks.py; this module is the stable public path.
+"""
+from .hapi.callbacks import (  # noqa: F401
+    Callback, ProgBarLogger, ModelCheckpoint, VisualDL, LRScheduler,
+    EarlyStopping, ReduceLROnPlateau,
+)
+
+__all__ = ['Callback', 'ProgBarLogger', 'ModelCheckpoint', 'VisualDL',
+           'LRScheduler', 'EarlyStopping', 'ReduceLROnPlateau']

@@ -49,3 +49,4 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
 # attribute stable — a function of the same name would be shadowed by
 # the submodule import.  Programmatic entry: launch.launch_main(argv).
 from . import launch  # noqa: F401,E402
+from . import utils  # noqa: F401,E402

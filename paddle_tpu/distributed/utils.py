@@ -1,0 +1,349 @@
+"""Launch topology utilities (`paddle.distributed.utils`).
+
+Reference: /root/reference/python/paddle/distributed/utils.py (Cluster/
+Pod/Trainer containers, get_cluster, start/watch/terminate local
+trainers).  TPU-native adaptation: a "device" is a TPU chip index, the
+per-trainer env pins `TPU_VISIBLE_DEVICES` (the reference pins
+`FLAGS_selected_gpus`), and process supervision is shared with the
+elastic launcher (`distributed/elastic.py`) instead of a bespoke loop.
+The rendezvous fabric is jax.distributed — endpoints here exist for
+API compatibility and env wiring, not for an RPC mesh of our own.
+"""
+import logging
+import os
+import socket
+import subprocess
+import sys
+
+from . import elastic as _elastic
+
+__all__ = [
+    'get_host_name_ip', 'Trainer', 'get_cluster', 'start_local_trainers',
+    'watch_local_trainers', 'find_free_ports', 'JobServer', 'Cluster',
+    'Pod', 'Hdfs', 'add_arguments', 'terminate_local_procs',
+    'TrainerProc', 'get_logger', 'pull_worker_log',
+]
+
+logger = logging.getLogger('paddle_tpu.distributed')
+
+
+def get_logger(log_level=20, name='root'):
+    """Reference utils.py:303 — module logger with a stream handler."""
+    lg = logging.getLogger(name)
+    lg.setLevel(log_level)
+    if not lg.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            '%(asctime)s-%(levelname)s: %(message)s'))
+        lg.addHandler(h)
+    return lg
+
+
+class Hdfs:
+    """Checkpoint-store coordinates (reference utils.py:117).  Kept as
+    a plain record; actual HDFS IO is a documented non-goal (SURVEY) —
+    checkpoints go through orbax/local paths."""
+
+    def __init__(self):
+        self.hdfs_ugi = None
+        self.hdfs_name = None
+        self.hdfs_path = None
+
+    def is_valid(self):
+        return None not in (self.hdfs_ugi, self.hdfs_name, self.hdfs_path)
+
+    def __eq__(self, o):
+        return (self.hdfs_ugi, self.hdfs_name, self.hdfs_path) == \
+            (o.hdfs_ugi, o.hdfs_name, o.hdfs_path)
+
+    def __ne__(self, o):
+        return not self == o
+
+    def __str__(self):
+        return (f'hdfs_ugi:{self.hdfs_ugi} hdfs_name:{self.hdfs_name} '
+                f'hdfs_path:{self.hdfs_path}')
+
+
+class JobServer:
+    def __init__(self):
+        self.endpoint = None
+
+    def __eq__(self, o):
+        return self.endpoint == o.endpoint
+
+    def __ne__(self, o):
+        return not self == o
+
+    def __str__(self):
+        return str(self.endpoint)
+
+
+class Trainer:
+    """One worker process: its devices (TPU chip indices), rendezvous
+    endpoint, and global rank."""
+
+    def __init__(self):
+        self.accelerators = []
+        self.endpoint = None
+        self.rank = None
+
+    # the reference field is `gpus`; keep it as an alias so legacy
+    # launch scripts that poke trainer.gpus keep working
+    @property
+    def gpus(self):
+        return self.accelerators
+
+    def __eq__(self, t):
+        return (self.accelerators == t.accelerators
+                and self.endpoint == t.endpoint and self.rank == t.rank)
+
+    def __ne__(self, t):
+        return not self == t
+
+    def __str__(self):
+        return (f'accelerators:{self.accelerators} '
+                f'endpoint:{self.endpoint} rank:{self.rank}')
+
+
+class Pod:
+    """One host: its address, port, and resident trainers."""
+
+    def __init__(self):
+        self.rank = None
+        self.id = None
+        self.addr = None
+        self.port = None
+        self.trainers = []
+        self.accelerators = []
+
+    @property
+    def gpus(self):
+        return self.accelerators
+
+    def __eq__(self, pod):
+        return (self.rank == pod.rank and self.id == pod.id
+                and self.addr == pod.addr and self.port == pod.port
+                and self.trainers == pod.trainers)
+
+    def __ne__(self, pod):
+        return not self == pod
+
+    def get_visible_accelerators(self):
+        if not self.accelerators:
+            raise ValueError(f'pod {self} sees no accelerators')
+        return ','.join(str(g) for g in self.accelerators)
+
+    get_visible_gpus = get_visible_accelerators
+
+    def __str__(self):
+        return (f'rank:{self.rank} id:{self.id} addr:{self.addr} '
+                f'port:{self.port} accelerators:{self.accelerators} '
+                f'trainers:{[str(t) for t in self.trainers]}')
+
+
+class Cluster:
+    """All pods of one job (reference utils.py:141)."""
+
+    def __init__(self, hdfs=None):
+        self.job_server = None
+        self.pods = []
+        self.hdfs = hdfs
+        self.job_stage_flag = None
+
+    def __eq__(self, c):
+        return (self.pods == c.pods
+                and self.job_stage_flag == c.job_stage_flag)
+
+    def __ne__(self, c):
+        return not self == c
+
+    def update_pods(self, cluster):
+        self.pods = list(cluster.pods)
+
+    def trainers_nranks(self):
+        return len(self.trainers_endpoints())
+
+    def pods_nranks(self):
+        return len(self.pods)
+
+    def trainers_endpoints(self):
+        return [t.endpoint for pod in self.pods for t in pod.trainers]
+
+    def pods_endpoints(self):
+        eps = []
+        for pod in self.pods:
+            if pod.addr is None or pod.port is None:
+                raise ValueError(f'{pod.addr}:{pod.port} is not a valid '
+                                 'endpoint')
+            eps.append(f'{pod.addr}:{pod.port}')
+        return eps
+
+    def get_pod_by_id(self, pod_id):
+        for pod in self.pods:
+            if str(pod.id) == str(pod_id):
+                return pod
+        return None
+
+    def __str__(self):
+        return (f'job_server:{self.job_server} '
+                f'pods:{[str(p) for p in self.pods]} '
+                f'job_stage_flag:{self.job_stage_flag} hdfs:{self.hdfs}')
+
+
+def get_cluster(node_ips, node_ip, trainer_endpoints, selected_devices):
+    """Build the Cluster/Pod topology (reference utils.py:316) and
+    return (cluster, current_pod).  `trainer_endpoints` is one endpoint
+    list per node; `selected_devices` the per-node chip indices."""
+    if not isinstance(trainer_endpoints, list):
+        raise TypeError('trainer_endpoints must be a list (one list of '
+                        'endpoints per node)')
+    cluster = Cluster()
+    rank = 0
+    for node_rank, ip in enumerate(node_ips):
+        pod = Pod()
+        pod.rank = node_rank
+        pod.id = node_rank
+        pod.addr = ip
+        pod.accelerators = list(selected_devices)
+        eps = trainer_endpoints[node_rank]
+        if len(eps) < len(selected_devices):
+            raise ValueError(
+                f'node {ip} has {len(eps)} endpoints for '
+                f'{len(selected_devices)} selected devices')
+        for dev, ep in zip(selected_devices, eps):
+            t = Trainer()
+            t.accelerators.append(dev)
+            t.endpoint = ep
+            t.rank = rank
+            rank += 1
+            pod.trainers.append(t)
+        cluster.pods.append(pod)
+    return cluster, cluster.pods[node_ips.index(node_ip)]
+
+
+def get_host_name_ip():
+    try:
+        name = socket.gethostname()
+        return name, socket.gethostbyname(name)
+    except OSError:
+        return None
+
+
+def find_free_ports(num):
+    """Reserve `num` distinct free TCP ports (reference utils.py:396)."""
+    ports = set()
+    socks = []
+    try:
+        for _ in range(num * 4):
+            if len(ports) >= num:
+                break
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.bind(('', 0))
+            p = s.getsockname()[1]
+            if p not in ports:
+                ports.add(p)
+                socks.append(s)   # hold open so the next bind differs
+            else:
+                s.close()
+    finally:
+        for s in socks:
+            s.close()
+    return ports if len(ports) >= num else None
+
+
+def add_arguments(argname, type, default, help, argparser, **kwargs):
+    """argparse helper (reference utils.py:379): booleans accept
+    true/false strings."""
+    bool_t = (lambda v: str(v).lower() == 'true') if type == bool else type
+    argparser.add_argument('--' + argname, default=default, type=bool_t,
+                           help=help + f' Default: %(default)s.', **kwargs)
+
+
+TrainerProc = _elastic.TrainerProc
+
+
+def _trainer_env(cluster, trainer):
+    return {
+        'TPU_VISIBLE_DEVICES': ','.join(
+            str(g) for g in trainer.accelerators),
+        'PADDLE_TRAINER_ID': str(trainer.rank),
+        'PADDLE_CURRENT_ENDPOINT': str(trainer.endpoint),
+        'PADDLE_TRAINERS_NUM': str(cluster.trainers_nranks()),
+        'PADDLE_TRAINER_ENDPOINTS': ','.join(
+            cluster.trainers_endpoints()),
+    }
+
+
+def start_local_trainers(cluster, pod, training_script,
+                         training_script_args, log_dir=None):
+    """Spawn this pod's trainers (reference utils.py:454) with the
+    paddle env-var contract set per trainer."""
+    procs = []
+    for local_rank, t in enumerate(pod.trainers):
+        env = dict(os.environ)
+        env.pop('http_proxy', None)
+        env.pop('https_proxy', None)
+        env.update(_trainer_env(cluster, t))
+        cmd = [sys.executable, '-u', training_script] \
+            + list(training_script_args)
+        tp = TrainerProc()
+        tp.rank = t.rank
+        tp.local_rank = local_rank
+        tp.cmd = cmd
+        tp.env = env
+        fn = None
+        if log_dir is not None:
+            os.makedirs(log_dir, exist_ok=True)
+            fn = open(os.path.join(log_dir, f'workerlog.{local_rank}'),
+                      'ab')
+        tp.log_fn = fn
+        tp.log_offset = fn.tell() if fn else None
+        tp.proc = subprocess.Popen(cmd, env=env, stdout=fn or None,
+                                   stderr=fn or None)
+        procs.append(tp)
+    return procs
+
+
+def pull_worker_log(tp):
+    """Stream a worker's log growth to stdout (reference utils.py:499)."""
+    if not tp.log_fn:
+        return
+    tp.log_fn.flush()
+    with open(tp.log_fn.name, 'rb') as f:
+        f.seek(tp.log_offset or 0)
+        chunk = f.read()
+        tp.log_offset = f.tell()
+    if chunk:
+        sys.stdout.write(chunk.decode('utf-8', 'replace'))
+
+
+def watch_local_trainers(procs, nranks):
+    """One poll pass over the pod's trainers (reference utils.py:514):
+    returns the still-alive list, [] when all exited cleanly, and
+    terminates everything on the first failure."""
+    alive = []
+    failed = []
+    for tp in procs:
+        if tp.log_fn is not None and tp.local_rank == 0:
+            pull_worker_log(tp)
+        ret = tp.proc.poll()
+        if ret is None:
+            alive.append(tp)
+        else:
+            if tp.log_fn is not None and not tp.log_fn.closed:
+                tp.log_fn.close()
+            if ret != 0:
+                failed.append(tp.rank)
+    if failed:
+        terminate_local_procs(procs)
+        raise RuntimeError(
+            f'trainer ranks {failed} exited abnormally '
+            f'({nranks} total); local trainers terminated')
+    return alive
+
+
+def terminate_local_procs(procs, grace=3.0):
+    """Reference utils.py:343 / launch_utils.py:308 — delegate to the
+    elastic launcher's terminate (SIGTERM, grace wait, SIGKILL; it also
+    closes and clears each TrainerProc's log_fn)."""
+    _elastic.terminate_local_procs(procs, grace=grace)

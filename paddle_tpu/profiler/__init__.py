@@ -9,9 +9,36 @@ import time
 import jax
 
 __all__ = ['Profiler', 'start_profiler', 'stop_profiler', 'profiler',
-           'StepTimer', 'RecordEvent']
+           'reset_profiler', 'cuda_profiler', 'StepTimer', 'RecordEvent']
 
 _active_logdir = None
+
+
+def reset_profiler():
+    """Drop profiling state gathered so far (reference:
+    fluid.profiler.reset_profiler).  XLA traces are windowed by
+    start/stop, so there is no cumulative op table to clear — an active
+    trace is aborted and restarted on the same logdir."""
+    global _active_logdir
+    if _active_logdir is not None:
+        logdir = _active_logdir
+        jax.profiler.stop_trace()
+        jax.profiler.start_trace(logdir)
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    """nvprof hook (reference: fluid.profiler.cuda_profiler) — no CUDA
+    on TPU, so this delegates to the XLA trace so legacy scripts still
+    produce a usable (XProf) profile."""
+    import warnings
+    warnings.warn('cuda_profiler has no CUDA meaning on TPU; recording '
+                  'an XLA trace instead (view with tensorboard)')
+    start_profiler()
+    try:
+        yield
+    finally:
+        stop_profiler()
 
 
 def start_profiler(state=None, tracer_option=None,

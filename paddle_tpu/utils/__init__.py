@@ -214,9 +214,10 @@ def try_import(module_name, err_msg=None):
 from . import unique_name  # noqa: E402,F401
 from . import download  # noqa: E402,F401
 from . import cpp_extension  # noqa: E402,F401
+from . import profiler  # noqa: E402,F401
 from ..dataset import image as image_util  # noqa: E402,F401
 from ..profiler import Profiler  # noqa: E402,F401
 
 __all__ += ['deprecated', 'run_check', 'require_version', 'try_import',
-            'unique_name', 'download', 'cpp_extension', 'image_util',
-            'Profiler']
+            'unique_name', 'download', 'cpp_extension', 'profiler',
+            'image_util', 'Profiler']

@@ -15,7 +15,19 @@ import os
 import subprocess
 import tempfile
 
-__all__ = ['CppExtension', 'CUDAExtension', 'load', 'setup']
+__all__ = ['CppExtension', 'CUDAExtension', 'load', 'setup',
+           'get_build_directory']
+
+
+def get_build_directory(verbose=False):
+    """Root directory for JIT-built extensions (reference
+    cpp_extension/extension_utils.py:741); override with
+    PADDLE_EXTENSION_DIR."""
+    root = os.environ.get('PADDLE_EXTENSION_DIR') or os.path.join(
+        tempfile.gettempdir(), 'paddle_tpu_extensions')
+    if verbose:
+        print(f'paddle_tpu extensions build directory: {root}')
+    return root
 
 
 def CppExtension(sources, *args, **kwargs):
@@ -37,8 +49,7 @@ def load(name, sources, extra_cxx_cflags=None, build_directory=None,
     (reference cpp_extension.py::load builds+imports a pybind module;
     here: extern \"C\" symbols over ctypes — zero non-baked deps)."""
     import ctypes
-    build_dir = build_directory or os.path.join(
-        tempfile.gettempdir(), 'paddle_tpu_extensions')
+    build_dir = build_directory or get_build_directory()
     os.makedirs(build_dir, exist_ok=True)
     out = os.path.join(build_dir, f'{name}.so')
     srcs = [os.path.abspath(s) for s in sources]

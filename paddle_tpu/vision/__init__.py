@@ -7,18 +7,9 @@ from . import ops  # noqa: F401
 from .models import *  # noqa: F401,F403
 from .models import __all__ as _models_all
 
-__all__ = ['datasets', 'models', 'transforms', 'ops'] + list(_models_all)
+from . import image  # noqa: F401
+from .image import set_image_backend, get_image_backend, image_load  # noqa: F401
 
-
-def set_image_backend(backend):
-    if backend not in ('pil', 'cv2', 'numpy'):
-        raise ValueError('unsupported backend: {}'.format(backend))
-    global _image_backend
-    _image_backend = backend
-
-
-def get_image_backend():
-    return _image_backend
-
-
-_image_backend = 'numpy'
+__all__ = ['datasets', 'models', 'transforms', 'ops', 'image',
+           'set_image_backend', 'get_image_backend', 'image_load'] \
+    + list(_models_all)

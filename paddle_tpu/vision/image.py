@@ -1,0 +1,66 @@
+"""Image IO backend selection (reference: python/paddle/vision/image.py).
+
+Backends: 'pil' (PIL.Image), 'cv2' (OpenCV BGR ndarray), 'tensor'
+(paddle Tensor, HWC uint8) and 'numpy' (host ndarray — the TPU-native
+default: datasets stage host-side numpy and batch-transfer to HBM).
+"""
+import numpy as np
+
+__all__ = ['set_image_backend', 'get_image_backend', 'image_load']
+
+_image_backend = 'numpy'
+
+
+def set_image_backend(backend):
+    global _image_backend
+    if backend not in ('pil', 'cv2', 'tensor', 'numpy'):
+        raise ValueError(
+            "Expected backend is one of ['pil', 'cv2', 'tensor', "
+            f"'numpy'], but got {backend}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def _read_array(path):
+    # raw .npy dumps are what the synthetic datasets stage in this
+    # egress-less environment — they are not PIL-decodable
+    if str(path).endswith('.npy'):
+        return np.load(path)
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise ImportError(
+            'image_load needs PIL (or a .npy path) for backend '
+            f'{_image_backend!r}; neither is available for {path!r}'
+        ) from e
+    with Image.open(path) as im:
+        return np.asarray(im.convert('RGB'))
+
+
+def image_load(path, backend=None):
+    """Load an image with the selected backend (reference image.py:110)."""
+    backend = backend or _image_backend
+    if backend not in ('pil', 'cv2', 'tensor', 'numpy'):
+        raise ValueError(
+            "Expected backend is one of ['pil', 'cv2', 'tensor', "
+            f"'numpy'], but got {backend}")
+    if backend == 'pil':
+        from PIL import Image
+        return Image.open(path)
+    if backend == 'cv2':
+        try:
+            import cv2
+        except ImportError as e:
+            raise ImportError(
+                'backend "cv2" needs opencv-python, which is not '
+                'installed in this environment; use "pil", "numpy" or '
+                '"tensor"') from e
+        return cv2.imread(str(path))
+    arr = _read_array(path)
+    if backend == 'tensor':
+        from ..tensor import to_tensor
+        return to_tensor(arr)
+    return arr

@@ -1,0 +1,168 @@
+"""Public 2.0 namespace parity: paddle.callbacks, distributed.utils,
+utils.profiler, utils.cpp_extension.get_build_directory, vision.image.
+
+Reference __all__ sources: python/paddle/callbacks.py,
+distributed/utils.py, utils/profiler.py, vision/image.py.
+"""
+import argparse
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_callbacks_namespace():
+    import paddle_tpu.callbacks as cb
+    for n in ['Callback', 'ProgBarLogger', 'ModelCheckpoint', 'VisualDL',
+              'LRScheduler', 'EarlyStopping', 'ReduceLROnPlateau']:
+        assert isinstance(getattr(cb, n), type), n
+    # the module path and the hapi implementation are the same objects
+    from paddle_tpu.hapi.callbacks import Callback
+    assert cb.Callback is Callback
+    assert paddle.callbacks is cb
+
+
+class TestDistributedUtils:
+    def _cluster(self):
+        from paddle_tpu.distributed import utils as du
+        ips = ['10.0.0.1', '10.0.0.2']
+        eps = [['10.0.0.1:6170', '10.0.0.1:6171'],
+               ['10.0.0.2:6170', '10.0.0.2:6171']]
+        return du.get_cluster(ips, '10.0.0.2', eps, [0, 1])
+
+    def test_get_cluster_topology(self):
+        cluster, pod = self._cluster()
+        assert cluster.trainers_nranks() == 4
+        assert cluster.pods_nranks() == 2
+        assert pod.rank == 1 and pod.addr == '10.0.0.2'
+        assert cluster.trainers_endpoints() == [
+            '10.0.0.1:6170', '10.0.0.1:6171',
+            '10.0.0.2:6170', '10.0.0.2:6171']
+        with pytest.raises(ValueError):
+            cluster.pods_endpoints()             # ports were never set
+        # ranks are globally consecutive
+        assert [t.rank for p in cluster.pods for t in p.trainers] == \
+            [0, 1, 2, 3]
+        assert cluster.get_pod_by_id(0).addr == '10.0.0.1'
+        # legacy field alias
+        assert pod.trainers[0].gpus == pod.trainers[0].accelerators
+
+    def test_cluster_equality(self):
+        c1, _ = self._cluster()
+        c2, _ = self._cluster()
+        assert c1 == c2
+        c2.pods[0].trainers[0].rank = 99
+        assert c1 != c2
+
+    def test_find_free_ports_and_hostname(self):
+        from paddle_tpu.distributed import utils as du
+        ports = du.find_free_ports(3)
+        assert ports is not None and len(ports) == 3
+        out = du.get_host_name_ip()
+        if out is not None:          # resolvable host
+            name, ip = out
+            assert isinstance(name, str) and isinstance(ip, str)
+
+    def test_add_arguments_bool(self):
+        from paddle_tpu.distributed import utils as du
+        ap = argparse.ArgumentParser()
+        du.add_arguments('use_amp', bool, False, 'amp flag', ap)
+        assert ap.parse_args(['--use_amp', 'true']).use_amp is True
+        assert ap.parse_args(['--use_amp', 'False']).use_amp is False
+
+    def test_start_watch_terminate_local_trainers(self, tmp_path):
+        from paddle_tpu.distributed import utils as du
+        import sys
+        script = tmp_path / 'worker.py'
+        script.write_text(
+            'import os\n'
+            'print("rank", os.environ["PADDLE_TRAINER_ID"],\n'
+            '      os.environ["PADDLE_TRAINER_ENDPOINTS"])\n')
+        cluster, pod = du.get_cluster(
+            ['127.0.0.1'], '127.0.0.1', [['127.0.0.1:6170']], [0])
+        procs = du.start_local_trainers(
+            cluster, pod, str(script), [], log_dir=str(tmp_path))
+        for _ in range(200):
+            alive = du.watch_local_trainers(procs, cluster.trainers_nranks())
+            if not alive:
+                break
+            import time
+            time.sleep(0.05)
+        assert not alive
+        log = (tmp_path / 'workerlog.0').read_text()
+        assert 'rank 0 127.0.0.1:6170' in log
+        du.terminate_local_procs(procs)
+
+    def test_watch_raises_on_failed_trainer(self, tmp_path):
+        from paddle_tpu.distributed import utils as du
+        script = tmp_path / 'bad.py'
+        script.write_text('raise SystemExit(3)\n')
+        cluster, pod = du.get_cluster(
+            ['127.0.0.1'], '127.0.0.1', [['127.0.0.1:6170']], [0])
+        procs = du.start_local_trainers(cluster, pod, str(script), [])
+        procs[0].proc.wait()
+        with pytest.raises(RuntimeError, match='exited abnormally'):
+            du.watch_local_trainers(procs, 1)
+
+
+def test_utils_profiler_options_and_batch_range():
+    from paddle_tpu.utils import profiler as up
+    opts = up.ProfilerOptions({'batch_range': [2, 4], 'state': 'CPU'})
+    assert opts['state'] == 'CPU'
+    assert opts['profile_path'] is None          # 'none' reads as None
+    with pytest.raises(ValueError):
+        opts['no_such_option']
+    assert opts.with_state('All')['state'] == 'All'
+
+    calls = []
+    # patch the trace backend, not the methods, so the Profiler's own
+    # _tracing bookkeeping (idempotent stop on __exit__) is exercised
+    real_start, real_stop = up.start_profiler, up.stop_profiler
+    up.start_profiler = lambda **k: calls.append('start')
+    up.stop_profiler = lambda **k: calls.append('stop')
+    try:
+        prof = up.Profiler(
+            enabled=True,
+            options=up.ProfilerOptions({'batch_range': [2, 4]}))
+        with prof:
+            for _ in range(5):
+                prof.record_step()
+    finally:
+        up.start_profiler, up.stop_profiler = real_start, real_stop
+    assert calls == ['start', 'stop']             # started at 2, stopped at 4
+    assert up.get_profiler() is not None
+
+
+def test_cpp_extension_get_build_directory(monkeypatch):
+    from paddle_tpu.utils import cpp_extension as ce
+    d = ce.get_build_directory()
+    assert 'paddle_tpu_extensions' in d
+    monkeypatch.setenv('PADDLE_EXTENSION_DIR', '/tmp/override_ext')
+    assert ce.get_build_directory() == '/tmp/override_ext'
+
+
+class TestVisionImage:
+    def test_backend_roundtrip(self):
+        from paddle_tpu.vision import image as vi
+        prev = vi.get_image_backend()
+        try:
+            vi.set_image_backend('tensor')
+            assert vi.get_image_backend() == 'tensor'
+            with pytest.raises(ValueError):
+                vi.set_image_backend('webp')
+        finally:
+            vi.set_image_backend(prev)
+        import paddle_tpu.vision as vision
+        assert vision.get_image_backend is vi.get_image_backend
+
+    def test_image_load_npy_fallback(self, tmp_path):
+        from paddle_tpu.vision import image as vi
+        arr = (np.random.RandomState(0).rand(4, 5, 3) * 255).astype('uint8')
+        p = tmp_path / 'img.npy'
+        np.save(p, arr)
+        out = vi.image_load(str(p), backend='numpy')
+        np.testing.assert_array_equal(out, arr)
+        t = vi.image_load(str(p), backend='tensor')
+        np.testing.assert_array_equal(np.asarray(t.value), arr)
