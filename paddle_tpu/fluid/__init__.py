@@ -21,15 +21,9 @@ from ..core.device import (  # noqa: F401
     is_compiled_with_cuda, is_compiled_with_xpu)
 from ..nn.layer.layers import ParamAttr  # noqa: F401
 from ..core.rng import seed  # noqa: F401
-from .. import regularizer  # noqa: F401
-from ..nn import clip  # noqa: F401
-from ..static.nn import embedding  # noqa: F401
-from ..nn.functional import one_hot as _one_hot
-
-
-def one_hot(input, depth, allow_out_of_range=False):
-    """fluid/input.py::one_hot — num_classes is called depth there."""
-    return _one_hot(input, depth)
+from . import regularizer  # noqa: F401
+from . import clip  # noqa: F401
+from .input import one_hot, embedding  # noqa: F401
 
 from . import layers  # noqa: F401
 from . import dygraph  # noqa: F401
@@ -39,6 +33,45 @@ from . import io  # noqa: F401
 from . import nets  # noqa: F401
 from . import core  # noqa: F401
 from . import contrib  # noqa: F401
+from . import framework  # noqa: F401
+from . import average  # noqa: F401
+from . import data_feeder  # noqa: F401
+from . import data_feed_desc  # noqa: F401
+from . import dataloader  # noqa: F401
+from . import dataset  # noqa: F401
+from . import unique_name  # noqa: F401
+from . import lod_tensor  # noqa: F401
+from . import log_helper  # noqa: F401
+from . import entry_attr  # noqa: F401
+from . import evaluator  # noqa: F401
+from . import profiler  # noqa: F401
+from . import generator  # noqa: F401
+from . import install_check  # noqa: F401
+from . import wrapped_decorator  # noqa: F401
+from . import layer_helper_base  # noqa: F401
+from . import default_scope_funcs  # noqa: F401
+from . import communicator  # noqa: F401
+from . import device_worker  # noqa: F401
+from . import trainer_desc  # noqa: F401
+from . import trainer_factory  # noqa: F401
+from . import transpiler  # noqa: F401
+from . import distributed  # noqa: F401
+from . import input  # noqa: F401
+from .average import WeightedAverage  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
+from .data_feed_desc import DataFeedDesc  # noqa: F401
+from .dataset import DatasetFactory  # noqa: F401
+from .framework import (  # noqa: F401
+    in_dygraph_mode, device_guard, set_flags, get_flags, xpu_places,
+    cuda_pinned_places, require_version)
+from .lod_tensor import (  # noqa: F401
+    create_lod_tensor, create_random_int_lodtensor)
+from .transpiler import (  # noqa: F401
+    DistributeTranspiler, DistributeTranspilerConfig, memory_optimize,
+    release_memory)
+from .generator import Generator  # noqa: F401
+from .clip import (  # noqa: F401
+    GradientClipByValue, GradientClipByNorm, GradientClipByGlobalNorm)
 
 
 def enable_dygraph(place=None):

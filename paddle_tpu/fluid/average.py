@@ -1,0 +1,41 @@
+"""fluid.average (reference: python/paddle/fluid/average.py)."""
+import numpy as np
+
+__all__ = ['WeightedAverage']
+
+
+def _is_number_or_matrix(x):
+    return isinstance(x, (int, float, np.ndarray)) or (
+        hasattr(x, 'value') or hasattr(x, '__float__'))
+
+
+class WeightedAverage:
+    """Running weighted mean of scalars/arrays (reference average.py:40)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.numerator = None
+        self.denominator = None
+
+    def add(self, value, weight):
+        if not _is_number_or_matrix(value):
+            raise ValueError('add(): value must be a number or ndarray')
+        if not isinstance(weight, (int, float)):
+            raise ValueError('add(): weight must be a number')
+        v = np.mean(np.asarray(
+            value.value if hasattr(value, 'value') else value,
+            dtype=np.float64))
+        if self.numerator is None:
+            self.numerator = v * weight
+            self.denominator = float(weight)
+        else:
+            self.numerator += v * weight
+            self.denominator += float(weight)
+
+    def eval(self):
+        if not self.denominator:
+            raise ValueError(
+                'there is no data in WeightedAverage; call add() first')
+        return self.numerator / self.denominator

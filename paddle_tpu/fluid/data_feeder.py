@@ -1,0 +1,71 @@
+"""fluid.data_feeder (reference: python/paddle/fluid/data_feeder.py).
+
+DataFeeder converts minibatch rows (lists/tuples of per-slot samples)
+into the feed dict an Executor.run accepts.  TPU-native: the values
+become numpy arrays batched on the host; device transfer happens once
+inside the compiled program run.
+"""
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ['DataFeeder']
+
+
+def _var_name(v):
+    return v if isinstance(v, str) else getattr(v, 'name', str(v))
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        if not feed_list:
+            raise ValueError('feed_list must name at least one variable')
+        self.feed_names = [_var_name(v) for v in feed_list]
+        self.place = place
+
+    def feed(self, iterable):
+        """Batch rows → {name: ndarray}.  Each row supplies one value
+        per feed variable, in feed_list order."""
+        cols = [[] for _ in self.feed_names]
+        for row in iterable:
+            if len(row) != len(self.feed_names):
+                raise ValueError(
+                    f'row has {len(row)} fields, feeder expects '
+                    f'{len(self.feed_names)}')
+            for c, v in zip(cols, row):
+                c.append(np.asarray(
+                    v.value if isinstance(v, Tensor) else v))
+        return {name: self._stack(c)
+                for name, c in zip(self.feed_names, cols)}
+
+    @staticmethod
+    def _stack(samples):
+        """Batch one slot; ragged samples (the 1.x LoD feed case) are
+        zero-padded to the per-dimension max — the padded-dense
+        redesign of the reference's LoD batch."""
+        shapes = {s.shape for s in samples}
+        if len(shapes) == 1:
+            return np.stack(samples)
+        if len({s.ndim for s in samples}) != 1:
+            raise ValueError('samples in one slot must share a rank, '
+                             f'got shapes {sorted(shapes)}')
+        dims = [max(s.shape[d] for s in samples)
+                for d in range(samples[0].ndim)]
+        out = np.zeros((len(samples), *dims), samples[0].dtype)
+        for i, s in enumerate(samples):
+            out[(i, *map(slice, s.shape))] = s
+        return out
+
+    def feed_parallel(self, iterable, num_places=None):
+        """1.x multi-device feed: one feed dict per place.  Devices are
+        fed by sharding the batch on the dp mesh axis here, so this
+        yields the single batched dict (the sharding constraint does
+        the split)."""
+        yield self.feed(iterable)
+
+    def decorate_reader(self, reader, multi_devices=False,
+                        num_places=None, drop_last=True):
+        def _reader():
+            for batch in reader():
+                yield self.feed(batch)
+        return _reader

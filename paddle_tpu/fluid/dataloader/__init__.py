@@ -1,0 +1,17 @@
+"""fluid.dataloader (reference: python/paddle/fluid/dataloader/) — the
+dataset/sampler/loader implementations live in paddle_tpu/io."""
+from ...io import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    random_split, Subset, BatchSampler, DistributedBatchSampler, Sampler,
+    SequenceSampler, RandomSampler, WeightedRandomSampler, get_worker_info)
+
+from . import dataset  # noqa: F401
+from . import batch_sampler  # noqa: F401
+from . import sampler  # noqa: F401
+from . import worker  # noqa: F401
+from . import dataloader_iter  # noqa: F401
+
+__all__ = ['Dataset', 'IterableDataset', 'TensorDataset', 'ComposeDataset',
+           'ChainDataset', 'random_split', 'Subset', 'BatchSampler',
+           'DistributedBatchSampler', 'Sampler', 'SequenceSampler',
+           'RandomSampler', 'WeightedRandomSampler', 'get_worker_info']

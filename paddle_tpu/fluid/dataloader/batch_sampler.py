@@ -1,0 +1,5 @@
+"""fluid.dataloader.batch_sampler (reference: fluid/dataloader/
+batch_sampler.py)."""
+from ...io import BatchSampler, DistributedBatchSampler  # noqa: F401
+
+__all__ = ['BatchSampler', 'DistributedBatchSampler']

@@ -1,0 +1,5 @@
+"""fluid.dataloader.dataloader_iter (reference: fluid/dataloader/
+dataloader_iter.py)."""
+from ...io import get_worker_info  # noqa: F401
+
+__all__ = ['get_worker_info']

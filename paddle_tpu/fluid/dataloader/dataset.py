@@ -1,0 +1,7 @@
+"""fluid.dataloader.dataset (reference: fluid/dataloader/dataset.py)."""
+from ...io import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    random_split, Subset)
+
+__all__ = ['Dataset', 'IterableDataset', 'TensorDataset', 'ComposeDataset',
+           'ChainDataset', 'random_split', 'Subset']

@@ -1,0 +1,6 @@
+"""fluid.dataloader.sampler (reference: fluid/dataloader/sampler.py)."""
+from ...io import (  # noqa: F401
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler)
+
+__all__ = ['Sampler', 'SequenceSampler', 'RandomSampler',
+           'WeightedRandomSampler']

@@ -1,0 +1,4 @@
+"""fluid.dataloader.worker (reference: fluid/dataloader/worker.py)."""
+from ...io import get_worker_info  # noqa: F401
+
+__all__ = ['get_worker_info']

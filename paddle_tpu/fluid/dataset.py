@@ -1,0 +1,20 @@
+"""fluid.dataset (reference: python/paddle/fluid/dataset.py) — factory
+over the fleet dataset implementations (distributed/dataset.py, with
+the native C++ slot-file parser underneath)."""
+from ..distributed.dataset import (  # noqa: F401
+    DatasetBase, InMemoryDataset, QueueDataset)
+
+__all__ = ['DatasetFactory', 'InMemoryDataset', 'QueueDataset']
+
+
+class DatasetFactory:
+    """Reference dataset.py:30 — create_dataset('InMemoryDataset')."""
+
+    def create_dataset(self, datafeed_class='QueueDataset'):
+        table = {'InMemoryDataset': InMemoryDataset,
+                 'QueueDataset': QueueDataset}
+        if datafeed_class not in table:
+            raise ValueError(
+                f'unknown dataset class {datafeed_class!r}; choose from '
+                f'{sorted(table)}')
+        return table[datafeed_class]()

@@ -1,0 +1,82 @@
+"""fluid.default_scope_funcs (reference: python/paddle/fluid/
+default_scope_funcs.py) — a thread-local stack of nested variable
+scopes rooted at the static global scope."""
+import threading
+
+from ..static.program import global_scope
+
+__all__ = ['get_cur_scope', 'enter_local_scope', 'leave_local_scope',
+           'var', 'find_var', 'scoped_function']
+
+
+class _LocalScope:
+    def __init__(self, parent):
+        self.parent = parent
+        self.vars = {}
+
+    def find(self, name):
+        if name in self.vars:
+            return self.vars[name]
+        if self.parent is not None:
+            return self.parent.find(name)
+        return None
+
+
+class _RootAdapter:
+    """Adapts the static global scope to the find() protocol."""
+
+    def find(self, name):
+        sc = global_scope()
+        try:
+            return sc.find_var(name)
+        except Exception:
+            return getattr(sc, 'vars', {}).get(name)
+
+
+_tls = threading.local()
+
+
+def get_cur_scope():
+    stack = getattr(_tls, 'stack', None)
+    if not stack:
+        _tls.stack = stack = [_LocalScope(_RootAdapter())]
+    return stack[-1]
+
+
+def enter_local_scope():
+    cur = get_cur_scope()
+    _tls.stack.append(_LocalScope(cur))
+
+
+def leave_local_scope():
+    if len(_tls.stack) <= 1:
+        raise RuntimeError('cannot leave the root scope')
+    _tls.stack.pop()
+
+
+def var(name):
+    """Create (or fetch) `name` in the current scope."""
+    cur = get_cur_scope()
+    if name not in cur.vars:
+        cur.vars[name] = _Placeholder(name)
+    return cur.vars[name]
+
+
+class _Placeholder:
+    def __init__(self, name):
+        self.name = name
+        self.value = None
+
+
+def find_var(name):
+    return get_cur_scope().find(name)
+
+
+def scoped_function(func):
+    """Run func inside a fresh local scope (reference
+    default_scope_funcs.py:72)."""
+    enter_local_scope()
+    try:
+        return func()
+    finally:
+        leave_local_scope()

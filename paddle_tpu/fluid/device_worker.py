@@ -1,0 +1,71 @@
+"""fluid.device_worker (reference: python/paddle/fluid/device_worker.py).
+
+The reference DeviceWorkers emit protobuf trainer descriptors that pick
+a C++ execution strategy (hogwild threads, downpour PS pull/push,
+pipeline sections).  TPU-native execution is one compiled XLA program,
+so these classes carry the same configuration knobs as plain dicts and
+`_gen_worker_desc` records the chosen strategy on the TrainerDesc —
+the executor's dataset-training loop consults it for sparse-PS and
+pipeline behavior.
+"""
+
+__all__ = ['DeviceWorker', 'Hogwild', 'DownpourSGD', 'Section',
+           'DownpourSGDOPT']
+
+
+class DeviceWorker:
+    def __init__(self):
+        self._program = None
+        self._infer = None
+
+    def _set_infer(self, infer=False):
+        self._infer = infer
+
+    def _set_fleet_desc(self, fleet_desc):
+        self._fleet_desc = fleet_desc
+
+    def _set_program(self, program):
+        self._program = program
+
+    def _gen_worker_desc(self, trainer_desc):
+        raise NotImplementedError(
+            'DeviceWorker is abstract; use Hogwild/DownpourSGD/Section')
+
+
+class Hogwild(DeviceWorker):
+    """Lock-free multi-thread host loop feeding the compiled step."""
+
+    def _gen_worker_desc(self, trainer_desc):
+        trainer_desc.proto['device_worker_name'] = 'HogwildWorker'
+        if self._infer:
+            trainer_desc.proto['hogwild_param'] = {
+                'skip_ops': ['feed', 'fetch']}
+
+
+class DownpourSGD(DeviceWorker):
+    """Sparse-PS worker: pulls/pushes through the host-offloaded
+    embedding tables (incubate/host_embedding.py)."""
+
+    def _gen_worker_desc(self, trainer_desc):
+        trainer_desc.proto['device_worker_name'] = 'DownpourWorker'
+        trainer_desc.proto['downpour_param'] = {
+            'push_sparse': not self._infer,
+            'push_dense': not self._infer,
+        }
+
+
+class DownpourSGDOPT(DownpourSGD):
+    def _gen_worker_desc(self, trainer_desc):
+        super()._gen_worker_desc(trainer_desc)
+        trainer_desc.proto['device_worker_name'] = 'DownpourWorkerOpt'
+
+
+class Section(DeviceWorker):
+    """Pipeline-parallel section worker; the TPU-native pipeline is the
+    1F1B shard_map engine (parallel/pipeline_1f1b.py)."""
+
+    def _gen_worker_desc(self, trainer_desc):
+        trainer_desc.proto['device_worker_name'] = 'SectionWorker'
+        pipeline = getattr(self._program, '_pipeline_opt', None) or {}
+        trainer_desc.proto['section_param'] = {
+            'num_microbatches': pipeline.get('num_microbatches', 1)}

@@ -1,0 +1,3 @@
+"""fluid.distributed (reference: python/paddle/fluid/distributed/) —
+legacy downpour/PS helpers; the live API is the fleet module."""
+from . import fleet  # noqa: F401

@@ -1,0 +1,32 @@
+"""fluid.distributed.fleet (reference: python/paddle/fluid/distributed/
+fleet.py) — the minimal legacy Fleet facade over the modern fleet."""
+from ...distributed import fleet as _fleet
+
+__all__ = ['Fleet']
+
+
+class Fleet:
+    """Legacy downpour Fleet shim: init/stop + worker/server queries
+    mapped onto the modern fleet singleton."""
+
+    def __init__(self):
+        self._fleet = _fleet
+
+    def init(self, role_maker=None):
+        self._fleet.init(role_maker)
+
+    def stop(self):
+        pass
+
+    def is_worker(self):
+        return self._fleet.is_worker()
+
+    def is_server(self):
+        return self._fleet.is_server() \
+            if hasattr(self._fleet, 'is_server') else False
+
+    def worker_num(self):
+        return self._fleet.worker_num()
+
+    def worker_index(self):
+        return self._fleet.worker_index()

@@ -499,3 +499,70 @@ def enabled():
     """True iff imperative (dygraph) mode is active."""
     from ..static.program import in_static_mode
     return not in_static_mode()
+
+
+# --- containers / cells (reference fluid/dygraph/{container,rnn}.py) ---
+Sequential = _nn.Sequential
+ParameterList = _nn.ParameterList
+LayerList = _nn.LayerList
+LSTMCell = _nn.LSTMCell
+GRUCell = _nn.GRUCell
+
+# --- legacy decay schedules (reference fluid/dygraph/
+# learning_rate_scheduler.py; real 1.x formulas in lr_compat) ---
+from .lr_compat import (  # noqa: F401,E402
+    NoamDecay, PiecewiseDecay, NaturalExpDecay, ExponentialDecay,
+    InverseTimeDecay, PolynomialDecay, CosineDecay, LinearLrWarmup,
+    StepDecay, MultiStepDecay, LambdaDecay, ReduceLROnPlateau)
+
+# --- parallel (reference fluid/dygraph/parallel.py) ---
+from ..distributed import ParallelEnv, DataParallel  # noqa: F401,E402
+
+
+def prepare_context(strategy=None):
+    """1.x parallel bootstrap: returns the parallel env after
+    initializing collectives (reference dygraph/parallel.py)."""
+    from ..distributed import init_parallel_env
+    init_parallel_env()
+    return ParallelEnv()
+
+
+# --- dy2static entry points (reference fluid/dygraph/jit.py) ---
+from ..jit import (  # noqa: F401,E402
+    save, load, not_to_static, TranslatedLayer, set_verbosity,
+    set_code_level, to_static as declarative)
+
+
+def dygraph_to_static_func(function):
+    """Legacy decorator name for to_static (reference dygraph/jit.py)."""
+    return declarative(function)
+
+
+# --- amp (reference fluid/dygraph/amp/{auto_cast,loss_scaler}.py) ---
+from ..amp import amp_guard  # noqa: F401,E402
+from ..amp import GradScaler as AmpScaler  # noqa: E402
+
+# --- profiler hooks (reference fluid/dygraph/profiler.py) ---
+from ..profiler import start_profiler as _start_prof  # noqa: E402
+from ..profiler import stop_profiler as _stop_prof  # noqa: E402
+
+
+def start_gperf_profiler():
+    """gperftools has no TPU meaning; records an XLA trace instead."""
+    return _start_prof()
+
+
+def stop_gperf_profiler():
+    return _stop_prof()
+
+
+__all__ += [
+    'Sequential', 'ParameterList', 'LayerList', 'LSTMCell', 'GRUCell',
+    'NoamDecay', 'PiecewiseDecay', 'NaturalExpDecay', 'ExponentialDecay',
+    'InverseTimeDecay', 'PolynomialDecay', 'CosineDecay', 'LinearLrWarmup',
+    'StepDecay', 'MultiStepDecay', 'LambdaDecay', 'ReduceLROnPlateau',
+    'prepare_context', 'ParallelEnv', 'DataParallel',
+    'declarative', 'dygraph_to_static_func', 'save', 'load',
+    'not_to_static', 'TranslatedLayer', 'set_verbosity', 'set_code_level',
+    'amp_guard', 'AmpScaler', 'start_gperf_profiler',
+    'stop_gperf_profiler']

@@ -1,0 +1,7 @@
+"""fluid.entry_attr (reference: python/paddle/fluid/entry_attr.py) —
+admission gates for sparse tables; implementation in
+distributed/entry_attr.py (enforced by HostOffloadEmbedding)."""
+from ..distributed.entry_attr import (  # noqa: F401
+    ProbabilityEntry, CountFilterEntry)
+
+__all__ = ['ProbabilityEntry', 'CountFilterEntry']

@@ -1,0 +1,5 @@
+"""fluid.evaluator (reference: python/paddle/fluid/evaluator.py) — the
+surviving evaluators are the fluid.metrics implementations."""
+from .metrics import ChunkEvaluator, EditDistance, DetectionMAP  # noqa: F401
+
+__all__ = ['ChunkEvaluator', 'EditDistance', 'DetectionMAP']

@@ -1,0 +1,72 @@
+"""fluid.framework (reference: python/paddle/fluid/framework.py).
+
+Program/Variable/scope machinery lives in static/program.py; this
+module adds the 1.x framework helpers (mode queries, flags, device
+guards, place lists).
+"""
+import contextlib
+
+from ..static.program import (  # noqa: F401
+    Program, program_guard, default_main_program, default_startup_program,
+    Variable, global_scope, name_scope, in_static_mode)
+from ..static.compat import cpu_places, cuda_places  # noqa: F401
+from ..core.device import (  # noqa: F401
+    CPUPlace, CUDAPlace, TPUPlace, XPUPlace, CUDAPinnedPlace,
+    is_compiled_with_cuda, is_compiled_with_xpu)
+from ..utils import require_version  # noqa: F401
+
+__all__ = ['Program', 'default_startup_program', 'default_main_program',
+           'program_guard', 'name_scope', 'cpu_places', 'cuda_places',
+           'xpu_places', 'cuda_pinned_places', 'in_dygraph_mode',
+           'is_compiled_with_cuda', 'is_compiled_with_xpu',
+           'Variable', 'require_version', 'device_guard', 'set_flags',
+           'get_flags']
+
+
+def in_dygraph_mode():
+    return not in_static_mode()
+
+
+def xpu_places(device_ids=None):
+    """XPU is not a TPU-native target; the device list is empty unless
+    ids are forced explicitly (matching paddle semantics of returning
+    XPUPlace objects for requested ids)."""
+    return [XPUPlace(i) for i in (device_ids or [])]
+
+
+def cuda_pinned_places(device_count=None):
+    """Pinned host staging places; on TPU the host side of the
+    double-buffered transfer path plays this role."""
+    return [CUDAPinnedPlace()] * (device_count or 1)
+
+
+# global framework flags (reference: C++ gflags surfaced via
+# set_flags/get_flags).  TPU-native: a plain dict consulted by the
+# python runtime; XLA knobs go through XLA_FLAGS instead.
+_FLAGS = {}
+
+
+def set_flags(flags):
+    if not isinstance(flags, dict):
+        raise TypeError('set_flags expects a dict of {flag: value}')
+    _FLAGS.update(flags)
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    if not isinstance(flags, (list, tuple)):
+        raise TypeError('get_flags expects a flag name or list of names')
+    return {f: _FLAGS.get(f) for f in flags}
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Reference framework.py device_guard: pins ops to a device in the
+    program.  Under XLA, placement inside one program is the
+    compiler's; the guard validates the name and is otherwise
+    advisory."""
+    if device is not None and device.split(':')[0] not in (
+            'cpu', 'gpu', 'xpu', 'npu', 'tpu', 'all'):
+        raise ValueError(f'unsupported device type {device!r}')
+    yield

@@ -1,0 +1,38 @@
+"""fluid.generator (reference: python/paddle/fluid/generator.py) — RNG
+stream handle over the global PRNGKey threading (core/rng.py)."""
+from ..core import rng as _rng
+
+__all__ = ['Generator']
+
+
+class Generator:
+    """Per-place random generator.  TPU-native randomness is a threaded
+    jax PRNGKey; manual_seed re-seeds the global stream and the
+    returned state is the (seed, counter) pair."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def get_state(self):
+        return _rng.get_cuda_rng_state()
+
+    def set_state(self, state):
+        _rng.set_cuda_rng_state(state)
+
+    def manual_seed(self, seed):
+        _rng.seed(seed)
+        return self
+
+    def seed(self):
+        import random as _random
+        s = _random.getrandbits(32)
+        _rng.seed(s)
+        return s
+
+    def initial_seed(self):
+        return _rng.get_seed()
+
+    def random(self):
+        raise NotImplementedError(
+            'Generator.random() (raw C++ engine draw) has no TPU '
+            'counterpart; draw through paddle_tpu.tensor.rand* ops')

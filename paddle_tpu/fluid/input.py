@@ -1,0 +1,10 @@
+"""fluid.input (reference: python/paddle/fluid/input.py)."""
+from ..static.nn import embedding  # noqa: F401
+from ..nn.functional import one_hot as _one_hot
+
+__all__ = ['one_hot', 'embedding']
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    """1.x signature: num_classes is called depth."""
+    return _one_hot(input, depth)

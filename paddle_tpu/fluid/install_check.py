@@ -1,0 +1,5 @@
+"""fluid.install_check (reference: python/paddle/fluid/install_check.py).
+run_check lives in paddle_tpu.utils (trains a tiny model end-to-end)."""
+from ..utils import run_check  # noqa: F401
+
+__all__ = ['run_check']

@@ -1,0 +1,40 @@
+"""fluid.layer_helper_base (reference: python/paddle/fluid/
+layer_helper_base.py).  The 1.x LayerHelper mediated between layer
+front-ends and the ProgramDesc; here parameters are created directly
+through the Layer machinery, so the helper delegates to an anonymous
+Layer and keeps the name/activation conveniences."""
+from ..nn.layer.layers import Layer
+from ..utils import unique_name
+
+__all__ = ['LayerHelperBase']
+
+
+class LayerHelperBase:
+    def __init__(self, name=None, layer_type=''):
+        self._layer_type = layer_type
+        self._name = name or unique_name.generate(layer_type or 'layer')
+        self._owner = Layer()
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def layer_type(self):
+        return self._layer_type
+
+    def create_parameter(self, attr, shape, dtype='float32',
+                         is_bias=False, default_initializer=None):
+        return self._owner.create_parameter(
+            shape, attr=attr, dtype=dtype, is_bias=is_bias,
+            default_initializer=default_initializer)
+
+    def to_variable(self, value, name=None):
+        from ..core.tensor import Tensor
+        return Tensor(value)
+
+    def append_activation(self, x, act=None):
+        if act is None:
+            return x
+        from ..nn import functional as F
+        return getattr(F, act)(x)

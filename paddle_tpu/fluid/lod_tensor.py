@@ -1,0 +1,66 @@
+"""fluid.lod_tensor (reference: python/paddle/fluid/lod_tensor.py).
+
+LoD (level-of-detail) ragged tensors are redesigned away in the
+TPU-native stack — variable-length data is padded dense + lengths
+(static/sequence.py).  These builders keep the 1.x construction API:
+they return a padded dense Tensor carrying its recursive sequence
+lengths as `.recursive_sequence_lengths()`, which the sequence_* ops
+accept.
+"""
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ['create_lod_tensor', 'create_random_int_lodtensor']
+
+
+def _flatten_lengths(recursive_seq_lens):
+    if not isinstance(recursive_seq_lens, (list, tuple)) or not all(
+            isinstance(l, (list, tuple)) for l in recursive_seq_lens):
+        raise TypeError('recursive_seq_lens must be a list of lists')
+    return [list(map(int, l)) for l in recursive_seq_lens]
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """Build a padded-dense tensor from flat `data` plus per-sequence
+    lengths (reference lod_tensor.py:25).  The innermost length list
+    partitions data's rows into sequences; rows pad to the max."""
+    lens = _flatten_lengths(recursive_seq_lens)
+    inner = lens[-1]
+    arr = np.asarray(data.value if isinstance(data, Tensor) else data)
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    if sum(inner) != arr.shape[0]:
+        raise ValueError(
+            f'sum of innermost seq lens {sum(inner)} != rows '
+            f'{arr.shape[0]}')
+    maxlen = max(inner) if inner else 0
+    out = np.zeros((len(inner), maxlen) + arr.shape[1:], arr.dtype)
+    off = 0
+    for i, n in enumerate(inner):
+        out[i, :n] = arr[off:off + n]
+        off += n
+    t = Tensor(out)
+    t._recursive_seq_lens = lens
+    # the 1.x LoDTensor read-back API
+    t.recursive_sequence_lengths = lambda: lens
+    t.lod = lambda: [list(_accumulate(l)) for l in lens]
+    return t
+
+
+def _accumulate(lengths):
+    off = 0
+    yield off
+    for n in lengths:
+        off += n
+        yield off
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place,
+                                low, high):
+    """Random integer ragged tensor (reference lod_tensor.py:110)."""
+    lens = _flatten_lengths(recursive_seq_lens)
+    total = sum(lens[-1])
+    data = np.random.randint(low, high + 1,
+                             size=[total] + list(base_shape))
+    return create_lod_tensor(data, recursive_seq_lens, place)

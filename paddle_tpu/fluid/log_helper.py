@@ -1,0 +1,16 @@
+"""fluid.log_helper (reference: python/paddle/fluid/log_helper.py)."""
+import logging
+
+__all__ = ['get_logger']
+
+
+def get_logger(name, level, fmt=None):
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    if not lg.handlers:
+        h = logging.StreamHandler()
+        if fmt:
+            h.setFormatter(logging.Formatter(fmt))
+        lg.addHandler(h)
+    lg.propagate = False
+    return lg

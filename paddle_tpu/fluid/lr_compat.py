@@ -1,0 +1,212 @@
+"""Legacy 1.x learning-rate decay schedules.
+
+Reference: python/paddle/fluid/dygraph/learning_rate_scheduler.py (the
+class forms) and fluid/layers/learning_rate_scheduler.py (the
+functional forms used inside static programs).  The 1.x schedules are
+parameterized by (decay_steps, decay_rate, staircase) — different
+formulas from the 2.0 `optimizer.lr` classes — so these are real
+implementations, subclassing LRScheduler for optimizer compatibility.
+
+1.x `begin`/`step` args: the global counter starts at `begin` and
+advances by `step` per step() call; `dtype` is accepted for signature
+parity (schedules compute in python floats / f32 either way).
+"""
+import math
+
+from ..optimizer.lr import LRScheduler, ReduceOnPlateau
+
+__all__ = [
+    'NoamDecay', 'PiecewiseDecay', 'NaturalExpDecay', 'ExponentialDecay',
+    'InverseTimeDecay', 'PolynomialDecay', 'CosineDecay', 'LinearLrWarmup',
+    'StepDecay', 'MultiStepDecay', 'LambdaDecay', 'ReduceLROnPlateau',
+]
+
+
+class _LegacyDecay(LRScheduler):
+    """Base: 1.x counter semantics (begin + n·step)."""
+
+    def __init__(self, learning_rate, begin=0, step=1, dtype='float32'):
+        self._begin = int(begin)
+        self._incr = int(step)
+        super().__init__(learning_rate, last_epoch=-1)
+
+    @property
+    def global_step(self):
+        return self._begin + max(self.last_epoch, 0) * self._incr
+
+    def get_lr(self):
+        return self._decay(self.global_step)
+
+    def value_at(self, step):
+        return self._decay(self._begin + step * self._incr)
+
+    def _decay(self, t):
+        raise NotImplementedError
+
+
+class NoamDecay(_LegacyDecay):
+    """lr · d_model^-0.5 · min(t^-0.5, t·warmup^-1.5)
+    (reference dygraph/learning_rate_scheduler.py NoamDecay — note the
+    1.x argument order d_model, warmup_steps, begin, step, dtype)."""
+
+    def __init__(self, d_model, warmup_steps, begin=1, step=1,
+                 dtype='float32', learning_rate=1.0):
+        self.d_model = float(d_model)
+        self.warmup_steps = float(warmup_steps)
+        super().__init__(learning_rate, begin, step, dtype)
+
+    def _decay(self, t):
+        t = max(float(t), 1.0)
+        return self.base_lr * self.d_model ** -0.5 * min(
+            t ** -0.5, t * self.warmup_steps ** -1.5)
+
+
+class PiecewiseDecay(_LegacyDecay):
+    def __init__(self, boundaries, values, begin, step=1, dtype='float32'):
+        if len(values) != len(boundaries) + 1:
+            raise ValueError('values must have one more entry than '
+                             'boundaries')
+        self.boundaries = [float(b) for b in boundaries]
+        self.values = [float(v) for v in values]
+        super().__init__(values[0], begin, step, dtype)
+
+    def _decay(self, t):
+        for b, v in zip(self.boundaries, self.values):
+            if t < b:
+                return v
+        return self.values[-1]
+
+
+class NaturalExpDecay(_LegacyDecay):
+    """lr · e^(−rate · t/decay_steps) (staircase floors the ratio)."""
+
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype='float32'):
+        self.decay_steps = float(decay_steps)
+        self.decay_rate = float(decay_rate)
+        self.staircase = staircase
+        super().__init__(learning_rate, begin, step, dtype)
+
+    def _ratio(self, t):
+        r = t / self.decay_steps
+        return math.floor(r) if self.staircase else r
+
+    def _decay(self, t):
+        return self.base_lr * math.exp(-self.decay_rate * self._ratio(t))
+
+
+class ExponentialDecay(NaturalExpDecay):
+    """lr · rate^(t/decay_steps)."""
+
+    def _decay(self, t):
+        return self.base_lr * self.decay_rate ** self._ratio(t)
+
+
+class InverseTimeDecay(NaturalExpDecay):
+    """lr / (1 + rate · t/decay_steps)."""
+
+    def _decay(self, t):
+        return self.base_lr / (1.0 + self.decay_rate * self._ratio(t))
+
+
+class PolynomialDecay(_LegacyDecay):
+    def __init__(self, learning_rate, decay_steps, end_learning_rate=1e-4,
+                 power=1.0, cycle=False, begin=0, step=1, dtype='float32'):
+        self.decay_steps = float(decay_steps)
+        self.end_lr = float(end_learning_rate)
+        self.power = float(power)
+        self.cycle = cycle
+        super().__init__(learning_rate, begin, step, dtype)
+
+    def _decay(self, t):
+        t = float(t)
+        steps = self.decay_steps
+        if self.cycle:
+            mult = math.ceil(t / steps) if t > 0 else 1.0
+            steps = steps * max(mult, 1.0)
+        else:
+            t = min(t, steps)
+        frac = (1.0 - t / steps) ** self.power
+        return (self.base_lr - self.end_lr) * frac + self.end_lr
+
+
+class CosineDecay(_LegacyDecay):
+    """lr · ½(cos(epoch·π/epochs)+1), epoch = ⌊t/step_each_epoch⌋."""
+
+    def __init__(self, learning_rate, step_each_epoch, epochs, begin=0,
+                 step=1, dtype='float32'):
+        self.step_each_epoch = float(step_each_epoch)
+        self.epochs = float(epochs)
+        super().__init__(learning_rate, begin, step, dtype)
+
+    def _decay(self, t):
+        epoch = math.floor(t / self.step_each_epoch)
+        return self.base_lr * 0.5 * (
+            math.cos(epoch * math.pi / self.epochs) + 1.0)
+
+
+class LinearLrWarmup(_LegacyDecay):
+    """Linear start_lr→end_lr over warmup_steps, then the wrapped
+    schedule (a float or another scheduler)."""
+
+    def __init__(self, learning_rate, warmup_steps, start_lr, end_lr,
+                 begin=1, step=1, dtype='float32'):
+        self.wrapped = learning_rate
+        self.warmup_steps = float(warmup_steps)
+        self.start_lr = float(start_lr)
+        self.end_lr = float(end_lr)
+        base = learning_rate if isinstance(learning_rate, (int, float)) \
+            else learning_rate.base_lr
+        super().__init__(base, begin, step, dtype)
+
+    def _decay(self, t):
+        if t < self.warmup_steps:
+            return self.start_lr + (self.end_lr - self.start_lr) \
+                * t / self.warmup_steps
+        if isinstance(self.wrapped, (int, float)):
+            return float(self.wrapped)
+        return self.wrapped._decay(t) if hasattr(self.wrapped, '_decay') \
+            else self.wrapped.value_at(t)
+
+
+class StepDecay(_LegacyDecay):
+    def __init__(self, learning_rate, step_size, decay_rate=0.1):
+        self.step_size = int(step_size)
+        self.decay_rate = float(decay_rate)
+        super().__init__(learning_rate)
+
+    def _decay(self, t):
+        return self.base_lr * self.decay_rate ** (int(t) // self.step_size)
+
+
+class MultiStepDecay(_LegacyDecay):
+    def __init__(self, learning_rate, milestones, decay_rate=0.1):
+        self.milestones = [int(m) for m in milestones]
+        self.decay_rate = float(decay_rate)
+        super().__init__(learning_rate)
+
+    def _decay(self, t):
+        n = sum(1 for m in self.milestones if t >= m)
+        return self.base_lr * self.decay_rate ** n
+
+
+class LambdaDecay(_LegacyDecay):
+    def __init__(self, learning_rate, lr_lambda):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate)
+
+    def _decay(self, t):
+        return self.base_lr * self.lr_lambda(int(t))
+
+
+class ReduceLROnPlateau(ReduceOnPlateau):
+    """1.x name/args (decay_rate ↦ factor) over the 2.0 implementation."""
+
+    def __init__(self, learning_rate, mode='min', decay_rate=0.1,
+                 patience=10, verbose=False, threshold=1e-4,
+                 threshold_mode='rel', cooldown=0, min_lr=0, eps=1e-8,
+                 dtype='float32'):
+        super().__init__(learning_rate, mode=mode, factor=decay_rate,
+                         patience=patience, threshold=threshold,
+                         threshold_mode=threshold_mode, cooldown=cooldown,
+                         min_lr=min_lr, epsilon=eps, verbose=verbose)

@@ -1,0 +1,8 @@
+"""fluid.profiler (reference: python/paddle/fluid/profiler.py) — the
+nvprof-era API over the XLA trace backend (paddle_tpu.profiler)."""
+from ..profiler import (  # noqa: F401
+    cuda_profiler, reset_profiler, profiler, start_profiler,
+    stop_profiler)
+
+__all__ = ['cuda_profiler', 'reset_profiler', 'profiler',
+           'start_profiler', 'stop_profiler']

@@ -1,0 +1,9 @@
+"""fluid.regularizer (reference: python/paddle/fluid/regularizer.py).
+The 1.x *Regularizer names are the 2.0 decay classes."""
+from ..regularizer import L1Decay, L2Decay  # noqa: F401
+
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay
+
+__all__ = ['L1Decay', 'L2Decay', 'L1DecayRegularizer',
+           'L2DecayRegularizer']

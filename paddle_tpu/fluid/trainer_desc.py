@@ -1,0 +1,80 @@
+"""fluid.trainer_desc (reference: python/paddle/fluid/trainer_desc.py).
+
+Dict-backed trainer descriptors (the reference builds protobufs for
+the C++ trainer registry; the TPU-native executor reads these dicts in
+its dataset-training loop).
+"""
+
+__all__ = ['TrainerDesc', 'MultiTrainer', 'DistMultiTrainer',
+           'PipelineTrainer', 'HeterXpuTrainer', 'HeterBoxWorker']
+
+
+class TrainerDesc:
+    def __init__(self):
+        self.proto = {'class_name': '', 'thread_num': 1, 'debug': False,
+                      'fetch_config': {}}
+        self._device_worker = None
+        self._program = None
+        self._infer = False
+
+    def _set_fetch_var_and_info(self, fetch_vars, fetch_info,
+                                print_period):
+        self.proto['fetch_config'] = {
+            'fetch_vars': [getattr(v, 'name', str(v)) for v in fetch_vars],
+            'fetch_info': list(fetch_info),
+            'print_period': print_period}
+
+    def _set_debug(self, debug):
+        self.proto['debug'] = bool(debug)
+
+    def _set_thread(self, thread_num):
+        self.proto['thread_num'] = int(thread_num)
+
+    def _set_device_worker(self, device_worker):
+        self._device_worker = device_worker
+
+    def _set_infer(self, infer):
+        self._infer = bool(infer)
+
+    def _set_program(self, program):
+        self._program = program
+
+    def _set_fleet_desc(self, fleet_desc):
+        self.proto['fleet_desc'] = fleet_desc
+
+    def _gen_trainer_desc(self):
+        if self._device_worker is not None:
+            self._device_worker._set_infer(self._infer)
+            self._device_worker._set_program(self._program)
+            self._device_worker._gen_worker_desc(self)
+        return self.proto
+
+
+class MultiTrainer(TrainerDesc):
+    def _gen_trainer_desc(self):
+        self.proto['class_name'] = 'MultiTrainer'
+        return super()._gen_trainer_desc()
+
+
+class DistMultiTrainer(TrainerDesc):
+    def _gen_trainer_desc(self):
+        self.proto['class_name'] = 'DistMultiTrainer'
+        return super()._gen_trainer_desc()
+
+
+class PipelineTrainer(TrainerDesc):
+    def _gen_trainer_desc(self):
+        self.proto['class_name'] = 'PipelineTrainer'
+        return super()._gen_trainer_desc()
+
+
+class HeterXpuTrainer(TrainerDesc):
+    def _gen_trainer_desc(self):
+        self.proto['class_name'] = 'HeterXpuTrainer'
+        return super()._gen_trainer_desc()
+
+
+class HeterBoxWorker(TrainerDesc):
+    def _gen_trainer_desc(self):
+        self.proto['class_name'] = 'HeterBoxWorker'
+        return super()._gen_trainer_desc()

@@ -1,0 +1,100 @@
+"""fluid.trainer_factory (reference: python/paddle/fluid/
+trainer_factory.py)."""
+import threading
+import time
+
+import numpy as np
+
+from . import trainer_desc as _td
+from . import device_worker as _dw
+
+__all__ = ['TrainerFactory', 'FetchHandler', 'FetchHandlerMonitor']
+
+
+class TrainerFactory:
+    def _create_trainer(self, opt_info=None):
+        opt_info = opt_info or {}
+        trainer_name = opt_info.get('trainer', 'MultiTrainer')
+        worker_name = opt_info.get('device_worker', 'Hogwild')
+        trainer = getattr(_td, trainer_name, None)
+        worker = getattr(_dw, worker_name, None)
+        if trainer is None or worker is None:
+            raise ValueError(
+                f'unknown trainer/device_worker pair '
+                f'({trainer_name!r}, {worker_name!r})')
+        t = trainer()
+        w = worker()
+        if 'fleet_desc' in opt_info:
+            t._set_fleet_desc(opt_info['fleet_desc'])
+            w._set_fleet_desc(opt_info['fleet_desc'])
+        t._set_device_worker(w)
+        return t
+
+
+class FetchHandler:
+    """User hook receiving {var_name: ndarray} every `period` seconds
+    while a dataset-training run is live."""
+
+    def __init__(self, var_dict=None, period_secs=60):
+        if var_dict is None:
+            raise ValueError('var_dict must map names to variables')
+        self.var_dict = var_dict
+        self.period_secs = period_secs
+
+    def handler(self, res_dict):
+        for k, v in res_dict.items():
+            if isinstance(v, np.ndarray):
+                print(f'{k}[0]: {v.ravel()[:1]}')
+
+    @staticmethod
+    def help():
+        print('''\
+class FetchHandlerExample(FetchHandler):
+    def handler(self, res_dict):
+        print(res_dict["var_name"])
+''')
+
+
+class FetchHandlerMonitor:
+    """Polls a scope for the handler's variables on a daemon thread
+    (reference trainer_factory.py:114)."""
+
+    def __init__(self, scope, handler):
+        self.scope = scope
+        self.handler = handler
+        self._stop = threading.Event()
+        self._thread = None
+        self._running = False
+
+    def _lookup(self, name):
+        try:
+            v = self.scope.find_var(name)
+        except Exception:
+            v = getattr(self.scope, 'vars', {}).get(name)
+        if v is None:
+            return None
+        val = getattr(v, 'value', v)
+        try:
+            return np.asarray(val)
+        except Exception:
+            return None
+
+    def _loop(self):
+        while not self._stop.is_set():
+            if self._stop.wait(self.handler.period_secs):
+                break
+            res = {user_name: self._lookup(getattr(var, 'name', var))
+                   for user_name, var in self.handler.var_dict.items()}
+            self.handler.handler(res)
+
+    def start(self):
+        if not self._running:
+            self._running = True
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop,
+                                            daemon=True)
+            self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._running = False

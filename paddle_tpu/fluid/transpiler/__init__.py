@@ -1,0 +1,134 @@
+"""fluid.transpiler (reference: python/paddle/fluid/transpiler/).
+
+The reference DistributeTranspiler rewrites a Program into trainer +
+pserver halves connected by send/recv ops.  The TPU-native stack has
+no parameter-server graph split — dense synchronization is XLA
+collectives over the mesh (fleet dp) and sparse tables are the
+host-offloaded embedding (incubate/host_embedding.py) — so:
+
+- sync_mode transpile returns the trainer program UNCHANGED (the
+  collective insertion happens at jit/sharding time, not as a graph
+  rewrite), with the endpoint bookkeeping kept for introspection;
+- pserver-program extraction raises with a pointer to the PS
+  substitute (the brpc fabric is a documented non-goal, SURVEY §2#34).
+
+memory_optimize/release_memory are no-ops in the reference 2.0 as
+well (XLA owns buffer liveness here).
+"""
+import hashlib
+import warnings
+
+__all__ = ['DistributeTranspiler', 'memory_optimize', 'release_memory',
+           'HashName', 'RoundRobin', 'DistributeTranspilerConfig']
+
+
+class PSDispatcher:
+    """Distribute variable names over pserver endpoints."""
+
+    def __init__(self, pserver_endpoints):
+        self._eplist = list(pserver_endpoints)
+
+    @property
+    def eplist(self):
+        return self._eplist
+
+    def reset(self):
+        pass
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class HashName(PSDispatcher):
+    """Endpoint = hash(var name) % n (reference transpiler/
+    ps_dispatcher.py)."""
+
+    def _hash_block(self, block_str):
+        return int(hashlib.md5(str(block_str).encode()).hexdigest(), 16)
+
+    def dispatch(self, varlist):
+        return [self._eplist[self._hash_block(getattr(v, 'name', v))
+                             % len(self._eplist)]
+                for v in varlist]
+
+
+class RoundRobin(PSDispatcher):
+    def __init__(self, pserver_endpoints):
+        super().__init__(pserver_endpoints)
+        self._step = 0
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        out = []
+        for _ in varlist:
+            out.append(self._eplist[self._step % len(self._eplist)])
+            self._step += 1
+        return out
+
+
+class DistributeTranspilerConfig:
+    slice_var_up = True
+    split_method = RoundRobin
+    min_block_size = 8192
+    enable_dc_asgd = False
+    mode = 'pserver'
+    print_log = False
+    wait_port = True
+    runtime_split_send_recv = False
+    sync_mode = True
+
+    def __init__(self):
+        pass
+
+
+class DistributeTranspiler:
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._trainer_program = None
+        self._endpoints = []
+
+    def transpile(self, trainer_id, program=None, pservers='127.0.0.1:6174',
+                  trainers=1, sync_mode=True, startup_program=None,
+                  current_endpoint='127.0.0.1:6174'):
+        from ..framework import default_main_program
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers
+        self.sync_mode = sync_mode
+        self._endpoints = pservers.split(',') if isinstance(pservers, str) \
+            else list(pservers)
+        self._trainer_program = program or default_main_program()
+
+    def get_trainer_program(self, wait_port=True):
+        """Collectives are inserted by sharding at jit time, so the
+        trainer program is the original program."""
+        if self._trainer_program is None:
+            raise RuntimeError('call transpile() first')
+        return self._trainer_program
+
+    def get_pserver_program(self, endpoint):
+        raise NotImplementedError(
+            'the brpc parameter-server graph split is a documented '
+            'non-goal on TPU; dense sync rides XLA collectives '
+            '(distributed.fleet) and sparse tables live in '
+            'paddle_tpu.incubate.HostOffloadEmbedding')
+
+    def get_pserver_programs(self, endpoint):
+        return self.get_pserver_program(endpoint)
+
+    def get_startup_program(self, endpoint, pserver_program=None,
+                            startup_program=None):
+        raise NotImplementedError(
+            'pserver startup programs do not exist on TPU; see '
+            'get_pserver_program')
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    warnings.warn('memory_optimize is a no-op: XLA owns buffer liveness '
+                  '(this matches the reference 2.0 deprecation)')
+
+
+def release_memory(input_program, skip_opt_set=None):
+    warnings.warn('release_memory is a no-op: XLA owns buffer liveness')

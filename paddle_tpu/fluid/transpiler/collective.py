@@ -1,0 +1,52 @@
+"""fluid.transpiler.collective (reference: python/paddle/fluid/
+transpiler/collective.py).
+
+The reference classes rewrite a Program inserting c_allreduce /
+c_broadcast ops.  TPU-native, the same effect is a sharding decision:
+GradAllReduce marks the program for dp-mesh gradient synchronization
+(XLA inserts the reduce-scatter/all-gather), LocalSGD for periodic
+parameter averaging (parallel/localsgd.py).  transpile() records the
+topology; the ParallelTrainer/fleet path consumes it.
+"""
+
+__all__ = ['GradAllReduce', 'LocalSGD']
+
+
+class Collective:
+    def __init__(self, nrings=1):
+        self.nrings = nrings
+        self.nranks = None
+        self.rank = None
+        self.endpoints = None
+
+    def transpile(self, startup_program, main_program, rank, endpoints,
+                  current_endpoint, wait_port=True):
+        self.nranks = len(endpoints) if isinstance(endpoints, (list, tuple)) \
+            else len(endpoints.split(','))
+        self.rank = rank
+        self.endpoints = endpoints
+        self.startup_program = startup_program
+        self.main_program = main_program
+        self._mark(main_program)
+
+    def _mark(self, program):
+        raise NotImplementedError
+
+
+class GradAllReduce(Collective):
+    def _mark(self, program):
+        if program is not None:
+            program._dist_mode = 'grad_allreduce'
+            program._dist_nranks = self.nranks
+
+
+class LocalSGD(Collective):
+    def __init__(self, nrings=1, k_steps=4):
+        super().__init__(nrings)
+        self.k_steps = k_steps
+
+    def _mark(self, program):
+        if program is not None:
+            program._dist_mode = 'local_sgd'
+            program._dist_nranks = self.nranks
+            program._local_sgd_k = self.k_steps

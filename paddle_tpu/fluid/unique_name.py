@@ -1,0 +1,6 @@
+"""fluid.unique_name (reference: python/paddle/fluid/unique_name.py) —
+same implementation as paddle.utils.unique_name."""
+from ..utils.unique_name import (  # noqa: F401
+    generate, switch, guard, UniqueNameGenerator)
+
+__all__ = ['generate', 'switch', 'guard']

@@ -1,0 +1,20 @@
+"""fluid.wrapped_decorator (reference: python/paddle/fluid/
+wrapped_decorator.py) — functools-based, no `decorator` dependency."""
+import contextlib
+import functools
+
+__all__ = ['wrap_decorator', 'signature_safe_contextmanager']
+
+
+def wrap_decorator(decorator_func):
+    """Turn a (fn → wrapped-call) factory into a decorator that
+    preserves the wrapped function's metadata."""
+    @functools.wraps(decorator_func)
+    def _decorator(func):
+        dec = decorator_func(func)
+        return functools.wraps(func)(dec)
+    return _decorator
+
+
+def signature_safe_contextmanager(func):
+    return contextlib.contextmanager(func)

@@ -1,0 +1,335 @@
+"""fluid 1.x submodule parity batch: clip/regularizer/average/
+data_feeder/dataloader/dataset/framework/lod_tensor/scope/desc/factory/
+transpiler (reference: the same-named python/paddle/fluid modules).
+"""
+import math
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+def test_clip_and_regularizer_aliases():
+    assert fluid.clip.GradientClipByGlobalNorm \
+        is fluid.clip.ClipGradByGlobalNorm
+    from paddle_tpu.nn.clip import ClipGradByNorm
+    assert fluid.clip.ClipGradByNorm is ClipGradByNorm
+    assert fluid.regularizer.L2DecayRegularizer is fluid.regularizer.L2Decay
+
+
+def test_weighted_average():
+    wa = fluid.WeightedAverage()
+    wa.add(1.0, weight=1)
+    wa.add(3.0, weight=3)
+    assert abs(wa.eval() - 2.5) < 1e-12
+    wa.reset()
+    with pytest.raises(ValueError):
+        wa.eval()
+
+
+def test_data_feeder_batches_rows():
+    feeder = fluid.DataFeeder(feed_list=['img', 'label'])
+    feed = feeder.feed([(np.ones((2, 2)), 0), (np.zeros((2, 2)), 1)])
+    assert feed['img'].shape == (2, 2, 2)
+    np.testing.assert_array_equal(feed['label'], [0, 1])
+    with pytest.raises(ValueError):
+        feeder.feed([(np.ones(2),)])
+
+
+def test_data_feeder_ragged_slot_pads():
+    feeder = fluid.DataFeeder(feed_list=['words', 'label'])
+    feed = feeder.feed([(np.array([1, 2, 3]), 0), (np.array([7]), 1)])
+    np.testing.assert_array_equal(feed['words'],
+                                  [[1, 2, 3], [7, 0, 0]])
+    with pytest.raises(ValueError):
+        feeder.feed([(np.ones((2, 2)), 0), (np.ones(2), 1)])
+
+
+def test_dataset_factory():
+    ds = fluid.DatasetFactory().create_dataset('InMemoryDataset')
+    from paddle_tpu.distributed.dataset import InMemoryDataset
+    assert isinstance(ds, InMemoryDataset)
+    with pytest.raises(ValueError):
+        fluid.DatasetFactory().create_dataset('NopeDataset')
+
+
+def test_dataloader_submodule_reexports():
+    from paddle_tpu.fluid.dataloader import Dataset, BatchSampler
+    import paddle_tpu.io as io
+    assert Dataset is io.Dataset and BatchSampler is io.BatchSampler
+    from paddle_tpu.fluid.dataloader.sampler import RandomSampler
+    assert RandomSampler is io.RandomSampler
+
+
+def test_framework_flags_and_modes():
+    fluid.set_flags({'FLAGS_check_nan_inf': True})
+    assert fluid.get_flags('FLAGS_check_nan_inf') == \
+        {'FLAGS_check_nan_inf': True}
+    with pytest.raises(TypeError):
+        fluid.set_flags(['notadict'])
+    assert fluid.in_dygraph_mode() in (True, False)
+    with fluid.device_guard('cpu'):
+        pass
+    with pytest.raises(ValueError):
+        with fluid.device_guard('quantum:0'):
+            pass
+    assert fluid.xpu_places() == []
+    assert len(fluid.cuda_pinned_places(2)) == 2
+
+
+def test_lod_tensor_padding():
+    t = fluid.create_lod_tensor(
+        np.arange(5, dtype='int64'), [[2, 3]], None)
+    assert t.shape[0] == 2 and t.shape[1] == 3
+    arr = np.asarray(t.value)
+    np.testing.assert_array_equal(arr[0, :2, 0], [0, 1])
+    np.testing.assert_array_equal(arr[1, :3, 0], [2, 3, 4])
+    assert t.recursive_sequence_lengths() == [[2, 3]]
+    assert t.lod() == [[0, 2, 5]]
+    with pytest.raises(ValueError):
+        fluid.create_lod_tensor(np.arange(4), [[2, 3]], None)
+    r = fluid.create_random_int_lodtensor([[1, 2]], [3], None, 0, 9)
+    assert tuple(r.shape) == (2, 2, 3)
+
+
+def test_default_scope_funcs():
+    from paddle_tpu.fluid import default_scope_funcs as dsf
+    v = dsf.var('x')
+    assert dsf.find_var('x') is v
+    dsf.enter_local_scope()
+    assert dsf.find_var('x') is v          # visible from parent
+    v2 = dsf.var('y')
+    assert dsf.find_var('y') is v2
+    dsf.leave_local_scope()
+    assert dsf.find_var('y') is None       # local scope gone
+
+    seen = []
+    dsf.scoped_function(lambda: seen.append(dsf.var('z')))
+    assert dsf.find_var('z') is None and len(seen) == 1
+
+
+def test_data_feed_desc_roundtrip(tmp_path):
+    proto = tmp_path / 'feed.prototxt'
+    proto.write_text('''name: "MultiSlotDataFeed"
+batch_size: 2
+multi_slot_desc {
+    slots {
+        name: "words"
+        type: "uint64"
+        is_dense: false
+        is_used: false
+    }
+    slots {
+        name: "label"
+        type: "uint64"
+        is_dense: false
+        is_used: false
+    }
+}''')
+    d = fluid.DataFeedDesc(str(proto))
+    assert [s['name'] for s in d.slots] == ['words', 'label']
+    d.set_batch_size(128)
+    d.set_dense_slots(['words'])
+    d.set_use_slots(['label'])
+    text = d.desc()
+    assert 'batch_size: 128' in text
+    assert 'is_dense: true' in text
+    with pytest.raises(ValueError):
+        d.set_use_slots(['nope'])
+    # the rendered text re-parses to the same config
+    proto2 = tmp_path / 'feed2.prototxt'
+    proto2.write_text(text)
+    d2 = fluid.DataFeedDesc(str(proto2))
+    assert d2.batch_size == 128
+    assert d2.slots[0]['is_dense'] is True
+    assert d2.slots[1]['is_used'] is True
+
+
+def test_trainer_factory_and_fetch_monitor():
+    from paddle_tpu.fluid.trainer_factory import (
+        TrainerFactory, FetchHandler, FetchHandlerMonitor)
+    t = TrainerFactory()._create_trainer(
+        {'trainer': 'DistMultiTrainer', 'device_worker': 'DownpourSGD'})
+    desc = t._gen_trainer_desc()
+    assert desc['class_name'] == 'DistMultiTrainer'
+    assert desc['device_worker_name'] == 'DownpourWorker'
+    with pytest.raises(ValueError):
+        TrainerFactory()._create_trainer({'trainer': 'NopeTrainer'})
+
+    class Scope:
+        vars = {'loss': type('V', (), {'value': np.float32(3.0)})()}
+
+        def find_var(self, name):
+            return self.vars.get(name)
+
+    got = []
+
+    class H(FetchHandler):
+        def handler(self, res):
+            got.append(res)
+
+    h = H(var_dict={'loss': 'loss'}, period_secs=0.01)
+    mon = FetchHandlerMonitor(Scope(), h)
+    mon.start()
+    import time
+    for _ in range(100):
+        if got:
+            break
+        time.sleep(0.01)
+    mon.stop()
+    assert got and float(got[0]['loss']) == 3.0
+    got.clear()
+    mon.start()                       # restart after stop must work
+    for _ in range(100):
+        if got:
+            break
+        time.sleep(0.01)
+    mon.stop()
+    assert got
+
+
+def test_transpiler_sync_mode_and_dispatchers():
+    from paddle_tpu.fluid.transpiler import (
+        DistributeTranspiler, DistributeTranspilerConfig, HashName,
+        RoundRobin)
+    rr = RoundRobin(['a:1', 'b:2'])
+    assert rr.dispatch(['v1', 'v2', 'v3']) == ['a:1', 'b:2', 'a:1']
+    rr.reset()
+    assert rr.dispatch(['v4']) == ['a:1']
+    hn = HashName(['a:1', 'b:2'])
+    d = hn.dispatch(['v1', 'v2'])
+    assert set(d) <= {'a:1', 'b:2'}
+    assert hn.dispatch(['v1', 'v2']) == d      # deterministic
+
+    t = DistributeTranspiler(DistributeTranspilerConfig())
+    prog = fluid.Program()
+    t.transpile(trainer_id=0, program=prog,
+                pservers='1.1.1.1:6174,1.1.1.2:6174', trainers=2)
+    assert t.get_trainer_program() is prog
+    with pytest.raises(NotImplementedError):
+        t.get_pserver_program('1.1.1.1:6174')
+
+
+def test_generator_and_misc_modules():
+    g = fluid.Generator().manual_seed(1234)
+    assert g.initial_seed() == 1234
+    s = g.get_state()
+    g.set_state(s)
+
+    from paddle_tpu.fluid.wrapped_decorator import (
+        wrap_decorator, signature_safe_contextmanager)
+
+    @wrap_decorator
+    def twice(fn):
+        def inner(*a):
+            return 2 * fn(*a)
+        return inner
+
+    @twice
+    def f(x):
+        """doc"""
+        return x
+
+    assert f(3) == 6 and f.__doc__ == 'doc'
+
+    @signature_safe_contextmanager
+    def ctx():
+        yield 7
+
+    with ctx() as v:
+        assert v == 7
+
+    from paddle_tpu.fluid.log_helper import get_logger
+    lg = get_logger('t_fluid_sub', 20, fmt='%(message)s')
+    assert lg.handlers and get_logger('t_fluid_sub', 20) is lg
+
+    from paddle_tpu.fluid.communicator import Communicator, LargeScaleKV
+    c = Communicator()
+    c.start()
+    assert c.is_running()
+    c.stop()
+    assert not c.is_running()
+
+
+def test_layer_helper_base_creates_parameters():
+    from paddle_tpu.fluid.layer_helper_base import LayerHelperBase
+    h = LayerHelperBase(layer_type='fc')
+    w = h.create_parameter(attr=None, shape=[3, 4], dtype='float32')
+    assert tuple(w.shape) == (3, 4)
+    b = h.create_parameter(attr=None, shape=[4], is_bias=True)
+    np.testing.assert_allclose(np.asarray(b.value), np.zeros(4))
+    y = h.append_activation(paddle.to_tensor(np.array([-1.0, 2.0])),
+                            act='relu')
+    np.testing.assert_allclose(np.asarray(y.value), [0.0, 2.0])
+
+
+def test_legacy_lr_schedules_formulas():
+    from paddle_tpu.fluid import lr_compat as lc
+    # exponential: lr * rate^(t/steps), staircase floors
+    sch = lc.ExponentialDecay(0.1, decay_steps=10, decay_rate=0.5)
+    [sch.step() for _ in range(5)]
+    assert abs(sch() - 0.1 * 0.5 ** 0.5) < 1e-12
+    # natural exp
+    sch = lc.NaturalExpDecay(0.1, 10, 0.5)
+    [sch.step() for _ in range(10)]
+    assert abs(sch() - 0.1 * math.exp(-0.5)) < 1e-12
+    # inverse time
+    sch = lc.InverseTimeDecay(0.1, 10, 0.5)
+    [sch.step() for _ in range(10)]
+    assert abs(sch() - 0.1 / 1.5) < 1e-12
+    # polynomial with cycle
+    sch = lc.PolynomialDecay(0.1, 10, end_learning_rate=0.01, power=1.0)
+    [sch.step() for _ in range(20)]
+    assert abs(sch() - 0.01) < 1e-12
+    # piecewise
+    sch = lc.PiecewiseDecay([5, 10], [0.1, 0.05, 0.01], begin=0)
+    vals = []
+    for _ in range(12):
+        vals.append(sch())
+        sch.step()
+    assert vals[0] == 0.1 and vals[6] == 0.05 and vals[11] == 0.01
+    # cosine
+    sch = lc.CosineDecay(0.1, step_each_epoch=2, epochs=4)
+    [sch.step() for _ in range(4)]   # epoch 2 of 4 → cos(pi/2)=0
+    assert abs(sch() - 0.1 * 0.5) < 1e-12
+    # warmup wraps a float
+    sch = lc.LinearLrWarmup(0.2, warmup_steps=4, start_lr=0.0, end_lr=0.2,
+                            begin=0)
+    assert abs(sch() - 0.0) < 1e-12
+    [sch.step() for _ in range(4)]
+    assert abs(sch() - 0.2) < 1e-12
+    # noam matches the 2.0 formula at the same step
+    sch = lc.NoamDecay(d_model=64, warmup_steps=100)
+    [sch.step() for _ in range(9)]   # global step 1+9=10
+    expect = 64 ** -0.5 * min(10 ** -0.5, 10 * 100 ** -1.5)
+    assert abs(sch() - expect) < 1e-12
+
+
+def test_dygraph_legacy_names():
+    dg = fluid.dygraph
+    from paddle_tpu import nn
+    assert dg.Sequential is nn.Sequential
+    assert dg.LSTMCell is nn.LSTMCell
+    assert dg.declarative is paddle.jit.to_static
+    assert dg.AmpScaler is paddle.amp.GradScaler
+    assert callable(dg.prepare_context)
+    sch = dg.StepDecay(0.1, step_size=3, decay_rate=0.1)
+    [sch.step() for _ in range(3)]
+    assert abs(sch() - 0.01) < 1e-12
+
+
+def test_fluid_module_paths_importable():
+    import importlib
+    for mod in ['clip', 'regularizer', 'average', 'data_feeder',
+                'data_feed_desc', 'dataloader', 'dataset', 'unique_name',
+                'framework', 'lod_tensor', 'log_helper', 'entry_attr',
+                'evaluator', 'profiler', 'generator', 'install_check',
+                'wrapped_decorator', 'layer_helper_base',
+                'default_scope_funcs', 'communicator', 'device_worker',
+                'trainer_desc', 'trainer_factory', 'transpiler',
+                'distributed', 'input', 'dataloader.sampler',
+                'transpiler.collective', 'distributed.fleet']:
+        importlib.import_module(f'paddle_tpu.fluid.{mod}')
