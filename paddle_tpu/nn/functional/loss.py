@@ -28,6 +28,42 @@ def _reduce(v, reduction):
     return v
 
 
+@jax.custom_vjp
+def _softmax_nll(x, lab):
+    """Per-token -log_softmax(x)[lab] over the LAST axis.
+
+    The autodiff backward of the take_along_axis gather is a
+    scatter-add into the full [N, V] buffer — serialized on TPU; the
+    unfused GPT-2 train step measured ~8x slower than expected at
+    vocab shape [8192, 50304] with it on the path (PERF.md round-4
+    chip session 2; tools/bench_ce_backward.py isolates the
+    formulations on hardware).  The custom backward emits the
+    classic softmax-CE gradient (softmax - one_hot) * g as dense
+    elementwise math, and recomputes softmax from the saved logits
+    instead of keeping the f32 log-probs residual alive.
+    """
+    logp = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
+
+
+def _softmax_nll_fwd(x, lab):
+    xf = x.astype(jnp.float32)
+    lse = jax.nn.logsumexp(xf, axis=-1, keepdims=True)
+    picked = jnp.take_along_axis(xf, lab[..., None], axis=-1)
+    return (lse - picked)[..., 0], (x, lab, lse)
+
+
+def _softmax_nll_bwd(res, g):
+    x, lab, lse = res
+    p = jnp.exp(x.astype(jnp.float32) - lse)
+    oh = lab[..., None] == jnp.arange(x.shape[-1], dtype=lab.dtype)
+    dx = (p - oh.astype(p.dtype)) * g[..., None]
+    return dx.astype(x.dtype), np.zeros(np.shape(lab), jax.dtypes.float0)
+
+
+_softmax_nll.defvjp(_softmax_nll_fwd, _softmax_nll_bwd)
+
+
 def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction='mean', soft_label=False, axis=-1,
                   use_softmax=True, name=None):
@@ -36,33 +72,46 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
         ins.append(wrap(weight))
 
     def fn(logits, lab, *maybe_w):
-        if use_softmax:
-            logp = jax.nn.log_softmax(logits, axis=axis)
-        else:
-            logp = jnp.log(jnp.maximum(logits, 1e-30))
         if soft_label:
+            if use_softmax:
+                logp = jax.nn.log_softmax(logits, axis=axis)
+            else:
+                logp = jnp.log(jnp.maximum(logits, 1e-30))
             per = -jnp.sum(lab * logp, axis=axis)
             if maybe_w:
                 per = per * jnp.sum(lab * maybe_w[0], axis=axis)
             return _reduce(per, reduction)
         lab_i = lab.astype(jnp.int32)
-        if lab_i.ndim == logp.ndim:
+        if lab_i.ndim == logits.ndim:
             lab_i = jnp.squeeze(lab_i, axis=axis)
         safe = jnp.where(lab_i == ignore_index, 0, lab_i)
-        per = -jnp.take_along_axis(
-            logp, safe[..., None], axis=axis)[..., 0]
+        if use_softmax and axis in (-1, logits.ndim - 1):
+            # stays f32 through the reduction (bf16 accumulation over
+            # thousands of tokens rounds the sum AND the mask-count
+            # denominator); only the final result drops back
+            per = _softmax_nll(logits, safe)
+        else:
+            if use_softmax:
+                logp = jax.nn.log_softmax(logits, axis=axis)
+            else:
+                logp = jnp.log(jnp.maximum(logits, 1e-30))
+            per = -jnp.take_along_axis(
+                logp, safe[..., None], axis=axis)[..., 0]
+            per = per.astype(jnp.float32)
         mask = (lab_i != ignore_index)
         per = jnp.where(mask, per, 0.0)
+        out_dtype = logits.dtype
         if maybe_w:
             w = maybe_w[0][safe]
             per = per * jnp.where(mask, w, 0.0)
             if reduction == 'mean':
                 denom = jnp.sum(jnp.where(mask, w, 0.0))
-                return jnp.sum(per) / jnp.maximum(denom, 1e-12)
+                return (jnp.sum(per)
+                        / jnp.maximum(denom, 1e-12)).astype(out_dtype)
         if reduction == 'mean':
-            denom = jnp.maximum(jnp.sum(mask.astype(logp.dtype)), 1.0)
-            return jnp.sum(per) / denom
-        return _reduce(per, reduction)
+            denom = jnp.maximum(jnp.sum(mask.astype(per.dtype)), 1.0)
+            return (jnp.sum(per) / denom).astype(out_dtype)
+        return _reduce(per, reduction).astype(out_dtype)
 
     return apply(fn, *ins, op_name='cross_entropy')
 

@@ -313,3 +313,49 @@ class TestTpFusedCE:
                                    rtol=1e-4, atol=1e-5)
         np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestDenseCEBackward:
+    """F.cross_entropy's hard-label path carries a custom_vjp whose
+    backward is dense (softmax - one_hot) math instead of the autodiff
+    scatter-add (serialized on TPU; tools/bench_ce_backward.py)."""
+
+    def test_grad_matches_autodiff_gather(self):
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(3)
+        x = jnp.asarray(rs.randn(48, 53).astype('float32'))
+        lab = jnp.asarray(rs.randint(0, 53, size=(48,)), jnp.int32)
+        lab = lab.at[::5].set(-100)   # exercise ignore_index masking
+
+        def autodiff(xv):
+            logp = jax.nn.log_softmax(xv, -1)
+            mask = lab != -100
+            safe = jnp.where(mask, lab, 0)
+            per = -jnp.take_along_axis(logp, safe[:, None], -1)[:, 0]
+            per = jnp.where(mask, per, 0.0)
+            return per.sum() / mask.sum()
+
+        def ours(xv):
+            return F.cross_entropy(paddle.Tensor(xv),
+                                   paddle.Tensor(lab)).value
+
+        g_ref = jax.grad(autodiff)(x)
+        g_got = jax.grad(ours)(x)
+        np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_bf16_dtype_and_jaxpr_has_no_scatter(self):
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(4)
+        x = jnp.asarray(rs.randn(16, 33), jnp.bfloat16)
+        lab = jnp.asarray(rs.randint(0, 33, size=(16,)), jnp.int32)
+
+        def ours(xv):
+            return F.cross_entropy(
+                paddle.Tensor(xv),
+                paddle.Tensor(lab)).value.astype(jnp.float32)
+
+        g = jax.grad(ours)(x)
+        assert g.dtype == jnp.bfloat16
+        jaxpr = str(jax.make_jaxpr(jax.grad(ours))(x))
+        assert 'scatter' not in jaxpr
