@@ -12,7 +12,7 @@ from ..nn import functional as F
 from ..distributed.fleet.meta_parallel import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
 from ..parallel.api import maybe_shard
-from ..tensor import creation, linalg, manipulation
+from ..tensor import linalg, manipulation
 
 __all__ = ['BertConfig', 'BertModel', 'BertForPretraining', 'bert_tiny',
            'bert_base', 'bert_large']
@@ -145,8 +145,8 @@ class BertModel(nn.Layer):
 
     def forward(self, input_ids, token_type_ids=None, attn_mask=None):
         B, T = input_ids.shape
-        pos = creation.arange(0, T, dtype='int64')
-        x = self.word_emb(input_ids) + self.pos_emb(pos)
+        x = self.word_emb(input_ids) + F.embedding_prefix(
+            self.pos_emb.weight, T)
         if token_type_ids is not None:
             x = x + self.type_emb(token_type_ids)
         x = self.drop(self.ln(x))

@@ -307,8 +307,7 @@ class GPT(nn.Layer):
                 x, nc = blk(x, cache=c, pos=pos)
                 new_caches.append(nc)
             return self.ln_f(x), new_caches
-        posv = creation.arange(0, T, dtype='int64')
-        x = self.wte(input_ids) + self.wpe(posv)
+        x = self.wte(input_ids) + F.embedding_prefix(self.wpe.weight, T)
         x = self.drop(x)
         x = maybe_shard(x, _act_spec(self.config))
         for blk in self.blocks:

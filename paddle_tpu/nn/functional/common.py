@@ -12,7 +12,8 @@ from ...tensor._helpers import wrap, raw
 
 __all__ = [
     'linear', 'dropout', 'dropout2d', 'dropout3d', 'alpha_dropout',
-    'embedding', 'one_hot', 'pad', 'interpolate', 'upsample',
+    'embedding', 'embedding_prefix', 'one_hot', 'pad', 'interpolate',
+    'upsample',
     'cosine_similarity', 'normalize', 'label_smooth', 'bilinear',
     'pixel_shuffle', 'unfold',
 ]
@@ -84,6 +85,16 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
             out = jnp.where(mask, 0.0, out)
         return out
     return apply(fn, wrap(x), wrap(weight), op_name='embedding')
+
+
+def embedding_prefix(weight, length):
+    """First `length` rows of an embedding table — the training-path
+    position-embedding lookup.  Equivalent to
+    embedding(arange(length), weight) but a slice: its backward is a
+    pad (dense) where the arange-gather's backward is a row scatter
+    (HLO census, PERF.md round 4)."""
+    return apply(lambda w: w[:length], wrap(weight),
+                 op_name='embedding_prefix')
 
 
 def one_hot(x, num_classes, name=None):

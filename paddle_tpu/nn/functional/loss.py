@@ -105,7 +105,8 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
             w = maybe_w[0][safe]
             per = per * jnp.where(mask, w, 0.0)
             if reduction == 'mean':
-                denom = jnp.sum(jnp.where(mask, w, 0.0))
+                denom = jnp.sum(
+                    jnp.where(mask, w, 0.0).astype(jnp.float32))
                 return (jnp.sum(per)
                         / jnp.maximum(denom, 1e-12)).astype(out_dtype)
         if reduction == 'mean':

@@ -127,8 +127,11 @@ def _bwd_core(x, w, labels, lse, g, num_chunks, col0, axis=None):
                       jnp.exp(z - lse[:, None]), 0.0)      # [N, Vc]
         loc = local - c * Vc
         mine = owned & (loc >= 0) & (loc < Vc)
-        p = p.at[jnp.arange(N), jnp.clip(loc, 0, Vc - 1)].add(
-            jnp.where(mine, -1.0, 0.0))
+        # dense one-hot subtraction: the .at[].add element scatter here
+        # serialized on TPU (HLO census round 4 — 8184 single-f32
+        # updates per chunk); the iota compare fuses into the epilogue
+        oh = (loc[:, None] == jnp.arange(Vc)[None, :]) & mine[:, None]
+        p = p - oh.astype(p.dtype)
         d = p * g[:, None]                                  # [N, Vc]
         dw_c = jnp.dot(x.astype(jnp.float32).T, d,
                        preferred_element_type=jnp.float32)
