@@ -320,6 +320,12 @@ CONFIGS = {
     'gptgen': bench_gptgen,
 }
 
+# Per-config timeout scale.  Killing a child mid-compile is what WEDGES
+# the tunnel (round-2: 5h outage), so the configs whose remote compile
+# is slow get a generous window instead of a kill: gptgen's whole
+# prefill+decode scan is one big XLA module.
+TIMEOUT_SCALE = {'gptgen': 3}
+
 UNITS = {
     'lenet': 'imgs/sec/chip',
     'resnet': 'imgs/sec/chip',
@@ -446,7 +452,9 @@ def main():
     p.add_argument('--single-json', action='store_true',
                    help='(internal) emit one config result as raw JSON')
     p.add_argument('--timeout', type=int, default=900,
-                   help='per-config subprocess timeout (seconds)')
+                   help='per-config subprocess timeout in seconds '
+                        '(slow-compile configs scale it by '
+                        'TIMEOUT_SCALE, e.g. gptgen x3)')
     args = p.parse_args()
 
     if args.single_json:
@@ -468,7 +476,9 @@ def main():
         names = []
     for i, name in enumerate(names):
         if args.config == 'all':
-            results[name] = _run_isolated(name, args.smoke, args.timeout)
+            results[name] = _run_isolated(
+                name, args.smoke,
+                args.timeout * TIMEOUT_SCALE.get(name, 1))
             # partial artifact after EVERY config: a tunnel death (or
             # driver kill) mid-run keeps the finished configs' numbers
             _write_partial(results)
