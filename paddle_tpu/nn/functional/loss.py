@@ -64,6 +64,31 @@ def _softmax_nll_bwd(res, g):
 _softmax_nll.defvjp(_softmax_nll_fwd, _softmax_nll_bwd)
 
 
+@jax.custom_vjp
+def _pick_nll(logp, lab):
+    """-logp[..., lab] over the last axis, with a dense -one_hot*g
+    backward (the autodiff gather backward is a serialized scatter on
+    TPU, same pathology as _softmax_nll)."""
+    return -jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
+
+
+def _pick_nll_fwd(logp, lab):
+    # residual carries class count + dtype as a [C]-zeros template
+    # (custom_vjp residuals must be arrays, not dtype objects)
+    tmpl = jnp.zeros((logp.shape[-1],), logp.dtype)
+    return _pick_nll(logp, lab), (lab, tmpl)
+
+
+def _pick_nll_bwd(res, g):
+    lab, tmpl = res
+    oh = lab[..., None] == jnp.arange(tmpl.shape[0], dtype=lab.dtype)
+    dlogp = jnp.where(oh, -g[..., None], 0.0).astype(tmpl.dtype)
+    return dlogp, np.zeros(np.shape(lab), jax.dtypes.float0)
+
+
+_pick_nll.defvjp(_pick_nll_fwd, _pick_nll_bwd)
+
+
 def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction='mean', soft_label=False, axis=-1,
                   use_softmax=True, name=None):
@@ -90,6 +115,10 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
             # thousands of tokens rounds the sum AND the mask-count
             # denominator); only the final result drops back
             per = _softmax_nll(logits, safe)
+        elif axis in (-1, logits.ndim - 1):
+            # prob-input path, same dense backward as the softmax one
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+            per = _pick_nll(logp, safe).astype(jnp.float32)
         else:
             if use_softmax:
                 logp = jax.nn.log_softmax(logits, axis=axis)
@@ -202,9 +231,13 @@ def nll_loss(input, label, weight=None, ignore_index=-100, reduction='mean',
         ins.append(wrap(weight))
 
     def fn(logp, lab, *maybe_w):
+        if logp.ndim > 2:
+            # reference contract: classes live at axis 1 for
+            # (N, C, d1..dK) inputs with (N, d1..dK) labels
+            logp = jnp.moveaxis(logp, 1, -1)
         lab_i = lab.astype(jnp.int32)
         safe = jnp.where(lab_i == ignore_index, 0, lab_i)
-        per = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        per = _pick_nll(logp, safe)
         mask = lab_i != ignore_index
         per = jnp.where(mask, per, 0.0)
         if maybe_w:

@@ -359,3 +359,38 @@ class TestDenseCEBackward:
         assert g.dtype == jnp.bfloat16
         jaxpr = str(jax.make_jaxpr(jax.grad(ours))(x))
         assert 'scatter' not in jaxpr
+
+    def test_nll_loss_dense_backward(self):
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(5)
+        logp = jax.nn.log_softmax(
+            jnp.asarray(rs.randn(24, 19), jnp.float32), -1)
+        lab = jnp.asarray(rs.randint(0, 19, size=(24,)), jnp.int32)
+        lab = lab.at[::6].set(-100)
+
+        def ours(lp):
+            return F.nll_loss(paddle.Tensor(lp), paddle.Tensor(lab)).value
+
+        def ref(lp):
+            m = lab != -100
+            s = jnp.where(m, lab, 0)
+            p = -jnp.take_along_axis(lp, s[:, None], -1)[:, 0] * m
+            return p.sum() / m.sum()
+
+        np.testing.assert_allclose(np.asarray(jax.grad(ours)(logp)),
+                                   np.asarray(jax.grad(ref)(logp)),
+                                   rtol=1e-6, atol=1e-7)
+        assert 'scatter' not in str(jax.make_jaxpr(jax.grad(ours))(logp))
+
+    def test_nll_loss_rank4_classes_axis1(self):
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(6)
+        lp = jax.nn.log_softmax(
+            jnp.asarray(rs.randn(4, 6, 5, 3), jnp.float32), 1)
+        lab = jnp.asarray(rs.randint(0, 6, size=(4, 5, 3)), jnp.int32)
+        got = F.nll_loss(paddle.Tensor(lp), paddle.Tensor(lab)).numpy()
+        lpn, labn = np.asarray(lp), np.asarray(lab)
+        want = -np.mean([lpn[n, labn[n, i, j], i, j]
+                         for n in range(4) for i in range(5)
+                         for j in range(3)])
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
