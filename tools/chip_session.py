@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""One-command chip session: run every hardware-blocked measurement in
+CHIPDAY.md order, persisting per-step artifacts so a mid-session tunnel
+wedge loses nothing.
+
+    python tools/chip_session.py            # run all pending steps
+    python tools/chip_session.py --watch    # poll until the tunnel
+                                            # answers, then run
+
+Design rules (learned the hard way — see PERF.md and the verify skill):
+- every step runs in ITS OWN subprocess with a GENEROUS timeout
+  (killing a python mid-TPU-compile wedges the tunnel for hours);
+- each step's stdout/stderr land in tools/chip_out/<step>.log, and a
+  step that already has a .ok marker is skipped on re-run;
+- after any step fails or times out, a 90s preflight decides between
+  continuing and stopping (a dead tunnel fails everything downstream
+  anyway — better to leave the queue intact for the next window).
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, 'tools', 'chip_out')
+
+# (name, argv, timeout_s) — order matters: cheap/valuable first, the
+# historical wedge offender (gptgen inside bench.py) is covered by
+# bench.py's own per-config isolation + TIMEOUT_SCALE.
+STEPS = [
+    ('bench', [sys.executable, 'bench.py'], 3 * 3600),
+    ('fused_head_ab',
+     [sys.executable, 'tools/bench_fused_head.py', '--iters', '15'],
+     45 * 60),
+    ('ce_backward',
+     [sys.executable, 'tools/bench_ce_backward.py'], 30 * 60),
+    ('tune_flash', [sys.executable, 'tools/tune_flash.py'], 3 * 3600),
+    ('census_gpt',
+     [sys.executable, 'tools/profile_transformer.py', '--model', 'gpt'],
+     45 * 60),
+    ('census_bert',
+     [sys.executable, 'tools/profile_transformer.py', '--model', 'bert'],
+     45 * 60),
+    ('profile_resnet', [sys.executable, 'tools/profile_resnet.py'],
+     45 * 60),
+    ('perf_experiments', [sys.executable, 'tools/perf_experiments.py'],
+     2 * 3600),
+]
+
+
+def log(msg):
+    print(f'[chip_session +{time.strftime("%H:%M:%S")}] {msg}',
+          file=sys.stderr, flush=True)
+
+
+def preflight(timeout_s=90):
+    """True iff the accelerator answers a tiny jit within timeout_s.
+    Runs in a child so a wedged tunnel cannot hang US."""
+    code = ('import jax, numpy as np, jax.numpy as jnp;'
+            'print(float(np.asarray(jax.jit(lambda a: a.sum())'
+            '(jnp.ones((8, 8))))))')
+    try:
+        p = subprocess.run([sys.executable, '-c', code], cwd=REPO,
+                           capture_output=True, timeout=timeout_s)
+        return p.returncode == 0 and b'64.0' in p.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_step(name, argv, timeout_s):
+    okf = os.path.join(OUT, f'{name}.ok')
+    if os.path.exists(okf):
+        log(f'{name}: already done (rm {okf} to re-run)')
+        return True
+    logf = os.path.join(OUT, f'{name}.log')
+    log(f'{name}: starting (timeout {timeout_s}s), log: {logf}')
+    t0 = time.time()
+    with open(logf, 'w') as fh:
+        try:
+            p = subprocess.run(argv, cwd=REPO, stdout=fh,
+                               stderr=subprocess.STDOUT,
+                               timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            log(f'{name}: TIMED OUT after {timeout_s}s')
+            return False
+    dt = time.time() - t0
+    if p.returncode == 0:
+        with open(okf, 'w') as fh:
+            fh.write(json.dumps({'t': time.time(), 'dur_s': dt}))
+        log(f'{name}: ok in {dt:.0f}s')
+        return True
+    log(f'{name}: FAILED rc={p.returncode} after {dt:.0f}s '
+        f'(tail: see {logf})')
+    return False
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--watch', action='store_true',
+                    help='poll the tunnel every 120s until it answers')
+    ap.add_argument('--only', default=None,
+                    help='comma-separated step names')
+    args = ap.parse_args()
+    os.makedirs(OUT, exist_ok=True)
+
+    if args.only:
+        want = [w.strip() for w in args.only.split(',') if w.strip()]
+        known = {s[0] for s in STEPS}
+        bad = [w for w in want if w not in known]
+        if bad:
+            log(f'unknown step(s) {bad}; choose from {sorted(known)}')
+            sys.exit(2)
+        steps = [s for s in STEPS if s[0] in want]
+    else:
+        steps = STEPS
+
+    if args.watch:
+        n = 0
+        while not preflight(90):
+            n += 1
+            log(f'tunnel dead (probe {n}); sleeping 120s')
+            time.sleep(120)
+    if not preflight(120):
+        log('tunnel not answering; aborting (re-run with --watch)')
+        sys.exit(2)
+    log('tunnel alive — running queued steps')
+
+    for name, argv, timeout_s in steps:
+        if not run_step(name, argv, timeout_s):
+            if not preflight(90):
+                log('tunnel died mid-session; stopping so the queue '
+                    'survives for the next window')
+                sys.exit(3)
+            log('tunnel still alive after failure; continuing')
+    log('session complete')
+
+
+if __name__ == '__main__':
+    main()
