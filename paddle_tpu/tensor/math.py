@@ -298,6 +298,41 @@ def tanh_(x, name=None):
     return x
 
 
+def exp_(x, name=None):
+    x._replace(exp(x._snapshot()))
+    return x
+
+
+def ceil_(x, name=None):
+    x._replace(ceil(x._snapshot()))
+    return x
+
+
+def floor_(x, name=None):
+    x._replace(floor(x._snapshot()))
+    return x
+
+
+def reciprocal_(x, name=None):
+    x._replace(reciprocal(x._snapshot()))
+    return x
+
+
+def round_(x, name=None):
+    x._replace(round(x._snapshot()))
+    return x
+
+
+def rsqrt_(x, name=None):
+    x._replace(rsqrt(x._snapshot()))
+    return x
+
+
+def sqrt_(x, name=None):
+    x._replace(sqrt(x._snapshot()))
+    return x
+
+
 def add_n(inputs, name=None):
     """Elementwise sum of a list of tensors (reference: sum op add_n)."""
     if not isinstance(inputs, (list, tuple)):
@@ -351,4 +386,10 @@ def broadcast_shape(x_shape, y_shape):
 
 
 __all__ += ['add_', 'subtract_', 'clip_', 'scale_', 'tanh_', 'add_n',
-            'trace', 'conj', 'real', 'imag', 'broadcast_shape']
+            'trace', 'conj', 'real', 'imag', 'broadcast_shape',
+            'exp_', 'ceil_', 'floor_', 'reciprocal_', 'round_',
+            'rsqrt_', 'sqrt_']
+# NOTE: reference tensor_method_func also lists 'mul', but its binder
+# (fluid/dygraph/math_op_patch.py:331) getattr-skips names missing from
+# paddle.tensor — 'mul' is one, so reference Tensor has NO mul method;
+# the only real 1.x mul (flatten-matmul) lives in fluid.layers.mul.

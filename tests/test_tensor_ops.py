@@ -406,3 +406,39 @@ class TestRound2SurfaceOps:
         paddle.check_shape([2, 3])
         with pytest.raises(TypeError):
             paddle.check_shape(object())
+
+
+class TestInplaceLongTail:
+    """Trailing-underscore variants bound as tensor methods
+    (reference tensor_method_func: exp_, ceil_, floor_,
+    reciprocal_, round_, rsqrt_, sqrt_)."""
+
+    def test_inplace_variants_mutate_and_backprop(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.array([4.0, 9.0], 'float32'))
+        x.stop_gradient = False
+        y = x.multiply(paddle.to_tensor(np.array([2.0, 2.0], 'float32')))
+        z = y.sqrt_()
+        assert z is y
+        np.testing.assert_allclose(z.numpy(), np.sqrt([8.0, 18.0]),
+                                   rtol=1e-5)
+        z.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   2 / (2 * np.sqrt([8.0, 18.0])),
+                                   rtol=1e-5)
+
+    def test_each_inplace_matches_functional(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        rs = np.random.RandomState(0)
+        base = np.abs(rs.randn(5).astype('float32')) + 0.5
+        for name in ['exp_', 'ceil_', 'floor_', 'reciprocal_',
+                     'round_', 'rsqrt_', 'sqrt_']:
+            t = paddle.to_tensor(base.copy())
+            out = getattr(t, name)()
+            want = getattr(paddle, name[:-1])(
+                paddle.to_tensor(base.copy())).numpy()
+            np.testing.assert_allclose(out.numpy(), want, rtol=1e-6,
+                                       err_msg=name)
+            np.testing.assert_allclose(t.numpy(), want, rtol=1e-6)

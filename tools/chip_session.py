@@ -122,19 +122,28 @@ def main():
             n += 1
             log(f'tunnel dead (probe {n}); sleeping 120s')
             time.sleep(120)
-    if not preflight(120):
+    elif not preflight(120):
         log('tunnel not answering; aborting (re-run with --watch)')
         sys.exit(2)
     log('tunnel alive — running queued steps')
 
+    failed = []
     for name, argv, timeout_s in steps:
         if not run_step(name, argv, timeout_s):
+            failed.append(name)
             if not preflight(90):
                 log('tunnel died mid-session; stopping so the queue '
                     'survives for the next window')
+                pending = [s[0] for s in steps
+                           if not os.path.exists(
+                               os.path.join(OUT, f'{s[0]}.ok'))]
+                log(f'pending steps: {pending}')
                 sys.exit(3)
             log('tunnel still alive after failure; continuing')
-    log('session complete')
+    if failed:
+        log(f'session finished with FAILED steps: {failed}')
+        sys.exit(1)
+    log('session complete — all steps ok')
 
 
 if __name__ == '__main__':
