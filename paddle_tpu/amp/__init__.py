@@ -41,6 +41,14 @@ BLACK_LIST = frozenset({
     'kl_div', 'cosh', 'sinh', 'tan', 'mean', 'sum', 'norm', 'dist',
     'reduce_mean', 'reduce_sum', 'cumsum', 'logsumexp', 'softplus',
     'erf', 'erfinv', 'lgamma', 'digamma', 'cross_entropy_loss',
+    # loss heads compute in f32 even when the step runs under an O1/O2
+    # autocast (ParallelTrainer wraps loss_fn in the forward's policy):
+    # each dispatches as ONE op, so without this a bf16 forward output
+    # would drag the f32 labels down via the gray/O2 rules
+    'mse_loss', 'l1_loss', 'square_error_cost', 'smooth_l1_loss',
+    'margin_ranking_loss', 'hinge_embedding_loss',
+    'cosine_embedding_loss', 'log_loss', 'ctc_loss',
+    'sigmoid_focal_loss',
 })
 
 # Normalization ops manage their own mixed precision: the functionals in

@@ -261,18 +261,21 @@ class ParallelTrainer:
 
     # -- step builders -------------------------------------------------------
     def _forward_loss(self, params, buffers, key, batch):
+        import contextlib
         from ..jit import functional_call
+        from .. import amp as amp_mod
         xs, ys = batch[:self.n_inputs], batch[self.n_inputs:]
         amp_on = bool(self.strategy and self.strategy.amp)
 
+        def autocast():
+            if not amp_on:
+                return contextlib.nullcontext()
+            return amp_mod.auto_cast(
+                level='O2' if self.strategy.amp_configs.get(
+                    'use_pure_fp16') else 'O1')
+
         def run(params, xs):
-            import contextlib
-            from .. import amp as amp_mod
-            cm = amp_mod.auto_cast(level='O2' if (
-                self.strategy and self.strategy.amp_configs.get(
-                    'use_pure_fp16')) else 'O1') if amp_on else \
-                contextlib.nullcontext()
-            with cm:
+            with autocast():
                 out, new_buffers = functional_call(
                     self.model, params, buffers, xs, key=key,
                     training=True)
@@ -285,7 +288,13 @@ class ParallelTrainer:
             lambda v: Tensor._from_value(v), out)
         ys_t = [Tensor._from_value(y) for y in ys]
         from ..core.autograd import no_grad
-        with no_grad():
+        # the loss runs under the SAME amp policy as the forward (the
+        # reference decorates the whole step): the black list promotes
+        # loss inputs to f32, so a bf16 forward cannot round the loss —
+        # without this the CE out_dtype contract hands back a
+        # bf16-quantized scalar (caught by the round-4 A/B trajectories
+        # landing exactly on the bf16 grid)
+        with no_grad(), autocast():
             loss = self.loss_fn(out_t, *ys_t)
         loss_v = loss.value if isinstance(loss, Tensor) else loss
         return loss_v.astype(jnp.float32).mean(), new_buffers
