@@ -37,7 +37,7 @@ BLACK_LIST = frozenset({
     'exp', 'expm1', 'log', 'log2', 'log10', 'log1p', 'pow', 'square',
     'sqrt', 'rsqrt', 'reciprocal', 'softmax', 'log_softmax',
     'cross_entropy', 'softmax_with_cross_entropy', 'nll_loss',
-    'binary_cross_entropy', 'binary_cross_entropy_with_logits',
+    'binary_cross_entropy', 'bce_with_logits',
     'kl_div', 'cosh', 'sinh', 'tan', 'mean', 'sum', 'norm', 'dist',
     'reduce_mean', 'reduce_sum', 'cumsum', 'logsumexp', 'softplus',
     'erf', 'erfinv', 'lgamma', 'digamma', 'cross_entropy_loss',

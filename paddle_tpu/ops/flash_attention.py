@@ -22,7 +22,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from . import _gating
 
-__all__ = ['flash_attention', 'can_use_pallas', 'autotune_blocks']
+__all__ = ['flash_attention', 'flash_attention_lse', 'can_use_pallas',
+           'autotune_blocks']
 
 # tuned on v5e at T=4096 D=128: (256, 512) beats XLA's fused einsum
 # attention by ~21%; see bench history
@@ -323,7 +324,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
 
 
-def _bwd_pallas(res, g, scale, causal, block_q, block_k):
+def _bwd_pallas(res, g, scale, causal, block_q, block_k, g_lse=None):
     q, k, v, out, lse = res
     bh, tq, d = q.shape
     tk = k.shape[1]
@@ -333,6 +334,11 @@ def _bwd_pallas(res, g, scale, causal, block_q, block_k):
     # TPU lowering accepts (vs 128 lanes: 16x less HBM traffic)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)
+    if g_lse is not None:
+        # lse cotangent (streaming-merge callers): dlse/ds = p, so the
+        # contribution p*g_lse folds into ds = p*(dp - delta) exactly
+        # as delta' = delta - g_lse — the kernels stay unchanged
+        delta = delta - g_lse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[:, :, None], (bh, tq, 8))
 
     dq_kernel = functools.partial(
@@ -421,6 +427,31 @@ def _flash_bwd(causal, scale, block_q, block_k, res, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_lse(q, k, v, causal, scale, block_q, block_k):
+    """Pallas attention returning (out, lse[bh, tq]) for streaming-
+    merge callers (ring attention combines per-block partials in
+    (out, lse) space).  The lse cotangent is exact: it folds into the
+    shared backward kernels as delta' = delta - g_lse
+    (_bwd_pallas), since d lse / d s = softmax(s)."""
+    out, lse8 = _fwd_pallas(q, k, v, scale, causal, block_q, block_k)
+    return out, lse8[:, :, 0]
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k):
+    out, lse8 = _fwd_pallas(q, k, v, scale, causal, block_q, block_k)
+    return (out, lse8[:, :, 0]), (q, k, v, out, lse8)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, res, g):
+    g_out, g_lse = g
+    return _bwd_pallas(res, g_out, scale, causal, block_q, block_k,
+                       g_lse=g_lse)
+
+
+flash_attention_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def flash_attention(q, k, v, causal=False, scale=None,

@@ -47,15 +47,42 @@ def _block_attend(q, k, v, q_chunk, k_chunk, t_local, causal):
     return m, l, o
 
 
-def ring_attention(q, k, v, axis_name, causal=True, scale=None):
+def ring_attention(q, k, v, axis_name, causal=True, scale=None,
+                   use_flash=None):
     """Attention inside shard_map: q/k/v are the LOCAL [B*H, T/sp, D]
     shards; K/V rotate around `axis_name`.  Returns local output shard.
+
+    Two per-block engines:
+    - einsum (default off-TPU): O((T/sp)^2) scores per block, masked.
+    - flash (`use_flash`, auto on TPU when the local shapes tile): each
+      visible block runs the Pallas kernel via flash_attention_lse and
+      partials merge in (out, lse) space — per-block memory drops to
+      O(block) and the kernel skips masked tiles, so the diagonal block
+      costs half.  Fully-masked future blocks skip compute entirely in
+      BOTH engines (lax.cond/switch on the rotated chunk index).
     """
     sp = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
     t_local = q.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if use_flash is None:
+        # gate on pallas_tpu_ok, NOT pallas_backend_ok: ring attention
+        # always runs inside a shard_map on an sp-mesh, where the
+        # kernel sees only its local shard (the same r3 finding that
+        # created can_use_pallas_spmd — a mesh must not veto here)
+        from ._gating import pallas_tpu_ok
+        from .flash_attention import _tuned_blocks
+        fbq, fbk = _tuned_blocks(t_local, t_local, q.shape[-1], causal)
+        fbq, fbk = min(fbq, t_local), min(fbk, t_local)
+        use_flash = (pallas_tpu_ok()
+                     and t_local % fbq == 0 and t_local % fbk == 0
+                     and q.shape[-1] % 64 == 0
+                     and fbq >= 128 and fbk >= 128)
+    if use_flash:
+        return _ring_flash(q, k, v, axis_name, causal, scale, sp, rank,
+                           t_local)
+
     qs = q.astype(jnp.float32) * scale
 
     perm = [(i, (i + 1) % sp) for i in range(sp)]
@@ -69,6 +96,13 @@ def ring_attention(q, k, v, axis_name, causal=True, scale=None):
         return (m_new, l_acc * alpha + l * beta,
                 o_acc * alpha + o * beta)
 
+    def skipped(kb, vb):
+        # identity partial under merge (m=NEG_INF => beta==0)
+        shp = (qs.shape[0], t_local, 1)
+        return (jnp.full(shp, NEG_INF, jnp.float32),
+                jnp.zeros(shp, jnp.float32),
+                jnp.zeros(qs.shape, jnp.float32))
+
     @jax.checkpoint
     def step(carry, i):
         m_acc, l_acc, o_acc, kb, vb = carry
@@ -77,7 +111,15 @@ def ring_attention(q, k, v, axis_name, causal=True, scale=None):
         kb = jax.lax.ppermute(kb, axis_name, perm)
         vb = jax.lax.ppermute(vb, axis_name, perm)
         k_chunk = (rank - i) % sp
-        part = _block_attend(qs, kb, vb, rank, k_chunk, t_local, causal)
+        if causal:
+            # future chunks are fully masked — skip their FLOPs
+            part = jax.lax.cond(
+                k_chunk > rank, skipped,
+                lambda kb, vb: _block_attend(qs, kb, vb, rank, k_chunk,
+                                             t_local, causal), kb, vb)
+        else:
+            part = _block_attend(qs, kb, vb, rank, k_chunk, t_local,
+                                 causal)
         m_acc, l_acc, o_acc = merge((m_acc, l_acc, o_acc), part)
         return (m_acc, l_acc, o_acc, kb, vb), None
 
@@ -89,8 +131,60 @@ def ring_attention(q, k, v, axis_name, causal=True, scale=None):
     return out.astype(q.dtype)
 
 
+def _ring_flash(q, k, v, axis_name, causal, scale, sp, rank, t_local):
+    """Flash-blocked ring: every visible block is one Pallas kernel
+    call; partials merge in (out, lse) space.  The lse gradient is
+    exact through flash_attention_lse's custom vjp."""
+    from .flash_attention import flash_attention_lse, _tuned_blocks
+    bq, bk = _tuned_blocks(t_local, t_local, q.shape[-1], causal)
+    bq, bk = min(bq, t_local), min(bk, t_local)
+    f32 = jnp.float32
+
+    def full_blk(kb, vb):
+        o, l = flash_attention_lse(q, kb, vb, False, scale, bq, bk)
+        return o.astype(f32), l
+
+    def diag_blk(kb, vb):
+        o, l = flash_attention_lse(q, kb, vb, True, scale, bq, bk)
+        return o.astype(f32), l
+
+    def skip_blk(kb, vb):
+        return (jnp.zeros(q.shape, f32),
+                jnp.full(q.shape[:2], -jnp.inf, f32))
+
+    def merge(acc, part):
+        o_a, l_a = acc
+        o_b, l_b = part
+        l_n = jnp.logaddexp(l_a, l_b)
+        # l_a is finite after the home block, so no -inf - -inf NaN
+        return (o_a * jnp.exp(l_a - l_n)[..., None]
+                + o_b * jnp.exp(l_b - l_n)[..., None], l_n)
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    @jax.checkpoint
+    def step(carry, i):
+        o_acc, l_acc, kb, vb = carry
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        k_chunk = (rank - i) % sp
+        if causal:
+            part = jax.lax.cond(k_chunk > rank, skip_blk, full_blk,
+                                kb, vb)
+        else:
+            part = full_blk(kb, vb)
+        o_acc, l_acc = merge((o_acc, l_acc), part)
+        return (o_acc, l_acc, kb, vb), None
+
+    o0, l0 = diag_blk(k, v) if causal else full_blk(k, v)
+    (o_acc, l_acc, _, _), _ = jax.lax.scan(
+        step, (o0, l0, k, v), jnp.arange(1, sp))
+    return o_acc.astype(q.dtype)
+
+
 def ring_attention_spmd(q, k, v, mesh, causal=True,
-                        batch_axes=('dp', 'tp'), seq_axis='sp'):
+                        batch_axes=('dp', 'tp'), seq_axis='sp',
+                        use_flash=None):
     """shard_map wrapper: q/k/v are GLOBAL [B*H, T, D] arrays (traced
     under jit on `mesh`); heads/batch split over `batch_axes`, sequence
     over `seq_axis`; ring rotation rides the `sp` ICI ring."""
@@ -98,6 +192,6 @@ def ring_attention_spmd(q, k, v, mesh, causal=True,
     spec = P(axes if len(axes) > 1 else (axes[0] if axes else None),
              seq_axis, None)
     fn = functools.partial(ring_attention, axis_name=seq_axis,
-                           causal=causal)
+                           causal=causal, use_flash=use_flash)
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
