@@ -122,16 +122,28 @@ def autotune_blocks(tq, tk, d, causal=True, dtype=jnp.bfloat16,
     return best, best_ms
 
 
-def _reference(q, k, v, causal, scale):
+def _reference_lse(q, k, v, causal, scale):
     s = jnp.einsum('bqd,bkd->bqk', q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if causal:
         tq, tk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((tq, tk), dtype=bool))
+        mask = jnp.tril(jnp.ones((tq, tk), dtype=bool),
+                        k=-1 if causal == 'strict' else 0)
         s = jnp.where(mask, s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum('bqk,bkd->bqd', p, v.astype(jnp.float32)) \
-        .astype(q.dtype)
+    # masked-softmax that zeroes fully-masked rows (strict mode's row
+    # 0) instead of going uniform — matches the Pallas kernels
+    m = jnp.maximum(jnp.max(s, axis=-1, keepdims=True), -1e29)
+    p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m))
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum('bqk,bkd->bqd', p, v.astype(jnp.float32)) \
+        / jnp.maximum(l, 1e-30)
+    lse = (m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]
+    return o, lse
+
+
+def _reference(q, k, v, causal, scale):
+    o, _ = _reference_lse(q, k, v, causal, scale)
+    return o.astype(q.dtype)
 
 
 # -- forward kernel ----------------------------------------------------------
@@ -159,11 +171,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 jnp.int32, (block_q, block_k), 0) + qi * block_q
             cols = jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1) + ki * block_k
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = jnp.where(rows > cols if causal == 'strict'
+                          else rows >= cols, s, NEG_INF)
         m_prev = m_sc[:, :1]                              # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)                            # [bq, bk]
+        if causal == 'strict':
+            # a fully-masked row (global token 0) has m_new == NEG_INF,
+            # making exp(s - m_new) == 1 on masked cells — zero them
+            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         alpha = jnp.exp(m_prev - m_new)                   # [bq, 1]
         l_new = alpha * l_sc[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         acc_sc[:] = acc_sc[:] * alpha + jax.lax.dot_general(
@@ -250,8 +267,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 0) + qi * block_q
             cols = jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1) + ki * block_k
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)                              # [bq, bk]
+            s = jnp.where(rows > cols if causal == 'strict'
+                          else rows >= cols, s, NEG_INF)
+        p = jnp.exp(jnp.minimum(s - lse, 0.0))            # [bq, bk]
+        if causal == 'strict':
+            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         dp = jax.lax.dot_general(
             do, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [bq, bk]
@@ -298,8 +318,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 0) + qi * block_q
             cols = jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1) + ki * block_k
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)                              # [bq, bk]
+            s = jnp.where(rows > cols if causal == 'strict'
+                          else rows >= cols, s, NEG_INF)
+        p = jnp.exp(jnp.minimum(s - lse, 0.0))            # [bq, bk]
+        if causal == 'strict':
+            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         dv_sc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # [bk, d]
@@ -430,12 +453,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention_lse(q, k, v, causal, scale, block_q, block_k):
-    """Pallas attention returning (out, lse[bh, tq]) for streaming-
-    merge callers (ring attention combines per-block partials in
-    (out, lse) space).  The lse cotangent is exact: it folds into the
-    shared backward kernels as delta' = delta - g_lse
-    (_bwd_pallas), since d lse / d s = softmax(s)."""
+def _flash_lse(q, k, v, causal, scale, block_q, block_k):
     out, lse8 = _fwd_pallas(q, k, v, scale, causal, block_q, block_k)
     return out, lse8[:, :, 0]
 
@@ -451,7 +469,26 @@ def _flash_lse_bwd(causal, scale, block_q, block_k, res, g):
                        g_lse=g_lse)
 
 
-flash_attention_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_lse(q, k, v, causal, scale, block_q, block_k):
+    """Attention returning (out, lse[bh, tq]) for streaming-merge
+    callers (ring attention combines per-block partials in (out, lse)
+    space).  The lse cotangent is exact: it folds into the shared
+    backward kernels as delta' = delta - g_lse (_bwd_pallas), since
+    d lse / d s = softmax(s).  Falls back to the jnp reference when
+    Pallas is unavailable or the shapes don't tile, like
+    flash_attention."""
+    from ._gating import pallas_tpu_ok
+    bq = min(block_q, q.shape[1])
+    bk = min(block_k, k.shape[1])
+    if (pallas_tpu_ok() and q.shape[1] % bq == 0
+            and k.shape[1] % bk == 0 and q.shape[2] % 64 == 0
+            and bq >= 128 and bk >= 128):
+        return _flash_lse(q, k, v, causal, scale, bq, bk)
+    o, lse = _reference_lse(q, k, v, causal, scale)
+    return o.astype(q.dtype), lse
 
 
 def flash_attention(q, k, v, causal=False, scale=None,

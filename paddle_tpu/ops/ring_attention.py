@@ -19,9 +19,35 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-__all__ = ['ring_attention', 'ring_attention_spmd']
+__all__ = ['ring_attention', 'ring_attention_spmd', 'stripe_tokens',
+           'unstripe_tokens', 'ring_attention_striped']
 
 NEG_INF = -1e30
+
+
+def _flash_gate_and_blocks(t_local, d, causal):
+    """(ok, bq, bk): may the per-block engine take the Pallas kernel?
+    Gates on pallas_tpu_ok, NOT pallas_backend_ok: the ring always runs
+    inside a shard_map on an sp-mesh, where the kernel only ever sees
+    its local shard (same r3 finding that created
+    can_use_pallas_spmd — an installed mesh must not veto)."""
+    from ._gating import pallas_tpu_ok
+    from .flash_attention import _tuned_blocks
+    bq, bk = _tuned_blocks(t_local, t_local, d, causal)
+    bq, bk = min(bq, t_local), min(bk, t_local)
+    ok = (pallas_tpu_ok() and t_local % bq == 0 and t_local % bk == 0
+          and d % 64 == 0 and bq >= 128 and bk >= 128)
+    return ok, bq, bk
+
+
+def _merge_lse(acc, part):
+    """Streaming merge of (out, lse) partials; the accumulator's lse is
+    finite after the home block, so a skipped partial's -inf is safe."""
+    o_a, l_a = acc
+    o_b, l_b = part
+    l_n = jnp.logaddexp(l_a, l_b)
+    return (o_a * jnp.exp(l_a - l_n)[..., None]
+            + o_b * jnp.exp(l_b - l_n)[..., None], l_n)
 
 
 def _block_attend(q, k, v, q_chunk, k_chunk, t_local, causal):
@@ -67,18 +93,8 @@ def ring_attention(q, k, v, axis_name, causal=True, scale=None,
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if use_flash is None:
-        # gate on pallas_tpu_ok, NOT pallas_backend_ok: ring attention
-        # always runs inside a shard_map on an sp-mesh, where the
-        # kernel sees only its local shard (the same r3 finding that
-        # created can_use_pallas_spmd — a mesh must not veto here)
-        from ._gating import pallas_tpu_ok
-        from .flash_attention import _tuned_blocks
-        fbq, fbk = _tuned_blocks(t_local, t_local, q.shape[-1], causal)
-        fbq, fbk = min(fbq, t_local), min(fbk, t_local)
-        use_flash = (pallas_tpu_ok()
-                     and t_local % fbq == 0 and t_local % fbk == 0
-                     and q.shape[-1] % 64 == 0
-                     and fbq >= 128 and fbk >= 128)
+        use_flash, _, _ = _flash_gate_and_blocks(t_local, q.shape[-1],
+                                                 causal)
     if use_flash:
         return _ring_flash(q, k, v, axis_name, causal, scale, sp, rank,
                            t_local)
@@ -135,9 +151,8 @@ def _ring_flash(q, k, v, axis_name, causal, scale, sp, rank, t_local):
     """Flash-blocked ring: every visible block is one Pallas kernel
     call; partials merge in (out, lse) space.  The lse gradient is
     exact through flash_attention_lse's custom vjp."""
-    from .flash_attention import flash_attention_lse, _tuned_blocks
-    bq, bk = _tuned_blocks(t_local, t_local, q.shape[-1], causal)
-    bq, bk = min(bq, t_local), min(bk, t_local)
+    from .flash_attention import flash_attention_lse
+    _, bq, bk = _flash_gate_and_blocks(t_local, q.shape[-1], causal)
     f32 = jnp.float32
 
     def full_blk(kb, vb):
@@ -152,14 +167,7 @@ def _ring_flash(q, k, v, axis_name, causal, scale, sp, rank, t_local):
         return (jnp.zeros(q.shape, f32),
                 jnp.full(q.shape[:2], -jnp.inf, f32))
 
-    def merge(acc, part):
-        o_a, l_a = acc
-        o_b, l_b = part
-        l_n = jnp.logaddexp(l_a, l_b)
-        # l_a is finite after the home block, so no -inf - -inf NaN
-        return (o_a * jnp.exp(l_a - l_n)[..., None]
-                + o_b * jnp.exp(l_b - l_n)[..., None], l_n)
-
+    merge = _merge_lse
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
     @jax.checkpoint
@@ -182,15 +190,128 @@ def _ring_flash(q, k, v, axis_name, causal, scale, sp, rank, t_local):
     return o_acc.astype(q.dtype)
 
 
+def stripe_tokens(x, sp, axis=1):
+    """Natural -> striped token order: token t = i*sp + s moves to
+    position s*(T/sp) + i, so a contiguous shard s over `axis` holds
+    the STRIDED tokens {s, s+sp, s+2sp, ...}.  Apply once at the model
+    boundary (ids in, logits/labels out) — attention is the only
+    position-coupled op, so the hidden states can live striped."""
+    T = x.shape[axis]
+    t_local = T // sp
+    shape = list(x.shape)
+    x = jnp.moveaxis(x, axis, 0)
+    x = x.reshape((t_local, sp) + x.shape[1:])
+    x = jnp.swapaxes(x, 0, 1).reshape((T,) + x.shape[2:])
+    return jnp.moveaxis(x, 0, axis).reshape(shape)
+
+
+def unstripe_tokens(x, sp, axis=1):
+    """Inverse of stripe_tokens."""
+    T = x.shape[axis]
+    t_local = T // sp
+    shape = list(x.shape)
+    x = jnp.moveaxis(x, axis, 0)
+    x = x.reshape((sp, t_local) + x.shape[1:])
+    x = jnp.swapaxes(x, 0, 1).reshape((T,) + x.shape[2:])
+    return jnp.moveaxis(x, 0, axis).reshape(shape)
+
+
+def ring_attention_striped(q, k, v, axis_name, scale=None,
+                           use_flash=None):
+    """Load-BALANCED causal ring over STRIPED token layout
+    (Striped Attention, Brandon et al. 2023; see PAPERS.md pattern
+    notes): device s holds tokens {s, s+sp, ...} (stripe_tokens), so
+    global causality token i*sp+r >= j*sp+s reduces per block-pair to
+    plain causal (i >= j) when r >= s and STRICT causal (i > j) when
+    r < s.  Every device computes a ~half-masked block at EVERY ring
+    step — wall-clock ~sp * block/2 versus the contiguous ring's
+    sp * block (where whichever device holds a fully-visible pair sets
+    the pace).  Inputs/outputs are local striped shards inside
+    shard_map, like ring_attention."""
+    sp = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if use_flash is None:
+        use_flash, _, _ = _flash_gate_and_blocks(t_local, q.shape[-1],
+                                                 True)
+    f32 = jnp.float32
+
+    if use_flash:
+        from .flash_attention import flash_attention_lse
+        _, bq, bk = _flash_gate_and_blocks(t_local, q.shape[-1], True)
+
+        def attend(kb, vb, mode):
+            o, l = flash_attention_lse(q, kb, vb, mode, scale, bq, bk)
+            return o.astype(f32), l
+    else:
+        qs = q.astype(f32)
+
+        def attend(kb, vb, mode):
+            s = jnp.einsum('bqd,bkd->bqk', qs, kb.astype(f32)) * scale
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1)
+            vis = rows > cols if mode == 'strict' else rows >= cols
+            s = jnp.where(vis[None], s, NEG_INF)
+            m = jnp.maximum(jnp.max(s, axis=-1), -1e29)
+            p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m[..., None]))
+            l = jnp.sum(p, axis=-1)
+            o = jnp.einsum('bqk,bkd->bqd', p, vb.astype(f32))
+            lse = m + jnp.log(jnp.maximum(l, 1e-30))
+            return o / jnp.maximum(l, 1e-30)[..., None], lse
+
+    merge = _merge_lse
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    @jax.checkpoint
+    def step(carry, i):
+        o_acc, l_acc, kb, vb = carry
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        s = (rank - i) % sp
+        # rank >= s: diagonal included; rank < s: strictly causal
+        part = jax.lax.cond(rank >= s,
+                            lambda kb, vb: attend(kb, vb, True),
+                            lambda kb, vb: attend(kb, vb, 'strict'),
+                            kb, vb)
+        o_acc, l_acc = merge((o_acc, l_acc), part)
+        return (o_acc, l_acc, kb, vb), None
+
+    o0, l0 = attend(k, v, True)           # home block: r == s
+    (o_acc, l_acc, _, _), _ = jax.lax.scan(
+        step, (o0, l0, k, v), jnp.arange(1, sp))
+    return o_acc.astype(q.dtype)
+
+
 def ring_attention_spmd(q, k, v, mesh, causal=True,
                         batch_axes=('dp', 'tp'), seq_axis='sp',
-                        use_flash=None):
+                        use_flash=None, striped=False):
     """shard_map wrapper: q/k/v are GLOBAL [B*H, T, D] arrays (traced
     under jit on `mesh`); heads/batch split over `batch_axes`, sequence
-    over `seq_axis`; ring rotation rides the `sp` ICI ring."""
+    over `seq_axis`; ring rotation rides the `sp` ICI ring.
+
+    `striped=True` (causal only) runs the load-balanced striped ring:
+    inputs are striped/unstriped here for drop-in numerics — GSPMD
+    inserts the relayout all-to-alls, so pipelines chasing the full 2x
+    should keep hidden states striped end-to-end and call
+    ring_attention_striped directly instead."""
     axes = tuple(a for a in batch_axes if a in mesh.shape)
     spec = P(axes if len(axes) > 1 else (axes[0] if axes else None),
              seq_axis, None)
+    if striped and not causal:
+        raise ValueError(
+            'striped=True requires causal=True: the stripe layout '
+            'exists to balance the causal mask; non-causal rings are '
+            'already balanced — drop striped.')
+    if striped:
+        sp = mesh.shape[seq_axis]
+        fn = functools.partial(ring_attention_striped,
+                               axis_name=seq_axis, use_flash=use_flash)
+        qs, ks, vs = (stripe_tokens(t, sp) for t in (q, k, v))
+        out = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check_vma=False)(qs, ks, vs)
+        return unstripe_tokens(out, sp)
     fn = functools.partial(ring_attention, axis_name=seq_axis,
                            causal=causal, use_flash=use_flash)
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
