@@ -418,6 +418,15 @@ def _bwd_pallas(res, g, scale, causal, block_q, block_k, g_lse=None):
 
 # -- public op ---------------------------------------------------------------
 
+def shapes_tile(tq, tk, d, block_q, block_k):
+    """The single shape predicate every Pallas-attention gate shares.
+    d=64 compiles fine (Mosaic pads the lane dim); smaller head dims
+    waste too much of the tile."""
+    bq, bk = min(block_q, tq), min(block_k, tk)
+    return (tq % bq == 0 and tk % bk == 0 and d % 64 == 0
+            and bq >= 128 and bk >= 128)
+
+
 def can_use_pallas(tq, tk, d, block_q=DEFAULT_BLOCK_Q,
                    block_k=DEFAULT_BLOCK_K):
     """True iff flash_attention will take the Pallas path for these
@@ -425,13 +434,8 @@ def can_use_pallas(tq, tk, d, block_q=DEFAULT_BLOCK_Q,
     flash and their own einsum path instead of hitting the slower jnp
     reference fallback."""
     from ._gating import pallas_backend_ok
-    if not pallas_backend_ok():
-        return False
-    bq, bk = min(block_q, tq), min(block_k, tk)
-    # d=64 compiles fine (Mosaic pads the lane dim); smaller head dims
-    # waste too much of the tile
-    return (tq % bq == 0 and tk % bk == 0 and d % 64 == 0
-            and bq >= 128 and bk >= 128)
+    return pallas_backend_ok() and shapes_tile(tq, tk, d, block_q,
+                                               block_k)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -483,9 +487,8 @@ def flash_attention_lse(q, k, v, causal, scale, block_q, block_k):
     from ._gating import pallas_tpu_ok
     bq = min(block_q, q.shape[1])
     bk = min(block_k, k.shape[1])
-    if (pallas_tpu_ok() and q.shape[1] % bq == 0
-            and k.shape[1] % bk == 0 and q.shape[2] % 64 == 0
-            and bq >= 128 and bk >= 128):
+    if pallas_tpu_ok() and shapes_tile(q.shape[1], k.shape[1],
+                                       q.shape[2], bq, bk):
         return _flash_lse(q, k, v, causal, scale, bq, bk)
     o, lse = _reference_lse(q, k, v, causal, scale)
     return o.astype(q.dtype), lse

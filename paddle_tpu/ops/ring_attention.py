@@ -32,11 +32,10 @@ def _flash_gate_and_blocks(t_local, d, causal):
     its local shard (same r3 finding that created
     can_use_pallas_spmd — an installed mesh must not veto)."""
     from ._gating import pallas_tpu_ok
-    from .flash_attention import _tuned_blocks
+    from .flash_attention import _tuned_blocks, shapes_tile
     bq, bk = _tuned_blocks(t_local, t_local, d, causal)
     bq, bk = min(bq, t_local), min(bk, t_local)
-    ok = (pallas_tpu_ok() and t_local % bq == 0 and t_local % bk == 0
-          and d % 64 == 0 and bq >= 128 and bk >= 128)
+    ok = pallas_tpu_ok() and shapes_tile(t_local, t_local, d, bq, bk)
     return ok, bq, bk
 
 
@@ -92,12 +91,13 @@ def ring_attention(q, k, v, axis_name, causal=True, scale=None,
     t_local = q.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    gate_ok, fbq, fbk = _flash_gate_and_blocks(t_local, q.shape[-1],
+                                               causal)
     if use_flash is None:
-        use_flash, _, _ = _flash_gate_and_blocks(t_local, q.shape[-1],
-                                                 causal)
+        use_flash = gate_ok
     if use_flash:
         return _ring_flash(q, k, v, axis_name, causal, scale, sp, rank,
-                           t_local)
+                           t_local, fbq, fbk)
 
     qs = q.astype(jnp.float32) * scale
 
@@ -147,12 +147,12 @@ def ring_attention(q, k, v, axis_name, causal=True, scale=None,
     return out.astype(q.dtype)
 
 
-def _ring_flash(q, k, v, axis_name, causal, scale, sp, rank, t_local):
+def _ring_flash(q, k, v, axis_name, causal, scale, sp, rank, t_local,
+                bq, bk):
     """Flash-blocked ring: every visible block is one Pallas kernel
     call; partials merge in (out, lse) space.  The lse gradient is
     exact through flash_attention_lse's custom vjp."""
     from .flash_attention import flash_attention_lse
-    _, bq, bk = _flash_gate_and_blocks(t_local, q.shape[-1], causal)
     f32 = jnp.float32
 
     def full_blk(kb, vb):
@@ -233,33 +233,25 @@ def ring_attention_striped(q, k, v, axis_name, scale=None,
     t_local = q.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    gate_ok, bq, bk = _flash_gate_and_blocks(t_local, q.shape[-1],
+                                             True)
     if use_flash is None:
-        use_flash, _, _ = _flash_gate_and_blocks(t_local, q.shape[-1],
-                                                 True)
+        use_flash = gate_ok
     f32 = jnp.float32
 
     if use_flash:
         from .flash_attention import flash_attention_lse
-        _, bq, bk = _flash_gate_and_blocks(t_local, q.shape[-1], True)
 
         def attend(kb, vb, mode):
             o, l = flash_attention_lse(q, kb, vb, mode, scale, bq, bk)
             return o.astype(f32), l
     else:
-        qs = q.astype(f32)
+        from .flash_attention import _reference_lse
 
         def attend(kb, vb, mode):
-            s = jnp.einsum('bqd,bkd->bqk', qs, kb.astype(f32)) * scale
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1)
-            vis = rows > cols if mode == 'strict' else rows >= cols
-            s = jnp.where(vis[None], s, NEG_INF)
-            m = jnp.maximum(jnp.max(s, axis=-1), -1e29)
-            p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m[..., None]))
-            l = jnp.sum(p, axis=-1)
-            o = jnp.einsum('bqk,bkd->bqd', p, vb.astype(f32))
-            lse = m + jnp.log(jnp.maximum(l, 1e-30))
-            return o / jnp.maximum(l, 1e-30)[..., None], lse
+            # shares the masked-softmax-with-lse math (incl. the
+            # fully-masked-row guards) with the flash fallback
+            return _reference_lse(q, kb, vb, mode, scale)
 
     merge = _merge_lse
     perm = [(i, (i + 1) % sp) for i in range(sp)]

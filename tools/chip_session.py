@@ -47,6 +47,14 @@ STEPS = [
      45 * 60),
     ('perf_experiments', [sys.executable, 'tools/perf_experiments.py'],
      2 * 3600),
+    # contingent chunk-size sweep LAST: only worth the window time if
+    # the default-8 MFU from fused_head_ab disappoints
+    ('fused_head_c4',
+     [sys.executable, 'tools/bench_fused_head.py', '--iters', '10',
+      '--chunks', '4'], 45 * 60),
+    ('fused_head_c16',
+     [sys.executable, 'tools/bench_fused_head.py', '--iters', '10',
+      '--chunks', '16'], 45 * 60),
 ]
 
 
