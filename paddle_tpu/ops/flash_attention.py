@@ -575,7 +575,4 @@ def can_use_pallas_spmd(B, H, T, d, mesh, dp_axis='dp', tp_axis='tp'):
         return False
     if B % dp or H % tp:
         return False
-    bq = min(DEFAULT_BLOCK_Q, T)
-    bk = min(DEFAULT_BLOCK_K, T)
-    return (T % bq == 0 and T % bk == 0 and d % 64 == 0
-            and bq >= 128 and bk >= 128)
+    return shapes_tile(T, T, d, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)

@@ -87,6 +87,10 @@ def main():
     ap.add_argument('--batch', type=int, default=8)
     ap.add_argument('--seq', type=int, default=1024)
     ap.add_argument('--chunks', type=int, default=8)
+    ap.add_argument('--arm', choices=['both', 'fused', 'unfused'],
+                    default='both',
+                    help='chunk sweeps only need the fused arm — the '
+                         'unfused baseline does not depend on --chunks')
     args = ap.parse_args()
     if args.smoke:
         args.iters, args.warmup = 3, 2
@@ -94,17 +98,17 @@ def main():
     import jax
     print(f'device: {jax.devices()[0]}', file=sys.stderr)
     rows = {}
-    for fused in (False, True):
-        rows['fused' if fused else 'unfused'] = bench(fused, args)
-    u, f = rows['unfused'], rows['fused']
-    print(f"unfused: {u['tokens_per_s']:.0f} tok/s "
-          f"({u['ms_per_step']:.1f} ms, MFU~{u['mfu_est']:.1%}) "
-          f"loss={u['loss']:.4f}", file=sys.stderr)
-    print(f"fused:   {f['tokens_per_s']:.0f} tok/s "
-          f"({f['ms_per_step']:.1f} ms, MFU~{f['mfu_est']:.1%}) "
-          f"loss={f['loss']:.4f}", file=sys.stderr)
-    print(f"speedup: {f['tokens_per_s'] / u['tokens_per_s']:.3f}x",
-          file=sys.stderr)
+    arms = {'both': (False, True), 'fused': (True,),
+            'unfused': (False,)}[args.arm]
+    for fused in arms:
+        name = 'fused' if fused else 'unfused'
+        rows[name] = r = bench(fused, args)
+        print(f"{name}: {r['tokens_per_s']:.0f} tok/s "
+              f"({r['ms_per_step']:.1f} ms, MFU~{r['mfu_est']:.1%}) "
+              f"loss={r['loss']:.4f}", file=sys.stderr)
+    if len(rows) == 2:
+        print(f"speedup: {rows['fused']['tokens_per_s'] / rows['unfused']['tokens_per_s']:.3f}x",
+              file=sys.stderr)
     import json
     print(json.dumps(rows))
 

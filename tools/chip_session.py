@@ -26,6 +26,11 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, 'tools', 'chip_out')
 
+# persistent XLA compilation cache for every child (recompiles are the
+# riskiest tunnel window); harmless no-op where unsupported
+os.environ.setdefault('JAX_COMPILATION_CACHE_DIR',
+                      os.path.join(REPO, '.jax_cache'))
+
 # (name, argv, timeout_s) — order matters: cheap/valuable first, the
 # historical wedge offender (gptgen inside bench.py) is covered by
 # bench.py's own per-config isolation + TIMEOUT_SCALE.
@@ -47,14 +52,16 @@ STEPS = [
      45 * 60),
     ('perf_experiments', [sys.executable, 'tools/perf_experiments.py'],
      2 * 3600),
-    # contingent chunk-size sweep LAST: only worth the window time if
-    # the default-8 MFU from fused_head_ab disappoints
+    # chunk-size sweep LAST (fused arm only — the unfused baseline is
+    # already in fused_head_ab.log and does not depend on --chunks);
+    # touch tools/chip_out/fused_head_c{4,16}.ok beforehand to skip
+    # when the default-8 MFU already hit target
     ('fused_head_c4',
      [sys.executable, 'tools/bench_fused_head.py', '--iters', '10',
-      '--chunks', '4'], 45 * 60),
+      '--chunks', '4', '--arm', 'fused'], 30 * 60),
     ('fused_head_c16',
      [sys.executable, 'tools/bench_fused_head.py', '--iters', '10',
-      '--chunks', '16'], 45 * 60),
+      '--chunks', '16', '--arm', 'fused'], 30 * 60),
 ]
 
 
