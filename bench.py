@@ -444,13 +444,8 @@ def _write_partial(results):
 
 
 def main():
-    # persistent XLA compilation cache: recompiles are the riskiest
-    # window through the dev tunnel (a killed compile wedges it), so
-    # cache executables across runs; harmless no-op where unsupported
-    os.environ.setdefault(
-        'JAX_COMPILATION_CACHE_DIR',
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     '.jax_cache'))
+    from tools._env import setup_jax_cache
+    setup_jax_cache()
     p = argparse.ArgumentParser()
     p.add_argument('--smoke', action='store_true',
                    help='tiny shapes, few iters (CI sanity)')

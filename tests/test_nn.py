@@ -150,18 +150,21 @@ class TestActivationsLosses:
 
 
 class TestRNN:
-    def test_lstm_vs_torch(self):
-        B, T, I, H = 2, 5, 4, 6
-        x = np.random.RandomState(0).randn(B, T, I).astype('float32')
-        ours = nn.LSTM(I, H)
-        ref = torch.nn.LSTM(I, H, batch_first=True)
-        # copy our params into torch
+    @staticmethod
+    def _copy_params_l0(ours, ref):
         sd = {n: p.numpy() for n, p in ours.named_parameters()}
         with torch.no_grad():
             ref.weight_ih_l0.copy_(torch.tensor(sd['weight_ih_l0']))
             ref.weight_hh_l0.copy_(torch.tensor(sd['weight_hh_l0']))
             ref.bias_ih_l0.copy_(torch.tensor(sd['bias_ih_l0']))
             ref.bias_hh_l0.copy_(torch.tensor(sd['bias_hh_l0']))
+
+    def test_lstm_vs_torch(self):
+        B, T, I, H = 2, 5, 4, 6
+        x = np.random.RandomState(0).randn(B, T, I).astype('float32')
+        ours = nn.LSTM(I, H)
+        ref = torch.nn.LSTM(I, H, batch_first=True)
+        self._copy_params_l0(ours, ref)
         y_ours, (h_ours, c_ours) = ours(paddle.to_tensor(x))
         y_ref, (h_ref, c_ref) = ref(torch.tensor(x))
         np.testing.assert_allclose(t2n(y_ours), y_ref.detach().numpy(),
@@ -176,6 +179,34 @@ class TestRNN:
         assert y.shape == [3, 7, 6] and h.shape == [2, 3, 6]
         y.sum().backward()
         assert gru.weight_ih_l0.grad is not None
+
+    def test_gru_vs_torch(self):
+        # paddle and torch share the GRU equations (reset applied to
+        # the projected hidden candidate), so numerics must match
+        B, T, I, H = 2, 5, 4, 6
+        x = np.random.RandomState(1).randn(B, T, I).astype('float32')
+        ours = nn.GRU(I, H)
+        ref = torch.nn.GRU(I, H, batch_first=True)
+        self._copy_params_l0(ours, ref)
+        y_ours, h_ours = ours(paddle.to_tensor(x))
+        y_ref, h_ref = ref(torch.tensor(x))
+        np.testing.assert_allclose(t2n(y_ours), y_ref.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(t2n(h_ours), h_ref.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_simple_rnn_vs_torch(self):
+        B, T, I, H = 2, 5, 4, 6
+        x = np.random.RandomState(2).randn(B, T, I).astype('float32')
+        ours = nn.SimpleRNN(I, H)
+        ref = torch.nn.RNN(I, H, batch_first=True)
+        self._copy_params_l0(ours, ref)
+        y_ours, h_ours = ours(paddle.to_tensor(x))
+        y_ref, h_ref = ref(torch.tensor(x))
+        np.testing.assert_allclose(t2n(y_ours), y_ref.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(t2n(h_ours), h_ref.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
 
 
 class TestLayerSystem:

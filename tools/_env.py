@@ -1,0 +1,15 @@
+"""Shared environment setup for the chip tools.
+
+Import (and call) BEFORE the first `import jax` in any entry point
+that compiles on the real chip: recompiles are the riskiest window
+through the dev tunnel (a killed compile wedges it), so every tool
+shares one persistent XLA compilation cache.
+"""
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def setup_jax_cache():
+    os.environ.setdefault('JAX_COMPILATION_CACHE_DIR',
+                          os.path.join(REPO, '.jax_cache'))

@@ -26,6 +26,9 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+from tools._env import setup_jax_cache
+setup_jax_cache()
+
 
 def timeit(fn, *args, iters=10):
     import jax

@@ -28,8 +28,9 @@ OUT = os.path.join(REPO, 'tools', 'chip_out')
 
 # persistent XLA compilation cache for every child (recompiles are the
 # riskiest tunnel window); harmless no-op where unsupported
-os.environ.setdefault('JAX_COMPILATION_CACHE_DIR',
-                      os.path.join(REPO, '.jax_cache'))
+sys.path.insert(0, REPO)
+from tools._env import setup_jax_cache  # noqa: E402
+setup_jax_cache()
 
 # (name, argv, timeout_s) — order matters: cheap/valuable first, the
 # historical wedge offender (gptgen inside bench.py) is covered by

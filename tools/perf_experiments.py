@@ -16,6 +16,9 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tools._env import setup_jax_cache
+setup_jax_cache()
+
 
 def run(tag, batch=256, image=224, recompute=False, bf16_in=False,
         iters=30, warmup=5):

@@ -17,6 +17,9 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tools._env import setup_jax_cache
+setup_jax_cache()
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)

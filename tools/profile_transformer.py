@@ -24,6 +24,9 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+from tools._env import setup_jax_cache
+setup_jax_cache()
+
 
 def build(model_name, batch, seq):
     import paddle_tpu as paddle

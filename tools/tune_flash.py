@@ -11,7 +11,13 @@ consults per shape at call time.
 import argparse
 import sys
 
-sys.path.insert(0, '.')
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools._env import setup_jax_cache
+setup_jax_cache()
 
 
 def main():
