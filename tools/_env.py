@@ -6,10 +6,20 @@ through the dev tunnel (a killed compile wedges it), so every tool
 shares one persistent XLA compilation cache.
 """
 import os
+import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def setup_jax_cache():
-    os.environ.setdefault('JAX_COMPILATION_CACHE_DIR',
-                          os.path.join(REPO, '.jax_cache'))
+    path = os.environ.setdefault('JAX_COMPILATION_CACHE_DIR',
+                                 os.path.join(REPO, '.jax_cache'))
+    # the dev box's sitecustomize imports jax at interpreter boot, so
+    # the env var alone is latched too late for THIS process (it still
+    # reaches subprocess children); apply to the live config as well
+    if 'jax' in sys.modules:
+        import jax
+        try:
+            jax.config.update('jax_compilation_cache_dir', path)
+        except AttributeError:
+            pass
