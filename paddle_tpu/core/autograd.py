@@ -58,18 +58,28 @@ def _float0_zeros(shape):
 
 
 class GradNode:
-    """One recorded op: holds the vjp closure and graph edges."""
+    """One recorded op: holds the vjp closure and graph edges.
+
+    `pure`/`in_vals` (the forward fn over input VALUES and those
+    values) are kept so create_graph=True can re-derive the vjp as a
+    TAPED op — the returned gradients then carry their own graph for
+    higher-order differentiation.  Nodes built outside dispatch.apply
+    (PyLayer) may leave them None; such nodes differentiate normally
+    but their gradient is a leaf for double-grad."""
 
     __slots__ = ('vjp_fn', 'inputs', 'out_avals', 'out_grads', 'name',
-                 'out_is_seq')
+                 'out_is_seq', 'pure', 'in_vals')
 
-    def __init__(self, vjp_fn, inputs, out_avals, name='', out_is_seq=False):
+    def __init__(self, vjp_fn, inputs, out_avals, name='', out_is_seq=False,
+                 pure=None, in_vals=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs          # Tensors that required grad (strong refs)
         self.out_avals = out_avals    # [(shape, dtype)] per output
         self.out_grads = [None] * len(out_avals)
         self.name = name
         self.out_is_seq = out_is_seq  # fn returned a tuple (vjp wants tuple)
+        self.pure = pure
+        self.in_vals = in_vals
 
     def seed_grad(self, index, grad):
         if self.out_grads[index] is None:
@@ -77,7 +87,7 @@ class GradNode:
         else:
             self.out_grads[index] = self.out_grads[index] + grad
 
-    def cotangents(self):
+    def cotangent_list(self):
         cts = []
         for g, (shape, dtype) in zip(self.out_grads, self.out_avals):
             if g is not None:
@@ -90,6 +100,10 @@ class GradNode:
                 cts.append(jnp.zeros(shape, dtype))
             else:
                 cts.append(_float0_zeros(shape))
+        return cts
+
+    def cotangents(self):
+        cts = self.cotangent_list()
         return tuple(cts) if self.out_is_seq else cts[0]
 
 
@@ -141,6 +155,8 @@ def backward_multi(tensors, grads=None, retain_graph=False):
                 t.grad_node.seed_grad(t.grad_index, g)
         if not retain_graph:
             node.vjp_fn = None
+            node.pure = None
+            node.in_vals = None
     if not retain_graph:
         for t in tensors:
             _detach_graph(t)
@@ -168,6 +184,65 @@ class set_grad_enabled:
         return False
 
 
+def _is_diff_dtype(dt):
+    """Differentiable dtypes: floats (incl. bfloat16) AND complex —
+    jax vjps carry complex cotangents fine."""
+    from .dtype import is_floating
+    return is_floating(dt) or np.issubdtype(np.dtype(dt), np.complexfloating)
+
+
+def _taped_vjp(node):
+    """Differentiable backward of one node: re-derives the vjp from the
+    node's recorded pure fn + forward values, records the computation
+    as a NEW GradNode (whose inputs are the original input tensors AND
+    any cotangent tensors), and returns per-input gradients as graph-
+    carrying Tensors.  This is what makes create_graph=True exact to
+    arbitrary order — the grad op itself went through jax.vjp."""
+    from .tensor import Tensor
+
+    cts = node.cotangent_list()
+    node_inputs = node.inputs
+    in_vals = node.in_vals
+    n_in = len(in_vals)
+    diff_in = [_is_diff_dtype(v.dtype) for v in in_vals]
+    # float0 cotangents (int outputs) are not valid traced values —
+    # close over them; trace only the float cotangents
+    ct_traced = [not (isinstance(c, np.ndarray)
+                      and c.dtype == jax.dtypes.float0) for c in cts]
+    ct_vals = [c.value if isinstance(c, Tensor) else c for c in cts]
+    traced_ct_vals = [v for v, m in zip(ct_vals, ct_traced) if m]
+    static_cts = [None if m else v for v, m in zip(ct_vals, ct_traced)]
+
+    def gradop(*flat):
+        ins = flat[:n_in]
+        dyn = list(flat[n_in:])
+        full_cts = [s if s is not None else dyn.pop(0)
+                    for s in static_cts]
+        ct = tuple(full_cts) if node.out_is_seq else full_cts[0]
+        _, vjp_fn = jax.vjp(node.pure, *ins)
+        gs = vjp_fn(ct)
+        return tuple(g for g, m in zip(gs, diff_in) if m)
+
+    flat_vals = list(in_vals) + traced_ct_vals
+    out_vals, vjp2 = jax.vjp(gradop, *flat_vals)
+    avals = [(v.shape, v.dtype) for v in out_vals]
+    edge_inputs = list(node_inputs) + [
+        c if isinstance(c, Tensor) and not c.stop_gradient else None
+        for c, m in zip(cts, ct_traced) if m]
+    node2 = GradNode(vjp2, edge_inputs, avals,
+                     name=(node.name or 'op') + '_grad',
+                     out_is_seq=True, pure=gradop, in_vals=flat_vals)
+    outs = []
+    for i, v in enumerate(out_vals):
+        t = Tensor(v, stop_gradient=False)
+        t.grad_node = node2
+        t.grad_index = i
+        outs.append(t)
+    # scatter back to per-input slots (None for non-float inputs)
+    it = iter(outs)
+    return [next(it) if m else None for m in diff_in]
+
+
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None):
@@ -179,18 +254,17 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     `.grad` accumulators.  `no_grad_vars` cuts gradient flow at those
     tensors.
 
-    create_graph=True (double grad) is not supported on the eager tape —
-    the TPU-fast route for higher-order derivatives is the compiled path,
-    where plain jax.grad composition (jax.grad(jax.grad(f))) applies; see
-    paddle_tpu.jit.
+    create_graph=True records the backward computation itself on the
+    tape (each node's vjp re-derived from its pure fn via jax.vjp, as
+    a new taped op), so the returned gradients are differentiable to
+    arbitrary order — WGAN-GP-style gradient penalties work eagerly.
+    PyLayer nodes (built outside dispatch) differentiate once but
+    their gradients are leaves.  The TPU-fast route for higher-order
+    derivatives remains the compiled path (jax.grad composition via
+    paddle_tpu.jit).
     """
     from .tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            'paddle.grad(create_graph=True) is not supported on the eager '
-            'tape; compose jax.grad via paddle_tpu.jit for higher-order '
-            'derivatives')
     if not only_inputs:
         raise NotImplementedError('only_inputs=False is not supported '
                                   '(matches the reference, which also '
@@ -219,7 +293,14 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
 
     roots = []
     for out, go in zip(outputs, grad_outputs):
-        g = jnp.ones_like(out.value) if go is None else _val(go)
+        if go is None:
+            g = jnp.ones_like(out.value)
+        elif create_graph:
+            g = go if isinstance(go, Tensor) else Tensor(jnp.asarray(go))
+        else:
+            g = _val(go)
+        if create_graph and not isinstance(g, Tensor):
+            g = Tensor(g, stop_gradient=True)
         if id(out) in input_ids and not out.stop_gradient:
             _acc_input(out, g)
         if out.grad_node is not None:
@@ -237,7 +318,17 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                 f'trying to differentiate through op {node.name!r} whose '
                 'graph was already freed by a previous backward()/grad() '
                 'call; pass retain_graph=True to the earlier call')
-        in_grads = node.vjp_fn(node.cotangents())
+        if create_graph and node.pure is not None:
+            in_grads = _taped_vjp(node)
+        else:
+            in_grads = node.vjp_fn(node.cotangents())
+            if create_graph:
+                # PyLayer fallback: differentiable once, leaf beyond
+                in_grads = [None if g is None
+                            or (isinstance(g, np.ndarray)
+                                and g.dtype == jax.dtypes.float0)
+                            else Tensor(g, stop_gradient=True)
+                            for g in in_grads]
         node.out_grads = [None] * len(node.out_avals)
         for t, g in zip(node.inputs, in_grads):
             if t is None or g is None or id(t) in cut_ids:
@@ -251,6 +342,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     if not retain_graph:
         for node in visited:
             node.vjp_fn = None
+            node.pure = None
+            node.in_vals = None
 
     results = []
     for t in inputs:
@@ -262,6 +355,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                     'stop_gradient=True); pass allow_unused=True to get '
                     'None instead')
             results.append(None)
+        elif isinstance(g, Tensor):
+            results.append(g)
         else:
             results.append(Tensor(g, stop_gradient=True))
     return results

@@ -81,7 +81,8 @@ def apply(fn, *args, op_name=None, **kwargs):
             [args[i] if not args[i].stop_gradient else None for i in tpos],
             avals,
             name=op_name or getattr(fn, '__name__', ''),
-            out_is_seq=not single)
+            out_is_seq=not single,
+            pure=pure, in_vals=vals)
         outs = [Tensor._from_value(v, stop_gradient=False) for v in flat]
         for i, t in enumerate(outs):
             t.grad_node = node

@@ -173,11 +173,52 @@ class TestGradAPI:
         with pytest.raises(RuntimeError, match='retain_graph'):
             paddle.grad([y], [x])
 
-    def test_create_graph_unsupported(self):
-        x = mk(2.0)
-        y = x * x
-        with pytest.raises(NotImplementedError):
-            paddle.grad([y], [x], create_graph=True)
+    def test_create_graph_second_order(self):
+        # d2/dx2 sum(x^3) = 6x
+        x = paddle.to_tensor(np.array([2.0, 3.0], 'float32'))
+        x.stop_gradient = False
+        g1 = paddle.grad((x ** 3).sum(), x, create_graph=True)[0]
+        np.testing.assert_allclose(g1.numpy(), [12.0, 27.0], rtol=1e-6)
+        g2 = paddle.grad(g1.sum(), x)[0]
+        np.testing.assert_allclose(g2.numpy(), [12.0, 18.0], rtol=1e-6)
+
+    def test_create_graph_third_order(self):
+        x = paddle.to_tensor(np.array([2.0], 'float32'))
+        x.stop_gradient = False
+        g1 = paddle.grad((x ** 4).sum(), x, create_graph=True)[0]
+        g2 = paddle.grad(g1.sum(), x, create_graph=True)[0]
+        g3 = paddle.grad(g2.sum(), x)[0]
+        np.testing.assert_allclose(g3.numpy(), [48.0], rtol=1e-6)
+
+    def test_gradient_penalty_backward(self):
+        # WGAN-GP: backward() THROUGH a create_graph gradient, checked
+        # against jax.grad(jax.grad) on the same function
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu import nn
+        paddle.seed(0)
+        D = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        xs = paddle.to_tensor(
+            np.random.RandomState(0).randn(6, 4).astype('float32'))
+        xs.stop_gradient = False
+        gx = paddle.grad(D(xs).sum(), xs, create_graph=True)[0]
+        gp = ((gx.square().sum(axis=1).sqrt() - 1.0) ** 2).mean()
+        gp.backward()
+        params = {n: p.value for n, p in D.named_parameters()}
+
+        def fwd(params, xv):
+            h = jnp.tanh(xv @ params['0.weight'] + params['0.bias'])
+            return (h @ params['2.weight'] + params['2.bias']).sum()
+
+        def penalty(params, xv):
+            g = jax.grad(fwd, argnums=1)(params, xv)
+            return jnp.mean(
+                (jnp.sqrt(jnp.sum(g ** 2, axis=1)) - 1.0) ** 2)
+
+        gref = jax.grad(penalty)(params, xs.value)
+        np.testing.assert_allclose(
+            D[0].weight.grad.numpy(), np.asarray(gref['0.weight']),
+            rtol=1e-4, atol=1e-6)
 
     def test_set_grad_enabled(self):
         x = mk(2.0)
