@@ -107,6 +107,60 @@ class GradNode:
         return tuple(cts) if self.out_is_seq else cts[0]
 
 
+def _has_hooks(t):
+    return bool(getattr(t, '_grad_hooks', None))
+
+
+def _fire_hooks(t, g):
+    """Run t's gradient hooks on the COMPLETE accumulated gradient.
+    Hooks receive/return Tensors (reference API); raw cotangents are
+    wrapped for the call and unwrapped back."""
+    from .tensor import Tensor
+    was_tensor = isinstance(g, Tensor)
+    for hook in list(t._grad_hooks.values()):
+        arg = g if isinstance(g, Tensor) else Tensor(g,
+                                                     stop_gradient=True)
+        r = hook(arg)
+        if r is not None:
+            g = r
+    if isinstance(g, Tensor) and not was_tensor:
+        return g.value
+    return g
+
+
+class _HookPending:
+    """Defers gradient contributions for hooked tensors so the hook
+    fires once on the fan-in total: a tensor's gradient is complete
+    exactly when its producer node is reached in reverse-topo order
+    (or at walk end for leaves)."""
+
+    def __init__(self):
+        self.by_id = {}
+
+    def defer(self, t, g):
+        e = self.by_id.get(id(t))
+        if e is None:
+            self.by_id[id(t)] = [t, g]
+        else:
+            e[1] = e[1] + g
+
+    def flush_for_node(self, node):
+        """(tensor, hooked_grad) pairs whose producer is `node`."""
+        if not self.by_id:       # the common, hook-free fast path
+            return ()
+        out = []
+        for k in [k for k, (t, _) in self.by_id.items()
+                  if t.grad_node is node]:
+            t, g = self.by_id.pop(k)
+            out.append((t, _fire_hooks(t, g)))
+        return out
+
+    def flush_rest(self):
+        out = [(t, _fire_hooks(t, g)) for t, g in self.by_id.values()]
+        self.by_id.clear()
+        return out
+
+
 def backward(tensor, grad=None, retain_graph=False):
     """Run reverse-mode accumulation from `tensor`.
 
@@ -126,8 +180,14 @@ def backward_multi(tensors, grads=None, retain_graph=False):
     if grads is None:
         grads = [None] * len(tensors)
     roots = []
+    pending = _HookPending()
     for t, g in zip(tensors, grads):
         g = jnp.ones_like(t.value) if g is None else _val(g)
+        if _has_hooks(t):
+            pending.defer(t, g)
+            if t.grad_node is not None:
+                roots.append(t.grad_node)
+            continue
         if not t.stop_gradient:
             t._accumulate_grad(g)
         if t.grad_node is not None:
@@ -136,6 +196,10 @@ def backward_multi(tensors, grads=None, retain_graph=False):
 
     order = _topo_order_multi(roots)
     for node in order:
+        for t, g in pending.flush_for_node(node):
+            if not t.stop_gradient:
+                t._accumulate_grad(g)
+            node.seed_grad(t.grad_index, g)
         if all(g is None for g in node.out_grads):
             continue
         if node.vjp_fn is None:
@@ -150,6 +214,9 @@ def backward_multi(tensors, grads=None, retain_graph=False):
                 continue
             if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
                 continue
+            if _has_hooks(t):
+                pending.defer(t, g)
+                continue
             t._accumulate_grad(g)
             if t.grad_node is not None:
                 t.grad_node.seed_grad(t.grad_index, g)
@@ -157,6 +224,9 @@ def backward_multi(tensors, grads=None, retain_graph=False):
             node.vjp_fn = None
             node.pure = None
             node.in_vals = None
+    for t, g in pending.flush_rest():
+        if not t.stop_gradient:
+            t._accumulate_grad(g)
     if not retain_graph:
         for t in tensors:
             _detach_graph(t)
@@ -292,6 +362,18 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         acc[k] = g if k not in acc else acc[k] + g
 
     roots = []
+    pending = _HookPending()
+
+    def _consume(t, g, node=None):
+        """Route one complete contribution for t (hook already fired
+        if any) into the input accumulator and the producer seed."""
+        if id(t) in input_ids and not t.stop_gradient:
+            _acc_input(t, g)
+        if node is not None:
+            node.seed_grad(t.grad_index, g)
+        elif t.grad_node is not None:
+            t.grad_node.seed_grad(t.grad_index, g)
+
     for out, go in zip(outputs, grad_outputs):
         if go is None:
             g = jnp.ones_like(out.value)
@@ -301,16 +383,22 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             g = _val(go)
         if create_graph and not isinstance(g, Tensor):
             g = Tensor(g, stop_gradient=True)
-        if id(out) in input_ids and not out.stop_gradient:
-            _acc_input(out, g)
+        if _has_hooks(out):
+            pending.defer(out, g)
+        else:
+            if id(out) in input_ids and not out.stop_gradient:
+                _acc_input(out, g)
+            if out.grad_node is not None:
+                out.grad_node.seed_grad(out.grad_index, g)
         if out.grad_node is not None:
-            out.grad_node.seed_grad(out.grad_index, g)
             roots.append(out.grad_node)
 
     order = _topo_order_multi(roots)
     visited = []
     for node in order:
         visited.append(node)
+        for t, g in pending.flush_for_node(node):
+            _consume(t, g, node=node)
         if all(g is None for g in node.out_grads):
             continue
         if node.vjp_fn is None:
@@ -335,10 +423,15 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                 continue
             if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
                 continue
+            if _has_hooks(t):
+                pending.defer(t, g)
+                continue
             if id(t) in input_ids and not t.stop_gradient:
                 _acc_input(t, g)
             if t.grad_node is not None:
                 t.grad_node.seed_grad(t.grad_index, g)
+    for t, g in pending.flush_rest():
+        _consume(t, g)
     if not retain_graph:
         for node in visited:
             node.vjp_fn = None

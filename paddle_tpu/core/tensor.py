@@ -17,6 +17,21 @@ from . import autograd, dispatch
 from .dtype import convert_dtype, get_default_dtype, dtype_name, is_floating
 
 
+class _HookHandle:
+    """Removable registration of a gradient hook (torch/paddle style)."""
+
+    _next_id = 0
+
+    def __init__(self, hooks, hook):
+        self._hooks = hooks
+        self._id = _HookHandle._next_id
+        _HookHandle._next_id += 1
+        hooks[self._id] = hook
+
+    def remove(self):
+        self._hooks.pop(self._id, None)
+
+
 class Tensor:
     __array_priority__ = 100  # beat numpy in mixed binary ops
 
@@ -108,6 +123,21 @@ class Tensor:
         if g.dtype != self.value.dtype:
             g = g.astype(self.value.dtype)
         self._grad = g if self._grad is None else self._grad + g
+
+    def register_hook(self, hook):
+        """Register `hook(grad) -> modified grad | None`, fired ONCE on
+        this tensor's fully-accumulated gradient during a backward walk
+        (reference varbase_patch_methods.py:283); the modified value is
+        what propagates further and lands in `.grad`.  Returns a handle
+        with `.remove()`."""
+        if self.stop_gradient:
+            raise RuntimeError(
+                'cannot register a gradient hook on a tensor with '
+                'stop_gradient=True')
+        hooks = getattr(self, '_grad_hooks', None)
+        if hooks is None:
+            hooks = self._grad_hooks = {}
+        return _HookHandle(hooks, hook)
 
     def backward(self, grad_tensor=None, retain_graph=False):
         autograd.backward(self, grad_tensor, retain_graph)
