@@ -226,6 +226,7 @@ class Tensor:
             raise ValueError(
                 f"set_value shape mismatch {v.shape} vs {self.value.shape}")
         self.value = v.astype(self.value.dtype)
+        self._inplace_version = self.inplace_version + 1
         return self
 
     def _snapshot(self):
@@ -250,7 +251,41 @@ class Tensor:
         self.value = other.value
         self.grad_node = other.grad_node
         self.grad_index = other.grad_index
+        self._inplace_version = self.inplace_version + 1
         return self
+
+    @property
+    def inplace_version(self):
+        """Count of in-place mutations (reference
+        varbase_patch_methods.py:428)."""
+        return getattr(self, '_inplace_version', 0)
+
+    def __array__(self, dtype=None, copy=None):
+        """numpy interop: np.asarray(tensor) yields the values (the
+        reference patches the same onto VarBase)."""
+        a = np.asarray(self.value)
+        if dtype is not None:
+            a = a.astype(dtype)
+        elif copy:
+            a = a.copy()
+        return a
+
+    def __deepcopy__(self, memo):
+        """Detached copy preserving the concrete class (Parameter
+        keeps being a Parameter — transformer stacks deepcopy layers)
+        and the exact dtype.  The jax buffer is immutable, so the copy
+        SHARES it: zero host round-trips; in-place ops rebind `value`
+        rather than mutate, so sharing is safe.  The tape edge is not
+        cloned (the copy is simply detached)."""
+        t = type(self).__new__(type(self))
+        t.__dict__.update({k: v for k, v in self.__dict__.items()
+                           if k not in ('_grad', 'grad_node',
+                                        '_grad_hooks')})
+        t._grad = None
+        t.grad_node = None
+        t.grad_index = 0
+        memo[id(self)] = t
+        return t
 
     # -- indexing ------------------------------------------------------------
     def _norm_index(self, idx):

@@ -442,3 +442,59 @@ class TestInplaceLongTail:
             np.testing.assert_allclose(out.numpy(), want, rtol=1e-6,
                                        err_msg=name)
             np.testing.assert_allclose(t.numpy(), want, rtol=1e-6)
+
+
+class TestTensorInterop:
+    """numpy interop dunders (reference varbase_patch_methods.py:
+    __array__ :513, __deepcopy__ :468, inplace_version :428)."""
+
+    def test_array_protocol(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        t = paddle.to_tensor(np.ones((2, 2), 'float32'))
+        a = np.asarray(t)
+        assert a.dtype == np.float32 and a.shape == (2, 2)
+        assert float(np.mean(a)) == 1.0
+
+    def test_array_priority_keeps_tensor_ops(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        t = paddle.to_tensor(np.ones(2, 'float32'))
+        t.stop_gradient = False
+        r = np.ones(2, 'float32') + t
+        assert type(r).__name__ == 'Tensor'
+        r.sum().backward()
+        assert t.grad is not None
+
+    def test_deepcopy_detached_value_copy(self):
+        import copy
+        import numpy as np
+        import paddle_tpu as paddle
+        t = paddle.to_tensor(np.arange(4, dtype='float32'))
+        c = copy.deepcopy({'w': t})['w']
+        assert c is not t and np.allclose(c.numpy(), t.numpy())
+        assert c.grad_node is None
+
+    def test_inplace_version_counts(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        t = paddle.to_tensor(np.ones(2, 'float32'))
+        assert t.inplace_version == 0
+        t.sqrt_()
+        t.exp_()
+        assert t.inplace_version == 2
+
+    def test_deepcopy_preserves_parameter_class(self):
+        import copy
+        import numpy as np
+        from paddle_tpu.core.tensor import Parameter
+        p = Parameter(np.ones((2, 2), 'float32'), name='w')
+        c = copy.deepcopy(p)
+        assert type(c) is Parameter and c.trainable and c.name == 'w'
+
+    def test_set_value_bumps_inplace_version(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        t = paddle.to_tensor(np.ones(2, 'float32'))
+        t.set_value(np.zeros(2, 'float32'))
+        assert t.inplace_version == 1
