@@ -238,3 +238,42 @@ class TestChannelWiseArtifact:
         # dequantized values sit on the 4-bit grid within half a step
         scale = np.abs(w).max()
         assert np.abs(w - w2).max() <= scale / 7
+
+
+class TestDynamicInt8Matmul:
+    """ops/int8_matmul.py — the int8 MXU building block for decode
+    serving (per-channel weight scales, dynamic per-tensor activation
+    scale, int32 accumulation)."""
+
+    def test_parity_vs_float(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.ops.int8_matmul import (quantize_weight_int8,
+                                                dynamic_int8_matmul)
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(8, 64), jnp.float32)
+        w = jnp.asarray(rs.randn(64, 96) / 8.0, jnp.float32)
+        wq, ws = quantize_weight_int8(w)
+        assert wq.dtype == jnp.int8 and ws.shape == (96,)
+        got = np.asarray(dynamic_int8_matmul(x, wq, ws,
+                                             out_dtype=jnp.float32))
+        want = np.asarray(x @ w)
+        rel = np.abs(got - want).max() / np.abs(want).max()
+        assert rel < 0.02, rel
+
+    def test_bias_and_bf16_out(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.ops.int8_matmul import (quantize_weight_int8,
+                                                dynamic_int8_matmul)
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.randn(4, 32), jnp.bfloat16)
+        w = jnp.asarray(rs.randn(32, 16) / 6.0, jnp.float32)
+        b = jnp.asarray(rs.randn(16), jnp.float32)
+        wq, ws = quantize_weight_int8(w)
+        out = dynamic_int8_matmul(x, wq, ws, bias=b)
+        assert out.dtype == jnp.bfloat16 and out.shape == (4, 16)
+        want = np.asarray(x.astype(jnp.float32) @ w + b)
+        rel = np.abs(np.asarray(out, np.float32) - want).max() \
+            / np.abs(want).max()
+        assert rel < 0.05, rel

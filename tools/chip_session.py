@@ -53,6 +53,8 @@ STEPS = [
      45 * 60),
     ('perf_experiments', [sys.executable, 'tools/perf_experiments.py'],
      2 * 3600),
+    ('int8_matmul', [sys.executable, 'tools/bench_int8_matmul.py'],
+     30 * 60),
     # chunk-size sweep LAST (fused arm only — the unfused baseline is
     # already in fused_head_ab.log and does not depend on --chunks);
     # touch tools/chip_out/fused_head_c{4,16}.ok beforehand to skip
