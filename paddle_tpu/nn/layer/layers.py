@@ -42,6 +42,10 @@ class ParamAttr:
 
 
 class Layer:
+    # unique_name.generate analogue: per-prefix counters numbered from
+    # zero, matching the reference's 'fc_0, fc_1' convention
+    _name_counters = {}
+
     def __init__(self, name_scope=None, dtype='float32'):
         self._dtype = convert_dtype(dtype) or get_default_dtype()
         self._parameters = collections.OrderedDict()
@@ -52,6 +56,9 @@ class Layer:
         self._forward_post_hooks = collections.OrderedDict()
         self.training = True
         self._name_scope = name_scope or self.__class__.__name__.lower()
+        n = Layer._name_counters.get(self._name_scope, 0)
+        Layer._name_counters[self._name_scope] = n + 1
+        self._full_name = f'{self._name_scope}_{n}'
 
     # -- attribute magic -----------------------------------------------------
     def __setattr__(self, name, value):
@@ -102,6 +109,25 @@ class Layer:
                 del d[name]
                 return
         object.__delattr__(self, name)
+
+    def full_name(self):
+        """Unique name: name_scope + '_' + counter (reference
+        layers.py:239, unique_name.generate analogue)."""
+        return self._full_name
+
+    def create_variable(self, name=None, persistable=None, dtype=None):
+        """An uninitialized (empty) tensor owned by this layer
+        (reference layers.py:418)."""
+        dt = convert_dtype(dtype) or self._dtype
+        t = Tensor(jnp.zeros((0,), dt), stop_gradient=True, name=name)
+        t.persistable = bool(persistable)
+        return t
+
+    # reference layers.py:467 — create_tensor is the 2.x alias
+    create_tensor = create_variable
+
+    def backward(self, *inputs):
+        raise ValueError("Layer shouldn't implement backward")
 
     # -- parameter management ------------------------------------------------
     def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
