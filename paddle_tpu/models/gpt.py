@@ -40,7 +40,8 @@ class GPTConfig:
                  sequence_parallel=False, initializer_range=0.02,
                  moe_num_experts=0, moe_every=2, moe_top_k=1,
                  moe_capacity_factor=1.25, moe_aux_weight=0.01,
-                 fused_head=False, fused_head_chunks=8):
+                 fused_head=False, fused_head_chunks=8,
+                 striped_sp=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -67,11 +68,40 @@ class GPTConfig:
         # (the head matmul then wants the V-sharded parallel CE).
         self.fused_head = fused_head
         self.fused_head_chunks = fused_head_chunks
+        # striped (load-balanced) sequence parallelism: hidden states
+        # live in the Striped Attention token order END-TO-END during
+        # training (ids/positions striped at embedding, labels
+        # shift-then-stripe in the fused loss — the per-token CE mean
+        # is permutation-invariant, so loss parity is exact).  Requires
+        # sequence_parallel + fused_head; eval/decode stay natural.
+        self.striped_sp = striped_sp
 
 
 def _act_spec(cfg):
     """Sharding of [B, T, H] activations between blocks."""
     return ('dp', 'sp' if cfg.sequence_parallel else None, None)
+
+
+def _striped_sp_now(cfg, training):
+    """sp degree iff a forward traced RIGHT NOW should run in the
+    striped layout.  ONE gate shared by GPT.forward (which stripes the
+    ids/positions) and CausalSelfAttention (which picks the striped
+    ring) so the two can never disagree: config opted in, training
+    with the fused head (striped hidden states are consumed only by
+    the permutation-invariant fused CE loss — eval logits must stay
+    natural), dropout inactive (mirrors _ring_mesh: the ring itself is
+    gated off under attention dropout), and an sp>1 mesh installed."""
+    if not (cfg.striped_sp and cfg.sequence_parallel and cfg.fused_head
+            and training):
+        return None
+    if cfg.dropout > 0.0:
+        return None
+    from ..distributed import env as _env
+    mesh = _env.get_mesh()
+    if mesh is None:
+        return None
+    sp = dict(mesh.shape).get('sp', 1)
+    return sp if sp > 1 else None
 
 
 class CausalSelfAttention(nn.Layer):
@@ -180,8 +210,12 @@ class CausalSelfAttention(nn.Layer):
             q = manipulation.reshape(q, [B * nh, T, hd])
             k = manipulation.reshape(k, [B * nh, T, hd])
             v = manipulation.reshape(v, [B * nh, T, hd])
+            # same gate as GPT.forward: striped traces get the
+            # load-balanced ring over already-striped hidden states
+            striped = _striped_sp_now(self.cfg, self.training) is not None
             y = apply(lambda qv, kv, vv: ring_attention_spmd(
-                qv, kv, vv, ring_mesh, causal=True), q, k, v,
+                qv, kv, vv, ring_mesh, causal=True, striped=striped,
+                pre_striped=striped), q, k, v,
                 op_name='ring_attention')
             y = manipulation.reshape(y, [B, nh, T, hd])
         elif self._use_flash(T):
@@ -307,7 +341,28 @@ class GPT(nn.Layer):
                 x, nc = blk(x, cache=c, pos=pos)
                 new_caches.append(nc)
             return self.ln_f(x), new_caches
-        x = self.wte(input_ids) + F.embedding_prefix(self.wpe.weight, T)
+        sp = _striped_sp_now(self.config, self.training)
+        # what THIS forward actually produced — loss() consults the
+        # record rather than re-deriving from live mode/mesh state,
+        # so a train-forward/eval-loss split cannot mispair layouts
+        self._last_striped = sp
+        if sp is not None:
+            # end-to-end striped layout: ids and the position rows
+            # enter in stripe order; every block then runs the
+            # load-balanced striped ring with NO per-layer relayout
+            from ..core.dispatch import apply as _apply
+            from ..ops.ring_attention import stripe_tokens
+            input_ids = _apply(
+                lambda v: stripe_tokens(v, sp, axis=1), input_ids,
+                op_name='stripe_ids')
+            pos_rows = F.embedding_prefix(self.wpe.weight, T)
+            pos_rows = _apply(
+                lambda v: stripe_tokens(v, sp, axis=0), pos_rows,
+                op_name='stripe_pos')
+            x = self.wte(input_ids) + pos_rows
+        else:
+            x = self.wte(input_ids) + F.embedding_prefix(
+                self.wpe.weight, T)
         x = self.drop(x)
         x = maybe_shard(x, _act_spec(self.config))
         for blk in self.blocks:
@@ -360,14 +415,41 @@ class GPTForCausalLM(nn.Layer):
                 and D != self.config.vocab_size:
             from ..core.dispatch import apply as _apply
             from ..ops.fused_ce import fused_linear_cross_entropy
+            # layout the forward ACTUALLY produced (recorded at trace
+            # time), not a re-derivation from live mode/mesh state
+            sp = getattr(self.gpt, '_last_striped', None)
 
-            def _fce(h, w, lb):
-                hh = h[:, :-1, :].reshape(B * (T - 1), D)
-                yy = lb[:, 1:].reshape(B * (T - 1))
-                losses = fused_linear_cross_entropy(
-                    hh, w.T, yy,
-                    num_chunks=self.config.fused_head_chunks)
-                return losses.mean()
+            if sp is not None:
+                from ..ops.ring_attention import stripe_tokens
+
+                def _fce(h, w, lb):
+                    # hidden states arrive STRIPED; labels are natural
+                    # ids: shift in natural order, mark the last
+                    # position invalid, then stripe — the masked mean
+                    # over B*(T-1) tokens equals the natural-order loss
+                    # exactly (the CE mean is permutation-invariant)
+                    import jax.numpy as jnp
+                    nxt = jnp.concatenate(
+                        [lb[:, 1:], jnp.zeros((B, 1), lb.dtype)], 1)
+                    valid = jnp.concatenate(
+                        [jnp.ones((B, T - 1), bool),
+                         jnp.zeros((B, 1), bool)], 1)
+                    nxt = stripe_tokens(nxt, sp, axis=1)
+                    valid = stripe_tokens(valid, sp, axis=1)
+                    hh = h.reshape(B * T, D)
+                    losses = fused_linear_cross_entropy(
+                        hh, w.T, nxt.reshape(B * T),
+                        num_chunks=self.config.fused_head_chunks)
+                    vv = valid.reshape(B * T).astype(losses.dtype)
+                    return jnp.sum(losses * vv) / jnp.sum(vv)
+            else:
+                def _fce(h, w, lb):
+                    hh = h[:, :-1, :].reshape(B * (T - 1), D)
+                    yy = lb[:, 1:].reshape(B * (T - 1))
+                    losses = fused_linear_cross_entropy(
+                        hh, w.T, yy,
+                        num_chunks=self.config.fused_head_chunks)
+                    return losses.mean()
 
             out = _apply(_fce, logits, self.gpt.wte.weight,
                          labels, op_name='fused_lm_head_ce')

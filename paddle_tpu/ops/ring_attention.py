@@ -278,7 +278,8 @@ def ring_attention_striped(q, k, v, axis_name, scale=None,
 
 def ring_attention_spmd(q, k, v, mesh, causal=True,
                         batch_axes=('dp', 'tp'), seq_axis='sp',
-                        use_flash=None, striped=False):
+                        use_flash=None, striped=False,
+                        pre_striped=False):
     """shard_map wrapper: q/k/v are GLOBAL [B*H, T, D] arrays (traced
     under jit on `mesh`); heads/batch split over `batch_axes`, sequence
     over `seq_axis`; ring rotation rides the `sp` ICI ring.
@@ -286,8 +287,8 @@ def ring_attention_spmd(q, k, v, mesh, causal=True,
     `striped=True` (causal only) runs the load-balanced striped ring:
     inputs are striped/unstriped here for drop-in numerics — GSPMD
     inserts the relayout all-to-alls, so pipelines chasing the full 2x
-    should keep hidden states striped end-to-end and call
-    ring_attention_striped directly instead."""
+    keep hidden states striped end-to-end and pass `pre_striped=True`
+    (inputs already in stripe order; output stays striped)."""
     axes = tuple(a for a in batch_axes if a in mesh.shape)
     spec = P(axes if len(axes) > 1 else (axes[0] if axes else None),
              seq_axis, None)
@@ -300,10 +301,11 @@ def ring_attention_spmd(q, k, v, mesh, causal=True,
         sp = mesh.shape[seq_axis]
         fn = functools.partial(ring_attention_striped,
                                axis_name=seq_axis, use_flash=use_flash)
-        qs, ks, vs = (stripe_tokens(t, sp) for t in (q, k, v))
+        if not pre_striped:
+            q, k, v = (stripe_tokens(t, sp) for t in (q, k, v))
         out = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                            out_specs=spec, check_vma=False)(qs, ks, vs)
-        return unstripe_tokens(out, sp)
+                            out_specs=spec, check_vma=False)(q, k, v)
+        return out if pre_striped else unstripe_tokens(out, sp)
     fn = functools.partial(ring_attention, axis_name=seq_axis,
                            causal=causal, use_flash=use_flash)
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),

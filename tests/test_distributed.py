@@ -279,9 +279,18 @@ class TestRingAttention:
     """SURVEY.md §2 item 35: sequence parallelism via ppermute KV ring."""
 
     def _losses(self, axes, sequence_parallel, n_steps=4):
+        return self._losses_cfg(axes, n_steps=n_steps,
+                                sequence_parallel=sequence_parallel)
+
+    def test_ring_matches_single_device(self):
+        l_sp = self._losses({'sp': 8}, True)
+        l_1 = self._losses({'sp': 1}, False)
+        np.testing.assert_allclose(l_sp, l_1, rtol=2e-4, atol=2e-4)
+
+    def _losses_cfg(self, axes, n_steps=3, fused_head=False, **cfg):
         dist_env.set_mesh(None)
         strategy = fleet.DistributedStrategy()
-        strategy.hybrid_configs['dp_degree'] = 1  # no inference from n_dev
+        strategy.hybrid_configs['dp_degree'] = 1
         for k, v in axes.items():
             key = {'dp': 'dp_degree', 'tp': 'mp_degree',
                    'sp': 'sp_degree'}[k]
@@ -290,7 +299,7 @@ class TestRingAttention:
         paddle.seed(0)
         from paddle_tpu.models import gpt_tiny
         m = gpt_tiny(num_layers=2, hidden_size=32, num_heads=2,
-                     sequence_parallel=sequence_parallel)
+                     dropout=0.0, fused_head=fused_head, **cfg)
         opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
         tr = ParallelTrainer(m, opt, lambda out, y: m.loss(out, y))
         ids = np.random.RandomState(0).randint(0, 128, (4, 16)) \
@@ -298,10 +307,20 @@ class TestRingAttention:
         return [float(np.asarray(tr.step(ids, ids)))
                 for _ in range(n_steps)]
 
-    def test_ring_matches_single_device(self):
-        l_sp = self._losses({'sp': 8}, True)
-        l_1 = self._losses({'sp': 1}, False)
-        np.testing.assert_allclose(l_sp, l_1, rtol=2e-4, atol=2e-4)
+    def test_striped_sp_matches_natural(self):
+        # end-to-end striped layout (ids/positions striped at the
+        # embedding, shift-then-stripe labels in the fused CE): the
+        # per-token mean is permutation-invariant, so losses match
+        l_striped = self._losses_cfg({'sp': 4}, fused_head=True,
+                                     sequence_parallel=True,
+                                     striped_sp=True)
+        l_natural = self._losses_cfg({'sp': 4}, fused_head=True,
+                                     sequence_parallel=True)
+        l_single = self._losses_cfg({'sp': 1}, fused_head=True)
+        np.testing.assert_allclose(l_striped, l_natural,
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(l_striped, l_single,
+                                   rtol=2e-4, atol=2e-4)
 
     def test_ring_hybrid_mesh(self):
         l_h = self._losses({'dp': 2, 'tp': 2, 'sp': 2}, True)
