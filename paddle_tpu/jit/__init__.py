@@ -11,6 +11,7 @@ fused StableHLO module.  save/load round-trips through jax.export
 serialization (our StableHLO stand-in for the reference's saved
 ProgramDesc + params).
 """
+import functools
 import os
 import pickle
 
@@ -112,6 +113,17 @@ class StaticFunction:
             else None
         self._jitted = {}          # static-key -> jitted fn
         self._last_lowered = None  # for save()
+        # forward the USER callable's identity (the reference's
+        # StaticFunction does the same); for a wrapped Layer that is
+        # the layer's forward, not the internal _BoundForward adapter
+        src = dygraph_function
+        if isinstance(src, _BoundForward):
+            src = type(src._inner).forward
+        functools.update_wrapper(
+            self, src,
+            assigned=('__name__', '__qualname__', '__doc__',
+                      '__module__'),
+            updated=())
 
     @property
     def dygraph_function(self):

@@ -453,3 +453,53 @@ class TestForRangeConversion:
         st = to_static(fn)
         np.testing.assert_allclose(
             np.asarray(st(_t([1.0])).numpy()), [3.0])
+
+
+class TestControlFlowProbes:
+    """Regression probes: nested loop break/continue accumulation,
+    tensor-if with early returns, tensor-if without else plus tail."""
+
+    def test_nested_break_continue_accumulation(self):
+        @paddle.jit.to_static
+        def f(x):
+            total = paddle.zeros([1])
+            for i in range(5):
+                if i == 3:
+                    break
+                for j in range(4):
+                    if j == 2:
+                        continue
+                    total = total + x * (i + j)
+            return total
+        np.testing.assert_allclose(
+            f(paddle.to_tensor([1.0])).numpy(), [21.0], rtol=1e-6)
+
+    def test_tensor_if_early_return_both_branches(self):
+        @paddle.jit.to_static
+        def f(x):
+            if x.sum() > 0:
+                return x + 1
+            else:
+                return x - 1
+        np.testing.assert_allclose(
+            f(paddle.to_tensor([2.0])).numpy(), [3.0], rtol=1e-6)
+        np.testing.assert_allclose(
+            f(paddle.to_tensor([-2.0])).numpy(), [-3.0], rtol=1e-6)
+
+    def test_tensor_if_no_else_with_tail(self):
+        @paddle.jit.to_static
+        def f(x):
+            y = x * 1.0
+            if x.sum() > 10:
+                y = y + 100
+            return y + 1
+        np.testing.assert_allclose(
+            f(paddle.to_tensor([2.0])).numpy(), [3.0], rtol=1e-6)
+        np.testing.assert_allclose(
+            f(paddle.to_tensor([20.0])).numpy(), [121.0], rtol=1e-6)
+
+    def test_static_function_forwards_name(self):
+        @paddle.jit.to_static
+        def my_fn(x):
+            return x
+        assert my_fn.__name__ == 'my_fn'
