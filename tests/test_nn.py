@@ -506,3 +506,31 @@ class TestDecode:
         lab = paddle.to_tensor(np.array([0, 0, 1, 2], 'int64'))
         v = float(F.npair_loss(a, p, lab))
         assert np.isfinite(v) and v > 0
+
+
+class TestMultiHeadAttentionParity:
+    def test_mha_vs_torch(self):
+        """Weight-mapped numeric parity with torch MultiheadAttention
+        (paddle keeps separate q/k/v projections; torch packs them)."""
+        B, T, H, NH = 2, 5, 16, 4
+        rs = np.random.RandomState(0)
+        x = rs.randn(B, T, H).astype('float32')
+        ours = nn.MultiHeadAttention(H, NH, dropout=0.0)
+        ref = torch.nn.MultiheadAttention(H, NH, dropout=0.0,
+                                          batch_first=True)
+        sd = {n: p.numpy() for n, p in ours.named_parameters()}
+        with torch.no_grad():
+            ref.in_proj_weight.copy_(torch.tensor(np.concatenate(
+                [sd['q_proj.weight'].T, sd['k_proj.weight'].T,
+                 sd['v_proj.weight'].T], 0)))
+            ref.in_proj_bias.copy_(torch.tensor(np.concatenate(
+                [sd['q_proj.bias'], sd['k_proj.bias'],
+                 sd['v_proj.bias']], 0)))
+            ref.out_proj.weight.copy_(
+                torch.tensor(sd['out_proj.weight'].T))
+            ref.out_proj.bias.copy_(torch.tensor(sd['out_proj.bias']))
+        y_ours = ours(paddle.to_tensor(x))
+        y_ref, _ = ref(torch.tensor(x), torch.tensor(x),
+                       torch.tensor(x))
+        np.testing.assert_allclose(t2n(y_ours), y_ref.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
