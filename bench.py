@@ -18,7 +18,8 @@ documented V100-class reference points, vs_baseline = value/baseline):
 
 Prints ONE JSON line to stdout: the headline ResNet metric, with the
 other configs nested under "extras". Progress goes to stderr.
-Run a single config with --config {lenet,resnet,bert,gpt,widedeep}.
+Run a single config with --config
+{lenet,resnet,bert,gpt,widedeep,longctx,gptgen} (or 'all').
 """
 import argparse
 import json
@@ -36,6 +37,8 @@ BASELINES = {
     'gpt': 20_000.0,        # tokens/s (V100-class GPT-2 small AMP)
     'gptgen': 2_000.0,      # decoded tokens/s (V100-class KV-cache
                             # batch-8 GPT-2 small generation)
+    'longctx': 5_000.0,     # tokens/s (V100-class GPT-2 small T=4096:
+                            # activation memory forces micro-batching)
 }
 
 
@@ -148,23 +151,21 @@ def bench_bert(smoke):
     return v
 
 
-def bench_gpt(smoke):
-    """GPT-2 small causal-LM train at T=1024 — the long-sequence
-    single-chip face of SURVEY §3 config 4 (the hybrid multichip path
-    is dryrun_multichip); flash attention carries the T^2 term."""
+def _bench_gpt_train(smoke, *, smoke_shape, full_shape, label):
+    """Shared GPT-2 train-bench harness (gpt @T=1024, longctx @T=4096):
+    fused CE head, flash attention on the T^2 term, bf16 AMP O2."""
     import jax
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import gpt_small, gpt_tiny
     from paddle_tpu.parallel import ParallelTrainer
     from paddle_tpu.distributed import fleet
 
-    batch, seq, iters, warmup = (2, 128, 3, 2) if smoke else \
-        (8, 1024, 15, 3)
+    batch, seq, iters, warmup = smoke_shape if smoke else full_shape
     paddle.seed(0)
     # fused_head: the LM-head matmul fuses into the loss (ops/
-    # fused_ce.py) — no f32 [B·T, V] logits tensor, the top HBM
+    # fused_ce.py) — no f32 [B*T, V] logits tensor, the top HBM
     # consumer of the unfused step
-    model = gpt_tiny(fused_head=True) if smoke else \
+    model = gpt_tiny(fused_head=True, max_seq_len=seq) if smoke else \
         gpt_small(max_seq_len=seq, dropout=0.0, fused_head=True,
                   fused_head_chunks=8)
     opt = paddle.optimizer.AdamW(learning_rate=3e-4,
@@ -184,13 +185,32 @@ def bench_gpt(smoke):
     for _ in range(warmup):
         loss = trainer.step(ids, ids)
     jax.block_until_ready(loss)
-    log(f'gpt warmup ({warmup} steps incl. compile): '
+    log(f'{label} warmup ({warmup} steps incl. compile): '
         f'{time.time() - t0:.1f}s loss={float(np.asarray(loss)):.4f}')
     dt = _time_steps(trainer.step, iters, ids, ids)
     v = batch * seq * iters / dt
-    log(f'gpt2-small: {iters} steps in {dt:.2f}s '
+    log(f'{label} T={seq}: {iters} steps in {dt:.2f}s '
         f'({dt / iters * 1000:.1f} ms/step, {v:.0f} tokens/s)')
     return v
+
+
+def bench_gpt(smoke):
+    """GPT-2 small causal-LM train at T=1024 — the single-chip face of
+    SURVEY §3 config 4 (the hybrid multichip path is
+    dryrun_multichip); the fused CE head is the bench default."""
+    return _bench_gpt_train(smoke, smoke_shape=(2, 128, 3, 2),
+                            full_shape=(8, 1024, 15, 3),
+                            label='gpt2-small')
+
+
+def bench_longctx(smoke):
+    """GPT-2 small at T=4096 on ONE chip — the long-context face of
+    the brief: flash attention carries the 16x-larger T^2 term in
+    O(block) memory.  (Beyond-one-chip sequences ride the sp ring;
+    see dryrun.)"""
+    return _bench_gpt_train(smoke, smoke_shape=(1, 256, 2, 2),
+                            full_shape=(2, 4096, 10, 3),
+                            label='gpt2-small-longctx')
 
 
 def bench_widedeep(smoke):
@@ -314,6 +334,7 @@ CONFIGS = {
     'bert': bench_bert,
     'gpt': bench_gpt,
     'widedeep': bench_widedeep,
+    'longctx': bench_longctx,
     # gptgen runs LAST: it is the only config that has ever wedged the
     # dev tunnel mid-run (r4: 900s timeout, tunnel dead afterwards) —
     # a repeat must not cost the other configs their numbers.
@@ -324,7 +345,7 @@ CONFIGS = {
 # the tunnel (round-2: 5h outage), so the configs whose remote compile
 # is slow get a generous window instead of a kill: gptgen's whole
 # prefill+decode scan is one big XLA module.
-TIMEOUT_SCALE = {'gptgen': 3}
+TIMEOUT_SCALE = {'gptgen': 3, 'longctx': 2}
 
 UNITS = {
     'lenet': 'imgs/sec/chip',
@@ -333,6 +354,7 @@ UNITS = {
     'gpt': 'tokens/sec/chip',
     'gptgen': 'decoded tokens/sec/chip',
     'widedeep': 'examples/sec/chip',
+    'longctx': 'tokens/sec/chip',
 }
 
 
@@ -511,6 +533,7 @@ def main():
         'bert': 'bert_base_bf16_pretrain_throughput',
         'gpt': 'gpt2_small_bf16_train_throughput',
         'gptgen': 'gpt2_small_kvcache_decode_throughput',
+        'longctx': 'gpt2_small_t4096_train_throughput',
         'widedeep': 'widedeep_sparse_train_throughput',
         'lenet': 'lenet_train_throughput',
     }
