@@ -347,6 +347,16 @@ CONFIGS = {
 # prefill+decode scan is one big XLA module.
 TIMEOUT_SCALE = {'gptgen': 3, 'longctx': 2}
 
+METRIC_NAMES = {
+    'resnet': 'resnet50_bf16_train_throughput',
+    'bert': 'bert_base_bf16_pretrain_throughput',
+    'gpt': 'gpt2_small_bf16_train_throughput',
+    'gptgen': 'gpt2_small_kvcache_decode_throughput',
+    'longctx': 'gpt2_small_t4096_train_throughput',
+    'widedeep': 'widedeep_sparse_train_throughput',
+    'lenet': 'lenet_train_throughput',
+}
+
 UNITS = {
     'lenet': 'imgs/sec/chip',
     'resnet': 'imgs/sec/chip',
@@ -528,15 +538,6 @@ def main():
             log(f'device: {jax.devices()[0]}')
             results[name] = _run_one(name, args.smoke)
 
-    metric_names = {
-        'resnet': 'resnet50_bf16_train_throughput',
-        'bert': 'bert_base_bf16_pretrain_throughput',
-        'gpt': 'gpt2_small_bf16_train_throughput',
-        'gptgen': 'gpt2_small_kvcache_decode_throughput',
-        'longctx': 'gpt2_small_t4096_train_throughput',
-        'widedeep': 'widedeep_sparse_train_throughput',
-        'lenet': 'lenet_train_throughput',
-    }
     # headline = resnet when it produced a number, else the first
     # config that did (a failed-resnet dict must not win selection)
     head_name = 'resnet' if (results.get('resnet') or {}).get('value') \
@@ -544,7 +545,7 @@ def main():
                   'resnet')
     head = results.get(head_name, {})
     out = {
-        'metric': metric_names[head_name],
+        'metric': METRIC_NAMES[head_name],
         'value': head.get('value'),
         'unit': head.get('unit', UNITS.get(head_name)),
         'vs_baseline': head.get('vs_baseline'),

@@ -269,3 +269,22 @@ class TestUtilsNamespace:
         import paddle_tpu as paddle
         paddle.utils.run_check()
         assert 'successfully' in capsys.readouterr().out
+
+
+class TestBenchRegistry:
+    """Every bench config must be registered in every lookup table —
+    a missing key is a KeyError in the middle of a chip window."""
+
+    def test_config_tables_aligned(self):
+        import importlib.util
+        import os
+        path = os.path.join(os.path.dirname(__file__), '..', 'bench.py')
+        spec = importlib.util.spec_from_file_location('bench', path)
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        names = set(bench.CONFIGS)
+        assert set(bench.UNITS) == names
+        assert set(bench.BASELINES) == names
+        assert set(bench.METRIC_NAMES) == names
+        assert set(bench.TIMEOUT_SCALE) <= names
+        assert list(bench.CONFIGS)[-1] == 'gptgen'  # wedge risk last
