@@ -42,8 +42,44 @@ BASELINES = {
 }
 
 
+CHIP_OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        'tools', 'chip_out')
+# committed, per-config chip-verified numbers (tools/chip_session.py
+# commits this file after every bench step) — the stale-merge source
+# when the tunnel is dead at driver time
+CHIP_RESULTS = os.path.join(CHIP_OUT, 'bench_results.json')
+GPTGEN_FALLBACK_FLAG = os.path.join(CHIP_OUT, 'gptgen_fallback.flag')
+
+
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+def _load_chip_results():
+    try:
+        with open(CHIP_RESULTS) as f:
+            d = json.load(f)
+        return d if isinstance(d, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def _record_chip_result(name, res):
+    """Persist a chip-verified per-config number (merged, timestamped)
+    so a later dead-tunnel driver run can still surface it as stale
+    evidence.  Only real-TPU, non-smoke numbers qualify — round 4 lost
+    a whole session's measurements to a CPU smoke run overwriting the
+    partial artifact."""
+    if res.get('value') is None or res.get('platform') != 'tpu':
+        return
+    os.makedirs(CHIP_OUT, exist_ok=True)
+    merged = _load_chip_results()
+    merged[name] = dict(res, measured_at=time.strftime(
+        '%Y-%m-%dT%H:%M:%SZ', time.gmtime()))
+    tmp = CHIP_RESULTS + '.tmp'
+    with open(tmp, 'w') as f:
+        json.dump(merged, f, indent=1, sort_keys=True)
+    os.replace(tmp, CHIP_RESULTS)
 
 
 def _time_steps(step, iters, *args):
@@ -269,8 +305,21 @@ def bench_gptgen(smoke):
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import gpt_small, gpt_tiny
 
-    batch, prompt, new, iters = (2, 8, 8, 2) if smoke else \
-        (8, 128, 128, 5)
+    bench_gptgen.last_note = None
+    fallback = os.path.exists(GPTGEN_FALLBACK_FLAG)
+    if smoke:
+        batch, prompt, new, iters = (2, 8, 8, 2)
+    elif fallback:
+        # a previous session recorded a mid-compile timeout: halve the
+        # decode module (shape drives compile time) so this session
+        # gets a number instead of another wedge
+        batch, prompt, new, iters = (4, 64, 64, 5)
+        bench_gptgen.last_note = (
+            f'fallback shape b{batch} p{prompt} n{new} '
+            '(previous session timed out mid-compile)')
+        log(f'gptgen: {bench_gptgen.last_note}')
+    else:
+        batch, prompt, new, iters = (8, 128, 128, 5)
     paddle.seed(0)
     model = gpt_tiny() if smoke else gpt_small(max_seq_len=prompt + new,
                                                dropout=0.0)
@@ -283,6 +332,9 @@ def bench_gptgen(smoke):
                          temperature=0)
     np.asarray(out.value)
     log(f'gptgen warmup (incl. compile): {time.time() - t0:.1f}s')
+    marker = os.environ.get('BENCH_COMPILE_MARKER')
+    if marker:      # tell the no-kill parent the compile is behind us
+        open(marker, 'w').close()
     t0 = time.time()
     for i in range(iters):
         out = model.generate(paddle.to_tensor(ids), max_new_tokens=new,
@@ -292,6 +344,17 @@ def bench_gptgen(smoke):
     v = batch * new * iters / dt
     log(f'gpt-generate: {iters} x {new} tokens in {dt:.2f}s '
         f'({v:.0f} tokens/s decoded)')
+    if fallback and not smoke:
+        # a completed fallback run retires the flag: the orphaned /
+        # post-compile full-shape module has had a session to land in
+        # the persistent XLA cache, so the NEXT session retries full
+        # shape (and re-arms on another timeout)
+        try:
+            os.remove(GPTGEN_FALLBACK_FLAG)
+            log('gptgen: fallback flag cleared — next session retries '
+                'the full shape')
+        except OSError:
+            pass
     return v
 
 
@@ -370,12 +433,18 @@ UNITS = {
 
 def _run_one(name, smoke):
     """Run one config in-process; returns its result dict."""
+    import jax
     from paddle_tpu.distributed import env as dist_env
     dist_env.set_mesh(None)
     try:
         v = CONFIGS[name](smoke)
-        return {'value': round(v, 2), 'unit': UNITS[name],
-                'vs_baseline': round(v / BASELINES[name], 4)}
+        res = {'value': round(v, 2), 'unit': UNITS[name],
+               'vs_baseline': round(v / BASELINES[name], 4),
+               'platform': jax.default_backend()}
+        note = getattr(CONFIGS[name], 'last_note', None)
+        if note:
+            res['note'] = note
+        return res
     except Exception as e:  # one config failing must not hide the rest
         log(f'{name} FAILED: {e!r}')
         return {'value': None, 'unit': UNITS[name],
@@ -404,15 +473,106 @@ def _run_isolated(name, smoke, timeout_s):
         return {'value': None, 'unit': UNITS[name],
                 'error': f'timeout after {timeout_s}s',
                 'stderr_tail': tail[-400:]}
-    for line in reversed(proc.stdout.strip().splitlines()):
+    parsed = _last_json_dict(proc.stdout)
+    if parsed is not None:
+        return parsed
+    log(f'{name} produced no JSON (rc={proc.returncode}): '
+        f'{proc.stderr[-300:]}')
+    return {'value': None, 'unit': UNITS[name],
+            'error': f'no output (rc={proc.returncode})'}
+
+
+# configs whose child must never be killed mid-compile: gptgen's whole
+# prefill+decode scan is one huge XLA module whose remote compile hit
+# ~900s in round 4, and killing a python mid-TPU-compile wedges the
+# shared tunnel for hours (round-2: 5h outage, round-4: two sessions)
+NO_KILL = {'gptgen'}
+
+
+def _arm_gptgen_fallback(reason):
+    os.makedirs(CHIP_OUT, exist_ok=True)
+    with open(GPTGEN_FALLBACK_FLAG, 'w') as f:
+        json.dump({'at': time.strftime('%Y-%m-%dT%H:%M:%SZ',
+                                       time.gmtime()),
+                   'reason': reason}, f)
+    log(f'gptgen fallback armed: {reason}')
+
+
+def _last_json_dict(text):
+    """Last JSON-dict line of a child's stdout, or None."""
+    for line in reversed(text.strip().splitlines()):
         try:
             parsed = json.loads(line)
         except ValueError:
             continue
         if isinstance(parsed, dict):   # stray numeric lines don't count
             return parsed
-    log(f'{name} produced no JSON (rc={proc.returncode}): '
-        f'{proc.stderr[-300:]}')
+    return None
+
+
+def _run_no_kill(name, smoke, timeout_s):
+    """Like _run_isolated, but safe for tunnel-wedging compiles:
+    - the child signals 'compile done' via a marker file; past the
+      timeout we only kill it AFTER that marker exists (killing during
+      execution is safe; killing during compile wedges the tunnel);
+    - a child still compiling at 2x the budget is ORPHANED, not killed
+      — it finishes the compile eventually and warms the persistent
+      XLA cache, so the next session's attempt is fast;
+    - either timeout path arms the halved-shape fallback flag so the
+      next attempt compiles a much smaller module."""
+    import subprocess
+    os.makedirs(CHIP_OUT, exist_ok=True)
+    marker = os.path.join(CHIP_OUT, f'{name}_compile_done.marker')
+    if os.path.exists(marker):
+        os.remove(marker)
+    cmd = [sys.executable, os.path.abspath(__file__), '--config', name,
+           '--single-json']
+    if smoke:
+        cmd.append('--smoke')
+    out_p = os.path.join(CHIP_OUT, f'{name}_child.out')
+    err_p = os.path.join(CHIP_OUT, f'{name}_child.err')
+    env = dict(os.environ, BENCH_COMPILE_MARKER=marker)
+    with open(out_p, 'w') as so, open(err_p, 'w') as se:
+        proc = subprocess.Popen(cmd, stdout=so, stderr=se, env=env,
+                                start_new_session=True)
+    deadline = time.time() + timeout_s
+    hard_deadline = deadline + timeout_s
+    while proc.poll() is None:
+        time.sleep(5)
+        now = time.time()
+        if proc.poll() is not None:
+            break   # finished during the sleep — its result counts
+        if now > deadline and os.path.exists(marker):
+            proc.kill()
+            proc.wait()
+            _arm_gptgen_fallback(
+                f'post-compile timeout after {timeout_s}s')
+            return {'value': None, 'unit': UNITS[name],
+                    'error': f'timeout after {timeout_s}s '
+                             '(compile had finished; child killed)'}
+        if now > hard_deadline:
+            _arm_gptgen_fallback(
+                f'compile still running at {2 * timeout_s}s')
+            return {'value': None, 'unit': UNITS[name],
+                    'error': f'compile exceeded {2 * timeout_s}s; '
+                             'child orphaned (not killed — a '
+                             'mid-compile kill wedges the tunnel) to '
+                             'finish warming the XLA cache',
+                    'orphan_pid': proc.pid}
+    try:
+        with open(out_p) as f:
+            stdout = f.read()
+    except OSError:
+        stdout = ''
+    parsed = _last_json_dict(stdout)
+    if parsed is not None:
+        return parsed
+    try:
+        with open(err_p) as f:
+            err_tail = f.read()[-300:]
+    except OSError:
+        err_tail = ''
+    log(f'{name} produced no JSON (rc={proc.returncode}): {err_tail}')
     return {'value': None, 'unit': UNITS[name],
             'error': f'no output (rc={proc.returncode})'}
 
@@ -503,16 +663,34 @@ def main():
     preflight_s = min(600, args.timeout * len(names))
     if args.config == 'all' and not _device_preflight(preflight_s):
         # dead accelerator tunnel: emit the artifact immediately with
-        # errors instead of hanging 5 subprocesses to their timeouts
-        results = {n: {'value': None, 'unit': UNITS[n],
-                       'error': 'device preflight failed (accelerator '
-                                'runtime unreachable)'} for n in names}
+        # errors instead of hanging 5 subprocesses to their timeouts —
+        # but surface the most recent committed chip-verified number
+        # per config (tagged stale_from) so a tunnel death at driver
+        # time preserves real measurements with honest provenance;
+        # top-level value stays null so staleness can never
+        # masquerade as a fresh number
+        stale = _load_chip_results()
+        for n in names:
+            r = {'value': None, 'unit': UNITS[n],
+                 'error': 'device preflight failed (accelerator '
+                          'runtime unreachable)'}
+            s = stale.get(n) or {}
+            if s.get('value') is not None:
+                r['stale_value'] = s['value']
+                r['stale_vs_baseline'] = s.get('vs_baseline')
+                r['stale_from'] = s.get('measured_at')
+                if s.get('note'):       # e.g. gptgen fallback shape —
+                    r['stale_note'] = s['note']  # provenance must ride
+            results[n] = r
         names = []
     for i, name in enumerate(names):
         if args.config == 'all':
-            results[name] = _run_isolated(
+            runner = _run_no_kill if name in NO_KILL else _run_isolated
+            results[name] = runner(
                 name, args.smoke,
                 args.timeout * TIMEOUT_SCALE.get(name, 1))
+            if not args.smoke:
+                _record_chip_result(name, results[name])
             # partial artifact after EVERY config: a tunnel death (or
             # driver kill) mid-run keeps the finished configs' numbers
             _write_partial(results)
@@ -537,6 +715,8 @@ def main():
             import jax
             log(f'device: {jax.devices()[0]}')
             results[name] = _run_one(name, args.smoke)
+            if not args.smoke:
+                _record_chip_result(name, results[name])
 
     # headline = resnet when it produced a number, else the first
     # config that did (a failed-resnet dict must not win selection)
@@ -551,6 +731,12 @@ def main():
         'vs_baseline': head.get('vs_baseline'),
         'extras': {k: v for k, v in results.items() if k != head_name},
     }
+    # the headline config is excluded from extras, so its stale
+    # provenance (if any) rides at the top level
+    for k in ('stale_value', 'stale_vs_baseline', 'stale_from',
+              'stale_note'):
+        if k in head:
+            out[k] = head[k]
     print(json.dumps(out))
 
 

@@ -87,6 +87,46 @@ def preflight(timeout_s=90):
         return False
 
 
+def commit_artifacts(name, ok):
+    """Commit the step's artifacts IMMEDIATELY (round-4 lesson: the
+    only copies of a whole session's measurements lived in gitignored
+    files and PERF.md prose — a later CPU smoke run overwrote them).
+    Logs land on success AND failure; a failure log is evidence too."""
+    paths = [os.path.join('tools', 'chip_out')]
+    tuning = os.path.join(REPO, 'paddle_tpu', 'ops',
+                          'flash_attention_tuning.json')
+    if os.path.exists(tuning):
+        paths.append(os.path.relpath(tuning, REPO))
+    for attempt in range(3):
+        try:
+            subprocess.run(['git', 'add', '-A', '--'] + paths,
+                           cwd=REPO, check=True, capture_output=True)
+            staged = subprocess.run(
+                ['git', 'diff', '--cached', '--quiet', '--'] + paths,
+                cwd=REPO)
+            if staged.returncode == 0:
+                return          # nothing new
+            # pathspec-scoped commit: a concurrent interactive session
+            # may have unrelated files staged — those must not be
+            # swept into a chip-evidence commit
+            subprocess.run(
+                ['git', 'commit', '-m',
+                 f'chip evidence: {name} '
+                 f'({"ok" if ok else "failed"})', '--'] + paths,
+                cwd=REPO, check=True, capture_output=True)
+            log(f'{name}: artifacts committed')
+            return
+        except subprocess.CalledProcessError as e:
+            # index.lock contention with an interactive session is the
+            # expected failure; back off and retry
+            log(f'{name}: git commit attempt {attempt + 1} failed '
+                f'({e.stderr.decode(errors="replace")[-200:]}); '
+                'retrying in 15s')
+            time.sleep(15)
+    log(f'{name}: artifacts NOT committed after 3 attempts '
+        '(left staged/untracked for manual pickup)')
+
+
 def run_step(name, argv, timeout_s):
     okf = os.path.join(OUT, f'{name}.ok')
     if os.path.exists(okf):
@@ -102,15 +142,18 @@ def run_step(name, argv, timeout_s):
                                timeout=timeout_s)
         except subprocess.TimeoutExpired:
             log(f'{name}: TIMED OUT after {timeout_s}s')
+            commit_artifacts(name, ok=False)
             return False
     dt = time.time() - t0
     if p.returncode == 0:
         with open(okf, 'w') as fh:
             fh.write(json.dumps({'t': time.time(), 'dur_s': dt}))
         log(f'{name}: ok in {dt:.0f}s')
+        commit_artifacts(name, ok=True)
         return True
     log(f'{name}: FAILED rc={p.returncode} after {dt:.0f}s '
         f'(tail: see {logf})')
+    commit_artifacts(name, ok=False)
     return False
 
 
