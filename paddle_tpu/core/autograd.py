@@ -409,7 +409,18 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         if create_graph and node.pure is not None:
             in_grads = _taped_vjp(node)
         else:
-            in_grads = node.vjp_fn(node.cotangents())
+            cts = node.cotangents()
+            if create_graph:
+                # under create_graph cotangents are seeded/accumulated
+                # as Tensors, but a raw closure (PyLayer) expects
+                # arrays — it wraps them itself, so a Tensor here
+                # would be double-wrapped and crash the user backward
+                if node.out_is_seq:
+                    cts = tuple(c.value if isinstance(c, Tensor) else c
+                                for c in cts)
+                elif isinstance(cts, Tensor):
+                    cts = cts.value
+            in_grads = node.vjp_fn(cts)
             if create_graph:
                 # PyLayer fallback: differentiable once, leaf beyond
                 in_grads = [None if g is None

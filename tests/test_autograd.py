@@ -290,6 +290,70 @@ class TestPyLayer:
                                    2 * (1 - np.tanh([0.6, 1.4]) ** 2),
                                    rtol=1e-5)
 
+    def test_create_graph_through_pylayer(self):
+        """ADVICE r4: paddle.grad(create_graph=True) over a graph
+        containing a PyLayer must not double-wrap the cotangent (the
+        raw closure wraps arrays itself).  The PyLayer differentiates
+        once; its gradient is a leaf for double-grad (documented
+        fallback in core/autograd.py GradNode)."""
+        from paddle_tpu.autograd import PyLayer
+
+        class Sq(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, dy):
+                x, = ctx.saved_tensor()
+                return dy * 2.0 * x
+
+        x = mk([0.5, -1.5])
+        g1 = paddle.grad(Sq.apply(x).sum(), x, create_graph=True)[0]
+        np.testing.assert_allclose(g1.numpy(), 2 * np.array([0.5, -1.5]),
+                                   rtol=1e-6)
+        # the leaf gradient composes with taped ops downstream: d/dx of
+        # sum(g1 * x) with g1 treated as a constant is g1 itself
+        g2 = paddle.grad((g1 * x).sum(), x, allow_unused=True)[0]
+        np.testing.assert_allclose(g2.numpy(), g1.numpy(), rtol=1e-6)
+
+    def test_create_graph_pylayer_multi_output(self):
+        """out_is_seq branch of the cotangent unwrap: a multi-output
+        PyLayer under create_graph gets a TUPLE of cotangents, each of
+        which may be a graph-carrying Tensor."""
+        from paddle_tpu.autograd import PyLayer
+
+        class two(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x, 3.0 * x
+
+            @staticmethod
+            def backward(ctx, da, db):
+                x, = ctx.saved_tensor()
+                return da * 2.0 * x + db * 3.0
+
+        x = mk([2.0, -1.0])
+        a, b = two.apply(x)
+        g = paddle.grad((a + b).sum(), x, create_graph=True)[0]
+        np.testing.assert_allclose(g.numpy(),
+                                   2 * np.array([2.0, -1.0]) + 3.0,
+                                   rtol=1e-6)
+
+    def test_create_graph_pylayer_mixed_tape(self):
+        """PyLayer inside a longer taped chain under create_graph: the
+        cotangent reaching the PyLayer is a graph-carrying Tensor and
+        must be unwrapped exactly once."""
+        cus_tanh = self._tanh_layer()
+        x = mk([0.3, 0.7])
+        y = cus_tanh.apply(x * 2.0).sum()
+        g1 = paddle.grad(y, x, create_graph=True)[0]
+        np.testing.assert_allclose(g1.numpy(),
+                                   2 * (1 - np.tanh([0.6, 1.4]) ** 2),
+                                   rtol=1e-5)
+
     def test_multi_input_output(self):
         from paddle_tpu.autograd import PyLayer
 
