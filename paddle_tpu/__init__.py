@@ -73,6 +73,33 @@ from . import incubate  # noqa: F401
 from .hapi import Model, summary, flops  # noqa: F401
 from . import callbacks  # noqa: F401
 
+# --- 1.x/2.0 top-level compat tail (reference python/paddle/
+# __init__.py:26-28,43,265-268) ---------------------------------------
+# enable_dygraph/disable_dygraph are the names behind the reference's
+# disable_static/enable_static aliases; dygraph is this framework's
+# default mode, so they delegate to the static-mode switch.
+from .fluid import enable_dygraph, disable_dygraph  # noqa: F401
+from .fluid.framework import in_dygraph_mode  # noqa: F401
+from .tensor.manipulation import crop as crop_tensor  # noqa: F401
+# reference: `from .framework import VarBase as Tensor` — the 1.x name
+# for the eager tensor is this framework's Tensor
+VarBase = Tensor
+
+
+def monkey_patch_variable():
+    """Reference __init__ calls this to graft math methods onto static
+    Variables (python/paddle/__init__.py:26,28).  Here static Program
+    variables are built with their full method surface from the start
+    (static/program.py), so the patch is an idempotent no-op kept for
+    API parity."""
+
+
+def monkey_patch_math_varbase():
+    """Reference __init__ grafts math dunders onto VarBase
+    (python/paddle/__init__.py:27,29).  Tensor ships with the full
+    dunder surface (tensor/__init__.py binds 147 methods at import),
+    so the patch is an idempotent no-op kept for API parity."""
+
 __all__ = ['Tensor', 'Parameter', 'no_grad', 'enable_grad', 'seed',
            'set_device', 'get_device', 'save', 'load', 'enable_static',
            'disable_static', 'Model', 'summary', 'flops',
@@ -80,4 +107,6 @@ __all__ = ['Tensor', 'Parameter', 'no_grad', 'enable_grad', 'seed',
            'DataParallel', 'ComplexTensor', 'dtype', 'bool',
            'get_cuda_rng_state', 'set_cuda_rng_state',
            'NPUPlace', 'CUDAPinnedPlace', 'is_compiled_with_npu',
-           'get_cudnn_version'] + list(_tensor_all)
+           'get_cudnn_version', 'enable_dygraph', 'disable_dygraph',
+           'in_dygraph_mode', 'crop_tensor', 'VarBase'] + \
+    list(_tensor_all)

@@ -295,12 +295,12 @@ def pad2d(input, paddings=(0, 0, 0, 0), mode='constant',
 
 @_register
 def crop_tensor(x, shape=None, offsets=None, name=None):
-    def fn(v):
-        shp = [int(s) for s in shape]
-        offs = [int(o) for o in (offsets or [0] * v.ndim)]
-        sl = tuple(slice(o, o + s) for o, s in zip(offs, shp))
-        return v[sl]
-    return apply(fn, wrap(x), op_name='crop_tensor')
+    """Reference fluid.layers.crop_tensor — delegates to
+    tensor.manipulation.crop, which carries the full semantics
+    (-1 keeps offset..end of the dim; shape=None keeps the input
+    shape)."""
+    from ..tensor.manipulation import crop
+    return crop(x, shape=shape, offsets=offsets, name=name)
 
 
 @_register
