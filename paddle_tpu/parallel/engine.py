@@ -377,15 +377,20 @@ class ParallelTrainer:
         return jax.jit(train_step, **kwargs)
 
     # -- public API ----------------------------------------------------------
-    def step(self, *batch):
-        """batch: numpy/jax arrays (x, y, ...). Returns python float loss."""
-        if self._pipeline:
-            return self._pipe_step(*batch)
+    def _ensure_compiled(self, batch):
+        """Coerce the batch to raw arrays and latch the jitted step."""
         vals = tuple(b.value if isinstance(b, Tensor) else jnp.asarray(b)
                      for b in batch)
         if self._compiled is None:
             self._n_batch = len(vals)
             self._compiled = self._build_step()
+        return vals
+
+    def step(self, *batch):
+        """batch: numpy/jax arrays (x, y, ...). Returns python float loss."""
+        if self._pipeline:
+            return self._pipe_step(*batch)
+        vals = self._ensure_compiled(batch)
         key = rng_mod.next_key()
         self.params, self.buffers, self.opt_state, loss = self._compiled(
             self.params, self.buffers, self.opt_state,
@@ -393,6 +398,25 @@ class ParallelTrainer:
         self._step_no += 1
         # LR-scheduler advancement is the caller's job (hapi epoch loop)
         return loss
+
+    def op_summary(self, *batch, sorted_by='total', **kwargs):
+        """Per-op table of THIS trainer's compiled train step
+        (profiler.op_summary) — lowers and compiles on the example
+        batch but does not execute and does not touch the global RNG
+        stream, so profiling never perturbs a seeded run.  Costs one
+        AOT compile; the later step() compile is a separate jit-cache
+        entry (deduped by the persistent XLA cache on TPU)."""
+        from ..profiler import op_summary
+        if self._pipeline:
+            raise NotImplementedError(
+                'op_summary under pipeline parallelism: profile the '
+                'per-stage module instead')
+        vals = self._ensure_compiled(batch)
+        # tracing placeholder only — must NOT advance rng_mod's stream
+        key = jax.random.PRNGKey(0)
+        return op_summary(self._compiled, self.params, self.buffers,
+                          self.opt_state, jnp.asarray(self._step_no + 1),
+                          key, *vals, sorted_by=sorted_by, **kwargs)
 
     def eval_step(self, *batch):
         if self._pipeline:

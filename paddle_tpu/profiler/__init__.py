@@ -1,15 +1,140 @@
 """Profiler (reference: python/paddle/fluid/profiler.py + platform/
 profiler).  TPU-native: wraps jax.profiler traces (viewable in
 TensorBoard/XProf) and adds host-side step timers — the reference's
-nvprof hooks have no TPU meaning.
+nvprof hooks have no TPU meaning.  `op_summary` is the per-op table
+(reference stop_profiler(sorted_key=...) prints per-op CUDA times;
+here rows come from the step's optimized HLO, ranked by memory
+traffic — the honest time proxy on an HBM-bound chip).
 """
 import contextlib
+import math
+import re
+import sys
 import time
 
 import jax
 
 __all__ = ['Profiler', 'start_profiler', 'stop_profiler', 'profiler',
-           'reset_profiler', 'cuda_profiler', 'StepTimer', 'RecordEvent']
+           'reset_profiler', 'cuda_profiler', 'StepTimer', 'RecordEvent',
+           'op_summary']
+
+_DTYPE_BYTES = {
+    'f64': 8, 'f32': 4, 'f16': 2, 'bf16': 2, 'f8e4m3fn': 1,
+    'f8e5m2': 1, 's64': 8, 's32': 4, 's16': 2, 's8': 1, 'u64': 8,
+    'u32': 4, 'u16': 2, 'u8': 1, 'pred': 1, 'c64': 8, 'c128': 16,
+}
+
+# `%name = f32[8,128]{1,0} opcode(...)` or tuple-rooted
+# `%name = (f32[2]{0}, s32[]{:T(128)}) opcode(...)` — tuple specs may
+# carry TPU tiled layouts with nested parens, hence the inner group
+_HLO_INSTR = re.compile(
+    r'^\s*(?:ROOT\s+)?[%\w.\-]+\s*=\s*'
+    r'(\((?:[^()]|\([^()]*\))*\)|\S+)\s+([\w\-]+)\(')
+_HLO_BUF = re.compile(r'(\w+)\[([\d,]*)\]')
+# computation header: `ENTRY %main (...) -> ... {` / `%body.12 (...) {`
+_HLO_COMP = re.compile(r'^(ENTRY\s+)?%?([\w.\-]+)\s*\([^)]*\)[^{]*{')
+
+
+def _work_lines(hlo_text):
+    """Instruction lines that represent scheduled work: the ENTRY
+    computation plus called control-flow bodies (while/cond regions run
+    their instructions every iteration), EXCLUDING fusion bodies —
+    a fusion's internals are register-resident; its HBM traffic is the
+    single `fusion` instruction at the call site."""
+    include = True
+    for line in hlo_text.splitlines():
+        m = _HLO_COMP.match(line)
+        if m:
+            include = 'fused' not in m.group(2)
+            continue
+        if line.startswith('}'):
+            include = True
+            continue
+        if include:
+            yield line
+
+
+def _buffer_bytes(type_spec):
+    """Total bytes of one HLO type spec (sums tuple components)."""
+    total = 0
+    for dtype, shape in _HLO_BUF.findall(type_spec):
+        n = math.prod(int(d) for d in shape.split(',') if d)
+        total += n * _DTYPE_BYTES.get(dtype, 4)
+    return total
+
+
+def op_summary(fn, *args, sorted_by='total', top=25, stream=None,
+               print_table=True):
+    """Per-op summary table for one jitted step (reference
+    fluid/profiler.py prints a per-op table via
+    stop_profiler(sorted_key); there the rows are CUDA kernel times —
+    here they come from the step's compiled, optimized HLO module).
+
+    `fn` is a jitted callable (or anything `jax.jit` accepts) and
+    `args` its example inputs; the step is lowered+compiled but NOT
+    executed.  Each row aggregates one HLO opcode post-fusion:
+    calls, output bytes (the HBM write traffic — the time proxy on a
+    bandwidth-bound chip), and its ratio of the module total.  Rows
+    cover the ENTRY computation plus while/cond bodies (counted once,
+    not by trip count); fusion internals are folded into their single
+    `fusion` call-site row.
+    Module-level flops / bytes-accessed from
+    `compiled.cost_analysis()` head the table when XLA reports them.
+
+    sorted_by: 'total'/'bytes' ranks by bytes, 'calls' by call count.
+    Returns the rows as a list of dicts (opcode, calls, bytes, ratio).
+    """
+    if sorted_by not in ('total', 'bytes', 'calls'):
+        raise ValueError(
+            f"sorted_by must be 'total', 'bytes' or 'calls', "
+            f'got {sorted_by!r}')
+    jitted = fn if hasattr(fn, 'lower') else jax.jit(fn)
+    compiled = jitted.lower(*args).compile()
+    totals = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):   # older jax returns [dict]
+            ca = ca[0] if ca else {}
+        for key in ('flops', 'bytes accessed'):
+            if ca.get(key):
+                totals[key] = float(ca[key])
+    except Exception:       # backend without cost analysis
+        pass
+
+    agg = {}
+    for line in _work_lines(compiled.as_text()):
+        m = _HLO_INSTR.match(line)
+        if not m:
+            continue
+        type_spec, opcode = m.groups()
+        if opcode in ('parameter', 'constant', 'tuple',
+                      'get-tuple-element'):
+            continue        # plumbing, not work
+        row = agg.setdefault(opcode, {'opcode': opcode, 'calls': 0,
+                                      'bytes': 0})
+        row['calls'] += 1
+        row['bytes'] += _buffer_bytes(type_spec)
+    grand = sum(r['bytes'] for r in agg.values()) or 1
+    key = 'calls' if sorted_by == 'calls' else 'bytes'
+    rows = sorted(agg.values(), key=lambda r: r[key], reverse=True)
+    for r in rows:
+        r['ratio'] = r['bytes'] / grand
+    if print_table:
+        out = stream or sys.stdout
+        print('------------------------- op summary '
+              '-------------------------', file=out)
+        for k, v in totals.items():
+            print(f'module {k}: {v:.3e}', file=out)
+        print(f'{"op":<28}{"calls":>8}{"out bytes":>14}{"ratio":>8}',
+              file=out)
+        for r in rows[:top]:
+            print(f'{r["opcode"]:<28}{r["calls"]:>8}'
+                  f'{r["bytes"]:>14,}{r["ratio"]:>8.2%}', file=out)
+        if len(rows) > top:
+            rest = sum(r['bytes'] for r in rows[top:])
+            print(f'{"... (" + str(len(rows) - top) + " more)":<28}'
+                  f'{"":>8}{rest:>14,}{rest / grand:>8.2%}', file=out)
+    return rows
 
 _active_logdir = None
 
