@@ -9,6 +9,22 @@ and `jax.device_put` happens at dequeue so H2D copy overlaps compute
 (double buffering).  TPU input pipelines are host-CPU-bound, not
 device-bound, so threads (which release the GIL inside numpy) replace
 the reference's process workers for typical decode/augment loads.
+
+Worker-mode boundary (measured, tools/bench_dataloader_workers.py):
+threads are the default — numpy-releasing-GIL augments run at sync
+speed or better with zero IPC cost.  PIL/Python-heavy transforms hold
+the GIL, so threads serialize; `use_process_workers=True` forks child
+processes for those (start method `fork` like the reference —
+closures allowed, no main-module guard; forkserver/spawn via
+`mp_context=` pay a ~2-3 s framework re-import per child and need
+picklable datasets).  Processes still cross an IPC queue per batch,
+so they win only when spare cores exist and the GIL-bound transform
+dominates.  1-core dev box, 96 samples, 4 workers (fork): numpy-heavy
+sync 344/s, threads 290/s, process 226/s; PIL-heavy sync 86/s,
+threads 77/s, process 67/s — with zero spare cores the worker modes
+can only show their overhead (threads ~10%, processes ~25%); on an
+n-core host the PIL-heavy pipeline scales with process workers while
+threads stay GIL-serialized.
 """
 import bisect
 import itertools
@@ -308,6 +324,39 @@ def get_worker_info():
     return getattr(_worker_info, 'info', None)
 
 
+def _process_worker(dataset, collate_fn, worker_init_fn, wid,
+                    num_workers, task_q, result_q):
+    """Process-worker loop (module-level so forkserver/spawn contexts
+    can pickle it).  Tasks are (seq, indices); results are (seq,
+    packed-payload bytes) — the same wire format the native ring
+    carries, so the parent can feed either consumer path."""
+    from . import native as _native
+    _worker_info.info = _WorkerInfo(wid, num_workers, dataset)
+    init_err = None
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(wid)
+    except Exception as e:     # fail every claimed batch, don't hang
+        init_err = _native.pack_error(e)
+    while True:
+        task = task_q.get()
+        if task is None:
+            # explicit done-handshake: the parent can then tell a
+            # cleanly-finished worker from one that exited mid-task
+            result_q.put(('__done__', wid))
+            return
+        seq, indices = task
+        if init_err is not None:
+            result_q.put((seq, init_err))
+            continue
+        try:
+            payload = _native.pack_batch(
+                collate_fn([dataset[i] for i in indices]))
+        except Exception as e:
+            payload = _native.pack_error(e)
+        result_q.put((seq, payload))
+
+
 # -- DataLoader --------------------------------------------------------------
 
 class _EndOfEpoch:
@@ -328,7 +377,8 @@ class DataLoader:
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=False, timeout=0, worker_init_fn=None,
                  persistent_workers=False, to_tensor=True,
-                 use_native_loader=True):
+                 use_native_loader=True, use_process_workers=False,
+                 mp_context=None):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
@@ -336,6 +386,13 @@ class DataLoader:
         self.prefetch_factor = max(2, int(prefetch_factor))
         self.worker_init_fn = worker_init_fn
         self.to_tensor = to_tensor
+        # opt-in OS-process workers for PIL/Python-heavy transforms
+        # that hold the GIL (threads serialize there; the reference
+        # forks workers for the same reason — dataloader_iter.py).
+        # Requires picklable dataset/collate_fn/worker_init_fn.
+        self.use_process_workers = bool(use_process_workers)
+        self.mp_context = mp_context
+        self.timeout = float(timeout) if timeout else 0.0
         # native ring serializes batches: arrays travel zero-pickle, but
         # exotic batch objects must be picklable — set False to keep the
         # in-process threaded path for those
@@ -513,24 +570,182 @@ class DataLoader:
         for t in threads:
             t.start()
         try:
-            for i in range(n_batches):
-                payload = ring.pop()
-                if payload is None:
-                    # a worker closed the ring mid-epoch (push failure);
-                    # a silent short epoch would corrupt training
-                    raise RuntimeError(
-                        f'native loader ring closed after {i}/'
-                        f'{n_batches} batches (worker failure)')
-                item = _native.unpack_batch(payload)
-                if isinstance(item, Exception):
-                    raise item
-                yield self._wrap(item)
+            yield from self._consume_ring(ring, n_batches)
         finally:
             ring.close()
+
+    def _consume_ring(self, ring, n_batches, pending_error=None):
+        """Shared consumer side of the in-order native ring: pop,
+        unpack, surface worker exceptions, wrap.  `pending_error` is a
+        one-slot list a producer thread fills before closing the ring
+        early (a silent short epoch would corrupt training)."""
+        from . import native as _native
+        for i in range(n_batches):
+            payload = ring.pop()
+            if payload is None:
+                if pending_error:
+                    raise pending_error[0]
+                raise RuntimeError(
+                    f'native loader ring closed after {i}/'
+                    f'{n_batches} batches (worker failure)')
+            item = _native.unpack_batch(payload)
+            if isinstance(item, Exception):
+                raise item
+            yield self._wrap(item)
+
+    def _iter_process(self):
+        """Opt-in OS-process workers (`use_process_workers=True`):
+        child processes run __getitem__ + collate in parallel — the
+        escape hatch for PIL/Python-heavy transforms where threads
+        serialize on the GIL (reference
+        io/dataloader/dataloader_iter.py forks workers for the same
+        reason; see tools/bench_dataloader_workers.py for the measured
+        thread-vs-process crossover).  Start method: `fork` where the
+        platform has it (like the reference — no main-module guard
+        needed, closures allowed, no per-child re-import; safe here
+        because children never touch the accelerator), else
+        forkserver/spawn, which require picklable dataset/collate_fn
+        and an `if __name__ == '__main__'` guard in user scripts —
+        override via `mp_context=`.  Children return packed payloads
+        (the native ring wire format) over a bounded mp queue; the
+        parent re-sequences and, when the C++ ring is built, feeds it
+        so the consumer side is the same aligned zero-copy pop as the
+        threaded native path.  Workers live per-epoch
+        (persistent_workers is accepted but not persisted)."""
+        import multiprocessing as mp
+        if self.mp_context:
+            ctx = mp.get_context(self.mp_context)
+        else:
+            methods = mp.get_all_start_methods()
+            ctx = mp.get_context(
+                'fork' if 'fork' in methods else
+                'forkserver' if 'forkserver' in methods else 'spawn')
+        from . import native as _native
+        indices_list = list(self.batch_sampler)
+        n_batches = len(indices_list)
+        window = max(2, self.num_workers * self.prefetch_factor)
+        task_q = ctx.Queue()
+        result_q = ctx.Queue(maxsize=window)
+        # windowed dispatch: preload `window` tasks, then one new task
+        # per result received — bounds the seq spread so one straggler
+        # worker cannot make the parent stash the whole epoch
+        state = {'next_task': 0, 'received': 0, 'sentinels': False}
+
+        def dispatch_next():
+            if state['next_task'] < n_batches:
+                seq = state['next_task']
+                task_q.put((seq, list(indices_list[seq])))
+                state['next_task'] = seq + 1
+            elif not state['sentinels']:
+                for _ in range(self.num_workers):
+                    task_q.put(None)
+                state['sentinels'] = True
+
+        for _ in range(min(window, n_batches)):
+            dispatch_next()
+        if state['next_task'] == n_batches:
+            dispatch_next()     # epoch fits in the window: sentinels now
+        procs = [ctx.Process(
+            target=_process_worker,
+            args=(self.dataset, self.collate_fn, self.worker_init_fn,
+                  w, self.num_workers, task_q, result_q), daemon=True)
+            for w in range(self.num_workers)]
+        try:
+            for p in procs:
+                p.start()
+        except Exception as e:
+            raise RuntimeError(
+                'process workers could not start — under '
+                f'{ctx.get_start_method()!r} the dataset/collate_fn/'
+                'worker_init_fn must be picklable and user scripts '
+                "need an `if __name__ == '__main__'` guard; use "
+                'threads (use_process_workers=False) for closures, or '
+                "mp_context='fork' where available") from e
+
+        poll_s = self.timeout or 5.0
+        stash = {}
+        done_wids = set()
+
+        def ordered_payloads():
+            """Yield payloads in seq order; a dead child must raise,
+            not hang the epoch.  A worker is 'dead' when its process
+            exited without the done-handshake — exit code 0 from a
+            dataset calling sys.exit(0) mid-task counts; a slow batch
+            on a live worker does not."""
+            import queue as _queue
+            for want in range(n_batches):
+                while want not in stash:
+                    try:
+                        seq, payload = result_q.get(timeout=poll_s)
+                    except _queue.Empty:
+                        died = [(i, p.exitcode)
+                                for i, p in enumerate(procs)
+                                if p.exitcode is not None
+                                and i not in done_wids]
+                        if died:
+                            raise RuntimeError(
+                                f'process worker {died[0][0]} died '
+                                f'(exitcode {died[0][1]}) after '
+                                f"{state['received']}/{n_batches} "
+                                'batches') from None
+                        if self.timeout:
+                            raise RuntimeError(
+                                f'DataLoader timed out after '
+                                f'{self.timeout}s waiting for batch '
+                                f'{want}') from None
+                        continue
+                    if seq == '__done__':
+                        done_wids.add(payload)
+                        continue
+                    stash[seq] = payload
+                    state['received'] += 1
+                    dispatch_next()
+                yield want, stash.pop(want)
+
+        use_ring = self.use_native_loader and _native.available()
+        try:
+            if use_ring:
+                ring = _native.NativeRing(window)
+                drain_err = []
+
+                def drain():
+                    try:
+                        for seq, payload in ordered_payloads():
+                            if not ring.push(seq, payload):
+                                return     # consumer closed the ring
+                    except BaseException as e:
+                        drain_err.append(e)
+                        ring.close()
+
+                t = threading.Thread(target=drain, daemon=True)
+                t.start()
+                try:
+                    yield from self._consume_ring(ring, n_batches,
+                                                  drain_err)
+                finally:
+                    ring.close()
+            else:
+                for _, payload in ordered_payloads():
+                    # bytearray copy: frombuffer over the queue's bytes
+                    # would yield READ-ONLY arrays, unlike every other
+                    # loader path
+                    item = _native.unpack_batch(
+                        np.frombuffer(bytearray(payload), np.uint8))
+                    if isinstance(item, Exception):
+                        raise item
+                    yield self._wrap(item)
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=2)
 
     def __iter__(self):
         if self.num_workers > 0 and not self._iterable \
                 and self.batch_sampler is not None:
+            if self.use_process_workers:
+                return self._iter_process()
             from . import native as _native
             if self.use_native_loader and _native.available():
                 return self._iter_native()

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Thread-vs-process DataLoader crossover (VERDICT r4 task 6).
+
+Two synthetic pipelines over the same 96-sample dataset:
+  numpy-heavy — big vectorized augment (releases the GIL inside numpy);
+  PIL-heavy   — PIL decode/resize/rotate per sample (holds the GIL for
+                most of its runtime).
+Each runs sync (num_workers=0), threaded, and process
+(use_process_workers=True) and prints one JSON line per cell.
+
+Expectation (multi-core host): threads win numpy-heavy (no pickle/IPC
+cost), processes win PIL-heavy (threads serialize on the GIL).  On a
+single-core host neither can beat sync — the run still validates
+overheads and correctness.  Results land in the io module docstring.
+"""
+import io as _io
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class NumpyHeavy:
+    """Vectorized augment: GIL-releasing numpy on a 256x256x3 image."""
+
+    def __init__(self, n=96, seed=0):
+        self.n = n
+        rs = np.random.RandomState(seed)
+        self.base = rs.randint(0, 255, size=(256, 256, 3)).astype('uint8')
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        x = self.base.astype('float32')
+        for _ in range(6):                 # ~50 MFLOP of elementwise
+            x = np.sqrt(x * 1.01 + i % 7) * 0.99 + 0.5
+        return x.mean(axis=2), np.array([i % 2], dtype='int64')
+
+
+class PILHeavy:
+    """Per-sample JPEG decode + resize + rotate: Python/PIL-bound."""
+
+    def __init__(self, n=96, seed=0):
+        from PIL import Image
+        self.n = n
+        rs = np.random.RandomState(seed)
+        img = Image.fromarray(
+            rs.randint(0, 255, size=(512, 512, 3)).astype('uint8'))
+        buf = _io.BytesIO()
+        img.save(buf, format='JPEG', quality=90)
+        self.jpeg = buf.getvalue()
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        from PIL import Image
+        img = Image.open(_io.BytesIO(self.jpeg))
+        img = img.rotate(i % 360, resample=Image.BILINEAR)
+        img = img.resize((224, 224), resample=Image.BICUBIC)
+        return (np.asarray(img, dtype='float32') / 255.0,
+                np.array([i % 2], dtype='int64'))
+
+
+def run(ds, mode, num_workers=4, batch_size=8):
+    from paddle_tpu.io import DataLoader
+    kw = dict(batch_size=batch_size, to_tensor=False)
+    if mode == 'sync':
+        loader = DataLoader(ds, num_workers=0, **kw)
+    elif mode == 'threads':
+        loader = DataLoader(ds, num_workers=num_workers, **kw)
+    elif mode == 'process':
+        loader = DataLoader(ds, num_workers=num_workers,
+                            use_process_workers=True, **kw)
+    else:
+        raise ValueError(mode)
+    n = 0
+    t0 = time.time()
+    for xb, _ in loader:
+        n += xb.shape[0]
+    dt = time.time() - t0
+    return n / dt, dt
+
+
+def main():
+    workers = int(os.environ.get('BENCH_DL_WORKERS', '4'))
+    for name, ds in [('numpy_heavy', NumpyHeavy()),
+                     ('pil_heavy', PILHeavy())]:
+        for mode in ('sync', 'threads', 'process'):
+            # warm one epoch (forkserver start, native ring build)
+            run(ds, mode, num_workers=workers)
+            sps, dt = run(ds, mode, num_workers=workers)
+            print(json.dumps({'pipeline': name, 'mode': mode,
+                              'workers': 0 if mode == 'sync' else workers,
+                              'nproc': os.cpu_count(),
+                              'samples_per_sec': round(sps, 1),
+                              'epoch_s': round(dt, 3)}), flush=True)
+
+
+if __name__ == '__main__':
+    main()
