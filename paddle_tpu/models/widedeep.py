@@ -40,6 +40,25 @@ class _FusedSparseEmbedding(nn.Layer):
         return self.table(ids + off)
 
 
+class _PerFieldSparseEmbedding(nn.Layer):
+    """Reference-style per-field tables — F separate gathers + stack
+    (the shape of the reference's per-slot lookup_table calls,
+    fleet/runtime/the_one_ps.py:417).  Kept as the baseline arm of the
+    fused-vs-per-field gather A/B (tools/bench_widedeep_gather.py,
+    PERF round-3 lead 3); the fused single-table gather is the
+    default."""
+
+    def __init__(self, field_dims, embed_dim):
+        super().__init__()
+        self.tables = nn.LayerList(
+            [nn.Embedding(int(d), embed_dim) for d in field_dims])
+
+    def forward(self, ids):
+        """ids [B, F] (field-local) → embeddings [B, F, E]."""
+        cols = [t(ids[:, i]) for i, t in enumerate(self.tables)]
+        return manipulation.stack(cols, axis=1)
+
+
 class WideDeep(nn.Layer):
     """wide (1st-order sparse + dense linear) + deep (embeddings→MLP).
 
@@ -49,18 +68,30 @@ class WideDeep(nn.Layer):
         embed_dim: deep embedding width.
         hidden: deep MLP widths.
         shard_vocab: shard the fused tables over the tp mesh axis.
+        fused_gather: one offset-addressed table per role (default) vs
+            reference-style per-field tables (A/B baseline; not
+            shardable over tp).
     """
 
     def __init__(self, sparse_field_dims, dense_dim=0, embed_dim=16,
-                 hidden=(64, 32), shard_vocab=False):
+                 hidden=(64, 32), shard_vocab=False, fused_gather=True):
         super().__init__()
         self.dense_dim = dense_dim
         f = len(sparse_field_dims)
-        self.wide = _FusedSparseEmbedding(sparse_field_dims, 1,
-                                          shard=shard_vocab)
-        self.deep_emb = _FusedSparseEmbedding(sparse_field_dims,
-                                              embed_dim,
+        if not fused_gather and shard_vocab:
+            raise ValueError('per-field tables (fused_gather=False) '
+                             'do not shard over tp; use the fused '
+                             'table for shard_vocab=True')
+        if fused_gather:
+            self.wide = _FusedSparseEmbedding(sparse_field_dims, 1,
                                               shard=shard_vocab)
+            self.deep_emb = _FusedSparseEmbedding(sparse_field_dims,
+                                                  embed_dim,
+                                                  shard=shard_vocab)
+        else:
+            self.wide = _PerFieldSparseEmbedding(sparse_field_dims, 1)
+            self.deep_emb = _PerFieldSparseEmbedding(sparse_field_dims,
+                                                     embed_dim)
         layers = []
         in_dim = f * embed_dim + dense_dim
         for h in hidden:

@@ -119,6 +119,41 @@ class TestSparseModels:
                                 paddle.to_tensor(dense)).value)
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
 
+    def test_per_field_gather_matches_fused(self):
+        """The A/B baseline arm (fused_gather=False, reference-style
+        per-field tables) must compute the same function when its
+        tables hold the same rows as the fused table's slices."""
+        dims, ids, dense, y = self._ctr(32)
+        paddle.seed(0)
+        m_f = WideDeep(dims, dense_dim=4, embed_dim=8)
+        m_p = WideDeep(dims, dense_dim=4, embed_dim=8,
+                       fused_gather=False)
+        # same non-embedding weights; per-field tables take row slices
+        # of the fused tables
+        sd = m_f.state_dict()
+        psd = m_p.state_dict()
+        for role in ('wide', 'deep_emb'):
+            fused_w = np.asarray(sd[f'{role}.table.weight'].value)
+            off = 0
+            for i, d in enumerate(dims):
+                psd[f'{role}.tables.{i}.weight'] = paddle.to_tensor(
+                    fused_w[off:off + d])
+                off += d
+        for k in list(psd):
+            if '.tables.' not in k:
+                psd[k] = sd[k]
+        m_p.set_state_dict(psd)
+        m_f.eval()
+        m_p.eval()
+        with paddle.no_grad():
+            a = np.asarray(m_f(paddle.to_tensor(ids),
+                               paddle.to_tensor(dense)).value)
+            b = np.asarray(m_p(paddle.to_tensor(ids),
+                               paddle.to_tensor(dense)).value)
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+        with pytest.raises(ValueError):
+            WideDeep(dims, shard_vocab=True, fused_gather=False)
+
     def test_engine_multi_input_eval(self):
         dims, ids, dense, y = self._ctr(32)
         paddle.seed(0)
