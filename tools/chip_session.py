@@ -57,6 +57,8 @@ STEPS = [
      30 * 60),
     ('widedeep_gather',
      [sys.executable, 'tools/bench_widedeep_gather.py'], 45 * 60),
+    ('embedding_grad',
+     [sys.executable, 'tools/bench_embedding_grad.py'], 30 * 60),
     # chunk-size sweep LAST (fused arm only — the unfused baseline is
     # already in fused_head_ab.log and does not depend on --chunks);
     # touch tools/chip_out/fused_head_c{4,16}.ok beforehand to skip
