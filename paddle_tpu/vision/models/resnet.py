@@ -11,11 +11,57 @@ implementation is TPU-first:
   full model (+loss+grad) into one XLA module; XLA fuses BN+ReLU into the
   conv epilogues.
 """
+import numpy as np
+
 from ... import nn
-from ...tensor.manipulation import flatten
+from ...tensor.manipulation import flatten, reshape, transpose
 
 __all__ = ['ResNet', 'resnet18', 'resnet34', 'resnet50', 'resnet101',
-           'resnet152']
+           'resnet152', 'space_to_depth_stem_weight']
+
+
+def _space_to_depth2(x):
+    """NHWC block-2 space-to-depth: [B,H,W,C] → [B,H/2,W/2,4C] with
+    channel order (u, v, c) — the MLPerf-TPU ResNet input transform.
+    The 7x7/s2 stem conv reads each input pixel from HBM under a
+    49-tap window at stride 2; on the s2d layout the same math is a
+    4x4/s1 conv over 4x fewer, 4x-wider pixels, which the TPU conv
+    unit tiles far better (no halo re-reads across the stride)."""
+    B, H, W, C = x.shape
+    if H % 2 or W % 2:
+        raise ValueError(
+            f'stem_space_to_depth needs even spatial dims, got {H}x{W}'
+            ' — pad or resize the input (the standard stem has no such'
+            ' constraint)')
+    x = reshape(x, [B, H // 2, 2, W // 2, 2, C])
+    x = transpose(x, [0, 1, 3, 2, 4, 5])
+    return reshape(x, [B, H // 2, W // 2, 4 * C])
+
+
+def space_to_depth_stem_weight(w7):
+    """EXACT re-lay of a standard [O,3,7,7] OIHW stem-conv weight into
+    the [O,12,4,4] weight of the s2d stem (stride 1, padding
+    ((2,1),(2,1))): output tap di of the 7x7/s2/pad-3 conv maps to
+    (k, u) of the 4x4 conv via di = 2k + u - 1 (the (k=0,u=0) slot
+    falls outside the 7-tap window and stays zero).  Used by the
+    parity test and for loading pretrained 7x7 stems into s2d
+    models."""
+    w7 = np.asarray(w7)
+    O, C = w7.shape[0], w7.shape[1]
+    w2 = np.zeros((O, 4 * C, 4, 4), w7.dtype)
+    for k in range(4):
+        for u in range(2):
+            di = 2 * k + u - 1
+            if not 0 <= di < 7:
+                continue
+            for l in range(4):
+                for v in range(2):
+                    dj = 2 * l + v - 1
+                    if not 0 <= dj < 7:
+                        continue
+                    for c in range(C):
+                        w2[:, (u * 2 + v) * C + c, k, l] = w7[:, c, di, dj]
+    return w2
 
 
 def _conv_bn(in_ch, out_ch, kernel, stride, padding, data_format,
@@ -94,16 +140,29 @@ class ResNet(nn.Layer):
                   101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
 
     def __init__(self, block, depth, num_classes=1000, with_pool=True,
-                 data_format='NCHW'):
+                 data_format='NCHW', stem_space_to_depth=False):
         super().__init__()
         layers = self._layer_cfg[depth]
         self.num_classes = num_classes
         self.with_pool = with_pool
         self.data_format = data_format
+        self.stem_space_to_depth = stem_space_to_depth
         self.inplanes = 64
 
-        self.conv1 = nn.Conv2D(3, 64, 7, stride=2, padding=3,
-                               bias_attr=False, data_format=data_format)
+        if stem_space_to_depth:
+            # MLPerf-TPU stem: s2d(2) input + 4x4/s1 conv — the same
+            # function as 7x7/s2/pad-3 (see space_to_depth_stem_weight)
+            if data_format != 'NHWC':
+                raise ValueError('stem_space_to_depth is the TPU-layout '
+                                 'stem; use data_format="NHWC"')
+            self.conv1 = nn.Conv2D(12, 64, 4, stride=1,
+                                   padding=[(2, 1), (2, 1)],
+                                   bias_attr=False,
+                                   data_format=data_format)
+        else:
+            self.conv1 = nn.Conv2D(3, 64, 7, stride=2, padding=3,
+                                   bias_attr=False,
+                                   data_format=data_format)
         self.bn1 = nn.BatchNorm2D(64, data_format=data_format)
         self.relu = nn.ReLU()
         self.maxpool = nn.MaxPool2D(3, stride=2, padding=1,
@@ -133,6 +192,8 @@ class ResNet(nn.Layer):
         return nn.Sequential(*blocks)
 
     def forward(self, x):
+        if self.stem_space_to_depth:
+            x = _space_to_depth2(x)
         x = self.relu(self.bn1(self.conv1(x)))
         x = self.maxpool(x)
         x = self.layer1(x)

@@ -48,6 +48,37 @@ def test_resnet_nhwc_matches_nchw():
     np.testing.assert_allclose(ya, yb, rtol=2e-4, atol=2e-4)
 
 
+def test_resnet_s2d_stem_matches_standard():
+    """The MLPerf-TPU space-to-depth stem is the SAME function as the
+    7x7/s2 stem under the exact weight re-lay
+    (space_to_depth_stem_weight) — proven here on CPU; the chip A/B
+    (tools/bench_resnet_s2d.py) measures whether it is faster."""
+    from paddle_tpu.vision.models.resnet import (
+        space_to_depth_stem_weight)
+    paddle.seed(0)
+    a = models.resnet18(num_classes=3, data_format='NHWC')
+    paddle.seed(0)
+    b = models.resnet18(num_classes=3, data_format='NHWC',
+                        stem_space_to_depth=True)
+    sd = a.state_dict()
+    bsd = b.state_dict()
+    for k in bsd:
+        if k == 'conv1.weight':
+            bsd[k] = t(space_to_depth_stem_weight(
+                np.asarray(sd[k].value)))
+        else:
+            bsd[k] = sd[k]
+    b.set_state_dict(bsd)
+    a.eval()
+    b.eval()
+    x = np.random.RandomState(0).randn(2, 32, 32, 3).astype('float32')
+    ya = np.asarray(a(t(x)).value)
+    yb = np.asarray(b(t(x)).value)
+    np.testing.assert_allclose(ya, yb, rtol=2e-4, atol=2e-4)
+    with pytest.raises(ValueError):
+        models.resnet18(stem_space_to_depth=True)   # NCHW forbidden
+
+
 def test_mobilenet_v2_forward():
     net = models.mobilenet_v2(scale=0.35, num_classes=3)
     x = t(np.random.randn(1, 3, 32, 32).astype('float32'))

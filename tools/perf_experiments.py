@@ -6,6 +6,10 @@ The step is HBM-bound; each variant tests one bytes-reduction lever:
   remat     — strategy.recompute: trade recompute FLOPs for residuals
   bf16in    — feed the images as bf16 (halves the input slab)
   b512      — batch 512 (amortize fixed traffic; may OOM)
+  s2d       — MLPerf-TPU space-to-depth stem (4x4/s1 conv on the
+              block-2 s2d input; exact-function re-lay of the 7x7/s2
+              stem — parity locked in test_resnet_s2d_stem_matches_
+              standard; this measures whether it is FASTER)
 Run on the real chip: python tools/perf_experiments.py
 """
 import os
@@ -21,7 +25,7 @@ setup_jax_cache()
 
 
 def run(tag, batch=256, image=224, recompute=False, bf16_in=False,
-        iters=30, warmup=5):
+        s2d=False, iters=30, warmup=5):
     import jax
     import paddle_tpu as paddle
     from paddle_tpu import nn
@@ -33,7 +37,7 @@ def run(tag, batch=256, image=224, recompute=False, bf16_in=False,
     dist_env.set_mesh(None)
     paddle.seed(0)
     net = ResNet(BottleneckBlock, 50, num_classes=1000,
-                 data_format='NHWC')
+                 data_format='NHWC', stem_space_to_depth=s2d)
     opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                                     parameters=net.parameters())
     ce = nn.CrossEntropyLoss()
@@ -75,6 +79,8 @@ def main():
     results['bf16in'] = run('bf16in', bf16_in=True)
     results['b512'] = run('b512', batch=512)
     results['b512rm'] = run('b512rm', batch=512, recompute=True)
+    results['s2d'] = run('s2d', s2d=True)
+    results['s2d_bf16'] = run('s2d_bf16', s2d=True, bf16_in=True)
     best = max((v, k) for k, v in results.items() if v)
     print(f'best: {best[1]} at {best[0]:.0f} imgs/s')
 
