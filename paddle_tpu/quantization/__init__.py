@@ -25,7 +25,8 @@ from ..tensor._helpers import wrap
 __all__ = ['fake_quant', 'FakeQuantAbsMax',
            'FakeQuantMovingAverageAbsMax', 'QuantedLayer',
            'ImperativeQuantAware', 'PostTrainingQuantization',
-           'quant_post_dynamic', 'load_quantized_model']
+           'quant_post_dynamic', 'load_quantized_model',
+           'Int8DynamicLinear', 'quantize_dynamic_int8']
 
 
 def _make_fake_quant():
@@ -338,6 +339,102 @@ def quant_post_dynamic(model):
     """Weight-only dynamic quantization: int8 weights + scales, no
     calibration (reference's WeightQuantization.quantize_weight_to_int)."""
     return PostTrainingQuantization(model, data_loader=None).quantize()
+
+
+class Int8DynamicLinear(Layer):
+    """Serving-time nn.Linear replacement that EXECUTES on the MXU's
+    native int8 path (ops/int8_matmul.py) — unlike the .quant
+    artifact path, which dequantizes back to float at load.  Weights
+    stay int8 in HBM (half the bytes of bf16 — the KV-cache decode
+    step is weight-bandwidth-bound), activations quantize dynamically
+    per call, the dot accumulates in int32.  Inference-only: gradients
+    do not flow into the int8 weights."""
+
+    def __init__(self, linear):
+        super().__init__()
+        from ..core.tensor import Tensor
+        from ..ops.int8_matmul import quantize_weight_int8
+        w_shape = linear.weight.shape          # [in, out] all variants
+        self.in_features = int(w_shape[0])
+        self.out_features = int(w_shape[1])
+        # quantize on-device: a host round-trip per Linear would cost
+        # seconds for a 100M-param model over the tunnel
+        q, scale = quantize_weight_int8(linear.weight.value)
+        self.register_buffer('qweight',
+                             Tensor(q, stop_gradient=True))
+        self.register_buffer('wscale',
+                             Tensor(scale, stop_gradient=True))
+        self.bias = linear.bias
+
+    def forward(self, x):
+        from ..ops.int8_matmul import dynamic_int8_matmul
+
+        def fn(xv, qv, sv, *maybe_b):
+            out_dtype = xv.dtype if jnp.issubdtype(
+                xv.dtype, jnp.floating) else jnp.bfloat16
+            return dynamic_int8_matmul(
+                xv, qv, sv, maybe_b[0] if maybe_b else None,
+                out_dtype=out_dtype)
+
+        args = [wrap(x), wrap(self.qweight), wrap(self.wscale)]
+        if self.bias is not None:
+            args.append(wrap(self.bias))
+        return apply(fn, *args, op_name='int8_linear')
+
+    def extra_repr(self):
+        return f'in={self.in_features}, out={self.out_features}, int8'
+
+
+def quantize_dynamic_int8(model, layer_filter=None):
+    """Swap every plain nn.Linear sublayer of `model` for an
+    Int8DynamicLinear, in place (the executing analog of
+    quant_post_dynamic; reference serving runs int8 through
+    PaddleSlim + TensorRT kernels, here it is one int8 dot_general on
+    the MXU).  Only exact nn.Linear instances are swapped — subclasses
+    (tp-sharded parallel linears, already-wrapped QuantedLayers) keep
+    their own math.  `layer_filter(full_name, layer) -> bool` opts
+    layers out (e.g. keep a numerically-sensitive head in bf16).
+    Returns `model`.  Typical decode use:
+
+        model.eval()
+        quantize_dynamic_int8(model)
+        model.generate(ids, max_new_tokens=128)   # one XLA module
+    """
+    from ..nn import Linear
+    from ..distributed import env as dist_env
+    from ..distributed.fleet.meta_parallel import (ColumnParallelLinear,
+                                                   RowParallelLinear)
+
+    # tp-sharded parallel linears are functionally plain Linears when
+    # no tp mesh axis is live (single-chip serving — the decode A/B
+    # target); with a real tp axis their weights are sharded and the
+    # per-shard quantization story is different, so they are skipped
+    mesh = dist_env.get_mesh()
+    tp_live = mesh is not None and 'tp' in mesh.axis_names \
+        and mesh.shape['tp'] > 1
+    swappable = (Linear,) if tp_live else \
+        (Linear, ColumnParallelLinear, RowParallelLinear)
+
+    def walk(layer, prefix=''):
+        n = 0
+        for name, sub in list(layer._sub_layers.items()):
+            full = f'{prefix}.{name}' if prefix else name
+            if type(sub) in swappable and (layer_filter is None
+                                           or layer_filter(full, sub)):
+                layer._sub_layers[name] = Int8DynamicLinear(sub)
+                n += 1
+            elif isinstance(sub, QuantedLayer):
+                # QuantedLayer.forward re-reads inner.weight for fake
+                # quant — swapping its inner Linear would break it;
+                # QAT models export through the .quant artifact path
+                continue
+            else:
+                n += walk(sub, full)
+        return n
+
+    if walk(model) == 0:
+        raise ValueError('no quantizable Linear sublayers found')
+    return model
 
 
 def load_quantized_model(model, path):

@@ -277,3 +277,81 @@ class TestDynamicInt8Matmul:
         rel = np.abs(np.asarray(out, np.float32) - want).max() \
             / np.abs(want).max()
         assert rel < 0.05, rel
+
+
+class TestQuantizeDynamicInt8:
+    """Executing int8 path: Int8DynamicLinear + model-wide swap
+    (the serving analog of quant_post_dynamic — weights stay int8
+    in HBM and the dot runs on the MXU int8 path)."""
+
+    def test_linear_swap_close_to_float(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.quantization import (Int8DynamicLinear,
+                                             quantize_dynamic_int8)
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(32, 64), nn.ReLU(),
+                            nn.Linear(64, 8))
+        net.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 32).astype('float32'))
+        with paddle.no_grad():
+            want = np.asarray(net(x).value)
+        quantize_dynamic_int8(net)
+        layers = list(net.sublayers())
+        assert sum(isinstance(l, Int8DynamicLinear) for l in layers) == 2
+        q = layers[0] if isinstance(layers[0], Int8DynamicLinear) \
+            else next(l for l in layers
+                      if isinstance(l, Int8DynamicLinear))
+        assert np.asarray(q.qweight.value).dtype == np.int8
+        with paddle.no_grad():
+            got = np.asarray(net(x).value)
+        rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+        assert rel < 0.05, rel
+
+    def test_layer_filter_and_no_linear_raises(self):
+        import pytest as _p
+        from paddle_tpu.quantization import (Int8DynamicLinear,
+                                             quantize_dynamic_int8)
+        net = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 4))
+        quantize_dynamic_int8(
+            net, layer_filter=lambda name, l: l.out_features != 4)
+        kinds = [type(l).__name__ for l in net.sublayers()]
+        assert kinds.count('Int8DynamicLinear') == 1
+        with _p.raises(ValueError):
+            quantize_dynamic_int8(nn.Sequential(nn.ReLU()))
+
+    def test_gpt_generate_int8_decode(self):
+        """The KV-cache decode module compiles and runs with int8
+        MLP/attention projections (the serving integration the chip
+        A/B decides on)."""
+        import numpy as np
+        from paddle_tpu.models.gpt import gpt_tiny
+        from paddle_tpu.quantization import quantize_dynamic_int8
+        paddle.seed(0)
+        m = gpt_tiny()
+        m.eval()
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, m.config.vocab_size,
+                         size=(2, 6)).astype('int64')
+        quantize_dynamic_int8(m)
+        out = m.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                         temperature=0)
+        assert tuple(out.shape) == (2, 11)
+        assert np.asarray(out.value).max() < m.config.vocab_size
+
+    def test_qat_wrapped_models_are_skipped(self):
+        """quantize_dynamic_int8 must not reach inside QuantedLayers
+        (their forward re-reads inner.weight); QAT models export via
+        the .quant artifact path instead."""
+        import numpy as np
+        import pytest as _p
+        from paddle_tpu.quantization import (ImperativeQuantAware,
+                                             quantize_dynamic_int8)
+        net = nn.Sequential(nn.Linear(8, 8))
+        ImperativeQuantAware().quantize(net)
+        with _p.raises(ValueError, match='no quantizable'):
+            quantize_dynamic_int8(net)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 8).astype('float32'))
+        net(x)      # QAT forward still works untouched

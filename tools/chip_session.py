@@ -69,6 +69,11 @@ STEPS = [
     ('fused_head_c16',
      [sys.executable, 'tools/bench_fused_head.py', '--iters', '10',
       '--chunks', '16', '--arm', 'fused'], 30 * 60),
+    # VERY LAST: compiles two gptgen-sized decode modules (the known
+    # wedge class) — a timeout here must not cost any other step, and
+    # the window is generous enough that a kill should never fire
+    ('int8_decode',
+     [sys.executable, 'tools/bench_int8_decode.py'], 3 * 3600),
 ]
 
 
