@@ -41,7 +41,7 @@ class GPTConfig:
                  moe_num_experts=0, moe_every=2, moe_top_k=1,
                  moe_capacity_factor=1.25, moe_aux_weight=0.01,
                  fused_head=False, fused_head_chunks=8,
-                 striped_sp=False):
+                 striped_sp=False, scan_decode_blocks=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -75,6 +75,20 @@ class GPTConfig:
         # is permutation-invariant, so loss parity is exact).  Requires
         # sequence_parallel + fused_head; eval/decode stay natural.
         self.striped_sp = striped_sp
+        # decode compile-time lever: scan ONE block body over stacked
+        # per-layer params inside generate() instead of inlining
+        # num_layers copies into the token scan — ~L-times less HLO
+        # in the decode module (the 900 s remote compile that twice
+        # wedged the round-4 tunnel was the unrolled form).  CPU
+        # measurement (stacks hoisted out of the token body): compile
+        # -33%, runtime +70% — CPU materializes each layer's param
+        # slice as a copy per token, which TPU's while-loop HBM reads
+        # do not; OPT-IN until the chip A/B
+        # (tools/bench_scan_decode.py) shows the compile shrink is
+        # worth the TPU runtime delta.  Token-exact parity with the
+        # unrolled path is locked in tests/test_kv_cache.py.  Ignored
+        # for heterogeneous stacks (MoE every-k blocks).
+        self.scan_decode_blocks = scan_decode_blocks
 
 
 def _act_spec(cfg):
@@ -519,32 +533,115 @@ class GPTForCausalLM(nn.Layer):
             return jax.random.categorical(key, lg, axis=-1) \
                 .astype(jnp.int64)
 
-        def gen_fn(params, buffers, ids, key):
-            caches = [(jnp.zeros((B, nh, Tmax, hd), jnp.float32),
-                       jnp.zeros((B, nh, Tmax, hd), jnp.float32))
-                      for _ in range(L)]
+        # scan-over-layers decode: ONE block body over stacked
+        # per-layer params — ~L-times less HLO in the decode module
+        # than inlining every block into the token scan (the unrolled
+        # form's ~900 s remote compile is what wedged the round-4
+        # tunnel).  Needs a homogeneous stack (no MoE blocks).
+        use_scan = (cfg.scan_decode_blocks and L > 1
+                    and cfg.moe_num_experts == 0)
+        blocks_prefix = 'gpt.blocks.'
+        block0 = self.gpt.blocks[0]
+
+        def _sub(tree, prefix):
+            return {k[len(prefix):]: v for k, v in tree.items()
+                    if k.startswith(prefix)}
+
+        def _stacked(tree):
+            """{'0.attn.qkv.weight': v, ...} → {'attn.qkv.weight':
+            [L, ...]} — per-layer leaves stacked for lax.scan."""
+            per = {}
+            for k, v in _sub(tree, blocks_prefix).items():
+                i, sub = k.split('.', 1)
+                per.setdefault(sub, [None] * L)[int(i)] = v
+            return {k: jnp.stack(vs) for k, vs in per.items()}
+
+        def _scan_blocks(x, stacked_p, stacked_b, k_all, v_all, p):
+            """Run the homogeneous block stack as one lax.scan; caches
+            ride as [L, B, nh, Tmax, hd] xs/ys."""
+            def layer_body(xc, per_layer):
+                lp, lb, kc, vc = per_layer
+                (xc, (nk, nv)), _ = functional_call(
+                    block0, lp, lb, (xc,),
+                    kwargs={'cache': (kc, vc), 'pos': p},
+                    training=False)
+                return xc, (nk, nv)
+            x, (nk_all, nv_all) = jax.lax.scan(
+                layer_body, x, (stacked_p, stacked_b, k_all, v_all))
+            return x, nk_all, nv_all
+
+        def _scan_step(state, ids_t, p, cache):
+            """Embeddings → scanned blocks → ln_f → tied head, built
+            from the same sublayers the unrolled path runs (dropout is
+            identity in eval).  `state` carries the per-layer stacks
+            computed ONCE per generate call — stacking in here would
+            re-emit L-way stacks into every token-scan body."""
+            params, buffers, stacked_p, stacked_b = state
+            k_all, v_all = cache
+            T = ids_t.shape[1]
+            posv = p.reshape(()).astype(jnp.int64) \
+                + jnp.arange(T, dtype=jnp.int64)
+            emb, _ = functional_call(
+                model.gpt.wte, _sub(params, 'gpt.wte.'),
+                _sub(buffers, 'gpt.wte.'), (ids_t,), training=False)
+            pe, _ = functional_call(
+                model.gpt.wpe, _sub(params, 'gpt.wpe.'),
+                _sub(buffers, 'gpt.wpe.'), (posv,), training=False)
+            x, nk_all, nv_all = _scan_blocks(
+                emb + pe, stacked_p, stacked_b, k_all, v_all, p)
+            h, _ = functional_call(
+                model.gpt.ln_f, _sub(params, 'gpt.ln_f.'),
+                _sub(buffers, 'gpt.ln_f.'), (x,), training=False)
+            logits = jnp.einsum('bth,vh->btv', h,
+                                params['gpt.wte.weight'])
+            return logits, (nk_all, nv_all)
+
+        def _unrolled_step(state, ids_t, p, caches):
+            params, buffers = state
             (logits, caches), _ = functional_call(
-                model, params, buffers, (ids,),
-                kwargs={'caches': caches, 'pos': jnp.zeros((), jnp.int32)},
-                training=False)
-            key, sk = jax.random.split(key)
-            tok = sample(logits[:, -1], sk)            # [B]
+                model, params, buffers, (ids_t,),
+                kwargs={'caches': caches, 'pos': p}, training=False)
+            return logits, caches
 
-            def body(carry, _):
-                tok, p, caches, key = carry
-                (logits, caches), _ = functional_call(
-                    model, params, buffers, (tok[:, None],),
-                    kwargs={'caches': caches, 'pos': p}, training=False)
+        def _make_gen(prepare, step, init_cache):
+            """One decode loop for both block forms: prefill + sample,
+            then a token lax.scan over `step`."""
+            def gen(params, buffers, ids, key):
+                state = prepare(params, buffers)
+                logits, cache = step(state, ids,
+                                     jnp.zeros((), jnp.int32),
+                                     init_cache())
                 key, sk = jax.random.split(key)
-                ntok = sample(logits[:, -1], sk)
-                return (ntok, p + 1, caches, key), tok
+                tok = sample(logits[:, -1], sk)        # [B]
 
-            (last, _, _, _), toks = jax.lax.scan(
-                body, (tok, jnp.asarray(T0, jnp.int32), caches, key),
-                None, length=int(max_new_tokens) - 1)
-            new = jnp.concatenate(
-                [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
-            return jnp.concatenate([ids, new], axis=1)
+                def body(carry, _):
+                    tok, p, cache, key = carry
+                    logits, cache = step(state, tok[:, None], p, cache)
+                    key, sk = jax.random.split(key)
+                    ntok = sample(logits[:, -1], sk)
+                    return (ntok, p + 1, cache, key), tok
+
+                (last, _, _, _), toks = jax.lax.scan(
+                    body, (tok, jnp.asarray(T0, jnp.int32), cache, key),
+                    None, length=int(max_new_tokens) - 1)
+                new = jnp.concatenate(
+                    [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+                return jnp.concatenate([ids, new], axis=1)
+            return gen
+
+        if use_scan:
+            gen_fn = _make_gen(
+                lambda p, b: (p, b, _stacked(p), _stacked(b)),
+                _scan_step,
+                lambda: (jnp.zeros((L, B, nh, Tmax, hd), jnp.float32),
+                         jnp.zeros((L, B, nh, Tmax, hd), jnp.float32)))
+        else:
+            gen_fn = _make_gen(
+                lambda p, b: (p, b),
+                _unrolled_step,
+                lambda: [(jnp.zeros((B, nh, Tmax, hd), jnp.float32),
+                          jnp.zeros((B, nh, Tmax, hd), jnp.float32))
+                         for _ in range(L)])
 
         # jit executables cache per function OBJECT: key the compiled
         # fn on the decode signature so repeat generate() calls with

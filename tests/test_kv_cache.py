@@ -164,6 +164,42 @@ class TestGPTGenerate:
                 [cur, lg[:, -1].argmax(-1)[:, None]], axis=1)
         np.testing.assert_array_equal(out, cur)
 
+    def test_scan_decode_blocks_token_exact(self):
+        """scan_decode_blocks=True (one block body scanned over
+        stacked per-layer params — the decode compile-time lever)
+        must be token-exact vs the unrolled decode, greedy AND
+        sampled."""
+        from paddle_tpu.models.gpt import gpt_tiny
+        paddle.seed(3)
+        m_u = gpt_tiny()
+        paddle.seed(3)
+        m_s = gpt_tiny(scan_decode_blocks=True)
+        m_s.set_state_dict(m_u.state_dict())
+        m_u.eval()
+        m_s.eval()
+        ids = np.random.RandomState(7).randint(
+            0, m_u.config.vocab_size, (2, 5)).astype('int64')
+        for kw in ({'temperature': 0},
+                   {'temperature': 0.8, 'top_k': 8, 'seed': 4}):
+            a = np.asarray(m_u.generate(paddle.to_tensor(ids),
+                                        max_new_tokens=6, **kw).value)
+            b = np.asarray(m_s.generate(paddle.to_tensor(ids),
+                                        max_new_tokens=6, **kw).value)
+            np.testing.assert_array_equal(a, b)
+
+    def test_scan_decode_ignored_for_moe(self):
+        """Heterogeneous stacks (MoE blocks) silently keep the
+        unrolled decode — generate must still work."""
+        from paddle_tpu.models.gpt import gpt_moe_tiny
+        paddle.seed(0)
+        m = gpt_moe_tiny(scan_decode_blocks=True)
+        m.eval()
+        ids = np.zeros((1, 3), 'int64')
+        out = np.asarray(m.generate(paddle.to_tensor(ids),
+                                    max_new_tokens=4,
+                                    temperature=0).value)
+        assert out.shape == (1, 7)
+
     def test_sampled_shape_and_range(self):
         from paddle_tpu.models.gpt import gpt_tiny
         m = gpt_tiny(num_layers=2, hidden_size=32, num_heads=2,

@@ -74,6 +74,8 @@ STEPS = [
     # the window is generous enough that a kill should never fire
     ('int8_decode',
      [sys.executable, 'tools/bench_int8_decode.py'], 3 * 3600),
+    ('scan_decode',
+     [sys.executable, 'tools/bench_scan_decode.py'], 3 * 3600),
 ]
 
 
