@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""GPT-2 causal-LM: a few fused-head AMP training steps, then
+KV-cache generation (whole decode = one XLA module), optionally
+through the executing int8 serving path.
+
+    python examples/gpt_train_generate.py                # tiny config
+    python examples/gpt_train_generate.py --size small   # GPT-2 small
+    python examples/gpt_train_generate.py --int8         # int8 decode
+"""
+import argparse
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.models.gpt import gpt_small, gpt_tiny
+from paddle_tpu.parallel import ParallelTrainer
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--size', choices=('tiny', 'small'), default='tiny')
+    ap.add_argument('--train-steps', type=int, default=3)
+    ap.add_argument('--seq-len', type=int, default=128)
+    ap.add_argument('--new-tokens', type=int, default=16)
+    ap.add_argument('--int8', action='store_true',
+                    help='quantize_dynamic_int8 before decoding')
+    args = ap.parse_args()
+
+    paddle.seed(0)
+    if args.size == 'small':
+        model = gpt_small(max_seq_len=max(1024, args.seq_len),
+                          dropout=0.0, fused_head=True)
+        batch = 8
+    else:
+        model = gpt_tiny(max_seq_len=max(128, args.seq_len),
+                         fused_head=True)
+        batch = 2
+    opt = paddle.optimizer.AdamW(learning_rate=3e-4,
+                                 parameters=model.parameters())
+    strategy = fleet.DistributedStrategy()
+    strategy.amp = True
+    strategy.amp_configs['use_pure_fp16'] = True
+    trainer = ParallelTrainer(model, opt,
+                              lambda out, y: model.loss(out, y),
+                              strategy=strategy)
+    rs = np.random.RandomState(0)
+    V = model.config.vocab_size
+    ids = rs.randint(0, V, size=(batch, args.seq_len)).astype('int64')
+    for i in range(args.train_steps):
+        t0 = time.time()
+        loss = trainer.step(ids, ids)
+        print(f'step {i}: loss={float(np.asarray(loss)):.4f} '
+              f'({time.time() - t0:.2f}s)')
+
+    # decode from the trained weights
+    trainer.sync_to_model()
+    model.eval()
+    if args.int8:
+        from paddle_tpu.quantization import quantize_dynamic_int8
+        quantize_dynamic_int8(model)
+        print('decoding through Int8DynamicLinear projections')
+    prompt = ids[:1, :min(8, ids.shape[1])]
+    out = model.generate(paddle.to_tensor(prompt),
+                         max_new_tokens=args.new_tokens, temperature=0)
+    print('prompt  :', prompt[0].tolist())
+    print('decoded :',
+          np.asarray(out.value)[0, prompt.shape[1]:].tolist())
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""LeNet on MNIST through the high-level hapi API — the canonical
+first program (reference tutorial: Model.prepare/fit/evaluate).
+
+    python examples/mnist_lenet.py [--epochs 2] [--batch-size 64]
+
+Falls back to a synthetic MNIST when the real IDX files are absent
+(zero-egress environments)."""
+import argparse
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+from paddle_tpu.vision.transforms import Compose, Normalize, Transpose
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--epochs', type=int, default=2)
+    ap.add_argument('--batch-size', type=int, default=64)
+    ap.add_argument('--limit-steps', type=int, default=0,
+                    help='>0 trims the datasets for a quick smoke run')
+    args = ap.parse_args()
+
+    # HWC uint8 -> normalized CHW float (LeNet is NCHW like the
+    # reference tutorial)
+    transform = Compose([Normalize(mean=[127.5], std=[127.5],
+                                   data_format='HWC'),
+                         Transpose((2, 0, 1))])
+    train_ds = MNIST(mode='train', transform=transform)
+    test_ds = MNIST(mode='test', transform=transform)
+    if args.limit_steps:
+        from paddle_tpu.io import Subset
+        n = args.limit_steps * args.batch_size
+        train_ds = Subset(train_ds, range(min(n, len(train_ds))))
+        test_ds = Subset(test_ds, range(min(n, len(test_ds))))
+
+    model = paddle.Model(LeNet())
+    model.prepare(
+        paddle.optimizer.Adam(learning_rate=1e-3,
+                              parameters=model.parameters()),
+        nn.CrossEntropyLoss(),
+        Accuracy())
+    model.fit(train_ds, epochs=args.epochs,
+              batch_size=args.batch_size, verbose=1)
+    eval_result = model.evaluate(test_ds, batch_size=args.batch_size,
+                                 verbose=0)
+    print('eval:', {k: float(v) if not isinstance(v, list) else v
+                    for k, v in eval_result.items()})
+
+
+if __name__ == '__main__':
+    main()
