@@ -220,6 +220,26 @@ class TestTopLevelReferenceParity:
         paddle.monkey_patch_variable()
         paddle.monkey_patch_math_varbase()
 
+    @pytest.mark.parametrize('ns', ['nn', 'nn.functional', 'optimizer',
+                                    'static', 'distributed'])
+    def test_subnamespace_all_parity(self, ns):
+        """Every name in the reference subpackage's __all__ must
+        resolve on the corresponding paddle_tpu subpackage."""
+        import re
+        path = os.path.join(os.path.dirname(REFERENCE_INIT),
+                            *ns.split('.'), '__init__.py')
+        src = open(path).read()
+        m = re.search(r'__all__\s*=\s*\[(.*?)\]', src, re.S)
+        assert m, f'reference {ns} has no __all__'
+        names = {a or b for a, b in
+                 re.findall(r"'([^']+)'|\"([^\"]+)\"", m.group(1))}
+        assert len(names) >= 10
+        mod = paddle
+        for part in ns.split('.'):
+            mod = getattr(mod, part)
+        missing = sorted(n for n in names if not hasattr(mod, n))
+        assert not missing, f'{ns} missing: {missing}'
+
     def test_crop_tensor_matches_crop(self):
         x = paddle.to_tensor(np.arange(24, dtype='float32')
                              .reshape(2, 3, 4))
