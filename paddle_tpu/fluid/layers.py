@@ -28,6 +28,10 @@ from ..tensor import (             # noqa: F401
     matmul, topk, multiplex, shard_index, crop, stanh, reverse)
 from ..nn.functional import sigmoid  # noqa: F401
 from ..tensor.creation import assign  # noqa: F401
+# 1.x fluid.layers exported the distribution classes directly
+# (reference fluid/layers/distributions.py __all__)
+from ..distribution import (  # noqa: F401
+    Normal, Uniform, Categorical, MultivariateNormalDiag)
 
 
 def fill_constant(shape, dtype, value, force_cpu=False, out=None,

@@ -9,7 +9,8 @@ from paddle_tpu.vision import models, transforms, datasets
 from paddle_tpu.vision.transforms import functional as TF
 from paddle_tpu import text
 from paddle_tpu.metric import Accuracy, Precision, Recall, Auc, accuracy
-from paddle_tpu.distribution import Normal, Uniform, Categorical
+from paddle_tpu.distribution import (Normal, Uniform, Categorical,
+                                     MultivariateNormalDiag)
 
 
 def t(a):
@@ -308,6 +309,41 @@ def test_categorical():
     paddle.seed(0)
     s = np.asarray(d.sample([2000]).value)
     assert abs((s == 2).mean() - 0.5) < 0.1
+
+
+def test_multivariate_normal_diag():
+    """Entropy and KL vs closed forms (reference
+    fluid/layers/distributions.py:531; scale is the DIAGONAL
+    covariance matrix)."""
+    cov_a = np.diag([0.5, 2.0]).astype('float32')
+    cov_b = np.diag([1.0, 1.0]).astype('float32')
+    a = MultivariateNormalDiag(np.array([0.3, 0.5], 'float32'), cov_a)
+    b = MultivariateNormalDiag(np.array([0.0, 0.0], 'float32'), cov_b)
+    k = 2
+    want_ent = 0.5 * (k * (1 + np.log(2 * np.pi))
+                      + np.log(0.5 * 2.0))
+    assert abs(float(np.asarray(a.entropy().value)) - want_ent) < 1e-5
+    # KL(a||b) for diagonal covariances
+    d = np.array([0.0, 0.0]) - np.array([0.3, 0.5])
+    want_kl = 0.5 * ((0.5 + 2.0) + d @ d - k
+                     + np.log(1.0 / (0.5 * 2.0)))
+    got_kl = float(np.asarray(a.kl_divergence(b).value))
+    assert abs(got_kl - want_kl) < 1e-5
+    import pytest as _p
+    with _p.raises(TypeError):
+        a.kl_divergence(Normal(0.0, 1.0))
+    # log-domain determinant: high-dim small variances must not
+    # underflow to -inf (prod(0.1^60) == 0 in f32)
+    big = MultivariateNormalDiag(np.zeros(60, 'float32'),
+                                 np.diag([0.1] * 60).astype('float32'))
+    ent = float(np.asarray(big.entropy().value))
+    want = 0.5 * (60 * (1 + np.log(2 * np.pi)) + 60 * np.log(0.1))
+    assert np.isfinite(ent) and abs(ent - want) < 1e-3
+    # 1.x namespace parity: fluid.layers exports all four classes
+    import paddle_tpu.fluid as fluid
+    for n in ('Normal', 'Uniform', 'Categorical',
+              'MultivariateNormalDiag'):
+        assert hasattr(fluid.layers, n), n
 
 
 def test_seed_reproduces_sampling_and_transforms():
