@@ -35,9 +35,12 @@ def main():
         shapes = [(args.tq, args.tk or args.tq, args.d or 128)]
     else:
         # the bench/model shapes: GPT-2 small T=1024 d=64, BERT s128
-        # (too small for pallas — skipped by the gate), long-ctx 4096/8192
-        shapes = [(1024, 1024, 64), (2048, 2048, 64), (2048, 2048, 128),
-                  (4096, 4096, 128), (8192, 8192, 128)]
+        # (too small for pallas — skipped by the gate), longctx bench
+        # = GPT-2 small at T=4096 so d stays 64, long-ctx 4096/8192
+        # at d=128 for the larger-model face
+        shapes = [(1024, 1024, 64), (2048, 2048, 64), (4096, 4096, 64),
+                  (2048, 2048, 128), (4096, 4096, 128),
+                  (8192, 8192, 128)]
     causal = not args.no_causal
     for tq, tk, d in shapes:
         best, ms = autotune_blocks(tq, tk, d, causal=causal, bh=args.bh)
