@@ -41,6 +41,11 @@ class TestExamples:
                           '--space-to-depth')
         assert 'imgs/s' in out
 
+    def test_bert_pretrain(self):
+        out = run_example('bert_pretrain.py', '--steps', '2',
+                          '--batch-size', '4', '--seq-len', '32')
+        assert out.count('mlm_loss=') == 2
+
     def test_gpt_train_generate(self):
         out = run_example('gpt_train_generate.py', '--train-steps', '2',
                           '--seq-len', '32', '--new-tokens', '4')
