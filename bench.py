@@ -521,20 +521,30 @@ def _run_no_kill(name, smoke, timeout_s):
     - either timeout path arms the halved-shape fallback flag so the
       next attempt compiles a much smaller module."""
     import subprocess
-    os.makedirs(CHIP_OUT, exist_ok=True)
-    marker = os.path.join(CHIP_OUT, f'{name}_compile_done.marker')
+    import tempfile
+    # real runs leave their scratch in the committed evidence dir;
+    # smoke runs (CI) must not litter it
+    scratch = tempfile.mkdtemp(prefix='bench_nokill_') if smoke \
+        else CHIP_OUT
+    os.makedirs(scratch, exist_ok=True)
+    marker = os.path.join(scratch, f'{name}_compile_done.marker')
     if os.path.exists(marker):
         os.remove(marker)
     cmd = [sys.executable, os.path.abspath(__file__), '--config', name,
            '--single-json']
     if smoke:
         cmd.append('--smoke')
-    out_p = os.path.join(CHIP_OUT, f'{name}_child.out')
-    err_p = os.path.join(CHIP_OUT, f'{name}_child.err')
+    out_p = os.path.join(scratch, f'{name}_child.out')
+    err_p = os.path.join(scratch, f'{name}_child.err')
     env = dict(os.environ, BENCH_COMPILE_MARKER=marker)
     with open(out_p, 'w') as so, open(err_p, 'w') as se:
         proc = subprocess.Popen(cmd, stdout=so, stderr=se, env=env,
                                 start_new_session=True)
+    def _cleanup_scratch():
+        if smoke:
+            import shutil
+            shutil.rmtree(scratch, ignore_errors=True)
+
     deadline = time.time() + timeout_s
     hard_deadline = deadline + timeout_s
     while proc.poll() is None:
@@ -545,14 +555,19 @@ def _run_no_kill(name, smoke, timeout_s):
         if now > deadline and os.path.exists(marker):
             proc.kill()
             proc.wait()
-            _arm_gptgen_fallback(
-                f'post-compile timeout after {timeout_s}s')
+            if not smoke:   # a CPU smoke hiccup must not degrade the
+                            # next REAL session to the halved shape
+                _arm_gptgen_fallback(
+                    f'post-compile timeout after {timeout_s}s')
+            _cleanup_scratch()
             return {'value': None, 'unit': UNITS[name],
                     'error': f'timeout after {timeout_s}s '
                              '(compile had finished; child killed)'}
         if now > hard_deadline:
-            _arm_gptgen_fallback(
-                f'compile still running at {2 * timeout_s}s')
+            if not smoke:
+                _arm_gptgen_fallback(
+                    f'compile still running at {2 * timeout_s}s')
+            # orphan keeps writing into scratch: do NOT clean it here
             return {'value': None, 'unit': UNITS[name],
                     'error': f'compile exceeded {2 * timeout_s}s; '
                              'child orphaned (not killed — a '
@@ -565,13 +580,14 @@ def _run_no_kill(name, smoke, timeout_s):
     except OSError:
         stdout = ''
     parsed = _last_json_dict(stdout)
-    if parsed is not None:
-        return parsed
     try:
         with open(err_p) as f:
             err_tail = f.read()[-300:]
     except OSError:
         err_tail = ''
+    _cleanup_scratch()
+    if parsed is not None:
+        return parsed
     log(f'{name} produced no JSON (rc={proc.returncode}): {err_tail}')
     return {'value': None, 'unit': UNITS[name],
             'error': f'no output (rc={proc.returncode})'}
@@ -624,8 +640,12 @@ def _device_preflight(total_budget_s=600):
     return False
 
 
-def _write_partial(results):
-    """Checkpoint the artifact-so-far next to this script."""
+def _write_partial(results, smoke=False):
+    """Checkpoint the artifact-so-far next to this script.  Smoke runs
+    (CI) must NOT overwrite it — round 4 lost a chip session's partial
+    numbers to exactly that."""
+    if smoke:
+        return
     try:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             'BENCH_partial.json')
@@ -693,7 +713,7 @@ def main():
                 _record_chip_result(name, results[name])
             # partial artifact after EVERY config: a tunnel death (or
             # driver kill) mid-run keeps the finished configs' numbers
-            _write_partial(results)
+            _write_partial(results, smoke=args.smoke)
             if 'timeout' in str(results[name].get('error', '')) and \
                     i + 1 < len(names):
                 # a timed-out config usually means the tunnel wedged
@@ -709,7 +729,7 @@ def main():
                             'error': 'accelerator runtime died '
                                      'mid-run (previous config '
                                      'timed out, preflight failed)'}
-                    _write_partial(results)
+                    _write_partial(results, smoke=args.smoke)
                     break
         else:
             import jax

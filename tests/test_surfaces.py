@@ -315,6 +315,35 @@ class TestBenchRegistry:
         assert rec['resnet']['value'] == 2481.0
         assert rec['resnet']['measured_at']
 
+    def test_smoke_orchestration_end_to_end(self, tmp_path):
+        """The driver-facing path: `bench.py --smoke` spawns every
+        config in its own subprocess (gptgen through the no-kill
+        runner), assembles one JSON line, and never records CPU smoke
+        numbers as chip evidence.  This is the test that fails BEFORE
+        a broken orchestration burns a real chip window."""
+        import json as _json
+        import subprocess
+        import sys as _sys
+        repo = os.path.join(os.path.dirname(__file__), '..')
+        env = dict(os.environ)
+        env.pop('PALLAS_AXON_POOL_IPS', None)
+        env['JAX_PLATFORMS'] = 'cpu'
+        proc = subprocess.run(
+            [_sys.executable, 'bench.py', '--smoke'],
+            cwd=repo, env=env, capture_output=True, text=True,
+            timeout=1500)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        line = proc.stdout.strip().splitlines()[-1]
+        out = _json.loads(line)
+        assert out['metric'] == 'resnet50_bf16_train_throughput'
+        assert out['value'] and out['value'] > 0
+        got = {'resnet'} | set(out['extras'])
+        bench = self._load_bench()
+        assert got == set(bench.CONFIGS), got
+        for name, res in out['extras'].items():
+            assert res.get('value'), (name, res)
+            assert res.get('platform') == 'cpu'
+
     def test_dead_tunnel_surfaces_stale_numbers(self, tmp_path,
                                                 monkeypatch, capsys):
         """A dead tunnel at driver time must preserve the most recent
