@@ -306,6 +306,7 @@ def bench_gptgen(smoke):
     from paddle_tpu.models.gpt import gpt_small, gpt_tiny
 
     bench_gptgen.last_note = None
+    bench_gptgen.nonstandard_shape = False
     fallback = os.path.exists(GPTGEN_FALLBACK_FLAG)
     if smoke:
         batch, prompt, new, iters = (2, 8, 8, 2)
@@ -317,6 +318,7 @@ def bench_gptgen(smoke):
         bench_gptgen.last_note = (
             f'fallback shape b{batch} p{prompt} n{new} '
             '(previous session timed out mid-compile)')
+        bench_gptgen.nonstandard_shape = True
         log(f'gptgen: {bench_gptgen.last_note}')
     else:
         batch, prompt, new, iters = (8, 128, 128, 5)
@@ -444,6 +446,11 @@ def _run_one(name, smoke):
         note = getattr(CONFIGS[name], 'last_note', None)
         if note:
             res['note'] = note
+        if getattr(CONFIGS[name], 'nonstandard_shape', False):
+            # e.g. the gptgen halved-shape fallback: the baseline
+            # constant is calibrated for the full shape, so a ratio
+            # would report a phantom regression
+            res['vs_baseline'] = None
         return res
     except Exception as e:  # one config failing must not hide the rest
         log(f'{name} FAILED: {e!r}')
@@ -714,7 +721,10 @@ def main():
             # partial artifact after EVERY config: a tunnel death (or
             # driver kill) mid-run keeps the finished configs' numbers
             _write_partial(results, smoke=args.smoke)
-            if 'timeout' in str(results[name].get('error', '')) and \
+            err_s = str(results[name].get('error', ''))
+            # 'exceeded' covers the no-kill orphan path — a compile
+            # running past 2x budget is the strongest wedge signal
+            if ('timeout' in err_s or 'exceeded' in err_s) and \
                     i + 1 < len(names):
                 # a timed-out config usually means the tunnel wedged
                 # mid-run: one quick probe decides between burning the

@@ -393,6 +393,15 @@ class DataLoader:
         self.use_process_workers = bool(use_process_workers)
         self.mp_context = mp_context
         self.timeout = float(timeout) if timeout else 0.0
+        if self.use_process_workers and (
+                self.num_workers == 0
+                or isinstance(dataset, IterableDataset)):
+            import warnings
+            warnings.warn(
+                'use_process_workers=True has no effect with '
+                'num_workers=0 or an IterableDataset — loading runs '
+                'in the main process; set num_workers>0 on a '
+                'map-style dataset to fork workers')
         # native ring serializes batches: arrays travel zero-pickle, but
         # exotic batch objects must be picklable — set False to keep the
         # in-process threaded path for those
@@ -626,25 +635,25 @@ class DataLoader:
         window = max(2, self.num_workers * self.prefetch_factor)
         task_q = ctx.Queue()
         result_q = ctx.Queue(maxsize=window)
-        # windowed dispatch: preload `window` tasks, then one new task
-        # per result received — bounds the seq spread so one straggler
-        # worker cannot make the parent stash the whole epoch
+        # windowed dispatch anchored at the CONSUMER cursor: only seqs
+        # < want + window are ever dispatched, so one straggler worker
+        # cannot make the parent stash more than `window` payloads
+        # (dispatching per-result instead would bound dispatched-minus-
+        # received but let the stash grow to the whole epoch)
         state = {'next_task': 0, 'received': 0, 'sentinels': False}
 
-        def dispatch_next():
-            if state['next_task'] < n_batches:
+        def dispatch_upto(want):
+            while state['next_task'] < min(n_batches, want + window):
                 seq = state['next_task']
                 task_q.put((seq, list(indices_list[seq])))
                 state['next_task'] = seq + 1
-            elif not state['sentinels']:
+            if state['next_task'] == n_batches \
+                    and not state['sentinels']:
                 for _ in range(self.num_workers):
                     task_q.put(None)
                 state['sentinels'] = True
 
-        for _ in range(min(window, n_batches)):
-            dispatch_next()
-        if state['next_task'] == n_batches:
-            dispatch_next()     # epoch fits in the window: sentinels now
+        dispatch_upto(0)
         procs = [ctx.Process(
             target=_process_worker,
             args=(self.dataset, self.collate_fn, self.worker_init_fn,
@@ -674,6 +683,8 @@ class DataLoader:
             on a live worker does not."""
             import queue as _queue
             for want in range(n_batches):
+                dispatch_upto(want)
+                stalled_polls = 0
                 while want not in stash:
                     try:
                         seq, payload = result_q.get(timeout=poll_s)
@@ -693,13 +704,29 @@ class DataLoader:
                                 f'DataLoader timed out after '
                                 f'{self.timeout}s waiting for batch '
                                 f'{want}') from None
+                        stalled_polls += 1
+                        if stalled_polls % 12 == 0:   # ~once a minute
+                            # children are alive but silent: a genuine
+                            # slow sample, OR a fork-inherited-lock
+                            # deadlock (forking a threaded jax parent)
+                            # — surface the escape hatches instead of
+                            # hanging mutely forever
+                            import warnings
+                            waited = stalled_polls * poll_s
+                            warnings.warn(
+                                f'DataLoader batch {want} has produced '
+                                f'no data for {waited:.0f}s with '
+                                'workers alive; if this is not a slow '
+                                "sample, try mp_context='forkserver' "
+                                '(fork can deadlock on locks inherited '
+                                'from a threaded parent) or set '
+                                'timeout= to fail fast')
                         continue
                     if seq == '__done__':
                         done_wids.add(payload)
                         continue
                     stash[seq] = payload
                     state['received'] += 1
-                    dispatch_next()
                 yield want, stash.pop(want)
 
         use_ring = self.use_native_loader and _native.available()

@@ -574,8 +574,13 @@ class GPTForCausalLM(nn.Layer):
             """Embeddings → scanned blocks → ln_f → tied head, built
             from the same sublayers the unrolled path runs (dropout is
             identity in eval).  `state` carries the per-layer stacks
-            computed ONCE per generate call — stacking in here would
-            re-emit L-way stacks into every token-scan body."""
+            computed ONCE per generate call (stacking in here would
+            re-emit L-way stacks into every token-scan body) plus only
+            the NON-block subtrees — threading the full params dict
+            through would keep a second unused copy of every block
+            weight live in the module.  (The stacks themselves still
+            double block-weight HBM versus the unrolled form for the
+            duration of the call — the price of the smaller module.)"""
             params, buffers, stacked_p, stacked_b = state
             k_all, v_all = cache
             T = ids_t.shape[1]
@@ -629,9 +634,14 @@ class GPTForCausalLM(nn.Layer):
                 return jnp.concatenate([ids, new], axis=1)
             return gen
 
+        def _nonblock(tree):
+            return {k: v for k, v in tree.items()
+                    if not k.startswith(blocks_prefix)}
+
         if use_scan:
             gen_fn = _make_gen(
-                lambda p, b: (p, b, _stacked(p), _stacked(b)),
+                lambda p, b: (_nonblock(p), _nonblock(b),
+                              _stacked(p), _stacked(b)),
                 _scan_step,
                 lambda: (jnp.zeros((L, B, nh, Tmax, hd), jnp.float32),
                          jnp.zeros((L, B, nh, Tmax, hd), jnp.float32)))

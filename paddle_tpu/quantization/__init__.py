@@ -433,7 +433,14 @@ def quantize_dynamic_int8(model, layer_filter=None):
         return n
 
     if walk(model) == 0:
-        raise ValueError('no quantizable Linear sublayers found')
+        hint = ''
+        if type(model) in swappable:
+            hint = (' — the ROOT layer is itself a quantizable '
+                    'Linear, but an in-place swap needs a parent: '
+                    'wrap it (e.g. nn.Sequential(model)) and '
+                    'quantize that')
+        raise ValueError('no quantizable Linear sublayers found'
+                         + hint)
     return model
 
 
