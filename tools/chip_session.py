@@ -43,6 +43,14 @@ STEPS = [
     ('ce_backward',
      [sys.executable, 'tools/bench_ce_backward.py'], 30 * 60),
     ('tune_flash', [sys.executable, 'tools/tune_flash.py'], 3 * 3600),
+    # re-measure the two flash-bound train configs WITH the tuned
+    # blocks (the 'bench' step above ran before the table existed);
+    # single-config runs record into bench_results.json, so the
+    # stale-merge serves the tuned numbers
+    ('bench_gpt_posttune',
+     [sys.executable, 'bench.py', '--config', 'gpt'], 45 * 60),
+    ('bench_longctx_posttune',
+     [sys.executable, 'bench.py', '--config', 'longctx'], 60 * 60),
     ('census_gpt',
      [sys.executable, 'tools/profile_transformer.py', '--model', 'gpt'],
      45 * 60),
