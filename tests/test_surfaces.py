@@ -285,6 +285,29 @@ class TestBenchRegistry:
         assert bench.NO_KILL <= names
         assert list(bench.CONFIGS)[-1] == 'gptgen'  # wedge risk last
 
+    def test_chip_session_queue_wellformed(self):
+        """Every queued watcher step must point at an existing tool
+        with a sane timeout — a typo'd path burns a real chip window
+        (tools/chip_session.py commits evidence per step)."""
+        import importlib.util
+        repo = os.path.join(os.path.dirname(__file__), '..')
+        spec = importlib.util.spec_from_file_location(
+            'chip_session', os.path.join(repo, 'tools',
+                                         'chip_session.py'))
+        cs = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(cs)
+        names = [s[0] for s in cs.STEPS]
+        assert len(names) == len(set(names)), 'duplicate step names'
+        for name, argv, timeout_s in cs.STEPS:
+            assert 600 <= timeout_s <= 4 * 3600, (name, timeout_s)
+            script = argv[1]
+            assert os.path.exists(os.path.join(repo, script)), \
+                f'step {name}: missing {script}'
+        # the wedge-class decode compiles must stay LAST so their
+        # failure cannot cost other steps their numbers
+        assert names[-2:] == ['int8_decode', 'scan_decode']
+        assert names[0] == 'bench'
+
     @staticmethod
     def _load_bench():
         import importlib.util
