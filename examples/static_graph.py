@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Static-graph (Program/Executor) training — the fluid-era workflow
+(reference: Program + optimizer.minimize + Executor run loop).
+TPU-native twist: the WHOLE program (forward + grads + optimizer
+update) lowers to ONE jitted XLA module on first run; subsequent
+`exe.run` calls are a single device dispatch.
+
+    python examples/static_graph.py [--steps 60]
+"""
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--steps', type=int, default=60)
+    args = ap.parse_args()
+
+    paddle.enable_static()
+    try:
+        paddle.seed(0)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data('x', [None, 4])
+            y = static.data('y', [None, 1])
+            h = static.nn.fc(x, 16, act='relu')
+            pred = static.nn.fc(h, 1)
+            loss = ((pred - y) * (pred - y)).mean()
+            opt = paddle.optimizer.Adam(learning_rate=0.05)
+            opt.minimize(loss)
+
+        exe = static.Executor()
+        rs = np.random.RandomState(0)
+        lv = float('nan')
+        w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], 'float32')
+        X = rs.randn(128, 4).astype('float32')
+        Y = X @ w_true
+        for i in range(args.steps):
+            lv, = exe.run(prog, feed={'x': X, 'y': Y},
+                          fetch_list=[loss])
+            if i % 10 == 0 or i == args.steps - 1:
+                print(f'step {i}: loss={float(lv):.5f}')
+        print('final loss:', float(lv))
+    finally:
+        paddle.disable_static()
+
+
+if __name__ == '__main__':
+    main()

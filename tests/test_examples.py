@@ -68,6 +68,11 @@ class TestExamples:
                           '--tp', '1', '--steps', '2', '--zero', '2')
         assert out.count('loss=') == 2
 
+    def test_static_graph(self):
+        out = run_example('static_graph.py', '--steps', '100')
+        lines = [ln for ln in out.splitlines() if 'final loss' in ln]
+        assert lines and float(lines[0].split(':')[1]) < 0.1
+
     def test_readme_lists_every_script(self):
         with open(os.path.join(EXAMPLES, 'README.md')) as f:
             readme = f.read()

@@ -289,13 +289,9 @@ class TestBenchRegistry:
         """Every queued watcher step must point at an existing tool
         with a sane timeout — a typo'd path burns a real chip window
         (tools/chip_session.py commits evidence per step)."""
-        import importlib.util
         repo = os.path.join(os.path.dirname(__file__), '..')
-        spec = importlib.util.spec_from_file_location(
-            'chip_session', os.path.join(repo, 'tools',
-                                         'chip_session.py'))
-        cs = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(cs)
+        cs = self._load_module(os.path.join('tools',
+                                            'chip_session.py'))
         names = [s[0] for s in cs.STEPS]
         assert len(names) == len(set(names)), 'duplicate step names'
         for name, argv, timeout_s in cs.STEPS:
@@ -309,14 +305,19 @@ class TestBenchRegistry:
         assert names[0] == 'bench'
 
     @staticmethod
-    def _load_bench():
+    def _load_module(relpath):
         import importlib.util
         import os
-        path = os.path.join(os.path.dirname(__file__), '..', 'bench.py')
-        spec = importlib.util.spec_from_file_location('bench', path)
-        bench = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(bench)
-        return bench
+        path = os.path.join(os.path.dirname(__file__), '..', relpath)
+        name = os.path.basename(relpath).rsplit('.', 1)[0]
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    @classmethod
+    def _load_bench(cls):
+        return cls._load_module('bench.py')
 
     def test_chip_result_recording_gates(self, tmp_path, monkeypatch):
         """Only real-TPU, non-null numbers may enter the committed
