@@ -58,6 +58,7 @@ from . import ops  # noqa: F401
 from . import models  # noqa: F401
 from . import profiler  # noqa: F401
 from . import utils  # noqa: F401
+from . import resilience  # noqa: F401
 from . import sysconfig  # noqa: F401
 from . import autograd  # noqa: F401
 from . import fluid  # noqa: F401

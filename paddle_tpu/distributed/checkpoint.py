@@ -1,4 +1,4 @@
-"""Sharded, async checkpointing (orbax-backed).
+"""Sharded, async checkpointing (orbax-backed) with verified commits.
 
 Reference analogue: /root/reference/python/paddle/framework/io.py:494
 (paddle.save of Program+params) plus fleet's per-rank save utils — on
@@ -10,6 +10,14 @@ copy with the next training steps.  Restore takes an abstract template
 (shapes/dtypes/NamedShardings) and materializes each leaf directly into
 its mesh placement.
 
+Crash-safety (resilience.manifest): a save only COUNTS once its commit
+manifest (step + per-file sizes/checksums) lands atomically after the
+async barrier.  `latest_step()` is the latest *committed* step; a
+SIGKILL mid-save leaves an uncommitted dir that readers simply never
+see, and a committed dir whose contents fail verification is
+quarantined (renamed aside, never silently loaded) while restore
+falls back to the previous committed step.
+
     save_sharded(tree, path, async_save=True)   -> wait() handle
     load_sharded(path, like=tree_or_abstract)   -> restored pytree
     CheckpointManager(dir, keep)                -> step-level save/
@@ -19,9 +27,12 @@ The pickle path (framework/io.py) remains for small host-side
 state_dicts; this module is the 1.3B-scale path.
 """
 import os
+import warnings
 
 import jax
 import numpy as np
+
+from ..resilience import manifest as _manifest
 
 __all__ = ['save_sharded', 'load_sharded', 'CheckpointManager']
 
@@ -35,25 +46,71 @@ def _checkpointer(async_save):
 
 
 class _SaveHandle:
-    def __init__(self, ckptr):
+    """Completion handle for one save.  wait() is idempotent: the
+    first successful call drains the async barrier, closes the
+    checkpointer, and commits the manifest; later calls are no-ops
+    (the old behaviour re-entered a closed checkpointer).  A wait()
+    that RAISES may be retried: each sub-step (drain+close, commit)
+    runs at most once, so a transient commit failure is retryable
+    without double-closing."""
+
+    def __init__(self, ckptr, on_commit=None):
         self._ckptr = ckptr
+        self._on_commit = on_commit
+        self._drained = False
+        self._done = False
 
     def wait(self):
-        if hasattr(self._ckptr, 'wait_until_finished'):
-            self._ckptr.wait_until_finished()
-        self._ckptr.close()
+        if self._done:
+            return
+        if not self._drained:
+            if hasattr(self._ckptr, 'wait_until_finished'):
+                self._ckptr.wait_until_finished()
+            self._ckptr.close()
+            self._drained = True
+        if self._on_commit is not None:
+            self._on_commit()
+        self._done = True
+
+    @property
+    def committed(self):
+        return self._done
 
 
-def save_sharded(tree, path, async_save=True, overwrite=True):
+def save_sharded(tree, path, async_save=True, overwrite=True,
+                 commit=True, step=None, checksums=True):
     """Write a (possibly mesh-sharded) pytree of jax.Arrays as per-shard
     artifacts under `path`.  Returns a handle; call .wait() before
     relying on the files (async mode overlaps with compute until then).
+    With `commit` (default) wait() also writes the commit manifest that
+    marks the directory as a finished, verifiable checkpoint.
+    `checksums=False` commits presence+sizes only — still catches every
+    crash-shaped tear without re-reading multi-GB shards inside the
+    post-save barrier (see resilience.manifest.write_manifest).
     """
     import orbax.checkpoint as ocp
     path = os.path.abspath(path)
     ckptr = _checkpointer(async_save)
     ckptr.save(path, args=ocp.args.StandardSave(tree), force=overwrite)
-    handle = _SaveHandle(ckptr)
+    on_commit = None
+    if commit:
+        # jax.process_index 0 ran the directory-level finalize; it also
+        # owns the commit record (multi-host: shared filesystem)
+        try:
+            writer = jax.process_index() == 0
+        except RuntimeError:
+            writer = True
+        if writer:
+            # leaf_spec must be computed from the SAME abstraction
+            # restore will compare against (_abstractify), or python
+            # scalar leaves record dtype 'int' at save but 'int32' at
+            # restore and a valid checkpoint fails the template check;
+            # computed eagerly — by commit time the arrays may be
+            # donated away
+            spec_tree = _abstractify(tree)
+            on_commit = lambda: _manifest.write_manifest(  # noqa: E731
+                path, step=step, tree=spec_tree, checksums=checksums)
+    handle = _SaveHandle(ckptr, on_commit=on_commit)
     if not async_save:
         handle.wait()
     return handle
@@ -88,55 +145,181 @@ def load_sharded(path, like):
 class CheckpointManager:
     """Step-level sharded checkpoint rotation — the elastic/failure
     recovery path (SURVEY §5 A3) at model scale.  save() is async by
-    default: step N+1 computes while step N's shards hit disk."""
+    default: step N+1 computes while step N's shards hit disk.
 
-    def __init__(self, directory, keep=3, prefix='step', async_save=True):
+    Only COMMITTED steps (valid manifest, see resilience.manifest) are
+    visible to latest_step()/restore(); restore() further verifies the
+    manifest's sizes/checksums and walks back to the previous committed
+    step when a directory turns out torn, renaming the torn dir aside
+    (quarantine) so it is preserved for forensics but never selected
+    again."""
+
+    def __init__(self, directory, keep=3, prefix='step', async_save=True,
+                 verify=True, checksums=True):
+        # checksums=False: commit sizes only — the hashing otherwise
+        # runs inside wait()'s post-save barrier (i.e. at the head of
+        # the NEXT save), a full re-read of the checkpoint that can
+        # eat the async overlap at multi-GB scale; sizes still catch
+        # every crash-shaped tear
         self.directory = os.path.abspath(directory)
         self.keep = keep
         self.prefix = prefix
         self.async_save = async_save
+        self.verify = verify
+        self.checksums = checksums
         self._pending = None
+        self._pending_step = None
         os.makedirs(self.directory, exist_ok=True)
 
     def _path(self, step):
         return os.path.join(self.directory, f'{self.prefix}_{step}')
 
-    def _steps(self):
+    def _steps(self, committed=True):
+        """Step ids present on disk, ascending.  committed=True (the
+        default and the only safe reader view) filters to dirs whose
+        commit manifest landed; committed=False additionally includes
+        torn/in-flight dirs — writer-side bookkeeping only."""
         out = []
         for f in os.listdir(self.directory):
             tag = f[len(self.prefix) + 1:]
-            if f.startswith(self.prefix + '_') and tag.isdigit():
-                out.append(int(tag))
+            if not (f.startswith(self.prefix + '_') and tag.isdigit()):
+                continue
+            if committed and not _manifest.is_committed(self._path(int(tag))):
+                continue
+            out.append(int(tag))
         return sorted(out)
 
     def save(self, tree, step):
         self.wait()  # one in-flight save at a time
-        self._pending = save_sharded(tree, self._path(step),
-                                     async_save=self.async_save)
+        handle = save_sharded(tree, self._path(step),
+                              async_save=self.async_save, step=step,
+                              checksums=self.checksums)
         if not self.async_save:
             self._prune()
-        return self._pending
+            return handle
+        self._pending = handle
+        self._pending_step = step
+        return handle
 
     def wait(self):
         if self._pending is not None:
             self._pending.wait()
             self._pending = None
+            self._pending_step = None
             self._prune()
 
     def _prune(self):
+        """Rotate out old COMMITTED checkpoints beyond `keep`.
+        Uncommitted dirs are never pruned here: the newest may be an
+        in-flight async save (ours or another process's), and torn
+        ones are quarantined — not destroyed — by restore()."""
         import shutil
-        for s in self._steps()[:-self.keep]:
+        for s in self._steps(committed=True)[:-self.keep]:
             shutil.rmtree(self._path(s), ignore_errors=True)
 
+    def _quarantine(self, step):
+        """Move a torn step dir aside (never delete: a human may want
+        the shards) under a non-step name so every lister skips it."""
+        src = self._path(step)
+        for k in range(100):
+            dst = f'{src}.torn-{k}'
+            if not os.path.exists(dst):
+                try:
+                    os.replace(src, dst)
+                    return dst
+                except OSError:
+                    break
+        return None
+
     def latest_step(self):
-        steps = self._steps()
+        """Newest COMMITTED step, or -1.  A directory whose async save
+        died before its manifest landed does not exist for readers."""
+        steps = self._steps(committed=True)
         return steps[-1] if steps else -1
 
-    def restore(self, like, step=None):
-        """Restore `step` (default: latest).  Returns (tree, step) or
-        (None, -1) when no checkpoint exists."""
-        if step is None:
-            step = self.latest_step()
-        if step < 0 or not os.path.isdir(self._path(step)):
-            return None, -1
-        return load_sharded(self._path(step), like), step
+    def restore(self, like, step=None, verify=None):
+        """Restore `step` (default: latest committed).  Returns
+        (tree, step) or (None, -1) when no committed checkpoint exists.
+
+        Each candidate's manifest is verified (file presence + sizes +
+        checksums) before orbax touches it; a torn candidate is
+        quarantined and the previous committed step is tried — restore
+        degrades to older data, never crashes on (or silently loads)
+        partial state."""
+        verify = self.verify if verify is None else verify
+        if step is not None:
+            candidates = [step] + [s for s in
+                                   reversed(self._steps(committed=True))
+                                   if s < step]
+        else:
+            candidates = list(reversed(self._steps(committed=True)))
+        if not candidates:
+            uncommitted = self._steps(committed=False)
+            if uncommitted:
+                # pre-manifest-era (or torn) step dirs exist but none
+                # are restorable — say so, or an upgraded job silently
+                # restarts from step 0 discarding all prior progress
+                warnings.warn(
+                    f'{len(uncommitted)} step dir(s) under '
+                    f'{self.directory} have no commit manifest '
+                    '(written before verified checkpoints, or torn); '
+                    'none are restorable as-is — inspect with '
+                    'tools/check_ckpt.py and adopt trusted dirs with '
+                    '--adopt', RuntimeWarning, stacklevel=2)
+        for s in candidates:
+            path = self._path(s)
+            if not os.path.isdir(path):
+                if s == step:
+                    # the EXPLICITLY requested step is absent — say so
+                    # before quietly degrading to older data (a typo'd
+                    # step number should be visible, not absorbed)
+                    warnings.warn(
+                        f'requested checkpoint step {step} does not '
+                        f'exist under {self.directory}; falling back '
+                        'to previous committed step',
+                        RuntimeWarning, stacklevel=2)
+                continue
+            if s == self._pending_step:
+                # our own async save is still in flight — not torn,
+                # just not finished; it cannot be restored yet
+                continue
+            doc = _manifest.read_manifest(path)
+            if doc is None:
+                # no manifest: either a kill-between-save-and-commit
+                # artifact or ANOTHER process's in-flight save — the
+                # two are indistinguishable from here, so never
+                # quarantine (renaming a live save out from under its
+                # writer would corrupt it); just skip
+                warnings.warn(
+                    f'checkpoint {path} has no commit manifest (torn '
+                    'or in-flight); falling back to previous '
+                    'committed step', RuntimeWarning, stacklevel=2)
+                continue
+            if verify:
+                ok, errors = _manifest.verify_manifest(path)
+                if not ok:
+                    # manifest present but contents mismatch: the
+                    # commit DID land, so nobody is still writing —
+                    # this is real corruption, safe to move aside
+                    moved = self._quarantine(s)
+                    warnings.warn(
+                        f'checkpoint {path} failed verification '
+                        f'({errors[:3]}{"..." if len(errors) > 3 else ""})'
+                        + (f'; quarantined to {moved}' if moved else '')
+                        + '; falling back to previous committed step',
+                        RuntimeWarning, stacklevel=2)
+                    continue
+            if doc.get('leaf_spec'):
+                # wrong-template restore is a CALLER bug, not a torn
+                # checkpoint: fail fast with named leaves (falling
+                # back would hit the same mismatch on older steps)
+                diffs = _manifest.spec_mismatches(
+                    doc['leaf_spec'],
+                    _manifest.leaf_spec(_abstractify(like)))
+                if diffs:
+                    raise ValueError(
+                        f'restore template does not match checkpoint '
+                        f'{path}: ' + '; '.join(diffs[:5])
+                        + ('...' if len(diffs) > 5 else ''))
+            return load_sharded(path, like), s
+        return None, -1

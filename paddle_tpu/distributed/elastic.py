@@ -25,8 +25,11 @@ import subprocess
 import sys
 import time
 
+from ..resilience import PREEMPTED_EXIT_CODE, GracefulShutdown
+
 __all__ = ['TrainerProc', 'start_local_trainers',
-           'terminate_local_procs', 'watch_local_trainers', 'supervise']
+           'terminate_local_procs', 'watch_local_trainers', 'supervise',
+           'PREEMPTED_EXIT_CODE']
 
 
 class TrainerProc:
@@ -40,6 +43,8 @@ class TrainerProc:
         self.cmd = None
         self.env = None
         self.restarts = 0
+        self.preemptions = 0
+        self.spawned_at = 0.0
 
 
 def start_local_trainers(cmds, log_dir=None, envs=None):
@@ -50,6 +55,11 @@ def start_local_trainers(cmds, log_dir=None, envs=None):
         env = dict(os.environ if envs is None else envs)
         env['PADDLE_TRAINER_ID'] = str(rank)
         env['PADDLE_RANK_IN_NODE'] = str(rank)
+        # worker and supervisor MUST agree on the preemption exit
+        # code, or every clean preemption reads as a crash and burns
+        # the restart budget (an explicit `envs` dict would otherwise
+        # drop the operator's override)
+        env['PADDLE_TPU_PREEMPTED_EXIT_CODE'] = str(PREEMPTED_EXIT_CODE)
         t = TrainerProc()
         t.rank = t.local_rank = rank
         t.cmd = list(cmd)
@@ -61,6 +71,7 @@ def start_local_trainers(cmds, log_dir=None, envs=None):
         t.proc = subprocess.Popen(
             cmd, env=env, stdout=t.log_fn or None,
             stderr=subprocess.STDOUT if t.log_fn else None)
+        t.spawned_at = time.time()
         procs.append(t)
     return procs
 
@@ -97,10 +108,20 @@ def terminate_local_procs(procs, grace=3.0):
                 pass
 
 
-def _restart(t, log_dir=None):
-    t.restarts += 1
+def _restart(t, log_dir=None, preempted=False):
+    """Relaunch a worker.  A clean preemption (exit code
+    PREEMPTED_EXIT_CODE after a graceful final checkpoint) bumps the
+    preemption counter, NOT the restart counter — the max_restarts
+    budget is a *failure* budget, and a fleet that preempts a job ten
+    times must not exhaust it."""
+    if preempted:
+        t.preemptions += 1
+    else:
+        t.restarts += 1
     env = dict(t.env)
     env['PADDLE_ELASTIC_RESTART_COUNT'] = str(t.restarts)
+    env['PADDLE_ELASTIC_PREEMPT_COUNT'] = str(t.preemptions)
+    env['PADDLE_TPU_PREEMPTED_EXIT_CODE'] = str(PREEMPTED_EXIT_CODE)
     t.env = env
     if log_dir and t.log_fn is None:
         t.log_fn = open(os.path.join(
@@ -108,20 +129,56 @@ def _restart(t, log_dir=None):
     t.proc = subprocess.Popen(
         t.cmd, env=env, stdout=t.log_fn or None,
         stderr=subprocess.STDOUT if t.log_fn else None)
+    t.spawned_at = time.time()
+
+
+def _seed_heartbeat(heartbeat_file):
+    with open(heartbeat_file, 'a'):
+        os.utime(heartbeat_file, None)
+
+
+def _heartbeat_age(heartbeat_file):
+    """Seconds since the worker last proved liveness.  A MISSING file
+    counts as infinitely stale: a worker (or operator) that deleted
+    the heartbeat mid-run used to silently disable hang detection —
+    exactly when detection matters most.  Any OTHER stat error
+    (ESTALE/EIO on a flaky shared fs) counts as fresh: one transient
+    hiccup must not SIGKILL a healthy worker and burn a restart."""
+    try:
+        return time.time() - os.path.getmtime(heartbeat_file)
+    except FileNotFoundError:
+        return float('inf')
+    except OSError:
+        return 0.0
 
 
 def watch_local_trainers(procs, max_restarts=3, poll=0.2,
                          heartbeat_file=None, heartbeat_timeout=None,
-                         log_dir=None, on_event=None):
+                         log_dir=None, on_event=None, shutdown=None,
+                         min_preempt_uptime=None):
     """The pod watch loop: poll workers, restart the dead, kill the
-    wedged (stale heartbeat), stop everything when one fails beyond
-    `max_restarts`.
+    wedged (stale or deleted heartbeat), stop everything when one
+    fails beyond `max_restarts`.
 
     Returns 0 when every worker exited cleanly; the failing worker's
-    exit code otherwise.  `on_event(kind, trainer)` (kinds 'exit',
-    'restart', 'hang') observes transitions — tests and progress
-    loggers hook it.
+    exit code otherwise.  A worker exiting PREEMPTED_EXIT_CODE (its
+    GracefulShutdown checkpointed and bowed out) is restarted without
+    consuming the max_restarts budget — unless it ran for less than
+    `min_preempt_uptime` seconds, which marks a preemption loop (e.g.
+    an exit-code env mismatch) and counts as a failure.  When `shutdown` (a
+    resilience.GracefulShutdown watching the SUPERVISOR's signals) is
+    requested, SIGTERM is forwarded to the workers so they checkpoint,
+    and the loop returns PREEMPTED_EXIT_CODE itself — preemption
+    propagates cleanly through nested supervision.  `on_event(kind,
+    trainer)` (kinds 'exit', 'restart', 'hang', 'preempt') observes
+    transitions — tests and progress loggers hook it.
     """
+    if min_preempt_uptime is None:
+        # default 5s, tunable per-deployment: real workers spend far
+        # longer than this importing + restoring before any step, but
+        # smoke workers (and tests) may legitimately live for less
+        min_preempt_uptime = float(os.environ.get(
+            'PADDLE_TPU_MIN_PREEMPT_UPTIME', '5'))
     if bool(heartbeat_file) != bool(heartbeat_timeout):
         raise ValueError(
             'heartbeat_file and heartbeat_timeout must be set '
@@ -131,19 +188,23 @@ def watch_local_trainers(procs, max_restarts=3, poll=0.2,
         # seed the heartbeat at supervision start: a worker that
         # wedges BEFORE its first checkpoint touch must still trip
         # the stale-mtime detector
-        with open(heartbeat_file, 'a'):
-            os.utime(heartbeat_file, None)
+        _seed_heartbeat(heartbeat_file)
     try:
         while True:
+            if shutdown is not None and shutdown.requested():
+                # host preemption reached the supervisor: pass the
+                # SIGTERM down (terminate_local_procs starts with
+                # terminate() == SIGTERM, so workers run their own
+                # graceful checkpoint within the grace window)
+                terminate_local_procs(procs, grace=30.0)
+                return PREEMPTED_EXIT_CODE
             alive = False
             for t in procs:
                 rc = t.proc.poll()
                 if rc is None:
                     alive = True
-                    if heartbeat_file and heartbeat_timeout and \
-                            os.path.exists(heartbeat_file):
-                        age = time.time() - os.path.getmtime(
-                            heartbeat_file)
+                    if heartbeat_file and heartbeat_timeout:
+                        age = _heartbeat_age(heartbeat_file)
                         if age > heartbeat_timeout:
                             if on_event:
                                 on_event('hang', t)
@@ -156,18 +217,28 @@ def watch_local_trainers(procs, max_restarts=3, poll=0.2,
                         continue
                 if rc == 0:
                     continue
+                preempted = rc == PREEMPTED_EXIT_CODE
+                if preempted and \
+                        time.time() - t.spawned_at < min_preempt_uptime:
+                    # a worker that claims preemption within seconds
+                    # of spawning is looping (env mismatch on the
+                    # exit code, shutdown tripped at startup) — count
+                    # it against the FAILURE budget or an unbounded
+                    # free-restart storm respawns forever
+                    preempted = False
                 # dead worker: restart or give up
                 if on_event:
-                    on_event('exit', t)
-                if t.restarts >= max_restarts:
+                    on_event('preempt' if preempted else 'exit', t)
+                if not preempted and t.restarts >= max_restarts:
                     terminate_local_procs(
                         [p for p in procs if p is not t])
                     return rc if rc is not None else 1
                 if heartbeat_file:
                     # a fresh heartbeat marks the NEW incarnation live
-                    with open(heartbeat_file, 'a'):
-                        os.utime(heartbeat_file, None)
-                _restart(t, log_dir)
+                    # (and re-seeds a deleted file so detection stays
+                    # armed)
+                    _seed_heartbeat(heartbeat_file)
+                _restart(t, log_dir, preempted=preempted)
                 if on_event:
                     on_event('restart', t)
                 alive = True
@@ -182,9 +253,18 @@ def watch_local_trainers(procs, max_restarts=3, poll=0.2,
 def supervise(cmd, max_restarts=3, log_dir=None, heartbeat_file=None,
               heartbeat_timeout=None, on_event=None):
     """Run ONE worker command under supervision (the per-host elastic
-    entry the launcher's --elastic flag uses)."""
+    entry the launcher's --elastic flag uses).  The supervisor itself
+    handles SIGTERM gracefully: forward to the worker, let it
+    checkpoint, exit PREEMPTED_EXIT_CODE."""
+    gs = GracefulShutdown(signals=(signal.SIGTERM,)).install()
     procs = start_local_trainers([cmd], log_dir=log_dir)
-    return watch_local_trainers(
-        procs, max_restarts=max_restarts, log_dir=log_dir,
-        heartbeat_file=heartbeat_file,
-        heartbeat_timeout=heartbeat_timeout, on_event=on_event)
+    try:
+        return watch_local_trainers(
+            procs, max_restarts=max_restarts, log_dir=log_dir,
+            heartbeat_file=heartbeat_file,
+            heartbeat_timeout=heartbeat_timeout, on_event=on_event,
+            shutdown=gs)
+    finally:
+        gs.uninstall()
+
+

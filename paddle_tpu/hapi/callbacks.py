@@ -11,8 +11,11 @@ import os
 import sys
 import time
 
+from ..resilience import NanSentinel
+
 __all__ = ['Callback', 'ProgBarLogger', 'ModelCheckpoint', 'LRScheduler',
-           'EarlyStopping', 'VisualDL', 'ReduceLROnPlateau', 'config_callbacks']
+           'EarlyStopping', 'VisualDL', 'ReduceLROnPlateau', 'NanGuard',
+           'config_callbacks']
 
 
 class CallbackList:
@@ -295,6 +298,77 @@ class ReduceLROnPlateau(Callback):
                 self.wait = 0
 
 
+class NanGuard(Callback):
+    """Divergence sentinel for Model.fit (resilience.NanSentinel
+    policy).  The compiled train step already SKIPS a non-finite
+    update on device (old params kept — see Model._make_train_step);
+    this callback adds the host-side policy: count consecutive
+    skipped steps, and after `patience` strikes roll the model back
+    to the last known-good state (captured at train begin and after
+    every clean epoch — the same boundaries ModelCheckpoint persists
+    to disk).  After `max_rollbacks` rollbacks the run raises
+    FloatingPointError instead of looping on a poisoned setup.
+
+    MEMORY: the rollback snapshot is a full device-side copy of
+    params + optimizer state + buffers, held for the whole fit — fine
+    for the models hapi targets, but a workload already at capacity
+    should pass NanGuard(rollback=False) (skip-only: non-finite
+    updates are still dropped on device at zero extra memory, there
+    is just nothing to roll back to) or NanGuard(enable=False).  At
+    1.3B scale use ParallelTrainer(nan_guard=True), which rolls back
+    to its on-disk committed checkpoint instead of a live copy.
+
+    Added to fit() by default; pass your own instance to tune.
+    """
+
+    def __init__(self, patience=3, max_rollbacks=2, enable=True,
+                 rollback=True, verbose=1):
+        super().__init__()
+        self.enable = enable
+        self.rollback = rollback
+        self.verbose = verbose
+        self.sentinel = NanSentinel(patience=patience,
+                                    max_rollbacks=max_rollbacks)
+        self._epoch_skip_base = 0
+
+    def on_train_begin(self, logs=None):
+        if self.enable and self.rollback:
+            self.model._capture_good_state()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch_skip_base = self.sentinel.total_skipped
+
+    def on_train_batch_end(self, step, logs=None):
+        if not self.enable:
+            return
+        action = self.sentinel.observe(
+            finite=getattr(self.model, '_last_step_ok', True))
+        if action == 'skip' and self.verbose:
+            print('NanGuard: non-finite loss/grad at step {} — update '
+                  'skipped ({}/{} strikes)'.format(
+                      step + 1, self.sentinel.strikes,
+                      self.sentinel.patience), file=sys.stderr)
+        elif action == 'rollback':
+            rolled = self.rollback and \
+                self.model._rollback_to_good_state()
+            if self.verbose:
+                print('NanGuard: {} consecutive non-finite steps — '
+                      '{}'.format(
+                          self.sentinel.patience,
+                          'rolled back to last good state' if rolled
+                          else 'no snapshot to roll back to; '
+                               'continuing with skipped updates'),
+                      file=sys.stderr)
+
+    def on_epoch_end(self, epoch, logs=None):
+        # refresh the rollback target only after a CLEAN epoch — an
+        # epoch containing skips may already carry subtly-poisoned
+        # state even though every applied update was finite
+        if self.enable and self.rollback and \
+                self.sentinel.total_skipped == self._epoch_skip_base:
+            self.model._capture_good_state()
+
+
 class VisualDL(Callback):
     """Scalar logging; writes JSONL events (no VisualDL service on TPU
     hosts — same constructor as the reference's VisualDL callback)."""
@@ -342,6 +416,8 @@ def config_callbacks(callbacks=None, model=None, batch_size=None,
         cbks.append(LRScheduler())
     if not any(isinstance(c, ModelCheckpoint) for c in cbks):
         cbks.append(ModelCheckpoint(save_freq, save_dir))
+    if mode == 'train' and not any(isinstance(c, NanGuard) for c in cbks):
+        cbks.append(NanGuard())
     cb_list = CallbackList(cbks)
     cb_list.set_model(model)
     cb_list.set_params({

@@ -9,6 +9,7 @@ stays host-side.  That is the whole TPU story: the MXU sees one fused
 program per step, the host only feeds batches.
 """
 import os
+import signal as _signal
 import warnings
 
 import numpy as np
@@ -20,6 +21,10 @@ from ..jit import functional_call
 from ..io import DataLoader, Dataset
 from ..framework.io import save as _save, load as _load
 from ..metric import Metric
+from ..resilience import (
+    finite_step as _finite_step, guard_update as _guard_update,
+    install_shutdown as _install_shutdown,
+    shutdown_requested as _shutdown_requested)
 from .callbacks import config_callbacks
 
 __all__ = ['Model']
@@ -68,6 +73,10 @@ class Model:
         self._pred_step_cache = {}
         # functional state lives here between steps (device pytrees)
         self._fstate = None
+        # divergence sentinel plumbing: last-known-good snapshot for
+        # rollback + the per-step finiteness flag NanGuard reads
+        self._good_state = None
+        self._last_step_ok = True
 
     # -- preparation ---------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -138,6 +147,39 @@ class Model:
         """Eager params changed (load/user edit): drop functional state."""
         self._fstate = None
 
+    # -- divergence rollback (resilience.NanSentinel policy) -----------------
+    def _copy_tree(self, t):
+        return jax.tree_util.tree_map(
+            lambda v: jnp.array(v, copy=True) if hasattr(v, 'dtype')
+            else v, t)
+
+    def _capture_good_state(self):
+        """Snapshot the functional state as the rollback target.
+        Copies are mandatory: the compiled step donates the live
+        fstate arrays, so an aliased snapshot would be deleted out
+        from under us by the very next step."""
+        st = self._get_fstate()
+        self._good_state = {'params': self._copy_tree(st['params']),
+                            'buffers': self._copy_tree(st['buffers']),
+                            'opt': self._copy_tree(st['opt']),
+                            'step': st['step']}
+
+    def _rollback_to_good_state(self):
+        """Restore the last captured snapshot (NanGuard calls this
+        after K consecutive non-finite steps).  -> True if a snapshot
+        existed.  The snapshot itself is re-copied so repeated
+        rollbacks keep working."""
+        if self._good_state is None:
+            return False
+        g = self._good_state
+        self._fstate = {'params': self._copy_tree(g['params']),
+                        'buffers': self._copy_tree(g['buffers']),
+                        'opt': self._copy_tree(g['opt']),
+                        'step': g['step']}
+        if self._optimizer is not None:
+            self._optimizer._global_step = g['step']
+        return True
+
     # -- compiled steps ------------------------------------------------------
     def _loss_value(self, outs, labels):
         outs_t = [Tensor._from_value(o) for o in outs]
@@ -177,12 +219,22 @@ class Model:
 
             (loss, (outs, new_buf)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            # divergence sentinel, device side: a non-finite
+            # loss/grad step keeps the OLD params/opt/buffers — the
+            # update is skipped inside the same XLA module, composing
+            # with the amp GradScaler's found_inf skip on the eager
+            # path.  Host-side policy (strike counting, rollback)
+            # lives in callbacks.NanGuard.
+            ok = _finite_step(loss, grads)
             # lr is a traced arg: scheduler steps / set_lr reach the
             # compiled module without retracing
             new_params, new_opt = opt.apply_gradients(
                 params, grads, opt_state, step, lr=lr)
+            new_params = _guard_update(ok, new_params, params)
+            new_opt = _guard_update(ok, new_opt, opt_state)
+            new_buf = _guard_update(ok, new_buf, buffers)
             metrics = self._metric_computes(outs, labels)
-            return new_params, new_buf, new_opt, loss, metrics
+            return new_params, new_buf, new_opt, loss, ok, metrics
 
         return jax.jit(step_fn, donate_argnums=(0, 1, 2))
 
@@ -239,14 +291,22 @@ class Model:
         rng = jax.random.fold_in(
             jax.random.PRNGKey(rng_mod.get_seed()), st['step'])
         # optimizer rules take t starting at 1 (Adam bias correction)
-        new_params, new_buf, new_opt, loss, mres = fn(
+        new_params, new_buf, new_opt, loss, ok, mres = fn(
             st['params'], st['buffers'], st['opt'], rng,
             jnp.asarray(st['step'] + 1, jnp.int32),
             jnp.asarray(self._optimizer.get_lr(), jnp.float32), *arrays)
+        # donation invalidated the inputs — always adopt the returned
+        # arrays (they hold the OLD values when the step was skipped)
+        ok = bool(ok)
+        self._last_step_ok = ok
         st.update(params=new_params, buffers=new_buf, opt=new_opt,
-                  step=st['step'] + 1)
+                  step=st['step'] + (1 if ok else 0))
         if self._optimizer is not None:
             self._optimizer._global_step = st['step']
+        if not ok:
+            # a skipped step contributes neither metrics nor an
+            # optimizer tick; policy (strikes/rollback) is NanGuard's
+            return float(loss), []
         metric_logs = [m.update(r) if not isinstance(r, (tuple, list))
                        else m.update(*r)
                        for m, r in zip(self._metrics, mres)]
@@ -328,6 +388,44 @@ class Model:
             save_freq=save_freq, save_dir=save_dir,
             metrics=['loss'] + [m.name() for m in self._metrics])
         self.stop_training = False
+        # preemption contract: SIGTERM during fit stops at the next
+        # step boundary, ModelCheckpoint's on_train_end writes the
+        # final checkpoint, and the tail of fit() exits
+        # PREEMPTED_EXIT_CODE (SIGINT instead hands control back).
+        # fit only BORROWS the handlers: if nothing else (launcher,
+        # auto_checkpoint range) installed them, they are restored on
+        # exit so a later Ctrl-C still kills the program normally
+        from ..resilience import shutdown as _sd
+        _owned_handlers = not _sd.handler_installed()
+        _install_shutdown()
+        try:
+            self._fit_loop(cbks, train_loader, eval_loader, epochs,
+                           eval_freq, batch_size, num_workers)
+        finally:
+            requested = _sd.shutdown_requested()
+            sig = _sd.preemption_signal()
+            if _owned_handlers:
+                _sd.uninstall_shutdown()
+                if sig == _signal.SIGINT:
+                    # user stop, and the latch is OURS: un-latch so
+                    # the next fit starts fresh — on the exception
+                    # path too, or a KeyboardInterrupt here would
+                    # poison every later training loop.  A BORROWED
+                    # latch is left set: the outer installer (e.g. an
+                    # auto_checkpoint range wrapping this fit) still
+                    # needs to see the request
+                    _sd.clear_shutdown()
+        if requested and sig != _signal.SIGINT:
+            # preemption — SIGTERM or a programmatic request() from a
+            # cluster agent: the final checkpoint just landed in
+            # on_train_end, exit with the code the elastic supervisor
+            # restarts for free.  SIGINT (user) instead returns
+            # control with training cleanly stopped.
+            _sd.exit_if_requested()
+        return self
+
+    def _fit_loop(self, cbks, train_loader, eval_loader, epochs,
+                  eval_freq, batch_size, num_workers):
         cbks.on_train_begin({})
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch, {})
@@ -342,9 +440,22 @@ class Model:
                 for m in self._metrics:
                     logs[str(m.name())] = m.accumulate()
                 cbks.on_train_batch_end(step, logs)
+                if _shutdown_requested():
+                    # preemption (SIGTERM latched by GracefulShutdown):
+                    # stop at this step boundary; on_train_end below
+                    # runs ModelCheckpoint's final save — the "final
+                    # synchronous checkpoint" of the preemption
+                    # contract — and the caller's exit_if_requested()
+                    # turns it into PREEMPTED_EXIT_CODE
+                    self.stop_training = True
                 if self.stop_training:
                     break
             cbks.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                # preemption/early-stop: every second of the grace
+                # window belongs to the final checkpoint, not to an
+                # eval pass
+                break
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(
                     eval_loader, batch_size=batch_size, verbose=0,
@@ -354,7 +465,6 @@ class Model:
                 break
         cbks.on_train_end(logs)
         self._sync_back()
-        return self
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, _callbacks=None):

@@ -23,8 +23,12 @@ Multi-host: only process 0 writes; every process reads the same dir
 (shared filesystem, the reference's HDFS role).
 """
 import os
-import tempfile
 import time
+import warnings
+
+from ...resilience import (
+    install_shutdown, shutdown_requested, retry, PREEMPTED_EXIT_CODE,
+    handler_installed, uninstall_shutdown)
 
 __all__ = ['configure', 'train_epoch_range', 'train_step_range',
            'AutoCheckpointChecker']
@@ -38,6 +42,7 @@ _state = {
     'inter': None,
     'heartbeat': None,
     'last_save': 0.0,
+    'graceful': True,
 }
 
 
@@ -55,17 +60,23 @@ class AutoCheckpointChecker:
 
 
 def configure(checkpoint_dir=None, model=None, optimizer=None,
-              save_checkpoint_inter=None, heartbeat_file=None):
+              save_checkpoint_inter=None, heartbeat_file=None,
+              graceful_shutdown=True):
     """Register what a snapshot contains.  `model`/`optimizer` may be
     single objects or lists; both expose state_dict/set_state_dict.
     `heartbeat_file` is touched at every save so an elastic supervisor
-    can detect a wedged trainer."""
+    can detect a wedged trainer.  With `graceful_shutdown` (default) a
+    SIGTERM/SIGINT during a train range saves one final synchronous
+    snapshot at the next step boundary and exits with
+    resilience.PREEMPTED_EXIT_CODE — which distributed.elastic
+    recognizes as a clean preemption (no restart budget consumed)."""
     _state['dir'] = checkpoint_dir
     _state['model'] = model
     _state['optimizer'] = optimizer
     _state['inter'] = save_checkpoint_inter
     _state['heartbeat'] = heartbeat_file
     _state['last_save'] = 0.0
+    _state['graceful'] = graceful_shutdown
 
 
 def _as_list(x):
@@ -116,20 +127,11 @@ def _save_snapshot(progress):
                        for o in _as_list(_state['optimizer'])],
     }
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
-                               prefix='.acp_tmp')
-    try:
-        with os.fdopen(fd, 'wb') as f:
-            pickle.dump(payload, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+    from ...resilience import atomic_write
+    retry(retries=2, backoff=0.05)(   # shared-fs writes flake; the
+        lambda: atomic_write(         # tmp+replace makes retries safe
+            path, lambda f: pickle.dump(payload, f), mode='wb',
+            prefix='.acp_tmp'))()
     _state['last_save'] = time.time()
 
 
@@ -150,8 +152,17 @@ def _load_snapshot():
     if path is None or not os.path.exists(path):
         return None
     import pickle
-    with open(path, 'rb') as f:
-        payload = pickle.load(f)
+    try:
+        with open(path, 'rb') as f:
+            payload = pickle.load(f)
+    except (EOFError, pickle.UnpicklingError, OSError, ValueError) as e:
+        # the write is atomic (tmp+replace), so a torn snapshot means
+        # external damage; a restarted worker must start over, not
+        # crash-loop on the same corrupt file
+        warnings.warn(
+            f'auto-checkpoint snapshot {path} is unreadable ({e}); '
+            'starting from scratch', RuntimeWarning)
+        return None
     for m, sd in zip(_as_list(_state['model']), payload['models']):
         m.set_state_dict(sd)
     for o, sd in zip(_as_list(_state['optimizer']),
@@ -169,20 +180,48 @@ def _should_save():
 
 def _range(kind, max_num):
     """Shared epoch/step generator: restore once, then yield only the
-    remaining indices, snapshotting after each completed one."""
+    remaining indices, snapshotting after each completed one.  Under
+    graceful shutdown (configure default), a SIGTERM mid-range saves a
+    final synchronous snapshot at the next boundary and exits
+    PREEMPTED_EXIT_CODE — the elastic supervisor restarts without
+    burning its failure budget and the resumed range loses zero
+    completed work."""
     if not AutoCheckpointChecker().valid():
         # reference behaviour: without the env/config the range is a
         # plain range and nothing is saved
         yield from range(max_num)
         return
-    progress = _load_snapshot()
-    start = 0
-    if progress is not None and progress.get('kind') == kind:
-        start = int(progress.get('next', 0))
-    for i in range(start, max_num):
-        yield i
-        if _should_save() or i == max_num - 1:
-            _save_snapshot({'kind': kind, 'next': i + 1})
+    # like Model.fit, the range only BORROWS the signal handlers: if
+    # nothing else installed them, restore on exit so a later
+    # Ctrl-C/SIGTERM behaves normally once the range is done
+    owned = _state['graceful'] and not handler_installed()
+    if _state['graceful']:
+        install_shutdown()   # idempotent; no-op off the main thread
+    try:
+        progress = _load_snapshot()
+        start = 0
+        if progress is not None and progress.get('kind') == kind:
+            start = int(progress.get('next', 0))
+        for i in range(start, max_num):
+            yield i
+            if _state['graceful'] and shutdown_requested():
+                # the completed index is durable BEFORE we bow out
+                _save_snapshot({'kind': kind, 'next': i + 1})
+                import signal
+                import sys
+                from ...resilience import (
+                    preemption_signal, clear_shutdown)
+                if preemption_signal() == signal.SIGINT:
+                    # user interrupt, not fleet preemption: snapshot
+                    # is saved, hand control back as Ctrl-C always has
+                    clear_shutdown()
+                    raise KeyboardInterrupt
+                sys.exit(PREEMPTED_EXIT_CODE)
+            if _should_save() or i == max_num - 1:
+                _save_snapshot({'kind': kind, 'next': i + 1})
+    finally:
+        if owned:
+            uninstall_shutdown()
 
 
 def train_epoch_range(max_epoch_num, save_checkpoint_inter=None):

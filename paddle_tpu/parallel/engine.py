@@ -29,6 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.tensor import Tensor
 from ..core import rng as rng_mod
 from ..distributed import env as _env
+from ..resilience import NanSentinel, finite_step, guard_update
 from .api import collect_param_shardings, make_spec
 
 __all__ = ['ParallelTrainer']
@@ -56,7 +57,8 @@ class ParallelTrainer:
     """
 
     def __init__(self, model, optimizer, loss_fn, mesh=None, strategy=None,
-                 donate=True, n_inputs=1):
+                 donate=True, n_inputs=1, nan_guard=False, nan_patience=3,
+                 nan_max_rollbacks=2):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -68,6 +70,13 @@ class ParallelTrainer:
         self._step_no = 0
         self._compiled = None
         self._eval_compiled = None
+        # divergence sentinel (resilience.NanSentinel): opt-in — the
+        # finiteness flag costs one host sync per step, and the lazy
+        # no-readback contract of step() is the default perf posture
+        self.nan_guard = bool(nan_guard)
+        self.sentinel = NanSentinel(
+            patience=nan_patience, max_rollbacks=nan_max_rollbacks) \
+            if nan_guard else None
 
         pp = (dict(self.mesh.shape).get('pp', 1)
               if self.mesh is not None else 1)
@@ -110,6 +119,13 @@ class ParallelTrainer:
         fleet/meta_parallel/pipeline_parallel.py:43."""
         from .pipeline import PipelineLayerModule
         from ..distributed.fleet.meta_parallel import PipelineLayer
+        if self.nan_guard:
+            import warnings
+            warnings.warn('nan_guard is not supported under pipeline '
+                          'parallelism yet; disabling', UserWarning,
+                          stacklevel=3)
+            self.nan_guard = False
+            self.sentinel = None
         model = self.model
         if hasattr(model, 'as_pipeline_module'):
             self._pipe = model.as_pipeline_module(pp, self.mesh)
@@ -348,6 +364,19 @@ class ParallelTrainer:
                     self._forward_loss, has_aux=True)(
                         params, buffers, key, batch)
             grads = shard_grads(grads)
+            if self.nan_guard:
+                # device-side skip (resilience.finite_step/
+                # guard_update): a non-finite loss/grad-norm step
+                # keeps the old params/opt/buffers inside the same XLA
+                # module; only the boolean crosses to the host where
+                # the sentinel's strike/rollback policy runs
+                ok = finite_step(loss, grads)
+                new_params, new_state = opt.apply_gradients(
+                    params, grads, opt_state, step_no)
+                new_params = guard_update(ok, new_params, params)
+                new_state = guard_update(ok, new_state, opt_state)
+                new_buffers = guard_update(ok, new_buffers, buffers)
+                return new_params, new_buffers, new_state, loss, ok
             new_params, new_state = opt.apply_gradients(
                 params, grads, opt_state, step_no)
             return new_params, new_buffers, new_state, loss
@@ -371,7 +400,8 @@ class ParallelTrainer:
             kwargs['in_shardings'] = (
                 p_sh, b_sh, s_sh, repl, repl) + tuple(
                     dp for _ in range(self._n_batch))
-            kwargs['out_shardings'] = (p_sh, b_sh, s_sh, repl)
+            kwargs['out_shardings'] = (p_sh, b_sh, s_sh, repl) + (
+                (repl,) if self.nan_guard else ())
         if self.donate:
             kwargs['donate_argnums'] = (0, 2)
         return jax.jit(train_step, **kwargs)
@@ -392,12 +422,49 @@ class ParallelTrainer:
             return self._pipe_step(*batch)
         vals = self._ensure_compiled(batch)
         key = rng_mod.next_key()
+        if self.nan_guard:
+            (self.params, self.buffers, self.opt_state, loss,
+             ok) = self._compiled(
+                self.params, self.buffers, self.opt_state,
+                jnp.asarray(self._step_no + 1), key, *vals)
+            ok = bool(ok)   # the one host sync nan_guard costs
+            if ok:
+                self._step_no += 1
+            if self.sentinel.observe(finite=ok) == 'rollback':
+                self._nan_rollback()
+            return loss
         self.params, self.buffers, self.opt_state, loss = self._compiled(
             self.params, self.buffers, self.opt_state,
             jnp.asarray(self._step_no + 1), key, *vals)
         self._step_no += 1
         # LR-scheduler advancement is the caller's job (hapi epoch loop)
         return loss
+
+    def _nan_rollback(self):
+        """Sentinel-demanded rollback: reload the last COMMITTED
+        sharded checkpoint (the save_checkpoint directory).  Without a
+        checkpoint there is nothing to restore — the device-side skip
+        already kept the params finite, so training simply continues
+        (and the sentinel escalates to FloatingPointError if the NaNs
+        persist across rollback budgets)."""
+        import warnings
+        mgr = getattr(self, '_ckpt_mgr', None)
+        if mgr is None:
+            warnings.warn(
+                'NanSentinel requested a rollback but no checkpoint '
+                'directory is configured (call save_checkpoint '
+                'periodically); continuing with skipped updates',
+                RuntimeWarning, stacklevel=2)
+            return False
+        mgr.wait()   # the in-flight save must commit before we read
+        got = self.restore_checkpoint(mgr.directory)
+        if got < 0:
+            warnings.warn(
+                'NanSentinel rollback found no committed checkpoint '
+                f'under {mgr.directory}; continuing with skipped '
+                'updates', RuntimeWarning, stacklevel=2)
+            return False
+        return True
 
     def op_summary(self, *batch, sorted_by='total', **kwargs):
         """Per-op table of THIS trainer's compiled train step
@@ -488,11 +555,22 @@ class ParallelTrainer:
         return mgr.save(self.train_state(), self._step_no)
 
     def restore_checkpoint(self, directory, step=None):
-        """Restore the newest (or given) checkpoint directly onto the
-        mesh; returns the restored step or -1."""
+        """Restore the newest (or given) COMMITTED checkpoint directly
+        onto the mesh; returns the restored step or -1.  Torn dirs
+        (async save killed before its manifest) are quarantined and
+        skipped — see distributed.checkpoint.CheckpointManager."""
+        import os
         from ..distributed.checkpoint import CheckpointManager
-        mgr = CheckpointManager(directory)
-        self._ckpt_mgr = mgr
+        mgr = getattr(self, '_ckpt_mgr', None)
+        if mgr is not None:
+            # drain the in-flight async save BEFORE any swap: dropping
+            # the handle would leave its manifest uncommitted forever
+            # (the newest step would read as torn) and leak the orbax
+            # checkpointer
+            mgr.wait()
+        if mgr is None or mgr.directory != os.path.abspath(directory):
+            mgr = CheckpointManager(directory)
+            self._ckpt_mgr = mgr
         state, got = mgr.restore(self.train_state(), step=step)
         if state is None:
             return -1

@@ -1,0 +1,51 @@
+"""paddle_tpu.resilience — fault-tolerant training runtime.
+
+TPU fleets preempt hosts routinely (maintenance, defrag, spot
+reclaim), and at pod scale *something* is always failing: a host dies
+mid-async-checkpoint and leaves a torn orbax directory, a transient
+NFS hiccup breaks a weight-cache read, a bad batch NaNs the loss.
+This package is the one place those failure modes are handled, and the
+rest of the stack composes with it:
+
+  manifest   verified checkpoints — a commit manifest (step, leaf
+             spec, per-file sizes + checksums) written atomically
+             AFTER the (async) save finishes; a directory without a
+             valid manifest never existed as far as restore is
+             concerned.  Used by distributed.checkpoint.
+  shutdown   GracefulShutdown — SIGTERM/SIGINT turn into a "finish
+             the step, checkpoint, exit PREEMPTED_EXIT_CODE" request;
+             distributed.elastic recognizes that exit code as a clean
+             preemption and restarts WITHOUT consuming the
+             max_restarts budget.
+  sentinel   NanSentinel — loss/grad-norm divergence policy: skip
+             non-finite updates, roll back to the last committed
+             checkpoint after K consecutive strikes.  Wired into
+             hapi.Model.fit (NanGuard callback) and
+             parallel.ParallelTrainer(nan_guard=True).
+  retry      the shared retry(fn, retries, backoff, jitter, retry_on)
+             decorator for transient host-side failures (shared-fs
+             reads, checkpoint commits) — replaces ad-hoc loops.
+
+Reference analogue: the reference framework spreads this over fleet
+elastic (etcd heartbeats), checkpoint_saver (versioned dirs) and the
+GradScaler's found_inf plumbing; here it is one subsystem.
+"""
+from .manifest import (  # noqa: F401
+    MANIFEST_NAME, write_manifest, read_manifest, verify_manifest,
+    is_committed, file_checksum, atomic_write)
+from .retry import retry  # noqa: F401
+from .shutdown import (  # noqa: F401
+    PREEMPTED_EXIT_CODE, GracefulShutdown, install_shutdown,
+    shutdown_requested, exit_if_requested, preemption_signal,
+    clear_shutdown, handler_installed, uninstall_shutdown)
+from .sentinel import NanSentinel, finite_step, guard_update  # noqa: F401
+
+__all__ = [
+    'MANIFEST_NAME', 'write_manifest', 'read_manifest',
+    'verify_manifest', 'is_committed', 'file_checksum', 'atomic_write',
+    'retry',
+    'PREEMPTED_EXIT_CODE', 'GracefulShutdown', 'install_shutdown',
+    'shutdown_requested', 'exit_if_requested', 'preemption_signal',
+    'clear_shutdown', 'handler_installed', 'uninstall_shutdown',
+    'NanSentinel', 'finite_step', 'guard_update',
+]

@@ -1,0 +1,187 @@
+"""Commit manifests: the "this checkpoint finished" marker.
+
+An async orbax save that dies mid-flight leaves a directory that LOOKS
+like a checkpoint (metadata files land early) but whose shard payloads
+are truncated or missing — and `CheckpointManager.latest_step()` used
+to happily select it.  The fix is the classic commit-record protocol:
+
+  1. write all checkpoint data (orbax, any layout),
+  2. fsync + atomically `os.replace` a manifest JSON into the dir
+     recording every file's size and checksum plus the step id.
+
+A directory is *committed* iff its manifest is present and parses;
+it is *verified* iff every recorded file exists with the recorded
+size/checksum.  Readers treat anything else as a torn save: it never
+happened.  The manifest is written by the SAME process that ran the
+save, strictly after the save barrier (`wait_until_finished`), so a
+SIGKILL anywhere in between simply yields an uncommitted dir.
+"""
+import hashlib
+import json
+import os
+import tempfile
+
+__all__ = ['MANIFEST_NAME', 'atomic_write', 'file_checksum',
+           'write_manifest', 'read_manifest', 'verify_manifest',
+           'is_committed', 'leaf_spec', 'spec_mismatches']
+
+MANIFEST_NAME = '_PADDLE_COMMIT.json'
+_FORMAT = 1
+
+
+def atomic_write(path, write_fn, mode='w', prefix='.tmp'):
+    """Crash-safe file write: tmp file in the target's directory,
+    `write_fn(f)`, flush+fsync, `os.replace`.  A crash at ANY point
+    leaves either the previous file or none — never a torn one.  The
+    shared protocol behind commit manifests and auto-checkpoint
+    snapshots."""
+    path = os.path.abspath(path)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), prefix=prefix)
+    try:
+        with os.fdopen(fd, mode) as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def file_checksum(path, algo='sha256', chunk=1 << 20):
+    """Streaming checksum — checkpoint shards can be GBs; never slurp."""
+    h = hashlib.new(algo)
+    with open(path, 'rb') as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def _walk_files(directory):
+    for root, dirs, files in os.walk(directory):
+        # deterministic order → deterministic manifests (diffable)
+        dirs.sort()
+        for f in sorted(files):
+            if f == MANIFEST_NAME:
+                continue
+            p = os.path.join(root, f)
+            yield os.path.relpath(p, directory), p
+
+
+def leaf_spec(tree):
+    """Flat {leaf-path: {shape, dtype}} of a pytree — recorded in the
+    manifest so restore can cross-check the template before touching
+    tensorstore (a wrong-model restore fails fast with a readable
+    message instead of an orbax shape error)."""
+    import jax
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    spec = {}
+    for path, v in flat:
+        key = '/'.join(str(getattr(k, 'key', getattr(k, 'idx', k)))
+                       for k in path)
+        shape = tuple(getattr(v, 'shape', ()) or ())
+        dtype = str(getattr(v, 'dtype', type(v).__name__))
+        spec[key] = {'shape': list(shape), 'dtype': dtype}
+    return spec
+
+
+def spec_mismatches(recorded, template):
+    """Compare two leaf_spec dicts -> list of human-readable diffs
+    (empty = compatible).  CheckpointManager.restore runs this before
+    handing the template to orbax, so restoring into the wrong model
+    fails with named leaves instead of a raw tensorstore shape error."""
+    out = []
+    for key in sorted(set(recorded) | set(template)):
+        a, b = recorded.get(key), template.get(key)
+        if a is None:
+            out.append(f'{key}: not in checkpoint')
+        elif b is None:
+            out.append(f'{key}: not in restore template')
+        elif list(a['shape']) != list(b['shape']) or \
+                a['dtype'] != b['dtype']:
+            out.append(
+                f'{key}: checkpoint {a["shape"]}/{a["dtype"]} vs '
+                f'template {b["shape"]}/{b["dtype"]}')
+    return out
+
+
+def write_manifest(directory, step=None, tree=None, algo='sha256',
+                   checksums=True):
+    """Scan `directory` and atomically commit its manifest.
+
+    Must be called only after the save fully finished (sync save
+    returned / async save's wait_until_finished passed).  tmp +
+    fsync + os.replace: a crash during THIS write leaves either the
+    previous manifest or none — never a torn one.
+
+    `checksums=False` records presence + sizes only: that still
+    catches every torn-write mode a crash produces (missing files,
+    truncation) without re-reading the shards — the right trade at
+    multi-GB checkpoint scale, where hashing inside the post-save
+    barrier would eat the async overlap.  Full checksums additionally
+    catch bit-level corruption.
+    """
+    directory = os.path.abspath(directory)
+    files = {}
+    for rel, p in _walk_files(directory):
+        meta = {'size': os.path.getsize(p)}
+        if checksums:
+            meta[algo] = file_checksum(p, algo)
+        files[rel] = meta
+    doc = {'format': _FORMAT, 'step': step, 'algo': algo, 'files': files}
+    if tree is not None:
+        doc['leaf_spec'] = leaf_spec(tree)
+    atomic_write(os.path.join(directory, MANIFEST_NAME),
+                 lambda f: json.dump(doc, f, indent=1, sort_keys=True),
+                 prefix='.commit_tmp')
+    return doc
+
+
+def read_manifest(directory):
+    """The parsed manifest, or None when absent/unreadable (an
+    unreadable manifest is indistinguishable from a torn commit and is
+    treated the same way)."""
+    try:
+        with open(os.path.join(directory, MANIFEST_NAME)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def is_committed(directory):
+    return read_manifest(directory) is not None
+
+
+def verify_manifest(directory, checksums=True):
+    """-> (ok, errors).  Checks every manifest-recorded file for
+    presence, size, and (optionally) checksum.  Extra files are
+    ignored — orbax versions differ in auxiliary artifacts, and extras
+    cannot corrupt a restore that only reads recorded data."""
+    directory = os.path.abspath(directory)
+    doc = read_manifest(directory)
+    if doc is None:
+        return False, ['missing or unreadable manifest '
+                       f'({MANIFEST_NAME})']
+    algo = doc.get('algo', 'sha256')
+    errors = []
+    for rel, meta in sorted(doc.get('files', {}).items()):
+        p = os.path.join(directory, rel)
+        if not os.path.isfile(p):
+            errors.append(f'{rel}: missing')
+            continue
+        size = os.path.getsize(p)
+        if size != meta.get('size'):
+            errors.append(
+                f'{rel}: size {size} != recorded {meta.get("size")}')
+            continue
+        if checksums and algo in meta:
+            got = file_checksum(p, algo)
+            if got != meta[algo]:
+                errors.append(f'{rel}: {algo} mismatch')
+    return not errors, errors

@@ -1,0 +1,58 @@
+"""Shared retry with exponential backoff + jitter.
+
+One decorator instead of per-module ad-hoc loops, so every transient
+host-side failure (shared-fs read, checkpoint commit, cache resolve)
+gets the same policy: bounded attempts, exponential backoff, decorrelated
+jitter (full-jitter — concurrent hosts retrying a shared filesystem
+must not stampede in lockstep).
+"""
+import functools
+import random
+import time
+
+__all__ = ['retry']
+
+
+def retry(fn=None, *, retries=3, backoff=0.1, max_backoff=30.0,
+          jitter=True, retry_on=(OSError,), on_retry=None,
+          sleep=time.sleep):
+    """Retry `fn` up to `retries` extra times on `retry_on` exceptions.
+
+    Usable three ways::
+
+        @retry
+        def f(...): ...
+
+        @retry(retries=5, retry_on=(OSError, TimeoutError))
+        def g(...): ...
+
+        retry(lambda: flaky(), retries=2)()   # ad-hoc call site
+
+    Attempt k (0-based) sleeps `backoff * 2**k`, capped at
+    `max_backoff`; with `jitter` the sleep is uniform in (0, that] so
+    a fleet of restarted hosts decorrelates.  The final failure
+    re-raises the last exception unchanged.  `on_retry(exc, attempt)`
+    observes each failed attempt (loggers, tests).
+    """
+    if fn is None:
+        return functools.partial(
+            retry, retries=retries, backoff=backoff,
+            max_backoff=max_backoff, jitter=jitter, retry_on=retry_on,
+            on_retry=on_retry, sleep=sleep)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        for attempt in range(retries + 1):
+            try:
+                return fn(*args, **kwargs)
+            except retry_on as e:
+                if attempt >= retries:
+                    raise
+                if on_retry is not None:
+                    on_retry(e, attempt)
+                delay = min(backoff * (2 ** attempt), max_backoff)
+                if jitter:
+                    delay = random.uniform(0, delay) or delay * 0.5
+                sleep(delay)
+
+    return wrapper

@@ -1,0 +1,119 @@
+"""NanSentinel — divergence policy for training loops.
+
+A non-finite loss or gradient norm on a TPU pod is almost never a
+one-off: by the time a human sees it in a dashboard the optimizer
+state is poisoned and days of compute follow it down.  The sentinel
+encodes the standard production response as a tiny state machine:
+
+  finite step          -> 'ok'        (strike counter resets)
+  non-finite step      -> 'skip'      (the update was/will be dropped;
+                                       the amp GradScaler's found_inf
+                                       skip composes — both count as
+                                       strikes here)
+  K consecutive skips  -> 'rollback'  (reload the last committed
+                                       checkpoint; counter resets so
+                                       the resumed run gets K fresh
+                                       strikes before re-rolling back)
+
+The sentinel is deliberately host-side and pure-Python: the cheap
+`isfinite(loss) & isfinite(grad_norm)` reduction runs inside the
+compiled step (see hapi.Model / ParallelTrainer), and only the single
+boolean crosses to the host where policy lives.
+"""
+import math
+
+__all__ = ['NanSentinel', 'finite_step', 'guard_update']
+
+
+def finite_step(loss, grads):
+    """In-graph health check: isfinite(loss) & isfinite(‖grads‖²) as
+    ONE boolean (f32 accumulation; an inf gradient overflows the
+    square into inf, a NaN propagates — both trip the flag).  Traced
+    inside compiled train steps by hapi.Model and ParallelTrainer so
+    only this boolean ever crosses to the host."""
+    import jax
+    import jax.numpy as jnp
+    leaves = jax.tree_util.tree_leaves(grads)
+    gnorm2 = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                 for g in leaves) if leaves else jnp.zeros(())
+    return jnp.isfinite(loss) & jnp.isfinite(gnorm2)
+
+
+def guard_update(ok, new, old):
+    """Select `new` when ok else `old`, leaf-wise — the device-side
+    skip: a non-finite step keeps the previous params/opt/buffers
+    inside the same XLA module (safe with donated inputs: the select
+    reads the donated buffers before the outputs alias them)."""
+    import jax
+    import jax.numpy as jnp
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(ok, a, b), new, old)
+
+
+class NanSentinel:
+    def __init__(self, patience=3, max_rollbacks=2, on_event=None):
+        """`patience`: consecutive non-finite steps before a rollback
+        is requested.  `max_rollbacks`: after this many rollbacks the
+        sentinel raises FloatingPointError instead — a run that NaNs
+        straight out of every restored checkpoint has a real bug and
+        must fail loudly, not loop forever.  `on_event(kind, info)`
+        observes 'skip'/'rollback'/'fatal' transitions."""
+        if patience < 1:
+            raise ValueError('patience must be >= 1')
+        self.patience = patience
+        self.max_rollbacks = max_rollbacks
+        self.on_event = on_event
+        self.strikes = 0
+        self.rollbacks = 0
+        self.total_skipped = 0
+
+    @staticmethod
+    def _finite(v):
+        if v is None:
+            return True
+        try:
+            return math.isfinite(float(v))
+        except (TypeError, ValueError):
+            return False
+
+    def observe(self, loss=None, grad_norm=None, finite=None):
+        """Record one step's health; -> 'ok' | 'skip' | 'rollback'.
+
+        Callers that already computed the in-graph finiteness flag pass
+        `finite=`; others pass host scalars for loss/grad_norm.
+        """
+        if finite is None:
+            finite = self._finite(loss) and self._finite(grad_norm)
+        if finite:
+            self.strikes = 0
+            return 'ok'
+        self.strikes += 1
+        self.total_skipped += 1
+        if self.strikes < self.patience:
+            if self.on_event:
+                self.on_event('skip', {'strikes': self.strikes,
+                                       'loss': loss})
+            return 'skip'
+        # patience exhausted: demand a rollback
+        self.strikes = 0
+        self.rollbacks += 1
+        if self.rollbacks > self.max_rollbacks:
+            if self.on_event:
+                self.on_event('fatal', {'rollbacks': self.rollbacks})
+            raise FloatingPointError(
+                f'training diverged: {self.patience} consecutive '
+                f'non-finite steps after {self.rollbacks - 1} '
+                'rollback(s) — refusing to loop; check LR/data/loss '
+                'scaling')
+        if self.on_event:
+            self.on_event('rollback', {'rollbacks': self.rollbacks})
+        return 'rollback'
+
+    def state_dict(self):
+        return {'strikes': self.strikes, 'rollbacks': self.rollbacks,
+                'total_skipped': self.total_skipped}
+
+    def load_state_dict(self, state):
+        self.strikes = int(state.get('strikes', 0))
+        self.rollbacks = int(state.get('rollbacks', 0))
+        self.total_skipped = int(state.get('total_skipped', 0))

@@ -1,26 +1,65 @@
 """paddle.utils.download — weight-file cache resolution.
 
 Reference analogue: /root/reference/python/paddle/utils/download.py
-(get_weights_path_from_url downloads to ~/.cache/paddle/hapi/weights).
-Zero-egress build: resolves against the local cache and raises with the
-expected path when absent (the vision/text model zoos initialize
-randomly instead of fetching pretrained weights).
+(get_weights_path_from_url downloads to ~/.cache/paddle/hapi/weights,
+with an ad-hoc DOWNLOAD_RETRY_LIMIT loop).  Zero-egress build:
+resolves against the local cache and raises with the expected path
+when absent (the vision/text model zoos initialize randomly instead of
+fetching pretrained weights).
+
+Robustness: the cache typically lives on a shared filesystem on TPU
+pods, where reads flake and concurrent writers leave half-copied
+files.  Resolution therefore verifies the md5 when one is given and
+retries through resilience.retry — the shared policy that replaced
+the reference's hand-rolled loop.
 """
 import os
+
+from ..resilience import file_checksum, retry
 
 __all__ = ['get_weights_path_from_url']
 
 WEIGHTS_HOME = os.path.expanduser('~/.cache/paddle/hapi/weights')
 
 
+class _CorruptCacheFile(OSError):
+    """md5 mismatch — retriable: a concurrent fetcher may still be
+    writing the file; stable corruption exhausts the retries and
+    surfaces as the final error."""
+
+
+@retry(retries=3, backoff=0.2, retry_on=(OSError,))
+def _verify(path, md5sum):
+    """Retried: the shared-fs read can flake, and a mismatch may be a
+    concurrent fetcher still writing — both settle on retry; stable
+    corruption exhausts the attempts."""
+    got = file_checksum(path, 'md5')
+    if got != md5sum:
+        raise _CorruptCacheFile(
+            f'{path}: md5 {got} != expected {md5sum}')
+    return path
+
+
 def get_path_from_url(url, root_dir, md5sum=None, check_exist=True):
     fname = url.split('/')[-1]
     path = os.path.join(root_dir, fname)
-    if os.path.exists(path):
+    # a missing file is NOT retried — zero egress means it cannot
+    # appear on its own, and the model zoos probe this path on every
+    # cold init (a backoff loop here would tax every random-init)
+    if not os.path.isfile(path):
+        raise RuntimeError(
+            f'{fname} not in local cache ({root_dir}) and this build '
+            f'has no egress to fetch {url}; place the file there '
+            'manually')
+    if not md5sum:
         return path
-    raise RuntimeError(
-        f'{fname} not in local cache ({root_dir}) and this build has no '
-        f'egress to fetch {url}; place the file there manually')
+    try:
+        return _verify(path, md5sum)
+    except _CorruptCacheFile as e:
+        raise RuntimeError(
+            f'{fname} in local cache ({root_dir}) is corrupt ({e}); '
+            'delete it and place a good copy — this build has no '
+            f'egress to re-fetch {url}') from e
 
 
 def get_weights_path_from_url(url, md5sum=None):

@@ -28,3 +28,16 @@ jax.config.update('jax_platforms', 'cpu')
 # f32 numerical parity, so force full precision (TPU perf paths pass bf16
 # dtypes explicitly, which this setting does not affect)
 jax.config.update('jax_default_matmul_precision', 'highest')
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        'markers',
+        'slow: long-running tests excluded from the tier-1 gate '
+        "(-m 'not slow')")
+    config.addinivalue_line(
+        'markers',
+        'faultinject: crash-recovery fault-injection tests (torn '
+        'checkpoint dirs, SIGKILL mid-save, SIGTERM preemption, NaN '
+        'rollback).  Tier-1-eligible — deliberately NOT slow: the '
+        'recovery path must stay gated on every PR')

@@ -21,7 +21,10 @@ def main():
     out_json = sys.argv[1]
     ckpt_dir = sys.argv[2]
     kill_at = int(os.environ.get('KILL_AT_STEP', '-1'))
+    term_at = int(os.environ.get('TERM_AT_STEP', '-1'))
     incarnation = int(os.environ.get('PADDLE_ELASTIC_RESTART_COUNT',
+                                     '0'))
+    preemptions = int(os.environ.get('PADDLE_ELASTIC_PREEMPT_COUNT',
                                      '0'))
 
     import paddle_tpu as paddle
@@ -43,6 +46,13 @@ def main():
     for step in acp.train_step_range(12):
         if step == kill_at and incarnation == 0:
             os.kill(os.getpid(), signal.SIGKILL)
+        if step == term_at and incarnation == 0 and preemptions == 0:
+            # simulated host preemption: SIGTERM to self.  The
+            # GracefulShutdown installed by train_step_range latches
+            # it; at this step's boundary the range saves a final
+            # snapshot and exits PREEMPTED_EXIT_CODE, which the
+            # supervisor restarts for free (no max_restarts burn)
+            os.kill(os.getpid(), signal.SIGTERM)
         x = paddle.to_tensor(xs[step % 5 * 4:(step % 5) * 4 + 4])
         y = paddle.to_tensor(ys[step % 5 * 4:(step % 5) * 4 + 4])
         loss = nn.functional.mse_loss(model(x), y)
@@ -57,6 +67,7 @@ def main():
             'weight': np.asarray(model.weight.value).ravel().tolist(),
             'bias': np.asarray(model.bias.value).ravel().tolist(),
             'incarnation': incarnation,
+            'preemptions': preemptions,
         }, f)
 
 

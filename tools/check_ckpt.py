@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""check_ckpt — verify a checkpoint directory's commit manifests.
+
+Operator-facing triage for the question "which step can I actually
+restore?" after a host died mid-save:
+
+    python tools/check_ckpt.py RUN_DIR             # summary + latest
+    python tools/check_ckpt.py RUN_DIR --no-checksums   # sizes only
+    python tools/check_ckpt.py RUN_DIR --step 120       # one step
+    python tools/check_ckpt.py RUN_DIR --quiet          # just the step
+
+Exit codes: 0 = at least one verified step exists, 1 = none do,
+2 = usage error.  Prints the latest COMMITTED+VERIFIED step on the
+last stdout line, so scripts can `$(... | tail -1)`.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.resilience import manifest as M  # noqa: E402
+
+
+def _step_dirs(directory, prefix):
+    out = []
+    for f in sorted(os.listdir(directory)):
+        tag = f[len(prefix) + 1:]
+        if f.startswith(prefix + '_') and tag.isdigit():
+            out.append((int(tag), os.path.join(directory, f)))
+    return sorted(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog='check_ckpt',
+        description='Verify commit manifests in a CheckpointManager '
+                    'directory and print the latest committed step.')
+    ap.add_argument('directory', help='checkpoint run directory')
+    ap.add_argument('--prefix', default='step',
+                    help='step-dir prefix (default: step)')
+    ap.add_argument('--step', type=int, default=None,
+                    help='verify only this step')
+    ap.add_argument('--no-checksums', action='store_true',
+                    help='skip checksum recompute (sizes/presence '
+                         'only — fast triage for TB-scale dirs)')
+    ap.add_argument('--adopt', action='store_true',
+                    help='write commit manifests for UNCOMMITTED step '
+                         'dirs (migrates checkpoints from before '
+                         'verified commits — only run this on dirs '
+                         'you trust to be complete)')
+    ap.add_argument('--quiet', action='store_true',
+                    help='print only the latest committed step')
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.directory):
+        print(f'error: {args.directory} is not a directory',
+              file=sys.stderr)
+        return 2
+
+    dirs = _step_dirs(args.directory, args.prefix)
+    if args.step is not None:
+        dirs = [(s, p) for s, p in dirs if s == args.step]
+        if not dirs:
+            print(f'error: no {args.prefix}_{args.step} under '
+                  f'{args.directory}', file=sys.stderr)
+            return 1
+
+    latest_ok = -1
+    for s, p in dirs:
+        doc = M.read_manifest(p)
+        if doc is None and args.adopt:
+            M.write_manifest(p, step=s)
+            doc = M.read_manifest(p)
+            if not args.quiet:
+                print(f'{args.prefix}_{s}: adopted (manifest written)')
+        if doc is None:
+            status = 'UNCOMMITTED (no manifest — torn or in-flight)'
+        else:
+            ok, errors = M.verify_manifest(
+                p, checksums=not args.no_checksums)
+            if ok:
+                status = 'ok ({} files{})'.format(
+                    len(doc.get('files', {})),
+                    ', sizes only' if args.no_checksums else '')
+                latest_ok = max(latest_ok, s)
+            else:
+                status = 'CORRUPT: ' + '; '.join(errors[:5])
+        if not args.quiet:
+            print(f'{args.prefix}_{s}: {status}')
+
+    torn = [f for f in os.listdir(args.directory) if '.torn-' in f]
+    if torn and not args.quiet:
+        print(f'quarantined: {", ".join(sorted(torn))}')
+
+    if not args.quiet:
+        print('latest committed step:', latest_ok)
+    else:
+        print(latest_ok)
+    return 0 if latest_ok >= 0 else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())
