@@ -20,7 +20,7 @@ from ..core.tensor import Tensor
 from ..core.dtype import convert_dtype
 
 __all__ = ['auto_cast', 'amp_guard', 'decorate', 'amp_decorate',
-           'GradScaler', 'WHITE_LIST', 'BLACK_LIST']
+           'GradScaler', 'WHITE_LIST', 'BLACK_LIST', 'audit']
 
 # Ops whose FLOPs dominate and which the MXU runs natively in bf16.
 # Mirrors the reference's white list {conv2d, matmul, mul} plus our op
@@ -149,6 +149,20 @@ def amp_state():
     """(enabled, level, dtype) — read by paddle_tpu.jit so compiled
     traces apply the same policy."""
     return _state
+
+
+def audit():
+    """Eager mixed-precision audit (paddle_tpu.analysis.amp_audit):
+
+        with amp.audit() as a, amp.auto_cast():
+            model(x)
+        print(a.report())   # amp-promotion findings: f32 operands the
+                            # hook re-casts every step
+
+    The jaxpr-level twin (f32 creep inside compiled steps) runs via
+    analysis.lint / to_static(check=...) / Model.prepare(lint=...)."""
+    from ..analysis import amp_audit
+    return amp_audit()
 
 
 def decorate(models, optimizers=None, level='O1', dtype='bfloat16',

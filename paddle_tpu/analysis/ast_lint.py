@@ -1,0 +1,312 @@
+"""AST pre-trace linter for dy2static sources + suppression comments.
+
+The jaxpr rules see what XLA will compile; this pass sees what the
+tracer will *choke on or silently sync over* before a jaxpr exists:
+``float(loss)`` / ``np.asarray(x)`` / ``x.numpy()`` / ``x.item()``
+on traced values force a device→host round trip per step (or raise a
+TracerConversion error under jit).  It runs on plain source text — no
+imports, no execution — so the CLI can sweep whole directories
+(the tier-1 self-lint gate over examples/ and models/ does exactly
+that).
+
+Scope
+-----
+``scope='traced'`` (default) lints only code the framework will
+trace: functions decorated with ``to_static``/``jit``/``pjit``,
+``forward`` methods of Layer subclasses, functions passed by name to
+a ``jit(...)`` call, and everything nested inside those.  ``'all'``
+lints every function — the audit mode for step-loop host code (this
+is the mode that flagged the per-step ``float(loss)`` in
+hapi/model.py's train_batch, fixed in the same PR that added it).
+
+Suppression
+-----------
+``# tpu-lint: disable=rule-a,rule-b`` (or bare ``disable`` for all
+rules) on the finding's line — or on the enclosing ``def`` line to
+suppress for a whole function.  The same comments suppress jaxpr-rule
+findings whose source location lands on the commented line
+(apply_suppressions).
+"""
+import ast
+import linecache
+import re
+
+from .findings import Finding, HIGH, INFO
+
+__all__ = ['lint_source', 'lint_file', 'lint_callable',
+           'apply_suppressions', 'suppressed_rules_on_line']
+
+_TRACED_DECORATORS = {'to_static', 'jit', 'pjit'}
+_NUMPY_MODULES = {'np', 'numpy', 'onp'}
+_NUMPY_SYNC_FUNCS = {'asarray', 'array'}
+_TENSOR_SYNC_METHODS = {'numpy', 'item', 'tolist'}
+_BUILTIN_CASTS = {'float', 'int', 'bool'}
+
+_SUPPRESS_RE = re.compile(
+    r'#\s*tpu-lint:\s*disable(?:=([A-Za-z0-9_,-]+))?')
+
+
+def suppressed_rules_on_line(file, line):
+    """Set of rule ids disabled by a comment on `file`:`line`
+    (``{'*'}`` when the bare form disables everything); empty set when
+    no comment."""
+    if not file or not line:
+        return set()
+    text = linecache.getline(file, line)
+    m = _SUPPRESS_RE.search(text)
+    if not m:
+        return set()
+    if m.group(1) is None:
+        return {'*'}
+    return {r.strip() for r in m.group(1).split(',') if r.strip()}
+
+
+def _is_suppressed(rule, file, line, extra_lines=()):
+    for ln in (line,) + tuple(extra_lines):
+        rules = suppressed_rules_on_line(file, ln)
+        if '*' in rules or rule in rules:
+            return True
+    return False
+
+
+def apply_suppressions(findings):
+    """Drop findings whose source line (in the real file) carries a
+    matching ``# tpu-lint: disable`` comment."""
+    return [f for f in findings
+            if not _is_suppressed(f.rule, f.file, f.line)]
+
+
+def _dotted_last(node):
+    """Last attribute segment of a decorator/callable expression."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _base_names(cls):
+    out = []
+    for b in cls.bases:
+        n = _dotted_last(b)
+        if n:
+            out.append(n)
+    return out
+
+
+class _Scoper(ast.NodeVisitor):
+    """Collect the set of FunctionDef nodes considered 'traced'."""
+
+    def __init__(self, tree):
+        self.traced = set()
+        self._jit_arg_names = set()
+        # pass 1: names handed to jit(...) calls anywhere
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    _dotted_last(node.func) in ('jit', 'to_static'):
+                for a in node.args[:1]:
+                    if isinstance(a, ast.Name):
+                        self._jit_arg_names.add(a.id)
+        # pass 2: mark defs
+        self._visit_block(tree.body, in_layer=False)
+
+    def _visit_block(self, body, in_layer):
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                layer = any(b in ('Layer', 'Module')
+                            for b in _base_names(node)) or \
+                    any(b.endswith('Layer') for b in _base_names(node))
+                self._visit_block(node.body, in_layer=layer)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                decs = {_dotted_last(d) for d in node.decorator_list}
+                if (decs & _TRACED_DECORATORS
+                        or node.name in self._jit_arg_names
+                        or (in_layer and node.name == 'forward')):
+                    self.traced.add(node)
+                    self._mark_nested(node)
+                else:
+                    self._visit_block(node.body, in_layer=False)
+
+    def _mark_nested(self, fn):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.traced.add(node)
+
+
+def _def_spans(tree):
+    """(def_line, end_line) of every function definition in `tree` —
+    the lines whose ``# tpu-lint: disable`` comments suppress findings
+    anywhere inside that function (nested defs included; FunctionDef
+    .lineno is the `def` keyword's line, not a decorator's)."""
+    spans = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            spans.append((node.lineno,
+                          getattr(node, 'end_lineno', node.lineno)))
+    return spans
+
+
+def _enclosing_def_lines(spans, line):
+    return tuple(s for s, e in spans if line is not None and
+                 s <= line <= e)
+
+
+def _plausibly_traced_arg(node):
+    """Would this expression plausibly hold a tensor?  Literals and
+    builtin calls (len(xs), float('nan')) are excluded; names,
+    attributes, indexing, arithmetic and METHOD calls (x.mean()) are
+    plausible."""
+    if isinstance(node, (ast.Name, ast.Attribute, ast.Subscript)):
+        return True
+    if isinstance(node, ast.BinOp):
+        return (_plausibly_traced_arg(node.left)
+                or _plausibly_traced_arg(node.right))
+    if isinstance(node, ast.UnaryOp):
+        return _plausibly_traced_arg(node.operand)
+    if isinstance(node, ast.Call) and isinstance(node.func,
+                                                 ast.Attribute):
+        return True        # method call: x.mean(), loss.sum(), ...
+    return False
+
+
+def _check_call(node, findings, filename):
+    fname = _dotted_last(node.func)
+    line = node.lineno
+    # float(x) / int(x) / bool(x) on a plausible tensor
+    if isinstance(node.func, ast.Name) and \
+            node.func.id in _BUILTIN_CASTS and len(node.args) == 1 and \
+            _plausibly_traced_arg(node.args[0]):
+        findings.append(Finding(
+            'host-sync', HIGH,
+            f'{node.func.id}(...) on a (possibly traced) tensor: '
+            'inside a traced step this is a device->host sync per call '
+            '(or a TracerConversion error under jit). Keep the value '
+            'on device (jnp) and materialize only at log/epoch '
+            'boundaries.',
+            file=filename, line=line, origin='ast'))
+        return
+    # np.asarray / np.array
+    if isinstance(node.func, ast.Attribute) and \
+            fname in _NUMPY_SYNC_FUNCS and \
+            isinstance(node.func.value, ast.Name) and \
+            node.func.value.id in _NUMPY_MODULES and node.args and \
+            _plausibly_traced_arg(node.args[0]):
+        findings.append(Finding(
+            'host-sync', HIGH,
+            f'np.{fname}(...) on a (possibly traced) tensor pulls it '
+            'to the host. Use jnp on device, or materialize at '
+            'log/epoch boundaries.',
+            file=filename, line=line, origin='ast'))
+        return
+    # x.numpy() / x.item() / x.tolist()
+    if isinstance(node.func, ast.Attribute) and \
+            fname in _TENSOR_SYNC_METHODS and not node.args:
+        findings.append(Finding(
+            'host-sync', HIGH,
+            f'.{fname}() forces a device->host sync; inside a traced '
+            'function it fails under jit. Stay in jnp, or move the '
+            'readback to a log boundary.',
+            file=filename, line=line, origin='ast'))
+        return
+    # bare print of (possibly) traced values
+    if isinstance(node.func, ast.Name) and node.func.id == 'print' \
+            and any(_plausibly_traced_arg(a) for a in node.args):
+        findings.append(Finding(
+            'host-sync', INFO,
+            'print() in traced code runs at trace time only (and '
+            'syncs if it formats device values). Use '
+            'jax.debug.print for runtime prints.',
+            file=filename, line=line, origin='ast'))
+
+
+def lint_source(src, filename='<source>', scope='traced', disable=(),
+                apply_suppress=True):
+    """Lint python source text; returns a list of Findings.
+
+    scope='traced': only functions the framework will trace (see
+    module docstring).  scope='all': every function — audit mode for
+    host-side step loops.  apply_suppress=False skips the in-pass
+    suppression check — for callers whose line numbers are RELATIVE
+    to a snippet (lint_callable) and must re-anchor before checking
+    comments against the real file."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding('parse-error', INFO,
+                        f'could not parse: {e}', file=filename,
+                        line=getattr(e, 'lineno', None), origin='ast')]
+    if scope == 'all':
+        targets = [n for n in ast.walk(tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        if not targets:
+            targets = [tree]        # lint module-level statements too
+    else:
+        targets = sorted(_Scoper(tree).traced, key=lambda n: n.lineno)
+
+    findings = []
+    seen = set()
+    spans = _def_spans(tree)
+    for fn in targets:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and id(node) not in seen:
+                seen.add(id(node))
+                before = len(findings)
+                _check_call(node, findings, filename)
+                if not apply_suppress:
+                    continue
+                # line-level + enclosing-def-level suppression (every
+                # def whose span contains the finding — nested defs
+                # included), checked against the real file
+                for f in findings[before:]:
+                    if _is_suppressed(
+                            f.rule, filename, f.line,
+                            _enclosing_def_lines(spans, f.line)):
+                        findings.remove(f)
+    return findings
+
+
+def lint_file(path, scope='traced', disable=()):
+    with open(path, 'r', encoding='utf-8') as fh:
+        src = fh.read()
+    linecache.checkcache(path)
+    return lint_source(src, filename=path, scope=scope, disable=disable)
+
+
+def lint_callable(fn, scope='traced', disable=()):
+    """AST-lint a live callable's source (best effort: decorated or
+    dynamically-generated functions without retrievable source yield
+    no findings)."""
+    import inspect
+    import textwrap
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        path = inspect.getsourcefile(fn)
+        _, base_line = inspect.getsourcelines(fn)
+    except (OSError, TypeError):
+        return []
+    # suppressions are deferred (apply_suppress=False): the snippet's
+    # line numbers are relative, so checking comments against the real
+    # file before re-anchoring would read the WRONG lines
+    findings = lint_source(src, filename=path or '<source>',
+                           scope='all', disable=disable,
+                           apply_suppress=False)
+    # re-anchor lines (and the def spans used for function-level
+    # suppression) to the real file; base_line points at the first
+    # snippet line — a decorator when present — while _def_spans
+    # reports the actual `def` lines
+    try:
+        spans = [(s + base_line - 1, e + base_line - 1)
+                 for s, e in _def_spans(ast.parse(src))]
+    except SyntaxError:       # pragma: no cover - parsed above already
+        spans = []
+    for f in findings:
+        if f.line is not None:
+            f.line = f.line + base_line - 1
+    return [f for f in findings
+            if not _is_suppressed(
+                f.rule, f.file, f.line,
+                _enclosing_def_lines(spans, f.line))]

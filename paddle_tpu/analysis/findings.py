@@ -1,0 +1,155 @@
+"""Finding / LintReport — the result types of the TPU lint pass.
+
+A Finding is one diagnosed hazard: a rule id (stable, kebab-case — the
+thing suppression comments and ``disable=`` lists name), a severity
+(``high`` > ``warn`` > ``info``), a human message, and the most precise
+source location the analyzer could recover (jaxpr equations carry
+source_info; AST findings carry exact lines).  LintReport aggregates
+findings for one lint run and renders them for humans (str), machines
+(to_json) and gates (max_severity / raise_for).
+"""
+import json
+
+__all__ = ['Finding', 'LintReport', 'LintError', 'LintWarning',
+           'HIGH', 'WARN', 'INFO', 'SEVERITIES']
+
+HIGH = 'high'
+WARN = 'warn'
+INFO = 'info'
+SEVERITIES = (INFO, WARN, HIGH)
+_ORDER = {INFO: 0, WARN: 1, HIGH: 2}
+
+
+class LintWarning(UserWarning):
+    """Category used when findings are emitted as warnings — lets users
+    ``warnings.filterwarnings`` the lint stream independently."""
+
+
+class LintError(RuntimeError):
+    """Raised by emit(mode='error') / LintReport.raise_for when
+    findings at or above the gating severity exist."""
+
+    def __init__(self, message, report=None):
+        super().__init__(message)
+        self.report = report
+
+
+class Finding:
+    """One diagnosed hazard."""
+
+    __slots__ = ('rule', 'severity', 'message', 'file', 'line', 'origin')
+
+    def __init__(self, rule, severity, message, file=None, line=None,
+                 origin='jaxpr'):
+        assert severity in SEVERITIES, severity
+        self.rule = rule
+        self.severity = severity
+        self.message = message
+        self.file = file
+        self.line = line
+        self.origin = origin    # 'jaxpr' | 'ast' | 'runtime'
+
+    @property
+    def location(self):
+        if self.file and self.line:
+            return f'{self.file}:{self.line}'
+        return self.file or ''
+
+    def to_dict(self):
+        return {'rule': self.rule, 'severity': self.severity,
+                'message': self.message, 'file': self.file,
+                'line': self.line, 'origin': self.origin}
+
+    def __str__(self):
+        loc = self.location
+        loc = f'{loc}: ' if loc else ''
+        return f'[{self.severity}] {self.rule}: {loc}{self.message}'
+
+    def __repr__(self):
+        return f'Finding({self!s})'
+
+
+def _rank(sev):
+    return _ORDER[sev]
+
+
+class LintReport:
+    """Findings of one lint run (one step function / one file set)."""
+
+    def __init__(self, findings=None, name=None):
+        self.findings = list(findings or [])
+        self.name = name
+
+    # -- aggregation ---------------------------------------------------------
+    def extend(self, more):
+        self.findings.extend(
+            more.findings if isinstance(more, LintReport) else more)
+        return self
+
+    def at_least(self, severity):
+        """Findings at or above `severity`."""
+        k = _rank(severity)
+        return [f for f in self.findings if _rank(f.severity) >= k]
+
+    @property
+    def high(self):
+        return [f for f in self.findings if f.severity == HIGH]
+
+    @property
+    def warnings(self):
+        return [f for f in self.findings if f.severity == WARN]
+
+    @property
+    def max_severity(self):
+        if not self.findings:
+            return None
+        return max((f.severity for f in self.findings), key=_rank)
+
+    def __bool__(self):
+        return bool(self.findings)
+
+    def __len__(self):
+        return len(self.findings)
+
+    def __iter__(self):
+        return iter(self.findings)
+
+    # -- gates ---------------------------------------------------------------
+    def raise_for(self, severity=HIGH):
+        """Raise LintError when findings at/above `severity` exist."""
+        bad = self.at_least(severity)
+        if bad:
+            raise LintError(self.render(bad), report=self)
+        return self
+
+    # -- rendering -----------------------------------------------------------
+    def counts(self):
+        c = {s: 0 for s in SEVERITIES}
+        for f in self.findings:
+            c[f.severity] += 1
+        return c
+
+    def summary(self):
+        c = self.counts()
+        head = f'tpu-lint[{self.name}]' if self.name else 'tpu-lint'
+        if not self.findings:
+            return f'{head}: clean'
+        return (f'{head}: {c[HIGH]} high, {c[WARN]} warn, '
+                f'{c[INFO]} info')
+
+    def render(self, findings=None):
+        fs = self.findings if findings is None else findings
+        lines = [self.summary()]
+        lines += [f'  {f}' for f in sorted(
+            fs, key=lambda f: -_rank(f.severity))]
+        return '\n'.join(lines)
+
+    def __str__(self):
+        return self.render()
+
+    def to_json(self, indent=None):
+        return json.dumps({
+            'name': self.name,
+            'counts': self.counts(),
+            'findings': [f.to_dict() for f in self.findings],
+        }, indent=indent)

@@ -1,0 +1,441 @@
+"""jaxpr-level lint rules + the rule registry.
+
+Each rule is a generator ``rule(ctx) -> yields Finding`` registered
+under a stable kebab-case id (the id users put in suppression comments
+and ``disable=`` lists).  Rules only READ the traced jaxpr — no device
+execution — and every finding carries the best source location jax's
+source_info gives us.
+
+Shipped rules
+-------------
+recompile-hazard   Python scalars / weak-typed leaves in the step
+                   signature, and shapes that vary across observed
+                   signatures: each variant is a full XLA recompile.
+host-sync          host callbacks compiled into the step
+                   (pure_callback/io_callback — an XLA→host round trip
+                   per step; debug_callback reported as info).
+replicated-giant   constant-derived intermediates above a byte
+                   threshold with no sharding constraint while a Mesh
+                   is active: XLA materializes them replicated on
+                   EVERY device.
+amp-promotion      matmul/conv operands upcast bf16→f32 before the
+                   dot (the MXU then runs the slow f32 path — use
+                   preferred_element_type) and non-weak f32 constants
+                   that drag bf16 intermediates up to f32.
+donation-violation donated buffers with no same-shape/dtype output to
+                   alias: XLA frees them, the caller's arrays die, and
+                   the donation saves nothing.
+constant-capture   large arrays baked into the jaxpr as consts —
+                   recompiled per value and replicated into the
+                   module instead of fed as arguments.
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import walker
+from .findings import Finding, HIGH, WARN, INFO
+
+__all__ = ['RULES', 'register_rule', 'RuleContext', 'DEFAULT_THRESHOLDS',
+           'run_rules']
+
+DEFAULT_THRESHOLDS = {
+    # replicated-giant: bytes of a constant-derived unsharded
+    # intermediate under an active mesh (64 MiB ≈ a [4096, 4096] f32)
+    'replicated_bytes': 64 << 20,
+    # constant-capture: bytes of a captured const worth flagging /
+    # escalating to high severity
+    'const_bytes': 1 << 20,
+    'const_bytes_high': 128 << 20,
+}
+
+_LOW_PRECISION = (jnp.bfloat16, jnp.float16)
+
+
+class RuleContext:
+    """Everything a rule may inspect for one lint run."""
+
+    def __init__(self, closed, *, mesh=None, donate_argnums=(),
+                 arg_leaf_ranges=None, python_scalars=None,
+                 signatures=None, thresholds=None, name=None):
+        self.closed = closed                  # ClosedJaxpr
+        self.jaxpr = closed.jaxpr
+        self.consts = closed.consts
+        self.mesh = mesh
+        self.donate_argnums = tuple(donate_argnums or ())
+        # [(start, stop)] flat-invar index range of each example arg
+        self.arg_leaf_ranges = arg_leaf_ranges or []
+        # [(arg_index, value)] example args passed as Python scalars
+        self.python_scalars = python_scalars or []
+        # optional [(shape-tuple, ...)] per observed call signature
+        self.signatures = signatures
+        self.thresholds = dict(DEFAULT_THRESHOLDS)
+        self.thresholds.update(thresholds or {})
+        self.name = name
+
+    def walk(self):
+        return walker.walk(self.jaxpr)
+
+    def producer_map(self):
+        """var -> producing eqn over the whole (nested) jaxpr."""
+        prod = {}
+        for _, eqn in self.walk():
+            for ov in eqn.outvars:
+                prod[ov] = eqn
+        return prod
+
+    def arg_of_invar(self, invar_index):
+        for argpos, (start, stop) in enumerate(self.arg_leaf_ranges):
+            if start <= invar_index < stop:
+                return argpos
+        return None
+
+
+RULES = {}
+
+
+def register_rule(rule_id, severity):
+    """Register ``fn(ctx) -> iterable[Finding]`` under `rule_id`.
+    `severity` documents the rule's default level (rules may yield
+    other levels for sub-cases)."""
+    def deco(fn):
+        RULES[rule_id] = (severity, fn)
+        fn.rule_id = rule_id
+        return fn
+    return deco
+
+
+def run_rules(ctx, disable=()):
+    out = []
+    for rule_id, (_, fn) in RULES.items():
+        if rule_id in disable:
+            continue
+        out.extend(fn(ctx))
+    return out
+
+
+def _loc(eqn):
+    return walker.eqn_location(eqn)
+
+
+def _fmt_aval(aval):
+    try:
+        return aval.str_short()
+    except Exception:
+        return str(aval)
+
+
+# -- recompile-hazard ---------------------------------------------------------
+
+def scalar_arg_findings(python_scalars, name=None):
+    """The shared Python-scalar-in-signature findings — used by the
+    jaxpr rule (ctx.python_scalars) AND by to_static(check=) for the
+    scalars its own cache closes over as static values.  ONE place
+    owns the severity mapping (float: unbounded values, HIGH; int:
+    usually bounded sizes, WARN; bool: two variants at most, INFO)."""
+    for argpos, val in python_scalars:
+        kind = type(val).__name__
+        sev = HIGH if isinstance(val, float) else \
+            (INFO if isinstance(val, bool) else WARN)
+        yield Finding(
+            'recompile-hazard', sev,
+            f'argument {argpos} of {name or "the step"} is a Python '
+            f'{kind} ({val!r}): jit treats it as a static constant, so '
+            'every distinct value triggers a full retrace + XLA '
+            'recompile. Pass it as a jnp/np array (traced) or mark it '
+            'static deliberately.',
+            origin='jaxpr')
+
+
+@register_rule('recompile-hazard', HIGH)
+def recompile_hazard(ctx):
+    """Step-signature elements that fork the jit cache."""
+    yield from scalar_arg_findings(ctx.python_scalars, ctx.name)
+    scalar_args = {i for i, _ in ctx.python_scalars}
+    for i, invar in enumerate(ctx.jaxpr.invars):
+        aval = getattr(invar, 'aval', None)
+        if aval is not None and getattr(aval, 'weak_type', False):
+            argpos = ctx.arg_of_invar(i)
+            if argpos in scalar_args:
+                continue    # already reported as a Python scalar
+            where = f'argument {argpos}' if argpos is not None \
+                else f'input leaf {i}'
+            yield Finding(
+                'recompile-hazard', WARN,
+                f'{where} is a weak-typed {_fmt_aval(aval)} leaf: '
+                'weak/strong dtype mismatches fork the jit cache '
+                '(one compile per flavor). Build it with an explicit '
+                'dtype, e.g. jnp.asarray(x, jnp.float32).',
+                origin='jaxpr')
+    if ctx.signatures and len(ctx.signatures) > 1:
+        arities = {len(s) for s in ctx.signatures}
+        if len(arities) == 1:
+            n = arities.pop()
+            for argpos in range(n):
+                shapes = {tuple(s[argpos]) for s in ctx.signatures}
+                if len(shapes) > 1:
+                    pretty = sorted(shapes)[:4]
+                    yield Finding(
+                        'recompile-hazard', HIGH,
+                        f'argument {argpos} shape varies across observed '
+                        f'step signatures ({pretty}{"..." if len(shapes) > 4 else ""}): '
+                        'each new shape is a full recompile. Pad or '
+                        'bucket batches to a fixed set of shapes '
+                        '(drop_last=True for ragged final batches).',
+                        origin='jaxpr')
+
+
+# -- host-sync ----------------------------------------------------------------
+
+_SYNC_PRIMS = {'pure_callback': HIGH, 'io_callback': HIGH,
+               'debug_callback': INFO}
+
+
+@register_rule('host-sync', HIGH)
+def host_sync(ctx):
+    """Host callbacks compiled into the step."""
+    for _, eqn in ctx.walk():
+        sev = _SYNC_PRIMS.get(eqn.primitive.name)
+        if sev is None:
+            continue
+        f, l = _loc(eqn)
+        if eqn.primitive.name == 'debug_callback':
+            msg = ('debug callback inside the compiled step: it runs '
+                   'on the host each execution — fine for debugging, '
+                   'remove for production steps.')
+        else:
+            msg = (f'{eqn.primitive.name} inside the compiled step: '
+                   'XLA stalls the device and round-trips to the host '
+                   'on EVERY step. Move the host work to epoch/log '
+                   'boundaries or express it in jnp.')
+        yield Finding('host-sync', sev, msg, file=f, line=l,
+                      origin='jaxpr')
+
+
+# -- replicated-giant ---------------------------------------------------------
+
+@register_rule('replicated-giant', HIGH)
+def replicated_giant(ctx):
+    """Giant constant-derived intermediates with a Mesh active.
+
+    XLA's SPMD partitioner shards values whose lineage reaches a
+    sharded input, but values derived ONLY from constants/literals
+    (iota position grids, jnp.ones/tril masks, baked tables) are
+    materialized replicated on every device unless explicitly
+    constrained."""
+    if ctx.mesh is None:
+        return
+    threshold = ctx.thresholds['replicated_bytes']
+    n_dev = 1
+    for v in dict(getattr(ctx.mesh, 'shape', {}) or {}).values():
+        n_dev *= v
+
+    # One dependency graph across ALL nesting levels.  Exact wiring of
+    # sub-jaxpr invars/outvars differs per primitive (scan carries,
+    # cond branches, pjit 1:1); the conservative superset — sub invars
+    # depend on all eqn inputs, eqn outputs depend on all sub outputs
+    # — is sound for both analyses below.
+    deps = {}           # var -> set of vars it is computed from
+    located = []        # (eqn, outvar) flag candidates
+    const_roots = set(ctx.jaxpr.constvars)
+    sync_invars = []    # inputs of every sharding_constraint anywhere
+    for parent, eqn in ctx.walk():
+        const_roots.update(parent.constvars)
+        ins = {v for v in eqn.invars if not walker.is_literal(v)}
+        subs = list(walker.subjaxprs(eqn))
+        sub_outs = {v for s in subs for v in s.outvars
+                    if not walker.is_literal(v)}
+        for s in subs:
+            for iv in s.invars:
+                deps.setdefault(iv, set()).update(ins)
+        for ov in eqn.outvars:
+            deps.setdefault(ov, set()).update(ins | sub_outs)
+        if eqn.primitive.name == 'sharding_constraint':
+            sync_invars.extend(ins)
+        else:
+            located.extend((eqn, ov) for ov in eqn.outvars)
+
+    # constant-derived: depends on nothing fed through the top invars
+    top_in = set(ctx.jaxpr.invars)
+    derived = set(const_roots)
+    changed = True
+    while changed:
+        changed = False
+        for v, ds in deps.items():
+            if v not in derived and v not in top_in and \
+                    all(d in derived for d in ds):
+                derived.add(v)
+                changed = True
+    # transitively feeding a sharding_constraint: XLA propagates the
+    # requested sharding backward through the producing fusion
+    constrained = set()
+    frontier = list(sync_invars)
+    while frontier:
+        v = frontier.pop()
+        if v in constrained:
+            continue
+        constrained.add(v)
+        frontier.extend(deps.get(v, ()))
+
+    outset = set(ctx.jaxpr.outvars)
+    for eqn, ov in located:
+        nbytes = walker.aval_bytes(ov.aval)
+        if (nbytes >= threshold and ov in derived
+                and ov not in constrained and ov not in outset):
+            f, l = _loc(eqn)
+            yield Finding(
+                'replicated-giant', HIGH,
+                f'{_fmt_aval(ov.aval)} ({nbytes / (1 << 20):.0f} MiB) '
+                'is derived only from constants and carries no '
+                f'sharding constraint: with the active {n_dev}-device '
+                'mesh it is replicated into EVERY device\'s HBM. Wrap '
+                'it in jax.lax.with_sharding_constraint or derive it '
+                'from a sharded input.',
+                file=f, line=l, origin='jaxpr')
+
+
+# -- amp-promotion ------------------------------------------------------------
+
+_MATMUL_PRIMS = {'dot_general', 'conv_general_dilated'}
+
+
+@register_rule('amp-promotion', WARN)
+def amp_promotion(ctx):
+    """f32 creep inside low-precision regions."""
+    prod = ctx.producer_map()
+
+    def upcast_of(v):
+        """The convert_element_type eqn that made `v` f32 from a
+        low-precision value, else None."""
+        e = prod.get(v)
+        if e is None or e.primitive.name != 'convert_element_type':
+            return None
+        src = e.invars[0]
+        src_dtype = getattr(getattr(src, 'aval', None), 'dtype', None)
+        dst_dtype = getattr(v.aval, 'dtype', None)
+        if src_dtype in _LOW_PRECISION and dst_dtype == jnp.float32:
+            return e
+        return None
+
+    seen_lines = set()
+    for _, eqn in ctx.walk():
+        if eqn.primitive.name in _MATMUL_PRIMS:
+            operands = [v for v in eqn.invars if not walker.is_literal(v)]
+            ups = [upcast_of(v) for v in operands]
+            # flag only when EVERY operand was upcast from low
+            # precision: that matmul could have run on the fast
+            # bf16 MXU path with an f32 accumulator; a genuinely-f32
+            # operand (softmax weights etc.) legitimately forces f32
+            if operands and all(u is not None for u in ups):
+                f, l = _loc(ups[0])
+                if (f, l) in seen_lines:
+                    continue
+                seen_lines.add((f, l))
+                yield Finding(
+                    'amp-promotion', WARN,
+                    f'{eqn.primitive.name} operands are upcast '
+                    'bf16/f16 -> f32 before the contraction: the MXU '
+                    'then runs the ~8x slower f32 path and HBM reads '
+                    'double. Keep operands in the low dtype and pass '
+                    'preferred_element_type=jnp.float32 for the f32 '
+                    'accumulator.',
+                    file=f, line=l, origin='jaxpr')
+            continue
+        # f32 literal dragging a low-precision value up to f32
+        out_dtypes = [getattr(getattr(ov, 'aval', None), 'dtype', None)
+                      for ov in eqn.outvars]
+        if not any(d == jnp.float32 for d in out_dtypes):
+            continue
+        lit_f32 = any(
+            walker.is_literal(v)
+            and getattr(v.aval, 'dtype', None) == jnp.float32
+            and not getattr(v.aval, 'weak_type', False)
+            for v in eqn.invars)
+        # the promoted operand is either still low precision or was
+        # just upcast by the promotion's inserted convert_element_type
+        has_low = any(
+            not walker.is_literal(v)
+            and (getattr(getattr(v, 'aval', None), 'dtype', None)
+                 in _LOW_PRECISION or upcast_of(v) is not None)
+            for v in eqn.invars)
+        if lit_f32 and has_low:
+            f, l = _loc(eqn)
+            yield Finding(
+                'amp-promotion', WARN,
+                f'non-weak f32 constant in `{eqn.primitive.name}` '
+                'promotes a bf16/f16 intermediate to f32 — the rest '
+                'of the chain then runs f32. Use a Python literal '
+                '(weak-typed) or cast the constant to the low dtype.',
+                file=f, line=l, origin='jaxpr')
+
+
+# -- donation-violation -------------------------------------------------------
+
+@register_rule('donation-violation', HIGH)
+def donation_violation(ctx):
+    """Donated inputs XLA cannot alias to any output."""
+    if not ctx.donate_argnums or not ctx.arg_leaf_ranges:
+        return
+    # multiset of output (shape, dtype) available for aliasing
+    avail = {}
+    for ov in ctx.jaxpr.outvars:
+        aval = getattr(ov, 'aval', None)
+        key = (tuple(getattr(aval, 'shape', ())),
+               str(getattr(aval, 'dtype', '?')))
+        avail[key] = avail.get(key, 0) + 1
+    invars = ctx.jaxpr.invars
+    for argpos in ctx.donate_argnums:
+        if argpos >= len(ctx.arg_leaf_ranges):
+            continue
+        start, stop = ctx.arg_leaf_ranges[argpos]
+        for i in range(start, stop):
+            aval = invars[i].aval
+            key = (tuple(aval.shape), str(aval.dtype))
+            if avail.get(key, 0) > 0:
+                avail[key] -= 1
+                continue
+            yield Finding(
+                'donation-violation', HIGH,
+                f'donated argument {argpos} leaf {_fmt_aval(aval)} has '
+                'no same-shape/dtype output to alias: XLA frees the '
+                'buffer, the caller\'s array is dead after the call '
+                '(reading it raises), and the donation saved no '
+                'memory. Return an updated value of the same '
+                'shape/dtype or stop donating this argument.',
+                origin='jaxpr')
+
+
+# -- constant-capture ---------------------------------------------------------
+
+@register_rule('constant-capture', WARN)
+def constant_capture(ctx):
+    """Large arrays closed over and baked into the jaxpr."""
+    threshold = ctx.thresholds['const_bytes']
+    high_at = ctx.thresholds['const_bytes_high']
+    # first use of each constvar gives the best source location
+    first_use = {}
+    for _, eqn in ctx.walk():
+        for v in eqn.invars:
+            if not walker.is_literal(v) and v not in first_use:
+                first_use[v] = eqn
+    for cvar, cval in zip(ctx.jaxpr.constvars, ctx.consts):
+        nbytes = getattr(cval, 'nbytes', None)
+        if nbytes is None:
+            try:
+                nbytes = np.asarray(cval).nbytes
+            except Exception:
+                continue
+        if nbytes < threshold:
+            continue
+        f, l = (None, None)
+        if cvar in first_use:
+            f, l = _loc(first_use[cvar])
+        sev = HIGH if nbytes >= high_at else WARN
+        yield Finding(
+            'constant-capture', sev,
+            f'{_fmt_aval(cvar.aval)} ({nbytes / (1 << 20):.1f} MiB) is '
+            'captured as a jaxpr CONSTANT: it is baked into the '
+            'compiled module (a new value means a full recompile, and '
+            'the artifact carries the bytes). Pass it as an explicit '
+            'argument instead of closing over it.',
+            file=f, line=l, origin='jaxpr')

@@ -1,0 +1,101 @@
+"""Runtime companions to the static rules.
+
+Two hazards are cheaper to catch live than to prove statically:
+
+* ``amp_audit()`` — an eager-mode dtype audit.  It rides the single
+  dispatch choke point (core/dispatch.set_audit_hook) and records, for
+  every op executed inside the ``with`` block, ops that run with MIXED
+  f32 + bf16/f16 array inputs while an auto_cast low-precision region
+  is active — the eager twin of the jaxpr ``amp-promotion`` rule.
+  Zero overhead when not active (dispatch checks one None).
+
+* ``note_retrace()`` — the recompile monitor the compile caches call
+  when one step function accumulates many signature variants
+  (hapi.Model / jit.StaticFunction wire this).  Static analysis sees
+  one signature; only the runtime sees the cache fork eight times.
+"""
+import contextlib
+import warnings
+
+import jax.numpy as jnp
+
+from .findings import Finding, LintReport, LintWarning, WARN, HIGH
+
+__all__ = ['amp_audit', 'OpDtypeAudit', 'note_retrace']
+
+_LOW = (jnp.bfloat16, jnp.float16)
+
+
+class OpDtypeAudit:
+    """Recorder handed back by amp_audit()."""
+
+    def __init__(self):
+        self.ops = []          # (op_name, (dtype, ...)) every op seen
+        self.findings = []
+
+    def report(self, name='amp-audit'):
+        return LintReport(self.findings, name=name)
+
+    def _observe(self, op_name, vals):
+        dtypes = tuple(getattr(v, 'dtype', None) for v in vals)
+        self.ops.append((op_name, dtypes))
+        from .. import amp as amp_mod
+        st = amp_mod.amp_state()
+        if not st.enabled or st.dtype not in _LOW:
+            return
+        if op_name in st.black or op_name in amp_mod.KEEP_LIST:
+            return            # f32 here is the policy, not a bug
+        has_low = any(d in _LOW for d in dtypes)
+        has_f32 = any(d == jnp.float32 for d in dtypes)
+        if has_low and has_f32:
+            self.findings.append(Finding(
+                'amp-promotion', WARN,
+                f'op `{op_name}` was fed mixed f32 + low-precision '
+                'inputs inside an auto_cast region: the amp hook '
+                're-casts the f32 operand on EVERY step (cast + HBM '
+                'traffic each time). Cast it once, outside the step '
+                '(usually a buffer/constant created outside the '
+                'region).',
+                origin='runtime'))
+
+
+@contextlib.contextmanager
+def amp_audit():
+    """Record eager op dtypes through the dispatch choke point; yields
+    an OpDtypeAudit whose .findings hold mixed-precision promotions
+    observed inside auto_cast regions."""
+    from ..core import dispatch
+    audit = OpDtypeAudit()
+    prev = dispatch.get_audit_hook()
+    dispatch.set_audit_hook(audit._observe)
+    try:
+        yield audit
+    finally:
+        dispatch.set_audit_hook(prev)
+
+
+_warned_retrace = set()
+
+
+def note_retrace(name, n_variants, threshold=8, instance=None):
+    """Called by compile caches when `name` has accumulated
+    `n_variants` compiled signatures.  Warns (once per power-of-two
+    crossing PER CACHE — pass the owning cache/object as `instance`
+    so two models sharing a label don't mask each other) with a
+    recompile-hazard finding; returns the Finding when one was
+    emitted, else None."""
+    if n_variants < threshold or (n_variants & (n_variants - 1)):
+        return None           # warn at threshold, 2x, 4x, ... only
+    key = (name, n_variants, id(instance))
+    if key in _warned_retrace:
+        return None
+    _warned_retrace.add(key)
+    f = Finding(
+        'recompile-hazard', HIGH,
+        f'{name} has compiled {n_variants} signature variants: the '
+        'step is retracing (varying shapes, Python-scalar args, or '
+        'weak/strong dtype flips). Each variant is a full XLA '
+        'compile — pad/bucket shapes and pass scalars as arrays.',
+        origin='runtime')
+    warnings.warn(str(f), LintWarning, stacklevel=3)
+    return f

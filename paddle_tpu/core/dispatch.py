@@ -23,6 +23,13 @@ _amp_hook = None
 # handler(fn, args, kwargs, op_name) -> Variable | NotImplemented.
 _static_handler = None
 
+# Installed by paddle_tpu.analysis.runtime.amp_audit; signature:
+# hook(op_name, vals) -> None.  A pure observer of the op stream —
+# invoked BEFORE the amp hook, so vals are the raw arrays the caller
+# fed the op (the audit diagnoses mixed dtypes the amp hook would
+# re-cast every step).  Costs one None check when absent.
+_audit_hook = None
+
 
 def set_amp_hook(hook):
     global _amp_hook
@@ -32,6 +39,15 @@ def set_amp_hook(hook):
 def set_static_handler(handler):
     global _static_handler
     _static_handler = handler
+
+
+def set_audit_hook(hook):
+    global _audit_hook
+    _audit_hook = hook
+
+
+def get_audit_hook():
+    return _audit_hook
 
 
 def _raw(x):
@@ -58,6 +74,11 @@ def apply(fn, *args, op_name=None, **kwargs):
     kwargs = {k: _raw(v) for k, v in kwargs.items()}
     tpos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
     vals = [args[i].value for i in tpos]
+
+    if _audit_hook is not None:
+        # pre-AMP observation: the audit diagnoses what the user FED
+        # the op (mixed dtypes the amp hook will re-cast every step)
+        _audit_hook(op_name or getattr(fn, '__name__', ''), vals)
 
     if _amp_hook is not None:
         vals = _amp_hook(op_name or getattr(fn, '__name__', ''), vals)

@@ -7,27 +7,28 @@ adjacent op-pair frequencies).
 
 TPU-native: the unit of execution is a jaxpr, not a ProgramDesc — the
 count walks either a static Program's recorded op DAG or the jaxpr of
-any traceable callable (`jax.make_jaxpr`), so it also sees what XLA
-will actually compile."""
+any traceable callable, so it also sees what XLA will actually
+compile.  The traversal is paddle_tpu.analysis.walker — the SAME walk
+the TPU lint rules use — so op counting and linting share one
+recursion over scan/cond/while/pjit sub-jaxprs instead of two ad-hoc
+ones (adjacent pairs count within one nesting level, matching the
+reference's within-block semantics)."""
 from collections import OrderedDict
 
 __all__ = ['op_freq_statistic']
 
 
-def _count_jaxpr(jaxpr, uni, pair):
-    prev = None
-    for eqn in jaxpr.eqns:
+def _count_jaxpr(closed, uni, pair):
+    from ...analysis import walker
+    last_in = {}        # id(parent jaxpr) -> previous op at that level
+    for parent, eqn in walker.walk(closed.jaxpr):
         name = eqn.primitive.name
         uni[name] = uni.get(name, 0) + 1
+        prev = last_in.get(id(parent))
         if prev is not None:
             key = f'{prev}->{name}'
             pair[key] = pair.get(key, 0) + 1
-        prev = name
-        # recurse into sub-jaxprs (scan/cond/while/pjit bodies)
-        for v in eqn.params.values():
-            sub = getattr(v, 'jaxpr', None)
-            if sub is not None:
-                _count_jaxpr(sub, uni, pair)
+        last_in[id(parent)] = name
 
 
 def op_freq_statistic(program, *example_args):
@@ -49,9 +50,9 @@ def op_freq_statistic(program, *example_args):
                 pair[key] = pair.get(key, 0) + 1
             prev = name
     elif callable(program):
-        import jax
-        jaxpr = jax.make_jaxpr(program)(*example_args)
-        _count_jaxpr(jaxpr.jaxpr, uni, pair)
+        from ...analysis import walker
+        closed = walker.trace_jaxpr(program, *example_args)
+        _count_jaxpr(closed, uni, pair)
     else:
         raise TypeError(
             'op_freq_statistic expects a static Program or a '

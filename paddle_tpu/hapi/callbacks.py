@@ -332,6 +332,12 @@ class NanGuard(Callback):
         self._epoch_skip_base = 0
 
     def on_train_begin(self, logs=None):
+        # the guard is the only default consumer of the per-step
+        # finiteness flag: disabling it flips Model.train_batch onto
+        # the sync-free path (loss/ok stay device arrays, step counter
+        # advances on device) — the host-sync-free posture for
+        # throughput runs
+        self.model._check_finite_steps = bool(self.enable)
         if self.enable and self.rollback:
             self.model._capture_good_state()
 
@@ -390,6 +396,13 @@ class VisualDL(Callback):
             elif isinstance(v, (list, tuple)) and v and \
                     isinstance(v[0], numbers.Number):
                 rec[k] = list(v)
+            else:
+                # lazy-loss path: logs carry device scalars; a logging
+                # callback is a log boundary, so IT pays the sync
+                try:
+                    rec[k] = float(getattr(v, 'value', v))
+                except (TypeError, ValueError):
+                    pass
         self._fh.write(json.dumps(rec) + '\n')
         self._fh.flush()
 

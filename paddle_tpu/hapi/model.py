@@ -66,7 +66,16 @@ class Model:
         self._optimizer = None
         self._loss = None
         self._metrics = []
+        self._lint = None
         self.stop_training = False
+        # True (default): train_batch materializes the per-step
+        # finiteness flag so skipped steps feed no metrics and
+        # NanGuard sees a Python bool — one host sync per step, the
+        # price of the exact skip contract.  NanGuard(enable=False)
+        # flips this off for the sync-free fast path: the loss / ok
+        # stay device arrays, the step counter advances on device, and
+        # skipped steps contribute zeroed (masked) metric stats.
+        self._check_finite_steps = True
         # compiled-step caches, keyed by (shapes, dtypes, lr-if-constant)
         self._train_step_cache = {}
         self._eval_step_cache = {}
@@ -80,7 +89,7 @@ class Model:
 
     # -- preparation ---------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
+                amp_configs=None, lint=None):
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _as_list(metrics)
@@ -88,6 +97,11 @@ class Model:
             assert isinstance(m, Metric), \
                 'metrics must be paddle_tpu.metric.Metric instances'
         self._amp = amp_configs or {}
+        # lint: run the paddle_tpu.analysis TPU lint over each newly
+        # compiled train step (jaxpr rules incl. donation audit) and
+        # over the network's forward source — None/False off,
+        # 'warn'/True warns, 'error' raises on high severity
+        self._lint = lint
         # a new optimizer/loss invalidates compiled steps (their traces
         # closed over the old ones) and the functional state
         self._train_step_cache.clear()
@@ -142,6 +156,11 @@ class Model:
                 if n in live:
                     self._optimizer._accumulators[id(live[n])] = \
                         jax.tree_util.tree_map(cp, st)
+            # the sync-free step path advances the counter on device;
+            # materialize it here (an epoch/save boundary) so
+            # state_dict round-trips a plain int
+            self._optimizer._global_step = int(
+                np.asarray(self._fstate['step']))
 
     def _invalidate(self):
         """Eager params changed (load/user edit): drop functional state."""
@@ -205,11 +224,20 @@ class Model:
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
         return sig + tuple(extra)
 
-    def _make_train_step(self, n_in):
+    def _build_train_step(self, n_in):
+        """The raw (unjitted) step — also what prepare(lint=...)
+        audits, so the linter sees exactly what XLA compiles."""
         network, opt = self.network, self._optimizer
 
-        def step_fn(params, buffers, opt_state, key, step, lr, *arrays):
+        def step_fn(params, buffers, opt_state, base_key, prev_step, lr,
+                    *arrays):
             inputs, labels = arrays[:n_in], arrays[n_in:]
+            # the per-step dropout key (fold of paddle.seed with the
+            # step counter) and the counter increment both live INSIDE
+            # the module: the sync-free path then issues zero per-step
+            # host-side dispatches beyond this one call
+            step = prev_step + 1
+            key = jax.random.fold_in(base_key, prev_step)
 
             def loss_fn(p):
                 outs, new_buf = functional_call(
@@ -233,10 +261,21 @@ class Model:
             new_params = _guard_update(ok, new_params, params)
             new_opt = _guard_update(ok, new_opt, opt_state)
             new_buf = _guard_update(ok, new_buf, buffers)
-            metrics = self._metric_computes(outs, labels)
-            return new_params, new_buf, new_opt, loss, ok, metrics
+            # metric stats are masked ON DEVICE for skipped steps so
+            # the sync-free path can feed them without reading `ok`
+            # back (neutral adds for count-style metrics)
+            metrics = [jax.tree_util.tree_map(
+                lambda v: jnp.where(ok, v, jnp.zeros_like(v)), r)
+                for r in self._metric_computes(outs, labels)]
+            new_step = prev_step + ok.astype(jnp.int32)
+            return (new_params, new_buf, new_opt, new_step, loss, ok,
+                    metrics)
 
-        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        return step_fn
+
+    def _make_train_step(self, n_in):
+        return jax.jit(self._build_train_step(n_in),
+                       donate_argnums=(0, 1, 2))
 
     def _make_eval_step(self, n_in):
         network = self.network
@@ -274,7 +313,13 @@ class Model:
 
     # -- public batch APIs ---------------------------------------------------
     def train_batch(self, inputs, labels=None):
-        """One compiled optimizer step; returns (loss, metric_results)."""
+        """One compiled optimizer step; returns (loss, metric_results).
+
+        The loss comes back as a DEVICE scalar (host-sync lint: the
+        old ``float(loss)`` here stalled the XLA queue every step —
+        see PERF.md).  ``float(loss)`` still works for callers that
+        want a number; the fit loop materializes only when a logger
+        actually prints."""
         assert self._optimizer is not None and self._loss is not None, \
             'call prepare(optimizer, loss) before train_batch'
         batch = _as_list(inputs) + _as_list(labels)
@@ -282,35 +327,74 @@ class Model:
         st = self._get_fstate()
         key = self._batch_key(arrays, ('train', n_in))
         if key not in self._train_step_cache:
+            if self._lint:
+                self._lint_train_step(n_in, st, arrays)
             self._train_step_cache[key] = self._make_train_step(n_in)
+            from ..analysis import note_retrace
+            note_retrace('Model.train_batch',
+                         len(self._train_step_cache), instance=self)
         fn = self._train_step_cache[key]
-        # per-step dropout key derived from the user's paddle.seed (the
-        # engine's core.rng), folded with the step counter — NOT a
-        # hard-coded constant, so reseeding changes the dropout streams
+        # base dropout key derived from the user's paddle.seed (the
+        # engine's core.rng) — the per-step fold with the counter
+        # happens inside the compiled module; cache the PRNGKey until
+        # the user reseeds
         from ..core import rng as rng_mod
-        rng = jax.random.fold_in(
-            jax.random.PRNGKey(rng_mod.get_seed()), st['step'])
-        # optimizer rules take t starting at 1 (Adam bias correction)
-        new_params, new_buf, new_opt, loss, ok, mres = fn(
-            st['params'], st['buffers'], st['opt'], rng,
-            jnp.asarray(st['step'] + 1, jnp.int32),
+        seed = rng_mod.get_seed()
+        if getattr(self, '_base_key_seed', None) != seed:
+            self._base_key = jax.random.PRNGKey(seed)
+            self._base_key_seed = seed
+        # optimizer rules take t starting at 1 (Adam bias correction —
+        # step_fn derives t = prev_step + 1 on device)
+        new_params, new_buf, new_opt, new_step, loss, ok, mres = fn(
+            st['params'], st['buffers'], st['opt'], self._base_key,
+            jnp.asarray(st['step'], jnp.int32),
             jnp.asarray(self._optimizer.get_lr(), jnp.float32), *arrays)
         # donation invalidated the inputs — always adopt the returned
         # arrays (they hold the OLD values when the step was skipped)
-        ok = bool(ok)
-        self._last_step_ok = ok
-        st.update(params=new_params, buffers=new_buf, opt=new_opt,
-                  step=st['step'] + (1 if ok else 0))
-        if self._optimizer is not None:
+        if self._check_finite_steps:
+            # exact-skip contract: materialize ok (one host sync) so a
+            # skipped step feeds no metrics and no optimizer tick
+            ok = bool(ok)
+            self._last_step_ok = ok
+            st.update(params=new_params, buffers=new_buf, opt=new_opt,
+                      step=st['step'] + (1 if ok else 0))
             self._optimizer._global_step = st['step']
-        if not ok:
-            # a skipped step contributes neither metrics nor an
-            # optimizer tick; policy (strikes/rollback) is NanGuard's
-            return float(loss), []
+            if not ok:
+                # policy (strikes/rollback) is NanGuard's
+                return loss, []
+        else:
+            # sync-free path: nothing here reads a device value — the
+            # host runs ahead and keeps the XLA queue full.  `ok`
+            # stays a device bool (NanGuard, if someone re-enables it,
+            # pays the sync), the step counter advanced on device, and
+            # mres was already masked to zero inside the module
+            self._last_step_ok = ok
+            st.update(params=new_params, buffers=new_buf, opt=new_opt,
+                      step=new_step)
+            self._optimizer._global_step = st['step']
         metric_logs = [m.update(r) if not isinstance(r, (tuple, list))
                        else m.update(*r)
                        for m, r in zip(self._metrics, mres)]
-        return float(loss), metric_logs
+        return loss, metric_logs
+
+    def _lint_train_step(self, n_in, st, arrays):
+        """prepare(lint=...): audit the exact step about to compile
+        (jaxpr rules, donation included) + the forward's source —
+        via safe_emit, so only LintError (the 'error'-mode verdict)
+        escapes and analyzer crashes degrade to a warning."""
+        from .. import analysis
+
+        def build():
+            step_fn = self._build_train_step(n_in)
+            report = analysis.lint(
+                step_fn, st['params'], st['buffers'], st['opt'],
+                jax.random.PRNGKey(0), jnp.zeros((), jnp.int32),
+                jnp.zeros((), jnp.float32), *arrays,
+                donate_argnums=(0, 1, 2), source=False,
+                name='Model.train_step')
+            return report.extend(analysis.lint_layer(self.network))
+
+        analysis.safe_emit(build, self._lint)
 
     def _eval_batch_lazy(self, arrays, n_in):
         """One compiled eval step with NO host readback: the returned
@@ -338,10 +422,15 @@ class Model:
         return outs, loss
 
     def eval_batch(self, inputs, labels=None):
+        """One compiled eval step; returns (loss, outputs) as DEVICE
+        arrays — the old ``float(loss)`` / ``np.asarray(o)`` here cost
+        a device→host round trip per batch (host-sync lint).  Call
+        ``float(loss)`` / ``np.asarray(o)`` at your log boundary to
+        materialize."""
         batch = _as_list(inputs) + _as_list(labels)
         arrays, n_in = self._split_batch(batch)
         outs, loss = self._eval_batch_lazy(arrays, n_in)
-        return float(loss), [np.asarray(o) for o in outs]
+        return loss, list(outs)
 
     def predict_batch(self, inputs):
         arrays = [_to_jnp(b) for b in _as_list(inputs)]

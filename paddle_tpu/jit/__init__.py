@@ -106,12 +106,13 @@ class StaticFunction:
     """
 
     def __init__(self, dygraph_function, input_spec=None, build_strategy=None,
-                 backend=None):
+                 backend=None, check=None):
         self._dygraph_function = dygraph_function
         self._input_spec = input_spec
         self._layer = dygraph_function if isinstance(dygraph_function, Layer) \
             else None
         self._jitted = {}          # static-key -> jitted fn
+        self._check = check        # analysis lint mode: None/'warn'/'error'
         self._last_lowered = None  # for save()
         # forward the USER callable's identity (the reference's
         # StaticFunction does the same); for a wrapped Layer that is
@@ -140,7 +141,7 @@ class StaticFunction:
                 static.append((i, a))
         return tuple(tpos), tvals, tuple(static)
 
-    def _make_jitted(self, tpos, static, n_args, training):
+    def _make_pure(self, tpos, static, n_args, training):
         layer = self._layer
         # data-dependent `if`/`while` in the source lower to
         # lax.cond/lax.while_loop (no-op for unconvertible functions)
@@ -162,7 +163,34 @@ class StaticFunction:
                            else v for v in full])
             return _flatten_out(out), {}
 
-        return jax.jit(pure)
+        return pure
+
+    def _make_jitted(self, tpos, static, n_args, training):
+        return jax.jit(self._make_pure(tpos, static, n_args, training))
+
+    def _check_report(self, tpos, static, n_args, training, params,
+                      buffers, key, tvals):
+        """to_static(check=...): lint the exact pure function jax.jit
+        will compile for this signature, plus the AST of the user's
+        source; python-scalar static args are the retrace hazards."""
+        from .. import analysis
+        pure = self._make_pure(tpos, static, n_args, training)
+        report = analysis.lint(pure, params, buffers, key, tvals,
+                               name=getattr(self, '__name__', 'to_static'),
+                               source=False)
+        # scalars the StaticFunction cache closes over as static values
+        # — same hazard, same shared policy as the jaxpr rule
+        scalars = [(i, a) for (i, a) in static
+                   if isinstance(a, (bool, int, float))]
+        report.findings.extend(analysis.scalar_arg_findings(
+            scalars, self.__name__))
+        src_fn = self._dygraph_function
+        if isinstance(src_fn, _BoundForward):
+            src_fn = type(src_fn._inner).forward
+        elif isinstance(src_fn, Layer):
+            src_fn = type(src_fn).forward
+        report.extend(analysis.lint_callable(src_fn))
+        return report
 
     def __call__(self, *args, **kwargs):
         if not _to_static_enabled:
@@ -175,12 +203,25 @@ class StaticFunction:
         training = self._layer.training if self._layer is not None else False
         cache_key = (tpos, tuple(repr(s) for s in static), len(args),
                      training)
-        if cache_key not in self._jitted:
-            self._jitted[cache_key] = self._make_jitted(
-                tpos, static, len(args), training)
         params, buffers = (self._layer.functional_state()
                            if self._layer is not None else ({}, {}))
         key = rng_mod.next_key()
+        if cache_key not in self._jitted:
+            if self._check:
+                from .. import analysis
+                analysis.safe_emit(
+                    lambda: self._check_report(
+                        tpos, static, len(args), training, params,
+                        buffers, key, tvals),
+                    self._check)
+            self._jitted[cache_key] = self._make_jitted(
+                tpos, static, len(args), training)
+            # the retrace monitor: many signature variants on one
+            # StaticFunction means something in the signature is
+            # unstable (shapes / scalars / weak types)
+            from ..analysis import note_retrace
+            note_retrace(f'to_static({self.__name__})',
+                         len(self._jitted), instance=self)
         out_vals, new_buffers = self._jitted[cache_key](
             params, buffers, key, tvals)
         if self._layer is not None and new_buffers:
@@ -231,19 +272,26 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, **kwargs):
+              backend=None, check=None, **kwargs):
     """Decorator/wrapper: compile a function or Layer with XLA.
 
     Reference: python/paddle/jit/api.py::to_static.
+
+    check: run the paddle_tpu.analysis TPU lint over the traced
+    function on each first-compile of a signature — None/False (off),
+    'warn'/True (findings surface as LintWarning), 'error' (raise
+    LintError on high-severity findings).  See README "Linting your
+    model".
     """
     def decorate(fn):
         if isinstance(fn, Layer):
-            fn.forward = StaticFunction(_BoundForward(fn), input_spec)
+            fn.forward = StaticFunction(_BoundForward(fn), input_spec,
+                                        check=check)
             # calling the layer itself routes through forward, which is
             # now compiled; also expose the StaticFunction
             fn._static_forward = fn.forward
             return fn
-        return StaticFunction(fn, input_spec)
+        return StaticFunction(fn, input_spec, check=check)
 
     if function is not None:
         return decorate(function)

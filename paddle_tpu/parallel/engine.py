@@ -58,7 +58,7 @@ class ParallelTrainer:
 
     def __init__(self, model, optimizer, loss_fn, mesh=None, strategy=None,
                  donate=True, n_inputs=1, nan_guard=False, nan_patience=3,
-                 nan_max_rollbacks=2):
+                 nan_max_rollbacks=2, lint=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -67,6 +67,11 @@ class ParallelTrainer:
         self.strategy = strategy or getattr(optimizer, '_fleet_strategy',
                                             None)
         self.donate = donate
+        # lint: audit the compiled step with paddle_tpu.analysis on
+        # first build — the mesh is passed through, so the
+        # replicated-giant rule is live here.  None/False off,
+        # 'warn'/True warns, 'error' raises on high severity.
+        self.lint = lint
         self._step_no = 0
         self._compiled = None
         self._eval_compiled = None
@@ -93,6 +98,15 @@ class ParallelTrainer:
                     'hybrid_configs.pp_degree before fleet.init.',
                     UserWarning, stacklevel=2)
         if self._pipeline:
+            if self.lint:
+                import warnings
+                warnings.warn(
+                    'ParallelTrainer(lint=...) is not supported under '
+                    'pipeline parallelism yet (the 1F1B step compiles '
+                    'per stage); the step will run UNLINTED. Lint the '
+                    'dp/tp configuration of the same model instead.',
+                    UserWarning, stacklevel=3)
+                self.lint = None
             self._init_pipeline(pp)
             return
 
@@ -381,6 +395,7 @@ class ParallelTrainer:
                 params, grads, opt_state, step_no)
             return new_params, new_buffers, new_state, loss
 
+        self._raw_step = train_step          # linted by _run_lint
         kwargs = {}
         if self.mesh is not None:
             repl = NamedSharding(self.mesh, P())
@@ -414,7 +429,25 @@ class ParallelTrainer:
         if self._compiled is None:
             self._n_batch = len(vals)
             self._compiled = self._build_step()
+            if self.lint:
+                self._run_lint(vals)
         return vals
+
+    def _run_lint(self, vals):
+        """ParallelTrainer(lint=...): audit the exact step function
+        _build_step handed to jax.jit, with the live mesh (so
+        replicated-giant fires) and the real donation set — via
+        safe_emit, so only LintError (the 'error'-mode verdict)
+        escapes and analyzer crashes degrade to a warning."""
+        from .. import analysis
+        analysis.safe_emit(
+            lambda: analysis.lint(
+                self._raw_step, self.params, self.buffers,
+                self.opt_state, jnp.zeros((), jnp.int32),
+                jax.random.PRNGKey(0), *vals, mesh=self.mesh,
+                donate_argnums=(0, 2) if self.donate else (),
+                source=False, name='ParallelTrainer.step'),
+            self.lint)
 
     def step(self, *batch):
         """batch: numpy/jax arrays (x, y, ...). Returns python float loss."""

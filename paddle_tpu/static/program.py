@@ -210,6 +210,46 @@ class Program:
     def vars(self):
         return dict(self.feed_vars)
 
+    # -- static validation (paddle_tpu.analysis) -----------------------------
+    def lint(self, fetch_list=None, mesh=None, disable=(),
+             thresholds=None):
+        """Run the TPU lint rules over the program's recorded DAG.
+
+        Builds the same closure Executor._compile evaluates — feeds
+        become ShapeDtypeStruct placeholders (declared shapes, batch
+        dim 1), parameters become explicit arguments (so they are NOT
+        reported as captured constants) — and traces it abstractly.
+        Returns a LintReport; nothing executes on device.
+        """
+        import jax as _jax
+        from .. import analysis
+
+        fetch_vars = [v for v in (fetch_list or [])
+                      if isinstance(v, Variable)]
+        if not fetch_vars and self.train_section is not None:
+            fetch_vars = [self.train_section[0]]
+        feed_objs = list(self.feed_vars.values())
+        structs = [_jax.ShapeDtypeStruct(v._feed_shape, v._feed_dtype)
+                   for v in feed_objs]
+        params = list(self._params.values())
+        p_structs = [_jax.ShapeDtypeStruct(tuple(p.value.shape),
+                                           p.value.dtype)
+                     for p in params]
+        side_sources = [v for _, v in self.side_effects]
+
+        def run(feed_vals, pvals):
+            env = {'__params__':
+                   {id(p): v for p, v in zip(params, pvals)}}
+            for v, val in zip(feed_objs, feed_vals):
+                env[id(v)] = val
+            outs = [fv._eval(env) for fv in fetch_vars]
+            side = [sv._eval(env) for sv in side_sources]
+            return outs, side
+
+        return analysis.lint(run, structs, p_structs, mesh=mesh,
+                             disable=disable, thresholds=thresholds,
+                             name=f'Program#{self.id}', source=False)
+
 
 _default_main = Program()
 _default_startup = Program()
@@ -353,7 +393,7 @@ class Executor:
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None,
-            return_numpy=True):
+            return_numpy=True, check=None):
         program = program or default_main_program()
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -361,6 +401,21 @@ class Executor:
             return program._run_loaded(feed, fetch_list, return_numpy)
         if hasattr(program, '_unwrap'):          # CompiledProgram
             program = program._unwrap()
+        lint_key = (program.id, program._version, str(check))
+        if check and lint_key not in getattr(self, '_linted_versions',
+                                             set()):
+            # validate once per (program, version, mode) — a 'warn'
+            # run never satisfies a later 'error' gate — before the
+            # first compile; safe_emit lets only LintError (the
+            # 'error'-mode verdict) escape, and the key is recorded
+            # only after a PASSED gate so a failed gate re-gates on
+            # retry
+            from .. import analysis
+            self._linted_versions = getattr(self, '_linted_versions',
+                                            set())
+            analysis.safe_emit(
+                lambda: program.lint(fetch_list=fetch_list), check)
+            self._linted_versions.add(lint_key)
         if program is _default_startup or (
                 not program.feed_vars and not fetch_list):
             return []  # startup: params already initialized eagerly

@@ -1,0 +1,668 @@
+"""paddle_tpu.analysis — the jaxpr-level TPU lint pass.
+
+Positive AND negative fixture per shipped rule, suppression-comment
+tests, CLI exit-code tests, the compile-choke-point integrations
+(to_static / Program / Model.prepare / ParallelTrainer / dispatch
+audit), and the tier-1 self-lint gate over examples/ and
+paddle_tpu/models/.  (File name sorts before test_host_embedding so
+the whole module runs inside the tier-1 window.)
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import warnings
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis, nn
+from paddle_tpu.analysis import (
+    Finding, LintError, LintReport, LintWarning)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(report, rule=None):
+    if rule is None:
+        return sorted({f.rule for f in report})
+    return [f for f in report if f.rule == rule]
+
+
+def mesh8():
+    return Mesh(np.array(jax.devices()).reshape(4, 2), ('dp', 'tp'))
+
+
+# --------------------------------------------------- rule: recompile-hazard
+class TestRecompileHazard:
+    def test_python_scalar_arg_flagged(self):
+        r = analysis.lint(lambda x, lr: x * lr, jnp.ones(3), 0.1,
+                          source=False)
+        fs = rules_of(r, 'recompile-hazard')
+        assert fs and fs[0].severity == 'high'
+
+    def test_weak_type_leaf_flagged(self):
+        r = analysis.lint(lambda x, lr: x * lr, jnp.ones(3),
+                          jnp.asarray(0.1), source=False)
+        fs = rules_of(r, 'recompile-hazard')
+        assert fs and fs[0].severity == 'warn'
+
+    def test_varying_shapes_flagged(self):
+        r = analysis.lint(lambda x: x + 1, jnp.ones((4, 8)),
+                          signatures=[((4, 8),), ((6, 8),), ((7, 8),)],
+                          source=False)
+        assert rules_of(r, 'recompile-hazard')
+
+    def test_negative_strong_typed_arrays(self):
+        r = analysis.lint(lambda x, lr: x * lr, jnp.ones(3),
+                          jnp.asarray(0.1, jnp.float32), source=False)
+        assert not r.findings
+
+    def test_note_retrace_warns_at_threshold(self):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter('always')
+            f = analysis.note_retrace('unit.step/%d' % os.getpid(), 8)
+        assert f is not None and f.rule == 'recompile-hazard'
+        assert any(isinstance(x.message, LintWarning) for x in w)
+        assert analysis.note_retrace('unit.other', 7) is None
+
+    def test_note_retrace_per_instance(self):
+        """Two caches sharing a label must each get their warning."""
+        a, b = object(), object()
+        with warnings.catch_warnings():
+            warnings.simplefilter('ignore')
+            fa = analysis.note_retrace('unit.shared', 8, instance=a)
+            fb = analysis.note_retrace('unit.shared', 8, instance=b)
+            fa2 = analysis.note_retrace('unit.shared', 8, instance=a)
+        assert fa is not None and fb is not None and fa2 is None
+
+
+# --------------------------------------------------------- rule: host-sync
+class TestHostSync:
+    def test_callback_in_step_flagged(self):
+        def step(x):
+            return jax.pure_callback(
+                lambda v: np.asarray(v) * 2,
+                jax.ShapeDtypeStruct((3,), np.float32), x)
+        r = analysis.lint(step, jnp.ones(3), source=False)
+        fs = rules_of(r, 'host-sync')
+        assert fs and fs[0].severity == 'high'
+
+    def test_trace_abort_is_a_finding(self):
+        def step(x):
+            if x.sum() > 0:       # concretizes a tracer
+                return x
+            return -x
+        r = analysis.lint(step, jnp.ones(3), source=False)
+        assert rules_of(r, 'host-sync')
+
+    def test_negative_pure_step(self):
+        r = analysis.lint(lambda x: (x * 2).sum(), jnp.ones(3),
+                          source=False)
+        assert not rules_of(r, 'host-sync')
+
+    def test_ast_flags_old_train_batch_pattern(self):
+        """The rule's first real catch: hapi train_batch's per-step
+        float(loss) / np.asarray(o) (fixed in this PR — PERF.md)."""
+        src = textwrap.dedent('''
+            def train_batch(self, inputs, labels):
+                loss, ok, outs = self._step(inputs, labels)
+                ok = bool(ok)
+                return float(loss), [np.asarray(o) for o in outs]
+        ''')
+        fs = analysis.lint_source(src, 'model.py', scope='all')
+        assert len([f for f in fs if f.rule == 'host-sync'
+                    and f.severity == 'high']) >= 3
+
+    def test_ast_traced_scope_positive_and_negative(self):
+        src = textwrap.dedent('''
+            class Net(Layer):
+                def forward(self, x):
+                    scale = float(x.mean())
+                    return x * scale
+
+            def host_loop():
+                loss = step()
+                print(float(loss))    # log boundary: fine in traced scope
+        ''')
+        fs = analysis.lint_source(src, 'net.py', scope='traced')
+        assert [f for f in fs if f.severity == 'high'] and \
+            all(f.line < 6 for f in fs)
+        clean = textwrap.dedent('''
+            class Net(Layer):
+                def forward(self, x):
+                    return (x * 2).sum()
+        ''')
+        assert not analysis.lint_source(clean, 'net.py', scope='traced')
+
+
+# --------------------------------------------------- rule: replicated-giant
+TH = {'replicated_bytes': 512 * 512 * 4}
+
+
+class TestReplicatedGiant:
+    def test_constant_mask_flagged_under_mesh(self):
+        def step(x):
+            m = jnp.tril(jnp.ones((512, 512), jnp.float32))
+            return x + m
+        r = analysis.lint(step, jnp.ones((512, 512)), mesh=mesh8(),
+                          thresholds=TH, source=False)
+        assert rules_of(r, 'replicated-giant')
+
+    def test_negative_with_sharding_constraint(self):
+        mesh = mesh8()
+
+        def step(x):
+            m = jnp.tril(jnp.ones((512, 512), jnp.float32))
+            m = jax.lax.with_sharding_constraint(
+                m, NamedSharding(mesh, P('dp')))
+            return x + m
+        r = analysis.lint(step, jnp.ones((512, 512)), mesh=mesh,
+                          thresholds=TH, source=False)
+        assert not rules_of(r, 'replicated-giant')
+
+    def test_negative_without_mesh(self):
+        def step(x):
+            return x + jnp.tril(jnp.ones((512, 512), jnp.float32))
+        r = analysis.lint(step, jnp.ones((512, 512)), thresholds=TH,
+                          source=False)
+        assert not rules_of(r, 'replicated-giant')
+
+    def test_input_derived_not_flagged(self):
+        def step(x):
+            return jnp.broadcast_to(x, (8, 512, 512)).sum(0)
+        r = analysis.lint(step, jnp.ones((512, 512)), mesh=mesh8(),
+                          thresholds=TH, source=False)
+        assert not rules_of(r, 'replicated-giant')
+
+
+# ------------------------------------------------------ rule: amp-promotion
+class TestAmpPromotion:
+    def test_operand_upcast_before_matmul_flagged(self):
+        def step(a, b):
+            return a.astype(jnp.float32) @ b.astype(jnp.float32)
+        r = analysis.lint(step, jnp.ones((4, 4), jnp.bfloat16),
+                          jnp.ones((4, 4), jnp.bfloat16), source=False)
+        assert rules_of(r, 'amp-promotion')
+
+    def test_negative_preferred_element_type(self):
+        def step(a, b):
+            return jnp.matmul(a, b,
+                              preferred_element_type=jnp.float32)
+        r = analysis.lint(step, jnp.ones((4, 4), jnp.bfloat16),
+                          jnp.ones((4, 4), jnp.bfloat16), source=False)
+        assert not rules_of(r, 'amp-promotion')
+
+    def test_f32_constant_promotion_flagged(self):
+        r = analysis.lint(lambda a: a * np.float32(2.0),
+                          jnp.ones(3, jnp.bfloat16), source=False)
+        assert rules_of(r, 'amp-promotion')
+
+    def test_negative_weak_python_literal(self):
+        r = analysis.lint(lambda a: a * 2.0,
+                          jnp.ones(3, jnp.bfloat16), source=False)
+        assert not rules_of(r, 'amp-promotion')
+
+    def test_fixed_ring_attention_block_is_clean(self):
+        """The confirmed ops/ finding this PR fixed: ring_attention's
+        einsum engine upcast q/k to f32 before the MXU dot."""
+        def fixed(q, k):
+            return jnp.einsum('bqd,bkd->bqk', q, k,
+                              preferred_element_type=jnp.float32) * 0.1
+        def old(q, k):
+            return jnp.einsum('bqd,bkd->bqk', q.astype(jnp.float32),
+                              k.astype(jnp.float32)) * 0.1
+        q = jnp.ones((2, 8, 4), jnp.bfloat16)
+        assert not rules_of(
+            analysis.lint(fixed, q, q, source=False), 'amp-promotion')
+        assert rules_of(
+            analysis.lint(old, q, q, source=False), 'amp-promotion')
+
+    def test_eager_amp_audit_via_dispatch(self):
+        from paddle_tpu import amp
+        with analysis.amp_audit() as audit:
+            with amp.auto_cast(level='O1'):
+                a = paddle.to_tensor(np.ones((4, 4), 'float32'))
+                b = paddle.to_tensor(np.ones((4, 4), 'float32'))
+                c = a @ b                      # whitelist -> bf16
+                _ = c + paddle.to_tensor(np.ones((4, 4), 'float32'))
+        assert audit.ops
+        assert rules_of(audit.report(), 'amp-promotion')
+        # hook uninstalled afterwards
+        from paddle_tpu.core import dispatch
+        assert dispatch.get_audit_hook() is None
+
+    def test_amp_audit_alias_in_amp_namespace(self):
+        from paddle_tpu import amp
+        with amp.audit() as a:
+            _ = paddle.to_tensor(np.ones(3, 'float32')) * 2
+        assert a.ops and not a.findings
+
+
+# ------------------------------------------------- rule: donation-violation
+class TestDonationViolation:
+    def test_donated_without_matching_output_flagged(self):
+        def step(p, x):
+            return p['w'].astype(jnp.bfloat16), x.mean()
+        r = analysis.lint(step, {'w': jnp.ones((3, 3))}, jnp.ones(3),
+                          donate_argnums=(0,), source=False)
+        fs = rules_of(r, 'donation-violation')
+        assert fs and fs[0].severity == 'high'
+
+    def test_negative_updated_params_returned(self):
+        def step(p, x):
+            return {'w': p['w'] - 0.1 * x.sum()}, x.mean()
+        r = analysis.lint(step, {'w': jnp.ones((3, 3))}, jnp.ones(3),
+                          donate_argnums=(0,), source=False)
+        assert not rules_of(r, 'donation-violation')
+
+    def test_no_donation_no_findings(self):
+        def step(p, x):
+            return p['w'].astype(jnp.bfloat16), x.mean()
+        r = analysis.lint(step, {'w': jnp.ones((3, 3))}, jnp.ones(3),
+                          source=False)
+        assert not rules_of(r, 'donation-violation')
+
+
+# -------------------------------------------------- rule: constant-capture
+class TestConstantCapture:
+    def test_closure_const_flagged(self):
+        big = np.ones((600, 600), np.float32)
+        r = analysis.lint(lambda x: x + big, jnp.ones((600, 600)),
+                          source=False)
+        fs = rules_of(r, 'constant-capture')
+        assert fs and 'constant' in fs[0].message.lower()
+
+    def test_negative_passed_as_argument(self):
+        big = jnp.ones((600, 600), jnp.float32)
+        r = analysis.lint(lambda x, b: x + b, jnp.ones((600, 600)),
+                          big, source=False)
+        assert not rules_of(r, 'constant-capture')
+
+    def test_small_const_not_flagged(self):
+        small = np.ones((4, 4), np.float32)
+        r = analysis.lint(lambda x: x + small, jnp.ones((4, 4)),
+                          source=False)
+        assert not rules_of(r, 'constant-capture')
+
+
+# ------------------------------------------------------------- suppression
+class TestSuppression:
+    def test_disable_kwarg(self):
+        r = analysis.lint(lambda x, lr: x * lr, jnp.ones(3), 0.1,
+                          disable=('recompile-hazard',), source=False)
+        assert not r.findings
+
+    def test_ast_line_comment(self, tmp_path):
+        p = tmp_path / 'net.py'
+        p.write_text(textwrap.dedent('''
+            class Net(Layer):
+                def forward(self, x):
+                    s = float(x.mean())  # tpu-lint: disable=host-sync
+                    t = float(x.sum())
+                    return x * s * t
+        '''))
+        fs = analysis.lint_file(str(p), scope='traced')
+        lines = [f.line for f in fs if f.rule == 'host-sync']
+        assert lines == [5]          # only the uncommented one
+
+    def test_ast_def_level_comment(self, tmp_path):
+        p = tmp_path / 'net.py'
+        p.write_text(textwrap.dedent('''
+            class Net(Layer):
+                def forward(self, x):  # tpu-lint: disable
+                    return x * float(x.mean())
+        '''))
+        assert not analysis.lint_file(str(p), scope='traced')
+
+    def test_unrelated_module_comment_does_not_suppress(self, tmp_path):
+        """lint_callable line numbers are snippet-relative until
+        re-anchored; a disable comment elsewhere in the module must
+        not swallow findings at colliding relative offsets."""
+        p = tmp_path / 'mod.py'
+        p.write_text(textwrap.dedent('''\
+            # tpu-lint: disable
+            import numpy as np
+
+            def victim(x):
+                return float(x)
+        '''))
+        import importlib.util
+        spec = importlib.util.spec_from_file_location('lintmod', p)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        fs = analysis.lint_callable(mod.victim)
+        assert [f.rule for f in fs] == ['host-sync']
+        assert fs[0].line == 5      # re-anchored to the real file
+
+    def test_def_comment_suppresses_decorated_function(self, tmp_path):
+        """base_line of a decorated fn is the decorator line; the
+        documented def-line suppression must still work."""
+        p = tmp_path / 'dec.py'
+        p.write_text(textwrap.dedent('''\
+            def deco(f):
+                return f
+
+            @deco
+            def victim(x):  # tpu-lint: disable=host-sync
+                return float(x)
+        '''))
+        import importlib.util
+        spec = importlib.util.spec_from_file_location('lintdec', p)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert analysis.lint_callable(mod.victim) == []
+
+    def test_nested_def_comment_suppresses(self, tmp_path):
+        p = tmp_path / 'nested.py'
+        p.write_text(textwrap.dedent('''\
+            class Net(Layer):
+                def forward(self, x):
+                    def inner(y):  # tpu-lint: disable=host-sync
+                        return float(y)
+                    return inner(x) + float(x)
+        '''))
+        fs = analysis.lint_file(str(p), scope='traced')
+        lines = [f.line for f in fs if f.rule == 'host-sync']
+        assert lines == [5]          # only the one outside inner
+
+    def test_jaxpr_finding_suppressed_by_source_comment(self, tmp_path):
+        p = tmp_path / 'step.py'
+        p.write_text(textwrap.dedent('''
+            import jax.numpy as jnp
+
+            def up(a, b):
+                a32 = a.astype(jnp.float32)  # tpu-lint: disable=amp-promotion
+                return a32 @ b.astype(jnp.float32)
+        '''))
+        import importlib.util
+        spec = importlib.util.spec_from_file_location('lintfix', p)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        r = analysis.lint(mod.up, jnp.ones((4, 4), jnp.bfloat16),
+                          jnp.ones((4, 4), jnp.bfloat16), source=False)
+        # the matmul finding anchors at the FIRST upcast line, which
+        # carries the suppression comment
+        assert not rules_of(r, 'amp-promotion')
+
+
+# -------------------------------------------------------------- report API
+class TestReport:
+    def test_severity_ordering_and_json(self):
+        rep = LintReport([
+            Finding('a-rule', 'info', 'm1'),
+            Finding('b-rule', 'high', 'm2', file='f.py', line=3),
+        ], name='t')
+        assert rep.max_severity == 'high'
+        assert len(rep.at_least('warn')) == 1
+        blob = json.loads(rep.to_json())
+        assert blob['counts']['high'] == 1
+        assert blob['findings'][1]['file'] == 'f.py'
+        with pytest.raises(LintError):
+            rep.raise_for('high')
+        LintReport([Finding('a', 'warn', 'm')]).raise_for('high')
+
+
+# ------------------------------------------------------------ integrations
+class TestToStaticCheck:
+    def test_clean_function_passes_error_mode(self):
+        fn = paddle.jit.to_static(lambda x: x * 2, check='error')
+        out = fn(paddle.to_tensor(np.ones(3, 'float32')))
+        assert out.shape == [3]
+
+    def test_callback_raises_in_error_mode(self):
+        def f(x):
+            v = jax.pure_callback(
+                lambda a: np.asarray(a) * 2,
+                jax.ShapeDtypeStruct((3,), np.float32), x.value)
+            return paddle.to_tensor(v)
+        fn = paddle.jit.to_static(f, check='error')
+        with pytest.raises(LintError):
+            fn(paddle.to_tensor(np.ones(3, 'float32')))
+
+    def test_scalar_static_arg_warns(self):
+        fn = paddle.jit.to_static(lambda x, lr: x * lr, check=True)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter('always')
+            fn(paddle.to_tensor(np.ones(3, 'float32')), 0.1)
+        assert any('recompile-hazard' in str(x.message) for x in w)
+
+    def test_check_off_by_default(self):
+        fn = paddle.jit.to_static(lambda x, lr: x * lr)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter('always')
+            fn(paddle.to_tensor(np.ones(3, 'float32')), 0.5)
+        assert not any(isinstance(x.message, LintWarning) for x in w)
+
+
+class TestProgramLint:
+    def test_program_lint_and_executor_check(self):
+        import paddle_tpu.static as static
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                xv = static.data('x', [None, 4], 'float32')
+                yv = xv * 2.0
+            rep = prog.lint(fetch_list=[yv])
+            assert not rep.high
+            exe = static.Executor()
+            out = exe.run(prog, feed={'x': np.ones((2, 4), 'float32')},
+                          fetch_list=[yv], check='warn')
+            np.testing.assert_allclose(out[0], 2.0)
+        finally:
+            paddle.disable_static()
+
+    def test_executor_check_keys_per_program(self):
+        """Two Programs share _version numbers; the check-dedupe must
+        key per program, not per bare version."""
+        import paddle_tpu.static as static
+        paddle.enable_static()
+        try:
+            exe = static.Executor()
+            progs, fetches, feeds = [], [], []
+            for _ in range(2):
+                prog = static.Program()
+                with static.program_guard(prog):
+                    xv = static.data('x', [None, 4], 'float32')
+                    fetches.append(xv * 2.0)
+                progs.append(prog)
+                feeds.append({'x': np.ones((2, 4), 'float32')})
+            for prog, fv, feed in zip(progs, fetches, feeds):
+                exe.run(prog, feed=feed, fetch_list=[fv], check='warn')
+            keys = exe._linted_versions
+            assert len(keys) == 2 and \
+                len({pid for pid, _, _ in keys}) == 2
+            # a 'warn'-mode run must not satisfy a later 'error' gate
+            exe.run(progs[0], feed=feeds[0], fetch_list=[fetches[0]],
+                    check='error')
+            assert len(exe._linted_versions) == 3
+        finally:
+            paddle.disable_static()
+
+
+class TestModelPrepareLint:
+    def _model(self, lint):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(2, 8), nn.ReLU(), nn.Linear(8, 2))
+        m = paddle.Model(net)
+        m.prepare(paddle.optimizer.Adam(learning_rate=0.1,
+                                        parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), paddle.metric.Accuracy(),
+                  lint=lint)
+        return m
+
+    def test_own_train_step_is_lint_clean(self):
+        """Dogfood: Model's compiled step passes its own lint gate at
+        error level (donation audit included)."""
+        m = self._model('error')
+        x = np.random.RandomState(0).randn(8, 2).astype('float32')
+        y = np.random.RandomState(1).randint(0, 2, (8, 1)).astype('int64')
+        loss, _ = m.train_batch([x], [y])
+        assert np.isfinite(float(loss))
+
+    def test_losses_stay_on_device(self):
+        """The satellite host-sync fix: train_batch/eval_batch return
+        device scalars; materialization is the caller's log-boundary
+        decision."""
+        m = self._model(None)
+        x = np.random.RandomState(0).randn(8, 2).astype('float32')
+        y = np.random.RandomState(1).randint(0, 2, (8, 1)).astype('int64')
+        loss, _ = m.train_batch([x], [y])
+        assert isinstance(loss, jax.Array) and loss.ndim == 0
+        eloss, outs = m.eval_batch([x], [y])
+        assert isinstance(eloss, jax.Array)
+        assert all(isinstance(o, jax.Array) for o in outs)
+
+    def test_sync_free_fit_with_nanguard_disabled(self):
+        from paddle_tpu.hapi.callbacks import NanGuard
+
+        class DS(paddle.io.Dataset):
+            def __init__(self):
+                rs = np.random.RandomState(0)
+                self.y = rs.randint(0, 2, 64).astype('int64')
+                c = np.array([[-2., -2.], [2., 2.]], 'float32')
+                self.x = c[self.y] + rs.randn(64, 2).astype('float32') * .5
+
+            def __getitem__(self, i):
+                return self.x[i], self.y[i:i + 1]
+
+            def __len__(self):
+                return 64
+
+        m = self._model(None)
+        m.fit(DS(), batch_size=32, epochs=3, verbose=0,
+              callbacks=[NanGuard(enable=False)])
+        assert not m._check_finite_steps       # sync-free path taken
+        assert isinstance(m._last_step_ok, jax.Array)
+        logs = m.evaluate(DS(), batch_size=32, verbose=0)
+        assert logs['acc'] > 0.9               # it still learns
+
+
+class TestParallelTrainerLint:
+    def test_step_lint_clean_on_mesh(self):
+        from paddle_tpu.parallel import ParallelTrainer
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        tr = ParallelTrainer(
+            net, opt, lambda out, y: nn.CrossEntropyLoss()(out, y),
+            mesh=Mesh(np.array(jax.devices()), ('dp',)), lint='error')
+        x = np.random.RandomState(0).randn(8, 4).astype('float32')
+        y = np.random.RandomState(1).randint(0, 2, (8, 1)).astype('int64')
+        loss = tr.step(x, y)
+        assert np.isfinite(float(np.asarray(loss)))
+
+
+class TestOpFrequenceSharedWalker:
+    def test_counts_recurse_into_control_flow(self):
+        from paddle_tpu import fluid
+
+        def f(x):
+            def body(c, _):
+                return jnp.sin(c) + jnp.cos(c), None
+            out, _ = jax.lax.scan(body, x, None, length=3)
+            return out
+        uni, pair = fluid.contrib.op_freq_statistic(
+            f, np.ones(3, 'float32'))
+        assert uni.get('sin', 0) >= 1 and uni.get('cos', 0) >= 1
+        assert any('->' in k for k in pair)
+
+    def test_callable_still_counts_plain_ops(self):
+        def f(x):
+            return jnp.sin(x) + jnp.sin(x) * jnp.cos(x)
+        from paddle_tpu import fluid
+        uni, pair = fluid.contrib.op_freq_statistic(
+            f, np.ones(3, 'float32'))
+        assert uni.get('sin', 0) >= 2
+
+
+# ------------------------------------------------------------------- CLI
+LINT_CLI = os.path.join(REPO, 'tools', 'tpu_lint.py')
+
+
+def run_cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    return subprocess.run(
+        [sys.executable, LINT_CLI, *args], capture_output=True,
+        text=True, env=env, cwd=REPO, timeout=240)
+
+
+class TestCli:
+    def test_exit_nonzero_on_seeded_violation(self, tmp_path):
+        bad = tmp_path / 'bad.py'
+        bad.write_text(textwrap.dedent('''
+            class Net(Layer):
+                def forward(self, x):
+                    return x * float(x.mean())
+        '''))
+        res = run_cli(str(bad))
+        assert res.returncode == 1, res.stdout + res.stderr
+        assert 'host-sync' in res.stdout
+
+    def test_exit_zero_on_clean_input(self, tmp_path):
+        good = tmp_path / 'good.py'
+        good.write_text(textwrap.dedent('''
+            class Net(Layer):
+                def forward(self, x):
+                    return (x * 2).sum()
+        '''))
+        res = run_cli(str(good))
+        assert res.returncode == 0, res.stdout + res.stderr
+
+    def test_json_output_parses(self, tmp_path):
+        bad = tmp_path / 'bad.py'
+        bad.write_text(textwrap.dedent('''
+            class Net(Layer):
+                def forward(self, x):
+                    return x * float(x.mean())
+        '''))
+        res = run_cli(str(bad), '--json', '--fail-on', 'never')
+        assert res.returncode == 0
+        blob = json.loads(res.stdout)
+        assert blob['counts']['high'] >= 1
+        assert blob['findings'][0]['rule'] == 'host-sync'
+
+    def test_usage_error_exit_2(self, tmp_path):
+        assert run_cli().returncode == 2
+        assert run_cli(str(tmp_path / 'missing.py')).returncode == 2
+
+    def test_disable_flag(self, tmp_path):
+        bad = tmp_path / 'bad.py'
+        bad.write_text(textwrap.dedent('''
+            class Net(Layer):
+                def forward(self, x):
+                    return x * float(x.mean())
+        '''))
+        res = run_cli(str(bad), '--disable', 'host-sync')
+        assert res.returncode == 0
+
+
+# ----------------------------------------------------- tier-1 self-lint gate
+class TestSelfLint:
+    def test_examples_and_models_zero_high_severity(self):
+        rep = analysis.lint_sources(
+            [os.path.join(REPO, 'examples'),
+             os.path.join(REPO, 'paddle_tpu', 'models')],
+            scope='traced')
+        assert rep.high == [], rep.render(rep.high)
+
+    def test_cli_gate_examples_and_models(self):
+        res = run_cli(os.path.join(REPO, 'examples'),
+                      os.path.join(REPO, 'paddle_tpu', 'models'))
+        assert res.returncode == 0, res.stdout + res.stderr
+
+    def test_hapi_and_engine_traced_scope_clean(self):
+        """The satellite fix holds: the hapi/engine sources carry no
+        high-severity traced-scope host syncs."""
+        rep = analysis.lint_sources(
+            [os.path.join(REPO, 'paddle_tpu', 'hapi', 'model.py'),
+             os.path.join(REPO, 'paddle_tpu', 'parallel', 'engine.py')],
+            scope='traced')
+        assert rep.high == [], rep.render(rep.high)

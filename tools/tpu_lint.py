@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""tpu_lint — sweep Python sources for TPU compilation hazards.
+
+The CLI front of paddle_tpu.analysis: AST-lints files/directories (no
+imports, no device, no execution — safe on any tree), and optionally
+deep-lints one callable's jaxpr.
+
+    python tools/tpu_lint.py examples/ paddle_tpu/models/
+    python tools/tpu_lint.py train.py --scope all       # audit host loops
+    python tools/tpu_lint.py examples/ --json           # machine output
+    python tools/tpu_lint.py x.py --disable host-sync
+    python tools/tpu_lint.py --jaxpr pkg.mod:fn --shapes 8x128xf32,8xi32
+
+Exit codes: 0 = no findings at/above --fail-on (default: high),
+1 = findings at/above --fail-on, 2 = usage error.  CI and bench
+scripts consume --json; the tier-1 self-lint gate
+(tests/test_analysis.py) runs this over examples/ and
+paddle_tpu/models/ and requires exit 0.
+
+Suppress a finding with `# tpu-lint: disable=<rule-id>` on its line.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_SEVS = ('info', 'warn', 'high')
+
+
+_DTYPE_TOKENS = {
+    'f16': 'float16', 'f32': 'float32', 'f64': 'float64',
+    'i8': 'int8', 'i16': 'int16', 'i32': 'int32', 'i64': 'int64',
+    'u8': 'uint8', 'u32': 'uint32', 'bool': 'bool',
+}
+
+
+def _parse_shapes(spec):
+    """'8x128xf32,8xi32' -> [ShapeDtypeStruct] (last token = dtype;
+    short tokens f32/i32/bf16/... or any numpy dtype name)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import jax
+    out = []
+    for part in spec.split(','):
+        toks = part.strip().split('x')
+        tok = toks[-1]
+        if tok == 'bf16':
+            dtype = jnp.bfloat16
+        else:
+            dtype = np.dtype(_DTYPE_TOKENS.get(tok, tok))
+        shape = tuple(int(t) for t in toks[:-1])
+        out.append(jax.ShapeDtypeStruct(shape, dtype))
+    return out
+
+
+def _resolve(target):
+    import importlib
+    mod_name, _, fn_name = target.partition(':')
+    if not fn_name:
+        raise SystemExit(f'--jaxpr needs module:function, got {target!r}')
+    mod = importlib.import_module(mod_name)
+    return getattr(mod, fn_name)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog='tpu_lint',
+        description='jaxpr/AST TPU lint: recompile hazards, host '
+                    'syncs, sharding & dtype audits.')
+    ap.add_argument('paths', nargs='*',
+                    help='.py files or directories to AST-lint')
+    ap.add_argument('--scope', choices=('traced', 'all'),
+                    default='traced',
+                    help="'traced' lints only code the framework will "
+                         "trace (to_static/jit/forward); 'all' audits "
+                         'every function (host step loops)')
+    ap.add_argument('--disable', action='append', default=[],
+                    metavar='RULE', help='rule id to skip (repeatable)')
+    ap.add_argument('--fail-on', choices=_SEVS + ('never',),
+                    default='high',
+                    help='lowest severity that makes the exit code '
+                         'non-zero (default: high)')
+    ap.add_argument('--json', action='store_true',
+                    help='machine-readable output for CI/bench scripts')
+    ap.add_argument('--jaxpr', metavar='MOD:FN',
+                    help='additionally deep-lint one callable by '
+                         'tracing its jaxpr (imports the module)')
+    ap.add_argument('--shapes', metavar='SPEC',
+                    help='example shapes for --jaxpr, e.g. '
+                         '"8x128xf32,8xi32" (last token is the dtype)')
+    args = ap.parse_args(argv)
+
+    if not args.paths and not args.jaxpr:
+        ap.print_usage(sys.stderr)
+        print('tpu_lint: nothing to lint (give paths or --jaxpr)',
+              file=sys.stderr)
+        return 2
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f'tpu_lint: no such path: {p}', file=sys.stderr)
+            return 2
+
+    from paddle_tpu import analysis
+
+    report = analysis.LintReport(name='tpu-lint')
+    if args.paths:
+        report.extend(analysis.lint_sources(
+            args.paths, scope=args.scope, disable=args.disable))
+    if args.jaxpr:
+        try:
+            fn = _resolve(args.jaxpr)
+        except (ImportError, AttributeError, SystemExit) as e:
+            print(f'tpu_lint: cannot resolve --jaxpr: {e}',
+                  file=sys.stderr)
+            return 2
+        try:
+            shapes = _parse_shapes(args.shapes) if args.shapes else []
+        except (TypeError, ValueError) as e:
+            print(f'tpu_lint: cannot parse --shapes: {e}',
+                  file=sys.stderr)
+            return 2
+        report.extend(analysis.lint(fn, *shapes,
+                                    disable=args.disable))
+
+    if args.json:
+        print(report.to_json(indent=2))
+    else:
+        print(report.render() if report else report.summary())
+
+    if args.fail_on == 'never':
+        return 0
+    return 1 if report.at_least(args.fail_on) else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
