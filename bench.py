@@ -662,6 +662,53 @@ def _write_partial(results, smoke=False):
         log(f'could not write partial artifact: {e}')
 
 
+def _lint_preflight(timeout_s=180, smoke=False):
+    """tpu_lint gate before burning chip time: a HIGH-severity finding
+    in examples/ or paddle_tpu/models/ means some bench config would
+    run a known-degraded step (host sync / retrace hazard) — fail the
+    bench up front and put the findings in the artifact instead of
+    discovering it in the throughput numbers.
+
+    Returns (ok, summary_dict).  Lint-infra failures (timeout, crash)
+    never block the bench: evidence beats a dead gate."""
+    import subprocess
+    repo = os.path.dirname(os.path.abspath(__file__))
+    cmd = [sys.executable, os.path.join(repo, 'tools', 'tpu_lint.py'),
+           os.path.join(repo, 'examples'),
+           os.path.join(repo, 'paddle_tpu', 'models'),
+           '--json', '--fail-on', 'never']
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s)
+        doc = json.loads(proc.stdout)
+    except Exception as e:
+        log(f'lint preflight skipped ({e!r})')
+        return True, {'error': repr(e)[:200]}
+    counts = doc.get('counts', {})
+    high = [f for f in doc.get('findings', [])
+            if f.get('severity') == 'high']
+    # findings become lint_finding telemetry events, made DURABLE via
+    # a flight dump into the committed evidence dir (chip_session's
+    # collect_flightrecs archives flightrec-*.json; an in-memory ring
+    # alone would die with this process)
+    if doc.get('findings') and not smoke:
+        try:
+            from paddle_tpu import telemetry
+            for f in doc['findings']:
+                telemetry.event('lint_finding', rule=f.get('rule'),
+                                severity=f.get('severity'),
+                                file=f.get('file'), line=f.get('line'),
+                                origin=f.get('origin'),
+                                name='bench-preflight')
+            telemetry.dump_flight(os.path.join(
+                CHIP_OUT, 'flightrec-bench-preflight.json'))
+        except Exception:
+            pass
+    summary = {'counts': counts, 'high': high[:10]}
+    log(f'lint preflight: {counts}')
+    return not high, summary
+
+
 def main():
     from tools._env import setup_jax_cache
     setup_jax_cache()
@@ -676,6 +723,8 @@ def main():
                    help='per-config subprocess timeout in seconds '
                         '(slow-compile configs scale it by '
                         'TIMEOUT_SCALE, e.g. gptgen x3)')
+    p.add_argument('--no-lint', action='store_true',
+                   help='skip the tpu_lint preflight gate')
     args = p.parse_args()
 
     if args.single_json:
@@ -687,6 +736,19 @@ def main():
 
     names = list(CONFIGS) if args.config == 'all' else [args.config]
     results = {}
+    lint_summary = None
+    if args.config == 'all' and not args.no_lint:
+        lint_ok, lint_summary = _lint_preflight(smoke=args.smoke)
+        if not lint_ok:
+            # high-severity hazard: fail BEFORE burning chip time,
+            # with the findings as the artifact
+            print(json.dumps({
+                'metric': METRIC_NAMES['resnet'], 'value': None,
+                'unit': UNITS['resnet'], 'vs_baseline': None,
+                'error': 'lint preflight failed (high-severity '
+                         'findings); fix or re-run with --no-lint',
+                'lint': lint_summary, 'extras': {}}))
+            sys.exit(1)
     preflight_s = min(600, args.timeout * len(names))
     if args.config == 'all' and not _device_preflight(preflight_s):
         # dead accelerator tunnel: emit the artifact immediately with
@@ -761,6 +823,8 @@ def main():
         'vs_baseline': head.get('vs_baseline'),
         'extras': {k: v for k, v in results.items() if k != head_name},
     }
+    if lint_summary is not None:
+        out['lint'] = lint_summary
     # the headline config is excluded from extras, so its stale
     # provenance (if any) rides at the top level
     for k in ('stale_value', 'stale_vs_baseline', 'stale_from',

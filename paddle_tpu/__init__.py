@@ -57,6 +57,7 @@ from . import hapi  # noqa: F401
 from . import ops  # noqa: F401
 from . import models  # noqa: F401
 from . import analysis  # noqa: F401
+from . import telemetry  # noqa: F401
 from . import profiler  # noqa: F401
 from . import utils  # noqa: F401
 from . import resilience  # noqa: F401

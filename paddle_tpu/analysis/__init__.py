@@ -177,13 +177,31 @@ def emit(report, mode='warn'):
 
     mode: falsy -> silent; 'warn'/True -> one LintWarning per report;
     'error' -> LintError on any high-severity finding (lower ones
-    still warn)."""
+    still warn).  Findings additionally land as telemetry
+    ``lint_finding`` events (countable per run, and part of the bench
+    artifact's evidence chain) regardless of warn/error mode."""
     if not mode or not report:
         return report
+    _telemetry_findings(report)
     if mode == 'error' and report.high:
         raise LintError(report.render(report.high), report=report)
     warnings.warn(str(report), LintWarning, stacklevel=3)
     return report
+
+
+def _telemetry_findings(report):
+    """One ``lint_finding`` telemetry event per finding (never
+    raises — telemetry must not break a compile)."""
+    try:
+        from .. import telemetry
+        for f in report:
+            telemetry.event('lint_finding', rule=f.rule,
+                            severity=f.severity, file=f.file,
+                            line=f.line, origin=f.origin,
+                            name=report.name)
+            telemetry.add(f'lint.{f.severity}')
+    except Exception:       # pragma: no cover - defensive
+        pass
 
 
 def safe_emit(build_report, mode):

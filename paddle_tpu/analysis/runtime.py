@@ -83,7 +83,16 @@ def note_retrace(name, n_variants, threshold=8, instance=None):
     crossing PER CACHE — pass the owning cache/object as `instance`
     so two models sharing a label don't mask each other) with a
     recompile-hazard finding; returns the Finding when one was
-    emitted, else None."""
+    emitted, else None.
+
+    Every call past the first variant additionally lands a telemetry
+    ``retrace`` event + counter, so retraces are COUNTABLE per run
+    (run_report) even below the warning threshold — static analysis
+    sees one signature; only this monitor sees the cache fork."""
+    if n_variants >= 2:
+        from .. import telemetry
+        telemetry.event('retrace', name=name, variants=n_variants)
+        telemetry.add('retrace.count')
     if n_variants < threshold or (n_variants & (n_variants - 1)):
         return None           # warn at threshold, 2x, 4x, ... only
     key = (name, n_variants, id(instance))

@@ -54,15 +54,18 @@ class _SaveHandle:
     runs at most once, so a transient commit failure is retryable
     without double-closing."""
 
-    def __init__(self, ckptr, on_commit=None):
+    def __init__(self, ckptr, on_commit=None, step=None):
         self._ckptr = ckptr
         self._on_commit = on_commit
         self._drained = False
         self._done = False
+        self._step = step
 
     def wait(self):
         if self._done:
             return
+        import time as _time
+        t0 = _time.perf_counter()
         if not self._drained:
             if hasattr(self._ckptr, 'wait_until_finished'):
                 self._ckptr.wait_until_finished()
@@ -71,6 +74,9 @@ class _SaveHandle:
         if self._on_commit is not None:
             self._on_commit()
         self._done = True
+        from ..telemetry import event as _tevent
+        _tevent('checkpoint_commit', step=self._step,
+                dur_s=round(_time.perf_counter() - t0, 6))
 
     @property
     def committed(self):
@@ -88,10 +94,19 @@ def save_sharded(tree, path, async_save=True, overwrite=True,
     crash-shaped tear without re-reading multi-GB shards inside the
     post-save barrier (see resilience.manifest.write_manifest).
     """
+    import time as _time
     import orbax.checkpoint as ocp
+    from ..telemetry import event as _tevent
     path = os.path.abspath(path)
     ckptr = _checkpointer(async_save)
+    _t0 = _time.perf_counter()
     ckptr.save(path, args=ocp.args.StandardSave(tree), force=overwrite)
+    # async mode: dispatch_s is the synchronous cost the step loop
+    # paid; the device→disk copy overlaps later compute and its drain
+    # is timed by the checkpoint_commit event in _SaveHandle.wait()
+    _tevent('checkpoint_save', step=step, path=path,
+            async_save=bool(async_save),
+            dispatch_s=round(_time.perf_counter() - _t0, 6))
     on_commit = None
     if commit:
         # jax.process_index 0 ran the directory-level finalize; it also
@@ -110,7 +125,7 @@ def save_sharded(tree, path, async_save=True, overwrite=True,
             spec_tree = _abstractify(tree)
             on_commit = lambda: _manifest.write_manifest(  # noqa: E731
                 path, step=step, tree=spec_tree, checksums=checksums)
-    handle = _SaveHandle(ckptr, on_commit=on_commit)
+    handle = _SaveHandle(ckptr, on_commit=on_commit, step=step)
     if not async_save:
         handle.wait()
     return handle
@@ -226,6 +241,9 @@ class CheckpointManager:
             if not os.path.exists(dst):
                 try:
                     os.replace(src, dst)
+                    from ..telemetry import event as _tevent
+                    _tevent('checkpoint_quarantine', step=step,
+                            path=src, moved_to=dst)
                     return dst
                 except OSError:
                     break
@@ -321,5 +339,8 @@ class CheckpointManager:
                         f'restore template does not match checkpoint '
                         f'{path}: ' + '; '.join(diffs[:5])
                         + ('...' if len(diffs) > 5 else ''))
-            return load_sharded(path, like), s
+            from ..telemetry import span as _tspan
+            with _tspan('checkpoint_restore', step=s, path=path):
+                tree = load_sharded(path, like)
+            return tree, s
         return None, -1

@@ -5,7 +5,6 @@ ProgBarLogger, ModelCheckpoint, LRScheduler, EarlyStopping, VisualDL).
 VisualDL has no TPU-side service here, so it degrades to a JSONL event
 log with the same constructor.
 """
-import json
 import numbers
 import os
 import sys
@@ -357,6 +356,14 @@ class NanGuard(Callback):
         elif action == 'rollback':
             rolled = self.rollback and \
                 self.model._rollback_to_good_state()
+            # post-mortem evidence: the sentinel already emitted the
+            # nan_rollback event; write the durable flight-recorder
+            # copy next to the checkpoints when a save_dir exists
+            if self.params.get('save_dir'):
+                from ..telemetry import dump_flight
+                dump_flight(os.path.join(
+                    self.params['save_dir'],
+                    f'flightrec-{step + 1}.json'))
             if self.verbose:
                 print('NanGuard: {} consecutive non-finite steps — '
                       '{}'.format(
@@ -376,47 +383,78 @@ class NanGuard(Callback):
 
 
 class VisualDL(Callback):
-    """Scalar logging; writes JSONL events (no VisualDL service on TPU
-    hosts — same constructor as the reference's VisualDL callback)."""
+    """Scalar logging onto the telemetry ScalarAdapter (no VisualDL
+    service on TPU hosts — same constructor as the reference's
+    VisualDL callback; same ``events.jsonl`` on disk, and every record
+    additionally lands in the telemetry stream as a ``scalar`` event).
 
-    def __init__(self, log_dir='./log'):
+    Sync-free by buffering: logs carry DEVICE scalars on the lazy
+    train path, and the old per-step ``float(loss)`` write stalled the
+    XLA queue every batch — the exact host sync the sync-free loop
+    removed.  Records now buffer un-materialized and are floated +
+    written only every `log_freq` steps and at epoch/eval/train end;
+    by then the buffered arrays are log_freq steps old and already
+    computed, so the flush does not stall the current step."""
+
+    def __init__(self, log_dir='./log', log_freq=10):
         super().__init__()
         self.log_dir = log_dir
-        self._fh = None
+        self.log_freq = max(1, int(log_freq))
+        self._writer = None
         self._step = 0
+        self._buf = []      # (tag, step, {key: device-or-py scalar})
 
-    def _write(self, tag, logs):
-        if self._fh is None:
-            os.makedirs(self.log_dir, exist_ok=True)
-            self._fh = open(os.path.join(self.log_dir, 'events.jsonl'), 'a')
-        rec = {'tag': tag, 'step': self._step, 'ts': time.time()}
-        for k, v in (logs or {}).items():
-            if isinstance(v, numbers.Number):
-                rec[k] = v
-            elif isinstance(v, (list, tuple)) and v and \
-                    isinstance(v[0], numbers.Number):
-                rec[k] = list(v)
-            else:
-                # lazy-loss path: logs carry device scalars; a logging
-                # callback is a log boundary, so IT pays the sync
-                try:
-                    rec[k] = float(getattr(v, 'value', v))
-                except (TypeError, ValueError):
-                    pass
-        self._fh.write(json.dumps(rec) + '\n')
-        self._fh.flush()
+    def _adapter(self):
+        if self._writer is None:
+            from ..telemetry import ScalarAdapter
+            self._writer = ScalarAdapter(self.log_dir)
+        return self._writer
+
+    @staticmethod
+    def _materialize(v):
+        if isinstance(v, numbers.Number):
+            return v
+        if isinstance(v, (list, tuple)) and v and \
+                isinstance(v[0], numbers.Number):
+            return list(v)
+        try:
+            return float(getattr(v, 'value', v))
+        except (TypeError, ValueError):
+            return None
+
+    def flush(self):
+        """Materialize buffered device scalars (the one sync, at the
+        log boundary) and write them through the adapter."""
+        if not self._buf:
+            return
+        buf, self._buf = self._buf, []
+        w = self._adapter()
+        for tag, step, logs in buf:
+            vals = {}
+            for k, v in logs.items():
+                fv = self._materialize(v)
+                if fv is not None:
+                    vals[k] = fv
+            w.write_record(tag, step, vals)
 
     def on_train_batch_end(self, step, logs=None):
         self._step += 1
-        self._write('train', logs)
+        self._buf.append(('train', self._step, dict(logs or {})))
+        if len(self._buf) >= self.log_freq:
+            self.flush()
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.flush()
 
     def on_eval_end(self, logs=None):
-        self._write('eval', logs)
+        self._buf.append(('eval', self._step, dict(logs or {})))
+        self.flush()
 
     def on_train_end(self, logs=None):
-        if self._fh:
-            self._fh.close()
-            self._fh = None
+        self.flush()
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
 
 
 def config_callbacks(callbacks=None, model=None, batch_size=None,

@@ -326,7 +326,8 @@ class Model:
         arrays, n_in = self._split_batch(batch)
         st = self._get_fstate()
         key = self._batch_key(arrays, ('train', n_in))
-        if key not in self._train_step_cache:
+        first_call = key not in self._train_step_cache
+        if first_call:
             if self._lint:
                 self._lint_train_step(n_in, st, arrays)
             self._train_step_cache[key] = self._make_train_step(n_in)
@@ -345,10 +346,24 @@ class Model:
             self._base_key_seed = seed
         # optimizer rules take t starting at 1 (Adam bias correction —
         # step_fn derives t = prev_step + 1 on device)
+        if first_call:
+            import time as _time
+            _ct0 = _time.perf_counter()
         new_params, new_buf, new_opt, new_step, loss, ok, mres = fn(
             st['params'], st['buffers'], st['opt'], self._base_key,
             jnp.asarray(st['step'], jnp.int32),
             jnp.asarray(self._optimizer.get_lr(), jnp.float32), *arrays)
+        if first_call:
+            # the first call of a new cache entry traces + XLA-compiles
+            # synchronously before dispatching, so this delta IS the
+            # compile cost (execution itself stays async)
+            from .. import telemetry
+            _dt = _time.perf_counter() - _ct0
+            telemetry.event('compile', name='Model.train_batch',
+                            dur_s=round(_dt, 6),
+                            variants=len(self._train_step_cache))
+            telemetry.add('compile.count')
+            telemetry.add('compile.total_s', _dt)
         # donation invalidated the inputs — always adopt the returned
         # arrays (they hold the OLD values when the step was skipped)
         if self._check_finite_steps:
@@ -407,15 +422,27 @@ class Model:
         else:
             params, buffers = st['params'], st['buffers']
         key = self._batch_key(arrays, ('eval', n_in))
-        if key not in self._eval_step_cache:
+        first_call = key not in self._eval_step_cache
+        if first_call:
             self._eval_step_cache[key] = self._make_eval_step(n_in)
         # eval runs layers in eval() mode (dropout off), but seed from
         # the user's paddle.seed anyway: a layer that samples in eval
         # must not silently pin to a hard-coded stream
         from ..core import rng as rng_mod
+        if first_call:
+            import time as _time
+            _ct0 = _time.perf_counter()
         outs, loss, mres = self._eval_step_cache[key](
             params, buffers, jax.random.PRNGKey(rng_mod.get_seed()),
             *arrays)
+        if first_call:
+            from .. import telemetry
+            _dt = _time.perf_counter() - _ct0
+            telemetry.event('compile', name='Model.eval_batch',
+                            dur_s=round(_dt, 6),
+                            variants=len(self._eval_step_cache))
+            telemetry.add('compile.count')
+            telemetry.add('compile.total_s', _dt)
         for m, r in zip(self._metrics, mres):
             m.update(r) if not isinstance(r, (tuple, list)) \
                 else m.update(*r)
@@ -485,11 +512,14 @@ class Model:
         # auto_checkpoint range) installed them, they are restored on
         # exit so a later Ctrl-C still kills the program normally
         from ..resilience import shutdown as _sd
+        from .. import telemetry as _tel
         _owned_handlers = not _sd.handler_installed()
         _install_shutdown()
         try:
-            self._fit_loop(cbks, train_loader, eval_loader, epochs,
-                           eval_freq, batch_size, num_workers)
+            with _tel.span('fit', epochs=epochs):
+                self._fit_loop(cbks, train_loader, eval_loader, epochs,
+                               eval_freq, batch_size, num_workers,
+                               log_freq=log_freq)
         finally:
             requested = _sd.shutdown_requested()
             sig = _sd.preemption_signal()
@@ -509,25 +539,70 @@ class Model:
             # cluster agent: the final checkpoint just landed in
             # on_train_end, exit with the code the elastic supervisor
             # restarts for free.  SIGINT (user) instead returns
-            # control with training cleanly stopped.
+            # control with training cleanly stopped.  The flight
+            # recorder lands NEXT TO that checkpoint so the preempted
+            # worker is post-mortemable without live logs (the signal
+            # handler already ring-buffered the preemption event; this
+            # writes the durable copy inside the grace window).
+            try:
+                step = int(self._optimizer._global_step)
+            except (TypeError, ValueError):
+                step = -1
+            _tel.event('preemption', signum=sig, where='hapi.fit',
+                       step=step)
+            dump_dir = save_dir or _tel.flight_dir()
+            if dump_dir:
+                _tel.dump_flight(os.path.join(
+                    dump_dir, f'flightrec-{step}.json'))
             _sd.exit_if_requested()
         return self
 
     def _fit_loop(self, cbks, train_loader, eval_loader, epochs,
-                  eval_freq, batch_size, num_workers):
+                  eval_freq, batch_size, num_workers, log_freq=10):
+        import time as _time
+        from .. import telemetry as _tel
+        _perf = _time.perf_counter
+        # sync-free telemetry: device loss scalars + host step/wait
+        # times buffer in the accumulator and flush every
+        # flush_interval steps (None when telemetry is not enabled)
+        acc = _tel.step_accumulator('train')
+        # metric accumulate() is a device readback: pay it only on
+        # steps some logger actually prints — the union of fit's
+        # log_freq and every callback's own log_freq (a user
+        # ProgBarLogger(log_freq=3) under fit(log_freq=10) must still
+        # see metric values at ITS boundaries)
+        log_freqs = {max(1, int(log_freq))}
+        for cb in cbks:
+            f = getattr(cb, 'log_freq', None)
+            if isinstance(f, int) and f > 0:
+                log_freqs.add(f)
         cbks.on_train_begin({})
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch, {})
             for m in self._metrics:
                 m.reset()
             logs = {}
-            for step, batch in enumerate(train_loader):
+            step = -1
+            loader_it = iter(train_loader)
+            while True:
+                _tw0 = _perf()
+                try:
+                    batch = next(loader_it)
+                except StopIteration:
+                    break
+                wait_s = _perf() - _tw0
+                step += 1
                 cbks.on_train_batch_begin(step, {})
                 arrays, n_in = self._split_batch(batch)
+                _ts0 = _perf()
                 loss, _ = self.train_batch(arrays[:n_in], arrays[n_in:])
+                if acc is not None:
+                    acc.observe(step=step, step_time_s=_perf() - _ts0,
+                                wait_s=wait_s, loss=loss)
                 logs = {'loss': loss}
-                for m in self._metrics:
-                    logs[str(m.name())] = m.accumulate()
+                if any((step + 1) % f == 0 for f in log_freqs):
+                    for m in self._metrics:
+                        logs[str(m.name())] = m.accumulate()
                 cbks.on_train_batch_end(step, logs)
                 if _shutdown_requested():
                     # preemption (SIGTERM latched by GracefulShutdown):
@@ -539,6 +614,10 @@ class Model:
                     self.stop_training = True
                 if self.stop_training:
                     break
+            if acc is not None:
+                acc.flush()
+            for m in self._metrics:
+                logs[str(m.name())] = m.accumulate()
             cbks.on_epoch_end(epoch, logs)
             if self.stop_training:
                 # preemption/early-stop: every second of the grace
@@ -569,16 +648,18 @@ class Model:
                 log_freq=log_freq, verbose=verbose, mode='eval',
                 metrics=['loss'] + [m.name() for m in self._metrics])
             cbks.on_eval_begin({})
-        for step, batch in enumerate(loader):
-            arrays, n_in = self._split_batch(batch)
-            # lazy path: the loss stays a device array and the metric
-            # updates are jnp adds — zero per-batch host syncs; a
-            # callback that formats the loss pays the sync itself,
-            # and only when it actually logs
-            _, loss = self._eval_batch_lazy(arrays, n_in)
-            total_loss = total_loss + loss
-            n_batches += 1
-            cbks.on_eval_batch_end(step, {'loss': loss})
+        from .. import telemetry as _tel
+        with _tel.span('evaluate'):
+            for step, batch in enumerate(loader):
+                arrays, n_in = self._split_batch(batch)
+                # lazy path: the loss stays a device array and the
+                # metric updates are jnp adds — zero per-batch host
+                # syncs; a callback that formats the loss pays the
+                # sync itself, and only when it actually logs
+                _, loss = self._eval_batch_lazy(arrays, n_in)
+                total_loss = total_loss + loss
+                n_batches += 1
+                cbks.on_eval_batch_end(step, {'loss': loss})
         logs = {'loss': float(total_loss) / max(1, n_batches)}
         for m in self._metrics:
             logs[str(m.name())] = m.accumulate()

@@ -216,6 +216,15 @@ def _range(kind, max_num):
                     # is saved, hand control back as Ctrl-C always has
                     clear_shutdown()
                     raise KeyboardInterrupt
+                # preemption: durable flight-recorder dump next to the
+                # snapshot so the restarted worker's post-mortem holds
+                # the final grace-window timeline
+                path = _ckpt_path()
+                if path is not None:
+                    from ... import telemetry
+                    telemetry.dump_flight(os.path.join(
+                        os.path.dirname(path),
+                        f'flightrec-{kind}{i + 1}.json'))
                 sys.exit(PREEMPTED_EXIT_CODE)
             if _should_save() or i == max_num - 1:
                 _save_snapshot({'kind': kind, 'next': i + 1})

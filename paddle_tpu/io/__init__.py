@@ -30,6 +30,7 @@ import bisect
 import itertools
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -768,13 +769,41 @@ class DataLoader:
             for p in procs:
                 p.join(timeout=2)
 
+    def _telemetry_iter(self, inner):
+        """Time each dequeue — the HOST-WAIT gauge: how long the
+        training loop blocked on this loader per batch (for the
+        threaded/native paths that is queue-pop time, i.e. true
+        starvation; for the sync path it is fetch+collate).  Pure
+        perf_counter deltas on the host — never touches the device."""
+        from .. import telemetry
+        _perf = time.perf_counter
+        while True:
+            t0 = _perf()
+            try:
+                item = next(inner)
+            except StopIteration:
+                return
+            dt = _perf() - t0
+            telemetry.add('io.dataloader.wait_s', dt)
+            telemetry.add('io.dataloader.batches', 1)
+            telemetry.set_gauge('io.dataloader.last_wait_ms',
+                                round(dt * 1000.0, 4))
+            yield item
+
     def __iter__(self):
         if self.num_workers > 0 and not self._iterable \
                 and self.batch_sampler is not None:
             if self.use_process_workers:
-                return self._iter_process()
-            from . import native as _native
-            if self.use_native_loader and _native.available():
-                return self._iter_native()
-            return self._iter_threaded()
-        return self._iter_sync()
+                it = self._iter_process()
+            else:
+                from . import native as _native
+                if self.use_native_loader and _native.available():
+                    it = self._iter_native()
+                else:
+                    it = self._iter_threaded()
+        else:
+            it = self._iter_sync()
+        from ..telemetry import active as _telemetry_active
+        if _telemetry_active():
+            return self._telemetry_iter(it)
+        return it

@@ -428,6 +428,11 @@ class ParallelTrainer:
                      for b in batch)
         if self._compiled is None:
             self._n_batch = len(vals)
+            # abstract shapes only — pinning the real batch arrays
+            # would hold a full global batch in HBM for the trainer's
+            # lifetime just in case the HLO audit runs
+            self._example_vals = tuple(
+                jax.ShapeDtypeStruct(v.shape, v.dtype) for v in vals)
             self._compiled = self._build_step()
             if self.lint:
                 self._run_lint(vals)
@@ -453,13 +458,19 @@ class ParallelTrainer:
         """batch: numpy/jax arrays (x, y, ...). Returns python float loss."""
         if self._pipeline:
             return self._pipe_step(*batch)
+        import time as _time
+        from .. import telemetry as _tel
+        first_call = self._compiled is None
         vals = self._ensure_compiled(batch)
         key = rng_mod.next_key()
+        _t0 = _time.perf_counter()
         if self.nan_guard:
             (self.params, self.buffers, self.opt_state, loss,
              ok) = self._compiled(
                 self.params, self.buffers, self.opt_state,
                 jnp.asarray(self._step_no + 1), key, *vals)
+            self._note_step(first_call, _time.perf_counter() - _t0,
+                            loss, _tel)
             ok = bool(ok)   # the one host sync nan_guard costs
             if ok:
                 self._step_no += 1
@@ -470,8 +481,72 @@ class ParallelTrainer:
             self.params, self.buffers, self.opt_state,
             jnp.asarray(self._step_no + 1), key, *vals)
         self._step_no += 1
+        self._note_step(first_call, _time.perf_counter() - _t0, loss,
+                        _tel)
         # LR-scheduler advancement is the caller's job (hapi epoch loop)
         return loss
+
+    def _note_step(self, first_call, dt, loss, _tel):
+        """Telemetry for one step() call: the first call of a fresh
+        compile is recorded as the compile cost (jit traces+compiles
+        synchronously before dispatching); steady-state calls feed the
+        sync-free accumulator — the loss stays a DEVICE scalar in the
+        buffer and is read back only at flush_interval boundaries."""
+        if first_call:
+            _tel.event('compile', name='ParallelTrainer.step',
+                       dur_s=round(dt, 6))
+            _tel.add('compile.count')
+            _tel.add('compile.total_s', dt)
+            self._maybe_collective_census()
+            return
+        acc = getattr(self, '_tel_acc', None)
+        if acc is None:
+            acc = self._tel_acc = _tel.step_accumulator('parallel')
+            if acc is None:
+                return
+        acc.observe(step=self._step_no, step_time_s=dt, loss=loss)
+
+    def _maybe_collective_census(self):
+        """EQuARX-groundwork comms audit: when full telemetry is on,
+        parse THIS step's optimized HLO (profiler's parser) and emit
+        per-collective call/byte counts.  Costs one AOT lower+compile
+        of the already-jitted step (deduped by the persistent XLA
+        cache); never raises."""
+        from .. import telemetry as _tel
+        if not _tel.enabled() or self.mesh is None:
+            return
+        try:
+            from ..profiler import (_work_lines, _HLO_INSTR,
+                                    _buffer_bytes)
+            key = jax.random.PRNGKey(0)
+            with _tel.span('hlo_audit'):
+                compiled = self._compiled.lower(
+                    self.params, self.buffers, self.opt_state,
+                    jnp.zeros((), jnp.int32), key,
+                    *self._example_vals).compile()
+                text = compiled.as_text()
+            per_op = {}
+            for line in _work_lines(text):
+                m = _HLO_INSTR.match(line)
+                if not m:
+                    continue
+                type_spec, opcode = m.groups()
+                base = opcode[:-6] if opcode.endswith('-start') \
+                    else opcode
+                if base not in ('all-reduce', 'all-gather',
+                                'reduce-scatter', 'collective-permute',
+                                'all-to-all'):
+                    continue
+                row = per_op.setdefault(base, {'calls': 0, 'bytes': 0})
+                row['calls'] += 1
+                row['bytes'] += _buffer_bytes(type_spec)
+            total = sum(r['bytes'] for r in per_op.values())
+            _tel.event('collectives', name='ParallelTrainer.step',
+                       mesh=dict(self.mesh.shape), per_op=per_op,
+                       total_bytes=total)
+            _tel.add('collective.bytes', total)
+        except Exception:       # audit is evidence, never a blocker
+            pass
 
     def _nan_rollback(self):
         """Sentinel-demanded rollback: reload the last COMMITTED
@@ -480,7 +555,9 @@ class ParallelTrainer:
         already kept the params finite, so training simply continues
         (and the sentinel escalates to FloatingPointError if the NaNs
         persist across rollback budgets)."""
+        import os
         import warnings
+        from ..telemetry import dump_flight
         mgr = getattr(self, '_ckpt_mgr', None)
         if mgr is None:
             warnings.warn(
@@ -489,6 +566,11 @@ class ParallelTrainer:
                 'periodically); continuing with skipped updates',
                 RuntimeWarning, stacklevel=2)
             return False
+        # durable post-mortem next to the checkpoint we are about to
+        # restore: the flight ring already holds the nan_skip strikes
+        # and the nan_rollback event that led here
+        dump_flight(os.path.join(mgr.directory,
+                                 f'flightrec-{self._step_no}.json'))
         mgr.wait()   # the in-flight save must commit before we read
         got = self.restore_checkpoint(mgr.directory)
         if got < 0:

@@ -10,9 +10,13 @@ import contextlib
 import math
 import re
 import sys
-import time
 
 import jax
+
+# THE step timer of the stack lives in telemetry (its stop() feeds the
+# recorder's step-time reservoir); this module and utils/profiler used
+# to carry near-duplicate implementations — both now re-export it.
+from ..telemetry import StepTimer  # noqa: F401
 
 __all__ = ['Profiler', 'start_profiler', 'stop_profiler', 'profiler',
            'reset_profiler', 'cuda_profiler', 'StepTimer', 'RecordEvent',
@@ -210,47 +214,6 @@ class RecordEvent:
     def __exit__(self, *exc):
         self._ctx.__exit__(*exc)
         self._ctx = None
-
-
-class StepTimer:
-    """Rolling step-time statistics for training loops.
-
-    Blocks on `sync` targets (device arrays) so timings reflect device
-    completion, not dispatch."""
-
-    def __init__(self, window=50):
-        self.window = window
-        self._times = []
-        self._t0 = None
-
-    def start(self):
-        self._t0 = time.perf_counter()
-
-    def stop(self, sync=None):
-        if sync is not None:
-            jax.block_until_ready(sync)
-        dt = time.perf_counter() - self._t0
-        self._times.append(dt)
-        if len(self._times) > self.window:
-            self._times.pop(0)
-        return dt
-
-    @property
-    def mean_ms(self):
-        if not self._times:
-            return 0.0
-        return sum(self._times) / len(self._times) * 1000.0
-
-    def summary(self):
-        if not self._times:
-            return {}
-        ts = sorted(self._times)
-        n = len(ts)
-        return {'mean_ms': self.mean_ms,
-                'p50_ms': ts[n // 2] * 1000.0,
-                'p90_ms': ts[min(n - 1, int(n * 0.9))] * 1000.0,
-                'max_ms': ts[-1] * 1000.0,
-                'steps': n}
 
 
 class Profiler:

@@ -149,8 +149,21 @@ def buffered(reader, size):
         t = threading.Thread(target=produce, daemon=True)
         t.start()
         try:
+            import time as _time
+            from ..telemetry import active as _tel_active
+            gauge = _tel_active()
             while True:
-                item = q.get()
+                if gauge:
+                    # host-wait gauge: time blocked on the producer
+                    # (same counter family as io.DataLoader's — the
+                    # run report's host-wait split reads both)
+                    _t0 = _time.perf_counter()
+                    item = q.get()
+                    from .. import telemetry
+                    telemetry.add('io.reader.wait_s',
+                                  _time.perf_counter() - _t0)
+                else:
+                    item = q.get()
                 if item is _End:
                     break
                 if isinstance(item, BaseException):

@@ -76,6 +76,17 @@ class NanSentinel:
         except (TypeError, ValueError):
             return False
 
+    @staticmethod
+    def _telemetry(kind, **data):
+        """nan_skip / nan_rollback / nan_fatal land in the run's
+        telemetry stream + flight recorder (never raises)."""
+        try:
+            from .. import telemetry
+            telemetry.event(kind, **data)
+            telemetry.add(f'{kind}.count')
+        except Exception:       # pragma: no cover - defensive
+            pass
+
     def observe(self, loss=None, grad_norm=None, finite=None):
         """Record one step's health; -> 'ok' | 'skip' | 'rollback'.
 
@@ -93,6 +104,8 @@ class NanSentinel:
             if self.on_event:
                 self.on_event('skip', {'strikes': self.strikes,
                                        'loss': loss})
+            self._telemetry('nan_skip', strikes=self.strikes,
+                            total_skipped=self.total_skipped)
             return 'skip'
         # patience exhausted: demand a rollback
         self.strikes = 0
@@ -100,6 +113,7 @@ class NanSentinel:
         if self.rollbacks > self.max_rollbacks:
             if self.on_event:
                 self.on_event('fatal', {'rollbacks': self.rollbacks})
+            self._telemetry('nan_fatal', rollbacks=self.rollbacks)
             raise FloatingPointError(
                 f'training diverged: {self.patience} consecutive '
                 f'non-finite steps after {self.rollbacks - 1} '
@@ -107,6 +121,8 @@ class NanSentinel:
                 'scaling')
         if self.on_event:
             self.on_event('rollback', {'rollbacks': self.rollbacks})
+        self._telemetry('nan_rollback', rollbacks=self.rollbacks,
+                        patience=self.patience)
         return 'rollback'
 
     def state_dict(self):

@@ -64,6 +64,16 @@ class GracefulShutdown:
     def install(self):
         if self._installed:
             return self
+        # pre-create the telemetry recorder on the MAIN thread so the
+        # signal handler's flight-ring note never has to construct it
+        # (get_recorder() takes a non-reentrant creation lock the
+        # handler must not touch)
+        try:
+            from ..telemetry import active, get_recorder
+            if active():
+                get_recorder()
+        except Exception:       # pragma: no cover - defensive
+            pass
         try:
             for s in self.signals:
                 self._prev[s] = signal.signal(s, self._handler)
@@ -107,14 +117,36 @@ class GracefulShutdown:
             return
         self.signum = signum
         self._event.set()
+        self._note_preemption(signum)
         if self.on_request is not None:
             self.on_request(signum)
+
+    @staticmethod
+    def _note_preemption(signum):
+        """Land a ``preemption`` event in the telemetry flight ring.
+        Runs in signal-handler context: event_unlocked is one atomic
+        deque append — no locks of any kind (a signal landing while
+        another thread holds the recorder lock — or the singleton
+        creation lock inside get_recorder() — must not deadlock the
+        latch), no file I/O (the JSONL copy is written by the poll
+        site, e.g. Model.fit's step boundary).  Reads the module
+        global directly: if no recorder exists yet the note is
+        skipped (install() pre-creates it on the main thread, so in
+        practice it exists)."""
+        try:
+            from ..telemetry import recorder as _rmod
+            rec = _rmod._recorder
+            if rec is not None and not _rmod.hard_off():
+                rec.event_unlocked('preemption', signum=signum)
+        except Exception:       # pragma: no cover - defensive
+            pass
 
     def request(self, signum=None):
         """Programmatic preemption request (tests; cluster agents that
         learn of preemption via metadata server rather than signal)."""
         self.signum = signum
         self._event.set()
+        self._note_preemption(signum)
 
     def requested(self):
         return self._event.is_set()

@@ -1,0 +1,108 @@
+"""Telemetry exporters: JSONL stream + TensorBoard-style scalar sink.
+
+JsonlWriter is the durable export — one append-only file per host,
+rank-tagged records, flushed per line so a preempted worker's stream
+is complete up to its last event.  ``tools/run_report.py`` merges
+these files across hosts into one run report.
+
+ScalarAdapter is the TensorBoard-scalar-shaped sink the hapi VisualDL
+callback rewires onto: ``add_scalar(tag, value, step)`` keeps the
+legacy ``events.jsonl`` format the old callback wrote (same keys, same
+file), and additionally forwards each record to the telemetry recorder
+as a ``scalar`` event so the run's scalars live in the same merged
+stream as its spans and resilience timeline.
+"""
+import json
+import os
+import threading
+import time
+
+from .recorder import get_recorder, _jsonable, _rank
+
+__all__ = ['JsonlWriter', 'ScalarAdapter']
+
+
+class JsonlWriter:
+    """Append-only JSONL event stream, one file per host process.
+
+    The filename carries the rank (``telemetry-r<rank>.jsonl``) so a
+    shared checkpoint/log directory collects every host's stream
+    without collisions; each record is additionally rank-tagged for
+    merged readers."""
+
+    def __init__(self, directory, rank=None, filename=None):
+        self.directory = os.path.abspath(directory)
+        self.rank = _rank() if rank is None else rank
+        os.makedirs(self.directory, exist_ok=True)
+        self.path = os.path.join(
+            self.directory, filename or f'telemetry-r{self.rank}.jsonl')
+        self._lock = threading.Lock()
+        self._fh = open(self.path, 'a')
+
+    def write(self, rec):
+        if self._fh is None:
+            return
+        line = json.dumps(dict(rec, rank=self.rank),
+                          default=_jsonable)
+        with self._lock:
+            if self._fh is None:    # closed while we serialized
+                return
+            self._fh.write(line + '\n')
+            # flush per record: events are boundary-rate, and a
+            # preempted worker's stream must be complete on disk
+            self._fh.flush()
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                finally:
+                    self._fh = None
+
+
+class ScalarAdapter:
+    """TensorBoard-scalar-shaped writer over the telemetry stream.
+
+    Keeps the legacy VisualDL ``events.jsonl`` on disk (same format:
+    one JSON object per line with ``tag``/``step``/``ts`` plus metric
+    keys) AND emits each record as a telemetry ``scalar`` event, so
+    scalars logged through the callback are queryable by
+    ``run_report`` next to spans and resilience events."""
+
+    def __init__(self, log_dir, recorder=None):
+        self.log_dir = log_dir
+        self.rec = recorder or get_recorder()
+        self._fh = None
+        self._lock = threading.Lock()
+
+    def _file(self):
+        if self._fh is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._fh = open(
+                os.path.join(self.log_dir, 'events.jsonl'), 'a')
+        return self._fh
+
+    def write_record(self, tag, step, values):
+        """Write one already-materialized record: `values` is a dict
+        of plain numbers / lists (the CALLER pays any device sync, at
+        its own log boundary)."""
+        rec = {'tag': tag, 'step': step, 'ts': time.time()}
+        rec.update(values)
+        with self._lock:
+            fh = self._file()
+            fh.write(json.dumps(rec, default=_jsonable) + '\n')
+            fh.flush()
+        self.rec.event('scalar', tag=tag, step=step, **values)
+        return rec
+
+    def add_scalar(self, tag, value, step):
+        return self.write_record(tag, step, {'value': value})
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                finally:
+                    self._fh = None

@@ -1,0 +1,177 @@
+"""Sync-free per-step telemetry: StepAccumulator + the unified StepTimer.
+
+The training loops this package instruments are sync-free by design
+(PR 2): losses come back as DEVICE scalars and nothing in the hot loop
+reads a device value.  Telemetry must not undo that, so per-step
+scalars are **buffered as device arrays** and materialized only every
+``flush_interval`` steps — by flush time those arrays are
+``flush_interval`` steps old and long since computed, so ``np.asarray``
+returns without stalling the XLA queue that is busy with the *current*
+steps.  The host-side step duration (``perf_counter`` deltas) rides
+along for free: it never touches the device at all.
+
+``StepTimer`` is the one step timer of the stack — the near-duplicate
+rolling-window timers that used to live in ``paddle_tpu/profiler`` and
+``paddle_tpu/utils/profiler`` both re-export this class, which
+additionally feeds the telemetry recorder's step-time reservoir so
+flight dumps and run reports see timings from every entry point.
+"""
+import time
+
+from .recorder import get_recorder, hard_off
+
+__all__ = ['StepAccumulator', 'StepTimer', 'percentiles']
+
+_MONO = time.perf_counter
+
+
+def percentiles(times_s):
+    """Summary stats for a list of per-step durations in SECONDS;
+    all outputs in milliseconds (the unit step times are read in)."""
+    if not times_s:
+        return {}
+    ts = sorted(times_s)
+    n = len(ts)
+
+    def pct(q):
+        return ts[min(n - 1, int(n * q))] * 1000.0
+
+    return {'steps': n,
+            'mean_ms': sum(ts) / n * 1000.0,
+            'p50_ms': pct(0.50),
+            'p90_ms': pct(0.90),
+            'p99_ms': pct(0.99),
+            'max_ms': ts[-1] * 1000.0}
+
+
+class StepAccumulator:
+    """Buffer per-step scalars as device arrays; flush to host every
+    ``flush_interval`` steps.
+
+        acc = telemetry.step_accumulator('train')   # None if disabled
+        ...
+        acc.observe(step=i, step_time_s=dt, wait_s=w, loss=loss)
+
+    ``observe`` does ZERO device reads — device scalars are appended
+    verbatim.  ``flush`` (every interval, and once at loop end)
+    materializes the buffered columns, emits one ``steps`` event with
+    the per-step arrays (step ids, step_time_ms, wait_ms, plus every
+    scalar column), and feeds the recorder's step-time reservoir for
+    percentile summaries.
+    """
+
+    def __init__(self, tag='train', flush_interval=None, recorder=None):
+        self.rec = recorder or get_recorder()
+        self.tag = tag
+        self.flush_interval = max(1, int(
+            flush_interval if flush_interval is not None
+            else self.rec.flush_interval))
+        self._steps = []
+        self._times = []
+        self._waits = []
+        self._scalars = []      # list of {name: device-or-py scalar}
+
+    def __len__(self):
+        return len(self._steps)
+
+    def observe(self, step=None, step_time_s=None, wait_s=None,
+                **scalars):
+        """Record one step.  `scalars` values may be device arrays
+        (kept lazy) or plain numbers; None values are dropped."""
+        self._steps.append(step if step is not None
+                           else (self._steps[-1] + 1 if self._steps
+                                 else 0))
+        self._times.append(step_time_s)
+        self._waits.append(wait_s)
+        self._scalars.append(
+            {k: v for k, v in scalars.items() if v is not None})
+        if len(self._steps) >= self.flush_interval:
+            self.flush()
+
+    def flush(self):
+        """Materialize the buffer (the one host read per interval) and
+        emit a ``steps`` event.  Safe to call with an empty buffer."""
+        if not self._steps:
+            return None
+        import numpy as np
+        steps, times, waits, rows = (self._steps, self._times,
+                                     self._waits, self._scalars)
+        self._steps, self._times, self._waits, self._scalars = \
+            [], [], [], []
+        cols = {}
+        for i, row in enumerate(rows):
+            for k, v in row.items():
+                try:
+                    fv = float(np.asarray(v))
+                except (TypeError, ValueError):
+                    continue
+                cols.setdefault(k, [None] * len(rows))[i] = fv
+        ev = {'tag': self.tag, 'n': len(steps),
+              'step_lo': steps[0], 'step_hi': steps[-1],
+              'step': list(steps)}
+        t_ms = [round(t * 1000.0, 4) for t in times if t is not None]
+        if t_ms:
+            ev['step_time_ms'] = [
+                round(t * 1000.0, 4) if t is not None else None
+                for t in times]
+            for t in times:
+                if t is not None:
+                    self.rec.observe_step_time(t, tag=self.tag)
+        w_ms = [w for w in waits if w is not None]
+        if w_ms:
+            ev['wait_ms'] = [
+                round(w * 1000.0, 4) if w is not None else None
+                for w in waits]
+            self.rec.add('io.host_wait_s', sum(w_ms))
+        ev.update(cols)
+        self.rec.add('steps.count', len(steps))
+        return self.rec.event('steps', **ev)
+
+
+class StepTimer:
+    """Rolling step-time statistics for training loops — THE step
+    timer (``paddle_tpu.profiler.StepTimer`` and
+    ``paddle_tpu.utils.profiler.StepTimer`` are this class).
+
+    Blocks on `sync` targets (device arrays) so timings reflect device
+    completion, not dispatch.  Unless ``record=False``, every stop()
+    also lands in the telemetry recorder's step-time reservoir so the
+    flight dump / run report summarize timings from ad-hoc profiling
+    loops too."""
+
+    def __init__(self, window=50, record=True, tag='steptimer'):
+        self.window = window
+        self.tag = tag
+        self._record = bool(record) and not hard_off()
+        self._times = []
+        self._t0 = None
+
+    def start(self):
+        self._t0 = _MONO()
+
+    def stop(self, sync=None):
+        if sync is not None:
+            import jax
+            jax.block_until_ready(sync)
+        dt = _MONO() - self._t0
+        self._times.append(dt)
+        if len(self._times) > self.window:
+            self._times.pop(0)
+        if self._record:
+            get_recorder().observe_step_time(dt, tag=self.tag)
+        return dt
+
+    @property
+    def mean_ms(self):
+        if not self._times:
+            return 0.0
+        return sum(self._times) / len(self._times) * 1000.0
+
+    def summary(self):
+        if not self._times:
+            return {}
+        s = percentiles(self._times)
+        # historical key set (profiler.StepTimer callers)
+        return {'mean_ms': s['mean_ms'], 'p50_ms': s['p50_ms'],
+                'p90_ms': s['p90_ms'], 'max_ms': s['max_ms'],
+                'steps': s['steps']}

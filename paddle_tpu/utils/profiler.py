@@ -11,9 +11,13 @@ import warnings
 
 from ..profiler import (start_profiler, stop_profiler, profiler,
                         reset_profiler, cuda_profiler)
+# the one step timer (telemetry-backed; paddle_tpu.profiler re-exports
+# the same class — the old per-module duplicates are gone)
+from ..telemetry import StepTimer  # noqa: F401
 
 __all__ = ['Profiler', 'get_profiler', 'ProfilerOptions', 'cuda_profiler',
-           'start_profiler', 'profiler', 'stop_profiler', 'reset_profiler']
+           'start_profiler', 'profiler', 'stop_profiler', 'reset_profiler',
+           'StepTimer']
 
 
 class ProfilerOptions:

@@ -106,6 +106,39 @@ def preflight(timeout_s=90):
         return False
 
 
+def collect_flightrecs(name):
+    """Copy any telemetry flight-recorder dumps a step left behind
+    (flightrec-*.json next to checkpoints / scratch dirs under the
+    repo) into the committed evidence dir — a tunnel death right after
+    a preemption/NaN event must not lose its post-mortem.  Dumps are
+    renamed '<step>__<orig>' so successive steps never clobber."""
+    import shutil
+    dst_dir = os.path.join(OUT, 'flightrec')
+    skip = {'.git', '.jax_cache', '.pytest_cache', '__pycache__',
+            'node_modules'}
+    found = 0
+    for root, dirs, files in os.walk(REPO):
+        dirs[:] = [d for d in dirs if d not in skip]
+        if os.path.abspath(root).startswith(os.path.abspath(dst_dir)):
+            continue
+        for f in files:
+            if not (f.startswith('flightrec-') and f.endswith('.json')):
+                continue
+            src = os.path.join(root, f)
+            os.makedirs(dst_dir, exist_ok=True)
+            dst = os.path.join(dst_dir, f'{name}__{f}')
+            try:
+                if not os.path.exists(dst) or \
+                        os.path.getmtime(src) > os.path.getmtime(dst):
+                    shutil.copy2(src, dst)
+                    found += 1
+            except OSError:
+                pass
+    if found:
+        log(f'{name}: {found} flight-recorder dump(s) archived to '
+            f'{dst_dir}')
+
+
 def commit_artifacts(name, ok):
     """Commit the step's artifacts IMMEDIATELY (round-4 lesson: the
     only copies of a whole session's measurements lived in gitignored
@@ -161,9 +194,11 @@ def run_step(name, argv, timeout_s):
                                timeout=timeout_s)
         except subprocess.TimeoutExpired:
             log(f'{name}: TIMED OUT after {timeout_s}s')
+            collect_flightrecs(name)
             commit_artifacts(name, ok=False)
             return False
     dt = time.time() - t0
+    collect_flightrecs(name)
     if p.returncode == 0:
         with open(okf, 'w') as fh:
             fh.write(json.dumps({'t': time.time(), 'dur_s': dt}))

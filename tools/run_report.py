@@ -1,0 +1,336 @@
+#!/usr/bin/env python
+"""run_report — merge per-host telemetry JSONL into one run report.
+
+The telemetry layer (paddle_tpu.telemetry) streams rank-tagged events
+to one ``telemetry-r<rank>.jsonl`` per host; resilience additionally
+drops ``flightrec-*.json`` flight-recorder dumps next to checkpoints.
+This CLI merges all of them and reconstructs what happened:
+
+    python tools/run_report.py <dir>            # human report
+    python tools/run_report.py <dir> --json     # bench/CI schema
+    python tools/run_report.py a.jsonl b.jsonl  # explicit files
+
+Report sections:
+  * step-time percentiles per loop tag (p50/p90/p99, from the
+    accumulators' flushed ``steps`` events);
+  * compile: total seconds + per-name breakdown, retrace count;
+  * device-step vs host-wait split (step_time vs dataloader wait);
+  * collectives census (per-op calls/bytes, when a mesh step emitted
+    one);
+  * the resilience event timeline (preemption, nan_skip/rollback,
+    checkpoint save/commit/restore/quarantine) in wall-clock order.
+
+``--json`` emits one stable dict (schema_version 1) that bench.py and
+CI consume; tests/test_event_telemetry.py schema-checks it.
+
+Stdlib-only on purpose: it must run on a dev machine against JSONL
+scraped off a dead worker, with no jax install.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+SCHEMA_VERSION = 1
+
+RESILIENCE_KINDS = (
+    'preemption', 'nan_skip', 'nan_rollback', 'nan_fatal',
+    'checkpoint_save', 'checkpoint_commit', 'checkpoint_restore',
+    'checkpoint_quarantine', 'flight_dump', 'crash')
+
+
+def _percentiles(times_ms):
+    if not times_ms:
+        return {}
+    ts = sorted(times_ms)
+    n = len(ts)
+
+    def pct(q):
+        return round(ts[min(n - 1, int(n * q))], 4)
+
+    return {'steps': n,
+            'mean_ms': round(sum(ts) / n, 4),
+            'p50_ms': pct(0.50), 'p90_ms': pct(0.90),
+            'p99_ms': pct(0.99), 'max_ms': round(ts[-1], 4)}
+
+
+def discover(paths):
+    """Expand dirs/files into (jsonl_files, flightrec_files)."""
+    jsonls, flights = [], []
+    for p in paths:
+        if os.path.isdir(p):
+            jsonls += sorted(glob.glob(
+                os.path.join(p, 'telemetry-*.jsonl')))
+            jsonls += sorted(glob.glob(
+                os.path.join(p, '**', 'telemetry-*.jsonl'),
+                recursive=True))
+            flights += sorted(glob.glob(
+                os.path.join(p, '**', 'flightrec-*.json'),
+                recursive=True))
+        elif p.endswith('.jsonl'):
+            jsonls.append(p)
+        elif p.endswith('.json'):
+            flights.append(p)
+    # de-dup while keeping order (dir glob may double-match)
+    seen = set()
+    jsonls = [f for f in jsonls
+              if not (f in seen or seen.add(f))]
+    return jsonls, flights
+
+
+def load_events(jsonl_files, flight_files):
+    """All events from every source, plus per-file metadata.
+    Flight dumps contribute their embedded event rings (rank-tagged
+    from the dump header); duplicate (ts, kind, rank) records — an
+    event both streamed and ring-dumped — collapse to one."""
+    events, sources = [], []
+    for f in jsonl_files:
+        n = 0
+        with open(f) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue        # torn final line of a dead worker
+                if isinstance(rec, dict) and 'kind' in rec:
+                    rec.setdefault('rank', 0)
+                    events.append(rec)
+                    n += 1
+        sources.append({'file': f, 'records': n, 'type': 'jsonl'})
+    for f in flight_files:
+        try:
+            with open(f) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        rank = doc.get('rank', 0)
+        n = 0
+        for rec in doc.get('events', []):
+            if isinstance(rec, dict) and 'kind' in rec:
+                rec = dict(rec)
+                rec.setdefault('rank', rank)
+                events.append(rec)
+                n += 1
+        sources.append({'file': f, 'records': n, 'type': 'flightrec',
+                        'counters': doc.get('counters', {})})
+    seen = set()
+    out = []
+    for e in events:
+        # monotonic 't' joins the key so two DISTINCT same-kind events
+        # in the same rounded microsecond survive; a record that was
+        # both streamed and ring-dumped shares all four fields
+        k = (e.get('ts'), e.get('t'), e.get('kind'), e.get('rank'))
+        if k in seen:
+            continue
+        seen.add(k)
+        out.append(e)
+    out.sort(key=lambda e: e.get('ts') or 0)
+    return out, sources
+
+
+def analyze(events, sources):
+    """The merged run report as one dict (the --json schema)."""
+    by_kind = {}
+    for e in events:
+        by_kind.setdefault(e['kind'], []).append(e)
+
+    # -- step-time percentiles + host-wait split per loop tag ----
+    step_stats, split = {}, {}
+    scalars_last = {}
+    total_steps = 0
+    for ev in by_kind.get('steps', ()):
+        tag = ev.get('tag', 'train')
+        st = step_stats.setdefault(tag, {'times_ms': [], 'waits_ms': [],
+                                         'n': 0})
+        st['n'] += ev.get('n', 0)
+        total_steps += ev.get('n', 0)
+        st['times_ms'] += [t for t in ev.get('step_time_ms') or []
+                           if t is not None]
+        st['waits_ms'] += [w for w in ev.get('wait_ms') or []
+                           if w is not None]
+        for k, col in ev.items():
+            if k in ('kind', 'ts', 't', 'rank', 'tag', 'n', 'step',
+                     'step_lo', 'step_hi', 'step_time_ms', 'wait_ms'):
+                continue
+            if isinstance(col, list) and col:
+                vals = [v for v in col if v is not None]
+                if vals:
+                    scalars_last.setdefault(tag, {})[k] = vals[-1]
+    steps_out = {}
+    for tag, st in step_stats.items():
+        steps_out[tag] = _percentiles(st['times_ms'])
+        steps_out[tag]['count'] = st['n']
+        dev_ms = sum(st['times_ms'])
+        wait_ms = sum(st['waits_ms'])
+        if dev_ms or wait_ms:
+            tot = dev_ms + wait_ms
+            split[tag] = {
+                'device_step_ms': round(dev_ms, 3),
+                'host_wait_ms': round(wait_ms, 3),
+                'host_wait_frac': round(wait_ms / tot, 6) if tot else 0.0,
+            }
+
+    # -- compile / retrace ---------------------------------------
+    compile_events = by_kind.get('compile', [])
+    per_name = {}
+    for e in compile_events:
+        row = per_name.setdefault(e.get('name', '?'),
+                                  {'count': 0, 'total_s': 0.0})
+        row['count'] += 1
+        row['total_s'] = round(row['total_s'] + (e.get('dur_s') or 0.0),
+                               6)
+    compile_out = {
+        'count': len(compile_events),
+        'total_s': round(sum(e.get('dur_s') or 0.0
+                             for e in compile_events), 6),
+        'per_name': per_name,
+    }
+    retraces = by_kind.get('retrace', [])
+    retrace_out = {'count': len(retraces)}
+    if retraces:
+        worst = max(retraces, key=lambda e: e.get('variants', 0))
+        retrace_out['max_variants'] = worst.get('variants')
+        retrace_out['worst'] = worst.get('name')
+
+    # -- collectives ---------------------------------------------
+    coll = by_kind.get('collectives', [])
+    collectives = None
+    if coll:
+        last = coll[-1]
+        collectives = {'per_op': last.get('per_op', {}),
+                       'total_bytes': last.get('total_bytes', 0),
+                       'mesh': last.get('mesh')}
+
+    # -- lint findings -------------------------------------------
+    lint = {}
+    for e in by_kind.get('lint_finding', ()):
+        lint[e.get('severity', '?')] = \
+            lint.get(e.get('severity', '?'), 0) + 1
+
+    # -- resilience timeline -------------------------------------
+    timeline = []
+    t0 = events[0]['ts'] if events else 0
+    for e in events:
+        if e['kind'] not in RESILIENCE_KINDS:
+            continue
+        row = {'t_rel_s': round((e.get('ts') or t0) - t0, 3),
+               'kind': e['kind'], 'rank': e.get('rank', 0)}
+        for k in ('step', 'signum', 'strikes', 'rollbacks', 'path',
+                  'moved_to', 'dur_s', 'dispatch_s', 'error'):
+            if e.get(k) is not None:
+                row[k] = e[k]
+        timeline.append(row)
+
+    ranks = sorted({e.get('rank', 0) for e in events})
+    spans = {}
+    for e in by_kind.get('span', ()):
+        row = spans.setdefault(e.get('name', '?'),
+                               {'count': 0, 'total_s': 0.0})
+        row['count'] += 1
+        row['total_s'] = round(row['total_s'] + (e.get('dur_s') or 0.0),
+                               6)
+    return {
+        'schema_version': SCHEMA_VERSION,
+        'hosts': ranks,
+        'n_events': len(events),
+        'sources': sources,
+        'steps': steps_out,
+        'total_steps': total_steps,
+        'split': split,
+        'compile': compile_out,
+        'retraces': retrace_out,
+        'collectives': collectives,
+        'lint_findings': lint,
+        'spans': spans,
+        'scalars_last': scalars_last,
+        'timeline': timeline,
+    }
+
+
+def render(report, stream=None):
+    out = stream or sys.stdout
+    p = lambda *a: print(*a, file=out)      # noqa: E731
+    p('================ paddle_tpu run report ================')
+    p(f"hosts: {report['hosts']}   events: {report['n_events']}   "
+      f"sources: {len(report['sources'])}")
+    if report['steps']:
+        p('\n-- step times --')
+        for tag, st in report['steps'].items():
+            if not st.get('steps'):
+                p(f'  [{tag}] {st.get("count", 0)} steps (no timings)')
+                continue
+            p(f'  [{tag}] n={st["count"]}  mean={st["mean_ms"]:.2f}ms  '
+              f'p50={st["p50_ms"]:.2f}  p90={st["p90_ms"]:.2f}  '
+              f'p99={st["p99_ms"]:.2f}  max={st["max_ms"]:.2f}')
+            sp = report['split'].get(tag)
+            if sp:
+                p(f'        device-step {sp["device_step_ms"]:.1f}ms '
+                  f'vs host-wait {sp["host_wait_ms"]:.1f}ms '
+                  f'({sp["host_wait_frac"]:.1%} waiting)')
+    c = report['compile']
+    p(f'\n-- compile --\n  {c["count"]} compiles, '
+      f'{c["total_s"]:.2f}s total')
+    for name, row in sorted(c['per_name'].items()):
+        p(f'    {name}: {row["count"]}x {row["total_s"]:.2f}s')
+    r = report['retraces']
+    p(f'  retraces: {r["count"]}'
+      + (f' (worst: {r.get("worst")} at {r.get("max_variants")} '
+         'variants)' if r['count'] else ''))
+    if report['collectives']:
+        co = report['collectives']
+        p(f'\n-- collectives (mesh {co.get("mesh")}) --')
+        for op, row in sorted(co['per_op'].items()):
+            p(f'    {op}: {row["calls"]} calls, {row["bytes"]:,} bytes')
+        p(f'    total: {co["total_bytes"]:,} bytes/step')
+    if report['lint_findings']:
+        p(f'\n-- lint findings --\n    {report["lint_findings"]}')
+    if report['scalars_last']:
+        p('\n-- last scalars --')
+        for tag, vals in report['scalars_last'].items():
+            pretty = ', '.join(f'{k}={v:.5g}'
+                               for k, v in sorted(vals.items()))
+            p(f'    [{tag}] {pretty}')
+    if report['timeline']:
+        p('\n-- resilience timeline --')
+        for row in report['timeline']:
+            extra = {k: v for k, v in row.items()
+                     if k not in ('t_rel_s', 'kind', 'rank')}
+            p(f'  +{row["t_rel_s"]:9.3f}s r{row["rank"]} '
+              f'{row["kind"]}' + (f'  {extra}' if extra else ''))
+    else:
+        p('\n-- resilience timeline --\n  (clean run: no events)')
+    p('=======================================================')
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog='run_report',
+        description='Merge per-host telemetry JSONL (+ flight-recorder '
+                    'dumps) into one run report.')
+    ap.add_argument('paths', nargs='+',
+                    help='telemetry dirs, telemetry-*.jsonl files, '
+                         'and/or flightrec-*.json dumps')
+    ap.add_argument('--json', action='store_true',
+                    help='machine-readable report for bench/CI')
+    args = ap.parse_args(argv)
+
+    jsonls, flights = discover(args.paths)
+    if not jsonls and not flights:
+        print('run_report: no telemetry-*.jsonl or flightrec-*.json '
+              f'under {args.paths}', file=sys.stderr)
+        return 2
+    events, sources = load_events(jsonls, flights)
+    report = analyze(events, sources)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        render(report)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
