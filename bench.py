@@ -662,12 +662,20 @@ def _write_partial(results, smoke=False):
         log(f'could not write partial artifact: {e}')
 
 
-def _lint_preflight(timeout_s=180, smoke=False):
+def _lint_preflight(timeout_s=300, smoke=False):
     """tpu_lint gate before burning chip time: a HIGH-severity finding
     in examples/ or paddle_tpu/models/ means some bench config would
     run a known-degraded step (host sync / retrace hazard) — fail the
     bench up front and put the findings in the artifact instead of
     discovering it in the throughput numbers.
+
+    The gate includes the lowered-HLO SPMD audit (--hlo under a forced
+    8-device CPU mesh): the model suite is lowered through the
+    partitioner and replicated-giant-hlo / collective-cost /
+    resharding / peak-memory run BEFORE any chip session — a
+    replicated giant or an OOM-bound peak shows up here, not in a
+    wedged tunnel.  The subprocess isolates the forced virtual mesh
+    from this process's real-device jax.
 
     Returns (ok, summary_dict).  Lint-infra failures (timeout, crash)
     never block the bench: evidence beats a dead gate."""
@@ -676,10 +684,18 @@ def _lint_preflight(timeout_s=180, smoke=False):
     cmd = [sys.executable, os.path.join(repo, 'tools', 'tpu_lint.py'),
            os.path.join(repo, 'examples'),
            os.path.join(repo, 'paddle_tpu', 'models'),
+           '--hlo', '--mesh', 'dp=8',
            '--json', '--fail-on', 'never']
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    # a pre-existing forced device count (e.g. a 4-device virtual-mesh
+    # launcher env) would beat tpu_lint's own =8 and break the dp=8
+    # lower — strip it so the subprocess forces exactly what it needs
+    env['XLA_FLAGS'] = ' '.join(
+        t for t in env.get('XLA_FLAGS', '').split()
+        if not t.startswith('--xla_force_host_platform_device_count'))
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=timeout_s)
+                              timeout=timeout_s, env=env)
         doc = json.loads(proc.stdout)
     except Exception as e:
         log(f'lint preflight skipped ({e!r})')
@@ -705,6 +721,16 @@ def _lint_preflight(timeout_s=180, smoke=False):
         except Exception:
             pass
     summary = {'counts': counts, 'high': high[:10]}
+    hlo = doc.get('hlo') or {}
+    if hlo:
+        # per-target headline numbers for the artifact: predicted
+        # collective wire traffic + peak HBM of each lowered step
+        summary['hlo'] = {
+            t: {'counts': r.get('counts'),
+                'peak_bytes': (r.get('extras') or {}).get('peak_bytes'),
+                'collective_wire_bytes': (r.get('extras') or {}).get(
+                    'collective_wire_bytes')}
+            for t, r in hlo.items()}
     log(f'lint preflight: {counts}')
     return not high, summary
 

@@ -31,6 +31,7 @@ Suppression: ``# tpu-lint: disable=rule-id`` on the flagged line (or
 the enclosing ``def``), or ``disable=('rule-id',)`` on any entry
 point.
 """
+import functools
 import os
 import warnings
 
@@ -49,15 +50,50 @@ from . import ast_lint  # noqa: F401
 from .ast_lint import (  # noqa: F401
     lint_source, lint_file, lint_callable, apply_suppressions)
 from .runtime import amp_audit, note_retrace, OpDtypeAudit  # noqa: F401
+from . import costmodel  # noqa: F401
+from . import hlo  # noqa: F401
+from .hlo import (  # noqa: F401
+    HLO_RULES, register_hlo_rule, DEFAULT_HLO_THRESHOLDS)
 
-__all__ = ['lint', 'lint_sources', 'lint_layer', 'emit', 'safe_emit',
+# the lowered-HLO SPMD audit (post-partitioner: sharding placement,
+# collective cost, per-device peak memory) — the escalation the
+# compile choke points run when a Mesh is active
+lint_hlo = hlo.audit
+
+
+def escalate_hlo(report, fn, state_args, batch_args, mesh, *,
+                 donate_argnums=(), name=None):
+    """The shared choke-point posture for the mesh-gated HLO
+    escalation: `state_args` replicated, `batch_args` sharded on the
+    mesh's data axis when divisible (hlo.auto_shardings heuristic,
+    replicated fallback), findings extend `report` in place.
+    ParallelTrainer does NOT use this — it lowers with its real jit
+    shardings and donation instead."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    repl = NamedSharding(mesh, PartitionSpec())
+    rep_tree = lambda t: jax.tree_util.tree_map(  # noqa: E731
+        lambda _: repl, t)
+    batch_sh = tuple(hlo.auto_shardings(mesh, tuple(batch_args)) or
+                     (rep_tree(b) for b in batch_args))
+    in_sh = tuple(rep_tree(a) for a in state_args) + batch_sh
+    return report.extend(lint_hlo(
+        fn, *state_args, *batch_args, mesh=mesh, in_shardings=in_sh,
+        donate_argnums=donate_argnums,
+        global_shapes=getattr(report, 'global_big_shapes', None),
+        name=name))
+
+
+__all__ = ['lint', 'lint_sources', 'lint_layer', 'lint_hlo',
+           'escalate_hlo', 'emit',
+           'safe_emit',
            'Finding', 'LintReport', 'LintError', 'LintWarning',
            'HIGH', 'WARN', 'INFO', 'RULES', 'register_rule',
            'RuleContext', 'run_rules', 'DEFAULT_THRESHOLDS',
-           'scalar_arg_findings',
+           'scalar_arg_findings', 'HLO_RULES', 'register_hlo_rule',
+           'DEFAULT_HLO_THRESHOLDS',
            'lint_source', 'lint_file', 'lint_callable',
            'apply_suppressions', 'amp_audit', 'note_retrace',
-           'walker', 'ast_lint']
+           'walker', 'ast_lint', 'hlo', 'costmodel']
 
 
 def _leaf_ranges(example_args):
@@ -124,7 +160,18 @@ def lint(fn, *example_args, mesh=None, donate_argnums=(), disable=(),
         findings.extend(lint_callable(fn, disable=disable))
     findings = [f for f in apply_suppressions(findings)
                 if f.rule not in disable]
-    return LintReport(findings, name=name)
+    report = LintReport(findings, name=name)
+    if closed is not None:
+        # thunk, NOT extras: a set of tuples is side data for the HLO
+        # escalation (lint_hlo(global_shapes=...) skips its second
+        # abstract trace), and only the mesh-gated escalation reads it
+        # — the common single-device path never pays the extra walk
+        thr = (thresholds or {}).get(
+            'replicated_bytes',
+            DEFAULT_HLO_THRESHOLDS['replicated_bytes'])
+        report._big_shapes_thunk = functools.partial(
+            hlo.global_big_shapes_of, closed, thr)
+    return report
 
 
 def _iter_py_files(paths):

@@ -19,6 +19,17 @@ lints every function — the audit mode for step-loop host code (this
 is the mode that flagged the per-step ``float(loss)`` in
 hapi/model.py's train_batch, fixed in the same PR that added it).
 
+In the DIRECTORY sweep (``lint_file``/``lint_sources``, i.e.
+``tpu_lint --scope all``), 'all' is loop-aware for host code: a sync
+in a function the framework will trace stays HIGH, but in plain host
+functions only syncs inside a ``for``/``while`` body are surfaced as
+WARN (a per-iteration host sync in a step loop — the thing the sweep
+hunts) and syncs outside loops demote to INFO (boundary
+materialization: benches/tests reading back results is how host code
+is supposed to look).  ``lint_source``'s raw behavior is unchanged
+unless ``host_audit=True`` — lint_callable treats its one function as
+traced regardless.
+
 Suppression
 -----------
 ``# tpu-lint: disable=rule-a,rule-b`` (or bare ``disable`` for all
@@ -31,7 +42,7 @@ import ast
 import linecache
 import re
 
-from .findings import Finding, HIGH, INFO
+from .findings import Finding, HIGH, WARN, INFO
 
 __all__ = ['lint_source', 'lint_file', 'lint_callable',
            'apply_suppressions', 'suppressed_rules_on_line']
@@ -222,40 +233,82 @@ def _check_call(node, findings, filename):
             file=filename, line=line, origin='ast'))
 
 
+def _loop_spans(fn):
+    """(start, end) line spans of every for/while body inside `fn`."""
+    spans = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            spans.append((node.lineno,
+                          getattr(node, 'end_lineno', node.lineno)))
+    return spans
+
+
+def _demote_host_finding(f, in_loop):
+    """Host-audit demotion: per-iteration syncs in host loops are WARN
+    (the step-loop hazard the sweep hunts), boundary syncs INFO."""
+    if f.severity != HIGH:
+        return f
+    if in_loop:
+        f.severity = WARN
+        f.message += (' [host-scope: per-iteration sync in a host '
+                      'loop — intentional for timing/readback, move '
+                      'to boundaries otherwise]')
+    else:
+        f.severity = INFO
+        f.message += (' [host-scope: outside any loop — boundary '
+                      'materialization is normal host code]')
+    return f
+
+
 def lint_source(src, filename='<source>', scope='traced', disable=(),
-                apply_suppress=True):
+                apply_suppress=True, host_audit=False):
     """Lint python source text; returns a list of Findings.
 
     scope='traced': only functions the framework will trace (see
     module docstring).  scope='all': every function — audit mode for
-    host-side step loops.  apply_suppress=False skips the in-pass
-    suppression check — for callers whose line numbers are RELATIVE
-    to a snippet (lint_callable) and must re-anchor before checking
-    comments against the real file."""
+    host-side step loops.  host_audit=True (what lint_file sets for
+    scope='all') additionally demotes findings in NON-traced
+    functions: WARN inside for/while bodies, INFO outside (see module
+    docstring).  apply_suppress=False skips the in-pass suppression
+    check — for callers whose line numbers are RELATIVE to a snippet
+    (lint_callable) and must re-anchor before checking comments
+    against the real file."""
     try:
         tree = ast.parse(src)
     except SyntaxError as e:
         return [Finding('parse-error', INFO,
                         f'could not parse: {e}', file=filename,
                         line=getattr(e, 'lineno', None), origin='ast')]
+    traced = _Scoper(tree).traced
     if scope == 'all':
         targets = [n for n in ast.walk(tree)
                    if isinstance(n, (ast.FunctionDef,
                                      ast.AsyncFunctionDef))]
         if not targets:
             targets = [tree]        # lint module-level statements too
+        else:
+            # traced defs first: a traced fn nested inside a host fn
+            # must claim its calls at full severity before the host
+            # walk (which would demote them) reaches them
+            targets.sort(key=lambda n: (n not in traced, n.lineno))
     else:
-        targets = sorted(_Scoper(tree).traced, key=lambda n: n.lineno)
+        targets = sorted(traced, key=lambda n: n.lineno)
 
     findings = []
     seen = set()
     spans = _def_spans(tree)
     for fn in targets:
+        demote = host_audit and scope == 'all' and fn not in traced
+        loops = _loop_spans(fn) if demote else ()
         for node in ast.walk(fn):
             if isinstance(node, ast.Call) and id(node) not in seen:
                 seen.add(id(node))
                 before = len(findings)
                 _check_call(node, findings, filename)
+                if demote:
+                    for f in findings[before:]:
+                        _demote_host_finding(f, any(
+                            s <= (f.line or 0) <= e for s, e in loops))
                 if not apply_suppress:
                     continue
                 # line-level + enclosing-def-level suppression (every
@@ -273,7 +326,8 @@ def lint_file(path, scope='traced', disable=()):
     with open(path, 'r', encoding='utf-8') as fh:
         src = fh.read()
     linecache.checkcache(path)
-    return lint_source(src, filename=path, scope=scope, disable=disable)
+    return lint_source(src, filename=path, scope=scope, disable=disable,
+                       host_audit=(scope == 'all'))
 
 
 def lint_callable(fn, scope='traced', disable=()):

@@ -1,0 +1,76 @@
+"""Ring cost model for TPU collectives (EQuARX-style comms audit).
+
+Pure math, no jax: given a collective opcode, the per-device buffer
+size the compiled (post-SPMD-partitioner) HLO shows, and the replica
+group size, predict the bytes each device puts on the ICI wire and a
+latency-vs-bandwidth time estimate.  The classic ring algorithms XLA
+uses on TPU tori:
+
+  all-reduce      reduce-scatter + all-gather: 2·(n-1)/n · S on the
+                  wire per device, 2·(n-1) hop phases
+  all-gather      each device forwards every shard once: (n-1)·S_shard
+                  = (n-1)/n · S_out, n-1 phases
+  reduce-scatter  (n-1)/n · S_in, n-1 phases
+  all-to-all      (n-1)/n · S, n-1 phases (torus routing folds this,
+                  but the ring bound is the honest static estimate)
+  collective-permute  S bytes, 1 hop
+
+The time estimate is the max of the latency term (phases · per-hop
+latency — dominates small buffers, EQuARX's motivating regime) and the
+bandwidth term (wire bytes / link bandwidth — dominates giant grads),
+reported as their sum (the usual α+β model upper bound).
+
+`analysis.hlo` drives this over a parsed HLO module; ParallelTrainer's
+collective census emits the prediction as a ``collective_cost``
+telemetry event so tools/run_report.py can put predicted and observed
+traffic side by side.
+"""
+
+__all__ = ['COLLECTIVE_OPS', 'ring_cost', 'DEFAULT_LINK_BW_GBPS',
+           'DEFAULT_LINK_LATENCY_US']
+
+# per-direction ICI link bandwidth and per-hop latency.  ~90 GB/s and
+# ~1 us are the right order for one TPU v4/v5 ICI link; both are knobs
+# (thresholds / CLI flags) because the point is the MODEL SHAPE of the
+# prediction, not chip-generation precision.
+DEFAULT_LINK_BW_GBPS = 90.0
+DEFAULT_LINK_LATENCY_US = 1.0
+
+# opcode -> (wire fraction numerator as f(n), phases as f(n)); S is the
+# per-device buffer size the compiled HLO shows for the op
+COLLECTIVE_OPS = ('all-reduce', 'all-gather', 'reduce-scatter',
+                  'all-to-all', 'collective-permute')
+
+
+def ring_cost(opcode, local_bytes, group_size, *,
+              bw_gbps=DEFAULT_LINK_BW_GBPS,
+              latency_us=DEFAULT_LINK_LATENCY_US):
+    """Predicted cost of ONE collective op.
+
+    opcode: base HLO opcode (no -start/-done suffix).
+    local_bytes: the op's per-device buffer size — the operand for
+    all-reduce/reduce-scatter/all-to-all/collective-permute, the
+    OUTPUT for all-gather (the gathered buffer).
+    group_size: devices per replica group (n).
+
+    Returns {'wire_bytes', 'phases', 'est_us'}; a group of 1 (or an
+    unknown opcode) costs nothing — the partitioner elides it.
+    """
+    n = max(1, int(group_size))
+    s = max(0, int(local_bytes))
+    if n == 1 or opcode not in COLLECTIVE_OPS or s == 0:
+        return {'wire_bytes': 0, 'phases': 0, 'est_us': 0.0}
+    if opcode == 'all-reduce':
+        wire = 2 * (n - 1) * s // n
+        phases = 2 * (n - 1)
+    elif opcode == 'collective-permute':
+        wire = s
+        phases = 1
+    else:   # all-gather / reduce-scatter / all-to-all
+        wire = (n - 1) * s // n
+        phases = n - 1
+    # alpha-beta model: latency term + bandwidth term.  1 GB/s moves
+    # 1e3 bytes per microsecond.
+    est_us = phases * float(latency_us) + wire / (float(bw_gbps) * 1e3)
+    return {'wire_bytes': wire, 'phases': phases,
+            'est_us': round(est_us, 3)}

@@ -76,14 +76,41 @@ def _rank(sev):
 class LintReport:
     """Findings of one lint run (one step function / one file set)."""
 
+    # set by analysis.lint when a jaxpr was traced; the mesh-gated HLO
+    # escalation is the only reader, so the walk it performs must not
+    # run on the common single-device path
+    _big_shapes_thunk = None
+    _big_shapes_cache = None
+
     def __init__(self, findings=None, name=None):
         self.findings = list(findings or [])
         self.name = name
+        # structured side data a pass wants to surface beyond
+        # findings (the HLO audit's collective census / peak-memory
+        # summary) — rendered by tpu_lint --hlo, part of to_json
+        self.extras = {}
+
+    @property
+    def global_big_shapes(self):
+        """Global traced shapes above the replicated-giant threshold,
+        computed on first access (lint_hlo(global_shapes=...) joins
+        against these instead of re-tracing).  Raises AttributeError
+        when no jaxpr was traced, preserving the getattr(..., None)
+        contract at the choke points."""
+        if self._big_shapes_thunk is None:
+            raise AttributeError('global_big_shapes')
+        if self._big_shapes_cache is None:
+            self._big_shapes_cache = self._big_shapes_thunk()
+        return self._big_shapes_cache
 
     # -- aggregation ---------------------------------------------------------
     def extend(self, more):
-        self.findings.extend(
-            more.findings if isinstance(more, LintReport) else more)
+        if isinstance(more, LintReport):
+            self.findings.extend(more.findings)
+            if more.extras:
+                self.extras.update(more.extras)
+        else:
+            self.findings.extend(more)
         return self
 
     def at_least(self, severity):
@@ -148,8 +175,11 @@ class LintReport:
         return self.render()
 
     def to_json(self, indent=None):
-        return json.dumps({
+        doc = {
             'name': self.name,
             'counts': self.counts(),
             'findings': [f.to_dict() for f in self.findings],
-        }, indent=indent)
+        }
+        if self.extras:
+            doc['extras'] = self.extras
+        return json.dumps(doc, indent=indent)

@@ -13,6 +13,8 @@ Nothing here executes device code: ``trace_jaxpr`` is jax.make_jaxpr
 (abstract evaluation), usable with concrete arrays *or*
 jax.ShapeDtypeStruct placeholders.
 """
+import math
+
 import numpy as np
 
 import jax
@@ -96,7 +98,14 @@ def aval_bytes(aval):
             n *= int(d)
         except TypeError:      # symbolic dim (jax.export) — unknown size
             return 0
-    return n * np.dtype(dtype).itemsize
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        # extended dtype (PRNG key avals: 'key<fry>') — numpy cannot
+        # size it; its base uint32 payload is what HBM actually holds
+        base = getattr(getattr(dtype, '_impl', None), 'key_shape', None)
+        itemsize = 4 * math.prod(base) if base else 4
+    return n * itemsize
 
 
 def const_derived_vars(jaxpr):

@@ -396,17 +396,31 @@ class Model:
         """prepare(lint=...): audit the exact step about to compile
         (jaxpr rules, donation included) + the forward's source —
         via safe_emit, so only LintError (the 'error'-mode verdict)
-        escapes and analyzer crashes degrade to a warning."""
+        escapes and analyzer crashes degrade to a warning.
+
+        Under an ACTIVE mesh (distributed env) the audit escalates to
+        the lowered-HLO pass: the step is lowered in hapi's SPMD
+        posture — state replicated, batch sharded over the mesh's
+        first data axis — and the post-partitioner rules
+        (replicated-giant-hlo, collective-cost, resharding,
+        peak-memory) extend the jaxpr report."""
         from .. import analysis
+        from ..distributed import env as _env
 
         def build():
             step_fn = self._build_train_step(n_in)
+            args = (st['params'], st['buffers'], st['opt'],
+                    jax.random.PRNGKey(0), jnp.zeros((), jnp.int32),
+                    jnp.zeros((), jnp.float32))
             report = analysis.lint(
-                step_fn, st['params'], st['buffers'], st['opt'],
-                jax.random.PRNGKey(0), jnp.zeros((), jnp.int32),
-                jnp.zeros((), jnp.float32), *arrays,
+                step_fn, *args, *arrays,
                 donate_argnums=(0, 1, 2), source=False,
                 name='Model.train_step')
+            mesh = _env.get_mesh()
+            if mesh is not None:
+                analysis.escalate_hlo(
+                    report, step_fn, args, arrays, mesh,
+                    donate_argnums=(0, 1, 2), name='Model.train_step')
             return report.extend(analysis.lint_layer(self.network))
 
         analysis.safe_emit(build, self._lint)

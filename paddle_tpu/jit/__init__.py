@@ -184,6 +184,15 @@ class StaticFunction:
                    if isinstance(a, (bool, int, float))]
         report.findings.extend(analysis.scalar_arg_findings(
             scalars, self.__name__))
+        # active mesh -> escalate to the lowered-HLO SPMD audit:
+        # state replicated, traced tensors sharded on the first data
+        # axis when divisible (analysis.hlo's forced-mesh heuristic)
+        from ..distributed import env as _env
+        mesh = _env.get_mesh()
+        if mesh is not None:
+            analysis.escalate_hlo(
+                report, pure, (params, buffers, key), (tvals,), mesh,
+                name=getattr(self, '__name__', 'to_static'))
         src_fn = self._dygraph_function
         if isinstance(src_fn, _BoundForward):
             src_fn = type(src_fn._inner).forward

@@ -397,6 +397,7 @@ class ParallelTrainer:
 
         self._raw_step = train_step          # linted by _run_lint
         kwargs = {}
+        self._jit_kwargs = kwargs            # HLO audit reuses these
         if self.mesh is not None:
             repl = NamedSharding(self.mesh, P())
             dp = NamedSharding(
@@ -443,16 +444,32 @@ class ParallelTrainer:
         _build_step handed to jax.jit, with the live mesh (so
         replicated-giant fires) and the real donation set — via
         safe_emit, so only LintError (the 'error'-mode verdict)
-        escapes and analyzer crashes degrade to a warning."""
+        escapes and analyzer crashes degrade to a warning.
+
+        With a Mesh active the audit ESCALATES to the lowered-HLO
+        pass (analysis.hlo): the step is lowered with the exact
+        in/out shardings + donation _build_step gave jax.jit, and the
+        post-partitioner rules (replicated-giant-hlo, collective-cost,
+        resharding, peak-memory) extend the jaxpr report."""
         from .. import analysis
-        analysis.safe_emit(
-            lambda: analysis.lint(
-                self._raw_step, self.params, self.buffers,
-                self.opt_state, jnp.zeros((), jnp.int32),
-                jax.random.PRNGKey(0), *vals, mesh=self.mesh,
+
+        def build():
+            args = (self.params, self.buffers, self.opt_state,
+                    jnp.zeros((), jnp.int32), jax.random.PRNGKey(0))
+            report = analysis.lint(
+                self._raw_step, *args, *vals, mesh=self.mesh,
                 donate_argnums=(0, 2) if self.donate else (),
-                source=False, name='ParallelTrainer.step'),
-            self.lint)
+                source=False, name='ParallelTrainer.step')
+            if self.mesh is not None:
+                report.extend(analysis.lint_hlo(
+                    self._raw_step, *args, *self._example_vals,
+                    mesh=self.mesh, jit_kwargs=self._jit_kwargs,
+                    global_shapes=getattr(report, 'global_big_shapes',
+                                          None),
+                    name='ParallelTrainer.step'))
+            return report
+
+        analysis.safe_emit(build, self.lint)
 
     def step(self, *batch):
         """batch: numpy/jax arrays (x, y, ...). Returns python float loss."""
@@ -507,17 +524,19 @@ class ParallelTrainer:
         acc.observe(step=self._step_no, step_time_s=dt, loss=loss)
 
     def _maybe_collective_census(self):
-        """EQuARX-groundwork comms audit: when full telemetry is on,
-        parse THIS step's optimized HLO (profiler's parser) and emit
-        per-collective call/byte counts.  Costs one AOT lower+compile
-        of the already-jitted step (deduped by the persistent XLA
-        cache); never raises."""
+        """EQuARX comms audit: when full telemetry is on, parse THIS
+        step's optimized HLO (analysis.hlo's parser) and emit both the
+        per-collective call/byte census (``collectives``) and the
+        cost-model PREDICTION (``collective_cost``: ring wire bytes +
+        latency/bandwidth time estimate per op) so run_report can show
+        predicted vs observed traffic side by side.  Costs one AOT
+        lower+compile of the already-jitted step (deduped by the
+        persistent XLA cache); never raises."""
         from .. import telemetry as _tel
         if not _tel.enabled() or self.mesh is None:
             return
         try:
-            from ..profiler import (_work_lines, _HLO_INSTR,
-                                    _buffer_bytes)
+            from ..analysis import hlo as _hlo
             key = jax.random.PRNGKey(0)
             with _tel.span('hlo_audit'):
                 compiled = self._compiled.lower(
@@ -525,26 +544,25 @@ class ParallelTrainer:
                     jnp.zeros((), jnp.int32), key,
                     *self._example_vals).compile()
                 text = compiled.as_text()
-            per_op = {}
-            for line in _work_lines(text):
-                m = _HLO_INSTR.match(line)
-                if not m:
-                    continue
-                type_spec, opcode = m.groups()
-                base = opcode[:-6] if opcode.endswith('-start') \
-                    else opcode
-                if base not in ('all-reduce', 'all-gather',
-                                'reduce-scatter', 'collective-permute',
-                                'all-to-all'):
-                    continue
-                row = per_op.setdefault(base, {'calls': 0, 'bytes': 0})
-                row['calls'] += 1
-                row['bytes'] += _buffer_bytes(type_spec)
+            census = _hlo.collective_census(_hlo.parse_module(text))
+            per_op = {base: {'calls': r['calls'], 'bytes': r['bytes']}
+                      for base, r in census.items()}
             total = sum(r['bytes'] for r in per_op.values())
             _tel.event('collectives', name='ParallelTrainer.step',
                        mesh=dict(self.mesh.shape), per_op=per_op,
                        total_bytes=total)
             _tel.add('collective.bytes', total)
+            predicted = {base: {'calls': r['calls'],
+                                'wire_bytes': r['wire_bytes'],
+                                'est_us': r['est_us'],
+                                'group_size': r['group_size']}
+                         for base, r in census.items()}
+            _tel.event('collective_cost', name='ParallelTrainer.step',
+                       mesh=dict(self.mesh.shape), per_op=predicted,
+                       wire_bytes_total=sum(
+                           r['wire_bytes'] for r in predicted.values()),
+                       est_us_total=round(sum(
+                           r['est_us'] for r in predicted.values()), 3))
         except Exception:       # audit is evidence, never a blocker
             pass
 

@@ -7,8 +7,6 @@ here rows come from the step's optimized HLO, ranked by memory
 traffic — the honest time proxy on an HBM-bound chip).
 """
 import contextlib
-import math
-import re
 import sys
 
 import jax
@@ -21,50 +19,6 @@ from ..telemetry import StepTimer  # noqa: F401
 __all__ = ['Profiler', 'start_profiler', 'stop_profiler', 'profiler',
            'reset_profiler', 'cuda_profiler', 'StepTimer', 'RecordEvent',
            'op_summary']
-
-_DTYPE_BYTES = {
-    'f64': 8, 'f32': 4, 'f16': 2, 'bf16': 2, 'f8e4m3fn': 1,
-    'f8e5m2': 1, 's64': 8, 's32': 4, 's16': 2, 's8': 1, 'u64': 8,
-    'u32': 4, 'u16': 2, 'u8': 1, 'pred': 1, 'c64': 8, 'c128': 16,
-}
-
-# `%name = f32[8,128]{1,0} opcode(...)` or tuple-rooted
-# `%name = (f32[2]{0}, s32[]{:T(128)}) opcode(...)` — tuple specs may
-# carry TPU tiled layouts with nested parens, hence the inner group
-_HLO_INSTR = re.compile(
-    r'^\s*(?:ROOT\s+)?[%\w.\-]+\s*=\s*'
-    r'(\((?:[^()]|\([^()]*\))*\)|\S+)\s+([\w\-]+)\(')
-_HLO_BUF = re.compile(r'(\w+)\[([\d,]*)\]')
-# computation header: `ENTRY %main (...) -> ... {` / `%body.12 (...) {`
-_HLO_COMP = re.compile(r'^(ENTRY\s+)?%?([\w.\-]+)\s*\([^)]*\)[^{]*{')
-
-
-def _work_lines(hlo_text):
-    """Instruction lines that represent scheduled work: the ENTRY
-    computation plus called control-flow bodies (while/cond regions run
-    their instructions every iteration), EXCLUDING fusion bodies —
-    a fusion's internals are register-resident; its HBM traffic is the
-    single `fusion` instruction at the call site."""
-    include = True
-    for line in hlo_text.splitlines():
-        m = _HLO_COMP.match(line)
-        if m:
-            include = 'fused' not in m.group(2)
-            continue
-        if line.startswith('}'):
-            include = True
-            continue
-        if include:
-            yield line
-
-
-def _buffer_bytes(type_spec):
-    """Total bytes of one HLO type spec (sums tuple components)."""
-    total = 0
-    for dtype, shape in _HLO_BUF.findall(type_spec):
-        n = math.prod(int(d) for d in shape.split(',') if d)
-        total += n * _DTYPE_BYTES.get(dtype, 4)
-    return total
 
 
 def op_summary(fn, *args, sorted_by='total', top=25, stream=None,
@@ -105,19 +59,19 @@ def op_summary(fn, *args, sorted_by='total', top=25, stream=None,
     except Exception:       # backend without cost analysis
         pass
 
+    # the HLO-text grammar lives in ONE place: analysis.hlo's parser
+    # (walk() = ENTRY + while/cond bodies, fusion internals folded
+    # into their call-site `fusion` row — exactly the rows we want)
+    from ..analysis import hlo as _hlo
     agg = {}
-    for line in _work_lines(compiled.as_text()):
-        m = _HLO_INSTR.match(line)
-        if not m:
-            continue
-        type_spec, opcode = m.groups()
-        if opcode in ('parameter', 'constant', 'tuple',
-                      'get-tuple-element'):
+    for _comp, ins in _hlo.parse_module(compiled.as_text()).walk():
+        if ins.opcode in ('parameter', 'constant', 'tuple',
+                          'get-tuple-element'):
             continue        # plumbing, not work
-        row = agg.setdefault(opcode, {'opcode': opcode, 'calls': 0,
-                                      'bytes': 0})
+        row = agg.setdefault(ins.opcode, {'opcode': ins.opcode,
+                                          'calls': 0, 'bytes': 0})
         row['calls'] += 1
-        row['bytes'] += _buffer_bytes(type_spec)
+        row['bytes'] += ins.bytes
     grand = sum(r['bytes'] for r in agg.values()) or 1
     key = 'calls' if sorted_by == 'calls' else 'bytes'
     rows = sorted(agg.values(), key=lambda r: r[key], reverse=True)

@@ -53,6 +53,8 @@ EVENT_KINDS = (
     'nan_fatal',           # rollback budget exhausted
     'lint_finding',        # analysis finding surfaced at a choke point
     'collectives',         # per-op collective byte census of one step
+    'collective_cost',     # predicted wire bytes / ring time per
+                           # collective (analysis.costmodel at compile)
     'steps',               # StepAccumulator flush (per-step scalars)
     'span',                # a closed span (name, dur_s)
     'scalar',              # user scalar (VisualDL / ScalarAdapter)

@@ -391,6 +391,45 @@ class TestSuppression:
         assert not rules_of(r, 'amp-promotion')
 
 
+# --------------------------------------------- host-audit demotion (scope=all)
+class TestHostAuditDemotion:
+    SRC = textwrap.dedent('''
+        def train_loop(model, data):
+            for batch in data:
+                loss = model(batch)
+                print(float(loss.mean()))
+            return float(loss.mean())
+
+        def main():
+            @to_static
+            def step(x):
+                return x * float(x.mean())
+            return step
+    ''')
+
+    def _by_line(self):
+        fs = analysis.lint_source(self.SRC, scope='all',
+                                  host_audit=True)
+        return {f.line: f.severity for f in fs
+                if f.rule == 'host-sync'}
+
+    def test_loop_sync_warns_boundary_sync_info(self):
+        sev = self._by_line()
+        assert sev[5] == 'warn'      # per-iteration sync in the loop
+        assert sev[6] == 'info'      # boundary readback
+
+    def test_nested_traced_def_stays_high(self):
+        """A traced fn nested inside a host fn keeps full severity —
+        the host walk must not demote its calls first."""
+        sev = self._by_line()
+        assert sev[11] == 'high'
+
+    def test_raw_lint_source_unchanged_without_host_audit(self):
+        fs = analysis.lint_source(self.SRC, scope='all')
+        assert all(f.severity == 'high' for f in fs
+                   if f.rule == 'host-sync')
+
+
 # -------------------------------------------------------------- report API
 class TestReport:
     def test_severity_ordering_and_json(self):
@@ -418,7 +457,7 @@ class TestToStaticCheck:
     def test_callback_raises_in_error_mode(self):
         def f(x):
             v = jax.pure_callback(
-                lambda a: np.asarray(a) * 2,
+                lambda a: np.asarray(a) * 2,  # tpu-lint: disable=host-sync
                 jax.ShapeDtypeStruct((3,), np.float32), x.value)
             return paddle.to_tensor(v)
         fn = paddle.jit.to_static(f, check='error')

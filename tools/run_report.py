@@ -16,12 +16,22 @@ Report sections:
   * compile: total seconds + per-name breakdown, retrace count;
   * device-step vs host-wait split (step_time vs dataloader wait);
   * collectives census (per-op calls/bytes, when a mesh step emitted
-    one);
+    one) side by side with the compile-time COST-MODEL PREDICTION
+    (``collective_cost`` events: ring wire bytes + alpha-beta time
+    estimate per op — analysis.costmodel);
   * the resilience event timeline (preemption, nan_skip/rollback,
     checkpoint save/commit/restore/quarantine) in wall-clock order.
 
-``--json`` emits one stable dict (schema_version 1) that bench.py and
-CI consume; tests/test_event_telemetry.py schema-checks it.
+Multi-host merges: per-host wall clocks drift (pods give no NTP
+guarantee), so each host's events are re-anchored to its first
+``steps`` event before ordering — SPMD stepping is lockstep, making
+that the one cross-host moment the streams share.  The applied
+offsets land in ``clock_skew``; anchoring is skipped when any host
+never stepped (nothing trustworthy to anchor on).
+
+``--json`` emits one stable dict (schema_version 1, additively
+extended) that bench.py and CI consume; tests/test_event_telemetry.py
+schema-checks it.
 
 Stdlib-only on purpose: it must run on a dev machine against JSONL
 scraped off a dead worker, with no jax install.
@@ -128,11 +138,46 @@ def load_events(jsonl_files, flight_files):
             continue
         seen.add(k)
         out.append(e)
+    skew = normalize_clock_skew(out)
     out.sort(key=lambda e: e.get('ts') or 0)
-    return out, sources
+    return out, sources, skew
 
 
-def analyze(events, sources):
+def normalize_clock_skew(events):
+    """Anchor each host's wall clock to its first ``steps`` event.
+
+    ts is per-host wall-clock; hosts drift by seconds on real pods,
+    which used to mis-order the merged resilience timeline (a rank-1
+    preemption could sort before the rank-0 steps that preceded it).
+    SPMD training steps in lockstep, so the first flushed ``steps``
+    event is the one instant every host's stream shares: shift each
+    rank by (its anchor - earliest anchor).  Mutates ts in place and
+    returns {rank: applied_offset_s}; skipped (returns {}) unless at
+    least two ranks exist and EVERY rank emitted steps events — a
+    host that never stepped has no trustworthy anchor."""
+    anchors = {}
+    ranks = set()
+    for e in events:
+        r = e.get('rank', 0)
+        ranks.add(r)
+        ts = e.get('ts')
+        if e.get('kind') == 'steps' and ts is not None:
+            if r not in anchors or ts < anchors[r]:
+                anchors[r] = ts
+    if len(ranks) < 2 or set(anchors) != ranks:
+        return {}
+    base = min(anchors.values())
+    offsets = {r: round(a - base, 6) for r, a in anchors.items()}
+    if not any(offsets.values()):
+        return {}
+    for e in events:
+        off = offsets.get(e.get('rank', 0))
+        if off and e.get('ts') is not None:
+            e['ts'] = round(e['ts'] - off, 6)
+    return offsets
+
+
+def analyze(events, sources, skew=None):
     """The merged run report as one dict (the --json schema)."""
     by_kind = {}
     for e in events:
@@ -196,7 +241,7 @@ def analyze(events, sources):
         retrace_out['max_variants'] = worst.get('variants')
         retrace_out['worst'] = worst.get('name')
 
-    # -- collectives ---------------------------------------------
+    # -- collectives: observed census vs compile-time prediction --
     coll = by_kind.get('collectives', [])
     collectives = None
     if coll:
@@ -204,6 +249,30 @@ def analyze(events, sources):
         collectives = {'per_op': last.get('per_op', {}),
                        'total_bytes': last.get('total_bytes', 0),
                        'mesh': last.get('mesh')}
+    cost = by_kind.get('collective_cost', [])
+    collectives_predicted = None
+    if cost:
+        last = cost[-1]
+        collectives_predicted = {
+            'per_op': last.get('per_op', {}),
+            'wire_bytes_total': last.get('wire_bytes_total', 0),
+            'est_us_total': last.get('est_us_total', 0.0),
+            'mesh': last.get('mesh')}
+    collectives_cmp = None
+    if collectives or collectives_predicted:
+        ops = set((collectives or {}).get('per_op', {})) | set(
+            (collectives_predicted or {}).get('per_op', {}))
+        collectives_cmp = {}
+        for op in sorted(ops):
+            obs = (collectives or {}).get('per_op', {}).get(op, {})
+            pred = (collectives_predicted or {}).get(
+                'per_op', {}).get(op, {})
+            collectives_cmp[op] = {
+                'observed_calls': obs.get('calls'),
+                'observed_bytes': obs.get('bytes'),
+                'predicted_wire_bytes': pred.get('wire_bytes'),
+                'predicted_est_us': pred.get('est_us'),
+            }
 
     # -- lint findings -------------------------------------------
     lint = {}
@@ -244,6 +313,9 @@ def analyze(events, sources):
         'compile': compile_out,
         'retraces': retrace_out,
         'collectives': collectives,
+        'collectives_predicted': collectives_predicted,
+        'collectives_cmp': collectives_cmp,
+        'clock_skew': skew or {},
         'lint_findings': lint,
         'spans': spans,
         'scalars_last': scalars_last,
@@ -280,12 +352,32 @@ def render(report, stream=None):
     p(f'  retraces: {r["count"]}'
       + (f' (worst: {r.get("worst")} at {r.get("max_variants")} '
          'variants)' if r['count'] else ''))
-    if report['collectives']:
-        co = report['collectives']
+    if report['collectives'] or report.get('collectives_predicted'):
+        co = report['collectives'] or report['collectives_predicted']
         p(f'\n-- collectives (mesh {co.get("mesh")}) --')
-        for op, row in sorted(co['per_op'].items()):
-            p(f'    {op}: {row["calls"]} calls, {row["bytes"]:,} bytes')
-        p(f'    total: {co["total_bytes"]:,} bytes/step')
+        cmp_rows = report.get('collectives_cmp') or {}
+        p(f'    {"op":<20}{"observed":>22}{"predicted (ring model)":>28}')
+        for op, row in sorted(cmp_rows.items()):
+            obs = '-'
+            if row['observed_calls'] is not None:
+                obs = (f'{row["observed_calls"]}x '
+                       f'{row["observed_bytes"]:,} B')
+            pred = '-'
+            if row['predicted_wire_bytes'] is not None:
+                pred = (f'{row["predicted_wire_bytes"]:,} B wire '
+                        f'~{row["predicted_est_us"]:.0f} us')
+            p(f'    {op:<20}{obs:>22}{pred:>28}')
+        if report['collectives']:
+            p(f'    observed total: '
+              f'{report["collectives"]["total_bytes"]:,} bytes/step')
+        if report.get('collectives_predicted'):
+            cp = report['collectives_predicted']
+            p(f'    predicted total: {cp["wire_bytes_total"]:,} wire '
+              f'bytes/step, ~{cp["est_us_total"]:.0f} us on the ring')
+    if report.get('clock_skew'):
+        p('\n-- clock skew (per-host anchor offsets applied) --')
+        for r, off in sorted(report['clock_skew'].items()):
+            p(f'    rank {r}: {off:+.3f}s')
     if report['lint_findings']:
         p(f'\n-- lint findings --\n    {report["lint_findings"]}')
     if report['scalars_last']:
@@ -323,8 +415,8 @@ def main(argv=None):
         print('run_report: no telemetry-*.jsonl or flightrec-*.json '
               f'under {args.paths}', file=sys.stderr)
         return 2
-    events, sources = load_events(jsonls, flights)
-    report = analyze(events, sources)
+    events, sources, skew = load_events(jsonls, flights)
+    report = analyze(events, sources, skew)
     if args.json:
         print(json.dumps(report, indent=1, sort_keys=True))
     else:

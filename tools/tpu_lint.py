@@ -10,12 +10,27 @@ deep-lints one callable's jaxpr.
     python tools/tpu_lint.py examples/ --json           # machine output
     python tools/tpu_lint.py x.py --disable host-sync
     python tools/tpu_lint.py --jaxpr pkg.mod:fn --shapes 8x128xf32,8xi32
+    python tools/tpu_lint.py examples/ --hlo --mesh dp=8   # SPMD audit
+
+--hlo escalates to the lowered-HLO SPMD audit (paddle_tpu.analysis.hlo):
+each target step is lowered through jax.jit under a FORCED virtual
+mesh (--mesh dp=8 / dp=4,tp=2 — CPU devices, no chip touched, no
+execution), the compiled post-partitioner module is parsed, and the
+HLO rules run: replicated-giant-hlo, collective-cost (ring
+byte/latency estimates per all-reduce/all-gather/reduce-scatter/
+all-to-all/collective-permute), resharding, peak-memory (liveness
+high-water vs --hbm-gb).  For examples/ + paddle_tpu/models/ paths a
+built-in suite of representative tiny step functions (GPT dp+tp,
+WideDeep, LeNet — the models the examples train) is lowered; --jaxpr
+targets are HLO-audited directly.
 
 Exit codes: 0 = no findings at/above --fail-on (default: high),
-1 = findings at/above --fail-on, 2 = usage error.  CI and bench
-scripts consume --json; the tier-1 self-lint gate
-(tests/test_analysis.py) runs this over examples/ and
-paddle_tpu/models/ and requires exit 0.
+1 = findings at/above --fail-on, 2 = usage error, or an --hlo
+infra failure (mesh build / lower crashed: the text/JSON report is
+still printed, with the error under "hlo_error").  CI and bench
+scripts consume --json; the tier-1 self-lint gates
+(tests/test_analysis.py, tests/test_analysis_hlo.py) run this over
+examples/ and paddle_tpu/models/ (AST and --hlo) and require exit 0.
 
 Suppress a finding with `# tpu-lint: disable=<rule-id>` on its line.
 """
@@ -65,6 +80,207 @@ def _resolve(target):
     return getattr(mod, fn_name)
 
 
+# -- the lowered-HLO SPMD audit (--hlo) ---------------------------------------
+
+def _parse_mesh(spec):
+    """'dp=8' / 'dp=4,tp=2' -> ordered {axis: size}."""
+    axes = {}
+    for part in spec.split(','):
+        name, _, size = part.strip().partition('=')
+        if not size:
+            raise ValueError(f'--mesh wants axis=size, got {part!r}')
+        axes[name] = int(size)
+    return axes
+
+
+def _force_mesh_env(axes):
+    """Make enough virtual devices exist BEFORE jax imports.  The
+    audit never executes device code, so CPU host devices are exactly
+    as good as chips for lowering through the SPMD partitioner.
+    Without --mesh the default is dp=8: forcing 1 device would make
+    every SPMD rule silently vacuous."""
+    n = 1
+    for v in (axes or {'dp': 8}).values():
+        n *= v
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    flags = os.environ.get('XLA_FLAGS', '')
+    if '--xla_force_host_platform_device_count' not in flags:
+        os.environ['XLA_FLAGS'] = (
+            flags + f' --xla_force_host_platform_device_count={n}'
+        ).strip()
+
+
+def _build_mesh(axes):
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+    if not axes:
+        axes = {'dp': len(jax.devices())}
+    n = 1
+    for v in axes.values():
+        n *= v
+    devs = jax.devices()
+    if n > len(devs):
+        raise SystemExit(
+            f'tpu_lint: mesh {axes} wants {n} devices but only '
+            f'{len(devs)} exist (is JAX_PLATFORMS set to a fixed '
+            'backend before the forced device count could apply?)')
+    return Mesh(np.array(devs[:n]).reshape(tuple(axes.values())),
+                tuple(axes.keys()))
+
+
+def _surrogate_step(model):
+    """forward + scalar surrogate loss + grad wrt params: the comms /
+    sharding / liveness story of a train step without dragging a
+    real optimizer into the audit."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.jit import functional_call
+
+    def step(params, buffers, key, *batch):
+        def loss_fn(p):
+            out, _ = functional_call(model, p, buffers, batch,
+                                     key=key, training=True)
+            return sum(jnp.square(l.astype(jnp.float32)).mean()
+                       for l in jax.tree_util.tree_leaves(out))
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        return loss, grads
+
+    return step
+
+
+def _target_state(model, mesh):
+    """(params, buffers) as ShapeDtypeStructs + their shardings (the
+    model's declared per-param specs resolved over the mesh — the
+    same resolution ParallelTrainer does)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.parallel.api import collect_param_shardings, make_spec
+    params, buffers = model.functional_state()
+    specs = collect_param_shardings(model)
+    p_sh = {n: NamedSharding(mesh, make_spec(specs.get(n), v.ndim, mesh))
+            for n, v in params.items()}
+    repl = NamedSharding(mesh, P())
+    b_sh = {n: repl for n in buffers}
+    sds = lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype)  # noqa: E731
+    return ({n: sds(v) for n, v in params.items()},
+            {n: sds(v) for n, v in buffers.items()}, p_sh, b_sh)
+
+
+def _hlo_target_gpt(mesh):
+    """Tiny GPT in the dp(+tp) posture of examples/gpt_train_generate
+    and examples/distributed_hybrid."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    paddle.seed(0)
+    model = GPT(GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                          num_heads=4, max_seq_len=32, dropout=0.0))
+    return model, (_ids_batch(mesh, (8, 16), 128),)
+
+
+def _hlo_target_widedeep(mesh):
+    """WideDeep sparse-gather model (paddle_tpu/models/widedeep)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.widedeep import WideDeep
+    paddle.seed(0)
+    model = WideDeep([16, 16, 16, 16], dense_dim=4, embed_dim=8,
+                     shard_vocab=False)
+    import jax
+    import jax.numpy as jnp
+    return model, (_ids_batch(mesh, (8, 4), 16),
+                   jax.ShapeDtypeStruct((8, 4), jnp.float32))
+
+
+def _hlo_target_lenet(mesh):
+    """LeNet vision path of examples/mnist_lenet."""
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import LeNet
+    import jax
+    import jax.numpy as jnp
+    paddle.seed(0)
+    model = LeNet()
+    return model, (jax.ShapeDtypeStruct((8, 1, 28, 28), jnp.float32),)
+
+
+def _ids_batch(mesh, shape, vocab):
+    import jax
+    import jax.numpy as jnp
+    del mesh, vocab     # shapes only: lowering never reads values
+    return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+
+# target name -> builder(mesh) -> (model, example_batch); the suite
+# proxies what examples/ + paddle_tpu/models/ actually train
+_HLO_TARGETS = {
+    'gpt': _hlo_target_gpt,
+    'widedeep': _hlo_target_widedeep,
+    'lenet': _hlo_target_lenet,
+}
+
+
+def _run_hlo_suite(mesh, targets, thresholds, disable):
+    """Lower + audit each target; returns {name: LintReport}."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu import analysis
+    from paddle_tpu.distributed import env as _env
+    reports, errors = {}, {}
+    prev_mesh = _env.get_mesh()
+    _env.set_mesh(mesh)     # model-internal maybe_shard constraints live
+    try:
+        first_axis = next((a for a in mesh.axis_names
+                           if mesh.shape[a] > 1), None)
+        for name in targets:
+            # per-target isolation: one broken lower must not discard
+            # the audits of the targets that DO lower
+            try:
+                model, batch = _HLO_TARGETS[name](mesh)
+                params, buffers, p_sh, b_sh = _target_state(model, mesh)
+                repl = NamedSharding(mesh, P())
+                batch_sh = tuple(
+                    NamedSharding(mesh, P(first_axis))
+                    if first_axis is not None and b.shape
+                    and b.shape[0] % mesh.shape[first_axis] == 0
+                    else repl
+                    for b in batch)
+                key = jax.random.PRNGKey(0)
+                reports[name] = analysis.lint_hlo(
+                    _surrogate_step(model), params, buffers, key,
+                    *batch, mesh=mesh,
+                    in_shardings=(p_sh, b_sh, repl) + batch_sh,
+                    thresholds=thresholds, disable=disable,
+                    name=f'hlo:{name}')
+            except Exception as e:
+                errors[name] = repr(e)
+                print(f'tpu_lint: --hlo target {name} failed: {e!r}',
+                      file=sys.stderr)
+    finally:
+        _env.set_mesh(prev_mesh)
+    return reports, errors
+
+
+def _render_hlo_extras(extras, out=sys.stdout):
+    mesh = extras.get('mesh')
+    print(f'    mesh={mesh} partitions={extras.get("n_partitions")}',
+          file=out)
+    census = extras.get('collectives') or {}
+    if not census:
+        print('    collectives: none', file=out)
+    for op, row in sorted(census.items()):
+        print(f'    {op}: {row["calls"]} calls, '
+              f'{row["bytes"] / (1 << 20):.2f} MiB buffers, '
+              f'{row["wire_bytes"] / (1 << 20):.2f} MiB wire, '
+              f'~{row["est_us"]:.0f} us (ring, '
+              f'{row["group_size"]} devices)', file=out)
+    peak = extras.get('peak_bytes')
+    budget = extras.get('hbm_budget_bytes')
+    if peak is not None:
+        line = f'    peak memory: {peak / (1 << 30):.3f} GiB per device'
+        if budget is not None:
+            line += f' (budget {budget / (1 << 30):.1f} GiB)'
+        print(line, file=out)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog='tpu_lint',
@@ -91,6 +307,23 @@ def main(argv=None):
     ap.add_argument('--shapes', metavar='SPEC',
                     help='example shapes for --jaxpr, e.g. '
                          '"8x128xf32,8xi32" (last token is the dtype)')
+    ap.add_argument('--hlo', action='store_true',
+                    help='lowered-HLO SPMD audit: lower step functions '
+                         'through the partitioner under a forced mesh '
+                         'and run the HLO rules (replicated-giant-hlo, '
+                         'collective-cost, resharding, peak-memory). '
+                         'Audits the built-in model suite for '
+                         'examples//models/ paths and any --jaxpr '
+                         'target; no device execution')
+    ap.add_argument('--mesh', metavar='SPEC',
+                    help='forced mesh axes for --hlo, e.g. "dp=8" or '
+                         '"dp=4,tp=2" (virtual CPU devices are created '
+                         'as needed; default: all visible devices on '
+                         'one dp axis, forcing 8 virtual CPU devices '
+                         'when the backend is not already pinned)')
+    ap.add_argument('--hbm-gb', type=float, metavar='GiB',
+                    help='per-device HBM budget the peak-memory rule '
+                         'gates against (default: 16)')
     args = ap.parse_args(argv)
 
     if not args.paths and not args.jaxpr:
@@ -102,6 +335,16 @@ def main(argv=None):
         if not os.path.exists(p):
             print(f'tpu_lint: no such path: {p}', file=sys.stderr)
             return 2
+
+    mesh_axes = None
+    if args.hlo:
+        try:
+            mesh_axes = _parse_mesh(args.mesh) if args.mesh else None
+        except ValueError as e:
+            print(f'tpu_lint: {e}', file=sys.stderr)
+            return 2
+        # BEFORE the first jax import (analysis pulls jax in)
+        _force_mesh_env(mesh_axes)
 
     from paddle_tpu import analysis
 
@@ -125,11 +368,84 @@ def main(argv=None):
         report.extend(analysis.lint(fn, *shapes,
                                     disable=args.disable))
 
+    hlo_reports = {}
+    hlo_error = None
+    if args.hlo:
+        thresholds = {}
+        if args.hbm_gb is not None:     # 0 is a legitimate budget
+            thresholds['hbm_bytes'] = int(args.hbm_gb * (1 << 30))
+        # inside the degrade-don't-discard region: a mesh that cannot
+        # be built (e.g. a preset backend with fewer devices than the
+        # forced count could create) must not throw away the AST/jaxpr
+        # report already in hand
+        mesh = None
+        try:
+            mesh = _build_mesh(mesh_axes)
+        except SystemExit as e:
+            hlo_error = str(e)
+            print(f'{hlo_error} — --hlo audit skipped; AST/jaxpr '
+                  'findings below are still valid', file=sys.stderr)
+        if mesh is not None and mesh.devices.size <= 1:
+            print('tpu_lint: --hlo resolved to a 1-device mesh — the '
+                  'SPMD audit is vacuous (nothing is partitioned, no '
+                  'collectives exist); pass --mesh, e.g. --mesh dp=8',
+                  file=sys.stderr)
+        # examples/ + models/ paths -> the built-in target suite (the
+        # models those paths train); --jaxpr -> that callable directly.
+        # Match whole path components, not substrings (tests/
+        # test_models.py is NOT a models/ path).
+        wants_suite = any(
+            part in ('examples', 'models')
+            for p in args.paths
+            for part in os.path.normpath(os.path.abspath(p))
+            .split(os.sep))
+        if not wants_suite and not args.jaxpr:
+            print('tpu_lint: --hlo has nothing to audit for these '
+                  'paths — it lowers the built-in model suite for '
+                  'examples//models/ paths or a --jaxpr target; '
+                  'AST/jaxpr findings below are NOT an SPMD audit',
+                  file=sys.stderr)
+        try:
+            if wants_suite and mesh is not None:
+                suite_reports, suite_errors = _run_hlo_suite(
+                    mesh, list(_HLO_TARGETS), thresholds,
+                    args.disable)
+                hlo_reports.update(suite_reports)
+                if suite_errors:
+                    hlo_error = '; '.join(
+                        f'{t}: {e}' for t, e in suite_errors.items())
+            if args.jaxpr and mesh is not None:
+                hlo_reports[args.jaxpr] = analysis.lint_hlo(
+                    fn, *shapes, mesh=mesh, thresholds=thresholds,
+                    disable=args.disable, name=f'hlo:{args.jaxpr}')
+        except Exception as e:
+            # do NOT discard the AST/jaxpr report already in hand: a
+            # broken lower must not silently disable the rest of the
+            # gate (bench's preflight parses stdout JSON regardless of
+            # the exit code)
+            hlo_error = repr(e)
+            print(f'tpu_lint: --hlo audit failed: {hlo_error} — '
+                  'AST/jaxpr findings below are still valid',
+                  file=sys.stderr)
+        for rep in hlo_reports.values():
+            report.findings.extend(rep.findings)
+
     if args.json:
-        print(report.to_json(indent=2))
+        doc = json.loads(report.to_json())
+        if args.hlo:
+            doc['hlo'] = {n: json.loads(r.to_json())
+                          for n, r in hlo_reports.items()}
+            if hlo_error:
+                doc['hlo_error'] = hlo_error
+        print(json.dumps(doc, indent=2))
     else:
         print(report.render() if report else report.summary())
+        for tname, rep in hlo_reports.items():
+            print(f'\n-- hlo audit [{tname}] --')
+            _render_hlo_extras(rep.extras)
 
+    if hlo_error:
+        return 2
     if args.fail_on == 'never':
         return 0
     return 1 if report.at_least(args.fail_on) else 0
