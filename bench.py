@@ -662,6 +662,45 @@ def _write_partial(results, smoke=False):
         log(f'could not write partial artifact: {e}')
 
 
+def _chaos_preflight(timeout_s=300):
+    """--chaos-smoke gate: one short seeded FaultPlan (SIGKILL at step
+    N + torn manifest write + dropped commit) driven by
+    tools/chaos_run.py on CPU, asserting the resilience invariant set
+    (restore only yields committed steps, commits monotonic,
+    preemption exits 117, restarts bounded, final state exact) BEFORE
+    any chip time is spent.  A regression in the commit/restore
+    protocol fails the bench here, with the violation list as the
+    artifact.
+
+    Returns (ok, summary_dict).  Chaos-infra failures (timeout, crash
+    of the driver itself) never block the bench — evidence beats a
+    dead gate — but invariant VIOLATIONS always do."""
+    import subprocess
+    import tempfile
+    repo = os.path.dirname(os.path.abspath(__file__))
+    workdir = tempfile.mkdtemp(prefix='bench_chaos_')
+    cmd = [sys.executable, os.path.join(repo, 'tools', 'chaos_run.py'),
+           '--smoke', '--json', '--dir', workdir]
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=env)
+        doc = json.loads(proc.stdout)
+    except Exception as e:
+        log(f'chaos preflight skipped ({e!r})')
+        return True, {'error': repr(e)[:200]}
+    summary = {'ok': doc.get('ok'),
+               'violations': doc.get('violations', [])[:10],
+               'injected': doc.get('injected', []),
+               'incarnations': doc.get('incarnations'),
+               'duration_s': doc.get('duration_s')}
+    log(f'chaos preflight: ok={doc.get("ok")} '
+        f'({len(doc.get("injected", []))} faults injected, '
+        f'{doc.get("incarnations")} incarnations)')
+    return bool(doc.get('ok')), summary
+
+
 def _lint_preflight(timeout_s=300, smoke=False):
     """tpu_lint gate before burning chip time: a HIGH-severity finding
     in examples/ or paddle_tpu/models/ means some bench config would
@@ -751,6 +790,10 @@ def main():
                         'TIMEOUT_SCALE, e.g. gptgen x3)')
     p.add_argument('--no-lint', action='store_true',
                    help='skip the tpu_lint preflight gate')
+    p.add_argument('--chaos-smoke', action='store_true',
+                   help='run a short seeded fault-injection plan '
+                        '(tools/chaos_run.py) and gate on the '
+                        'resilience invariants before benching')
     args = p.parse_args()
 
     if args.single_json:
@@ -763,6 +806,21 @@ def main():
     names = list(CONFIGS) if args.config == 'all' else [args.config]
     results = {}
     lint_summary = None
+    chaos_summary = None
+    if args.chaos_smoke:
+        chaos_ok, chaos_summary = _chaos_preflight()
+        if not chaos_ok:
+            # a resilience-invariant violation means checkpoints from
+            # a chip run could be unrecoverable — fail before burning
+            # chip time, with the violations as the artifact
+            print(json.dumps({
+                'metric': METRIC_NAMES['resnet'], 'value': None,
+                'unit': UNITS['resnet'], 'vs_baseline': None,
+                'error': 'chaos preflight failed (resilience '
+                         'invariant violations); fix or re-run '
+                         'without --chaos-smoke',
+                'chaos': chaos_summary, 'extras': {}}))
+            sys.exit(1)
     if args.config == 'all' and not args.no_lint:
         lint_ok, lint_summary = _lint_preflight(smoke=args.smoke)
         if not lint_ok:
@@ -851,6 +909,8 @@ def main():
     }
     if lint_summary is not None:
         out['lint'] = lint_summary
+    if chaos_summary is not None:
+        out['chaos'] = chaos_summary
     # the headline config is excluded from extras, so its stale
     # provenance (if any) rides at the top level
     for k in ('stale_value', 'stale_vs_baseline', 'stale_from',

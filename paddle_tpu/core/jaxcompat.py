@@ -1,0 +1,28 @@
+"""Version compatibility shims for jax APIs the codebase rides.
+
+One home instead of per-module try/excepts, imported only by the call
+sites that need each shim (this module must stay import-light: jax
+only, no paddle_tpu dependencies).
+"""
+import jax
+
+__all__ = ['shard_map']
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax exposes ``jax.shard_map(..., check_vma=)``; the pinned
+    build only has ``jax.experimental.shard_map.shard_map`` whose
+    equivalent flag is ``check_rep=``.  Every manual-SPMD engine
+    (1F1B pipeline, GPipe, LocalSGD, flash/ring attention) routes
+    through here so a jax upgrade is one-line.
+    """
+    try:
+        sm = jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm(f, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, check_rep=check_vma)
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_vma=check_vma)

@@ -16,7 +16,12 @@ async barrier.  `latest_step()` is the latest *committed* step; a
 SIGKILL mid-save leaves an uncommitted dir that readers simply never
 see, and a committed dir whose contents fail verification is
 quarantined (renamed aside, never silently loaded) while restore
-falls back to the previous committed step.
+falls back to the previous committed step.  Multi-host saves commit in
+two phases (per-host intent/ack files, process-0 finalize only after
+every ack — see resilience.manifest.finalize_two_phase), and restore
+onto a DIFFERENT mesh/process count reshards the committed arrays onto
+the new placement (elastic reshape — a preempted pool resumes
+smaller), logged as a ``reshape_restore`` telemetry event.
 
     save_sharded(tree, path, async_save=True)   -> wait() handle
     load_sharded(path, like=tree_or_abstract)   -> restored pytree
@@ -83,8 +88,28 @@ class _SaveHandle:
         return self._done
 
 
+def _tree_topology(tree):
+    """{'mesh': axis-size dict, 'process_count': N} recorded in the
+    commit manifest — the reshape-restore path reads it back to log
+    that a checkpoint saved under dp=8 is being resharded onto a
+    smaller pool."""
+    meta = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        mesh = getattr(getattr(leaf, 'sharding', None), 'mesh', None)
+        shape = getattr(mesh, 'shape', None)
+        if shape:
+            meta['mesh'] = dict(shape)
+            break
+    try:
+        meta['process_count'] = jax.process_count()
+    except RuntimeError:
+        pass
+    return meta
+
+
 def save_sharded(tree, path, async_save=True, overwrite=True,
-                 commit=True, step=None, checksums=True):
+                 commit=True, step=None, checksums=True,
+                 two_phase=None, num_hosts=None, barrier_timeout=120.0):
     """Write a (possibly mesh-sharded) pytree of jax.Arrays as per-shard
     artifacts under `path`.  Returns a handle; call .wait() before
     relying on the files (async mode overlaps with compute until then).
@@ -93,11 +118,30 @@ def save_sharded(tree, path, async_save=True, overwrite=True,
     `checksums=False` commits presence+sizes only — still catches every
     crash-shaped tear without re-reading multi-GB shards inside the
     post-save barrier (see resilience.manifest.write_manifest).
+
+    Multi-host runs commit in TWO PHASES (resilience.manifest): every
+    process's wait() writes an intent/ack recording that its shards are
+    durable, and process 0 writes the final manifest only after every
+    host's ack arrived (bounded by `barrier_timeout`) — process 0
+    finishing its own save proves nothing about host 7's, and the old
+    single-phase commit could certify a checkpoint whose remote shards
+    were still in flight.  `two_phase` defaults to process_count > 1;
+    tests force it with an explicit `num_hosts` to simulate a pod in
+    one process.  A SIGKILL between the phases leaves acks but no
+    manifest: uncommitted, and quarantined as half-committed once the
+    acks go stale (see CheckpointManager.restore).
     """
     import time as _time
     import orbax.checkpoint as ocp
     from ..telemetry import event as _tevent
     path = os.path.abspath(path)
+    try:
+        proc, nprocs = jax.process_index(), jax.process_count()
+    except RuntimeError:
+        proc, nprocs = 0, 1
+    if two_phase is None:
+        two_phase = nprocs > 1
+    hosts = int(num_hosts) if num_hosts is not None else nprocs
     ckptr = _checkpointer(async_save)
     _t0 = _time.perf_counter()
     ckptr.save(path, args=ocp.args.StandardSave(tree), force=overwrite)
@@ -109,22 +153,30 @@ def save_sharded(tree, path, async_save=True, overwrite=True,
             dispatch_s=round(_time.perf_counter() - _t0, 6))
     on_commit = None
     if commit:
-        # jax.process_index 0 ran the directory-level finalize; it also
-        # owns the commit record (multi-host: shared filesystem)
-        try:
-            writer = jax.process_index() == 0
-        except RuntimeError:
-            writer = True
-        if writer:
-            # leaf_spec must be computed from the SAME abstraction
-            # restore will compare against (_abstractify), or python
-            # scalar leaves record dtype 'int' at save but 'int32' at
-            # restore and a valid checkpoint fails the template check;
-            # computed eagerly — by commit time the arrays may be
-            # donated away
-            spec_tree = _abstractify(tree)
+        # leaf_spec must be computed from the SAME abstraction
+        # restore will compare against (_abstractify), or python
+        # scalar leaves record dtype 'int' at save but 'int32' at
+        # restore and a valid checkpoint fails the template check;
+        # computed eagerly — by commit time the arrays may be
+        # donated away
+        spec_tree = _abstractify(tree)
+        meta = _tree_topology(tree)
+        if two_phase:
+            def on_commit():
+                # phase 1: THIS host's shards are durable (we are past
+                # the save barrier).  Phase 2 runs on process 0 only.
+                _manifest.write_intent(path, proc, step=step,
+                                       files=(), checksums=checksums)
+                if proc == 0:
+                    _manifest.finalize_two_phase(
+                        path, hosts, step=step, tree=spec_tree,
+                        checksums=checksums, meta=meta,
+                        timeout=barrier_timeout)
+        elif proc == 0:
+            # single-host fast path: one atomic manifest, no barrier
             on_commit = lambda: _manifest.write_manifest(  # noqa: E731
-                path, step=step, tree=spec_tree, checksums=checksums)
+                path, step=step, tree=spec_tree, checksums=checksums,
+                meta=meta)
     handle = _SaveHandle(ckptr, on_commit=on_commit, step=step)
     if not async_save:
         handle.wait()
@@ -170,7 +222,9 @@ class CheckpointManager:
     again."""
 
     def __init__(self, directory, keep=3, prefix='step', async_save=True,
-                 verify=True, checksums=True):
+                 verify=True, checksums=True, two_phase=None,
+                 num_hosts=None, barrier_timeout=120.0,
+                 half_commit_grace=300.0):
         # checksums=False: commit sizes only — the hashing otherwise
         # runs inside wait()'s post-save barrier (i.e. at the head of
         # the NEXT save), a full re-read of the checkpoint that can
@@ -182,6 +236,15 @@ class CheckpointManager:
         self.async_save = async_save
         self.verify = verify
         self.checksums = checksums
+        # cross-host two-phase commit knobs (see save_sharded); a dir
+        # holding 2PC acks but no manifest for longer than
+        # half_commit_grace seconds is a half-committed save whose
+        # finalizer died between the phases — quarantineable, since
+        # acks land only after every writer's save barrier
+        self.two_phase = two_phase
+        self.num_hosts = num_hosts
+        self.barrier_timeout = barrier_timeout
+        self.half_commit_grace = half_commit_grace
         self._pending = None
         self._pending_step = None
         os.makedirs(self.directory, exist_ok=True)
@@ -208,7 +271,10 @@ class CheckpointManager:
         self.wait()  # one in-flight save at a time
         handle = save_sharded(tree, self._path(step),
                               async_save=self.async_save, step=step,
-                              checksums=self.checksums)
+                              checksums=self.checksums,
+                              two_phase=self.two_phase,
+                              num_hosts=self.num_hosts,
+                              barrier_timeout=self.barrier_timeout)
         if not self.async_save:
             self._prune()
             return handle
@@ -265,6 +331,7 @@ class CheckpointManager:
         degrades to older data, never crashes on (or silently loads)
         partial state."""
         verify = self.verify if verify is None else verify
+        self._sweep_half_committed()
         if step is not None:
             candidates = [step] + [s for s in
                                    reversed(self._steps(committed=True))
@@ -307,7 +374,9 @@ class CheckpointManager:
                 # artifact or ANOTHER process's in-flight save — the
                 # two are indistinguishable from here, so never
                 # quarantine (renaming a live save out from under its
-                # writer would corrupt it); just skip
+                # writer would corrupt it); just skip.  (Dirs whose
+                # 2PC acks went STALE were already quarantined by the
+                # _sweep_half_committed pass.)
                 warnings.warn(
                     f'checkpoint {path} has no commit manifest (torn '
                     'or in-flight); falling back to previous '
@@ -339,8 +408,59 @@ class CheckpointManager:
                         f'restore template does not match checkpoint '
                         f'{path}: ' + '; '.join(diffs[:5])
                         + ('...' if len(diffs) > 5 else ''))
+            self._note_reshape(doc, like, s)
             from ..telemetry import span as _tspan
             with _tspan('checkpoint_restore', step=s, path=path):
                 tree = load_sharded(path, like)
             return tree, s
         return None, -1
+
+    def _sweep_half_committed(self):
+        """Quarantine UNCOMMITTED step dirs whose two-phase acks went
+        stale.  Acks land only after every writer's save barrier, so
+        stale acks + no manifest can only mean the finalizer died
+        between intent and finalize — nobody is still writing, and
+        leaving the dir around would shadow the real latest step
+        forever.  Dirs with fresh acks (finalize may be in flight) or
+        no acks at all (single-phase in-flight save) are never
+        touched."""
+        committed = set(self._steps(committed=True))
+        for s in self._steps(committed=False):
+            if s in committed or s == self._pending_step:
+                continue
+            path = self._path(s)
+            age = _manifest.intent_age(path)
+            if age is None or age <= self.half_commit_grace:
+                continue
+            moved = self._quarantine(s)
+            warnings.warn(
+                f'checkpoint {path} is half-committed (2-phase acks '
+                f'{age:.0f}s stale, no final manifest — finalizer '
+                'died between intent and finalize)'
+                + (f'; quarantined to {moved}' if moved else '')
+                + '; falling back to previous committed step',
+                RuntimeWarning, stacklevel=3)
+
+    @staticmethod
+    def _note_reshape(doc, like, step):
+        """Elastic reshape restore: the manifest records the SAVING
+        topology (mesh axis sizes + process count); when the restore
+        template's mesh differs — a preempted dp=8 pool resuming as
+        dp=4 — orbax reshards each leaf from the committed tensorstore
+        data onto the new placement.  That is correct but operationally
+        loud-worthy, so it lands in telemetry as ``reshape_restore``."""
+        saved_mesh = doc.get('mesh')
+        saved_procs = doc.get('process_count')
+        cur = _tree_topology(like)
+        cur_mesh, cur_procs = cur.get('mesh'), cur.get('process_count')
+        mesh_changed = (saved_mesh is not None and cur_mesh is not None
+                        and saved_mesh != cur_mesh)
+        procs_changed = (saved_procs is not None
+                         and cur_procs is not None
+                         and saved_procs != cur_procs)
+        if mesh_changed or procs_changed:
+            from ..telemetry import event as _tevent
+            _tevent('reshape_restore', step=step,
+                    saved_mesh=saved_mesh, mesh=cur_mesh,
+                    saved_process_count=saved_procs,
+                    process_count=cur_procs)

@@ -155,7 +155,8 @@ def _heartbeat_age(heartbeat_file):
 def watch_local_trainers(procs, max_restarts=3, poll=0.2,
                          heartbeat_file=None, heartbeat_timeout=None,
                          log_dir=None, on_event=None, shutdown=None,
-                         min_preempt_uptime=None):
+                         min_preempt_uptime=None, restart_backoff=1.0,
+                         restart_backoff_max=30.0):
     """The pod watch loop: poll workers, restart the dead, kill the
     wedged (stale or deleted heartbeat), stop everything when one
     fails beyond `max_restarts`.
@@ -170,8 +171,17 @@ def watch_local_trainers(procs, max_restarts=3, poll=0.2,
     requested, SIGTERM is forwarded to the workers so they checkpoint,
     and the loop returns PREEMPTED_EXIT_CODE itself — preemption
     propagates cleanly through nested supervision.  `on_event(kind,
-    trainer)` (kinds 'exit', 'restart', 'hang', 'preempt') observes
-    transitions — tests and progress loggers hook it.
+    trainer)` (kinds 'exit', 'restart', 'hang', 'preempt', 'backoff')
+    observes transitions — tests and progress loggers hook it.
+
+    CRASH restarts (not preemptions) back off exponentially:
+    restart k of a worker waits ``min(restart_backoff * 2**(k-1),
+    restart_backoff_max)`` seconds before respawning.  A crash-looping
+    worker (bad import, poisoned checkpoint) used to burn the whole
+    max_restarts budget in milliseconds — with backoff the budget
+    spans long enough for a transient cause (NFS blip, node coming
+    up) to clear.  Preempted workers still respawn immediately: the
+    fleet already imposed that wait.
     """
     if min_preempt_uptime is None:
         # default 5s, tunable per-deployment: real workers spend far
@@ -233,6 +243,30 @@ def watch_local_trainers(procs, max_restarts=3, poll=0.2,
                     terminate_local_procs(
                         [p for p in procs if p is not t])
                     return rc if rc is not None else 1
+                if not preempted and restart_backoff > 0:
+                    delay = min(restart_backoff * (2 ** t.restarts),
+                                restart_backoff_max)
+                    if on_event:
+                        on_event('backoff', t)
+                    try:
+                        from ..telemetry import event as _tevent
+                        _tevent('restart_backoff', rank=t.rank,
+                                restarts=t.restarts,
+                                delay_s=round(delay, 3))
+                    except Exception:
+                        pass
+                    # chunked: a SIGTERM (fleet preemption) arriving
+                    # mid-backoff must still reach the OTHER workers
+                    # within the kill-grace window, not wait out a
+                    # 30s sleep in the shared supervision loop
+                    deadline = time.monotonic() + delay
+                    while time.monotonic() < deadline:
+                        if shutdown is not None and \
+                                shutdown.requested():
+                            terminate_local_procs(procs, grace=30.0)
+                            return PREEMPTED_EXIT_CODE
+                        time.sleep(min(poll, max(
+                            0.0, deadline - time.monotonic())))
                 if heartbeat_file:
                     # a fresh heartbeat marks the NEW incarnation live
                     # (and re-seeds a deleted file so detection stays
@@ -251,7 +285,8 @@ def watch_local_trainers(procs, max_restarts=3, poll=0.2,
 
 
 def supervise(cmd, max_restarts=3, log_dir=None, heartbeat_file=None,
-              heartbeat_timeout=None, on_event=None):
+              heartbeat_timeout=None, on_event=None,
+              restart_backoff=1.0, restart_backoff_max=30.0):
     """Run ONE worker command under supervision (the per-host elastic
     entry the launcher's --elastic flag uses).  The supervisor itself
     handles SIGTERM gracefully: forward to the worker, let it
@@ -263,7 +298,8 @@ def supervise(cmd, max_restarts=3, log_dir=None, heartbeat_file=None,
             procs, max_restarts=max_restarts, log_dir=log_dir,
             heartbeat_file=heartbeat_file,
             heartbeat_timeout=heartbeat_timeout, on_event=on_event,
-            shutdown=gs)
+            shutdown=gs, restart_backoff=restart_backoff,
+            restart_backoff_max=restart_backoff_max)
     finally:
         gs.uninstall()
 

@@ -119,8 +119,16 @@ def _save_snapshot(progress):
             return v
         return np.asarray(v)
 
+    try:
+        nprocs = jax.process_count()
+    except RuntimeError:
+        nprocs = 1
     payload = {
         'progress': progress,
+        # snapshot state is host numpy — layout-free by construction —
+        # but the WRITING topology is recorded so a restore onto a
+        # different pool size is visible (elastic reshape), not silent
+        'process_count': nprocs,
         'models': [_host(m.state_dict())
                    for m in _as_list(_state['model'])],
         'optimizers': [_host(o.state_dict())
@@ -163,6 +171,25 @@ def _load_snapshot():
             f'auto-checkpoint snapshot {path} is unreadable ({e}); '
             'starting from scratch', RuntimeWarning)
         return None
+    saved_procs = payload.get('process_count')
+    if saved_procs is not None:
+        import jax
+        try:
+            nprocs = jax.process_count()
+        except RuntimeError:
+            nprocs = 1
+        if nprocs != saved_procs:
+            # elastic reshape: the snapshot is host numpy, so a
+            # preempted pool resuming with fewer (or more) hosts
+            # restores exactly — log it so the topology change is
+            # auditable in the run report
+            try:
+                from ... import telemetry
+                telemetry.event('reshape_restore',
+                                saved_process_count=saved_procs,
+                                process_count=nprocs, path=path)
+            except Exception:
+                pass
     for m, sd in zip(_as_list(_state['model']), payload['models']):
         m.set_state_dict(sd)
     for o, sd in zip(_as_list(_state['optimizer']),

@@ -529,7 +529,7 @@ def flash_attention_spmd(q, k, v, mesh, causal=False, scale=None,
     this explicit shard_map to ride a hybrid mesh.
     """
     from jax.sharding import PartitionSpec as P
-    shard_map = jax.shard_map
+    from ..core.jaxcompat import shard_map
 
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])

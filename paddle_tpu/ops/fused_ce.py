@@ -46,7 +46,8 @@ __all__ = ['fused_linear_cross_entropy',
 
 def _varying(v, axis):
     """Mark a replicated value as axis-varying for shard_map's
-    manual-axes check (pvary was renamed to pcast)."""
+    manual-axes check (pvary was renamed to pcast).  Pre-VMA jax has
+    neither primitive AND no varying-type check — nothing to mark."""
     if axis is None:
         return v
     if hasattr(lax, 'pcast'):
@@ -54,7 +55,9 @@ def _varying(v, axis):
             return lax.pcast(v, to='varying')
         except TypeError:
             pass
-    return lax.pvary(v, axis)
+    if hasattr(lax, 'pvary'):
+        return lax.pvary(v, axis)
+    return v
 
 
 def _chunk_w(w, num_chunks):

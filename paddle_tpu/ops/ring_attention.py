@@ -16,6 +16,7 @@ import functools
 import math
 
 import jax
+from ..core.jaxcompat import shard_map
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -312,10 +313,10 @@ def ring_attention_spmd(q, k, v, mesh, causal=True,
                                axis_name=seq_axis, use_flash=use_flash)
         if not pre_striped:
             q, k, v = (stripe_tokens(t, sp) for t in (q, k, v))
-        out = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+        out = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                             out_specs=spec, check_vma=False)(q, k, v)
         return out if pre_striped else unstripe_tokens(out, sp)
     fn = functools.partial(ring_attention, axis_name=seq_axis,
                            causal=causal, use_flash=use_flash)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)

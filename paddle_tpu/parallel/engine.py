@@ -133,13 +133,6 @@ class ParallelTrainer:
         fleet/meta_parallel/pipeline_parallel.py:43."""
         from .pipeline import PipelineLayerModule
         from ..distributed.fleet.meta_parallel import PipelineLayer
-        if self.nan_guard:
-            import warnings
-            warnings.warn('nan_guard is not supported under pipeline '
-                          'parallelism yet; disabling', UserWarning,
-                          stacklevel=3)
-            self.nan_guard = False
-            self.sentinel = None
         model = self.model
         if hasattr(model, 'as_pipeline_module'):
             self._pipe = model.as_pipeline_module(pp, self.mesh)
@@ -229,43 +222,77 @@ class ParallelTrainer:
                     if g.ndim and g.shape[0] % dp_n == 0 else g)
                 for k, g in d_sh.items()}
 
+        nan_guard = self.nan_guard
+
         def train_step(params, opt_state, step_no, ids, labels):
             B = ids.shape[0]
             assert B % M == 0, (B, M)
             ids_mb = ids.reshape((M, B // M) + ids.shape[1:])
             lb_mb = labels.reshape((M, B // M) + labels.shape[1:])
-            loss, (d_sh, d_st) = pipeline_value_and_grad(
+            out = pipeline_value_and_grad(
                 params['shared'], params['stages'], ids_mb, lb_mb,
                 mesh=mesh, first_fn=pipe.first_fn,
                 stage_fn=pipe.stage_fn, last_fn=pipe.last_fn,
-                stage_specs=pipe.stage_specs)
+                stage_specs=pipe.stage_specs, with_finite=nan_guard)
+            if nan_guard:
+                loss, (d_sh, d_st), ok = out
+            else:
+                loss, (d_sh, d_st) = out
             grads = {'shared': shard_shared_grads(d_sh), 'stages': d_st}
             new_params, new_state = opt.apply_gradients(
                 params, grads, opt_state, step_no)
+            if nan_guard:
+                # device-side skip, same contract as the dp path: a
+                # non-finite microbatch (or non-finite reduced grads)
+                # keeps the old params/opt inside the same XLA module;
+                # only the boolean crosses to the host for the
+                # sentinel's strike/rollback policy
+                new_params = guard_update(ok, new_params, params)
+                new_state = guard_update(ok, new_state, opt_state)
+                return new_params, new_state, loss, ok
             return new_params, new_state, loss
 
         p_sh = self._pipe_shardings
         repl = NamedSharding(mesh, P())
         s_sh = self._pipe_state_shardings
         batch_sh = NamedSharding(mesh, P('dp'))
+        out_sh = (p_sh, s_sh, repl) + ((repl,) if nan_guard else ())
         kwargs = {
             'in_shardings': (p_sh, s_sh, repl, batch_sh, batch_sh),
-            'out_shardings': (p_sh, s_sh, repl),
+            'out_shardings': out_sh,
         }
         if self.donate:
             kwargs['donate_argnums'] = (0, 1)
         return jax.jit(train_step, **kwargs)
 
     def _pipe_step(self, *batch):
+        import time as _time
+        from .. import telemetry as _tel
         vals = tuple(b.value if isinstance(b, Tensor) else jnp.asarray(b)
                      for b in batch)
         assert len(vals) == 2, 'pipeline step expects (inputs, labels)'
-        if self._compiled is None:
+        first_call = self._compiled is None
+        if first_call:
             self._compiled = self._build_pipe_step()
+        _t0 = _time.perf_counter()
+        if self.nan_guard:
+            self.params, self.opt_state, loss, ok = self._compiled(
+                self.params, self.opt_state,
+                jnp.asarray(self._step_no + 1), *vals)
+            self._note_step(first_call, _time.perf_counter() - _t0,
+                            loss, _tel)
+            ok = bool(ok)   # the one host sync nan_guard costs
+            if ok:
+                self._step_no += 1
+            if self.sentinel.observe(finite=ok) == 'rollback':
+                self._nan_rollback()
+            return loss
         self.params, self.opt_state, loss = self._compiled(
             self.params, self.opt_state, jnp.asarray(self._step_no + 1),
             *vals)
         self._step_no += 1
+        self._note_step(first_call, _time.perf_counter() - _t0, loss,
+                        _tel)
         return loss
 
     # -- sharding placement --------------------------------------------------

@@ -91,7 +91,8 @@ class LocalSGDTrainer:
         batch_spec = P(dp_axis)
 
         def step(params, buffers, state, step_no, key, *batch):
-            return jax.shard_map(
+            from ..core.jaxcompat import shard_map
+            return shard_map(
                 local_step, mesh=self.mesh,
                 in_specs=(spec_p, spec_b, spec_s, P(), P())
                 + (batch_spec,) * len(batch),

@@ -98,7 +98,8 @@ def gpipe_spmd(stacked_params, x, stage_fn, mesh, num_microbatches,
         out = gpipe(params, xmb, stage_fn=stage_fn, axis_name=pp_axis)
         return out[None]  # per-stage leading dim; only stage S-1 is real
 
-    out = jax.shard_map(
+    from ..core.jaxcompat import shard_map
+    out = shard_map(
         run, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: p_spec,
                                          stacked_params), P()),

@@ -58,8 +58,15 @@ def _spec_mentions(spec, axis):
 def pipeline_value_and_grad(shared, stages, ids_mb, labels_mb, *, mesh,
                             first_fn, stage_fn, last_fn, stage_specs,
                             pp_axis='pp', dp_axis='dp', tp_axis='tp',
-                            ep_axis='ep'):
+                            ep_axis='ep', with_finite=False):
     """Compute (mean loss, (d_shared, d_stages)) with 1F1B pipelining.
+
+    `with_finite=True` additionally returns a replicated boolean `ok`:
+    every microbatch loss was finite (checked PER MICROBATCH inside the
+    schedule, on the last stage, at the tick that produced it) AND the
+    reduced gradients are finite.  The reduction is folded into the
+    same XLA module — nan_guard under pipeline parallelism costs no
+    extra dispatch, and only the one boolean crosses to the host.
 
     shared      : pytree of pp-replicated params (embedding, final LN…).
     stages      : pytree whose leaves are stage-major [S, ...].
@@ -118,7 +125,8 @@ def pipeline_value_and_grad(shared, stages, ids_mb, labels_mb, *, mesh,
         stash0 = jnp.zeros((nstash,) + act_zero.shape, act_zero.dtype)
 
         def tick(carry, t):
-            act_in, grad_in, stash, d_sh, d_st, loss_acc = carry
+            (act_in, grad_in, stash, d_sh, d_st, loss_acc,
+             nbad) = carry
             tf = t - rank
             do_f = (tf >= 0) & (tf < 2 * M) & (tf % 2 == 0)
             m_f = jnp.clip(tf // 2, 0, M - 1)
@@ -127,14 +135,20 @@ def pipeline_value_and_grad(shared, stages, ids_mb, labels_mb, *, mesh,
             m_b = jnp.clip(tb // 2, 0, M - 1)
 
             def fwd(op):
-                act_in, stash, loss_acc = op
+                act_in, stash, loss_acc, nbad = op
                 y, l = full_stage(shared, stage_p, act_in, m_f)
                 stash = jax.lax.dynamic_update_index_in_dim(
                     stash, act_in, m_f % nstash, 0)
-                return y, stash, loss_acc + l
+                # per-microbatch health, folded into the schedule: l
+                # is this microbatch's loss on the last stage (0.0 —
+                # finite — elsewhere), so nbad counts exactly the
+                # non-finite microbatches
+                nbad = nbad + (~jnp.isfinite(l)).astype(jnp.int32)
+                return y, stash, loss_acc + l, nbad
 
-            act_out, stash, loss_acc = jax.lax.cond(
-                do_f, fwd, lambda op: op, (act_in, stash, loss_acc))
+            act_out, stash, loss_acc, nbad = jax.lax.cond(
+                do_f, fwd, lambda op: op,
+                (act_in, stash, loss_acc, nbad))
 
             def bwd(op):
                 grad_in, d_sh, d_st = op
@@ -159,10 +173,12 @@ def pipeline_value_and_grad(shared, stages, ids_mb, labels_mb, *, mesh,
             # travels on idle edges and is masked by the schedule
             act_nxt = jax.lax.ppermute(act_out, pp_axis, perm_dn)
             grad_nxt = jax.lax.ppermute(dx_out, pp_axis, perm_up)
-            return (act_nxt, grad_nxt, stash, d_sh, d_st, loss_acc), None
+            return (act_nxt, grad_nxt, stash, d_sh, d_st, loss_acc,
+                    nbad), None
 
-        init = (act_zero, act_zero, stash0, d_sh0, d_st0, jnp.float32(0.0))
-        (_, _, _, d_sh, d_st, loss_acc), _ = jax.lax.scan(
+        init = (act_zero, act_zero, stash0, d_sh0, d_st0,
+                jnp.float32(0.0), jnp.int32(0))
+        (_, _, _, d_sh, d_st, loss_acc, nbad), _ = jax.lax.scan(
             tick, init, jnp.arange(ticks))
 
         # loss lives on stage S-1 only; total over pp, mean over M, dp
@@ -203,17 +219,46 @@ def pipeline_value_and_grad(shared, stages, ids_mb, labels_mb, *, mesh,
                 if _spec_mentions(spec, a)
                 else jax.lax.pmean(g, a),
                 d_st, stage_specs)
+        ok = None
+        if with_finite:
+            # grad health AFTER all reductions: a NaN/inf anywhere in
+            # any rank's shard poisons its local sum of squares; psum
+            # over every mesh axis makes the verdict identical on all
+            # ranks (so `ok` can be returned replicated)
+            leaves = (jax.tree_util.tree_leaves(d_sh)
+                      + jax.tree_util.tree_leaves(d_st))
+            g2 = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                     for g in leaves) if leaves else jnp.zeros(())
+            bad = (nbad
+                   + (~jnp.isfinite(g2)).astype(jnp.int32)
+                   + (~jnp.isfinite(loss)).astype(jnp.int32))
+            bad = jax.lax.psum(bad, pp_axis)
+            for axis, size in ((dp_axis, dp), (tp_axis, tp),
+                               (ep_axis, ep)):
+                if size > 1:
+                    bad = jax.lax.psum(bad, axis)
+            ok = bad == 0
         # re-attach the local pp dim for the out_spec gather
         d_st = jax.tree_util.tree_map(lambda g: g[None], d_st)
+        if with_finite:
+            return loss, d_sh, d_st, ok
         return loss, d_sh, d_st
 
     repl = P()
     shared_specs = jax.tree_util.tree_map(lambda _: repl, shared)
     mb_spec = P(None, dp_axis)
     out_stage_specs = stage_specs
-    loss, d_sh, d_st = jax.shard_map(
+    out_specs = (repl, shared_specs, out_stage_specs)
+    if with_finite:
+        out_specs = out_specs + (repl,)
+    from ..core.jaxcompat import shard_map
+    out = shard_map(
         worker, mesh=mesh,
         in_specs=(shared_specs, stage_specs, mb_spec, mb_spec),
-        out_specs=(repl, shared_specs, out_stage_specs),
+        out_specs=out_specs,
         check_vma=False)(shared, stages, ids_mb, labels_mb)
+    if with_finite:
+        loss, d_sh, d_st, ok = out
+        return loss, (d_sh, d_st), ok
+    loss, d_sh, d_st = out
     return loss, (d_sh, d_st)

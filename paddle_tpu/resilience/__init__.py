@@ -22,30 +22,42 @@ rest of the stack composes with it:
              checkpoint after K consecutive strikes.  Wired into
              hapi.Model.fit (NanGuard callback) and
              parallel.ParallelTrainer(nan_guard=True).
-  retry      the shared retry(fn, retries, backoff, jitter, retry_on)
-             decorator for transient host-side failures (shared-fs
-             reads, checkpoint commits) — replaces ad-hoc loops.
+  retry      the shared retry(fn, retries, backoff, jitter, retry_on,
+             deadline) decorator for transient host-side failures
+             (shared-fs reads, checkpoint commits) — replaces ad-hoc
+             loops; deadline caps barrier waits.
+  chaos      deterministic, seeded fault injection (FaultPlan /
+             ChaosEngine) + the resilience invariant checker — the
+             proof harness for everything above.  Driven by
+             tools/chaos_run.py and the `chaos` pytest fixture.
 
 Reference analogue: the reference framework spreads this over fleet
 elastic (etcd heartbeats), checkpoint_saver (versioned dirs) and the
 GradScaler's found_inf plumbing; here it is one subsystem.
 """
 from .manifest import (  # noqa: F401
-    MANIFEST_NAME, write_manifest, read_manifest, verify_manifest,
-    is_committed, file_checksum, atomic_write)
+    MANIFEST_NAME, TWO_PHASE_DIR, write_manifest, read_manifest,
+    verify_manifest, is_committed, file_checksum, atomic_write,
+    write_intent, read_intents, intent_age, finalize_two_phase,
+    CommitBarrierTimeout)
 from .retry import retry  # noqa: F401
 from .shutdown import (  # noqa: F401
     PREEMPTED_EXIT_CODE, GracefulShutdown, install_shutdown,
     shutdown_requested, exit_if_requested, preemption_signal,
     clear_shutdown, handler_installed, uninstall_shutdown)
 from .sentinel import NanSentinel, finite_step, guard_update  # noqa: F401
+from .chaos import (  # noqa: F401
+    Fault, FaultPlan, ChaosEngine, check_invariants)
 
 __all__ = [
-    'MANIFEST_NAME', 'write_manifest', 'read_manifest',
+    'MANIFEST_NAME', 'TWO_PHASE_DIR', 'write_manifest', 'read_manifest',
     'verify_manifest', 'is_committed', 'file_checksum', 'atomic_write',
+    'write_intent', 'read_intents', 'intent_age', 'finalize_two_phase',
+    'CommitBarrierTimeout',
     'retry',
     'PREEMPTED_EXIT_CODE', 'GracefulShutdown', 'install_shutdown',
     'shutdown_requested', 'exit_if_requested', 'preemption_signal',
     'clear_shutdown', 'handler_installed', 'uninstall_shutdown',
     'NanSentinel', 'finite_step', 'guard_update',
+    'Fault', 'FaultPlan', 'ChaosEngine', 'check_invariants',
 ]

@@ -1,0 +1,517 @@
+"""paddle_tpu.resilience.chaos — deterministic, seeded fault injection.
+
+The resilience runtime (verified commits, two-phase cross-host
+finalize, preemption handling, NaN rollback) makes promises it could
+not previously PROVE: nothing in the repo injected the faults those
+paths exist for.  This module is that proof harness.
+
+A :class:`FaultPlan` is a declarative, *seeded* list of faults:
+
+    plan = FaultPlan(seed=7, faults=[
+        Fault('io_error', path='_PADDLE_COMMIT', prob=0.5,
+              errno_name='EIO'),
+        Fault('torn_write', at_step=3),
+        Fault('sigkill', at_step=5),
+        Fault('nan_grads', at_step=4),
+    ])
+
+and a :class:`ChaosEngine` applies it through *scoped monkeypatch
+seams* on the boundaries real failures hit:
+
+  file seam        ``resilience.manifest.atomic_write`` — EIO/ENOSPC
+                   raised mid-commit, slow (sleep-injected) writes,
+                   torn writes (the tmp file lands, truncated, WITHOUT
+                   the atomic rename — what a dying NFS client leaves)
+  ckpt seam        ``distributed.checkpoint._SaveHandle.wait`` — shard
+                   truncation / byte corruption / dropped or
+                   half-finished commits applied the instant a save
+                   barrier completes (exactly when a host dies)
+  process seam     ``engine.step(n)`` called from the training loop —
+                   SIGTERM (graceful-preemption path) or SIGKILL
+                   (crash path) delivered at step N, heartbeat files
+                   deleted or back-dated
+  grads seam       ``engine.poison(n, *arrays)`` — NaN written into
+                   the step-N batch so the compiled step's finiteness
+                   reduction (hapi / ParallelTrainer / 1F1B pipeline)
+                   sees a genuinely non-finite gradient
+
+Determinism is the load-bearing property: every probabilistic decision
+comes from ``random.Random(plan.seed)``, consulted in a fixed seam
+order, so the SAME plan replays the SAME injected-fault sequence —
+``engine.sequence()`` — twice.  Every injection also lands in
+telemetry as a ``fault_injected`` event, which tools/run_report.py
+merges into the resilience timeline next to the commit-barrier spans
+and rollbacks it provoked.
+
+:func:`check_invariants` is the assertion side: given a checkpoint
+directory (and optionally the run's telemetry events) it verifies the
+resilience invariant set — restore() can only ever yield a committed,
+verifiable step; committed steps are monotonic; preemptions exited
+PREEMPTED_EXIT_CODE; restarts stayed within budget.  tools/chaos_run.py
+drives a training script under a plan and gates on it; bench.py's
+``--chaos-smoke`` preflight runs one short plan before spending chip
+time.
+"""
+import contextlib
+import errno as _errno
+import json
+import os
+import random
+import signal
+import time
+
+__all__ = ['FAULT_KINDS', 'Fault', 'FaultPlan', 'ChaosEngine',
+           'ChaosCallback', 'check_invariants', 'plan_from_env',
+           'PLAN_ENV']
+
+PLAN_ENV = 'PADDLE_TPU_CHAOS_PLAN'
+
+FAULT_KINDS = (
+    'io_error',          # raise OSError(errno) from matching file writes
+    'slow_io',           # sleep delay_s inside matching file writes
+    'torn_write',        # leave a truncated tmp file, skip the rename
+    'drop_commit',       # save barrier passes, manifest never written
+    'corrupt_shard',     # flip bytes in the largest committed payload
+    'truncate_shard',    # truncate the largest committed payload
+    'sigterm',           # graceful preemption at step N
+    'sigkill',           # hard crash at step N
+    'delete_heartbeat',  # remove the heartbeat file at step N
+    'stale_heartbeat',   # back-date the heartbeat mtime at step N
+    'nan_grads',         # poison the step-N batch with NaN
+)
+
+
+class Fault:
+    """One declarative fault.
+
+    kind        one of FAULT_KINDS.
+    at_step     fire exactly at this training step (process/grads
+                seams), or at the save of this step (ckpt seam).
+    prob        fire probabilistically per opportunity (file seam);
+                drawn from the plan's seeded RNG.
+    count       max number of injections (default 1 for at_step
+                faults, unbounded for prob faults).
+    path        substring filter on the file path (file/ckpt seams).
+    errno_name  'EIO' | 'ENOSPC' | ... for io_error.
+    delay_s     sleep for slow_io.
+    """
+
+    def __init__(self, kind, at_step=None, prob=None, count=None,
+                 path=None, errno_name='EIO', delay_s=0.05):
+        if kind not in FAULT_KINDS:
+            raise ValueError(f'unknown fault kind {kind!r}; '
+                             f'one of {FAULT_KINDS}')
+        self.kind = kind
+        self.at_step = at_step
+        self.prob = prob
+        self.count = count if count is not None else \
+            (1 if at_step is not None else None)
+        self.path = path
+        self.errno_name = errno_name
+        self.delay_s = delay_s
+        self.fired = 0
+
+    def to_dict(self):
+        return {k: getattr(self, k) for k in
+                ('kind', 'at_step', 'prob', 'count', 'path',
+                 'errno_name', 'delay_s')}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**{k: v for k, v in d.items()
+                      if k in ('kind', 'at_step', 'prob', 'count',
+                               'path', 'errno_name', 'delay_s')})
+
+    def _exhausted(self):
+        return self.count is not None and self.fired >= self.count
+
+    def __repr__(self):
+        bits = [self.kind]
+        if self.at_step is not None:
+            bits.append(f'at_step={self.at_step}')
+        if self.prob is not None:
+            bits.append(f'prob={self.prob}')
+        return f'Fault({", ".join(bits)})'
+
+
+class FaultPlan:
+    """A seeded, declarative set of faults — JSON-serializable so the
+    chaos_run driver can ship it to a worker subprocess through one
+    env var and a replayed run sees the identical plan."""
+
+    def __init__(self, seed=0, faults=(), name=None):
+        self.seed = int(seed)
+        self.faults = [f if isinstance(f, Fault) else Fault.from_dict(f)
+                       for f in faults]
+        self.name = name
+
+    def to_json(self):
+        return json.dumps({'seed': self.seed, 'name': self.name,
+                           'faults': [f.to_dict() for f in self.faults]},
+                          sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text):
+        d = json.loads(text)
+        return cls(seed=d.get('seed', 0), faults=d.get('faults', ()),
+                   name=d.get('name'))
+
+
+def plan_from_env(env=PLAN_ENV):
+    """The FaultPlan shipped via the environment, or None.  Workers
+    call this at startup so ANY training script becomes chaos-runnable
+    without code changes beyond engine.step()/poison() hooks."""
+    text = os.environ.get(env)
+    return FaultPlan.from_json(text) if text else None
+
+
+class ChaosEngine:
+    """Applies one FaultPlan through scoped monkeypatch seams.
+
+    Use as a context manager (``with ChaosEngine(plan) as eng:``) or
+    via activate()/deactivate().  All patches are process-local and
+    fully undone on exit — the `chaos` pytest fixture guarantees
+    deactivation even on test failure.
+    """
+
+    def __init__(self, plan, heartbeat_file=None):
+        self.plan = plan if isinstance(plan, FaultPlan) else \
+            FaultPlan(**plan) if isinstance(plan, dict) else plan
+        self.rng = random.Random(self.plan.seed)
+        self.heartbeat_file = heartbeat_file
+        self.injected = []          # deterministic injection log
+        self._saved = []            # (obj, attr, original) undo stack
+        self._active = False
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def record(self, fault, **info):
+        """One injection: appended to the deterministic sequence and
+        emitted as a ``fault_injected`` telemetry event."""
+        fault.fired += 1
+        entry = dict(fault=fault.kind, seq=len(self.injected), **info)
+        self.injected.append(entry)
+        try:
+            from .. import telemetry
+            telemetry.event('fault_injected', seed=self.plan.seed,
+                            plan=self.plan.name, **entry)
+            telemetry.add('chaos.injected')
+        except Exception:       # pragma: no cover - defensive
+            pass
+        return entry
+
+    def sequence(self):
+        """The injected-fault sequence so far — the replayability
+        contract: same plan (same seed), same scenario ⇒ identical
+        sequence."""
+        return list(self.injected)
+
+    def _matching(self, kinds, path=None, step=None):
+        """Armed faults of `kinds` matching the path/step filters, in
+        plan order (deterministic)."""
+        out = []
+        for f in self.plan.faults:
+            if f.kind not in kinds or f._exhausted():
+                continue
+            if path is not None and f.path is not None \
+                    and f.path not in str(path):
+                continue
+            if step is not None and f.at_step is not None \
+                    and f.at_step != step:
+                continue
+            if path is None and f.path is not None:
+                continue
+            out.append(f)
+        return out
+
+    def _roll(self, fault):
+        """Seeded probability gate.  at_step faults fire
+        deterministically; prob faults consult the plan RNG — one draw
+        per opportunity, so the decision stream is a pure function of
+        the seed and the seam-call order."""
+        if fault.prob is None:
+            return True
+        return self.rng.random() < fault.prob
+
+    # -- seams ---------------------------------------------------------------
+
+    def _patch(self, obj, attr, repl):
+        self._saved.append((obj, attr, getattr(obj, attr)))
+        setattr(obj, attr, repl)
+
+    def activate(self):
+        if self._active:
+            return self
+        from . import manifest as _manifest
+        from ..distributed import checkpoint as _ckpt
+
+        orig_write = _manifest.atomic_write
+
+        def chaotic_atomic_write(path, write_fn, mode='w',
+                                 prefix='.tmp'):
+            for f in self._matching(('io_error',), path=path):
+                if self._roll(f):
+                    self.record(f, path=str(path),
+                                errno=f.errno_name)
+                    code = getattr(_errno, f.errno_name, _errno.EIO)
+                    raise OSError(code, os.strerror(code), str(path))
+            for f in self._matching(('slow_io',), path=path):
+                if self._roll(f):
+                    self.record(f, path=str(path), delay_s=f.delay_s)
+                    time.sleep(f.delay_s)
+            for f in self._matching(('torn_write',), path=path):
+                if self._roll(f):
+                    # what a dying writer leaves on a non-atomic fs:
+                    # half the bytes under the REAL name, no fsync, no
+                    # rename discipline — the strongest tear the
+                    # verify/quarantine path must catch
+                    import io
+                    buf = io.BytesIO() if 'b' in mode else io.StringIO()
+                    write_fn(buf)
+                    data = buf.getvalue()
+                    half = data[:max(1, len(data) // 2)]
+                    with open(path, 'wb' if 'b' in mode else 'w') as fh:
+                        fh.write(half)
+                    self.record(f, path=str(path),
+                                bytes_kept=len(half))
+                    return
+            return orig_write(path, write_fn, mode=mode, prefix=prefix)
+
+        self._patch(_manifest, 'atomic_write', chaotic_atomic_write)
+
+        orig_wait = _ckpt._SaveHandle.wait
+        eng = self
+
+        def chaotic_wait(handle):
+            step = getattr(handle, '_step', None)
+            for f in eng._matching(('drop_commit',), step=step):
+                if eng._roll(f):
+                    # the save barrier drains but the process "dies"
+                    # before its commit: exactly the SIGKILL-between-
+                    # save-and-commit window, minus the actual kill
+                    if hasattr(handle._ckptr, 'wait_until_finished'):
+                        handle._ckptr.wait_until_finished()
+                    handle._ckptr.close()
+                    handle._drained = True
+                    handle._done = True
+                    eng.record(f, step=step)
+                    return
+            orig_wait(handle)
+            for f in eng._matching(('corrupt_shard', 'truncate_shard'),
+                                   step=step):
+                if eng._roll(f):
+                    # handle has no path; the fault carries it
+                    target = f.path
+                    if target and os.path.isdir(target):
+                        victim = eng._damage_dir(target,
+                                                 flip=f.kind ==
+                                                 'corrupt_shard')
+                        eng.record(f, step=step, path=victim)
+
+        self._patch(_ckpt._SaveHandle, 'wait', chaotic_wait)
+        self._active = True
+        return self
+
+    def deactivate(self):
+        while self._saved:
+            obj, attr, orig = self._saved.pop()
+            setattr(obj, attr, orig)
+        self._active = False
+
+    def __enter__(self):
+        return self.activate()
+
+    def __exit__(self, *exc):
+        self.deactivate()
+        return False
+
+    @staticmethod
+    def _damage_dir(directory, flip=True):
+        """Largest payload file in `directory`: byte-flip (bit-level
+        corruption under an intact size) or truncate (torn write)."""
+        from .manifest import MANIFEST_NAME, TWO_PHASE_DIR
+        victim, size = None, -1
+        for root, dirs, files in os.walk(directory):
+            if TWO_PHASE_DIR in dirs:
+                dirs.remove(TWO_PHASE_DIR)
+            for f in files:
+                if f == MANIFEST_NAME:
+                    continue
+                p = os.path.join(root, f)
+                if os.path.getsize(p) > size:
+                    victim, size = p, os.path.getsize(p)
+        if victim is None:
+            return None
+        with open(victim, 'r+b') as fh:
+            if flip:
+                fh.seek(max(0, size // 2))
+                b = fh.read(1)
+                fh.seek(max(0, size // 2))
+                fh.write(bytes([(b[0] ^ 0xFF) if b else 0xFF]))
+            else:
+                fh.truncate(max(0, size // 2))
+        return victim
+
+    # -- process / heartbeat seam -------------------------------------------
+
+    def step(self, step_no):
+        """Call once per training step (the chaos_run worker and the
+        ChaosCallback do).  Fires process-level faults scheduled for
+        this step: SIGTERM (latched by GracefulShutdown → graceful
+        preemption), SIGKILL (hard crash), heartbeat tampering."""
+        for f in self._matching(('delete_heartbeat',), step=step_no):
+            if f.at_step == step_no and self._roll(f):
+                hb = self.heartbeat_file
+                self.record(f, step=step_no, path=hb)
+                if hb:
+                    try:
+                        os.remove(hb)
+                    except OSError:
+                        pass
+        for f in self._matching(('stale_heartbeat',), step=step_no):
+            if f.at_step == step_no and self._roll(f):
+                hb = self.heartbeat_file
+                self.record(f, step=step_no, path=hb)
+                if hb and os.path.exists(hb):
+                    past = time.time() - 10_000
+                    os.utime(hb, (past, past))
+        for f in self._matching(('sigterm',), step=step_no):
+            if f.at_step == step_no and self._roll(f):
+                self.record(f, step=step_no, signum=int(signal.SIGTERM))
+                os.kill(os.getpid(), signal.SIGTERM)
+        for f in self._matching(('sigkill',), step=step_no):
+            if f.at_step == step_no and self._roll(f):
+                self.record(f, step=step_no, signum=int(signal.SIGKILL))
+                # record must be durable first: SIGKILL gives no
+                # chance to flush anything afterwards
+                try:
+                    from .. import telemetry
+                    d = telemetry.flight_dir()
+                    if d:
+                        telemetry.dump_flight(os.path.join(
+                            d, f'flightrec-chaos-kill-{step_no}.json'))
+                except Exception:
+                    pass
+                os.kill(os.getpid(), signal.SIGKILL)
+
+    def poison(self, step_no, *arrays):
+        """NaN-inject the step-N batch (grads seam): returns the
+        arrays, with element [0, ...] of each set to NaN when a
+        ``nan_grads`` fault fires for this step.  Works on numpy
+        arrays; float arrays only (ids pass through untouched)."""
+        import numpy as np
+        fired = False
+        for f in self._matching(('nan_grads',), step=step_no):
+            if f.at_step == step_no and self._roll(f):
+                self.record(f, step=step_no)
+                fired = True
+        if not fired:
+            return arrays if len(arrays) != 1 else arrays[0]
+        out = []
+        for a in arrays:
+            a = np.array(a, copy=True)
+            if np.issubdtype(a.dtype, np.floating):
+                a.reshape(-1)[0] = np.nan
+            out.append(a)
+        return tuple(out) if len(out) != 1 else out[0]
+
+
+class ChaosCallback:
+    """hapi-style callback adapter: drives ``engine.step`` from
+    ``Model.fit``'s batch boundary so a FaultPlan's process-level
+    faults apply to hapi training loops too (duck-typed — hapi only
+    calls the hooks a callback defines)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._step = 0
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        self.engine.step(self._step)
+
+
+# -- invariant checking --------------------------------------------------------
+
+def check_invariants(ckpt_dir, prefix='step', events=None,
+                     max_restarts=None, restarts=None,
+                     preempt_codes=(), expect_committed=True):
+    """Verify the resilience invariant set after a chaos run.
+
+    Returns a list of violation strings (empty == all invariants held):
+
+      I1  every COMMITTED step dir verifies (presence+size+digest) —
+          restore() can therefore only ever yield a committed step;
+      I2  committed steps seen over time are monotonic
+          (``checkpoint_commit`` telemetry events, when provided);
+      I3  every restore landed on a step that was committed at the
+          time (``checkpoint_restore`` step ∈ committed set);
+      I4  preemptions exited PREEMPTED_EXIT_CODE (`preempt_codes`:
+          exit codes the supervisor attributed to preemption);
+      I5  restarts stayed within budget (when both given).
+    """
+    from . import manifest as M
+    from .shutdown import PREEMPTED_EXIT_CODE
+    violations = []
+    committed = []
+    if os.path.isdir(ckpt_dir):
+        for f in sorted(os.listdir(ckpt_dir)):
+            tag = f[len(prefix) + 1:]
+            if not (f.startswith(prefix + '_') and tag.isdigit()):
+                continue
+            p = os.path.join(ckpt_dir, f)
+            if not M.is_committed(p):
+                continue
+            committed.append(int(tag))
+            ok, errs = M.verify_manifest(p)
+            if not ok:
+                violations.append(
+                    f'I1: committed step {tag} fails verification: '
+                    f'{errs[:3]}')
+    elif expect_committed:
+        violations.append(f'I1: checkpoint dir {ckpt_dir} missing')
+    if expect_committed and not committed:
+        violations.append('I1: no committed step survived the run')
+    if events:
+        commits = [e.get('step') for e in events
+                   if e.get('kind') == 'checkpoint_commit'
+                   and e.get('step') is not None]
+        # per-incarnation streams may interleave after a rollback
+        # restore — monotonic within each rank's stream order is the
+        # invariant (a later commit may legitimately re-commit an
+        # EARLIER step only after a restore to it).  Restores are
+        # emitted as spans (kind='span', name='checkpoint_restore').
+        restores = [e.get('step') for e in events
+                    if (e.get('kind') == 'checkpoint_restore'
+                        or (e.get('kind') == 'span'
+                            and e.get('name') == 'checkpoint_restore'))
+                    and e.get('step') is not None]
+        lo = None
+        restored = set(restores)
+        for s in commits:
+            if lo is not None and s < lo and s not in restored \
+                    and (s + 1) not in restored:
+                violations.append(
+                    f'I2: commit steps not monotonic: {s} after {lo} '
+                    'with no intervening restore')
+            lo = s if lo is None else max(lo, s)
+        commit_set = set(commits) | set(committed)
+        for s in restores:
+            if s not in commit_set:
+                violations.append(
+                    f'I3: restore yielded step {s}, which was never '
+                    'committed')
+    for code in preempt_codes:
+        if code != PREEMPTED_EXIT_CODE:
+            violations.append(
+                f'I4: preemption exited {code}, expected '
+                f'{PREEMPTED_EXIT_CODE}')
+    if max_restarts is not None and restarts is not None \
+            and restarts > max_restarts:
+        violations.append(
+            f'I5: {restarts} failure restarts exceed the '
+            f'max_restarts={max_restarts} budget')
+    return violations

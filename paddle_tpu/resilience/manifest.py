@@ -15,18 +15,54 @@ size/checksum.  Readers treat anything else as a torn save: it never
 happened.  The manifest is written by the SAME process that ran the
 save, strictly after the save barrier (`wait_until_finished`), so a
 SIGKILL anywhere in between simply yields an uncommitted dir.
+
+Multi-host saves use the TWO-PHASE variant of the same protocol.  On a
+pod every host writes its own shard files to the shared filesystem;
+process 0 finishing ITS save proves nothing about host 7's.  So:
+
+  phase 1 (every host, after its local save barrier): atomically write
+      an intent/ack file ``_PADDLE_2PC/intent.r<host>`` recording the
+      shard files that host wrote (sizes + digests);
+  phase 2 (process 0 only): wait — bounded by a deadline, with
+      retry backoff — until ALL hosts' acks are present, merge them,
+      and atomically write the final manifest (with ``hosts`` and a
+      per-file ``host`` tag).
+
+A SIGKILL *between* the phases leaves acks but no manifest: readers
+see an uncommitted dir exactly as before, and once the acks are stale
+(nobody can still be finalizing) the half-committed dir is safe to
+quarantine.  The final `os.replace` of the manifest remains the single
+linearization point — there is no state in which a reader can observe
+a committed-but-incomplete checkpoint.
 """
 import hashlib
 import json
 import os
 import tempfile
 
-__all__ = ['MANIFEST_NAME', 'atomic_write', 'file_checksum',
-           'write_manifest', 'read_manifest', 'verify_manifest',
-           'is_committed', 'leaf_spec', 'spec_mismatches']
+__all__ = ['MANIFEST_NAME', 'TWO_PHASE_DIR', 'atomic_write',
+           'file_checksum', 'write_manifest', 'read_manifest',
+           'verify_manifest', 'is_committed', 'leaf_spec',
+           'spec_mismatches', 'write_intent', 'read_intents',
+           'intent_age', 'finalize_two_phase', 'CommitBarrierTimeout']
 
 MANIFEST_NAME = '_PADDLE_COMMIT.json'
-_FORMAT = 1
+TWO_PHASE_DIR = '_PADDLE_2PC'
+_FORMAT = 2
+
+
+class CommitBarrierTimeout(TimeoutError):
+    """The two-phase finalize deadline expired with acks still
+    missing.  Carries the missing host ids so the caller (or operator)
+    knows WHICH host never finished its save."""
+
+    def __init__(self, directory, missing, timeout):
+        self.directory = directory
+        self.missing = sorted(missing)
+        self.timeout = timeout
+        super().__init__(
+            f'commit barrier for {directory} timed out after '
+            f'{timeout:.1f}s waiting for host ack(s) {self.missing}')
 
 
 def atomic_write(path, write_fn, mode='w', prefix='.tmp'):
@@ -65,8 +101,11 @@ def file_checksum(path, algo='sha256', chunk=1 << 20):
 
 def _walk_files(directory):
     for root, dirs, files in os.walk(directory):
-        # deterministic order → deterministic manifests (diffable)
+        # deterministic order → deterministic manifests (diffable);
+        # the 2PC intent dir is protocol state, not checkpoint payload
         dirs.sort()
+        if TWO_PHASE_DIR in dirs:
+            dirs.remove(TWO_PHASE_DIR)
         for f in sorted(files):
             if f == MANIFEST_NAME:
                 continue
@@ -112,7 +151,7 @@ def spec_mismatches(recorded, template):
 
 
 def write_manifest(directory, step=None, tree=None, algo='sha256',
-                   checksums=True):
+                   checksums=True, meta=None):
     """Scan `directory` and atomically commit its manifest.
 
     Must be called only after the save fully finished (sync save
@@ -126,20 +165,200 @@ def write_manifest(directory, step=None, tree=None, algo='sha256',
     multi-GB checkpoint scale, where hashing inside the post-save
     barrier would eat the async overlap.  Full checksums additionally
     catch bit-level corruption.
+
+    `meta` (a dict) is merged into the manifest document — the sharded
+    save path records the saving mesh's axis sizes and process count
+    so a reshape restore can tell (and log) that the topology changed.
     """
     directory = os.path.abspath(directory)
     files = {}
     for rel, p in _walk_files(directory):
-        meta = {'size': os.path.getsize(p)}
+        rec = {'size': os.path.getsize(p)}
         if checksums:
-            meta[algo] = file_checksum(p, algo)
-        files[rel] = meta
+            rec[algo] = file_checksum(p, algo)
+        files[rel] = rec
     doc = {'format': _FORMAT, 'step': step, 'algo': algo, 'files': files}
+    if meta:
+        doc.update(meta)
     if tree is not None:
         doc['leaf_spec'] = leaf_spec(tree)
     atomic_write(os.path.join(directory, MANIFEST_NAME),
                  lambda f: json.dump(doc, f, indent=1, sort_keys=True),
                  prefix='.commit_tmp')
+    return doc
+
+
+# -- two-phase (cross-host) commit --------------------------------------------
+
+def _intent_name(host):
+    return f'intent.r{int(host)}'
+
+
+def _host_from_rel(rel):
+    """Best-effort owner of an unclaimed artifact: orbax/tensorstore
+    per-process paths carry ``process_<idx>``; everything else
+    (metadata written by the finalize rank) is host 0's."""
+    import re
+    m = re.search(r'process_(\d+)', rel)
+    return int(m.group(1)) if m else 0
+
+
+def write_intent(directory, host, step=None, files=None, algo='sha256',
+                 checksums=True):
+    """Phase 1: host `host` acknowledges that ITS shard files are fully
+    on disk.  Called strictly after that host's local save barrier, so
+    the ack is a durable promise — a SIGKILL before this call simply
+    leaves the ack missing and the finalize barrier times out.
+
+    `files` restricts the ack to the relative paths this host wrote
+    (orbax per-process artifacts); None records every payload file
+    currently visible (single-host, or a process-0 catch-all)."""
+    directory = os.path.abspath(directory)
+    d2 = os.path.join(directory, TWO_PHASE_DIR)
+    os.makedirs(d2, exist_ok=True)
+    rec = {}
+    if files is None:
+        pairs = list(_walk_files(directory))
+    else:
+        pairs = [(rel, os.path.join(directory, rel)) for rel in files]
+    for rel, p in pairs:
+        entry = {'size': os.path.getsize(p)}
+        if checksums:
+            entry[algo] = file_checksum(p, algo)
+        rec[rel] = entry
+    doc = {'format': _FORMAT, 'host': int(host), 'step': step,
+           'algo': algo, 'files': rec}
+    atomic_write(os.path.join(d2, _intent_name(host)),
+                 lambda f: json.dump(doc, f, indent=1, sort_keys=True),
+                 prefix='.intent_tmp')
+    try:
+        from .. import telemetry
+        telemetry.event('commit_intent', step=step, host=int(host),
+                        files=len(rec), path=directory)
+    except Exception:       # pragma: no cover - defensive
+        pass
+    return doc
+
+
+def read_intents(directory):
+    """{host: intent doc} for every readable ack under the 2PC dir.
+    A torn intent (atomic_write makes that external damage only) is
+    skipped — it reads as a missing ack, which the barrier treats as
+    'that host has not finished'."""
+    d2 = os.path.join(os.path.abspath(directory), TWO_PHASE_DIR)
+    out = {}
+    try:
+        names = os.listdir(d2)
+    except OSError:
+        return out
+    for f in names:
+        if not f.startswith('intent.r'):
+            continue
+        try:
+            with open(os.path.join(d2, f)) as fh:
+                doc = json.load(fh)
+            out[int(doc['host'])] = doc
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+    return out
+
+
+def intent_age(directory):
+    """Seconds since the NEWEST intent file changed, or None when no
+    intent exists.  Readers use this to tell a half-committed save
+    (stale acks, finalizer died between the phases — quarantineable)
+    from one whose finalize may still be in flight."""
+    import time
+    d2 = os.path.join(os.path.abspath(directory), TWO_PHASE_DIR)
+    newest = None
+    try:
+        for f in os.listdir(d2):
+            if not f.startswith('intent.r'):
+                continue
+            try:
+                m = os.path.getmtime(os.path.join(d2, f))
+            except OSError:
+                continue
+            newest = m if newest is None else max(newest, m)
+    except OSError:
+        return None
+    return None if newest is None else max(0.0, time.time() - newest)
+
+
+def finalize_two_phase(directory, num_hosts, step=None, tree=None,
+                       algo='sha256', checksums=True, meta=None,
+                       timeout=120.0, poll=0.05):
+    """Phase 2 (process 0 only): wait for every host's ack, merge the
+    per-host file records, and atomically commit the final manifest.
+
+    The wait is a retry() loop with exponential backoff capped by a
+    hard `timeout` deadline — a dead host must surface as
+    CommitBarrierTimeout (and an uncommitted, later-quarantined dir),
+    never as an infinite barrier.  Each file entry in the merged
+    manifest carries its owning ``host``, which check_ckpt --deep uses
+    to report missing-host vs torn vs digest-mismatch distinctly."""
+    from .retry import retry
+    directory = os.path.abspath(directory)
+    try:
+        from .. import telemetry
+        _span = telemetry.span('commit_barrier', step=step,
+                               hosts=num_hosts)
+    except Exception:       # pragma: no cover - defensive
+        import contextlib
+        _span = contextlib.nullcontext()
+    with _span:
+        state = {}
+
+        def gather():
+            intents = read_intents(directory)
+            missing = [h for h in range(num_hosts) if h not in intents]
+            if missing:
+                raise OSError(
+                    f'missing commit ack(s) from host(s) {missing}')
+            state['intents'] = intents
+
+        try:
+            # retries is effectively unbounded; the deadline is the cap
+            retry(gather, retries=1 << 30, backoff=poll, max_backoff=1.0,
+                  jitter=False, deadline=timeout)()
+        except OSError:
+            intents = read_intents(directory)
+            raise CommitBarrierTimeout(
+                directory,
+                [h for h in range(num_hosts) if h not in intents],
+                timeout) from None
+        files = {}
+        for host in sorted(state['intents']):
+            for rel, entry in state['intents'][host]['files'].items():
+                files[rel] = dict(entry, host=host)
+        # files no ack claimed (orbax layouts where a host cannot
+        # attribute its own artifacts): every host's data is durable
+        # once all acks landed, so the finalizer scans and records
+        # them itself, attributing by the per-process path convention
+        # where one exists
+        for rel, p in _walk_files(directory):
+            if rel in files:
+                continue
+            entry = {'size': os.path.getsize(p)}
+            if checksums:
+                entry[algo] = file_checksum(p, algo)
+            files[rel] = dict(entry, host=_host_from_rel(rel))
+        doc = {'format': _FORMAT, 'step': step, 'algo': algo,
+               'hosts': num_hosts, 'files': files}
+        if meta:
+            doc.update(meta)
+        if tree is not None:
+            doc['leaf_spec'] = leaf_spec(tree)
+        atomic_write(os.path.join(directory, MANIFEST_NAME),
+                     lambda f: json.dump(doc, f, indent=1,
+                                         sort_keys=True),
+                     prefix='.commit_tmp')
+    try:
+        from .. import telemetry
+        telemetry.event('commit_finalize', step=step, hosts=num_hosts,
+                        files=len(files), path=directory)
+    except Exception:       # pragma: no cover - defensive
+        pass
     return doc
 
 

@@ -4,7 +4,13 @@ One decorator instead of per-module ad-hoc loops, so every transient
 host-side failure (shared-fs read, checkpoint commit, cache resolve)
 gets the same policy: bounded attempts, exponential backoff, decorrelated
 jitter (full-jitter — concurrent hosts retrying a shared filesystem
-must not stampede in lockstep).
+must not stampede in lockstep), and an optional total-wall-clock
+`deadline` so a barrier wait can never retry forever.
+
+Every retried attempt lands in the run's telemetry stream (a ``retry``
+event + ``retry.count`` counter) unless the caller supplies its own
+`on_retry` observer — silent retries hide exactly the flaky-fs
+episodes a post-mortem needs to see.
 """
 import functools
 import random
@@ -13,9 +19,22 @@ import time
 __all__ = ['retry']
 
 
+def _default_on_retry(fn, exc, attempt, delay):
+    """The default observer: a telemetry ``retry`` event + counter.
+    Never raises — retrying is the priority, not recording it."""
+    try:
+        from .. import telemetry
+        telemetry.event('retry', fn=getattr(fn, '__name__', repr(fn)),
+                        attempt=attempt, delay_s=round(delay, 6),
+                        error=repr(exc)[:200])
+        telemetry.add('retry.count')
+    except Exception:       # pragma: no cover - defensive
+        pass
+
+
 def retry(fn=None, *, retries=3, backoff=0.1, max_backoff=30.0,
           jitter=True, retry_on=(OSError,), on_retry=None,
-          sleep=time.sleep):
+          sleep=time.sleep, deadline=None):
     """Retry `fn` up to `retries` extra times on `retry_on` exceptions.
 
     Usable three ways::
@@ -32,27 +51,43 @@ def retry(fn=None, *, retries=3, backoff=0.1, max_backoff=30.0,
     `max_backoff`; with `jitter` the sleep is uniform in (0, that] so
     a fleet of restarted hosts decorrelates.  The final failure
     re-raises the last exception unchanged.  `on_retry(exc, attempt)`
-    observes each failed attempt (loggers, tests).
+    observes each failed attempt (loggers, tests); when omitted, each
+    retry emits a telemetry ``retry`` event instead.
+
+    `deadline` caps TOTAL wall clock: when the elapsed time plus the
+    next sleep would cross it, the last exception re-raises instead of
+    sleeping — the cross-host commit barrier leans on this (a dead
+    host must become a timeout, not an infinite wait).
     """
     if fn is None:
         return functools.partial(
             retry, retries=retries, backoff=backoff,
             max_backoff=max_backoff, jitter=jitter, retry_on=retry_on,
-            on_retry=on_retry, sleep=sleep)
+            on_retry=on_retry, sleep=sleep, deadline=deadline)
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
+        start = time.monotonic()
         for attempt in range(retries + 1):
             try:
                 return fn(*args, **kwargs)
             except retry_on as e:
                 if attempt >= retries:
                     raise
-                if on_retry is not None:
-                    on_retry(e, attempt)
-                delay = min(backoff * (2 ** attempt), max_backoff)
+                # exponent clamped: deadline-capped barrier waits run
+                # thousands of attempts, and 2**attempt as a bare int
+                # overflows float conversion past ~2**1024
+                delay = min(backoff * (2 ** min(attempt, 60)),
+                            max_backoff)
                 if jitter:
                     delay = random.uniform(0, delay) or delay * 0.5
+                if deadline is not None and \
+                        time.monotonic() - start + delay > deadline:
+                    raise
+                if on_retry is not None:
+                    on_retry(e, attempt)
+                else:
+                    _default_on_retry(fn, e, attempt, delay)
                 sleep(delay)
 
     return wrapper

@@ -47,6 +47,16 @@ EVENT_KINDS = (
     'checkpoint_commit',   # async barrier drained + manifest committed
     'checkpoint_restore',  # restore completed (step, dur_s)
     'checkpoint_quarantine',  # torn dir moved aside
+    'commit_intent',       # 2-phase commit: one host's ack landed
+    'commit_finalize',     # 2-phase commit: all acks in, manifest up
+    'reshape_restore',     # restore resharded onto a different
+                           # mesh / process count (elastic reshape)
+    'retry',               # resilience.retry re-attempted a transient
+                           # failure (fn, attempt, delay_s, error)
+    'restart_backoff',     # elastic supervisor delaying a crash
+                           # restart (exponential backoff)
+    'fault_injected',      # chaos engine injected a planned fault
+                           # (seed, fault kind, step/path)
     'preemption',          # SIGTERM/SIGINT latched or observed
     'nan_skip',            # non-finite step skipped on device
     'nan_rollback',        # sentinel demanded a rollback

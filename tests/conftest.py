@@ -30,6 +30,33 @@ jax.config.update('jax_platforms', 'cpu')
 jax.config.update('jax_default_matmul_precision', 'highest')
 
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def chaos():
+    """Factory for scoped ChaosEngines: ``eng = chaos(plan)`` patches
+    the fault seams for the test body and ALWAYS unpatches at teardown
+    (even on failure), so one test's injected faults can never leak
+    into the next."""
+    from paddle_tpu.resilience.chaos import ChaosEngine, FaultPlan
+    engines = []
+
+    def make(plan, heartbeat_file=None):
+        if isinstance(plan, dict):
+            plan = FaultPlan(**plan)
+        eng = ChaosEngine(plan, heartbeat_file=heartbeat_file)
+        engines.append(eng)
+        return eng.activate()
+
+    yield make
+    # reverse order: a later engine saved the earlier one's patched
+    # seams as its "originals", so forward teardown would re-install
+    # the first engine's fault wrappers permanently
+    for eng in reversed(engines):
+        eng.deactivate()
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         'markers',

@@ -12,6 +12,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 import paddle_tpu as paddle
+from paddle_tpu.core.jaxcompat import shard_map
 import paddle_tpu.nn as nn
 import paddle_tpu.distributed as dist
 from paddle_tpu.distributed import fleet, collective, env as dist_env
@@ -40,7 +41,7 @@ class TestCollectives:
             return out.value
 
         xs = jnp.arange(8.0)
-        y = jax.shard_map(body, mesh=mesh, in_specs=P('dp'),
+        y = shard_map(body, mesh=mesh, in_specs=P('dp'),
                           out_specs=P('dp'))(xs)
         np.testing.assert_allclose(np.asarray(y), np.full(8, 28.0))
 
@@ -59,7 +60,7 @@ class TestCollectives:
             return out.value
 
         xs = jnp.arange(8.0)
-        y = jax.shard_map(body, mesh=mesh, in_specs=P('dp'),
+        y = shard_map(body, mesh=mesh, in_specs=P('dp'),
                           out_specs=P('dp'))(xs)
         np.testing.assert_allclose(np.asarray(y), np.full(8, 3.0))
 
@@ -73,7 +74,7 @@ class TestCollectives:
             return got.value
 
         xs = jnp.arange(8.0).reshape(8, 1)
-        y = jax.shard_map(body, mesh=mesh, in_specs=P('dp'),
+        y = shard_map(body, mesh=mesh, in_specs=P('dp'),
                           out_specs=P(None, 'dp'))(xs)
         assert np.asarray(y).shape == (8, 8)
 
@@ -87,7 +88,7 @@ class TestCollectives:
             return out.value
 
         xs = jnp.arange(8.0)
-        y = jax.shard_map(body, mesh=mesh, in_specs=P('pp'),
+        y = shard_map(body, mesh=mesh, in_specs=P('pp'),
                           out_specs=P('pp'))(xs)
         np.testing.assert_allclose(np.asarray(y),
                                    np.roll(np.arange(8.0), 1))

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 import paddle_tpu as paddle
+from paddle_tpu.core.jaxcompat import shard_map
 from paddle_tpu.distributed import fleet
 from paddle_tpu.distributed.fleet import metrics as FM
 from paddle_tpu.metric import Auc
@@ -59,7 +60,7 @@ class TestMeshRoute:
             local = jnp.sum(x)
             return (FM.sum(local), FM.max(local), FM.min(local))
 
-        f = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=P('dp'),
+        f = jax.jit(shard_map(step, mesh=mesh, in_specs=P('dp'),
                                   out_specs=(P(), P(), P())))
         x = np.arange(8, dtype='float32')
         s, mx, mn = f(x)
@@ -92,7 +93,7 @@ class TestMeshRoute:
             neg = jnp.zeros(buckets).at[b].add(1.0 - lb)
             return FM.sum(pos), FM.sum(neg)
 
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(shard_map(
             eval_step, mesh=mesh, in_specs=(P('dp'), P('dp')),
             out_specs=(P(), P())))
         gpos, gneg = f(scores, labels)

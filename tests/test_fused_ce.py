@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 import paddle_tpu as paddle
+from paddle_tpu.core.jaxcompat import shard_map
 from paddle_tpu.ops.fused_ce import fused_linear_cross_entropy
 
 
@@ -230,7 +231,7 @@ class TestTpFusedCE:
             return fused_linear_cross_entropy_tp(
                 xv, wv, yv, axis='tp', num_chunks=chunks)
 
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(shard_map(
             step, mesh=mesh,
             in_specs=(P(), P(None, 'tp'), P()), out_specs=P()))
         got = np.asarray(f(jnp.asarray(x), jnp.asarray(w),
@@ -277,7 +278,7 @@ class TestTpFusedCE:
             return jnp.mean(fused_linear_cross_entropy_tp(
                 xv, wv, jnp.asarray(labels), num_chunks=3))
 
-        g = jax.jit(jax.shard_map(
+        g = jax.jit(shard_map(
             jax.grad(loss_sharded, argnums=(0, 1)), mesh=mesh,
             in_specs=(P(), P(None, 'tp')),
             out_specs=(P(), P(None, 'tp'))))
@@ -301,7 +302,7 @@ class TestTpFusedCE:
         def loss_sharded(xv, wv):
             return jnp.mean(step(xv, wv, jnp.asarray(y)))
 
-        g = jax.jit(jax.shard_map(
+        g = jax.jit(shard_map(
             jax.grad(loss_sharded, argnums=(0, 1)), mesh=mesh,
             in_specs=(P(), P(None, 'tp')),
             out_specs=(P(), P(None, 'tp'))))

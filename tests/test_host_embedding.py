@@ -8,6 +8,7 @@ import numpy as np
 import pytest  # noqa: F401
 
 import paddle_tpu as paddle
+from paddle_tpu.core.jaxcompat import shard_map
 from paddle_tpu import nn
 from paddle_tpu.incubate import HostOffloadEmbedding
 
@@ -714,7 +715,7 @@ class TestFirstLocalOwnership:
         def fwd(idv, anchor):
             return emb._lookup_mp(idv, anchor)
 
-        f = jax.shard_map(fwd, mesh=mesh, in_specs=(P('dp'), P()),
+        f = shard_map(fwd, mesh=mesh, in_specs=(P('dp'), P()),
                           out_specs=P('dp'))
         rows = np.asarray(jax.jit(f)(jnp.asarray(ids),
                                      jnp.zeros((1,), jnp.float32)))
@@ -739,7 +740,7 @@ class TestFirstLocalOwnership:
             out = emb._lookup_mp(idv, anchor)
             return jax.lax.psum(out.sum(), 'dp')
 
-        g = jax.shard_map(jax.grad(loss), mesh=mesh,
+        g = shard_map(jax.grad(loss), mesh=mesh,
                           in_specs=(P(), P('dp')), out_specs=P())
         jax.jit(g)(jnp.zeros((1,), jnp.float32), jnp.asarray(ids))
         jax.effects_barrier()   # pushes are async io_callbacks

@@ -8,10 +8,27 @@ restore?" after a host died mid-save:
     python tools/check_ckpt.py RUN_DIR --no-checksums   # sizes only
     python tools/check_ckpt.py RUN_DIR --step 120       # one step
     python tools/check_ckpt.py RUN_DIR --quiet          # just the step
+    python tools/check_ckpt.py RUN_DIR --deep           # forensic mode
 
 Exit codes: 0 = at least one verified step exists, 1 = none do,
 2 = usage error.  Prints the latest COMMITTED+VERIFIED step on the
 last stdout line, so scripts can `$(... | tail -1)`.
+
+``--deep`` re-hashes EVERY shard of every committed step against the
+manifest digests and classifies each failure, exiting with a distinct
+code per class so automation can branch on the cause:
+
+    3 = torn       (file truncated / size mismatch / some-but-not-all
+                    of a host's shards missing, or 2-phase acks with
+                    no final manifest)
+    4 = missing host  (ALL shards attributed to some host are gone, or
+                    a host's 2-phase ack never landed — the pod lost a
+                    worker mid-commit)
+    5 = digest mismatch  (sizes intact, bytes rotted — storage-level
+                    corruption)
+
+When several classes occur, missing-host wins over torn over digest
+(ordered by how actionable the triage is).
 """
 import argparse
 import os
@@ -22,6 +39,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 from paddle_tpu.resilience import manifest as M  # noqa: E402
 
+EXIT_TORN = 3
+EXIT_MISSING_HOST = 4
+EXIT_DIGEST = 5
+
 
 def _step_dirs(directory, prefix):
     out = []
@@ -30,6 +51,64 @@ def _step_dirs(directory, prefix):
         if f.startswith(prefix + '_') and tag.isdigit():
             out.append((int(tag), os.path.join(directory, f)))
     return sorted(out)
+
+
+def deep_check(step_dir):
+    """Forensic classification of one step dir.
+
+    Returns (classes, details): `classes` ⊆ {'torn', 'missing_host',
+    'digest'}, `details` human-readable lines.  Re-hashes every
+    manifest-recorded file (full read — this is the slow, thorough
+    mode) and cross-checks the two-phase commit records when present:
+    a host whose EVERY shard is absent (or whose ack is missing from a
+    half-committed dir) is a lost worker, not a torn file."""
+    doc = M.read_manifest(step_dir)
+    classes, details = set(), []
+    if doc is None:
+        intents = M.read_intents(step_dir)
+        if intents:
+            classes.add('torn')
+            details.append(
+                f'half-committed: {len(intents)} two-phase ack(s) '
+                f'(hosts {sorted(intents)}) but no final manifest')
+        else:
+            details.append('uncommitted (no manifest, no acks)')
+        return classes, details
+    algo = doc.get('algo', 'sha256')
+    per_host = {}            # host -> [rel, ...]
+    missing_by_host = {}     # host -> [rel, ...]
+    for rel, meta in sorted(doc.get('files', {}).items()):
+        host = meta.get('host', 0)
+        per_host.setdefault(host, []).append(rel)
+        p = os.path.join(step_dir, rel)
+        if not os.path.isfile(p):
+            missing_by_host.setdefault(host, []).append(rel)
+            continue
+        size = os.path.getsize(p)
+        if size != meta.get('size'):
+            classes.add('torn')
+            details.append(
+                f'{rel}: size {size} != recorded {meta.get("size")}')
+            continue
+        if algo in meta and M.file_checksum(p, algo) != meta[algo]:
+            classes.add('digest')
+            details.append(f'{rel}: {algo} mismatch (size intact)')
+    for host, missing in sorted(missing_by_host.items()):
+        if len(missing) == len(per_host[host]):
+            classes.add('missing_host')
+            details.append(
+                f'host {host}: ALL {len(missing)} shard(s) missing')
+        else:
+            classes.add('torn')
+            details.extend(f'{rel}: missing' for rel in missing[:5])
+    hosts = doc.get('hosts')
+    if hosts:
+        for h in range(hosts):
+            if h not in per_host:
+                classes.add('missing_host')
+                details.append(
+                    f'host {h}: no files attributed in the manifest')
+    return classes, details
 
 
 def main(argv=None):
@@ -45,6 +124,11 @@ def main(argv=None):
     ap.add_argument('--no-checksums', action='store_true',
                     help='skip checksum recompute (sizes/presence '
                          'only — fast triage for TB-scale dirs)')
+    ap.add_argument('--deep', action='store_true',
+                    help='re-hash every per-host shard against the '
+                         'manifest digests and exit with a distinct '
+                         'code per failure class: 3=torn, '
+                         '4=missing host, 5=digest mismatch')
     ap.add_argument('--adopt', action='store_true',
                     help='write commit manifests for UNCOMMITTED step '
                          'dirs (migrates checkpoints from before '
@@ -68,7 +152,22 @@ def main(argv=None):
             return 1
 
     latest_ok = -1
+    deep_classes = set()
     for s, p in dirs:
+        if args.deep:
+            classes, details = deep_check(p)
+            deep_classes |= classes
+            ok_deep = not classes and M.read_manifest(p) is not None
+            if ok_deep:
+                latest_ok = max(latest_ok, s)
+            if not args.quiet:
+                status = 'ok (deep)' if ok_deep else \
+                    'FAIL [' + ', '.join(sorted(classes) or
+                                         ['uncommitted']) + ']'
+                print(f'{args.prefix}_{s}: {status}')
+                for line in details[:8]:
+                    print(f'    {line}')
+            continue
         doc = M.read_manifest(p)
         if doc is None and args.adopt:
             M.write_manifest(p, step=s)
@@ -98,6 +197,14 @@ def main(argv=None):
         print('latest committed step:', latest_ok)
     else:
         print(latest_ok)
+    if args.deep and deep_classes:
+        # precedence: a lost worker beats a torn file beats bit rot —
+        # the operator's next action differs per class
+        if 'missing_host' in deep_classes:
+            return EXIT_MISSING_HOST
+        if 'torn' in deep_classes:
+            return EXIT_TORN
+        return EXIT_DIGEST
     return 0 if latest_ok >= 0 else 1
 
 

@@ -47,7 +47,13 @@ SCHEMA_VERSION = 1
 RESILIENCE_KINDS = (
     'preemption', 'nan_skip', 'nan_rollback', 'nan_fatal',
     'checkpoint_save', 'checkpoint_commit', 'checkpoint_restore',
-    'checkpoint_quarantine', 'flight_dump', 'crash')
+    'checkpoint_quarantine', 'flight_dump', 'crash',
+    'commit_intent', 'commit_finalize', 'reshape_restore',
+    'retry', 'restart_backoff', 'fault_injected')
+
+# spans (kind='span', name=...) that belong on the resilience
+# timeline: the 2-phase commit barrier wait and the restore itself
+RESILIENCE_SPAN_NAMES = ('commit_barrier', 'checkpoint_restore')
 
 
 def _percentiles(times_ms):
@@ -284,12 +290,17 @@ def analyze(events, sources, skew=None):
     timeline = []
     t0 = events[0]['ts'] if events else 0
     for e in events:
-        if e['kind'] not in RESILIENCE_KINDS:
+        is_res_span = (e['kind'] == 'span'
+                       and e.get('name') in RESILIENCE_SPAN_NAMES)
+        if e['kind'] not in RESILIENCE_KINDS and not is_res_span:
             continue
+        kind = f"span:{e['name']}" if is_res_span else e['kind']
         row = {'t_rel_s': round((e.get('ts') or t0) - t0, 3),
-               'kind': e['kind'], 'rank': e.get('rank', 0)}
+               'kind': kind, 'rank': e.get('rank', 0)}
         for k in ('step', 'signum', 'strikes', 'rollbacks', 'path',
-                  'moved_to', 'dur_s', 'dispatch_s', 'error'):
+                  'moved_to', 'dur_s', 'dispatch_s', 'error',
+                  'fault', 'seed', 'host', 'hosts', 'attempt',
+                  'delay_s', 'mesh', 'saved_mesh'):
             if e.get(k) is not None:
                 row[k] = e[k]
         timeline.append(row)
