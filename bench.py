@@ -701,6 +701,70 @@ def _chaos_preflight(timeout_s=300):
     return bool(doc.get('ok')), summary
 
 
+def _plan_preflight(timeout_s=600):
+    """--plan-smoke gate: run the auto-sharding planner
+    (tools/tpu_lint.py --plan) over the built-in gpt/widedeep/lenet
+    suite on a virtual dp=8 CPU mesh and compare each target's
+    top-ranked plan against the committed goldens
+    (tools/plan_goldens.json).  A diff means the cost model or the
+    planner's scoring regressed — the same posture as the HLO
+    self-lint gate pinning rule behavior.
+
+    Returns (ok, summary_dict).  Planner-infra failures (timeout,
+    crash, plan_error) never block the bench — evidence beats a dead
+    gate — but a golden MISMATCH always does."""
+    import subprocess
+    repo = os.path.dirname(os.path.abspath(__file__))
+    golden_path = os.path.join(repo, 'tools', 'plan_goldens.json')
+    try:
+        with open(golden_path) as f:
+            goldens = json.load(f)
+    except (OSError, ValueError) as e:
+        log(f'plan preflight skipped (no goldens: {e!r})')
+        return True, {'error': repr(e)[:200]}
+    chips = int(goldens.get('chips', 8))
+    cmd = [sys.executable, os.path.join(repo, 'tools', 'tpu_lint.py'),
+           '--plan', '--chips', str(chips), '--json']
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env['XLA_FLAGS'] = ' '.join(
+        t for t in env.get('XLA_FLAGS', '').split()
+        if not t.startswith('--xla_force_host_platform_device_count'))
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=env)
+        doc = json.loads(proc.stdout)
+    except Exception as e:
+        log(f'plan preflight skipped ({e!r})')
+        return True, {'error': repr(e)[:200]}
+    if doc.get('plan_error'):
+        log(f'plan preflight skipped (plan_error: '
+            f'{doc["plan_error"][:120]})')
+        return True, {'error': doc['plan_error'][:200]}
+    mismatches = {}
+    winners = {}
+    for target, want in (goldens.get('winners') or {}).items():
+        res = (doc.get('plan') or {}).get(target)
+        got = (res or {}).get('winner')
+        winners[target] = None if got is None else {
+            'mesh': got['mesh'], 'assignment': got['assignment'],
+            'fallback': got.get('fallback')}
+        if got is None:
+            mismatches[target] = {'want': want, 'got': None}
+            continue
+        got_mesh = {a: s for a, s in got['mesh'].items() if s > 1}
+        want_mesh = {a: s for a, s in (want.get('mesh') or {}).items()
+                     if s > 1}
+        if got_mesh != want_mesh \
+                or got['assignment'] != want.get('assignment') \
+                or got.get('fallback') != want.get('fallback'):
+            mismatches[target] = {'want': want, 'got': winners[target]}
+    summary = {'winners': winners, 'mismatches': mismatches,
+               'chips': chips}
+    log(f'plan preflight: {len(winners)} targets, '
+        f'{len(mismatches)} golden mismatches')
+    return not mismatches, summary
+
+
 def _lint_preflight(timeout_s=300, smoke=False):
     """tpu_lint gate before burning chip time: a HIGH-severity finding
     in examples/ or paddle_tpu/models/ means some bench config would
@@ -794,6 +858,11 @@ def main():
                    help='run a short seeded fault-injection plan '
                         '(tools/chaos_run.py) and gate on the '
                         'resilience invariants before benching')
+    p.add_argument('--plan-smoke', action='store_true',
+                   help='run the auto-sharding planner over the '
+                        'built-in suite on a virtual dp=8 CPU mesh '
+                        'and gate on the committed golden plans '
+                        '(tools/plan_goldens.json)')
     args = p.parse_args()
 
     if args.single_json:
@@ -807,6 +876,22 @@ def main():
     results = {}
     lint_summary = None
     chaos_summary = None
+    plan_summary = None
+    if args.plan_smoke:
+        plan_ok, plan_summary = _plan_preflight()
+        if not plan_ok:
+            # a golden-plan mismatch means the cost model now ranks
+            # shardings differently — fail before burning chip time,
+            # with the diff as the artifact
+            print(json.dumps({
+                'metric': METRIC_NAMES['resnet'], 'value': None,
+                'unit': UNITS['resnet'], 'vs_baseline': None,
+                'error': 'plan preflight failed (top-ranked plan '
+                         'differs from tools/plan_goldens.json); '
+                         'update the goldens deliberately or fix the '
+                         'cost model, or re-run without --plan-smoke',
+                'plan': plan_summary, 'extras': {}}))
+            sys.exit(1)
     if args.chaos_smoke:
         chaos_ok, chaos_summary = _chaos_preflight()
         if not chaos_ok:
@@ -911,6 +996,8 @@ def main():
         out['lint'] = lint_summary
     if chaos_summary is not None:
         out['chaos'] = chaos_summary
+    if plan_summary is not None:
+        out['plan'] = plan_summary
     # the headline config is excluded from extras, so its stale
     # provenance (if any) rides at the top level
     for k in ('stale_value', 'stale_vs_baseline', 'stale_from',

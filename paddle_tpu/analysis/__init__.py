@@ -14,7 +14,13 @@ properties statically:
 * an **AST pre-trace linter** (``ast_lint``) sweeps dy2static sources
   for host syncs the tracer would hit before a jaxpr exists;
 * **runtime companions** (``runtime``): an eager dtype audit riding
-  core/dispatch, and the retrace monitor compile caches report into.
+  core/dispatch, and the retrace monitor compile caches report into;
+* an **auto-sharding planner** (``planner``): enumerates candidate
+  mesh shapes and PartitionSpec assignments for a step function,
+  scores each through the lowered-HLO audit (torus-decomposed
+  collective cost via ``costmodel`` + liveness peak memory vs an HBM
+  budget) and returns ranked plans — ``tpu_lint --plan`` and
+  ``ParallelTrainer(auto_shard=True)``.
 
 Entry points:
 
@@ -54,6 +60,9 @@ from . import costmodel  # noqa: F401
 from . import hlo  # noqa: F401
 from .hlo import (  # noqa: F401
     HLO_RULES, register_hlo_rule, DEFAULT_HLO_THRESHOLDS)
+from . import targets  # noqa: F401
+from . import planner  # noqa: F401
+from .planner import plan_model  # noqa: F401
 
 # the lowered-HLO SPMD audit (post-partitioner: sharding placement,
 # collective cost, per-device peak memory) — the escalation the
@@ -93,7 +102,8 @@ __all__ = ['lint', 'lint_sources', 'lint_layer', 'lint_hlo',
            'DEFAULT_HLO_THRESHOLDS',
            'lint_source', 'lint_file', 'lint_callable',
            'apply_suppressions', 'amp_audit', 'note_retrace',
-           'walker', 'ast_lint', 'hlo', 'costmodel']
+           'walker', 'ast_lint', 'hlo', 'costmodel', 'targets',
+           'planner', 'plan_model']
 
 
 def _leaf_ranges(example_args):

@@ -1,76 +1,314 @@
-"""Ring cost model for TPU collectives (EQuARX-style comms audit).
+"""Topology-aware cost model for TPU collectives.
 
 Pure math, no jax: given a collective opcode, the per-device buffer
-size the compiled (post-SPMD-partitioner) HLO shows, and the replica
-group size, predict the bytes each device puts on the ICI wire and a
-latency-vs-bandwidth time estimate.  The classic ring algorithms XLA
-uses on TPU tori:
+size the compiled (post-SPMD-partitioner) HLO shows, and the mesh-axis
+decomposition of its replica group, predict the bytes each device puts
+on the ICI wire and a latency-vs-bandwidth time estimate.
 
-  all-reduce      reduce-scatter + all-gather: 2·(n-1)/n · S on the
-                  wire per device, 2·(n-1) hop phases
-  all-gather      each device forwards every shard once: (n-1)·S_shard
-                  = (n-1)/n · S_out, n-1 phases
-  reduce-scatter  (n-1)/n · S_in, n-1 phases
-  all-to-all      (n-1)/n · S, n-1 phases (torus routing folds this,
-                  but the ring bound is the honest static estimate)
-  collective-permute  S bytes, 1 hop
+Two model shapes:
 
-The time estimate is the max of the latency term (phases · per-hop
-latency — dominates small buffers, EQuARX's motivating regime) and the
-bandwidth term (wire bytes / link bandwidth — dominates giant grads),
-reported as their sum (the usual α+β model upper bound).
+* ``ring_cost`` — the classic single-ring bound (one flat ring over
+  the whole group).  Kept byte-exact for single-axis groups; it is
+  the honest estimate when the group does not align with mesh axes.
+* ``torus_cost`` — per-axis staging on a 2D/3D torus, which is what
+  XLA actually emits for multi-axis replica groups (the distributed
+  linear-algebra TPU paper's decomposition, arxiv 2112.09017):
 
-`analysis.hlo` drives this over a parsed HLO module; ParallelTrainer's
-collective census emits the prediction as a ``collective_cost``
-telemetry event so tools/run_report.py can put predicted and observed
-traffic side by side.
+    all-reduce    reduce-scatter down each axis then all-gather back
+                  up in reverse.  Wire bytes are unchanged versus the
+                  flat ring (2·S·(n-1)/n — the bytes must still
+                  leave), but the phase count drops from 2·(n-1) to
+                  Σ 2·(a_i - 1): a 4x4 mesh pays 12 hop latencies,
+                  not 30.
+    all-gather /  per-axis gathers / scatters: (n-1)/n · S on the
+    reduce-scatter  wire, Σ (a_i - 1) phases.
+    all-to-all    per-axis exchange (store-and-forward): each stage
+                  forwards (a_i - 1)/a_i of the FULL buffer along
+                  that axis — Σ S·(a_i-1)/a_i wire bytes (MORE than
+                  the flat ring's (n-1)/n·S: transit bytes are real)
+                  in only Σ (a_i - 1) phases.
+    collective-permute  S bytes, 1 hop.
+
+``axes_for_group`` infers the torus decomposition of a replica group
+from the active mesh shape, so ``analysis.hlo``'s census stops
+costing a dp×tp mesh as one flat ring over all chips.
+
+The time estimate is the alpha+beta sum per stage: phases · per-hop
+latency (dominates small buffers) plus stage wire bytes / link
+bandwidth (dominates giant grads).  Both knobs are *axis-aware*: pass
+a dict ({axis_name: value}, ``'default'`` fallback) when the mesh
+wires different generations/directions differently.  A
+``Calibration`` table (measured alpha/beta per collective kind,
+fitted offline by ``tools/calibrate_costmodel.py`` from archived run
+telemetry) overrides the analytic estimate entirely — the planner
+(``analysis.planner``) consumes it so ranked plans track the chips
+actually in the building rather than data-sheet constants.
 """
+import json
 
-__all__ = ['COLLECTIVE_OPS', 'ring_cost', 'DEFAULT_LINK_BW_GBPS',
-           'DEFAULT_LINK_LATENCY_US']
+__all__ = ['COLLECTIVE_OPS', 'ring_cost', 'torus_cost',
+           'axes_for_group', 'Calibration', 'load_calibration',
+           'effective_links',
+           'DEFAULT_LINK_BW_GBPS', 'DEFAULT_LINK_LATENCY_US']
 
 # per-direction ICI link bandwidth and per-hop latency.  ~90 GB/s and
 # ~1 us are the right order for one TPU v4/v5 ICI link; both are knobs
-# (thresholds / CLI flags) because the point is the MODEL SHAPE of the
-# prediction, not chip-generation precision.
+# (thresholds / CLI flags / calibration tables) because the point is
+# the MODEL SHAPE of the prediction, not chip-generation precision.
 DEFAULT_LINK_BW_GBPS = 90.0
 DEFAULT_LINK_LATENCY_US = 1.0
 
-# opcode -> (wire fraction numerator as f(n), phases as f(n)); S is the
-# per-device buffer size the compiled HLO shows for the op
 COLLECTIVE_OPS = ('all-reduce', 'all-gather', 'reduce-scatter',
                   'all-to-all', 'collective-permute')
 
+CALIBRATION_VERSION = 1
 
-def ring_cost(opcode, local_bytes, group_size, *,
-              bw_gbps=DEFAULT_LINK_BW_GBPS,
-              latency_us=DEFAULT_LINK_LATENCY_US):
-    """Predicted cost of ONE collective op.
+
+class Calibration:
+    """Measured cost-model parameters from a chip session.
+
+    ``per_op`` maps a collective kind to fitted ``alpha_us`` (per hop)
+    and ``beta_us_per_byte`` (per wire byte): when present, the
+    estimate for that kind becomes ``alpha·phases + beta·wire`` with
+    the MEASURED constants.  ``link_bw_gbps`` / ``link_latency_us``
+    (scalar or {axis: value}) re-anchor the analytic defaults for
+    kinds that were not fitted.  Produced by
+    ``tools/calibrate_costmodel.py``; consumed via
+    ``tpu_lint --plan --calibration file.json`` and
+    ``ParallelTrainer(auto_shard=True, calibration=...)``.
+    """
+
+    def __init__(self, per_op=None, link_bw_gbps=None,
+                 link_latency_us=None, meta=None):
+        self.per_op = dict(per_op or {})
+        self.link_bw_gbps = link_bw_gbps
+        self.link_latency_us = link_latency_us
+        self.meta = dict(meta or {})
+
+    @classmethod
+    def from_dict(cls, doc):
+        v = doc.get('version', CALIBRATION_VERSION)
+        if v > CALIBRATION_VERSION:
+            raise ValueError(
+                f'calibration table version {v} is newer than this '
+                f'cost model understands ({CALIBRATION_VERSION})')
+        return cls(per_op=doc.get('per_op'),
+                   link_bw_gbps=doc.get('link_bw_gbps'),
+                   link_latency_us=doc.get('link_latency_us'),
+                   meta=doc.get('meta'))
+
+    def to_dict(self):
+        doc = {'version': CALIBRATION_VERSION, 'per_op': self.per_op}
+        if self.link_bw_gbps is not None:
+            doc['link_bw_gbps'] = self.link_bw_gbps
+        if self.link_latency_us is not None:
+            doc['link_latency_us'] = self.link_latency_us
+        if self.meta:
+            doc['meta'] = self.meta
+        return doc
+
+    def save(self, path):
+        with open(path, 'w') as f:
+            json.dump(self.to_dict(), f, indent=1, sort_keys=True)
+
+    def __repr__(self):
+        return f'Calibration(per_op={sorted(self.per_op)})'
+
+
+def load_calibration(path):
+    """Read a calibration table written by calibrate_costmodel.py."""
+    with open(path) as f:
+        return Calibration.from_dict(json.load(f))
+
+
+def effective_links(bw_gbps, latency_us, calibration):
+    """Resolve the link knobs against a calibration table: measured
+    link numbers re-anchor the analytic DEFAULTS, while an explicit
+    non-default override (CLI flag / thresholds) still wins.  Returns
+    (bw_gbps, latency_us), never None."""
+    if calibration is not None:
+        if calibration.link_bw_gbps is not None and \
+                (not bw_gbps or bw_gbps == DEFAULT_LINK_BW_GBPS):
+            bw_gbps = calibration.link_bw_gbps
+        if calibration.link_latency_us is not None and \
+                (not latency_us
+                 or latency_us == DEFAULT_LINK_LATENCY_US):
+            latency_us = calibration.link_latency_us
+    return (bw_gbps or DEFAULT_LINK_BW_GBPS,
+            latency_us or DEFAULT_LINK_LATENCY_US)
+
+
+def _per_axis(value, axis, default):
+    """Resolve a scalar-or-{axis: value} knob for one mesh axis."""
+    if value is None:
+        return default
+    if isinstance(value, dict):
+        v = value.get(axis)
+        if v is None:
+            v = value.get('default')
+        return default if v is None else float(v)
+    return float(value)
+
+
+def _norm_axes(axes):
+    """axes -> ((name_or_None, size>1), ...).  Accepts bare ints,
+    (name, size) pairs, or a mix; size-1 axes are elided (nothing
+    moves along them)."""
+    out = []
+    for a in axes:
+        if isinstance(a, (tuple, list)):
+            name, size = a[0], int(a[1])
+        else:
+            name, size = None, int(a)
+        if size > 1:
+            out.append((name, size))
+    return tuple(out)
+
+
+def axes_for_group(mesh_shape, group_size):
+    """Infer the torus decomposition of a replica group of
+    ``group_size`` devices on a mesh of ``mesh_shape`` (ordered
+    {axis: size}).
+
+    XLA forms replica groups along mesh axes, so a group's size is a
+    product of some subset of axis sizes; the finest matching subset
+    (most axes) is the decomposition torus routing exploits.  Returns
+    a tuple of (axis_name, size) pairs, or ``((None, group_size),)``
+    — the flat-ring fallback — when no subset multiplies out (a group
+    that does not align with the mesh, or no mesh in hand)."""
+    n = max(1, int(group_size))
+    if n == 1:
+        return ()
+    sized = [(name, int(s)) for name, s in (mesh_shape or {}).items()
+             if int(s) > 1]
+    best = None
+
+    def dfs(i, left, picked):
+        nonlocal best
+        if left == 1:
+            if best is None or len(picked) > len(best):
+                best = tuple(picked)
+            return
+        if i == len(sized):
+            return
+        name, s = sized[i]
+        if left % s == 0:
+            picked.append((name, s))
+            dfs(i + 1, left // s, picked)
+            picked.pop()
+        dfs(i + 1, left, picked)
+
+    dfs(0, n, [])
+    return best if best else ((None, n),)
+
+
+def _stages(opcode, s, axes):
+    """Per-axis (axis_name, phases, wire_bytes) stages of one
+    collective, floats for the multi-axis staging math."""
+    stages = []
+    if opcode == 'collective-permute':
+        name = axes[0][0] if axes else None
+        return [(name, 1, float(s))]
+    if opcode == 'all-reduce':
+        remaining = float(s)
+        down = []
+        for name, a in axes:          # reduce-scatter down each axis
+            down.append((name, a - 1, remaining * (a - 1) / a))
+            remaining /= a
+        # all-gather back up in reverse: mirror bytes and phases
+        return down + [st for st in reversed(down)]
+    if opcode == 'reduce-scatter':
+        remaining = float(s)
+        for name, a in axes:
+            stages.append((name, a - 1, remaining * (a - 1) / a))
+            remaining /= a
+        return stages
+    if opcode == 'all-gather':
+        # s is the GATHERED (output) size; the per-device shard grows
+        # axis by axis
+        n = 1
+        for _, a in axes:
+            n *= a
+        have = float(s) / n
+        for name, a in axes:
+            stages.append((name, a - 1, have * (a - 1)))
+            have *= a
+        return stages
+    if opcode == 'all-to-all':
+        # store-and-forward: every stage forwards (a-1)/a of the FULL
+        # buffer along its axis
+        for name, a in axes:
+            stages.append((name, a - 1, float(s) * (a - 1) / a))
+        return stages
+    return []
+
+
+def torus_cost(opcode, local_bytes, axes, *, bw_gbps=None,
+               latency_us=None, calibration=None):
+    """Predicted cost of ONE collective over a torus-decomposed group.
 
     opcode: base HLO opcode (no -start/-done suffix).
     local_bytes: the op's per-device buffer size — the operand for
     all-reduce/reduce-scatter/all-to-all/collective-permute, the
     OUTPUT for all-gather (the gathered buffer).
-    group_size: devices per replica group (n).
+    axes: the replica group's per-axis sizes — bare ints or
+    (axis_name, size) pairs, e.g. ``(('dp', 4), ('tp', 2))`` from
+    ``axes_for_group``.  A single axis reduces to the classic ring.
+    bw_gbps / latency_us: scalar or {axis_name: value} knobs.
+    calibration: optional ``Calibration`` with fitted per-op
+    alpha/beta that override the analytic estimate.
 
-    Returns {'wire_bytes', 'phases', 'est_us'}; a group of 1 (or an
-    unknown opcode) costs nothing — the partitioner elides it.
+    Returns {'wire_bytes', 'phases', 'est_us', 'axes'}; an empty /
+    all-1 group (or an unknown opcode) costs nothing — the
+    partitioner elides it.
     """
-    n = max(1, int(group_size))
     s = max(0, int(local_bytes))
-    if n == 1 or opcode not in COLLECTIVE_OPS or s == 0:
-        return {'wire_bytes': 0, 'phases': 0, 'est_us': 0.0}
-    if opcode == 'all-reduce':
-        wire = 2 * (n - 1) * s // n
-        phases = 2 * (n - 1)
-    elif opcode == 'collective-permute':
-        wire = s
-        phases = 1
-    else:   # all-gather / reduce-scatter / all-to-all
-        wire = (n - 1) * s // n
-        phases = n - 1
-    # alpha-beta model: latency term + bandwidth term.  1 GB/s moves
-    # 1e3 bytes per microsecond.
-    est_us = phases * float(latency_us) + wire / (float(bw_gbps) * 1e3)
+    axes = _norm_axes(axes)
+    if not axes or opcode not in COLLECTIVE_OPS or s == 0:
+        return {'wire_bytes': 0, 'phases': 0, 'est_us': 0.0, 'axes': ()}
+    bw_gbps, latency_us = effective_links(bw_gbps, latency_us,
+                                          calibration)
+    if len(axes) == 1 and opcode != 'collective-permute':
+        # byte-exact single-ring arithmetic (the pre-torus contract)
+        name, n = axes[0]
+        if opcode == 'all-reduce':
+            wire = 2 * (n - 1) * s // n
+            phases = 2 * (n - 1)
+        else:   # all-gather / reduce-scatter / all-to-all
+            wire = (n - 1) * s // n
+            phases = n - 1
+        alpha = _per_axis(latency_us, name, DEFAULT_LINK_LATENCY_US)
+        bw = _per_axis(bw_gbps, name, DEFAULT_LINK_BW_GBPS)
+        est = phases * alpha + wire / (bw * 1e3)
+    else:
+        stages = _stages(opcode, s, axes)
+        phases = sum(p for _, p, _ in stages)
+        wire = int(sum(b for _, _, b in stages))
+        est = 0.0
+        for name, p, b in stages:
+            alpha = _per_axis(latency_us, name, DEFAULT_LINK_LATENCY_US)
+            bw = _per_axis(bw_gbps, name, DEFAULT_LINK_BW_GBPS)
+            # 1 GB/s moves 1e3 bytes per microsecond
+            est += p * alpha + b / (bw * 1e3)
+    cal = (calibration.per_op.get(opcode)
+           if calibration is not None else None)
+    if cal:
+        est = (float(cal.get('alpha_us', 0.0)) * phases
+               + float(cal.get('beta_us_per_byte', 0.0)) * wire)
     return {'wire_bytes': wire, 'phases': phases,
-            'est_us': round(est_us, 3)}
+            'est_us': round(est, 3), 'axes': axes}
+
+
+def ring_cost(opcode, local_bytes, group_size, *,
+              bw_gbps=DEFAULT_LINK_BW_GBPS,
+              latency_us=DEFAULT_LINK_LATENCY_US):
+    """Flat single-ring bound over the whole group (the honest
+    estimate when no mesh decomposition is known).  See torus_cost
+    for the semantics of opcode/local_bytes."""
+    n = max(1, int(group_size))
+    if n == 1:
+        return {'wire_bytes': 0, 'phases': 0, 'est_us': 0.0}
+    out = torus_cost(opcode, local_bytes, ((None, n),),
+                     bw_gbps=bw_gbps, latency_us=latency_us)
+    return {'wire_bytes': out['wire_bytes'], 'phases': out['phases'],
+            'est_us': out['est_us']}

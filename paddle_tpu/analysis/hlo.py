@@ -49,7 +49,7 @@ __all__ = ['parse_module', 'HloModule', 'HloComputation', 'HloInstr',
            'buffer_bytes', 'collective_census', 'peak_memory',
            'HLO_RULES', 'register_hlo_rule', 'HloRuleContext',
            'run_hlo_rules', 'DEFAULT_HLO_THRESHOLDS', 'audit',
-           'audit_text', 'auto_shardings']
+           'audit_text', 'auto_shardings', 'lower_text']
 
 DEFAULT_HLO_THRESHOLDS = {
     # replicated-giant-hlo: per-device bytes of an intermediate still
@@ -66,6 +66,10 @@ DEFAULT_HLO_THRESHOLDS = {
     # cost-model knobs (costmodel defaults; exposed for A/B vs chips)
     'link_bw_gbps': costmodel.DEFAULT_LINK_BW_GBPS,
     'link_latency_us': costmodel.DEFAULT_LINK_LATENCY_US,
+    # optional costmodel.Calibration (measured alpha/beta per op kind,
+    # from tools/calibrate_costmodel.py) — overrides the analytic
+    # estimate in the census and everything built on it (the planner)
+    'calibration': None,
 }
 
 _DTYPE_BYTES = {
@@ -145,11 +149,11 @@ class HloInstr:
 
     __slots__ = ('name', 'opcode', 'type_spec', 'bytes', 'operands',
                  'sharding', 'group_size', 'called', 'fusion_kind',
-                 'file', 'line', 'is_root')
+                 'call_target', 'file', 'line', 'is_root')
 
     def __init__(self, name, opcode, type_spec, operands=(), sharding=None,
                  group_size=None, called=(), fusion_kind=None, file=None,
-                 line=None, is_root=False):
+                 line=None, is_root=False, call_target=None):
         self.name = name
         self.opcode = opcode
         self.type_spec = type_spec
@@ -159,6 +163,7 @@ class HloInstr:
         self.group_size = group_size    # replica group size (collectives)
         self.called = tuple(called)     # names of called computations
         self.fusion_kind = fusion_kind  # kLoop/kOutput/... for fusions
+        self.call_target = call_target  # custom-call target name
         self.file = file
         self.line = line
         self.is_root = is_root
@@ -253,6 +258,10 @@ def _parse_instr(line, num_partitions):
     if opcode == 'fusion':
         km = re.search(r'kind=(\w+)', rest)
         fusion_kind = km.group(1) if km else None
+    call_target = None
+    if opcode == 'custom-call':
+        tm = re.search(r'custom_call_target="([^"]*)"', rest)
+        call_target = tm.group(1) if tm else None
     file = line_no = None
     mm = _META_RE.search(rest)
     if mm:
@@ -260,7 +269,8 @@ def _parse_instr(line, num_partitions):
     return HloInstr(name, opcode, type_spec, operands=operands,
                     sharding=_parse_sharding(rest), group_size=group_size,
                     called=called, fusion_kind=fusion_kind, file=file,
-                    line=line_no, is_root=bool(root))
+                    line=line_no, is_root=bool(root),
+                    call_target=call_target)
 
 
 def parse_module(text):
@@ -320,17 +330,23 @@ def _short(type_spec, limit=48):
         else type_spec[:limit - 3] + '...'
 
 
-def collective_census(module, *, bw_gbps=None, latency_us=None):
-    """Per-collective census with predicted ring cost.
+def collective_census(module, *, bw_gbps=None, latency_us=None,
+                      mesh_shape=None, calibration=None):
+    """Per-collective census with predicted cost.
 
-    Returns {base_opcode: {calls, bytes, wire_bytes, est_us,
-    max_wire_bytes, group_size, file, line}} — ``bytes`` is per-device
-    buffer bytes summed over call sites (comparable to the telemetry
-    census), ``wire_bytes``/``est_us`` the cost-model prediction.
-    '-done' halves of async pairs are not double counted.
+    Returns {base_opcode: {calls, bytes, wire_bytes, est_us, phases,
+    max_wire_bytes, group_size, axes, file, line}} — ``bytes`` is
+    per-device buffer bytes summed over call sites (comparable to the
+    telemetry census), ``wire_bytes``/``est_us``/``phases`` the
+    cost-model prediction.  With ``mesh_shape`` in hand each replica
+    group is decomposed onto its torus axes
+    (``costmodel.axes_for_group``) — a dp×tp mesh is no longer costed
+    as one flat ring over all chips — and a ``calibration`` table
+    substitutes measured alpha/beta.  '-done' halves of async pairs
+    are not double counted.
     """
-    bw = bw_gbps or costmodel.DEFAULT_LINK_BW_GBPS
-    lat = latency_us or costmodel.DEFAULT_LINK_LATENCY_US
+    bw, lat = costmodel.effective_links(bw_gbps, latency_us,
+                                        calibration)
     rows = {}
     for comp, ins in module.walk():
         if ins.opcode.endswith('-done'):
@@ -339,24 +355,26 @@ def collective_census(module, *, bw_gbps=None, latency_us=None):
         if base is None:
             continue
         n = ins.group_size or module.num_partitions
+        axes = costmodel.axes_for_group(mesh_shape, n)
         local = _collective_bytes(comp, ins, base)
         if base == 'all-gather':
             # the cost model wants the GATHERED size for all-gather
-            cost = costmodel.ring_cost(base, local * n, n,
-                                       bw_gbps=bw, latency_us=lat)
             counted = local * n
         else:
-            cost = costmodel.ring_cost(base, local, n,
-                                       bw_gbps=bw, latency_us=lat)
             counted = local
+        cost = costmodel.torus_cost(base, counted, axes, bw_gbps=bw,
+                                    latency_us=lat,
+                                    calibration=calibration)
         row = rows.setdefault(base, {
             'calls': 0, 'bytes': 0, 'wire_bytes': 0, 'est_us': 0.0,
-            'max_wire_bytes': 0, 'max_est_us': 0.0, 'group_size': n,
+            'phases': 0, 'max_wire_bytes': 0, 'max_est_us': 0.0,
+            'group_size': n, 'axes': cost['axes'],
             'file': None, 'line': None})
         row['calls'] += 1
         row['bytes'] += counted
         row['wire_bytes'] += cost['wire_bytes']
         row['est_us'] = round(row['est_us'] + cost['est_us'], 3)
+        row['phases'] += cost['phases']
         if cost['wire_bytes'] > row['max_wire_bytes']:
             # group_size/est ride along: on a multi-axis mesh one base
             # opcode mixes group sizes (tp=2 activation vs dp=4 grad
@@ -364,6 +382,7 @@ def collective_census(module, *, bw_gbps=None, latency_us=None):
             row['max_wire_bytes'] = cost['wire_bytes']
             row['max_est_us'] = cost['est_us']
             row['group_size'] = n
+            row['axes'] = cost['axes']
             row['file'], row['line'] = ins.file, ins.line
     return rows
 
@@ -464,7 +483,9 @@ class HloRuleContext:
             self._census = collective_census(
                 self.module,
                 bw_gbps=self.thresholds['link_bw_gbps'],
-                latency_us=self.thresholds['link_latency_us'])
+                latency_us=self.thresholds['link_latency_us'],
+                mesh_shape=self.mesh_shape or None,
+                calibration=self.thresholds.get('calibration'))
             self.summary['collectives'] = self._census
             self.summary['collective_wire_bytes'] = sum(
                 r['wire_bytes'] for r in self._census.values())
@@ -745,10 +766,29 @@ def audit_text(text, *, mesh=None, thresholds=None, disable=(),
     return report
 
 
+def lower_text(fn, *example_args, jit_kwargs=None, lower_cache=None,
+               cache_key=None, **example_kwargs):
+    """``jax.jit(fn, **jit_kwargs).lower(...).compile().as_text()``
+    with an optional cross-caller memo: when `lower_cache` (a plain
+    dict) holds `cache_key`, the trace+lower+compile is skipped
+    entirely.  This is how ``tpu_lint --plan`` and ``--hlo`` share
+    ONE lowering per (target, mesh) pair instead of paying the
+    partitioner twice for the same program."""
+    import jax
+    if lower_cache is not None and cache_key is not None \
+            and cache_key in lower_cache:
+        return lower_cache[cache_key]
+    text = jax.jit(fn, **(jit_kwargs or {})).lower(
+        *example_args, **example_kwargs).compile().as_text()
+    if lower_cache is not None and cache_key is not None:
+        lower_cache[cache_key] = text
+    return text
+
+
 def audit(fn, *example_args, mesh=None, in_shardings='auto',
           out_shardings=None, donate_argnums=(), jit_kwargs=None,
           thresholds=None, disable=(), name=None, global_shapes=None,
-          **example_kwargs):
+          lower_cache=None, cache_key=None, **example_kwargs):
     """Lower `fn` through the SPMD partitioner and audit the compiled
     per-device HLO.  No device execution: ``jit.lower().compile()``
     only — runs fine under JAX_PLATFORMS=cpu with
@@ -762,8 +802,9 @@ def audit(fn, *example_args, mesh=None, in_shardings='auto',
     jit_kwargs: full jax.jit kwargs from a compile choke point
     (ParallelTrainer passes its real in/out shardings + donation) —
     overrides in/out_shardings/donate_argnums.
+    lower_cache / cache_key: see ``lower_text`` — reuse (or publish)
+    the compiled HLO text of this exact (fn, shardings) pair.
     """
-    import jax
     name = name or getattr(fn, '__name__', None) or 'step'
     thr = dict(DEFAULT_HLO_THRESHOLDS)
     thr.update(thresholds or {})
@@ -780,14 +821,15 @@ def audit(fn, *example_args, mesh=None, in_shardings='auto',
             jit_kwargs['out_shardings'] = out_shardings
         if donate_argnums:
             jit_kwargs['donate_argnums'] = tuple(donate_argnums)
-    compiled = jax.jit(fn, **jit_kwargs).lower(
-        *example_args, **example_kwargs).compile()
+    text = lower_text(fn, *example_args, jit_kwargs=jit_kwargs,
+                      lower_cache=lower_cache, cache_key=cache_key,
+                      **example_kwargs)
     if global_shapes is None:
         # a caller that already traced the step (the jaxpr lint runs
         # first at every choke point) can pass its shapes and skip
         # this second abstract trace
         global_shapes = _global_big_shapes(
             fn, example_args, example_kwargs, thr['replicated_bytes'])
-    return audit_text(compiled.as_text(), mesh=mesh, thresholds=thr,
+    return audit_text(text, mesh=mesh, thresholds=thr,
                       disable=disable, global_shapes=global_shapes,
                       name=name)

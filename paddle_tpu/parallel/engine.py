@@ -58,7 +58,8 @@ class ParallelTrainer:
 
     def __init__(self, model, optimizer, loss_fn, mesh=None, strategy=None,
                  donate=True, n_inputs=1, nan_guard=False, nan_patience=3,
-                 nan_max_rollbacks=2, lint=None):
+                 nan_max_rollbacks=2, lint=None, auto_shard=False,
+                 hbm_budget_gb=None, calibration=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -67,6 +68,18 @@ class ParallelTrainer:
         self.strategy = strategy or getattr(optimizer, '_fleet_strategy',
                                             None)
         self.donate = donate
+        # auto_shard: consult analysis.planner for the best
+        # (mesh, PartitionSpec) plan over the available devices and
+        # apply it before the first compile.  True -> defaults; a dict
+        # is passed through to planner.plan_model (max_candidates,
+        # include_pp, thresholds, ...).  hbm_budget_gb gates the plan's
+        # peak-memory estimate; calibration is a measured
+        # costmodel.Calibration (or a path to one).
+        self.auto_shard = auto_shard
+        self.hbm_budget_gb = hbm_budget_gb
+        self.plan_calibration = calibration
+        self._auto_planned = False
+        self.plan = None        # the winning analysis.planner plan
         # lint: audit the compiled step with paddle_tpu.analysis on
         # first build — the mesh is passed through, so the
         # replicated-giant rule is live here.  None/False off,
@@ -107,6 +120,14 @@ class ParallelTrainer:
                     'dp/tp configuration of the same model instead.',
                     UserWarning, stacklevel=3)
                 self.lint = None
+            if self.auto_shard:
+                import warnings
+                warnings.warn(
+                    'ParallelTrainer(auto_shard=True) is not supported '
+                    'under pipeline parallelism (the planner cannot '
+                    'reshape a configured 1F1B schedule); keeping the '
+                    'hand-specified mesh.', UserWarning, stacklevel=3)
+                self.auto_shard = False
             self._init_pipeline(pp)
             return
 
@@ -115,7 +136,11 @@ class ParallelTrainer:
         self.params = params
         self.buffers = buffers
         self.opt_state = optimizer.init(params)
-        if self.mesh is not None:
+        if self.auto_shard:
+            pass    # placement deferred: the planner picks the mesh
+                    # and PartitionSpecs at the first step, when the
+                    # batch shapes are known (_auto_plan)
+        elif self.mesh is not None:
             self._place_state()
         elif self.donate:
             # device_put would alias the live Parameters' arrays; the
@@ -449,12 +474,106 @@ class ParallelTrainer:
             kwargs['donate_argnums'] = (0, 2)
         return jax.jit(train_step, **kwargs)
 
+    # -- auto-sharding (analysis.planner) ------------------------------------
+    def _auto_plan(self, vals):
+        """Consult the planner with the real batch shapes, apply the
+        winning (mesh, PartitionSpec) plan, and emit a
+        ``plan_selected`` telemetry event run_report joins against
+        the observed collective census.  Planner failure degrades to
+        the hand-specified posture with a warning — auto_shard must
+        never be able to kill a train loop that would have run."""
+        import warnings
+        from .. import telemetry as _tel
+        from ..analysis import planner as _planner
+        self._auto_planned = True
+        devices = (list(self.mesh.devices.flat)
+                   if self.mesh is not None else list(jax.devices()))
+        kwargs = dict(self.auto_shard) \
+            if isinstance(self.auto_shard, dict) else {}
+        if kwargs.pop('include_pp', False):
+            # a pp>1 winner would be applied as a plain mesh with no
+            # 1F1B schedule behind it: pp-way redundant compute sold
+            # at a pipeline price.  Configure strategy.pipeline by
+            # hand to use pp.
+            warnings.warn(
+                'auto_shard cannot apply pipeline (pp>1) plans; '
+                'include_pp is ignored', RuntimeWarning, stacklevel=3)
+        kwargs['include_pp'] = False
+        batch = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                      for v in vals[:self.n_inputs])
+        try:
+            result = _planner.plan_model(
+                self.model, batch, chips=len(devices), devices=devices,
+                hbm_budget_gb=self.hbm_budget_gb,
+                calibration=self.plan_calibration,
+                name=type(self.model).__name__, **kwargs)
+            winner = result.winner
+        except Exception as e:
+            warnings.warn(
+                f'auto_shard planning failed ({e!r}); keeping the '
+                'hand-specified mesh/shardings', RuntimeWarning,
+                stacklevel=3)
+            self._place_unplanned()
+            return
+        if winner is None:
+            warnings.warn(
+                'auto_shard: no candidate plan fit the '
+                f'{result.hbm_bytes / (1 << 30):.1f} GiB HBM budget '
+                '(best peak '
+                + (f'{result.candidates[0].peak_bytes / (1 << 30):.2f}'
+                   ' GiB' if result.candidates else 'unknown')
+                + '); keeping the hand-specified mesh/shardings',
+                RuntimeWarning, stacklevel=3)
+            self._place_unplanned()
+            return
+        self.plan = winner
+        if winner.batch_scale < 1.0:
+            warnings.warn(
+                'auto_shard: only a reduced-batch fallback plan fit '
+                'the HBM budget; the trainer keeps YOUR batch size — '
+                'lower the global batch by '
+                f'{1 / winner.batch_scale:.0f}x to match the plan',
+                RuntimeWarning, stacklevel=3)
+        self.mesh = _planner._build_mesh(devices, winner.mesh_axes)
+        self.param_specs = dict(winner.param_specs)
+        # model-internal maybe_shard constraints read the env mesh at
+        # trace time: the planned mesh must be the live one
+        _env.set_mesh(self.mesh)
+        if winner.remat:
+            if self.strategy is not None:
+                self.strategy.recompute = True
+            else:
+                warnings.warn(
+                    'auto_shard picked a remat fallback plan but no '
+                    'strategy is configured to carry '
+                    'strategy.recompute; the step runs without remat '
+                    'and may exceed the HBM budget', RuntimeWarning,
+                    stacklevel=3)
+        self._place_state()
+        _tel.event('plan_selected', **result.to_event())
+        _tel.add('plan.candidates', len(result.candidates))
+
+    def _place_unplanned(self):
+        """Constructor placement semantics, deferred: the auto_shard
+        path skipped them awaiting the plan — on planner failure the
+        hand-specified posture must still hold (donate may not alias
+        the live Layer's arrays)."""
+        if self.mesh is not None:
+            self._place_state()
+        elif self.donate:
+            self.params = {n: jnp.array(v, copy=True)
+                           for n, v in self.params.items()}
+            self.buffers = {n: jnp.array(v, copy=True)
+                            for n, v in self.buffers.items()}
+
     # -- public API ----------------------------------------------------------
     def _ensure_compiled(self, batch):
         """Coerce the batch to raw arrays and latch the jitted step."""
         vals = tuple(b.value if isinstance(b, Tensor) else jnp.asarray(b)
                      for b in batch)
         if self._compiled is None:
+            if self.auto_shard and not self._auto_planned:
+                self._auto_plan(vals)
             self._n_batch = len(vals)
             # abstract shapes only — pinning the real batch arrays
             # would hold a full global batch in HBM for the trainer's
@@ -571,7 +690,8 @@ class ParallelTrainer:
                     jnp.zeros((), jnp.int32), key,
                     *self._example_vals).compile()
                 text = compiled.as_text()
-            census = _hlo.collective_census(_hlo.parse_module(text))
+            census = _hlo.collective_census(
+                _hlo.parse_module(text), mesh_shape=dict(self.mesh.shape))
             per_op = {base: {'calls': r['calls'], 'bytes': r['bytes']}
                       for base, r in census.items()}
             total = sum(r['bytes'] for r in per_op.values())
@@ -582,6 +702,7 @@ class ParallelTrainer:
             predicted = {base: {'calls': r['calls'],
                                 'wire_bytes': r['wire_bytes'],
                                 'est_us': r['est_us'],
+                                'phases': r['phases'],
                                 'group_size': r['group_size']}
                          for base, r in census.items()}
             _tel.event('collective_cost', name='ParallelTrainer.step',

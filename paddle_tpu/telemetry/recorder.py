@@ -63,8 +63,15 @@ EVENT_KINDS = (
     'nan_fatal',           # rollback budget exhausted
     'lint_finding',        # analysis finding surfaced at a choke point
     'collectives',         # per-op collective byte census of one step
-    'collective_cost',     # predicted wire bytes / ring time per
+    'collective_cost',     # predicted wire bytes / torus time per
                            # collective (analysis.costmodel at compile)
+    'collective_observed', # profiled per-collective timing from a
+                           # chip session (op, wire_bytes, us, phases)
+                           # — calibrate_costmodel fits alpha/beta
+                           # from these
+    'plan_selected',       # auto-sharding planner chose a plan
+                           # (winner mesh/assignment, predicted wire
+                           # bytes/us + peak HBM, candidates scored)
     'steps',               # StepAccumulator flush (per-step scalars)
     'span',                # a closed span (name, dur_s)
     'scalar',              # user scalar (VisualDL / ScalarAdapter)

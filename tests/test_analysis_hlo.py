@@ -620,7 +620,7 @@ class TestCollectiveCostTelemetry:
             [sys.executable,
              os.path.join(REPO, 'tools', 'run_report.py'), d],
             capture_output=True, text=True, timeout=120)
-        assert 'predicted (ring model)' in p2.stdout
+        assert 'predicted (cost model)' in p2.stdout
         assert 'predicted total' in p2.stdout
 
 

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""calibrate_costmodel — fit measured alpha/beta for the collective
+cost model from archived run telemetry.
+
+The analytic cost model (paddle_tpu.analysis.costmodel) predicts a
+collective's time as ``alpha * phases + beta * wire_bytes`` with
+data-sheet constants.  A chip session that profiles its collectives
+emits ``collective_observed`` telemetry events (op, wire_bytes,
+phases, us); this harness replays those JSONL streams (and/or
+run_report --json documents), fits alpha/beta per collective kind by
+least squares, and writes the calibration table the planner consumes:
+
+    python tools/calibrate_costmodel.py /ckpt/run7/telemetry \\
+        -o calibration.json
+    python tools/tpu_lint.py --plan --chips 256 \\
+        --calibration calibration.json
+
+No chip (and no jax install) required: stdlib-only over archived
+JSONL, like run_report.  Sample sources, in priority order:
+
+* ``collective_observed`` events in telemetry-*.jsonl / flightrec
+  dumps — one (phases, wire_bytes, us) sample each;
+* run_report ``--json`` documents (recognized by schema_version +
+  collectives_cmp): each op row's aggregate observed_us /
+  observed_wire_bytes / observed_phases becomes one sample.
+
+Fit per op kind: ordinary least squares on
+``us ~ alpha * phases + beta * wire_bytes`` via the 2x2 normal
+equations, coefficients clamped at >= 0.  With fewer than
+--min-samples samples (or a singular system — all samples the same
+size), alpha is pinned to the analytic default and only beta is
+fitted; kinds with no samples at all are left out of the table (the
+cost model keeps its analytic estimate for them).
+
+Output schema (costmodel.Calibration version 1):
+
+    {"version": 1,
+     "per_op": {"all-reduce": {"alpha_us": ..,
+                               "beta_us_per_byte": ..,
+                               "samples": N, "residual_us": ..}},
+     "meta": {"sources": [...], "fitted_at": null}}
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import run_report  # noqa: E402  (stdlib-only sibling)
+
+CALIBRATION_VERSION = 1
+DEFAULT_ALPHA_US = 1.0      # costmodel.DEFAULT_LINK_LATENCY_US
+
+
+def harvest(paths):
+    """(samples, sources): samples = {op: [(phases, wire_bytes, us)]}."""
+    samples, sources = {}, []
+    jsonls, flights = run_report.discover(paths)
+    report_docs = []
+    kept_flights = []
+    for f in flights:
+        # a run_report --json doc is also a .json file — sniff it
+        try:
+            with open(f) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict) and 'collectives_cmp' in doc:
+            report_docs.append((f, doc))
+        else:
+            kept_flights.append(f)
+    if jsonls or kept_flights:
+        events, srcs, _skew = run_report.load_events(jsonls,
+                                                     kept_flights)
+        n = 0
+        for e in events:
+            if e.get('kind') != 'collective_observed':
+                continue
+            op = e.get('op')
+            us = e.get('us')
+            wire = e.get('wire_bytes')
+            if op is None or us is None or wire is None:
+                continue
+            phases = e.get('phases') or 0
+            samples.setdefault(op, []).append(
+                (float(phases), float(wire), float(us)))
+            n += 1
+        sources.append({'type': 'events', 'files': len(srcs),
+                        'samples': n})
+    for f, doc in report_docs:
+        n = 0
+        for op, row in (doc.get('collectives_cmp') or {}).items():
+            us = row.get('observed_us')
+            wire = row.get('observed_wire_bytes') \
+                or row.get('predicted_wire_bytes')
+            phases = row.get('observed_phases') \
+                or row.get('predicted_phases') or 0
+            if us is None or wire is None:
+                continue
+            samples.setdefault(op, []).append(
+                (float(phases), float(wire), float(us)))
+            n += 1
+        sources.append({'type': 'run_report', 'file': f, 'samples': n})
+    return samples, sources
+
+
+def fit_op(rows, *, min_samples=2, default_alpha=DEFAULT_ALPHA_US):
+    """Least-squares ``us ~ alpha*phases + beta*wire`` over one op's
+    samples.  Returns {'alpha_us', 'beta_us_per_byte', 'samples',
+    'residual_us', 'mode'}."""
+    n = len(rows)
+    spp = sum(p * p for p, _, _ in rows)
+    sww = sum(w * w for _, w, _ in rows)
+    spw = sum(p * w for p, w, _ in rows)
+    spu = sum(p * u for p, _, u in rows)
+    swu = sum(w * u for _, w, u in rows)
+    det = spp * sww - spw * spw
+    alpha = beta = None
+    mode = 'lstsq'
+    # the system is singular when every sample has proportional
+    # (phases, wire) — one buffer size profiled over and over
+    if n >= min_samples and det > 1e-9 * max(spp, sww, 1.0):
+        alpha = (spu * sww - swu * spw) / det
+        beta = (swu * spp - spu * spw) / det
+    if alpha is None or alpha < 0 or beta is None or beta < 0:
+        # beta-only fallback: pin alpha to the analytic default and
+        # attribute the rest to bandwidth (clamped at zero)
+        mode = 'beta-only'
+        alpha = float(default_alpha)
+        num = sum(w * (u - alpha * p) for p, w, u in rows)
+        beta = max(0.0, num / sww) if sww > 0 else 0.0
+    resid = (sum((u - (alpha * p + beta * w)) ** 2
+                 for p, w, u in rows) / n) ** 0.5
+    return {'alpha_us': round(alpha, 6),
+            'beta_us_per_byte': round(beta, 12),
+            'samples': n, 'residual_us': round(resid, 3),
+            'mode': mode}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog='calibrate_costmodel',
+        description='Fit measured alpha/beta per collective kind from '
+                    'archived telemetry; write the calibration table '
+                    'the auto-sharding planner consumes.')
+    ap.add_argument('paths', nargs='+',
+                    help='telemetry dirs, telemetry-*.jsonl files, '
+                         'flightrec-*.json dumps and/or run_report '
+                         '--json documents')
+    ap.add_argument('-o', '--output', default='calibration.json',
+                    help='calibration table path (default: '
+                         'calibration.json)')
+    ap.add_argument('--min-samples', type=int, default=2,
+                    help='fewest samples for a full alpha+beta fit '
+                         '(below it: beta-only; default 2)')
+    ap.add_argument('--json', action='store_true',
+                    help='also print the table to stdout')
+    args = ap.parse_args(argv)
+
+    samples, sources = harvest(args.paths)
+    if not samples:
+        print('calibrate_costmodel: no collective_observed samples '
+              f'under {args.paths} (a chip session that profiles its '
+              'collectives emits them; run_report --json docs with '
+              'observed_us also work)', file=sys.stderr)
+        return 2
+    per_op = {op: fit_op(rows, min_samples=args.min_samples)
+              for op, rows in sorted(samples.items())}
+    doc = {'version': CALIBRATION_VERSION, 'per_op': per_op,
+           'meta': {'sources': sources,
+                    'total_samples': sum(len(r)
+                                         for r in samples.values())}}
+    with open(args.output, 'w') as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    if args.json:
+        print(json.dumps(doc, indent=1, sort_keys=True))
+    else:
+        for op, row in per_op.items():
+            print(f'{op}: alpha={row["alpha_us"]} us/hop  '
+                  f'beta={row["beta_us_per_byte"]:.3e} us/B  '
+                  f'({row["samples"]} samples, {row["mode"]}, '
+                  f'rms {row["residual_us"]} us)')
+        print(f'wrote {args.output}')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

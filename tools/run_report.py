@@ -17,8 +17,16 @@ Report sections:
   * device-step vs host-wait split (step_time vs dataloader wait);
   * collectives census (per-op calls/bytes, when a mesh step emitted
     one) side by side with the compile-time COST-MODEL PREDICTION
-    (``collective_cost`` events: ring wire bytes + alpha-beta time
-    estimate per op — analysis.costmodel);
+    (``collective_cost`` events: torus wire bytes + alpha-beta time
+    estimate per op — analysis.costmodel) and, when a chip session
+    profiled them, OBSERVED per-collective timings
+    (``collective_observed`` events — the fit input for
+    tools/calibrate_costmodel.py);
+  * the auto-sharding plan (``plan_selected``): which (mesh,
+    PartitionSpec) candidate the planner chose and its predicted
+    wire/peak numbers joined against the observed census — every
+    auto-sharded run reports predicted-vs-actual for the plan that
+    was picked;
   * the resilience event timeline (preemption, nan_skip/rollback,
     checkpoint save/commit/restore/quarantine) in wall-clock order.
 
@@ -264,21 +272,65 @@ def analyze(events, sources, skew=None):
             'wire_bytes_total': last.get('wire_bytes_total', 0),
             'est_us_total': last.get('est_us_total', 0.0),
             'mesh': last.get('mesh')}
+    # profiled per-collective timings (chip-session A/B): the observed
+    # side calibrate_costmodel.py fits alpha/beta from
+    observed_us = {}
+    for e in by_kind.get('collective_observed', ()):
+        op = e.get('op')
+        if op is None:
+            continue
+        row = observed_us.setdefault(
+            op, {'us': 0.0, 'wire_bytes': 0, 'phases': 0, 'calls': 0})
+        row['us'] = round(row['us'] + (e.get('us') or 0.0), 3)
+        row['wire_bytes'] += e.get('wire_bytes') or 0
+        row['phases'] += e.get('phases') or 0
+        row['calls'] += e.get('calls') or 1
     collectives_cmp = None
-    if collectives or collectives_predicted:
+    if collectives or collectives_predicted or observed_us:
         ops = set((collectives or {}).get('per_op', {})) | set(
-            (collectives_predicted or {}).get('per_op', {}))
+            (collectives_predicted or {}).get('per_op', {})) | set(
+            observed_us)
         collectives_cmp = {}
         for op in sorted(ops):
             obs = (collectives or {}).get('per_op', {}).get(op, {})
             pred = (collectives_predicted or {}).get(
                 'per_op', {}).get(op, {})
+            prof = observed_us.get(op, {})
             collectives_cmp[op] = {
                 'observed_calls': obs.get('calls'),
                 'observed_bytes': obs.get('bytes'),
+                'observed_us': prof.get('us'),
+                'observed_wire_bytes': prof.get('wire_bytes') or None,
+                'observed_phases': prof.get('phases') or None,
                 'predicted_wire_bytes': pred.get('wire_bytes'),
                 'predicted_est_us': pred.get('est_us'),
+                'predicted_phases': pred.get('phases'),
             }
+
+    # -- auto-sharding plan: predicted-vs-actual for the chosen plan --
+    plan = None
+    plan_events = by_kind.get('plan_selected', [])
+    if plan_events:
+        last = plan_events[-1]
+        plan = {
+            'name': last.get('name'),
+            'chips': last.get('chips'),
+            'winner': last.get('winner'),
+            'candidates_scored': last.get('candidates_scored'),
+            'hbm_budget_bytes': last.get('hbm_budget_bytes'),
+            'predicted_wire_bytes': last.get('wire_bytes'),
+            'predicted_est_us': last.get('est_us'),
+            'predicted_compute_us': last.get('compute_us'),
+            'predicted_peak_bytes': last.get('peak_bytes'),
+        }
+        obs_bytes = (collectives or {}).get('total_bytes')
+        plan['observed_bytes'] = obs_bytes
+        obs_us = round(sum(r['us'] for r in observed_us.values()), 3) \
+            if observed_us else None
+        plan['observed_us'] = obs_us
+        pred_us = plan.get('predicted_est_us')
+        if obs_us and pred_us:
+            plan['us_ratio'] = round(obs_us / pred_us, 4)
 
     # -- lint findings -------------------------------------------
     lint = {}
@@ -326,6 +378,7 @@ def analyze(events, sources, skew=None):
         'collectives': collectives,
         'collectives_predicted': collectives_predicted,
         'collectives_cmp': collectives_cmp,
+        'plan': plan,
         'clock_skew': skew or {},
         'lint_findings': lint,
         'spans': spans,
@@ -367,12 +420,15 @@ def render(report, stream=None):
         co = report['collectives'] or report['collectives_predicted']
         p(f'\n-- collectives (mesh {co.get("mesh")}) --')
         cmp_rows = report.get('collectives_cmp') or {}
-        p(f'    {"op":<20}{"observed":>22}{"predicted (ring model)":>28}')
+        p(f'    {"op":<20}{"observed":>22}{"predicted (cost model)":>28}')
         for op, row in sorted(cmp_rows.items()):
-            obs = '-'
+            obs_parts = []
             if row['observed_calls'] is not None:
-                obs = (f'{row["observed_calls"]}x '
-                       f'{row["observed_bytes"]:,} B')
+                obs_parts.append(f'{row["observed_calls"]}x '
+                                 f'{row["observed_bytes"]:,} B')
+            if row.get('observed_us') is not None:
+                obs_parts.append(f'{row["observed_us"]:.0f} us')
+            obs = ' '.join(obs_parts) or '-'
             pred = '-'
             if row['predicted_wire_bytes'] is not None:
                 pred = (f'{row["predicted_wire_bytes"]:,} B wire '
@@ -384,7 +440,29 @@ def render(report, stream=None):
         if report.get('collectives_predicted'):
             cp = report['collectives_predicted']
             p(f'    predicted total: {cp["wire_bytes_total"]:,} wire '
-              f'bytes/step, ~{cp["est_us_total"]:.0f} us on the ring')
+              f'bytes/step, ~{cp["est_us_total"]:.0f} us on the wire')
+    if report.get('plan'):
+        pl = report['plan']
+        w = pl.get('winner') or {}
+        p('\n-- auto-sharding plan --')
+        p(f'    {pl.get("name")}: winner {w.get("mesh")} '
+          f'[{w.get("assignment")}]'
+          + (f' +{w["fallback"]}' if w.get('fallback') else '')
+          + f' of {pl.get("candidates_scored")} candidates')
+        if pl.get('predicted_wire_bytes') is not None:
+            p(f'    predicted: {pl["predicted_wire_bytes"]:,} wire '
+              f'bytes/step, ~{pl.get("predicted_est_us", 0):.0f} us '
+              'collectives, peak '
+              f'{(pl.get("predicted_peak_bytes") or 0) / (1 << 30):.2f}'
+              ' GiB')
+        if pl.get('observed_bytes') is not None:
+            obs_line = (f'    observed:  {pl["observed_bytes"]:,} '
+                        'collective bytes/step')
+            if pl.get('observed_us'):
+                obs_line += f', {pl["observed_us"]:.0f} us'
+                if pl.get('us_ratio'):
+                    obs_line += f' (x{pl["us_ratio"]:.2f} of predicted)'
+            p(obs_line)
     if report.get('clock_skew'):
         p('\n-- clock skew (per-host anchor offsets applied) --')
         for r, off in sorted(report['clock_skew'].items()):

@@ -11,6 +11,7 @@ deep-lints one callable's jaxpr.
     python tools/tpu_lint.py x.py --disable host-sync
     python tools/tpu_lint.py --jaxpr pkg.mod:fn --shapes 8x128xf32,8xi32
     python tools/tpu_lint.py examples/ --hlo --mesh dp=8   # SPMD audit
+    python tools/tpu_lint.py --plan --chips 8 [--hbm-gb 16]  # planner
 
 --hlo escalates to the lowered-HLO SPMD audit (paddle_tpu.analysis.hlo):
 each target step is lowered through jax.jit under a FORCED virtual
@@ -23,6 +24,17 @@ high-water vs --hbm-gb).  For examples/ + paddle_tpu/models/ paths a
 built-in suite of representative tiny step functions (GPT dp+tp,
 WideDeep, LeNet — the models the examples train) is lowered; --jaxpr
 targets are HLO-audited directly.
+
+--plan runs the auto-sharding planner (paddle_tpu.analysis.planner)
+over the same built-in suite: every dp/tp/pp factorization of --chips
+(2D/3D torus layouts included) crossed with PartitionSpec assignments
+(declared tp specs / fully replicated / fsdp dim-0) is lowered through
+the partitioner and ranked by predicted step cost (torus-decomposed
+collective wire time + a per-device compute floor) under the --hbm-gb
+budget, with remat / half-batch fallback plans when nothing fits.
+--plan and --hlo share one lowering per (target, mesh, shardings)
+triple.  --calibration swaps measured alpha/beta (from
+tools/calibrate_costmodel.py) into the cost model.
 
 Exit codes: 0 = no findings at/above --fail-on (default: high),
 1 = findings at/above --fail-on, 2 = usage error, or an --hlo
@@ -93,15 +105,17 @@ def _parse_mesh(spec):
     return axes
 
 
-def _force_mesh_env(axes):
+def _force_mesh_env(axes, min_devices=0):
     """Make enough virtual devices exist BEFORE jax imports.  The
     audit never executes device code, so CPU host devices are exactly
     as good as chips for lowering through the SPMD partitioner.
     Without --mesh the default is dp=8: forcing 1 device would make
-    every SPMD rule silently vacuous."""
+    every SPMD rule silently vacuous.  ``min_devices`` raises the
+    floor (--plan --chips N wants N devices regardless of --mesh)."""
     n = 1
     for v in (axes or {'dp': 8}).values():
         n *= v
+    n = max(n, int(min_devices))
     os.environ.setdefault('JAX_PLATFORMS', 'cpu')
     flags = os.environ.get('XLA_FLAGS', '')
     if '--xla_force_host_platform_device_count' not in flags:
@@ -129,126 +143,40 @@ def _build_mesh(axes):
                 tuple(axes.keys()))
 
 
-def _surrogate_step(model):
-    """forward + scalar surrogate loss + grad wrt params: the comms /
-    sharding / liveness story of a train step without dragging a
-    real optimizer into the audit."""
-    import jax
-    import jax.numpy as jnp
-    from paddle_tpu.jit import functional_call
-
-    def step(params, buffers, key, *batch):
-        def loss_fn(p):
-            out, _ = functional_call(model, p, buffers, batch,
-                                     key=key, training=True)
-            return sum(jnp.square(l.astype(jnp.float32)).mean()
-                       for l in jax.tree_util.tree_leaves(out))
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        return loss, grads
-
-    return step
-
-
-def _target_state(model, mesh):
-    """(params, buffers) as ShapeDtypeStructs + their shardings (the
-    model's declared per-param specs resolved over the mesh — the
-    same resolution ParallelTrainer does)."""
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    from paddle_tpu.parallel.api import collect_param_shardings, make_spec
-    params, buffers = model.functional_state()
-    specs = collect_param_shardings(model)
-    p_sh = {n: NamedSharding(mesh, make_spec(specs.get(n), v.ndim, mesh))
-            for n, v in params.items()}
-    repl = NamedSharding(mesh, P())
-    b_sh = {n: repl for n in buffers}
-    sds = lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype)  # noqa: E731
-    return ({n: sds(v) for n, v in params.items()},
-            {n: sds(v) for n, v in buffers.items()}, p_sh, b_sh)
-
-
-def _hlo_target_gpt(mesh):
-    """Tiny GPT in the dp(+tp) posture of examples/gpt_train_generate
-    and examples/distributed_hybrid."""
-    import paddle_tpu as paddle
-    from paddle_tpu.models.gpt import GPT, GPTConfig
-    paddle.seed(0)
-    model = GPT(GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
-                          num_heads=4, max_seq_len=32, dropout=0.0))
-    return model, (_ids_batch(mesh, (8, 16), 128),)
-
-
-def _hlo_target_widedeep(mesh):
-    """WideDeep sparse-gather model (paddle_tpu/models/widedeep)."""
-    import paddle_tpu as paddle
-    from paddle_tpu.models.widedeep import WideDeep
-    paddle.seed(0)
-    model = WideDeep([16, 16, 16, 16], dense_dim=4, embed_dim=8,
-                     shard_vocab=False)
-    import jax
-    import jax.numpy as jnp
-    return model, (_ids_batch(mesh, (8, 4), 16),
-                   jax.ShapeDtypeStruct((8, 4), jnp.float32))
-
-
-def _hlo_target_lenet(mesh):
-    """LeNet vision path of examples/mnist_lenet."""
-    import paddle_tpu as paddle
-    from paddle_tpu.vision.models import LeNet
-    import jax
-    import jax.numpy as jnp
-    paddle.seed(0)
-    model = LeNet()
-    return model, (jax.ShapeDtypeStruct((8, 1, 28, 28), jnp.float32),)
-
-
-def _ids_batch(mesh, shape, vocab):
-    import jax
-    import jax.numpy as jnp
-    del mesh, vocab     # shapes only: lowering never reads values
-    return jax.ShapeDtypeStruct(shape, jnp.int32)
-
-
-# target name -> builder(mesh) -> (model, example_batch); the suite
-# proxies what examples/ + paddle_tpu/models/ actually train
-_HLO_TARGETS = {
-    'gpt': _hlo_target_gpt,
-    'widedeep': _hlo_target_widedeep,
-    'lenet': _hlo_target_lenet,
-}
-
-
-def _run_hlo_suite(mesh, targets, thresholds, disable):
-    """Lower + audit each target; returns {name: LintReport}."""
+def _run_hlo_suite(mesh, target_names, thresholds, disable,
+                   lower_cache=None):
+    """Lower + audit each built-in target (analysis.targets);
+    returns {name: LintReport}.  `lower_cache` is the shared memo —
+    when ``--plan`` already lowered this exact (target, mesh,
+    shardings) triple, the audit reuses that compiled text instead of
+    paying trace+lower a second time."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
     from paddle_tpu import analysis
+    from paddle_tpu.analysis import targets as _targets
     from paddle_tpu.distributed import env as _env
     reports, errors = {}, {}
     prev_mesh = _env.get_mesh()
     _env.set_mesh(mesh)     # model-internal maybe_shard constraints live
     try:
-        first_axis = next((a for a in mesh.axis_names
-                           if mesh.shape[a] > 1), None)
-        for name in targets:
+        for name in target_names:
             # per-target isolation: one broken lower must not discard
             # the audits of the targets that DO lower
             try:
-                model, batch = _HLO_TARGETS[name](mesh)
-                params, buffers, p_sh, b_sh = _target_state(model, mesh)
+                model, batch = _targets.TARGETS[name](mesh)
+                params, buffers, p_sh, b_sh = _targets.target_state(
+                    model, mesh)
                 repl = NamedSharding(mesh, P())
-                batch_sh = tuple(
-                    NamedSharding(mesh, P(first_axis))
-                    if first_axis is not None and b.shape
-                    and b.shape[0] % mesh.shape[first_axis] == 0
-                    else repl
-                    for b in batch)
+                batch_sh = _targets.batch_shardings(mesh, batch)
                 key = jax.random.PRNGKey(0)
+                ck = _targets.cache_key(name, mesh.shape, p_sh,
+                                        batch_sh, batch=batch)
                 reports[name] = analysis.lint_hlo(
-                    _surrogate_step(model), params, buffers, key,
-                    *batch, mesh=mesh,
+                    _targets.surrogate_step(model), params, buffers,
+                    key, *batch, mesh=mesh,
                     in_shardings=(p_sh, b_sh, repl) + batch_sh,
                     thresholds=thresholds, disable=disable,
+                    lower_cache=lower_cache, cache_key=ck,
                     name=f'hlo:{name}')
             except Exception as e:
                 errors[name] = repr(e)
@@ -257,6 +185,27 @@ def _run_hlo_suite(mesh, targets, thresholds, disable):
     finally:
         _env.set_mesh(prev_mesh)
     return reports, errors
+
+
+def _run_plan_suite(target_names, chips, *, hbm_gb=None,
+                    calibration=None, include_pp=True,
+                    max_candidates=None, lower_cache=None):
+    """Auto-sharding planner over the built-in targets; returns
+    ({name: PlanResult}, {name: error})."""
+    from paddle_tpu.analysis import planner
+    results, errors = {}, {}
+    for name in target_names:
+        try:
+            results[name] = planner.plan_target(
+                name, chips=chips, hbm_budget_gb=hbm_gb,
+                calibration=calibration, include_pp=include_pp,
+                max_candidates=max_candidates,
+                lower_cache=lower_cache)
+        except Exception as e:
+            errors[name] = repr(e)
+            print(f'tpu_lint: --plan target {name} failed: {e!r}',
+                  file=sys.stderr)
+    return results, errors
 
 
 def _render_hlo_extras(extras, out=sys.stdout):
@@ -323,13 +272,38 @@ def main(argv=None):
                          'when the backend is not already pinned)')
     ap.add_argument('--hbm-gb', type=float, metavar='GiB',
                     help='per-device HBM budget the peak-memory rule '
-                         'gates against (default: 16)')
+                         'and the planner gate against (default: 16)')
+    ap.add_argument('--plan', action='store_true',
+                    help='auto-sharding planner: enumerate candidate '
+                         'mesh shapes (dp/tp/pp factorizations of '
+                         '--chips) and PartitionSpec assignments for '
+                         'the built-in model suite, score each by '
+                         'lowering through the partitioner (collective '
+                         'wire cost + peak HBM, no execution) and '
+                         'print the ranked plans; shares lowerings '
+                         'with --hlo')
+    ap.add_argument('--chips', type=int, metavar='N',
+                    help='device count the planner plans for '
+                         '(default: 8 virtual CPU devices)')
+    ap.add_argument('--targets', metavar='NAMES',
+                    help='comma-separated built-in targets for --plan '
+                         '(gpt,widedeep,lenet; default: all)')
+    ap.add_argument('--calibration', metavar='FILE',
+                    help='measured alpha/beta calibration table '
+                         '(tools/calibrate_costmodel.py output) the '
+                         'cost model substitutes for its analytic '
+                         'defaults')
+    ap.add_argument('--max-candidates', type=int, metavar='K',
+                    help='cap on lowered plan candidates per target')
+    ap.add_argument('--no-pp', action='store_true',
+                    help='exclude pipeline (pp>1) layouts from the '
+                         'plan enumeration')
     args = ap.parse_args(argv)
 
-    if not args.paths and not args.jaxpr:
+    if not args.paths and not args.jaxpr and not args.plan:
         ap.print_usage(sys.stderr)
-        print('tpu_lint: nothing to lint (give paths or --jaxpr)',
-              file=sys.stderr)
+        print('tpu_lint: nothing to lint (give paths, --jaxpr or '
+              '--plan)', file=sys.stderr)
         return 2
     for p in args.paths:
         if not os.path.exists(p):
@@ -337,14 +311,16 @@ def main(argv=None):
             return 2
 
     mesh_axes = None
-    if args.hlo:
+    if args.plan and not args.chips:
+        args.chips = 8
+    if args.hlo or args.plan:
         try:
             mesh_axes = _parse_mesh(args.mesh) if args.mesh else None
         except ValueError as e:
             print(f'tpu_lint: {e}', file=sys.stderr)
             return 2
         # BEFORE the first jax import (analysis pulls jax in)
-        _force_mesh_env(mesh_axes)
+        _force_mesh_env(mesh_axes, min_devices=args.chips or 0)
 
     from paddle_tpu import analysis
 
@@ -368,12 +344,51 @@ def main(argv=None):
         report.extend(analysis.lint(fn, *shapes,
                                     disable=args.disable))
 
+    # one lowering memo shared by --plan and --hlo: the same
+    # (target, mesh, shardings) triple is compiled exactly once no
+    # matter how many surfaces ask for it
+    lower_cache = {}
+    plan_results = {}
+    plan_error = None
+    calibration = None
+    if args.calibration:
+        from paddle_tpu.analysis import costmodel as _costmodel
+        try:
+            calibration = _costmodel.load_calibration(args.calibration)
+        except (OSError, ValueError) as e:
+            print(f'tpu_lint: cannot load --calibration: {e}',
+                  file=sys.stderr)
+            return 2
+    if args.plan:
+        from paddle_tpu.analysis import targets as _targets_mod
+        names = list(_targets_mod.TARGETS)
+        if args.targets:
+            names = [t.strip() for t in args.targets.split(',')
+                     if t.strip()]
+            unknown = [t for t in names
+                       if t not in _targets_mod.TARGETS]
+            if unknown:
+                print(f'tpu_lint: unknown --targets {unknown} '
+                      f'(have: {list(_targets_mod.TARGETS)})',
+                      file=sys.stderr)
+                return 2
+        plan_results, plan_errors = _run_plan_suite(
+            names, args.chips, hbm_gb=args.hbm_gb,
+            calibration=calibration, include_pp=not args.no_pp,
+            max_candidates=args.max_candidates,
+            lower_cache=lower_cache)
+        if plan_errors:
+            plan_error = '; '.join(f'{t}: {e}'
+                                   for t, e in plan_errors.items())
+
     hlo_reports = {}
     hlo_error = None
     if args.hlo:
         thresholds = {}
         if args.hbm_gb is not None:     # 0 is a legitimate budget
             thresholds['hbm_bytes'] = int(args.hbm_gb * (1 << 30))
+        if calibration is not None:
+            thresholds['calibration'] = calibration
         # inside the degrade-don't-discard region: a mesh that cannot
         # be built (e.g. a preset backend with fewer devices than the
         # forced count could create) must not throw away the AST/jaxpr
@@ -407,9 +422,10 @@ def main(argv=None):
                   file=sys.stderr)
         try:
             if wants_suite and mesh is not None:
+                from paddle_tpu.analysis import targets as _tmod
                 suite_reports, suite_errors = _run_hlo_suite(
-                    mesh, list(_HLO_TARGETS), thresholds,
-                    args.disable)
+                    mesh, list(_tmod.TARGETS), thresholds,
+                    args.disable, lower_cache=lower_cache)
                 hlo_reports.update(suite_reports)
                 if suite_errors:
                     hlo_error = '; '.join(
@@ -437,14 +453,23 @@ def main(argv=None):
                           for n, r in hlo_reports.items()}
             if hlo_error:
                 doc['hlo_error'] = hlo_error
+        if args.plan:
+            doc['plan'] = {n: r.to_json()
+                           for n, r in plan_results.items()}
+            if plan_error:
+                doc['plan_error'] = plan_error
         print(json.dumps(doc, indent=2))
     else:
-        print(report.render() if report else report.summary())
+        if args.paths or args.jaxpr:
+            print(report.render() if report else report.summary())
         for tname, rep in hlo_reports.items():
             print(f'\n-- hlo audit [{tname}] --')
             _render_hlo_extras(rep.extras)
+        for tname, res in plan_results.items():
+            print()
+            print(res.render())
 
-    if hlo_error:
+    if hlo_error or plan_error:
         return 2
     if args.fail_on == 'never':
         return 0
