@@ -765,6 +765,171 @@ def _plan_preflight(timeout_s=600):
     return not mismatches, summary
 
 
+def _cache_smoke_child(telemetry_dir, smoke):
+    """--cache-smoke child: run the lenet trainer + gpt generate cold
+    paths once each, reporting time-to-first-step and the compile
+    cache's per-target deserialize counts as one JSON line.  The
+    parent runs this twice against one cache dir: the second (warm)
+    process must deserialize instead of recompiling."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, telemetry
+    from paddle_tpu.core import compile_cache as cc
+
+    telemetry.enable(telemetry_dir)
+    out = {'cache_enabled': cc.enabled(), 'cache_dir': cc.cache_dir()}
+
+    def delta(before):
+        now = cc.stats()
+        return {k: now.get(k, 0) - before.get(k, 0)
+                for k in ('deserialize_exec', 'serialize_exec',
+                          'hit_exec', 'miss_exec')}
+
+    # -- lenet trainer step --------------------------------------------------
+    from paddle_tpu.vision.models import LeNet
+    from paddle_tpu.parallel import ParallelTrainer
+    batch = 64 if smoke else 256
+    paddle.seed(0)
+    net = LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    ce = nn.CrossEntropyLoss()
+    trainer = ParallelTrainer(net, opt, lambda o, y: ce(o, y))
+    rs = np.random.RandomState(0)
+    x = rs.randn(batch, 1, 28, 28).astype('float32')
+    y = rs.randint(0, 10, size=(batch, 1)).astype('int64')
+    before = cc.stats()
+    t0 = time.perf_counter()
+    loss = trainer.step(x, y)
+    jax.block_until_ready(loss)
+    out['lenet'] = dict(delta(before),
+                        ttfs_s=round(time.perf_counter() - t0, 4),
+                        loss=float(np.asarray(loss)))
+
+    # -- gpt generate (kv-cache decode module) -------------------------------
+    from paddle_tpu.models.gpt import gpt_small, gpt_tiny
+    if smoke:
+        b, prompt, new = 2, 8, 8
+        model = gpt_tiny()
+    else:
+        b, prompt, new = 8, 128, 128
+        model = gpt_small(max_seq_len=prompt + new, dropout=0.0)
+    paddle.seed(0)
+    model.eval()
+    ids = np.random.RandomState(0).randint(
+        0, model.config.vocab_size, (b, prompt)).astype('int64')
+    before = cc.stats()
+    t0 = time.perf_counter()
+    gen = model.generate(paddle.to_tensor(ids), max_new_tokens=new,
+                         temperature=0)
+    np.asarray(gen.value)
+    out['gpt'] = dict(delta(before),
+                      ttfs_s=round(time.perf_counter() - t0, 4),
+                      tokens=np.asarray(gen.value)[0, -4:].tolist())
+    out['stats'] = cc.stats()
+    telemetry.disable()
+    print(json.dumps(out))
+
+
+def _cache_preflight(smoke, timeout_s=900):
+    """--cache-smoke gate: two COLD PROCESSES share one fresh compile
+    cache — the first populates (serialize), the second must record
+    >=1 exec-tier deserialize hit per target (lenet trainer step + gpt
+    generate) and a lower time-to-first-step, proving every restart /
+    cold-start path skips trace+lower.  The warm run's telemetry is
+    joined through run_report so the artifact carries the hit rate.
+
+    Returns (ok, summary).  Infra failures (timeout, crash) never
+    block the bench — evidence beats a dead gate — but a missing hit
+    or a slower warm start always does."""
+    import subprocess
+    import tempfile
+    workdir = tempfile.mkdtemp(prefix='bench_cache_')
+    cache = os.path.join(workdir, 'cache')
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               PADDLE_TPU_COMPILE_CACHE=cache)
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    runs = {}
+    for phase in ('cold', 'warm'):
+        tel = os.path.join(workdir, f'tel_{phase}')
+        cmd = [sys.executable, os.path.abspath(__file__),
+               '--cache-smoke-child', '--telemetry-dir', tel]
+        if smoke:
+            cmd.append('--smoke')
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=timeout_s, env=env)
+            doc = _last_json_dict(proc.stdout)
+        except Exception as e:
+            log(f'cache preflight skipped ({e!r})')
+            return True, {'error': repr(e)[:200]}
+        if doc is None:
+            log(f'cache preflight skipped (no child output, '
+                f'rc={proc.returncode}): {proc.stderr[-300:]}')
+            return True, {'error': f'no output (rc={proc.returncode})'}
+        runs[phase] = doc
+    failures = []
+    per_target = {}
+    tot_cold = tot_warm = 0.0
+    for tgt in ('lenet', 'gpt'):
+        cold = runs['cold'].get(tgt, {})
+        warm = runs['warm'].get(tgt, {})
+        des = warm.get('deserialize_exec', 0)
+        per_target[tgt] = {
+            'cold_ttfs_s': cold.get('ttfs_s'),
+            'warm_ttfs_s': warm.get('ttfs_s'),
+            'warm_deserialize_hits': des,
+        }
+        if des < 1:
+            failures.append(f'{tgt}: warm run recorded no exec-tier '
+                            'deserialize hit')
+        tot_cold += cold.get('ttfs_s') or 0.0
+        tot_warm += warm.get('ttfs_s') or float('inf')
+    # deserialized executables must reproduce the cold numerics
+    # exactly — a fingerprint collision handing back the WRONG module
+    # would otherwise pass on hit count + speed alone
+    if runs['cold'].get('lenet', {}).get('loss') != \
+            runs['warm'].get('lenet', {}).get('loss'):
+        failures.append(
+            f'lenet: warm loss {runs["warm"].get("lenet", {}).get("loss")} '
+            f'!= cold {runs["cold"].get("lenet", {}).get("loss")}')
+    if runs['cold'].get('gpt', {}).get('tokens') != \
+            runs['warm'].get('gpt', {}).get('tokens'):
+        failures.append(
+            f'gpt: warm tokens {runs["warm"].get("gpt", {}).get("tokens")} '
+            f'!= cold {runs["cold"].get("gpt", {}).get("tokens")}')
+    if not tot_warm < tot_cold:
+        # total, not per-target: CPU smoke compile times compress the
+        # per-target margins into the noise floor, but the warm run
+        # must still win overall or the cache isn't saving anything
+        failures.append(
+            f'warm time-to-first-step total {tot_warm:.3f}s not lower '
+            f'than cold {tot_cold:.3f}s')
+    hit_rate = None
+    try:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), 'tools'))
+        import run_report as _rr
+        jsonls, flights = _rr.discover(
+            [os.path.join(workdir, 'tel_warm')])
+        events, sources, skew = _rr.load_events(jsonls, flights)
+        hit_rate = (_rr.analyze(events, sources, skew)
+                    .get('compile_cache'))
+    except Exception as e:
+        log(f'cache preflight: run_report join failed ({e!r})')
+    summary = {'targets': per_target, 'failures': failures,
+               'warm_run_report': hit_rate,
+               'cache_dir': cache}
+    ok = not failures
+    log(f'cache preflight: {"ok" if ok else "FAIL"} '
+        + ' '.join(f'{t}={d["warm_deserialize_hits"]}hit '
+                   f'{d["cold_ttfs_s"]}s->{d["warm_ttfs_s"]}s'
+                   for t, d in per_target.items()))
+    for f in failures:
+        log(f'  {f}')
+    return ok, summary
+
+
 def _lint_preflight(timeout_s=300, smoke=False):
     """tpu_lint gate before burning chip time: a HIGH-severity finding
     in examples/ or paddle_tpu/models/ means some bench config would
@@ -863,7 +1028,25 @@ def main():
                         'built-in suite on a virtual dp=8 CPU mesh '
                         'and gate on the committed golden plans '
                         '(tools/plan_goldens.json)')
+    p.add_argument('--cache-smoke', action='store_true',
+                   help='two cold processes against one fresh compile '
+                        'cache: the second must deserialize (>=1 '
+                        'exec-tier hit per target) and start faster — '
+                        'gates the persistent-compile-cache warm path')
+    p.add_argument('--cache-smoke-child', action='store_true',
+                   help='(internal) run one cold-path pass for '
+                        '--cache-smoke and emit its JSON')
+    p.add_argument('--telemetry-dir', default=None,
+                   help='(internal) telemetry JSONL dir for '
+                        '--cache-smoke-child')
     args = p.parse_args()
+
+    if args.cache_smoke_child:
+        import tempfile
+        _cache_smoke_child(args.telemetry_dir
+                           or tempfile.mkdtemp(prefix='cache_tel_'),
+                           args.smoke)
+        return
 
     if args.single_json:
         if args.config == 'all':
@@ -877,6 +1060,22 @@ def main():
     lint_summary = None
     chaos_summary = None
     plan_summary = None
+    cache_summary = None
+    if args.cache_smoke:
+        cache_ok, cache_summary = _cache_preflight(args.smoke)
+        if not cache_ok:
+            # a cold warm-path means every elastic restart / serving
+            # cold-start re-pays full compilation — fail before
+            # burning chip time, with the per-target numbers as the
+            # artifact
+            print(json.dumps({
+                'metric': METRIC_NAMES['resnet'], 'value': None,
+                'unit': UNITS['resnet'], 'vs_baseline': None,
+                'error': 'cache preflight failed (no deserialize hit '
+                         'or no warm-start speedup); fix the compile '
+                         'cache or re-run without --cache-smoke',
+                'compile_cache': cache_summary, 'extras': {}}))
+            sys.exit(1)
     if args.plan_smoke:
         plan_ok, plan_summary = _plan_preflight()
         if not plan_ok:
@@ -998,6 +1197,8 @@ def main():
         out['chaos'] = chaos_summary
     if plan_summary is not None:
         out['plan'] = plan_summary
+    if cache_summary is not None:
+        out['compile_cache'] = cache_summary
     # the headline config is excluded from extras, so its stale
     # provenance (if any) rides at the top level
     for k in ('stale_value', 'stale_vs_baseline', 'stale_from',

@@ -773,13 +773,37 @@ def lower_text(fn, *example_args, jit_kwargs=None, lower_cache=None,
     dict) holds `cache_key`, the trace+lower+compile is skipped
     entirely.  This is how ``tpu_lint --plan`` and ``--hlo`` share
     ONE lowering per (target, mesh) pair instead of paying the
-    partitioner twice for the same program."""
+    partitioner twice for the same program.
+
+    Keyed lowerings are additionally backed by the PERSISTENT compile
+    cache's text tier (core.compile_cache): a repeated ``tpu_lint``
+    invocation on unchanged targets reads its candidate modules off
+    disk instead of compiling them again — dozens of planner
+    candidates come back in seconds.  `cache_key` must be a
+    deterministic, process-independent value (analysis.targets builds
+    them from resolved specs and shapes); the persistent fingerprint
+    folds in the jax version, backend, device count and package
+    sources, so code or environment drift invalidates cleanly."""
     import jax
     if lower_cache is not None and cache_key is not None \
             and cache_key in lower_cache:
         return lower_cache[cache_key]
+    fp = None
+    if cache_key is not None:
+        from ..core import compile_cache as _cc
+        if _cc.enabled():
+            fp = _cc.fingerprint('lower-text', key=cache_key)
+            if fp is not None:
+                text = _cc.get_text(fp, name='lower_text')
+                if text is not None:
+                    if lower_cache is not None:
+                        lower_cache[cache_key] = text
+                    return text
     text = jax.jit(fn, **(jit_kwargs or {})).lower(
         *example_args, **example_kwargs).compile().as_text()
+    if fp is not None:
+        from ..core import compile_cache as _cc
+        _cc.put_text(fp, text, name='lower_text')
     if lower_cache is not None and cache_key is not None:
         lower_cache[cache_key] = text
     return text

@@ -331,6 +331,16 @@ class CheckpointManager:
         degrades to older data, never crashes on (or silently loads)
         partial state."""
         verify = self.verify if verify is None else verify
+        try:
+            # AOT warm start: a precompile sidecar manifest in the run
+            # dir (tools/precompile.py) pre-loads the exported step
+            # modules, so the restore target's first compile lookups
+            # deserialize instead of re-tracing
+            from ..core import compile_cache
+            compile_cache.warm_start(self.directory,
+                                     name='CheckpointManager')
+        except Exception:
+            pass
         self._sweep_half_committed()
         if step is not None:
             candidates = [step] + [s for s in

@@ -52,12 +52,24 @@ def memory_usage(program, batch_size=1):
     `batch_size` (reference memory_usage: the Program var walk; the
     ±30% band is the reference's own fudge factor).  Pass a jitted
     function's `.lower(...).compile()` object to get XLA's exact
-    per-buffer analysis instead."""
+    per-buffer analysis instead, or anything exposing
+    ``compiled_text()`` (a ParallelTrainer after its first step) to
+    get a liveness high-water estimate from the already-lowered HLO —
+    no re-lowering, and free when the persistent compile cache holds
+    the step's text."""
     if hasattr(program, 'memory_analysis'):   # compiled XLA exe
         ma = program.memory_analysis()
         exact = (ma.temp_size_in_bytes + ma.argument_size_in_bytes
                  + ma.output_size_in_bytes
                  + ma.generated_code_size_in_bytes)
         return exact, exact
+    if hasattr(program, 'compiled_text'):     # e.g. ParallelTrainer:
+        # reuse the trainer's (possibly cache-served) lowered step
+        # instead of re-lowering from scratch — the liveness walk is
+        # analysis.hlo's peak-memory estimate, per device
+        from ...analysis import hlo as _hlo
+        peak = _hlo.peak_memory(_hlo.parse_module(
+            program.compiled_text()))
+        return peak, peak
     size = _param_bytes(program, batch_size)
     return size * 0.7, size * 1.3

@@ -330,7 +330,23 @@ class Model:
         if first_call:
             if self._lint:
                 self._lint_train_step(n_in, st, arrays)
-            self._train_step_cache[key] = self._make_train_step(n_in)
+            jitted = self._make_train_step(n_in)
+            from ..core import compile_cache as _cc
+            if _cc.enabled():
+                # persistent executable cache (core.compile_cache): a
+                # restarted process deserializes the exported step
+                # instead of re-tracing; cold path unchanged (donating
+                # jit) and additionally exported for the next process
+                example = (st['params'], st['buffers'], st['opt'],
+                           jax.random.PRNGKey(0),
+                           jnp.zeros((), jnp.int32),
+                           jnp.zeros((), jnp.float32), *arrays)
+                fp = _cc.jaxpr_fingerprint(
+                    'hapi-train', self._build_train_step(n_in), example,
+                    extra=('donate', (0, 1, 2)))
+                jitted = _cc.through_cache(jitted, example, fp=fp,
+                                           name='Model.train_batch')
+            self._train_step_cache[key] = jitted
             from ..analysis import note_retrace
             note_retrace('Model.train_batch',
                          len(self._train_step_cache), instance=self)

@@ -159,6 +159,16 @@ def _load_snapshot():
     path = _ckpt_path()
     if path is None or not os.path.exists(path):
         return None
+    try:
+        # AOT warm start: when tools/precompile.py left a sidecar
+        # manifest next to the snapshot, pre-load the exported compile
+        # artifacts so the restarted worker's first step deserializes
+        # instead of re-paying trace+lower+compile
+        from ...core import compile_cache
+        compile_cache.warm_start(os.path.dirname(path),
+                                 name='auto_checkpoint')
+    except Exception:
+        pass
     import pickle
     try:
         with open(path, 'rb') as f:

@@ -223,8 +223,21 @@ class StaticFunction:
                         tpos, static, len(args), training, params,
                         buffers, key, tvals),
                     self._check)
-            self._jitted[cache_key] = self._make_jitted(
-                tpos, static, len(args), training)
+            jitted = self._make_jitted(tpos, static, len(args), training)
+            from ..core import compile_cache as _cc
+            if _cc.enabled():
+                # persistent executable cache: a warm process (restart,
+                # second worker, inference cold-start) deserializes the
+                # exported module instead of re-tracing; the cold path
+                # below keeps today's exact jit (and exports it)
+                fp = _cc.jaxpr_fingerprint(
+                    'to_static',
+                    self._make_pure(tpos, static, len(args), training),
+                    (params, buffers, key, tvals))
+                jitted = _cc.through_cache(
+                    jitted, (params, buffers, key, tvals), fp=fp,
+                    name=f'to_static({self.__name__})')
+            self._jitted[cache_key] = jitted
             # the retrace monitor: many signature variants on one
             # StaticFunction means something in the signature is
             # unstable (shapes / scalars / weak types)

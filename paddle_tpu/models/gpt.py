@@ -498,13 +498,26 @@ class GPTForCausalLM(nn.Layer):
         0 = greedy argmax; otherwise softmax sampling (optionally top-k
         truncated).  Returns [B, T0 + max_new_tokens] token ids.
 
+        Prompt lengths are BUCKETED to the next power of two: the
+        prompt is right-padded to the bucket, the true length rides as
+        a traced scalar (prefill samples at row T0-1; decode overwrites
+        the padded k/v slots before the causal mask can expose them),
+        so the compiled-module set stays finite across arbitrary
+        prompt lengths — the serving-bucket precursor.  Token streams
+        are bit-identical to the unbucketed decode (the padded tail is
+        masked to exact zeros).  Modules are keyed through the shared
+        ``core.compile_cache`` fingerprint and persisted as
+        ``jax.export`` artifacts, so a fresh process (restart, serving
+        cold-start) deserializes instead of re-tracing; see
+        ``precompile_decode`` for the export-time AOT path.
+
         The reference decodes through fluid's BeamSearchDecoder host loop
         (fluid/layers/rnn.py:1581); this is the TPU-native equivalent of
         its cache mechanism (nn/layer/transformer.py:151).
         """
         import jax
         import jax.numpy as jnp
-        from ..jit import functional_call
+        from ..core import compile_cache as _cc
 
         cfg = self.config
         ids = input_ids.value if isinstance(input_ids, Tensor) \
@@ -513,14 +526,106 @@ class GPTForCausalLM(nn.Layer):
         B, T0 = ids.shape
         if int(max_new_tokens) < 1:
             return Tensor(ids)
-        Tmax = T0 + int(max_new_tokens)
-        if Tmax > cfg.max_seq_len:
-            raise ValueError(f'prompt+new tokens {Tmax} exceeds '
-                             f'max_seq_len {cfg.max_seq_len}')
+        if T0 + int(max_new_tokens) > cfg.max_seq_len:
+            raise ValueError(
+                f'prompt+new tokens {T0 + int(max_new_tokens)} exceeds '
+                f'max_seq_len {cfg.max_seq_len}')
+        if not hasattr(self, '_gen_cache'):
+            self._gen_cache = {}
+        # the serving hot path keys on the CHEAP signature (bucketed
+        # prompt, not exact length); the fingerprint/closure build in
+        # _decode_program runs only on a module-cache miss
+        P = self._decode_bucket(T0, int(max_new_tokens))
+        greedy = temperature == 0 or temperature is None
+        sig = (B, P, int(max_new_tokens), greedy,
+               float(temperature or 0.0), top_k)
+        params, buffers = self.functional_state()
+        ids_p = jnp.pad(ids, ((0, 0), (0, P - T0)))
+        t0v = jnp.asarray(T0, jnp.int32)
+        key = jax.random.PRNGKey(seed)
+        jitted = self._gen_cache.get(sig)
+        if jitted is None:
+            gen_fn, fp, _ck, _P = self._decode_program(
+                B, T0, int(max_new_tokens), temperature, top_k,
+                params=params)
+            if fp is not None:
+                jitted = _cc.lookup_executable(fp, name='GPT.generate')
+                if jitted is not None:
+                    # aval drift (x64 flip etc.) degrades to a fresh
+                    # jit instead of crashing the serve path
+                    jitted = _cc._with_fallback(
+                        jitted, jax.jit(gen_fn), name='GPT.generate')
+            if jitted is None:
+                # export-primary: ONE trace serves both the persistent
+                # artifact and this process's executable (plain jax.jit
+                # when the cache is off or the trace is unexportable)
+                jitted = _cc.export_jit(
+                    gen_fn, (params, buffers, ids_p, t0v, key), fp=fp,
+                    name='GPT.generate')
+            self._gen_cache[sig] = jitted
+        new = jitted(params, buffers, ids_p, t0v, key)
+        return Tensor(jnp.concatenate([ids, new], axis=1))
+
+    def precompile_decode(self, batch_size, prompt_len, max_new_tokens,
+                          temperature=1.0, top_k=None):
+        """AOT warm start for one decode bucket: build, export and
+        persist the decode module `generate` would compile for this
+        (batch, bucketed prompt, new tokens, sampling) signature —
+        without running it.  Returns (fingerprint, prompt_bucket).
+        ``tools/precompile.py`` drives this over the declared serving
+        bucket set at export time; a later worker's ``generate``
+        deserializes the artifact instead of re-tracing."""
+        import jax
+        import jax.numpy as jnp
+        from ..core import compile_cache as _cc
+        if prompt_len + int(max_new_tokens) > self.config.max_seq_len:
+            raise ValueError(
+                f'prompt+new tokens {prompt_len + int(max_new_tokens)} '
+                f'exceeds max_seq_len {self.config.max_seq_len}')
+        gen_fn, fp, _ck, P = self._decode_program(
+            int(batch_size), int(prompt_len), int(max_new_tokens),
+            temperature, top_k)
+        if fp is None or not _cc.enabled():
+            return fp, P
+        if _cc.get('exec', fp, name='precompile_decode') is None:
+            params, buffers = self.functional_state()
+            example = (params, buffers,
+                       jnp.zeros((int(batch_size), P), jnp.int64),
+                       jnp.asarray(P, jnp.int32), jax.random.PRNGKey(0))
+            _cc.store_executable(fp, jax.jit(gen_fn), example,
+                                 name='GPT.generate', aot_compile=True)
+        return fp, P
+
+    def _decode_bucket(self, T0, max_new_tokens):
+        """Prompt bucket for one decode signature: next power of two
+        (capped so bucket + new tokens fit max_seq_len).  MoE configs
+        are exempt — padded garbage tokens would compete with real
+        ones for expert capacity in prefill."""
+        from ..core import compile_cache as _cc
+        cfg = self.config
+        if cfg.moe_num_experts > 0:
+            return T0
+        return _cc.bucket_pow2(T0, cap=cfg.max_seq_len - max_new_tokens)
+
+    def _decode_program(self, B, T0, max_new_tokens, temperature,
+                        top_k, params=None):
+        """Build the decode function + its shared cache fingerprint for
+        one signature.  Returns (gen_fn, fingerprint, module_key,
+        prompt_bucket); gen_fn(params, buffers, ids[B, bucket],
+        t0_scalar, key) -> new tokens [B, max_new_tokens].  `params`
+        (shapes only are read) saves callers that already hold the
+        functional state a second full tree walk."""
+        import jax
+        import jax.numpy as jnp
+        from ..core import compile_cache as _cc
+        from ..jit import functional_call
+
+        cfg = self.config
+        P = self._decode_bucket(T0, max_new_tokens)
+        Tmax = P + max_new_tokens
         nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
         L = cfg.num_layers
         model = self
-        params, buffers = self.functional_state()
         greedy = temperature == 0 or temperature is None
 
         def sample(logits, key):
@@ -609,15 +714,22 @@ class GPTForCausalLM(nn.Layer):
             return logits, caches
 
         def _make_gen(prepare, step, init_cache):
-            """One decode loop for both block forms: prefill + sample,
-            then a token lax.scan over `step`."""
-            def gen(params, buffers, ids, key):
+            """One decode loop for both block forms: prefill (padded to
+            the bucket, true prompt length `t0` traced), sample at row
+            t0-1, then a token lax.scan over `step` starting at
+            position t0.  Bucketing stays bit-exact: rows < t0 only
+            attend real columns, the garbage k/v the padded prefill
+            rows wrote at t0..P-1 is overwritten by each decoded
+            token's slot BEFORE the causal mask (col <= row) can ever
+            expose it, and the masked softmax tail underflows to exact
+            zeros."""
+            def gen(params, buffers, ids, t0, key):
                 state = prepare(params, buffers)
                 logits, cache = step(state, ids,
                                      jnp.zeros((), jnp.int32),
                                      init_cache())
                 key, sk = jax.random.split(key)
-                tok = sample(logits[:, -1], sk)        # [B]
+                tok = sample(jnp.take(logits, t0 - 1, axis=1), sk)  # [B]
 
                 def body(carry, _):
                     tok, p, cache, key = carry
@@ -627,11 +739,10 @@ class GPTForCausalLM(nn.Layer):
                     return (ntok, p + 1, cache, key), tok
 
                 (last, _, _, _), toks = jax.lax.scan(
-                    body, (tok, jnp.asarray(T0, jnp.int32), cache, key),
-                    None, length=int(max_new_tokens) - 1)
-                new = jnp.concatenate(
+                    body, (tok, t0, cache, key),
+                    None, length=max_new_tokens - 1)
+                return jnp.concatenate(
                     [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
-                return jnp.concatenate([ids, new], axis=1)
             return gen
 
         def _nonblock(tree):
@@ -653,18 +764,24 @@ class GPTForCausalLM(nn.Layer):
                           jnp.zeros((B, nh, Tmax, hd), jnp.float32))
                          for _ in range(L)])
 
-        # jit executables cache per function OBJECT: key the compiled
-        # fn on the decode signature so repeat generate() calls with
-        # the same shapes/sampling reuse one XLA module
-        cache_key = (B, T0, int(max_new_tokens), greedy,
-                     float(temperature or 0.0), top_k)
-        if not hasattr(self, '_gen_cache'):
-            self._gen_cache = {}
-        jitted = self._gen_cache.get(cache_key)
-        if jitted is None:
-            jitted = self._gen_cache[cache_key] = jax.jit(gen_fn)
-        out = jitted(params, buffers, ids, jax.random.PRNGKey(seed))
-        return Tensor(out)
+        # the decode signature keys the module: bucketed prompt P (not
+        # T0), so every prompt length in a bucket reuses ONE compiled
+        # module, in-process and across processes
+        if params is None:
+            params, _ = self.functional_state()
+        pspec = tuple(sorted((n, tuple(v.shape), str(v.dtype))
+                             for n, v in params.items()))
+        fp = _cc.fingerprint(
+            'gpt-decode', config=tuple(sorted(vars(cfg).items())),
+            params=pspec, batch=B, prompt_bucket=P, new=max_new_tokens,
+            sampling=(greedy, float(temperature or 0.0), top_k),
+            scan=use_scan,
+            # prompt-ids aval dtype follows the x64 setting — a module
+            # exported under one setting must not be handed the other
+            ids_dtype=str(jnp.asarray(0, jnp.int64).dtype))
+        ck = fp or ('gen', B, P, max_new_tokens, greedy,
+                    float(temperature or 0.0), top_k, use_scan)
+        return gen_fn, fp, ck, P
 
     def as_pipeline_module(self, num_stages, mesh):
         """Adapter for the 1F1B pipeline engine (parallel.pipeline_1f1b):

@@ -581,9 +581,87 @@ class ParallelTrainer:
             self._example_vals = tuple(
                 jax.ShapeDtypeStruct(v.shape, v.dtype) for v in vals)
             self._compiled = self._build_step()
+            self._maybe_persistent_cache()
             if self.lint:
                 self._run_lint(vals)
         return vals
+
+    # -- persistent compile cache (core.compile_cache) -----------------------
+    def _step_example_args(self):
+        """Abstract example args of the jitted step, in its signature
+        order — shared by the cache fingerprint/export and
+        compiled_text()."""
+        return (self.params, self.buffers, self.opt_state,
+                jnp.zeros((), jnp.int32), jax.random.PRNGKey(0)) \
+            + tuple(self._example_vals)
+
+    def _maybe_persistent_cache(self):
+        """Swap the freshly-built jitted step for a deserialized
+        executable when the persistent cache holds this exact program
+        (same jaxpr, shardings, donation, mesh, jax, code); on a miss,
+        export the cold step so the NEXT process (elastic restart,
+        reshape restore, second worker) deserializes instead of
+        recompiling.  A hit forgoes donation (jax.export artifacts do
+        not donate) — correctness is identical, peak HBM grows by one
+        params+opt generation; set PADDLE_TPU_COMPILE_CACHE=0 to keep
+        strict donation.  Never raises."""
+        from ..core import compile_cache as _cc
+        self._cc_fp = None
+        if not _cc.enabled():
+            return
+        try:
+            args = self._step_example_args()
+            self._cc_fp = _cc.jaxpr_fingerprint(
+                'trainer-step', self._raw_step, args,
+                extra=(repr(self._jit_kwargs),
+                       tuple(sorted(dict(self.mesh.shape).items()))
+                       if self.mesh is not None else None))
+            self._compiled = _cc.through_cache(
+                self._compiled, args, fp=self._cc_fp,
+                name='ParallelTrainer.step')
+        except Exception:       # cache plumbing must never kill a run
+            self._cc_fp = None
+
+    def compiled_text(self):
+        """Compiled (post-partitioner) HLO text of the jitted step —
+        lower+compile only, never executed.  Memoized in-process AND in
+        the persistent cache's text tier, so the collective census,
+        profiler.op_summary and fluid.contrib.memory_usage all share
+        ONE lowering per step program, across processes."""
+        text = getattr(self, '_hlo_text', None)
+        if text is not None:
+            return text
+        if self._compiled is None:
+            raise RuntimeError(
+                'compiled_text() needs a compiled step: run one '
+                'step() (or _ensure_compiled) first')
+        from ..core import compile_cache as _cc
+        fp = None
+        if getattr(self, '_cc_fp', None) and _cc.enabled():
+            fp = _cc.fingerprint('hlo-text', key=self._cc_fp)
+            text = _cc.get_text(fp, name='ParallelTrainer.step')
+            if text is not None:
+                self._hlo_text = text
+                return text
+        compiled = self._compiled.lower(
+            *self._step_example_args()).compile()
+        text = compiled.as_text()
+        try:
+            # module-total cost analysis only exists on the live
+            # compiled object — stash it for op_summary (a
+            # cache-served text has none; the table then omits totals)
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            self._hlo_totals = {k: float(ca[k])
+                                for k in ('flops', 'bytes accessed')
+                                if ca.get(k)}
+        except Exception:
+            self._hlo_totals = {}
+        if fp is not None:
+            _cc.put_text(fp, text, name='ParallelTrainer.step')
+        self._hlo_text = text
+        return text
 
     def _run_lint(self, vals):
         """ParallelTrainer(lint=...): audit the exact step function
@@ -683,13 +761,8 @@ class ParallelTrainer:
             return
         try:
             from ..analysis import hlo as _hlo
-            key = jax.random.PRNGKey(0)
             with _tel.span('hlo_audit'):
-                compiled = self._compiled.lower(
-                    self.params, self.buffers, self.opt_state,
-                    jnp.zeros((), jnp.int32), key,
-                    *self._example_vals).compile()
-                text = compiled.as_text()
+                text = self.compiled_text()
             census = _hlo.collective_census(
                 _hlo.parse_module(text), mesh_shape=dict(self.mesh.shape))
             per_op = {base: {'calls': r['calls'], 'bytes': r['bytes']}
@@ -749,22 +822,22 @@ class ParallelTrainer:
 
     def op_summary(self, *batch, sorted_by='total', **kwargs):
         """Per-op table of THIS trainer's compiled train step
-        (profiler.op_summary) — lowers and compiles on the example
-        batch but does not execute and does not touch the global RNG
-        stream, so profiling never perturbs a seeded run.  Costs one
-        AOT compile; the later step() compile is a separate jit-cache
-        entry (deduped by the persistent XLA cache on TPU)."""
+        (profiler.op_summary) — never executed, never touches the
+        global RNG stream.  The lowered module is shared through
+        compiled_text(): the collective census, this table and
+        fluid.contrib.memory_usage pay at most ONE lowering between
+        them, and none at all when the persistent compile cache
+        already holds this step's HLO text."""
         from ..profiler import op_summary
         if self._pipeline:
             raise NotImplementedError(
                 'op_summary under pipeline parallelism: profile the '
                 'per-stage module instead')
-        vals = self._ensure_compiled(batch)
-        # tracing placeholder only — must NOT advance rng_mod's stream
-        key = jax.random.PRNGKey(0)
-        return op_summary(self._compiled, self.params, self.buffers,
-                          self.opt_state, jnp.asarray(self._step_no + 1),
-                          key, *vals, sorted_by=sorted_by, **kwargs)
+        self._ensure_compiled(batch)
+        text = self.compiled_text()
+        return op_summary(self._compiled, hlo_text=text,
+                          totals=getattr(self, '_hlo_totals', None),
+                          sorted_by=sorted_by, **kwargs)
 
     def eval_step(self, *batch):
         if self._pipeline:

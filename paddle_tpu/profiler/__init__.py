@@ -22,7 +22,7 @@ __all__ = ['Profiler', 'start_profiler', 'stop_profiler', 'profiler',
 
 
 def op_summary(fn, *args, sorted_by='total', top=25, stream=None,
-               print_table=True):
+               print_table=True, hlo_text=None, totals=None):
     """Per-op summary table for one jitted step (reference
     fluid/profiler.py prints a per-op table via
     stop_profiler(sorted_key); there the rows are CUDA kernel times —
@@ -41,30 +41,40 @@ def op_summary(fn, *args, sorted_by='total', top=25, stream=None,
 
     sorted_by: 'total'/'bytes' ranks by bytes, 'calls' by call count.
     Returns the rows as a list of dicts (opcode, calls, bytes, ratio).
+
+    hlo_text: compiled HLO text already in hand (a trainer's
+    ``compiled_text()``, the planner's lowering memo, or the
+    persistent compile cache's text tier) — skips the lower+compile
+    entirely, so profiling a just-trained fn is free.  Module-total
+    cost_analysis rows need the live compiled object: pass them via
+    ``totals`` when the caller has them (ParallelTrainer stashes
+    them at its one lowering), else they are omitted on that path.
     """
     if sorted_by not in ('total', 'bytes', 'calls'):
         raise ValueError(
             f"sorted_by must be 'total', 'bytes' or 'calls', "
             f'got {sorted_by!r}')
-    jitted = fn if hasattr(fn, 'lower') else jax.jit(fn)
-    compiled = jitted.lower(*args).compile()
-    totals = {}
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):   # older jax returns [dict]
-            ca = ca[0] if ca else {}
-        for key in ('flops', 'bytes accessed'):
-            if ca.get(key):
-                totals[key] = float(ca[key])
-    except Exception:       # backend without cost analysis
-        pass
+    totals = dict(totals or {})
+    if hlo_text is None:
+        jitted = fn if hasattr(fn, 'lower') else jax.jit(fn)
+        compiled = jitted.lower(*args).compile()
+        hlo_text = compiled.as_text()
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):  # older jax returns [dict]
+                ca = ca[0] if ca else {}
+            for key in ('flops', 'bytes accessed'):
+                if ca.get(key):
+                    totals[key] = float(ca[key])
+        except Exception:       # backend without cost analysis
+            pass
 
     # the HLO-text grammar lives in ONE place: analysis.hlo's parser
     # (walk() = ENTRY + while/cond bodies, fusion internals folded
     # into their call-site `fusion` row — exactly the rows we want)
     from ..analysis import hlo as _hlo
     agg = {}
-    for _comp, ins in _hlo.parse_module(compiled.as_text()).walk():
+    for _comp, ins in _hlo.parse_module(hlo_text).walk():
         if ins.opcode in ('parameter', 'constant', 'tuple',
                           'get-tuple-element'):
             continue        # plumbing, not work

@@ -72,6 +72,9 @@ EVENT_KINDS = (
     'plan_selected',       # auto-sharding planner chose a plan
                            # (winner mesh/assignment, predicted wire
                            # bytes/us + peak HBM, candidates scored)
+    'compile_cache',       # persistent compile-cache traffic (action:
+                           # hit/miss/serialize/deserialize/quarantine/
+                           # warm_start; tier, bytes, dur_s, saved_s)
     'steps',               # StepAccumulator flush (per-step scalars)
     'span',                # a closed span (name, dur_s)
     'scalar',              # user scalar (VisualDL / ScalarAdapter)

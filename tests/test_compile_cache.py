@@ -1,0 +1,579 @@
+"""Persistent compilation cache + AOT warm start (core.compile_cache).
+
+Covers the PR-7 contract: fingerprint stability and key sensitivity
+(mesh / shardings / donation), atomic entry commit with torn-entry
+quarantine (incl. the chaos fixture's fault seams on the shared
+manifest.atomic_write), exec-tier round trips at every compile choke
+point (to_static / ParallelTrainer / hapi / gptgen decode) with
+bit-identical numerics, the cross-process hit via subprocess, the
+env escape hatch, decode prompt-length bucketing, the precompile
+sidecar manifest + warm_start, lower_text's persistent tier, and the
+run_report hit-rate join.
+
+(File name sorts before test_host_embedding so tier-1 runs it.)
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core import compile_cache as cc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def cache(tmp_path, monkeypatch):
+    """A fresh enabled cache dir for one test."""
+    d = tmp_path / 'ccache'
+    monkeypatch.setenv(cc.ENV_VAR, str(d))
+    cc.reset_stats()
+    cc._extra_dirs.clear()
+    yield str(d)
+    cc.reset_stats()
+    cc._extra_dirs.clear()
+
+
+def _delta(before, key):
+    return cc.stats().get(key, 0) - before.get(key, 0)
+
+
+class TestFingerprint:
+    def test_stable_and_part_sensitive(self, cache):
+        a = cc.fingerprint('k', mesh=(('dp', 8),), donate=(0, 2))
+        b = cc.fingerprint('k', mesh=(('dp', 8),), donate=(0, 2))
+        assert a == b and len(a) == 64
+        # mesh, sharding, donation each flip the key
+        assert cc.fingerprint('k', mesh=(('dp', 4),),
+                              donate=(0, 2)) != a
+        assert cc.fingerprint('k', mesh=(('dp', 8),), donate=()) != a
+        assert cc.fingerprint('k2', mesh=(('dp', 8),),
+                              donate=(0, 2)) != a
+        assert cc.fingerprint('k', mesh=(('dp', 8),), donate=(0, 2),
+                              shardings="P('dp')") != a
+
+    def test_jaxpr_fingerprint_ignores_addresses(self, cache):
+        # two closures with identical semantics but distinct function
+        # objects (different id()/0x addresses) must key identically
+        def make(scale):
+            def f(x):
+                return jnp.tanh(x) * scale
+            return f
+
+        args = (jnp.ones((4, 4)),)
+        assert cc.jaxpr_fingerprint('t', make(2.0), args) == \
+            cc.jaxpr_fingerprint('t', make(2.0), args)
+        assert cc.jaxpr_fingerprint('t', make(3.0), args) != \
+            cc.jaxpr_fingerprint('t', make(2.0), args)
+
+    def test_cross_process_stability(self, cache):
+        """The same program fingerprints identically in a fresh
+        interpreter — the property every cross-process hit rests on."""
+        code = (
+            'import os\n'
+            f'os.environ["JAX_PLATFORMS"] = "cpu"\n'
+            'os.environ["XLA_FLAGS"] = '
+            '"--xla_force_host_platform_device_count=8"\n'
+            'import jax.numpy as jnp\n'
+            'from paddle_tpu.core import compile_cache as cc\n'
+            'print(cc.jaxpr_fingerprint("t", '
+            'lambda x: jnp.tanh(x) * 2.0, (jnp.ones((4, 4)),)))\n'
+        )
+        env = dict(os.environ, PADDLE_TPU_COMPILE_CACHE=cache)
+        out = subprocess.run(
+            [sys.executable, '-c', code], capture_output=True,
+            text=True, env=env, cwd=REPO, timeout=120)
+        assert out.returncode == 0, out.stderr[-500:]
+        local = cc.jaxpr_fingerprint(
+            't', lambda x: jnp.tanh(x) * 2.0, (jnp.ones((4, 4)),))
+        assert out.stdout.strip().splitlines()[-1] == local
+
+    def test_bucket_pow2(self):
+        assert cc.bucket_pow2(1) == 1
+        assert cc.bucket_pow2(5) == 8
+        assert cc.bucket_pow2(8) == 8
+        assert cc.bucket_pow2(9) == 16
+        # cap keeps the bucket inside max_seq_len - max_new
+        assert cc.bucket_pow2(5, cap=6) == 6
+        # but never below n itself
+        assert cc.bucket_pow2(7, cap=6) == 7
+
+
+class TestEntryStore:
+    def test_text_round_trip_and_stats(self, cache):
+        fp = cc.fingerprint('hlo-text', key='k1')
+        assert cc.get_text(fp) is None
+        assert cc.put_text(fp, 'HloModule m\n', meta={'x': 1})
+        assert cc.get_text(fp) == 'HloModule m\n'
+        s = cc.stats()
+        assert s['serialize_hlo'] == 1 and s['hit_hlo'] == 1 \
+            and s['miss_hlo'] == 1
+
+    def test_disabled_env_escape_hatch(self, tmp_path, monkeypatch):
+        for off in ('0', 'off', 'false', ''):
+            monkeypatch.setenv(cc.ENV_VAR, off)
+            assert not cc.enabled()
+            assert cc.cache_dir() is None
+            assert not cc.put_text('f' * 64, 'x')
+            assert cc.get_text('f' * 64) is None
+        monkeypatch.setenv(cc.ENV_VAR, str(tmp_path / 'on'))
+        assert cc.enabled()
+
+    def test_torn_entry_quarantined_never_loaded(self, cache):
+        fp = cc.fingerprint('hlo-text', key='torn')
+        cc.put_text(fp, 'HloModule big\n' * 100)
+        path = cc._entry_path('hlo', fp)
+        data = open(path, 'rb').read()
+        with open(path, 'wb') as f:        # external torn write
+            f.write(data[:len(data) // 2])
+        before = cc.stats()
+        assert cc.get_text(fp) is None
+        assert _delta(before, 'quarantine_hlo') == 1
+        assert not os.path.exists(path)
+        assert os.path.exists(path + '.quarantine')
+        # and the quarantined entry stays invisible to later lookups
+        assert cc.get_text(fp) is None
+
+    def test_chaos_torn_write_seam(self, cache, chaos):
+        """put() writes through manifest.atomic_write — the chaos
+        engine's torn-write fault tears the entry mid-commit, and the
+        reader must quarantine instead of loading it."""
+        fp = cc.fingerprint('hlo-text', key='chaos-torn')
+        eng = chaos({'seed': 0, 'faults': [
+            {'kind': 'torn_write', 'path': '.ptcc', 'prob': 1.0}]})
+        assert cc.put_text(fp, 'HloModule torn\n' * 64)
+        assert eng.injected, 'chaos never fired on the cache write'
+        before = cc.stats()
+        assert cc.get_text(fp) is None
+        assert _delta(before, 'quarantine_hlo') == 1
+
+    def test_chaos_io_error_swallowed(self, cache, chaos):
+        """An EIO on the commit write degrades to a no-op put — the
+        cache must never be able to kill a training run."""
+        fp = cc.fingerprint('hlo-text', key='chaos-eio')
+        chaos({'seed': 0, 'faults': [
+            {'kind': 'io_error', 'path': '.ptcc', 'prob': 1.0,
+             'errno_name': 'EIO'}]})
+        assert cc.put_text(fp, 'HloModule x\n') is False
+        assert cc.get_text(fp) is None
+
+
+class TestExecutableTier:
+    def test_round_trip_numerics(self, cache):
+        def f(a, b):
+            return jnp.tanh(a @ b) + 1.0, {'s': (a @ b).sum()}
+
+        args = (jnp.arange(12.0).reshape(3, 4),
+                jnp.arange(8.0).reshape(4, 2))
+        fp = cc.jaxpr_fingerprint('t', f, args)
+        jitted = jax.jit(f)
+        assert cc.store_executable(fp, jitted, args)
+        warm = cc.lookup_executable(fp)
+        assert warm is not None
+        a0, d0 = jitted(*args)
+        a1, d1 = warm(*args)
+        np.testing.assert_array_equal(np.asarray(a0), np.asarray(a1))
+        np.testing.assert_array_equal(np.asarray(d0['s']),
+                                      np.asarray(d1['s']))
+        assert cc.stats()['deserialize_exec'] == 1
+
+    def test_sharded_round_trip(self, cache):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                    ('dp', 'tp'))
+        p_sh = NamedSharding(mesh, P(None, 'tp'))
+        x_sh = NamedSharding(mesh, P('dp'))
+
+        def step(w, x):
+            return w - 0.1 * (x.T @ (x @ w))
+
+        jitted = jax.jit(step, in_shardings=(p_sh, x_sh),
+                         out_shardings=p_sh, donate_argnums=(0,))
+        w = jax.device_put(np.ones((16, 8), np.float32), p_sh)
+        x = jax.device_put(np.ones((8, 16), np.float32), x_sh)
+        fp = cc.jaxpr_fingerprint('t', step, (w, x),
+                                  extra=('shard', str(p_sh), str(x_sh)))
+        assert cc.store_executable(fp, jitted, (w, x))
+        warm = cc.lookup_executable(fp)
+        ref = jax.jit(step, in_shardings=(p_sh, x_sh),
+                      out_shardings=p_sh)(w, x)
+        got = warm(w, x)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+    def test_warm_hit_falls_back_on_new_shapes(self, cache):
+        """A deserialized module is shape-rigid where jax.jit would
+        retrace (ragged last batch, new to_static shapes): the warm
+        callable must degrade to the cold jit, not crash."""
+        def f(x):
+            return (x * 2.0).sum()
+
+        args = (jnp.ones((4, 4)),)
+        fp = cc.jaxpr_fingerprint('t', f, args)
+        cc.store_executable(fp, jax.jit(f), args)
+        warm = cc.through_cache(jax.jit(f), args, fp=fp)
+        assert float(np.asarray(warm(jnp.ones((4, 4))))) == 32.0
+        # a DIFFERENT shape through the same callable: the exported
+        # module rejects it; the fallback jit retraces and answers
+        assert float(np.asarray(warm(jnp.ones((8, 8))))) == 128.0
+        assert cc.stats().get('fallback_exec', 0) == 1
+
+    def test_trainer_ragged_last_batch_after_hit(self, cache):
+        """The warm-restart trainer must survive a smaller final batch
+        exactly like a cold run (jit retraces it silently)."""
+        rs = np.random.RandomState(0)
+        x = rs.randn(8, 1, 28, 28).astype('float32')
+        y = rs.randint(0, 10, size=(8, 1)).astype('int64')
+        t1 = TestChokePoints()._lenet_trainer()
+        t1.step(x, y)                       # populate
+        t2 = TestChokePoints()._lenet_trainer()
+        t2.step(x, y)                       # deserialize hit
+        assert cc.stats().get('deserialize_exec', 0) >= 1
+        loss = t2.step(x[:4], y[:4])        # ragged final batch
+        assert np.isfinite(float(np.asarray(loss)))
+
+    def test_through_cache_cold_then_warm(self, cache):
+        def f(x):
+            return jnp.sin(x).sum()
+
+        args = (jnp.ones((8,)),)
+        fp = cc.jaxpr_fingerprint('t', f, args)
+        cold = jax.jit(f)
+        out = cc.through_cache(cold, args, fp=fp)
+        assert out is cold          # miss: the cold jit is kept
+        warm = cc.through_cache(jax.jit(f), args, fp=fp)
+        assert warm is not cold     # hit: deserialized replacement
+        np.testing.assert_allclose(np.asarray(cold(*args)),
+                                   np.asarray(warm(*args)))
+
+
+class TestChokePoints:
+    def _lenet_trainer(self):
+        from paddle_tpu import nn
+        from paddle_tpu.vision.models import LeNet
+        from paddle_tpu.parallel import ParallelTrainer
+        paddle.seed(0)
+        net = LeNet()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters())
+        ce = nn.CrossEntropyLoss()
+        return ParallelTrainer(net, opt, lambda o, y: ce(o, y))
+
+    def test_trainer_serialize_then_hit(self, cache):
+        rs = np.random.RandomState(0)
+        x = rs.randn(8, 1, 28, 28).astype('float32')
+        y = rs.randint(0, 10, size=(8, 1)).astype('int64')
+        t1 = self._lenet_trainer()
+        l_cold = float(np.asarray(t1.step(x, y)))
+        s = cc.stats()
+        assert s.get('serialize_exec', 0) >= 1
+        assert s.get('deserialize_exec', 0) == 0
+        # a second trainer = a simulated restart: same program, fresh
+        # object — must deserialize and produce the identical loss
+        t2 = self._lenet_trainer()
+        l_warm = float(np.asarray(t2.step(x, y)))
+        assert cc.stats().get('deserialize_exec', 0) >= 1
+        assert l_cold == l_warm
+
+    def test_to_static_hit_numerics(self, cache):
+        from paddle_tpu import jit as pjit
+
+        def build():
+            @pjit.to_static
+            def f(a):
+                return a * 2.0 + 1.0
+            return f
+
+        x = paddle.to_tensor(np.arange(6.0, dtype=np.float32))
+        cold = np.asarray(build()(x).value)
+        assert cc.stats().get('serialize_exec', 0) >= 1
+        warm = np.asarray(build()(x).value)
+        assert cc.stats().get('deserialize_exec', 0) >= 1
+        np.testing.assert_array_equal(cold, warm)
+
+    def test_hapi_train_batch_hit(self, cache):
+        from paddle_tpu import nn
+        from paddle_tpu.hapi import Model
+
+        def build():
+            paddle.seed(3)
+            net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(),
+                                nn.Linear(8, 2))
+            m = Model(net)
+            m.prepare(paddle.optimizer.SGD(
+                learning_rate=0.1, parameters=net.parameters()),
+                nn.CrossEntropyLoss())
+            return m
+
+        x = np.random.RandomState(0).randn(8, 4).astype('float32')
+        y = np.random.RandomState(1).randint(0, 2, (8, 1)).astype('int64')
+        m1 = build()
+        loss_cold, _ = m1.train_batch([x], [y])
+        assert cc.stats().get('serialize_exec', 0) >= 1
+        m2 = build()
+        loss_warm, _ = m2.train_batch([x], [y])
+        assert cc.stats().get('deserialize_exec', 0) >= 1
+        assert float(np.asarray(loss_cold)) == \
+            float(np.asarray(loss_warm))
+
+    def test_cross_process_hit_via_subprocess(self, cache):
+        """The actual restart story: two fresh interpreters, one cache
+        — the second must deserialize what the first serialized."""
+        code = (
+            'import os, json\n'
+            'os.environ["JAX_PLATFORMS"] = "cpu"\n'
+            'os.environ["XLA_FLAGS"] = '
+            '"--xla_force_host_platform_device_count=8"\n'
+            'import numpy as np\n'
+            'import paddle_tpu as paddle\n'
+            'from paddle_tpu import jit as pjit\n'
+            'from paddle_tpu.core import compile_cache as cc\n'
+            '@pjit.to_static\n'
+            'def f(a):\n'
+            '    return a * 3.0 - 1.0\n'
+            'x = paddle.to_tensor(np.ones((4, 4), np.float32))\n'
+            'out = np.asarray(f(x).value)\n'
+            'print(json.dumps({"sum": float(out.sum()),'
+            ' "stats": cc.stats()}))\n'
+        )
+        env = dict(os.environ, PADDLE_TPU_COMPILE_CACHE=cache)
+        docs = []
+        for _ in range(2):
+            out = subprocess.run(
+                [sys.executable, '-c', code], capture_output=True,
+                text=True, env=env, cwd=REPO, timeout=240)
+            assert out.returncode == 0, out.stderr[-500:]
+            docs.append(json.loads(out.stdout.strip().splitlines()[-1]))
+        assert docs[0]['stats'].get('serialize_exec', 0) >= 1
+        assert docs[0]['stats'].get('deserialize_exec', 0) == 0
+        assert docs[1]['stats'].get('deserialize_exec', 0) >= 1
+        assert docs[0]['sum'] == docs[1]['sum']
+
+
+class TestDecodeBucketing:
+    def _model(self, **kw):
+        from paddle_tpu.models.gpt import gpt_tiny
+        paddle.seed(11)
+        m = gpt_tiny(num_layers=2, hidden_size=32, num_heads=2,
+                     max_seq_len=32, **kw)
+        m.eval()
+        return m
+
+    def test_bucketed_greedy_matches_full_forward(self):
+        """T0=5 buckets to 8 (3 padded positions) — the decoded stream
+        must still exactly match repeated full forwards."""
+        m = self._model()
+        ids = np.random.RandomState(5).randint(0, 128, (2, 5)) \
+            .astype('int64')
+        out = np.asarray(m.generate(paddle.to_tensor(ids),
+                                    max_new_tokens=3,
+                                    temperature=0).value)
+        cur = ids.copy()
+        for _ in range(3):
+            lg = np.asarray(m(paddle.to_tensor(cur)).value)
+            cur = np.concatenate(
+                [cur, lg[:, -1].argmax(-1)[:, None]], axis=1)
+        np.testing.assert_array_equal(out, cur)
+
+    def test_bucket_shares_one_module(self):
+        """Prompt lengths 5 and 7 share the 8-bucket: ONE compiled
+        module, finite module set."""
+        m = self._model()
+        rs = np.random.RandomState(0)
+        for t0 in (5, 7):
+            ids = rs.randint(0, 128, (2, t0)).astype('int64')
+            out = m.generate(paddle.to_tensor(ids), max_new_tokens=3,
+                             temperature=0)
+            assert np.asarray(out.value).shape == (2, t0 + 3)
+        assert len(m._gen_cache) == 1
+        # a different bucket (16) compiles a second module
+        ids = rs.randint(0, 128, (2, 9)).astype('int64')
+        m.generate(paddle.to_tensor(ids), max_new_tokens=3,
+                   temperature=0)
+        assert len(m._gen_cache) == 2
+
+    def test_sampled_bucketed_in_range(self):
+        m = self._model()
+        ids = np.zeros((1, 3), 'int64')
+        out = np.asarray(m.generate(paddle.to_tensor(ids),
+                                    max_new_tokens=5, temperature=0.8,
+                                    top_k=10, seed=1).value)
+        assert out.shape == (1, 8)
+        assert (out >= 0).all() and (out < 128).all()
+
+    def test_persistent_decode_hit(self, cache):
+        m1 = self._model()
+        ids = np.random.RandomState(2).randint(0, 128, (1, 5)) \
+            .astype('int64')
+        cold = np.asarray(m1.generate(paddle.to_tensor(ids),
+                                      max_new_tokens=3,
+                                      temperature=0).value)
+        assert cc.stats().get('serialize_exec', 0) >= 1
+        m2 = self._model()        # fresh instance, same config/seed
+        warm = np.asarray(m2.generate(paddle.to_tensor(ids),
+                                      max_new_tokens=3,
+                                      temperature=0).value)
+        assert cc.stats().get('deserialize_exec', 0) >= 1
+        np.testing.assert_array_equal(cold, warm)
+
+    def test_precompile_decode_then_generate(self, cache):
+        m1 = self._model()
+        fp, bucket = m1.precompile_decode(1, 5, 3, temperature=0)
+        assert bucket == 8 and fp is not None
+        before = cc.stats()
+        m2 = self._model()
+        ids = np.random.RandomState(2).randint(0, 128, (1, 5)) \
+            .astype('int64')
+        m2.generate(paddle.to_tensor(ids), max_new_tokens=3,
+                    temperature=0)
+        assert _delta(before, 'deserialize_exec') >= 1
+        assert _delta(before, 'serialize_exec') == 0
+
+
+class TestLowerTextTier:
+    def test_persistent_backing(self, cache):
+        from paddle_tpu.analysis import hlo as _hlo
+
+        def f(x):
+            return (x * 2).sum()
+
+        args = (jax.ShapeDtypeStruct((8, 8), jnp.float32),)
+        ck = ('unit-test-lower', (('dp', 1),), (), (), False,
+              (((8, 8), 'float32'),))
+        t1 = _hlo.lower_text(f, *args, lower_cache={}, cache_key=ck)
+        before = cc.stats()
+        # fresh in-process memo: must come back from the PERSISTENT
+        # tier without compiling again
+        t2 = _hlo.lower_text(f, *args, lower_cache={}, cache_key=ck)
+        assert t1 == t2
+        assert _delta(before, 'hit_hlo') == 1
+
+    def test_trainer_compiled_text_memo(self, cache):
+        from paddle_tpu import nn
+        from paddle_tpu.parallel import ParallelTrainer
+        from paddle_tpu.fluid.contrib import memory_usage_calc
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        ce = nn.CrossEntropyLoss()
+        tr = ParallelTrainer(net, opt, lambda o, y: ce(o, y))
+        x = np.ones((8, 4), np.float32)
+        y = np.zeros((8, 1), np.int64)
+        tr.step(x, y)
+        text = tr.compiled_text()
+        assert 'HloModule' in text
+        assert tr.compiled_text() is text     # in-process memo
+        # memory_usage routes through the SAME lowered artifact
+        lo, hi = memory_usage_calc.memory_usage(tr)
+        assert lo == hi and lo > 0
+        # op_summary too — rows come from the shared text
+        rows = tr.op_summary(x, y, print_table=False)
+        assert rows and all('opcode' in r for r in rows)
+
+
+class TestWarmStartManifest:
+    def test_sidecar_roundtrip_and_verify(self, cache, tmp_path):
+        run = tmp_path / 'run'
+        fp = cc.fingerprint('hlo-text', key='ws')
+        cc.put_text(fp, 'HloModule ws\n')
+        cc.write_precompile_manifest(
+            str(run), [{'tier': 'hlo', 'fingerprint': fp,
+                        'description': 'unit'}])
+        doc = cc.read_precompile_manifest(str(run))
+        assert doc and len(doc['entries']) == 1
+        ok, errors = cc.verify_precompile_manifest(str(run))
+        assert ok, errors
+        assert cc.warm_start(str(run)) == 1
+        # corrupt the entry: verify fails, warm_start quarantines
+        path = cc._entry_path('hlo', fp)
+        with open(path, 'wb') as f:
+            f.write(b'garbage')
+        ok, errors = cc.verify_precompile_manifest(str(run))
+        assert not ok and 'torn or corrupt' in errors[0]
+        assert cc.warm_start(str(run)) == 0
+        assert os.path.exists(path + '.quarantine')
+
+    def test_cross_host_cache_dir_fallback(self, cache, tmp_path,
+                                           monkeypatch):
+        """A sidecar written on another host (different cache dir)
+        still audits, warm-starts and HITS: the recorded cache_dir is
+        a lookup fallback, not an exit-6 false alarm."""
+        fp = cc.fingerprint('hlo-text', key='xhost')
+        cc.put_text(fp, 'HloModule xhost\n')
+        run = tmp_path / 'run'
+        cc.write_precompile_manifest(
+            str(run), [{'tier': 'hlo', 'fingerprint': fp,
+                        'description': 'xhost'}])
+        monkeypatch.setenv(cc.ENV_VAR, str(tmp_path / 'other'))
+        ok, errors = cc.verify_precompile_manifest(str(run))
+        assert ok, errors
+        assert cc.warm_start(str(run)) == 1
+        assert cc.get_text(fp) == 'HloModule xhost\n'
+
+    def test_verify_reports_cache_disabled(self, tmp_path,
+                                           monkeypatch):
+        # sidecar written with the cache off records no cache_dir; a
+        # disabled host auditing it has nowhere to look and must say so
+        monkeypatch.setenv(cc.ENV_VAR, '0')
+        run = tmp_path / 'run'
+        cc.write_precompile_manifest(str(run), [])
+        ok, errors = cc.verify_precompile_manifest(str(run))
+        assert not ok and 'disabled' in errors[0]
+
+    def test_verify_uses_recorded_dir_when_env_disabled(
+            self, cache, tmp_path, monkeypatch):
+        fp = cc.fingerprint('hlo-text', key='recdir')
+        cc.put_text(fp, 'HloModule recdir\n')
+        run = tmp_path / 'run'
+        cc.write_precompile_manifest(
+            str(run), [{'tier': 'hlo', 'fingerprint': fp,
+                        'description': 'recdir'}])
+        monkeypatch.setenv(cc.ENV_VAR, '0')
+        ok, errors = cc.verify_precompile_manifest(str(run))
+        assert ok, errors
+
+
+class TestRunReportJoin:
+    def test_hit_rate_section(self, cache, tmp_path):
+        from paddle_tpu import telemetry
+        tel = tmp_path / 'tel'
+        telemetry.enable(str(tel))
+        try:
+            fp = cc.fingerprint('hlo-text', key='rr')
+            cc.get_text(fp)                   # miss
+            cc.put_text(fp, 'HloModule rr\n')  # serialize
+            cc.get_text(fp)                   # hit
+        finally:
+            telemetry.disable()
+        sys.path.insert(0, os.path.join(REPO, 'tools'))
+        import run_report as rr
+        jsonls, flights = rr.discover([str(tel)])
+        events, sources, skew = rr.load_events(jsonls, flights)
+        report = rr.analyze(events, sources, skew)
+        ccr = report['compile_cache']
+        assert ccr['hits'] == 1 and ccr['misses'] == 1
+        assert ccr['lookups'] == 2 and ccr['hit_rate'] == 0.5
+        assert ccr['serialized'] == 1
+        # render must not crash with the section present
+        import io
+        rr.render(report, stream=io.StringIO())
+
+    def test_tpu_lint_json_surfaces_cache_hits(self, cache, capsys):
+        import importlib
+        sys.path.insert(0, os.path.join(REPO, 'tools'))
+        tpu_lint = importlib.import_module('tpu_lint')
+        rc = tpu_lint.main(['--plan', '--chips', '2', '--targets',
+                            'lenet', '--max-candidates', '1',
+                            '--json'])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        hits = doc['cache_hits']
+        assert hits['enabled'] is True
+        assert hits['persistent'] + hits['persistent_misses'] >= 1

@@ -26,9 +26,15 @@ code per class so automation can branch on the cause:
                     worker mid-commit)
     5 = digest mismatch  (sizes intact, bytes rotted — storage-level
                     corruption)
+    6 = precompile manifest invalid  (the run dir carries a
+                    _PADDLE_PRECOMPILE.json sidecar — tools/
+                    precompile.py's AOT warm-start set — but some
+                    listed compile-cache entry is missing, torn, or
+                    the cache is disabled: a restore would fall back
+                    to full recompilation)
 
 When several classes occur, missing-host wins over torn over digest
-(ordered by how actionable the triage is).
+over precompile (ordered by how actionable the triage is).
 """
 import argparse
 import os
@@ -37,11 +43,13 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+from paddle_tpu.core import compile_cache as CC  # noqa: E402
 from paddle_tpu.resilience import manifest as M  # noqa: E402
 
 EXIT_TORN = 3
 EXIT_MISSING_HOST = 4
 EXIT_DIGEST = 5
+EXIT_PRECOMPILE = 6
 
 
 def _step_dirs(directory, prefix):
@@ -126,9 +134,12 @@ def main(argv=None):
                          'only — fast triage for TB-scale dirs)')
     ap.add_argument('--deep', action='store_true',
                     help='re-hash every per-host shard against the '
-                         'manifest digests and exit with a distinct '
-                         'code per failure class: 3=torn, '
-                         '4=missing host, 5=digest mismatch')
+                         'manifest digests (and audit the '
+                         '_PADDLE_PRECOMPILE.json AOT sidecar when '
+                         'present) and exit with a distinct code per '
+                         'failure class: 3=torn, 4=missing host, '
+                         '5=digest mismatch, 6=precompile manifest '
+                         'invalid')
     ap.add_argument('--adopt', action='store_true',
                     help='write commit manifests for UNCOMMITTED step '
                          'dirs (migrates checkpoints from before '
@@ -193,18 +204,43 @@ def main(argv=None):
     if torn and not args.quiet:
         print(f'quarantined: {", ".join(sorted(torn))}')
 
+    precompile_bad = False
+    pc_present = args.deep and os.path.exists(
+        os.path.join(args.directory, CC.PRECOMPILE_MANIFEST))
+    pc_doc = CC.read_precompile_manifest(args.directory) \
+        if pc_present else None
+    if pc_present:
+        # an unparseable sidecar must FAIL the audit, not read as
+        # 'no sidecar' — a restore would silently fall back to full
+        # recompilation
+        # a declared AOT warm-start set rides with this run dir:
+        # audit every listed compile-cache entry so a restore target's
+        # deserialization path is provable, not hoped-for
+        ok_pc, pc_errors = CC.verify_precompile_manifest(args.directory)
+        precompile_bad = not ok_pc
+        if not args.quiet:
+            n = len((pc_doc or {}).get('entries', []))
+            status = f'ok ({n} AOT entries verified)' if ok_pc else \
+                'FAIL [precompile]'
+            print(f'precompile manifest: {status}')
+            for line in pc_errors[:8]:
+                print(f'    {line}')
+
     if not args.quiet:
         print('latest committed step:', latest_ok)
     else:
         print(latest_ok)
-    if args.deep and deep_classes:
-        # precedence: a lost worker beats a torn file beats bit rot —
-        # the operator's next action differs per class
+    if args.deep and (deep_classes or precompile_bad):
+        # precedence: a lost worker beats a torn file beats bit rot
+        # beats a cold AOT set — the operator's next action differs
+        # per class
         if 'missing_host' in deep_classes:
             return EXIT_MISSING_HOST
         if 'torn' in deep_classes:
             return EXIT_TORN
-        return EXIT_DIGEST
+        if 'digest' in deep_classes:
+            return EXIT_DIGEST
+        return EXIT_PRECOMPILE
     return 0 if latest_ok >= 0 else 1
 
 

@@ -1,0 +1,284 @@
+#!/usr/bin/env python
+"""precompile — AOT warm start: compile the declared bucket set at
+export time so a restarted (or freshly served) worker deserializes
+instead of recompiling.
+
+    python tools/precompile.py RUN_DIR                      # defaults
+    python tools/precompile.py RUN_DIR --targets lenet,gpt --mesh dp=4
+    python tools/precompile.py RUN_DIR \\
+        --gpt-decode 8x128x128,8x64x128 --gpt-model small
+    python tools/precompile.py RUN_DIR --json
+
+What gets compiled (all without ever executing a step):
+
+* **train-step lowerings** — the built-in audit targets
+  (analysis.targets: gpt / widedeep / lenet) lowered through the SPMD
+  partitioner for every requested mesh, landing in the persistent
+  compile cache's TEXT tier (the exact keys ``tpu_lint --plan``/
+  ``--hlo`` and the planner read) and seeding jax's persistent XLA
+  cache with the compiled executables;
+* **gptgen decode buckets** — ``--gpt-decode BxT0xNEW`` signatures
+  exported through ``GPTForCausalLM.precompile_decode`` into the EXEC
+  tier (serialized ``jax.export`` artifacts, prompt lengths bucketed
+  to the next power of two) plus an AOT XLA compile, so a serving
+  cold-start's ``generate`` deserializes and skips the optimizer
+  passes too;
+* **elastic-reshape target meshes** — when RUN_DIR holds committed
+  sharded checkpoints, the newest step's commit manifest records the
+  saving mesh (PR 5's reshape metadata); its dp axis halved (dp/2,
+  dp/4, ...) is added to the mesh set, so the reshape-restore path a
+  preempted pool takes onto fewer hosts finds its lowerings warm.
+
+Every produced entry is recorded in a sidecar
+``_PADDLE_PRECOMPILE.json`` committed into RUN_DIR:
+``check_ckpt --deep`` audits it (a restore target's AOT set is
+provable), and ``warm_start`` (called by auto_checkpoint /
+CheckpointManager.restore) pre-loads it on the next restart.
+
+Exit codes: 0 = every requested artifact compiled, 1 = some failed
+(the manifest still records the ones that succeeded), 2 = usage error.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _parse_mesh(spec):
+    axes = {}
+    for part in spec.split(','):
+        name, _, size = part.strip().partition('=')
+        if not size:
+            raise ValueError(f'--mesh wants axis=size, got {part!r}')
+        axes[name] = int(size)
+    return axes
+
+
+def _parse_decode(spec):
+    """'8x128x128,2x16x8' -> [(B, T0, NEW), ...]."""
+    out = []
+    for part in spec.split(','):
+        dims = part.strip().lower().split('x')
+        if len(dims) != 3:
+            raise ValueError(
+                f'--gpt-decode wants BxT0xNEW, got {part!r}')
+        out.append(tuple(int(d) for d in dims))
+    return out
+
+
+def _reshape_meshes(run_dir):
+    """Elastic-reshape targets from the newest committed step's
+    manifest: the saved mesh itself plus its dp axis halved down to 1
+    — the meshes a preempted pool restores onto."""
+    from paddle_tpu.resilience import manifest as M
+    steps = []
+    try:
+        for f in os.listdir(run_dir):
+            tag = f.rpartition('_')[2]
+            if tag.isdigit() and os.path.isdir(os.path.join(run_dir, f)):
+                steps.append((int(tag), os.path.join(run_dir, f)))
+    except OSError:
+        return []
+    for _s, p in sorted(steps, reverse=True):
+        doc = M.read_manifest(p)
+        if doc is None or not doc.get('mesh'):
+            continue
+        mesh = {a: int(s) for a, s in doc['mesh'].items()}
+        out = [dict(mesh)]
+        dp = mesh.get('dp', 1)
+        while dp > 1:
+            dp //= 2
+            # dp=1 included: a pool shrinking to a single host is the
+            # most-shrunk elastic target and still wants a warm lower
+            out.append(dict(mesh, dp=dp))
+        return out
+    return []
+
+
+def _build_mesh(axes):
+    import math
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+    n = math.prod(axes.values())
+    devs = jax.devices()
+    if n > len(devs):
+        raise RuntimeError(
+            f'mesh {axes} wants {n} devices but only {len(devs)} exist')
+    return Mesh(np.array(devs[:n]).reshape(tuple(axes.values())),
+                tuple(axes.keys()))
+
+
+def _precompile_target(name, mesh_axes, entries, errors):
+    """Lower one audit target's surrogate step for one mesh into the
+    persistent text tier (exact tpu_lint/planner keys) — the
+    lower+compile also seeds jax's XLA disk cache."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.analysis import hlo as _hlo
+    from paddle_tpu.analysis import targets as _targets
+    from paddle_tpu.core import compile_cache as _cc
+    from paddle_tpu.distributed import env as _env
+    desc = f'target-step {name} @ {mesh_axes or "1-device"}'
+    try:
+        mesh = _build_mesh(mesh_axes) if mesh_axes else \
+            _build_mesh({'dp': 1})
+        prev = _env.get_mesh()
+        _env.set_mesh(mesh)
+        try:
+            model, batch = _targets.TARGETS[name](mesh)
+            params, buffers, p_sh, b_sh = _targets.target_state(
+                model, mesh)
+            repl = NamedSharding(mesh, P())
+            batch_sh = _targets.batch_shardings(mesh, batch)
+            key = jax.random.PRNGKey(0)
+            ck = _targets.cache_key(name, mesh.shape, p_sh, batch_sh,
+                                    batch=batch)
+            _hlo.lower_text(
+                _targets.surrogate_step(model), params, buffers, key,
+                *batch,
+                jit_kwargs={'in_shardings': (p_sh, b_sh, repl)
+                            + batch_sh},
+                lower_cache={}, cache_key=ck)
+        finally:
+            _env.set_mesh(prev)
+        fp = _cc.fingerprint('lower-text', key=ck)
+        if fp is not None and _cc.get('hlo', fp) is not None:
+            entries.append({'tier': 'hlo', 'fingerprint': fp,
+                            'description': desc})
+        else:
+            errors[desc] = 'entry not committed (cache disabled?)'
+    except Exception as e:
+        errors[desc] = repr(e)
+
+
+def _precompile_decode(model_name, shape, kwargs, entries, errors):
+    import paddle_tpu as paddle
+    from paddle_tpu.models import gpt as _gpt
+    B, T0, new = shape
+    desc = f'gpt-decode {model_name} b{B} p{T0} n{new}'
+    try:
+        paddle.seed(0)
+        builders = {'tiny': _gpt.gpt_tiny, 'small': _gpt.gpt_small}
+        default_len = 128 if model_name == 'tiny' else 1024
+        model = builders[model_name](
+            max_seq_len=max(default_len, T0 + new), dropout=0.0)
+        model.eval()
+        fp, P = model.precompile_decode(B, T0, new, **kwargs)
+        if fp is None:
+            errors[desc] = 'no fingerprint (cache disabled?)'
+            return
+        from paddle_tpu.core import compile_cache as _cc
+        if _cc.get('exec', fp) is None:
+            # the export itself failed (non-exportable trace, torn
+            # write, disk full) — recording the entry anyway would
+            # make check_ckpt --deep fail LATER with no error at the
+            # moment the operator could act
+            errors[desc] = 'entry not committed (export failed?)'
+            return
+        entries.append({'tier': 'exec', 'fingerprint': fp,
+                        'description': f'{desc} (bucket {P})'})
+    except Exception as e:
+        errors[desc] = repr(e)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog='precompile',
+        description='AOT-compile the declared bucket set into the '
+                    'persistent compile cache and commit a sidecar '
+                    'manifest next to a checkpoint run dir.')
+    ap.add_argument('run_dir',
+                    help='checkpoint run directory the sidecar '
+                         'manifest is committed into (created if '
+                         'absent)')
+    ap.add_argument('--targets', default='gpt,widedeep,lenet',
+                    help='comma-separated built-in train-step targets '
+                         '(gpt,widedeep,lenet); "none" to skip')
+    ap.add_argument('--mesh', metavar='SPEC', default=None,
+                    help='mesh axes to lower the targets for, e.g. '
+                         '"dp=4" or "dp=2,tp=2" (default: single '
+                         'device, plus any reshape meshes recorded in '
+                         'the run dir\'s newest commit manifest)')
+    ap.add_argument('--gpt-decode', metavar='BxT0xNEW[,...]',
+                    default=None,
+                    help='gptgen decode bucket signatures to export, '
+                         'e.g. "8x128x128,8x64x128" (prompt lengths '
+                         'are bucketed to the next power of two)')
+    ap.add_argument('--gpt-model', choices=('tiny', 'small'),
+                    default='small',
+                    help='GPT config the decode buckets compile for')
+    ap.add_argument('--temperature', type=float, default=0.0,
+                    help='decode sampling temperature baked into the '
+                         'exported modules (default 0 = greedy)')
+    ap.add_argument('--top-k', type=int, default=None,
+                    help='decode top-k baked into the exported modules')
+    ap.add_argument('--cache', metavar='DIR', default=None,
+                    help='compile-cache directory (sets '
+                         'PADDLE_TPU_COMPILE_CACHE for this run)')
+    ap.add_argument('--json', action='store_true',
+                    help='machine-readable summary on stdout')
+    args = ap.parse_args(argv)
+
+    if args.cache:
+        os.environ['PADDLE_TPU_COMPILE_CACHE'] = args.cache
+    try:
+        mesh_axes = _parse_mesh(args.mesh) if args.mesh else None
+        decode = _parse_decode(args.gpt_decode) if args.gpt_decode \
+            else []
+    except ValueError as e:
+        print(f'precompile: {e}', file=sys.stderr)
+        return 2
+
+    from paddle_tpu.core import compile_cache as _cc
+    if not _cc.enabled():
+        print('precompile: the persistent compile cache is disabled '
+              f'({_cc.ENV_VAR}); nothing to do', file=sys.stderr)
+        return 2
+
+    target_names = [] if args.targets.strip().lower() == 'none' else \
+        [t.strip() for t in args.targets.split(',') if t.strip()]
+    meshes = [mesh_axes] if mesh_axes else [None]
+    reshape = _reshape_meshes(args.run_dir)
+    for m in reshape:
+        if m not in meshes:
+            meshes.append(m)
+
+    entries, errors = [], {}
+    for m in meshes:
+        for name in target_names:
+            _precompile_target(name, m, entries, errors)
+    kwargs = {'temperature': args.temperature, 'top_k': args.top_k}
+    for shape in decode:
+        _precompile_decode(args.gpt_model, shape, kwargs, entries,
+                           errors)
+
+    doc = _cc.write_precompile_manifest(
+        args.run_dir, entries,
+        meta={'meshes': [m or {} for m in meshes],
+              'reshape_meshes': reshape})
+    summary = {'run_dir': os.path.abspath(args.run_dir),
+               'cache_dir': _cc.cache_dir(),
+               'entries': len(entries),
+               'errors': errors,
+               'meshes': doc['meshes']}
+    if args.json:
+        print(json.dumps(summary, indent=1, sort_keys=True))
+    else:
+        print(f'precompiled {len(entries)} artifact(s) into '
+              f'{_cc.cache_dir()}')
+        for e in entries:
+            print(f'  {e["tier"]:<5} {e["fingerprint"][:16]}  '
+                  f'{e["description"]}')
+        for desc, err in errors.items():
+            print(f'  FAILED {desc}: {err}')
+        print(f'sidecar manifest: '
+              f'{os.path.join(os.path.abspath(args.run_dir), _cc.PRECOMPILE_MANIFEST)}')
+    return 1 if errors else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -255,6 +255,48 @@ def analyze(events, sources, skew=None):
         retrace_out['max_variants'] = worst.get('variants')
         retrace_out['worst'] = worst.get('name')
 
+    # -- persistent compile cache: hit rate + compile time saved --
+    cc_events = by_kind.get('compile_cache', [])
+    compile_cache = None
+    if cc_events:
+        actions = {}
+        per_name = {}
+        for e in cc_events:
+            a = e.get('action', '?')
+            row = actions.setdefault(a, {'count': 0, 'bytes': 0})
+            row['count'] += 1
+            row['bytes'] += e.get('bytes') or 0
+            nm = per_name.setdefault(e.get('name', '?'),
+                                     {'hits': 0, 'misses': 0})
+            # 'deserialize' refines a 'hit' (same lookup), so only
+            # hit/miss count toward the rate — one event per lookup
+            if a == 'hit':
+                nm['hits'] += 1
+            elif a == 'miss':
+                nm['misses'] += 1
+        hits = actions.get('hit', {}).get('count', 0)
+        misses = actions.get('miss', {}).get('count', 0)
+        lookups = hits + misses
+        compile_cache = {
+            'actions': actions,
+            'hits': hits,
+            'misses': misses,
+            'lookups': lookups,
+            'hit_rate': round(hits / lookups, 4) if lookups else None,
+            'deserialized': actions.get('deserialize',
+                                        {}).get('count', 0),
+            'serialized': actions.get('serialize', {}).get('count', 0),
+            'quarantined': actions.get('quarantine',
+                                       {}).get('count', 0),
+            'warm_start_entries': sum(e.get('count') or 0
+                                      for e in cc_events
+                                      if e.get('action') == 'warm_start'),
+            'compile_time_saved_s': round(sum(
+                e.get('saved_s') or 0.0 for e in cc_events
+                if e.get('action') == 'deserialize'), 6),
+            'per_name': per_name,
+        }
+
     # -- collectives: observed census vs compile-time prediction --
     coll = by_kind.get('collectives', [])
     collectives = None
@@ -374,6 +416,7 @@ def analyze(events, sources, skew=None):
         'total_steps': total_steps,
         'split': split,
         'compile': compile_out,
+        'compile_cache': compile_cache,
         'retraces': retrace_out,
         'collectives': collectives,
         'collectives_predicted': collectives_predicted,
@@ -416,6 +459,24 @@ def render(report, stream=None):
     p(f'  retraces: {r["count"]}'
       + (f' (worst: {r.get("worst")} at {r.get("max_variants")} '
          'variants)' if r['count'] else ''))
+    cc = report.get('compile_cache')
+    if cc:
+        rate = (f'{cc["hit_rate"]:.0%}' if cc.get('hit_rate') is not None
+                else 'n/a')
+        p(f'  cache: {cc["hits"]}/{cc["lookups"]} lookups hit ({rate}), '
+          f'{cc["deserialized"]} deserialized, '
+          f'{cc["serialized"]} serialized'
+          + (f', {cc["quarantined"]} quarantined'
+             if cc['quarantined'] else '')
+          + (f', {cc["warm_start_entries"]} warm-start entries'
+             if cc['warm_start_entries'] else ''))
+        if cc.get('compile_time_saved_s'):
+            p(f'  cache saved ~{cc["compile_time_saved_s"]:.2f}s of '
+              'trace+lower')
+        for name, row in sorted(cc['per_name'].items()):
+            if name != '?':
+                p(f'    {name}: {row["hits"]} hit / '
+                  f'{row["misses"]} miss')
     if report['collectives'] or report.get('collectives_predicted'):
         co = report['collectives'] or report['collectives_predicted']
         p(f'\n-- collectives (mesh {co.get("mesh")}) --')

@@ -346,8 +346,14 @@ def main(argv=None):
 
     # one lowering memo shared by --plan and --hlo: the same
     # (target, mesh, shardings) triple is compiled exactly once no
-    # matter how many surfaces ask for it
+    # matter how many surfaces ask for it.  The memo is additionally
+    # backed by the PERSISTENT compile cache's text tier
+    # (core.compile_cache via hlo.lower_text), so a repeated tpu_lint
+    # invocation on unchanged targets reads its candidate modules off
+    # disk — the stats delta below lands in --json as `cache_hits`.
     lower_cache = {}
+    from paddle_tpu.core import compile_cache as _cc
+    _cc_before = _cc.stats()
     plan_results = {}
     plan_error = None
     calibration = None
@@ -446,6 +452,21 @@ def main(argv=None):
         for rep in hlo_reports.values():
             report.findings.extend(rep.findings)
 
+    cache_hits = None
+    if args.plan or args.hlo:
+        after = _cc.stats()
+        delta = lambda k: after.get(k, 0) - _cc_before.get(k, 0)  # noqa: E731
+        cache_hits = {
+            'persistent': delta('hit_hlo'),
+            'persistent_misses': delta('miss_hlo'),
+            'memo_entries': len(lower_cache),
+            'enabled': _cc.enabled(),
+        }
+        if cache_hits['persistent']:
+            print(f'tpu_lint: {cache_hits["persistent"]} lowering(s) '
+                  'served from the persistent compile cache',
+                  file=sys.stderr)
+
     if args.json:
         doc = json.loads(report.to_json())
         if args.hlo:
@@ -458,6 +479,8 @@ def main(argv=None):
                            for n, r in plan_results.items()}
             if plan_error:
                 doc['plan_error'] = plan_error
+        if cache_hits is not None:
+            doc['cache_hits'] = cache_hits
         print(json.dumps(doc, indent=2))
     else:
         if args.paths or args.jaxpr:
@@ -468,6 +491,11 @@ def main(argv=None):
         for tname, res in plan_results.items():
             print()
             print(res.render())
+        if cache_hits is not None and (cache_hits['persistent']
+                                       or cache_hits['persistent_misses']):
+            print(f'\ncompile cache: {cache_hits["persistent"]} hit / '
+                  f'{cache_hits["persistent_misses"]} miss '
+                  '(persistent lowering tier)')
 
     if hlo_error or plan_error:
         return 2
