@@ -930,6 +930,148 @@ def _cache_preflight(smoke, timeout_s=900):
     return ok, summary
 
 
+def _profile_smoke_child(telemetry_dir):
+    """--profile-smoke child (forced 8-device CPU mesh): capture one
+    sampled profiler window on (a) lenet through hapi
+    ``fit(profile=…)`` and (b) the dp=8 CPU-mesh ParallelTrainer, then
+    prove steps OUTSIDE a window add no host sync (device→host
+    transfer guard, the PR-3 proof) with a profiler attached.  Emits
+    one JSON line the parent asserts on."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, telemetry
+    from paddle_tpu.parallel import ParallelTrainer
+    from paddle_tpu.distributed import env as dist_env
+    from paddle_tpu.vision.models import LeNet
+
+    telemetry.enable(telemetry_dir)
+    out = {}
+    rs = np.random.RandomState(0)
+
+    # (a) lenet via hapi fit(profile=): one window, breakdown gauges
+    paddle.seed(0)
+    model = paddle.hapi.Model(LeNet())
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    model.prepare(optimizer=opt, loss=nn.CrossEntropyLoss())
+    x = rs.randn(8, 1, 28, 28).astype('float32')
+    y = rs.randint(0, 10, size=(8, 1)).astype('int64')
+    model.fit([(x, y)] * 6, epochs=1, verbose=0,
+              profile={'every': 100, 'steps': 2, 'start': 2,
+                       'dir': telemetry_dir})
+    caps = telemetry.events('profile_capture')
+    out['lenet_windows'] = len(caps)
+    out['lenet_errors'] = [c.get('error') for c in caps
+                           if c.get('error')]
+
+    # (b) dp=8 mesh trainer: census-matched collective_observed
+    prev = dist_env.get_mesh()
+    mesh = dist_env.build_mesh({'dp': 8})
+    dist_env.set_mesh(mesh)
+    try:
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(32, 64), nn.ReLU(),
+                            nn.Linear(64, 8))
+        topt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                     parameters=net.parameters())
+        mse = nn.MSELoss()
+        tr = ParallelTrainer(
+            net, topt, lambda o, t: mse(o, t), mesh=mesh,
+            profile={'every': 100, 'steps': 2, 'start': 2,
+                     'dir': telemetry_dir})
+        tx = rs.randn(16, 32).astype('float32')
+        ty = rs.randn(16, 8).astype('float32')
+        for _ in range(5):
+            loss = tr.step(tx, ty)
+        jax.block_until_ready(loss)
+        tr.finish_profile(sync=loss)
+        out['collective_observed'] = len(
+            telemetry.events('collective_observed'))
+
+        # (c) sync-free proof: a trainer with a profiler ATTACHED but
+        # no window in range must add zero device→host transfers per
+        # step (the telemetry-overhead A/B of the sampled design).
+        # Fresh net+optimizer: tr donated the first pair's opt state.
+        paddle.seed(0)
+        net2 = nn.Sequential(nn.Linear(32, 64), nn.ReLU(),
+                             nn.Linear(64, 8))
+        topt2 = paddle.optimizer.Adam(learning_rate=1e-3,
+                                      parameters=net2.parameters())
+        tr2 = ParallelTrainer(
+            net2, topt2, lambda o, t: mse(o, t), mesh=mesh,
+            donate=False,
+            profile={'every': 1000, 'steps': 1, 'start': 900,
+                     'dir': telemetry_dir})
+        tr2.step(tx, ty)    # compile + census outside the guard
+        try:
+            with jax.transfer_guard_device_to_host('disallow'):
+                for _ in range(4):
+                    tr2.step(tx, ty)
+            out['sync_free_ok'] = True
+        except Exception as e:
+            out['sync_free_ok'] = False
+            out['sync_free_error'] = repr(e)[:300]
+    finally:
+        dist_env.set_mesh(prev)
+        telemetry.disable()
+    print(json.dumps(out))
+
+
+def _profile_preflight(timeout_s=600):
+    """--profile-smoke gate: the self-profiling runtime must (1) close
+    a capture window on both loop integrations (hapi fit + the dp=8
+    CPU-mesh ParallelTrainer), (2) land >=1 census-matched
+    ``collective_observed`` event — the calibration fitter's input —
+    and (3) keep non-profiled steps sync-free under a transfer guard.
+
+    Returns (ok, summary).  Infra failures (timeout, crash) never
+    block the bench — evidence beats a dead gate — but a windowless
+    run, zero observed collectives, or an added host sync always do."""
+    import subprocess
+    import tempfile
+    workdir = tempfile.mkdtemp(prefix='bench_profile_')
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    env['XLA_FLAGS'] = ' '.join(
+        [t for t in env.get('XLA_FLAGS', '').split()
+         if not t.startswith('--xla_force_host_platform_device_count')]
+        + ['--xla_force_host_platform_device_count=8'])
+    cmd = [sys.executable, os.path.abspath(__file__),
+           '--profile-smoke-child', '--telemetry-dir', workdir]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=env)
+        doc = _last_json_dict(proc.stdout)
+    except Exception as e:
+        log(f'profile preflight skipped ({e!r})')
+        return True, {'error': repr(e)[:200]}
+    if doc is None:
+        log(f'profile preflight skipped (no child output, '
+            f'rc={proc.returncode}): {proc.stderr[-300:]}')
+        return True, {'error': f'no output (rc={proc.returncode})'}
+    failures = []
+    if not doc.get('lenet_windows'):
+        failures.append('lenet fit(profile=) closed no capture window')
+    if doc.get('lenet_errors'):
+        failures.append(f'lenet window errors: {doc["lenet_errors"]}')
+    if (doc.get('collective_observed') or 0) < 1:
+        failures.append('dp=8 trainer produced no collective_observed '
+                        'event (the calibration fit has no input)')
+    if not doc.get('sync_free_ok'):
+        failures.append('non-profiled steps synced the host with a '
+                        'profiler attached: '
+                        + str(doc.get('sync_free_error')))
+    summary = dict(doc, failures=failures)
+    ok = not failures
+    log(f'profile preflight: {"ok" if ok else "FAIL"} '
+        f'(windows={doc.get("lenet_windows")}, '
+        f'observed={doc.get("collective_observed")}, '
+        f'sync_free={doc.get("sync_free_ok")})')
+    for f in failures:
+        log(f'  {f}')
+    return ok, summary
+
+
 def _lint_preflight(timeout_s=300, smoke=False):
     """tpu_lint gate before burning chip time: a HIGH-severity finding
     in examples/ or paddle_tpu/models/ means some bench config would
@@ -1036,9 +1178,18 @@ def main():
     p.add_argument('--cache-smoke-child', action='store_true',
                    help='(internal) run one cold-path pass for '
                         '--cache-smoke and emit its JSON')
+    p.add_argument('--profile-smoke', action='store_true',
+                   help='capture one sampled profiler window on lenet '
+                        '+ the dp=8 CPU-mesh trainer: >=1 '
+                        'collective_observed event must land and '
+                        'non-profiled steps must stay sync-free — '
+                        'gates the self-profiling runtime')
+    p.add_argument('--profile-smoke-child', action='store_true',
+                   help='(internal) run the profile-smoke captures '
+                        'and emit their JSON')
     p.add_argument('--telemetry-dir', default=None,
                    help='(internal) telemetry JSONL dir for '
-                        '--cache-smoke-child')
+                        '--cache-smoke-child / --profile-smoke-child')
     args = p.parse_args()
 
     if args.cache_smoke_child:
@@ -1046,6 +1197,12 @@ def main():
         _cache_smoke_child(args.telemetry_dir
                            or tempfile.mkdtemp(prefix='cache_tel_'),
                            args.smoke)
+        return
+
+    if args.profile_smoke_child:
+        import tempfile
+        _profile_smoke_child(args.telemetry_dir
+                             or tempfile.mkdtemp(prefix='prof_tel_'))
         return
 
     if args.single_json:
@@ -1061,6 +1218,24 @@ def main():
     chaos_summary = None
     plan_summary = None
     cache_summary = None
+    profile_summary = None
+    if args.profile_smoke:
+        profile_ok, profile_summary = _profile_preflight()
+        if not profile_ok:
+            # a dead capture path means chip sessions produce no
+            # collective_observed evidence (the calibration loop
+            # starves) or — worse — profiling costs per-step syncs;
+            # fail before burning chip time
+            print(json.dumps({
+                'metric': METRIC_NAMES['resnet'], 'value': None,
+                'unit': UNITS['resnet'], 'vs_baseline': None,
+                'error': 'profile preflight failed (no capture '
+                         'window / no collective_observed / host '
+                         'sync outside windows); fix '
+                         'telemetry.profile or re-run without '
+                         '--profile-smoke',
+                'profile': profile_summary, 'extras': {}}))
+            sys.exit(1)
     if args.cache_smoke:
         cache_ok, cache_summary = _cache_preflight(args.smoke)
         if not cache_ok:
@@ -1199,6 +1374,8 @@ def main():
         out['plan'] = plan_summary
     if cache_summary is not None:
         out['compile_cache'] = cache_summary
+    if profile_summary is not None:
+        out['profile'] = profile_summary
     # the headline config is excluded from extras, so its stale
     # provenance (if any) rides at the top level
     for k in ('stale_value', 'stale_vs_baseline', 'stale_from',

@@ -46,7 +46,8 @@ from .findings import Finding, LintReport, HIGH, WARN, INFO
 from .rules import DEFAULT_THRESHOLDS as _JAXPR_THRESHOLDS
 
 __all__ = ['parse_module', 'HloModule', 'HloComputation', 'HloInstr',
-           'buffer_bytes', 'collective_census', 'peak_memory',
+           'buffer_bytes', 'collective_census', 'collective_instrs',
+           'peak_memory',
            'HLO_RULES', 'register_hlo_rule', 'HloRuleContext',
            'run_hlo_rules', 'DEFAULT_HLO_THRESHOLDS', 'audit',
            'audit_text', 'auto_shardings', 'lower_text']
@@ -345,9 +346,61 @@ def collective_census(module, *, bw_gbps=None, latency_us=None,
     substitutes measured alpha/beta.  '-done' halves of async pairs
     are not double counted.
     """
+    # ONE walk/cost implementation: the per-instruction index is the
+    # source of truth (the trace join reads it directly), and the
+    # census is its aggregation by base opcode
+    rows = {}
+    for r in collective_instrs(module, bw_gbps=bw_gbps,
+                               latency_us=latency_us,
+                               mesh_shape=mesh_shape,
+                               calibration=calibration).values():
+        row = rows.setdefault(r['op'], {
+            'calls': 0, 'bytes': 0, 'wire_bytes': 0, 'est_us': 0.0,
+            'phases': 0, 'max_wire_bytes': 0, 'max_est_us': 0.0,
+            'group_size': r['group_size'], 'axes': r['axes'],
+            'file': None, 'line': None})
+        row['calls'] += 1
+        row['bytes'] += r['bytes']
+        row['wire_bytes'] += r['wire_bytes']
+        row['est_us'] = round(row['est_us'] + r['est_us'], 3)
+        row['phases'] += r['phases']
+        if r['wire_bytes'] > row['max_wire_bytes']:
+            # group_size/est ride along: on a multi-axis mesh one base
+            # opcode mixes group sizes (tp=2 activation vs dp=4 grad
+            # all-reduces) and the flag must describe the worst call
+            row['max_wire_bytes'] = r['wire_bytes']
+            row['max_est_us'] = r['est_us']
+            row['group_size'] = r['group_size']
+            row['axes'] = r['axes']
+            row['file'], row['line'] = r['file'], r['line']
+    return rows
+
+
+def collective_instrs(module, *, bw_gbps=None, latency_us=None,
+                      mesh_shape=None, calibration=None):
+    """Per-INSTRUCTION collective index of a compiled module — the
+    join key for profiled-trace matching (``profiler.trace.
+    match_collectives``): a captured trace times ops by instruction
+    name, and this index carries each collective instruction's base
+    opcode + byte/replica-group signature plus the cost-model
+    prediction for exactly that call.
+
+    Returns {instr_name: {op, bytes, wire_bytes, phases, est_us,
+    group_size, axes, file, line}} — ``bytes`` is the counted buffer
+    (gathered size for all-gather, operand size otherwise), the same
+    convention as :func:`collective_census`, whose rows are these
+    aggregated by base opcode.  '-done' halves of async pairs are
+    skipped (the '-start' op owns the transfer).
+
+    HLO names are unique per COMPUTATION, not per module: when a
+    while/scan body reuses an entry-computation name, the later
+    instruction keys as ``name@computation`` so no row is lost — the
+    trace join strips the ``@…`` qualifier before lookup (a trace
+    merges same-named events anyway).
+    """
     bw, lat = costmodel.effective_links(bw_gbps, latency_us,
                                         calibration)
-    rows = {}
+    out = {}
     for comp, ins in module.walk():
         if ins.opcode.endswith('-done'):
             continue
@@ -357,34 +410,19 @@ def collective_census(module, *, bw_gbps=None, latency_us=None,
         n = ins.group_size or module.num_partitions
         axes = costmodel.axes_for_group(mesh_shape, n)
         local = _collective_bytes(comp, ins, base)
-        if base == 'all-gather':
-            # the cost model wants the GATHERED size for all-gather
-            counted = local * n
-        else:
-            counted = local
+        counted = local * n if base == 'all-gather' else local
         cost = costmodel.torus_cost(base, counted, axes, bw_gbps=bw,
                                     latency_us=lat,
                                     calibration=calibration)
-        row = rows.setdefault(base, {
-            'calls': 0, 'bytes': 0, 'wire_bytes': 0, 'est_us': 0.0,
-            'phases': 0, 'max_wire_bytes': 0, 'max_est_us': 0.0,
+        key = ins.name if ins.name not in out \
+            else f'{ins.name}@{comp.name}'
+        out[key] = {
+            'op': base, 'bytes': counted,
+            'wire_bytes': cost['wire_bytes'],
+            'phases': cost['phases'], 'est_us': cost['est_us'],
             'group_size': n, 'axes': cost['axes'],
-            'file': None, 'line': None})
-        row['calls'] += 1
-        row['bytes'] += counted
-        row['wire_bytes'] += cost['wire_bytes']
-        row['est_us'] = round(row['est_us'] + cost['est_us'], 3)
-        row['phases'] += cost['phases']
-        if cost['wire_bytes'] > row['max_wire_bytes']:
-            # group_size/est ride along: on a multi-axis mesh one base
-            # opcode mixes group sizes (tp=2 activation vs dp=4 grad
-            # all-reduces) and the flag must describe the worst call
-            row['max_wire_bytes'] = cost['wire_bytes']
-            row['max_est_us'] = cost['est_us']
-            row['group_size'] = n
-            row['axes'] = cost['axes']
-            row['file'], row['line'] = ins.file, ins.line
-    return rows
+            'file': ins.file, 'line': ins.line}
+    return out
 
 
 # -- peak-memory liveness -----------------------------------------------------

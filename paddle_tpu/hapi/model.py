@@ -519,7 +519,16 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
-            callbacks=None):
+            callbacks=None, profile=None):
+        """``profile=`` enables sampled on-device trace capture over
+        the train loop (telemetry.profile): None → the
+        ``PADDLE_TPU_PROFILE`` env decides (default off), False forces
+        off, True/str/dict/ProfileSchedule configure the windows.
+        Trace artifacts land next to the flight-recorder dumps
+        (``save_dir`` when given); each closed window emits a
+        ``profile_capture`` event and the device-compute vs
+        collective-time breakdown gauges.  Steps outside a window pay
+        one integer compare — the sync-free loop contract holds."""
         assert self._optimizer is not None and self._loss is not None, \
             'call prepare(optimizer, loss) before fit'
         train_loader = self._to_loader(train_data, batch_size, shuffle,
@@ -549,7 +558,8 @@ class Model:
             with _tel.span('fit', epochs=epochs):
                 self._fit_loop(cbks, train_loader, eval_loader, epochs,
                                eval_freq, batch_size, num_workers,
-                               log_freq=log_freq)
+                               log_freq=log_freq, profile=profile,
+                               save_dir=save_dir)
         finally:
             requested = _sd.shutdown_requested()
             sig = _sd.preemption_signal()
@@ -588,14 +598,19 @@ class Model:
         return self
 
     def _fit_loop(self, cbks, train_loader, eval_loader, epochs,
-                  eval_freq, batch_size, num_workers, log_freq=10):
-        import time as _time
+                  eval_freq, batch_size, num_workers, log_freq=10,
+                  profile=None, save_dir=None):
         from .. import telemetry as _tel
-        _perf = _time.perf_counter
         # sync-free telemetry: device loss scalars + host step/wait
         # times buffer in the accumulator and flush every
         # flush_interval steps (None when telemetry is not enabled)
         acc = _tel.step_accumulator('train')
+        # sampled trace capture (telemetry.profile); None when off.
+        # hapi steps carry no jit shardings, so windows yield the
+        # profile_capture breakdown without the collective census
+        # join — the mesh path (ParallelTrainer) does both.
+        prof = _tel.step_profiler(profile, base_dir=save_dir,
+                                  name='fit')
         # metric accumulate() is a device readback: pay it only on
         # steps some logger actually prints — the union of fit's
         # log_freq and every callback's own log_freq (a user
@@ -607,6 +622,26 @@ class Model:
             if isinstance(f, int) and f > 0:
                 log_freqs.add(f)
         cbks.on_train_begin({})
+        try:
+            self._fit_epochs(cbks, train_loader, eval_loader, epochs,
+                             eval_freq, batch_size, num_workers,
+                             log_freqs, acc, prof)
+        finally:
+            if prof is not None:
+                # ALWAYS finalize — an exception mid-epoch must not
+                # leave jax.profiler tracing for the rest of the
+                # process (every later window would fail to start).
+                # sync on the last loss so a still-open window waits
+                # for its traced async steps before stop_trace.
+                prof.close(sync=self._last_fit_loss)
+
+    def _fit_epochs(self, cbks, train_loader, eval_loader, epochs,
+                    eval_freq, batch_size, num_workers, log_freqs,
+                    acc, prof):
+        import time as _time
+        _perf = _time.perf_counter
+        gstep = 0
+        self._last_fit_loss = None
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch, {})
             for m in self._metrics:
@@ -626,9 +661,13 @@ class Model:
                 arrays, n_in = self._split_batch(batch)
                 _ts0 = _perf()
                 loss, _ = self.train_batch(arrays[:n_in], arrays[n_in:])
+                self._last_fit_loss = loss
                 if acc is not None:
                     acc.observe(step=step, step_time_s=_perf() - _ts0,
                                 wait_s=wait_s, loss=loss)
+                if prof is not None:
+                    prof.observe(gstep, sync=loss)   # 0-based index
+                gstep += 1
                 logs = {'loss': loss}
                 if any((step + 1) % f == 0 for f in log_freqs):
                     for m in self._metrics:

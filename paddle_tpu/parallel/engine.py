@@ -59,7 +59,7 @@ class ParallelTrainer:
     def __init__(self, model, optimizer, loss_fn, mesh=None, strategy=None,
                  donate=True, n_inputs=1, nan_guard=False, nan_patience=3,
                  nan_max_rollbacks=2, lint=None, auto_shard=False,
-                 hbm_budget_gb=None, calibration=None):
+                 hbm_budget_gb=None, calibration=None, profile=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -85,6 +85,15 @@ class ParallelTrainer:
         # replicated-giant rule is live here.  None/False off,
         # 'warn'/True warns, 'error' raises on high severity.
         self.lint = lint
+        # profile: sampled on-device trace capture over this trainer's
+        # step loop (telemetry.profile).  None → the PADDLE_TPU_PROFILE
+        # env decides; False off; True/str/dict/ProfileSchedule
+        # configure windows.  Profiled collectives are census-matched
+        # through compiled_text() and emitted as collective_observed
+        # events — the calibration-fit input.
+        self.profile = profile
+        self._profiler = None
+        self._profiler_init = False
         self._step_no = 0
         self._compiled = None
         self._eval_compiled = None
@@ -505,7 +514,7 @@ class ParallelTrainer:
             result = _planner.plan_model(
                 self.model, batch, chips=len(devices), devices=devices,
                 hbm_budget_gb=self.hbm_budget_gb,
-                calibration=self.plan_calibration,
+                calibration=self._resolved_calibration(),
                 name=type(self.model).__name__, **kwargs)
             winner = result.winner
         except Exception as e:
@@ -727,12 +736,71 @@ class ParallelTrainer:
         # LR-scheduler advancement is the caller's job (hapi epoch loop)
         return loss
 
+    def _resolved_calibration(self):
+        """The calibration= argument as a costmodel.Calibration (paths
+        loaded lazily, once), or None — shared by the planner's cost
+        scoring, the census prediction events and the profiler's
+        census join, so all three predict with the same constants."""
+        if not hasattr(self, '_calibration_obj'):
+            cal = self.plan_calibration
+            if isinstance(cal, str):
+                from ..analysis import costmodel as _cm
+                try:
+                    cal = _cm.load_calibration(cal)
+                except Exception as e:
+                    import warnings
+                    warnings.warn(
+                        f'calibration table {cal!r} could not be '
+                        f'loaded ({e!r}); predictions fall back to '
+                        'the analytic cost model', RuntimeWarning,
+                        stacklevel=3)
+                    cal = None
+            elif cal is not None and not hasattr(cal, 'per_op'):
+                cal = None
+            self._calibration_obj = cal
+        return self._calibration_obj
+
+    def _ensure_profiler(self, _tel):
+        """Latch the sampled step profiler (telemetry.profile) on
+        first use.  None when profiling is off — the per-step cost is
+        then a single attribute read.  The census join runs through
+        compiled_text() so profiled collectives carry the compiled
+        module's wire-byte/phase signature (pipeline steps profile
+        without the join: their per-stage modules lower separately)."""
+        if not self._profiler_init:
+            self._profiler_init = True
+            try:
+                mesh_shape = (dict(self.mesh.shape)
+                              if self.mesh is not None else None)
+                n_parts = (int(np.prod(list(mesh_shape.values())))
+                           if mesh_shape else 1)
+                cal = self._resolved_calibration()
+                text_fn = self.compiled_text \
+                    if (self.mesh is not None
+                        and not self._pipeline) else None
+                self._profiler = _tel.step_profiler(
+                    self.profile, name='parallel',
+                    hlo_text_fn=text_fn, mesh_shape=mesh_shape,
+                    num_partitions=n_parts, calibration=cal)
+            except Exception:   # profiling must never kill a step
+                self._profiler = None
+        return self._profiler
+
     def _note_step(self, first_call, dt, loss, _tel):
         """Telemetry for one step() call: the first call of a fresh
         compile is recorded as the compile cost (jit traces+compiles
         synchronously before dispatching); steady-state calls feed the
         sync-free accumulator — the loss stays a DEVICE scalar in the
         buffer and is read back only at flush_interval boundaries."""
+        prof = self._ensure_profiler(_tel)
+        if prof is not None:
+            # a dedicated 0-based call counter: _step_no increments
+            # before this hook on one path and after it on the
+            # nan_guard path (and does not advance on skipped steps),
+            # so window step labels would drift between them
+            n = self._profile_calls = getattr(
+                self, '_profile_calls', -1) + 1
+            prof.observe(n, sync=loss)
         if first_call:
             _tel.event('compile', name='ParallelTrainer.step',
                        dur_s=round(dt, 6))
@@ -764,7 +832,8 @@ class ParallelTrainer:
             with _tel.span('hlo_audit'):
                 text = self.compiled_text()
             census = _hlo.collective_census(
-                _hlo.parse_module(text), mesh_shape=dict(self.mesh.shape))
+                _hlo.parse_module(text), mesh_shape=dict(self.mesh.shape),
+                calibration=self._resolved_calibration())
             per_op = {base: {'calls': r['calls'], 'bytes': r['bytes']}
                       for base, r in census.items()}
             total = sum(r['bytes'] for r in per_op.values())
@@ -786,6 +855,20 @@ class ParallelTrainer:
                            r['est_us'] for r in predicted.values()), 3))
         except Exception:       # audit is evidence, never a blocker
             pass
+
+    def finish_profile(self, sync=None):
+        """Finalize the sampled profiler at the end of a step loop: a
+        still-open capture window is stopped, parsed and emitted (pass
+        the last loss as `sync` so the traced async steps complete
+        first).  No-op when profiling is off.  Without this, a window
+        that opened on the run's final steps would leave jax.profiler
+        tracing and its evidence unparsed.  Returns the window
+        summaries gathered so far."""
+        prof = self._profiler
+        if prof is None:
+            return []
+        prof.close(sync=sync)
+        return prof.windows
 
     def _nan_rollback(self):
         """Sentinel-demanded rollback: reload the last COMMITTED

@@ -5,6 +5,16 @@ nvprof hooks have no TPU meaning.  `op_summary` is the per-op table
 (reference stop_profiler(sorted_key=...) prints per-op CUDA times;
 here rows come from the step's optimized HLO, ranked by memory
 traffic — the honest time proxy on an HBM-bound chip).
+
+The trace a start/stop window emits is not just for the TensorBoard
+UI any more: ``profiler.trace`` parses the perfetto ``*.trace.json.gz``
+into per-op durations (stdlib gzip+json), and ``stop_profiler``
+returns a parsed :class:`trace.TraceProfile` when asked — profiled
+collectives join the ``analysis.hlo`` census by instruction name and
+become ``collective_observed`` telemetry events (the calibration-fit
+input).  The sampled in-training capture loop lives in
+``telemetry.profile`` (``fit(profile=…)``,
+``ParallelTrainer(profile=…)``, ``PADDLE_TPU_PROFILE``).
 """
 import contextlib
 import sys
@@ -15,10 +25,14 @@ import jax
 # recorder's step-time reservoir); this module and utils/profiler used
 # to carry near-duplicate implementations — both now re-export it.
 from ..telemetry import StepTimer  # noqa: F401
+from . import trace  # noqa: F401
+from .trace import (  # noqa: F401
+    TraceProfile, parse_trace, find_traces, match_collectives)
 
 __all__ = ['Profiler', 'start_profiler', 'stop_profiler', 'profiler',
            'reset_profiler', 'cuda_profiler', 'StepTimer', 'RecordEvent',
-           'op_summary']
+           'op_summary', 'trace', 'TraceProfile', 'parse_trace',
+           'find_traces', 'match_collectives']
 
 
 def op_summary(fn, *args, sorted_by='total', top=25, stream=None,
@@ -144,11 +158,17 @@ def start_profiler(state=None, tracer_option=None,
     return logdir
 
 
-def stop_profiler(sorted_key=None, profile_path=None):
+def stop_profiler(sorted_key=None, profile_path=None, parse=False):
+    """End the window.  Returns the logdir (legacy contract), or —
+    with ``parse=True`` — the parsed :class:`trace.TraceProfile` of
+    the newest emitted trace (None when nothing was written)."""
     global _active_logdir
     jax.profiler.stop_trace()
     out = _active_logdir
     _active_logdir = None
+    if parse and out is not None:
+        files = find_traces(out)
+        return parse_trace(files[-1]) if files else None
     return out
 
 

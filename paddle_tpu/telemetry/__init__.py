@@ -55,12 +55,18 @@ from .recorder import (  # noqa: F401
     Recorder, get_recorder, reset, hard_off, EVENT_KINDS)
 from .stepstats import (  # noqa: F401
     StepAccumulator, StepTimer, percentiles)
-from .exporters import JsonlWriter, ScalarAdapter  # noqa: F401
+from .exporters import (  # noqa: F401
+    JsonlWriter, ScalarAdapter, TensorBoardWriter, TeeWriter)
+from .profile import (  # noqa: F401
+    ProfileSchedule, StepProfiler, step_profiler, capture,
+    resolve_schedule)
 
 __all__ = [
     'Recorder', 'get_recorder', 'reset', 'hard_off', 'EVENT_KINDS',
     'StepAccumulator', 'StepTimer', 'percentiles',
-    'JsonlWriter', 'ScalarAdapter',
+    'JsonlWriter', 'ScalarAdapter', 'TensorBoardWriter', 'TeeWriter',
+    'ProfileSchedule', 'StepProfiler', 'step_profiler', 'capture',
+    'resolve_schedule',
     'enable', 'disable', 'enabled', 'active',
     'event', 'add', 'set_gauge', 'span', 'events',
     'step_accumulator', 'dump_flight', 'flight_dir',
@@ -84,7 +90,7 @@ def enabled():
 
 
 def enable(log_dir=None, flush_interval=32, crash_dump=True,
-           max_events=None):
+           max_events=None, tensorboard=False):
     """Turn on full telemetry: stream events to
     ``<log_dir>/telemetry-r<rank>.jsonl``, activate the sync-free
     per-step accumulators in hapi/ParallelTrainer at
@@ -92,7 +98,14 @@ def enable(log_dir=None, flush_interval=32, crash_dump=True,
     the flight recorder on an unhandled exception.
 
     log_dir=None keeps everything in memory (step accumulation and
-    flight dumps still work; nothing streams to disk)."""
+    flight dumps still work; nothing streams to disk).
+
+    tensorboard=True additionally writes TensorBoard-native event
+    files (``events.out.tfevents.*``) next to the JSONL: the SAME
+    buffered device scalars — ``steps`` flushes and ``scalar``
+    records — become TB scalar points at their flush boundary, so the
+    export adds zero per-step host syncs (stdlib-only writer, see
+    exporters.TensorBoardWriter)."""
     global _enabled, _crash_dir
     if hard_off():
         return None
@@ -103,7 +116,10 @@ def enable(log_dir=None, flush_interval=32, crash_dump=True,
         rec._events = deque(rec._events, maxlen=max_events)
     rec.flush_interval = max(1, int(flush_interval))
     if log_dir is not None:
-        old = rec.attach_writer(JsonlWriter(log_dir))
+        writer = JsonlWriter(log_dir)
+        if tensorboard:
+            writer = TeeWriter(writer, TensorBoardWriter(log_dir))
+        old = rec.attach_writer(writer)
         if old is not None:
             old.close()
         _crash_dir = os.path.abspath(log_dir)

@@ -66,9 +66,13 @@ EVENT_KINDS = (
     'collective_cost',     # predicted wire bytes / torus time per
                            # collective (analysis.costmodel at compile)
     'collective_observed', # profiled per-collective timing from a
-                           # chip session (op, wire_bytes, us, phases)
-                           # — calibrate_costmodel fits alpha/beta
-                           # from these
+                           # capture window (op, wire_bytes, us,
+                           # phases) — telemetry.profile emits them,
+                           # calibrate_costmodel fits alpha/beta
+                           # from them
+    'profile_capture',     # one sampled jax.profiler window closed
+                           # (step range, trace path, device-compute
+                           # vs collective breakdown, error if any)
     'plan_selected',       # auto-sharding planner chose a plan
                            # (winner mesh/assignment, predicted wire
                            # bytes/us + peak HBM, candidates scored)

@@ -20,6 +20,11 @@ os.environ.setdefault('JAX_ENABLE_X64', '0')
 # a developer's warm ~/.cache can never mask a recompile regression).
 # Cache-behavior tests opt back in with monkeypatch / subprocess envs.
 os.environ.setdefault('PADDLE_TPU_COMPILE_CACHE', '0')
+# same hermeticity for the sampled profiler: an ambient
+# PADDLE_TPU_PROFILE would make every fit/trainer test open
+# jax.profiler windows (block_until_ready + trace parse per close) —
+# profile-behavior tests pass profile= / monkeypatch explicitly
+os.environ.setdefault('PADDLE_TPU_PROFILE', '0')
 
 import jax  # noqa: E402
 

@@ -59,6 +59,17 @@ STEPS = [
      45 * 60),
     ('profile_resnet', [sys.executable, 'tools/profile_resnet.py'],
      45 * 60),
+    # self-profiling closed loop: capture a sampled trace window over
+    # the built-in dp workload on the REAL chips, emit
+    # collective_observed telemetry, and fit the calibration table
+    # the auto-sharding planner consumes (ROADMAP item-3 follow-up:
+    # the fitter finally has an on-device producer).  --dp 0 = every
+    # visible device; artifacts (traces + telemetry JSONL +
+    # calibration.json) land in the committed evidence dir
+    ('profile_collectives',
+     [sys.executable, 'tools/profile_run.py', '--dp', '0',
+      '--out', 'tools/chip_out/profile_run',
+      '--fit', 'tools/chip_out/calibration.json'], 45 * 60),
     ('perf_experiments', [sys.executable, 'tools/perf_experiments.py'],
      2 * 3600),
     ('int8_matmul', [sys.executable, 'tools/bench_int8_matmul.py'],

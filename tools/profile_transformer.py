@@ -11,10 +11,18 @@ Usage (real chip):
     python tools/profile_transformer.py --model bert  [--batch 64 --seq 128]
 
 Prints: cost_analysis flops/bytes, measured ms/step (best of 3),
-TFLOPS-equivalent (6*N*tokens/s), and the top optimized-HLO op census.
+TFLOPS-equivalent (6*N*tokens/s), and the top optimized-HLO op census
+(via the shared ``profiler.op_summary`` / ``analysis.hlo`` parser —
+the ad-hoc Counter census this script used to carry is gone).
+
+``--emit-telemetry`` additionally captures an on-device trace window
+around one timing rep through the shared capture/parse API
+(``telemetry.capture``), leaving telemetry JSONL + a
+``profile_capture`` breakdown (and census-matched
+``collective_observed`` events on multi-device runs) in ``--out`` for
+tools/run_report.py / tools/calibrate_costmodel.py.
 """
 import argparse
-import collections
 import os
 import sys
 import time
@@ -69,12 +77,24 @@ def main():
     ap.add_argument('--batch', type=int, default=None)
     ap.add_argument('--seq', type=int, default=None)
     ap.add_argument('--iters', type=int, default=15)
+    ap.add_argument('--emit-telemetry', action='store_true',
+                    help='capture a trace window around one timing '
+                         'rep and stream telemetry JSONL to --out')
+    ap.add_argument('--out', default=None,
+                    help='telemetry/trace output dir for '
+                         '--emit-telemetry (default: '
+                         'tools/chip_out/profile_<model>)')
     args = ap.parse_args()
     batch = args.batch or (8 if args.model == 'gpt' else 64)
     seq = args.seq or (1024 if args.model == 'gpt' else 128)
+    out = args.out or os.path.join('tools', 'chip_out',
+                                   f'profile_{args.model}')
 
     import jax
+    from paddle_tpu import telemetry
     print(f'device: {jax.devices()[0]}', flush=True)
+    if args.emit_telemetry:
+        telemetry.enable(out)
     tr, ids, lbl, n_params = build(args.model, batch, seq)
     # device-resident inputs, exactly like bench.py: measure compute,
     # not the host link
@@ -97,6 +117,29 @@ def main():
         float(np.asarray(loss))
         dt = (time.time() - t0) / args.iters
         best = dt if best is None or dt < best else best
+
+    if args.emit_telemetry:
+        # a SEPARATE short traced window AFTER the headline reps: the
+        # window close pays block_until_ready + trace parse + the
+        # compiled_text lowering — none of which may touch the
+        # best-of-3 measurement (PERF.md methodology)
+        n_trace = min(args.iters, 4)
+        with telemetry.capture(
+                os.path.join(out, 'trace'), name=args.model,
+                hlo_text_fn=tr.compiled_text,
+                mesh_shape=(dict(tr.mesh.shape)
+                            if tr.mesh is not None else None),
+                steps=n_trace) as cap:
+            for _ in range(n_trace):
+                loss = tr.step(ids, lbl)
+            cap.sync = loss
+        win = cap.windows[-1] if cap.windows else {}
+        print(f'trace window ({n_trace} steps): '
+              f'{win.get("device_us_per_step", 0):.0f} us/step '
+              f'device, '
+              f'{win.get("collective_us_per_step", 0):.0f} us '
+              f'collectives ({len(cap.observed)} '
+              'collective_observed)', flush=True)
     toks = batch * seq / best
     print(f'{args.model} b={batch} T={seq}: {best * 1000:.1f} ms/step '
           f'{toks:.0f} tokens/s '
@@ -104,41 +147,20 @@ def main():
           f'{6 * n_params * toks / 1e12 / 197 * 100:.0f}% of v5e peak)',
           flush=True)
 
-    # cost analysis LAST: lower().compile() goes through the AOT path
-    # and does NOT reuse jit's in-memory executable — it recompiles.
-    # Running it after the timing loop keeps the chip idle while
-    # measuring (PERF.md methodology rule 2)
-    compiled = getattr(tr, '_compiled', None)
-    analysis = None
-    if compiled is not None and hasattr(compiled, 'lower'):
-        try:
-            import jax.numpy as jnp
-            from paddle_tpu.core import rng as rng_mod
-            lowered = compiled.lower(
-                tr.params, tr.buffers, tr.opt_state,
-                jnp.asarray(1), rng_mod.next_key(),
-                *(jnp.asarray(a) for a in (ids, lbl)))
-            analysis = lowered.compile()
-            ca = analysis.cost_analysis()
-            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-            print(f"cost: {ca.get('flops', 0):.3e} flops/step, "
-                  f"{ca.get('bytes accessed', 0):.3e} bytes/step",
-                  flush=True)
-        except Exception as e:
-            print(f'cost_analysis unavailable: {e!r}', flush=True)
-
-    # optimized-HLO op census (where do the ops go)
-    if analysis is not None:
-        try:
-            import re
-            hlo = analysis.as_text()
-            ops = collections.Counter(
-                m.group(1) for m in re.finditer(
-                    r'^\s*(?:ROOT )?\S+ = \S+ (\w+)\(', hlo,
-                    re.MULTILINE))
-            print('top HLO ops:', ops.most_common(12), flush=True)
-        except Exception as e:
-            print(f'hlo census unavailable: {e!r}', flush=True)
+    # census LAST: compiled_text() lowers through the AOT path (it
+    # does not reuse jit's in-memory executable), so running it after
+    # the timing loop keeps the chip idle while measuring (PERF.md
+    # methodology rule 2).  One shared lowering serves the module
+    # cost totals AND the per-op table (profiler.op_summary over the
+    # analysis.hlo parser — and nothing at all when the persistent
+    # compile cache already holds this step's text).
+    try:
+        tr.op_summary(ids, lbl, top=12)
+    except Exception as e:
+        print(f'op census unavailable: {e!r}', flush=True)
+    if args.emit_telemetry:
+        telemetry.disable()
+        print(f'telemetry JSONL + trace artifacts: {out}', flush=True)
 
 
 if __name__ == '__main__':

@@ -314,19 +314,37 @@ def analyze(events, sources, skew=None):
             'wire_bytes_total': last.get('wire_bytes_total', 0),
             'est_us_total': last.get('est_us_total', 0.0),
             'mesh': last.get('mesh')}
-    # profiled per-collective timings (chip-session A/B): the observed
-    # side calibrate_costmodel.py fits alpha/beta from
-    observed_us = {}
+    # profiled per-collective timings (telemetry.profile capture
+    # windows): the observed side calibrate_costmodel.py fits
+    # alpha/beta from.  Events carry per-CALL us, one event per call
+    # site (instr) per window — average each call site across windows,
+    # then sum call sites per op, so the per-op observed_us is a
+    # per-step total comparable to the census est_us no matter how
+    # many windows the run captured.
+    per_instr = {}
     for e in by_kind.get('collective_observed', ()):
         op = e.get('op')
         if op is None:
             continue
+        # `name` (the emitting loop) joins the key: two trainers in
+        # one run may reuse an instr name across different compiled
+        # modules — their per-call timings must not blend
+        key = (op, e.get('name'), e.get('instr'))
+        r = per_instr.setdefault(
+            key, {'us': [], 'wire_bytes': 0, 'phases': 0, 'calls': 0})
+        r['us'].append(e.get('us') or 0.0)
+        r['wire_bytes'] = max(r['wire_bytes'],
+                              e.get('wire_bytes') or 0)
+        r['phases'] = max(r['phases'], e.get('phases') or 0)
+        r['calls'] += e.get('calls') or 1
+    observed_us = {}
+    for (op, _name, _instr), r in per_instr.items():
         row = observed_us.setdefault(
             op, {'us': 0.0, 'wire_bytes': 0, 'phases': 0, 'calls': 0})
-        row['us'] = round(row['us'] + (e.get('us') or 0.0), 3)
-        row['wire_bytes'] += e.get('wire_bytes') or 0
-        row['phases'] += e.get('phases') or 0
-        row['calls'] += e.get('calls') or 1
+        row['us'] = round(row['us'] + sum(r['us']) / len(r['us']), 3)
+        row['wire_bytes'] += r['wire_bytes']
+        row['phases'] += r['phases']
+        row['calls'] += r['calls']
     collectives_cmp = None
     if collectives or collectives_predicted or observed_us:
         ops = set((collectives or {}).get('per_op', {})) | set(
@@ -338,7 +356,7 @@ def analyze(events, sources, skew=None):
             pred = (collectives_predicted or {}).get(
                 'per_op', {}).get(op, {})
             prof = observed_us.get(op, {})
-            collectives_cmp[op] = {
+            row = {
                 'observed_calls': obs.get('calls'),
                 'observed_bytes': obs.get('bytes'),
                 'observed_us': prof.get('us'),
@@ -348,6 +366,15 @@ def analyze(events, sources, skew=None):
                 'predicted_est_us': pred.get('est_us'),
                 'predicted_phases': pred.get('phases'),
             }
+            # the closed loop: profiled us over the cost-model
+            # estimate, per op — what calibration is meant to pull
+            # toward 1.0.  Both sides are per-step totals: the census
+            # sums est_us over an op's call sites, and the profiler
+            # emits each call site's per-execution us once.
+            o_us, p_us = row['observed_us'], row['predicted_est_us']
+            if o_us and p_us:
+                row['us_ratio'] = round(o_us / p_us, 4)
+            collectives_cmp[op] = row
 
     # -- auto-sharding plan: predicted-vs-actual for the chosen plan --
     plan = None
@@ -373,6 +400,23 @@ def analyze(events, sources, skew=None):
         pred_us = plan.get('predicted_est_us')
         if obs_us and pred_us:
             plan['us_ratio'] = round(obs_us / pred_us, 4)
+
+    # -- profile capture windows (telemetry.profile) --------------
+    profile = None
+    cap_events = by_kind.get('profile_capture', [])
+    if cap_events:
+        ok = [e for e in cap_events if not e.get('error')]
+        last = (ok or cap_events)[-1]
+        profile = {
+            'windows': len(cap_events),
+            'errors': len(cap_events) - len(ok),
+            'collective_observed': sum(
+                e.get('collective_observed') or 0 for e in cap_events),
+            'last': {k: last.get(k) for k in (
+                'name', 'step_lo', 'step_hi', 'device_us_per_step',
+                'collective_us_per_step', 'collective_frac', 'trace',
+                'error') if last.get(k) is not None},
+        }
 
     # -- lint findings -------------------------------------------
     lint = {}
@@ -422,6 +466,7 @@ def analyze(events, sources, skew=None):
         'collectives_predicted': collectives_predicted,
         'collectives_cmp': collectives_cmp,
         'plan': plan,
+        'profile': profile,
         'clock_skew': skew or {},
         'lint_findings': lint,
         'spans': spans,
@@ -489,6 +534,8 @@ def render(report, stream=None):
                                  f'{row["observed_bytes"]:,} B')
             if row.get('observed_us') is not None:
                 obs_parts.append(f'{row["observed_us"]:.0f} us')
+                if row.get('us_ratio'):
+                    obs_parts.append(f'(x{row["us_ratio"]:.2f})')
             obs = ' '.join(obs_parts) or '-'
             pred = '-'
             if row['predicted_wire_bytes'] is not None:
@@ -524,6 +571,20 @@ def render(report, stream=None):
                 if pl.get('us_ratio'):
                     obs_line += f' (x{pl["us_ratio"]:.2f} of predicted)'
             p(obs_line)
+    if report.get('profile'):
+        pr = report['profile']
+        last = pr.get('last') or {}
+        p('\n-- profile captures --')
+        p(f'    {pr["windows"]} window(s), '
+          f'{pr["collective_observed"]} collective_observed event(s)'
+          + (f', {pr["errors"]} failed' if pr.get('errors') else ''))
+        if last.get('device_us_per_step') is not None:
+            frac = last.get('collective_frac') or 0.0
+            p(f'    last window [{last.get("name")}] steps '
+              f'{last.get("step_lo")}-{last.get("step_hi")}: '
+              f'{last["device_us_per_step"]:.0f} us/step device, '
+              f'{last.get("collective_us_per_step", 0):.0f} us '
+              f'({frac:.1%}) in collectives')
     if report.get('clock_skew'):
         p('\n-- clock skew (per-host anchor offsets applied) --')
         for r, off in sorted(report['clock_skew'].items()):
