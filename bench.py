@@ -662,25 +662,26 @@ def _write_partial(results, smoke=False):
         log(f'could not write partial artifact: {e}')
 
 
-def _chaos_preflight(timeout_s=300):
-    """--chaos-smoke gate: one short seeded FaultPlan (SIGKILL at step
-    N + torn manifest write + dropped commit) driven by
-    tools/chaos_run.py on CPU, asserting the resilience invariant set
-    (restore only yields committed steps, commits monotonic,
-    preemption exits 117, restarts bounded, final state exact) BEFORE
-    any chip time is spent.  A regression in the commit/restore
-    protocol fails the bench here, with the violation list as the
-    artifact.
+def _chaos_preflight(timeout_s=420):
+    """--chaos-smoke gate: tools/soak_run.py --smoke on CPU BEFORE any
+    chip time is spent — (1) the golden plan-generator and
+    shrunk-plan fixtures (property-based chaos machinery cannot drift
+    silently), then (2) ONE 2-process ChaosCluster spin of the
+    built-in smoke plan: a hung collective (watchdog timeout ->
+    coordinated abort -> elastic restart), a SIGKILLed worker (crash
+    recovery from the two-phase committed step), a SIGTERM preemption
+    (exit 117), and a torn manifest write — the coverage the two old
+    single-process chaos_run driver cases provided, now across real
+    process boundaries, gated on invariants I1-I7 + bit-exact final
+    state on every rank.
 
     Returns (ok, summary_dict).  Chaos-infra failures (timeout, crash
     of the driver itself) never block the bench — evidence beats a
     dead gate — but invariant VIOLATIONS always do."""
     import subprocess
-    import tempfile
     repo = os.path.dirname(os.path.abspath(__file__))
-    workdir = tempfile.mkdtemp(prefix='bench_chaos_')
-    cmd = [sys.executable, os.path.join(repo, 'tools', 'chaos_run.py'),
-           '--smoke', '--json', '--dir', workdir]
+    cmd = [sys.executable, os.path.join(repo, 'tools', 'soak_run.py'),
+           '--smoke', '--json']
     env = dict(os.environ, JAX_PLATFORMS='cpu')
     env.pop('PALLAS_AXON_POOL_IPS', None)
     try:
@@ -690,14 +691,17 @@ def _chaos_preflight(timeout_s=300):
     except Exception as e:
         log(f'chaos preflight skipped ({e!r})')
         return True, {'error': repr(e)[:200]}
+    cluster = doc.get('cluster') or {}
     summary = {'ok': doc.get('ok'),
-               'violations': doc.get('violations', [])[:10],
-               'injected': doc.get('injected', []),
-               'incarnations': doc.get('incarnations'),
-               'duration_s': doc.get('duration_s')}
+               'failures': doc.get('failures', [])[:10],
+               'injected': cluster.get('injected', []),
+               'incarnations': cluster.get('incarnations'),
+               'watchdog_exit_codes':
+                   cluster.get('watchdog_exit_codes'),
+               'duration_s': cluster.get('duration_s')}
     log(f'chaos preflight: ok={doc.get("ok")} '
-        f'({len(doc.get("injected", []))} faults injected, '
-        f'{doc.get("incarnations")} incarnations)')
+        f'({len(cluster.get("injected", []))} faults injected across '
+        f'2 procs, incarnations={cluster.get("incarnations")})')
     return bool(doc.get('ok')), summary
 
 

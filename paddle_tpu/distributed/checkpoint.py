@@ -39,7 +39,9 @@ import numpy as np
 
 from ..resilience import manifest as _manifest
 
-__all__ = ['save_sharded', 'load_sharded', 'CheckpointManager']
+__all__ = ['save_sharded', 'load_sharded', 'CheckpointManager',
+           'save_host_shard', 'load_host_shard',
+           'latest_committed_step']
 
 
 def _checkpointer(async_save):
@@ -192,6 +194,94 @@ def _abstractify(like):
                                     if not hasattr(x, 'dtype') else x.dtype,
                                     sharding=sharding)
     return jax.tree_util.tree_map(leaf, like)
+
+
+def save_host_shard(run_dir, step, host, arrays, num_hosts,
+                    prefix='step', finalize=None, checksums=True,
+                    barrier_timeout=120.0, meta=None):
+    """Per-HOST shard save with the cross-host two-phase commit — the
+    multi-process checkpoint path for clusters where one orbax save
+    cannot span the processes (the CPU backend runs no cross-process
+    computations; host-local state has the same shape on real pods).
+
+    Each host writes ``<run_dir>/<prefix>_<step>/shard_r<host>.npz``
+    through resilience.manifest.atomic_write (the chaos file seam
+    covers it: torn/EIO writes hit this exactly as they hit orbax
+    manifests), then acks with a phase-1 intent.  Host 0 (`finalize`
+    overrides) finalizes the two-phase commit once every host's ack
+    landed, recording ``process_count`` so ``check_ckpt --deep
+    --cluster`` can audit the rank set.  Raises CommitBarrierTimeout
+    from the finalizer when an ack never arrives (a killed worker) —
+    the directory then stays uncommitted and is swept later, exactly
+    like the orbax path.  Emits the same checkpoint telemetry.
+
+    Returns the manifest doc on the finalizing host, else None."""
+    import io
+    import time as _time
+    from ..telemetry import event as _tevent
+    host = int(host)
+    num_hosts = int(num_hosts)
+    if finalize is None:
+        finalize = host == 0
+    step_dir = os.path.join(os.path.abspath(run_dir),
+                            f'{prefix}_{step}')
+    os.makedirs(step_dir, exist_ok=True)
+    rel = f'shard_r{host}.npz'
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+    payload = buf.getvalue()
+    _t0 = _time.perf_counter()
+    _manifest.atomic_write(os.path.join(step_dir, rel),
+                           lambda f: f.write(payload), mode='wb',
+                           prefix='.shard_tmp')
+    _tevent('checkpoint_save', step=step, path=step_dir,
+            async_save=False, host=host,
+            dispatch_s=round(_time.perf_counter() - _t0, 6))
+    _manifest.write_intent(step_dir, host, step=step, files=[rel],
+                           checksums=checksums)
+    if not finalize:
+        return None
+    full_meta = {'process_count': num_hosts}
+    full_meta.update(meta or {})
+    doc = _manifest.finalize_two_phase(
+        step_dir, num_hosts, step=step, checksums=checksums,
+        meta=full_meta, timeout=barrier_timeout)
+    _tevent('checkpoint_commit', step=step, host=host, dur_s=None)
+    return doc
+
+
+def load_host_shard(run_dir, step, host, prefix='step'):
+    """This host's shard dict from a COMMITTED per-host step dir, or
+    None (absent / uncommitted / unreadable — the caller falls back to
+    an older step or a cold start)."""
+    step_dir = os.path.join(os.path.abspath(run_dir),
+                            f'{prefix}_{step}')
+    if not _manifest.is_committed(step_dir):
+        return None
+    p = os.path.join(step_dir, f'shard_r{int(host)}.npz')
+    try:
+        with np.load(p) as z:
+            return {k: z[k].copy() for k in z.files}
+    except (OSError, ValueError):
+        return None
+
+
+def latest_committed_step(run_dir, prefix='step'):
+    """Newest COMMITTED step id under `run_dir`, or -1 — the reader
+    view shared by every worker of a multi-process cluster (each then
+    loads its own shard with load_host_shard)."""
+    best = -1
+    try:
+        names = os.listdir(os.path.abspath(run_dir))
+    except OSError:
+        return best
+    for f in names:
+        tag = f[len(prefix) + 1:]
+        if not (f.startswith(prefix + '_') and tag.isdigit()):
+            continue
+        if _manifest.is_committed(os.path.join(run_dir, f)):
+            best = max(best, int(tag))
+    return best
 
 
 def load_sharded(path, like):

@@ -15,8 +15,30 @@ Process groups: a reference `Group` names a NCCL communicator subset; a
 paddle_tpu `Group` names a SET OF MESH AXES — e.g. the dp group is axis
 ('dp',), the mp group axis ('tp',).  XLA derives the participant subsets
 from the mesh, which is how sub-groups ride ICI instead of host loops.
+
+HOST TRANSPORT (multi-process, outside any mesh region): XLA cannot run
+one computation across processes on the CPU backend, and even on TPU
+some collectives are host-side by nature (object gathers, commit
+barriers, control-plane consensus).  :class:`HostCollectives` is that
+layer: a key-value transport over a pluggable client — jax's
+coordination-service client on a real pod (``jax.distributed``
+initialized), or a :class:`FileKVStore` over a shared directory for the
+multi-process chaos topology, where a SIGKILLed worker must be able to
+restart and REJOIN (the coordination service cannot re-admit a dead
+task; files can).  Every payload travels with an explicit dtype/shape/
+crc32 header, so the wire format is dtype-agnostic: an int8 or packed
+int4 quantized payload (EQuARX) is framed and verified identically to
+f32.  Every blocking wait is deadline-bounded and polls the cluster
+abort flag — a dead or hung peer surfaces as :class:`CollectiveTimeout`
+or :class:`CoordinatedAbort`, never as an infinite wait.  These are the
+collective-layer fault seams resilience.chaos injects into.
 """
+import binascii
 import contextlib
+import json
+import os
+import pickle
+import time
 
 import numpy as np
 import jax
@@ -30,7 +52,10 @@ from . import env as _env
 __all__ = ['ReduceOp', 'Group', 'new_group', 'get_group', 'all_reduce',
            'all_gather', 'all_gather_object', 'broadcast', 'reduce',
            'scatter', 'alltoall', 'send', 'recv', 'barrier', 'wait',
-           'axis_scope', 'current_axes', 'get_axis_rank', 'split_group']
+           'axis_scope', 'current_axes', 'get_axis_rank', 'split_group',
+           'FileKVStore', 'HostCollectives', 'CollectiveTimeout',
+           'CollectivePayloadError', 'CoordinatedAbort',
+           'get_kv_client', 'set_kv_client', 'KV_ENV']
 
 
 class ReduceOp:
@@ -326,3 +351,463 @@ def wait(tensor, group=None, use_calc_stream=True):
 def split_group(mesh_axis):
     """Convenience: the Group for one mesh axis."""
     return new_group(axes=(mesh_axis,))
+
+
+# =============================================================================
+# Host-side multi-process transport (the collective-layer fault surface)
+# =============================================================================
+
+KV_ENV = 'PADDLE_TPU_KV'
+
+
+class CollectiveTimeout(TimeoutError):
+    """A host collective's deadline expired with participants still
+    missing.  Carries the op/tag and which ranks never showed — the
+    watchdog and the post-mortem both need rank attribution."""
+
+    def __init__(self, op, tag, missing, timeout):
+        self.op = op
+        self.tag = tag
+        self.missing = sorted(missing)
+        self.timeout = timeout
+        super().__init__(
+            f'{op}[{tag}] timed out after {timeout:.1f}s waiting for '
+            f'rank(s) {self.missing}')
+
+
+class CollectivePayloadError(ValueError):
+    """A collective payload failed its frame check (crc32 / header
+    mismatch).  Wire corruption must be DETECTED at the collective
+    boundary, whatever the dtype — the quantized-wire path (int8/int4
+    all-reduce) rides the same frame."""
+
+    def __init__(self, op, tag, rank, detail):
+        self.op = op
+        self.tag = tag
+        self.rank = rank
+        super().__init__(
+            f'{op}[{tag}] payload from rank {rank} corrupt: {detail}')
+
+
+class CoordinatedAbort(RuntimeError):
+    """The cluster abort flag was raised while this rank waited inside
+    a collective.  Raised so the hung/waiting rank exits promptly and
+    the elastic supervisor restarts the cluster from the last committed
+    step, instead of every rank burning its own full timeout."""
+
+
+class FileKVStore:
+    """A restart-proof key-value store over a shared directory.
+
+    Same interface subset as jax's DistributedRuntimeClient
+    (``key_value_set_bytes`` / ``blocking_key_value_get_bytes`` / ...),
+    but backed by atomic files: a worker that was SIGKILLed can respawn
+    and keep participating, which the coordination service does not
+    allow (a dead task cannot re-register).  This is the transport the
+    multi-process chaos topology runs on; real pods use the jax client.
+
+    Writes go through resilience.manifest.atomic_write, so the file
+    seam's torn-write/EIO chaos faults apply to the collective wire
+    exactly as they do to checkpoints."""
+
+    def __init__(self, directory, poll=0.005):
+        self.directory = os.path.abspath(directory)
+        self.poll = poll
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _path(self, key):
+        # keys may contain '/'; quote to one flat filename so listing
+        # and deletion stay trivial
+        from urllib.parse import quote
+        return os.path.join(self.directory, quote(str(key), safe=''))
+
+    def key_value_set_bytes(self, key, value):
+        from ..resilience.manifest import atomic_write
+        atomic_write(self._path(key), lambda f: f.write(value),
+                     mode='wb', prefix='.kv_tmp')
+
+    def key_value_set(self, key, value):
+        self.key_value_set_bytes(key, value.encode('utf-8'))
+
+    def try_get_bytes(self, key):
+        try:
+            with open(self._path(key), 'rb') as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def blocking_key_value_get_bytes(self, key, timeout_ms):
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        while True:
+            v = self.try_get_bytes(key)
+            if v is not None:
+                return v
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f'key {key!r} not set within '
+                                   f'{timeout_ms}ms')
+            time.sleep(self.poll)
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        return self.blocking_key_value_get_bytes(
+            key, timeout_ms).decode('utf-8')
+
+    def key_value_delete(self, key):
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
+    def key_value_dir_get_bytes(self, prefix):
+        from urllib.parse import quote, unquote
+        q = quote(str(prefix), safe='')
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for f in sorted(names):
+            if not f.startswith(q) or f.startswith('.'):
+                continue
+            v = self.try_get_bytes(unquote(f))
+            if v is not None:
+                out.append((unquote(f), v))
+        return out
+
+
+_kv_client = None
+
+
+def set_kv_client(client):
+    """Install the process-global KV client (tests, chaos workers).
+    Pass None to fall back to env/jax discovery."""
+    global _kv_client
+    _kv_client = client
+    return client
+
+
+def get_kv_client():
+    """The host-transport KV client, resolved once per process:
+    an explicitly installed client wins; then ``PADDLE_TPU_KV``
+    (``file:<dir>`` — the chaos topology ships this); then a live
+    ``jax.distributed`` coordination-service client; else None
+    (single-process world: HostCollectives degrades to identity)."""
+    global _kv_client
+    if _kv_client is not None:
+        return _kv_client
+    spec = os.environ.get(KV_ENV)
+    if spec:
+        if spec.startswith('file:'):
+            _kv_client = FileKVStore(spec[len('file:'):])
+            return _kv_client
+        raise ValueError(f'unsupported {KV_ENV} spec {spec!r} '
+                         "(expected 'file:<dir>')")
+    try:
+        from jax._src import distributed as _jd
+        client = getattr(_jd.global_state, 'client', None)
+        if client is not None:
+            _kv_client = client
+            return _kv_client
+    except Exception:
+        pass
+    return None
+
+
+def _frame(arr):
+    """Serialize one ndarray with an explicit header: dtype, shape and
+    a crc32 of the raw bytes.  Dtype-agnostic on purpose — int8/uint8
+    (quantized wire traffic) frames identically to f32, and the
+    receiver verifies the crc BEFORE interpreting a single element."""
+    a = np.ascontiguousarray(arr)
+    raw = a.tobytes()
+    head = json.dumps({'dtype': a.dtype.str, 'shape': list(a.shape),
+                       'crc32': binascii.crc32(raw) & 0xFFFFFFFF,
+                       'nbytes': len(raw)}).encode('utf-8')
+    return len(head).to_bytes(4, 'big') + head + raw
+
+
+def _unframe(payload, op, tag, rank):
+    if len(payload) < 4:
+        raise CollectivePayloadError(op, tag, rank, 'frame truncated')
+    hlen = int.from_bytes(payload[:4], 'big')
+    try:
+        head = json.loads(payload[4:4 + hlen].decode('utf-8'))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise CollectivePayloadError(op, tag, rank,
+                                     f'header unparseable ({e})')
+    raw = payload[4 + hlen:]
+    if len(raw) != head.get('nbytes'):
+        raise CollectivePayloadError(
+            op, tag, rank,
+            f'{len(raw)} payload bytes != recorded {head.get("nbytes")}')
+    crc = binascii.crc32(raw) & 0xFFFFFFFF
+    if crc != head.get('crc32'):
+        raise CollectivePayloadError(
+            op, tag, rank, f'crc32 {crc:#x} != recorded '
+            f'{head.get("crc32"):#x}')
+    return np.frombuffer(raw, dtype=np.dtype(head['dtype'])).reshape(
+        head['shape']).copy()
+
+
+class HostCollectives:
+    """Host-side collectives across real process boundaries.
+
+    Each rank posts its framed payload under a deterministic key
+    ``<ns>/<tag>/<op>/r<rank>`` and blockingly fetches every peer's.
+    Keys are tagged by the CALLER (typically with the step id), which
+    makes the exchange replay-stable across elastic restarts: a
+    restarted rank that restored an older committed step re-fetches its
+    peers' already-posted step keys and catches up, while the peers
+    wait at the barrier of the step the straggler has not reached yet.
+    (The contract: per-step payloads must be deterministic functions of
+    the step — true for SPMD training state.)
+
+    Every wait is bounded by ``timeout_s`` and polls the cluster abort
+    flag; on deadline the raiser names the missing ranks
+    (CollectiveTimeout) so the watchdog can attribute the straggler.
+    Old generations are pruned lazily (``gc_window`` step-tags deep).
+    """
+
+    ABORT_KEY = 'abort'
+
+    def __init__(self, client=None, rank=None, world=None,
+                 namespace='ptpu', timeout_s=60.0, poll=0.01,
+                 gc_window=32):
+        self.client = client if client is not None else get_kv_client()
+        if rank is None:
+            rank = int(os.environ.get('PADDLE_TRAINER_ID', 0) or 0)
+        if world is None:
+            world = os.environ.get('PADDLE_TRAINERS_NUM')
+            if world is None:
+                try:
+                    world = jax.process_count()
+                except RuntimeError:
+                    world = 1
+        self.rank = int(rank)
+        self.world = int(world)
+        self.namespace = namespace
+        self.timeout_s = float(timeout_s)
+        self.poll = poll
+        self.gc_window = gc_window
+        self._history = []          # posted (tag, op) for lazy gc
+        self._epoch = time.time()   # aborts older than our start are
+                                    # a previous incarnation's
+
+    # -- keys / abort flag ---------------------------------------------------
+
+    def _key(self, tag, op, rank):
+        return f'{self.namespace}/{tag}/{op}/r{rank}'
+
+    def _abort_key(self):
+        return f'{self.namespace}/{self.ABORT_KEY}'
+
+    def request_abort(self, reason=''):
+        """Raise the cluster abort flag: every rank polling inside a
+        collective observes it within one poll interval and raises
+        CoordinatedAbort instead of waiting out its own timeout."""
+        if self.client is None:
+            return
+        doc = json.dumps({'ts': time.time(), 'rank': self.rank,
+                          'reason': str(reason)[:200]})
+        try:
+            self.client.key_value_set_bytes(self._abort_key(),
+                                            doc.encode('utf-8'))
+        except Exception:
+            pass
+
+    def clear_abort(self):
+        """Called at worker startup: a NEW incarnation must not be
+        killed by the abort that restarted it."""
+        if self.client is None:
+            return
+        try:
+            self.client.key_value_delete(self._abort_key())
+        except Exception:
+            pass
+
+    def try_get(self, key):
+        """Non-blocking-ish read of one key on ANY client:
+        FileKVStore's try_get_bytes when present, else a 1ms blocking
+        get on the jax coordination-service client (absence reads as
+        None).  The abort flag and the watchdog's peer heartbeats go
+        through this so they work on real pods, not just the file
+        store."""
+        c = self.client
+        if c is None:
+            return None
+        if hasattr(c, 'try_get_bytes'):
+            return c.try_get_bytes(key)
+        try:
+            return c.blocking_key_value_get_bytes(key, 1)
+        except Exception:
+            return None
+
+    def abort_requested(self):
+        """The live abort doc, or None.  Aborts raised before this
+        transport's creation are stale (previous incarnation) and are
+        ignored — clear_abort races with slow starters otherwise."""
+        raw = self.try_get(self._abort_key())
+        if raw is None:
+            return None
+        try:
+            doc = json.loads(raw.decode('utf-8'))
+        except (ValueError, UnicodeDecodeError):
+            return None
+        if doc.get('ts', 0) < self._epoch:
+            return None
+        return doc
+
+    # -- transport primitives (the chaos seam patches these) -----------------
+
+    def post(self, tag, op, payload):
+        """Publish this rank's framed payload for one collective."""
+        self.client.key_value_set_bytes(
+            self._key(tag, op, self.rank), payload)
+        self._history.append((tag, op))
+        self._gc()
+
+    def fetch(self, tag, op, rank, deadline):
+        """Blocking fetch of `rank`'s payload, bounded by `deadline`
+        (monotonic), polling the abort flag between attempts."""
+        poll_ms = max(1, int(self.poll * 1000))
+        while True:
+            try:
+                return self.client.blocking_key_value_get_bytes(
+                    self._key(tag, op, rank), poll_ms)
+            except Exception:
+                pass
+            doc = self.abort_requested()
+            if doc is not None:
+                raise CoordinatedAbort(
+                    f'{op}[{tag}]: abort requested by rank '
+                    f'{doc.get("rank")} ({doc.get("reason")!r})')
+            if time.monotonic() >= deadline:
+                return None
+
+    def _gc(self):
+        """Prune own keys older than gc_window collectives — bounded
+        disk/KV growth without breaking replay (a restarted rank can
+        lag at most the checkpoint cadence, which the caller keeps
+        well inside the window)."""
+        while len(self._history) > self.gc_window:
+            tag, op = self._history.pop(0)
+            try:
+                self.client.key_value_delete(
+                    self._key(tag, op, self.rank))
+            except Exception:
+                pass
+
+    # -- collectives ---------------------------------------------------------
+
+    def _effective_timeout(self, timeout_s):
+        """The wait bound for one collective: the explicit/default
+        timeout, clamped by a started Watchdog's per-collective budget
+        (``Budget.collective_s``) and by any enclosing
+        ``collective_budget`` scope."""
+        t = self.timeout_s if timeout_s is None else float(timeout_s)
+        try:
+            from ..resilience.watchdog import (
+                remaining_budget, default_collective_s)
+            dflt = default_collective_s()
+            if dflt is not None:
+                t = min(t, float(dflt))
+            rem = remaining_budget()
+            if rem is not None:
+                t = min(t, max(0.01, rem))
+        except Exception:
+            pass
+        return t
+
+    def _exchange(self, tag, op, arr, timeout_s=None):
+        """Post own frame, fetch every peer's; returns {rank: ndarray}.
+        The whole exchange runs inside a collective_budget scope of
+        its effective timeout, so nested bounded waits — retry() on a
+        flaky shared fs, most of all — cannot outlive it."""
+        if self.client is None or self.world <= 1:
+            return {self.rank: np.asarray(arr)}
+        t = self._effective_timeout(timeout_s)
+        try:
+            from ..resilience.watchdog import collective_budget
+            scope = collective_budget(t)
+        except Exception:       # pragma: no cover - defensive
+            scope = contextlib.nullcontext()
+        with scope:
+            self.post(tag, op, _frame(np.asarray(arr)))
+            deadline = time.monotonic() + t
+            out, missing = {}, []
+            for r in range(self.world):
+                if r == self.rank:
+                    out[r] = np.asarray(arr)
+                    continue
+                payload = self.fetch(tag, op, r, deadline)
+                if payload is None:
+                    missing.append(r)
+                    continue
+                out[r] = _unframe(payload, op, tag, r)
+        if missing:
+            self._note_timeout(op, tag, missing, t)
+            raise CollectiveTimeout(op, tag, missing, t)
+        return out
+
+    def _note_timeout(self, op, tag, missing, timeout):
+        try:
+            from .. import telemetry
+            telemetry.event('timeout', op=op, tag=tag,
+                            missing=sorted(missing),
+                            budget_s=round(timeout, 3), rank=self.rank)
+            telemetry.add('collective.timeouts')
+        except Exception:
+            pass
+
+    def allreduce(self, arr, op='sum', tag='ar', timeout_s=None):
+        """Cross-process all-reduce of one host array (any dtype).
+        op: 'sum' | 'mean' | 'max' | 'min'."""
+        parts = self._exchange(tag, f'allreduce-{op}', arr,
+                               timeout_s=timeout_s)
+        stack = np.stack([parts[r] for r in sorted(parts)])
+        if op == 'sum':
+            return stack.sum(axis=0).astype(stack.dtype)
+        if op == 'mean':
+            return stack.mean(axis=0).astype(stack.dtype)
+        if op == 'max':
+            return stack.max(axis=0)
+        if op == 'min':
+            return stack.min(axis=0)
+        raise ValueError(f'bad host allreduce op {op!r}')
+
+    def allgather(self, arr, tag='ag', timeout_s=None):
+        """[world, ...] stack of every rank's array."""
+        parts = self._exchange(tag, 'allgather', arr,
+                               timeout_s=timeout_s)
+        return np.stack([parts[r] for r in sorted(parts)])
+
+    def allgather_object(self, obj, tag='ago', timeout_s=None):
+        """Every rank's python object, as a rank-ordered list (pickle
+        payloads ride the same crc-framed wire)."""
+        buf = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        parts = self._exchange(tag, 'allgather_object', buf,
+                               timeout_s=timeout_s)
+        return [pickle.loads(parts[r].tobytes())
+                for r in sorted(parts)]
+
+    def broadcast_object(self, obj, src=0, tag='bc', timeout_s=None):
+        """src's object on every rank."""
+        if self.client is None or self.world <= 1:
+            return obj
+        t = self._effective_timeout(timeout_s)
+        op = 'broadcast'
+        if self.rank == src:
+            buf = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+            self.post(tag, op, _frame(buf))
+            return obj
+        payload = self.fetch(tag, op, src, time.monotonic() + t)
+        if payload is None:
+            self._note_timeout(op, tag, [src], t)
+            raise CollectiveTimeout(op, tag, [src], t)
+        return pickle.loads(_unframe(payload, op, tag,
+                                     src).tobytes())
+
+    def barrier_host(self, tag='bar', timeout_s=None):
+        """All ranks reach this tag (a 1-byte allgather)."""
+        self._exchange(tag, 'barrier',
+                       np.zeros((1,), np.uint8), timeout_s=timeout_s)
+        return True

@@ -29,7 +29,12 @@ from ..resilience import PREEMPTED_EXIT_CODE, GracefulShutdown
 
 __all__ = ['TrainerProc', 'start_local_trainers',
            'terminate_local_procs', 'watch_local_trainers', 'supervise',
-           'PREEMPTED_EXIT_CODE']
+           'PREEMPTED_EXIT_CODE', 'DEADLINE_EXIT_CODE']
+
+# returned by watch_local_trainers when its `deadline` expires before
+# the workers finish: the supervised run wedged (the timeout(1)
+# convention code, so shell drivers read it naturally)
+DEADLINE_EXIT_CODE = 124
 
 
 class TrainerProc:
@@ -156,7 +161,7 @@ def watch_local_trainers(procs, max_restarts=3, poll=0.2,
                          heartbeat_file=None, heartbeat_timeout=None,
                          log_dir=None, on_event=None, shutdown=None,
                          min_preempt_uptime=None, restart_backoff=1.0,
-                         restart_backoff_max=30.0):
+                         restart_backoff_max=30.0, deadline=None):
     """The pod watch loop: poll workers, restart the dead, kill the
     wedged (stale or deleted heartbeat), stop everything when one
     fails beyond `max_restarts`.
@@ -171,8 +176,9 @@ def watch_local_trainers(procs, max_restarts=3, poll=0.2,
     requested, SIGTERM is forwarded to the workers so they checkpoint,
     and the loop returns PREEMPTED_EXIT_CODE itself — preemption
     propagates cleanly through nested supervision.  `on_event(kind,
-    trainer)` (kinds 'exit', 'restart', 'hang', 'preempt', 'backoff')
-    observes transitions — tests and progress loggers hook it.
+    trainer)` (kinds 'exit', 'restart', 'hang', 'preempt', 'backoff',
+    'watchdog') observes transitions — tests and progress loggers
+    hook it.
 
     CRASH restarts (not preemptions) back off exponentially:
     restart k of a worker waits ``min(restart_backoff * 2**(k-1),
@@ -182,7 +188,21 @@ def watch_local_trainers(procs, max_restarts=3, poll=0.2,
     spans long enough for a transient cause (NFS blip, node coming
     up) to clear.  Preempted workers still respawn immediately: the
     fleet already imposed that wait.
+
+    `deadline` bounds the WHOLE supervision in wall-clock seconds: a
+    cluster that neither completes nor fails within it is torn down
+    and the loop returns DEADLINE_EXIT_CODE (124) — chaos soaks use
+    this as invariant I7 (complete or die loudly, never wedge a
+    reservation).  A worker exiting resilience.watchdog's
+    WATCHDOG_EXIT_CODE (a self-detected hang) is restarted as a
+    normal FAILURE (it consumes the max_restarts budget — a
+    deterministic hang must not restart forever) but is surfaced to
+    `on_event` as kind 'watchdog' so supervisors and reports can tell
+    a hang from a crash.
     """
+    from ..resilience.watchdog import WATCHDOG_EXIT_CODE
+    watch_deadline = (time.monotonic() + deadline
+                      if deadline is not None else None)
     if min_preempt_uptime is None:
         # default 5s, tunable per-deployment: real workers spend far
         # longer than this importing + restoring before any step, but
@@ -208,6 +228,12 @@ def watch_local_trainers(procs, max_restarts=3, poll=0.2,
                 # graceful checkpoint within the grace window)
                 terminate_local_procs(procs, grace=30.0)
                 return PREEMPTED_EXIT_CODE
+            if watch_deadline is not None and \
+                    time.monotonic() > watch_deadline:
+                # the I7 backstop: a wedged cluster is torn down and
+                # reported as a deadline breach, never left running
+                terminate_local_procs(procs, grace=3.0)
+                return DEADLINE_EXIT_CODE
             alive = False
             for t in procs:
                 rc = t.proc.poll()
@@ -238,7 +264,9 @@ def watch_local_trainers(procs, max_restarts=3, poll=0.2,
                     preempted = False
                 # dead worker: restart or give up
                 if on_event:
-                    on_event('preempt' if preempted else 'exit', t)
+                    on_event('preempt' if preempted
+                             else 'watchdog' if rc == WATCHDOG_EXIT_CODE
+                             else 'exit', t)
                 if not preempted and t.restarts >= max_restarts:
                     terminate_local_procs(
                         [p for p in procs if p is not t])

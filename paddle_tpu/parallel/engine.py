@@ -59,7 +59,8 @@ class ParallelTrainer:
     def __init__(self, model, optimizer, loss_fn, mesh=None, strategy=None,
                  donate=True, n_inputs=1, nan_guard=False, nan_patience=3,
                  nan_max_rollbacks=2, lint=None, auto_shard=False,
-                 hbm_budget_gb=None, calibration=None, profile=None):
+                 hbm_budget_gb=None, calibration=None, profile=None,
+                 watchdog=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -94,6 +95,18 @@ class ParallelTrainer:
         self.profile = profile
         self._profiler = None
         self._profiler_init = False
+        # watchdog: straggler/hang supervision (resilience.watchdog).
+        # None → the PADDLE_TPU_WATCHDOG env decides (default OFF);
+        # False hard-off; True/dict/Budget arm per-step deadline
+        # budgets — derived from the auto-shard plan's cost-model
+        # estimate × slack when one exists — plus the heartbeat
+        # quorum when a cluster KV transport is configured.  A blown
+        # deadline escalates timeout → flight dump → coordinated
+        # abort → WATCHDOG_EXIT_CODE so the elastic supervisor
+        # restarts the rank instead of the cluster deadlocking.
+        self.watchdog = watchdog
+        self._watchdog = None
+        self._watchdog_init = False
         self._step_no = 0
         self._compiled = None
         self._eval_compiled = None
@@ -308,22 +321,31 @@ class ParallelTrainer:
         first_call = self._compiled is None
         if first_call:
             self._compiled = self._build_pipe_step()
+        wd = self._ensure_watchdog()
+        if wd is not None:
+            wd.step_started(self._step_no + 1, first=first_call)
         _t0 = _time.perf_counter()
+        try:
+            if self.nan_guard:
+                self.params, self.opt_state, loss, ok = self._compiled(
+                    self.params, self.opt_state,
+                    jnp.asarray(self._step_no + 1), *vals)
+                self._note_step(first_call, _time.perf_counter() - _t0,
+                                loss, _tel)
+                ok = bool(ok)   # the one host sync nan_guard costs
+            else:
+                self.params, self.opt_state, loss = self._compiled(
+                    self.params, self.opt_state,
+                    jnp.asarray(self._step_no + 1), *vals)
+        finally:
+            if wd is not None:
+                wd.step_finished(self._step_no + 1)
         if self.nan_guard:
-            self.params, self.opt_state, loss, ok = self._compiled(
-                self.params, self.opt_state,
-                jnp.asarray(self._step_no + 1), *vals)
-            self._note_step(first_call, _time.perf_counter() - _t0,
-                            loss, _tel)
-            ok = bool(ok)   # the one host sync nan_guard costs
             if ok:
                 self._step_no += 1
             if self.sentinel.observe(finite=ok) == 'rollback':
                 self._nan_rollback()
             return loss
-        self.params, self.opt_state, loss = self._compiled(
-            self.params, self.opt_state, jnp.asarray(self._step_no + 1),
-            *vals)
         self._step_no += 1
         self._note_step(first_call, _time.perf_counter() - _t0, loss,
                         _tel)
@@ -713,23 +735,35 @@ class ParallelTrainer:
         first_call = self._compiled is None
         vals = self._ensure_compiled(batch)
         key = rng_mod.next_key()
+        wd = self._ensure_watchdog()
+        if wd is not None:
+            # the deadline covers dispatch + (nan path) the device
+            # sync — where a hung collective actually blocks the host
+            wd.step_started(self._step_no + 1, first=first_call)
         _t0 = _time.perf_counter()
+        try:
+            if self.nan_guard:
+                (self.params, self.buffers, self.opt_state, loss,
+                 ok) = self._compiled(
+                    self.params, self.buffers, self.opt_state,
+                    jnp.asarray(self._step_no + 1), key, *vals)
+                self._note_step(first_call, _time.perf_counter() - _t0,
+                                loss, _tel)
+                ok = bool(ok)   # the one host sync nan_guard costs
+            else:
+                (self.params, self.buffers, self.opt_state,
+                 loss) = self._compiled(
+                    self.params, self.buffers, self.opt_state,
+                    jnp.asarray(self._step_no + 1), key, *vals)
+        finally:
+            if wd is not None:
+                wd.step_finished(self._step_no + 1)
         if self.nan_guard:
-            (self.params, self.buffers, self.opt_state, loss,
-             ok) = self._compiled(
-                self.params, self.buffers, self.opt_state,
-                jnp.asarray(self._step_no + 1), key, *vals)
-            self._note_step(first_call, _time.perf_counter() - _t0,
-                            loss, _tel)
-            ok = bool(ok)   # the one host sync nan_guard costs
             if ok:
                 self._step_no += 1
             if self.sentinel.observe(finite=ok) == 'rollback':
                 self._nan_rollback()
             return loss
-        self.params, self.buffers, self.opt_state, loss = self._compiled(
-            self.params, self.buffers, self.opt_state,
-            jnp.asarray(self._step_no + 1), key, *vals)
         self._step_no += 1
         self._note_step(first_call, _time.perf_counter() - _t0, loss,
                         _tel)
@@ -759,6 +793,50 @@ class ParallelTrainer:
                 cal = None
             self._calibration_obj = cal
         return self._calibration_obj
+
+    def _ensure_watchdog(self):
+        """Latch the straggler/hang watchdog on first use; None when
+        off (the default) — the per-step cost is then one attribute
+        read.  The step budget derives from the PR-6 cost model when
+        the planner picked this trainer's plan (est_us + compute_us,
+        × the budget's slack factor); a cluster KV transport (env
+        PADDLE_TPU_KV / jax.distributed) additionally arms the
+        heartbeat quorum."""
+        if self._watchdog_init:
+            return self._watchdog
+        self._watchdog_init = True
+        try:
+            from ..resilience.watchdog import (
+                resolve_watchdog, Budget, Watchdog)
+            budget = resolve_watchdog(self.watchdog)
+            if budget is None:
+                return None
+            if budget.step_s is None and self.plan is not None:
+                est = ((getattr(self.plan, 'est_us', 0) or 0)
+                       + (getattr(self.plan, 'compute_us', 0) or 0))
+                if est > 0:
+                    budget.step_s = Budget.from_costmodel(
+                        est, slack=budget.slack).step_s
+            from ..distributed.collective import get_kv_client
+            mgr = getattr(self, '_ckpt_mgr', None)
+            self._watchdog = Watchdog(
+                budget=budget, name='parallel', kv=get_kv_client(),
+                flight_dir=(mgr.directory if mgr is not None
+                            else None)).start()
+        except Exception:       # supervision must never kill a step
+            self._watchdog = None
+        return self._watchdog
+
+    def stop_watchdog(self):
+        """Stop the supervision thread (end of the step loop; tests).
+        Final: later step() calls run unwatched — an explicit stop
+        must not be silently undone by the next step re-latching a
+        fresh escalation-armed thread.  Assign ``self.watchdog`` and
+        reset ``_watchdog_init`` to re-arm deliberately.  No-op when
+        the watchdog is off."""
+        wd, self._watchdog = self._watchdog, None
+        if wd is not None:
+            wd.stop()
 
     def _ensure_profiler(self, _tel):
         """Latch the sampled step profiler (telemetry.profile) on

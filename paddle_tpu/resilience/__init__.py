@@ -28,8 +28,20 @@ rest of the stack composes with it:
              loops; deadline caps barrier waits.
   chaos      deterministic, seeded fault injection (FaultPlan /
              ChaosEngine) + the resilience invariant checker — the
-             proof harness for everything above.  Driven by
-             tools/chaos_run.py and the `chaos` pytest fixture.
+             proof harness for everything above.  ChaosCluster spawns
+             a TRUE multi-process topology (N workers + supervisor)
+             with collective-layer fault seams.  Driven by
+             tools/chaos_run.py, tools/soak_run.py and the `chaos`
+             pytest fixture.
+  watchdog   straggler/hang supervision: per-step and per-collective
+             deadline budgets (cost-model-derived), heartbeat quorum
+             across ranks, and the timeout -> flight-dump ->
+             coordinated-abort -> elastic-restart escalation so a
+             hung rank costs one restart, never a deadlocked cluster.
+  plangen    property-based chaos plan generation: seeded composition
+             of legal fault sequences for long soaks, plus shrinking
+             a failing plan to a minimal committed reproducer
+             (tools/soak_run.py).
 
 Reference analogue: the reference framework spreads this over fleet
 elastic (etcd heartbeats), checkpoint_saver (versioned dirs) and the
@@ -47,7 +59,11 @@ from .shutdown import (  # noqa: F401
     clear_shutdown, handler_installed, uninstall_shutdown)
 from .sentinel import NanSentinel, finite_step, guard_update  # noqa: F401
 from .chaos import (  # noqa: F401
-    Fault, FaultPlan, ChaosEngine, check_invariants)
+    Fault, FaultPlan, ChaosEngine, ChaosCluster, check_invariants,
+    load_run_events)
+from .watchdog import (  # noqa: F401
+    Watchdog, Budget, WATCHDOG_EXIT_CODE, collective_budget,
+    remaining_budget, resolve_watchdog)
 
 __all__ = [
     'MANIFEST_NAME', 'TWO_PHASE_DIR', 'write_manifest', 'read_manifest',
@@ -59,5 +75,8 @@ __all__ = [
     'shutdown_requested', 'exit_if_requested', 'preemption_signal',
     'clear_shutdown', 'handler_installed', 'uninstall_shutdown',
     'NanSentinel', 'finite_step', 'guard_update',
-    'Fault', 'FaultPlan', 'ChaosEngine', 'check_invariants',
+    'Fault', 'FaultPlan', 'ChaosEngine', 'ChaosCluster',
+    'check_invariants', 'load_run_events',
+    'Watchdog', 'Budget', 'WATCHDOG_EXIT_CODE', 'collective_budget',
+    'remaining_budget', 'resolve_watchdog',
 ]

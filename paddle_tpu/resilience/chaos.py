@@ -60,11 +60,25 @@ import random
 import signal
 import time
 
-__all__ = ['FAULT_KINDS', 'Fault', 'FaultPlan', 'ChaosEngine',
-           'ChaosCallback', 'check_invariants', 'plan_from_env',
+__all__ = ['FAULT_KINDS', 'COLLECTIVE_FAULT_KINDS', 'Fault',
+           'FaultPlan', 'ChaosEngine', 'ChaosCallback', 'ChaosCluster',
+           'check_invariants', 'plan_from_env', 'load_run_events',
            'PLAN_ENV']
 
 PLAN_ENV = 'PADDLE_TPU_CHAOS_PLAN'
+
+# faults that land on the host-collective wire (distributed.collective
+# HostCollectives) — the seam class added for the multi-process chaos
+# topology, and the one that must exist BEFORE quantized (EQuARX)
+# collectives change what travels on it
+COLLECTIVE_FAULT_KINDS = (
+    'collective_delay',    # sleep delay_s before posting the payload
+    'collective_hang',     # go silent: never post; peers time out and
+                           # the abort flag (or delay_s) releases us
+    'collective_drop',     # participant drops out: raise mid-collective
+    'collective_corrupt',  # flip a payload byte AFTER the crc header
+                           # is computed — receivers must detect it
+)
 
 FAULT_KINDS = (
     'io_error',          # raise OSError(errno) from matching file writes
@@ -78,26 +92,36 @@ FAULT_KINDS = (
     'delete_heartbeat',  # remove the heartbeat file at step N
     'stale_heartbeat',   # back-date the heartbeat mtime at step N
     'nan_grads',         # poison the step-N batch with NaN
-)
+    'slow_rank',         # throttle this rank's step N by delay_s (the
+                         # straggler the watchdog must attribute)
+) + COLLECTIVE_FAULT_KINDS
 
 
 class Fault:
     """One declarative fault.
 
     kind        one of FAULT_KINDS.
-    at_step     fire exactly at this training step (process/grads
-                seams), or at the save of this step (ckpt seam).
+    at_step     fire exactly at this training step (process/grads/
+                collective seams), or at the save of this step (ckpt
+                seam).
     prob        fire probabilistically per opportunity (file seam);
                 drawn from the plan's seeded RNG.
     count       max number of injections (default 1 for at_step
                 faults, unbounded for prob faults).
     path        substring filter on the file path (file/ckpt seams).
     errno_name  'EIO' | 'ENOSPC' | ... for io_error.
-    delay_s     sleep for slow_io.
+    delay_s     sleep for slow_io / collective_delay / slow_rank, and
+                the hang duration cap for collective_hang.
+    rank        only fire on this cluster rank (None = any rank) —
+                multi-process plans slice per rank; see
+                FaultPlan.slice_for_rank.
+    op          substring filter on the collective op/tag (collective
+                seams; e.g. 'allreduce' or 'step7').
     """
 
     def __init__(self, kind, at_step=None, prob=None, count=None,
-                 path=None, errno_name='EIO', delay_s=0.05):
+                 path=None, errno_name='EIO', delay_s=0.05,
+                 rank=None, op=None):
         if kind not in FAULT_KINDS:
             raise ValueError(f'unknown fault kind {kind!r}; '
                              f'one of {FAULT_KINDS}')
@@ -109,18 +133,19 @@ class Fault:
         self.path = path
         self.errno_name = errno_name
         self.delay_s = delay_s
+        self.rank = rank
+        self.op = op
         self.fired = 0
 
+    _FIELDS = ('kind', 'at_step', 'prob', 'count', 'path',
+               'errno_name', 'delay_s', 'rank', 'op')
+
     def to_dict(self):
-        return {k: getattr(self, k) for k in
-                ('kind', 'at_step', 'prob', 'count', 'path',
-                 'errno_name', 'delay_s')}
+        return {k: getattr(self, k) for k in self._FIELDS}
 
     @classmethod
     def from_dict(cls, d):
-        return cls(**{k: v for k, v in d.items()
-                      if k in ('kind', 'at_step', 'prob', 'count',
-                               'path', 'errno_name', 'delay_s')})
+        return cls(**{k: v for k, v in d.items() if k in cls._FIELDS})
 
     def _exhausted(self):
         return self.count is not None and self.fired >= self.count
@@ -131,6 +156,10 @@ class Fault:
             bits.append(f'at_step={self.at_step}')
         if self.prob is not None:
             bits.append(f'prob={self.prob}')
+        if self.rank is not None:
+            bits.append(f'rank={self.rank}')
+        if self.op is not None:
+            bits.append(f'op={self.op!r}')
         return f'Fault({", ".join(bits)})'
 
 
@@ -156,6 +185,50 @@ class FaultPlan:
         return cls(seed=d.get('seed', 0), faults=d.get('faults', ()),
                    name=d.get('name'))
 
+    def slice_for_rank(self, rank):
+        """This rank's share of a cluster plan: faults addressed to
+        `rank` plus the unaddressed ones.  The SEED is unchanged —
+        same cluster seed => every rank replays its identical injected
+        sequence, and the union over ranks is the plan's sequence."""
+        rank = int(rank)
+        faults = [Fault.from_dict(f.to_dict()) for f in self.faults
+                  if f.rank is None or int(f.rank) == rank]
+        return FaultPlan(seed=self.seed, faults=faults,
+                         name=f'{self.name or "plan"}@r{rank}')
+
+    def mark_fired(self, events, rank=None):
+        """Replay the fault ledger into this plan: count the
+        ``fault_injected`` records a PREVIOUS incarnation already
+        injected (telemetry JSONL + flight dumps survive the process)
+        and advance each bounded fault's ``fired`` counter, so a
+        restarted worker re-reading the same plan does not re-kill /
+        re-hang itself at the same step forever — while faults it has
+        NOT yet reached still fire.  Returns the number of ledger
+        entries applied."""
+        applied = 0
+        for f in self.faults:
+            if f.count is None:
+                continue        # unbounded prob faults may refire
+            n = 0
+            for e in events:
+                if e.get('kind') != 'fault_injected':
+                    continue
+                if e.get('fault') != f.kind:
+                    continue
+                if rank is not None and e.get('rank', 0) != rank:
+                    continue
+                if f.at_step is not None \
+                        and e.get('step') != f.at_step:
+                    continue
+                if f.op is not None and f.op not in str(
+                        e.get('op') or e.get('tag') or ''):
+                    continue
+                n += 1
+            if n:
+                f.fired = min(f.count, f.fired + n)
+                applied += n
+        return applied
+
 
 def plan_from_env(env=PLAN_ENV):
     """The FaultPlan shipped via the environment, or None.  Workers
@@ -174,22 +247,30 @@ class ChaosEngine:
     deactivation even on test failure.
     """
 
-    def __init__(self, plan, heartbeat_file=None):
+    def __init__(self, plan, heartbeat_file=None, rank=None):
         self.plan = plan if isinstance(plan, FaultPlan) else \
             FaultPlan(**plan) if isinstance(plan, dict) else plan
         self.rng = random.Random(self.plan.seed)
         self.heartbeat_file = heartbeat_file
+        self.rank = (int(rank) if rank is not None else
+                     int(os.environ.get('PADDLE_TRAINER_ID', 0) or 0))
         self.injected = []          # deterministic injection log
         self._saved = []            # (obj, attr, original) undo stack
         self._active = False
+        self._current_step = None   # set by step(); collective faults
+                                    # with at_step match against it
 
     # -- bookkeeping ---------------------------------------------------------
 
     def record(self, fault, **info):
         """One injection: appended to the deterministic sequence and
-        emitted as a ``fault_injected`` telemetry event."""
+        emitted as a ``fault_injected`` telemetry event.  Every entry
+        carries a rank (seam-provided, else the engine's own) so
+        in-memory consumers and flight-ring copies stay attributable
+        without relying on the JSONL writer's per-process tag."""
         fault.fired += 1
         entry = dict(fault=fault.kind, seq=len(self.injected), **info)
+        entry.setdefault('rank', self.rank)
         self.injected.append(entry)
         try:
             from .. import telemetry
@@ -206,12 +287,20 @@ class ChaosEngine:
         sequence."""
         return list(self.injected)
 
-    def _matching(self, kinds, path=None, step=None):
-        """Armed faults of `kinds` matching the path/step filters, in
-        plan order (deterministic)."""
+    def _matching(self, kinds, path=None, step=None, op=None,
+                  rank=None):
+        """Armed faults of `kinds` matching the path/step/op/rank
+        filters, in plan order (deterministic).  `rank` defaults to
+        the engine's own rank; the collective seam passes the POSTING
+        transport's rank instead (class-level patches see every
+        transport in the process — in-process multi-rank tests would
+        otherwise misattribute rank-addressed wire faults)."""
+        rank = self.rank if rank is None else int(rank)
         out = []
         for f in self.plan.faults:
             if f.kind not in kinds or f._exhausted():
+                continue
+            if f.rank is not None and int(f.rank) != rank:
                 continue
             if path is not None and f.path is not None \
                     and f.path not in str(path):
@@ -220,6 +309,9 @@ class ChaosEngine:
                     and f.at_step != step:
                 continue
             if path is None and f.path is not None:
+                continue
+            if f.op is not None and (op is None
+                                     or f.op not in str(op)):
                 continue
             out.append(f)
         return out
@@ -309,8 +401,87 @@ class ChaosEngine:
                         eng.record(f, step=step, path=victim)
 
         self._patch(_ckpt._SaveHandle, 'wait', chaotic_wait)
+        self._install_collective_seams()
         self._active = True
         return self
+
+    def _install_collective_seams(self):
+        """Patch the host-collective transport's post() (class-level:
+        every HostCollectives instance in this process).  The four wire
+        faults live here because this is where a real cluster fails:
+        a slow NIC (delay), a wedged peer (hang), a crashed peer
+        (drop), and bit rot on the wire (corrupt) — all BEFORE the
+        payload leaves this rank, so the injected byte damage must be
+        caught by the receivers' frame checks, whatever the dtype."""
+        from ..distributed import collective as _coll
+
+        eng = self
+        orig_post = _coll.HostCollectives.post
+
+        def chaotic_post(transport, tag, op, payload):
+            label = f'{op}:{tag}'
+            step = eng._current_step
+
+            def armed(f):
+                # mirror the process seam's explicit recheck: an
+                # at_step fault must not fire on collectives that run
+                # BEFORE the loop's first engine.step() (startup
+                # barriers/broadcasts), when _current_step is None and
+                # _matching's step filter is vacuous
+                if f.at_step is not None and f.at_step != step:
+                    return False
+                return eng._roll(f)
+            for f in eng._matching(('collective_drop',), step=step,
+                                   op=label,
+                                   rank=transport.rank):
+                if armed(f):
+                    eng.record(f, op=op, tag=tag, rank=transport.rank,
+                               step=step)
+                    raise RuntimeError(
+                        f'chaos: injected participant drop in '
+                        f'{op}[{tag}] on rank {eng.rank}')
+            for f in eng._matching(('collective_hang',), step=step,
+                                   op=label,
+                                   rank=transport.rank):
+                if armed(f):
+                    eng.record(f, op=op, tag=tag, rank=transport.rank,
+                               step=step, delay_s=f.delay_s)
+                    # go silent: peers see a missing participant and
+                    # time out; we wake early only for the cluster
+                    # abort flag (the coordinated-abort release) or
+                    # the hang cap (a straggler that finally arrives)
+                    deadline = time.monotonic() + f.delay_s
+                    while time.monotonic() < deadline:
+                        doc = transport.abort_requested()
+                        if doc is not None:
+                            from ..distributed.collective import \
+                                CoordinatedAbort
+                            raise CoordinatedAbort(
+                                f'chaos hang in {op}[{tag}] released '
+                                f'by abort from rank '
+                                f'{doc.get("rank")}')
+                        time.sleep(min(0.02, f.delay_s))
+            for f in eng._matching(('collective_delay',), step=step,
+                                   op=label,
+                                   rank=transport.rank):
+                if armed(f):
+                    eng.record(f, op=op, tag=tag, rank=transport.rank,
+                               step=step, delay_s=f.delay_s)
+                    time.sleep(f.delay_s)
+            for f in eng._matching(('collective_corrupt',), step=step,
+                                   op=label,
+                                   rank=transport.rank):
+                if armed(f):
+                    eng.record(f, op=op, tag=tag, rank=transport.rank,
+                               step=step)
+                    # flip one payload byte AFTER the crc header was
+                    # computed: receivers MUST reject the frame
+                    b = bytearray(payload)
+                    b[-1] ^= 0xFF
+                    payload = bytes(b)
+            return orig_post(transport, tag, op, payload)
+
+        self._patch(_coll.HostCollectives, 'post', chaotic_post)
 
     def deactivate(self):
         while self._saved:
@@ -358,7 +529,18 @@ class ChaosEngine:
         """Call once per training step (the chaos_run worker and the
         ChaosCallback do).  Fires process-level faults scheduled for
         this step: SIGTERM (latched by GracefulShutdown → graceful
-        preemption), SIGKILL (hard crash), heartbeat tampering."""
+        preemption), SIGKILL (hard crash), heartbeat tampering,
+        slow-rank throttling.  Also advances the step the collective
+        seams match ``at_step`` against."""
+        self._current_step = step_no
+        for f in self._matching(('slow_rank',), step=step_no):
+            if f.at_step == step_no and self._roll(f):
+                # the deliberate straggler: this rank's step runs, just
+                # late — the watchdog's soft threshold must attribute
+                # it without killing anything
+                self.record(f, step=step_no, rank=self.rank,
+                            delay_s=f.delay_s)
+                time.sleep(f.delay_s)
         for f in self._matching(('delete_heartbeat',), step=step_no):
             if f.at_step == step_no and self._roll(f):
                 hb = self.heartbeat_file
@@ -438,7 +620,8 @@ class ChaosCallback:
 
 def check_invariants(ckpt_dir, prefix='step', events=None,
                      max_restarts=None, restarts=None,
-                     preempt_codes=(), expect_committed=True):
+                     preempt_codes=(), expect_committed=True,
+                     final_rc=None, duration_s=None, deadline_s=None):
     """Verify the resilience invariant set after a chaos run.
 
     Returns a list of violation strings (empty == all invariants held):
@@ -451,7 +634,15 @@ def check_invariants(ckpt_dir, prefix='step', events=None,
           time (``checkpoint_restore`` step ∈ committed set);
       I4  preemptions exited PREEMPTED_EXIT_CODE (`preempt_codes`:
           exit codes the supervisor attributed to preemption);
-      I5  restarts stayed within budget (when both given).
+      I5  restarts stayed within budget (when both given);
+      I6  no step is published (committed) twice after a restart
+          unless an intervening restore rolled back BELOW it — a
+          restarted worker that re-commits work it never un-did is
+          double-publishing state;
+      I7  the cluster either completed (rc 0) or exited preempted,
+          within the deadline budget — a deadlocked or wedged cluster
+          (any other rc, or `duration_s` > `deadline_s`) is itself an
+          invariant violation, whatever its checkpoints look like.
     """
     from . import manifest as M
     from .shutdown import PREEMPTED_EXIT_CODE
@@ -504,6 +695,33 @@ def check_invariants(ckpt_dir, prefix='step', events=None,
                 violations.append(
                     f'I3: restore yielded step {s}, which was never '
                     'committed')
+        # I6: a step may be committed AGAIN only after a restore that
+        # rolled back below it (the replay then legitimately re-earns
+        # it).  Walk the merged stream in order, tracking whether a
+        # sufficiently-deep restore separates the two commits.
+        commit_or_restore = [
+            e for e in events
+            if (e.get('kind') == 'checkpoint_commit'
+                and e.get('step') is not None)
+            or ((e.get('kind') == 'checkpoint_restore'
+                 or (e.get('kind') == 'span'
+                     and e.get('name') == 'checkpoint_restore'))
+                and e.get('step') is not None)]
+        seen_commit = {}        # step -> index of its last commit
+        for i, e in enumerate(commit_or_restore):
+            s = e.get('step')
+            if e.get('kind') == 'checkpoint_commit':
+                if s in seen_commit:
+                    prev = seen_commit[s]
+                    rolled_back = any(
+                        r.get('kind') != 'checkpoint_commit'
+                        and r.get('step') < s
+                        for r in commit_or_restore[prev + 1:i])
+                    if not rolled_back:
+                        violations.append(
+                            f'I6: step {s} published twice with no '
+                            'intervening restore below it')
+                seen_commit[s] = i
     for code in preempt_codes:
         if code != PREEMPTED_EXIT_CODE:
             violations.append(
@@ -514,4 +732,243 @@ def check_invariants(ckpt_dir, prefix='step', events=None,
         violations.append(
             f'I5: {restarts} failure restarts exceed the '
             f'max_restarts={max_restarts} budget')
+    if final_rc is not None and final_rc not in (
+            0, PREEMPTED_EXIT_CODE):
+        violations.append(
+            f'I7: cluster neither completed nor exited preempted '
+            f'(rc={final_rc})')
+    if deadline_s is not None and duration_s is not None \
+            and duration_s > deadline_s:
+        violations.append(
+            f'I7: run took {duration_s:.1f}s, past the '
+            f'{deadline_s:.1f}s deadline budget')
     return violations
+
+
+class ChaosCluster:
+    """A true multi-process chaos topology: N worker processes under
+    elastic supervision, one shared filesystem KV transport, one
+    seeded FaultPlan sliced per rank.
+
+    Each worker is a separate interpreter (tools/soak_run.py
+    ``--worker`` by default) that: joins the cluster's
+    :class:`~paddle_tpu.distributed.collective.FileKVStore` transport
+    (restart-proof — the jax coordination service cannot re-admit a
+    SIGKILLed task, files can; workers still ``jax.distributed``-
+    initialize when `jax_distributed` is set and the plan kills
+    nobody), activates its per-rank plan slice (same cluster seed =>
+    identical injected sequence every run), trains the deterministic
+    workload with a host all-reduce every step, two-phase-commits
+    per-rank checkpoint shards, and runs a
+    :class:`~paddle_tpu.resilience.watchdog.Watchdog` so a hung
+    collective escalates timeout -> flight dump -> coordinated abort
+    -> WATCHDOG_EXIT_CODE instead of deadlocking the cluster.
+
+    ``run()`` supervises to completion (bounded by `deadline_s`),
+    merges every incarnation's telemetry, and checks invariants I1-I7
+    plus cross-rank final-state agreement.  Teardown is guaranteed:
+    worker processes are terminated and any coordinator-side seams
+    deactivated even when a worker dies mid-plan (the killed-worker
+    case the PR-5 reverse-order teardown fix is mirrored for)."""
+
+    def __init__(self, procs=2, plan=None, steps=20, workdir=None,
+                 max_restarts=4, save_every=2, collective_timeout_s=30.0,
+                 barrier_timeout_s=20.0, watchdog='step=90,grace=2',
+                 worker_argv=None, deadline_s=240.0,
+                 jax_distributed=False, engine=None, extra_env=None):
+        import tempfile
+        self.procs = int(procs)
+        self.plan = (plan if isinstance(plan, FaultPlan)
+                     else FaultPlan(**plan) if isinstance(plan, dict)
+                     else plan or FaultPlan(seed=0))
+        self.steps = int(steps)
+        self.workdir = workdir or tempfile.mkdtemp(prefix='chaos_cluster_')
+        self.max_restarts = max_restarts
+        self.save_every = save_every
+        self.collective_timeout_s = collective_timeout_s
+        self.barrier_timeout_s = barrier_timeout_s
+        self.watchdog = watchdog
+        self.worker_argv = worker_argv
+        self.deadline_s = deadline_s
+        self.jax_distributed = jax_distributed
+        # an optional coordinator-side engine (callers injecting
+        # supervisor-level faults); run() owns its teardown
+        self.engine = engine
+        self.extra_env = dict(extra_env or {})
+
+    def _default_worker(self):
+        import sys
+        repo = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        return [sys.executable,
+                os.path.join(repo, 'tools', 'soak_run.py'), '--worker']
+
+    def _worker_env(self):
+        import sys
+        repo = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env.pop('PALLAS_AXON_POOL_IPS', None)
+        env.update({
+            'JAX_PLATFORMS': 'cpu',
+            'PYTHONPATH': repo + os.pathsep + env.get('PYTHONPATH', ''),
+            'PADDLE_TPU_KV': 'file:' + os.path.join(self.workdir, 'kv'),
+            'PADDLE_TRAINERS_NUM': str(self.procs),
+            'PADDLE_TPU_CHAOS_PLAN': self.plan.to_json(),
+            'PADDLE_TPU_CHAOS_STEPS': str(self.steps),
+            'PADDLE_TPU_CHAOS_DIR': self.workdir,
+            'PADDLE_TPU_SOAK_SAVE_EVERY': str(self.save_every),
+            'PADDLE_TPU_SOAK_COLLECTIVE_TIMEOUT':
+                str(self.collective_timeout_s),
+            'PADDLE_TPU_SOAK_BARRIER_TIMEOUT':
+                str(self.barrier_timeout_s),
+            'PADDLE_TPU_SOAK_JAXDIST':
+                '1' if self.jax_distributed else '0',
+            'PADDLE_TPU_WATCHDOG': self.watchdog or '0',
+            'PADDLE_TPU_MIN_PREEMPT_UPTIME': '0',
+        })
+        if self.jax_distributed:
+            import socket
+            s = socket.socket()
+            s.bind(('127.0.0.1', 0))
+            port = s.getsockname()[1]
+            s.close()
+            env['PADDLE_TPU_SOAK_COORD'] = f'127.0.0.1:{port}'
+        env.update({k: str(v) for k, v in self.extra_env.items()})
+        return env
+
+    def run(self):
+        """Supervise one full chaos soak; returns the report dict
+        (ok, violations, injected sequence, incarnations, finals)."""
+        from ..distributed import elastic
+        os.makedirs(os.path.join(self.workdir, 'kv'), exist_ok=True)
+        cmd = list(self.worker_argv or self._default_worker())
+        t0 = time.time()
+        supervisor_events = []
+        exit_codes = {'preempt': [], 'exit': [], 'watchdog': []}
+
+        def on_event(kind, t):
+            supervisor_events.append((kind, t.rank))
+            rc = t.proc.returncode if t.proc else None
+            if kind in exit_codes and rc is not None:
+                exit_codes[kind].append(rc)
+
+        procs = elastic.start_local_trainers(
+            [cmd] * self.procs, envs=self._worker_env(),
+            log_dir=os.path.join(self.workdir, 'logs'))
+        try:
+            rc = elastic.watch_local_trainers(
+                procs, max_restarts=self.max_restarts, poll=0.05,
+                min_preempt_uptime=0.0, on_event=on_event,
+                restart_backoff=0.2, restart_backoff_max=2.0,
+                deadline=self.deadline_s)
+        finally:
+            elastic.terminate_local_procs(procs, grace=2.0)
+            if self.engine is not None:
+                # mirror of the PR-5 reverse-order teardown fix for the
+                # collective seam class: a worker SIGKILLed mid-plan
+                # must not leave the coordinator's transport patched
+                self.engine.deactivate()
+        duration = time.time() - t0
+
+        events = load_run_events(self.workdir)
+        injected = [e for e in events
+                    if e.get('kind') == 'fault_injected']
+        restarts = max((p.restarts for p in procs), default=0)
+        violations = check_invariants(
+            os.path.join(self.workdir, 'ckpt'), events=events,
+            max_restarts=self.max_restarts, restarts=restarts,
+            preempt_codes=exit_codes['preempt'], final_rc=rc,
+            duration_s=duration, deadline_s=self.deadline_s)
+        finals = self._load_finals()
+        if rc == 0:
+            if len(finals) != self.procs:
+                violations.append(
+                    f'only {sorted(finals)} of {self.procs} ranks '
+                    'wrote a final state')
+            elif len({json.dumps(v['final_w']) for v in
+                      finals.values()}) > 1:
+                violations.append(
+                    'ranks disagree on the final state — a collective '
+                    'fault leaked into the arithmetic')
+        return {
+            'ok': not violations,
+            'violations': violations,
+            'plan': json.loads(self.plan.to_json()),
+            'procs': self.procs,
+            'steps': self.steps,
+            'rc': rc,
+            'injected': [{k: e.get(k) for k in
+                          ('fault', 'step', 'path', 'seq', 'errno',
+                           'op', 'tag', 'rank')
+                          if e.get(k) is not None} for e in injected],
+            'incarnations': {p.rank: 1 + p.restarts + p.preemptions
+                             for p in procs},
+            'failure_restarts': {p.rank: p.restarts for p in procs},
+            'preemptions': {p.rank: p.preemptions for p in procs},
+            'preempt_exit_codes': exit_codes['preempt'],
+            'watchdog_exit_codes': exit_codes['watchdog'],
+            'supervisor_events': supervisor_events,
+            'duration_s': round(duration, 2),
+            'finals': finals,
+            'workdir': self.workdir,
+            'events': len(events),
+        }
+
+    def _load_finals(self):
+        out = {}
+        for r in range(self.procs):
+            p = os.path.join(self.workdir, f'out_r{r}.json')
+            try:
+                with open(p) as f:
+                    out[r] = json.load(f)
+            except (OSError, ValueError):
+                continue
+        return out
+
+
+def load_run_events(workdir):
+    """Every telemetry event of a supervised run under `workdir`:
+    streamed JSONL plus the event rings of any flight-recorder dumps
+    (a SIGKILLed or watchdog-killed incarnation's last moments only
+    survive in its pre-kill dump).  Deduped and wall-clock ordered —
+    the input to check_invariants(events=...)."""
+    import glob
+    events = []
+    for f in sorted(glob.glob(os.path.join(
+            workdir, '**', 'telemetry-*.jsonl'), recursive=True)):
+        with open(f) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue        # torn final line of a dead worker
+                if isinstance(rec, dict) and 'kind' in rec:
+                    events.append(rec)
+    for f in sorted(glob.glob(os.path.join(
+            workdir, '**', 'flightrec-*.json'), recursive=True)):
+        try:
+            with open(f) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        rank = doc.get('rank', 0)
+        for rec in doc.get('events', []):
+            if isinstance(rec, dict) and 'kind' in rec:
+                rec = dict(rec)
+                rec.setdefault('rank', rank)
+                events.append(rec)
+    # an event both streamed and ring-dumped collapses to one, and the
+    # merged stream is replayed in wall-clock order
+    seen, out = set(), []
+    for e in events:
+        k = (e.get('ts'), e.get('t'), e.get('kind'), e.get('rank', 0))
+        if k in seen:
+            continue
+        seen.add(k)
+        out.append(e)
+    out.sort(key=lambda e: e.get('ts') or 0)
+    return out

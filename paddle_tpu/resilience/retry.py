@@ -19,14 +19,24 @@ import time
 __all__ = ['retry']
 
 
-def _default_on_retry(fn, exc, attempt, delay):
+def _default_on_retry(fn, exc, attempt, delay, clamped_from=None,
+                      deadline=None):
     """The default observer: a telemetry ``retry`` event + counter.
     Never raises — retrying is the priority, not recording it."""
     try:
         from .. import telemetry
+        extra = {}
+        if clamped_from is not None:
+            # the watchdog's collective budget shortened this loop's
+            # deadline — recorded so a post-mortem can tell "retry gave
+            # up early" from "retry exhausted its own deadline"
+            extra['deadline_s'] = round(deadline, 6)
+            extra['clamped_from_s'] = (
+                None if clamped_from == float('inf')
+                else round(clamped_from, 6))
         telemetry.event('retry', fn=getattr(fn, '__name__', repr(fn)),
                         attempt=attempt, delay_s=round(delay, 6),
-                        error=repr(exc)[:200])
+                        error=repr(exc)[:200], **extra)
         telemetry.add('retry.count')
     except Exception:       # pragma: no cover - defensive
         pass
@@ -58,6 +68,15 @@ def retry(fn=None, *, retries=3, backoff=0.1, max_backoff=30.0,
     next sleep would cross it, the last exception re-raises instead of
     sleeping — the cross-host commit barrier leans on this (a dead
     host must become a timeout, not an infinite wait).
+
+    When the call runs inside a watchdog collective budget
+    (resilience.watchdog.collective_budget), the effective deadline is
+    CLAMPED to the remaining budget — a retry loop nested inside a
+    collective deadline must not outlive it (a generous
+    `deadline=120` on a shared-fs read would otherwise keep a rank
+    alive-but-silent long past the point its peers timed out and
+    aborted).  The clamp is recorded on the emitted ``retry`` events
+    (`deadline_s` + `clamped_from_s`).
     """
     if fn is None:
         return functools.partial(
@@ -68,6 +87,17 @@ def retry(fn=None, *, retries=3, backoff=0.1, max_backoff=30.0,
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
         start = time.monotonic()
+        eff_deadline, clamped_from = deadline, None
+        try:
+            from .watchdog import remaining_budget
+            rem = remaining_budget()
+        except Exception:       # pragma: no cover - defensive
+            rem = None
+        if rem is not None and (eff_deadline is None
+                                or rem < eff_deadline):
+            clamped_from = (float('inf') if eff_deadline is None
+                            else eff_deadline)
+            eff_deadline = rem
         for attempt in range(retries + 1):
             try:
                 return fn(*args, **kwargs)
@@ -81,13 +111,15 @@ def retry(fn=None, *, retries=3, backoff=0.1, max_backoff=30.0,
                             max_backoff)
                 if jitter:
                     delay = random.uniform(0, delay) or delay * 0.5
-                if deadline is not None and \
-                        time.monotonic() - start + delay > deadline:
+                if eff_deadline is not None and \
+                        time.monotonic() - start + delay > eff_deadline:
                     raise
                 if on_retry is not None:
                     on_retry(e, attempt)
                 else:
-                    _default_on_retry(fn, e, attempt, delay)
+                    _default_on_retry(fn, e, attempt, delay,
+                                      clamped_from=clamped_from,
+                                      deadline=eff_deadline)
                 sleep(delay)
 
     return wrapper

@@ -56,7 +56,17 @@ EVENT_KINDS = (
     'restart_backoff',     # elastic supervisor delaying a crash
                            # restart (exponential backoff)
     'fault_injected',      # chaos engine injected a planned fault
-                           # (seed, fault kind, step/path)
+                           # (seed, fault kind, step/path/op/rank)
+    'timeout',             # a collective or step deadline expired
+                           # (op/step, budget_s, missing ranks) —
+                           # HostCollectives / watchdog emit these
+    'straggler',           # a step ran past its soft threshold, or a
+                           # peer's heartbeat went stale (rank/peer
+                           # attribution)
+    'quorum_lost',         # a majority of ranks stopped heartbeating;
+                           # the watchdog escalates to abort
+    'coordinated_abort',   # the cluster abort flag was raised so
+                           # peers stop waiting and restart together
     'preemption',          # SIGTERM/SIGINT latched or observed
     'nan_skip',            # non-finite step skipped on device
     'nan_rollback',        # sentinel demanded a rollback

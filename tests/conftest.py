@@ -25,6 +25,11 @@ os.environ.setdefault('PADDLE_TPU_COMPILE_CACHE', '0')
 # jax.profiler windows (block_until_ready + trace parse per close) —
 # profile-behavior tests pass profile= / monkeypatch explicitly
 os.environ.setdefault('PADDLE_TPU_PROFILE', '0')
+# ...and for the straggler/hang watchdog: an ambient
+# PADDLE_TPU_WATCHDOG would arm deadline supervision (and its
+# escalation exits!) under every trainer test — watchdog-behavior
+# tests pass watchdog= / monkeypatch explicitly
+os.environ.setdefault('PADDLE_TPU_WATCHDOG', '0')
 
 import jax  # noqa: E402
 

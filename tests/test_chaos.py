@@ -665,48 +665,13 @@ class TestCheckInvariants:
 
 
 # ------------------------------------------------- chaos_run driver --------
-# slow: each case supervises a real training subprocess (two jax
-# imports + a dozen checkpoint saves, ~30s).  Tier-1 budget is bounded
-# by the test_host_embedding abort; the same end-to-end path gates
-# every bench run via `bench.py --chaos-smoke`, and the invariant
-# checker itself is unit-tested above.
-@pytest.mark.slow
-@pytest.mark.faultinject
-class TestChaosRunDriver:
-    def test_smoke_plan_holds_invariants(self, tmp_path):
-        """The bench --chaos-smoke gate, end to end: SIGKILL at step 5
-        + torn manifest + dropped commit, supervised restart, all
-        invariants hold and the final state is exact."""
-        p = subprocess.run(
-            [sys.executable, os.path.join(_REPO, 'tools',
-                                          'chaos_run.py'),
-             '--smoke', '--json', '--dir', str(tmp_path / 'chaos')],
-            capture_output=True, text=True, timeout=300,
-            env=_env())
-        assert p.returncode == 0, p.stdout + p.stderr
-        doc = json.loads(p.stdout)
-        assert doc['ok'], doc['violations']
-        kinds = {e['fault'] for e in doc['injected']}
-        assert {'sigkill', 'torn_write', 'drop_commit'} <= kinds
-        assert doc['failure_restarts'] == 1     # the SIGKILL
-        assert doc['final']['final_step'] == 10  # --smoke step count
-
-    def test_sigterm_plan_preempts_cleanly(self, tmp_path):
-        plan = json.dumps({'seed': 1, 'name': 'preempt', 'faults': [
-            {'kind': 'sigterm', 'at_step': 4}]})
-        p = subprocess.run(
-            [sys.executable, os.path.join(_REPO, 'tools',
-                                          'chaos_run.py'),
-             '--plan', plan, '--steps', '8', '--json',
-             '--dir', str(tmp_path / 'chaos')],
-            capture_output=True, text=True, timeout=300,
-            env=_env())
-        assert p.returncode == 0, p.stdout + p.stderr
-        doc = json.loads(p.stdout)
-        assert doc['ok'], doc['violations']
-        assert doc['preemptions'] == 1
-        assert doc['failure_restarts'] == 0
-        assert doc['preempt_exit_codes'] == [PREEMPTED_EXIT_CODE]
+# The two single-process subprocess driver cases that lived here
+# (sigkill smoke-plan + sigterm preemption) FOLDED into the 2-process
+# ChaosCluster smoke: tests/test_chaos_cluster.py::TestChaosClusterE2E
+# covers both exit paths across real process boundaries, and the same
+# spin gates every bench run via `bench.py --chaos-smoke`
+# (tools/soak_run.py --smoke).  chaos_run.py itself stays supported
+# for single-process script supervision.
 
 
 def _env(extra=None):

@@ -32,7 +32,6 @@ the built-in worker at the bottom of this file for the pattern).
 Exit code 0 iff every invariant held.
 """
 import argparse
-import glob
 import json
 import os
 import sys
@@ -138,43 +137,10 @@ def worker_main(args):
 def _load_events(workdir):
     """Every telemetry event of the run: streamed JSONL plus the event
     rings of any flight-recorder dumps (a SIGKILLed incarnation's last
-    moments only survive in its pre-kill dump)."""
-    events = []
-    for f in sorted(glob.glob(os.path.join(
-            workdir, 'telemetry', 'telemetry-*.jsonl'))):
-        with open(f) as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue        # torn final line of a killed worker
-                if isinstance(rec, dict) and 'kind' in rec:
-                    events.append(rec)
-    for f in sorted(glob.glob(os.path.join(
-            workdir, '**', 'flightrec-*.json'), recursive=True)):
-        try:
-            with open(f) as fh:
-                doc = json.load(fh)
-        except (OSError, ValueError):
-            continue
-        for rec in doc.get('events', []):
-            if isinstance(rec, dict) and 'kind' in rec:
-                events.append(rec)
-    # an event both streamed and ring-dumped collapses to one, and the
-    # merged stream is replayed in wall-clock order (flight dumps
-    # arrive after the JSONL in file order but overlap it in time)
-    seen, out = set(), []
-    for e in events:
-        k = (e.get('ts'), e.get('t'), e.get('kind'), e.get('rank', 0))
-        if k in seen:
-            continue
-        seen.add(k)
-        out.append(e)
-    out.sort(key=lambda e: e.get('ts') or 0)
-    return out
+    moments only survive in its pre-kill dump).  Shared with the
+    multi-process ChaosCluster driver."""
+    from paddle_tpu.resilience.chaos import load_run_events
+    return load_run_events(workdir)
 
 
 def supervise_run(plan, workdir, steps=12, max_restarts=3,

@@ -32,9 +32,21 @@ code per class so automation can branch on the cause:
                     listed compile-cache entry is missing, torn, or
                     the cache is disabled: a restore would fall back
                     to full recompilation)
+    7 = rank-set mismatch  (``--cluster`` only: the hosts attributed
+                    in a multi-process manifest do not cover the
+                    recorded ``process_count`` — some rank's shards
+                    were never part of the commit, or the manifest's
+                    own hosts/process_count disagree; a restore on
+                    the recorded topology would be missing state)
+
+``--deep --cluster`` additionally validates each committed step's
+per-host shard set against the ``process_count`` the multi-process
+save recorded in its manifest (save_host_shard / save_sharded both
+record it).
 
 When several classes occur, missing-host wins over torn over digest
-over precompile (ordered by how actionable the triage is).
+over rank-set over precompile (ordered by how actionable the triage
+is).
 """
 import argparse
 import os
@@ -50,6 +62,7 @@ EXIT_TORN = 3
 EXIT_MISSING_HOST = 4
 EXIT_DIGEST = 5
 EXIT_PRECOMPILE = 6
+EXIT_RANK_SET = 7
 
 
 def _step_dirs(directory, prefix):
@@ -61,15 +74,23 @@ def _step_dirs(directory, prefix):
     return sorted(out)
 
 
-def deep_check(step_dir):
+def deep_check(step_dir, cluster=False):
     """Forensic classification of one step dir.
 
     Returns (classes, details): `classes` ⊆ {'torn', 'missing_host',
-    'digest'}, `details` human-readable lines.  Re-hashes every
-    manifest-recorded file (full read — this is the slow, thorough
-    mode) and cross-checks the two-phase commit records when present:
-    a host whose EVERY shard is absent (or whose ack is missing from a
-    half-committed dir) is a lost worker, not a torn file."""
+    'digest', 'rank_set'}, `details` human-readable lines.  Re-hashes
+    every manifest-recorded file (full read — this is the slow,
+    thorough mode) and cross-checks the two-phase commit records when
+    present: a host whose EVERY shard is absent (or whose ack is
+    missing from a half-committed dir) is a lost worker, not a torn
+    file.
+
+    `cluster` additionally audits the RANK SET of a multi-process
+    save: the hosts attributed across the manifest's files must cover
+    exactly ``range(process_count)`` as recorded at save time, and
+    the manifest's own ``hosts`` field must agree — a manifest that
+    certifies 2 ranks of a 4-process save restores silently
+    incomplete state on the recorded topology."""
     doc = M.read_manifest(step_dir)
     classes, details = set(), []
     if doc is None:
@@ -116,6 +137,34 @@ def deep_check(step_dir):
                 classes.add('missing_host')
                 details.append(
                     f'host {h}: no files attributed in the manifest')
+    if cluster:
+        procs = doc.get('process_count')
+        attributed = set(per_host)
+        if procs is None:
+            classes.add('rank_set')
+            details.append(
+                'manifest records no process_count — not a '
+                'multi-process save (or saved before the cluster '
+                'format); the rank set cannot be validated')
+        else:
+            expected = set(range(int(procs)))
+            if hosts is not None and int(hosts) != int(procs):
+                classes.add('rank_set')
+                details.append(
+                    f'manifest hosts={hosts} disagrees with recorded '
+                    f'process_count={procs}')
+            extra = sorted(attributed - expected)
+            absent = sorted(expected - attributed)
+            if extra:
+                classes.add('rank_set')
+                details.append(
+                    f'shards attributed to rank(s) {extra} outside '
+                    f'the recorded process_count={procs}')
+            if absent:
+                classes.add('rank_set')
+                details.append(
+                    f'rank(s) {absent} of process_count={procs} own '
+                    'no shard in the manifest')
     return classes, details
 
 
@@ -140,6 +189,11 @@ def main(argv=None):
                          'failure class: 3=torn, 4=missing host, '
                          '5=digest mismatch, 6=precompile manifest '
                          'invalid')
+    ap.add_argument('--cluster', action='store_true',
+                    help='with --deep: validate each committed step\'s '
+                         'per-host shard set against the manifest\'s '
+                         'recorded process_count (multi-process '
+                         'saves); exit 7 on a rank-set mismatch')
     ap.add_argument('--adopt', action='store_true',
                     help='write commit manifests for UNCOMMITTED step '
                          'dirs (migrates checkpoints from before '
@@ -166,7 +220,7 @@ def main(argv=None):
     deep_classes = set()
     for s, p in dirs:
         if args.deep:
-            classes, details = deep_check(p)
+            classes, details = deep_check(p, cluster=args.cluster)
             deep_classes |= classes
             ok_deep = not classes and M.read_manifest(p) is not None
             if ok_deep:
@@ -232,14 +286,16 @@ def main(argv=None):
         print(latest_ok)
     if args.deep and (deep_classes or precompile_bad):
         # precedence: a lost worker beats a torn file beats bit rot
-        # beats a cold AOT set — the operator's next action differs
-        # per class
+        # beats an inconsistent rank set beats a cold AOT set — the
+        # operator's next action differs per class
         if 'missing_host' in deep_classes:
             return EXIT_MISSING_HOST
         if 'torn' in deep_classes:
             return EXIT_TORN
         if 'digest' in deep_classes:
             return EXIT_DIGEST
+        if 'rank_set' in deep_classes:
+            return EXIT_RANK_SET
         return EXIT_PRECOMPILE
     return 0 if latest_ok >= 0 else 1
 

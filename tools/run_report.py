@@ -57,7 +57,12 @@ RESILIENCE_KINDS = (
     'checkpoint_save', 'checkpoint_commit', 'checkpoint_restore',
     'checkpoint_quarantine', 'flight_dump', 'crash',
     'commit_intent', 'commit_finalize', 'reshape_restore',
-    'retry', 'restart_backoff', 'fault_injected')
+    'retry', 'restart_backoff', 'fault_injected',
+    # watchdog / collective-layer supervision (PR 10): blown deadlines,
+    # straggler attribution, lost heartbeat quorum, cluster aborts —
+    # each row carries its rank, so a merged multi-host timeline shows
+    # WHO hung and who merely waited
+    'timeout', 'straggler', 'quorum_lost', 'coordinated_abort')
 
 # spans (kind='span', name=...) that belong on the resilience
 # timeline: the 2-phase commit barrier wait and the restore itself
@@ -438,10 +443,37 @@ def analyze(events, sources, skew=None):
         for k in ('step', 'signum', 'strikes', 'rollbacks', 'path',
                   'moved_to', 'dur_s', 'dispatch_s', 'error',
                   'fault', 'seed', 'host', 'hosts', 'attempt',
-                  'delay_s', 'mesh', 'saved_mesh'):
+                  'delay_s', 'mesh', 'saved_mesh',
+                  'op', 'tag', 'budget_s', 'elapsed_s', 'missing',
+                  'peer', 'heartbeat_age_s', 'live', 'stale',
+                  'reason', 'deadline_s', 'clamped_from_s'):
             if e.get(k) is not None:
                 row[k] = e[k]
         timeline.append(row)
+
+    # -- watchdog / collective supervision summary ----------------
+    watchdog = None
+    wd_kinds = ('timeout', 'straggler', 'quorum_lost',
+                'coordinated_abort')
+    if any(by_kind.get(k) for k in wd_kinds):
+        watchdog = {}
+        for k in wd_kinds:
+            rows = by_kind.get(k, [])
+            if not rows:
+                continue
+            per_rank = {}
+            for e in rows:
+                r = e.get('rank', 0)
+                per_rank[r] = per_rank.get(r, 0) + 1
+            watchdog[k] = {'count': len(rows), 'per_rank': per_rank}
+        faults = by_kind.get('fault_injected', [])
+        if faults:
+            per_rank = {}
+            for e in faults:
+                r = e.get('rank', 0)
+                per_rank[r] = per_rank.get(r, 0) + 1
+            watchdog['fault_injected'] = {'count': len(faults),
+                                          'per_rank': per_rank}
 
     ranks = sorted({e.get('rank', 0) for e in events})
     spans = {}
@@ -468,6 +500,7 @@ def analyze(events, sources, skew=None):
         'plan': plan,
         'profile': profile,
         'clock_skew': skew or {},
+        'watchdog': watchdog,
         'lint_findings': lint,
         'spans': spans,
         'scalars_last': scalars_last,
@@ -589,6 +622,12 @@ def render(report, stream=None):
         p('\n-- clock skew (per-host anchor offsets applied) --')
         for r, off in sorted(report['clock_skew'].items()):
             p(f'    rank {r}: {off:+.3f}s')
+    if report.get('watchdog'):
+        p('\n-- watchdog / collective supervision --')
+        for kind, row in sorted(report['watchdog'].items()):
+            ranks = ', '.join(f'r{r}:{n}' for r, n in
+                              sorted(row['per_rank'].items()))
+            p(f'    {kind}: {row["count"]} ({ranks})')
     if report['lint_findings']:
         p(f'\n-- lint findings --\n    {report["lint_findings"]}')
     if report['scalars_last']:

@@ -1,0 +1,545 @@
+#!/usr/bin/env python
+"""soak_run — property-based multi-process chaos soaks.
+
+The executable half of paddle_tpu.resilience.{chaos.ChaosCluster,
+plangen, watchdog}: generate a seeded, legal FaultPlan, spin a TRUE
+multi-process cluster (N worker interpreters + elastic supervisor +
+shared-filesystem KV collective transport), inject the plan, and gate
+on invariants I1-I7:
+
+    I1  restore() only ever yields a committed, verifiable step
+    I2  committed steps are monotonic (modulo explicit restores)
+    I3  every restore landed on a committed step
+    I4  preemptions exited PREEMPTED_EXIT_CODE (117)
+    I5  restarts stayed within the failure budget
+    I6  no step is published twice after a restart without an
+        intervening restore below it
+    I7  the cluster completes (or exits preempted) within the
+        deadline budget — it never deadlocks
+    +   every rank's final state equals the uninterrupted reference
+        (the workload is a pure function of the step index)
+
+Usage:
+
+    python tools/soak_run.py --procs 2 --seed 7 --steps 50   # one soak
+    python tools/soak_run.py ... --once                # skip the
+                                                       # same-seed
+                                                       # replay check
+    python tools/soak_run.py ... --break I6 --shrink   # deliberately
+        # break an invariant, then shrink the failing plan to a
+        # minimal reproducer and emit it as a pytest regression case
+    python tools/soak_run.py --smoke --json            # CI gate:
+        # golden plan/shrinker fixtures + one 2-process cluster spin
+
+The default run executes the SAME seed twice and asserts the injected
+fault sequences are identical per rank — the replayability contract.
+
+Worker mode (internal, spawned by ChaosCluster): ``--worker``.
+Exit code 0 iff every gate held.
+"""
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+GOLDENS = os.path.join(_REPO, 'tools', 'soak_goldens.json')
+
+# the built-in smoke plan: one hung collective (the watchdog/abort
+# path), one hard kill (crash recovery), one graceful preemption (the
+# 117 path — folds the old chaos_run driver coverage), one torn
+# manifest write (commit protocol).  Seeded; 2 processes; 12 steps.
+SMOKE_PLAN = {
+    'seed': 7,
+    'name': 'cluster-smoke',
+    'faults': [
+        {'kind': 'collective_hang', 'at_step': 4, 'rank': 1,
+         'delay_s': 30.0},
+        {'kind': 'sigkill', 'at_step': 6, 'rank': 0},
+        {'kind': 'sigterm', 'at_step': 9, 'rank': 1},
+        # count=2 tears the shard AND the 2PC intent of ONE save
+        # attempt; the replayed save after the next restart commits —
+        # torn-then-recover, with a bounded, replay-stable sequence
+        {'kind': 'torn_write', 'path': 'step_8', 'count': 2},
+    ],
+}
+
+
+def _final_w(steps, world=1):
+    """The workload's exact final state: w_i = mean over `world`
+    copies of (0.9*w_{i-1} + i), float32 throughout — pure in
+    (step index, world), so ANY fault schedule that lets the cluster
+    finish must reproduce it bit-for-bit on every rank.  The per-step
+    mean IS part of the arithmetic: np.mean accumulates f32, and the
+    sum of three identical f32 values rounds (3a needs up to 26
+    mantissa bits), so mean-of-identical-replicas is only bitwise
+    identity at power-of-two world sizes — the reference replays the
+    exact collective the workers run instead of assuming it away."""
+    import numpy as np
+    w = np.arange(8.0, dtype='float32')
+    for i in range(1, steps + 1):
+        w = (w * np.float32(0.9)
+             + np.float32(i) * np.ones(8, dtype='float32'))
+        if world > 1:
+            w = np.stack([w] * world).mean(axis=0).astype(np.float32)
+    return w
+
+
+# =============================================================================
+# worker (one rank of the ChaosCluster)
+# =============================================================================
+
+def worker_main():
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    jaxdist = (os.environ.get('PADDLE_TPU_SOAK_JAXDIST') == '1'
+               and os.environ.get('PADDLE_TPU_SOAK_COORD'))
+    if jaxdist:
+        # must precede ANY jax computation (backend init): first
+        # thing, before paddle_tpu pulls jax in.  A
+        # jax.distributed-initialized cluster (clean soaks and real
+        # pods; the coordination service cannot re-admit a SIGKILLed
+        # task, so kill-plans run without it — the FileKVStore
+        # transport carries the collectives either way.)
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=os.environ['PADDLE_TPU_SOAK_COORD'],
+            num_processes=int(os.environ.get('PADDLE_TRAINERS_NUM',
+                                             '1')),
+            process_id=int(os.environ.get('PADDLE_TRAINER_ID', '0')),
+            initialization_timeout=60)
+    import numpy as np
+    from paddle_tpu import telemetry
+    from paddle_tpu.distributed.checkpoint import (
+        save_host_shard, load_host_shard, latest_committed_step)
+    from paddle_tpu.distributed.collective import (
+        HostCollectives, CollectiveTimeout, CollectivePayloadError,
+        CoordinatedAbort)
+    from paddle_tpu.resilience import (
+        install_shutdown, shutdown_requested, PREEMPTED_EXIT_CODE,
+        CommitBarrierTimeout, WATCHDOG_EXIT_CODE)
+    from paddle_tpu.resilience.chaos import (
+        ChaosEngine, plan_from_env, load_run_events)
+    from paddle_tpu.resilience.watchdog import Budget, Watchdog
+
+    workdir = os.environ['PADDLE_TPU_CHAOS_DIR']
+    steps = int(os.environ.get('PADDLE_TPU_CHAOS_STEPS', '12'))
+    rank = int(os.environ.get('PADDLE_TRAINER_ID', '0'))
+    world = int(os.environ.get('PADDLE_TRAINERS_NUM', '1'))
+    save_every = int(os.environ.get('PADDLE_TPU_SOAK_SAVE_EVERY', '2'))
+    coll_t = float(os.environ.get(
+        'PADDLE_TPU_SOAK_COLLECTIVE_TIMEOUT', '30'))
+    barrier_t = float(os.environ.get(
+        'PADDLE_TPU_SOAK_BARRIER_TIMEOUT', '20'))
+    break_mode = os.environ.get('PADDLE_TPU_SOAK_BREAK', '')
+    incarnation = (int(os.environ.get('PADDLE_ELASTIC_RESTART_COUNT',
+                                      '0'))
+                   + int(os.environ.get('PADDLE_ELASTIC_PREEMPT_COUNT',
+                                        '0')))
+    telemetry.enable(os.path.join(workdir, 'telemetry'))
+
+    if jaxdist:
+        import jax
+        telemetry.event('run_meta', jax_distributed=True,
+                        process_count=jax.process_count())
+
+    plan = plan_from_env()
+    engine = None
+    if plan is not None:
+        mine = plan.slice_for_rank(rank)
+        if incarnation:
+            # replay the fault ledger: one-shot faults a previous
+            # incarnation already injected must not re-fire on the
+            # replayed steps (a restarted worker re-killing itself at
+            # the same step forever), while not-yet-reached faults
+            # still do
+            mine.mark_fired(load_run_events(workdir), rank=rank)
+        engine = ChaosEngine(mine, rank=rank).activate()
+
+    transport = HostCollectives(rank=rank, world=world,
+                                timeout_s=coll_t)
+    transport.clear_abort()
+    budget = Budget.from_env(os.environ.get('PADDLE_TPU_WATCHDOG'))
+    wd = None
+    if budget is not None:
+        wd = Watchdog(budget=budget, name='soak',
+                      transport=transport, flight_dir=workdir).start()
+    install_shutdown()
+
+    ckpt = os.path.join(workdir, 'ckpt')
+    w = np.arange(8.0, dtype=np.float32)
+    start = 1
+    latest = latest_committed_step(ckpt)
+    if latest >= 0:
+        shard = load_host_shard(ckpt, latest, rank)
+        if shard is not None:
+            w = shard['w'].astype(np.float32)
+            start = latest + 1
+            telemetry.event('checkpoint_restore', step=latest,
+                            host=rank)
+    if break_mode == 'I6' and incarnation and latest >= 0:
+        # the DELIBERATE bug --break I6 asks for: republish the step
+        # we just restored without rolling back below it — exactly
+        # the double-publish invariant I6 exists to catch
+        from paddle_tpu.resilience import manifest as _m
+        _m.write_manifest(os.path.join(ckpt, f'step_{latest}'),
+                          step=latest)
+        telemetry.event('checkpoint_commit', step=latest, host=rank)
+
+    def abort_exit(exc):
+        telemetry.event('coordinated_abort', rank=rank,
+                        reason=repr(exc)[:200])
+        transport.request_abort(repr(exc))
+        telemetry.dump_flight(os.path.join(
+            workdir, f'flightrec-abort-r{rank}-{os.getpid()}.json'))
+        if wd is not None:
+            wd.stop()
+        sys.exit(WATCHDOG_EXIT_CODE)
+
+    try:
+        for i in range(start, steps + 1):
+            if wd is not None:
+                wd.step_started(i, first=(i == start))
+            if engine is not None:
+                engine.step(i)      # may SIGKILL/SIGTERM/throttle us
+            if shutdown_requested():
+                # preemption beats everything else this step could do:
+                # a latched SIGTERM must exit 117 BEFORE a collective
+                # timeout (a peer already gone) can reclassify this
+                # clean preemption as a watchdog abort
+                telemetry.dump_flight(os.path.join(
+                    workdir, f'flightrec-preempt-r{rank}-{i}.json'))
+                if wd is not None:
+                    wd.stop()
+                sys.exit(PREEMPTED_EXIT_CODE)
+            w = (w * np.float32(0.9)
+                 + np.float32(i) * np.ones(8, np.float32))
+            try:
+                w = transport.allreduce(w, 'mean', tag=f'step{i}')
+            except (CollectiveTimeout, CollectivePayloadError) as e:
+                abort_exit(e)
+            except CoordinatedAbort:
+                telemetry.dump_flight(os.path.join(
+                    workdir,
+                    f'flightrec-abort-r{rank}-{os.getpid()}.json'))
+                if wd is not None:
+                    wd.stop()
+                sys.exit(WATCHDOG_EXIT_CODE)
+            if i % save_every == 0:
+                try:
+                    save_host_shard(ckpt, i, rank,
+                                    {'w': w,
+                                     'step': np.asarray(i)},
+                                    num_hosts=world,
+                                    barrier_timeout=barrier_t)
+                except CommitBarrierTimeout:
+                    # an ack never arrived (peer died mid-step): the
+                    # dir stays uncommitted and is swept later — the
+                    # run continues on the previous committed step
+                    pass
+                except OSError as e:
+                    # EIO/ENOSPC on a shard/intent write: a save is
+                    # best-effort — losing one checkpoint must not
+                    # kill training (restore falls back to the
+                    # previous committed step); the file seam's
+                    # io_error faults land here
+                    telemetry.event('checkpoint_quarantine', step=i,
+                                    host=rank, error=repr(e)[:200])
+            if wd is not None:
+                wd.step_finished(i)
+            if shutdown_requested():
+                telemetry.dump_flight(os.path.join(
+                    workdir, f'flightrec-preempt-r{rank}-{i}.json'))
+                if wd is not None:
+                    wd.stop()
+                sys.exit(PREEMPTED_EXIT_CODE)
+    finally:
+        if wd is not None:
+            wd.stop()
+    with open(os.path.join(workdir, f'out_r{rank}.json'), 'w') as f:
+        json.dump({'final_w': np.asarray(w).tolist(),
+                   'final_step': steps,
+                   'incarnation': incarnation}, f)
+    return 0
+
+
+# =============================================================================
+# drivers
+# =============================================================================
+
+def _norm_sequence(report):
+    """Per-rank injected sequences (cross-rank interleaving is
+    timing-dependent; per-rank order is the deterministic contract)."""
+    by_rank = {}
+    for e in report['injected']:
+        by_rank.setdefault(e.get('rank', 0), []).append(
+            (e.get('fault'), e.get('step'), e.get('op')))
+    return {r: v for r, v in sorted(by_rank.items())}
+
+
+def _check_finals(report, steps):
+    import numpy as np
+    ref = _final_w(steps, world=report.get('procs', 1))
+    bad = []
+    for r, doc in sorted(report.get('finals', {}).items()):
+        if not np.array_equal(
+                np.asarray(doc['final_w'], dtype=np.float32), ref):
+            bad.append(f'rank {r} final state differs from the '
+                       'uninterrupted reference')
+    return bad
+
+
+def run_soak(args, plan=None, workdir=None, extra_env=None):
+    from paddle_tpu.resilience.chaos import ChaosCluster
+    from paddle_tpu.resilience import plangen
+    if plan is None:
+        plan = plangen.generate_plan(
+            args.seed, args.steps, args.procs, n_faults=args.faults,
+            save_every=args.save_every,
+            hang_s=4 * args.collective_timeout)
+    cluster = ChaosCluster(
+        procs=args.procs, plan=plan, steps=args.steps,
+        workdir=workdir, save_every=args.save_every,
+        collective_timeout_s=args.collective_timeout,
+        barrier_timeout_s=args.barrier_timeout,
+        watchdog=args.watchdog, deadline_s=args.deadline,
+        max_restarts=args.max_restarts,
+        jax_distributed=args.jax_distributed,
+        extra_env=extra_env)
+    report = cluster.run()
+    report['violations'] += _check_finals(report, args.steps) \
+        if report['rc'] == 0 else []
+    report['ok'] = not report['violations']
+    return report, plan
+
+
+def cmd_soak(args):
+    """One (or, default, two — replay-verified) seeded soaks."""
+    report, plan = run_soak(args)
+    reports = [report]
+    if not args.once:
+        replay, _ = run_soak(args, plan=plan)
+        reports.append(replay)
+        a, b = _norm_sequence(report), _norm_sequence(replay)
+        if a != b:
+            report['violations'].append(
+                'same seed did NOT reproduce the identical injected '
+                f'sequence: {a} vs {b}')
+            report['ok'] = False
+        else:
+            report['replay_identical'] = True
+    out = dict(report)
+    out['plan_kinds'] = [f['kind'] for f in out['plan']['faults']]
+    if args.json:
+        print(json.dumps(out, indent=1, sort_keys=True, default=str))
+    else:
+        print(f'soak: seed={args.seed} procs={args.procs} '
+              f'steps={args.steps} faults={len(plan.faults)} '
+              f'({", ".join(sorted(set(out["plan_kinds"])))})')
+        for e in report['injected']:
+            print(f'  injected: {e}')
+        print(f'  incarnations={report["incarnations"]} '
+              f'rc={report["rc"]} in {report["duration_s"]}s')
+        if report.get('replay_identical'):
+            print('  replay: identical injected sequence (seed '
+                  f'{args.seed})')
+        if report['ok']:
+            print('  all invariants I1-I7 held')
+        else:
+            for v in report['violations']:
+                print(f'  VIOLATION: {v}')
+    return 0 if report['ok'] else 1
+
+
+def cmd_shrink(args):
+    """Break an invariant on purpose (or take a failing plan), shrink
+    to the minimal reproducer, emit a regression test."""
+    from paddle_tpu.resilience import plangen
+    extra = {'PADDLE_TPU_SOAK_BREAK': args.break_invariant} \
+        if args.break_invariant else None
+
+    def failing(candidate):
+        rep, _ = run_soak(args, plan=candidate, extra_env=extra)
+        return not rep['ok']
+
+    plan = plangen.generate_plan(
+        args.seed, args.steps, args.procs, n_faults=args.faults,
+        save_every=args.save_every,
+        hang_s=4 * args.collective_timeout)
+    print(f'shrink: initial plan has {len(plan.faults)} fault(s); '
+          f'oracle = invariants under '
+          f'{"--break " + args.break_invariant if args.break_invariant else "the plan"}')
+    shrunk, runs = plangen.shrink(plan, failing,
+                                  max_runs=args.max_shrink_runs,
+                                  log=lambda m: print(f'  {m}'))
+    path = args.emit_regression or os.path.join(
+        os.getcwd(), 'test_chaos_regression.py')
+    plangen.emit_regression(
+        shrunk, path, procs=args.procs, steps=args.steps,
+        violations=[f'deliberate --break {args.break_invariant}']
+        if args.break_invariant else (),
+        collective_timeout_s=args.collective_timeout,
+        deadline_s=args.deadline)
+    doc = {'initial_faults': len(plan.faults),
+           'shrunk_faults': len(shrunk.faults),
+           'shrunk_plan': json.loads(shrunk.to_json()),
+           'oracle_runs': runs,
+           'regression_test': path}
+    if args.json:
+        print(json.dumps(doc, indent=1, sort_keys=True))
+    else:
+        print(f'shrunk {len(plan.faults)} -> {len(shrunk.faults)} '
+              f'fault(s) in {runs} oracle run(s)')
+        for f in shrunk.faults:
+            print(f'  {f}')
+        print(f'regression test written to {path}')
+    return 0
+
+
+def cmd_smoke(args):
+    """The CI gate: golden plan + golden shrunk-plan fixtures (the
+    generator and the shrinker cannot drift silently), then ONE
+    2-process ChaosCluster spin of the built-in smoke plan (hung
+    collective -> watchdog abort, SIGKILL recovery, SIGTERM
+    preemption, torn manifest — folds the old chaos_run subprocess
+    driver coverage)."""
+    from paddle_tpu.resilience import plangen
+    from paddle_tpu.resilience.chaos import FaultPlan
+    failures = []
+    with open(GOLDENS) as f:
+        gold = json.load(f)
+
+    g = gold['plan_seed7']
+    plan7 = plangen.generate_plan(7, g['steps'], g['procs'],
+                                  save_every=g['save_every'],
+                                  hang_s=g['hang_s'])
+    fp = plangen.plan_fingerprint(plan7)
+    if fp != g['fingerprint']:
+        failures.append(
+            f'generate_plan(seed=7) drifted: fingerprint {fp} != '
+            f'golden {g["fingerprint"]} '
+            f'(kinds now {[f.kind for f in plan7.faults]})')
+    for kind in ('collective_hang', 'sigkill', 'torn_write'):
+        if kind not in [f.kind for f in plan7.faults]:
+            failures.append(f'seed-7 plan lost required kind {kind}')
+
+    gs = gold['shrink_demo']
+
+    def canned_oracle(candidate):
+        kinds = [f.kind for f in candidate.faults]
+        return 'sigkill' in kinds and 'torn_write' in kinds
+
+    shrunk, runs = plangen.shrink(plan7, canned_oracle)
+    sfp = plangen.plan_fingerprint(shrunk)
+    if sfp != gs['fingerprint'] or \
+            len(shrunk.faults) != gs['n_faults']:
+        failures.append(
+            f'shrinker drifted: {len(shrunk.faults)} fault(s) '
+            f'fingerprint {sfp} != golden {gs["n_faults"]}/'
+            f'{gs["fingerprint"]}')
+
+    cluster_report = None
+    if not args.no_cluster:
+        smoke_args = argparse.Namespace(
+            seed=7, procs=2, steps=12, faults=4, save_every=2,
+            collective_timeout=5.0, barrier_timeout=10.0,
+            watchdog='step=60,grace=2', deadline=180.0,
+            max_restarts=6, jax_distributed=False)
+        cluster_report, _ = run_soak(
+            smoke_args, plan=FaultPlan.from_json(
+                json.dumps(SMOKE_PLAN)))
+        if not cluster_report['ok']:
+            failures += [f'cluster smoke: {v}'
+                         for v in cluster_report['violations']]
+        injected_kinds = {e.get('fault')
+                          for e in cluster_report['injected']}
+        for kind in ('collective_hang', 'sigkill', 'sigterm',
+                     'torn_write'):
+            if kind not in injected_kinds:
+                failures.append(
+                    f'cluster smoke never injected {kind} '
+                    f'(got {sorted(injected_kinds)})')
+
+    doc = {'ok': not failures, 'failures': failures,
+           'plan_fingerprint': fp, 'shrunk_fingerprint': sfp,
+           'oracle_runs': runs}
+    if cluster_report is not None:
+        doc['cluster'] = {k: cluster_report.get(k) for k in
+                          ('ok', 'violations', 'injected',
+                           'incarnations', 'duration_s', 'rc',
+                           'watchdog_exit_codes',
+                           'preempt_exit_codes')}
+    if args.json:
+        print(json.dumps(doc, indent=1, sort_keys=True, default=str))
+    else:
+        print('soak smoke:', 'ok' if doc['ok'] else 'FAILED')
+        for msg in failures:
+            print(f'  {msg}')
+        if cluster_report is not None:
+            print(f'  cluster spin: rc={cluster_report["rc"]} '
+                  f'{len(cluster_report["injected"])} faults, '
+                  f'incarnations={cluster_report["incarnations"]}, '
+                  f'{cluster_report["duration_s"]}s')
+    return 0 if doc['ok'] else 1
+
+
+def main(argv=None):
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == '--worker':
+        sys.exit(worker_main())
+    ap = argparse.ArgumentParser(
+        prog='soak_run',
+        description='Property-based multi-process chaos soaks over '
+                    'invariants I1-I7, with failing-plan shrinking.')
+    ap.add_argument('--procs', type=int, default=2)
+    ap.add_argument('--seed', type=int, default=7)
+    ap.add_argument('--steps', type=int, default=50)
+    ap.add_argument('--faults', type=int, default=6,
+                    help='plan size for the generator (default 6)')
+    ap.add_argument('--save-every', type=int, default=2)
+    ap.add_argument('--collective-timeout', type=float, default=15.0)
+    ap.add_argument('--barrier-timeout', type=float, default=20.0)
+    ap.add_argument('--watchdog', default='step=120,grace=2',
+                    help="worker watchdog config (PADDLE_TPU_WATCHDOG "
+                         "syntax; '0' disables)")
+    ap.add_argument('--deadline', type=float, default=300.0,
+                    help='I7 wall-clock budget per soak (seconds)')
+    ap.add_argument('--max-restarts', type=int, default=6,
+                    help='per-rank failure-restart budget (invariant '
+                         'I5); abort cascades under compound plans '
+                         'cost a restart per affected rank')
+    ap.add_argument('--jax-distributed', action='store_true',
+                    help='also jax.distributed-initialize the workers '
+                         '(clean plans only: the coordination service '
+                         'cannot re-admit a killed task)')
+    ap.add_argument('--once', action='store_true',
+                    help='skip the same-seed replay verification')
+    ap.add_argument('--shrink', action='store_true',
+                    help='shrink a failing plan to a minimal '
+                         'reproducer (combine with --break)')
+    ap.add_argument('--break', dest='break_invariant', default=None,
+                    choices=['I6'],
+                    help='deliberately break an invariant in the '
+                         'worker (shrinker demo / self-test)')
+    ap.add_argument('--max-shrink-runs', type=int, default=16)
+    ap.add_argument('--emit-regression', default=None,
+                    help='path for the generated pytest reproducer')
+    ap.add_argument('--smoke', action='store_true',
+                    help='CI gate: golden fixtures + one 2-process '
+                         'cluster spin')
+    ap.add_argument('--no-cluster', action='store_true',
+                    help='with --smoke: fixtures only (no processes)')
+    ap.add_argument('--json', action='store_true')
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        return cmd_smoke(args)
+    if args.shrink or args.break_invariant:
+        return cmd_shrink(args)
+    return cmd_soak(args)
+
+
+if __name__ == '__main__':
+    sys.exit(main())
