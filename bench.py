@@ -1076,6 +1076,184 @@ def _profile_preflight(timeout_s=600):
     return ok, summary
 
 
+def _fused_smoke_child(smoke):
+    """--fused-smoke child: steps/sec-vs-K sweep (K in {1, 8, 32}) of
+    the fused train loop (core.scan_loop) on the lenet and widedeep
+    bench model classes, plus a K=1-vs-unfused bit-exactness probe.
+    K=1 runs through the SAME fused machinery (a length-1 scan), so
+    the sweep isolates exactly what fusion buys: dispatch count.
+    Emits one JSON line the parent asserts on."""
+    import time as _time
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.parallel import ParallelTrainer
+    from paddle_tpu.vision.models import LeNet
+    from paddle_tpu.models.widedeep import WideDeep
+
+    out = {'sweep': {}}
+    rs = np.random.RandomState(0)
+
+    def sweep(name, make, stack, total, reps=1):
+        res = {}
+        for K in (1, 8, 32):
+            trainer = make(K)
+            chunk = stack(K)
+            loss = trainer.step_fused(*chunk)   # compile + 1st chunk
+            jax.block_until_ready(loss)
+            n_chunks = max(2, total // K)
+            best = 0.0
+            for _ in range(reps):   # best-of: a loaded box adds
+                t0 = _time.perf_counter()   # noise, never speed
+                for _ in range(n_chunks):
+                    loss = trainer.step_fused(*chunk)
+                jax.block_until_ready(loss)
+                dt = _time.perf_counter() - t0
+                best = max(best, n_chunks * K / dt)
+            res[str(K)] = round(best, 2)
+            log(f'fused {name} K={K}: {res[str(K)]} steps/s '
+                f'(best of {reps} x {n_chunks} chunks)')
+        out['sweep'][name] = res
+        return res
+
+    # -- lenet (the gated config: small model, dispatch-bound).  The
+    # high-QPS posture is SMALL per-step work — batch 4 keeps the
+    # conv cheap enough that dispatch (what fusion removes) is a
+    # measurable share of the step on CPU, mirroring the real-chip
+    # regime where a lenet step is microseconds of MXU time.
+    batch = 4
+    x = rs.randn(batch, 1, 28, 28).astype('float32')
+    y = rs.randint(0, 10, size=(batch, 1)).astype('int64')
+
+    def make_lenet(K):
+        paddle.seed(0)
+        net = LeNet()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters())
+        ce = nn.CrossEntropyLoss()
+        return ParallelTrainer(net, opt, lambda o, t: ce(o, t),
+                               fused_steps=K)
+
+    def stack_lenet(K):
+        return (np.broadcast_to(x, (K,) + x.shape).copy(),
+                np.broadcast_to(y, (K,) + y.shape).copy())
+
+    lres = sweep('lenet', make_lenet, stack_lenet,
+                 total=128 if smoke else 256, reps=3)
+    out['lenet_uplift_k32'] = round(lres['32'] / lres['1'], 3)
+
+    # K=1 fused vs today's per-step loop.  A dense model must be
+    # BIT-exact (the scan changes nothing but dispatch count); the
+    # conv model is allclose-gated — XLA reassociates the conv grad
+    # inside a scan body, a ~1 ULP/step drift (see MIGRATION.md).
+    def make_mlp(K):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(32, 64), nn.ReLU(),
+                            nn.Linear(64, 10))
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters())
+        ce = nn.CrossEntropyLoss()
+        return ParallelTrainer(net, opt, lambda o, t: ce(o, t),
+                               fused_steps=K)
+    mx = rs.randn(batch, 32).astype('float32')
+    t_a = make_mlp(0)
+    l_a = [np.asarray(t_a.step(mx, y)) for _ in range(3)]
+    t_b = make_mlp(1)
+    l_b = [np.asarray(t_b.step_fused(mx[None], y[None]))[0]
+           for _ in range(3)]
+    out['mlp_k1_bitexact'] = bool(
+        np.array_equal(np.asarray(l_a), np.asarray(l_b)))
+    t_c = make_lenet(0)
+    c_a = [np.asarray(t_c.step(x, y)) for _ in range(3)]
+    t_d = make_lenet(1)
+    c_b = [np.asarray(t_d.step_fused(x[None], y[None]))[0]
+           for _ in range(3)]
+    out['lenet_k1_allclose'] = bool(np.allclose(
+        np.asarray(c_a), np.asarray(c_b), rtol=1e-5, atol=1e-6))
+    out['lenet_k1_max_reldiff'] = float(np.max(
+        np.abs(np.asarray(c_a) - np.asarray(c_b))
+        / np.maximum(np.abs(np.asarray(c_a)), 1e-9)))
+
+    # -- widedeep-class (recorded, not gated: bigger per-step work) --
+    fields = [100_000] * 26
+    dense_dim = 13
+    wbatch = 256
+    ids = np.stack([rs.randint(0, f, size=wbatch) for f in fields],
+                   axis=1).astype('int64')
+    dense = rs.rand(wbatch, dense_dim).astype('float32')
+    wy = rs.randint(0, 2, size=(wbatch, 1)).astype('float32')
+
+    def make_wd(K):
+        paddle.seed(0)
+        model = WideDeep(fields, dense_dim=dense_dim, embed_dim=16,
+                         hidden=(400, 400, 400))
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+        bce = nn.BCEWithLogitsLoss()
+        return ParallelTrainer(model, opt,
+                               lambda o, t: bce(o, t), n_inputs=2,
+                               fused_steps=K)
+
+    def stack_wd(K):
+        return tuple(np.broadcast_to(a, (K,) + a.shape).copy()
+                     for a in (ids, dense, wy))
+
+    wres = sweep('widedeep', make_wd, stack_wd,
+                 total=16 if smoke else 32)
+    out['widedeep_uplift_k32'] = round(wres['32'] / wres['1'], 3)
+    print(json.dumps(out))
+
+
+def _fused_preflight(smoke, timeout_s=900):
+    """--fused-smoke gate: the fused K-step loop must (1) be bit-exact
+    with the per-step loop at K=1 and (2) show a steps/sec uplift at
+    K=32 vs K=1 on the lenet config — the whole point of whole-loop
+    compilation is dispatch amortization on small models, and a
+    regression here means the scan is paying more than it saves.
+
+    Returns (ok, summary).  Infra failures (timeout, crash) never
+    block the bench — evidence beats a dead gate — but a K=1 numeric
+    drift or a missing uplift always does."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    cmd = [sys.executable, os.path.abspath(__file__),
+           '--fused-smoke-child'] + (['--smoke'] if smoke else [])
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=env)
+        doc = _last_json_dict(proc.stdout)
+    except Exception as e:
+        log(f'fused preflight skipped ({e!r})')
+        return True, {'error': repr(e)[:200]}
+    if doc is None:
+        log(f'fused preflight skipped (no child output, '
+            f'rc={proc.returncode}): {proc.stderr[-300:]}')
+        return True, {'error': f'no output (rc={proc.returncode})'}
+    failures = []
+    if not doc.get('mlp_k1_bitexact'):
+        failures.append('fused K=1 losses drifted bitwise from the '
+                        'per-step loop on the dense model')
+    if not doc.get('lenet_k1_allclose'):
+        failures.append('fused K=1 lenet losses drifted beyond conv '
+                        'reassociation tolerance (max rel diff '
+                        f'{doc.get("lenet_k1_max_reldiff")})')
+    uplift = doc.get('lenet_uplift_k32') or 0
+    if uplift <= 1.0:
+        failures.append(f'no steps/sec uplift at K=32 vs K=1 on '
+                        f'lenet (x{uplift})')
+    summary = dict(doc, failures=failures)
+    ok = not failures
+    log(f'fused preflight: {"ok" if ok else "FAIL"} '
+        f'(lenet x{doc.get("lenet_uplift_k32")}, '
+        f'widedeep x{doc.get("widedeep_uplift_k32")}, '
+        f'k1_bitexact={doc.get("mlp_k1_bitexact")}, '
+        f'lenet_allclose={doc.get("lenet_k1_allclose")})')
+    for f in failures:
+        log(f'  {f}')
+    return ok, summary
+
+
 def _lint_preflight(timeout_s=300, smoke=False):
     """tpu_lint gate before burning chip time: a HIGH-severity finding
     in examples/ or paddle_tpu/models/ means some bench config would
@@ -1191,6 +1369,15 @@ def main():
     p.add_argument('--profile-smoke-child', action='store_true',
                    help='(internal) run the profile-smoke captures '
                         'and emit their JSON')
+    p.add_argument('--fused-smoke', action='store_true',
+                   help='steps/sec-vs-K sweep (K in {1,8,32}) of the '
+                        'fused train loop on the lenet/widedeep '
+                        'configs: K=32 must beat K=1 on lenet and '
+                        'K=1 must stay bit-exact — gates whole-loop '
+                        'compilation (core.scan_loop)')
+    p.add_argument('--fused-smoke-child', action='store_true',
+                   help='(internal) run the fused K-sweep and emit '
+                        'its JSON')
     p.add_argument('--telemetry-dir', default=None,
                    help='(internal) telemetry JSONL dir for '
                         '--cache-smoke-child / --profile-smoke-child')
@@ -1209,6 +1396,10 @@ def main():
                              or tempfile.mkdtemp(prefix='prof_tel_'))
         return
 
+    if args.fused_smoke_child:
+        _fused_smoke_child(args.smoke)
+        return
+
     if args.single_json:
         if args.config == 'all':
             p.error('--single-json needs an explicit --config NAME')
@@ -1223,6 +1414,22 @@ def main():
     plan_summary = None
     cache_summary = None
     profile_summary = None
+    fused_summary = None
+    if args.fused_smoke:
+        fused_ok, fused_summary = _fused_preflight(args.smoke)
+        if not fused_ok:
+            # a K=1 drift or a missing uplift means the fused loop is
+            # either wrong or pointless — fail before burning chip
+            # time, with the sweep as the artifact
+            print(json.dumps({
+                'metric': METRIC_NAMES['resnet'], 'value': None,
+                'unit': UNITS['resnet'], 'vs_baseline': None,
+                'error': 'fused preflight failed (K=1 numeric drift '
+                         'or no steps/sec uplift at K=32); fix '
+                         'core.scan_loop or re-run without '
+                         '--fused-smoke',
+                'fused': fused_summary, 'extras': {}}))
+            sys.exit(1)
     if args.profile_smoke:
         profile_ok, profile_summary = _profile_preflight()
         if not profile_ok:
@@ -1380,6 +1587,8 @@ def main():
         out['compile_cache'] = cache_summary
     if profile_summary is not None:
         out['profile'] = profile_summary
+    if fused_summary is not None:
+        out['fused'] = fused_summary
     # the headline config is excluded from extras, so its stale
     # provenance (if any) rides at the top level
     for k in ('stale_value', 'stale_vs_baseline', 'stale_from',

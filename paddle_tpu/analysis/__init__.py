@@ -119,7 +119,7 @@ def _leaf_ranges(example_args):
 
 def lint(fn, *example_args, mesh=None, donate_argnums=(), disable=(),
          signatures=None, thresholds=None, name=None, source=True,
-         **example_kwargs):
+         fused_steps=None, **example_kwargs):
     """Trace `fn` abstractly and run every registered jaxpr rule.
 
     example_args: concrete arrays / pytrees / jax.ShapeDtypeStruct
@@ -164,7 +164,7 @@ def lint(fn, *example_args, mesh=None, donate_argnums=(), disable=(),
             closed, mesh=mesh, donate_argnums=donate_argnums,
             arg_leaf_ranges=_leaf_ranges(traced_args),
             python_scalars=python_scalars, signatures=signatures,
-            thresholds=thresholds, name=name)
+            thresholds=thresholds, name=name, fused_steps=fused_steps)
         findings.extend(run_rules(ctx, disable=disable))
     if source:
         findings.extend(lint_callable(fn, disable=disable))

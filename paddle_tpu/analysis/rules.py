@@ -28,6 +28,11 @@ donation-violation donated buffers with no same-shape/dtype output to
 constant-capture   large arrays baked into the jaxpr as consts —
                    recompiled per value and replicated into the
                    module instead of fed as arguments.
+chunk-break        host callbacks/syncs inside a step audited in its
+                   FUSED posture (fused_steps=K, core.scan_loop):
+                   each K-chunk would round-trip to the host K times
+                   from inside one dispatch.  Silent unless the lint
+                   caller declares fused intent.
 """
 import numpy as np
 
@@ -57,7 +62,8 @@ class RuleContext:
 
     def __init__(self, closed, *, mesh=None, donate_argnums=(),
                  arg_leaf_ranges=None, python_scalars=None,
-                 signatures=None, thresholds=None, name=None):
+                 signatures=None, thresholds=None, name=None,
+                 fused_steps=None):
         self.closed = closed                  # ClosedJaxpr
         self.jaxpr = closed.jaxpr
         self.consts = closed.consts
@@ -72,6 +78,9 @@ class RuleContext:
         self.thresholds = dict(DEFAULT_THRESHOLDS)
         self.thresholds.update(thresholds or {})
         self.name = name
+        # chunk length when the step is audited in its fused posture
+        # (core.scan_loop); None/0 keeps the chunk-break rule silent
+        self.fused_steps = fused_steps
 
     def walk(self):
         return walker.walk(self.jaxpr)
@@ -210,6 +219,40 @@ def host_sync(ctx):
                    'boundaries or express it in jnp.')
         yield Finding('host-sync', sev, msg, file=f, line=l,
                       origin='jaxpr')
+
+
+# -- chunk-break --------------------------------------------------------------
+
+_CHUNK_BREAKERS = {'pure_callback': HIGH, 'io_callback': HIGH,
+                   'debug_callback': WARN, 'infeed': WARN,
+                   'outfeed': WARN}
+
+
+@register_rule('chunk-break', WARN)
+def chunk_break(ctx):
+    """Host round-trips inside a step audited in its FUSED posture
+    (``fused_steps=K``, core.scan_loop).  A per-step host callback is
+    merely slow; inside a K-step ``lax.scan`` it fires K times per
+    dispatch and serializes the whole chunk on the host — the fusion
+    win evaporates and the watchdog's chunk budget starts timing host
+    code.  Silent unless the lint caller declared fused intent."""
+    k = getattr(ctx, 'fused_steps', None)
+    if not k:
+        return
+    for _, eqn in ctx.walk():
+        sev = _CHUNK_BREAKERS.get(eqn.primitive.name)
+        if sev is None:
+            continue
+        f, l = _loc(eqn)
+        yield Finding(
+            'chunk-break', sev,
+            f'{eqn.primitive.name} inside a step fused at '
+            f'fused_steps={k}: each K-chunk would round-trip to the '
+            f'host {k} times from inside one XLA dispatch, '
+            'serializing the scan. Move the host work to chunk '
+            'boundaries, express it in jnp, or run this step '
+            'unfused (fused_steps=0).',
+            file=f, line=l, origin='jaxpr')
 
 
 # -- replicated-giant ---------------------------------------------------------

@@ -78,6 +78,7 @@ class Model:
         self._check_finite_steps = True
         # compiled-step caches, keyed by (shapes, dtypes, lr-if-constant)
         self._train_step_cache = {}
+        self._train_chunk_cache = {}    # fused K-step modules
         self._eval_step_cache = {}
         self._pred_step_cache = {}
         # functional state lives here between steps (device pytrees)
@@ -105,6 +106,7 @@ class Model:
         # a new optimizer/loss invalidates compiled steps (their traces
         # closed over the old ones) and the functional state
         self._train_step_cache.clear()
+        self._train_chunk_cache.clear()
         self._eval_step_cache.clear()
         self._pred_step_cache.clear()
         self._invalidate()
@@ -303,13 +305,17 @@ class Model:
 
         return jax.jit(step_fn)
 
-    def _split_batch(self, batch):
-        batch = [_to_jnp(b) for b in _as_list(batch)]
+    def _split_arity(self, n_fields):
+        """How many leading fields of an n_fields batch feed forward
+        (the rest are labels) — shape logic only, no conversion."""
         n_lab = len(self._labels) if self._labels else \
             (1 if self._loss is not None else 0)
-        n_lab = min(n_lab, max(0, len(batch) - 1))
-        n_in = len(batch) - n_lab
-        return batch, n_in
+        n_lab = min(n_lab, max(0, n_fields - 1))
+        return n_fields - n_lab
+
+    def _split_batch(self, batch):
+        batch = [_to_jnp(b) for b in _as_list(batch)]
+        return batch, self._split_arity(len(batch))
 
     # -- public batch APIs ---------------------------------------------------
     def train_batch(self, inputs, labels=None):
@@ -408,7 +414,121 @@ class Model:
                        for m, r in zip(self._metrics, mres)]
         return loss, metric_logs
 
-    def _lint_train_step(self, n_in, st, arrays):
+    # -- fused K-step chunks (core.scan_loop) --------------------------------
+    def train_chunk(self, stacked, n_in=None, k=None):
+        """K compiled optimizer steps in ONE dispatch (whole-loop
+        compilation, core.scan_loop): `stacked` is the chunk's batch —
+        each array carries a leading K dim — and the call returns
+        ``(losses, oks)`` as K-length DEVICE arrays.  The rng stream,
+        skip contract and update math are bit-exact with K calls of
+        :meth:`train_batch` (pinned by tests/test_fused_loop.py);
+        what changes is cadence: ONE host round-trip per chunk, and
+        under the default exact-skip posture ONE host sync per chunk
+        (the finite-mask readback, ``scan_loop.chunk_sync``)."""
+        assert self._optimizer is not None and self._loss is not None, \
+            'call prepare(optimizer, loss) before train_chunk'
+        import time as _time
+        from ..core import scan_loop as _scan
+        stacked = tuple(_to_jnp(v) for v in stacked)
+        k = int(k if k is not None else stacked[0].shape[0])
+        if n_in is None:
+            _, n_in = self._split_batch([v[0] for v in stacked])
+        st = self._get_fstate()
+        key = self._batch_key(stacked, ('train-fused', n_in, k))
+        first_call = key not in self._train_chunk_cache
+        if first_call:
+            if self._lint:
+                self._lint_train_step(
+                    n_in, st, [v[0] for v in stacked], fused=k)
+            fused_fn = _scan.fused_hapi_step(
+                self._build_train_step(n_in), k)
+            jitted = jax.jit(fused_fn, donate_argnums=(0, 1, 2))
+            from ..core import compile_cache as _cc
+            if _cc.enabled():
+                # the fused module rides the same persistent cache as
+                # the per-step one; K folds into the fingerprint so
+                # the two can never collide
+                example = (st['params'], st['buffers'], st['opt'],
+                           jax.random.PRNGKey(0),
+                           jnp.zeros((), jnp.int32),
+                           jnp.zeros((), jnp.float32), *stacked)
+                fp = _cc.jaxpr_fingerprint(
+                    'hapi-train-fused', fused_fn, example,
+                    extra=('donate', (0, 1, 2), 'fused', k))
+                jitted = _cc.through_cache(jitted, example, fp=fp,
+                                           name='Model.train_chunk')
+            self._train_chunk_cache[key] = jitted
+            from ..analysis import note_retrace
+            note_retrace('Model.train_chunk',
+                         len(self._train_chunk_cache), instance=self)
+        fn = self._train_chunk_cache[key]
+        from ..core import rng as rng_mod
+        seed = rng_mod.get_seed()
+        if getattr(self, '_base_key_seed', None) != seed:
+            self._base_key = jax.random.PRNGKey(seed)
+            self._base_key_seed = seed
+        if first_call:
+            _ct0 = _time.perf_counter()
+        new_params, new_buf, new_opt, new_step, losses, oks, mres = fn(
+            st['params'], st['buffers'], st['opt'], self._base_key,
+            jnp.asarray(st['step'], jnp.int32),
+            jnp.asarray(self._optimizer.get_lr(), jnp.float32),
+            *stacked)
+        if first_call:
+            from .. import telemetry
+            _dt = _time.perf_counter() - _ct0
+            telemetry.event('compile', name='Model.train_chunk',
+                            dur_s=round(_dt, 6), fused_steps=k,
+                            variants=len(self._train_chunk_cache))
+            telemetry.add('compile.count')
+            telemetry.add('compile.total_s', _dt)
+        if self._check_finite_steps:
+            # exact-skip contract at chunk cadence: ONE sanctioned
+            # host sync materializes the K-step finite mask; skipped
+            # steps advanced neither the counter nor (on device) the
+            # state.  NanGuard reads _last_step_ok once per chunk, so
+            # the chunk reduces CONSERVATIVELY: any poisoned step
+            # marks the whole chunk not-ok — a mostly-NaN chunk whose
+            # last step happens finite must still count a strike
+            # (strike granularity becomes per-chunk; see MIGRATION)
+            mask = _scan.chunk_sync(oks)
+            n_ok = int(mask.sum())
+            self._last_step_ok = bool(mask.all())
+            st.update(params=new_params, buffers=new_buf, opt=new_opt,
+                      step=st['step'] + n_ok)
+            self._optimizer._global_step = st['step']
+        else:
+            # sync-free path: zero host reads per chunk — the device
+            # step counter is adopted lazily and the mask stays a
+            # device array for whoever chooses to pay the sync
+            self._last_step_ok = oks[-1]
+            st.update(params=new_params, buffers=new_buf, opt=new_opt,
+                      step=new_step)
+            self._optimizer._global_step = st['step']
+        self._chunk_metric_update(mres)
+        return losses, oks
+
+    @staticmethod
+    def _merge_chunk_dim(v):
+        """(K, N, ...) stacked metric stats -> (K*N, ...): metric
+        update() accumulates sums/counts, so feeding the chunk-merged
+        stats once equals feeding K per-step stats (skipped steps were
+        already masked to zero on device)."""
+        if getattr(v, 'ndim', 0) >= 2:
+            return v.reshape((-1,) + tuple(v.shape[2:]))
+        return v
+
+    def _chunk_metric_update(self, mres):
+        logs = []
+        for m, r in zip(self._metrics, mres):
+            if isinstance(r, (tuple, list)):
+                logs.append(m.update(*[self._merge_chunk_dim(x)
+                                       for x in r]))
+            else:
+                logs.append(m.update(self._merge_chunk_dim(r)))
+        return logs
+
+    def _lint_train_step(self, n_in, st, arrays, fused=None):
         """prepare(lint=...): audit the exact step about to compile
         (jaxpr rules, donation included) + the forward's source —
         via safe_emit, so only LintError (the 'error'-mode verdict)
@@ -431,7 +551,7 @@ class Model:
             report = analysis.lint(
                 step_fn, *args, *arrays,
                 donate_argnums=(0, 1, 2), source=False,
-                name='Model.train_step')
+                fused_steps=fused, name='Model.train_step')
             mesh = _env.get_mesh()
             if mesh is not None:
                 analysis.escalate_hlo(
@@ -519,7 +639,7 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
-            callbacks=None, profile=None):
+            callbacks=None, profile=None, fused_steps=None):
         """``profile=`` enables sampled on-device trace capture over
         the train loop (telemetry.profile): None → the
         ``PADDLE_TPU_PROFILE`` env decides (default off), False forces
@@ -528,7 +648,17 @@ class Model:
         (``save_dir`` when given); each closed window emits a
         ``profile_capture`` event and the device-compute vs
         collective-time breakdown gauges.  Steps outside a window pay
-        one integer compare — the sync-free loop contract holds."""
+        one integer compare — the sync-free loop contract holds.
+
+        ``fused_steps=K`` compiles K train steps into ONE XLA module
+        (core.scan_loop): batches are staged in K-step chunks
+        (double-buffered device prefetch when ``num_workers>0``),
+        losses/metrics accumulate on device inside the scan, and
+        callbacks / logging / the preemption check run at chunk
+        boundaries — dispatch overhead drops ~K-fold on small models.
+        None defers to the ``PADDLE_TPU_FUSED_STEPS`` env (default
+        off); K=1 is bit-exact with the per-step loop.  A short final
+        chunk falls back to the per-step path."""
         assert self._optimizer is not None and self._loss is not None, \
             'call prepare(optimizer, loss) before fit'
         train_loader = self._to_loader(train_data, batch_size, shuffle,
@@ -559,7 +689,8 @@ class Model:
                 self._fit_loop(cbks, train_loader, eval_loader, epochs,
                                eval_freq, batch_size, num_workers,
                                log_freq=log_freq, profile=profile,
-                               save_dir=save_dir)
+                               save_dir=save_dir,
+                               fused_steps=fused_steps)
         finally:
             requested = _sd.shutdown_requested()
             sig = _sd.preemption_signal()
@@ -599,7 +730,7 @@ class Model:
 
     def _fit_loop(self, cbks, train_loader, eval_loader, epochs,
                   eval_freq, batch_size, num_workers, log_freq=10,
-                  profile=None, save_dir=None):
+                  profile=None, save_dir=None, fused_steps=None):
         from .. import telemetry as _tel
         # sync-free telemetry: device loss scalars + host step/wait
         # times buffer in the accumulator and flush every
@@ -621,11 +752,19 @@ class Model:
             f = getattr(cb, 'log_freq', None)
             if isinstance(f, int) and f > 0:
                 log_freqs.add(f)
+        from ..core import scan_loop as _scan
+        k = _scan.resolve_fused_steps(fused_steps)
         cbks.on_train_begin({})
         try:
-            self._fit_epochs(cbks, train_loader, eval_loader, epochs,
-                             eval_freq, batch_size, num_workers,
-                             log_freqs, acc, prof)
+            if k:
+                self._fit_epochs_fused(
+                    cbks, train_loader, eval_loader, epochs,
+                    eval_freq, batch_size, num_workers, log_freqs,
+                    acc, prof, k)
+            else:
+                self._fit_epochs(cbks, train_loader, eval_loader,
+                                 epochs, eval_freq, batch_size,
+                                 num_workers, log_freqs, acc, prof)
         finally:
             if prof is not None:
                 # ALWAYS finalize — an exception mid-epoch must not
@@ -692,6 +831,123 @@ class Model:
                 # preemption/early-stop: every second of the grace
                 # window belongs to the final checkpoint, not to an
                 # eval pass
+                break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(
+                    eval_loader, batch_size=batch_size, verbose=0,
+                    num_workers=num_workers, _callbacks=cbks)
+                cbks.on_eval_end(eval_logs)
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs)
+        self._sync_back()
+
+    def _fit_epochs_fused(self, cbks, train_loader, eval_loader,
+                          epochs, eval_freq, batch_size, num_workers,
+                          log_freqs, acc, prof, k):
+        """The K-step fused epoch loop (core.scan_loop): batches are
+        staged in K-chunks — stacked + device-put on a background
+        thread when the loader has workers, so chunk N+1's transfer
+        overlaps chunk N's execution — and each chunk is ONE compiled
+        dispatch.  Callbacks, logging and the preemption check run at
+        chunk boundaries; a short final chunk takes the per-step
+        path.  Losses stay device arrays throughout (the
+        accumulator's chunk rows expand to per-step stats at flush)."""
+        import time as _time
+        from ..core import scan_loop as _scan
+        from .. import telemetry as _tel
+        _perf = _time.perf_counter
+        gstep = 0
+        self._last_fit_loss = None
+
+        def stage(batches):
+            # keep leaves RAW (numpy stays host, Tensors unwrap to
+            # their device values): stack_batches then pays one
+            # transfer per host field and zero readbacks for device
+            # fields — no _to_jnp round-trip before stacking
+            rows = [[v.value if isinstance(v, Tensor) else v
+                     for v in _as_list(b)] for b in batches]
+            return (_scan.stack_batches(rows),
+                    self._split_arity(len(rows[0])))
+
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch, {})
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            step = -1
+            # overlap decision follows the LOADER's own workers (a
+            # pre-built DataLoader(num_workers=4) must get background
+            # staging even when fit's num_workers default is 0)
+            loader_workers = getattr(train_loader, 'num_workers',
+                                     None)
+            if loader_workers is None:
+                loader_workers = num_workers
+            pref = _scan.ChunkPrefetcher(
+                iter(train_loader), k, stage,
+                background=loader_workers > 0)
+            for staged, n, wait_s in pref:
+                if n == k:
+                    (stacked, n_in) = staged
+                    cbks.on_train_batch_begin(step + 1, {})
+                    _ts0 = _perf()
+                    losses, _oks = self.train_chunk(stacked, n_in, k)
+                    dt = _perf() - _ts0
+                    loss = losses[-1]
+                    self._last_fit_loss = loss
+                    if acc is not None:
+                        acc.observe_chunk(step + 1, n, step_time_s=dt,
+                                          wait_s=wait_s, loss=losses)
+                    _tel.set_gauge('fused.host_wait_ms',
+                                   round(wait_s * 1000.0, 4))
+                    if prof is not None:
+                        prof.observe(gstep, sync=loss, span=n)
+                    gstep += n
+                    step += n
+                    logs = {'loss': loss}
+                    if any((step + 1 - j) % f == 0
+                           for f in log_freqs for j in range(n)):
+                        for m in self._metrics:
+                            logs[str(m.name())] = m.accumulate()
+                    cbks.on_train_batch_end(step, logs)
+                else:
+                    # ragged tail: run the < K remaining batches
+                    # through the per-step module instead of paying a
+                    # one-off K'-length compile
+                    for batch in staged:
+                        step += 1
+                        cbks.on_train_batch_begin(step, {})
+                        arrays, n_in = self._split_batch(batch)
+                        _ts0 = _perf()
+                        loss, _ = self.train_batch(arrays[:n_in],
+                                                   arrays[n_in:])
+                        self._last_fit_loss = loss
+                        if acc is not None:
+                            acc.observe(step=step,
+                                        step_time_s=_perf() - _ts0,
+                                        loss=loss)
+                        if prof is not None:
+                            prof.observe(gstep, sync=loss)
+                        gstep += 1
+                        logs = {'loss': loss}
+                        if any((step + 1) % f == 0 for f in log_freqs):
+                            for m in self._metrics:
+                                logs[str(m.name())] = m.accumulate()
+                        cbks.on_train_batch_end(step, logs)
+                if _shutdown_requested():
+                    # preemption lands at the chunk boundary we are on:
+                    # fused granularity is K steps, and the state here
+                    # IS a chunk boundary — the final checkpoint in
+                    # on_train_end restores to exactly this step
+                    self.stop_training = True
+                if self.stop_training:
+                    break
+            if acc is not None:
+                acc.flush()
+            for m in self._metrics:
+                logs[str(m.name())] = m.accumulate()
+            cbks.on_epoch_end(epoch, logs)
+            if self.stop_training:
                 break
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(

@@ -379,7 +379,7 @@ class DataLoader:
                  use_shared_memory=False, timeout=0, worker_init_fn=None,
                  persistent_workers=False, to_tensor=True,
                  use_native_loader=True, use_process_workers=False,
-                 mp_context=None):
+                 mp_context=None, device_prefetch=False):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
@@ -403,6 +403,22 @@ class DataLoader:
                 'num_workers=0 or an IterableDataset — loading runs '
                 'in the main process; set num_workers>0 on a '
                 'map-style dataset to fork workers')
+        # device_prefetch: double-buffered host->device staging — a
+        # background thread jax.device_put's the NEXT batch while the
+        # train loop executes the current one, so the H2D copy
+        # overlaps compute (the fused K-step loop stages whole chunks
+        # the same way — core.scan_loop.ChunkPrefetcher).  Off for
+        # num_workers=0: there is no producer thread to overlap with,
+        # and the extra queue hop would only add latency.
+        self.device_prefetch = bool(device_prefetch)
+        if self.device_prefetch and self.num_workers == 0:
+            import warnings
+            warnings.warn(
+                'device_prefetch=True has no effect with '
+                'num_workers=0 — batches are produced on the consumer '
+                'thread, so there is nothing to overlap; set '
+                'num_workers>0 to enable background device staging')
+            self.device_prefetch = False
         # native ring serializes batches: arrays travel zero-pickle, but
         # exotic batch objects must be picklable — set False to keep the
         # in-process threaded path for those
@@ -769,6 +785,85 @@ class DataLoader:
             for p in procs:
                 p.join(timeout=2)
 
+    @staticmethod
+    def _device_put_batch(item):
+        """Stage one (possibly wrapped) batch onto device: numpy
+        leaves become committed device arrays; Tensors re-wrap their
+        transferred value; non-array leaves pass through."""
+        import jax
+
+        def dev(x):
+            if isinstance(x, Tensor):
+                return Tensor._from_value(jax.device_put(x.value))
+            if isinstance(x, np.ndarray) and x.dtype != object and \
+                    x.dtype.kind in 'biufc':
+                return jax.device_put(x)
+            return x
+        if isinstance(item, dict):
+            return {k: dev(v) for k, v in item.items()}
+        if isinstance(item, (tuple, list)):
+            return [dev(v) for v in item]
+        return dev(item)
+
+    def _iter_device_prefetch(self, inner):
+        """Double-buffered device staging: a daemon thread pulls from
+        the worker pipeline, ``jax.device_put``s each batch, and parks
+        up to two staged batches in a bounded queue.  The dequeue wait
+        is the OVERLAP gauge: ~0 ms means the transfer fully hid
+        behind compute; a persistent positive value means the loader
+        (or the H2D link) is the bottleneck."""
+        from .. import telemetry
+        out_q = queue.Queue(maxsize=2)
+        err = []
+        closed = []             # consumer-gone flag (one-slot list)
+        _SENTINEL = _EndOfEpoch
+
+        def put(item):
+            while not closed:
+                try:
+                    out_q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for item in inner:
+                    if not put(self._device_put_batch(item)):
+                        return
+            except BaseException as e:
+                err.append(e)
+            finally:
+                put(_SENTINEL)
+
+        threading.Thread(target=producer, daemon=True).start()
+        _perf = time.perf_counter
+        try:
+            while True:
+                t0 = _perf()
+                item = out_q.get()
+                dt = _perf() - t0
+                telemetry.add('io.device_prefetch.wait_s', dt)
+                telemetry.set_gauge('io.device_prefetch.last_wait_ms',
+                                    round(dt * 1000.0, 4))
+                if item is _SENTINEL:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            # an abandoned iterator (early stop, preemption, raised
+            # callback) must release the producer parked on the full
+            # queue — otherwise each broken-off epoch leaks a thread
+            # plus two device-staged batches for the process lifetime
+            closed.append(True)
+            try:
+                while True:
+                    out_q.get_nowait()
+            except queue.Empty:
+                pass
+
     def _telemetry_iter(self, inner):
         """Time each dequeue — the HOST-WAIT gauge: how long the
         training loop blocked on this loader per batch (for the
@@ -803,6 +898,9 @@ class DataLoader:
                     it = self._iter_threaded()
         else:
             it = self._iter_sync()
+        if self.device_prefetch and self.num_workers > 0 \
+                and not self._iterable and self.batch_sampler is not None:
+            it = self._iter_device_prefetch(it)
         from ..telemetry import active as _telemetry_active
         if _telemetry_active():
             return self._telemetry_iter(it)

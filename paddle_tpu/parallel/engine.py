@@ -60,7 +60,7 @@ class ParallelTrainer:
                  donate=True, n_inputs=1, nan_guard=False, nan_patience=3,
                  nan_max_rollbacks=2, lint=None, auto_shard=False,
                  hbm_budget_gb=None, calibration=None, profile=None,
-                 watchdog=None):
+                 watchdog=None, fused_steps=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -107,6 +107,14 @@ class ParallelTrainer:
         self.watchdog = watchdog
         self._watchdog = None
         self._watchdog_init = False
+        # fused_steps: whole-loop compilation (core.scan_loop) — K
+        # steps per compiled dispatch via step_fused().  None → the
+        # PADDLE_TPU_FUSED_STEPS env decides (default OFF); K clamps
+        # adaptively against the watchdog step budget when a plan's
+        # cost-model estimate exists (fused_chunk_len()).
+        from ..core import scan_loop as _scan
+        self.fused_steps = _scan.resolve_fused_steps(fused_steps)
+        self._fused_cache = {}
         self._step_no = 0
         self._compiled = None
         self._eval_compiled = None
@@ -770,6 +778,224 @@ class ParallelTrainer:
         # LR-scheduler advancement is the caller's job (hapi epoch loop)
         return loss
 
+    # -- fused K-step chunks (core.scan_loop) --------------------------------
+    def fused_chunk_len(self, k=None):
+        """The chunk length callers should stage for
+        :meth:`step_fused`: ``fused_steps`` clamped adaptively against
+        the armed watchdog step budget (scan_loop.clamp_chunk) using
+        the auto-shard plan's cost-model step estimate when one
+        exists — a fused chunk must stay detectable within the
+        deadline the operator armed.  Without a budget or an estimate
+        K passes through unchanged."""
+        from ..core import scan_loop as _scan
+        k = self.fused_steps if k is None else int(k)
+        wd = self._ensure_watchdog()
+        budget = wd.budget if wd is not None else None
+        est = None
+        if self.plan is not None:
+            est_us = ((getattr(self.plan, 'est_us', 0) or 0)
+                      + (getattr(self.plan, 'compute_us', 0) or 0))
+            if est_us > 0:
+                est = est_us * 1e-6
+        return _scan.clamp_chunk(k, budget, est)
+
+    def _build_fused_step(self, k):
+        """jit the K-step scan over the SAME raw step _build_step
+        hands jax.jit, with the stacked-batch shardings (leading K dim
+        unsharded, dp on dim 1) and the same donation posture."""
+        from ..core import scan_loop as _scan
+        self._build_step()      # latches _raw_step (+ shardings math)
+        fused = _scan.fused_trainer_step(self._raw_step, k,
+                                         nan_guard=self.nan_guard)
+        kwargs = {}
+        if self.mesh is not None:
+            base = self._jit_kwargs
+            p_sh, b_sh, s_sh, repl = base['in_shardings'][:4]
+            batch_sh = base['in_shardings'][5:]
+
+            def stack_sh(sh):
+                return NamedSharding(self.mesh, P(None, *sh.spec))
+
+            kwargs['in_shardings'] = (
+                (p_sh, b_sh, s_sh, repl, repl)
+                + tuple(stack_sh(s) for s in batch_sh))
+            kwargs['out_shardings'] = (p_sh, b_sh, s_sh, repl, repl) \
+                + ((repl,) if self.nan_guard else ())
+        if self.donate:
+            kwargs['donate_argnums'] = (0, 2)
+        self._fused_jit_kwargs = kwargs
+        return jax.jit(fused, **kwargs)
+
+    def _fused_example_args(self, k, vals):
+        return (self.params, self.buffers, self.opt_state,
+                jnp.zeros((), jnp.int32),
+                jnp.zeros((k, 2), jnp.uint32)) + tuple(
+                    jax.ShapeDtypeStruct(v.shape, v.dtype)
+                    for v in vals)
+
+    def step_fused(self, *batch):
+        """K optimizer steps in ONE compiled dispatch (whole-loop
+        compilation, core.scan_loop): every array in `batch` carries a
+        leading K dim (stage with ``scan_loop.stack_batches``, sized
+        by :meth:`fused_chunk_len`).  Returns the K per-step losses as
+        one DEVICE array — zero host syncs per chunk on the default
+        path, exactly one (the finite-mask readback) under
+        ``nan_guard``.  The per-step rng stream, step counter and
+        update math are bit-exact with K calls of :meth:`step`;
+        checkpoint/restore granularity becomes K steps (chunks end at
+        step boundaries, so ``save_checkpoint`` between chunks commits
+        exact step ids)."""
+        if self._pipeline:
+            raise NotImplementedError(
+                'fused_steps under pipeline parallelism: the 1F1B '
+                'schedule is already a fused multi-microbatch module')
+        import time as _time
+        import warnings
+        from .. import telemetry as _tel
+        from ..core import scan_loop as _scan
+        vals = tuple(b.value if isinstance(b, Tensor) else jnp.asarray(b)
+                     for b in batch)
+        k = int(vals[0].shape[0])
+        ck = (k,) + tuple((tuple(v.shape), str(v.dtype)) for v in vals)
+        first_call = ck not in self._fused_cache
+        if first_call:
+            if self.auto_shard and not self._auto_planned:
+                self._auto_plan(tuple(v[0] for v in vals))
+            self._n_batch = len(vals)
+            fit = self.fused_chunk_len(k)
+            if fit < k:
+                warnings.warn(
+                    f'fused chunk of {k} steps exceeds the watchdog '
+                    f'step budget (fits {fit}); stage '
+                    'fused_chunk_len() chunks so hang detection stays '
+                    'inside the armed deadline', RuntimeWarning,
+                    stacklevel=2)
+                _tel.event('fused_clamp', requested=k, fits=fit)
+            jitted = self._build_fused_step(k)
+            from ..core import compile_cache as _cc
+            self._fused_fp = None
+            if _cc.enabled():
+                try:
+                    args = self._fused_example_args(k, vals)
+                    self._fused_fp = _cc.jaxpr_fingerprint(
+                        'trainer-fused-step', self._raw_fused(k), args,
+                        extra=('fused', k,
+                               repr(self._fused_jit_kwargs),
+                               tuple(sorted(dict(self.mesh.shape)
+                                            .items()))
+                               if self.mesh is not None else None))
+                    jitted = _cc.through_cache(
+                        jitted, args, fp=self._fused_fp,
+                        name='ParallelTrainer.step_fused')
+                except Exception:   # cache plumbing never kills a run
+                    self._fused_fp = None
+            self._fused_cache[ck] = jitted
+            if self.lint:
+                self._run_lint_fused(vals, k)
+        fn = self._fused_cache[ck]
+        # K keys from the SAME host stream the unfused loop consumes —
+        # fused and unfused runs see identical dropout
+        keys = jnp.stack([rng_mod.next_key() for _ in range(k)])
+        wd = self._ensure_watchdog()
+        if wd is not None:
+            # the budget covers the whole K-step chunk (compile rides
+            # the first chunk's first step)
+            b = wd.budget
+            budget_s = None
+            if b is not None:
+                per = b.effective_step_s()
+                head = b.effective_first_step_s() if first_call else per
+                budget_s = head + (k - 1) * per
+            wd.step_started(self._step_no + k, budget_s=budget_s,
+                            first=first_call)
+        _t0 = _time.perf_counter()
+        try:
+            if self.nan_guard:
+                (self.params, self.buffers, self.opt_state, _s,
+                 losses, oks) = fn(
+                    self.params, self.buffers, self.opt_state,
+                    jnp.asarray(self._step_no, jnp.int32), keys, *vals)
+            else:
+                (self.params, self.buffers, self.opt_state, _s,
+                 losses) = fn(
+                    self.params, self.buffers, self.opt_state,
+                    jnp.asarray(self._step_no, jnp.int32), keys, *vals)
+        finally:
+            if wd is not None:
+                wd.step_finished(self._step_no + k)
+        dt = _time.perf_counter() - _t0
+        # telemetry rows are labeled by a monotone DISPATCH counter:
+        # under nan_guard, _step_no advances only by the finite count,
+        # so labeling rows _step_no-k+1.. would reuse ids across
+        # chunks containing skips
+        row_lo = getattr(self, '_fused_rows', 0) + 1
+        self._fused_rows = row_lo + k - 1
+        if self.nan_guard:
+            # the chunk's ONE sanctioned host sync: the K-step mask
+            mask = _scan.chunk_sync(oks)
+            self._step_no += int(mask.sum())
+            self._note_chunk(first_call, dt, losses, k, row_lo)
+            for ok in mask:
+                if self.sentinel.observe(finite=bool(ok)) == 'rollback':
+                    self._nan_rollback()
+                    break
+            return losses
+        self._step_no += k
+        self._note_chunk(first_call, dt, losses, k, row_lo)
+        return losses
+
+    def _raw_fused(self, k):
+        """The unjitted fused scan (fingerprint input)."""
+        from ..core import scan_loop as _scan
+        return _scan.fused_trainer_step(self._raw_step, k,
+                                        nan_guard=self.nan_guard)
+
+    def _run_lint_fused(self, vals, k):
+        """Lint the per-step function in its fused posture: the
+        ``chunk-break`` rule flags host callbacks/syncs that would
+        force the K-chunk to split back into per-step dispatches."""
+        from .. import analysis
+
+        def build():
+            args = (self.params, self.buffers, self.opt_state,
+                    jnp.zeros((), jnp.int32), jax.random.PRNGKey(0))
+            per_step = tuple(jax.ShapeDtypeStruct(v.shape[1:], v.dtype)
+                             for v in vals)
+            return analysis.lint(
+                self._raw_step, *args, *per_step, mesh=self.mesh,
+                donate_argnums=(0, 2) if self.donate else (),
+                source=False, fused_steps=k,
+                name='ParallelTrainer.step_fused')
+
+        analysis.safe_emit(build, self.lint)
+
+    def _note_chunk(self, first_call, dt, losses, k, step_lo):
+        """Telemetry for one fused chunk: the compile event on the
+        first call, chunk rows (expanded to per-step stats at flush)
+        on the steady state, and span-tagged profiler observes so a
+        capture window attributes its collectives to exact step ids.
+        ``step_lo`` is the monotone dispatch index of the chunk's
+        first step (distinct from _step_no, which skips don't
+        advance)."""
+        from .. import telemetry as _tel
+        prof = self._ensure_profiler(_tel)
+        if prof is not None:
+            n0 = getattr(self, '_profile_calls', -1) + 1
+            self._profile_calls = n0 + k - 1
+            prof.observe(n0, sync=losses, span=k)
+        if first_call:
+            _tel.event('compile', name='ParallelTrainer.step_fused',
+                       dur_s=round(dt, 6), fused_steps=k)
+            _tel.add('compile.count')
+            _tel.add('compile.total_s', dt)
+            return
+        acc = getattr(self, '_tel_acc', None)
+        if acc is None:
+            acc = self._tel_acc = _tel.step_accumulator('parallel')
+            if acc is None:
+                return
+        acc.observe_chunk(step_lo, k, step_time_s=dt, loss=losses)
+
     def _resolved_calibration(self):
         """The calibration= argument as a costmodel.Calibration (paths
         loaded lazily, once), or None — shared by the planner's cost
@@ -853,7 +1079,7 @@ class ParallelTrainer:
                 n_parts = (int(np.prod(list(mesh_shape.values())))
                            if mesh_shape else 1)
                 cal = self._resolved_calibration()
-                text_fn = self.compiled_text \
+                text_fn = self._census_text \
                     if (self.mesh is not None
                         and not self._pipeline) else None
                 self._profiler = _tel.step_profiler(
@@ -863,6 +1089,18 @@ class ParallelTrainer:
             except Exception:   # profiling must never kill a step
                 self._profiler = None
         return self._profiler
+
+    def _census_text(self):
+        """compiled_text for the profiler's census join, or None when
+        only the FUSED module exists: the per-step module was never
+        compiled, and the scan module's instruction names would not
+        join the per-step census anyway — fused windows keep the
+        compute-vs-collective breakdown without the per-instruction
+        attribution (a clean skip, not an error on the
+        profile_capture event)."""
+        if self._compiled is None:
+            return None
+        return self.compiled_text()
 
     def _note_step(self, first_call, dt, loss, _tel):
         """Telemetry for one step() call: the first call of a fresh

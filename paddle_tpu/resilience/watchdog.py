@@ -57,6 +57,15 @@ class Budget:
 
     step_s        wall-clock allowance for one host-loop step (dispatch
                   to dispatch).  None -> default_step_s.
+                  Under a FUSED K-step loop (core.scan_loop) one
+                  host-visible "step" is a whole K-chunk: the trainer
+                  passes ``step_started(budget_s=K x step_s)`` so the
+                  budget covers the chunk, and — when an explicit
+                  step_s was armed together with a cost-model step
+                  estimate — K itself clamps so a hung chunk is still
+                  detected inside the armed deadline
+                  (``scan_loop.clamp_chunk`` /
+                  ``ParallelTrainer.fused_chunk_len``).
     collective_s  allowance for one host collective's wait.
     slack         multiplier applied to cost-model estimates when
                   deriving budgets (estimates are ideal-wire numbers;

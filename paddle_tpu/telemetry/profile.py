@@ -181,23 +181,41 @@ class StepProfiler:
         return self.base_dir
 
     # -- loop hooks ----------------------------------------------------------
-    def observe(self, step_no, sync=None):
+    def observe(self, step_no, sync=None, span=1):
         """One step just dispatched; `step_no` is its 0-based index in
         THIS loop (both wired loops count calls from 0, so schedule
         steps mean the same thing on every path — and ``start=1``, the
         smallest schedulable window, opens right after the first
         call).  Cheap outside a window (an int compare); opens the
         trace when the NEXT step starts a window, closes + parses when
-        this step completed one."""
+        this step completed one.
+
+        ``span=K`` (a fused chunk, core.scan_loop) declares that this
+        ONE dispatch covered steps ``step_no .. step_no+K-1``: windows
+        then open at exact chunk boundaries and close on whole chunks,
+        so a window landing inside a fused run attributes its
+        collective us to ``step_lo .. step_lo+n*K-1`` — exact step
+        ids, never a blurred range."""
         try:
-            self._last_step = step_no
+            span = max(1, int(span))
+            last = step_no + span - 1
+            self._last_step = last
             if self._active is not None:
-                if step_no >= self._active['hi']:
+                if last >= self._active['hi']:
+                    # a chunk never splits: the window's hi stretches
+                    # to this chunk's exact last step id
+                    self._active['hi'] = max(self._active['hi'], last)
                     self._stop(sync)
                 return
-            if self.schedule.starts_at(step_no + 1,
-                                       len(self.windows)):
-                self._start(step_no + 1)
+            # does a scheduled start land inside the NEXT chunk?
+            for s in range(last + 1, last + span + 1):
+                if self.schedule.starts_at(s, len(self.windows)):
+                    # open at the chunk boundary (exact step id) and
+                    # cover whole chunks
+                    import math
+                    n_chunks = math.ceil(self.schedule.steps / span)
+                    self._start(last + 1, hi=last + n_chunks * span)
+                    break
         except Exception:       # profiling must never kill the loop
             self._active = None
 
@@ -210,12 +228,14 @@ class StepProfiler:
             self._active = None
 
     # -- window mechanics ----------------------------------------------------
-    def _start(self, lo):
+    def _start(self, lo, hi=None):
         import jax
         d = os.path.join(self._ensure_dir(),
                          f'trace-{self.name}-step{lo:06d}')
         jax.profiler.start_trace(d)
-        self._active = {'lo': lo, 'hi': lo + self.schedule.steps - 1,
+        self._active = {'lo': lo,
+                        'hi': (hi if hi is not None
+                               else lo + self.schedule.steps - 1),
                         'dir': d, 't0': time.perf_counter()}
 
     def _stop(self, sync):
@@ -297,6 +317,11 @@ class StepProfiler:
         from ..analysis import hlo as _hlo
         from ..profiler import trace as _trace
         text = self.hlo_text_fn()
+        if not text:
+            # the loop has no census-joinable module (e.g. a fused-
+            # only trainer): keep the window's breakdown, skip the
+            # per-instruction join
+            return []
         module = _hlo.parse_module(text)
         idx = _hlo.collective_instrs(module,
                                      mesh_shape=self.mesh_shape,

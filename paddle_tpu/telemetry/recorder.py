@@ -89,7 +89,12 @@ EVENT_KINDS = (
     'compile_cache',       # persistent compile-cache traffic (action:
                            # hit/miss/serialize/deserialize/quarantine/
                            # warm_start; tier, bytes, dur_s, saved_s)
-    'steps',               # StepAccumulator flush (per-step scalars)
+    'fused_clamp',         # a fused K-chunk exceeded the watchdog
+                           # step budget's capacity (requested, fits)
+                           # — stage fused_chunk_len() chunks instead
+    'steps',               # StepAccumulator flush (per-step scalars;
+                           # fused chunk rows arrive expanded to
+                           # per-step entries)
     'span',                # a closed span (name, dur_s)
     'scalar',              # user scalar (VisualDL / ScalarAdapter)
     'flight_dump',         # a flight-recorder dump was written

@@ -70,23 +70,79 @@ class StepAccumulator:
         self._times = []
         self._waits = []
         self._scalars = []      # list of {name: device-or-py scalar}
+        self._spans = []        # steps each buffered row covers (K>=1)
 
     def __len__(self):
-        return len(self._steps)
+        return sum(self._spans)
 
     def observe(self, step=None, step_time_s=None, wait_s=None,
                 **scalars):
         """Record one step.  `scalars` values may be device arrays
         (kept lazy) or plain numbers; None values are dropped."""
         self._steps.append(step if step is not None
-                           else (self._steps[-1] + 1 if self._steps
-                                 else 0))
+                           else (self._steps[-1] + self._spans[-1]
+                                 if self._steps else 0))
         self._times.append(step_time_s)
         self._waits.append(wait_s)
         self._scalars.append(
             {k: v for k, v in scalars.items() if v is not None})
-        if len(self._steps) >= self.flush_interval:
+        self._spans.append(1)
+        if len(self) >= self.flush_interval:
             self.flush()
+
+    def observe_chunk(self, step_lo, n, step_time_s=None, wait_s=None,
+                      **scalars):
+        """Record one fused K-step chunk (core.scan_loop): `scalars`
+        values may be K-length stacked DEVICE arrays — kept lazy, like
+        observe(), and expanded to per-step rows at flush so run_report
+        percentiles stay per-step, not per-chunk.  ``step_time_s`` is
+        the chunk's wall time (divided evenly across its steps at
+        flush); ``wait_s`` is the chunk's staging wait (attributed to
+        the chunk's first step)."""
+        n = max(1, int(n))
+        self._steps.append(step_lo if step_lo is not None
+                           else (self._steps[-1] + self._spans[-1]
+                                 if self._steps else 0))
+        self._times.append(step_time_s)
+        self._waits.append(wait_s)
+        self._scalars.append(
+            {k: v for k, v in scalars.items() if v is not None})
+        self._spans.append(n)
+        if len(self) >= self.flush_interval:
+            self.flush()
+
+    @staticmethod
+    def _expand_scalar(v, n):
+        """One buffered scalar cell -> n per-step floats (or Nones).
+        The chunk-flush path tolerates K-length stacked arrays: a
+        device array of size n contributes one float per step; a plain
+        scalar broadcasts."""
+        import numpy as np
+        try:
+            a = np.asarray(v)
+            if a.size == n:
+                return [float(x) for x in a.reshape(-1)]
+            if a.size == 1:
+                return [float(a.reshape(()))] * n
+        except (TypeError, ValueError):
+            pass
+        return [None] * n
+
+    def _expand_rows(self, steps, times, waits, rows, spans):
+        """Buffered (possibly chunked) rows -> flat per-step columns."""
+        f_steps, f_times, f_waits, f_rows = [], [], [], []
+        for step, t, w, row, n in zip(steps, times, waits, rows, spans):
+            base = step if step is not None else 0
+            for j in range(n):
+                f_steps.append(base + j)
+                f_times.append(t / n if t is not None else None)
+                f_waits.append(w if j == 0 else None)
+            expanded = {k: self._expand_scalar(v, n)
+                        for k, v in row.items()}
+            for j in range(n):
+                f_rows.append({k: vs[j] for k, vs in expanded.items()
+                               if vs[j] is not None})
+        return f_steps, f_times, f_waits, f_rows
 
     def flush(self):
         """Materialize the buffer (the one host read per interval) and
@@ -94,10 +150,11 @@ class StepAccumulator:
         if not self._steps:
             return None
         import numpy as np
-        steps, times, waits, rows = (self._steps, self._times,
-                                     self._waits, self._scalars)
-        self._steps, self._times, self._waits, self._scalars = \
-            [], [], [], []
+        steps, times, waits, rows = self._expand_rows(
+            self._steps, self._times, self._waits, self._scalars,
+            self._spans)
+        (self._steps, self._times, self._waits, self._scalars,
+         self._spans) = [], [], [], [], []
         cols = {}
         for i, row in enumerate(rows):
             for k, v in row.items():

@@ -30,6 +30,11 @@ os.environ.setdefault('PADDLE_TPU_PROFILE', '0')
 # escalation exits!) under every trainer test — watchdog-behavior
 # tests pass watchdog= / monkeypatch explicitly
 os.environ.setdefault('PADDLE_TPU_WATCHDOG', '0')
+# ...and for the fused K-step loop: an ambient PADDLE_TPU_FUSED_STEPS
+# would flip every fit() into chunked dispatch (different callback /
+# sync cadence than the tests pin) — fused-behavior tests pass
+# fused_steps= explicitly
+os.environ.setdefault('PADDLE_TPU_FUSED_STEPS', '0')
 
 import jax  # noqa: E402
 

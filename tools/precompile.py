@@ -112,17 +112,25 @@ def _build_mesh(axes):
                 tuple(axes.keys()))
 
 
-def _precompile_target(name, mesh_axes, entries, errors):
+def _precompile_target(name, mesh_axes, entries, errors,
+                       fused_steps=0):
     """Lower one audit target's surrogate step for one mesh into the
     persistent text tier (exact tpu_lint/planner keys) — the
-    lower+compile also seeds jax's XLA disk cache."""
+    lower+compile also seeds jax's XLA disk cache.  ``fused_steps=K``
+    instead lowers the K-step FUSED module (core.scan_loop: one
+    lax.scan over a K-stacked batch) under a distinct cache key, so a
+    deploy that trains with ``fused_steps=K`` finds its whole-loop
+    module warm."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
     from paddle_tpu.analysis import hlo as _hlo
     from paddle_tpu.analysis import targets as _targets
     from paddle_tpu.core import compile_cache as _cc
+    from paddle_tpu.core import scan_loop as _scan
     from paddle_tpu.distributed import env as _env
-    desc = f'target-step {name} @ {mesh_axes or "1-device"}'
+    k = max(0, int(fused_steps))
+    desc = f'target-step {name} @ {mesh_axes or "1-device"}' + \
+        (f' fused x{k}' if k else '')
     try:
         mesh = _build_mesh(mesh_axes) if mesh_axes else \
             _build_mesh({'dp': 1})
@@ -135,11 +143,22 @@ def _precompile_target(name, mesh_axes, entries, errors):
             repl = NamedSharding(mesh, P())
             batch_sh = _targets.batch_shardings(mesh, batch)
             key = jax.random.PRNGKey(0)
-            ck = _targets.cache_key(name, mesh.shape, p_sh, batch_sh,
+            step = _targets.surrogate_step(model)
+            ck_name = name if not k else f'{name}+fused{k}'
+            if k:
+                # stack the batch with a leading K dim and shift the
+                # dp sharding one dim right — the fused scan's axes
+                step = _scan.fused_surrogate(step, k)
+                batch = tuple(jax.ShapeDtypeStruct((k,) + tuple(b.shape),
+                                                   b.dtype)
+                              for b in batch)
+                batch_sh = tuple(
+                    NamedSharding(mesh, P(None, *sh.spec))
+                    for sh in batch_sh)
+            ck = _targets.cache_key(ck_name, mesh.shape, p_sh, batch_sh,
                                     batch=batch)
             _hlo.lower_text(
-                _targets.surrogate_step(model), params, buffers, key,
-                *batch,
+                step, params, buffers, key, *batch,
                 jit_kwargs={'in_shardings': (p_sh, b_sh, repl)
                             + batch_sh},
                 lower_cache={}, cache_key=ck)
@@ -203,6 +222,13 @@ def main(argv=None):
                          '"dp=4" or "dp=2,tp=2" (default: single '
                          'device, plus any reshape meshes recorded in '
                          'the run dir\'s newest commit manifest)')
+    ap.add_argument('--fused-steps', metavar='K[,K2,...]', default=None,
+                    help='additionally AOT-lower each target\'s '
+                         'K-step FUSED train module (core.scan_loop '
+                         'whole-loop compilation) for these chunk '
+                         'lengths, e.g. "8,32" — a deploy training '
+                         'with fused_steps=K then warm-starts its '
+                         'fused module too')
     ap.add_argument('--gpt-decode', metavar='BxT0xNEW[,...]',
                     default=None,
                     help='gptgen decode bucket signatures to export, '
@@ -247,10 +273,22 @@ def main(argv=None):
         if m not in meshes:
             meshes.append(m)
 
+    try:
+        fused = [int(x) for x in args.fused_steps.split(',')
+                 if x.strip()] if args.fused_steps else []
+        if any(x < 1 for x in fused):
+            raise ValueError('--fused-steps wants K >= 1')
+    except ValueError as e:
+        print(f'precompile: {e}', file=sys.stderr)
+        return 2
+
     entries, errors = [], {}
     for m in meshes:
         for name in target_names:
             _precompile_target(name, m, entries, errors)
+            for k in fused:
+                _precompile_target(name, m, entries, errors,
+                                   fused_steps=k)
     kwargs = {'temperature': args.temperature, 'top_k': args.top_k}
     for shape in decode:
         _precompile_decode(args.gpt_model, shape, kwargs, entries,
@@ -259,7 +297,8 @@ def main(argv=None):
     doc = _cc.write_precompile_manifest(
         args.run_dir, entries,
         meta={'meshes': [m or {} for m in meshes],
-              'reshape_meshes': reshape})
+              'reshape_meshes': reshape,
+              'fused_steps': fused})
     summary = {'run_dir': os.path.abspath(args.run_dir),
                'cache_dir': _cc.cache_dir(),
                'entries': len(entries),

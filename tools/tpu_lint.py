@@ -256,6 +256,13 @@ def main(argv=None):
     ap.add_argument('--shapes', metavar='SPEC',
                     help='example shapes for --jaxpr, e.g. '
                          '"8x128xf32,8xi32" (last token is the dtype)')
+    ap.add_argument('--fused', type=int, metavar='K', default=None,
+                    help='audit the --jaxpr target in its FUSED '
+                         'posture (core.scan_loop, fused_steps=K): '
+                         'the chunk-break rule flags host '
+                         'callbacks/syncs that would force a K-step '
+                         'chunk to split back into per-step '
+                         'dispatches')
     ap.add_argument('--hlo', action='store_true',
                     help='lowered-HLO SPMD audit: lower step functions '
                          'through the partitioner under a forced mesh '
@@ -342,7 +349,8 @@ def main(argv=None):
                   file=sys.stderr)
             return 2
         report.extend(analysis.lint(fn, *shapes,
-                                    disable=args.disable))
+                                    disable=args.disable,
+                                    fused_steps=args.fused))
 
     # one lowering memo shared by --plan and --hlo: the same
     # (target, mesh, shardings) triple is compiled exactly once no
