@@ -39,6 +39,9 @@ BASELINES = {
                             # batch-8 GPT-2 small generation)
     'longctx': 5_000.0,     # tokens/s (V100-class GPT-2 small T=4096:
                             # activation memory forces micro-batching)
+    'serve': 4_000.0,       # decoded tokens/s (V100-class vLLM-style
+                            # continuous batching, GPT-2 small,
+                            # batch-64 mixed-length Poisson load)
 }
 
 
@@ -360,6 +363,79 @@ def bench_gptgen(smoke):
     return v
 
 
+def _serve_setup(smoke):
+    """Shared model + engine config + request set for the serve bench
+    and the --serve-smoke gate: tiny model on CPU smoke, gpt-small on
+    chip runs; batch 64 continuous batching either way."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import gpt_small, gpt_tiny
+    from paddle_tpu.serving import ServeConfig, poisson_requests
+
+    paddle.seed(0)
+    if smoke:
+        # hidden 256: big enough that batch-64 decode genuinely reuses
+        # weights per step (the continuous-batching premise) while the
+        # ~10 bucket modules still compile in well under a minute
+        model = gpt_tiny(hidden_size=256, num_heads=4, num_layers=4,
+                         max_seq_len=64)
+        cfg = ServeConfig(block_size=8, max_slots=64, decode_span=8,
+                          prompt_buckets=(8, 16),
+                          batch_buckets=(8, 64), prefill_batch=8,
+                          max_model_len=48, temperature=0.0)
+        n, rate = 96, 2000.0
+        prompt_lens, new_tokens = (5, 7, 8, 12, 16), (16, 24)
+    else:
+        model = gpt_small(max_seq_len=256, dropout=0.0)
+        cfg = ServeConfig(block_size=16, max_slots=64, decode_span=8,
+                          prompt_buckets=(32, 64),
+                          batch_buckets=(8, 64), max_model_len=160,
+                          temperature=0.0)
+        n, rate = 128, 100.0
+        prompt_lens, new_tokens = (24, 32, 48, 64), (32, 64)
+    model.eval()
+
+    def load(seed):
+        return poisson_requests(
+            n, rate_rps=rate, prompt_lens=prompt_lens,
+            new_tokens=new_tokens, vocab_size=model.config.vocab_size,
+            seed=seed, deadline_s=600.0)
+
+    return model, cfg, load
+
+
+def bench_serve(smoke):
+    """Continuous-batching serving throughput (paddle_tpu/serving):
+    batch-64 paged-KV decode under seeded Poisson load with mixed
+    prompt/output lengths — decoded tokens/sec/chip plus p99 TTFT,
+    the ROADMAP item-1 target metrics."""
+    import jax
+    from paddle_tpu.serving import ServingEngine
+
+    model, cfg, load = _serve_setup(smoke)
+    eng = ServingEngine(model, cfg)
+    t0 = time.time()
+    eng.warmup()                        # every declared bucket module
+    eng.run(load(seed=3))               # then a shakeout load
+    log(f'serve warmup (incl. compile): {time.time() - t0:.1f}s '
+        f'({eng.compile_count} modules)')
+    marker = os.environ.get('BENCH_COMPILE_MARKER')
+    if marker:
+        open(marker, 'w').close()
+    rep = eng.run(load(seed=7))
+    chips = jax.device_count()
+    v = (rep['tokens_per_s'] or 0.0) / max(1, chips)
+    bench_serve.last_note = (
+        f"p99 TTFT {rep['ttft_p99_s']:.3f}s, "
+        f"{rep['interventions']} interventions, "
+        f"batch<= {cfg.max_slots}" if rep['ttft_p99_s'] else None)
+    log(f"serve: {rep['decoded_tokens']} tokens in "
+        f"{rep['wall_s']:.2f}s ({v:.0f} tokens/s/chip), "
+        f"p99 TTFT {rep['ttft_p99_s']}")
+    if rep['audit']:
+        raise RuntimeError(f'serve invariants violated: {rep["audit"]}')
+    return v
+
+
 def bench_lenet(smoke):
     import jax
     import paddle_tpu as paddle
@@ -400,6 +476,7 @@ CONFIGS = {
     'gpt': bench_gpt,
     'widedeep': bench_widedeep,
     'longctx': bench_longctx,
+    'serve': bench_serve,
     # gptgen runs LAST: it is the only config that has ever wedged the
     # dev tunnel mid-run (r4: 900s timeout, tunnel dead afterwards) —
     # a repeat must not cost the other configs their numbers.
@@ -409,8 +486,9 @@ CONFIGS = {
 # Per-config timeout scale.  Killing a child mid-compile is what WEDGES
 # the tunnel (round-2: 5h outage), so the configs whose remote compile
 # is slow get a generous window instead of a kill: gptgen's whole
-# prefill+decode scan is one big XLA module.
-TIMEOUT_SCALE = {'gptgen': 3, 'longctx': 2}
+# prefill+decode scan is one big XLA module; serve compiles one module
+# per declared bucket.
+TIMEOUT_SCALE = {'gptgen': 3, 'longctx': 2, 'serve': 2}
 
 METRIC_NAMES = {
     'resnet': 'resnet50_bf16_train_throughput',
@@ -420,6 +498,7 @@ METRIC_NAMES = {
     'longctx': 'gpt2_small_t4096_train_throughput',
     'widedeep': 'widedeep_sparse_train_throughput',
     'lenet': 'lenet_train_throughput',
+    'serve': 'gpt_serve_continuous_batching_decode_throughput',
 }
 
 UNITS = {
@@ -430,6 +509,7 @@ UNITS = {
     'gptgen': 'decoded tokens/sec/chip',
     'widedeep': 'examples/sec/chip',
     'longctx': 'tokens/sec/chip',
+    'serve': 'decoded tokens/sec/chip',
 }
 
 
@@ -1204,6 +1284,154 @@ def _fused_smoke_child(smoke):
     print(json.dumps(out))
 
 
+def _serve_smoke_child(smoke):
+    """--serve-smoke child: one engine, warmup load then measured
+    load, vs a sequential batch-1 generate baseline on the SAME
+    request set.  Emits one JSON line with the gate evidence:
+
+    - engine_tps vs seq_tps (continuous batching must win),
+    - zero post-warmup compiles (engine module count AND persistent
+      compile-cache stats — a fresh cache dir is armed for this
+      process so every serialize is visible),
+    - scheduler invariants (all requests complete, none starved past
+      its deadline budget, no leaked/aliased KV blocks),
+    - paged decode bit-exact vs dense-cache generate (greedy).
+    """
+    import tempfile
+    import numpy as np  # noqa: F811
+    del smoke       # the gate always runs the CPU smoke scale
+    # a fresh cache makes 'zero post-warmup compiles' measurable via
+    # compile_cache.stats(): warmup serializes every module, the
+    # measured run must add none
+    os.environ['PADDLE_TPU_COMPILE_CACHE'] = tempfile.mkdtemp(
+        prefix='bench_serve_cc_')
+    import paddle_tpu as paddle
+    from paddle_tpu.core import compile_cache as CC
+    from paddle_tpu.serving import ServingEngine
+
+    model, cfg, load = _serve_setup(smoke=True)
+    eng = ServingEngine(model, cfg)
+    t0 = time.time()
+    eng.warmup()                            # every declared module
+    eng.run(load(seed=3))                   # shakeout under load
+    warm_s = time.time() - t0
+    compiles0 = eng.compile_count
+    stats0 = CC.stats()
+    rep = eng.run(load(seed=7))
+    compiles_after = eng.compile_count - compiles0
+    stats1 = CC.stats()
+    cache_new = {k: stats1.get(k, 0) - stats0.get(k, 0)
+                 for k in ('serialize_exec', 'miss_exec')
+                 if stats1.get(k, 0) != stats0.get(k, 0)}
+
+    # sequential batch-1 baseline + bit-exactness on the same set
+    reqs = load(seed=7)
+    fin = {r.rid: r for r in eng.scheduler.finished}
+    refs = {}
+    for r in reqs:                          # warm generate's buckets
+        refs[r.rid] = np.asarray(model.generate(
+            paddle.to_tensor(r.prompt[None, :]), r.max_new_tokens,
+            temperature=0).value)[0, r.prompt.size:].tolist()
+    t0 = time.time()
+    total = 0
+    for r in reqs:
+        out = model.generate(paddle.to_tensor(r.prompt[None, :]),
+                             r.max_new_tokens, temperature=0)
+        np.asarray(out.value)
+        total += r.max_new_tokens
+    seq_wall = time.time() - t0
+    seq_tps = total / seq_wall
+    exact = all(fin[r.rid].tokens == refs[r.rid] for r in reqs
+                if r.rid in fin)
+
+    recs = rep['requests']
+    starved = [r for r in recs if r['reason'] == 'deadline']
+    incomplete = [r for r in recs if r['state'] not in ('done',)
+                  or r['reason'] not in ('eos', 'max_tokens')]
+    missing = [r.rid for r in reqs if r.rid not in fin]
+    print(json.dumps({
+        'engine_tps': rep['tokens_per_s'],
+        'seq_tps': seq_tps,
+        'speedup': (rep['tokens_per_s'] or 0) / seq_tps,
+        'p99_ttft_s': rep['ttft_p99_s'],
+        'p50_ttft_s': rep['ttft_p50_s'],
+        'tpot_mean_s': rep['tpot_mean_s'],
+        'warmup_s': round(warm_s, 2),
+        'compiles_after_warmup': compiles_after,
+        'cache_activity_after_warmup': cache_new,
+        'modules': eng.stats()['modules'],
+        'exact_vs_generate': bool(exact),
+        'batch': cfg.max_slots,
+        'requests': len(reqs),
+        'decoded_tokens': rep['decoded_tokens'],
+        'interventions': rep['interventions'],
+        'starved': [r['rid'] for r in starved],
+        'incomplete': [r['rid'] for r in incomplete] + missing,
+        'audit': rep['audit'],
+        'counters': rep['counters'],
+    }))
+
+
+def _serve_preflight(smoke, timeout_s=900):
+    """--serve-smoke gate (the ISSUE-12 acceptance bar): under
+    sustained synthetic Poisson load at batch 64 on the CPU smoke,
+    continuous batching must sustain STRICTLY higher decoded
+    tokens/sec than sequential batch-1 generate on the same request
+    set, with zero post-warmup compiles, intact scheduler/allocator
+    invariants, and paged-attention output bit-exact vs the dense
+    reference.  Returns (ok, summary); infra failures never block —
+    evidence beats a dead gate — but a violated bar always does."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    cmd = [sys.executable, os.path.abspath(__file__),
+           '--serve-smoke-child'] + (['--smoke'] if smoke else [])
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=env)
+        doc = _last_json_dict(proc.stdout)
+    except Exception as e:
+        log(f'serve preflight skipped ({e!r})')
+        return True, {'error': repr(e)[:200]}
+    if doc is None:
+        log(f'serve preflight skipped (no child output, '
+            f'rc={proc.returncode}): {proc.stderr[-300:]}')
+        return True, {'error': f'no output (rc={proc.returncode})'}
+    failures = []
+    if not doc.get('exact_vs_generate'):
+        failures.append('paged decode drifted from dense-cache '
+                        'generate (bit-exactness broken)')
+    speedup = doc.get('speedup') or 0
+    if speedup <= 1.0:
+        failures.append('continuous batching did not beat sequential '
+                        f'batch-1 generate (x{speedup:.2f})')
+    if doc.get('compiles_after_warmup'):
+        failures.append(f'{doc["compiles_after_warmup"]} module '
+                        'compile(s) AFTER warmup (bucket set leak)')
+    if doc.get('cache_activity_after_warmup'):
+        failures.append('compile-cache misses/serializes after warmup:'
+                        f' {doc["cache_activity_after_warmup"]}')
+    if doc.get('starved'):
+        failures.append(f'requests starved past their deadline '
+                        f'budget: {doc["starved"][:5]}')
+    if doc.get('incomplete'):
+        failures.append(f'admitted requests neither completed nor '
+                        f'cleanly evicted: {doc["incomplete"][:5]}')
+    if doc.get('audit'):
+        failures.append(f'allocator/scheduler invariants violated: '
+                        f'{doc["audit"][:3]}')
+    summary = dict(doc, failures=failures)
+    ok = not failures
+    log(f'serve preflight: {"ok" if ok else "FAIL"} '
+        f'(engine x{speedup:.2f} vs sequential, '
+        f'p99 TTFT {doc.get("p99_ttft_s")}, '
+        f'exact={doc.get("exact_vs_generate")}, '
+        f'post-warmup compiles={doc.get("compiles_after_warmup")})')
+    for f in failures:
+        log(f'  {f}')
+    return ok, summary
+
+
 def _fused_preflight(smoke, timeout_s=900):
     """--fused-smoke gate: the fused K-step loop must (1) be bit-exact
     with the per-step loop at K=1 and (2) show a steps/sec uplift at
@@ -1369,6 +1597,17 @@ def main():
     p.add_argument('--profile-smoke-child', action='store_true',
                    help='(internal) run the profile-smoke captures '
                         'and emit their JSON')
+    p.add_argument('--serve-smoke', action='store_true',
+                   help='preflight gate: continuous-batching serving '
+                        '(paddle_tpu/serving) under synthetic Poisson '
+                        'load at batch 64 on CPU must beat sequential '
+                        'batch-1 generate on the same request set, '
+                        'with zero post-warmup compiles, intact '
+                        'scheduler/KV-block invariants and paged '
+                        'decode bit-exact vs the dense reference')
+    p.add_argument('--serve-smoke-child', action='store_true',
+                   help='(internal) run the serve-smoke measurement '
+                        'and emit its JSON')
     p.add_argument('--fused-smoke', action='store_true',
                    help='steps/sec-vs-K sweep (K in {1,8,32}) of the '
                         'fused train loop on the lenet/widedeep '
@@ -1400,6 +1639,10 @@ def main():
         _fused_smoke_child(args.smoke)
         return
 
+    if args.serve_smoke_child:
+        _serve_smoke_child(args.smoke)
+        return
+
     if args.single_json:
         if args.config == 'all':
             p.error('--single-json needs an explicit --config NAME')
@@ -1415,6 +1658,23 @@ def main():
     cache_summary = None
     profile_summary = None
     fused_summary = None
+    serve_summary = None
+    if args.serve_smoke:
+        serve_ok, serve_summary = _serve_preflight(args.smoke)
+        if not serve_ok:
+            # the serving runtime regressed below its acceptance bar
+            # (slower than sequential decode, recompiles under load,
+            # leaked blocks or numeric drift) — fail before burning
+            # chip time, with the measurement as the artifact
+            print(json.dumps({
+                'metric': METRIC_NAMES['resnet'], 'value': None,
+                'unit': UNITS['resnet'], 'vs_baseline': None,
+                'error': 'serve preflight failed (continuous batching '
+                         'below the acceptance bar); fix '
+                         'paddle_tpu/serving or re-run without '
+                         '--serve-smoke',
+                'serve': serve_summary, 'extras': {}}))
+            sys.exit(1)
     if args.fused_smoke:
         fused_ok, fused_summary = _fused_preflight(args.smoke)
         if not fused_ok:
@@ -1589,6 +1849,8 @@ def main():
         out['profile'] = profile_summary
     if fused_summary is not None:
         out['fused'] = fused_summary
+    if serve_summary is not None:
+        out['serve'] = serve_summary
     # the headline config is excluded from extras, so its stale
     # provenance (if any) rides at the top level
     for k in ('stale_value', 'stale_vs_baseline', 'stale_from',

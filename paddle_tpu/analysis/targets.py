@@ -140,6 +140,34 @@ def _target_widedeep(mesh):
                    jax.ShapeDtypeStruct((8, 4), jnp.float32))
 
 
+def _target_gptserve(mesh):
+    """One paged decode step of the serving engine
+    (serving/engine.DecodeAuditLayer): a ragged live batch attending
+    the paged KV pool through per-sequence block tables — the
+    continuous-batching serving surface, auditable/plannable like any
+    train step."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from ..models.gpt import GPTConfig, GPTForCausalLM
+    from ..serving.engine import DecodeAuditLayer
+    del mesh
+    paddle.seed(0)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        max_seq_len=32, dropout=0.0))
+    model.eval()
+    L, nh, hd = 2, 4, 16
+    S, bs, mb = 8, 8, 4                   # batch, block size, table w
+    nb = S * mb + 1                       # pool incl. trash block
+    return DecodeAuditLayer(model), (
+        _ids_batch((S, 1), 128),
+        jax.ShapeDtypeStruct((L, nb, nh, bs, hd), jnp.float32),
+        jax.ShapeDtypeStruct((L, nb, nh, bs, hd), jnp.float32),
+        _ids_batch((S, mb), 0),
+        _ids_batch((S,), 0))
+
+
 def _target_lenet(mesh):
     """LeNet vision path of examples/mnist_lenet."""
     import jax
@@ -158,4 +186,5 @@ TARGETS = {
     'gpt': _target_gpt,
     'widedeep': _target_widedeep,
     'lenet': _target_lenet,
+    'gptserve': _target_gptserve,
 }

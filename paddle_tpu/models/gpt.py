@@ -180,6 +180,36 @@ class CausalSelfAttention(nn.Layer):
         q = manipulation.transpose(qkv[:, :, 0], [0, 2, 1, 3])
         k = manipulation.transpose(qkv[:, :, 1], [0, 2, 1, 3])
         v = manipulation.transpose(qkv[:, :, 2], [0, 2, 1, 3])
+        if cache is not None and getattr(cache, 'paged', False):
+            # paged serving decode (serving/kv_cache.PagedCacheView):
+            # ONE query token per sequence, k/v scattered into the
+            # sequence's pool blocks through its block table, ragged
+            # per-sequence length masking — bit-exact vs the dense
+            # buffer below on shared prefixes (ops/paged_attention).
+            if T != 1:
+                raise ValueError(
+                    'paged cache views decode one token per step; '
+                    f'prefill goes through the dense path (got T={T})')
+            from ..core.dispatch import apply as _apply
+            from ..ops.paged_attention import (paged_attention,
+                                               write_kv)
+
+            def paged(kp, vp, tbl, slots, lens, qv, kv, vv):
+                kp, vp = write_kv(kp, vp, kv[:, :, 0], vv[:, :, 0],
+                                  tbl, slots)
+                y = paged_attention(qv[:, :, 0], kp, vp, tbl, lens)
+                return y[:, :, None], kp, vp
+
+            y, new_k, new_v = _apply(
+                paged, cache.k_pool, cache.v_pool, cache.block_table,
+                cache.slots, cache.lens, q, k, v,
+                op_name='paged_attention')
+            y = manipulation.transpose(y, [0, 2, 1, 3])
+            y = manipulation.reshape(y, [B, T, H])
+            y = self.proj(y)
+            return self.resid_drop(y), cache.updated(
+                new_k.value if hasattr(new_k, 'value') else new_k,
+                new_v.value if hasattr(new_v, 'value') else new_v)
         if cache is not None:
             # jit-friendly incremental decode: k/v land in a
             # PREALLOCATED [B, nh, Tmax, hd] buffer at traced offset
@@ -341,13 +371,21 @@ class GPT(nn.Layer):
     def forward(self, input_ids, caches=None, pos=None):
         B, T = input_ids.shape
         if caches is not None:
-            # incremental: absolute positions start at traced offset
+            # incremental: absolute positions start at traced offset —
+            # a scalar for the lock-step generate() batch, a [B] vector
+            # for the serving engine's ragged live set (every sequence
+            # at its own depth)
             from ..core.dispatch import apply as _apply
             import jax.numpy as jnp
-            posv = _apply(
-                lambda p: p.reshape(()).astype(jnp.int64)
-                + jnp.arange(T, dtype=jnp.int64),
-                pos, op_name='pos_offset')
+
+            def _posv(p):
+                if getattr(p, 'ndim', 0) == 0 or p.size == 1:
+                    return p.reshape(()).astype(jnp.int64) \
+                        + jnp.arange(T, dtype=jnp.int64)
+                return p.reshape(-1).astype(jnp.int64)[:, None] \
+                    + jnp.arange(T, dtype=jnp.int64)[None, :]
+
+            posv = _apply(_posv, pos, op_name='pos_offset')
             x = self.wte(input_ids) + self.wpe(posv)
             x = self.drop(x)
             new_caches = []
@@ -486,6 +524,45 @@ class GPTForCausalLM(nn.Layer):
                 out = out + self.config.moe_aux_weight * \
                     (total / float(len(aux)))
         return out
+
+    def init_decode_caches(self, batch_size, max_len, dtype=None):
+        """Per-layer dense KV buffers ``[B, nh, max_len, hd]`` for the
+        cached forward — what ``generate`` preallocates internally.
+        The serving engine allocates prefill-sized ones (rounded up to
+        its KV block size) and scatters them into the paged pool."""
+        import jax.numpy as jnp
+        cfg = self.config
+        nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+        dtype = dtype or jnp.float32
+        shape = (int(batch_size), nh, int(max_len), hd)
+        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                for _ in range(cfg.num_layers)]
+
+    def prefill(self, params, buffers, ids, pos, caches):
+        """Pure cached forward over a (padded) prompt: every position's
+        k/v lands in ``caches`` starting at ``pos``; returns
+        ``(logits, new_caches)``.  Safe inside jit — ``generate`` and
+        the serving engine (``serving/engine.py``) both run their
+        prefill through here, so the two can never drift.
+
+        ``caches`` is a list of per-layer dense ``(k, v)`` buffers
+        (``init_decode_caches``) or paged views
+        (``serving.kv_cache.PagedCacheView``, decode only);
+        ``pos`` is a traced scalar (lock-step batch) or a ``[B]``
+        vector (ragged serving batch) of absolute start positions."""
+        from ..jit import functional_call
+        (logits, new_caches), _ = functional_call(
+            self, params, buffers, (ids,),
+            kwargs={'caches': caches, 'pos': pos}, training=False)
+        return logits, new_caches
+
+    def decode_step(self, params, buffers, tok, pos, caches):
+        """One incremental decode step: ``tok`` is ``[B, 1]`` (the
+        previous step's sampled token), ``pos`` its absolute
+        position(s).  Same pure cached forward as :meth:`prefill` —
+        factored apart so callers (generate's token scan, the serving
+        engine's continuous-batching step) name what they mean."""
+        return self.prefill(params, buffers, tok, pos, caches)
 
     def generate(self, input_ids, max_new_tokens, temperature=1.0,
                  top_k=None, seed=0):
@@ -706,14 +783,18 @@ class GPTForCausalLM(nn.Layer):
                                 params['gpt.wte.weight'])
             return logits, (nk_all, nv_all)
 
-        def _unrolled_step(state, ids_t, p, caches):
-            params, buffers = state
-            (logits, caches), _ = functional_call(
-                model, params, buffers, (ids_t,),
-                kwargs={'caches': caches, 'pos': p}, training=False)
-            return logits, caches
+        def _unrolled_prefill(state, ids_t, p, caches):
+            # the factored serving-shared entry points: generate's
+            # prefill and token steps run the SAME pure cached forward
+            # the serving engine calls (prefill()/decode_step()), so
+            # batch-1 generate and the continuous-batching engine can
+            # never drift apart numerically
+            return model.prefill(*state, ids_t, p, caches)
 
-        def _make_gen(prepare, step, init_cache):
+        def _unrolled_decode(state, tok_t, p, caches):
+            return model.decode_step(*state, tok_t, p, caches)
+
+        def _make_gen(prepare, step, init_cache, decode=None):
             """One decode loop for both block forms: prefill (padded to
             the bucket, true prompt length `t0` traced), sample at row
             t0-1, then a token lax.scan over `step` starting at
@@ -723,6 +804,8 @@ class GPTForCausalLM(nn.Layer):
             token's slot BEFORE the causal mask (col <= row) can ever
             expose it, and the masked softmax tail underflows to exact
             zeros."""
+            decode = decode or step
+
             def gen(params, buffers, ids, t0, key):
                 state = prepare(params, buffers)
                 logits, cache = step(state, ids,
@@ -733,7 +816,8 @@ class GPTForCausalLM(nn.Layer):
 
                 def body(carry, _):
                     tok, p, cache, key = carry
-                    logits, cache = step(state, tok[:, None], p, cache)
+                    logits, cache = decode(state, tok[:, None], p,
+                                           cache)
                     key, sk = jax.random.split(key)
                     ntok = sample(logits[:, -1], sk)
                     return (ntok, p + 1, cache, key), tok
@@ -759,10 +843,9 @@ class GPTForCausalLM(nn.Layer):
         else:
             gen_fn = _make_gen(
                 lambda p, b: (p, b),
-                _unrolled_step,
-                lambda: [(jnp.zeros((B, nh, Tmax, hd), jnp.float32),
-                          jnp.zeros((B, nh, Tmax, hd), jnp.float32))
-                         for _ in range(L)])
+                _unrolled_prefill,
+                lambda: model.init_decode_caches(B, Tmax),
+                decode=_unrolled_decode)
 
         # the decode signature keys the module: bucketed prompt P (not
         # T0), so every prompt length in a bucket reuses ONE compiled

@@ -1,0 +1,29 @@
+"""paddle_tpu.serving — the production inference runtime.
+
+Continuous batching + paged KV-cache decode over the sharded engine
+(ROADMAP item 1): a request scheduler that admits/evicts sequences at
+every decode intervention, a paged KV cache (fixed-size blocks, one
+preallocated pool, per-sequence block tables), a ragged paged
+attention op (``ops/paged_attention.py``, RPA-style per PAPERS.md
+arxiv 2604.15464) and a serving engine with fused multi-step decode —
+all over a declared pow2 bucket set so ``tools/precompile.py --serve``
+AOT-compiles the whole surface at deploy time.
+
+    from paddle_tpu.serving import ServingEngine, ServeConfig
+    eng = ServingEngine(model, ServeConfig(max_slots=64))
+    eng.submit(prompt_ids, max_new_tokens=64)
+    report = eng.run()
+
+Additive: ``GPTForCausalLM.generate`` is unchanged (and bit-exact
+with the engine's greedy decode by test).
+"""
+from .kv_cache import PagedKVCache, PagedCacheView   # noqa: F401
+from .scheduler import (                             # noqa: F401
+    ContinuousBatchingScheduler, DecodePlan, Request)
+from .loadgen import poisson_requests                # noqa: F401
+from .engine import (                                # noqa: F401
+    DecodeAuditLayer, ServeConfig, ServingEngine)
+
+__all__ = ['PagedKVCache', 'PagedCacheView', 'Request', 'DecodePlan',
+           'ContinuousBatchingScheduler', 'poisson_requests',
+           'ServeConfig', 'ServingEngine', 'DecodeAuditLayer']

@@ -1,0 +1,755 @@
+"""Serving engine: continuous batching over the paged KV cache.
+
+Ties the whole PR-7..11 runway into live decode throughput:
+
+- **paged KV cache** (``kv_cache.py``): fixed-size blocks in one
+  preallocated pool, per-sequence block tables, heads sharded on the
+  ``tp`` mesh axis;
+- **ragged paged attention** (``ops/paged_attention.py``): the whole
+  live set — every sequence at its own depth — decodes as ONE batched
+  step, bit-exact vs the dense cached path;
+- **continuous batching** (``scheduler.py``): admit/evict at every
+  intervention, prefill into freed blocks, immediate backfill;
+- **fused multi-step decode**: ``decode_span=K`` scans K decode steps
+  inside one compiled module between scheduler interventions — the
+  ROADMAP item-4 remainder lifted to the decode loop;
+- **finite module set**: prompts bucket to the declared pow2 prompt
+  set, the live batch pads to the declared pow2 batch set, admission
+  bursts chunk to pow2 prefill batches — the whole serving surface is
+  ``len(prompt_buckets) x len(prefill chunks) + len(batch_buckets)``
+  compiled modules, built deterministically by ``warmup()`` and
+  AOT-compiled by ``tools/precompile.py --serve`` (zero cold-start
+  compiles), audited by ``check_ckpt --deep`` like any other
+  precompile entry;
+- **per-request SLOs**: watchdog-derived deadline budgets (PR 10)
+  evict starved requests with a ``timeout`` telemetry event; TTFT /
+  TPOT land on ``serve_request`` events and PR-8 profile windows
+  attribute device time to exact intervention ids.
+
+The decode math runs through the SAME ``GPTForCausalLM.prefill`` /
+``decode_step`` functional forwards that ``generate()`` uses, so
+greedy engine output is bit-exact with sequential batch-1 generate —
+pinned by test and by ``bench.py --serve-smoke``.
+"""
+import json
+import math
+import time
+
+import numpy as np
+
+from .. import nn
+from ..core import compile_cache as _cc
+from ..resilience.watchdog import resolve_watchdog
+from .kv_cache import PagedKVCache, PagedCacheView, blocks_for
+from .scheduler import ContinuousBatchingScheduler, Request
+
+__all__ = ['ServeConfig', 'ServingEngine', 'DecodeAuditLayer']
+
+
+def _pow2_chain(lo, hi):
+    out = []
+    b = int(lo)
+    while b < int(hi):
+        out.append(b)
+        b *= 2
+    out.append(int(hi))
+    return tuple(sorted(set(out)))
+
+
+class ServeConfig:
+    """Declared serving surface — every field below shapes the finite
+    compiled-module set, so the config IS the AOT bucket declaration.
+    """
+
+    def __init__(self, *, block_size=16, max_slots=8, decode_span=4,
+                 prompt_buckets=None, batch_buckets=None,
+                 prefill_batch=8, max_model_len=None, temperature=0.0,
+                 top_k=None, eos_id=None, num_blocks=None,
+                 request_deadline_s=None, watchdog=None, profile=None,
+                 seed=0):
+        self.block_size = int(block_size)
+        self.max_slots = int(max_slots)
+        self.decode_span = max(1, int(decode_span))
+        # admission bursts prefill together: chunks of up to
+        # `prefill_batch` same-bucket prompts share ONE dispatch
+        # (modules per (prompt bucket, pow2 chunk) pair)
+        self.prefill_batch = max(1, int(prefill_batch))
+        self.prompt_buckets = None if prompt_buckets is None \
+            else tuple(sorted(set(int(p) for p in prompt_buckets)))
+        self.batch_buckets = batch_buckets
+        self.max_model_len = max_model_len
+        self.temperature = temperature
+        self.top_k = top_k
+        self.eos_id = eos_id
+        self.num_blocks = num_blocks
+        self.request_deadline_s = request_deadline_s
+        self.watchdog = watchdog
+        self.profile = profile
+        self.seed = int(seed)
+
+    @classmethod
+    def from_json(cls, path_or_dict):
+        """A serving config file: the ServeConfig fields, plus
+        ``model``/``model_kwargs`` keys the callers that build models
+        from configs (tools/precompile.py --serve) consume."""
+        if isinstance(path_or_dict, dict):
+            doc = dict(path_or_dict)
+        else:
+            with open(path_or_dict) as f:
+                doc = json.load(f)
+        doc.pop('model', None)
+        doc.pop('model_kwargs', None)
+        return cls(**doc)
+
+    def resolved(self, model_config):
+        """Fill derived fields from the model config; returns self."""
+        if self.max_model_len is None:
+            self.max_model_len = int(model_config.max_seq_len)
+        if self.prompt_buckets is None:
+            hi = _cc.bucket_pow2(max(1, self.max_model_len // 2))
+            self.prompt_buckets = _pow2_chain(min(8, hi), hi)
+        if self.batch_buckets is None:
+            self.batch_buckets = _pow2_chain(1, self.max_slots)
+        else:
+            self.batch_buckets = tuple(sorted(set(
+                int(b) for b in self.batch_buckets)))
+        if self.num_blocks is None:
+            per_seq = blocks_for(self.max_model_len, self.block_size)
+            self.num_blocks = self.max_slots * per_seq + 1
+        if max(self.prompt_buckets) > self.max_model_len:
+            raise ValueError(
+                f'prompt bucket {max(self.prompt_buckets)} exceeds '
+                f'max_model_len {self.max_model_len}')
+        return self
+
+    def signature(self):
+        """The scalar fields that key compiled serving modules."""
+        return tuple(sorted(
+            (k, v if not isinstance(v, (list, tuple)) else tuple(v))
+            for k, v in vars(self).items()
+            if k not in ('watchdog', 'profile', 'request_deadline_s')))
+
+    def to_dict(self):
+        return {k: (list(v) if isinstance(v, tuple) else v)
+                for k, v in vars(self).items()
+                if k not in ('watchdog', 'profile')}
+
+
+class ServingEngine:
+    """Continuous-batching decode over one ``GPTForCausalLM``.
+
+    ::
+
+        eng = ServingEngine(model, ServeConfig(max_slots=64))
+        eng.submit(prompt_ids, max_new_tokens=64)
+        report = eng.run()          # drain; per-request TTFT/TPOT
+
+    The model must be non-MoE (padded prefill rows would contend for
+    expert capacity — same exemption as generate's pow2 bucketing).
+    """
+
+    def __init__(self, model, config=None, now_fn=time.monotonic):
+        cfg = model.config
+        if cfg.moe_num_experts > 0:
+            raise ValueError('serving engine requires a non-MoE model '
+                             '(see GPTForCausalLM._decode_bucket)')
+        model.eval()
+        self.model = model
+        self.config = (config or ServeConfig()).resolved(cfg)
+        self.now_fn = now_fn
+        # one engine-relative clock for EVERY timestamp (arrivals,
+        # TTFT, deadlines) so offsets and wall reads never mix frames
+        self._epoch = now_fn()
+        self._clock = lambda: self.now_fn() - self._epoch
+        self._params, self._buffers = model.functional_state()
+        nh = cfg.num_heads
+        hd = cfg.hidden_size // nh
+        self.cache = PagedKVCache(
+            cfg.num_layers, nh, hd, block_size=self.config.block_size,
+            num_blocks=self.config.num_blocks)
+        self.scheduler = ContinuousBatchingScheduler(
+            self.cache, max_slots=self.config.max_slots,
+            batch_buckets=self.config.batch_buckets,
+            bucket_fn=self.prompt_bucket,
+            max_model_len=self.config.max_model_len,
+            decode_span=self.config.decode_span,
+            eos_id=self.config.eos_id, now_fn=self._clock)
+        self.budget = resolve_watchdog(self.config.watchdog)
+        self._modules = {}
+        self.compile_count = 0
+        self.interventions = 0
+        self.decoded_tokens = 0
+        self._rid = 0
+        self._prefills = 0
+        from ..telemetry.profile import step_profiler
+        self._prof = step_profiler(profile=self.config.profile,
+                                   name='serve')
+
+    # -- buckets -------------------------------------------------------------
+    def prompt_bucket(self, t0):
+        for b in self.config.prompt_buckets:
+            if b >= t0:
+                return b
+        raise ValueError(
+            f'prompt length {t0} exceeds the declared bucket set '
+            f'{self.config.prompt_buckets}')
+
+    def request_deadline_s(self, max_new_tokens):
+        """Per-request completion budget: explicit config wins; an
+        armed watchdog Budget (PR 10) derives prefill + per-span
+        allowances; None = no deadline."""
+        if self.config.request_deadline_s is not None:
+            return float(self.config.request_deadline_s)
+        if self.budget is None:
+            return None
+        spans = math.ceil(max(1, max_new_tokens - 1)
+                          / self.config.decode_span)
+        return self.budget.effective_first_step_s() \
+            + spans * self.budget.effective_step_s()
+
+    # -- sampling (mirrors generate()'s) -------------------------------------
+    def _sample_fn(self):
+        import jax
+        import jax.numpy as jnp
+        temperature, top_k = self.config.temperature, self.config.top_k
+        greedy = temperature == 0 or temperature is None
+
+        def sample(logits, key):
+            if greedy:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int64)
+            lg = logits / jnp.asarray(temperature, logits.dtype)
+            if top_k is not None:
+                kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+                lg = jnp.where(lg < kth, -1e9, lg)
+            return jax.random.categorical(key, lg, axis=-1) \
+                .astype(jnp.int64)
+
+        return sample
+
+    # -- compiled modules ----------------------------------------------------
+    def _fingerprint(self, kind, **extra):
+        pspec = tuple(sorted((n, tuple(v.shape), str(v.dtype))
+                             for n, v in self._params.items()))
+        import jax.numpy as jnp
+        return _cc.fingerprint(
+            kind, config=tuple(sorted(vars(self.model.config).items())),
+            serve=self.config.signature(), params=pspec,
+            ids_dtype=str(jnp.asarray(0, jnp.int64).dtype), **extra)
+
+    def _get_module(self, sig, build_fn, fp, example, name,
+                    donate=()):
+        mod = self._modules.get(sig)
+        if mod is not None:
+            return mod
+        import jax
+        # through_cache, not export-primary: the COLD path must keep
+        # its donate_argnums — the pools are the whole KV cache and a
+        # non-donating step memcpys them every call (a warm-start's
+        # deserialized module forgoes donation, the documented PR-7
+        # trade)
+        jitted = _cc.through_cache(
+            jax.jit(build_fn, donate_argnums=donate), example,
+            fp=fp, name=name)
+        self._modules[sig] = jitted
+        self.compile_count += 1
+        return jitted
+
+    def _prefill_build(self, P, B):
+        """The prefill module body for one (prompt bucket, chunk)
+        pair: ONE cached forward over B padded prompts, per-row first
+        tokens sampled at each row's true length, every row's
+        block-rounded KV scattered through its own block-table row."""
+        import jax.numpy as jnp
+        from ..parallel.api import maybe_shard
+        from ..ops.paged_attention import POOL_SPEC
+        model = self.model
+        bs = self.config.block_size
+        nblk = blocks_for(P, bs)
+        Pc = nblk * bs
+        sample = self._sample_fn()
+        nh = model.config.num_heads
+        hd = model.config.hidden_size // nh
+
+        def prefill_fn(params, buffers, ids, t0, ks, vs, block_ids,
+                       key):
+            caches = model.init_decode_caches(B, Pc)
+            logits, caches = model.prefill(
+                params, buffers, ids, jnp.zeros((), jnp.int32), caches)
+            lg = logits.value if hasattr(logits, 'value') else logits
+            rows = jnp.take_along_axis(
+                lg, (t0 - 1)[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]                      # [B, V]
+            tok = sample(rows, key)                # [B]
+            new_ks, new_vs = [], []
+            for (kbuf, vbuf), kp, vp in zip(caches, ks, vs):
+                kbuf = kbuf.value if hasattr(kbuf, 'value') else kbuf
+                vbuf = vbuf.value if hasattr(vbuf, 'value') else vbuf
+                # [B, nh, Pc, hd] -> [B, nblk, nh, bs, hd] block rows
+                kb = jnp.transpose(
+                    kbuf.reshape(B, nh, nblk, bs, hd), (0, 2, 1, 3, 4))
+                vb = jnp.transpose(
+                    vbuf.reshape(B, nh, nblk, bs, hd), (0, 2, 1, 3, 4))
+                kp = maybe_shard(kp, POOL_SPEC)
+                vp = maybe_shard(vp, POOL_SPEC)
+                new_ks.append(kp.at[block_ids].set(
+                    kb.astype(kp.dtype)))
+                new_vs.append(vp.at[block_ids].set(
+                    vb.astype(vp.dtype)))
+            return tok, tuple(new_ks), tuple(new_vs)
+
+        return prefill_fn, nblk
+
+    def _prefill_spec(self, P, B):
+        """ONE source of truth for a prefill module's (fn, fp,
+        example args, name, donate) — _prefill_module compiles it,
+        precompile() AOT-exports it; they can never drift apart."""
+        import jax
+        import jax.numpy as jnp
+        fn, nblk = self._prefill_build(P, B)
+        fp = self._fingerprint('serve-prefill', bucket=P, nblk=nblk,
+                               chunk=B)
+        ks, vs = (tuple(x) for x in zip(*self.cache.pools))
+        example = (self._params, self._buffers,
+                   jnp.zeros((B, P), jnp.int64),
+                   jnp.full((B,), P, jnp.int32), ks, vs,
+                   jnp.zeros((B, nblk), jnp.int32),
+                   jax.random.PRNGKey(0))
+        return fn, fp, example, f'serve.prefill[{P}x{B}]', (4, 5)
+
+    def _prefill_module(self, P, B):
+        sig = ('prefill', P, B)
+        if sig in self._modules:
+            return self._modules[sig]
+        return self._get_module(sig, *self._prefill_spec(P, B))
+
+    def _decode_build(self, S, K):
+        """The fused decode module body for one (batch bucket, span):
+        ``lax.scan`` over K single-token steps of the WHOLE live set —
+        scheduler interventions only happen between these modules."""
+        import jax
+        import jax.numpy as jnp
+        from ..parallel.api import maybe_shard
+        from ..ops.paged_attention import POOL_SPEC
+        model = self.model
+        L = model.config.num_layers
+        sample = self._sample_fn()
+        eos = self.config.eos_id
+
+        def decode_fn(params, buffers, ks, vs, tables, ctx, tok,
+                      active, limit, key):
+            ks = tuple(maybe_shard(k, POOL_SPEC) for k in ks)
+            vs = tuple(maybe_shard(v, POOL_SPEC) for v in vs)
+
+            def body(carry, _):
+                tok, ctx, active, ks, vs, key = carry
+                views = [PagedCacheView(ks[l], vs[l], tables, ctx,
+                                        ctx + 1) for l in range(L)]
+                logits, views = model.decode_step(
+                    params, buffers, tok[:, None], ctx, views)
+                lg = logits.value if hasattr(logits, 'value') else logits
+                key, sk = jax.random.split(key)
+                ntok = sample(lg[:, -1], sk)
+                emitted_valid = active
+                ntok = jnp.where(active, ntok, tok)
+                nctx = ctx + active.astype(ctx.dtype)
+                nactive = active & (nctx < limit)
+                if eos is not None:
+                    nactive = nactive & (ntok != eos)
+                ks = tuple(v.k_pool for v in views)
+                vs = tuple(v.v_pool for v in views)
+                return (ntok, nctx, nactive, ks, vs, key), \
+                    (ntok, emitted_valid)
+
+            (tok, ctx, active, ks, vs, key), (toks, valid) = \
+                jax.lax.scan(body, (tok, ctx, active, ks, vs, key),
+                             None, length=K)
+            return toks, valid, ks, vs
+
+        return decode_fn
+
+    def _decode_spec(self, S, K):
+        """Same single-source contract as _prefill_spec, for the
+        fused decode modules."""
+        import jax
+        import jax.numpy as jnp
+        fn = self._decode_build(S, K)
+        fp = self._fingerprint('serve-decode', batch=S, span=K)
+        ks, vs = (tuple(x) for x in zip(*self.cache.pools))
+        W = self.scheduler.table_width
+        example = (self._params, self._buffers, ks, vs,
+                   jnp.zeros((S, W), jnp.int32),
+                   jnp.zeros((S,), jnp.int64),
+                   jnp.zeros((S,), jnp.int64),
+                   jnp.zeros((S,), bool),
+                   jnp.zeros((S,), jnp.int64),
+                   jax.random.PRNGKey(0))
+        return fn, fp, example, f'serve.decode[{S}x{K}]', (2, 3)
+
+    def _decode_module(self, S, K):
+        sig = ('decode', S, K)
+        if sig in self._modules:
+            return self._modules[sig]
+        return self._get_module(sig, *self._decode_spec(S, K))
+
+    # -- request lifecycle ---------------------------------------------------
+    def submit(self, prompt, max_new_tokens=None, rid=None,
+               arrival_t=None, deadline_s=None):
+        if isinstance(prompt, Request):
+            req = prompt
+            if req.deadline_s is None:
+                req.deadline_s = self.request_deadline_s(
+                    req.max_new_tokens)
+        else:
+            self._rid += 1
+            req = Request(
+                rid if rid is not None else f'r{self._rid:05d}',
+                prompt, max_new_tokens,
+                arrival_t=(arrival_t if arrival_t is not None
+                           else self._clock()),
+                deadline_s=(deadline_s if deadline_s is not None
+                            else self.request_deadline_s(
+                                max_new_tokens)))
+        return self.scheduler.submit(req)
+
+    def _chunk_bucket(self, n):
+        return _cc.bucket_pow2(n, cap=self.config.prefill_batch)
+
+    def _prefill_dispatch(self, reqs):
+        """Dispatch ONE batched prefill over a chunk of same-bucket
+        admissions (async); the pools chain through donation so
+        back-to-back chunks pipeline on the device.  Returns the
+        un-synced first-token device array [chunk bucket]."""
+        import jax
+        import jax.numpy as jnp
+        P = reqs[0].prompt_bucket
+        nblk = blocks_for(P, self.config.block_size)
+        B = self._chunk_bucket(len(reqs))
+        mod = self._prefill_module(P, B)
+        ids = np.zeros((B, P), np.int64)
+        t0s = np.ones((B,), np.int32)      # padding rows sample row 0
+        blocks = np.zeros((B, nblk), np.int32)   # padding -> trash
+        for i, req in enumerate(reqs):
+            ids[i, :req.prompt.size] = req.prompt
+            t0s[i] = req.prompt.size
+            blocks[i] = self.cache.owned(req.rid)[:nblk]
+        ks, vs = (tuple(x) for x in zip(*self.cache.pools))
+        self._prefills += 1
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(self.config.seed), self._prefills)
+        tok, ks, vs = mod(self._params, self._buffers,
+                          jnp.asarray(ids), jnp.asarray(t0s),
+                          ks, vs, jnp.asarray(blocks), key)
+        self.cache.set_pools(list(zip(ks, vs)))
+        return tok
+
+    def _prefill_finish(self, req, tok):
+        """Record one synced first token (TTFT anchor) and finish the
+        request if it is already complete."""
+        req.tokens.append(int(tok))
+        req.first_token_t = self._clock()
+        self.decoded_tokens += 1
+        if self.config.eos_id is not None \
+                and req.tokens[-1] == self.config.eos_id:
+            self.scheduler.finish(req, 'eos')
+        elif len(req.tokens) >= req.max_new_tokens:
+            self.scheduler.finish(req, 'max_tokens')
+        return req
+
+    def _decode(self, plan):
+        import jax
+        import jax.numpy as jnp
+        mod = self._decode_module(plan.batch, plan.span)
+        ks, vs = (tuple(x) for x in zip(*self.cache.pools))
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(self.config.seed + 1),
+            self.interventions)
+        toks, valid, ks, vs = mod(
+            self._params, self._buffers, ks, vs,
+            jnp.asarray(plan.tables), jnp.asarray(plan.ctx),
+            jnp.asarray(plan.tok), jnp.asarray(plan.active),
+            jnp.asarray(plan.limit), key)
+        self.cache.set_pools(list(zip(ks, vs)))
+        return toks, valid
+
+    def _note_finished(self, finished, now):
+        from .. import telemetry
+        for req in finished:
+            rec = req.record(now)
+            telemetry.event('serve_request', **rec)
+            if req.reason == 'deadline':
+                telemetry.event(
+                    'timeout', op='serve_request', rid=req.rid,
+                    budget_s=req.deadline_s, age_s=rec['age_s'])
+
+    # -- the intervention loop -----------------------------------------------
+    def step(self, now=None):
+        """ONE scheduler intervention: release/admit/prefill, decode
+        the live set for one span, absorb, evict, backfill.  Returns
+        the intervention's progress count (admissions + evictions +
+        decoded tokens); 0 means nothing could move at all."""
+        from .. import telemetry
+        sched = self.scheduler
+        now = self._clock() if now is None else now
+        breached = sched.check_deadlines(now)
+        self._note_finished(breached, now)
+        # two-phase admission: chunk same-bucket admissions into
+        # batched prefill dispatches (device work pipelines through
+        # the donated pool chain), then sync first tokens in order
+        dispatched, chunk = [], []
+        admitted = 0
+
+        def flush():
+            if chunk:
+                dispatched.append((list(chunk),
+                                   self._prefill_dispatch(chunk)))
+                chunk.clear()
+
+        while True:
+            req = sched.admit_next()
+            if req is None:
+                break
+            admitted += 1
+            if chunk and (req.prompt_bucket != chunk[0].prompt_bucket
+                          or len(chunk) >= self.config.prefill_batch):
+                flush()
+            chunk.append(req)
+        flush()
+        for reqs, toks_dev in dispatched:
+            toks = np.asarray(toks_dev)
+            for i, req in enumerate(reqs):
+                self._prefill_finish(req, toks[i])
+        self._note_finished(
+            [r for reqs, _ in dispatched for r in reqs if r.done], now)
+        progress = admitted + len(breached)
+        if not sched.running:
+            return progress
+        preempted = sched.reserve_span(sched.decode_span)
+        # a preempted request's emitted tokens are discarded and will
+        # be recomputed — un-count them so tokens_per_s only ever
+        # counts DELIVERED tokens once
+        self.decoded_tokens -= sum(
+            getattr(r, 'discarded_tokens', 0) for r in preempted)
+        plan = sched.plan()
+        if plan is None:
+            return progress
+        toks_dev, valid_dev = self._decode(plan)
+        if self._prof is not None:
+            self._prof.observe(self.interventions * plan.span,
+                               sync=toks_dev, span=plan.span)
+        toks = np.asarray(toks_dev)
+        valid = np.asarray(valid_dev)
+        finished = sched.absorb(plan, toks, valid)
+        self._note_finished(finished, self._clock())
+        n = int(valid.sum())
+        self.decoded_tokens += n
+        self.interventions += 1
+        telemetry.event('serve_step', intervention=self.interventions,
+                        live=len(plan.requests), batch=plan.batch,
+                        span=plan.span, decoded=n, admitted=admitted,
+                        finished=len(finished),
+                        preempted=len(preempted),
+                        queued=len(sched.queue),
+                        free_blocks=self.cache.free_blocks)
+        telemetry.add('serve.decoded_tokens', n)
+        return progress + n
+
+    def run(self, requests=(), timeout_s=None):
+        """Drive to drain: submit `requests` honoring their
+        ``arrival_t`` offsets (the Poisson load path), loop
+        interventions until every request completes or evicts.
+        Returns the report dict."""
+        pending = sorted(requests, key=lambda r: r.arrival_t)
+        sched = self.scheduler
+        t0 = self.now_fn()
+        start = self._clock()
+        fin0 = len(sched.finished)
+        tok0 = self.decoded_tokens
+        # arrival offsets land on the engine clock at release time
+        for r in pending:
+            r.arrival_t = start + max(0.0, r.arrival_t)
+        try:
+            while pending or sched.queue or sched.running:
+                now = self._clock()
+                if timeout_s is not None and now - start > timeout_s:
+                    for req in list(sched.running) + list(sched.queue):
+                        if req in sched.queue:
+                            sched.queue.remove(req)
+                        sched.finish(req, 'engine_timeout')
+                    pending = []
+                    break
+                while pending and pending[0].arrival_t <= now:
+                    self.submit(pending.pop(0))
+                if not sched.queue and not sched.running:
+                    if pending:
+                        time.sleep(min(
+                            0.05, max(0.0, pending[0].arrival_t - now)))
+                    continue
+                if self.step(now=now) == 0 and not sched.running \
+                        and sched.queue:
+                    # nothing live and the head of the queue cannot be
+                    # admitted even into an empty pool: it can never
+                    # run — evict instead of spinning forever
+                    req = sched.queue.popleft()
+                    sched.finish(req, 'oom')
+                    self._note_finished([req], self._clock())
+        finally:
+            if self._prof is not None:
+                self._prof.close()
+        return self.report(wall_s=self.now_fn() - t0,
+                           finished_from=fin0, tokens_from=tok0)
+
+    # -- reporting / stats ---------------------------------------------------
+    def report(self, wall_s=None, finished_from=0, tokens_from=0):
+        """Aggregate latency/throughput report — over the whole engine
+        life by default, or over one run()'s window (its requests and
+        its decoded tokens) when the slicing args are given."""
+        now = self._clock()
+        sched = self.scheduler
+        recs = [r.record(now) for r in sched.finished[finished_from:]]
+        ttfts = sorted(r['ttft_s'] for r in recs
+                       if r['ttft_s'] is not None)
+        tpots = [r['tpot_s'] for r in recs if r['tpot_s'] is not None]
+
+        def pct(sorted_vals, q):
+            if not sorted_vals:
+                return None
+            i = min(len(sorted_vals) - 1,
+                    int(math.ceil(q * len(sorted_vals))) - 1)
+            return sorted_vals[max(0, i)]
+
+        decoded = self.decoded_tokens - tokens_from
+        return {
+            'requests': recs,
+            'counters': dict(sched.counters),
+            'decoded_tokens': decoded,
+            'interventions': self.interventions,
+            'wall_s': wall_s,
+            'tokens_per_s': decoded / wall_s if wall_s else None,
+            'ttft_p50_s': pct(ttfts, 0.50),
+            'ttft_p99_s': pct(ttfts, 0.99),
+            'tpot_mean_s': (sum(tpots) / len(tpots)) if tpots else None,
+            'compile_count': self.compile_count,
+            'modules': sorted(str(s) for s in self._modules),
+            'audit': sched.audit(),
+        }
+
+    def stats(self):
+        return {'compile_count': self.compile_count,
+                'modules': sorted(str(s) for s in self._modules),
+                'interventions': self.interventions,
+                'decoded_tokens': self.decoded_tokens,
+                'free_blocks': self.cache.free_blocks}
+
+    # -- AOT / declared bucket set -------------------------------------------
+    def bucket_set(self):
+        """The declared compiled-module signatures — what
+        ``tools/precompile.py --serve`` AOT-compiles and what the lint
+        gate sweeps."""
+        c = self.config
+        return {'prompt_buckets': list(c.prompt_buckets),
+                'batch_buckets': list(c.batch_buckets),
+                'prefill_chunks': list(_pow2_chain(1, c.prefill_batch)),
+                'decode_span': c.decode_span,
+                'block_size': c.block_size,
+                'max_slots': c.max_slots,
+                'max_model_len': c.max_model_len}
+
+    def warmup(self):
+        """Build AND execute every declared module once, on inert
+        inputs (all rows point at the trash block, decode lanes
+        inactive), so the call-path XLA compile happens NOW — the
+        deterministic cold-start a serving deploy pays once, after
+        which run() never compiles or first-call-stalls regardless of
+        which buckets the live traffic hits.  Returns stats()."""
+        import jax
+        import jax.numpy as jnp
+        params, buffers = self._params, self._buffers
+        key = jax.random.PRNGKey(self.config.seed)
+        for P in self.config.prompt_buckets:
+            nblk = blocks_for(P, self.config.block_size)
+            for B in _pow2_chain(1, self.config.prefill_batch):
+                mod = self._prefill_module(P, B)
+                ks, vs = (tuple(x) for x in zip(*self.cache.pools))
+                tok, ks, vs = mod(
+                    params, buffers, jnp.zeros((B, P), jnp.int64),
+                    jnp.full((B,), P, jnp.int32), ks, vs,
+                    jnp.zeros((B, nblk), jnp.int32), key)
+                self.cache.set_pools(list(zip(ks, vs)))
+                np.asarray(tok)
+        W = self.scheduler.table_width
+        for S in self.config.batch_buckets:
+            mod = self._decode_module(S, self.config.decode_span)
+            ks, vs = (tuple(x) for x in zip(*self.cache.pools))
+            toks, _valid, ks, vs = mod(
+                params, buffers, ks, vs,
+                jnp.zeros((S, W), jnp.int32),
+                jnp.zeros((S,), jnp.int64), jnp.zeros((S,), jnp.int64),
+                jnp.zeros((S,), bool), jnp.zeros((S,), jnp.int64), key)
+            self.cache.set_pools(list(zip(ks, vs)))
+            np.asarray(toks)
+        return self.stats()
+
+    def precompile(self):
+        """Export + AOT-compile every declared serving module into the
+        persistent compile cache (PR 7); returns sidecar entries for
+        ``compile_cache.write_precompile_manifest``.  A later engine in
+        a fresh process deserializes instead of tracing."""
+        import jax
+        entries, errors = [], {}
+        if not _cc.enabled():
+            return entries, {'cache': 'compile cache disabled'}
+        specs = [(f'serve-prefill bucket {P} chunk {B}',
+                  lambda P=P, B=B: self._prefill_spec(P, B))
+                 for P in self.config.prompt_buckets
+                 for B in _pow2_chain(1, self.config.prefill_batch)]
+        specs += [(f'serve-decode batch {S} span '
+                   f'{self.config.decode_span}',
+                   lambda S=S: self._decode_spec(
+                       S, self.config.decode_span))
+                  for S in self.config.batch_buckets]
+        for desc, make in specs:
+            try:
+                # the EXACT spec the runtime modules compile from —
+                # one source, so the AOT artifact can never drift
+                fn, fp, example, name, _donate = make()
+                if fp is None:
+                    errors[desc] = 'no fingerprint'
+                elif _cc.get('exec', fp) is None and \
+                        not _cc.store_executable(
+                            fp, jax.jit(fn), example, name=name,
+                            aot_compile=True):
+                    errors[desc] = 'export failed'
+                else:
+                    entries.append({'tier': 'exec', 'fingerprint': fp,
+                                    'description': desc})
+            except Exception as e:
+                errors[desc] = repr(e)
+        return entries, errors
+
+
+class DecodeAuditLayer(nn.Layer):
+    """One paged decode step as an ``analysis.targets`` audit surface:
+    a Layer whose forward runs the serving engine's per-step math
+    (paged views + ragged attention over the pool) so ``tpu_lint
+    --hlo``/``--plan`` can lower and audit the serving path with the
+    same machinery as the train steps."""
+
+    def __init__(self, model):
+        super().__init__()
+        self.model = model
+
+    def forward(self, tok, k_pools, v_pools, tables, ctx):
+        import jax.numpy as jnp
+
+        def raw(t):
+            return t.value if hasattr(t, 'value') else t
+
+        kp, vp = raw(k_pools), raw(v_pools)
+        tbl, cx = raw(tables), raw(ctx)
+        L = self.model.config.num_layers
+        views = [PagedCacheView(kp[l], vp[l], tbl, cx, cx + 1)
+                 for l in range(L)]
+        logits, views = self.model(tok, caches=views, pos=cx)
+        nk = jnp.stack([raw(v.k_pool) for v in views])
+        nv = jnp.stack([raw(v.v_pool) for v in views])
+        return logits, nk, nv

@@ -1,0 +1,194 @@
+"""Paged KV cache: fixed-size blocks in one preallocated pool.
+
+The serving engine never allocates per-sequence KV buffers.  Instead
+each layer owns ONE device pool ``[num_blocks, num_heads, block_size,
+head_dim]`` allocated once at engine construction, and every live
+sequence owns an ordered list of pool blocks (its *block table*).
+Admission allocates blocks, eviction frees them — memory churn is a
+host-side free-list operation, never a device reallocation, so the
+compiled decode step's shapes never change (the zero-recompile
+property the whole serving surface is built on).
+
+Block 0 is reserved as the **trash block**: inactive batch slots in a
+compiled decode step point their tables at it so their (masked,
+ignored) writes land somewhere harmless.  The allocator never hands
+out block 0, and ``audit()`` proves the invariants the churn tests
+lean on: a block is owned by at most one sequence, owned and free
+sets never intersect, and nothing leaks.
+
+Sharding: pools carry their heads on the ``tp`` mesh axis
+(``ops.paged_attention.POOL_SPEC``) — the same Megatron head split as
+the attention weights, applied by the engine's compiled steps via
+``maybe_shard`` when a mesh is installed.
+"""
+import jax
+import numpy as np
+
+__all__ = ['PagedKVCache', 'PagedCacheView', 'TRASH_BLOCK',
+           'blocks_for']
+
+TRASH_BLOCK = 0
+
+
+def blocks_for(num_positions, block_size):
+    """Blocks needed to hold `num_positions` cache slots."""
+    return -(-int(num_positions) // int(block_size))
+
+
+@jax.tree_util.register_pytree_node_class
+class PagedCacheView:
+    """One layer's paged cache as seen by a compiled decode step.
+
+    A pytree of (k_pool, v_pool, block_table, slots, lens):
+
+    - ``slots`` [S]: the absolute position this step WRITES (each
+      sequence's context length before its new token);
+    - ``lens`` [S]: the valid length the attention READS (slots + 1 —
+      the just-written token attends itself, exactly like the dense
+      cached path's causal row).
+
+    ``models/gpt.py::CausalSelfAttention`` dispatches on the ``paged``
+    marker: a view threaded through ``caches=`` routes the block's
+    attention to ``ops.paged_attention`` instead of the dense
+    preallocated buffer.  Views flow through jit/scan like any other
+    pytree; ``updated()`` is the functional write-back.
+    """
+
+    paged = True
+
+    def __init__(self, k_pool, v_pool, block_table, slots, lens):
+        self.k_pool = k_pool
+        self.v_pool = v_pool
+        self.block_table = block_table
+        self.slots = slots
+        self.lens = lens
+
+    def updated(self, k_pool, v_pool):
+        return PagedCacheView(k_pool, v_pool, self.block_table,
+                              self.slots, self.lens)
+
+    def tree_flatten(self):
+        return ((self.k_pool, self.v_pool, self.block_table,
+                 self.slots, self.lens), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        del aux
+        return cls(*children)
+
+
+class PagedKVCache:
+    """The pool + its host-side block allocator.
+
+    Device state: ``pools`` — one (k_pool, v_pool) pair per layer,
+    updated functionally by the engine after each compiled step
+    (``set_pools``).  Host state: a free list and the per-sequence
+    owned-block lists.  Allocation never partially succeeds: asking
+    for more blocks than are free changes nothing and returns False.
+    """
+
+    def __init__(self, num_layers, num_heads, head_dim, *,
+                 block_size, num_blocks, dtype=None, device_init=True):
+        import jax.numpy as jnp
+        if num_blocks < 2:
+            raise ValueError('num_blocks must be >= 2 (block 0 is the '
+                             'reserved trash block)')
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.dtype = dtype or jnp.float32
+        if device_init:
+            shape = (self.num_blocks, self.num_heads, self.block_size,
+                     self.head_dim)
+            self.pools = [(jnp.zeros(shape, self.dtype),
+                           jnp.zeros(shape, self.dtype))
+                          for _ in range(self.num_layers)]
+        else:           # allocator-only (churn tests, audits)
+            self.pools = None
+        # LIFO free list: freshly freed blocks are the warmest
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self._owned = {}            # seq_id -> [block ids, in order]
+
+    # -- allocator ----------------------------------------------------------
+    @property
+    def free_blocks(self):
+        return len(self._free)
+
+    def owned(self, seq_id):
+        return list(self._owned.get(seq_id, ()))
+
+    def can_cover(self, seq_id, num_positions):
+        need = blocks_for(num_positions, self.block_size) \
+            - len(self._owned.get(seq_id, ()))
+        return need <= len(self._free)
+
+    def ensure(self, seq_id, num_positions):
+        """Grow `seq_id`'s block list to cover `num_positions` cache
+        slots.  All-or-nothing: False (and no change) when the free
+        list cannot cover the growth."""
+        have = self._owned.setdefault(seq_id, [])
+        need = blocks_for(num_positions, self.block_size) - len(have)
+        if need <= 0:
+            return True
+        if need > len(self._free):
+            return False
+        for _ in range(need):
+            have.append(self._free.pop())
+        return True
+
+    def free_seq(self, seq_id):
+        """Release every block `seq_id` owns; returns how many."""
+        blocks = self._owned.pop(seq_id, [])
+        self._free.extend(reversed(blocks))
+        return len(blocks)
+
+    def table_row(self, seq_id, width):
+        """`seq_id`'s block table padded (with the trash block) to a
+        fixed `width` — one row of a compiled step's table input."""
+        blocks = self._owned.get(seq_id, ())
+        if len(blocks) > width:
+            raise ValueError(
+                f'sequence {seq_id} owns {len(blocks)} blocks > table '
+                f'width {width}')
+        row = np.full((width,), TRASH_BLOCK, np.int32)
+        row[:len(blocks)] = blocks
+        return row
+
+    def audit(self):
+        """Invariant check; returns a list of violation strings (empty
+        = healthy).  The churn property tests call this after every
+        mutation."""
+        problems = []
+        seen = {}
+        for sid, blocks in self._owned.items():
+            for b in blocks:
+                if b == TRASH_BLOCK or not 0 < b < self.num_blocks:
+                    problems.append(f'seq {sid} owns illegal block {b}')
+                if b in seen:
+                    problems.append(
+                        f'block {b} aliased by seqs {seen[b]} and {sid}')
+                seen[b] = sid
+        free = set(self._free)
+        if len(free) != len(self._free):
+            problems.append('free list holds duplicates')
+        both = free & set(seen)
+        if both:
+            problems.append(f'blocks {sorted(both)} both free and owned')
+        if TRASH_BLOCK in free:
+            problems.append('trash block on the free list')
+        if len(free) + len(seen) != self.num_blocks - 1:
+            problems.append(
+                f'leak: {self.num_blocks - 1 - len(free) - len(seen)} '
+                'block(s) neither free nor owned')
+        return problems
+
+    # -- device pools -------------------------------------------------------
+    def set_pools(self, pools):
+        """Functional write-back after a compiled step."""
+        self.pools = list(pools)
+
+    def layer_view(self, layer, block_tables, slots, lens):
+        k, v = self.pools[layer]
+        return PagedCacheView(k, v, block_tables, slots, lens)

@@ -1,0 +1,40 @@
+"""Seeded synthetic load for the serving engine.
+
+Poisson arrivals (exponential inter-arrival gaps at ``rate_rps``) with
+mixed prompt/output lengths drawn from declared choice sets — the
+ROADMAP item-1 contract that makes scheduler policies *benchmarkable*:
+the same seed always produces the same request set with the same
+arrival times, so two engine configurations (or an engine vs a
+sequential baseline) see identical offered load.
+"""
+import numpy as np
+
+from .scheduler import Request
+
+__all__ = ['poisson_requests']
+
+
+def poisson_requests(num_requests, *, rate_rps, prompt_lens,
+                     new_tokens, vocab_size, seed=0, deadline_s=None,
+                     start_t=0.0):
+    """A deterministic request list sorted by arrival time.
+
+    prompt_lens / new_tokens: sequences of lengths sampled uniformly
+    per request (mixed-length traffic); ``rate_rps`` the Poisson
+    arrival rate; ``deadline_s`` an optional per-request completion
+    budget (the watchdog-deadline seed).
+    """
+    rs = np.random.RandomState(int(seed))
+    prompt_lens = list(prompt_lens)
+    new_tokens = list(new_tokens)
+    t = float(start_t)
+    out = []
+    for i in range(int(num_requests)):
+        t += rs.exponential(1.0 / float(rate_rps))
+        t0 = int(prompt_lens[rs.randint(len(prompt_lens))])
+        new = int(new_tokens[rs.randint(len(new_tokens))])
+        prompt = rs.randint(0, int(vocab_size), size=(t0,)) \
+            .astype(np.int64)
+        out.append(Request(f'req-{seed}-{i:04d}', prompt, new,
+                           arrival_t=t, deadline_s=deadline_s))
+    return out

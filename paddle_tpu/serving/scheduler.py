@@ -1,0 +1,314 @@
+"""Continuous-batching request scheduler.
+
+The unit of scheduling is the *decode intervention*: between two
+interventions the engine runs one compiled multi-step decode over the
+live set; at each intervention the scheduler
+
+- releases newly-arrived requests from the load/clock into the queue,
+- **admits** queued requests while a batch slot AND enough free KV
+  blocks exist (prefill happens immediately on admission),
+- **evicts** finished requests (EOS, max tokens, deadline breach) and
+  frees their blocks — the freed capacity backfills from the queue at
+  the SAME intervention, so the batch never idles half-empty while
+  work queues,
+- **reserves** blocks so every live sequence can absorb the next
+  fused decode span without any allocation inside the compiled step.
+
+When reservation cannot cover the live set (pool pressure), the
+youngest running request is *preempted* back to the queue — its
+blocks free immediately and it re-prefills later (recompute-style
+preemption, the simple/robust vLLM policy).
+
+All host-side bookkeeping: the scheduler never touches a device
+array.  The engine asks for a :class:`DecodePlan` (padded numpy
+tables/lengths bucketed to the declared pow2 batch set) and reports
+back the decoded tokens.
+"""
+import collections
+import time
+
+import numpy as np
+
+from .kv_cache import TRASH_BLOCK, blocks_for
+
+__all__ = ['Request', 'DecodePlan', 'ContinuousBatchingScheduler']
+
+
+class Request:
+    """One generation request moving through the serving engine."""
+
+    QUEUED, RUNNING, DONE, EVICTED = 'queued', 'running', 'done', \
+        'evicted'
+
+    def __init__(self, rid, prompt, max_new_tokens, *, arrival_t=0.0,
+                 deadline_s=None):
+        self.rid = rid
+        self.prompt = np.asarray(prompt, np.int64).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError('empty prompt')
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError('max_new_tokens must be >= 1')
+        self.arrival_t = float(arrival_t)
+        self.deadline_s = deadline_s
+        self.state = Request.QUEUED
+        self.reason = None          # eos | max_tokens | deadline | ...
+        self.tokens = []            # decoded token ids (ints)
+        self.ctx = 0                # cache positions written so far
+        self.prompt_bucket = None   # padded prefill length (pow2)
+        self.first_token_t = None   # wall time of the first token
+        self.finish_t = None
+        self.preemptions = 0
+        self.discarded_tokens = 0   # last preemption's recompute debt
+
+    @property
+    def done(self):
+        return self.state in (Request.DONE, Request.EVICTED)
+
+    # emitted-token accounting: after prefill ctx == t0 and ONE token
+    # exists; each decode step advances ctx and emits one more.  The
+    # last useful decode step is the one reaching ctx == limit - 1.
+    @property
+    def limit(self):
+        return self.prompt.size + self.max_new_tokens - 1
+
+    def record(self, now, ttft_anchor=None):
+        """Latency summary for reports/telemetry."""
+        anchor = self.arrival_t if ttft_anchor is None else ttft_anchor
+        ttft = None if self.first_token_t is None \
+            else self.first_token_t - anchor
+        tpot = None
+        if self.finish_t is not None and self.first_token_t is not None \
+                and len(self.tokens) > 1:
+            tpot = (self.finish_t - self.first_token_t) \
+                / (len(self.tokens) - 1)
+        return {'rid': self.rid, 'state': self.state,
+                'reason': self.reason, 'prompt_len': int(self.prompt.size),
+                'tokens': len(self.tokens), 'ttft_s': ttft,
+                'tpot_s': tpot, 'preemptions': self.preemptions,
+                'age_s': (now - self.arrival_t)}
+
+
+class DecodePlan:
+    """One intervention's padded decode inputs (host numpy)."""
+
+    def __init__(self, requests, batch_bucket, table_width, span):
+        self.requests = list(requests)        # live order, <= bucket
+        self.batch = int(batch_bucket)
+        self.span = int(span)
+        self.tables = np.full((self.batch, table_width), TRASH_BLOCK,
+                              np.int32)
+        self.ctx = np.zeros((self.batch,), np.int64)
+        self.tok = np.zeros((self.batch,), np.int64)
+        self.active = np.zeros((self.batch,), bool)
+        self.limit = np.zeros((self.batch,), np.int64)
+
+
+class ContinuousBatchingScheduler:
+    """Admission/eviction policy over a :class:`PagedKVCache`.
+
+    ``bucket_fn(prompt_len) -> padded prefill length`` comes from the
+    engine (its declared pow2 prompt-bucket set); ``batch_buckets`` is
+    the declared pow2 set of decode batch sizes (must contain
+    ``max_slots``).
+    """
+
+    def __init__(self, cache, *, max_slots, batch_buckets, bucket_fn,
+                 max_model_len, decode_span=1, eos_id=None,
+                 now_fn=time.monotonic):
+        self.cache = cache
+        self.max_slots = int(max_slots)
+        self.batch_buckets = tuple(sorted(set(
+            int(b) for b in batch_buckets)))
+        if self.max_slots not in self.batch_buckets:
+            raise ValueError(
+                f'batch_buckets {self.batch_buckets} must contain '
+                f'max_slots {self.max_slots}')
+        self.bucket_fn = bucket_fn
+        self.max_model_len = int(max_model_len)
+        self.decode_span = max(1, int(decode_span))
+        self.eos_id = eos_id
+        self.now_fn = now_fn
+        self.table_width = blocks_for(self.max_model_len,
+                                      cache.block_size)
+        self.queue = collections.deque()
+        self.running = []            # admission order (oldest first)
+        self.finished = []
+        self.counters = collections.Counter()
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, req):
+        total = req.prompt.size + req.max_new_tokens
+        if total > self.max_model_len:
+            raise ValueError(
+                f'request {req.rid}: prompt+new {total} exceeds '
+                f'max_model_len {self.max_model_len}')
+        # feasibility: the request's WORST-CASE block need (prefill
+        # bucket or its full trajectory, whichever is larger) must fit
+        # an empty pool — otherwise reservation would preempt it
+        # against itself forever (admit -> decode -> self-preempt ->
+        # re-admit livelock)
+        worst = blocks_for(max(int(self.bucket_fn(req.prompt.size)),
+                               req.limit), self.cache.block_size)
+        if worst > self.cache.num_blocks - 1:
+            raise ValueError(
+                f'request {req.rid}: needs {worst} KV blocks at its '
+                f'longest, pool only has {self.cache.num_blocks - 1}')
+        self.queue.append(req)
+        self.counters['submitted'] += 1
+        return req
+
+    # -- admission ----------------------------------------------------------
+    def admit_next(self):
+        """Admit the head of the queue if a slot and blocks exist;
+        returns the Request (caller prefills it) or None."""
+        if not self.queue or len(self.running) >= self.max_slots:
+            return None
+        req = self.queue[0]
+        bucket = int(self.bucket_fn(req.prompt.size))
+        # the prefill scatter writes the whole (block-rounded) bucket;
+        # reserving one decode span up front keeps admission from
+        # thrashing against the very next reservation pass
+        need = max(bucket,
+                   min(req.prompt.size + self.decode_span, req.limit))
+        if not self.cache.ensure(req.rid, need):
+            return None
+        self.queue.popleft()
+        req.state = Request.RUNNING
+        req.prompt_bucket = bucket
+        req.ctx = req.prompt.size
+        self.running.append(req)
+        self.counters['admitted'] += 1
+        return req
+
+    # -- eviction / completion ----------------------------------------------
+    def finish(self, req, reason):
+        req.state = Request.DONE if reason in ('eos', 'max_tokens') \
+            else Request.EVICTED
+        req.reason = reason
+        req.finish_t = self.now_fn()
+        self.cache.free_seq(req.rid)
+        if req in self.running:
+            self.running.remove(req)
+        self.finished.append(req)
+        self.counters['evicted' if req.state == Request.EVICTED
+                      else 'completed'] += 1
+
+    def preempt_youngest(self):
+        """Pool pressure: push the newest running request back to the
+        queue head (recompute-style — its blocks free now, it
+        re-prefills from scratch later)."""
+        if not self.running:
+            return None
+        req = self.running.pop()
+        self.cache.free_seq(req.rid)
+        req.state = Request.QUEUED
+        # the discarded work is recomputed after re-admission — the
+        # engine rolls its decoded-token accounting back by this much
+        # so throughput never counts a token twice
+        req.discarded_tokens = len(req.tokens)
+        self.counters['discarded_tokens'] += req.discarded_tokens
+        req.tokens = []
+        req.ctx = 0
+        req.first_token_t = None
+        req.preemptions += 1
+        self.queue.appendleft(req)
+        self.counters['preempted'] += 1
+        return req
+
+    def check_deadlines(self, now):
+        """Evict running AND queued requests past their deadline —
+        the watchdog-budget starvation guard."""
+        breached = [r for r in list(self.running) + list(self.queue)
+                    if r.deadline_s is not None
+                    and now - r.arrival_t > r.deadline_s]
+        for req in breached:
+            if req in self.queue:
+                self.queue.remove(req)
+            self.finish(req, 'deadline')
+        return breached
+
+    # -- decode planning -----------------------------------------------------
+    def reserve_span(self, span):
+        """Reserve blocks so every live sequence can write `span` more
+        positions (capped at its own limit).  Preempts the youngest
+        request(s) on pool pressure; returns the preempted list."""
+        preempted = []
+        i = 0
+        while i < len(self.running):
+            req = self.running[i]
+            need = min(req.ctx + span, req.limit)
+            if self.cache.ensure(req.rid, need):
+                i += 1
+                continue
+            victim = self.preempt_youngest()
+            preempted.append(victim)
+            if victim is req:
+                continue            # re-check from the same index
+            # a younger victim freed blocks; retry this request
+        return preempted
+
+    def plan(self, span=None):
+        """Build the DecodePlan for the current live set (None when
+        nothing is running).  Batch is padded to the smallest declared
+        pow2 bucket >= live count; padding rows point at the trash
+        block and stay inactive."""
+        if not self.running:
+            return None
+        span = self.decode_span if span is None else int(span)
+        live = len(self.running)
+        batch = next(b for b in self.batch_buckets if b >= live)
+        plan = DecodePlan(self.running, batch, self.table_width, span)
+        for i, req in enumerate(self.running):
+            plan.tables[i] = self.cache.table_row(req.rid,
+                                                  self.table_width)
+            plan.ctx[i] = req.ctx
+            plan.tok[i] = req.tokens[-1]
+            plan.active[i] = len(req.tokens) < req.max_new_tokens
+            plan.limit[i] = req.limit
+        return plan
+
+    def absorb(self, plan, toks, valid):
+        """Fold one decode span's outputs back into the requests:
+        append valid tokens, finish on EOS / max tokens.  ``toks`` and
+        ``valid`` are ``[span, batch]`` host arrays."""
+        finished = []
+        for i, req in enumerate(plan.requests):
+            emitted = 0
+            for k in range(plan.span):
+                if not valid[k, i] or req.done:
+                    break
+                tok = int(toks[k, i])
+                req.tokens.append(tok)
+                emitted += 1
+                if self.eos_id is not None and tok == self.eos_id:
+                    self.finish(req, 'eos')
+                    break
+                if len(req.tokens) >= req.max_new_tokens:
+                    self.finish(req, 'max_tokens')
+                    break
+            req.ctx = min(req.ctx + emitted, req.limit)
+            if req.done:
+                finished.append(req)
+        self.counters['decode_steps'] += plan.span
+        return finished
+
+    # -- invariants ----------------------------------------------------------
+    def audit(self):
+        """Scheduler+allocator invariants; list of violations."""
+        problems = list(self.cache.audit())
+        states = collections.Counter(r.state for r in self.running)
+        if set(states) - {Request.RUNNING}:
+            problems.append(f'non-running request in live set: {states}')
+        for req in self.running:
+            covered = len(self.cache.owned(req.rid)) \
+                * self.cache.block_size
+            if covered < req.ctx:
+                problems.append(
+                    f'request {req.rid}: ctx {req.ctx} exceeds its '
+                    f'{covered} covered cache positions')
+        for req in self.finished:
+            if self.cache.owned(req.rid):
+                problems.append(
+                    f'finished request {req.rid} still owns blocks')
+        return problems

@@ -92,6 +92,14 @@ EVENT_KINDS = (
     'fused_clamp',         # a fused K-chunk exceeded the watchdog
                            # step budget's capacity (requested, fits)
                            # — stage fused_chunk_len() chunks instead
+    'serve_step',          # one serving-engine intervention (live
+                           # set size, batch bucket, span, decoded
+                           # tokens, admissions/evictions/preemptions,
+                           # free KV blocks) — serving/engine.py
+    'serve_request',       # one serving request finished (rid,
+                           # state/reason, prompt_len, tokens, TTFT,
+                           # TPOT, preemptions) — deadline breaches
+                           # additionally emit a 'timeout' event
     'steps',               # StepAccumulator flush (per-step scalars;
                            # fused chunk rows arrive expanded to
                            # per-step entries)

@@ -843,7 +843,10 @@ class TestSelfLintHlo:
         assert res.returncode == 0, res.stdout + res.stderr
         doc = json.loads(res.stdout)
         assert doc['counts']['high'] == 0, doc
-        assert set(doc['hlo']) == {'gpt', 'widedeep', 'lenet'}
+        # gptserve joined the suite in PR 12 (the serving decode step
+        # as an audit target)
+        assert set(doc['hlo']) == {'gpt', 'widedeep', 'lenet',
+                                   'gptserve'}
         for name, rep in doc['hlo'].items():
             assert rep['counts']['high'] == 0, (name, rep)
             ex = rep['extras']

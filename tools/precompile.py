@@ -174,6 +174,43 @@ def _precompile_target(name, mesh_axes, entries, errors,
         errors[desc] = repr(e)
 
 
+def _precompile_serve(config_path, entries, errors):
+    """--serve CONFIG: AOT-compile the WHOLE serving surface a config
+    declares — every prompt-bucket prefill module and every
+    (batch bucket x decode span) fused decode module
+    (paddle_tpu/serving) — into the exec tier, so a serving cold
+    start deserializes instead of tracing (zero cold-start compiles).
+    Returns the engine's declared bucket set for the sidecar meta."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import gpt as _gpt
+    from paddle_tpu.serving import ServeConfig, ServingEngine
+    try:
+        with open(config_path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        errors[f'serve config {config_path}'] = repr(e)
+        return None
+    model_name = doc.get('model', 'small')
+    builders = {'tiny': _gpt.gpt_tiny, 'small': _gpt.gpt_small}
+    if model_name not in builders:
+        errors[f'serve config {config_path}'] = \
+            f'unknown model {model_name!r} (have {list(builders)})'
+        return None
+    paddle.seed(0)
+    kw = dict(doc.get('model_kwargs') or {})
+    kw.setdefault('dropout', 0.0)
+    try:
+        model = builders[model_name](**kw)
+        engine = ServingEngine(model, ServeConfig.from_json(doc))
+        serve_entries, serve_errors = engine.precompile()
+    except Exception as e:
+        errors[f'serve config {config_path}'] = repr(e)
+        return None
+    entries.extend(serve_entries)
+    errors.update({f'serve: {k}': v for k, v in serve_errors.items()})
+    return dict(engine.bucket_set(), model=model_name)
+
+
 def _precompile_decode(model_name, shape, kwargs, entries, errors):
     import paddle_tpu as paddle
     from paddle_tpu.models import gpt as _gpt
@@ -234,6 +271,14 @@ def main(argv=None):
                     help='gptgen decode bucket signatures to export, '
                          'e.g. "8x128x128,8x64x128" (prompt lengths '
                          'are bucketed to the next power of two)')
+    ap.add_argument('--serve', metavar='CONFIG', default=None,
+                    help='serving config JSON (paddle_tpu/serving '
+                         'ServeConfig fields + "model"/"model_kwargs")'
+                         ': AOT-compile its WHOLE declared bucket set '
+                         '— every prompt-bucket prefill and every '
+                         'batch-bucket fused decode module — so a '
+                         'serving cold start deserializes instead of '
+                         'tracing')
     ap.add_argument('--gpt-model', choices=('tiny', 'small'),
                     default='small',
                     help='GPT config the decode buckets compile for')
@@ -293,12 +338,16 @@ def main(argv=None):
     for shape in decode:
         _precompile_decode(args.gpt_model, shape, kwargs, entries,
                            errors)
+    serve_buckets = None
+    if args.serve:
+        serve_buckets = _precompile_serve(args.serve, entries, errors)
 
     doc = _cc.write_precompile_manifest(
         args.run_dir, entries,
         meta={'meshes': [m or {} for m in meshes],
               'reshape_meshes': reshape,
-              'fused_steps': fused})
+              'fused_steps': fused,
+              'serve_buckets': serve_buckets})
     summary = {'run_dir': os.path.abspath(args.run_dir),
                'cache_dir': _cc.cache_dir(),
                'entries': len(entries),

@@ -424,7 +424,7 @@ def main(argv=None):
         # Match whole path components, not substrings (tests/
         # test_models.py is NOT a models/ path).
         wants_suite = any(
-            part in ('examples', 'models')
+            part in ('examples', 'models', 'serving')
             for p in args.paths
             for part in os.path.normpath(os.path.abspath(p))
             .split(os.sep))
