@@ -681,9 +681,13 @@ def _run_no_kill(name, smoke, timeout_s):
 
 
 def _device_preflight_once(timeout_s):
-    """Run one tiny jitted op in a subprocess: True iff the device
-    stack (incl. a possibly-wedged dev tunnel) answers within
-    timeout_s.  Executed in a child so a hang cannot wedge US."""
+    """Run one tiny jitted op in a subprocess: (True, None) iff the
+    device stack (incl. a possibly-wedged dev tunnel) answers within
+    timeout_s, else (False, reason) — the reason (timeout vs crash,
+    with rc + stderr tail) lands in the bench artifact so a failed
+    chip round is diagnosable after the fact (BENCH rounds r02-r05
+    all failed preflight with NOTHING captured).  Executed in a child
+    so a hang cannot wedge US."""
     import subprocess
     code = ('import jax, jax.numpy as jnp, numpy as np;'
             'v = float(np.asarray(jax.jit(lambda a: a.sum())'
@@ -695,21 +699,26 @@ def _device_preflight_once(timeout_s):
                               timeout=timeout_s)
     except subprocess.TimeoutExpired:
         log(f'device preflight attempt timed out after {timeout_s}s')
-        return False
-    ok = 'PREFLIGHT_OK' in proc.stdout
-    if not ok:
-        log(f'device preflight failed (rc={proc.returncode}): '
-            f'{proc.stderr[-300:]}')
-    return ok
+        return False, (f'timeout after {timeout_s:.0f}s (tiny jitted '
+                       'op never answered — wedged tunnel?)')
+    if 'PREFLIGHT_OK' in proc.stdout:
+        return True, None
+    reason = (f'rc={proc.returncode}: '
+              f'{(proc.stderr or proc.stdout)[-300:].strip()}')
+    log(f'device preflight failed ({reason})')
+    return False, reason
 
 
 def _device_preflight(total_budget_s=600):
     """Preflight with RETRY + BACKOFF: the dev tunnel recovers from
     transient wedges in minutes (round-2 lesson: a single 180s attempt
     nulled the whole artifact).  Attempts at ~0/1/2/4-minute marks
-    within total_budget_s, then give up fast with the error artifact."""
+    within total_budget_s, then give up fast with the error artifact.
+    Returns (ok, attempts) — attempts is the per-try diagnosis list
+    that rides into the artifact when every try failed."""
     deadline = time.time() + total_budget_s
     waits = [0, 60, 120, 240]
+    attempts = []
     for i, w in enumerate(waits):
         remaining = deadline - time.time()
         if remaining <= 10:
@@ -720,11 +729,14 @@ def _device_preflight(total_budget_s=600):
                 f'({remaining:.0f}s of budget left)')
             time.sleep(min(w, max(0, remaining - 60)))
         attempt_s = min(120, max(30, deadline - time.time()))
-        if _device_preflight_once(attempt_s):
+        ok, reason = _device_preflight_once(attempt_s)
+        if ok:
             if i:
                 log('preflight recovered after retry')
-            return True
-    return False
+            return True, attempts
+        attempts.append({'attempt': i, 'timeout_s': round(attempt_s),
+                         'reason': reason})
+    return False, attempts
 
 
 def _write_partial(results, smoke=False):
@@ -1432,6 +1444,180 @@ def _serve_preflight(smoke, timeout_s=900):
     return ok, summary
 
 
+def _obs_smoke_child(smoke):
+    """--obs-smoke child: one serving engine with the live
+    observability plane ON (`serve_metrics_port=0` — ephemeral
+    127.0.0.1 port), short Poisson load, a scraper thread hitting
+    /metrics + /status.json every 200ms THROUGHOUT the measured run.
+    Emits one JSON line with the gate evidence:
+
+    - mid-run scrapes carry populated TTFT/TPOT percentiles and the
+      KV-occupancy gauge (the live plane actually aggregates),
+    - zero post-warmup compiles with the scraper attached (scraping
+      cannot perturb the compiled surface),
+    - a NON-serving trainer loop with the LiveAggregator installed
+      stays sync-free under a device->host transfer guard (the live
+      plane is free to leave on everywhere).
+    """
+    import tempfile
+    import threading
+    import urllib.request
+    import numpy as np  # noqa: F811
+    del smoke       # the gate always runs the CPU smoke scale
+    os.environ['PADDLE_TPU_COMPILE_CACHE'] = tempfile.mkdtemp(
+        prefix='bench_obs_cc_')
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, telemetry
+    from paddle_tpu.serving import ServingEngine
+
+    out = {}
+    model, cfg, load = _serve_setup(smoke=True)
+    eng = ServingEngine(model, cfg, serve_metrics_port=0)
+    url = eng.metrics_server.url
+    eng.warmup()                    # builds every module, marks steady
+    compiles0 = eng.compile_count
+
+    scrapes = {'status': [], 'metrics': [], 'errors': []}
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.wait(0.2):
+            try:
+                scrapes['metrics'].append(urllib.request.urlopen(
+                    url + '/metrics', timeout=5).read().decode())
+                scrapes['status'].append(json.loads(
+                    urllib.request.urlopen(
+                        url + '/status.json', timeout=5).read()))
+            except Exception as e:
+                scrapes['errors'].append(repr(e)[:200])
+
+    th = threading.Thread(target=scraper, daemon=True)
+    th.start()
+    rep = eng.run(load(seed=11))
+    stop.set()
+    th.join(timeout=10)
+    status = json.loads(urllib.request.urlopen(
+        url + '/status.json', timeout=5).read())
+    metrics = urllib.request.urlopen(
+        url + '/metrics', timeout=5).read().decode()
+    eng.close()
+    all_status = scrapes['status'] + [status]
+    populated = [s for s in all_status
+                 if s['serving']['ttft_ms'].get('count')
+                 and s['serving']['tpot_ms'].get('count')
+                 and 'kv_occupancy' in s['serving']['gauges']]
+    out['scrapes'] = len(scrapes['status'])
+    out['scrape_errors'] = scrapes['errors'][:5]
+    out['populated_scrapes'] = len(populated)
+    out['ttft_p99_ms'] = status['serving']['ttft_ms'].get('p99')
+    out['tpot_p50_ms'] = status['serving']['tpot_ms'].get('p50')
+    out['tokens_per_s'] = rep['tokens_per_s']
+    out['metrics_has_ttft'] = 'paddle_tpu_serve_ttft_ms' in metrics
+    out['metrics_has_occupancy'] = \
+        'paddle_tpu_serve_kv_occupancy' in metrics
+    out['compiles_after_warmup'] = eng.compile_count - compiles0
+    out['post_steady_compiles'] = status['compiles']['after_steady']
+    out['alerts'] = [a.get('kind') for a in status['alerts']]
+
+    # (c) a non-serving trainer loop with live.py enabled stays
+    # sync-free: the aggregator consumes only buffered flushes, so a
+    # transfer guard over the hot loop must not trip
+    from paddle_tpu.telemetry import LiveAggregator
+    agg = LiveAggregator().install()
+    telemetry.enable(None)
+    try:
+        paddle.seed(0)
+        m2 = paddle.hapi.Model(nn.Sequential(
+            nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4)))
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=m2.parameters())
+        m2.prepare(optimizer=opt, loss=nn.MSELoss())
+        m2._check_finite_steps = False      # NanGuard(enable=False)
+        rs = np.random.RandomState(0)
+        x = rs.randn(8, 16).astype('float32')
+        y = rs.randn(8, 4).astype('float32')
+        m2.train_batch(x, y)        # compile outside the guard
+        acc = telemetry.step_accumulator('obsguard')
+        try:
+            with jax.transfer_guard_device_to_host('disallow'):
+                for i in range(8):
+                    t0 = time.perf_counter()
+                    loss, _ = m2.train_batch(x, y)
+                    acc.observe(step=i,
+                                step_time_s=time.perf_counter() - t0,
+                                loss=loss)
+            out['sync_free_ok'] = True
+        except Exception as e:
+            out['sync_free_ok'] = False
+            out['sync_free_error'] = repr(e)[:300]
+        acc.flush()                 # the one sync, at the boundary
+        out['live_saw_steps'] = bool(
+            agg.step_ms.get('obsguard')
+            and agg.step_ms['obsguard'].percentiles())
+    finally:
+        agg.uninstall()
+        telemetry.disable()
+    print(json.dumps(out))
+
+
+def _obs_preflight(smoke, timeout_s=900):
+    """--obs-smoke gate (the ISSUE-13 acceptance bar): with the live
+    metrics endpoint up and scraped every 200ms through a Poisson
+    serving run, (a) mid-run scrapes must carry populated TTFT/TPOT
+    percentiles and the occupancy gauge, (b) the engine must compile
+    NOTHING after warmup (a scraper cannot perturb the compiled
+    surface), and (c) a non-serving trainer loop with the live
+    aggregator installed must stay sync-free under a transfer guard.
+    Returns (ok, summary); infra failures never block — evidence
+    beats a dead gate — but a violated bar always does."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    cmd = [sys.executable, os.path.abspath(__file__),
+           '--obs-smoke-child'] + (['--smoke'] if smoke else [])
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=env)
+        doc = _last_json_dict(proc.stdout)
+    except Exception as e:
+        log(f'obs preflight skipped ({e!r})')
+        return True, {'error': repr(e)[:200]}
+    if doc is None:
+        log(f'obs preflight skipped (no child output, '
+            f'rc={proc.returncode}): {proc.stderr[-300:]}')
+        return True, {'error': f'no output (rc={proc.returncode})'}
+    failures = []
+    if not doc.get('populated_scrapes'):
+        failures.append('no mid-run scrape carried populated '
+                        'TTFT/TPOT percentiles + occupancy gauge')
+    if not doc.get('metrics_has_ttft') \
+            or not doc.get('metrics_has_occupancy'):
+        failures.append('/metrics missing the TTFT or occupancy '
+                        'families')
+    if doc.get('compiles_after_warmup'):
+        failures.append(f'{doc["compiles_after_warmup"]} compile(s) '
+                        'after warmup with the scraper attached')
+    if not doc.get('sync_free_ok'):
+        failures.append('trainer loop with LiveAggregator installed '
+                        'synced the host: '
+                        + str(doc.get('sync_free_error')))
+    if not doc.get('live_saw_steps'):
+        failures.append('the aggregator never aggregated the trainer '
+                        "loop's steps flushes (live plane blind to "
+                        'training)')
+    summary = dict(doc, failures=failures)
+    ok = not failures
+    log(f'obs preflight: {"ok" if ok else "FAIL"} '
+        f'({doc.get("populated_scrapes")}/{doc.get("scrapes")} '
+        f'populated scrapes, p99 TTFT {doc.get("ttft_p99_ms")}ms, '
+        f'post-warmup compiles={doc.get("compiles_after_warmup")}, '
+        f'sync_free={doc.get("sync_free_ok")})')
+    for f in failures:
+        log(f'  {f}')
+    return ok, summary
+
+
 def _fused_preflight(smoke, timeout_s=900):
     """--fused-smoke gate: the fused K-step loop must (1) be bit-exact
     with the per-step loop at K=1 and (2) show a steps/sec uplift at
@@ -1608,6 +1794,16 @@ def main():
     p.add_argument('--serve-smoke-child', action='store_true',
                    help='(internal) run the serve-smoke measurement '
                         'and emit its JSON')
+    p.add_argument('--obs-smoke', action='store_true',
+                   help='preflight gate: live observability plane — '
+                        'a serving run with the HTTP status server '
+                        'on, scraped mid-run, must show populated '
+                        'TTFT/TPOT percentiles + occupancy gauges, '
+                        'zero post-warmup compiles, and a sync-free '
+                        'trainer loop with the aggregator installed')
+    p.add_argument('--obs-smoke-child', action='store_true',
+                   help='(internal) run the obs-smoke measurement '
+                        'and emit its JSON')
     p.add_argument('--fused-smoke', action='store_true',
                    help='steps/sec-vs-K sweep (K in {1,8,32}) of the '
                         'fused train loop on the lenet/widedeep '
@@ -1643,6 +1839,10 @@ def main():
         _serve_smoke_child(args.smoke)
         return
 
+    if args.obs_smoke_child:
+        _obs_smoke_child(args.smoke)
+        return
+
     if args.single_json:
         if args.config == 'all':
             p.error('--single-json needs an explicit --config NAME')
@@ -1659,6 +1859,24 @@ def main():
     profile_summary = None
     fused_summary = None
     serve_summary = None
+    obs_summary = None
+    if args.obs_smoke:
+        obs_ok, obs_summary = _obs_preflight(args.smoke)
+        if not obs_ok:
+            # a dead live plane means a serving deploy flies blind
+            # (no mid-run TTFT/occupancy) or — worse — observing the
+            # engine perturbs it; fail before burning chip time
+            print(json.dumps({
+                'metric': METRIC_NAMES['resnet'], 'value': None,
+                'unit': UNITS['resnet'], 'vs_baseline': None,
+                'error': 'obs preflight failed (live metrics endpoint '
+                         'unpopulated, post-warmup compiles with a '
+                         'scraper attached, or a host sync from the '
+                         'live aggregator); fix telemetry.live / '
+                         'telemetry.httpd or re-run without '
+                         '--obs-smoke',
+                'obs': obs_summary, 'extras': {}}))
+            sys.exit(1)
     if args.serve_smoke:
         serve_ok, serve_summary = _serve_preflight(args.smoke)
         if not serve_ok:
@@ -1764,19 +1982,29 @@ def main():
                 'lint': lint_summary, 'extras': {}}))
             sys.exit(1)
     preflight_s = min(600, args.timeout * len(names))
-    if args.config == 'all' and not _device_preflight(preflight_s):
+    preflight_attempts = None
+    if args.config == 'all':
+        preflight_ok, preflight_attempts = \
+            _device_preflight(preflight_s)
+    else:
+        preflight_ok = True
+    if not preflight_ok:
         # dead accelerator tunnel: emit the artifact immediately with
         # errors instead of hanging 5 subprocesses to their timeouts —
         # but surface the most recent committed chip-verified number
         # per config (tagged stale_from) so a tunnel death at driver
         # time preserves real measurements with honest provenance;
         # top-level value stays null so staleness can never
-        # masquerade as a fresh number
+        # masquerade as a fresh number.  The per-attempt diagnosis
+        # (timeout vs crash, rc, stderr tail) rides along — rounds
+        # r02-r05 failed here with no reason captured.
+        why = (preflight_attempts or [{}])[-1].get('reason')
         stale = _load_chip_results()
         for n in names:
             r = {'value': None, 'unit': UNITS[n],
                  'error': 'device preflight failed (accelerator '
-                          'runtime unreachable)'}
+                          'runtime unreachable)'
+                          + (f': {why}' if why else '')}
             s = stale.get(n) or {}
             if s.get('value') is not None:
                 r['stale_value'] = s['value']
@@ -1806,7 +2034,8 @@ def main():
                 # mid-run: one quick probe decides between burning the
                 # full timeout on every remaining config or failing
                 # them fast with a diagnosable error
-                if not _device_preflight_once(90):
+                probe_ok, probe_why = _device_preflight_once(90)
+                if not probe_ok:
                     log('tunnel unresponsive after timeout; '
                         'fast-failing remaining configs')
                     for rest in names[i + 1:]:
@@ -1814,7 +2043,9 @@ def main():
                             'value': None, 'unit': UNITS[rest],
                             'error': 'accelerator runtime died '
                                      'mid-run (previous config '
-                                     'timed out, preflight failed)'}
+                                     'timed out, preflight failed'
+                                     + (f': {probe_why}' if probe_why
+                                        else '') + ')'}
                     _write_partial(results, smoke=args.smoke)
                     break
         else:
@@ -1851,6 +2082,12 @@ def main():
         out['fused'] = fused_summary
     if serve_summary is not None:
         out['serve'] = serve_summary
+    if obs_summary is not None:
+        out['obs'] = obs_summary
+    if preflight_attempts:
+        # non-empty only when at least one preflight try failed: the
+        # diagnosis (timeout vs crash, rc, stderr tail) per attempt
+        out['device_preflight'] = {'attempts': preflight_attempts}
     # the headline config is excluded from extras, so its stale
     # provenance (if any) rides at the top level
     for k in ('stale_value', 'stale_vs_baseline', 'stale_from',

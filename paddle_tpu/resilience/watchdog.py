@@ -99,6 +99,26 @@ class Budget:
         # compile dominates the first step; be generous but bounded
         return max(120.0, 4 * self.effective_step_s())
 
+    # -- serving SLO derivations (telemetry.monitors / serving) -----------
+    def ttft_budget_s(self):
+        """The aggregate TTFT allowance the serving SLO monitor
+        compares its rolling p99 against: queueing + prefill ride on
+        the first-step allowance, exactly like the per-request
+        deadline derivation — one budget machinery, two consumers."""
+        return self.effective_first_step_s()
+
+    def request_budget_s(self, max_new_tokens, span=1):
+        """Per-request completion allowance: first-step (prefill +
+        compile headroom) plus one step allowance per fused decode
+        span.  ``ServingEngine.request_deadline_s`` derives per-request
+        deadlines from this; ``SLOMonitor`` uses the same numbers as
+        aggregate thresholds."""
+        import math
+        spans = math.ceil(max(1, int(max_new_tokens) - 1)
+                          / max(1, int(span)))
+        return self.effective_first_step_s() \
+            + spans * self.effective_step_s()
+
     @classmethod
     def from_costmodel(cls, est_step_us, slack=8.0, min_step_s=5.0,
                        **kwargs):

@@ -24,7 +24,17 @@ Ties the whole PR-7..11 runway into live decode throughput:
 - **per-request SLOs**: watchdog-derived deadline budgets (PR 10)
   evict starved requests with a ``timeout`` telemetry event; TTFT /
   TPOT land on ``serve_request`` events and PR-8 profile windows
-  attribute device time to exact intervention ids.
+  attribute device time to exact intervention ids;
+- **live observability** (``serve_metrics_port=`` /
+  ``PADDLE_TPU_METRICS_PORT``, default OFF): a
+  ``telemetry.live.LiveAggregator`` subscribed to the recorder
+  stream keeps rolling TTFT/TPOT/occupancy windows, SLO/drift
+  monitors emit ``slo_breach``/``drift_detected``, and a stdlib HTTP
+  server exposes ``/healthz`` ``/status.json`` ``/metrics``
+  ``/requests/<rid>`` — scrapes read host-side rolling state only,
+  so a live scrape changes no numerics and adds no syncs (pinned by
+  test and ``bench.py --obs-smoke``); every request carries a full
+  lifecycle trace (``serve_trace`` events).
 
 The decode math runs through the SAME ``GPTForCausalLM.prefill`` /
 ``decode_step`` functional forwards that ``generate()`` uses, so
@@ -148,7 +158,8 @@ class ServingEngine:
     expert capacity — same exemption as generate's pow2 bucketing).
     """
 
-    def __init__(self, model, config=None, now_fn=time.monotonic):
+    def __init__(self, model, config=None, now_fn=time.monotonic,
+                 serve_metrics_port=None, live_window_s=60.0):
         cfg = model.config
         if cfg.moe_num_experts > 0:
             raise ValueError('serving engine requires a non-MoE model '
@@ -181,9 +192,81 @@ class ServingEngine:
         self.decoded_tokens = 0
         self._rid = 0
         self._prefills = 0
+        # first-token / rollback counts carried to the NEXT serve_step
+        # event so the live plane's token accounting matches
+        # decoded_tokens exactly (prefill-only interventions emit no
+        # serve_step of their own)
+        self._pending_prefilled = 0
+        self._pending_discarded = 0
         from ..telemetry.profile import step_profiler
         self._prof = step_profiler(profile=self.config.profile,
                                    name='serve')
+        # -- live observability plane (default OFF; see telemetry.live) --
+        # the aggregator consumes the recorder's boundary-rate stream,
+        # the monitors turn its windows into slo_breach/drift_detected
+        # events, and the HTTP server exposes /metrics + /status.json.
+        # Nothing here adds device syncs: scrapes read host-side
+        # rolling state only.
+        self.live = None
+        self.monitors = []
+        self.metrics_server = None
+        from ..telemetry.httpd import resolve_metrics_port
+        port = resolve_metrics_port(serve_metrics_port)
+        if port is not None:
+            from ..telemetry.live import LiveAggregator
+            from ..telemetry.monitors import DriftMonitor, SLOMonitor
+            from ..telemetry.httpd import MetricsServer
+            self.live = LiveAggregator(
+                window_s=live_window_s).install()
+            self.live.live_trace_fn = self._live_trace
+            # watchdog budgets feed the SLO thresholds: the same
+            # Budget that derives per-request deadlines defines the
+            # aggregate TTFT envelope
+            self.monitors = [
+                self.live.attach_monitor(SLOMonitor(budget=self.budget)),
+                self.live.attach_monitor(DriftMonitor()),
+            ]
+            try:
+                self.metrics_server = MetricsServer(self.live,
+                                                    port=port).start()
+            except Exception:
+                # a dead port (EADDRINUSE, ...) must not leak the
+                # recorder subscription: the engine never constructs,
+                # so close() could never run
+                self.live.uninstall()
+                self.live = None
+                self.monitors = []
+                raise
+
+    # -- live plane ----------------------------------------------------------
+    def _live_trace(self, rid):
+        """telemetry.live hook: the in-flight trace for `rid` (the
+        finished ones live in the aggregator's serve_trace store).
+        Runs on a scrape thread while the engine thread mutates the
+        scheduler structures — copying a deque mid-mutation raises
+        RuntimeError, so retry a few times and give up with None (the
+        next scrape sees a settled state)."""
+        sched = self.scheduler
+        for _ in range(4):
+            try:
+                reqs = list(sched.running) + list(sched.queue) \
+                    + list(sched.finished)
+                for req in reqs:
+                    if req.rid == rid:
+                        return [dict(row) for row in req.trace]
+                return None
+            except RuntimeError:    # mutated during iteration
+                continue
+        return None
+
+    def close(self):
+        """Tear down the live plane (HTTP server + stream
+        subscription).  Idempotent; the engine itself stays usable."""
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
+        if self.live is not None:
+            self.live.uninstall()
 
     # -- buckets -------------------------------------------------------------
     def prompt_bucket(self, t0):
@@ -202,10 +285,8 @@ class ServingEngine:
             return float(self.config.request_deadline_s)
         if self.budget is None:
             return None
-        spans = math.ceil(max(1, max_new_tokens - 1)
-                          / self.config.decode_span)
-        return self.budget.effective_first_step_s() \
-            + spans * self.budget.effective_step_s()
+        return self.budget.request_budget_s(
+            max_new_tokens, span=self.config.decode_span)
 
     # -- sampling (mirrors generate()'s) -------------------------------------
     def _sample_fn(self):
@@ -440,6 +521,10 @@ class ServingEngine:
                           jnp.asarray(ids), jnp.asarray(t0s),
                           ks, vs, jnp.asarray(blocks), key)
         self.cache.set_pools(list(zip(ks, vs)))
+        now = self._clock()
+        for req in reqs:
+            req.trace_note('prefill', now, bucket=P, chunk=B,
+                           dispatch=self._prefills)
         return tok
 
     def _prefill_finish(self, req, tok):
@@ -447,6 +532,7 @@ class ServingEngine:
         request if it is already complete."""
         req.tokens.append(int(tok))
         req.first_token_t = self._clock()
+        req.trace_note('first_token', req.first_token_t)
         self.decoded_tokens += 1
         if self.config.eos_id is not None \
                 and req.tokens[-1] == self.config.eos_id:
@@ -471,11 +557,40 @@ class ServingEngine:
         self.cache.set_pools(list(zip(ks, vs)))
         return toks, valid
 
+    def _flush_pending_tokens(self, admitted, t_start):
+        """A prefill-only intervention (nothing left running) emits a
+        decode-less ``serve_step`` carrying the pending first-token /
+        rollback counts, so no delivered token is ever lost to the
+        early-return paths."""
+        if not self._pending_prefilled and not self._pending_discarded:
+            return
+        from .. import telemetry
+        sched = self.scheduler
+        telemetry.event('serve_step', intervention=self.interventions,
+                        live=0, batch=0, span=0, decoded=0,
+                        admitted=admitted, finished=0, preempted=0,
+                        queued=len(sched.queue),
+                        free_blocks=self.cache.free_blocks,
+                        total_blocks=self.cache.num_blocks,
+                        prefilled=self._pending_prefilled,
+                        discarded=self._pending_discarded,
+                        dur_s=round(self._clock() - t_start, 6))
+        self._pending_prefilled = 0
+        self._pending_discarded = 0
+
     def _note_finished(self, finished, now):
         from .. import telemetry
         for req in finished:
             rec = req.record(now)
             telemetry.event('serve_request', **rec)
+            # the full lifecycle trail, ONE event per finished request
+            # (bounded by request count, never by decode steps);
+            # joinable with serve_request by rid, served live at
+            # /requests/<rid>
+            telemetry.event('serve_trace', rid=req.rid,
+                            state=req.state, reason=req.reason,
+                            prompt_bucket=req.prompt_bucket,
+                            trace=[dict(r) for r in req.trace])
             if req.reason == 'deadline':
                 telemetry.event(
                     'timeout', op='serve_request', rid=req.rid,
@@ -490,6 +605,7 @@ class ServingEngine:
         from .. import telemetry
         sched = self.scheduler
         now = self._clock() if now is None else now
+        t_start = self._clock()
         breached = sched.check_deadlines(now)
         self._note_finished(breached, now)
         # two-phase admission: chunk same-bucket admissions into
@@ -518,19 +634,28 @@ class ServingEngine:
             toks = np.asarray(toks_dev)
             for i, req in enumerate(reqs):
                 self._prefill_finish(req, toks[i])
+            self._pending_prefilled += len(reqs)
         self._note_finished(
             [r for reqs, _ in dispatched for r in reqs if r.done], now)
         progress = admitted + len(breached)
         if not sched.running:
+            # everything finished at prefill (or evicted): flush the
+            # carried first-token counts NOW — no later serve_step
+            # will fire to carry them, and the live plane / run_report
+            # token accounting must still match decoded_tokens
+            self._flush_pending_tokens(admitted, t_start)
             return progress
         preempted = sched.reserve_span(sched.decode_span)
         # a preempted request's emitted tokens are discarded and will
         # be recomputed — un-count them so tokens_per_s only ever
         # counts DELIVERED tokens once
-        self.decoded_tokens -= sum(
-            getattr(r, 'discarded_tokens', 0) for r in preempted)
+        discarded = sum(getattr(r, 'discarded_tokens', 0)
+                        for r in preempted)
+        self.decoded_tokens -= discarded
+        self._pending_discarded += discarded
         plan = sched.plan()
         if plan is None:
+            self._flush_pending_tokens(admitted, t_start)
             return progress
         toks_dev, valid_dev = self._decode(plan)
         if self._prof is not None:
@@ -549,7 +674,13 @@ class ServingEngine:
                         finished=len(finished),
                         preempted=len(preempted),
                         queued=len(sched.queue),
-                        free_blocks=self.cache.free_blocks)
+                        free_blocks=self.cache.free_blocks,
+                        total_blocks=self.cache.num_blocks,
+                        prefilled=self._pending_prefilled,
+                        discarded=self._pending_discarded,
+                        dur_s=round(self._clock() - t_start, 6))
+        self._pending_prefilled = 0
+        self._pending_discarded = 0
         telemetry.add('serve.decoded_tokens', n)
         return progress + n
 
@@ -571,10 +702,17 @@ class ServingEngine:
             while pending or sched.queue or sched.running:
                 now = self._clock()
                 if timeout_s is not None and now - start > timeout_s:
+                    timed_out = []
                     for req in list(sched.running) + list(sched.queue):
                         if req in sched.queue:
                             sched.queue.remove(req)
                         sched.finish(req, 'engine_timeout')
+                        timed_out.append(req)
+                    # same telemetry as any other eviction: these
+                    # requests must not vanish from the live plane /
+                    # run_report during exactly the overload that
+                    # timed the run out
+                    self._note_finished(timed_out, self._clock())
                     pending = []
                     break
                 while pending and pending[0].arrival_t <= now:
@@ -687,6 +825,10 @@ class ServingEngine:
                 jnp.zeros((S,), bool), jnp.zeros((S,), jnp.int64), key)
             self.cache.set_pools(list(zip(ks, vs)))
             np.asarray(toks)
+        if self.live is not None:
+            # every declared module just built+ran: compiles from here
+            # on are anomalies the drift monitor flags
+            self.live.mark_steady()
         return self.stats()
 
     def precompile(self):

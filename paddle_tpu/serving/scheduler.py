@@ -60,6 +60,19 @@ class Request:
         self.finish_t = None
         self.preemptions = 0
         self.discarded_tokens = 0   # last preemption's recompute debt
+        self.trace = []             # lifecycle rows (see trace_note)
+
+    def trace_note(self, stage, t, **tags):
+        """Append one lifecycle row: the queued→admitted→prefill→
+        first_token→decode_span*→finished/evicted/preempted trail,
+        each row timestamped on the engine clock and tagged with its
+        cause/bucket.  Host-side list append — the engine emits the
+        whole trail as ONE ``serve_trace`` event at finish, and
+        ``telemetry.live`` serves it at ``/requests/<rid>``."""
+        row = {'stage': stage, 't': round(float(t), 6)}
+        row.update({k: v for k, v in tags.items() if v is not None})
+        self.trace.append(row)
+        return row
 
     @property
     def done(self):
@@ -156,6 +169,10 @@ class ContinuousBatchingScheduler:
                 f'longest, pool only has {self.cache.num_blocks - 1}')
         self.queue.append(req)
         self.counters['submitted'] += 1
+        req.trace_note('queued', self.now_fn(),
+                       prompt_len=int(req.prompt.size),
+                       max_new_tokens=req.max_new_tokens,
+                       deadline_s=req.deadline_s)
         return req
 
     # -- admission ----------------------------------------------------------
@@ -179,6 +196,8 @@ class ContinuousBatchingScheduler:
         req.ctx = req.prompt.size
         self.running.append(req)
         self.counters['admitted'] += 1
+        req.trace_note('admitted', self.now_fn(), bucket=bucket,
+                       blocks=len(self.cache.owned(req.rid)))
         return req
 
     # -- eviction / completion ----------------------------------------------
@@ -193,6 +212,9 @@ class ContinuousBatchingScheduler:
         self.finished.append(req)
         self.counters['evicted' if req.state == Request.EVICTED
                       else 'completed'] += 1
+        req.trace_note('finished' if req.state == Request.DONE
+                       else 'evicted', req.finish_t, cause=reason,
+                       tokens=len(req.tokens))
 
     def preempt_youngest(self):
         """Pool pressure: push the newest running request back to the
@@ -214,6 +236,8 @@ class ContinuousBatchingScheduler:
         req.preemptions += 1
         self.queue.appendleft(req)
         self.counters['preempted'] += 1
+        req.trace_note('preempted', self.now_fn(), cause='pool',
+                       discarded_tokens=req.discarded_tokens)
         return req
 
     def check_deadlines(self, now):
@@ -273,8 +297,10 @@ class ContinuousBatchingScheduler:
         append valid tokens, finish on EOS / max tokens.  ``toks`` and
         ``valid`` are ``[span, batch]`` host arrays."""
         finished = []
+        now = self.now_fn()
         for i, req in enumerate(plan.requests):
             emitted = 0
+            finish_reason = None
             for k in range(plan.span):
                 if not valid[k, i] or req.done:
                     break
@@ -282,12 +308,21 @@ class ContinuousBatchingScheduler:
                 req.tokens.append(tok)
                 emitted += 1
                 if self.eos_id is not None and tok == self.eos_id:
-                    self.finish(req, 'eos')
+                    finish_reason = 'eos'
                     break
                 if len(req.tokens) >= req.max_new_tokens:
-                    self.finish(req, 'max_tokens')
+                    finish_reason = 'max_tokens'
                     break
             req.ctx = min(req.ctx + emitted, req.limit)
+            if emitted:
+                # ONE trace row per intervention per live request,
+                # noted BEFORE any finish row so the trail stays in
+                # lifecycle order
+                req.trace_note('decode_span', now, span=plan.span,
+                               emitted=emitted,
+                               tokens=len(req.tokens))
+            if finish_reason is not None:
+                self.finish(req, finish_reason)
             if req.done:
                 finished.append(req)
         self.counters['decode_steps'] += plan.span

@@ -60,6 +60,10 @@ from .exporters import (  # noqa: F401
 from .profile import (  # noqa: F401
     ProfileSchedule, StepProfiler, step_profiler, capture,
     resolve_schedule)
+from .live import (  # noqa: F401
+    LiveAggregator, RollingWindow, RateCounter)
+from .monitors import SLOMonitor, DriftMonitor  # noqa: F401
+from .httpd import MetricsServer, resolve_metrics_port  # noqa: F401
 
 __all__ = [
     'Recorder', 'get_recorder', 'reset', 'hard_off', 'EVENT_KINDS',
@@ -67,6 +71,9 @@ __all__ = [
     'JsonlWriter', 'ScalarAdapter', 'TensorBoardWriter', 'TeeWriter',
     'ProfileSchedule', 'StepProfiler', 'step_profiler', 'capture',
     'resolve_schedule',
+    'LiveAggregator', 'RollingWindow', 'RateCounter',
+    'SLOMonitor', 'DriftMonitor',
+    'MetricsServer', 'resolve_metrics_port',
     'enable', 'disable', 'enabled', 'active',
     'event', 'add', 'set_gauge', 'span', 'events',
     'step_accumulator', 'dump_flight', 'flight_dir',

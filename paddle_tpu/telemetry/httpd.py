@@ -1,0 +1,161 @@
+"""Stdlib-only HTTP status server over a LiveAggregator.
+
+Four read-only routes, enough for a human with curl, a Prometheus
+scraper, and a load balancer's health check:
+
+* ``/healthz``        — liveness: ``{"ok": true, "uptime_s": …}``
+* ``/status.json``    — the aggregator's full rolling snapshot
+  (latency percentiles, rates, gauges, alerts, traced rids)
+* ``/metrics``        — Prometheus text exposition format
+* ``/requests/<rid>`` — one request's lifecycle trace (finished
+  requests from the bounded ``serve_trace`` store; in-flight ones via
+  the engine's live hook), 404 when unknown
+
+Serving happens on daemon threads (ThreadingHTTPServer); every
+response is computed from the aggregator's host-side rolling state
+under its lock — a scrape NEVER touches a device array, a compiled
+module, or the engine's scheduler structures, which is what makes
+"scraping /metrics mid-run changes no numerics and adds no syncs"
+provable (bench ``--obs-smoke`` and the bit-exactness test pin it).
+
+Security note: binds ``127.0.0.1`` by default — metrics can leak
+prompts' shape/timing and the trace view leaks rids; exporting the
+port off-host is an explicit operator decision
+(``PADDLE_TPU_METRICS_HOST=0.0.0.0``).
+
+Off by default everywhere: construct+start explicitly, or let
+``ServingEngine(serve_metrics_port=…)`` / ``PADDLE_TPU_METRICS_PORT``
+do it (see :func:`resolve_metrics_port` for the posture).
+"""
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+__all__ = ['MetricsServer', 'resolve_metrics_port',
+           'METRICS_PORT_ENV', 'METRICS_HOST_ENV']
+
+METRICS_PORT_ENV = 'PADDLE_TPU_METRICS_PORT'
+METRICS_HOST_ENV = 'PADDLE_TPU_METRICS_HOST'
+
+
+def resolve_metrics_port(arg=None):
+    """The shared opt-in posture (mirrors ``resolve_watchdog``):
+    explicit ``False`` -> None (off even if the env says on); an int
+    passes through (0 = bind an ephemeral port — tests);``None`` ->
+    the PADDLE_TPU_METRICS_PORT env decides, where unset/'0'/'off'/
+    'false' mean off.  Returns a port int or None."""
+    if arg is False:
+        return None
+    if arg is not None:
+        return int(arg)
+    text = (os.environ.get(METRICS_PORT_ENV) or '').strip().lower()
+    if text in ('', '0', 'off', 'false'):
+        return None
+    return int(text)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the server instance carries .aggregator (set by MetricsServer)
+    protocol_version = 'HTTP/1.1'
+
+    def log_message(self, *args):       # no stderr chatter per scrape
+        pass
+
+    def _send(self, code, body, ctype='application/json'):
+        data = body if isinstance(body, bytes) else body.encode('utf-8')
+        self.send_response(code)
+        self.send_header('Content-Type', f'{ctype}; charset=utf-8')
+        self.send_header('Content-Length', str(len(data)))
+        self.end_headers()
+        try:
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass                        # scraper went away mid-write
+
+    def do_GET(self):                   # noqa: N802 (http.server API)
+        agg = self.server.aggregator
+        path = self.path.split('?', 1)[0].rstrip('/') or '/'
+        try:
+            if path == '/healthz':
+                self._send(200, json.dumps(
+                    {'ok': True,
+                     'uptime_s': agg.snapshot().get('uptime_s')}))
+            elif path == '/status.json':
+                self._send(200, json.dumps(agg.snapshot(), indent=1))
+            elif path == '/metrics':
+                self._send(200, agg.prometheus(),
+                           ctype='text/plain; version=0.0.4')
+            elif path.startswith('/requests/'):
+                rid = path[len('/requests/'):]
+                doc = agg.request_trace(rid)
+                if doc is None:
+                    self._send(404, json.dumps(
+                        {'error': f'unknown rid {rid!r}'}))
+                else:
+                    self._send(200, json.dumps(doc, indent=1))
+            elif path == '/':
+                self._send(200, json.dumps({'routes': [
+                    '/healthz', '/status.json', '/metrics',
+                    '/requests/<rid>']}))
+            else:
+                self._send(404, json.dumps({'error': 'not found'}))
+        except Exception as e:          # a scrape must never crash it
+            try:
+                self._send(500, json.dumps({'error': repr(e)[:200]}))
+            except Exception:
+                pass
+
+
+class MetricsServer:
+    """One live-metrics HTTP endpoint over one aggregator.
+
+        srv = MetricsServer(agg, port=0).start()
+        ... http://127.0.0.1:{srv.port}/status.json ...
+        srv.stop()
+    """
+
+    def __init__(self, aggregator, port=0, host=None):
+        self.aggregator = aggregator
+        self.requested_port = int(port)
+        self.host = host or os.environ.get(METRICS_HOST_ENV,
+                                           '127.0.0.1')
+        self._httpd = None
+        self._thread = None
+        self.port = None
+
+    def start(self):
+        if self._httpd is not None:
+            return self
+        httpd = ThreadingHTTPServer((self.host, self.requested_port),
+                                    _Handler)
+        httpd.daemon_threads = True
+        httpd.aggregator = self.aggregator
+        self._httpd = httpd
+        self.port = httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, name='paddle-tpu-metrics',
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        httpd, self._httpd = self._httpd, None
+        t, self._thread = self._thread, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if t is not None:
+            t.join(timeout=5.0)
+
+    @property
+    def url(self):
+        return (None if self.port is None
+                else f'http://{self.host}:{self.port}')
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

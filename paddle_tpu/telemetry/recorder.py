@@ -37,8 +37,11 @@ from collections import deque
 __all__ = ['Recorder', 'get_recorder', 'reset', 'hard_off',
            'EVENT_KINDS']
 
-# documented event vocabulary (informative, not enforced — subsystems
-# may add kinds, run_report groups unknown kinds into the timeline)
+# documented event vocabulary.  Every kind any module under
+# paddle_tpu/ emits MUST be declared here — a meta-test greps the
+# package's emission sites and fails on an undeclared kind, so the
+# vocabulary can no longer drift silently (run_report still groups
+# unknown kinds from third-party emitters into the timeline).
 EVENT_KINDS = (
     'run_meta',            # enable(): argv / rank / backend
     'compile',             # a step function compiled (dur_s, variants)
@@ -100,6 +103,29 @@ EVENT_KINDS = (
                            # state/reason, prompt_len, tokens, TTFT,
                            # TPOT, preemptions) — deadline breaches
                            # additionally emit a 'timeout' event
+    'serve_trace',         # one finished request's full lifecycle
+                           # trace (rid + ordered stage rows:
+                           # queued -> admitted -> prefill ->
+                           # first_token -> decode_span* ->
+                           # finished/evicted/preempted, each with
+                           # cause and bucket tags) — joinable with
+                           # serve_request by rid; telemetry.live
+                           # keeps a bounded store of these for the
+                           # /requests/<rid> HTTP trace view
+    'slo_breach',          # a rolling SLO monitor tripped (what:
+                           # ttft_p99 over the watchdog-derived
+                           # budget, or deadline-eviction rate over
+                           # threshold) — telemetry.monitors emits,
+                           # with observed vs budget attribution
+    'drift_detected',      # predicted-vs-observed drift: windowed
+                           # us_ratio from collective_observed left
+                           # its band, or a compile landed after the
+                           # run was declared steady — the
+                           # re-planning trigger a plan_supervisor
+                           # (ROADMAP item 3) consumes
+    'crash',               # the sys.excepthook crash hook latched an
+                           # unhandled exception (ring-only, then the
+                           # flight dump persists it)
     'steps',               # StepAccumulator flush (per-step scalars;
                            # fused chunk rows arrive expanded to
                            # per-step entries)
@@ -149,6 +175,7 @@ class Recorder:
         self.gauges = {}
         self.span_stats = {}    # name -> {count, total_s, max_s}
         self._writer = None     # exporters.JsonlWriter when enabled
+        self._subscribers = ()  # in-process stream consumers (live.py)
         self._local = threading.local()
         self._t0_wall = _WALL()
         self._t0 = _MONO()
@@ -170,10 +197,16 @@ class Recorder:
         with self._lock:
             self._events.append(rec)
             w = self._writer
+            subs = self._subscribers
         if w is not None:
             try:
                 w.write(rec)
             except Exception:       # a full disk must not kill a step
+                pass
+        for cb in subs:
+            try:
+                cb(rec)
+            except Exception:       # a broken consumer must not either
                 pass
         return rec
 
@@ -247,6 +280,27 @@ class Recorder:
     def step_times(self, tag='step'):
         with self._lock:
             return list(self._step_reservoir.get(tag, []))
+
+    # -- in-process subscribers ----------------------------------------------
+    def subscribe(self, callback):
+        """Register an in-process consumer of the event stream.  It
+        receives exactly the records a writer would — the boundary-rate
+        flushes, never anything per-step — after the ring append and
+        the JSONL write, outside the recorder lock.  Exceptions are
+        swallowed (consumers are observers, never blockers).  Signal-
+        safe ``event_unlocked`` records do NOT notify (no user code
+        may run in a signal handler's context)."""
+        with self._lock:
+            if callback not in self._subscribers:
+                self._subscribers = self._subscribers + (callback,)
+        return callback
+
+    def unsubscribe(self, callback):
+        # equality, not identity: a bound method (agg.write) is a
+        # fresh object on every attribute access, but compares equal
+        with self._lock:
+            self._subscribers = tuple(
+                cb for cb in self._subscribers if cb != callback)
 
     # -- writer --------------------------------------------------------------
     def attach_writer(self, writer):

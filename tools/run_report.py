@@ -27,8 +27,14 @@ Report sections:
     wire/peak numbers joined against the observed census — every
     auto-sharded run reports predicted-vs-actual for the plan that
     was picked;
+  * the serving section (``serve_step``/``serve_request`` joined):
+    TTFT/TPOT percentiles, tokens/s, eviction/preemption counts by
+    cause, per-request timeline rows, lifecycle traces
+    (``serve_trace``) and any ``slo_breach``/``drift_detected``
+    monitor alerts;
   * the resilience event timeline (preemption, nan_skip/rollback,
-    checkpoint save/commit/restore/quarantine) in wall-clock order.
+    checkpoint save/commit/restore/quarantine, SLO breaches and
+    drift detections) in wall-clock order.
 
 Multi-host merges: per-host wall clocks drift (pods give no NTP
 guarantee), so each host's events are re-anchored to its first
@@ -62,7 +68,11 @@ RESILIENCE_KINDS = (
     # straggler attribution, lost heartbeat quorum, cluster aborts —
     # each row carries its rank, so a merged multi-host timeline shows
     # WHO hung and who merely waited
-    'timeout', 'straggler', 'quorum_lost', 'coordinated_abort')
+    'timeout', 'straggler', 'quorum_lost', 'coordinated_abort',
+    # rolling SLO/drift monitors (telemetry.monitors): an SLO breach
+    # or a predicted-vs-observed drift detection belongs on the same
+    # timeline as the failures it predicts
+    'slo_breach', 'drift_detected')
 
 # spans (kind='span', name=...) that belong on the resilience
 # timeline: the 2-phase commit barrier wait and the restore itself
@@ -423,6 +433,72 @@ def analyze(events, sources, skew=None):
                 'error') if last.get(k) is not None},
         }
 
+    # -- serving: the serve_step / serve_request join --------------
+    # (emitted since PR 12, silently dropped until now)
+    serving = None
+    serve_steps = by_kind.get('serve_step', [])
+    serve_reqs = by_kind.get('serve_request', [])
+    if serve_steps or serve_reqs:
+        ttft_ms = [r['ttft_s'] * 1000.0 for r in serve_reqs
+                   if r.get('ttft_s') is not None]
+        tpot_ms = [r['tpot_s'] * 1000.0 for r in serve_reqs
+                   if r.get('tpot_s') is not None]
+        # span tokens + carried prefill first tokens - preemption
+        # rollbacks = the engine's delivered-token accounting
+        decoded = sum((e.get('decoded') or 0)
+                      + (e.get('prefilled') or 0)
+                      - (e.get('discarded') or 0)
+                      for e in serve_steps)
+        ts = [e['ts'] for e in serve_steps if e.get('ts') is not None]
+        wall = (max(ts) - min(ts)) if len(ts) > 1 else None
+        by_cause = {}
+        completed = evicted = 0
+        for r in serve_reqs:
+            cause = r.get('reason') or '?'
+            by_cause[cause] = by_cause.get(cause, 0) + 1
+            if r.get('state') == 'done':
+                completed += 1
+            else:
+                evicted += 1
+        requests_rows = [
+            {k: r.get(k) for k in (
+                'rid', 'state', 'reason', 'prompt_len', 'tokens',
+                'ttft_s', 'tpot_s', 'preemptions', 'age_s', 'rank')
+             if r.get(k) is not None}
+            for r in serve_reqs]
+        traces = {e['rid']: e.get('trace') or []
+                  for e in by_kind.get('serve_trace', ())
+                  if e.get('rid') is not None}
+        serving = {
+            'requests': len(serve_reqs),
+            'completed': completed,
+            'evicted': evicted,
+            'by_cause': by_cause,
+            'preemptions': sum(r.get('preemptions') or 0
+                               for r in serve_reqs),
+            'ttft_ms': _percentiles(ttft_ms),
+            'tpot_ms': _percentiles(tpot_ms),
+            'interventions': len(serve_steps),
+            'decoded_tokens': decoded,
+            'tokens_per_s': (round(decoded / wall, 3)
+                             if wall else None),
+            'last_step': {k: serve_steps[-1].get(k) for k in (
+                'live', 'batch', 'span', 'queued', 'free_blocks',
+                'total_blocks')} if serve_steps else None,
+            'slo_breaches': [
+                {k: e.get(k) for k in (
+                    'what', 'observed_s', 'budget_s', 'observed_frac',
+                    'threshold_frac', 'rank') if e.get(k) is not None}
+                for e in by_kind.get('slo_breach', ())],
+            'drift_detected': [
+                {k: e.get(k) for k in (
+                    'cause', 'op', 'instr', 'us_ratio', 'band',
+                    'name', 'rank') if e.get(k) is not None}
+                for e in by_kind.get('drift_detected', ())],
+            'request_timeline': requests_rows,
+            'traces': traces,
+        }
+
     # -- lint findings -------------------------------------------
     lint = {}
     for e in by_kind.get('lint_finding', ()):
@@ -446,7 +522,9 @@ def analyze(events, sources, skew=None):
                   'delay_s', 'mesh', 'saved_mesh',
                   'op', 'tag', 'budget_s', 'elapsed_s', 'missing',
                   'peer', 'heartbeat_age_s', 'live', 'stale',
-                  'reason', 'deadline_s', 'clamped_from_s'):
+                  'reason', 'deadline_s', 'clamped_from_s',
+                  'what', 'cause', 'rid', 'observed_s', 'us_ratio',
+                  'instr', 'observed_frac'):
             if e.get(k) is not None:
                 row[k] = e[k]
         timeline.append(row)
@@ -499,6 +577,7 @@ def analyze(events, sources, skew=None):
         'collectives_cmp': collectives_cmp,
         'plan': plan,
         'profile': profile,
+        'serving': serving,
         'clock_skew': skew or {},
         'watchdog': watchdog,
         'lint_findings': lint,
@@ -618,6 +697,47 @@ def render(report, stream=None):
               f'{last["device_us_per_step"]:.0f} us/step device, '
               f'{last.get("collective_us_per_step", 0):.0f} us '
               f'({frac:.1%}) in collectives')
+    if report.get('serving'):
+        sv = report['serving']
+        p('\n-- serving --')
+        p(f'    {sv["requests"]} requests: {sv["completed"]} '
+          f'completed, {sv["evicted"]} evicted '
+          f'({", ".join(f"{c}:{n}" for c, n in sorted(sv["by_cause"].items()))})'
+          + (f', {sv["preemptions"]} preemption(s)'
+             if sv['preemptions'] else ''))
+        tk = sv.get('tokens_per_s')
+        p(f'    {sv["decoded_tokens"]} tokens over '
+          f'{sv["interventions"]} interventions'
+          + (f' ({tk:.0f} tokens/s)' if tk else ''))
+        for label, pct in (('TTFT', sv['ttft_ms']),
+                           ('TPOT', sv['tpot_ms'])):
+            if pct:
+                p(f'    {label}: p50={pct["p50_ms"]:.1f}ms '
+                  f'p99={pct["p99_ms"]:.1f}ms '
+                  f'max={pct["max_ms"]:.1f}ms (n={pct["steps"]})')
+        last = sv.get('last_step')
+        if last:
+            p(f'    last intervention: {last.get("live")} live / '
+              f'batch {last.get("batch")} / {last.get("queued")} '
+              f'queued / {last.get("free_blocks")} of '
+              f'{last.get("total_blocks")} blocks free')
+        for b in sv['slo_breaches']:
+            p(f'    SLO BREACH: {b}')
+        for d in sv['drift_detected']:
+            p(f'    DRIFT: {d}')
+        rows = sv['request_timeline']
+        for r in rows[:8]:
+            ttft = r.get('ttft_s')
+            p(f'      {r.get("rid")}: {r.get("state")}'
+              f'/{r.get("reason")} prompt={r.get("prompt_len")} '
+              f'tokens={r.get("tokens")}'
+              + (f' ttft={ttft * 1000:.0f}ms'
+                 if ttft is not None else '')
+              + (f' preempted x{r["preemptions"]}'
+                 if r.get('preemptions') else ''))
+        if len(rows) > 8:
+            p(f'      ... {len(rows) - 8} more request(s) '
+              '(--json has all)')
     if report.get('clock_skew'):
         p('\n-- clock skew (per-host anchor offsets applied) --')
         for r, off in sorted(report['clock_skew'].items()):
