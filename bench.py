@@ -1668,6 +1668,182 @@ def _fused_preflight(smoke, timeout_s=900):
     return ok, summary
 
 
+def _quant_smoke_child(telemetry_dir, smoke):
+    """--quant-smoke child (forced 8-device CPU mesh): the quantized
+    wire's acceptance evidence in one process —
+
+    - lenet trained quantized-wire vs full-width on identical data/rng
+      (tools/quant_accuracy.compare): final-loss delta gate + per-op
+      censuses with wire_dtype tags,
+    - the quantized trainer runs with a profile window so
+      census-joined ``collective_observed`` events (s8-tagged) land in
+      telemetry for the parent's run_report join,
+    - zero post-warmup compiles (compile events after step 1),
+    - corrupt-after-crc rejection: a quantized HostCollectives payload
+      byte-flipped by the chaos seam AFTER the crc header must raise
+      CollectivePayloadError on the receiving rank.
+
+    Emits one JSON line the parent asserts on."""
+    import tempfile
+    import threading
+    del smoke       # the gate always runs the CPU smoke scale
+    from paddle_tpu import telemetry
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), 'tools'))
+    import quant_accuracy as _qa
+
+    telemetry.enable(telemetry_dir)
+    out = {}
+    try:
+        row = _qa.compare(
+            'lenet', {'block': 256, 'min_bytes': 0}, steps=25,
+            profile={'every': 100, 'steps': 2, 'start': 2,
+                     'dir': telemetry_dir})
+        out.update(row)
+        out['observed_rows'] = len(
+            telemetry.events('collective_observed'))
+        out['observed_s8'] = sum(
+            1 for e in telemetry.events('collective_observed')
+            if e.get('wire_dtype') == 's8')
+
+        # corrupt-after-crc on the QUANTIZED host wire: two ranks over
+        # one FileKVStore, the chaos collective_corrupt seam flips a
+        # payload byte after the header on rank 0 — rank 1 must reject
+        from paddle_tpu.distributed.collective import (
+            FileKVStore, HostCollectives, CollectivePayloadError)
+        from paddle_tpu.resilience.chaos import ChaosEngine, FaultPlan
+        kv = FileKVStore(tempfile.mkdtemp(prefix='quant_corrupt_'))
+        t0 = HostCollectives(client=kv, rank=0, world=2, timeout_s=15,
+                             quant='int8', quant_min_bytes=0)
+        t1 = HostCollectives(client=kv, rank=1, world=2, timeout_s=15,
+                             quant='int8', quant_min_bytes=0)
+        eng = ChaosEngine(FaultPlan(seed=0, faults=[
+            {'kind': 'collective_corrupt', 'at_step': 1, 'rank': 0}]),
+            rank=0).activate()
+        try:
+            eng.step(1)
+            arr = np.arange(1024, dtype='float32')
+
+            def rank0():
+                try:
+                    t0.allreduce(arr, 'mean', tag='corrupt1')
+                except Exception:
+                    pass
+            th = threading.Thread(target=rank0)
+            th.start()
+            try:
+                t1.allreduce(arr, 'mean', tag='corrupt1')
+                out['corrupt_rejected'] = False
+            except CollectivePayloadError:
+                out['corrupt_rejected'] = True
+            th.join()
+        finally:
+            eng.deactivate()
+    finally:
+        telemetry.disable()
+    print(json.dumps(out))
+
+
+def _quant_preflight(smoke, timeout_s=900):
+    """--quant-smoke gate (the ISSUE-14 acceptance bar): quantized-
+    wire lenet must converge within the gated loss delta of full
+    width, the run_report join must show wire_dtype-tagged predicted
+    bytes >=2x below the full-width baseline with observed_us
+    populated from the profile window, the quantized trainer must
+    compile nothing after warmup, and a quantized payload corrupted
+    after its crc header must be rejected under chaos.  Returns
+    (ok, summary); infra failures never block — evidence beats a dead
+    gate — but a violated bar always does."""
+    import subprocess
+    import tempfile
+    workdir = tempfile.mkdtemp(prefix='bench_quant_')
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    env['XLA_FLAGS'] = ' '.join(
+        [t for t in env.get('XLA_FLAGS', '').split()
+         if not t.startswith('--xla_force_host_platform_device_count')]
+        + ['--xla_force_host_platform_device_count=8'])
+    cmd = [sys.executable, os.path.abspath(__file__),
+           '--quant-smoke-child', '--telemetry-dir', workdir] \
+        + (['--smoke'] if smoke else [])
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=env)
+        doc = _last_json_dict(proc.stdout)
+    except Exception as e:
+        log(f'quant preflight skipped ({e!r})')
+        return True, {'error': repr(e)[:200]}
+    if doc is None:
+        log(f'quant preflight skipped (no child output, '
+            f'rc={proc.returncode}): {proc.stderr[-300:]}')
+        return True, {'error': f'no output (rc={proc.returncode})'}
+    failures = []
+    delta_rel = doc.get('loss_delta_rel')
+    if delta_rel is None or delta_rel > 0.10:
+        # explicit None check: a PERFECT run reports exactly 0.0,
+        # which a falsy-or default would misread as missing
+        failures.append(
+            'quantized-wire lenet drifted '
+            + ('(no measurement)' if delta_rel is None
+               else f'{delta_rel * 100:.1f}% of the full-width loss '
+                    'progress (gate 10%)'))
+    if (doc.get('wire_reduction') or 0) < 2.0:
+        failures.append(
+            f'predicted wire reduction x{doc.get("wire_reduction")} '
+            'below the x2 bar')
+    s8 = [op for op, r in (doc.get('census_quant') or {}).items()
+          if r.get('wire_dtype') == 's8']
+    if not s8:
+        failures.append('no s8-tagged collective in the quantized '
+                        "trainer's census (wire never quantized)")
+    if doc.get('compile_events_quant') not in (None, 1):
+        failures.append(
+            f'{doc.get("compile_events_quant")} compile events across '
+            'the quantized run (expected exactly the warmup compile)')
+    if not doc.get('corrupt_rejected'):
+        failures.append('a quantized payload corrupted after the crc '
+                        'header was ACCEPTED by a receiver')
+    # the run_report join: predicted-vs-observed with the wire_dtype
+    # dimension populated (observed_us from the child's profile window)
+    rr = None
+    try:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), 'tools'))
+        import run_report as _rr
+        jsonls, flights = _rr.discover([workdir])
+        events, sources, skew = _rr.load_events(jsonls, flights)
+        rep = _rr.analyze(events, sources, skew)
+        cmp_rows = rep.get('collectives_cmp') or {}
+        rr = {op: {'wire_dtype': r.get('wire_dtype'),
+                   'predicted_wire_bytes': r.get('predicted_wire_bytes'),
+                   'observed_us': r.get('observed_us')}
+              for op, r in cmp_rows.items()}
+        tagged = [op for op, r in cmp_rows.items()
+                  if r.get('wire_dtype') == 's8']
+        if not tagged:
+            failures.append('run_report collectives_cmp carries no '
+                            's8-tagged row')
+        observed = [op for op in tagged
+                    if cmp_rows[op].get('observed_us')]
+        if not observed:
+            failures.append('no s8-tagged row has observed_us '
+                            'populated (profile join failed)')
+    except Exception as e:
+        log(f'quant preflight: run_report join failed ({e!r})')
+        failures.append(f'run_report join failed: {e!r}')
+    summary = dict(doc, failures=failures, run_report=rr)
+    summary.pop('losses', None)
+    ok = not failures
+    log(f'quant preflight: {"ok" if ok else "FAIL"} '
+        f'(loss delta {(doc.get("loss_delta_rel") or 0) * 100:.2f}%, '
+        f'wire x{doc.get("wire_reduction")}, '
+        f'observed_s8={doc.get("observed_s8")}, '
+        f'corrupt_rejected={doc.get("corrupt_rejected")})')
+    for f in failures:
+        log(f'  {f}')
+    return ok, summary
+
+
 def _lint_preflight(timeout_s=300, smoke=False):
     """tpu_lint gate before burning chip time: a HIGH-severity finding
     in examples/ or paddle_tpu/models/ means some bench config would
@@ -1813,6 +1989,19 @@ def main():
     p.add_argument('--fused-smoke-child', action='store_true',
                    help='(internal) run the fused K-sweep and emit '
                         'its JSON')
+    p.add_argument('--quant-smoke', action='store_true',
+                   help='preflight gate: quantized collectives '
+                        '(parallel.quant_collectives) — quantized-'
+                        'wire lenet must converge within the loss-'
+                        'delta gate of full width, the run_report '
+                        'join must show s8-tagged predicted wire '
+                        'bytes >=2x below the full-width baseline '
+                        'with observed_us populated, zero post-'
+                        'warmup compiles, and corrupt-after-crc '
+                        'quantized payloads must be rejected')
+    p.add_argument('--quant-smoke-child', action='store_true',
+                   help='(internal) run the quant-smoke measurement '
+                        'and emit its JSON')
     p.add_argument('--telemetry-dir', default=None,
                    help='(internal) telemetry JSONL dir for '
                         '--cache-smoke-child / --profile-smoke-child')
@@ -1833,6 +2022,13 @@ def main():
 
     if args.fused_smoke_child:
         _fused_smoke_child(args.smoke)
+        return
+
+    if args.quant_smoke_child:
+        import tempfile
+        _quant_smoke_child(args.telemetry_dir
+                           or tempfile.mkdtemp(prefix='quant_tel_'),
+                           args.smoke)
         return
 
     if args.serve_smoke_child:
@@ -1860,6 +2056,25 @@ def main():
     fused_summary = None
     serve_summary = None
     obs_summary = None
+    quant_summary = None
+    if args.quant_smoke:
+        quant_ok, quant_summary = _quant_preflight(args.smoke)
+        if not quant_ok:
+            # a failed quant gate means the quantized wire is either
+            # wrong (loss drift, accepted corruption) or pointless
+            # (no byte reduction) — fail before burning chip time,
+            # with the measurement as the artifact
+            print(json.dumps({
+                'metric': METRIC_NAMES['resnet'], 'value': None,
+                'unit': UNITS['resnet'], 'vs_baseline': None,
+                'error': 'quant preflight failed (quantized-wire '
+                         'loss drift, <2x wire reduction, missing '
+                         's8 evidence, post-warmup compiles, or '
+                         'accepted corruption); fix '
+                         'parallel.quant_collectives or re-run '
+                         'without --quant-smoke',
+                'quant': quant_summary, 'extras': {}}))
+            sys.exit(1)
     if args.obs_smoke:
         obs_ok, obs_summary = _obs_preflight(args.smoke)
         if not obs_ok:
@@ -2084,6 +2299,8 @@ def main():
         out['serve'] = serve_summary
     if obs_summary is not None:
         out['obs'] = obs_summary
+    if quant_summary is not None:
+        out['quant'] = quant_summary
     if preflight_attempts:
         # non-empty only when at least one preflight try failed: the
         # diagnosis (timeout vs crash, rc, stderr tail) per attempt

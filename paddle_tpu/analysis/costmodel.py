@@ -48,7 +48,8 @@ import json
 
 __all__ = ['COLLECTIVE_OPS', 'ring_cost', 'torus_cost',
            'axes_for_group', 'Calibration', 'load_calibration',
-           'effective_links',
+           'effective_links', 'WIRE_DTYPE_BYTES', 'quant_wire_factor',
+           'quantized_allreduce_cost',
            'DEFAULT_LINK_BW_GBPS', 'DEFAULT_LINK_LATENCY_US']
 
 # per-direction ICI link bandwidth and per-hop latency.  ~90 GB/s and
@@ -297,6 +298,61 @@ def torus_cost(opcode, local_bytes, axes, *, bw_gbps=None,
                + float(cal.get('beta_us_per_byte', 0.0)) * wire)
     return {'wire_bytes': wire, 'phases': phases,
             'est_us': round(est, 3), 'axes': axes}
+
+
+# -- wire-dtype dimension (quantized collectives, EQuARX) ---------------------
+
+# bytes per element on the wire, keyed by HLO dtype spellings AND the
+# quant-config spellings — one table so census rows ('f32', 's8') and
+# planner what-ifs ('int8', 'bf16') price identically
+WIRE_DTYPE_BYTES = {
+    'f64': 8.0, 'f32': 4.0, 'float32': 4.0, 'f16': 2.0, 'bf16': 2.0,
+    'bfloat16': 2.0, 's8': 1.0, 'u8': 1.0, 'int8': 1.0,
+    'int4': 0.5, 's4': 0.5,
+}
+
+
+def quant_wire_factor(elem_bytes=4, wire_dtype='int8', block=256,
+                      scale_bytes=4):
+    """Payload-byte multiplier of re-wiring a collective at
+    ``wire_dtype``: the quantized element plus one f32 scale per
+    ``block`` elements, over the full-width element.  int8 over f32
+    with block=256 ≈ 0.254 (the EQuARX ~4x)."""
+    qb = WIRE_DTYPE_BYTES.get(wire_dtype)
+    if qb is None:
+        raise ValueError(f'unknown wire dtype {wire_dtype!r}')
+    return (qb + float(scale_bytes) / block) / float(elem_bytes)
+
+
+def quantized_allreduce_cost(local_bytes, axes, *, elem_bytes=4,
+                             wire_dtype='int8', block=256,
+                             master_accum=False, bw_gbps=None,
+                             latency_us=None, calibration=None):
+    """Predicted cost of the DECOMPOSED quantized all-reduce
+    (parallel.quant_collectives): quantize → all-to-all → local sum →
+    quantize → all-gather, both halves at ``wire_dtype`` payload
+    bytes (+ per-block f32 scales).  ``master_accum`` keeps the
+    reduce half a full-width reduce-scatter (exact sum) and quantizes
+    only the gather.  Returns the torus_cost dict shape plus
+    ``wire_dtype`` — the planner's what-if when a full-width
+    all-reduce dominates a plan's estimate."""
+    f = quant_wire_factor(elem_bytes, wire_dtype, block)
+    qbytes = int(local_bytes * f)
+    kw = dict(bw_gbps=bw_gbps, latency_us=latency_us,
+              calibration=calibration)
+    if master_accum:
+        first = torus_cost('reduce-scatter', int(local_bytes), axes,
+                           **kw)
+    else:
+        first = torus_cost('all-to-all', qbytes, axes, **kw)
+    second = torus_cost('all-gather', qbytes, axes, **kw)
+    return {
+        'wire_bytes': first['wire_bytes'] + second['wire_bytes'],
+        'phases': first['phases'] + second['phases'],
+        'est_us': round(first['est_us'] + second['est_us'], 3),
+        'axes': second['axes'],
+        'wire_dtype': wire_dtype,
+    }
 
 
 def ring_cost(opcode, local_bytes, group_size, *,

@@ -326,6 +326,25 @@ def _collective_bytes(comp, ins, base):
     return total or ins.bytes
 
 
+def _collective_wire_dtype(comp, ins):
+    """The dtype actually on the wire for one collective: the element
+    type of its byte-dominant operand (quantized collectives move s8
+    payloads next to tiny f32 scale buffers — the payload dtype is
+    the honest tag).  Falls back to the output type spec."""
+    best, best_b = None, -1
+    for op in ins.operands:
+        src = comp.index.get(op)
+        if src is None:
+            continue
+        m = _BUF_RE.search(src.type_spec)
+        if m and src.bytes > best_b:
+            best, best_b = m.group(1), src.bytes
+    if best is None:
+        m = _BUF_RE.search(ins.type_spec)
+        best = m.group(1) if m else None
+    return best
+
+
 def _short(type_spec, limit=48):
     return type_spec if len(type_spec) <= limit \
         else type_spec[:limit - 3] + '...'
@@ -358,6 +377,7 @@ def collective_census(module, *, bw_gbps=None, latency_us=None,
             'calls': 0, 'bytes': 0, 'wire_bytes': 0, 'est_us': 0.0,
             'phases': 0, 'max_wire_bytes': 0, 'max_est_us': 0.0,
             'group_size': r['group_size'], 'axes': r['axes'],
+            'wire_dtype': r.get('wire_dtype'),
             'file': None, 'line': None})
         row['calls'] += 1
         row['bytes'] += r['bytes']
@@ -372,6 +392,7 @@ def collective_census(module, *, bw_gbps=None, latency_us=None,
             row['max_est_us'] = r['est_us']
             row['group_size'] = r['group_size']
             row['axes'] = r['axes']
+            row['wire_dtype'] = r.get('wire_dtype')
             row['file'], row['line'] = r['file'], r['line']
     return rows
 
@@ -421,6 +442,7 @@ def collective_instrs(module, *, bw_gbps=None, latency_us=None,
             'wire_bytes': cost['wire_bytes'],
             'phases': cost['phases'], 'est_us': cost['est_us'],
             'group_size': n, 'axes': cost['axes'],
+            'wire_dtype': _collective_wire_dtype(comp, ins),
             'file': ins.file, 'line': ins.line}
     return out
 

@@ -71,7 +71,8 @@ class ShardingPlan:
     __slots__ = ('mesh_axes', 'assignment', 'param_specs', 'batch_axis',
                  'wire_bytes', 'est_us', 'compute_us', 'score_us',
                  'peak_bytes', 'phases', 'fits', 'scored_via',
-                 'remat', 'batch_scale', 'census', 'notes', 'rank')
+                 'remat', 'batch_scale', 'census', 'notes', 'rank',
+                 'quant')
 
     def __init__(self, mesh_axes, assignment, param_specs=None,
                  batch_axis='dp'):
@@ -92,6 +93,13 @@ class ShardingPlan:
         self.census = {}
         self.notes = []
         self.rank = None
+        # wire-dtype what-if: when the full-width grad all-reduce
+        # dominates this plan's estimate, the predicted numbers of
+        # re-wiring it at int8 (quantized_allreduce_cost) land here —
+        # {'wire_dtype', 'wire_bytes', 'est_us', 'score_us',
+        #  'saved_us'} — and the planner RECOMMENDS
+        # quant_collectives='int8'
+        self.quant = None
 
     @property
     def fallback(self):
@@ -132,6 +140,7 @@ class ShardingPlan:
             'fallback': self.fallback,
             'notes': list(self.notes),
             'rank': self.rank,
+            'quant': dict(self.quant) if self.quant else None,
         }
 
     def __repr__(self):
@@ -207,6 +216,8 @@ class PlanResult:
             'est_us': None if w is None else w.est_us,
             'compute_us': None if w is None else w.compute_us,
             'peak_bytes': None if w is None else w.peak_bytes,
+            'quant': (dict(w.quant)
+                      if w is not None and w.quant else None),
         }
 
     def render(self):
@@ -238,6 +249,17 @@ class PlanResult:
                     f'{"fits" if p.fits else "STILL OVER"}')
         w = self.winner
         lines.append(f'  winner: {w.describe() if w else "none"}')
+        if w is not None and w.quant and w.quant.get('recommended'):
+            q = w.quant
+            lines.append(
+                "  recommend: quant_collectives="
+                f"'{q['wire_dtype']}' — the grad all-reduce is "
+                f"{q['ar_frac'] * 100:.0f}% of the step estimate; "
+                f"int8 wire cuts it to ~{q['score_us']:.1f}us "
+                f"(saves {q['saved_us']:.1f}us/step, wire "
+                f"{w.wire_bytes / (1 << 20):.2f} -> "
+                f"{q['wire_bytes'] / (1 << 20):.2f} MiB).  Gate "
+                'quality first: tools/quant_accuracy.py')
         if self.enumerated > len(self.candidates) + len(self.errors):
             lines.append(
                 f'  (scored {len(self.candidates)} of '
@@ -458,7 +480,58 @@ def _score_lowered(plan, model, batch, mesh, *, thresholds,
         module, peak_tflops=thr.get('peak_tflops', DEFAULT_PEAK_TFLOPS),
         hbm_gbps=thr.get('hbm_gbps', DEFAULT_HBM_GBPS)), 3)
     plan.score_us = round(plan.compute_us + plan.est_us, 3)
+    _maybe_recommend_quant(plan, thr)
     return plan
+
+
+# -- wire-dtype what-if: recommend quantized collectives ----------------------
+
+# a plan is "collective-dominated" when the grad all-reduce alone is
+# at least this share of the whole step estimate — below it the
+# quantized wire cannot move the step time enough to matter
+QUANT_RECOMMEND_AR_FRAC = 0.25
+# ...and the re-wired step must be at least this much faster overall
+QUANT_RECOMMEND_MIN_SPEEDUP = 1.1
+
+
+def _maybe_recommend_quant(plan, thr, *, wire_dtype='int8',
+                           block=256):
+    """Price the plan's full-width all-reduce traffic at the
+    quantized wire (costmodel.quantized_allreduce_cost) and attach a
+    recommendation when the collective dominates and the savings are
+    real.  Pure what-if — never changes the plan's own score (the
+    ranking stays full-width-honest; flipping the wire is the
+    operator's call: quality gate first, see tools/quant_accuracy)."""
+    ar = plan.census.get('all-reduce')
+    if not ar or not ar.get('wire_bytes'):
+        return
+    elem = costmodel.WIRE_DTYPE_BYTES.get(
+        ar.get('wire_dtype') or 'f32', 4.0)
+    if elem <= costmodel.WIRE_DTYPE_BYTES[wire_dtype]:
+        return      # already on a narrow wire
+    q = costmodel.quantized_allreduce_cost(
+        ar['bytes'], ar['axes'], elem_bytes=elem,
+        wire_dtype=wire_dtype, block=block,
+        bw_gbps=thr['link_bw_gbps'], latency_us=thr['link_latency_us'],
+        calibration=thr.get('calibration'))
+    q_est = round(plan.est_us - ar['est_us'] + q['est_us'], 3)
+    q_score = round(plan.compute_us + q_est, 3)
+    q_wire = plan.wire_bytes - ar['wire_bytes'] + q['wire_bytes']
+    plan.quant = {
+        'wire_dtype': wire_dtype,
+        'block': block,
+        'ar_frac': round(ar['est_us'] / plan.score_us, 4)
+        if plan.score_us else 0.0,
+        'wire_bytes': q_wire,
+        'est_us': q_est,
+        'score_us': q_score,
+        'saved_us': round(plan.score_us - q_score, 3),
+        'recommended': bool(
+            plan.score_us
+            and ar['est_us'] >= QUANT_RECOMMEND_AR_FRAC * plan.score_us
+            and plan.score_us
+            >= QUANT_RECOMMEND_MIN_SPEEDUP * q_score),
+    }
 
 
 def _params_dev_bytes(model, mesh_axes, param_specs):

@@ -512,17 +512,61 @@ def get_kv_client():
     return None
 
 
-def _frame(arr):
+def _frame(arr, extra=None):
     """Serialize one ndarray with an explicit header: dtype, shape and
     a crc32 of the raw bytes.  Dtype-agnostic on purpose — int8/uint8
     (quantized wire traffic) frames identically to f32, and the
-    receiver verifies the crc BEFORE interpreting a single element."""
+    receiver verifies the crc BEFORE interpreting a single element.
+    ``extra`` header fields (the quantized wire's block metadata) ride
+    the SAME frame, covered by the same crc discipline."""
     a = np.ascontiguousarray(arr)
     raw = a.tobytes()
-    head = json.dumps({'dtype': a.dtype.str, 'shape': list(a.shape),
-                       'crc32': binascii.crc32(raw) & 0xFFFFFFFF,
-                       'nbytes': len(raw)}).encode('utf-8')
+    doc = {'dtype': a.dtype.str, 'shape': list(a.shape),
+           'crc32': binascii.crc32(raw) & 0xFFFFFFFF,
+           'nbytes': len(raw)}
+    if extra:
+        doc.update(extra)
+    head = json.dumps(doc).encode('utf-8')
     return len(head).to_bytes(4, 'big') + head + raw
+
+
+# -- block-scaled int8 host wire (the numpy twin of ---------------------------
+#    parallel.quant_collectives' device core; deterministic rounding —
+#    host payloads must replay bit-identically across elastic restarts)
+
+WIRE_QUANT_BLOCK = 256
+
+
+def _quantize_host(arr, block=WIRE_QUANT_BLOCK):
+    """float ndarray -> (int8 [nb, block], f32 scales [nb]); per-block
+    symmetric abs-max, round-half-even (np.rint) — pure in the input,
+    so a restarted rank re-posting the same step re-frames the
+    identical bytes."""
+    flat = np.ascontiguousarray(arr).reshape(-1).astype(np.float32)
+    pad = (-flat.size) % block
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    xb = flat.reshape(-1, block)
+    scales = np.maximum(np.abs(xb).max(axis=1) / 127.0,
+                        np.float32(1e-30)).astype(np.float32)
+    q = np.clip(np.rint(xb / scales[:, None]), -127,
+                127).astype(np.int8)
+    return q, scales
+
+
+def _frame_quant(arr, block=WIRE_QUANT_BLOCK):
+    """Frame a float array as int8 blocks + f32 scales in ONE crc32-
+    covered payload: [scales f32 | q int8], with the block layout and
+    the original dtype/shape in the header.  A byte flipped anywhere
+    after the header — scales or body — fails the crc."""
+    a = np.ascontiguousarray(arr)
+    q, scales = _quantize_host(a, block)
+    packed = np.concatenate([scales.view(np.uint8).reshape(-1),
+                             q.view(np.uint8).reshape(-1)])
+    return _frame(packed, extra={
+        'wire': 'int8-block', 'block': int(block),
+        'nscales': int(scales.size),
+        'orig_dtype': a.dtype.str, 'orig_shape': list(a.shape)})
 
 
 def _unframe(payload, op, tag, rank):
@@ -544,8 +588,25 @@ def _unframe(payload, op, tag, rank):
         raise CollectivePayloadError(
             op, tag, rank, f'crc32 {crc:#x} != recorded '
             f'{head.get("crc32"):#x}')
-    return np.frombuffer(raw, dtype=np.dtype(head['dtype'])).reshape(
+    arr = np.frombuffer(raw, dtype=np.dtype(head['dtype'])).reshape(
         head['shape']).copy()
+    if head.get('wire') == 'int8-block':
+        # dequantize AFTER the crc held: scales then body
+        ns = int(head['nscales'])
+        block = int(head['block'])
+        body = arr[ns * 4:]
+        if body.size != ns * block:
+            raise CollectivePayloadError(
+                op, tag, rank, f'{body.size} quant payload bytes != '
+                f'{ns} blocks x {block}')
+        scales = arr[:ns * 4].view(np.float32)
+        q = body.view(np.int8).reshape(ns, block)
+        flat = (q.astype(np.float32) * scales[:, None]).reshape(-1)
+        shape = tuple(head['orig_shape'])
+        n = int(np.prod(shape)) if shape else 1
+        return flat[:n].reshape(shape).astype(
+            np.dtype(head['orig_dtype']))
+    return arr
 
 
 class HostCollectives:
@@ -571,7 +632,14 @@ class HostCollectives:
 
     def __init__(self, client=None, rank=None, world=None,
                  namespace='ptpu', timeout_s=60.0, poll=0.01,
-                 gc_window=32):
+                 gc_window=32, quant=None, quant_min_bytes=1024):
+        # quant: 'int8' (or True) ships float payloads as block-scaled
+        # int8 + f32 scales inside the same crc frame (EQuARX host
+        # wire).  Instance default; per-call ``quant=`` overrides.
+        # Arrays below quant_min_bytes ship full width (scale overhead
+        # wins).  ALL ranks must agree on the setting: the sum runs
+        # over every rank's DEQUANTIZED payload — own contribution
+        # included — so results stay bitwise identical cluster-wide.
         self.client = client if client is not None else get_kv_client()
         if rank is None:
             rank = int(os.environ.get('PADDLE_TRAINER_ID', 0) or 0)
@@ -588,6 +656,8 @@ class HostCollectives:
         self.timeout_s = float(timeout_s)
         self.poll = poll
         self.gc_window = gc_window
+        self.quant = quant
+        self.quant_min_bytes = int(quant_min_bytes)
         self._history = []          # posted (tag, op) for lazy gc
         self._epoch = time.time()   # aborts older than our start are
                                     # a previous incarnation's
@@ -717,11 +787,27 @@ class HostCollectives:
             pass
         return t
 
-    def _exchange(self, tag, op, arr, timeout_s=None):
+    def _use_quant(self, arr, quant):
+        """True when this payload should ride the int8 block wire:
+        an armed quant setting, a float array, and enough bytes that
+        the per-block scales do not eat the savings."""
+        q = self.quant if quant is None else quant
+        if not q or q in ('0', 'off', 'none', False):
+            return False
+        if q not in ('int8', True, '1'):
+            raise ValueError(f'host quant wire {q!r}: only int8')
+        a = np.asarray(arr)
+        return (np.issubdtype(a.dtype, np.floating)
+                and a.nbytes >= self.quant_min_bytes)
+
+    def _exchange(self, tag, op, arr, timeout_s=None, quant=None):
         """Post own frame, fetch every peer's; returns {rank: ndarray}.
         The whole exchange runs inside a collective_budget scope of
         its effective timeout, so nested bounded waits — retry() on a
-        flaky shared fs, most of all — cannot outlive it."""
+        flaky shared fs, most of all — cannot outlive it.  Under the
+        quantized wire the OWN contribution also round-trips through
+        its frame: every rank reduces over identical dequantized
+        values, keeping results bitwise equal across the cluster."""
         if self.client is None or self.world <= 1:
             return {self.rank: np.asarray(arr)}
         t = self._effective_timeout(timeout_s)
@@ -731,12 +817,20 @@ class HostCollectives:
         except Exception:       # pragma: no cover - defensive
             scope = contextlib.nullcontext()
         with scope:
-            self.post(tag, op, _frame(np.asarray(arr)))
+            quantized = self._use_quant(arr, quant)
+            own = _frame_quant(np.asarray(arr)) if quantized \
+                else _frame(np.asarray(arr))
+            self.post(tag, op, own)
             deadline = time.monotonic() + t
             out, missing = {}, []
             for r in range(self.world):
                 if r == self.rank:
-                    out[r] = np.asarray(arr)
+                    # quantized: the OWN contribution round-trips
+                    # through its frame so every rank reduces over
+                    # identical dequantized values; full width keeps
+                    # the old zero-copy path (no redundant crc)
+                    out[r] = _unframe(own, op, tag, r) if quantized \
+                        else np.asarray(arr)
                     continue
                 payload = self.fetch(tag, op, r, deadline)
                 if payload is None:
@@ -758,11 +852,15 @@ class HostCollectives:
         except Exception:
             pass
 
-    def allreduce(self, arr, op='sum', tag='ar', timeout_s=None):
+    def allreduce(self, arr, op='sum', tag='ar', timeout_s=None,
+                  quant=None):
         """Cross-process all-reduce of one host array (any dtype).
-        op: 'sum' | 'mean' | 'max' | 'min'."""
+        op: 'sum' | 'mean' | 'max' | 'min'.  ``quant='int8'`` ships
+        the payload as block-scaled int8 (scales inside the crc
+        frame); the reduction itself runs full width over the
+        dequantized parts."""
         parts = self._exchange(tag, f'allreduce-{op}', arr,
-                               timeout_s=timeout_s)
+                               timeout_s=timeout_s, quant=quant)
         stack = np.stack([parts[r] for r in sorted(parts)])
         if op == 'sum':
             return stack.sum(axis=0).astype(stack.dtype)
@@ -775,9 +873,13 @@ class HostCollectives:
         raise ValueError(f'bad host allreduce op {op!r}')
 
     def allgather(self, arr, tag='ag', timeout_s=None):
-        """[world, ...] stack of every rank's array."""
+        """[world, ...] stack of every rank's EXACT array.  Always
+        full width — gathers exchange state whose bitwise identity
+        matters (digests, reference weights), so the instance quant
+        default deliberately does not apply; only the lossy-by-
+        construction :meth:`allreduce` consults it."""
         parts = self._exchange(tag, 'allgather', arr,
-                               timeout_s=timeout_s)
+                               timeout_s=timeout_s, quant=False)
         return np.stack([parts[r] for r in sorted(parts)])
 
     def allgather_object(self, obj, tag='ago', timeout_s=None):

@@ -15,7 +15,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ['quantize_weight_int8', 'dynamic_int8_matmul',
-           'artifact_to_matmul_scale']
+           'quantize_weight_int4_packed', 'unpack_int4',
+           'dynamic_int4_matmul', 'artifact_to_matmul_scale']
 
 
 def artifact_to_matmul_scale(scale, qmax=127):
@@ -52,3 +53,61 @@ def dynamic_int8_matmul(x, w_q, w_scale, bias=None,
     if bias is not None:
         out = out + bias.astype(jnp.float32)
     return out.astype(out_dtype)
+
+
+# -- packed int4 weights (two nibbles per byte) -------------------------------
+#
+# The PTQ artifact's 4x-compression backend: weights quantize onto the
+# symmetric int4 grid (qmax=7, per-out-channel abs-max scales like the
+# int8 path) and PACK two H-rows per uint8 — a quarter of bf16's HBM
+# bytes on the weight-bandwidth-bound decode step.  The kernel unpacks
+# nibbles to int8 in-register and runs the SAME int8 x int8 -> int32
+# dot, so the int4 path is bit-identical to an int8 dot over the
+# unpacked values (pinned by the parity test).
+
+_Q4MAX = 7.0
+
+
+def quantize_weight_int4_packed(w):
+    """[H, O] float -> (packed uint8 [ceil(H/2), O], f32 scales [O]).
+    Per-out-channel symmetric abs-max on the int4 grid; even H-row in
+    the low nibble, odd H-row in the high nibble (zero-padded when H
+    is odd — a zero row contributes nothing to the dot)."""
+    w = jnp.asarray(w)
+    scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0) / _Q4MAX
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[None, :]),
+                 -_Q4MAX, _Q4MAX).astype(jnp.int8)
+    if q.shape[0] % 2:
+        q = jnp.concatenate(
+            [q, jnp.zeros((1, q.shape[1]), jnp.int8)], axis=0)
+    lo = q[0::2].astype(jnp.uint8) & 0xF
+    hi = q[1::2].astype(jnp.uint8) & 0xF
+    return (hi << 4) | lo, scale
+
+
+def unpack_int4(packed, rows):
+    """uint8 [P, O] -> int8 [rows, O]: split nibbles, sign-extend,
+    re-interleave the H rows.  Lossless inverse of the packer."""
+    def sext(v):
+        v = v.astype(jnp.int8)
+        return jnp.where(v >= 8, v - 16, v)
+
+    lo = sext(packed & 0xF)
+    hi = sext((packed >> 4) & 0xF)
+    q = jnp.stack([lo, hi], axis=1).reshape(-1, packed.shape[1])
+    return q[:rows]
+
+
+def dynamic_int4_matmul(x, w_packed, w_scale, rows=None, bias=None,
+                        out_dtype=jnp.bfloat16):
+    """x [..., H] float @ dequant(int4-packed weight): nibbles unpack
+    in the kernel, then the identical int8 dot as
+    :func:`dynamic_int8_matmul` — the unpack fuses into the dot's
+    operand read, the weight streams from HBM at half a byte per
+    element.  ``rows`` is H (needed when H is odd; defaults to
+    ``2 * w_packed.shape[0]``)."""
+    rows = int(rows) if rows is not None else 2 * w_packed.shape[0]
+    return dynamic_int8_matmul(x, unpack_int4(w_packed, rows),
+                               w_scale, bias=bias,
+                               out_dtype=out_dtype)

@@ -12,7 +12,10 @@ from .api import (  # noqa: F401
 from .engine import ParallelTrainer  # noqa: F401
 from .localsgd import LocalSGDTrainer  # noqa: F401
 from .pipeline import gpipe, gpipe_spmd  # noqa: F401
+from .quant_collectives import (  # noqa: F401
+    QuantCollectiveConfig, resolve_quant_collectives)
 
 __all__ = ['maybe_shard', 'collect_param_shardings', 'named_sharding',
            'make_spec', 'ParallelTrainer', 'LocalSGDTrainer', 'gpipe',
-           'gpipe_spmd']
+           'gpipe_spmd', 'QuantCollectiveConfig',
+           'resolve_quant_collectives']

@@ -60,7 +60,7 @@ class ParallelTrainer:
                  donate=True, n_inputs=1, nan_guard=False, nan_patience=3,
                  nan_max_rollbacks=2, lint=None, auto_shard=False,
                  hbm_budget_gb=None, calibration=None, profile=None,
-                 watchdog=None, fused_steps=None):
+                 watchdog=None, fused_steps=None, quant_collectives=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -115,6 +115,18 @@ class ParallelTrainer:
         from ..core import scan_loop as _scan
         self.fused_steps = _scan.resolve_fused_steps(fused_steps)
         self._fused_cache = {}
+        # quant_collectives: EQuARX-style block-scaled int8 wire for
+        # the DP grad sync (parallel.quant_collectives).  None → the
+        # PADDLE_TPU_QUANT_COLLECTIVES env decides (default OFF);
+        # False hard-off; 'int8'/True/dict/QuantCollectiveConfig arm
+        # the quantized reduce-scatter→all-gather decomposition.  The
+        # stochastic-rounding keys derive in-module from the step
+        # counter — the quantized step stays sync-free and consumes
+        # nothing from the model's rng stream.
+        from . import quant_collectives as _qc
+        self.quant_collectives = _qc.resolve_quant_collectives(
+            quant_collectives)
+        self._quant_active = None   # the config the built step uses
         self._step_no = 0
         self._compiled = None
         self._eval_compiled = None
@@ -141,6 +153,14 @@ class ParallelTrainer:
                     'hybrid_configs.pp_degree before fleet.init.',
                     UserWarning, stacklevel=2)
         if self._pipeline:
+            if self.quant_collectives is not None:
+                import warnings
+                warnings.warn(
+                    'quant_collectives is not supported under pipeline '
+                    'parallelism (the 1F1B schedule owns its own '
+                    'collectives); the wire stays full width.',
+                    RuntimeWarning, stacklevel=3)
+                self.quant_collectives = None
             if self.lint:
                 import warnings
                 warnings.warn(
@@ -445,8 +465,19 @@ class ParallelTrainer:
             return {n: jax.lax.with_sharding_constraint(
                 g, self._grad_shardings[n]) for n, g in grads.items()}
 
+        quant_cfg = self._resolve_quant(merge_k)
+        self._quant_active = quant_cfg
+        quant_grads = self._build_quant_grads(quant_cfg) \
+            if quant_cfg is not None else None
+
         def train_step(params, buffers, opt_state, step_no, key, *batch):
-            if merge_k > 1:
+            if quant_grads is not None:
+                # quantized wire: per-shard grads inside shard_map,
+                # explicit int8 reduce (parallel.quant_collectives) —
+                # the partitioner never sees a full-width grad psum
+                loss, grads, new_buffers = quant_grads(
+                    params, buffers, step_no, key, batch)
+            elif merge_k > 1:
                 # microbatch accumulation: batch dim 0 must divide by k
                 def body(carry, mb):
                     g_acc, buf = carry
@@ -512,6 +543,107 @@ class ParallelTrainer:
         if self.donate:
             kwargs['donate_argnums'] = (0, 2)
         return jax.jit(train_step, **kwargs)
+
+    # -- quantized wire (parallel.quant_collectives) -------------------------
+    def _resolve_quant(self, merge_k=1):
+        """The quantized-wire config THIS step build can honor, or
+        None.  A requested config that cannot apply degrades to full
+        width with a warning naming the reason — quantization must
+        never be able to kill a train loop that would have run."""
+        cfg = self.quant_collectives
+        if cfg is None:
+            return None
+        import warnings
+
+        def off(reason):
+            warnings.warn(
+                f'quant_collectives requested but {reason}; the DP '
+                'grad sync runs full width', RuntimeWarning,
+                stacklevel=4)
+            return None
+
+        if self.mesh is None:
+            return off('no mesh is configured')
+        shape = dict(self.mesh.shape)
+        if shape.get('dp', 1) <= 1:
+            return off('the mesh has no dp axis > 1')
+        others = {a: s for a, s in shape.items()
+                  if a != 'dp' and s > 1}
+        if others:
+            return off(f'non-dp mesh axes {others} are live (the '
+                       'quantized decomposition covers the pure-DP '
+                       'grad sync; TP activations keep their own '
+                       'collectives)')
+        live = set()
+        for spec in self.param_specs.values():
+            for part in (spec or ()):
+                for ax in (part if isinstance(part, (tuple, list))
+                           else (part,)):
+                    if ax and ax != '...' and shape.get(ax, 1) > 1:
+                        live.add(ax)
+        if live:
+            return off(f'param specs shard over {sorted(live)} — the '
+                       'quantized step needs dp-replicated params')
+        if merge_k > 1:
+            return off('strategy.gradient_merge accumulates '
+                       'microbatch grads inside the step')
+        zero_stage = (self.strategy.sharding_configs.get('stage', 1)
+                      if self.strategy and self.strategy.sharding
+                      else 0)
+        if zero_stage >= 2:
+            return off('strategy.sharding stage>=2 (ZeRO-2) owns the '
+                       'grad reduce-scatter — quantized grads would '
+                       'arrive replicated and defeat it')
+        return cfg
+
+    def _build_quant_grads(self, cfg):
+        """The quantized DP grad sync: forward+backward per dp shard
+        inside ONE shard_map region, then the explicit block-scaled
+        int8 all-reduce decomposition over the fused flat grad
+        message.  Returns ``fn(params, buffers, step_no, key, batch)
+        -> (loss, grads, new_buffers)`` with grads already mean-
+        reduced (replicated), drop-in for the implicit-psum path."""
+        from ..core.jaxcompat import shard_map
+        from . import quant_collectives as _qc
+        mesh = self.mesh
+        dp_n = dict(mesh.shape)['dp']
+
+        def body(params, buffers, step_no, key, *batch):
+            # per-replica dropout stream, like the global batch would
+            # draw distinct masks per example
+            key = jax.random.fold_in(key, jax.lax.axis_index('dp'))
+            # model-internal maybe_shard constraints read the env
+            # mesh at trace time; inside shard_map everything is
+            # already local, so they must be identity here
+            prev = _env.get_mesh()
+            _env.set_mesh(None)
+            try:
+                (loss, new_buf), g = jax.value_and_grad(
+                    self._forward_loss, has_aux=True)(
+                        params, buffers, key, batch)
+            finally:
+                _env.set_mesh(prev)
+            qkey = _qc.step_key(cfg, step_no) if cfg.stochastic \
+                else None
+            g = _qc.quantized_allreduce_tree(
+                g, 'dp', n=dp_n, cfg=cfg, key=qkey, op='mean')
+            loss = jax.lax.pmean(loss, 'dp')
+            new_buf = jax.tree_util.tree_map(
+                lambda b: jax.lax.pmean(b, 'dp'), new_buf)
+            return loss, g, new_buf
+
+        def quant_grads(params, buffers, step_no, key, batch):
+            repl_p = jax.tree_util.tree_map(lambda _: P(), params)
+            repl_b = jax.tree_util.tree_map(lambda _: P(), buffers)
+            sm = shard_map(
+                body, mesh=mesh,
+                in_specs=(repl_p, repl_b, P(), P())
+                + (P('dp'),) * len(batch),
+                out_specs=(P(), repl_p, repl_b),
+                check_vma=False)
+            return sm(params, buffers, step_no, key, *batch)
+
+        return quant_grads
 
     # -- auto-sharding (analysis.planner) ------------------------------------
     def _auto_plan(self, vals):
@@ -1150,7 +1282,8 @@ class ParallelTrainer:
             census = _hlo.collective_census(
                 _hlo.parse_module(text), mesh_shape=dict(self.mesh.shape),
                 calibration=self._resolved_calibration())
-            per_op = {base: {'calls': r['calls'], 'bytes': r['bytes']}
+            per_op = {base: {'calls': r['calls'], 'bytes': r['bytes'],
+                             'wire_dtype': r.get('wire_dtype')}
                       for base, r in census.items()}
             total = sum(r['bytes'] for r in per_op.values())
             _tel.event('collectives', name='ParallelTrainer.step',
@@ -1161,14 +1294,19 @@ class ParallelTrainer:
                                 'wire_bytes': r['wire_bytes'],
                                 'est_us': r['est_us'],
                                 'phases': r['phases'],
-                                'group_size': r['group_size']}
+                                'group_size': r['group_size'],
+                                'wire_dtype': r.get('wire_dtype')}
                          for base, r in census.items()}
+            quant = self._quant_active
             _tel.event('collective_cost', name='ParallelTrainer.step',
                        mesh=dict(self.mesh.shape), per_op=predicted,
                        wire_bytes_total=sum(
                            r['wire_bytes'] for r in predicted.values()),
                        est_us_total=round(sum(
-                           r['est_us'] for r in predicted.values()), 3))
+                           r['est_us'] for r in predicted.values()), 3),
+                       quant_collectives=(quant.dtype
+                                          if quant is not None
+                                          else None))
         except Exception:       # audit is evidence, never a blocker
             pass
 

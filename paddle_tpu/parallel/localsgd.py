@@ -23,13 +23,21 @@ __all__ = ['LocalSGDTrainer']
 
 class LocalSGDTrainer:
     def __init__(self, model, optimizer, loss_fn, mesh=None, k_steps=4,
-                 n_inputs=1, dp_axis='dp'):
+                 n_inputs=1, dp_axis='dp', quant_collectives=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.k_steps = max(1, int(k_steps))
         self.n_inputs = n_inputs
         self.dp_axis = dp_axis
+        # quant_collectives: ship the periodic model average on a
+        # block-scaled int8 wire (parallel.quant_collectives) — the
+        # natural fit for LocalSGD, whose whole point is trading sync
+        # fidelity for wire frequency.  Same resolve posture as
+        # ParallelTrainer (env default OFF, False beats env).
+        from . import quant_collectives as _qc
+        self.quant_collectives = _qc.resolve_quant_collectives(
+            quant_collectives)
         self.mesh = mesh or _env.get_mesh()
         assert self.mesh is not None and \
             dict(self.mesh.shape).get(dp_axis, 1) > 1, \
@@ -102,11 +110,33 @@ class LocalSGDTrainer:
 
         self._compiled = jax.jit(step, donate_argnums=(0, 2))
 
-        def sync(params):
-            # mean over the replica dim, broadcast back: ONE all-reduce
-            return jax.tree_util.tree_map(
-                lambda a: jnp.broadcast_to(a.mean(0, keepdims=True),
-                                           a.shape), params)
+        if self.quant_collectives is None:
+            def sync(params, step_no):
+                # mean over the replica dim, broadcast back: ONE
+                # all-reduce
+                del step_no
+                return jax.tree_util.tree_map(
+                    lambda a: jnp.broadcast_to(
+                        a.mean(0, keepdims=True), a.shape), params)
+        else:
+            from . import quant_collectives as _qc
+            cfg = self.quant_collectives
+            n = self.dp
+
+            def sync_body(params, step_no):
+                local = jax.tree_util.tree_map(lambda a: a[0], params)
+                qkey = _qc.step_key(cfg, step_no) if cfg.stochastic \
+                    else None
+                avg = _qc.quantized_allreduce_tree(
+                    local, dp_axis, n=n, cfg=cfg, key=qkey, op='mean')
+                return jax.tree_util.tree_map(lambda a: a[None], avg)
+
+            def sync(params, step_no):
+                from ..core.jaxcompat import shard_map
+                return shard_map(
+                    sync_body, mesh=self.mesh,
+                    in_specs=(spec_p, P()), out_specs=spec_p,
+                    check_vma=False)(params, step_no)
 
         self._sync_fn = jax.jit(sync, donate_argnums=0)
 
@@ -123,14 +153,16 @@ class LocalSGDTrainer:
             jnp.asarray(self._step_no + 1), key, *vals)
         self._step_no += 1
         if self._step_no % self.k_steps == 0:
-            self.params = self._sync_fn(self.params)
+            self.params = self._sync_fn(
+                self.params, jnp.asarray(self._step_no))
         return loss
 
     def sync(self):
         """Force a parameter average now."""
         if self._sync_fn is None:
             self._build()
-        self.params = self._sync_fn(self.params)
+        self.params = self._sync_fn(
+            self.params, jnp.asarray(self._step_no))
 
     def sync_to_model(self):
         """Average replicas and write back into the live Layer."""

@@ -233,6 +233,7 @@ def match_collectives(profile, instr_index, *, num_partitions=1,
                'bytes': info['bytes'],
                'group_size': info['group_size'],
                'axes': [list(a) for a in info.get('axes') or ()],
+               'wire_dtype': info.get('wire_dtype'),
                'predicted_us': info.get('est_us')}
         if name:
             out['name'] = name

@@ -26,7 +26,9 @@ __all__ = ['fake_quant', 'FakeQuantAbsMax',
            'FakeQuantMovingAverageAbsMax', 'QuantedLayer',
            'ImperativeQuantAware', 'PostTrainingQuantization',
            'quant_post_dynamic', 'load_quantized_model',
-           'Int8DynamicLinear', 'quantize_dynamic_int8']
+           'Int8DynamicLinear', 'Int4DynamicLinear',
+           'quantize_dynamic_int8', 'quantize_dynamic_int4',
+           'quantize_for_serving']
 
 
 def _make_fake_quant():
@@ -385,21 +387,58 @@ class Int8DynamicLinear(Layer):
         return f'in={self.in_features}, out={self.out_features}, int8'
 
 
-def quantize_dynamic_int8(model, layer_filter=None):
-    """Swap every plain nn.Linear sublayer of `model` for an
-    Int8DynamicLinear, in place (the executing analog of
-    quant_post_dynamic; reference serving runs int8 through
-    PaddleSlim + TensorRT kernels, here it is one int8 dot_general on
-    the MXU).  Only exact nn.Linear instances are swapped — subclasses
-    (tp-sharded parallel linears, already-wrapped QuantedLayers) keep
-    their own math.  `layer_filter(full_name, layer) -> bool` opts
-    layers out (e.g. keep a numerically-sensitive head in bf16).
-    Returns `model`.  Typical decode use:
+class Int4DynamicLinear(Layer):
+    """Serving-time nn.Linear replacement on PACKED int4 weights
+    (ops/int8_matmul.quantize_weight_int4_packed): two H-rows per
+    uint8 in HBM — a QUARTER of bf16's weight bytes on the
+    weight-bandwidth-bound decode step — unpacked to int8 in the
+    kernel and fed through the same int8 x int8 -> int32 dot as
+    :class:`Int8DynamicLinear`.  Coarser grid (qmax=7): gate quality
+    per model before shipping (tools/quant_accuracy for the wire;
+    eval-set perplexity for PTQ weights).  Inference-only."""
 
-        model.eval()
-        quantize_dynamic_int8(model)
-        model.generate(ids, max_new_tokens=128)   # one XLA module
-    """
+    def __init__(self, linear):
+        super().__init__()
+        from ..core.tensor import Tensor
+        from ..ops.int8_matmul import quantize_weight_int4_packed
+        w_shape = linear.weight.shape          # [in, out] all variants
+        self.in_features = int(w_shape[0])
+        self.out_features = int(w_shape[1])
+        packed, scale = quantize_weight_int4_packed(linear.weight.value)
+        self.register_buffer('qweight',
+                             Tensor(packed, stop_gradient=True))
+        self.register_buffer('wscale',
+                             Tensor(scale, stop_gradient=True))
+        self.bias = linear.bias
+
+    def forward(self, x):
+        from ..ops.int8_matmul import dynamic_int4_matmul
+        rows = self.in_features
+
+        def fn(xv, qv, sv, *maybe_b):
+            out_dtype = xv.dtype if jnp.issubdtype(
+                xv.dtype, jnp.floating) else jnp.bfloat16
+            return dynamic_int4_matmul(
+                xv, qv, sv, rows=rows,
+                bias=maybe_b[0] if maybe_b else None,
+                out_dtype=out_dtype)
+
+        args = [wrap(x), wrap(self.qweight), wrap(self.wscale)]
+        if self.bias is not None:
+            args.append(wrap(self.bias))
+        return apply(fn, *args, op_name='int4_linear')
+
+    def extra_repr(self):
+        return f'in={self.in_features}, out={self.out_features}, int4'
+
+
+def _quantize_dynamic(model, make_layer, layer_filter=None):
+    """Swap every plain nn.Linear sublayer of `model` for
+    ``make_layer(sub)``, in place.  Only exact nn.Linear instances are
+    swapped — subclasses (tp-sharded parallel linears under a live tp
+    axis, already-wrapped QuantedLayers) keep their own math.
+    `layer_filter(full_name, layer) -> bool` opts layers out (e.g.
+    keep a numerically-sensitive head in bf16).  Returns `model`."""
     from ..nn import Linear
     from ..distributed import env as dist_env
     from ..distributed.fleet.meta_parallel import (ColumnParallelLinear,
@@ -421,7 +460,7 @@ def quantize_dynamic_int8(model, layer_filter=None):
             full = f'{prefix}.{name}' if prefix else name
             if type(sub) in swappable and (layer_filter is None
                                            or layer_filter(full, sub)):
-                layer._sub_layers[name] = Int8DynamicLinear(sub)
+                layer._sub_layers[name] = make_layer(sub)
                 n += 1
             elif isinstance(sub, QuantedLayer):
                 # QuantedLayer.forward re-reads inner.weight for fake
@@ -441,6 +480,54 @@ def quantize_dynamic_int8(model, layer_filter=None):
                     'quantize that')
         raise ValueError('no quantizable Linear sublayers found'
                          + hint)
+    return model
+
+
+def quantize_dynamic_int8(model, layer_filter=None):
+    """Swap every plain nn.Linear sublayer of `model` for an
+    Int8DynamicLinear, in place (the executing analog of
+    quant_post_dynamic; reference serving runs int8 through
+    PaddleSlim + TensorRT kernels, here it is one int8 dot_general on
+    the MXU).  Typical decode use:
+
+        model.eval()
+        quantize_dynamic_int8(model)
+        model.generate(ids, max_new_tokens=128)   # one XLA module
+    """
+    return _quantize_dynamic(model, Int8DynamicLinear, layer_filter)
+
+
+def quantize_dynamic_int4(model, layer_filter=None):
+    """int4 twin of :func:`quantize_dynamic_int8`: packed nibbles in
+    HBM, unpacked in the kernel (ops/int8_matmul.dynamic_int4_matmul).
+    A quarter of bf16's weight bytes; coarser grid — measure quality
+    before shipping."""
+    return _quantize_dynamic(model, Int4DynamicLinear, layer_filter)
+
+
+_SERVING_MODES = {'int8': quantize_dynamic_int8,
+                  'int4': quantize_dynamic_int4}
+
+
+def quantize_for_serving(model, mode='int8', layer_filter=None):
+    """Weight-only PTQ of a serving model, in place — the
+    ``ServeConfig(quantize=...)`` entry point.  ``mode`` is 'int8'
+    (Int8DynamicLinear) or 'int4' (packed Int4DynamicLinear); every
+    decode then reads half-width (or quarter-width) weights from HBM
+    through the MXU's native int8 path.  Activations stay dynamic
+    per-call; the KV cache and embeddings keep their dtype.  Returns
+    `model`."""
+    fn = _SERVING_MODES.get(mode)
+    if fn is None:
+        raise ValueError(
+            f'quantize_for_serving mode {mode!r}: expected one of '
+            f'{sorted(_SERVING_MODES)}')
+    model.eval()
+    fn(model, layer_filter)
+    # the swap is IRREVERSIBLE (float weights are dropped): mark the
+    # model so a ServingEngine whose config declares a different
+    # quantize mode refuses instead of compiling a mis-keyed surface
+    model._ptq_mode = mode
     return model
 
 

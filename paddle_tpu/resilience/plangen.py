@@ -129,7 +129,7 @@ def _make(kind, rng, steps, procs, save_every, hang_s):
 def generate_plan(seed, steps, procs, n_faults=6,
                   require=('collective_hang', 'sigkill', 'torn_write'),
                   save_every=2, hang_s=60.0, kinds=None,
-                  name=None):
+                  name=None, quant_wire=False):
     """A seeded, legal FaultPlan for one soak.
 
     `require` kinds are always present (coverage classes the soak
@@ -137,7 +137,17 @@ def generate_plan(seed, steps, procs, n_faults=6,
     GENERATABLE_KINDS, minus requirements already satisfied).  Pure in
     (seed, steps, procs, knobs): the same call composes the identical
     plan, which is what makes a soak failure replayable before it is
-    even shrunk."""
+    even shrunk.
+
+    ``quant_wire`` is the quantized-wire COVERAGE CLASS: the plan is
+    tagged ``+qwire`` and tools/soak_run.py runs the workers' host
+    all-reduces on the block-scaled int8 wire
+    (``HostCollectives.allreduce(quant='int8')``), so every injected
+    fault — corrupt-after-crc, SIGKILL mid-allreduce, hangs — drives
+    the QUANTIZED payload path.  It changes no fault draw: the same
+    seed composes the identical fault sequence either way (so a
+    quantized soak failure bisects cleanly against its full-width
+    twin)."""
     # int-folded so the draw stream is pure in (seed, steps, procs)
     # (random.Random rejects tuples)
     rng = random.Random(int(seed) * 1_000_003
@@ -173,8 +183,10 @@ def generate_plan(seed, steps, procs, n_faults=6,
                 break
         else:
             break       # pool exhausted at this size; plan stays legal
-    return FaultPlan(seed=seed, faults=faults,
-                     name=name or f'soak-{seed}')
+    base = name or f'soak-{seed}'
+    if quant_wire:
+        base += '+qwire'
+    return FaultPlan(seed=seed, faults=faults, name=base)
 
 
 def plan_fingerprint(plan):

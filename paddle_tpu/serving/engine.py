@@ -76,7 +76,7 @@ class ServeConfig:
                  prefill_batch=8, max_model_len=None, temperature=0.0,
                  top_k=None, eos_id=None, num_blocks=None,
                  request_deadline_s=None, watchdog=None, profile=None,
-                 seed=0):
+                 seed=0, quantize=None):
         self.block_size = int(block_size)
         self.max_slots = int(max_slots)
         self.decode_span = max(1, int(decode_span))
@@ -96,6 +96,15 @@ class ServeConfig:
         self.watchdog = watchdog
         self.profile = profile
         self.seed = int(seed)
+        # weight-only PTQ of the served model: None (full width),
+        # 'int8' (Int8DynamicLinear) or 'int4' (packed nibbles) —
+        # decode reads half-/quarter-width weights from HBM.  Part of
+        # signature(), so quantized and full-width surfaces can never
+        # share a compiled module.
+        if quantize not in (None, 'int8', 'int4'):
+            raise ValueError(f'ServeConfig quantize={quantize!r}: '
+                             "expected None, 'int8' or 'int4'")
+        self.quantize = quantize
 
     @classmethod
     def from_json(cls, path_or_dict):
@@ -167,6 +176,26 @@ class ServingEngine:
         model.eval()
         self.model = model
         self.config = (config or ServeConfig()).resolved(cfg)
+        applied = getattr(model, '_ptq_mode', None)
+        if applied != (self.config.quantize or None):
+            if applied is not None:
+                # the swap dropped the float weights — an engine whose
+                # declared signature disagrees with the model's actual
+                # numerics would mis-key its compiled/AOT surface
+                raise ValueError(
+                    f'model was already PTQ-quantized ({applied!r}) '
+                    f'but this config declares '
+                    f'quantize={self.config.quantize!r}; build each '
+                    'quantization mode from a FRESH model '
+                    '(quantize_for_serving swaps weights in place)')
+            # weight-only PTQ BEFORE functional_state: the swapped
+            # Int8/Int4DynamicLinears' int8 buffers become the params/
+            # buffers every prefill/decode module closes over, so the
+            # whole compiled serving surface reads narrow weights from
+            # HBM (and precompile --serve AOT-compiles the same —
+            # quantize is part of the config signature)
+            from ..quantization import quantize_for_serving
+            quantize_for_serving(model, self.config.quantize)
         self.now_fn = now_fn
         # one engine-relative clock for EVERY timestamp (arrivals,
         # TTFT, deadlines) so offsets and wall reads never mix frames

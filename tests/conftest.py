@@ -35,6 +35,12 @@ os.environ.setdefault('PADDLE_TPU_WATCHDOG', '0')
 # sync cadence than the tests pin) — fused-behavior tests pass
 # fused_steps= explicitly
 os.environ.setdefault('PADDLE_TPU_FUSED_STEPS', '0')
+# ...and for the quantized collective wire: an ambient
+# PADDLE_TPU_QUANT_COLLECTIVES would re-route every dp trainer's grad
+# sync through the int8 decomposition (different numerics than the
+# exactness tests pin) — quant-behavior tests pass quant_collectives=
+# explicitly
+os.environ.setdefault('PADDLE_TPU_QUANT_COLLECTIVES', '0')
 
 import jax  # noqa: E402
 

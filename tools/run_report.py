@@ -328,6 +328,7 @@ def analyze(events, sources, skew=None):
             'per_op': last.get('per_op', {}),
             'wire_bytes_total': last.get('wire_bytes_total', 0),
             'est_us_total': last.get('est_us_total', 0.0),
+            'quant_collectives': last.get('quant_collectives'),
             'mesh': last.get('mesh')}
     # profiled per-collective timings (telemetry.profile capture
     # windows): the observed side calibrate_costmodel.py fits
@@ -346,20 +347,24 @@ def analyze(events, sources, skew=None):
         # modules — their per-call timings must not blend
         key = (op, e.get('name'), e.get('instr'))
         r = per_instr.setdefault(
-            key, {'us': [], 'wire_bytes': 0, 'phases': 0, 'calls': 0})
+            key, {'us': [], 'wire_bytes': 0, 'phases': 0, 'calls': 0,
+                  'wire_dtype': None})
         r['us'].append(e.get('us') or 0.0)
         r['wire_bytes'] = max(r['wire_bytes'],
                               e.get('wire_bytes') or 0)
         r['phases'] = max(r['phases'], e.get('phases') or 0)
         r['calls'] += e.get('calls') or 1
+        r['wire_dtype'] = e.get('wire_dtype') or r['wire_dtype']
     observed_us = {}
     for (op, _name, _instr), r in per_instr.items():
         row = observed_us.setdefault(
-            op, {'us': 0.0, 'wire_bytes': 0, 'phases': 0, 'calls': 0})
+            op, {'us': 0.0, 'wire_bytes': 0, 'phases': 0, 'calls': 0,
+                 'wire_dtype': None})
         row['us'] = round(row['us'] + sum(r['us']) / len(r['us']), 3)
         row['wire_bytes'] += r['wire_bytes']
         row['phases'] += r['phases']
         row['calls'] += r['calls']
+        row['wire_dtype'] = r['wire_dtype'] or row['wire_dtype']
     collectives_cmp = None
     if collectives or collectives_predicted or observed_us:
         ops = set((collectives or {}).get('per_op', {})) | set(
@@ -380,6 +385,13 @@ def analyze(events, sources, skew=None):
                 'predicted_wire_bytes': pred.get('wire_bytes'),
                 'predicted_est_us': pred.get('est_us'),
                 'predicted_phases': pred.get('phases'),
+                # the wire-dtype dimension: the compiled module's
+                # payload element type (s8 under quantized
+                # collectives) — prediction first, profiler join as
+                # fallback, so the 2-4x byte claim is auditable per op
+                'wire_dtype': (pred.get('wire_dtype')
+                               or obs.get('wire_dtype')
+                               or prof.get('wire_dtype')),
             }
             # the closed loop: profiled us over the cost-model
             # estimate, per op — what calibration is meant to pull
@@ -640,6 +652,8 @@ def render(report, stream=None):
         cmp_rows = report.get('collectives_cmp') or {}
         p(f'    {"op":<20}{"observed":>22}{"predicted (cost model)":>28}')
         for op, row in sorted(cmp_rows.items()):
+            if row.get('wire_dtype') and row['wire_dtype'] != 'f32':
+                op = f'{op}[{row["wire_dtype"]}]'
             obs_parts = []
             if row['observed_calls'] is not None:
                 obs_parts.append(f'{row["observed_calls"]}x '

@@ -67,7 +67,7 @@ SMOKE_PLAN = {
 }
 
 
-def _final_w(steps, world=1):
+def _final_w(steps, world=1, quant=False):
     """The workload's exact final state: w_i = mean over `world`
     copies of (0.9*w_{i-1} + i), float32 throughout — pure in
     (step index, world), so ANY fault schedule that lets the cluster
@@ -76,13 +76,23 @@ def _final_w(steps, world=1):
     sum of three identical f32 values rounds (3a needs up to 26
     mantissa bits), so mean-of-identical-replicas is only bitwise
     identity at power-of-two world sizes — the reference replays the
-    exact collective the workers run instead of assuming it away."""
+    exact collective the workers run instead of assuming it away.
+
+    ``quant`` replays the quantized wire: each rank's contribution
+    round-trips through the SAME deterministic block quantizer the
+    transport frames with, BEFORE the mean — the quantized soak's
+    bit-exact reference (host quantization is pure in the payload, so
+    restarts replay it identically)."""
     import numpy as np
     w = np.arange(8.0, dtype='float32')
     for i in range(1, steps + 1):
         w = (w * np.float32(0.9)
              + np.float32(i) * np.ones(8, dtype='float32'))
         if world > 1:
+            if quant:
+                from paddle_tpu.distributed.collective import (
+                    _frame_quant, _unframe)
+                w = _unframe(_frame_quant(w), 'ref', 'ref', 0)
             w = np.stack([w] * world).mean(axis=0).astype(np.float32)
     return w
 
@@ -157,8 +167,15 @@ def worker_main():
             mine.mark_fired(load_run_events(workdir), rank=rank)
         engine = ChaosEngine(mine, rank=rank).activate()
 
+    # the quantized-wire coverage class: every all-reduce below ships
+    # block-scaled int8 + scales through the same crc frame — the
+    # fault seams (corrupt-after-crc, SIGKILL mid-allreduce, hangs)
+    # then exercise the quantized payload path.  quant_min_bytes=0:
+    # the 8-float workload array must actually quantize.
+    quant = os.environ.get('PADDLE_TPU_SOAK_QUANT') or None
     transport = HostCollectives(rank=rank, world=world,
-                                timeout_s=coll_t)
+                                timeout_s=coll_t,
+                                quant=quant, quant_min_bytes=0)
     transport.clear_abort()
     budget = Budget.from_env(os.environ.get('PADDLE_TPU_WATCHDOG'))
     wd = None
@@ -278,9 +295,9 @@ def _norm_sequence(report):
     return {r: v for r, v in sorted(by_rank.items())}
 
 
-def _check_finals(report, steps):
+def _check_finals(report, steps, quant=False):
     import numpy as np
-    ref = _final_w(steps, world=report.get('procs', 1))
+    ref = _final_w(steps, world=report.get('procs', 1), quant=quant)
     bad = []
     for r, doc in sorted(report.get('finals', {}).items()):
         if not np.array_equal(
@@ -293,11 +310,16 @@ def _check_finals(report, steps):
 def run_soak(args, plan=None, workdir=None, extra_env=None):
     from paddle_tpu.resilience.chaos import ChaosCluster
     from paddle_tpu.resilience import plangen
+    quant = bool(getattr(args, 'quant_wire', False))
     if plan is None:
         plan = plangen.generate_plan(
             args.seed, args.steps, args.procs, n_faults=args.faults,
             save_every=args.save_every,
-            hang_s=4 * args.collective_timeout)
+            hang_s=4 * args.collective_timeout,
+            quant_wire=quant)
+    if quant:
+        extra_env = dict(extra_env or {},
+                         PADDLE_TPU_SOAK_QUANT='int8')
     cluster = ChaosCluster(
         procs=args.procs, plan=plan, steps=args.steps,
         workdir=workdir, save_every=args.save_every,
@@ -308,7 +330,9 @@ def run_soak(args, plan=None, workdir=None, extra_env=None):
         jax_distributed=args.jax_distributed,
         extra_env=extra_env)
     report = cluster.run()
-    report['violations'] += _check_finals(report, args.steps) \
+    report['quant_wire'] = quant
+    report['violations'] += _check_finals(report, args.steps,
+                                          quant=quant) \
         if report['rc'] == 0 else []
     report['ok'] = not report['violations']
     return report, plan
@@ -510,6 +534,13 @@ def main(argv=None):
                     help='per-rank failure-restart budget (invariant '
                          'I5); abort cascades under compound plans '
                          'cost a restart per affected rank')
+    ap.add_argument('--quant-wire', action='store_true',
+                    help='quantized-wire coverage class: workers run '
+                         'every host all-reduce as block-scaled int8 '
+                         '+ scales inside the crc frame, so the '
+                         'fault seams drive the quantized payload '
+                         'path; the bit-exact final-state reference '
+                         'replays the same quantizer')
     ap.add_argument('--jax-distributed', action='store_true',
                     help='also jax.distributed-initialize the workers '
                          '(clean plans only: the coordination service '
