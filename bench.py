@@ -22,6 +22,7 @@ Run a single config with --config
 {lenet,resnet,bert,gpt,widedeep,longctx,gptgen} (or 'all').
 """
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -1618,6 +1619,237 @@ def _obs_preflight(smoke, timeout_s=900):
     return ok, summary
 
 
+def _cluster_obs_smoke_child(smoke):
+    """--cluster-obs-smoke child: the training-cluster observability
+    plane under chaos (the ISSUE-15 acceptance bar), in one process:
+
+    (a) a 2-proc ChaosCluster with rank 1 throttled (``slow_rank``)
+        then SIGKILLed, cluster stats armed — rank 0's aggregator
+        serves /cluster/status.json on an ephemeral port while the
+        parent thread scrapes every 200ms.  Mid-run scrapes must
+        ATTRIBUTE the straggler to rank 1 with populated skew, and
+        the kill must DEGRADE the view (rank 1 stale-marked, server
+        still answering) rather than crash the plane or the job
+        (rc=0, invariants I1-I7 + bit-exact finals still gate).
+    (b) scraping changes nothing: a hapi trainer loop runs twice on
+        identical seeds/data — publisher ON (under a device->host
+        transfer guard: the publisher must add no syncs) vs
+        publisher OFF — and must produce bit-identical losses with
+        equal compile counts.
+
+    Emits one JSON line with the gate evidence."""
+    import tempfile
+    import threading
+    import urllib.request
+    import numpy as np  # noqa: F811
+    del smoke       # the gate always runs the CPU smoke scale
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, telemetry
+    from paddle_tpu.resilience.chaos import ChaosCluster, FaultPlan
+
+    out = {}
+
+    # -- (a) chaos-validated live cluster view ---------------------------
+    plan = FaultPlan(seed=7, name='cluster-obs-smoke', faults=(
+        [{'kind': 'slow_rank', 'at_step': s, 'rank': 1,
+          'delay_s': 0.35} for s in range(3, 10)]
+        + [{'kind': 'sigkill', 'at_step': 14, 'rank': 1}]))
+    cluster = ChaosCluster(
+        procs=2, plan=plan, steps=20, save_every=2,
+        collective_timeout_s=20.0, watchdog='step=60,grace=2',
+        deadline_s=180.0, cluster_stats=True,
+        # hold the killed rank down for ~4s: the stale threshold is
+        # 1.5s, so the degraded (stale-marked) view is observable by
+        # the 200ms scraper for a couple of seconds before the
+        # elastic respawn re-publishes
+        restart_backoff=4.0, restart_backoff_max=5.0,
+        extra_env={'PADDLE_TPU_SOAK_FLUSH': '2',
+                   'PADDLE_TPU_SOAK_STALE_AFTER': '1.5'})
+    result = {}
+
+    def _run():
+        result['report'] = cluster.run()
+
+    th = threading.Thread(target=_run, daemon=True)
+    th.start()
+    snaps, scrape_errors = [], 0
+    t0 = time.time()
+    while th.is_alive() and time.time() - t0 < 170:
+        try:
+            with open(cluster.cluster_port_file) as f:
+                port = json.load(f)['port']
+            doc = json.loads(urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/cluster/status.json',
+                timeout=2).read())
+            snaps.append(doc)
+        except Exception:
+            scrape_errors += 1
+        time.sleep(0.2)
+    th.join(timeout=30)
+    rep = result.get('report') or {}
+    out['cluster_rc'] = rep.get('rc')
+    out['cluster_ok'] = rep.get('ok')
+    out['violations'] = (rep.get('violations') or [])[:4]
+    out['scrapes'] = len(snaps)
+    out['scrape_errors'] = scrape_errors
+    blamed = [s for s in snaps
+              if (s.get('straggler') or {}).get('rank') is not None]
+    attributed = [s for s in blamed
+                  if s['straggler']['rank'] == 1
+                  and (s['straggler'].get('skew') or 0) > 1.0]
+    out['straggler_scrapes'] = len(attributed)
+    # attributions naming any OTHER rank: transient windows may blame
+    # a waiter briefly, but the correct attribution must dominate
+    out['wrong_rank_scrapes'] = len(blamed) - len(
+        [s for s in blamed if s['straggler']['rank'] == 1])
+    if attributed:
+        out['straggler_example'] = attributed[0]['straggler']
+        out['critical_path_example'] = \
+            attributed[0].get('critical_path')
+    # any scrape that saw rank 1 stale/missing while the server still
+    # answered = the degraded-not-crashed contract (the SIGKILL window
+    # before the elastic respawn re-publishes)
+    degraded = [s for s in snaps
+                if s.get('degraded')
+                and ((s.get('ranks') or {}).get('1', {}).get('stale')
+                     or 1 in (s.get('missing') or []))]
+    out['degraded_scrapes'] = len(degraded)
+    out['kill_injected'] = any(
+        e.get('fault') == 'sigkill' for e in rep.get('injected', ()))
+
+    # -- (b) scrape-changes-nothing + sync-free publisher ----------------
+    from paddle_tpu.distributed.collective import (
+        FileKVStore, HostCollectives)
+    from paddle_tpu.telemetry.cluster import ClusterPublisher
+
+    def _losses(with_publisher):
+        telemetry.reset()
+        telemetry.enable(None, flush_interval=4)
+        pub = None
+        if with_publisher:
+            kv = FileKVStore(tempfile.mkdtemp(prefix='cobs_kv_'))
+            pub = ClusterPublisher(
+                transport=HostCollectives(client=kv, rank=0, world=1),
+                interval_s=0.0).install()
+        try:
+            paddle.seed(0)
+            model = paddle.hapi.Model(nn.Sequential(
+                nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4)))
+            opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                        parameters=model.parameters())
+            model.prepare(optimizer=opt, loss=nn.MSELoss())
+            model._check_finite_steps = False
+            rs = np.random.RandomState(0)
+            x = rs.randn(8, 16).astype('float32')
+            y = rs.randn(8, 4).astype('float32')
+            model.train_batch(x, y)     # compile outside the guard
+            acc = telemetry.step_accumulator('cobsguard')
+            losses = []
+            guard = (jax.transfer_guard_device_to_host('disallow')
+                     if with_publisher else contextlib.nullcontext())
+            with guard:
+                for i in range(8):
+                    t0 = time.perf_counter()
+                    loss, _ = model.train_batch(x, y)
+                    acc.observe(step=i,
+                                step_time_s=time.perf_counter() - t0,
+                                loss=loss)
+                    losses.append(loss)
+            acc.flush()                 # the one sync, at the boundary
+            frames = pub.published if pub is not None else None
+            compiles = len(telemetry.events('compile'))
+            return ([float(np.asarray(l)) for l in losses],
+                    compiles, frames)
+        finally:
+            if pub is not None:
+                pub.uninstall()
+            telemetry.disable()
+            telemetry.reset()
+
+    try:
+        on_losses, on_compiles, frames = _losses(True)
+        out['sync_free_ok'] = True
+        out['frames_published'] = frames
+    except Exception as e:
+        out['sync_free_ok'] = False
+        out['sync_free_error'] = repr(e)[:300]
+        on_losses, on_compiles = None, None
+    if on_losses is not None:
+        off_losses, off_compiles, _ = _losses(False)
+        out['bitexact'] = on_losses == off_losses
+        out['equal_compiles'] = on_compiles == off_compiles
+    print(json.dumps(out))
+
+
+def _cluster_obs_preflight(smoke, timeout_s=900):
+    """--cluster-obs-smoke gate (the ISSUE-15 acceptance bar): a
+    2-proc ChaosCluster with a throttled rank must be live-attributable
+    (mid-run /cluster/status.json scrape names the correct straggler
+    with populated skew), a SIGKILLed rank must degrade the view
+    (stale-marked) rather than crash the plane or the job, and a
+    publisher-enabled trainer loop must stay sync-free and bit-exact
+    with equal compile counts.  Infra failures never block — evidence
+    beats a dead gate — but a violated bar always does."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    cmd = [sys.executable, os.path.abspath(__file__),
+           '--cluster-obs-smoke-child'] + (['--smoke'] if smoke else [])
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=env)
+        doc = _last_json_dict(proc.stdout)
+    except Exception as e:
+        log(f'cluster-obs preflight skipped ({e!r})')
+        return True, {'error': repr(e)[:200]}
+    if doc is None:
+        log(f'cluster-obs preflight skipped (no child output, '
+            f'rc={proc.returncode}): {proc.stderr[-300:]}')
+        return True, {'error': f'no output (rc={proc.returncode})'}
+    failures = []
+    if doc.get('cluster_rc') != 0 or not doc.get('cluster_ok'):
+        failures.append(
+            'the chaos run itself failed under the observability '
+            f'plane (rc={doc.get("cluster_rc")}, violations='
+            f'{doc.get("violations")}) — the plane must never cost '
+            'the job')
+    if not doc.get('straggler_scrapes'):
+        failures.append('no mid-run scrape attributed the throttled '
+                        'rank 1 as straggler with populated skew')
+    elif (doc.get('wrong_rank_scrapes') or 0) \
+            > doc['straggler_scrapes']:
+        failures.append(
+            f'wrong-rank attributions ({doc["wrong_rank_scrapes"]}) '
+            f'outnumber correct ones ({doc["straggler_scrapes"]})')
+    if not doc.get('degraded_scrapes'):
+        failures.append('SIGKILL of rank 1 never surfaced as a '
+                        'degraded (stale-marked) view — either the '
+                        'plane crashed or staleness is broken')
+    if not doc.get('kill_injected'):
+        failures.append('the sigkill fault never fired (gate '
+                        'evidence incomplete)')
+    if not doc.get('sync_free_ok'):
+        failures.append('publisher-enabled trainer loop synced the '
+                        'host: ' + str(doc.get('sync_free_error')))
+    if doc.get('bitexact') is False:
+        failures.append('publisher-enabled trainer losses drifted '
+                        'bitwise from the publisher-off run')
+    if doc.get('equal_compiles') is False:
+        failures.append('publisher changed the compile count')
+    summary = dict(doc, failures=failures)
+    ok = not failures
+    log(f'cluster-obs preflight: {"ok" if ok else "FAIL"} '
+        f'({doc.get("straggler_scrapes")}/{doc.get("scrapes")} '
+        f'attributed scrapes, degraded={doc.get("degraded_scrapes")}, '
+        f'rc={doc.get("cluster_rc")}, '
+        f'sync_free={doc.get("sync_free_ok")}, '
+        f'bitexact={doc.get("bitexact")})')
+    for f in failures:
+        log(f'  {f}')
+    return ok, summary
+
+
 def _fused_preflight(smoke, timeout_s=900):
     """--fused-smoke gate: the fused K-step loop must (1) be bit-exact
     with the per-step loop at K=1 and (2) show a steps/sec uplift at
@@ -1980,6 +2212,19 @@ def main():
     p.add_argument('--obs-smoke-child', action='store_true',
                    help='(internal) run the obs-smoke measurement '
                         'and emit its JSON')
+    p.add_argument('--cluster-obs-smoke', action='store_true',
+                   help='preflight gate: live TRAINING-cluster '
+                        'observability (telemetry.cluster) — a '
+                        '2-proc ChaosCluster with a throttled rank '
+                        'must be live-attributable mid-run '
+                        '(/cluster/status.json names the straggler '
+                        'with populated skew), a SIGKILLed rank must '
+                        'degrade the view (stale-marked) not crash '
+                        'it, and a publisher-enabled trainer loop '
+                        'must stay sync-free and bit-exact')
+    p.add_argument('--cluster-obs-smoke-child', action='store_true',
+                   help='(internal) run the cluster-obs measurement '
+                        'and emit its JSON')
     p.add_argument('--fused-smoke', action='store_true',
                    help='steps/sec-vs-K sweep (K in {1,8,32}) of the '
                         'fused train loop on the lenet/widedeep '
@@ -2039,6 +2284,10 @@ def main():
         _obs_smoke_child(args.smoke)
         return
 
+    if args.cluster_obs_smoke_child:
+        _cluster_obs_smoke_child(args.smoke)
+        return
+
     if args.single_json:
         if args.config == 'all':
             p.error('--single-json needs an explicit --config NAME')
@@ -2056,6 +2305,7 @@ def main():
     fused_summary = None
     serve_summary = None
     obs_summary = None
+    cluster_obs_summary = None
     quant_summary = None
     if args.quant_smoke:
         quant_ok, quant_summary = _quant_preflight(args.smoke)
@@ -2091,6 +2341,24 @@ def main():
                          'telemetry.httpd or re-run without '
                          '--obs-smoke',
                 'obs': obs_summary, 'extras': {}}))
+            sys.exit(1)
+    if args.cluster_obs_smoke:
+        cobs_ok, cluster_obs_summary = _cluster_obs_preflight(
+            args.smoke)
+        if not cobs_ok:
+            # a blind or fragile cluster plane means multi-host chip
+            # runs stay post-hoc-only (stragglers invisible until the
+            # job dies) or — worse — observing the cluster kills it;
+            # fail before burning chip time
+            print(json.dumps({
+                'metric': METRIC_NAMES['resnet'], 'value': None,
+                'unit': UNITS['resnet'], 'vs_baseline': None,
+                'error': 'cluster-obs preflight failed (straggler '
+                         'not attributed, kill crashed the view, or '
+                         'the publisher perturbed training); fix '
+                         'telemetry.cluster or re-run without '
+                         '--cluster-obs-smoke',
+                'cluster_obs': cluster_obs_summary, 'extras': {}}))
             sys.exit(1)
     if args.serve_smoke:
         serve_ok, serve_summary = _serve_preflight(args.smoke)
@@ -2299,6 +2567,8 @@ def main():
         out['serve'] = serve_summary
     if obs_summary is not None:
         out['obs'] = obs_summary
+    if cluster_obs_summary is not None:
+        out['cluster_obs'] = cluster_obs_summary
     if quant_summary is not None:
         out['quant'] = quant_summary
     if preflight_attempts:

@@ -852,6 +852,83 @@ class HostCollectives:
         except Exception:
             pass
 
+    # -- stats-frame side channel (telemetry.cluster) ------------------------
+    #
+    # A NON-BLOCKING publish/read channel riding the same KV transport
+    # the collectives use: each rank overwrites ONE well-known key
+    # (``<ns>/cstats/r<rank>``) with a small JSON document, and any
+    # rank reads every peer's latest frame without waiting.  No
+    # barrier, no deadline, no device sync — a dead peer simply stops
+    # refreshing its key and its frame goes stale, which is exactly
+    # the degraded-view semantics the cluster observability plane
+    # wants (a crashed rank must never crash the observer).
+
+    STATS_KEY = 'cstats'
+
+    def post_stats(self, doc, key=None):
+        """Overwrite this rank's stats frame.  Never blocks, never
+        raises — publishing telemetry must not be able to kill (or
+        stall) a training step.  Returns True when the write landed."""
+        if self.client is None:
+            return False
+        k = f'{self.namespace}/{key or self.STATS_KEY}/r{self.rank}'
+        try:
+            payload = json.dumps(doc, default=str).encode('utf-8')
+        except (TypeError, ValueError):
+            return False
+        try:
+            self.client.key_value_set_bytes(k, payload)
+            return True
+        except Exception:
+            # some KV backends (jax coordination service) reject
+            # overwrites: best-effort delete-then-set, then give up
+            try:
+                self.client.key_value_delete(k)
+                self.client.key_value_set_bytes(k, payload)
+                return True
+            except Exception:
+                return False
+
+    def read_stats(self, rank, key=None):
+        """`rank`'s latest stats frame as a dict, or None (absent,
+        unreadable, or corrupt — a torn frame is skipped, not an
+        error)."""
+        raw = self.try_get(
+            f'{self.namespace}/{key or self.STATS_KEY}/r{rank}')
+        if raw is None:
+            return None
+        try:
+            doc = json.loads(raw.decode('utf-8'))
+        except (ValueError, UnicodeDecodeError):
+            return None
+        return doc if isinstance(doc, dict) else None
+
+    def read_all_stats(self, key=None):
+        """{rank: frame} for every rank with a readable frame.  Purely
+        non-blocking: missing ranks are simply absent."""
+        out = {}
+        for r in range(self.world):
+            doc = self.read_stats(r, key=key)
+            if doc is not None:
+                out[r] = doc
+        return out
+
+    def read_heartbeats(self):
+        """{rank: age_s} for every rank with a readable watchdog
+        heartbeat (the ``hb/r<rank>`` keys resilience.watchdog
+        publishes) — own rank included."""
+        out = {}
+        now = time.time()
+        for r in range(self.world):
+            raw = self.try_get(f'{self.namespace}/hb/r{r}')
+            if raw is None:
+                continue
+            try:
+                out[r] = now - json.loads(raw.decode('utf-8'))['ts']
+            except (ValueError, KeyError, UnicodeDecodeError):
+                continue
+        return out
+
     def allreduce(self, arr, op='sum', tag='ar', timeout_s=None,
                   quant=None):
         """Cross-process all-reduce of one host array (any dtype).

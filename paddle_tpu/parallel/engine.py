@@ -60,7 +60,8 @@ class ParallelTrainer:
                  donate=True, n_inputs=1, nan_guard=False, nan_patience=3,
                  nan_max_rollbacks=2, lint=None, auto_shard=False,
                  hbm_budget_gb=None, calibration=None, profile=None,
-                 watchdog=None, fused_steps=None, quant_collectives=None):
+                 watchdog=None, fused_steps=None, quant_collectives=None,
+                 cluster_stats=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -107,6 +108,21 @@ class ParallelTrainer:
         self.watchdog = watchdog
         self._watchdog = None
         self._watchdog_init = False
+        # cluster_stats: the live training-cluster observability plane
+        # (telemetry.cluster).  None → PADDLE_TPU_CLUSTER_STATS
+        # decides (default OFF); False hard-off; True/float arm a
+        # ClusterPublisher on this rank (stats frames over the
+        # existing KV transport at the boundary-rate stream's cadence
+        # — zero new device syncs) and, on rank 0, a ClusterAggregator
+        # served as /cluster/status.json through the metrics server.
+        self.cluster_stats = cluster_stats
+        self._cluster_plane = None
+        self._cluster_init = False
+        # rolling measured step times feeding Budget.note_measured —
+        # host-side perf_counter deltas only, no device reads
+        from collections import deque as _deque
+        self._measured_dts = _deque(maxlen=256)
+        self._measured_n = 0
         # fused_steps: whole-loop compilation (core.scan_loop) — K
         # steps per compiled dispatch via step_fused().  None → the
         # PADDLE_TPU_FUSED_STEPS env decides (default OFF); K clamps
@@ -1115,12 +1131,14 @@ class ParallelTrainer:
             n0 = getattr(self, '_profile_calls', -1) + 1
             self._profile_calls = n0 + k - 1
             prof.observe(n0, sync=losses, span=k)
+        self._ensure_cluster_plane()
         if first_call:
             _tel.event('compile', name='ParallelTrainer.step_fused',
                        dur_s=round(dt, 6), fused_steps=k)
             _tel.add('compile.count')
             _tel.add('compile.total_s', dt)
             return
+        self._note_measured_step(dt, _tel, k=k)
         acc = getattr(self, '_tel_acc', None)
         if acc is None:
             acc = self._tel_acc = _tel.step_accumulator('parallel')
@@ -1196,6 +1214,56 @@ class ParallelTrainer:
         if wd is not None:
             wd.stop()
 
+    def _ensure_cluster_plane(self):
+        """Latch the cluster observability publisher (telemetry.
+        cluster) on first use; None when off (the default) — the
+        per-step cost is then one attribute read.  Rank 0
+        additionally aggregates and registers the /cluster view on
+        the process metrics server (or one the env port arms)."""
+        if self._cluster_init:
+            return self._cluster_plane
+        self._cluster_init = True
+        try:
+            from ..telemetry.cluster import (
+                resolve_cluster_stats, enable_cluster_plane)
+            interval = resolve_cluster_stats(self.cluster_stats)
+            if interval is None:
+                return None
+            self._cluster_plane = enable_cluster_plane(
+                interval_s=interval)
+        except Exception:   # observability must never kill a step
+            self._cluster_plane = None
+        return self._cluster_plane
+
+    def stop_cluster_plane(self):
+        """Tear down this trainer's cluster-plane handle (publisher
+        subscription + /cluster source registration).  Final, like
+        stop_watchdog(); no-op when the plane is off."""
+        plane, self._cluster_plane = self._cluster_plane, None
+        if plane is not None:
+            plane.close()
+
+    def _note_measured_step(self, dt, _tel, k=1):
+        """Feed one measured step (or chunk) duration into the rolling
+        profile and — every 32 observations — refresh an armed, non-
+        explicit watchdog budget from it (Budget.note_measured: the
+        measured p95 x slack replaces the analytic estimate; ROADMAP
+        item-3 carry-over).  Host floats only; never raises."""
+        try:
+            self._measured_dts.append(dt / max(1, k))
+            self._measured_n += 1
+            if self._measured_n % 32:
+                return
+            wd = self._watchdog
+            if wd is None:
+                return
+            new = wd.budget.note_measured(self._measured_dts)
+            if new is not None:
+                _tel.set_gauge('watchdog.measured_step_s',
+                               round(new, 4))
+        except Exception:
+            pass
+
     def _ensure_profiler(self, _tel):
         """Latch the sampled step profiler (telemetry.profile) on
         first use.  None when profiling is off — the per-step cost is
@@ -1249,6 +1317,7 @@ class ParallelTrainer:
             n = self._profile_calls = getattr(
                 self, '_profile_calls', -1) + 1
             prof.observe(n, sync=loss)
+        self._ensure_cluster_plane()
         if first_call:
             _tel.event('compile', name='ParallelTrainer.step',
                        dur_s=round(dt, 6))
@@ -1256,6 +1325,7 @@ class ParallelTrainer:
             _tel.add('compile.total_s', dt)
             self._maybe_collective_census()
             return
+        self._note_measured_step(dt, _tel)
         acc = getattr(self, '_tel_acc', None)
         if acc is None:
             acc = self._tel_acc = _tel.step_accumulator('parallel')

@@ -775,9 +775,30 @@ class ChaosCluster:
                  max_restarts=4, save_every=2, collective_timeout_s=30.0,
                  barrier_timeout_s=20.0, watchdog='step=90,grace=2',
                  worker_argv=None, deadline_s=240.0,
-                 jax_distributed=False, engine=None, extra_env=None):
+                 jax_distributed=False, engine=None, extra_env=None,
+                 cluster_stats=False, cluster_stats_interval=0.25,
+                 restart_backoff=0.2, restart_backoff_max=2.0):
         import tempfile
         self.procs = int(procs)
+        # crash-restart backoff (seconds, exponential up to the max).
+        # The cluster-obs smoke widens it so a SIGKILLed rank stays
+        # down long enough for the live view's stale-marking to be
+        # observable by a 200ms scraper — with the default snappy
+        # respawn the degraded window can close before one scrape.
+        self.restart_backoff = float(restart_backoff)
+        self.restart_backoff_max = float(restart_backoff_max)
+        # cluster_stats: arm the live training observability plane
+        # (telemetry.cluster) inside the workers — every rank
+        # publishes stats frames over the cluster's own KV transport,
+        # rank 0 aggregates and serves /cluster/status.json on an
+        # ephemeral 127.0.0.1 port written to
+        # <workdir>/cluster_port.json so the supervisor (or a test /
+        # the --cluster-obs-smoke gate) can scrape a LIVE view of the
+        # chaos run.  The plane must survive every fault the plan
+        # injects: a killed rank degrades the view (stale-marked),
+        # never crashes it.
+        self.cluster_stats = bool(cluster_stats)
+        self.cluster_stats_interval = float(cluster_stats_interval)
         self.plan = (plan if isinstance(plan, FaultPlan)
                      else FaultPlan(**plan) if isinstance(plan, dict)
                      else plan or FaultPlan(seed=0))
@@ -827,6 +848,9 @@ class ChaosCluster:
             'PADDLE_TPU_WATCHDOG': self.watchdog or '0',
             'PADDLE_TPU_MIN_PREEMPT_UPTIME': '0',
         })
+        if self.cluster_stats:
+            env['PADDLE_TPU_CLUSTER_STATS'] = str(
+                self.cluster_stats_interval)
         if self.jax_distributed:
             import socket
             s = socket.socket()
@@ -860,7 +884,8 @@ class ChaosCluster:
             rc = elastic.watch_local_trainers(
                 procs, max_restarts=self.max_restarts, poll=0.05,
                 min_preempt_uptime=0.0, on_event=on_event,
-                restart_backoff=0.2, restart_backoff_max=2.0,
+                restart_backoff=self.restart_backoff,
+                restart_backoff_max=self.restart_backoff_max,
                 deadline=self.deadline_s)
         finally:
             elastic.terminate_local_procs(procs, grace=2.0)
@@ -913,7 +938,15 @@ class ChaosCluster:
             'finals': finals,
             'workdir': self.workdir,
             'events': len(events),
+            'cluster_port_file': (self.cluster_port_file
+                                  if self.cluster_stats else None),
         }
+
+    @property
+    def cluster_port_file(self):
+        """Where rank 0's aggregator publishes its bound HTTP port
+        (written by the worker once the MetricsServer is up)."""
+        return os.path.join(self.workdir, 'cluster_port.json')
 
     def _load_finals(self):
         out = {}

@@ -88,6 +88,12 @@ class Budget:
         self.straggler_frac = float(straggler_frac)
         self.default_step_s = float(default_step_s)
         self.grace_s = float(grace_s)
+        # where step_s came from — the adaptation ladder: an operator's
+        # EXPLICIT deadline is never overridden; the analytic
+        # cost-model estimate and the global default both yield to a
+        # MEASURED rolling profile once one exists (note_measured)
+        self.step_source = ('explicit' if step_s is not None
+                            else 'default')
 
     def effective_step_s(self):
         return self.step_s if self.step_s is not None \
@@ -129,7 +135,35 @@ class Budget:
         while keeping the deadline proportional to the workload instead
         of one global constant."""
         step_s = max(min_step_s, float(est_step_us) * 1e-6 * slack)
-        return cls(step_s=step_s, slack=slack, **kwargs)
+        budget = cls(step_s=step_s, slack=slack, **kwargs)
+        budget.step_source = 'costmodel'
+        return budget
+
+    def note_measured(self, times_s, min_samples=16, quantile=0.95,
+                      min_step_s=1.0):
+        """Refresh the step budget from MEASURED per-step wall times
+        (the ROADMAP item-3 carry-over: budgets from rolling per-step
+        profiles, not the analytic estimate).
+
+        ``times_s`` is a window of recent host-side step durations in
+        seconds.  The new budget is the window's ``quantile`` x
+        ``slack`` (the same slack posture the cost-model derivation
+        uses), floored at ``min_step_s``.  Only non-explicit budgets
+        adapt: an operator's armed ``step=`` deadline is a contract,
+        while the cost-model/default numbers are estimates the
+        measured profile strictly improves on.  Returns the new step_s,
+        or None when nothing changed (explicit budget, or too few
+        samples)."""
+        if self.step_source == 'explicit':
+            return None
+        ts = sorted(float(t) for t in times_s if t is not None)
+        if len(ts) < int(min_samples):
+            return None
+        est = ts[min(len(ts) - 1, int(len(ts) * float(quantile)))]
+        new = max(float(min_step_s), est * self.slack)
+        self.step_s = new
+        self.step_source = 'measured'
+        return new
 
     @classmethod
     def from_env(cls, text):
@@ -369,7 +403,15 @@ class Watchdog:
     def _publish_heartbeat(self):
         tr = self.transport
         try:
-            doc = json.dumps({'ts': time.time(), 'step': self._step_no})
+            # rank/step/budget ride along so the cluster aggregator's
+            # heartbeat join can show WHAT deadline a silent rank was
+            # under, not just that it went silent
+            doc = json.dumps({'ts': time.time(), 'step': self._step_no,
+                              'rank': self.rank,
+                              'budget_s': round(
+                                  self.budget.effective_step_s(), 3),
+                              'budget_source': getattr(
+                                  self.budget, 'step_source', None)})
             tr.client.key_value_set_bytes(
                 f'{tr.namespace}/hb/r{self.rank}', doc.encode('utf-8'))
         except Exception:

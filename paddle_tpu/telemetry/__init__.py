@@ -63,7 +63,11 @@ from .profile import (  # noqa: F401
 from .live import (  # noqa: F401
     LiveAggregator, RollingWindow, RateCounter)
 from .monitors import SLOMonitor, DriftMonitor  # noqa: F401
-from .httpd import MetricsServer, resolve_metrics_port  # noqa: F401
+from .httpd import (  # noqa: F401
+    MetricsServer, resolve_metrics_port, attach_source)
+from .cluster import (  # noqa: F401
+    ClusterPublisher, ClusterAggregator, ClusterPlane,
+    enable_cluster_plane, resolve_cluster_stats)
 
 __all__ = [
     'Recorder', 'get_recorder', 'reset', 'hard_off', 'EVENT_KINDS',
@@ -73,7 +77,9 @@ __all__ = [
     'resolve_schedule',
     'LiveAggregator', 'RollingWindow', 'RateCounter',
     'SLOMonitor', 'DriftMonitor',
-    'MetricsServer', 'resolve_metrics_port',
+    'MetricsServer', 'resolve_metrics_port', 'attach_source',
+    'ClusterPublisher', 'ClusterAggregator', 'ClusterPlane',
+    'enable_cluster_plane', 'resolve_cluster_stats',
     'enable', 'disable', 'enabled', 'active',
     'event', 'add', 'set_gauge', 'span', 'events',
     'step_accumulator', 'dump_flight', 'flight_dir',

@@ -305,6 +305,10 @@ class LiveAggregator:
         'compile': _on_compile,
         'slo_breach': _on_alert,
         'drift_detected': _on_alert,
+        # cluster-plane edges (telemetry.cluster monitors) belong in
+        # the same alert ring /status.json surfaces
+        'straggler_suspect': _on_alert,
+        'rank_divergence': _on_alert,
     }
 
     # -- reads ---------------------------------------------------------------

@@ -25,6 +25,19 @@ event, re-arming only after the signal returns inside its band (with
 hysteresis), so a supervisor sees edges, not a firehose — and the
 seeded drift-injection acceptance ("inflate one collective's observed
 us → exactly one ``drift_detected``") holds by construction.
+
+The TRAINING-cluster plane (:mod:`telemetry.cluster`) reuses the same
+two monitors through a second hook, ``observe_cluster(view)``, called
+by the :class:`~paddle_tpu.telemetry.cluster.ClusterAggregator` after
+every collect:
+
+* :class:`SLOMonitor` latches ``straggler_suspect`` — the cluster
+  view attributed a straggler (step-time/compute skew, a rank falling
+  behind, or a stale frame/heartbeat) — re-arming when the
+  attribution clears or moves to another rank;
+* :class:`DriftMonitor` latches ``rank_divergence`` — the cross-rank
+  loss-window spread left its band: a rank is training on different
+  state than its peers.
 """
 import time
 from collections import deque
@@ -107,6 +120,36 @@ class SLOMonitor:
             self._fire('ttft_p99', observed_s=round(p99, 4),
                        budget_s=self.ttft_budget_s,
                        window_count=pct['count'])
+
+    # -- cluster hook (telemetry.cluster.ClusterAggregator) ------------------
+    def observe_cluster(self, view):
+        """Latch ``straggler_suspect`` off one cluster view: the
+        aggregator attributed a straggler and this monitor had not yet
+        fired for that rank.  Re-arms when the attribution clears (or
+        moves — a NEW straggler rank fires again: the supervisor needs
+        every edge, not just the first)."""
+        strag = (view or {}).get('straggler')
+        if not strag:
+            self._latched.discard('straggler')
+            self._strag_rank = None
+            return
+        rank = strag.get('rank')
+        if 'straggler' in self._latched \
+                and getattr(self, '_strag_rank', None) == rank:
+            return
+        self._strag_rank = rank
+        self._latched.add('straggler')
+        # the suspect rides as 'suspect', NOT 'rank': the JSONL writer
+        # stamps every record with the EMITTING host's rank (the
+        # aggregator's rank 0), which would clobber the attribution
+        ev = _emit('straggler_suspect', suspect=rank,
+                   cause=strag.get('cause'), skew=strag.get('skew'),
+                   behind=strag.get('behind'),
+                   hb_stale=strag.get('hb_stale'),
+                   world=view.get('world'),
+                   max_step=view.get('max_step'))
+        self.breaches.append(ev or dict(kind='straggler_suspect',
+                                        suspect=rank, **strag))
 
     def _check_deadline_rate(self, agg, now):
         dl = agg.by_cause.get('deadline')
@@ -207,3 +250,28 @@ class DriftMonitor:
             return
         self._fire('post_steady_compile', lkey, name=name,
                    dur_s=rec.get('dur_s'))
+
+    # -- cluster hook (telemetry.cluster.ClusterAggregator) ------------------
+    def observe_cluster(self, view):
+        """Latch ``rank_divergence`` off one cluster view: the
+        cross-rank loss-window spread left its band (a rank trains on
+        different state — corrupt restore, leaked collective fault,
+        desynced rng).  Hysteresis: re-arms at half the band."""
+        div = (view or {}).get('loss_divergence')
+        lkey = ('rank_divergence',)
+        if not div:
+            return
+        spread = div.get('spread') or 0.0
+        band = div.get('band') or 0.0
+        if lkey in self._latched:
+            if spread <= band * 0.5:
+                self._latched.discard(lkey)
+            return
+        if div.get('divergent'):
+            self._latched.add(lkey)
+            ev = _emit('rank_divergence', spread=spread, band=band,
+                       per_rank=div.get('per_rank'),
+                       world=view.get('world'),
+                       max_step=view.get('max_step'))
+            self.detections.append(ev or dict(kind='rank_divergence',
+                                              spread=spread))

@@ -123,6 +123,16 @@ EVENT_KINDS = (
                            # run was declared steady — the
                            # re-planning trigger a plan_supervisor
                            # (ROADMAP item 3) consumes
+    'straggler_suspect',   # the live cluster view attributed a
+                           # straggler (rank + cause: compute/step
+                           # skew, behind, stale frame/heartbeat) —
+                           # telemetry.monitors latches it off the
+                           # ClusterAggregator's joined view; distinct
+                           # from the watchdog's own-step 'straggler'
+    'rank_divergence',     # cross-rank loss-window spread left its
+                           # band: a rank is training on different
+                           # state than its peers (corrupt restore,
+                           # leaked collective fault, desynced rng)
     'crash',               # the sys.excepthook crash hook latched an
                            # unhandled exception (ring-only, then the
                            # flight dump persists it)

@@ -41,6 +41,11 @@ os.environ.setdefault('PADDLE_TPU_FUSED_STEPS', '0')
 # exactness tests pin) — quant-behavior tests pass quant_collectives=
 # explicitly
 os.environ.setdefault('PADDLE_TPU_QUANT_COLLECTIVES', '0')
+# ...and for the cluster observability plane: an ambient
+# PADDLE_TPU_CLUSTER_STATS would subscribe a stats-frame publisher
+# under every trainer test — cluster-obs tests pass cluster_stats= /
+# construct publishers explicitly
+os.environ.setdefault('PADDLE_TPU_CLUSTER_STATS', '0')
 
 import jax  # noqa: E402
 

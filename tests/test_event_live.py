@@ -717,8 +717,48 @@ class TestEventKindsMeta:
 
     def test_new_kinds_documented(self):
         for kind in ('serve_trace', 'slo_breach', 'drift_detected',
-                     'crash'):
+                     'crash', 'straggler_suspect', 'rank_divergence'):
             assert kind in EVENT_KINDS
+
+    def test_every_kind_rendered_or_ignore_listed(self):
+        """The CONSUMPTION side of the vocabulary: every declared
+        EVENT_KINDS entry must either be read by run_report's
+        analyze() (RENDERED_KINDS) or sit on its explicit, reasoned
+        ignore list — an event can never again be emitted and
+        silently dropped (the PR-12 serve_step/serve_request bug,
+        prevented structurally this time)."""
+        sys.path.insert(0, os.path.join(_REPO, 'tools'))
+        try:
+            import run_report
+        finally:
+            sys.path.pop(0)
+        rendered = set(run_report.RENDERED_KINDS)
+        ignored = set(run_report.IGNORED_KINDS)
+        declared = set(EVENT_KINDS)
+        uncovered = declared - rendered - ignored
+        assert not uncovered, (
+            f'EVENT_KINDS entries neither rendered by run_report nor '
+            f'ignore-listed with a reason: {sorted(uncovered)} — '
+            'either consume them in analyze() or add them to '
+            'IGNORED_KINDS saying why')
+        # the coverage sets must not rot either: no unknown kinds, no
+        # kind claiming both dispositions, and every ignore entry
+        # carries a non-empty reason
+        assert not (rendered - declared), (rendered - declared)
+        assert not (ignored - declared), (ignored - declared)
+        assert not (rendered & ignored), (rendered & ignored)
+        for kind, reason in run_report.IGNORED_KINDS.items():
+            assert reason and reason.strip(), kind
+
+        # and RENDERED_KINDS must be honest: each rendered kind is
+        # actually mentioned in analyze()'s source
+        import inspect
+        src = inspect.getsource(run_report.analyze)
+        src += ' '.join(run_report.RESILIENCE_KINDS)  # timeline set
+        for kind in rendered:
+            assert kind in src, (
+                f'{kind} claimed as rendered but analyze() never '
+                'references it')
 
     def test_serve_request_field_schema(self):
         """The serve_request event contract run_report and the live

@@ -55,6 +55,7 @@ import glob
 import json
 import os
 import sys
+import time
 
 SCHEMA_VERSION = 1
 
@@ -72,11 +73,51 @@ RESILIENCE_KINDS = (
     # rolling SLO/drift monitors (telemetry.monitors): an SLO breach
     # or a predicted-vs-observed drift detection belongs on the same
     # timeline as the failures it predicts
-    'slo_breach', 'drift_detected')
+    'slo_breach', 'drift_detected',
+    # live cluster-view edges (telemetry.cluster monitors): who the
+    # joined view blamed, and when the per-rank losses split
+    'straggler_suspect', 'rank_divergence',
+    # a fused K-chunk that exceeded the armed watchdog budget
+    'fused_clamp')
 
 # spans (kind='span', name=...) that belong on the resilience
 # timeline: the 2-phase commit barrier wait and the restore itself
 RESILIENCE_SPAN_NAMES = ('commit_barrier', 'checkpoint_restore')
+
+# -- the EVENT_KINDS coverage contract ----------------------------------------
+# telemetry.recorder.EVENT_KINDS is the emission vocabulary; this pair
+# is the CONSUMPTION side.  The recorder meta-test asserts every
+# declared kind is either in RENDERED_KINDS (analyze() reads it into a
+# report section / the timeline) or in IGNORED_KINDS with a written
+# reason — so an event can never again be emitted and silently dropped
+# (the PR-12 serve_step/serve_request bug, prevented structurally).
+RENDERED_KINDS = RESILIENCE_KINDS + (
+    'steps',                # step-time percentiles / split / scalars
+    'compile', 'retrace',   # compile section
+    'compile_cache',        # cache section
+    'collectives', 'collective_cost', 'collective_observed',
+    'plan_selected',        # plan section
+    'profile_capture',      # profile section
+    'serve_step', 'serve_request', 'serve_trace',  # serving section
+    'lint_finding',         # lint section
+    'span',                 # spans table + resilience span rows
+)
+IGNORED_KINDS = {
+    'run_meta': 'per-run header (argv/rank/backend): provenance '
+                'metadata, not a report row',
+    'scalar': 'user scalar stream — consumed by the TensorBoard/'
+              'VisualDL exporters, not the merged report',
+}
+
+
+def _median(vals):
+    """Proper even-count median (two-rank clusters must not anchor
+    the skew baseline on the slower rank)."""
+    if not vals:
+        return None
+    vs = sorted(vals)
+    n = len(vs)
+    return vs[n // 2] if n % 2 else 0.5 * (vs[n // 2 - 1] + vs[n // 2])
 
 
 def _percentiles(times_ms):
@@ -536,7 +577,10 @@ def analyze(events, sources, skew=None):
                   'peer', 'heartbeat_age_s', 'live', 'stale',
                   'reason', 'deadline_s', 'clamped_from_s',
                   'what', 'cause', 'rid', 'observed_s', 'us_ratio',
-                  'instr', 'observed_frac'):
+                  'instr', 'observed_frac',
+                  'skew', 'behind', 'hb_stale', 'spread', 'band',
+                  'world', 'max_step', 'requested', 'fits',
+                  'suspect'):
             if e.get(k) is not None:
                 row[k] = e[k]
         timeline.append(row)
@@ -565,6 +609,73 @@ def analyze(events, sources, skew=None):
             watchdog['fault_injected'] = {'count': len(faults),
                                           'per_rank': per_rank}
 
+    # -- cluster: per-rank step skew + straggler attribution -------
+    # Per-rank step-time stats (the tag-keyed section above blends
+    # ranks — fine for one host, blind for a cluster).  With >= 2
+    # stepping ranks, compute each rank's skew vs the cluster median
+    # p50 and join the live plane's straggler_suspect /
+    # rank_divergence edges.
+    rank_steps = {}
+    for ev in by_kind.get('steps', ()):
+        r = ev.get('rank', 0)
+        st = rank_steps.setdefault(
+            r, {'times_ms': [], 'n': 0, 'last_step': None,
+                'tags': set()})
+        st['n'] += ev.get('n', 0)
+        st['tags'].add(ev.get('tag', 'train'))
+        st['times_ms'] += [t for t in ev.get('step_time_ms') or []
+                           if t is not None]
+        hi = ev.get('step_hi')
+        if hi is not None:
+            st['last_step'] = (hi if st['last_step'] is None
+                               else max(st['last_step'], hi))
+    cluster = None
+    if len(rank_steps) >= 2:
+        per_rank = {}
+        p50s = []
+        for r, st in sorted(rank_steps.items()):
+            pct = _percentiles(st['times_ms'])
+            row = {'steps': st['n'],
+                   'last_step': st['last_step'],
+                   'tags': sorted(st['tags'])}
+            row.update({k: pct.get(k) for k in
+                        ('mean_ms', 'p50_ms', 'p99_ms') if pct})
+            per_rank[r] = row
+            if pct.get('p50_ms'):
+                p50s.append(pct['p50_ms'])
+        med = _median(p50s)
+        max_step = max((st['last_step'] for st in rank_steps.values()
+                        if st['last_step'] is not None), default=None)
+        worst = None
+        for r, row in per_rank.items():
+            if med and row.get('p50_ms'):
+                row['skew'] = round(row['p50_ms'] / med, 4)
+                if worst is None or row['skew'] > \
+                        per_rank[worst]['skew']:
+                    worst = r
+            if max_step is not None and row.get('last_step') is not None:
+                row['behind'] = max_step - row['last_step']
+        cluster = {
+            'ranks': {str(r): row for r, row in per_rank.items()},
+            'max_step': max_step,
+            'median_p50_ms': med,
+            'straggler': ({'rank': worst,
+                           'skew': per_rank[worst]['skew']}
+                          if worst is not None
+                          and per_rank[worst].get('skew', 0) >= 1.5
+                          else None),
+            'suspects': [
+                {k: e.get(k) for k in (
+                    'suspect', 'cause', 'skew', 'behind', 'hb_stale',
+                    'max_step') if e.get(k) is not None}
+                for e in by_kind.get('straggler_suspect', ())],
+            'divergence': [
+                {k: e.get(k) for k in (
+                    'spread', 'band', 'per_rank', 'max_step')
+                 if e.get(k) is not None}
+                for e in by_kind.get('rank_divergence', ())],
+        }
+
     ranks = sorted({e.get('rank', 0) for e in events})
     spans = {}
     for e in by_kind.get('span', ()):
@@ -591,6 +702,7 @@ def analyze(events, sources, skew=None):
         'profile': profile,
         'serving': serving,
         'clock_skew': skew or {},
+        'cluster': cluster,
         'watchdog': watchdog,
         'lint_findings': lint,
         'spans': spans,
@@ -752,6 +864,28 @@ def render(report, stream=None):
         if len(rows) > 8:
             p(f'      ... {len(rows) - 8} more request(s) '
               '(--json has all)')
+    if report.get('cluster'):
+        cl = report['cluster']
+        p('\n-- cluster (per-rank step skew) --')
+        for r, row in sorted(cl['ranks'].items()):
+            bits = [f'n={row.get("steps")}']
+            if row.get('p50_ms') is not None:
+                bits.append(f'p50={row["p50_ms"]:.2f}ms')
+            if row.get('skew') is not None:
+                bits.append(f'skew=x{row["skew"]:.2f}')
+            if row.get('last_step') is not None:
+                bits.append(f'step={row["last_step"]}')
+            if row.get('behind'):
+                bits.append(f'behind={row["behind"]}')
+            p(f'    rank {r}: {"  ".join(bits)}')
+        if cl.get('straggler'):
+            s = cl['straggler']
+            p(f'    straggler: rank {s["rank"]} at x{s["skew"]:.2f} '
+              'the cluster median')
+        for s in cl.get('suspects', ()):
+            p(f'    SUSPECT (live): {s}')
+        for d in cl.get('divergence', ()):
+            p(f'    DIVERGENCE (live): {d}')
     if report.get('clock_skew'):
         p('\n-- clock skew (per-host anchor offsets applied) --')
         for r, off in sorted(report['clock_skew'].items()):
@@ -782,6 +916,65 @@ def render(report, stream=None):
     p('=======================================================')
 
 
+def report_once(paths, as_json=False, stream=None):
+    """One discover -> merge -> analyze -> render pass.  Returns the
+    report dict, or None when nothing was found."""
+    jsonls, flights = discover(paths)
+    if not jsonls and not flights:
+        return None
+    events, sources, skew = load_events(jsonls, flights)
+    report = analyze(events, sources, skew)
+    out = stream or sys.stdout
+    if as_json:
+        print(json.dumps(report, indent=1, sort_keys=True), file=out)
+    else:
+        render(report, stream=out)
+    return report
+
+
+def follow(paths, interval_s=5.0, as_json=False, max_refreshes=None,
+           stream=None, clear=None):
+    """Live-tail mode: re-render the report from a RUNNING job's
+    JSONL/flight-ring every `interval_s` seconds instead of waiting
+    for job exit.  Safe against concurrent writers: the JSONL loader
+    already skips a torn final line, and flight dumps are written
+    atomically.  Stops on Ctrl-C (or after `max_refreshes` passes —
+    tests/CI).  Returns the number of render passes."""
+    out = stream or sys.stdout
+    if clear is None:
+        clear = out.isatty() and not as_json
+    # status chatter goes to stdout only for the human renderer —
+    # under --json stdout must stay a clean stream of report
+    # documents (one per refresh), so stamps/waits route to stderr
+    chat = sys.stderr if as_json else out
+    n = 0
+    try:
+        while True:
+            if clear:
+                print('\x1b[2J\x1b[H', end='', file=out)
+            report = report_once(paths, as_json=as_json, stream=out)
+            if report is None:
+                print(f'run_report --follow: waiting for telemetry '
+                      f'under {paths} ...', file=chat)
+            else:
+                import datetime
+                stamp = datetime.datetime.now().strftime('%H:%M:%S')
+                print(f'[--follow {stamp}: {report["n_events"]} '
+                      f'events, refresh every {interval_s:g}s, '
+                      'Ctrl-C to stop]', file=chat)
+            for s in {out, chat}:
+                try:
+                    s.flush()
+                except (OSError, ValueError):
+                    pass
+            n += 1
+            if max_refreshes is not None and n >= max_refreshes:
+                return n
+            time.sleep(interval_s)
+    except KeyboardInterrupt:
+        return n
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog='run_report',
@@ -792,19 +985,27 @@ def main(argv=None):
                          'and/or flightrec-*.json dumps')
     ap.add_argument('--json', action='store_true',
                     help='machine-readable report for bench/CI')
+    ap.add_argument('--follow', action='store_true',
+                    help='live-tail a RUNNING job: re-render every '
+                         '--interval seconds instead of requiring '
+                         'job exit (Ctrl-C to stop)')
+    ap.add_argument('--interval', type=float, default=5.0,
+                    help='refresh period for --follow (seconds, '
+                         'default 5)')
+    ap.add_argument('--refreshes', type=int, default=None,
+                    help='with --follow: stop after N renders '
+                         '(default: until Ctrl-C)')
     args = ap.parse_args(argv)
 
-    jsonls, flights = discover(args.paths)
-    if not jsonls and not flights:
+    if args.follow:
+        follow(args.paths, interval_s=args.interval,
+               as_json=args.json, max_refreshes=args.refreshes)
+        return 0
+    report = report_once(args.paths, as_json=args.json)
+    if report is None:
         print('run_report: no telemetry-*.jsonl or flightrec-*.json '
               f'under {args.paths}', file=sys.stderr)
         return 2
-    events, sources, skew = load_events(jsonls, flights)
-    report = analyze(events, sources, skew)
-    if args.json:
-        print(json.dumps(report, indent=1, sort_keys=True))
-    else:
-        render(report)
     return 0
 
 

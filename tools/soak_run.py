@@ -147,7 +147,11 @@ def worker_main():
                                       '0'))
                    + int(os.environ.get('PADDLE_ELASTIC_PREEMPT_COUNT',
                                         '0')))
-    telemetry.enable(os.path.join(workdir, 'telemetry'))
+    # cluster-obs runs flush at a short cadence so stats frames carry
+    # fresh rolling windows even on short soaks
+    flush_every = int(os.environ.get('PADDLE_TPU_SOAK_FLUSH', '8'))
+    telemetry.enable(os.path.join(workdir, 'telemetry'),
+                     flush_interval=flush_every)
 
     if jaxdist:
         import jax
@@ -184,6 +188,37 @@ def worker_main():
                       transport=transport, flight_dir=workdir).start()
     install_shutdown()
 
+    # -- live cluster observability plane (default OFF) ------------------
+    # every rank publishes stats frames over the SAME KV transport the
+    # collectives ride; rank 0 aggregates + serves /cluster/status.json
+    # on an ephemeral port recorded in <workdir>/cluster_port.json.
+    # The per-step compute-vs-collective wall split feeds the frames
+    # (via the step accumulator's extra columns) so the aggregator can
+    # attribute a throttled rank: in a BSP step every rank's TOTAL time
+    # equalizes through the allreduce barrier — only the straggler's
+    # COMPUTE half inflates.
+    from paddle_tpu.telemetry.cluster import (
+        resolve_cluster_stats, enable_cluster_plane)
+    import time as _time
+    plane = None
+    acc = None
+    cs_interval = resolve_cluster_stats()
+    if cs_interval is not None:
+        plane = enable_cluster_plane(
+            transport=transport, interval_s=cs_interval,
+            serve=(True if rank == 0 else False),
+            stale_after_s=float(os.environ.get(
+                'PADDLE_TPU_SOAK_STALE_AFTER', '3.0')))
+        if rank == 0 and plane.port is not None:
+            from paddle_tpu.resilience.manifest import atomic_write
+            atomic_write(
+                os.path.join(workdir, 'cluster_port.json'),
+                lambda f: f.write(json.dumps(
+                    {'port': plane.port, 'pid': os.getpid(),
+                     'incarnation': incarnation})))
+        acc = telemetry.step_accumulator('soak',
+                                         flush_interval=flush_every)
+
     ckpt = os.path.join(workdir, 'ckpt')
     w = np.arange(8.0, dtype=np.float32)
     start = 1
@@ -218,6 +253,7 @@ def worker_main():
         for i in range(start, steps + 1):
             if wd is not None:
                 wd.step_started(i, first=(i == start))
+            _t0 = _time.perf_counter()
             if engine is not None:
                 engine.step(i)      # may SIGKILL/SIGTERM/throttle us
             if shutdown_requested():
@@ -232,6 +268,7 @@ def worker_main():
                 sys.exit(PREEMPTED_EXIT_CODE)
             w = (w * np.float32(0.9)
                  + np.float32(i) * np.ones(8, np.float32))
+            _t_coll = _time.perf_counter()
             try:
                 w = transport.allreduce(w, 'mean', tag=f'step{i}')
             except (CollectiveTimeout, CollectivePayloadError) as e:
@@ -243,6 +280,15 @@ def worker_main():
                 if wd is not None:
                     wd.stop()
                 sys.exit(WATCHDOG_EXIT_CODE)
+            _t_end = _time.perf_counter()
+            if acc is not None:
+                # compute = injected throttle + local update (the
+                # straggler's inflated half); coll = barrier wait +
+                # wire (the WAITERS' inflated half)
+                acc.observe(step=i, step_time_s=_t_end - _t0,
+                            loss=float(w[0]),
+                            compute_ms=(_t_coll - _t0) * 1000.0,
+                            coll_ms=(_t_end - _t_coll) * 1000.0)
             if i % save_every == 0:
                 try:
                     save_host_shard(ckpt, i, rank,
@@ -272,6 +318,10 @@ def worker_main():
                     wd.stop()
                 sys.exit(PREEMPTED_EXIT_CODE)
     finally:
+        if acc is not None:
+            acc.flush()
+        if plane is not None:
+            plane.close()       # publishes the final frame itself
         if wd is not None:
             wd.stop()
     with open(os.path.join(workdir, f'out_r{rank}.json'), 'w') as f:
