@@ -710,33 +710,70 @@ def _device_preflight_once(timeout_s):
     return False, reason
 
 
+def _classify_preflight_reason(reason):
+    """Map a captured preflight failure reason onto its retry class.
+    'timeout' — the tiny op never answered (wedged tunnel): recovers
+    in minutes, worth the longest wait.  'device_unavailable' —
+    backend init / device discovery failed loudly: the pool usually
+    returns within a minute.  'crash' — the probe process died some
+    other way: only transient infra makes a retry worthwhile, so it
+    gets the shortest one."""
+    r = (reason or '').lower()
+    if 'timeout' in r:
+        return 'timeout'
+    if any(s in r for s in ('unable to initialize backend',
+                            'no devices', 'device', 'unavailable',
+                            'failed to connect', 'connection')):
+        return 'device_unavailable'
+    return 'crash'
+
+
+_PREFLIGHT_RETRY_WAIT_S = {'timeout': 240, 'device_unavailable': 60,
+                           'crash': 20}
+
+
 def _device_preflight(total_budget_s=600):
-    """Preflight with RETRY + BACKOFF: the dev tunnel recovers from
-    transient wedges in minutes (round-2 lesson: a single 180s attempt
-    nulled the whole artifact).  Attempts at ~0/1/2/4-minute marks
-    within total_budget_s, then give up fast with the error artifact.
-    Returns (ok, attempts) — attempts is the per-try diagnosis list
-    that rides into the artifact when every try failed."""
+    """Preflight with one bounded retry PER FAILURE-REASON CLASS: the
+    dev tunnel recovers from transient wedges in minutes (round-2
+    lesson: a single 180s attempt nulled the whole artifact), but the
+    old fixed 0/1/2/4-minute ladder retried a hard crash exactly like
+    a wedge — burning four minutes of budget on a failure mode where
+    waiting never helps.  Each captured failure reason is classified
+    (timeout / device_unavailable / crash) and each CLASS gets one
+    retry with its own backoff; a failure mode that repeats after its
+    retry gives up immediately, while a mode that MORPHS (timeout ->
+    crash) earns the new class's single retry.  Returns
+    (ok, attempts) — attempts is the per-try diagnosis list
+    (reason + reason_class) that rides into the artifact when every
+    try failed."""
     deadline = time.time() + total_budget_s
-    waits = [0, 60, 120, 240]
     attempts = []
-    for i, w in enumerate(waits):
+    retried = set()
+    i = 0
+    while True:
         remaining = deadline - time.time()
         if remaining <= 10:
             break
-        if w:
-            log(f'preflight retry {i}/{len(waits) - 1}: waiting {w}s '
-                'for the tunnel to recover '
-                f'({remaining:.0f}s of budget left)')
-            time.sleep(min(w, max(0, remaining - 60)))
-        attempt_s = min(120, max(30, deadline - time.time()))
+        attempt_s = min(120, max(30, remaining))
         ok, reason = _device_preflight_once(attempt_s)
         if ok:
             if i:
                 log('preflight recovered after retry')
             return True, attempts
+        cls = _classify_preflight_reason(reason)
         attempts.append({'attempt': i, 'timeout_s': round(attempt_s),
-                         'reason': reason})
+                         'reason': reason, 'reason_class': cls})
+        if cls in retried:
+            log(f'preflight giving up: {cls} failure repeated after '
+                'its retry')
+            break
+        retried.add(cls)
+        wait = _PREFLIGHT_RETRY_WAIT_S.get(cls, 60)
+        i += 1
+        remaining = deadline - time.time()
+        log(f'preflight retry {i} ({cls}): waiting {wait}s for '
+            f'recovery ({remaining:.0f}s of budget left)')
+        time.sleep(min(wait, max(0, remaining - 60)))
     return False, attempts
 
 
@@ -796,6 +833,170 @@ def _chaos_preflight(timeout_s=420):
         f'({len(cluster.get("injected", []))} faults injected across '
         f'2 procs, incarnations={cluster.get("incarnations")})')
     return bool(doc.get('ok')), summary
+
+
+def _supervisor_smoke_child():
+    """--supervisor-smoke child (forced 8-device CPU mesh): the
+    self-healing actuator's acceptance evidence in one process —
+
+    - a dp=8 trainer with the supervisor armed, running with an
+      artificial per-step slowdown while on the incumbent mesh (the
+      degradation the injected drift reports), receives ONE synthetic
+      ``drift_detected`` edge: exactly one remediation must actuate
+      (replan with drift-adjusted calibration -> background precompile
+      -> boundary swap), the mesh must actually change, steps/sec must
+      recover once the swap lands (the slowdown stops with the
+      incumbent mesh), and sustained drift inside the cooldown must
+      NOT actuate again;
+    - a clean run (supervisor armed, no drift) must actuate ZERO
+      times.
+
+    Emits one JSON line the parent asserts on."""
+    import time as _time
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, distributed as dist, telemetry
+    from paddle_tpu.parallel import ParallelTrainer
+    from paddle_tpu.telemetry import get_recorder
+
+    events = []
+    get_recorder().subscribe(lambda r: events.append(dict(r)))
+
+    def make_trainer():
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(64, 256), nn.ReLU(),
+                            nn.Linear(256, 64))
+        opt = paddle.optimizer.Momentum(learning_rate=0.01,
+                                        parameters=net.parameters())
+        return ParallelTrainer(
+            net, opt, lambda o, y: ((o - y) ** 2).mean(),
+            supervisor={'debounce_s': 0.05, 'cooldown_s': 120.0,
+                        'margin': 0.0})
+
+    rs = np.random.RandomState(1)
+    X = rs.randn(16, 64).astype('float32')
+    Y = rs.randn(16, 64).astype('float32')
+    out = {}
+
+    # -- run A: injected drift, degraded incumbent -----------------------
+    dist.init_parallel_env(axes={'dp': 8})
+    tr = make_trainer()
+    incumbent = dict(tr.mesh.shape)
+    slow_s = 0.05           # the degradation drift is reporting
+
+    def timed_steps(n):
+        t0 = _time.perf_counter()
+        for _ in range(n):
+            tr.step(X, Y)
+            if dict(tr.mesh.shape) == incumbent:
+                _time.sleep(slow_s)
+        return n / (_time.perf_counter() - t0)
+
+    timed_steps(3)                              # warmup + compile
+    out['pre_sps'] = round(timed_steps(6), 2)
+    telemetry.event('drift_detected', cause='us_ratio',
+                    op='all-reduce', instr='bench-smoke',
+                    us_ratio=50.0, band=4.0, windows=8)
+    deadline = _time.time() + 60
+    while _time.time() < deadline:
+        if tr._supervisor is not None and tr._supervisor.incidents:
+            break
+        _time.sleep(0.05)
+    timed_steps(2)                              # boundary: apply swap
+    out['mesh_before'] = incumbent
+    out['mesh_after'] = dict(tr.mesh.shape)
+    # sustained drift inside the cooldown: must not actuate again
+    for _ in range(3):
+        telemetry.event('drift_detected', cause='us_ratio',
+                        op='all-reduce', instr='bench-smoke',
+                        us_ratio=50.0, band=4.0, windows=8)
+        _time.sleep(0.1)
+    timed_steps(2)                              # post-swap recompile
+    out['post_sps'] = round(timed_steps(6), 2)
+    out['losses_finite'] = bool(np.isfinite(
+        float(np.asarray(tr.step(X, Y)))))
+    tr.stop_supervisor()
+    out['swaps'] = sum(1 for e in events if e['kind'] == 'plan_swap')
+    out['outcomes'] = [e.get('outcome') for e in events
+                       if e['kind'] == 'remediation']
+    out['recovered'] = out['post_sps'] > out['pre_sps'] * 1.2
+
+    # -- run B: clean — zero actuations ----------------------------------
+    events.clear()
+    from paddle_tpu.distributed import env as dist_env
+    dist_env.set_mesh(None)
+    dist.init_parallel_env(axes={'dp': 8})
+    tr2 = make_trainer()
+    for _ in range(8):
+        tr2.step(X, Y)
+    tr2.stop_supervisor()
+    out['clean_swaps'] = sum(1 for e in events
+                             if e['kind'] in ('plan_swap',
+                                              'remediation'))
+    out['clean_incidents'] = len(tr2._supervisor.incidents
+                                 if tr2._supervisor else [])
+    print(json.dumps(out))
+
+
+def _supervisor_preflight(timeout_s=900):
+    """--supervisor-smoke gate: the self-healing runtime must earn
+    chip time — injected drift on a dp=8 CPU-mesh trainer must
+    produce EXACTLY one plan migration (mesh actually changes,
+    steps/sec recovers, sustained drift suppressed by the cooldown),
+    and a clean run with the supervisor armed must actuate zero
+    times.
+
+    Returns (ok, summary).  Infra failures (timeout, crash of the
+    child) never block the bench — evidence beats a dead gate — but a
+    missing/double actuation, an unchanged mesh, unrecovered
+    throughput, or a clean-run actuation always does."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    env['XLA_FLAGS'] = ' '.join(
+        [t for t in env.get('XLA_FLAGS', '').split()
+         if not t.startswith('--xla_force_host_platform_device_count')]
+        + ['--xla_force_host_platform_device_count=8'])
+    env['PADDLE_TPU_SUPERVISOR'] = '0'      # the child arms explicitly
+    cmd = [sys.executable, os.path.abspath(__file__),
+           '--supervisor-smoke-child']
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=env)
+        doc = _last_json_dict(proc.stdout)
+    except Exception as e:
+        log(f'supervisor preflight skipped ({e!r})')
+        return True, {'error': repr(e)[:200]}
+    if doc is None:
+        log(f'supervisor preflight skipped (no child output, '
+            f'rc={proc.returncode}): {proc.stderr[-300:]}')
+        return True, {'error': f'no output (rc={proc.returncode})'}
+    failures = []
+    if doc.get('swaps') != 1:
+        failures.append(f'expected exactly 1 plan_swap under '
+                        f'sustained drift, got {doc.get("swaps")} '
+                        f'(outcomes {doc.get("outcomes")})')
+    if doc.get('mesh_after') == doc.get('mesh_before'):
+        failures.append('mesh did not change across the swap '
+                        f'({doc.get("mesh_before")})')
+    if not doc.get('recovered'):
+        failures.append(f'throughput did not recover after the swap '
+                        f'(pre {doc.get("pre_sps")} -> post '
+                        f'{doc.get("post_sps")} steps/s)')
+    if not doc.get('losses_finite'):
+        failures.append('post-swap loss went non-finite')
+    if doc.get('clean_swaps'):
+        failures.append(f'clean run actuated '
+                        f'{doc.get("clean_swaps")} time(s)')
+    summary = dict(doc, failures=failures)
+    ok = not failures
+    log(f'supervisor preflight: {"ok" if ok else "FAIL"} '
+        f'(swaps={doc.get("swaps")}, '
+        f'{doc.get("mesh_before")} -> {doc.get("mesh_after")}, '
+        f'{doc.get("pre_sps")} -> {doc.get("post_sps")} steps/s, '
+        f'clean_swaps={doc.get("clean_swaps")})')
+    for f in failures:
+        log(f'  {f}')
+    return ok, summary
 
 
 def _plan_preflight(timeout_s=600):
@@ -2247,6 +2448,17 @@ def main():
     p.add_argument('--quant-smoke-child', action='store_true',
                    help='(internal) run the quant-smoke measurement '
                         'and emit its JSON')
+    p.add_argument('--supervisor-smoke', action='store_true',
+                   help='preflight gate: the self-healing plan '
+                        'supervisor (resilience.supervisor) — '
+                        'injected drift on a dp=8 CPU-mesh trainer '
+                        'must produce exactly ONE safe plan '
+                        'migration (mesh changes, steps/sec '
+                        'recovers, cooldown suppresses re-fire) and '
+                        'a clean armed run must actuate zero times')
+    p.add_argument('--supervisor-smoke-child', action='store_true',
+                   help='(internal) run the supervisor-smoke '
+                        'measurement and emit its JSON')
     p.add_argument('--telemetry-dir', default=None,
                    help='(internal) telemetry JSONL dir for '
                         '--cache-smoke-child / --profile-smoke-child')
@@ -2274,6 +2486,10 @@ def main():
         _quant_smoke_child(args.telemetry_dir
                            or tempfile.mkdtemp(prefix='quant_tel_'),
                            args.smoke)
+        return
+
+    if args.supervisor_smoke_child:
+        _supervisor_smoke_child()
         return
 
     if args.serve_smoke_child:
@@ -2307,6 +2523,24 @@ def main():
     obs_summary = None
     cluster_obs_summary = None
     quant_summary = None
+    supervisor_summary = None
+    if args.supervisor_smoke:
+        sup_ok, supervisor_summary = _supervisor_preflight()
+        if not sup_ok:
+            # a mis-actuating supervisor on chip is worse than none:
+            # a missing swap means drift goes unremediated, a double
+            # or clean-run swap means the actuator thrashes live
+            # training — fail before burning chip time
+            print(json.dumps({
+                'metric': METRIC_NAMES['resnet'], 'value': None,
+                'unit': UNITS['resnet'], 'vs_baseline': None,
+                'error': 'supervisor preflight failed (missing/'
+                         'double actuation, unchanged mesh, '
+                         'unrecovered throughput, or a clean-run '
+                         'swap); fix resilience.supervisor or re-run '
+                         'without --supervisor-smoke',
+                'supervisor': supervisor_summary, 'extras': {}}))
+            sys.exit(1)
     if args.quant_smoke:
         quant_ok, quant_summary = _quant_preflight(args.smoke)
         if not quant_ok:
@@ -2571,6 +2805,8 @@ def main():
         out['cluster_obs'] = cluster_obs_summary
     if quant_summary is not None:
         out['quant'] = quant_summary
+    if supervisor_summary is not None:
+        out['supervisor'] = supervisor_summary
     if preflight_attempts:
         # non-empty only when at least one preflight try failed: the
         # diagnosis (timeout vs crash, rc, stderr tail) per attempt

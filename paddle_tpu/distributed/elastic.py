@@ -29,7 +29,8 @@ from ..resilience import PREEMPTED_EXIT_CODE, GracefulShutdown
 
 __all__ = ['TrainerProc', 'start_local_trainers',
            'terminate_local_procs', 'watch_local_trainers', 'supervise',
-           'PREEMPTED_EXIT_CODE', 'DEADLINE_EXIT_CODE']
+           'request_reshape', 'PREEMPTED_EXIT_CODE',
+           'DEADLINE_EXIT_CODE']
 
 # returned by watch_local_trainers when its `deadline` expires before
 # the workers finish: the supervised run wedged (the timeout(1)
@@ -49,6 +50,7 @@ class TrainerProc:
         self.env = None
         self.restarts = 0
         self.preemptions = 0
+        self.reshapes = 0
         self.spawned_at = 0.0
 
 
@@ -113,19 +115,27 @@ def terminate_local_procs(procs, grace=3.0):
                 pass
 
 
-def _restart(t, log_dir=None, preempted=False):
+def _restart(t, log_dir=None, preempted=False, reshape=False,
+             extra_env=None):
     """Relaunch a worker.  A clean preemption (exit code
     PREEMPTED_EXIT_CODE after a graceful final checkpoint) bumps the
     preemption counter, NOT the restart counter — the max_restarts
     budget is a *failure* budget, and a fleet that preempts a job ten
-    times must not exhaust it."""
-    if preempted:
+    times must not exhaust it.  A supervisor-initiated RESHAPE bumps
+    its own counter for the same reason (plus `extra_env`: the new
+    mesh/plan riding into the next incarnation)."""
+    if reshape:
+        t.reshapes += 1
+    elif preempted:
         t.preemptions += 1
     else:
         t.restarts += 1
     env = dict(t.env)
+    if extra_env:
+        env.update({k: str(v) for k, v in extra_env.items()})
     env['PADDLE_ELASTIC_RESTART_COUNT'] = str(t.restarts)
     env['PADDLE_ELASTIC_PREEMPT_COUNT'] = str(t.preemptions)
+    env['PADDLE_ELASTIC_RESHAPE_COUNT'] = str(t.reshapes)
     env['PADDLE_TPU_PREEMPTED_EXIT_CODE'] = str(PREEMPTED_EXIT_CODE)
     t.env = env
     if log_dir and t.log_fn is None:
@@ -157,11 +167,51 @@ def _heartbeat_age(heartbeat_file):
         return 0.0
 
 
+def request_reshape(workdir, mesh=None, env=None, reason=None):
+    """Queue a coordinated reshape restart for the supervision loop
+    watching `workdir` (``watch_local_trainers(reshape_dir=...)``):
+    every worker is gracefully terminated and relaunched together
+    with `env` merged in (how a new mesh/plan reaches the next
+    incarnation) — WITHOUT consuming the max_restarts budget or
+    tripping the crash backoff, the same posture as a fleet
+    preemption.  Returns the request's seq."""
+    from ..resilience.supervisor import write_reshape_request
+    return write_reshape_request(workdir, mesh=mesh, env=env,
+                                 reason=reason)
+
+
+def _coordinated_reshape(procs, req, log_dir, on_event,
+                         heartbeat_file):
+    """Gracefully stop EVERY worker and relaunch them together with
+    the request's env merged in — one restart for the whole cluster,
+    free of the failure budget."""
+    terminate_local_procs(procs, grace=30.0)
+    extra = dict(req.get('env') or {})
+    mesh = req.get('mesh')
+    if mesh:
+        extra.setdefault('PADDLE_TPU_RESHAPE_MESH', ','.join(
+            f'{a}={s}' for a, s in mesh.items()))
+    if heartbeat_file:
+        _seed_heartbeat(heartbeat_file)
+    for t in procs:
+        _restart(t, log_dir, reshape=True, extra_env=extra)
+        if on_event:
+            on_event('reshape', t)
+    try:
+        from ..telemetry import event as _tevent
+        _tevent('reshape_restore', initiator='supervisor',
+                seq=req.get('seq'), mesh=mesh,
+                reason=req.get('reason'))
+    except Exception:
+        pass
+
+
 def watch_local_trainers(procs, max_restarts=3, poll=0.2,
                          heartbeat_file=None, heartbeat_timeout=None,
                          log_dir=None, on_event=None, shutdown=None,
                          min_preempt_uptime=None, restart_backoff=1.0,
-                         restart_backoff_max=30.0, deadline=None):
+                         restart_backoff_max=30.0, deadline=None,
+                         reshape_dir=None):
     """The pod watch loop: poll workers, restart the dead, kill the
     wedged (stale or deleted heartbeat), stop everything when one
     fails beyond `max_restarts`.
@@ -177,8 +227,16 @@ def watch_local_trainers(procs, max_restarts=3, poll=0.2,
     and the loop returns PREEMPTED_EXIT_CODE itself — preemption
     propagates cleanly through nested supervision.  `on_event(kind,
     trainer)` (kinds 'exit', 'restart', 'hang', 'preempt', 'backoff',
-    'watchdog') observes transitions — tests and progress loggers
-    hook it.
+    'watchdog', 'reshape') observes transitions — tests and progress
+    loggers hook it.
+
+    `reshape_dir` arms the supervisor-initiated COORDINATED restart
+    path: a ``reshape_request.json`` appearing there (written by
+    :func:`request_reshape` / the plan supervisor) with a new seq
+    gracefully terminates every worker and relaunches them together
+    with the request's env merged in.  Reshapes consume NO
+    max_restarts budget and trip NO crash backoff — a planned
+    migration is not a failure, exactly like a preemption.
 
     CRASH restarts (not preemptions) back off exponentially:
     restart k of a worker waits ``min(restart_backoff * 2**(k-1),
@@ -219,8 +277,18 @@ def watch_local_trainers(procs, max_restarts=3, poll=0.2,
         # wedges BEFORE its first checkpoint touch must still trip
         # the stale-mtime detector
         _seed_heartbeat(heartbeat_file)
+    reshape_seq = 0     # act once per NEW request seq
     try:
         while True:
+            if reshape_dir is not None:
+                from ..resilience.supervisor import \
+                    read_reshape_request
+                req = read_reshape_request(reshape_dir)
+                if req and int(req.get('seq', 0)) > reshape_seq:
+                    reshape_seq = int(req['seq'])
+                    _coordinated_reshape(procs, req, log_dir,
+                                         on_event, heartbeat_file)
+                    continue
             if shutdown is not None and shutdown.requested():
                 # host preemption reached the supervisor: pass the
                 # SIGTERM down (terminate_local_procs starts with

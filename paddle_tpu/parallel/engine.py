@@ -61,7 +61,7 @@ class ParallelTrainer:
                  nan_max_rollbacks=2, lint=None, auto_shard=False,
                  hbm_budget_gb=None, calibration=None, profile=None,
                  watchdog=None, fused_steps=None, quant_collectives=None,
-                 cluster_stats=None):
+                 cluster_stats=None, supervisor=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -118,6 +118,23 @@ class ParallelTrainer:
         self.cluster_stats = cluster_stats
         self._cluster_plane = None
         self._cluster_init = False
+        # supervisor: the self-healing actuator (resilience.
+        # supervisor).  None → PADDLE_TPU_SUPERVISOR decides (default
+        # OFF); False hard-off; True/dict/SupervisorConfig arm a
+        # PlanSupervisor subscribed to this process's recorder: SLO/
+        # drift/straggler triggers re-run the planner with the live
+        # calibration, background-AOT-compile the winner, and queue a
+        # plan swap this trainer applies at its next step/chunk
+        # boundary (_apply_pending_plan).  Every failure in the
+        # ladder degrades to the incumbent plan.
+        self.supervisor = supervisor
+        self._supervisor = None
+        self._supervisor_init = False
+        self._pending_plan = None     # (plan, devices, incident meta)
+        import threading as _threading
+        # serializes trace-time _env.set_mesh flips between the live
+        # build path and the supervisor's shadow precompile
+        self._trace_lock = _threading.RLock()
         # rolling measured step times feeding Budget.note_measured —
         # host-side perf_counter deltas only, no device reads
         from collections import deque as _deque
@@ -888,6 +905,8 @@ class ParallelTrainer:
             return self._pipe_step(*batch)
         import time as _time
         from .. import telemetry as _tel
+        if self._pending_plan is not None:
+            self._apply_pending_plan()
         first_call = self._compiled is None
         vals = self._ensure_compiled(batch)
         key = rng_mod.next_key()
@@ -1001,6 +1020,10 @@ class ParallelTrainer:
         import warnings
         from .. import telemetry as _tel
         from ..core import scan_loop as _scan
+        if self._pending_plan is not None:
+            # chunk boundary: the supervisor's queued plan lands
+            # BEFORE this chunk compiles/dispatches
+            self._apply_pending_plan()
         vals = tuple(b.value if isinstance(b, Tensor) else jnp.asarray(b)
                      for b in batch)
         k = int(vals[0].shape[0])
@@ -1132,6 +1155,7 @@ class ParallelTrainer:
             self._profile_calls = n0 + k - 1
             prof.observe(n0, sync=losses, span=k)
         self._ensure_cluster_plane()
+        self._ensure_supervisor()
         if first_call:
             _tel.event('compile', name='ParallelTrainer.step_fused',
                        dur_s=round(dt, 6), fused_steps=k)
@@ -1243,6 +1267,158 @@ class ParallelTrainer:
         if plane is not None:
             plane.close()
 
+    # -- self-healing supervisor (resilience.supervisor) ---------------------
+    def _ensure_supervisor(self):
+        """Latch the plan-supervisor actuator on first use; None when
+        off (the default) — the per-step cost is then one attribute
+        read.  The supervisor subscribes to THIS process's recorder
+        and queues plan swaps in ``_pending_plan``; step()/
+        step_fused() apply them at the next boundary."""
+        if self._supervisor_init:
+            return self._supervisor
+        self._supervisor_init = True
+        try:
+            from ..resilience.supervisor import (
+                resolve_supervisor, PlanSupervisor, TrainerHost)
+            cfg = resolve_supervisor(self.supervisor)
+            if cfg is None:
+                return None
+            self._supervisor = PlanSupervisor(
+                TrainerHost(self), cfg).start()
+        except Exception:     # the actuator must never kill a step
+            self._supervisor = None
+        return self._supervisor
+
+    def stop_supervisor(self):
+        """Stop the actuator thread.  Final, like stop_watchdog():
+        later step() calls run unsupervised — assign
+        ``self.supervisor`` and reset ``_supervisor_init`` to re-arm
+        deliberately.  An already-queued swap still applies (the
+        trainer owns it).  No-op when the supervisor is off."""
+        sup, self._supervisor = self._supervisor, None
+        if sup is not None:
+            sup.stop()
+
+    def precompile_plan(self, plan, devices):
+        """AOT-compile `plan`'s REAL train step on a shadow of this
+        trainer — abstract state only, the live arrays are never
+        touched — and push it through the persistent compile cache
+        under the SAME fingerprint the post-swap rebuild computes, so
+        the swap's recompile deserializes instead of paying a cold
+        compile (cache off: the candidate is still validated to
+        trace+compile).  Runs on the supervisor's thread under the
+        trace lock; raises on failure — the safety ladder's
+        degrade-to-incumbent rung."""
+        import copy
+        from ..analysis import planner as _planner
+        from ..core import compile_cache as _cc
+        if self._compiled is None or not hasattr(self, '_example_vals'):
+            raise RuntimeError(
+                'precompile_plan needs a compiled incumbent step')
+
+        def abstract(tree):
+            return {n: (jax.ShapeDtypeStruct(v.shape, v.dtype)
+                        if hasattr(v, 'shape') else v)
+                    for n, v in tree.items()}
+
+        shadow = copy.copy(self)
+        shadow.plan = plan
+        shadow.param_specs = dict(plan.param_specs)
+        shadow.params = abstract(self.params)
+        shadow.buffers = abstract(self.buffers)
+        shadow.opt_state = {n: abstract(st)
+                            for n, st in self.opt_state.items()}
+        with self._trace_lock:
+            prev = _env.get_mesh()
+            try:
+                shadow.mesh = _planner._build_mesh(
+                    list(devices), plan.mesh_axes)
+                # model-internal maybe_shard constraints read the env
+                # mesh at trace time — restored before the lock drops
+                _env.set_mesh(shadow.mesh)
+                jitted = shadow._build_step()
+                args = shadow._step_example_args()
+                if _cc.enabled():
+                    fp = _cc.jaxpr_fingerprint(
+                        'trainer-step', shadow._raw_step, args,
+                        extra=(repr(shadow._jit_kwargs),
+                               tuple(sorted(dict(shadow.mesh.shape)
+                                            .items()))))
+                    _cc.through_cache(jitted, args, fp=fp,
+                                      name='ParallelTrainer.step')
+                else:
+                    jitted.lower(*args).compile()
+            finally:
+                _env.set_mesh(prev)
+
+    def _apply_pending_plan(self):
+        """Apply the supervisor's queued plan at this step/chunk
+        boundary: the PR-5 elastic-reshape restore posture, in
+        process — state re-places onto the new mesh (a reshard, not a
+        restart), the compiled artifacts drop (the precompiled
+        candidate deserializes from the persistent cache), and the
+        measured-step window + watchdog budget reset so the new plan
+        re-learns from fresh profiles instead of inheriting the
+        degraded plan's p95.  Emits ``plan_swap``; ANY failure
+        reverts to the incumbent state and emits a degraded
+        ``remediation`` — a swap can never kill a step loop that
+        would have run."""
+        import time as _time
+        from .. import telemetry as _tel
+        pending, self._pending_plan = self._pending_plan, None
+        if pending is None or self._pipeline:
+            return
+        plan, devices, meta = pending
+        from ..analysis import planner as _planner
+        old_mesh = self.mesh
+        old = (self.plan, self.mesh,
+               dict(self.param_specs), self.params, self.buffers,
+               self.opt_state, self._compiled, self._eval_compiled,
+               self._fused_cache, getattr(self, '_hlo_text', None))
+        t0 = _time.perf_counter()
+        try:
+            with self._trace_lock:
+                mesh = _planner._build_mesh(
+                    list(devices), plan.mesh_axes)
+                self.plan = plan
+                self.mesh = mesh
+                self.param_specs = dict(plan.param_specs)
+                _env.set_mesh(mesh)
+                self._place_state()
+                self._compiled = None
+                self._eval_compiled = None
+                self._fused_cache = {}
+                self._hlo_text = None
+            # fresh profiles for the new plan (satellite of the swap:
+            # budgets must not inherit the degraded plan's p95)
+            self._measured_dts.clear()
+            self._measured_n = 0
+            wd = self._watchdog
+            if wd is not None and getattr(wd, 'budget', None) is not None:
+                est = ((getattr(plan, 'est_us', 0) or 0)
+                       + (getattr(plan, 'compute_us', 0) or 0))
+                wd.budget.reset_measured(est_step_us=est or None)
+            _tel.event(
+                'plan_swap', step=self._step_no,
+                from_mesh=(dict(old_mesh.shape)
+                           if old_mesh is not None else None),
+                to_mesh=dict(plan.mesh_axes),
+                assignment=plan.assignment,
+                trigger=(meta or {}).get('trigger'),
+                policy=(meta or {}).get('policy'),
+                dur_s=round(_time.perf_counter() - t0, 6))
+        except Exception as e:
+            (self.plan, self.mesh, self.param_specs, self.params,
+             self.buffers, self.opt_state, self._compiled,
+             self._eval_compiled, self._fused_cache,
+             self._hlo_text) = old
+            _env.set_mesh(self.mesh)
+            _tel.event('remediation',
+                       trigger=(meta or {}).get('trigger'),
+                       policy=(meta or {}).get('policy'),
+                       outcome='degraded', stage='swap',
+                       error=repr(e))
+
     def _note_measured_step(self, dt, _tel, k=1):
         """Feed one measured step (or chunk) duration into the rolling
         profile and — every 32 observations — refresh an armed, non-
@@ -1318,6 +1494,7 @@ class ParallelTrainer:
                 self, '_profile_calls', -1) + 1
             prof.observe(n, sync=loss)
         self._ensure_cluster_plane()
+        self._ensure_supervisor()
         if first_call:
             _tel.event('compile', name='ParallelTrainer.step',
                        dur_s=round(dt, 6))

@@ -42,6 +42,15 @@ rest of the stack composes with it:
              of legal fault sequences for long soaks, plus shrinking
              a failing plan to a minimal committed reproducer
              (tools/soak_run.py).
+  supervisor the self-healing ACTUATOR closing the observe->act loop:
+             PlanSupervisor subscribes to the telemetry event stream
+             (slo_breach / drift_detected / straggler_suspect / ...),
+             classifies triggers into remediation policies, re-plans
+             over the healthy device set with live calibration,
+             AOT-precompiles the candidate, and queues a safe plan
+             swap at a step boundary (in-process) or a coordinated
+             reshape restart (multi-process clusters).  Default OFF
+             (PADDLE_TPU_SUPERVISOR / ParallelTrainer(supervisor=)).
 
 Reference analogue: the reference framework spreads this over fleet
 elastic (etcd heartbeats), checkpoint_saver (versioned dirs) and the
@@ -64,6 +73,9 @@ from .chaos import (  # noqa: F401
 from .watchdog import (  # noqa: F401
     Watchdog, Budget, WATCHDOG_EXIT_CODE, collective_budget,
     remaining_budget, resolve_watchdog)
+from .supervisor import (  # noqa: F401
+    PlanSupervisor, SupervisorConfig, TrainerHost, resolve_supervisor,
+    TRIGGER_POLICIES, write_reshape_request, read_reshape_request)
 
 __all__ = [
     'MANIFEST_NAME', 'TWO_PHASE_DIR', 'write_manifest', 'read_manifest',
@@ -79,4 +91,7 @@ __all__ = [
     'check_invariants', 'load_run_events',
     'Watchdog', 'Budget', 'WATCHDOG_EXIT_CODE', 'collective_budget',
     'remaining_budget', 'resolve_watchdog',
+    'PlanSupervisor', 'SupervisorConfig', 'TrainerHost',
+    'resolve_supervisor', 'TRIGGER_POLICIES', 'write_reshape_request',
+    'read_reshape_request',
 ]

@@ -94,6 +94,11 @@ FAULT_KINDS = (
     'nan_grads',         # poison the step-N batch with NaN
     'slow_rank',         # throttle this rank's step N by delay_s (the
                          # straggler the watchdog must attribute)
+    'drift',             # emit a synthetic drift_detected at step N
+                         # (op + us_ratio): the sustained sensor edge
+                         # the plan supervisor must actuate on exactly
+                         # once — chaos-grade drift without waiting
+                         # for a real profiled collective to degrade
 ) + COLLECTIVE_FAULT_KINDS
 
 
@@ -116,12 +121,16 @@ class Fault:
                 multi-process plans slice per rank; see
                 FaultPlan.slice_for_rank.
     op          substring filter on the collective op/tag (collective
-                seams; e.g. 'allreduce' or 'step7').
+                seams; e.g. 'allreduce' or 'step7'), and the drifted
+                collective kind for ``drift`` faults (default
+                'all-reduce').
+    us_ratio    observed/predicted ratio a ``drift`` fault reports
+                (default 8.0 — far outside the monitor's 4x band).
     """
 
     def __init__(self, kind, at_step=None, prob=None, count=None,
                  path=None, errno_name='EIO', delay_s=0.05,
-                 rank=None, op=None):
+                 rank=None, op=None, us_ratio=None):
         if kind not in FAULT_KINDS:
             raise ValueError(f'unknown fault kind {kind!r}; '
                              f'one of {FAULT_KINDS}')
@@ -135,13 +144,20 @@ class Fault:
         self.delay_s = delay_s
         self.rank = rank
         self.op = op
+        self.us_ratio = us_ratio
         self.fired = 0
 
     _FIELDS = ('kind', 'at_step', 'prob', 'count', 'path',
-               'errno_name', 'delay_s', 'rank', 'op')
+               'errno_name', 'delay_s', 'rank', 'op', 'us_ratio')
 
     def to_dict(self):
-        return {k: getattr(self, k) for k in self._FIELDS}
+        d = {k: getattr(self, k) for k in self._FIELDS}
+        # us_ratio joined the schema after plans were golden-pinned:
+        # omit it when unset so every pre-existing plan's canonical
+        # JSON (and fingerprint) stays byte-identical
+        if d['us_ratio'] is None:
+            del d['us_ratio']
+        return d
 
     @classmethod
     def from_dict(cls, d):
@@ -310,8 +326,11 @@ class ChaosEngine:
                 continue
             if path is None and f.path is not None:
                 continue
-            if f.op is not None and (op is None
-                                     or f.op not in str(op)):
+            # a drift fault's `op` is PAYLOAD (which collective the
+            # synthetic sensor edge reports), not an op-seam address —
+            # the step loop that fires it has no op context
+            if f.op is not None and f.kind != 'drift' \
+                    and (op is None or f.op not in str(op)):
                 continue
             out.append(f)
         return out
@@ -541,6 +560,24 @@ class ChaosEngine:
                 self.record(f, step=step_no, rank=self.rank,
                             delay_s=f.delay_s)
                 time.sleep(f.delay_s)
+        for f in self._matching(('drift',), step=step_no):
+            if f.at_step == step_no and self._roll(f):
+                # synthetic sensor edge: the SAME drift_detected event
+                # telemetry.monitors latches off a real profiled
+                # collective, minus the hours of waiting — the plan
+                # supervisor must classify, re-plan and actuate on it
+                # exactly once
+                op = f.op or 'all-reduce'
+                ratio = float(f.us_ratio or 8.0)
+                self.record(f, step=step_no, op=op, us_ratio=ratio)
+                try:
+                    from .. import telemetry
+                    telemetry.event(
+                        'drift_detected', cause='us_ratio', op=op,
+                        instr='chaos-injected', us_ratio=ratio,
+                        band=4.0, windows=8)
+                except Exception:
+                    pass
         for f in self._matching(('delete_heartbeat',), step=step_no):
             if f.at_step == step_no and self._roll(f):
                 hb = self.heartbeat_file
@@ -777,7 +814,8 @@ class ChaosCluster:
                  worker_argv=None, deadline_s=240.0,
                  jax_distributed=False, engine=None, extra_env=None,
                  cluster_stats=False, cluster_stats_interval=0.25,
-                 restart_backoff=0.2, restart_backoff_max=2.0):
+                 restart_backoff=0.2, restart_backoff_max=2.0,
+                 supervisor=None):
         import tempfile
         self.procs = int(procs)
         # crash-restart backoff (seconds, exponential up to the max).
@@ -799,6 +837,12 @@ class ChaosCluster:
         # never crashes it.
         self.cluster_stats = bool(cluster_stats)
         self.cluster_stats_interval = float(cluster_stats_interval)
+        # supervisor: arm the self-healing plan supervisor inside the
+        # workers (resilience.supervisor posture string/'1') AND the
+        # coordinated-reshape watch on this supervision loop — a
+        # rank-0 worker's swap request restarts the whole cluster
+        # once, free of the max_restarts budget.
+        self.supervisor = supervisor
         self.plan = (plan if isinstance(plan, FaultPlan)
                      else FaultPlan(**plan) if isinstance(plan, dict)
                      else plan or FaultPlan(seed=0))
@@ -851,6 +895,9 @@ class ChaosCluster:
         if self.cluster_stats:
             env['PADDLE_TPU_CLUSTER_STATS'] = str(
                 self.cluster_stats_interval)
+        if self.supervisor:
+            env['PADDLE_TPU_SUPERVISOR'] = (
+                '1' if self.supervisor is True else str(self.supervisor))
         if self.jax_distributed:
             import socket
             s = socket.socket()
@@ -886,7 +933,8 @@ class ChaosCluster:
                 min_preempt_uptime=0.0, on_event=on_event,
                 restart_backoff=self.restart_backoff,
                 restart_backoff_max=self.restart_backoff_max,
-                deadline=self.deadline_s)
+                deadline=self.deadline_s,
+                reshape_dir=self.workdir if self.supervisor else None)
         finally:
             elastic.terminate_local_procs(procs, grace=2.0)
             if self.engine is not None:
@@ -928,9 +976,10 @@ class ChaosCluster:
                            'op', 'tag', 'rank')
                           if e.get(k) is not None} for e in injected],
             'incarnations': {p.rank: 1 + p.restarts + p.preemptions
-                             for p in procs},
+                             + p.reshapes for p in procs},
             'failure_restarts': {p.rank: p.restarts for p in procs},
             'preemptions': {p.rank: p.preemptions for p in procs},
+            'reshapes': {p.rank: p.reshapes for p in procs},
             'preempt_exit_codes': exit_codes['preempt'],
             'watchdog_exit_codes': exit_codes['watchdog'],
             'supervisor_events': supervisor_events,

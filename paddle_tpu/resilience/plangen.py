@@ -30,8 +30,8 @@ import random
 
 from .chaos import Fault, FaultPlan, COLLECTIVE_FAULT_KINDS
 
-__all__ = ['GENERATABLE_KINDS', 'generate_plan', 'legal', 'shrink',
-           'plan_fingerprint', 'emit_regression']
+__all__ = ['GENERATABLE_KINDS', 'OPTIN_KINDS', 'generate_plan',
+           'legal', 'shrink', 'plan_fingerprint', 'emit_regression']
 
 # kinds the generator composes.  nan_grads is excluded (the soak
 # workload has no gradient path), delete/stale_heartbeat are excluded
@@ -42,6 +42,12 @@ GENERATABLE_KINDS = (
     'slow_io', 'slow_rank',
 ) + COLLECTIVE_FAULT_KINDS
 
+# opt-in coverage-class kinds: legal() admits them but the DEFAULT
+# pool never draws them — growing GENERATABLE_KINDS would shift every
+# seeded draw stream and silently break golden-pinned plans.  'drift'
+# is the supervisor-migration class (generate_plan(supervisor=True)).
+OPTIN_KINDS = ('drift',)
+
 
 def legal(fault, steps, procs, save_every=2, hang_min_s=None):
     """True iff `fault` respects its seam's preconditions for a soak
@@ -49,11 +55,16 @@ def legal(fault, steps, procs, save_every=2, hang_min_s=None):
     legal faults; the shrinker preserves legality by construction
     (removing faults cannot violate a precondition)."""
     f = fault if isinstance(fault, Fault) else Fault.from_dict(fault)
-    if f.kind not in GENERATABLE_KINDS:
+    if f.kind not in GENERATABLE_KINDS + OPTIN_KINDS:
         return False
     if f.rank is not None and not (0 <= int(f.rank) < procs):
         return False
     in_range = f.at_step is None or (2 <= f.at_step <= steps)
+    if f.kind == 'drift':
+        # the synthetic sensor edge must land on rank 0 — the plan
+        # supervisor actuator subscribes to rank 0's recorder; drift
+        # injected anywhere else never reaches it
+        return in_range and f.at_step is not None and f.rank == 0
     if f.kind in ('sigkill', 'sigterm'):
         # process faults fire from the step loop: need a live step, an
         # addressed rank (an unaddressed kill would fire on EVERY rank
@@ -95,6 +106,11 @@ def _make(kind, rng, steps, procs, save_every, hang_s):
         lo = min(save_every + 1, steps)
         return Fault(kind, at_step=rng.randrange(lo, steps + 1),
                      rank=rank)
+    if kind == 'drift':
+        lo = min(save_every + 1, steps)
+        return Fault(kind, at_step=rng.randrange(lo, steps + 1),
+                     rank=0, op='all-reduce',
+                     us_ratio=round(rng.uniform(6.0, 12.0), 2))
     if kind == 'slow_rank':
         return Fault(kind, at_step=step, rank=rank,
                      delay_s=round(rng.uniform(0.2, 0.8), 3))
@@ -129,7 +145,7 @@ def _make(kind, rng, steps, procs, save_every, hang_s):
 def generate_plan(seed, steps, procs, n_faults=6,
                   require=('collective_hang', 'sigkill', 'torn_write'),
                   save_every=2, hang_s=60.0, kinds=None,
-                  name=None, quant_wire=False):
+                  name=None, quant_wire=False, supervisor=False):
     """A seeded, legal FaultPlan for one soak.
 
     `require` kinds are always present (coverage classes the soak
@@ -147,7 +163,18 @@ def generate_plan(seed, steps, procs, n_faults=6,
     the QUANTIZED payload path.  It changes no fault draw: the same
     seed composes the identical fault sequence either way (so a
     quantized soak failure bisects cleanly against its full-width
-    twin)."""
+    twin).
+
+    ``supervisor`` is the supervisor-MIGRATION coverage class (plan
+    tagged ``+sup``): an injected ``drift`` fault on rank 0 — the
+    synthetic sensor edge the plan supervisor actuates on — plus a
+    SIGKILL landing ONE STEP after it, i.e. inside the window where
+    the reshape request is written but the coordinated restart has
+    not completed.  The gate it feeds: the request survives the
+    crash, the cluster reshapes exactly once, no max_restarts burn,
+    finals stay bit-exact.  The extra draws happen AFTER the require
+    loop and only when armed, so ``supervisor=False`` plans (and
+    their golden fingerprints) are byte-identical to before."""
     # int-folded so the draw stream is pure in (seed, steps, procs)
     # (random.Random rejects tuples)
     rng = random.Random(int(seed) * 1_000_003
@@ -175,6 +202,20 @@ def generate_plan(seed, steps, procs, n_faults=6,
             raise RuntimeError(
                 f'could not compose a legal {kind!r} fault for '
                 f'steps={steps} procs={procs}')
+    if supervisor:
+        drift = None
+        for _ in range(64):
+            f = _make('drift', rng, steps, procs, save_every, hang_s)
+            if admit(f):
+                drift = f
+                break
+        if drift is None:
+            raise RuntimeError(
+                f'could not compose a legal drift fault for '
+                f'steps={steps} procs={procs}')
+        # the mid-migration crash: one step after the sensor edge
+        admit(Fault('sigkill', rank=rng.randrange(procs),
+                    at_step=min(steps, drift.at_step + 1)))
     while len(faults) < n_faults:
         kind = pool[rng.randrange(len(pool))]
         for _ in range(64):
@@ -186,6 +227,8 @@ def generate_plan(seed, steps, procs, n_faults=6,
     base = name or f'soak-{seed}'
     if quant_wire:
         base += '+qwire'
+    if supervisor:
+        base += '+sup'
     return FaultPlan(seed=seed, faults=faults, name=base)
 
 

@@ -1,0 +1,552 @@
+"""Self-healing runtime: the plan supervisor ACTUATOR.
+
+PRs 13–15 built the sensors — latched ``slo_breach`` /
+``drift_detected`` edges (telemetry.monitors), ``straggler_suspect``
+/ ``rank_divergence`` from the cluster view, the watchdog's own
+``straggler`` / ``quorum_lost`` escalations, measured step budgets
+and live-fitted calibration.  This module closes the observe→act
+loop: a :class:`PlanSupervisor` subscribes to the Recorder's event
+stream, debounces and classifies each trigger into a remediation
+policy, re-runs the PR-6 planner against the *current* health of the
+cluster (live calibration, healthy ranks only), AOT-compiles the
+winning candidate's real train step in the background through the
+PR-7 persistent compile cache, and swaps plans at a chunk boundary —
+the compiled sharded module as the reconfiguration unit, in the
+spirit of Flex-TPU's runtime-reconfigurable dataflow.
+
+The actuator is governed by a STRICT safety ladder; every rung
+degrades to the incumbent plan, never crashes the job:
+
+1. classify   the trigger maps to a policy (``replan`` /
+              ``exclude_rank`` / ``backoff``); unknown triggers are
+              dropped.
+2. debounce   triggers within ``debounce_s`` of the first coalesce
+              into ONE incident; a cooldown window after any
+              actuation suppresses re-fire, so a single sustained
+              incident actuates exactly once (``remediation`` events
+              record suppressed triggers).
+3. re-plan    the planner runs with the incident-adjusted
+              calibration (a drifted collective's measured penalty is
+              folded into ``per_op``) and only the healthy device
+              set.  Planner failure → degrade.
+4. margin     the candidate's predicted step must beat the
+              incumbent's estimate — the incumbent re-scored in the
+              SAME planner run when possible, else the live-measured
+              step profile — by at least ``margin`` (fractional).
+              Not better → hold.
+5. precompile the candidate's REAL train step AOT-compiles in the
+              background (through the compile cache, so the post-swap
+              rebuild deserializes instead of recompiling).  Compile
+              failure → degrade.
+6. swap       the new plan is queued; the trainer applies it at the
+              next step/chunk boundary via the elastic-reshape
+              restore path and emits ``plan_swap``.  Swap failure →
+              revert to the incumbent state, degrade.
+
+Opt-in posture (the watchdog's exactly): ``ParallelTrainer(
+supervisor=True|dict|SupervisorConfig)`` or the
+``PADDLE_TPU_SUPERVISOR`` env (default OFF; explicit ``False`` beats
+the env; conftest pins ``0`` so no test arms it by accident).
+``ChaosCluster(supervisor=...)`` / ``tools/soak_run.py`` arm it
+inside chaos workers, where the multi-process swap path rides the
+:func:`paddle_tpu.distributed.elastic.request_reshape` coordinated
+restart (no ``max_restarts`` burn — same posture as preemptions).
+"""
+import json
+import os
+import queue
+import threading
+import time
+
+__all__ = ['SUPERVISOR_ENV', 'TRIGGER_POLICIES', 'SupervisorConfig',
+           'resolve_supervisor', 'PlanSupervisor', 'TrainerHost',
+           'drift_calibration', 'write_reshape_request',
+           'read_reshape_request', 'RESHAPE_REQUEST_NAME']
+
+SUPERVISOR_ENV = 'PADDLE_TPU_SUPERVISOR'
+
+# trigger event kind -> remediation policy.  ``replan`` runs the full
+# safety ladder; ``exclude_rank`` re-plans over the healthy subset
+# (the suspect's devices dropped when the host can attribute them);
+# ``backoff`` records the incident and arms the cooldown WITHOUT
+# actuating — divergence and lost quorum are states a new sharding
+# plan cannot fix (restore/restart machinery owns them), so acting
+# would only thrash.
+TRIGGER_POLICIES = {
+    'drift_detected': 'replan',
+    'slo_breach': 'replan',
+    'straggler_suspect': 'exclude_rank',
+    'straggler': 'exclude_rank',
+    'rank_divergence': 'backoff',
+    'quorum_lost': 'backoff',
+}
+
+_MONO = time.monotonic
+
+
+def _emit(kind, **data):
+    from .. import telemetry as _tel
+    return _tel.event(kind, **data)
+
+
+class SupervisorConfig:
+    """Knobs of the safety ladder.
+
+    debounce_s   triggers arriving within this window of the first
+                 coalesce into one incident (sensors latch, but
+                 several sensors can fire for one cause).
+    cooldown_s   after ANY terminal outcome (swap/hold/degraded/
+                 backoff) new triggers are suppressed for this long —
+                 the hysteresis making "one incident → at most one
+                 actuation" structural, and giving a fresh plan time
+                 to prove itself before it can be re-judged.
+    margin       fractional improvement the candidate's predicted
+                 step must show over the incumbent's estimate
+                 (0.1 = 10% faster) before a swap is worth its cost.
+    max_swaps    lifetime cap on actuated swaps (None = unbounded) —
+                 a mis-tuned sensor can never turn the supervisor
+                 into a plan-thrashing loop.
+    policies     overrides merged over TRIGGER_POLICIES (a dict, or
+                 ``{'slo_breach': None}`` to drop a trigger).
+    """
+
+    def __init__(self, debounce_s=0.25, cooldown_s=30.0, margin=0.1,
+                 max_swaps=None, policies=None):
+        self.debounce_s = float(debounce_s)
+        self.cooldown_s = float(cooldown_s)
+        self.margin = float(margin)
+        self.max_swaps = None if max_swaps is None else int(max_swaps)
+        self.policies = dict(TRIGGER_POLICIES)
+        for k, v in (policies or {}).items():
+            if v is None:
+                self.policies.pop(k, None)
+            else:
+                self.policies[k] = v
+
+    @classmethod
+    def from_env(cls, text):
+        """Parse the PADDLE_TPU_SUPERVISOR value: '1'/'on' ->
+        defaults; 'margin=0.2,cooldown=10,debounce=1' -> numbers."""
+        text = (text or '').strip()
+        if text.lower() in ('', '0', 'off', 'false'):
+            return None
+        if text.lower() in ('1', 'on', 'true'):
+            return cls()
+        kwargs = {}
+        keymap = {'debounce': 'debounce_s', 'cooldown': 'cooldown_s',
+                  'margin': 'margin', 'max_swaps': 'max_swaps'}
+        for part in text.split(','):
+            if '=' not in part:
+                continue
+            k, v = part.split('=', 1)
+            k = keymap.get(k.strip())
+            if k is None:
+                continue
+            try:
+                kwargs[k] = float(v) if k != 'max_swaps' else int(v)
+            except ValueError:
+                pass
+        return cls(**kwargs)
+
+    def to_dict(self):
+        return {'debounce_s': self.debounce_s,
+                'cooldown_s': self.cooldown_s, 'margin': self.margin,
+                'max_swaps': self.max_swaps}
+
+
+def resolve_supervisor(arg):
+    """The shared opt-in posture (resolve_watchdog's exactly):
+    explicit False -> None (off even if the env says on); True ->
+    SupervisorConfig(); config/dict pass through; None -> the
+    PADDLE_TPU_SUPERVISOR env decides.  Returns a SupervisorConfig or
+    None."""
+    if arg is False:
+        return None
+    if arg is None:
+        return SupervisorConfig.from_env(os.environ.get(SUPERVISOR_ENV))
+    if arg is True:
+        return SupervisorConfig()
+    if isinstance(arg, SupervisorConfig):
+        return arg
+    if isinstance(arg, dict):
+        return SupervisorConfig(**arg)
+    raise TypeError(
+        f'supervisor= expects bool/dict/SupervisorConfig, got {arg!r}')
+
+
+def drift_calibration(base, incidents):
+    """Fold the observed drift back into the planner's cost model: a
+    ``drift_detected`` trigger carries the measured
+    observed/predicted ``us_ratio`` for one collective — the re-plan
+    must score that op at its MEASURED cost, or it would happily
+    re-pick the plan the drift just invalidated.  Returns a new
+    ``costmodel.Calibration`` (base entries preserved; the drifted
+    op's alpha/beta scaled by the ratio), or ``base`` unchanged when
+    no trigger carries a usable ratio."""
+    from ..analysis import costmodel as _cm
+    per_op = {}
+    if base is not None:
+        per_op.update({k: dict(v) for k, v in base.per_op.items()})
+    touched = False
+    for data in incidents:
+        op = data.get('op')
+        ratio = data.get('us_ratio')
+        if not op or not ratio or ratio <= 1.0:
+            continue
+        ent = per_op.get(op, {})
+        alpha = ent.get('alpha_us')
+        beta = ent.get('beta_us_per_byte')
+        if alpha is None:
+            alpha = _cm.DEFAULT_LINK_LATENCY_US
+        if beta is None:
+            # analytic default: 1 / (bw in bytes/us)
+            beta = 1.0 / (_cm.DEFAULT_LINK_BW_GBPS * 1e3)
+        per_op[op] = {'alpha_us': alpha * ratio,
+                      'beta_us_per_byte': beta * ratio}
+        touched = True
+    if not touched:
+        return base
+    return _cm.Calibration(
+        per_op=per_op,
+        link_bw_gbps=getattr(base, 'link_bw_gbps', None),
+        link_latency_us=getattr(base, 'link_latency_us', None),
+        meta={'source': 'supervisor-drift'})
+
+
+# -- multi-process swap path: the coordinated-reshape request file ------------
+
+RESHAPE_REQUEST_NAME = 'reshape_request.json'
+
+
+def write_reshape_request(workdir, mesh=None, env=None, reason=None,
+                          seq=None):
+    """Queue a supervisor-initiated coordinated restart for the
+    elastic supervisor watching this workdir: atomically write
+    ``reshape_request.json`` with a monotone ``seq`` (the watch loop
+    acts once per new seq).  ``env`` entries are merged into every
+    worker's environment on the restart — how a new mesh/plan reaches
+    the next incarnation.  Returns the seq written."""
+    from .manifest import atomic_write
+    path = os.path.join(workdir, RESHAPE_REQUEST_NAME)
+    if seq is None:
+        prev = read_reshape_request(workdir)
+        seq = (prev.get('seq', 0) if prev else 0) + 1
+    doc = {'seq': int(seq), 'ts': time.time(),
+           'mesh': dict(mesh) if mesh else None,
+           'env': {k: str(v) for k, v in (env or {}).items()},
+           'reason': reason}
+    atomic_write(path, lambda f: f.write(json.dumps(doc,
+                                                    sort_keys=True)))
+    return doc['seq']
+
+
+def read_reshape_request(workdir):
+    """The pending reshape request under `workdir`, or None (missing
+    or torn file — a half-written request must read as absent, never
+    crash the watch loop)."""
+    try:
+        with open(os.path.join(workdir, RESHAPE_REQUEST_NAME)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) and 'seq' in doc else None
+
+
+class PlanSupervisor:
+    """The actuator thread: recorder subscription in, remediation
+    (``remediation`` / ``plan_swap`` telemetry) out.
+
+    `host` supplies the environment the ladder runs against — a
+    :class:`TrainerHost` wrapping a live ``ParallelTrainer`` (the
+    in-process path), or any object with the same five methods (the
+    chaos soak uses a rank-0 file-writing host):
+
+      healthy_devices(incident) -> device list for the re-plan
+      replan(devices, calibration) -> planner PlanResult
+      incumbent() -> (plan, step_estimate_s) — either may be None
+      precompile(plan, devices) -> None (raise on failure)
+      request_swap(plan, devices, incident) -> True when queued
+
+    Every host call runs on the supervisor's own daemon thread; a
+    raised exception anywhere degrades that incident to the incumbent
+    plan.  ``stop()`` (or the thread dying) leaves training entirely
+    untouched — the trainer only ever sees a queued plan it applies
+    at its own boundary."""
+
+    def __init__(self, host, config=None):
+        self.host = host
+        self.config = config or SupervisorConfig()
+        self._q = queue.Queue()
+        self._thread = None
+        self._stop = threading.Event()
+        self._cooldown_until = 0.0
+        self._subscribed = False
+        self.swaps = 0              # actuated plan swaps (lifetime)
+        self.incidents = []         # terminal remediation records
+        self._suppressed = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        """Subscribe to the recorder and start the worker thread.
+        Idempotent; returns self."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        from ..telemetry import get_recorder
+        self._stop.clear()
+        if not self._subscribed:
+            get_recorder().subscribe(self._on_event)
+            self._subscribed = True
+        self._thread = threading.Thread(
+            target=self._run, name='plan-supervisor', daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=5.0):
+        """Unsubscribe and stop the worker.  Training continues
+        untouched — an already-queued swap still applies (the trainer
+        owns it), but no new incident is ever processed."""
+        if self._subscribed:
+            from ..telemetry import get_recorder
+            try:
+                get_recorder().unsubscribe(self._on_event)
+            except Exception:
+                pass
+            self._subscribed = False
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            t.join(timeout)
+
+    # -- recorder subscriber ----------------------------------------------
+    def _on_event(self, rec):
+        """Called inline by the recorder's notify loop: filter to the
+        trigger vocabulary and enqueue — never block, never raise
+        (the recorder swallows exceptions, but a slow subscriber
+        would stall every emitter)."""
+        try:
+            kind = rec.get('kind')
+            if kind not in self.config.policies:
+                return
+            if self._stop.is_set():
+                return
+            self._q.put_nowait(dict(rec))
+        except Exception:
+            pass
+
+    # -- worker ------------------------------------------------------------
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                first = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self._handle(first)
+            except Exception:
+                # the ladder has its own degrade path; this catches
+                # bookkeeping bugs — the actuator must never die loud
+                pass
+
+    def _drain(self, deadline):
+        """Coalesce triggers until `deadline`; returns them."""
+        more = []
+        while True:
+            left = deadline - _MONO()
+            if left <= 0:
+                break
+            try:
+                more.append(self._q.get(timeout=left))
+            except queue.Empty:
+                break
+        return more
+
+    def _handle(self, first):
+        cfg = self.config
+        now = _MONO()
+        if now < self._cooldown_until:
+            # inside the cooldown: the incident already actuated (or
+            # terminally resolved); count, don't act
+            self._suppressed += 1 + self._qsize_drain()
+            return
+        triggers = [first] + self._drain(now + cfg.debounce_s)
+        incident = {
+            'trigger': first.get('kind'),
+            'policy': cfg.policies.get(first.get('kind')),
+            'triggers': len(triggers),
+            'kinds': sorted({t.get('kind') for t in triggers}),
+            'data': triggers,
+        }
+        self._suppressed = 0
+        outcome = self._remediate(incident)
+        self._cooldown_until = _MONO() + cfg.cooldown_s
+        incident['outcome'] = outcome
+        self.incidents.append(incident)
+
+    def _qsize_drain(self):
+        n = 0
+        while True:
+            try:
+                self._q.get_nowait()
+                n += 1
+            except queue.Empty:
+                return n
+
+    def _terminal(self, incident, outcome, **data):
+        _emit('remediation', trigger=incident['trigger'],
+              policy=incident['policy'], outcome=outcome,
+              triggers=incident['triggers'],
+              kinds=incident['kinds'], **data)
+        return outcome
+
+    def _remediate(self, incident):
+        """One incident through the safety ladder; returns the
+        terminal outcome string ('swap'/'hold'/'backoff'/
+        'degraded')."""
+        cfg = self.config
+        policy = incident['policy']
+        if policy == 'backoff':
+            return self._terminal(incident, 'backoff')
+        if cfg.max_swaps is not None and self.swaps >= cfg.max_swaps:
+            return self._terminal(incident, 'hold',
+                                  reason='max_swaps reached')
+        host = self.host
+        # rung 3: re-plan over the healthy set with the incident-
+        # adjusted calibration
+        try:
+            devices = host.healthy_devices(incident)
+            cal = drift_calibration(
+                host.calibration(), incident['data'])
+            result = host.replan(devices, cal)
+            cand = result.winner if result is not None else None
+        except Exception as e:
+            return self._terminal(incident, 'degraded', stage='plan',
+                                  error=repr(e))
+        if cand is None:
+            return self._terminal(incident, 'degraded', stage='plan',
+                                  error='no candidate fit the budget')
+        # rung 4: the margin gate.  Prefer the incumbent re-scored in
+        # the SAME planner run (identical cost model, so the
+        # comparison is apples-to-apples); fall back to the live-
+        # measured step estimate.
+        try:
+            inc_plan, inc_meas_s = host.incumbent()
+        except Exception:
+            inc_plan, inc_meas_s = None, None
+        if inc_plan is not None \
+                and dict(cand.mesh_axes) == dict(inc_plan.mesh_axes) \
+                and cand.assignment == getattr(inc_plan, 'assignment',
+                                               None):
+            return self._terminal(
+                incident, 'hold', reason='winner is the incumbent',
+                mesh=dict(cand.mesh_axes))
+        inc_s = None
+        if inc_plan is not None and result is not None:
+            for p in result.candidates + result.fallbacks:
+                if dict(p.mesh_axes) == dict(inc_plan.mesh_axes) \
+                        and p.assignment == inc_plan.assignment:
+                    inc_s = p.score_us * 1e-6
+                    break
+        if inc_s is None:
+            inc_s = inc_meas_s
+        cand_s = cand.score_us * 1e-6
+        if inc_s is not None and cand_s > inc_s * (1.0 - cfg.margin):
+            return self._terminal(
+                incident, 'hold', reason='margin not met',
+                candidate_s=round(cand_s, 6),
+                incumbent_s=round(inc_s, 6), margin=cfg.margin)
+        # rung 5: background AOT compile of the real step
+        try:
+            host.precompile(cand, devices)
+        except Exception as e:
+            return self._terminal(incident, 'degraded',
+                                  stage='compile', error=repr(e))
+        # rung 6: queue the swap at the trainer's boundary
+        try:
+            if not host.request_swap(cand, devices, incident):
+                return self._terminal(incident, 'hold',
+                                      reason='swap refused')
+        except Exception as e:
+            return self._terminal(incident, 'degraded', stage='swap',
+                                  error=repr(e))
+        self.swaps += 1
+        return self._terminal(
+            incident, 'swap', mesh=dict(cand.mesh_axes),
+            assignment=cand.assignment,
+            candidate_s=round(cand_s, 6),
+            incumbent_s=None if inc_s is None else round(inc_s, 6))
+
+
+class TrainerHost:
+    """The in-process host: the ladder runs against a live
+    ``ParallelTrainer``.  Planner re-entry reuses the trainer's model
+    / batch shapes / HBM budget; the swap is QUEUED
+    (``trainer._pending_plan``) and applied by the trainer itself at
+    the next step/chunk boundary — the supervisor thread never
+    touches live device state."""
+
+    def __init__(self, trainer):
+        self.trainer = trainer
+
+    def calibration(self):
+        return self.trainer._resolved_calibration()
+
+    def healthy_devices(self, incident):
+        """The device set the re-plan may use: the trainer's current
+        mesh (else all visible), minus any devices attributed to a
+        straggler suspect when the policy excludes ranks and the
+        attribution maps onto local devices (single-host multi-device
+        meshes; on one-device-per-process topologies exclusion is the
+        elastic layer's job)."""
+        import jax
+        t = self.trainer
+        devices = (list(t.mesh.devices.flat) if t.mesh is not None
+                   else list(jax.devices()))
+        if incident.get('policy') != 'exclude_rank':
+            return devices
+        suspects = {d.get('suspect') for d in incident['data']
+                    if d.get('suspect') is not None}
+        if not suspects:
+            return devices
+        healthy = [d for d in devices if d.id not in suspects]
+        # never exclude below half the fleet: mass exclusion is a
+        # sensor failure, not a remediation
+        if len(healthy) < max(1, len(devices) // 2):
+            return devices
+        return healthy or devices
+
+    def incumbent(self):
+        t = self.trainer
+        meas = None
+        try:
+            dts = list(t._measured_dts)
+            if dts:
+                dts.sort()
+                meas = dts[len(dts) // 2]        # median live step
+        except Exception:
+            meas = None
+        return t.plan, meas
+
+    def replan(self, devices, calibration):
+        from ..analysis import planner as _planner
+        t = self.trainer
+        vals = getattr(t, '_example_vals', None)
+        if not vals:
+            raise RuntimeError('trainer has not compiled a step yet')
+        batch = tuple(vals[:t.n_inputs])
+        return _planner.plan_model(
+            t.model, batch, chips=len(devices), devices=list(devices),
+            hbm_budget_gb=t.hbm_budget_gb, calibration=calibration,
+            include_pp=False, name=type(t.model).__name__)
+
+    def precompile(self, plan, devices):
+        self.trainer.precompile_plan(plan, devices)
+
+    def request_swap(self, plan, devices, incident):
+        t = self.trainer
+        if getattr(t, '_pending_plan', None) is not None:
+            return False
+        t._pending_plan = (plan, list(devices), {
+            'trigger': incident.get('trigger'),
+            'policy': incident.get('policy')})
+        return True

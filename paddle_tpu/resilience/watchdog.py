@@ -165,6 +165,25 @@ class Budget:
         self.step_source = 'measured'
         return new
 
+    def reset_measured(self, est_step_us=None, min_step_s=5.0):
+        """Forget a MEASURED step budget after a plan swap: the new
+        plan's steps share nothing with the degraded plan's p95, so
+        the rolling profile must re-learn from scratch.  The budget
+        drops back one rung on the adaptation ladder — to the new
+        plan's cost-model estimate when one is given, else the global
+        default.  Explicit budgets are a contract and never reset.
+        Returns the new step_s (None = default)."""
+        if self.step_source == 'explicit':
+            return None
+        if est_step_us:
+            self.step_s = max(float(min_step_s),
+                              float(est_step_us) * 1e-6 * self.slack)
+            self.step_source = 'costmodel'
+        else:
+            self.step_s = None
+            self.step_source = 'default'
+        return self.step_s
+
     @classmethod
     def from_env(cls, text):
         """Parse the PADDLE_TPU_WATCHDOG value: '1'/'on' -> defaults;

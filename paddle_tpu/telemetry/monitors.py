@@ -88,6 +88,12 @@ class SLOMonitor:
         self.breaches = []              # local record (tests/reports)
 
     def observe(self, rec, agg):
+        if rec.get('kind') == 'plan_swap':
+            # a new plan means new budgets: clear the latch so the
+            # NEXT breach (under the new plan) is a fresh edge, not a
+            # hangover from the plan the supervisor just retired
+            self._latched.clear()
+            return
         # TTFT and deadline-eviction state only change when a request
         # finishes — serve_step would re-check an unchanged window
         if rec.get('kind') != 'serve_request':
@@ -195,13 +201,29 @@ class DriftMonitor:
         self._ratios = {}               # (op, instr) -> deque of ratio
         self._window = int(window)
         self._latched = set()
+        self._post_swap_compiles = 0
         self.detections = []            # local record (tests/reports)
 
     def observe(self, rec, agg):
         kind = rec.get('kind')
-        if kind == 'collective_observed':
+        if kind == 'plan_swap':
+            # the swapped-in plan predicts with different constants
+            # and compiles fresh modules: stale ratio windows (and the
+            # retired plan's latches) would mis-attribute the new
+            # plan's first observations as drift — or suppress real
+            # drift under a recycled latch key
+            self._ratios.clear()
+            self._latched.clear()
+            # the swapped plan's own rebuild (per-step and/or fused
+            # module) compiles AFTER steady by construction — it is
+            # the actuation, not drift
+            self._post_swap_compiles = 2
+        elif kind == 'collective_observed':
             self._observe_collective(rec)
         elif kind == 'compile':
+            if self._post_swap_compiles > 0:
+                self._post_swap_compiles -= 1
+                return
             self._observe_compile(rec, agg)
 
     def _fire(self, cause, key, **data):

@@ -133,6 +133,15 @@ EVENT_KINDS = (
                            # band: a rank is training on different
                            # state than its peers (corrupt restore,
                            # leaked collective fault, desynced rng)
+    'remediation',         # the plan supervisor resolved one incident
+                           # (trigger, policy, outcome: swap/hold/
+                           # backoff/degraded, with stage + error on
+                           # the degrade path) — resilience.supervisor
+                           # emits one per debounced incident
+    'plan_swap',           # the trainer applied a supervisor-queued
+                           # plan at a step/chunk boundary (from_mesh
+                           # -> to_mesh, assignment, trigger, dur_s)
+                           # — the observe→act loop's actuation edge
     'crash',               # the sys.excepthook crash hook latched an
                            # unhandled exception (ring-only, then the
                            # flight dump persists it)

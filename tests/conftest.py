@@ -46,6 +46,12 @@ os.environ.setdefault('PADDLE_TPU_QUANT_COLLECTIVES', '0')
 # under every trainer test — cluster-obs tests pass cluster_stats= /
 # construct publishers explicitly
 os.environ.setdefault('PADDLE_TPU_CLUSTER_STATS', '0')
+# ...and for the self-healing plan supervisor: an ambient
+# PADDLE_TPU_SUPERVISOR would subscribe an ACTUATOR to every test
+# trainer's event stream (a stray drift_detected could queue a live
+# plan swap mid-test) — supervisor-behavior tests pass supervisor= /
+# construct PlanSupervisor explicitly
+os.environ.setdefault('PADDLE_TPU_SUPERVISOR', '0')
 
 import jax  # noqa: E402
 

@@ -78,7 +78,12 @@ RESILIENCE_KINDS = (
     # joined view blamed, and when the per-rank losses split
     'straggler_suspect', 'rank_divergence',
     # a fused K-chunk that exceeded the armed watchdog budget
-    'fused_clamp')
+    'fused_clamp',
+    # the self-healing actuator (resilience.supervisor): how each
+    # incident terminated (swap/hold/backoff/degraded + stage), and
+    # the applied plan swap itself — the observe->act loop's act half
+    # belongs on the same timeline as the sensor edges that caused it
+    'remediation', 'plan_swap')
 
 # spans (kind='span', name=...) that belong on the resilience
 # timeline: the 2-phase commit barrier wait and the restore itself
@@ -580,7 +585,11 @@ def analyze(events, sources, skew=None):
                   'instr', 'observed_frac',
                   'skew', 'behind', 'hb_stale', 'spread', 'band',
                   'world', 'max_step', 'requested', 'fits',
-                  'suspect'):
+                  'suspect',
+                  'trigger', 'policy', 'outcome', 'stage',
+                  'triggers', 'kinds', 'from_mesh', 'to_mesh',
+                  'assignment', 'candidate_s', 'incumbent_s',
+                  'margin', 'seq'):
             if e.get(k) is not None:
                 row[k] = e[k]
         timeline.append(row)

@@ -97,6 +97,80 @@ def _final_w(steps, world=1, quant=False):
     return w
 
 
+class _SoakMigration:
+    """Duck-typed planner result/candidate for the soak workload: the
+    host loop has no model to shard, so the 'winning plan' is just the
+    world laid out as pure DP — what matters is exercising the full
+    actuation seam (classify -> ladder -> coordinated-reshape
+    restart), not the sharding math the in-process TrainerHost owns."""
+
+    def __init__(self, world):
+        self.mesh_axes = {'dp': int(world)}
+        self.assignment = 'reshape'
+        self.score_us = 1.0
+        self.candidates = [self]
+        self.fallbacks = []
+
+    @property
+    def winner(self):
+        return self
+
+
+class SoakHost:
+    """Rank-0 supervisor host for the soak cluster: the swap under
+    test is the CLUSTER seam — a durable ``reshape_request.json`` the
+    elastic watch loop answers with a coordinated restart (no
+    max_restarts burn, same posture as preemptions).
+
+    The request FILE doubles as the cluster-lifetime exactly-once
+    ledger: it survives the very restart it causes, so when the
+    injected drift re-fires in the next incarnation (the chaos fault
+    ledger flushes best-effort and can lose the record to the
+    restart's SIGTERM) the ladder holds at ``request_swap`` instead of
+    reshape-looping the cluster."""
+
+    def __init__(self, workdir, world):
+        self.workdir = workdir
+        self.world = int(world)
+
+    def calibration(self):
+        return None
+
+    def healthy_devices(self, incident):
+        return list(range(self.world))
+
+    def incumbent(self):
+        return None, None
+
+    def replan(self, devices, calibration):
+        return _SoakMigration(len(devices))
+
+    def precompile(self, plan, devices):
+        pass            # nothing to compile on the host-loop path
+
+    def request_swap(self, plan, devices, incident):
+        from paddle_tpu import telemetry
+        from paddle_tpu.resilience.supervisor import (
+            read_reshape_request, write_reshape_request)
+        if read_reshape_request(self.workdir) is not None:
+            return False        # this cluster already actuated once
+        seq = write_reshape_request(
+            self.workdir, mesh=plan.mesh_axes,
+            env={'PADDLE_TPU_SOAK_RESHAPED': '1'},
+            reason=incident.get('trigger'))
+        telemetry.event('plan_swap', seq=seq,
+                        to_mesh=dict(plan.mesh_axes),
+                        assignment=plan.assignment,
+                        trigger=incident.get('trigger'),
+                        policy=incident.get('policy'))
+        # the restart this request triggers SIGTERMs us before the
+        # JSONL buffer necessarily flushes: dump the flight ring so
+        # load_run_events still sees the swap (and the fault ledger)
+        telemetry.dump_flight(os.path.join(
+            self.workdir, f'flightrec-reshape-{os.getpid()}.json'))
+        return True
+
+
 # =============================================================================
 # worker (one rank of the ChaosCluster)
 # =============================================================================
@@ -146,6 +220,8 @@ def worker_main():
     incarnation = (int(os.environ.get('PADDLE_ELASTIC_RESTART_COUNT',
                                       '0'))
                    + int(os.environ.get('PADDLE_ELASTIC_PREEMPT_COUNT',
+                                        '0'))
+                   + int(os.environ.get('PADDLE_ELASTIC_RESHAPE_COUNT',
                                         '0')))
     # cluster-obs runs flush at a short cadence so stats frames carry
     # fresh rolling windows even on short soaks
@@ -218,6 +294,19 @@ def worker_main():
                      'incarnation': incarnation})))
         acc = telemetry.step_accumulator('soak',
                                          flush_interval=flush_every)
+
+    # -- self-healing plan supervisor (default OFF) ----------------------
+    # ChaosCluster(supervisor=...) arms it via PADDLE_TPU_SUPERVISOR.
+    # Rank 0 runs the actuator against the SoakHost: the ladder's swap
+    # rung writes the coordinated-reshape request the elastic watch
+    # loop (reshape_dir=workdir) answers with a whole-cluster restart.
+    from paddle_tpu.resilience.supervisor import (
+        resolve_supervisor, PlanSupervisor)
+    sup = None
+    sup_cfg = resolve_supervisor(None)
+    if sup_cfg is not None and rank == 0:
+        sup = PlanSupervisor(SoakHost(workdir, world=world),
+                             sup_cfg).start()
 
     ckpt = os.path.join(workdir, 'ckpt')
     w = np.arange(8.0, dtype=np.float32)
@@ -318,6 +407,8 @@ def worker_main():
                     wd.stop()
                 sys.exit(PREEMPTED_EXIT_CODE)
     finally:
+        if sup is not None:
+            sup.stop(timeout=1.0)
         if acc is not None:
             acc.flush()
         if plane is not None:
@@ -361,12 +452,13 @@ def run_soak(args, plan=None, workdir=None, extra_env=None):
     from paddle_tpu.resilience.chaos import ChaosCluster
     from paddle_tpu.resilience import plangen
     quant = bool(getattr(args, 'quant_wire', False))
+    sup = getattr(args, 'supervisor', None) or None
     if plan is None:
         plan = plangen.generate_plan(
             args.seed, args.steps, args.procs, n_faults=args.faults,
             save_every=args.save_every,
             hang_s=4 * args.collective_timeout,
-            quant_wire=quant)
+            quant_wire=quant, supervisor=bool(sup))
     if quant:
         extra_env = dict(extra_env or {},
                          PADDLE_TPU_SOAK_QUANT='int8')
@@ -378,7 +470,7 @@ def run_soak(args, plan=None, workdir=None, extra_env=None):
         watchdog=args.watchdog, deadline_s=args.deadline,
         max_restarts=args.max_restarts,
         jax_distributed=args.jax_distributed,
-        extra_env=extra_env)
+        supervisor=sup, extra_env=extra_env)
     report = cluster.run()
     report['quant_wire'] = quant
     report['violations'] += _check_finals(report, args.steps,
@@ -591,6 +683,16 @@ def main(argv=None):
                          'fault seams drive the quantized payload '
                          'path; the bit-exact final-state reference '
                          'replays the same quantizer')
+    ap.add_argument('--supervisor', default=None,
+                    help='arm the self-healing plan supervisor in '
+                         'the workers (PADDLE_TPU_SUPERVISOR syntax, '
+                         "e.g. '1' or 'cooldown=10,margin=0.2') and "
+                         'add the supervisor-migration coverage '
+                         'class to generated plans: one injected '
+                         'drift on rank 0 plus a SIGKILL one step '
+                         'later (mid-migration crash); the actuated '
+                         'swap is a coordinated-reshape restart, '
+                         'free of the max_restarts budget')
     ap.add_argument('--jax-distributed', action='store_true',
                     help='also jax.distributed-initialize the workers '
                          '(clean plans only: the coordination service '
