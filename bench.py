@@ -999,6 +999,166 @@ def _supervisor_preflight(timeout_s=900):
     return ok, summary
 
 
+def _threads_smoke_child():
+    """--threads-smoke child (forced 8-device CPU mesh): the runtime
+    lock checker's acceptance evidence in one process —
+
+    - ARMED window (analysis.lockcheck.install): a dp=8 trainer runs
+      real steps and the serving engine completes a smoke load while
+      every paddle_tpu-constructed lock is instrumented; the checker
+      must record zero lock-order cycles and zero unguarded accesses,
+      and must neither deadlock nor crash either workload;
+    - UNARMED re-run of the identical trainer: losses must match the
+      armed run bit-exactly (observation must not perturb training).
+
+    Emits one JSON line the parent asserts on."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, distributed as dist
+    from paddle_tpu.analysis import lockcheck
+    from paddle_tpu.distributed import env as dist_env
+    from paddle_tpu.serving import ServingEngine
+
+    rs = np.random.RandomState(1)
+    X = rs.randn(16, 64).astype('float32')
+    Y = rs.randn(16, 64).astype('float32')
+
+    def run_trainer(steps=6):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(64, 256), nn.ReLU(),
+                            nn.Linear(256, 64))
+        opt = paddle.optimizer.Momentum(learning_rate=0.01,
+                                        parameters=net.parameters())
+        from paddle_tpu.parallel import ParallelTrainer
+        tr = ParallelTrainer(net, opt,
+                             lambda o, y: ((o - y) ** 2).mean())
+        return [float(np.asarray(tr.step(X, Y)))
+                for _ in range(steps)]
+
+    out = {'checker_error': None}
+    try:
+        with lockcheck.install() as chk:
+            dist.init_parallel_env(axes={'dp': 8})
+            out['armed_losses'] = run_trainer()
+            model, cfg, load = _serve_setup(smoke=True)
+            eng = ServingEngine(model, cfg)
+            eng.warmup()
+            rep = eng.run(load(seed=3))
+            out['serve_tokens'] = rep['decoded_tokens']
+            out['serve_audit'] = rep['audit']
+            lrep = chk.report()
+            out['locks'] = chk.locks_created
+            out['edges'] = lrep.extras['lockcheck']['edges']
+            out['cycles'] = len(
+                [f for f in lrep if f.rule == 'lock-order-cycle'])
+            out['violations'] = len(
+                [f for f in lrep if f.rule == 'unguarded-access'])
+            out['findings'] = [f.message[:160] for f in lrep]
+    except Exception as e:          # checker or guarded run crashed
+        out['checker_error'] = repr(e)[:300]
+    else:
+        dist_env.set_mesh(None)
+        dist.init_parallel_env(axes={'dp': 8})
+        out['unarmed_losses'] = run_trainer()
+        out['bit_exact'] = (out['armed_losses']
+                            == out['unarmed_losses'])
+    print(json.dumps(out))
+
+
+def _threads_preflight(timeout_s=900):
+    """--threads-smoke gate: the concurrency posture must hold before
+    chip time — (a) the static sweep (tpu_lint --threads) over all of
+    paddle_tpu/ must report zero HIGH findings, and (b) a dp=8
+    trainer plus a serving-engine smoke must complete with the
+    runtime lock checker armed: zero lock-order cycles, zero
+    unguarded accesses, zero checker crashes, and armed-vs-unarmed
+    losses bit-exact (observation never perturbs training).
+
+    Returns (ok, summary).  Infra failures (timeout, child crash)
+    never block the bench — evidence beats a dead gate — but a HIGH
+    lint finding, a cycle, a violation, or a loss mismatch always
+    does."""
+    import subprocess
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    env['XLA_FLAGS'] = ' '.join(
+        [t for t in env.get('XLA_FLAGS', '').split()
+         if not t.startswith('--xla_force_host_platform_device_count')]
+        + ['--xla_force_host_platform_device_count=8'])
+    env['PADDLE_TPU_LOCKCHECK'] = '0'       # the child arms explicitly
+    failures = []
+    summary = {}
+    # -- (a) static sweep: zero HIGH across the package ------------------
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, 'tools', 'tpu_lint.py'),
+             'paddle_tpu/', '--threads', '--json', '--fail-on',
+             'never'],
+            capture_output=True, text=True, timeout=timeout_s,
+            env=env, cwd=repo)
+        # tpu_lint --json pretty-prints one multi-line document (not
+        # the one-line-JSON child protocol _last_json_dict parses)
+        doc = json.loads(proc.stdout)
+    except Exception as e:
+        log(f'threads lint sweep skipped ({e!r})')
+        doc = None
+    if doc is not None:
+        summary['lint'] = {'counts': doc.get('counts'),
+                           'files': (doc.get('extras', {})
+                                     .get('threads', {}).get('files'))}
+        high = (doc.get('counts') or {}).get('high', 0)
+        if high:
+            rules = sorted({f.get('rule') for f in doc.get('findings',
+                                                           ())
+                            if f.get('severity') == 'high'})
+            failures.append(f'{high} HIGH concurrency finding(s) in '
+                            f'paddle_tpu/ ({", ".join(rules)})')
+    # -- (b) armed runtime smoke -----------------------------------------
+    cmd = [sys.executable, os.path.abspath(__file__),
+           '--threads-smoke-child']
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=env)
+        doc = _last_json_dict(proc.stdout)
+    except Exception as e:
+        log(f'threads smoke skipped ({e!r})')
+        doc = {'error': repr(e)[:200]}
+    if doc is None:
+        log(f'threads smoke skipped (no child output, '
+            f'rc={proc.returncode}): {proc.stderr[-300:]}')
+        doc = {'error': f'no output (rc={proc.returncode})'}
+    summary['smoke'] = {k: doc.get(k) for k in
+                        ('locks', 'edges', 'cycles', 'violations',
+                         'serve_tokens', 'bit_exact', 'checker_error',
+                         'error', 'findings')}
+    if doc.get('checker_error'):
+        failures.append('armed run crashed: '
+                        + str(doc['checker_error']))
+    if doc.get('cycles'):
+        failures.append(f'{doc["cycles"]} lock-order cycle(s) under '
+                        'the armed trainer+engine run')
+    if doc.get('violations'):
+        failures.append(f'{doc["violations"]} unguarded cross-thread '
+                        'access(es) under the armed run')
+    if 'bit_exact' in doc and not doc.get('bit_exact'):
+        failures.append('armed vs unarmed trainer losses diverged '
+                        '(observation perturbed training)')
+    if doc.get('serve_audit'):
+        failures.append(f'serve invariants violated under the armed '
+                        f'engine: {doc["serve_audit"]}')
+    summary['failures'] = failures
+    ok = not failures
+    sm = summary.get('smoke', {})
+    log(f'threads preflight: {"ok" if ok else "FAIL"} '
+        f'(high={((summary.get("lint") or {}).get("counts") or {}).get("high")}, '
+        f'locks={sm.get("locks")}, edges={sm.get("edges")}, '
+        f'cycles={sm.get("cycles")}, violations={sm.get("violations")}, '
+        f'bit_exact={sm.get("bit_exact")})')
+    for f in failures:
+        log(f'  {f}')
+    return ok, summary
+
+
 def _plan_preflight(timeout_s=600):
     """--plan-smoke gate: run the auto-sharding planner
     (tools/tpu_lint.py --plan) over the built-in gpt/widedeep/lenet
@@ -2459,6 +2619,19 @@ def main():
     p.add_argument('--supervisor-smoke-child', action='store_true',
                    help='(internal) run the supervisor-smoke '
                         'measurement and emit its JSON')
+    p.add_argument('--threads-smoke', action='store_true',
+                   help='preflight gate: the concurrency posture — '
+                        'the static sweep (tpu_lint --threads) over '
+                        'paddle_tpu/ must report zero HIGH findings, '
+                        'and a dp=8 trainer + serving-engine smoke '
+                        'with the runtime lock checker armed '
+                        '(analysis.lockcheck) must finish with zero '
+                        'lock-order cycles, zero unguarded accesses, '
+                        'zero checker crashes, and bit-exact losses '
+                        'vs the unarmed run')
+    p.add_argument('--threads-smoke-child', action='store_true',
+                   help='(internal) run the threads-smoke armed '
+                        'measurement and emit its JSON')
     p.add_argument('--telemetry-dir', default=None,
                    help='(internal) telemetry JSONL dir for '
                         '--cache-smoke-child / --profile-smoke-child')
@@ -2490,6 +2663,10 @@ def main():
 
     if args.supervisor_smoke_child:
         _supervisor_smoke_child()
+        return
+
+    if args.threads_smoke_child:
+        _threads_smoke_child()
         return
 
     if args.serve_smoke_child:
@@ -2524,6 +2701,26 @@ def main():
     cluster_obs_summary = None
     quant_summary = None
     supervisor_summary = None
+    threads_summary = None
+    if args.threads_smoke:
+        threads_ok, threads_summary = _threads_preflight()
+        if not threads_ok:
+            # a HIGH concurrency finding or an armed-run cycle/
+            # violation means the host runtime can race or deadlock
+            # mid-run on chip — and a loss divergence means the
+            # checker itself perturbs training; fail before burning
+            # chip time
+            print(json.dumps({
+                'metric': METRIC_NAMES['resnet'], 'value': None,
+                'unit': UNITS['resnet'], 'vs_baseline': None,
+                'error': 'threads preflight failed (HIGH concurrency '
+                         'lint finding, lock-order cycle, unguarded '
+                         'cross-thread access, checker crash, or '
+                         'armed-vs-unarmed loss divergence); fix the '
+                         'flagged runtime code or re-run without '
+                         '--threads-smoke',
+                'threads': threads_summary, 'extras': {}}))
+            sys.exit(1)
     if args.supervisor_smoke:
         sup_ok, supervisor_summary = _supervisor_preflight()
         if not sup_ok:
@@ -2807,6 +3004,8 @@ def main():
         out['quant'] = quant_summary
     if supervisor_summary is not None:
         out['supervisor'] = supervisor_summary
+    if threads_summary is not None:
+        out['threads'] = threads_summary
     if preflight_attempts:
         # non-empty only when at least one preflight try failed: the
         # diagnosis (timeout vs crash, rc, stderr tail) per attempt

@@ -63,6 +63,13 @@ from .hlo import (  # noqa: F401
 from . import targets  # noqa: F401
 from . import planner  # noqa: F401
 from .planner import plan_model  # noqa: F401
+from . import threads  # noqa: F401
+from .threads import (  # noqa: F401
+    lint_threads_source, lint_threads_file, lint_threads_sources,
+    THREAD_RULES, register_thread_rule)
+from . import lockcheck  # noqa: F401
+from .lockcheck import (  # noqa: F401
+    LockChecker, resolve_lockcheck)
 
 # the lowered-HLO SPMD audit (post-partitioner: sharding placement,
 # collective cost, per-device peak memory) — the escalation the
@@ -103,7 +110,11 @@ __all__ = ['lint', 'lint_sources', 'lint_layer', 'lint_hlo',
            'lint_source', 'lint_file', 'lint_callable',
            'apply_suppressions', 'amp_audit', 'note_retrace',
            'walker', 'ast_lint', 'hlo', 'costmodel', 'targets',
-           'planner', 'plan_model']
+           'planner', 'plan_model',
+           'threads', 'lint_threads_source', 'lint_threads_file',
+           'lint_threads_sources', 'THREAD_RULES',
+           'register_thread_rule', 'lockcheck', 'LockChecker',
+           'resolve_lockcheck']
 
 
 def _leaf_ranges(example_args):

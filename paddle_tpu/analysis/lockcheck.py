@@ -1,0 +1,404 @@
+"""Opt-in runtime lock checker — lockdep-lite for the host runtime.
+
+The static pass (threads.py) sees what the source *says*; this one
+sees what the threads actually *do*.  Armed (it is OFF by default),
+it patches ``threading.Lock``/``threading.RLock`` so locks
+constructed from paddle_tpu frames are wrapped with instrumented
+proxies that record, per thread, the stack of currently-held locks:
+
+- every "acquire B while holding A" adds an A→B edge to a lock-order
+  graph (nodes are construction sites, lockdep-class style, so all
+  instances from one site share a node); a cycle in that graph is a
+  potential deadlock even if the run never actually deadlocked —
+  reported as a HIGH ``lock-order-cycle`` finding with the
+  first-seen acquisition stacks;
+- ``guard_object(obj, attrs, lock_attr)`` registers live objects
+  whose attributes must only be touched under their lock: any
+  cross-thread access while the lock is not held is a HIGH
+  ``unguarded-access`` finding (the runtime teeth behind the static
+  guarded-by annotations);
+- hold times per lock are aggregated and emitted as one ``lockcheck``
+  telemetry event when the checker disarms.
+
+Posture: the established opt-in shape — ``install()`` (context
+manager / pytest fixture) arms explicitly; ``maybe_install(arg)``
+follows resolve_watchdog's contract (explicit ``False`` beats the
+env, ``None`` lets ``PADDLE_TPU_LOCKCHECK`` decide).  tier-1 pins the
+env to ``0`` (conftest) and the chaos composition test arms it on
+purpose.  The checker itself must never deadlock or crash the run:
+its one internal mutex is a real (unwrapped) lock, taken only for
+short dict updates and never while blocking on a user lock.
+"""
+import os
+import sys
+import threading
+import time
+
+from contextlib import contextmanager
+
+from .findings import Finding, LintReport, HIGH
+
+__all__ = ['LockChecker', 'CheckedLock', 'install', 'maybe_install',
+           'resolve_lockcheck', 'LOCKCHECK_ENV']
+
+LOCKCHECK_ENV = 'PADDLE_TPU_LOCKCHECK'
+
+# the real factories, bound at import time — everything internal to
+# the checker (and the restore path) uses these, never the patched
+# module attributes
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+_OFF_VALUES = ('', '0', 'off', 'false', 'no')
+
+
+def resolve_lockcheck(arg=None):
+    """Shared opt-in posture: explicit False -> off even if the env
+    says on; True -> on; None -> PADDLE_TPU_LOCKCHECK decides."""
+    if arg is False:
+        return False
+    if arg is True:
+        return True
+    return os.environ.get(LOCKCHECK_ENV, '').lower() not in _OFF_VALUES
+
+
+def _site_name(frame):
+    return (f'{os.path.basename(frame.f_code.co_filename)}'
+            f':{frame.f_lineno}')
+
+
+def _short_stack(skip=2, depth=4):
+    """Compact acquisition stack: innermost `depth` frames outside
+    this module."""
+    here = os.path.abspath(__file__)
+    out = []
+    f = sys._getframe(skip)
+    while f is not None and len(out) < depth:
+        if os.path.abspath(f.f_code.co_filename) != here:
+            out.append(f'{os.path.basename(f.f_code.co_filename)}'
+                       f':{f.f_lineno}:{f.f_code.co_name}')
+        f = f.f_back
+    return ' < '.join(out)
+
+
+class CheckedLock:
+    """Instrumented proxy around a real Lock/RLock.  Mirrors the
+    context-manager protocol and forwards everything else (Condition
+    internals like ``_is_owned`` included) to the wrapped lock."""
+
+    def __init__(self, real, checker, name):
+        self._real = real
+        self._checker = checker
+        self.name = name
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._real.acquire(blocking, timeout)
+        if got:
+            self._checker._note_acquire(self)
+        return got
+
+    def release(self):
+        self._checker._note_release(self)
+        self._real.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, name):
+        # locked(), _is_owned(), _release_save(), ... — the wrapped
+        # lock's own protocol keeps working (Condition over a plain
+        # Lock falls back to acquire/release, which stay instrumented)
+        return getattr(self._real, name)
+
+    def __repr__(self):
+        return f'<CheckedLock {self.name} of {self._real!r}>'
+
+
+class LockChecker:
+    """Lock-order graph + guarded-object registry for one armed
+    window."""
+
+    def __init__(self, scope='paddle_tpu', max_findings=200):
+        self.scope = scope          # substring filter on the file
+        self.locks_created = 0      # constructing Lock()/RLock();
+        self.max_findings = max_findings
+        self._meta = _REAL_LOCK()   # internal mutex: short updates only
+        self._tls = threading.local()
+        self._edges = {}            # (a, b) -> first-seen stack pair
+        self._hold = {}             # name -> [count, total_s, max_s]
+        self._violations = []
+        self._vseen = set()
+        self._guarded = []          # (obj, original class)
+
+    # -- wrapping -------------------------------------------------------------
+
+    def wrap(self, lock=None, name=None, rlock=False):
+        """Wrap an existing lock (or make a fresh one) under a stable
+        graph-node name."""
+        real = lock if lock is not None else (
+            _REAL_RLOCK() if rlock else _REAL_LOCK())
+        if name is None:
+            name = _site_name(sys._getframe(1))
+        self.locks_created += 1
+        return CheckedLock(real, self, name)
+
+    def _make_factory(self, rlock):
+        checker = self
+        real = _REAL_RLOCK if rlock else _REAL_LOCK
+        scope = self.scope
+
+        def factory():
+            r = real()
+            if scope is not None:
+                f = sys._getframe(1)
+                if scope not in f.f_code.co_filename:
+                    return r          # foreign lock: stay invisible
+            checker.locks_created += 1
+            return CheckedLock(r, checker,
+                               _site_name(sys._getframe(1)))
+        return factory
+
+    # -- acquisition tracking -------------------------------------------------
+
+    def _held(self):
+        h = getattr(self._tls, 'held', None)
+        if h is None:
+            h = self._tls.held = []
+        return h
+
+    def holds(self, lock):
+        """Does the calling thread currently hold `lock`?"""
+        return any(entry[0] is lock for entry in self._held())
+
+    def _note_acquire(self, lock):
+        held = self._held()
+        if not self.holds(lock):    # re-entrant RLock: no new edges
+            prior = {e[0].name for e in held}
+            prior.discard(lock.name)
+            new = [(p, lock.name) for p in prior
+                   if (p, lock.name) not in self._edges]
+            if new:
+                stack = _short_stack()
+                with self._meta:
+                    for edge in new:
+                        self._edges.setdefault(edge, stack)
+        held.append((lock, time.monotonic()))
+
+    def _note_release(self, lock):
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is lock:
+                _, t0 = held.pop(i)
+                dt = time.monotonic() - t0
+                with self._meta:
+                    st = self._hold.setdefault(lock.name,
+                                               [0, 0.0, 0.0])
+                    st[0] += 1
+                    st[1] += dt
+                    st[2] = max(st[2], dt)
+                return
+        # release of a lock acquired before arming: nothing recorded
+
+    # -- guarded objects ------------------------------------------------------
+
+    def guard_object(self, obj, attrs, lock_attr='_lock'):
+        """Register a live object: accesses to `attrs` from any
+        thread other than the registering one, while `obj.<lock_attr>`
+        is not held, become HIGH ``unguarded-access`` findings.
+        Undone automatically when the checker disarms."""
+        cls = type(obj)
+        checker = self
+        attrset = frozenset(attrs)
+        owner = threading.get_ident()
+
+        def _ga(inner, name):
+            if name in attrset:
+                checker._check_guarded(inner, name, lock_attr, owner)
+            return cls.__getattribute__(inner, name)
+
+        def _sa(inner, name, value):
+            if name in attrset:
+                checker._check_guarded(inner, name, lock_attr, owner)
+            cls.__setattr__(inner, name, value)
+
+        sub = type(cls.__name__, (cls,),
+                   {'__getattribute__': _ga, '__setattr__': _sa})
+        obj.__class__ = sub
+        self._guarded.append((obj, cls))
+        return obj
+
+    def _check_guarded(self, obj, attr, lock_attr, owner):
+        if threading.get_ident() == owner:
+            return                  # cross-thread accesses only
+        try:
+            lock = object.__getattribute__(obj, lock_attr)
+        except AttributeError:
+            return
+        if isinstance(lock, CheckedLock):
+            if self.holds(lock):
+                return
+        else:
+            is_owned = getattr(lock, '_is_owned', None)
+            if is_owned is None or is_owned():
+                return              # plain Lock: holder unknowable
+        # caller site: innermost frame outside this module
+        here = os.path.abspath(__file__)
+        file, line = None, None
+        f = sys._getframe(1)
+        while f is not None:
+            if os.path.abspath(f.f_code.co_filename) != here:
+                file, line = f.f_code.co_filename, f.f_lineno
+                break
+            f = f.f_back
+        key = (type(obj).__name__, attr, file, line)
+        with self._meta:
+            if key in self._vseen or \
+                    len(self._violations) >= self.max_findings:
+                return
+            self._vseen.add(key)
+            self._violations.append(Finding(
+                'unguarded-access', HIGH,
+                f'{type(obj).__name__}.{attr} accessed from thread '
+                f'{threading.current_thread().name!r} without '
+                f'holding {lock_attr}',
+                file=file, line=line, origin='runtime'))
+
+    def _unguard_all(self):
+        for obj, cls in self._guarded:
+            try:
+                obj.__class__ = cls
+            except TypeError:       # pragma: no cover - layout change
+                pass
+        self._guarded = []
+
+    # -- reporting ------------------------------------------------------------
+
+    def cycles(self):
+        """Simple cycles in the lock-order graph (each a node list
+        with the closing node repeated), deduped by node set."""
+        with self._meta:
+            adj = {}
+            for a, b in self._edges:
+                adj.setdefault(a, []).append(b)
+        out, seen_sets = [], set()
+        for start in sorted(adj):
+            path, on_path = [], set()
+
+            def dfs(n, depth=0):
+                if n in on_path:
+                    cyc = path[path.index(n):] + [n]
+                    key = frozenset(cyc)
+                    if key not in seen_sets:
+                        seen_sets.add(key)
+                        out.append(cyc)
+                    return
+                if depth > 64:      # graphs here are tiny; stay safe
+                    return
+                path.append(n)
+                on_path.add(n)
+                for m in adj.get(n, ()):
+                    dfs(m, depth + 1)
+                path.pop()
+                on_path.discard(n)
+
+            dfs(start)
+        return out
+
+    def hold_stats(self):
+        with self._meta:
+            return {
+                name: {'count': c,
+                       'total_ms': round(tot * 1e3, 3),
+                       'max_ms': round(mx * 1e3, 3)}
+                for name, (c, tot, mx) in sorted(self._hold.items())}
+
+    def report(self, name='lockcheck'):
+        """LintReport (origin='runtime'): lock-order cycles as HIGH
+        potential deadlocks + recorded unguarded accesses, with the
+        hold-time stats in extras."""
+        rep = LintReport(name=name)
+        with self._meta:
+            edges = dict(self._edges)
+        for cyc in self.cycles():
+            stacks = '; '.join(
+                f'{a}->{b} @ {edges.get((a, b), "?")}'
+                for a, b in zip(cyc, cyc[1:]))
+            rep.findings.append(Finding(
+                'lock-order-cycle', HIGH,
+                'potential deadlock: lock-order cycle '
+                + ' -> '.join(cyc)
+                + f' (first-seen acquisitions: {stacks})',
+                origin='runtime'))
+        with self._meta:
+            rep.findings.extend(self._violations)
+        rep.extras['lockcheck'] = {
+            'locks': self.locks_created,
+            'edges': len(edges),
+            'cycles': len(rep.findings) - len(self._violations),
+            'hold': self.hold_stats(),
+        }
+        return rep
+
+    def emit_telemetry(self):
+        """One `lockcheck` event summarizing the armed window."""
+        from .. import telemetry
+        hold = self.hold_stats()
+        worst = sorted(hold.items(), key=lambda kv: -kv[1]['max_ms'])
+        telemetry.event(
+            'lockcheck',
+            locks=self.locks_created, edges=len(self._edges),
+            cycles=len(self.cycles()),
+            violations=len(self._violations),
+            max_hold_ms=(worst[0][1]['max_ms'] if worst else 0.0),
+            max_hold_lock=(worst[0][0] if worst else None))
+
+
+# -- arming -------------------------------------------------------------------
+
+_install_mutex = _REAL_LOCK()
+_active = [None]
+
+
+@contextmanager
+def install(scope='paddle_tpu', checker=None, emit=True):
+    """Arm the checker: patch threading.Lock/RLock so locks
+    constructed (from `scope` frames) inside the window are
+    instrumented.  Restores the factories, un-guards registered
+    objects, and emits the `lockcheck` telemetry event on exit —
+    exceptions included."""
+    chk = checker if checker is not None else LockChecker(scope=scope)
+    with _install_mutex:
+        if _active[0] is not None:
+            raise RuntimeError('lockcheck is already installed')
+        _active[0] = chk
+        threading.Lock = chk._make_factory(rlock=False)
+        threading.RLock = chk._make_factory(rlock=True)
+    try:
+        yield chk
+    finally:
+        with _install_mutex:
+            threading.Lock = _REAL_LOCK
+            threading.RLock = _REAL_RLOCK
+            _active[0] = None
+        chk._unguard_all()
+        if emit:
+            try:
+                chk.emit_telemetry()
+            except Exception:       # never crash the guarded run
+                pass
+
+
+@contextmanager
+def maybe_install(arg=None, scope='paddle_tpu'):
+    """``install()`` when resolve_lockcheck(arg) says on, else a
+    no-op context yielding None — the env-gated entry point."""
+    if not resolve_lockcheck(arg):
+        yield None
+        return
+    with install(scope=scope) as chk:
+        yield chk

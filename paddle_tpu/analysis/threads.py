@@ -1,0 +1,544 @@
+"""AST concurrency lint: the host-thread side of the sync-free posture.
+
+The jaxpr/HLO tiers check what XLA compiles; this tier checks what the
+*host threads* do around it.  The runtime grew 15+ daemon threads and
+15+ locks (watchdog, plan supervisor, metrics server, cluster
+aggregator, chunk prefetcher, DataLoader workers) and every recent
+review pass caught a real race by hand — this registry makes those
+review checks mechanical.  Three rules, all ``origin='ast'``:
+
+``guarded-by``
+    Classes annotate shared attributes either with a trailing
+    ``# guarded-by: _lock`` comment on the attribute's assignment
+    line, or with a class-level ``_GUARDED_BY = {'attr': '_lock'}``
+    map.  Reads/writes of an annotated attribute outside a lexical
+    ``with self._lock:`` flag HIGH when the enclosing method is
+    reachable off a thread entry point (a ``threading.Thread(target=
+    self.m)`` target or a ``subscribe(self.m)`` callback — subscriber
+    callbacks run on whatever thread emitted the event), WARN
+    otherwise.  A ``# locked-by: _lock`` comment on a ``def`` line
+    declares the whole method runs with the lock already held (the
+    per-kind handler pattern: dispatched under the caller's ``with``)
+    — that is the rule refinement for the common false positive, not
+    a suppression.  ``__init__`` is exempt (construction
+    happens-before publication).
+
+``blocking-under-lock``
+    ``block_until_ready`` / ``device_put`` / ``.post(`` / file IO /
+    ``time.sleep`` lexically inside a ``with <lock>:`` body.  HIGH
+    when the enclosing class is a Recorder/aggregator/publisher (the
+    hot telemetry locks sit on every event emit — blocking there
+    stalls the train loop), WARN elsewhere.
+
+``daemon-thread-lifecycle``
+    Every ``threading.Thread(daemon=True)`` start site must have a
+    reachable stop/join path: a ``.join(`` on the thread in the
+    enclosing scope, or — for ``self._thread``-style ownership — a
+    class method from the known stop registry (``stop``, ``close``,
+    ``stop_watchdog``, ``stop_supervisor``, ...).  Else WARN: a
+    daemon thread with no shutdown path leaks past its owner's
+    lifetime (parked on a bounded queue, holding batch memory).
+
+Suppression uses the established grammar: ``# tpu-lint:
+disable=guarded-by`` on the finding's line or its enclosing ``def``
+line (see ast_lint).  Everything here is pure source analysis — no
+imports, no execution — so the CLI sweep (``tpu_lint --threads``) and
+the tier-1 self-lint gate run it over all of ``paddle_tpu/``.
+"""
+import ast
+import linecache
+import os
+import re
+
+from .findings import Finding, LintReport, HIGH, WARN, INFO
+from .ast_lint import (_is_suppressed, _def_spans,
+                       _enclosing_def_lines, _dotted_last)
+
+__all__ = ['lint_threads_source', 'lint_threads_file',
+           'lint_threads_sources', 'THREAD_RULES',
+           'register_thread_rule', 'BLOCKING_UNDER_LOCK',
+           'STOP_METHODS', 'HOT_CLASS_MARKERS']
+
+_GUARD_RE = re.compile(r'#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)')
+_LOCKED_RE = re.compile(r'#\s*locked-by:\s*([A-Za-z_][A-Za-z0-9_]*)')
+_SELF_ASSIGN_RE = re.compile(
+    r'self\.([A-Za-z_][A-Za-z0-9_]*)\s*(?:[-+*/|&^]|//|>>|<<)?=(?!=)')
+
+# method names that block (or can block) the calling thread.  `.get`/
+# `.put`/`.join` are deliberately absent: dict.get / str.join noise
+# would drown the signal.
+BLOCKING_UNDER_LOCK = {
+    'block_until_ready',    # device sync
+    'device_put',           # host->device transfer
+    'sleep',                # time.sleep
+    'post', 'post_stats',   # transport/KV publish (network RTT)
+    'urlopen', 'request',   # HTTP
+}
+
+# classes whose locks sit on the per-event hot path: blocking under
+# them stalls every emitter (the train loop included) -> HIGH
+HOT_CLASS_MARKERS = ('Recorder', 'Aggregator', 'Publisher')
+
+# known stop/teardown entry points: a daemon thread stored on `self`
+# is considered owned when its class exposes one of these (the
+# registry the lifecycle rule checks before demanding a literal join)
+STOP_METHODS = {
+    'stop', 'close', 'shutdown', 'terminate', 'uninstall',
+    'stop_watchdog', 'stop_supervisor', 'stop_all', '__exit__',
+}
+
+THREAD_RULES = {}
+
+
+def register_thread_rule(rule_id, severity):
+    """Same decorator shape as rules.register_rule: registry maps
+    rule id -> (default severity, fn(ctx) -> findings)."""
+    def deco(fn):
+        THREAD_RULES[rule_id] = (severity, fn)
+        return fn
+    return deco
+
+
+# -- module context -----------------------------------------------------------
+
+def _body_start(fn):
+    """First body line of a def — comment scans for `# locked-by`
+    cover the whole (possibly multi-line) signature."""
+    return fn.body[0].lineno if fn.body else fn.lineno + 1
+
+
+class _FuncScope:
+    __slots__ = ('node', 'cls', 'start', 'end')
+
+    def __init__(self, node, cls):
+        self.node = node
+        self.cls = cls          # enclosing ClassDef or None
+        self.start = node.lineno
+        self.end = getattr(node, 'end_lineno', node.lineno)
+
+
+class _Ctx:
+    """Parsed module + line-comment annotations, shared by all rules."""
+
+    def __init__(self, tree, src, filename):
+        self.tree = tree
+        self.filename = filename
+        self.lines = src.splitlines()
+        # line -> annotation payload
+        self.guard_at = {}
+        self.locked_at = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = _GUARD_RE.search(text)
+            if m:
+                self.guard_at[i] = m.group(1)
+            m = _LOCKED_RE.search(text)
+            if m:
+                self.locked_at[i] = m.group(1)
+        # scopes: every def, with its enclosing class (if any)
+        self.funcs = []
+        self.classes = []
+        self._index(tree.body, None)
+
+    def _index(self, body, cls):
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                self.classes.append(node)
+                self._index(node.body, node)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                self.funcs.append(_FuncScope(node, cls))
+                self._index(node.body, None)
+            elif hasattr(node, 'body'):
+                self._index(node.body, cls)
+                for attr in ('orelse', 'finalbody'):
+                    self._index(getattr(node, attr, []) or [], cls)
+                for h in getattr(node, 'handlers', []) or []:
+                    self._index(h.body, cls)
+
+    def enclosing_func(self, line):
+        """Innermost def scope containing `line` (None at module
+        level)."""
+        best = None
+        for fs in self.funcs:
+            if fs.start <= line <= fs.end:
+                if best is None or fs.start > best.start:
+                    best = fs
+        return best
+
+    def locked_by(self, fn):
+        """Lock names declared via `# locked-by:` on the def's
+        signature lines."""
+        out = set()
+        for ln in range(fn.lineno, _body_start(fn)):
+            if ln in self.locked_at:
+                out.add(self.locked_at[ln])
+        return out
+
+
+def _walk_skip_defs(node):
+    """Walk `node`'s subtree but do not descend into nested function
+    definitions (their bodies run later, not under the current
+    with/lock)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _self_attr(node):
+    """'x' for an `self.x` Attribute expression, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == 'self':
+        return node.attr
+    return None
+
+
+def _with_lock_spans(fn):
+    """[(lock_expr_name, start, end)] for every `with <lock>:` inside
+    `fn`.  `self._lock` yields '_lock'; a bare name yields that name.
+    Anything whose last segment doesn't look lock-ish is skipped."""
+    spans = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                expr = item.context_expr
+                name = _self_attr(expr)
+                if name is None and isinstance(expr, ast.Name):
+                    name = expr.id
+                if name is None and isinstance(expr, ast.Attribute):
+                    name = expr.attr
+                if name is None:
+                    continue
+                spans.append((name, node.lineno,
+                              getattr(node, 'end_lineno', node.lineno)))
+    return spans
+
+
+# -- per-class model (guarded-by) ---------------------------------------------
+
+class _ClassModel:
+    def __init__(self, cls, ctx):
+        self.node = cls
+        self.name = cls.name
+        self.methods = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.guarded = {}
+        self._collect_guard_map()
+        self._collect_guard_comments(ctx)
+        self.entry_points = self._entry_points()
+        self.reachable = self._closure(self.entry_points)
+
+    def _collect_guard_map(self):
+        for node in self.node.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == '_GUARDED_BY' \
+                    and isinstance(node.value, ast.Dict):
+                for k, v in zip(node.value.keys, node.value.values):
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(v, ast.Constant) and \
+                            isinstance(k.value, str) and \
+                            isinstance(v.value, str):
+                        self.guarded[k.value] = v.value
+
+    def _collect_guard_comments(self, ctx):
+        end = getattr(self.node, 'end_lineno', self.node.lineno)
+        for ln in range(self.node.lineno, end + 1):
+            lock = ctx.guard_at.get(ln)
+            if lock is None:
+                continue
+            m = _SELF_ASSIGN_RE.search(ctx.lines[ln - 1])
+            if m:
+                self.guarded[m.group(1)] = lock
+
+    def _entry_points(self):
+        """Method names handed to Thread(target=...) or subscribe(...)
+        anywhere in the class — code that runs on another thread."""
+        out = set()
+        for meth in self.methods.values():
+            for node in ast.walk(meth):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _dotted_last(node.func)
+                if callee == 'Thread':
+                    for kw in node.keywords:
+                        if kw.arg == 'target':
+                            t = _self_attr(kw.value)
+                            if t:
+                                out.add(t)
+                elif callee == 'subscribe':
+                    for a in node.args:
+                        t = _self_attr(a)
+                        if t:
+                            out.add(t)
+        return out
+
+    def _closure(self, seeds):
+        """Transitive closure of `seeds` over the self.m() call
+        graph."""
+        calls = {}
+        for name, meth in self.methods.items():
+            callees = set()
+            for node in ast.walk(meth):
+                if isinstance(node, ast.Call):
+                    t = _self_attr(node.func)
+                    if t and t in self.methods:
+                        callees.add(t)
+            calls[name] = callees
+        seen = set()
+        frontier = [s for s in seeds if s in self.methods]
+        while frontier:
+            m = frontier.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            frontier.extend(calls.get(m, ()))
+        return seen
+
+
+@register_thread_rule('guarded-by', HIGH)
+def check_guarded_by(ctx):
+    findings = []
+    for cls in ctx.classes:
+        model = _ClassModel(cls, ctx)
+        if not model.guarded:
+            continue
+        for mname, meth in model.methods.items():
+            if mname == '__init__':
+                continue
+            held_whole = ctx.locked_by(meth)
+            spans = _with_lock_spans(meth)
+            seen = set()
+            for node in ast.walk(meth):
+                attr = _self_attr(node)
+                if attr is None or attr not in model.guarded:
+                    continue
+                lock = model.guarded[attr]
+                if lock in held_whole:
+                    continue
+                if any(n == lock and s <= node.lineno <= e
+                       for n, s, e in spans):
+                    continue
+                key = (attr, node.lineno)
+                if key in seen:
+                    continue
+                seen.add(key)
+                hot = mname in model.reachable
+                sev = HIGH if hot else WARN
+                why = ('reachable from a thread entry point '
+                       f'({", ".join(sorted(model.entry_points))})'
+                       if hot else 'not provably single-threaded')
+                findings.append(Finding(
+                    'guarded-by', sev,
+                    f'{model.name}.{mname}: self.{attr} is guarded by '
+                    f"self.{lock} but accessed outside 'with "
+                    f"self.{lock}' ({why}). Take the lock, or mark "
+                    f"the method '# locked-by: {lock}' if every "
+                    'caller already holds it.',
+                    file=ctx.filename, line=node.lineno, origin='ast'))
+    return findings
+
+
+# -- blocking-call-under-lock -------------------------------------------------
+
+def _is_blocking_call(node):
+    """(label, True) when `node` is a call that can block the calling
+    thread: the registry methods, builtin open(), or time.sleep."""
+    if not isinstance(node, ast.Call):
+        return None
+    if isinstance(node.func, ast.Name):
+        if node.func.id == 'open':
+            return 'open() [file IO]'
+        return None
+    name = _dotted_last(node.func)
+    if name in BLOCKING_UNDER_LOCK:
+        return f'.{name}()'
+    return None
+
+
+@register_thread_rule('blocking-under-lock', HIGH)
+def check_blocking_under_lock(ctx):
+    findings = []
+    for fs in ctx.funcs:
+        cls_name = fs.cls.name if fs.cls is not None else None
+        hot = bool(cls_name) and any(
+            m in cls_name for m in HOT_CLASS_MARKERS)
+        for node in ast.walk(fs.node):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            lock_names = []
+            for item in node.items:
+                name = _self_attr(item.context_expr)
+                if name is None and isinstance(item.context_expr,
+                                               ast.Name):
+                    name = item.context_expr.id
+                if name and 'lock' in name.lower():
+                    lock_names.append(name)
+            if not lock_names:
+                continue
+            for sub in _walk_skip_defs(node):
+                label = _is_blocking_call(sub)
+                if label is None:
+                    continue
+                sev = HIGH if hot else WARN
+                where = f'{cls_name}.{fs.node.name}' if cls_name \
+                    else fs.node.name
+                hint = ('every event emitter (the train loop '
+                        'included) serializes behind this lock'
+                        if hot else 'holders block waiters for the '
+                        'full call')
+                findings.append(Finding(
+                    'blocking-under-lock', sev,
+                    f'{where}: {label} inside '
+                    f"'with {'/'.join(lock_names)}' — {hint}. Move "
+                    'the blocking call outside the critical section '
+                    '(snapshot under the lock, act after release).',
+                    file=ctx.filename, line=sub.lineno, origin='ast'))
+    return findings
+
+
+# -- daemon lifecycle ---------------------------------------------------------
+
+def _is_thread_join(node):
+    """A Call that plausibly joins a thread: `x.join()`,
+    `x.join(timeout)`, `x.join(timeout=..)` — excludes str.join
+    (exactly one non-numeric positional) and os.path.join."""
+    if not isinstance(node, ast.Call) or \
+            not isinstance(node.func, ast.Attribute) or \
+            node.func.attr != 'join':
+        return False
+    base = node.func.value
+    if isinstance(base, ast.Constant):          # 'sep'.join(...)
+        return False
+    if isinstance(base, ast.Attribute) and base.attr == 'path':
+        return False                            # os.path.join(...)
+    if len(node.args) > 1:
+        return False
+    if node.args:
+        a = node.args[0]
+        if not (isinstance(a, ast.Constant)
+                and isinstance(a.value, (int, float))) and \
+                not isinstance(a, (ast.Name, ast.Attribute)):
+            return False
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            return False
+    return True
+
+
+def _contains_join(node):
+    return any(_is_thread_join(n) for n in ast.walk(node))
+
+
+@register_thread_rule('daemon-thread-lifecycle', WARN)
+def check_daemon_lifecycle(ctx):
+    findings = []
+    # map Thread(...) call -> how it is bound (self attr / local / bare)
+    assigned_self = {}          # id(call) -> attr name
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                _dotted_last(node.value.func) == 'Thread':
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr:
+                    assigned_self[id(node.value)] = attr
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and _dotted_last(node.func) == 'Thread'):
+            continue
+        daemon = any(
+            kw.arg == 'daemon' and isinstance(kw.value, ast.Constant)
+            and kw.value.value is True for kw in node.keywords)
+        if not daemon:
+            continue
+        fs = ctx.enclosing_func(node.lineno)
+        ok = False
+        if id(node) in assigned_self and fs is not None and \
+                fs.cls is not None:
+            cls = fs.cls
+            ok = _contains_join(cls) or any(
+                isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n.name in STOP_METHODS for n in cls.body)
+        elif fs is not None:
+            ok = _contains_join(fs.node)
+        else:
+            ok = _contains_join(ctx.tree)       # module-level start
+        if ok:
+            continue
+        findings.append(Finding(
+            'daemon-thread-lifecycle', WARN,
+            'threading.Thread(daemon=True) started here has no '
+            'reachable stop/join path (no .join() in the owning '
+            'scope, no stop-registry method on the owning class). '
+            'Daemon threads with no shutdown path leak past their '
+            "owner's lifetime — add a sentinel/stop flag and a "
+            'bounded join.',
+            file=ctx.filename, line=node.lineno, origin='ast'))
+    return findings
+
+
+# -- entry points -------------------------------------------------------------
+
+def lint_threads_source(src, filename='<source>', disable=(),
+                        apply_suppress=True):
+    """Run the concurrency rules on python source text; returns a
+    list of Findings (origin='ast')."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding('parse-error', INFO,
+                        f'could not parse: {e}', file=filename,
+                        line=getattr(e, 'lineno', None), origin='ast')]
+    ctx = _Ctx(tree, src, filename)
+    findings = []
+    for rule_id, (_sev, fn) in THREAD_RULES.items():
+        if rule_id in disable:
+            continue
+        findings.extend(fn(ctx))
+    if apply_suppress:
+        spans = _def_spans(tree)
+        findings = [
+            f for f in findings
+            if not _is_suppressed(f.rule, filename, f.line,
+                                  _enclosing_def_lines(spans, f.line))]
+    findings.sort(key=lambda f: (f.line or 0))
+    return findings
+
+
+def lint_threads_file(path, disable=()):
+    with open(path, 'r', encoding='utf-8') as fh:
+        src = fh.read()
+    linecache.checkcache(path)
+    return lint_threads_source(src, filename=path, disable=disable)
+
+
+def _iter_py_files(paths):
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if not d.startswith('.')
+                                 and d != '__pycache__')
+                for f in sorted(files):
+                    if f.endswith('.py'):
+                        yield os.path.join(root, f)
+        elif p.endswith('.py'):
+            yield p
+
+
+def lint_threads_sources(paths, disable=()):
+    """Sweep files/directories with the concurrency rules; returns a
+    LintReport (what ``tpu_lint --threads`` and the tier-1 self-lint
+    gate run)."""
+    rep = LintReport(name='threads')
+    n_files = 0
+    for path in _iter_py_files(paths):
+        n_files += 1
+        rep.findings.extend(lint_threads_file(path, disable=disable))
+    rep.extras['threads'] = {'files': n_files,
+                             'rules': sorted(THREAD_RULES)}
+    return rep

@@ -341,3 +341,8 @@ class ChunkPrefetcher:
                     self._q.get_nowait()
             except queue.Empty:
                 pass
+            # bounded join: the producer's put-poll loop re-checks
+            # _closed every 0.1s, so it exits within one poll tick —
+            # the timeout only guards against a stage_fn wedged on a
+            # device transfer
+            self._thread.join(timeout=2.0)

@@ -494,7 +494,19 @@ class DataLoader:
             work_q.put((pos, indices))
         n_batches = work_q.qsize()
         results = {}
-        lock = threading.Lock()
+        stop = threading.Event()
+
+        def put(item):
+            # bounded put that gives up once the consumer abandons the
+            # generator — a worker parked forever on a full out_q is an
+            # orphan daemon thread
+            while not stop.is_set():
+                try:
+                    out_q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def worker(wid):
             try:
@@ -511,37 +523,48 @@ class DataLoader:
                         pos, _ = work_q.get_nowait()
                     except queue.Empty:
                         return
-                    out_q.put((pos, e))
-            while True:
+                    if not put((pos, e)):
+                        return
+            while not stop.is_set():
                 try:
                     pos, indices = work_q.get_nowait()
                 except queue.Empty:
                     return
                 try:
-                    out_q.put((pos, self._fetch(indices)))
+                    item = self._fetch(indices)
                 except Exception as e:  # surface in main thread
-                    out_q.put((pos, e))
+                    item = e
+                if not put((pos, item)):
+                    return
 
         threads = [threading.Thread(target=worker, args=(w,), daemon=True)
                    for w in range(self.num_workers)]
         for t in threads:
             t.start()
-        # re-order: batches may finish out of order; emit sequentially
-        next_pos = 0
-        received = 0
-        while next_pos < n_batches:
-            if next_pos in results:
-                item = results.pop(next_pos)
-            else:
-                pos, item = out_q.get()
-                received += 1
-                if pos != next_pos:
-                    results[pos] = item
-                    continue
-            if isinstance(item, Exception):
-                raise item
-            yield self._wrap(item)
-            next_pos += 1
+        try:
+            # re-order: batches may finish out of order; emit sequentially
+            next_pos = 0
+            received = 0
+            while next_pos < n_batches:
+                if next_pos in results:
+                    item = results.pop(next_pos)
+                else:
+                    pos, item = out_q.get()
+                    received += 1
+                    if pos != next_pos:
+                        results[pos] = item
+                        continue
+                if isinstance(item, Exception):
+                    raise item
+                yield self._wrap(item)
+                next_pos += 1
+        finally:
+            stop.set()
+            # workers poll `stop` on every queue op, so they exit
+            # within one 0.1s tick; the timeout only guards a
+            # __getitem__ wedged mid-fetch
+            for t in threads:
+                t.join(timeout=2.0)
 
     def _iter_native(self):
         """Workers pack collated batches into the C++ in-order ring
@@ -598,7 +621,12 @@ class DataLoader:
         try:
             yield from self._consume_ring(ring, n_batches)
         finally:
+            # close() makes every blocked ring.push return False, so
+            # the workers fall out of their claim loops — then a
+            # bounded join reaps them (no orphan daemon threads)
             ring.close()
+            for t in threads:
+                t.join(timeout=2.0)
 
     def _consume_ring(self, ring, n_batches, pending_error=None):
         """Shared consumer side of the in-order native ring: pop,
@@ -767,7 +795,10 @@ class DataLoader:
                     yield from self._consume_ring(ring, n_batches,
                                                   drain_err)
                 finally:
+                    # close() unblocks a drain parked on ring.push (it
+                    # returns False), so the bounded join reaps it
                     ring.close()
+                    t.join(timeout=2.0)
             else:
                 for _, payload in ordered_payloads():
                     # bytearray copy: frombuffer over the queue's bytes
@@ -837,7 +868,8 @@ class DataLoader:
             finally:
                 put(_SENTINEL)
 
-        threading.Thread(target=producer, daemon=True).start()
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
         _perf = time.perf_counter
         try:
             while True:
@@ -863,6 +895,9 @@ class DataLoader:
                     out_q.get_nowait()
             except queue.Empty:
                 pass
+            # producer's put-poll re-checks `closed` every 0.1s; the
+            # timeout only guards a device_put wedged mid-transfer
+            t.join(timeout=2.0)
 
     def _telemetry_iter(self, inner):
         """Time each dequeue — the HOST-WAIT gauge: how long the

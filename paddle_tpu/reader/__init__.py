@@ -173,6 +173,10 @@ def buffered(reader, size):
             # consumer abandoned early (firstn/zip/early-stop): release
             # the producer instead of leaving it parked on a full queue
             stop.set()
+            # bounded join — the producer's put-poll re-checks `stop`
+            # every 0.1s; the timeout only guards a source reader
+            # wedged mid-next()
+            t.join(timeout=2.0)
 
     return buffered_reader
 
@@ -232,9 +236,11 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                     _put_or_stop(out_q, end_token, stop)
                     return
 
-        threading.Thread(target=feed, daemon=True).start()
-        for _ in range(process_num):
-            threading.Thread(target=work, daemon=True).start()
+        threads = [threading.Thread(target=feed, daemon=True)]
+        threads.extend(threading.Thread(target=work, daemon=True)
+                       for _ in range(process_num))
+        for t in threads:
+            t.start()
 
         finished = 0
         try:
@@ -269,6 +275,10 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                     next_i += 1
         finally:
             stop.set()
+            # feeder and workers all poll `stop` on their queue ops, so
+            # they exit within one 0.1s tick — bounded join, no leak
+            for t in threads:
+                t.join(timeout=2.0)
 
     return xreader
 
@@ -296,8 +306,10 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             finally:
                 _put_or_stop(q, end_token, stop)
 
-        for r in readers:
-            threading.Thread(target=drain, args=(r,), daemon=True).start()
+        threads = [threading.Thread(target=drain, args=(r,), daemon=True)
+                   for r in readers]
+        for t in threads:
+            t.start()
         finished = 0
         try:
             while finished < len(readers):
@@ -310,5 +322,9 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
                     yield item[1]
         finally:
             stop.set()
+            # drainers poll `stop` on put, so this completes within one
+            # 0.1s tick per thread — bounded join, no orphan threads
+            for t in threads:
+                t.join(timeout=2.0)
 
     return mp_reader

@@ -277,43 +277,55 @@ class PlanSupervisor:
         self.host = host
         self.config = config or SupervisorConfig()
         self._q = queue.Queue()
-        self._thread = None
+        # _lock covers the state shared between the worker thread and
+        # whoever calls start()/stop() or reads the counters (bench,
+        # tests, the trainer's teardown).  Held for dict/counter
+        # updates only — never across host calls or joins.
+        self._lock = threading.Lock()
+        self._thread = None         # guarded-by: _lock
         self._stop = threading.Event()
-        self._cooldown_until = 0.0
-        self._subscribed = False
-        self.swaps = 0              # actuated plan swaps (lifetime)
-        self.incidents = []         # terminal remediation records
-        self._suppressed = 0
+        self._cooldown_until = 0.0  # guarded-by: _lock
+        self._subscribed = False    # guarded-by: _lock
+        self.swaps = 0              # guarded-by: _lock (lifetime swaps)
+        self.incidents = []         # guarded-by: _lock (terminal recs)
+        self._suppressed = 0        # guarded-by: _lock
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
         """Subscribe to the recorder and start the worker thread.
-        Idempotent; returns self."""
-        if self._thread is not None and self._thread.is_alive():
-            return self
+        Idempotent (and safe against concurrent start/stop); returns
+        self."""
         from ..telemetry import get_recorder
-        self._stop.clear()
-        if not self._subscribed:
-            get_recorder().subscribe(self._on_event)
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop.clear()
+            subscribe = not self._subscribed
             self._subscribed = True
-        self._thread = threading.Thread(
-            target=self._run, name='plan-supervisor', daemon=True)
-        self._thread.start()
+            t = self._thread = threading.Thread(
+                target=self._run, name='plan-supervisor', daemon=True)
+        if subscribe:
+            get_recorder().subscribe(self._on_event)
+        t.start()
         return self
 
     def stop(self, timeout=5.0):
         """Unsubscribe and stop the worker.  Training continues
         untouched — an already-queued swap still applies (the trainer
         owns it), but no new incident is ever processed."""
-        if self._subscribed:
+        with self._lock:
+            unsub = self._subscribed
+            self._subscribed = False
+            t, self._thread = self._thread, None
+        if unsub:
             from ..telemetry import get_recorder
             try:
                 get_recorder().unsubscribe(self._on_event)
             except Exception:
                 pass
-            self._subscribed = False
         self._stop.set()
-        t, self._thread = self._thread, None
+        # join OUTSIDE the lock: a worker parked in _handle must be
+        # able to take _lock to finish its incident while we wait
         if t is not None and t.is_alive() \
                 and t is not threading.current_thread():
             t.join(timeout)
@@ -364,10 +376,14 @@ class PlanSupervisor:
     def _handle(self, first):
         cfg = self.config
         now = _MONO()
-        if now < self._cooldown_until:
+        with self._lock:
+            cooled = now < self._cooldown_until
+        if cooled:
             # inside the cooldown: the incident already actuated (or
             # terminally resolved); count, don't act
-            self._suppressed += 1 + self._qsize_drain()
+            n = 1 + self._qsize_drain()
+            with self._lock:
+                self._suppressed += n
             return
         triggers = [first] + self._drain(now + cfg.debounce_s)
         incident = {
@@ -377,11 +393,15 @@ class PlanSupervisor:
             'kinds': sorted({t.get('kind') for t in triggers}),
             'data': triggers,
         }
-        self._suppressed = 0
+        with self._lock:
+            self._suppressed = 0
+        # the ladder (planner re-entry, AOT compile) runs UNLOCKED —
+        # holding _lock across it would park stop() for minutes
         outcome = self._remediate(incident)
-        self._cooldown_until = _MONO() + cfg.cooldown_s
         incident['outcome'] = outcome
-        self.incidents.append(incident)
+        with self._lock:
+            self._cooldown_until = _MONO() + cfg.cooldown_s
+            self.incidents.append(incident)
 
     def _qsize_drain(self):
         n = 0
@@ -407,7 +427,9 @@ class PlanSupervisor:
         policy = incident['policy']
         if policy == 'backoff':
             return self._terminal(incident, 'backoff')
-        if cfg.max_swaps is not None and self.swaps >= cfg.max_swaps:
+        with self._lock:
+            swaps = self.swaps
+        if cfg.max_swaps is not None and swaps >= cfg.max_swaps:
             return self._terminal(incident, 'hold',
                                   reason='max_swaps reached')
         host = self.host
@@ -469,7 +491,8 @@ class PlanSupervisor:
         except Exception as e:
             return self._terminal(incident, 'degraded', stage='swap',
                                   error=repr(e))
-        self.swaps += 1
+        with self._lock:
+            self.swaps += 1
         return self._terminal(
             incident, 'swap', mesh=dict(cand.mesh_axes),
             assignment=cand.assignment,

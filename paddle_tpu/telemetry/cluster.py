@@ -139,22 +139,25 @@ class ClusterPublisher:
         self._lock = threading.RLock()
         self._recorder = recorder
         self._installed = False
-        # rolling state (all host-side floats; fed from flushed rows)
-        self.step_ms = RollingWindow(window_s)
-        self.wait_ms = RollingWindow(window_s)
-        self.loss = RollingWindow(window_s)
-        self.cols = {}                  # name -> RollingWindow
-        self.coll_ratio = RollingWindow(window_s)
-        self.last_step = None
-        self.last_commit_step = None
-        self.steps_total = 0
-        self.compiles = 0
-        self.compile_s = 0.0
-        self.retraces = 0
-        self.tag = None
-        self._seq = 0
-        self._last_pub = 0.0
-        self.published = 0
+        # rolling state (all host-side floats; fed from flushed rows).
+        # write() runs on whatever thread emitted the event, so every
+        # mutable field below belongs to _lock (the concurrency lint
+        # enforces the annotations).
+        self.step_ms = RollingWindow(window_s)      # guarded-by: _lock
+        self.wait_ms = RollingWindow(window_s)      # guarded-by: _lock
+        self.loss = RollingWindow(window_s)         # guarded-by: _lock
+        self.cols = {}                              # guarded-by: _lock
+        self.coll_ratio = RollingWindow(window_s)   # guarded-by: _lock
+        self.last_step = None                       # guarded-by: _lock
+        self.last_commit_step = None                # guarded-by: _lock
+        self.steps_total = 0                        # guarded-by: _lock
+        self.compiles = 0                           # guarded-by: _lock
+        self.compile_s = 0.0                        # guarded-by: _lock
+        self.retraces = 0                           # guarded-by: _lock
+        self.tag = None                             # guarded-by: _lock
+        self._seq = 0                               # guarded-by: _lock
+        self._last_pub = 0.0                        # guarded-by: _lock
+        self.published = 0                          # guarded-by: _lock
 
     # -- lifecycle -----------------------------------------------------------
     def install(self, recorder=None):
@@ -202,7 +205,7 @@ class ClusterPublisher:
         except Exception:
             pass
 
-    def _on_steps(self, rec, now):
+    def _on_steps(self, rec, now):      # locked-by: _lock
         self.tag = rec.get('tag', self.tag)
         n = rec.get('n') or 0
         self.steps_total += n
@@ -269,17 +272,29 @@ class ClusterPublisher:
 
     def maybe_publish(self, now=None):
         now = now if now is not None else _MONO()
-        if now - self._last_pub < self.interval_s:
-            return False
-        return self.publish(now)
+        with self._lock:
+            if now - self._last_pub < self.interval_s:
+                return False
+            # claim the slot BEFORE posting: write() runs on every
+            # emitter thread, and an unlocked check-then-act here let
+            # two threads pass the rate gate and double-post the frame
+            self._last_pub = now
+        return self._post(now)
 
     def publish(self, now=None):
         """Build + post one frame now (rate limit bypassed)."""
         now = now if now is not None else _MONO()
-        self._last_pub = now
+        with self._lock:
+            self._last_pub = now
+        return self._post(now)
+
+    def _post(self, now):
+        # the KV post runs UNLOCKED — a network RTT under _lock would
+        # stall every event emitter behind the subscriber callback
         ok = self.transport.post_stats(self.frame(now))
         if ok:
-            self.published += 1
+            with self._lock:
+                self.published += 1
         return ok
 
 
@@ -456,10 +471,13 @@ class ClusterAggregator:
         self.behind_threshold = int(behind_threshold)
         self.divergence_band = float(divergence_band)
         self.min_collect_gap_s = float(min_collect_gap_s)
-        self.monitors = []
+        # Mutable aggregator state below is guarded by _lock: collect()
+        # may be called from a scrape thread (httpd handler) while a
+        # monitor attaches from the trainer thread.
+        self.monitors = []              # guarded-by: _lock
         self._lock = threading.RLock()
-        self._last_view = None
-        self._last_collect = 0.0
+        self._last_view = None          # guarded-by: _lock
+        self._last_collect = 0.0        # guarded-by: _lock
         self._t0 = _MONO()
         # staleness is judged on THIS process's monotonic clock: a
         # rank is stale when its frame seq has not advanced for
@@ -468,7 +486,7 @@ class ClusterAggregator:
         # healthy rank on a host whose clock is offset by more than
         # stale_after_s (pods give no NTP guarantee — the same reason
         # run_report anchors per-host clock skew).
-        self._seen = {}         # rank -> [seq, first_seen_mono]
+        self._seen = {}  # rank -> [seq, first_seen_mono]  # guarded-by: _lock
 
     def attach_monitor(self, monitor):
         with self._lock:
@@ -501,7 +519,7 @@ class ClusterAggregator:
                 pass                    # observers never block
         return view
 
-    def _build_view(self):
+    def _build_view(self):  # locked-by: _lock
         wall = _WALL()
         frames = {}
         try:

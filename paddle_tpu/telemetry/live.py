@@ -148,52 +148,62 @@ class LiveAggregator:
     def __init__(self, window_s=60.0, max_traces=256, max_alerts=64):
         self.window_s = float(window_s)
         self._lock = threading.RLock()
-        self._recorder = None
+        # write() is a subscriber callback — it runs on whatever
+        # thread emits (trainer, serving engine, supervisor worker)
+        # while scrape threads call snapshot(); every mutable field
+        # below is therefore guarded by _lock.
+        self._recorder = None           # guarded-by: _lock
         self._t0 = _MONO()
-        self.monitors = []
+        self.monitors = []              # guarded-by: _lock
         self._in_write = threading.local()
         # serving latency windows (seconds)
-        self.ttft = RollingWindow(window_s)
-        self.tpot = RollingWindow(window_s)
-        self.intervention_s = RollingWindow(window_s)
-        self.step_ms = {}               # loop tag -> RollingWindow
+        self.ttft = RollingWindow(window_s)          # guarded-by: _lock
+        self.tpot = RollingWindow(window_s)          # guarded-by: _lock
+        self.intervention_s = RollingWindow(window_s)  # guarded-by: _lock
+        self.step_ms = {}  # loop tag -> RollingWindow  # guarded-by: _lock
         # rates / totals.  Tokens are two MONOTONIC counters (emitted
         # and preemption-discarded) rather than one net counter: the
         # Prometheus families must never decrease (a dropping counter
         # reads as a reset and corrupts rate() queries), while the
         # delivered figure (emitted - discarded) stays exact.
-        self.tokens_emitted = RateCounter(window_s)
-        self.tokens_discarded = RateCounter(window_s)
-        self.admitted = RateCounter(window_s)
-        self.finished = RateCounter(window_s)
-        self.preempted = RateCounter(window_s)
-        self.compiles = RateCounter(window_s)
-        self.by_cause = {}              # finish cause -> RateCounter
-        self.requests_seen = 0
-        self.steady_since = None        # mono ts of mark_steady()
-        self.compiles_after_steady = 0
+        self.tokens_emitted = RateCounter(window_s)    # guarded-by: _lock
+        self.tokens_discarded = RateCounter(window_s)  # guarded-by: _lock
+        self.admitted = RateCounter(window_s)          # guarded-by: _lock
+        self.finished = RateCounter(window_s)          # guarded-by: _lock
+        self.preempted = RateCounter(window_s)         # guarded-by: _lock
+        self.compiles = RateCounter(window_s)          # guarded-by: _lock
+        self.by_cause = {}  # finish cause -> RateCounter  # guarded-by: _lock
+        self.requests_seen = 0          # guarded-by: _lock
+        self.steady_since = None  # mono ts of mark_steady()  # guarded-by: _lock
+        self.compiles_after_steady = 0  # guarded-by: _lock
         # live gauges (last serve_step snapshot)
-        self.gauges = {}
-        self._last_serve_step_t = None
+        self.gauges = {}                # guarded-by: _lock
+        self._last_serve_step_t = None  # guarded-by: _lock
         # bounded stores
-        self._traces = OrderedDict()    # rid -> trace rows (LRU)
+        self._traces = OrderedDict()  # rid -> trace rows (LRU)  # guarded-by: _lock
         self._max_traces = int(max_traces)
-        self.alerts = deque(maxlen=int(max_alerts))
-        self.live_trace_fn = None       # engine hook: rid -> rows|None
+        self.alerts = deque(maxlen=int(max_alerts))    # guarded-by: _lock
+        self.live_trace_fn = None  # engine hook: rid -> rows|None  # guarded-by: _lock
 
     # -- lifecycle -----------------------------------------------------------
     def install(self, recorder=None):
         """Subscribe to the (given or global) Recorder's stream."""
         rec = recorder or get_recorder()
-        if self._recorder is None:
-            rec.subscribe(self.write)
+        # claim the slot under _lock: an unlocked check-then-act here
+        # let two install() racers both subscribe, double-counting
+        # every event thereafter
+        with self._lock:
+            if self._recorder is not None:
+                return self
             self._recorder = rec
+        rec.subscribe(self.write)
         return self
 
     def uninstall(self):
-        if self._recorder is not None:
-            self._recorder.unsubscribe(self.write)
-            self._recorder = None
+        with self._lock:
+            rec, self._recorder = self._recorder, None
+        if rec is not None:
+            rec.unsubscribe(self.write)
         return self
 
     def attach_monitor(self, monitor):
@@ -238,7 +248,7 @@ class LiveAggregator:
         self.uninstall()
 
     # per-kind state updates (called under self._lock)
-    def _on_serve_step(self, rec, now):
+    def _on_serve_step(self, rec, now):  # locked-by: _lock
         dur = rec.get('dur_s')
         if dur is not None:
             self.intervention_s.add(dur, now)
@@ -264,7 +274,7 @@ class LiveAggregator:
                 (usable - free) / usable, 4)
         self._last_serve_step_t = now
 
-    def _on_serve_request(self, rec, now):
+    def _on_serve_request(self, rec, now):  # locked-by: _lock
         self.requests_seen += 1
         self.finished.add(1, now)
         self.ttft.add(rec.get('ttft_s'), now)
@@ -273,7 +283,7 @@ class LiveAggregator:
         self.by_cause.setdefault(
             reason, RateCounter(self.window_s)).add(1, now)
 
-    def _on_serve_trace(self, rec, now):
+    def _on_serve_trace(self, rec, now):  # locked-by: _lock
         rid = rec.get('rid')
         if rid is None:
             return
@@ -282,19 +292,19 @@ class LiveAggregator:
         while len(self._traces) > self._max_traces:
             self._traces.popitem(last=False)
 
-    def _on_steps(self, rec, now):
+    def _on_steps(self, rec, now):  # locked-by: _lock
         tag = rec.get('tag', 'train')
         win = self.step_ms.setdefault(tag, RollingWindow(self.window_s))
         for t in rec.get('step_time_ms') or ():
             if t is not None:
                 win.add(t, now)
 
-    def _on_compile(self, rec, now):
+    def _on_compile(self, rec, now):  # locked-by: _lock
         self.compiles.add(1, now)
         if self.steady_since is not None:
             self.compiles_after_steady += 1
 
-    def _on_alert(self, rec, now):
+    def _on_alert(self, rec, now):  # locked-by: _lock
         self.alerts.append(dict(rec))
 
     _HANDLERS = {

@@ -151,6 +151,10 @@ EVENT_KINDS = (
     'span',                # a closed span (name, dur_s)
     'scalar',              # user scalar (VisualDL / ScalarAdapter)
     'flight_dump',         # a flight-recorder dump was written
+    'lockcheck',           # analysis.lockcheck disarm summary (locks
+                           # wrapped, order-graph edges, cycles,
+                           # unguarded accesses, worst hold time) —
+                           # one per armed window
 )
 
 _WALL = time.time

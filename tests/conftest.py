@@ -52,6 +52,11 @@ os.environ.setdefault('PADDLE_TPU_CLUSTER_STATS', '0')
 # plan swap mid-test) — supervisor-behavior tests pass supervisor= /
 # construct PlanSupervisor explicitly
 os.environ.setdefault('PADDLE_TPU_SUPERVISOR', '0')
+# ...and for the runtime lock checker: an ambient PADDLE_TPU_LOCKCHECK
+# would patch threading.Lock/RLock factories under every test (and
+# first-armed-wins would make arming order test-order-dependent) —
+# lockcheck-behavior tests arm install()/maybe_install(True) explicitly
+os.environ.setdefault('PADDLE_TPU_LOCKCHECK', '0')
 
 import jax  # noqa: E402
 

@@ -112,6 +112,9 @@ IGNORED_KINDS = {
                 'metadata, not a report row',
     'scalar': 'user scalar stream — consumed by the TensorBoard/'
               'VisualDL exporters, not the merged report',
+    'lockcheck': 'runtime lock-checker disarm summary (cycles/'
+                 'violations/hold stats): a debug diagnostic read '
+                 'directly from its own report(), not a run row',
 }
 
 

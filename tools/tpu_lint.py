@@ -12,6 +12,16 @@ deep-lints one callable's jaxpr.
     python tools/tpu_lint.py --jaxpr pkg.mod:fn --shapes 8x128xf32,8xi32
     python tools/tpu_lint.py examples/ --hlo --mesh dp=8   # SPMD audit
     python tools/tpu_lint.py --plan --chips 8 [--hbm-gb 16]  # planner
+    python tools/tpu_lint.py paddle_tpu/ --threads    # concurrency lint
+
+--threads swaps the sweep for the concurrency rules
+(paddle_tpu.analysis.threads): guarded-by (annotated shared state
+accessed outside its lock), blocking-under-lock (device syncs /
+network / file IO / sleeps inside a critical section), and
+daemon-thread-lifecycle (daemon threads with no stop/join path).
+Pure source analysis, same suppression grammar; the tier-1 gate
+(tests/test_analysis_threads.py) runs it over paddle_tpu/ at zero
+HIGH.
 
 --hlo escalates to the lowered-HLO SPMD audit (paddle_tpu.analysis.hlo):
 each target step is lowered through jax.jit under a FORCED virtual
@@ -305,12 +315,22 @@ def main(argv=None):
     ap.add_argument('--no-pp', action='store_true',
                     help='exclude pipeline (pp>1) layouts from the '
                          'plan enumeration')
+    ap.add_argument('--threads', action='store_true',
+                    help='concurrency lint instead of the host-sync '
+                         'sweep: guarded-by, blocking-under-lock and '
+                         'daemon-thread-lifecycle over PATHS (pure '
+                         'source analysis, no imports)')
     args = ap.parse_args(argv)
 
     if not args.paths and not args.jaxpr and not args.plan:
         ap.print_usage(sys.stderr)
         print('tpu_lint: nothing to lint (give paths, --jaxpr or '
               '--plan)', file=sys.stderr)
+        return 2
+    if args.threads and not args.paths:
+        ap.print_usage(sys.stderr)
+        print('tpu_lint: --threads needs paths to sweep',
+              file=sys.stderr)
         return 2
     for p in args.paths:
         if not os.path.exists(p):
@@ -333,8 +353,12 @@ def main(argv=None):
 
     report = analysis.LintReport(name='tpu-lint')
     if args.paths:
-        report.extend(analysis.lint_sources(
-            args.paths, scope=args.scope, disable=args.disable))
+        if args.threads:
+            report.extend(analysis.lint_threads_sources(
+                args.paths, disable=args.disable))
+        else:
+            report.extend(analysis.lint_sources(
+                args.paths, scope=args.scope, disable=args.disable))
     if args.jaxpr:
         try:
             fn = _resolve(args.jaxpr)
