@@ -681,6 +681,13 @@ def _run_no_kill(name, smoke, timeout_s):
             'error': f'no output (rc={proc.returncode})'}
 
 
+# device memory_stats rows from the most recent successful preflight
+# probe (TPU/GPU backends; [] on CPU which exposes none) — read at
+# artifact-assembly time so every chip artifact records how much HBM
+# the pool offered BEFORE any config ran
+_preflight_memstats = None
+
+
 def _device_preflight_once(timeout_s):
     """Run one tiny jitted op in a subprocess: (True, None) iff the
     device stack (incl. a possibly-wedged dev tunnel) answers within
@@ -688,12 +695,27 @@ def _device_preflight_once(timeout_s):
     with rc + stderr tail) lands in the bench artifact so a failed
     chip round is diagnosable after the fact (BENCH rounds r02-r05
     all failed preflight with NOTHING captured).  Executed in a child
-    so a hang cannot wedge US."""
+    so a hang cannot wedge US.  A passing probe also captures each
+    device's ``memory_stats()`` (in-use/peak/limit) into the
+    artifact's ``device_mem`` — the live-truth baseline the memory
+    observatory's per-run numbers are read against."""
     import subprocess
-    code = ('import jax, jax.numpy as jnp, numpy as np;'
+    global _preflight_memstats
+    code = ('import json, jax, jax.numpy as jnp, numpy as np\n'
             'v = float(np.asarray(jax.jit(lambda a: a.sum())'
-            '(jnp.ones((8, 8)))));'
-            'print("PREFLIGHT_OK", v)')
+            '(jnp.ones((8, 8)))))\n'
+            'rows = []\n'
+            'for d in jax.local_devices():\n'
+            '    st = d.memory_stats()\n'
+            '    if st:\n'
+            '        rows.append({"device": str(d.id),\n'
+            '                     "bytes_in_use":'
+            ' st.get("bytes_in_use"),\n'
+            '                     "peak_bytes_in_use":'
+            ' st.get("peak_bytes_in_use"),\n'
+            '                     "bytes_limit":'
+            ' st.get("bytes_limit")})\n'
+            'print("PREFLIGHT_OK", v, json.dumps(rows))\n')
     try:
         proc = subprocess.run([sys.executable, '-c', code],
                               capture_output=True, text=True,
@@ -703,6 +725,14 @@ def _device_preflight_once(timeout_s):
         return False, (f'timeout after {timeout_s:.0f}s (tiny jitted '
                        'op never answered — wedged tunnel?)')
     if 'PREFLIGHT_OK' in proc.stdout:
+        for line in proc.stdout.splitlines():
+            if line.startswith('PREFLIGHT_OK'):
+                try:
+                    _preflight_memstats = json.loads(
+                        line.split(' ', 2)[2])
+                except (IndexError, ValueError):
+                    pass
+                break
         return True, None
     reason = (f'rc={proc.returncode}: '
               f'{(proc.stderr or proc.stdout)[-300:].strip()}')
@@ -1980,6 +2010,253 @@ def _obs_preflight(smoke, timeout_s=900):
     return ok, summary
 
 
+def _mem_smoke_child(smoke):
+    """--mem-smoke child: the memory observatory end to end on the
+    dp=8 CPU mesh, armed.  Emits one JSON line with the gate
+    evidence:
+
+    - every compiled module produced a ``memory_compiled`` event
+      (the trainer's free ``compiled_text()`` path AND the armed hapi
+      ``train_batch`` path),
+    - ``run_report --json`` carries a populated three-way memory
+      table (per-module predicted/compiled rows + live sampler),
+    - a seeded near-budget injection fires EXACTLY ONE
+      ``memory_pressure`` edge -> one supervisor re-plan whose
+      ``hbm_budget_gb`` is TIGHTER than the breached budget,
+    - the armed sampler adds zero device->host syncs (census ticks
+      taken INSIDE a transfer guard around the hot loop).
+    """
+    import tempfile
+    import numpy as np  # noqa: F811
+    del smoke       # the gate always runs the CPU smoke scale
+    # armed BEFORE paddle imports consult the env; huge interval so
+    # every tick below is an explicit, deterministic sample_once()
+    os.environ['PADDLE_TPU_MEMSTATS'] = 'interval=3600'
+    os.environ['PADDLE_TPU_COMPILE_CACHE'] = '0'
+    import jax
+    from jax.sharding import Mesh
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, telemetry
+    from paddle_tpu.telemetry import LiveAggregator
+    from paddle_tpu.telemetry import memory as mem
+    from paddle_tpu.telemetry.monitors import MemoryMonitor
+    from paddle_tpu.parallel import ParallelTrainer
+    from paddle_tpu.resilience.supervisor import (
+        PlanSupervisor, SupervisorConfig)
+
+    out = {}
+    tmpdir = tempfile.mkdtemp(prefix='bench_mem_')
+    telemetry.enable(tmpdir)
+
+    # -- (a) compiled truth at both extraction tiers ------------------
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                        nn.Linear(32, 4))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ('dp',))
+    tr = ParallelTrainer(net, opt, loss_fn=nn.MSELoss(), mesh=mesh)
+    rs = np.random.RandomState(0)
+    x = rs.randn(16, 16).astype('float32')
+    y = rs.randn(16, 4).astype('float32')
+    tr.step(x, y)                   # armed extraction at first compile
+    tr.compiled_text()              # the free trainer-hlo path
+    paddle.seed(1)
+    m2 = paddle.hapi.Model(nn.Linear(8, 2))
+    opt2 = paddle.optimizer.SGD(learning_rate=0.1,
+                                parameters=m2.network.parameters())
+    m2.prepare(optimizer=opt2, loss=nn.MSELoss())
+    m2.train_batch(rs.randn(4, 8).astype('float32'),
+                   rs.randn(4, 2).astype('float32'))
+    noted = sorted({e['name']
+                    for e in telemetry.events('memory_compiled')})
+    out['memory_compiled_modules'] = noted
+    out['all_modules_extracted'] = (
+        'ParallelTrainer.step' in noted
+        and 'Model.train_batch' in noted)
+
+    # -- (d) the armed sampler adds zero syncs ------------------------
+    sampler = mem.ensure_sampler()
+    out['sampler_armed'] = sampler is not None
+    try:
+        with jax.transfer_guard_device_to_host('disallow'):
+            for _ in range(8):
+                tr.step(x, y)
+                s = (sampler or mem.MemorySampler()).sample_once()
+        out['sync_free_ok'] = True
+        out['sampler_source'] = (s or {}).get('source')
+    except Exception as e:
+        out['sync_free_ok'] = False
+        out['sync_free_error'] = repr(e)[:300]
+
+    # -- (c) seeded near-budget injection -> exactly-once pressure
+    #        -> one tightened supervisor re-plan --------------------
+    class _Host:
+        """Five-method host whose replan records the tightened
+        budget; the swap is a no-op plan echo."""
+
+        class _Plan:
+            mesh_axes = {'dp': 8}
+            assignment = 'replicated'
+            score_us = 50.0
+
+        def __init__(self):
+            self.replans = []
+
+        def calibration(self):
+            return None
+
+        def healthy_devices(self, incident):
+            return list(range(8))
+
+        def replan(self, devices, calibration, hbm_budget_gb=None):
+            self.replans.append(hbm_budget_gb)
+
+            class R:
+                winner = self._Plan()
+                candidates = [winner]
+                fallbacks = []
+            return R()
+
+        def incumbent(self):
+            return None, None
+
+        def precompile(self, plan, devices):
+            pass
+
+        def request_swap(self, plan, devices, incident):
+            return True
+
+    agg = LiveAggregator().install()
+    host = _Host()
+    sup = PlanSupervisor(host, SupervisorConfig(
+        debounce_s=0.01, cooldown_s=0.0, margin=0.1)).start()
+    try:
+        census = mem.live_arrays_bytes() or 0
+        # near-budget: the census sits just UNDER the watermark, so
+        # the next (seeded, fixed-size) allocation crosses it
+        budget = int((census + (4 << 20)) / 0.9)
+        agg.attach_monitor(MemoryMonitor(budget_bytes=budget))
+        probe = mem.MemorySampler(mem.MemConfig(
+            budget_gb=budget / float(1 << 30)))
+        probe.sample_once()             # below watermark: no edge
+        ballast = jax.numpy.ones((budget // 4, 2), jax.numpy.float32)
+        ballast.block_until_ready()     # ~2x the 4 MiB headroom
+        probe.sample_once()             # crosses: THE edge
+        probe.sample_once()             # latched: must not re-fire
+        deadline = time.time() + 10
+        while time.time() < deadline and not sup.incidents:
+            time.sleep(0.05)
+        del ballast
+        pressures = telemetry.events('memory_pressure')
+        out['pressure_events'] = len(pressures)
+        out['budget_gb'] = round(budget / float(1 << 30), 4)
+        out['replans'] = len(host.replans)
+        out['tightened_gb'] = (None if not host.replans
+                               else host.replans[0])
+        out['budget_tightened'] = bool(
+            host.replans and host.replans[0] is not None
+            and host.replans[0] < budget / float(1 << 30))
+        out['supervisor_outcomes'] = [
+            i.get('outcome') for i in sup.incidents]
+    finally:
+        sup.stop()
+        agg.uninstall()
+        mem.stop_sampler()
+
+    # -- (b) the run_report three-way table ---------------------------
+    telemetry.disable()
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      'tools', 'run_report.py'), tmpdir, '--json'],
+        capture_output=True, text=True, timeout=120)
+    try:
+        rep = json.loads(proc.stdout)
+    except ValueError:
+        rep = {}
+    memsec = rep.get('memory') or {}
+    mods = memsec.get('modules') or {}
+    out['report_memory_modules'] = len(mods)
+    out['report_three_way'] = bool(
+        mods
+        and all(r.get('predicted_peak_bytes') is not None
+                and r.get('compiled_peak_bytes') is not None
+                for r in mods.values())
+        and (memsec.get('live') or {}).get('device_bytes') is not None)
+    out['report_ratio_mean'] = memsec.get('ratio_mean')
+    out['report_pressure_events'] = memsec.get('pressure_events')
+    print(json.dumps(out))
+
+
+def _mem_preflight(smoke, timeout_s=900):
+    """--mem-smoke gate (the ISSUE-18 acceptance bar): on a dp=8 CPU
+    mesh with PADDLE_TPU_MEMSTATS armed, (a) every compiled module
+    must produce a ``memory_compiled`` event, (b) ``run_report
+    --json`` must carry a populated three-way memory table, (c) a
+    seeded near-budget injection must fire EXACTLY ONE
+    ``memory_pressure`` and drive one supervisor re-plan with a
+    TIGHTENED ``hbm_budget_gb``, and (d) the armed sampler must add
+    zero device->host syncs under a transfer guard.  Returns
+    (ok, summary); infra failures never block — evidence beats a
+    dead gate — but a violated bar always does."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    env['XLA_FLAGS'] = ' '.join(
+        [t for t in env.get('XLA_FLAGS', '').split()
+         if not t.startswith('--xla_force_host_platform_device_count')]
+        + ['--xla_force_host_platform_device_count=8'])
+    env.pop('PADDLE_TPU_MEMSTATS', None)    # the child arms explicitly
+    cmd = [sys.executable, os.path.abspath(__file__),
+           '--mem-smoke-child'] + (['--smoke'] if smoke else [])
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=env)
+        doc = _last_json_dict(proc.stdout)
+    except Exception as e:
+        log(f'mem preflight skipped ({e!r})')
+        return True, {'error': repr(e)[:200]}
+    if doc is None:
+        log(f'mem preflight skipped (no child output, '
+            f'rc={proc.returncode}): {proc.stderr[-300:]}')
+        return True, {'error': f'no output (rc={proc.returncode})'}
+    failures = []
+    if not doc.get('all_modules_extracted'):
+        failures.append('a compiled module produced no '
+                        'memory_compiled event (got: '
+                        f'{doc.get("memory_compiled_modules")})')
+    if not doc.get('report_three_way'):
+        failures.append('run_report --json memory table unpopulated '
+                        '(modules='
+                        f'{doc.get("report_memory_modules")})')
+    if doc.get('pressure_events') != 1:
+        failures.append(f'near-budget injection fired '
+                        f'{doc.get("pressure_events")} '
+                        'memory_pressure event(s), want exactly 1')
+    if doc.get('replans') != 1 or not doc.get('budget_tightened'):
+        failures.append('supervisor re-plan missing or budget not '
+                        f'tightened (replans={doc.get("replans")}, '
+                        f'hint={doc.get("tightened_gb")} vs breached '
+                        f'{doc.get("budget_gb")} GiB)')
+    if not doc.get('sync_free_ok'):
+        failures.append('armed sampler synced the host under the '
+                        'transfer guard: '
+                        + str(doc.get('sync_free_error')))
+    summary = dict(doc, failures=failures)
+    ok = not failures
+    log(f'mem preflight: {"ok" if ok else "FAIL"} '
+        f'(modules={doc.get("memory_compiled_modules")}, '
+        f'ratio_mean={doc.get("report_ratio_mean")}, '
+        f'pressure={doc.get("pressure_events")}, '
+        f'tightened={doc.get("tightened_gb")}, '
+        f'sync_free={doc.get("sync_free_ok")})')
+    for f in failures:
+        log(f'  {f}')
+    return ok, summary
+
+
 def _cluster_obs_smoke_child(smoke):
     """--cluster-obs-smoke child: the training-cluster observability
     plane under chaos (the ISSUE-15 acceptance bar), in one process:
@@ -2586,6 +2863,21 @@ def main():
     p.add_argument('--cluster-obs-smoke-child', action='store_true',
                    help='(internal) run the cluster-obs measurement '
                         'and emit its JSON')
+    p.add_argument('--mem-smoke', action='store_true',
+                   help='preflight gate: memory observatory '
+                        '(telemetry.memory) — a dp=8 CPU mesh run '
+                        'with PADDLE_TPU_MEMSTATS armed must produce '
+                        'memory_compiled for every compiled module, '
+                        'a populated three-way (predicted/compiled/'
+                        'live) table in run_report --json, a seeded '
+                        'near-budget injection firing exactly one '
+                        'memory_pressure -> one supervisor re-plan '
+                        'with a tightened hbm_budget_gb, and a '
+                        'transfer-guard proof the armed sampler adds '
+                        'zero syncs')
+    p.add_argument('--mem-smoke-child', action='store_true',
+                   help='(internal) run the mem-smoke measurement '
+                        'and emit its JSON')
     p.add_argument('--fused-smoke', action='store_true',
                    help='steps/sec-vs-K sweep (K in {1,8,32}) of the '
                         'fused train loop on the lenet/widedeep '
@@ -2681,6 +2973,10 @@ def main():
         _cluster_obs_smoke_child(args.smoke)
         return
 
+    if args.mem_smoke_child:
+        _mem_smoke_child(args.smoke)
+        return
+
     if args.single_json:
         if args.config == 'all':
             p.error('--single-json needs an explicit --config NAME')
@@ -2699,6 +2995,7 @@ def main():
     serve_summary = None
     obs_summary = None
     cluster_obs_summary = None
+    mem_summary = None
     quant_summary = None
     supervisor_summary = None
     threads_summary = None
@@ -2790,6 +3087,24 @@ def main():
                          'telemetry.cluster or re-run without '
                          '--cluster-obs-smoke',
                 'cluster_obs': cluster_obs_summary, 'extras': {}}))
+            sys.exit(1)
+    if args.mem_smoke:
+        mem_ok, mem_summary = _mem_preflight(args.smoke)
+        if not mem_ok:
+            # a lying memory plane means the planner's HBM gate keeps
+            # admitting plans that OOM live, and nothing re-plans
+            # when they do — fail before burning chip time
+            print(json.dumps({
+                'metric': METRIC_NAMES['resnet'], 'value': None,
+                'unit': UNITS['resnet'], 'vs_baseline': None,
+                'error': 'mem preflight failed (memory_compiled '
+                         'missing for a module, three-way table '
+                         'unpopulated, pressure edge not exactly-'
+                         'once, re-plan budget untightened, or the '
+                         'armed sampler synced the host); fix '
+                         'telemetry.memory / resilience.supervisor '
+                         'or re-run without --mem-smoke',
+                'mem': mem_summary, 'extras': {}}))
             sys.exit(1)
     if args.serve_smoke:
         serve_ok, serve_summary = _serve_preflight(args.smoke)
@@ -3000,6 +3315,12 @@ def main():
         out['obs'] = obs_summary
     if cluster_obs_summary is not None:
         out['cluster_obs'] = cluster_obs_summary
+    if mem_summary is not None:
+        out['mem'] = mem_summary
+    if _preflight_memstats:
+        # per-device HBM baseline captured by the passing preflight
+        # probe (absent on CPU: no memory_stats there)
+        out['device_mem'] = _preflight_memstats
     if quant_summary is not None:
         out['quant'] = quant_summary
     if supervisor_summary is not None:

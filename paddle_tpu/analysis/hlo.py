@@ -501,6 +501,71 @@ def peak_memory(module):
     return peak
 
 
+def peak_memory_report(module, top=8):
+    """The liveness walk of :func:`peak_memory` over the ENTRY
+    computation, instrumented: re-runs the same born-at-def /
+    dies-after-last-use schedule tracking the live buffer set, and
+    snapshots the largest contributors at the peak instant — so the
+    memory observatory can say not just HOW HIGH the predicted
+    high-water is but WHICH buffers stack it (with source attribution
+    when the HLO carries metadata).
+
+    Returns ``{'peak_bytes', 'param_bytes', 'at_instr',
+    'contributors': [{name, opcode, bytes, file, line}, ...]}`` —
+    contributors sorted largest-first, capped at `top`, parameters
+    folded into one synthetic row.  peak_bytes matches
+    :func:`peak_memory` minus callee-transient stacking (entry-local
+    buffers only), so it is a floor of the full estimate, never above
+    it."""
+    empty = {'peak_bytes': 0, 'param_bytes': 0, 'at_instr': None,
+             'contributors': []}
+    if module.entry is None:
+        return empty
+    comp = module.entry
+    params = sum(i.bytes for i in comp.instrs if i.opcode == 'parameter')
+    last_use = {}
+    for idx, ins in enumerate(comp.instrs):
+        for op in ins.operands:
+            last_use[op] = idx
+    live_set = {}               # instr name -> bytes (non-param buffers)
+    live = params
+    peak = live
+    at_instr = None
+    peak_set = {}
+    for idx, ins in enumerate(comp.instrs):
+        if ins.opcode != 'parameter':
+            if ins.opcode not in _ALIAS_OPS and ins.bytes:
+                live_set[ins.name] = ins.bytes
+                live += ins.bytes
+            if live > peak:
+                peak = live
+                at_instr = ins.name
+                peak_set = dict(live_set)
+        for op in set(ins.operands):
+            if last_use.get(op) == idx:
+                src = comp.index.get(op)
+                if src is not None and src.opcode != 'parameter' \
+                        and src.opcode not in _ALIAS_OPS:
+                    live -= src.bytes
+                    live_set.pop(op, None)
+    contributors = []
+    if params:
+        contributors.append({'name': '(parameters)',
+                             'opcode': 'parameter', 'bytes': params,
+                             'file': None, 'line': None})
+    for name, b in sorted(peak_set.items(), key=lambda kv: -kv[1]):
+        ins = comp.index.get(name)
+        contributors.append({
+            'name': name,
+            'opcode': ins.opcode if ins is not None else '?',
+            'bytes': b,
+            'file': ins.file if ins is not None else None,
+            'line': ins.line if ins is not None else None})
+    contributors.sort(key=lambda c: -c['bytes'])
+    return {'peak_bytes': peak, 'param_bytes': params,
+            'at_instr': at_instr, 'contributors': contributors[:top]}
+
+
 # -- rule registry ------------------------------------------------------------
 
 HLO_RULES = {}

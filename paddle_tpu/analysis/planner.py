@@ -475,7 +475,23 @@ def _score_lowered(plan, model, batch, mesh, *, thresholds,
     plan.wire_bytes = sum(r['wire_bytes'] for r in census.values())
     plan.est_us = round(sum(r['est_us'] for r in census.values()), 3)
     plan.phases = sum(r['phases'] for r in census.values())
-    plan.peak_bytes = _hlo.peak_memory(module)
+    # the liveness estimate, scaled by the fitted predicted-vs-compiled
+    # bias when the Calibration table carries one (memory observatory:
+    # per_op['peak_memory']['bias'], fitted from memory_compiled
+    # events the same way collective alpha/beta are fitted from
+    # collective_observed) — so the HBM gate below judges candidates
+    # at the estimator's MEASURED accuracy, not its nominal one
+    peak = _hlo.peak_memory(module)
+    cal = thr.get('calibration')
+    if cal is not None:
+        try:
+            bias = float(cal.per_op.get('peak_memory', {})
+                         .get('bias', 1.0))
+            if bias > 0:
+                peak = int(peak * bias)
+        except Exception:
+            pass
+    plan.peak_bytes = peak
     plan.compute_us = round(compute_floor_us(
         module, peak_tflops=thr.get('peak_tflops', DEFAULT_PEAK_TFLOPS),
         hbm_gbps=thr.get('hbm_gbps', DEFAULT_HBM_GBPS)), 3)

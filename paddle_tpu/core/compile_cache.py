@@ -471,6 +471,19 @@ def lookup_executable(fp, name=None):
           dur_s=time.perf_counter() - t0,
           saved_s=(header.get('meta') or {}).get('export_s'),
           name=name, fp=fp)
+    # warm starts skip every compile choke point downstream, so the
+    # memory observatory would go blind on exactly the restarted
+    # processes that need it — armed-only (extra lower+compile,
+    # amortized by the XLA persistent cache the aot store warmed)
+    from ..telemetry import memory as _mem
+    if _mem.armed():
+        try:
+            avals = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                     for a in exp.in_avals]
+            _mem.maybe_note_compiled(name or f'fp:{str(fp)[:12]}',
+                                     fn, avals, source='warm_start')
+        except Exception:
+            pass
     return fn
 
 
@@ -497,7 +510,12 @@ def store_executable(fp, jitted, example_args, name=None, meta=None,
         blob = exp.serialize()
         export_s = time.perf_counter() - t0
         if aot_compile:
-            jax.jit(exp.call).lower(*abstract).compile()
+            compiled = jax.jit(exp.call).lower(*abstract).compile()
+            # memory observatory rides the AOT compile we just paid
+            # for — FREE extraction on every cold-miss population
+            from ..telemetry import memory as _mem
+            _mem.note_compiled(name or f'fp:{str(fp)[:12]}', compiled,
+                               source='compile_cache')
     except Exception:
         return False
     doc = dict(meta or {})

@@ -352,6 +352,17 @@ class Model:
                     extra=('donate', (0, 1, 2)))
                 jitted = _cc.through_cache(jitted, example, fp=fp,
                                            name='Model.train_batch')
+            # memory observatory, armed-only (one extra lower+compile
+            # per variant): XLA memory_analysis vs liveness prediction
+            from ..telemetry import memory as _mem
+            _mem.ensure_sampler()
+            if _mem.armed():
+                _mem.maybe_note_compiled(
+                    'Model.train_batch', jitted,
+                    (st['params'], st['buffers'], st['opt'],
+                     jax.random.PRNGKey(0), jnp.zeros((), jnp.int32),
+                     jnp.zeros((), jnp.float32), *arrays),
+                    source='hapi')
             self._train_step_cache[key] = jitted
             from ..analysis import note_retrace
             note_retrace('Model.train_batch',
@@ -457,6 +468,14 @@ class Model:
                     extra=('donate', (0, 1, 2), 'fused', k))
                 jitted = _cc.through_cache(jitted, example, fp=fp,
                                            name='Model.train_chunk')
+            from ..telemetry import memory as _mem
+            if _mem.armed():
+                _mem.maybe_note_compiled(
+                    'Model.train_chunk', jitted,
+                    (st['params'], st['buffers'], st['opt'],
+                     jax.random.PRNGKey(0), jnp.zeros((), jnp.int32),
+                     jnp.zeros((), jnp.float32), *stacked),
+                    source='hapi')
             self._train_chunk_cache[key] = jitted
             from ..analysis import note_retrace
             note_retrace('Model.train_chunk',

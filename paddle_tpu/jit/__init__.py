@@ -237,6 +237,13 @@ class StaticFunction:
                 jitted = _cc.through_cache(
                     jitted, (params, buffers, key, tvals), fp=fp,
                     name=f'to_static({self.__name__})')
+            # memory observatory, armed-only (one extra lower+compile
+            # per variant): XLA memory_analysis vs liveness prediction
+            from ..telemetry import memory as _mem
+            if _mem.armed():
+                _mem.maybe_note_compiled(
+                    f'to_static({self.__name__})', jitted,
+                    (params, buffers, key, tvals), source='to_static')
             self._jitted[cache_key] = jitted
             # the retrace monitor: many signature variants on one
             # StaticFunction means something in the signature is

@@ -788,6 +788,16 @@ class ParallelTrainer:
             self._maybe_persistent_cache()
             if self.lint:
                 self._run_lint(vals)
+            # memory observatory: armed-only here (an extra
+            # lower+compile; compiled_text() extracts for FREE when
+            # anything else wants the HLO), plus the live sampler
+            # (no-op unless PADDLE_TPU_MEMSTATS)
+            from ..telemetry import memory as _mem
+            _mem.ensure_sampler()
+            if _mem.armed():
+                _mem.maybe_note_compiled(
+                    'ParallelTrainer.step', self._compiled,
+                    self._step_example_args(), source='trainer')
         return vals
 
     # -- persistent compile cache (core.compile_cache) -----------------------
@@ -850,6 +860,11 @@ class ParallelTrainer:
         compiled = self._compiled.lower(
             *self._step_example_args()).compile()
         text = compiled.as_text()
+        # memory observatory rides the lowering we already paid for:
+        # XLA memory_analysis + liveness prediction, free here
+        from ..telemetry import memory as _mem
+        _mem.note_compiled('ParallelTrainer.step', compiled,
+                           hlo_text=text, source='trainer-hlo')
         try:
             # module-total cost analysis only exists on the live
             # compiled object — stash it for op_summary (a

@@ -60,8 +60,9 @@ import time
 
 __all__ = ['SUPERVISOR_ENV', 'TRIGGER_POLICIES', 'SupervisorConfig',
            'resolve_supervisor', 'PlanSupervisor', 'TrainerHost',
-           'drift_calibration', 'write_reshape_request',
-           'read_reshape_request', 'RESHAPE_REQUEST_NAME']
+           'drift_calibration', 'memory_budget_hint',
+           'write_reshape_request', 'read_reshape_request',
+           'RESHAPE_REQUEST_NAME']
 
 SUPERVISOR_ENV = 'PADDLE_TPU_SUPERVISOR'
 
@@ -75,6 +76,7 @@ SUPERVISOR_ENV = 'PADDLE_TPU_SUPERVISOR'
 TRIGGER_POLICIES = {
     'drift_detected': 'replan',
     'slo_breach': 'replan',
+    'memory_pressure': 'replan',
     'straggler_suspect': 'exclude_rank',
     'straggler': 'exclude_rank',
     'rank_divergence': 'backoff',
@@ -211,6 +213,26 @@ def drift_calibration(base, incidents):
         link_bw_gbps=getattr(base, 'link_bw_gbps', None),
         link_latency_us=getattr(base, 'link_latency_us', None),
         meta={'source': 'supervisor-drift'})
+
+
+def memory_budget_hint(incidents, safety=0.9):
+    """A TIGHTENED ``hbm_budget_gb`` for the re-plan after a
+    ``memory_pressure`` trigger, or None when no trigger carries the
+    live numbers.  The breached plan passed the planner's HBM gate yet
+    overshot live — the liveness estimate understates this workload by
+    (at worst) observed/budget — so the re-plan must clear a gate
+    shrunk by that factor times a safety margin, making the swapped-in
+    plan provably fit where the incumbent provably did not."""
+    hint = None
+    for data in incidents:
+        observed = data.get('observed_bytes')
+        budget = data.get('budget_bytes')
+        if not observed or not budget:
+            continue
+        gb = (budget / float(1 << 30)) \
+            * min(1.0, budget / observed) * float(safety)
+        hint = gb if hint is None else min(hint, gb)
+    return hint
 
 
 # -- multi-process swap path: the coordinated-reshape request file ------------
@@ -439,7 +461,19 @@ class PlanSupervisor:
             devices = host.healthy_devices(incident)
             cal = drift_calibration(
                 host.calibration(), incident['data'])
-            result = host.replan(devices, cal)
+            # a memory_pressure trigger tightens the re-plan's HBM
+            # gate; passed conditionally so hosts with the classic
+            # 2-arg replan keep working for every other trigger
+            hint = memory_budget_hint(incident['data'])
+            if hint is None:
+                result = host.replan(devices, cal)
+            else:
+                incident['hbm_budget_gb'] = round(hint, 4)
+                try:
+                    result = host.replan(devices, cal,
+                                         hbm_budget_gb=hint)
+                except TypeError:
+                    result = host.replan(devices, cal)
             cand = result.winner if result is not None else None
         except Exception as e:
             return self._terminal(incident, 'degraded', stage='plan',
@@ -493,11 +527,15 @@ class PlanSupervisor:
                                   error=repr(e))
         with self._lock:
             self.swaps += 1
+        extra = {}
+        if incident.get('hbm_budget_gb') is not None:
+            extra['hbm_budget_gb'] = incident['hbm_budget_gb']
         return self._terminal(
             incident, 'swap', mesh=dict(cand.mesh_axes),
             assignment=cand.assignment,
             candidate_s=round(cand_s, 6),
-            incumbent_s=None if inc_s is None else round(inc_s, 6))
+            incumbent_s=None if inc_s is None else round(inc_s, 6),
+            **extra)
 
 
 class TrainerHost:
@@ -550,16 +588,18 @@ class TrainerHost:
             meas = None
         return t.plan, meas
 
-    def replan(self, devices, calibration):
+    def replan(self, devices, calibration, hbm_budget_gb=None):
         from ..analysis import planner as _planner
         t = self.trainer
         vals = getattr(t, '_example_vals', None)
         if not vals:
             raise RuntimeError('trainer has not compiled a step yet')
         batch = tuple(vals[:t.n_inputs])
+        budget = (t.hbm_budget_gb if hbm_budget_gb is None
+                  else hbm_budget_gb)
         return _planner.plan_model(
             t.model, batch, chips=len(devices), devices=list(devices),
-            hbm_budget_gb=t.hbm_budget_gb, calibration=calibration,
+            hbm_budget_gb=budget, calibration=calibration,
             include_pp=False, name=type(t.model).__name__)
 
     def precompile(self, plan, devices):

@@ -255,6 +255,19 @@ class ServingEngine:
                 self.live.attach_monitor(SLOMonitor(budget=self.budget)),
                 self.live.attach_monitor(DriftMonitor()),
             ]
+            # memory-pressure sensing rides the same plane when the
+            # PADDLE_TPU_MEMSTATS grammar declares a budget_gb
+            from ..telemetry import memory as _mem
+            mcfg = _mem.resolve_memstats()
+            if mcfg is not None and mcfg.budget_bytes is not None:
+                from ..telemetry.monitors import MemoryMonitor
+                self.monitors.append(self.live.attach_monitor(
+                    MemoryMonitor(config=mcfg)))
+        # live memory sampler: default OFF, armed by the same env
+        # (idempotent no-op when unset; daemon thread, boundary rate)
+        from ..telemetry import memory as _mem_sampler
+        _mem_sampler.ensure_sampler()
+        if port is not None:
             try:
                 self.metrics_server = MetricsServer(self.live,
                                                     port=port).start()
@@ -362,6 +375,14 @@ class ServingEngine:
             fp=fp, name=name)
         self._modules[sig] = jitted
         self.compile_count += 1
+        # memory observatory, armed-only (an extra lower+compile per
+        # module): every serving module's XLA memory_analysis vs the
+        # liveness prediction — through a FRESH jit, because a
+        # warm-started exported call cannot re-lower
+        from ..telemetry import memory as _mem
+        if _mem.armed():
+            _mem.maybe_note_compiled(name, jax.jit(build_fn), example,
+                                     source='serving')
         return jitted
 
     def _prefill_build(self, P, B):
@@ -595,12 +616,16 @@ class ServingEngine:
             return
         from .. import telemetry
         sched = self.scheduler
+        frag = self.cache.frag_report()
         telemetry.event('serve_step', intervention=self.interventions,
                         live=0, batch=0, span=0, decoded=0,
                         admitted=admitted, finished=0, preempted=0,
                         queued=len(sched.queue),
                         free_blocks=self.cache.free_blocks,
                         total_blocks=self.cache.num_blocks,
+                        kv_frag_frac=frag['frag_frac'],
+                        kv_largest_free_run=frag['largest_free_run'],
+                        kv_high_water=frag['high_water_blocks'],
                         prefilled=self._pending_prefilled,
                         discarded=self._pending_discarded,
                         dur_s=round(self._clock() - t_start, 6))
@@ -697,6 +722,7 @@ class ServingEngine:
         n = int(valid.sum())
         self.decoded_tokens += n
         self.interventions += 1
+        frag = self.cache.frag_report()
         telemetry.event('serve_step', intervention=self.interventions,
                         live=len(plan.requests), batch=plan.batch,
                         span=plan.span, decoded=n, admitted=admitted,
@@ -705,6 +731,9 @@ class ServingEngine:
                         queued=len(sched.queue),
                         free_blocks=self.cache.free_blocks,
                         total_blocks=self.cache.num_blocks,
+                        kv_frag_frac=frag['frag_frac'],
+                        kv_largest_free_run=frag['largest_free_run'],
+                        kv_high_water=frag['high_water_blocks'],
                         prefilled=self._pending_prefilled,
                         discarded=self._pending_discarded,
                         dur_s=round(self._clock() - t_start, 6))
@@ -805,7 +834,8 @@ class ServingEngine:
                 'modules': sorted(str(s) for s in self._modules),
                 'interventions': self.interventions,
                 'decoded_tokens': self.decoded_tokens,
-                'free_blocks': self.cache.free_blocks}
+                'free_blocks': self.cache.free_blocks,
+                'kv_frag': self.cache.frag_report()}
 
     # -- AOT / declared bucket set -------------------------------------------
     def bucket_set(self):

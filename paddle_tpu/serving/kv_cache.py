@@ -110,6 +110,7 @@ class PagedKVCache:
         # LIFO free list: freshly freed blocks are the warmest
         self._free = list(range(self.num_blocks - 1, 0, -1))
         self._owned = {}            # seq_id -> [block ids, in order]
+        self._high_water = 0        # max blocks ever simultaneously owned
 
     # -- allocator ----------------------------------------------------------
     @property
@@ -136,7 +137,65 @@ class PagedKVCache:
             return False
         for _ in range(need):
             have.append(self._free.pop())
+        used = (self.num_blocks - 1) - len(self._free)
+        if used > self._high_water:
+            self._high_water = used
         return True
+
+    @property
+    def high_water_blocks(self):
+        """Most blocks ever simultaneously owned (lifetime)."""
+        return self._high_water
+
+    def frag_report(self):
+        """Pool-shape truth for the memory observatory: how BROKEN UP
+        the pool is, not just how full.
+
+        - ``free_runs`` / ``largest_free_run``: maximal runs of
+          consecutive block ids in the free list — a pool can hold
+          plenty of free blocks yet no contiguous span (irrelevant to
+          correctness here, the classic fragmentation signal on
+          allocators that ever need spans);
+        - ``frag_frac``: 1 - largest_run/free (0 = one solid span);
+        - ``seq_spread_max`` / ``seq_spread_mean``: per-sequence block
+          spread, (max-min+1)/owned — how scattered each sequence's
+          blocks sit in the pool (gather locality);
+        - ``high_water_blocks``: lifetime peak of owned blocks (the
+          number capacity planning actually wants).
+        """
+        usable = self.num_blocks - 1
+        free = sorted(self._free)
+        runs = []
+        if free:
+            start = prev = free[0]
+            for b in free[1:]:
+                if b == prev + 1:
+                    prev = b
+                    continue
+                runs.append(prev - start + 1)
+                start = prev = b
+            runs.append(prev - start + 1)
+        largest = max(runs) if runs else 0
+        spreads = []
+        for blocks in self._owned.values():
+            if blocks:
+                spreads.append(
+                    (max(blocks) - min(blocks) + 1) / len(blocks))
+        return {
+            'num_blocks': self.num_blocks,
+            'usable_blocks': usable,
+            'free_blocks': len(free),
+            'owned_blocks': usable - len(free),
+            'owned_seqs': sum(1 for b in self._owned.values() if b),
+            'free_runs': len(runs),
+            'largest_free_run': largest,
+            'frag_frac': round(1.0 - largest / len(free), 4)
+            if free else 0.0,
+            'seq_spread_max': round(max(spreads), 4) if spreads else 0.0,
+            'seq_spread_mean': round(sum(spreads) / len(spreads), 4)
+            if spreads else 0.0,
+            'high_water_blocks': self._high_water,
+        }
 
     def free_seq(self, seq_id):
         """Release every block `seq_id` owns; returns how many."""

@@ -62,7 +62,11 @@ from .profile import (  # noqa: F401
     resolve_schedule)
 from .live import (  # noqa: F401
     LiveAggregator, RollingWindow, RateCounter)
-from .monitors import SLOMonitor, DriftMonitor  # noqa: F401
+from .monitors import (  # noqa: F401
+    SLOMonitor, DriftMonitor, MemoryMonitor)
+from .memory import (  # noqa: F401
+    MemConfig, MemorySampler, resolve_memstats, note_compiled,
+    maybe_note_compiled, ensure_sampler, stop_sampler)
 from .httpd import (  # noqa: F401
     MetricsServer, resolve_metrics_port, attach_source)
 from .cluster import (  # noqa: F401
@@ -76,7 +80,9 @@ __all__ = [
     'ProfileSchedule', 'StepProfiler', 'step_profiler', 'capture',
     'resolve_schedule',
     'LiveAggregator', 'RollingWindow', 'RateCounter',
-    'SLOMonitor', 'DriftMonitor',
+    'SLOMonitor', 'DriftMonitor', 'MemoryMonitor',
+    'MemConfig', 'MemorySampler', 'resolve_memstats', 'note_compiled',
+    'maybe_note_compiled', 'ensure_sampler', 'stop_sampler',
     'MetricsServer', 'resolve_metrics_port', 'attach_source',
     'ClusterPublisher', 'ClusterAggregator', 'ClusterPlane',
     'enable_cluster_plane', 'resolve_cluster_stats',

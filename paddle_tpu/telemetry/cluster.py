@@ -268,6 +268,20 @@ class ClusterPublisher:
                 doc['loss'] = {'last': round(vals[-1], 6),
                                'mean': round(sum(vals) / len(vals), 6),
                                'count': len(vals)}
+        # per-rank memory columns (memory observatory): the sampler's
+        # last gauges, read at frame rate — absent when the sampler is
+        # off, so frames stay byte-compatible with the pre-memory wire
+        try:
+            gauges = get_recorder().gauges
+            for field, key in (('mem_device_bytes', 'memory.device_bytes'),
+                               ('mem_peak_bytes',
+                                'memory.device_peak_bytes'),
+                               ('mem_host_rss', 'memory.host_rss')):
+                v = gauges.get(key)
+                if v is not None:
+                    doc[field] = int(v)
+        except Exception:
+            pass
         return doc
 
     def maybe_publish(self, now=None):
@@ -578,6 +592,9 @@ class ClusterAggregator:
                 'coll_ratio': f.get('coll_ratio'),
                 'loss_mean': loss.get('mean'),
                 'loss_last': loss.get('last'),
+                'mem_device_bytes': f.get('mem_device_bytes'),
+                'mem_peak_bytes': f.get('mem_peak_bytes'),
+                'mem_host_rss': f.get('mem_host_rss'),
             }
             for k, v in cols.items():
                 row.setdefault(k, v)
@@ -599,6 +616,16 @@ class ClusterAggregator:
                 row['behind'] = max_step - row['step']
             if med_p50 and row.get('step_p50_ms') is not None:
                 row['skew'] = round(row['step_p50_ms'] / med_p50, 4)
+        # memory skew (memory observatory): per-rank live bytes vs the
+        # cluster median — a rank running hot on HBM is the next OOM
+        med_mem = _median([row['mem_device_bytes']
+                           for row in per_rank.values()
+                           if row.get('mem_device_bytes')
+                           and not row.get('stale')])
+        for r, row in per_rank.items():
+            if med_mem and row.get('mem_device_bytes'):
+                row['mem_skew'] = round(
+                    row['mem_device_bytes'] / med_mem, 4)
         straggler = attribute_straggler(
             per_rank, skew_threshold=self.skew_threshold,
             behind_threshold=self.behind_threshold,
@@ -686,6 +713,18 @@ class ClusterAggregator:
         fam('rank_loss_mean', 'gauge',
             'rolling loss-window mean per rank',
             [({'rank': r}, row.get('loss_mean'))
+             for r, row in ranks.items()])
+        fam('rank_mem_device_bytes', 'gauge',
+            'live device bytes per rank (memory sampler)',
+            [({'rank': r}, row.get('mem_device_bytes'))
+             for r, row in ranks.items()])
+        fam('rank_mem_host_rss_bytes', 'gauge',
+            'host RSS per rank (memory sampler)',
+            [({'rank': r}, row.get('mem_host_rss'))
+             for r, row in ranks.items()])
+        fam('rank_mem_skew', 'gauge',
+            'rank live device bytes over the cluster median',
+            [({'rank': r}, row.get('mem_skew'))
              for r, row in ranks.items()])
         strag = view.get('straggler')
         fam('straggler_rank', 'gauge',

@@ -134,11 +134,18 @@ class _Handler(BaseHTTPRequestHandler):
                         {'error': f'unknown rid {rid!r}'}))
                 else:
                     self._send(200, json.dumps(doc, indent=1))
+            elif path == '/memory.json':
+                # the memory observatory's three-way table (predicted
+                # vs compiled vs live) — module-global state, so every
+                # metrics server in the process serves it without any
+                # wiring
+                from . import memory as _mem
+                self._send(200, json.dumps(_mem.snapshot(), indent=1))
             elif self._try_source(path, sources):
                 pass
             elif path == '/':
                 routes = ['/healthz', '/status.json', '/metrics',
-                          '/requests/<rid>']
+                          '/requests/<rid>', '/memory.json']
                 for name in sorted(sources):
                     routes += [f'/{name}/status.json',
                                f'/{name}/metrics']

@@ -262,7 +262,8 @@ class LiveAggregator:
         self.admitted.add(rec.get('admitted') or 0, now)
         self.preempted.add(rec.get('preempted') or 0, now)
         for k in ('live', 'batch', 'span', 'queued', 'free_blocks',
-                  'total_blocks', 'intervention'):
+                  'total_blocks', 'intervention', 'kv_frag_frac',
+                  'kv_largest_free_run', 'kv_high_water'):
             if rec.get(k) is not None:
                 self.gauges[k] = rec[k]
         free = rec.get('free_blocks')
@@ -319,6 +320,8 @@ class LiveAggregator:
         # the same alert ring /status.json surfaces
         'straggler_suspect': _on_alert,
         'rank_divergence': _on_alert,
+        # the memory observatory's actuation edge (MemoryMonitor)
+        'memory_pressure': _on_alert,
     }
 
     # -- reads ---------------------------------------------------------------
@@ -468,6 +471,15 @@ class LiveAggregator:
             [({}, g.get('kv_occupancy'))])
         fam('serve_free_blocks', 'gauge', 'free KV pool blocks',
             [({}, g.get('free_blocks'))])
+        fam('serve_kv_frag_frac', 'gauge',
+            'KV pool fragmentation (1 - largest free run / free)',
+            [({}, g.get('kv_frag_frac'))])
+        fam('serve_kv_largest_free_run', 'gauge',
+            'largest contiguous free KV block run',
+            [({}, g.get('kv_largest_free_run'))])
+        fam('serve_kv_high_water_blocks', 'gauge',
+            'lifetime peak of simultaneously owned KV blocks',
+            [({}, g.get('kv_high_water'))])
         fam('serve_queue_depth', 'gauge', 'queued requests',
             [({}, g.get('queued'))])
         fam('serve_active_lanes', 'gauge', 'live decode lanes',

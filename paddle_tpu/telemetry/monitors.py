@@ -42,7 +42,7 @@ every collect:
 import time
 from collections import deque
 
-__all__ = ['SLOMonitor', 'DriftMonitor']
+__all__ = ['SLOMonitor', 'DriftMonitor', 'MemoryMonitor']
 
 _MONO = time.monotonic
 
@@ -297,3 +297,71 @@ class DriftMonitor:
                        max_step=view.get('max_step'))
             self.detections.append(ev or dict(kind='rank_divergence',
                                               spread=spread))
+
+
+class MemoryMonitor:
+    """Live HBM high-water vs budget, latched exactly-once.
+
+    Observes the boundary-rate ``memory_sample`` records the
+    :class:`telemetry.memory.MemorySampler` emits (device bytes from
+    ``memory_stats()`` on TPU, the live-arrays census on CPU) and
+    fires ONE ``memory_pressure`` event when the live bytes cross
+    ``budget_bytes * watermark`` — the edge the plan supervisor
+    re-plans on with a tightened ``hbm_budget_gb``.  Re-arms with
+    hysteresis (bytes back under ``watermark * rearm_frac`` of the
+    budget) and on ``plan_swap`` (a new plan means a new memory
+    footprint: the next breach is a fresh edge).
+
+    budget_bytes    the live-bytes allowance.  Defaults to the
+                    sampler's own MemConfig budget (budget_gb in the
+                    PADDLE_TPU_MEMSTATS grammar) when a config is
+                    given; without any budget the monitor is dormant.
+    watermark       breach threshold as a fraction of budget (0.9).
+    rearm_frac      hysteresis fraction of the firing threshold.
+    """
+
+    def __init__(self, budget_bytes=None, config=None, watermark=None,
+                 rearm_frac=None):
+        if config is not None:
+            if budget_bytes is None:
+                budget_bytes = config.budget_bytes
+            if watermark is None:
+                watermark = config.watermark
+            if rearm_frac is None:
+                rearm_frac = config.rearm_frac
+        self.budget_bytes = (None if budget_bytes is None
+                             else int(budget_bytes))
+        self.watermark = 0.9 if watermark is None else float(watermark)
+        self.rearm_frac = 0.7 if rearm_frac is None else float(rearm_frac)
+        self._latched = set()
+        self.breaches = []              # local record (tests/reports)
+
+    def observe(self, rec, agg):
+        kind = rec.get('kind')
+        if kind == 'plan_swap':
+            # the swapped-in plan reshapes the footprint (that was the
+            # point of the re-plan): the next breach is a fresh edge
+            self._latched.clear()
+            return
+        if kind != 'memory_sample' or self.budget_bytes is None:
+            return
+        observed = rec.get('device_bytes')
+        if observed is None:
+            return
+        threshold = self.budget_bytes * self.watermark
+        if 'memory' in self._latched:
+            if observed <= threshold * self.rearm_frac:
+                self._latched.discard('memory')      # re-arm
+            return
+        if observed > threshold:
+            self._latched.add('memory')
+            ev = _emit('memory_pressure',
+                       observed_bytes=int(observed),
+                       peak_bytes=rec.get('device_peak_bytes'),
+                       budget_bytes=self.budget_bytes,
+                       watermark=self.watermark,
+                       frac=round(observed / self.budget_bytes, 4),
+                       source=rec.get('source'))
+            self.breaches.append(ev or dict(
+                kind='memory_pressure', observed_bytes=int(observed),
+                budget_bytes=self.budget_bytes))

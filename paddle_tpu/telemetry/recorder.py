@@ -155,6 +155,21 @@ EVENT_KINDS = (
                            # wrapped, order-graph edges, cycles,
                            # unguarded accesses, worst hold time) —
                            # one per armed window
+    'memory_compiled',     # XLA memory_analysis of one compiled
+                           # module (argument/output/temp/alias/code
+                           # bytes + the PR-4 liveness prediction and
+                           # their ratio) — telemetry.memory extracts
+                           # at the compile choke points
+    'memory_sample',       # one MemorySampler tick: live device
+                           # bytes (memory_stats or the live-arrays
+                           # census), high-water, host RSS —
+                           # boundary-rate, default OFF
+                           # (PADDLE_TPU_MEMSTATS)
+    'memory_pressure',     # the live high-water crossed the budget
+                           # watermark (telemetry.monitors
+                           # MemoryMonitor; latched exactly-once like
+                           # slo_breach) — the supervisor re-plans on
+                           # it with a tightened hbm budget
 )
 
 _WALL = time.time

@@ -57,6 +57,12 @@ os.environ.setdefault('PADDLE_TPU_SUPERVISOR', '0')
 # first-armed-wins would make arming order test-order-dependent) —
 # lockcheck-behavior tests arm install()/maybe_install(True) explicitly
 os.environ.setdefault('PADDLE_TPU_LOCKCHECK', '0')
+# ...and for the memory observatory: an ambient PADDLE_TPU_MEMSTATS
+# would arm the live sampler thread plus the armed extraction paths
+# (an extra lower().compile() per hapi/jit/serving module) under every
+# test — memstats-behavior tests pass memstats= / monkeypatch
+# explicitly
+os.environ.setdefault('PADDLE_TPU_MEMSTATS', '0')
 
 import jax  # noqa: E402
 

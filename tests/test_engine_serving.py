@@ -195,9 +195,33 @@ class TestBlockAllocator:
             assert problems == [], f'step {step}: {problems}'
             used = sum(blocks_for(n, 4) for n in live.values())
             assert c.free_blocks == 16 - used
+            # frag_report invariants hold at every churn step: the
+            # observatory's pool-shape numbers must stay consistent
+            # with the allocator truth no matter the interleaving
+            fr = c.frag_report()
+            assert fr['usable_blocks'] == 16
+            assert fr['free_blocks'] == c.free_blocks
+            assert fr['owned_blocks'] == used
+            assert fr['owned_seqs'] == len(live)
+            assert 0 <= fr['largest_free_run'] <= fr['free_blocks']
+            if fr['free_blocks']:
+                assert fr['free_runs'] >= 1
+                assert 0.0 <= fr['frag_frac'] < 1.0
+            else:
+                assert fr['free_runs'] == 0
+                assert fr['frag_frac'] == 0.0
+            assert fr['seq_spread_max'] >= fr['seq_spread_mean'] >= \
+                (1.0 if live else 0.0)
+            assert fr['high_water_blocks'] >= used
         for sid in list(live):
             c.free_seq(sid)
         assert c.free_blocks == 16 and c.audit() == []
+        fr = c.frag_report()
+        # drained pool: every usable block free, one solid span again
+        # would be ideal but free-list order is eviction-dependent —
+        # the invariants that MUST hold are exact counts + high water
+        assert fr['free_blocks'] == 16 and fr['owned_seqs'] == 0
+        assert fr['high_water_blocks'] >= 1
 
 
 class TestSchedulerHost:

@@ -53,8 +53,11 @@ DEFAULT_ALPHA_US = 1.0      # costmodel.DEFAULT_LINK_LATENCY_US
 
 
 def harvest(paths):
-    """(samples, sources): samples = {op: [(phases, wire_bytes, us)]}."""
-    samples, sources = {}, []
+    """(samples, mem_samples, sources):
+    samples = {op: [(phases, wire_bytes, us)]};
+    mem_samples = [(predicted_peak_bytes, compiled_peak_bytes)] from
+    ``memory_compiled`` events / run_report ``memory`` sections."""
+    samples, mem_samples, sources = {}, [], []
     jsonls, flights = run_report.discover(paths)
     report_docs = []
     kept_flights = []
@@ -72,9 +75,17 @@ def harvest(paths):
     if jsonls or kept_flights:
         events, srcs, _skew = run_report.load_events(jsonls,
                                                      kept_flights)
-        n = 0
+        n = m = 0
         for e in events:
-            if e.get('kind') != 'collective_observed':
+            kind = e.get('kind')
+            if kind == 'memory_compiled':
+                pred = e.get('predicted_peak_bytes')
+                comp = e.get('compiled_peak_bytes')
+                if pred and comp:
+                    mem_samples.append((float(pred), float(comp)))
+                    m += 1
+                continue
+            if kind != 'collective_observed':
                 continue
             op = e.get('op')
             us = e.get('us')
@@ -86,9 +97,9 @@ def harvest(paths):
                 (float(phases), float(wire), float(us)))
             n += 1
         sources.append({'type': 'events', 'files': len(srcs),
-                        'samples': n})
+                        'samples': n, 'mem_samples': m})
     for f, doc in report_docs:
-        n = 0
+        n = m = 0
         for op, row in (doc.get('collectives_cmp') or {}).items():
             us = row.get('observed_us')
             wire = row.get('observed_wire_bytes') \
@@ -100,8 +111,16 @@ def harvest(paths):
             samples.setdefault(op, []).append(
                 (float(phases), float(wire), float(us)))
             n += 1
-        sources.append({'type': 'run_report', 'file': f, 'samples': n})
-    return samples, sources
+        mem = doc.get('memory') or {}
+        for name, row in (mem.get('modules') or {}).items():
+            pred = row.get('predicted_peak_bytes')
+            comp = row.get('compiled_peak_bytes')
+            if pred and comp:
+                mem_samples.append((float(pred), float(comp)))
+                m += 1
+        sources.append({'type': 'run_report', 'file': f,
+                        'samples': n, 'mem_samples': m})
+    return samples, mem_samples, sources
 
 
 def fit_op(rows, *, min_samples=2, default_alpha=DEFAULT_ALPHA_US):
@@ -137,6 +156,26 @@ def fit_op(rows, *, min_samples=2, default_alpha=DEFAULT_ALPHA_US):
             'mode': mode}
 
 
+def fit_peak_memory(rows):
+    """Fit the liveness estimator's bias from (predicted, compiled)
+    peak-byte pairs: least squares through the origin on
+    ``compiled ~ bias * predicted``.  The planner multiplies its
+    liveness peak by this bias before the HBM gate, so a bias > 1
+    (estimator runs light vs what XLA actually reserves) makes the
+    gate conservative.  Returns a per_op-style row under the
+    ``peak_memory`` pseudo-kind, or None without usable samples."""
+    rows = [(p, c) for p, c in rows if p > 0 and c > 0]
+    if not rows:
+        return None
+    spp = sum(p * p for p, _ in rows)
+    spc = sum(p * c for p, c in rows)
+    bias = spc / spp if spp > 0 else 1.0
+    n = len(rows)
+    resid = (sum((c - bias * p) ** 2 for p, c in rows) / n) ** 0.5
+    return {'bias': round(bias, 6), 'samples': n,
+            'residual_bytes': round(resid, 1)}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog='calibrate_costmodel',
@@ -157,25 +196,35 @@ def main(argv=None):
                     help='also print the table to stdout')
     args = ap.parse_args(argv)
 
-    samples, sources = harvest(args.paths)
-    if not samples:
-        print('calibrate_costmodel: no collective_observed samples '
-              f'under {args.paths} (a chip session that profiles its '
-              'collectives emits them; run_report --json docs with '
-              'observed_us also work)', file=sys.stderr)
+    samples, mem_samples, sources = harvest(args.paths)
+    if not samples and not mem_samples:
+        print('calibrate_costmodel: no collective_observed or '
+              f'memory_compiled samples under {args.paths} (a chip '
+              'session that profiles its collectives emits the '
+              'former, any compile choke point the latter; '
+              'run_report --json docs also work)', file=sys.stderr)
         return 2
     per_op = {op: fit_op(rows, min_samples=args.min_samples)
               for op, rows in sorted(samples.items())}
+    mem_row = fit_peak_memory(mem_samples)
+    if mem_row is not None:
+        per_op['peak_memory'] = mem_row
     doc = {'version': CALIBRATION_VERSION, 'per_op': per_op,
            'meta': {'sources': sources,
                     'total_samples': sum(len(r)
-                                         for r in samples.values())}}
+                                         for r in samples.values())
+                    + len(mem_samples)}}
     with open(args.output, 'w') as f:
         json.dump(doc, f, indent=1, sort_keys=True)
     if args.json:
         print(json.dumps(doc, indent=1, sort_keys=True))
     else:
         for op, row in per_op.items():
+            if op == 'peak_memory':
+                print(f'{op}: bias={row["bias"]} '
+                      f'(compiled/predicted; {row["samples"]} '
+                      f'samples, rms {row["residual_bytes"]} B)')
+                continue
             print(f'{op}: alpha={row["alpha_us"]} us/hop  '
                   f'beta={row["beta_us_per_byte"]:.3e} us/B  '
                   f'({row["samples"]} samples, {row["mode"]}, '

@@ -117,6 +117,48 @@ def preflight(timeout_s=90):
         return False
 
 
+def collect_memsnap(name, timeout_s=120):
+    """Archive a device-memory snapshot right after a step finishes
+    (memory observatory satellite): per-device HBM stats from
+    ``device.memory_stats()`` plus host RSS land in
+    tools/chip_out/<step>.mem.json.  Runs in a child — same rule as
+    preflight: a wedged tunnel must not hang the session driver — and
+    a probe failure only logs; the step's own verdict stands."""
+    code = (
+        'import json\n'
+        'import jax\n'
+        'from paddle_tpu.telemetry import memory as mem\n'
+        'print(json.dumps({\n'
+        '    "platform": jax.devices()[0].platform,\n'
+        '    "num_devices": len(jax.local_devices()),\n'
+        '    "devices": mem.device_memory_stats(),\n'
+        '    "host_rss_bytes": mem.host_rss_bytes(),\n'
+        '}))\n')
+    try:
+        p = subprocess.run([sys.executable, '-c', code], cwd=REPO,
+                           capture_output=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        log(f'{name}: memory snapshot probe timed out ({timeout_s}s)')
+        return
+    if p.returncode != 0:
+        log(f'{name}: memory snapshot probe failed '
+            f'(rc={p.returncode})')
+        return
+    try:
+        snap = json.loads(p.stdout.decode().strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        log(f'{name}: memory snapshot probe emitted no JSON')
+        return
+    snap['step'] = name
+    snap['t'] = time.time()
+    with open(os.path.join(OUT, f'{name}.mem.json'), 'w') as fh:
+        json.dump(snap, fh, indent=1)
+    rows = snap.get('devices') or []
+    log(f'{name}: memory snapshot archived '
+        f'({snap.get("num_devices", 0)} device(s), '
+        f'{len(rows)} with HBM stats)')
+
+
 def collect_flightrecs(name):
     """Copy any telemetry flight-recorder dumps a step left behind
     (flightrec-*.json next to checkpoints / scratch dirs under the
@@ -206,10 +248,12 @@ def run_step(name, argv, timeout_s):
         except subprocess.TimeoutExpired:
             log(f'{name}: TIMED OUT after {timeout_s}s')
             collect_flightrecs(name)
+            collect_memsnap(name)
             commit_artifacts(name, ok=False)
             return False
     dt = time.time() - t0
     collect_flightrecs(name)
+    collect_memsnap(name)
     if p.returncode == 0:
         with open(okf, 'w') as fh:
             fh.write(json.dumps({'t': time.time(), 'dur_s': dt}))

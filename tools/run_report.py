@@ -83,7 +83,11 @@ RESILIENCE_KINDS = (
     # incident terminated (swap/hold/backoff/degraded + stage), and
     # the applied plan swap itself — the observe->act loop's act half
     # belongs on the same timeline as the sensor edges that caused it
-    'remediation', 'plan_swap')
+    'remediation', 'plan_swap',
+    # memory observatory (telemetry.memory + MemoryMonitor): live
+    # bytes crossed the budget watermark — the edge the supervisor
+    # re-plans on with a tightened hbm_budget_gb
+    'memory_pressure')
 
 # spans (kind='span', name=...) that belong on the resilience
 # timeline: the 2-phase commit barrier wait and the restore itself
@@ -106,6 +110,8 @@ RENDERED_KINDS = RESILIENCE_KINDS + (
     'serve_step', 'serve_request', 'serve_trace',  # serving section
     'lint_finding',         # lint section
     'span',                 # spans table + resilience span rows
+    'memory_compiled',      # memory section: per-module three-way rows
+    'memory_sample',        # memory section: live sampler ticks
 )
 IGNORED_KINDS = {
     'run_meta': 'per-run header (argv/rank/backend): provenance '
@@ -560,6 +566,48 @@ def analyze(events, sources, skew=None):
             'traces': traces,
         }
 
+    # -- memory: predicted vs compiled vs live ---------------------
+    # One row per compiled module (newest memory_compiled wins — a
+    # retrace replaces its module's row, same as the live registry),
+    # joined with the sampler's live stream.  The predicted/compiled
+    # ratio is the memory analogue of collectives_cmp's us_ratio: the
+    # number calibration is meant to pull toward 1.0 so the planner's
+    # HBM gate stops lying.
+    memory = None
+    mem_compiled = by_kind.get('memory_compiled', [])
+    mem_samples = by_kind.get('memory_sample', [])
+    if mem_compiled or mem_samples:
+        modules = {}
+        for e in mem_compiled:
+            modules[e.get('name', '?')] = {
+                k: e.get(k) for k in (
+                    'source', 'predicted_peak_bytes',
+                    'compiled_peak_bytes', 'argument_bytes',
+                    'output_bytes', 'temp_bytes', 'alias_bytes',
+                    'code_bytes', 'ratio')
+                if e.get(k) is not None}
+        ratios = [row['ratio'] for row in modules.values()
+                  if row.get('ratio') is not None]
+        live = None
+        if mem_samples:
+            last = mem_samples[-1]
+            live = {k: last.get(k) for k in (
+                'source', 'device_bytes', 'device_peak_bytes',
+                'device_limit_bytes', 'host_rss', 'budget_bytes')
+                if last.get(k) is not None}
+            live['samples'] = len(mem_samples)
+            peaks = [s.get('device_bytes') for s in mem_samples
+                     if s.get('device_bytes') is not None]
+            if peaks:
+                live['max_device_bytes'] = max(peaks)
+        memory = {
+            'modules': modules,
+            'live': live,
+            'ratio_mean': (round(sum(ratios) / len(ratios), 4)
+                           if ratios else None),
+            'pressure_events': len(by_kind.get('memory_pressure', ())),
+        }
+
     # -- lint findings -------------------------------------------
     lint = {}
     for e in by_kind.get('lint_finding', ()):
@@ -592,7 +640,9 @@ def analyze(events, sources, skew=None):
                   'trigger', 'policy', 'outcome', 'stage',
                   'triggers', 'kinds', 'from_mesh', 'to_mesh',
                   'assignment', 'candidate_s', 'incumbent_s',
-                  'margin', 'seq'):
+                  'margin', 'seq',
+                  'observed_bytes', 'peak_bytes', 'budget_bytes',
+                  'watermark', 'frac', 'source', 'hbm_budget_gb'):
             if e.get(k) is not None:
                 row[k] = e[k]
         timeline.append(row)
@@ -713,6 +763,7 @@ def analyze(events, sources, skew=None):
         'plan': plan,
         'profile': profile,
         'serving': serving,
+        'memory': memory,
         'clock_skew': skew or {},
         'cluster': cluster,
         'watchdog': watchdog,
@@ -876,6 +927,41 @@ def render(report, stream=None):
         if len(rows) > 8:
             p(f'      ... {len(rows) - 8} more request(s) '
               '(--json has all)')
+    if report.get('memory'):
+        mem = report['memory']
+        p('\n-- memory (predicted vs compiled vs live) --')
+        mods = mem.get('modules') or {}
+        if mods:
+            p(f'    {"module":<26}{"predicted":>14}{"compiled":>14}'
+              f'{"ratio":>8}')
+            for name, row in sorted(mods.items()):
+                pred = row.get('predicted_peak_bytes')
+                comp = row.get('compiled_peak_bytes')
+                ratio = row.get('ratio')
+                p(f'    {name:<26}'
+                  f'{(f"{pred:,} B" if pred is not None else "-"):>14}'
+                  f'{(f"{comp:,} B" if comp is not None else "-"):>14}'
+                  f'{(f"x{ratio:.2f}" if ratio is not None else "-"):>8}')
+        if mem.get('ratio_mean') is not None:
+            p(f'    mean predicted/compiled ratio: '
+              f'x{mem["ratio_mean"]:.2f} (calibration pulls this '
+              'toward 1.0)')
+        live = mem.get('live')
+        if live:
+            bits = [f'{live["samples"]} sample(s) '
+                    f'[{live.get("source", "?")}]']
+            if live.get('device_bytes') is not None:
+                bits.append(f'{live["device_bytes"]:,} B live')
+            if live.get('max_device_bytes') is not None:
+                bits.append(f'{live["max_device_bytes"]:,} B high-water')
+            if live.get('host_rss') is not None:
+                bits.append(f'rss {live["host_rss"]:,} B')
+            if live.get('budget_bytes') is not None:
+                bits.append(f'budget {live["budget_bytes"]:,} B')
+            p(f'    live: {"  ".join(bits)}')
+        if mem.get('pressure_events'):
+            p(f'    MEMORY PRESSURE: {mem["pressure_events"]} '
+              'event(s) (see resilience timeline)')
     if report.get('cluster'):
         cl = report['cluster']
         p('\n-- cluster (per-rank step skew) --')
