@@ -1189,6 +1189,261 @@ def _threads_preflight(timeout_s=900):
     return ok, summary
 
 
+def _spmd_smoke_child():
+    """--spmd-smoke child: the SPMD-contract runtime evidence —
+
+    (a) INJECTED: a 2-proc ChaosCluster with a rank-gated skipped
+        collective (``collective_skip`` on rank 1): the merged run
+        telemetry must contain a ``collective_mismatch`` event that
+        names the exact seeded call site (the soak worker's allreduce
+        line) no later than the first generic ``timeout`` event, with
+        invariants I1-I7 and bit-exact finals intact;
+    (b) UNINJECTED twin (same cluster shape, empty plan): zero
+        ``collective_mismatch`` events;
+    (c) a ledger-ON trainer loop under a device->host transfer guard
+        (the ledger must add no syncs), bit-exact with equal compile
+        counts vs a ledger-OFF run.
+
+    Emits one JSON line the parent asserts on."""
+    import tempfile
+    import contextlib
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, telemetry
+    from paddle_tpu.resilience.chaos import (
+        ChaosCluster, FaultPlan, load_run_events)
+
+    out = {}
+    repo = os.path.dirname(os.path.abspath(__file__))
+    # the seeded call site: the soak worker's per-step allreduce
+    site_line = None
+    with open(os.path.join(repo, 'tools', 'soak_run.py')) as f:
+        for no, line in enumerate(f, 1):
+            if "transport.allreduce(w, 'mean'" in line:
+                site_line = no
+                break
+    out['seeded_site'] = (f'soak_run.py:{site_line}'
+                         if site_line else None)
+
+    def _spin(faults, tag):
+        plan = FaultPlan(seed=11, name=f'spmd-smoke-{tag}',
+                         faults=faults)
+        cluster = ChaosCluster(
+            procs=2, plan=plan, steps=10, save_every=2,
+            collective_timeout_s=8.0, watchdog='step=60,grace=2',
+            deadline_s=150.0)
+        rep = cluster.run()
+        events = load_run_events(cluster.workdir)
+        return rep, events
+
+    # -- (a) injected skip ----------------------------------------------
+    try:
+        rep, events = _spin(
+            [{'kind': 'collective_skip', 'at_step': 5, 'rank': 1,
+              'count': 1}], 'injected')
+        mm = [e for e in events
+              if e.get('kind') == 'collective_mismatch']
+        to = [e for e in events if e.get('kind') == 'timeout']
+        out['injected_ok'] = rep.get('ok')
+        out['injected_rc'] = rep.get('rc')
+        out['violations'] = (rep.get('violations') or [])[:4]
+        out['skip_injected'] = any(
+            e.get('fault') == 'collective_skip'
+            for e in rep.get('injected', ()))
+        out['mismatch_events'] = len(mm)
+        out['timeout_events'] = len(to)
+        sites = [s for e in mm
+                 for s in (e.get('sites') or {}).values()]
+        out['mismatch_sites'] = sorted(set(sites))[:4]
+        out['site_attributed'] = bool(
+            out['seeded_site'] and out['seeded_site'] in sites)
+        if mm and to:
+            out['mismatch_before_timeout'] = (
+                min(e.get('ts') or 0 for e in mm)
+                <= min(e.get('ts') or 0 for e in to))
+    except Exception as e:
+        out['injected_error'] = repr(e)[:300]
+
+    # -- (b) uninjected twin --------------------------------------------
+    try:
+        rep, events = _spin([], 'twin')
+        out['twin_ok'] = rep.get('ok')
+        out['twin_mismatch_events'] = len(
+            [e for e in events
+             if e.get('kind') == 'collective_mismatch'])
+    except Exception as e:
+        out['twin_error'] = repr(e)[:300]
+
+    # -- (c) ledger-on trainer: sync-free, bit-exact, equal compiles ----
+    rs = np.random.RandomState(0)
+    X = rs.randn(8, 16).astype('float32')
+    Y = rs.randn(8, 4).astype('float32')
+
+    def _losses(ledger_on):
+        from paddle_tpu.distributed.collective import reset_ledgers
+        os.environ['PADDLE_TPU_COLLECTIVE_LEDGER'] = \
+            '1' if ledger_on else '0'
+        reset_ledgers()
+        telemetry.reset()
+        telemetry.enable(None, flush_interval=4)
+        try:
+            paddle.seed(0)
+            net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                                nn.Linear(32, 4))
+            opt = paddle.optimizer.Momentum(
+                learning_rate=0.01, parameters=net.parameters())
+            from paddle_tpu.parallel import ParallelTrainer
+            tr = ParallelTrainer(net, opt,
+                                 lambda o, y: ((o - y) ** 2).mean())
+            tr.step(X, Y)           # compile outside the guard
+            guard = (jax.transfer_guard_device_to_host('disallow')
+                     if ledger_on else contextlib.nullcontext())
+            losses = []
+            with guard:
+                for _ in range(6):
+                    losses.append(tr.step(X, Y))
+            compiles = len(telemetry.events('compile'))
+            return [float(np.asarray(l)) for l in losses], compiles
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+            os.environ.pop('PADDLE_TPU_COLLECTIVE_LEDGER', None)
+
+    try:
+        on_losses, on_compiles = _losses(True)
+        out['sync_free_ok'] = True
+        off_losses, off_compiles = _losses(False)
+        out['bit_exact'] = on_losses == off_losses
+        out['equal_compiles'] = on_compiles == off_compiles
+    except Exception as e:
+        out['sync_free_ok'] = False
+        out['sync_free_error'] = repr(e)[:300]
+    print(json.dumps(out))
+
+
+def _spmd_preflight(timeout_s=900):
+    """--spmd-smoke gate: the SPMD contract must hold before chip
+    time — (a) the static sweep (tpu_lint --spmd) over paddle_tpu/ +
+    tools/ must report zero HIGH findings, and (b) the armed runtime
+    smoke: an injected rank-gated skipped collective in a 2-proc
+    ChaosCluster must be attributed (``collective_mismatch`` naming
+    the seeded call site, no later than the generic timeout) with
+    I1-I7 intact, the uninjected twin must emit zero mismatch events,
+    and the ledger-ON trainer loop must be sync-free and bit-exact
+    with equal compiles vs ledger-OFF.
+
+    Returns (ok, summary).  Infra failures (timeout, child crash)
+    never block the bench — evidence beats a dead gate — but a HIGH
+    lint finding, a missed/ghost attribution, a broken invariant, or
+    a perturbed trainer always does."""
+    import subprocess
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    env['XLA_FLAGS'] = ' '.join(
+        [t for t in env.get('XLA_FLAGS', '').split()
+         if not t.startswith('--xla_force_host_platform_device_count')]
+        + ['--xla_force_host_platform_device_count=8'])
+    failures = []
+    summary = {}
+    # -- (a) static sweep: zero HIGH across package + tools --------------
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, 'tools', 'tpu_lint.py'),
+             'paddle_tpu/', 'tools/', '--spmd', '--json', '--fail-on',
+             'never'],
+            capture_output=True, text=True, timeout=timeout_s,
+            env=env, cwd=repo)
+        doc = json.loads(proc.stdout)
+    except Exception as e:
+        log(f'spmd lint sweep skipped ({e!r})')
+        doc = None
+    if doc is not None:
+        summary['lint'] = {'counts': doc.get('counts'),
+                           'files': (doc.get('extras', {})
+                                     .get('spmd', {}).get('files'))}
+        high = (doc.get('counts') or {}).get('high', 0)
+        if high:
+            rules = sorted({f.get('rule') for f in doc.get('findings',
+                                                           ())
+                            if f.get('severity') == 'high'})
+            failures.append(f'{high} HIGH SPMD finding(s) in '
+                            f'paddle_tpu/ + tools/ '
+                            f'({", ".join(rules)})')
+    # -- (b) armed runtime smoke -----------------------------------------
+    cmd = [sys.executable, os.path.abspath(__file__),
+           '--spmd-smoke-child']
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=env)
+        doc = _last_json_dict(proc.stdout)
+    except Exception as e:
+        log(f'spmd smoke skipped ({e!r})')
+        doc = {'error': repr(e)[:200]}
+    if doc is None:
+        log(f'spmd smoke skipped (no child output, '
+            f'rc={proc.returncode}): {proc.stderr[-300:]}')
+        doc = {'error': f'no output (rc={proc.returncode})'}
+    summary['smoke'] = {k: doc.get(k) for k in
+                        ('seeded_site', 'injected_ok', 'skip_injected',
+                         'mismatch_events', 'timeout_events',
+                         'mismatch_sites', 'site_attributed',
+                         'mismatch_before_timeout', 'twin_ok',
+                         'twin_mismatch_events', 'sync_free_ok',
+                         'bit_exact', 'equal_compiles',
+                         'injected_error', 'twin_error',
+                         'sync_free_error', 'error')}
+    if 'error' not in doc:
+        if doc.get('injected_error'):
+            failures.append('injected cluster spin crashed: '
+                            + str(doc['injected_error']))
+        else:
+            if doc.get('injected_ok') is False:
+                failures.append('invariants I1-I7 / finals broke '
+                                'under the injected skip: '
+                                f'{doc.get("violations")}')
+            if doc.get('skip_injected') and not doc.get(
+                    'site_attributed'):
+                failures.append(
+                    'collective_mismatch missed the seeded call site '
+                    f'(wanted {doc.get("seeded_site")}, saw '
+                    f'{doc.get("mismatch_sites")})')
+            if doc.get('mismatch_events') and doc.get(
+                    'timeout_events') and not doc.get(
+                    'mismatch_before_timeout'):
+                failures.append('attribution arrived AFTER the '
+                                'generic watchdog timeout')
+        if doc.get('twin_error'):
+            failures.append('uninjected twin spin crashed: '
+                            + str(doc['twin_error']))
+        elif doc.get('twin_mismatch_events'):
+            failures.append(
+                f'{doc["twin_mismatch_events"]} ghost '
+                'collective_mismatch event(s) in the clean twin run')
+        if doc.get('sync_free_ok') is False:
+            failures.append('ledger-ON trainer loop synced '
+                            'device->host: '
+                            + str(doc.get('sync_free_error')))
+        if 'bit_exact' in doc and not doc.get('bit_exact'):
+            failures.append('ledger-ON vs ledger-OFF trainer losses '
+                            'diverged (recording perturbed training)')
+        if 'equal_compiles' in doc and not doc.get('equal_compiles'):
+            failures.append('ledger-ON vs ledger-OFF compile counts '
+                            'differ (recording perturbed tracing)')
+    summary['failures'] = failures
+    ok = not failures
+    sm = summary.get('smoke', {})
+    log(f'spmd preflight: {"ok" if ok else "FAIL"} '
+        f'(high={((summary.get("lint") or {}).get("counts") or {}).get("high")}, '
+        f'mismatch={sm.get("mismatch_events")}, '
+        f'site={sm.get("site_attributed")}, '
+        f'twin={sm.get("twin_mismatch_events")}, '
+        f'bit_exact={sm.get("bit_exact")})')
+    for f in failures:
+        log(f'  {f}')
+    return ok, summary
+
+
 def _plan_preflight(timeout_s=600):
     """--plan-smoke gate: run the auto-sharding planner
     (tools/tpu_lint.py --plan) over the built-in gpt/widedeep/lenet
@@ -2924,6 +3179,21 @@ def main():
     p.add_argument('--threads-smoke-child', action='store_true',
                    help='(internal) run the threads-smoke armed '
                         'measurement and emit its JSON')
+    p.add_argument('--spmd-smoke', action='store_true',
+                   help='preflight gate: the SPMD contract — the '
+                        'static sweep (tpu_lint --spmd) over '
+                        'paddle_tpu/ + tools/ must report zero HIGH '
+                        'findings, and a 2-proc ChaosCluster with a '
+                        'rank-gated skipped collective injected must '
+                        'attribute collective_mismatch to the exact '
+                        'seeded call site (no later than the generic '
+                        'timeout) with I1-I7 intact, a clean twin '
+                        'emitting zero mismatch events, and the '
+                        'ledger-ON trainer loop sync-free + '
+                        'bit-exact vs ledger-OFF')
+    p.add_argument('--spmd-smoke-child', action='store_true',
+                   help='(internal) run the spmd-smoke armed '
+                        'measurement and emit its JSON')
     p.add_argument('--telemetry-dir', default=None,
                    help='(internal) telemetry JSONL dir for '
                         '--cache-smoke-child / --profile-smoke-child')
@@ -2959,6 +3229,10 @@ def main():
 
     if args.threads_smoke_child:
         _threads_smoke_child()
+        return
+
+    if args.spmd_smoke_child:
+        _spmd_smoke_child()
         return
 
     if args.serve_smoke_child:
@@ -2999,6 +3273,7 @@ def main():
     quant_summary = None
     supervisor_summary = None
     threads_summary = None
+    spmd_summary = None
     if args.threads_smoke:
         threads_ok, threads_summary = _threads_preflight()
         if not threads_ok:
@@ -3017,6 +3292,28 @@ def main():
                          'flagged runtime code or re-run without '
                          '--threads-smoke',
                 'threads': threads_summary, 'extras': {}}))
+            sys.exit(1)
+    if args.spmd_smoke:
+        spmd_ok, spmd_summary = _spmd_preflight()
+        if not spmd_ok:
+            # a HIGH SPMD finding means a rank-gated collective or
+            # unbroadcast host entropy can deadlock or silently
+            # diverge the fleet; a missed attribution means the
+            # flight recorder can't name the first divergent
+            # collective when it matters; a ghost mismatch or a
+            # perturbed trainer means the ledger itself is unsafe to
+            # leave on — fail before burning chip time
+            print(json.dumps({
+                'metric': METRIC_NAMES['resnet'], 'value': None,
+                'unit': UNITS['resnet'], 'vs_baseline': None,
+                'error': 'spmd preflight failed (HIGH SPMD lint '
+                         'finding, missed or late collective_mismatch '
+                         'attribution, ghost mismatch on a clean run, '
+                         'broken chaos invariants, or ledger-on '
+                         'trainer divergence); fix the flagged '
+                         'collective code or re-run without '
+                         '--spmd-smoke',
+                'spmd': spmd_summary, 'extras': {}}))
             sys.exit(1)
     if args.supervisor_smoke:
         sup_ok, supervisor_summary = _supervisor_preflight()
@@ -3327,6 +3624,8 @@ def main():
         out['supervisor'] = supervisor_summary
     if threads_summary is not None:
         out['threads'] = threads_summary
+    if spmd_summary is not None:
+        out['spmd'] = spmd_summary
     if preflight_attempts:
         # non-empty only when at least one preflight try failed: the
         # diagnosis (timeout vs crash, rc, stderr tail) per attempt

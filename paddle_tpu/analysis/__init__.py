@@ -70,6 +70,12 @@ from .threads import (  # noqa: F401
 from . import lockcheck  # noqa: F401
 from .lockcheck import (  # noqa: F401
     LockChecker, resolve_lockcheck)
+# importing spmd also registers its HLO collective-order rule into
+# HLO_RULES, so every --hlo audit checks conditional branch parity
+from . import spmd  # noqa: F401
+from .spmd import (  # noqa: F401
+    lint_spmd_source, lint_spmd_file, lint_spmd_sources,
+    SPMD_RULES, register_spmd_rule)
 
 # the lowered-HLO SPMD audit (post-partitioner: sharding placement,
 # collective cost, per-device peak memory) — the escalation the
@@ -114,7 +120,9 @@ __all__ = ['lint', 'lint_sources', 'lint_layer', 'lint_hlo',
            'threads', 'lint_threads_source', 'lint_threads_file',
            'lint_threads_sources', 'THREAD_RULES',
            'register_thread_rule', 'lockcheck', 'LockChecker',
-           'resolve_lockcheck']
+           'resolve_lockcheck',
+           'spmd', 'lint_spmd_source', 'lint_spmd_file',
+           'lint_spmd_sources', 'SPMD_RULES', 'register_spmd_rule']
 
 
 def _leaf_ranges(example_args):

@@ -1,0 +1,628 @@
+"""SPMD contract lint — cross-rank divergence checks over the AST + HLO.
+
+The SPMD contract: every rank issues the *same* collective sequence
+with the same payload metadata (op, shape, dtype, axes).  Violations
+are the two worst multi-host failure classes:
+
+* a **rank-gated collective** (``if rank == 0: allreduce(...)``)
+  deadlocks — the gated ranks wait forever for a frame that never
+  arrives, and the watchdog can only report a generic timeout;
+* **host nondeterminism** (time, env, host ``random``, set/dict
+  iteration order) feeding traced values or collective payloads makes
+  ranks compute different programs/values — the ``rank_divergence``
+  cause class that PR-15 can detect but not attribute.
+
+Rules (``tools/tpu_lint.py --spmd <paths>``):
+
+``rank-dependent-collective`` (HIGH)
+    Control flow conditioned on ``process_index``/rank/trainer-id/env
+    guards a collective call site so it is reachable on only one side
+    of the branch.  Symmetric role splits (``if rank == src: post
+    else: fetch``) are the transport idiom and are not flagged —
+    ``post``/``fetch`` are two roles of one logical collective.
+
+``collective-order`` (WARN ast / HIGH hlo)
+    Per-path collective sequence extraction through a function (AST)
+    or HLO ``conditional``: all paths must issue identical
+    (op, shape, dtype, axes) sequences.  The HLO half registers into
+    the ``--hlo`` audit registry and joins ``hlo.collective_instrs``.
+
+``host-nondeterminism-into-trace`` (HIGH)
+    ``time.*``/``os.environ``/host ``random``/``os.getpid``/set
+    iteration feeding a collective payload (HIGH — ranks exchange
+    different values) or a traced constant via ``jnp.asarray`` (WARN —
+    per-rank traces diverge, retrace storms + value splits).
+    Sanitizer: routing the value through ``broadcast_object`` (every
+    rank receives the src rank's value).
+
+``unbroadcast-rng`` (WARN)
+    Host-local entropy (time/pid/urandom/host random) seeding
+    ``PRNGKey`` — every rank gets a *different* key stream where the
+    replicated-parameter contract expects the same one.  Derive
+    per-rank keys from a broadcast base key + ``fold_in(rank)``.
+
+Suppression: ``# tpu-lint: disable=rule-id`` on the finding line or
+the enclosing ``def`` line, same grammar as every other lint family.
+"""
+import ast
+
+from .findings import Finding, LintReport, HIGH, WARN, INFO
+from .ast_lint import (
+    _is_suppressed, _def_spans, _enclosing_def_lines, _dotted_last)
+
+__all__ = [
+    'SPMD_RULES', 'register_spmd_rule',
+    'lint_spmd_source', 'lint_spmd_file', 'lint_spmd_sources',
+    'HOST_COLLECTIVE_OPS', 'DEVICE_COLLECTIVE_OPS',
+]
+
+# -- what counts as a collective ---------------------------------------------
+
+# HostCollectives methods (and the module-level wrappers around them).
+# ``post``/``fetch`` are the two roles of one KV-framed collective, so
+# sequence comparison normalizes them to one label: a branch that posts
+# while the other fetches is the broadcast idiom, not a divergence.
+HOST_COLLECTIVE_OPS = frozenset({
+    'allreduce', 'allgather', 'allgather_object', 'broadcast_object',
+    'barrier_host', '_exchange', 'post', 'fetch',
+})
+
+# In-trace (lax / shard_map) collectives.
+DEVICE_COLLECTIVE_OPS = frozenset({
+    'psum', 'pmean', 'pmax', 'pmin', 'all_gather', 'ppermute',
+    'all_to_all', 'psum_scatter', 'pgather',
+})
+
+_ALL_COLLECTIVE_OPS = HOST_COLLECTIVE_OPS | DEVICE_COLLECTIVE_OPS
+
+# Explicitly NOT collectives: the non-blocking stats side channel and
+# read-only peers.  Listed so the distinction is greppable.
+_NON_COLLECTIVE = frozenset({
+    'post_stats', 'read_stats', 'read_all_stats', 'read_heartbeats',
+})
+
+# Names whose value is rank identity.
+_RANK_NAMES = frozenset({
+    'rank', 'local_rank', 'process_index', 'trainer_id', 'proc_id',
+    'worker_id', 'host_id', 'task_id',
+})
+_RANK_ENV_TOKENS = ('RANK', 'TRAINER_ID', 'PROCESS', 'WORKER_ID',
+                    'TASK_INDEX')
+
+SPMD_RULES = {}
+
+
+def register_spmd_rule(rule_id, severity):
+    def deco(fn):
+        SPMD_RULES[rule_id] = (severity, fn)
+        return fn
+    return deco
+
+
+# -- shared AST helpers -------------------------------------------------------
+
+class _FuncScope:
+    __slots__ = ('node', 'cls', 'start', 'end')
+
+    def __init__(self, node, cls):
+        self.node = node
+        self.cls = cls
+        self.start = node.lineno
+        self.end = getattr(node, 'end_lineno', node.lineno)
+
+
+class _Ctx:
+    """Parsed source + per-function index for one file."""
+
+    def __init__(self, tree, src, filename):
+        self.tree = tree
+        self.src = src
+        self.filename = filename
+        self.funcs = []
+        self._index(tree.body, None)
+
+    def _index(self, body, cls):
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                self._index(node.body, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.funcs.append(_FuncScope(node, cls))
+                self._index(node.body, cls)
+            elif isinstance(node, (ast.If, ast.For, ast.While, ast.With,
+                                   ast.Try)):
+                for field in ('body', 'orelse', 'finalbody'):
+                    self._index(getattr(node, field, []) or [], cls)
+                for h in getattr(node, 'handlers', []) or []:
+                    self._index(h.body, cls)
+
+
+def _walk_skip_defs(node):
+    """ast.walk over `node` without descending into nested defs."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _collective_label(node):
+    """The collective op label for a Call node, or None.
+
+    post/fetch normalize to 'post/fetch' so the src/dst role split of
+    one logical broadcast compares equal across branches.
+    """
+    if not isinstance(node, ast.Call):
+        return None
+    name = _dotted_last(node.func)
+    if name in _NON_COLLECTIVE:
+        return None
+    if name in _ALL_COLLECTIVE_OPS:
+        return 'post/fetch' if name in ('post', 'fetch') else name
+    return None
+
+
+def _collectives_in(nodes):
+    """(line, label) pairs for collective calls under `nodes`, in
+    source order, skipping nested function bodies."""
+    out = []
+    for root in nodes:
+        for n in _walk_skip_defs(root):
+            lab = _collective_label(n)
+            if lab is not None:
+                out.append((n.lineno, lab))
+        lab = _collective_label(root)
+        if lab is not None:
+            out.append((root.lineno, lab))
+    out.sort()
+    return out
+
+
+def _is_rank_expr(node):
+    """True when the expression's value derives from rank identity."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in _RANK_NAMES:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _RANK_NAMES:
+            return True
+        if isinstance(n, ast.Call):
+            fname = _dotted_last(n.func)
+            if fname in ('process_index', 'process_count'):
+                # process_count() alone is replicated; only the index
+                # diverges — but count rarely appears in guards alone.
+                if fname == 'process_index':
+                    return True
+            if fname in ('getenv', 'get') or isinstance(n.func, ast.Name):
+                for a in n.args:
+                    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                        if any(t in a.value for t in _RANK_ENV_TOKENS):
+                            return True
+        if isinstance(n, ast.Subscript):
+            # os.environ['PADDLE_TRAINER_ID']
+            sl = n.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                if any(t in sl.value for t in _RANK_ENV_TOKENS):
+                    return True
+    return False
+
+
+def _terminates(body):
+    """True when the statement list always leaves the function/loop."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Return, ast.Raise, ast.Break,
+                             ast.Continue)):
+            return True
+    return False
+
+
+def _cond_text(node, src_lines):
+    try:
+        return ast.unparse(node).strip()[:60]
+    except Exception:
+        line = getattr(node, 'lineno', None)
+        if line and 0 < line <= len(src_lines):
+            return src_lines[line - 1].strip()[:60]
+        return '<cond>'
+
+
+# -- rule: rank-dependent-collective ------------------------------------------
+
+def _loop_targets(fn):
+    """Names bound as For-loop targets inside `fn` — comparing rank
+    against one of these (``for r in range(world): if r == self.rank``)
+    is the symmetric per-peer iteration every rank runs identically,
+    not a rank gate."""
+    out = set()
+    for n in _walk_skip_defs(fn):
+        if isinstance(n, ast.For):
+            tgt = n.target
+            if isinstance(tgt, ast.Name):
+                out.add(tgt.id)
+            elif isinstance(tgt, ast.Tuple):
+                out.update(e.id for e in tgt.elts
+                           if isinstance(e, ast.Name))
+    return out
+
+
+def _compares_loop_var(test, loop_names):
+    if not isinstance(test, ast.Compare):
+        return False
+    for side in (test.left, *test.comparators):
+        if isinstance(side, ast.Name) and side.id in loop_names:
+            return True
+    return False
+
+
+@register_spmd_rule('rank-dependent-collective', HIGH)
+def check_rank_dependent_collective(ctx, findings):
+    src_lines = ctx.src.splitlines()
+    for scope in ctx.funcs:
+        fn = scope.node
+        loop_names = _loop_targets(fn)
+        for node in _walk_skip_defs(fn):
+            if not isinstance(node, ast.If):
+                continue
+            if not _is_rank_expr(node.test):
+                continue
+            if _compares_loop_var(node.test, loop_names):
+                continue    # symmetric per-peer iteration
+            body_seq = _collectives_in(node.body)
+            else_seq = _collectives_in(node.orelse)
+            cond = _cond_text(node.test, src_lines)
+            # Early-return gate: `if rank != 0: return` makes every
+            # collective after the If one-sided.
+            if _terminates(node.body) and not node.orelse:
+                end = getattr(node, 'end_lineno', node.lineno)
+                after = [(ln, lab) for (ln, lab)
+                         in _collectives_in(fn.body) if ln > end]
+                if after and not body_seq:
+                    ln, lab = after[0]
+                    findings.append(Finding(
+                        'rank-dependent-collective', HIGH,
+                        f'collective `{lab}` only reachable on ranks '
+                        f'where `{cond}` is false (guard at line '
+                        f'{node.lineno} returns early) — gated ranks '
+                        f'never issue it: deadlock hazard',
+                        file=ctx.filename, line=ln, origin='ast'))
+                    continue
+                if after and body_seq:
+                    # both paths collect — fall through to sequence
+                    # comparison below with `after` as the else path
+                    else_seq = after
+            if body_seq and not else_seq:
+                ln, lab = body_seq[0]
+                findings.append(Finding(
+                    'rank-dependent-collective', HIGH,
+                    f'collective `{lab}` reachable only when `{cond}` '
+                    f'— other ranks never issue it: deadlock hazard '
+                    f'(hoist it out of the rank guard, or use '
+                    f'broadcast_object for one-rank work)',
+                    file=ctx.filename, line=ln, origin='ast'))
+            elif else_seq and not body_seq:
+                ln, lab = else_seq[0]
+                findings.append(Finding(
+                    'rank-dependent-collective', HIGH,
+                    f'collective `{lab}` reachable only when `{cond}` '
+                    f'is false — gated ranks never issue it: deadlock '
+                    f'hazard',
+                    file=ctx.filename, line=ln, origin='ast'))
+            elif body_seq and else_seq:
+                if [l for _, l in body_seq] != [l for _, l in else_seq]:
+                    ln, lab = body_seq[0]
+                    findings.append(Finding(
+                        'rank-dependent-collective', WARN,
+                        f'branches of rank guard `{cond}` issue '
+                        f'different collective sequences '
+                        f'({[l for _, l in body_seq]} vs '
+                        f'{[l for _, l in else_seq]}) — every rank '
+                        f'must issue the same sequence',
+                        file=ctx.filename, line=ln, origin='ast'))
+
+
+# -- rule: collective-order (AST half) ----------------------------------------
+
+@register_spmd_rule('collective-order', WARN)
+def check_collective_order(ctx, findings):
+    src_lines = ctx.src.splitlines()
+    for scope in ctx.funcs:
+        for node in _walk_skip_defs(scope.node):
+            if not isinstance(node, ast.If):
+                continue
+            if _is_rank_expr(node.test):
+                continue  # rank-dependent-collective owns rank guards
+            body_seq = [l for _, l in _collectives_in(node.body)]
+            else_seq = [l for _, l in _collectives_in(node.orelse)]
+            if body_seq and else_seq and body_seq != else_seq:
+                cond = _cond_text(node.test, src_lines)
+                findings.append(Finding(
+                    'collective-order', WARN,
+                    f'branches of `{cond}` issue different collective '
+                    f'sequences ({body_seq} vs {else_seq}) — if the '
+                    f'predicate can disagree across ranks this '
+                    f'deadlocks; hoist the collectives or make the '
+                    f'predicate replicated',
+                    file=ctx.filename, line=node.lineno, origin='ast'))
+
+
+# -- rule: host-nondeterminism-into-trace -------------------------------------
+
+_TIME_FNS = frozenset({'time', 'time_ns', 'monotonic', 'monotonic_ns',
+                       'perf_counter', 'perf_counter_ns'})
+_ENTROPY_FNS = frozenset({'getpid', 'urandom', 'uuid1', 'uuid4',
+                          'gethostname', 'token_bytes', 'token_hex',
+                          'randbytes'})
+_HOST_RANDOM_FNS = frozenset({'random', 'randint', 'randrange',
+                              'uniform', 'normal', 'rand', 'randn',
+                              'choice', 'shuffle', 'sample', 'seed'})
+_TRACE_CASTS = frozenset({'asarray', 'array'})
+# Sinks whose payload every rank must agree on.  broadcast_object is
+# deliberately absent: it is the sanitizer (src rank's value wins).
+_PAYLOAD_SINKS = frozenset({'allreduce', 'allgather', 'allgather_object',
+                            'post', '_exchange'}) | DEVICE_COLLECTIVE_OPS
+
+
+def _nondet_source(node):
+    """('kind', line) when the expression reads host nondeterminism."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            name = _dotted_last(n.func)
+            base = None
+            if isinstance(n.func, ast.Attribute):
+                v = n.func.value
+                base = v.id if isinstance(v, ast.Name) else \
+                    getattr(v, 'attr', None)
+            if name in _TIME_FNS and base in ('time', None):
+                return ('time.%s()' % name, n.lineno)
+            if name in _ENTROPY_FNS:
+                return ('%s()' % name, n.lineno)
+            if name in _HOST_RANDOM_FNS and base in ('random', 'np',
+                                                     'numpy'):
+                return ('%s.%s()' % (base, name), n.lineno)
+    return None
+
+
+def _is_broadcast_call(node):
+    return (isinstance(node, ast.Call) and
+            _dotted_last(node.func) in ('broadcast_object', 'broadcast'))
+
+
+@register_spmd_rule('host-nondeterminism-into-trace', HIGH)
+def check_host_nondeterminism(ctx, findings):
+    for scope in ctx.funcs:
+        fn = scope.node
+        tainted = {}    # name -> source description
+        # seed taint from set-iteration (hash-order differs per process
+        # under per-process hash randomization)
+        for node in _walk_skip_defs(fn):
+            if isinstance(node, ast.For) and isinstance(node.target,
+                                                        ast.Name):
+                it = node.iter
+                if isinstance(it, ast.Call) and \
+                        _dotted_last(it.func) == 'set':
+                    tainted[node.target.id] = 'set(...) iteration order'
+        # fixed-point taint propagation through assignments
+        # source order approximates flow order: a later
+        # `x = broadcast_object(x)` must win over the earlier taint
+        assigns = sorted(
+            (n for n in _walk_skip_defs(fn)
+             if isinstance(n, (ast.Assign, ast.AnnAssign,
+                               ast.AugAssign))),
+            key=lambda n: n.lineno)
+        for _ in range(4):
+            changed = False
+            for a in assigns:
+                value = a.value
+                if value is None:
+                    continue
+                targets = a.targets if isinstance(a, ast.Assign) \
+                    else [a.target]
+                names = [t.id for t in targets
+                         if isinstance(t, ast.Name)]
+                if not names:
+                    continue
+                if _is_broadcast_call(value):
+                    for nm in names:        # sanitized
+                        if nm in tainted:
+                            del tainted[nm]
+                            changed = True
+                    continue
+                src = _nondet_source(value)
+                if src is None:
+                    for n in ast.walk(value):
+                        if isinstance(n, ast.Name) and n.id in tainted:
+                            src = (tainted[n.id], value.lineno)
+                            break
+                if src is not None:
+                    for nm in names:
+                        if nm not in tainted:
+                            tainted[nm] = src[0]
+                            changed = True
+            if not changed:
+                break
+        # sinks
+        for node in _walk_skip_defs(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted_last(node.func)
+            if name in _PAYLOAD_SINKS:
+                sev, what = HIGH, 'collective payload'
+            elif name in _TRACE_CASTS:
+                sev, what = WARN, 'traced value'
+            else:
+                continue
+            args = list(node.args)
+            if name in ('post', '_exchange') and len(args) >= 3:
+                args = args[2:]     # (tag, op, payload...)
+            for arg in args:
+                src = _nondet_source(arg)
+                if src is None:
+                    for n in ast.walk(arg):
+                        if isinstance(n, ast.Name) and n.id in tainted:
+                            src = (tainted[n.id], n.lineno)
+                            break
+                if src is not None:
+                    findings.append(Finding(
+                        'host-nondeterminism-into-trace', sev,
+                        f'host nondeterminism ({src[0]}) feeds a '
+                        f'{what} via `{name}` — ranks will disagree; '
+                        f'route it through broadcast_object first',
+                        file=ctx.filename, line=node.lineno,
+                        origin='ast'))
+                    break
+
+
+# -- rule: unbroadcast-rng ----------------------------------------------------
+
+@register_spmd_rule('unbroadcast-rng', WARN)
+def check_unbroadcast_rng(ctx, findings):
+    for scope in ctx.funcs:
+        fn = scope.node
+        tainted = set()
+        # source order, so a later sanitizing reassignment wins
+        for node in sorted(
+                (n for n in _walk_skip_defs(fn)
+                 if isinstance(n, ast.Assign)),
+                key=lambda n: n.lineno):
+            if node.value is not None:
+                if _is_broadcast_call(node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            tainted.discard(t.id)
+                    continue
+                if _nondet_source(node.value) is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            tainted.add(t.id)
+        for node in _walk_skip_defs(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if _dotted_last(node.func) != 'PRNGKey':
+                continue
+            for arg in node.args:
+                bad = _nondet_source(arg)
+                if bad is None:
+                    for n in ast.walk(arg):
+                        if isinstance(n, ast.Name) and n.id in tainted:
+                            bad = (n.id, n.lineno)
+                            break
+                if bad is not None:
+                    findings.append(Finding(
+                        'unbroadcast-rng', WARN,
+                        f'PRNGKey seeded from host-local entropy '
+                        f'({bad[0]}) — every rank gets a different '
+                        f'key; broadcast a base seed then '
+                        f'fold_in(rank) for per-rank streams',
+                        file=ctx.filename, line=node.lineno,
+                        origin='ast'))
+                    break
+
+
+# -- HLO half: collective-order through `conditional` -------------------------
+
+def _register_hlo_half():
+    try:
+        from .hlo import register_hlo_rule, _collective_base
+    except Exception:        # pragma: no cover - hlo always importable
+        return
+
+    def _branch_signature(module, comp_name, memo):
+        """Ordered (op, shape, group_size) collective signature of a
+        computation, recursing through calls (not fusions)."""
+        if comp_name in memo:
+            return memo[comp_name]
+        memo[comp_name] = []        # cycle guard
+        comp = module.computations.get(comp_name)
+        sig = []
+        if comp is not None:
+            for ins in comp.instrs:
+                base = _collective_base(ins.opcode)
+                if base is not None:
+                    sig.append((base, ins.type_spec or '',
+                                ins.group_size or 0))
+                elif ins.opcode in ('call', 'while', 'conditional'):
+                    for sub in ins.called:
+                        sig.extend(_branch_signature(module, sub, memo))
+        memo[comp_name] = sig
+        return sig
+
+    @register_hlo_rule('collective-order', HIGH)
+    def check_hlo_collective_order(ctx):
+        findings = []
+        module = ctx.module
+        memo = {}
+        for comp in module.computations.values():
+            for ins in comp.instrs:
+                if ins.opcode != 'conditional' or len(ins.called) < 2:
+                    continue
+                sigs = [(_branch_signature(module, b, memo), b)
+                        for b in ins.called]
+                first, first_name = sigs[0]
+                for sig, name in sigs[1:]:
+                    if sig != first:
+                        one_sided = (not sig) != (not first)
+                        sev = HIGH if one_sided else WARN
+                        findings.append(Finding(
+                            'collective-order', sev,
+                            f'conditional `{ins.name}` branches issue '
+                            f'different collective sequences: '
+                            f'`{first_name}` -> '
+                            f'{[s[0] for s in first] or "none"}, '
+                            f'`{name}` -> '
+                            f'{[s[0] for s in sig] or "none"} — all '
+                            f'paths must issue identical collectives '
+                            f'or divergent predicates deadlock',
+                            file=ins.file, line=ins.line,
+                            origin='hlo'))
+                        break
+        return findings
+
+
+_register_hlo_half()
+
+
+# -- entry points -------------------------------------------------------------
+
+def lint_spmd_source(src, filename='<string>', disable=(),
+                     apply_suppress=True):
+    """Run the SPMD rules over one source string -> [Finding]."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding('parse-error', INFO, f'could not parse: {e}',
+                        file=filename, line=getattr(e, 'lineno', None),
+                        origin='ast')]
+    ctx = _Ctx(tree, src, filename)
+    findings = []
+    for rule_id, (severity, fn) in SPMD_RULES.items():
+        if rule_id in disable:
+            continue
+        fn(ctx, findings)
+    if apply_suppress:
+        spans = _def_spans(tree)
+        findings = [
+            f for f in findings
+            if not _is_suppressed(f.rule, filename, f.line,
+                                  _enclosing_def_lines(spans, f.line))]
+    findings.sort(key=lambda f: (f.line or 0))
+    return findings
+
+
+def lint_spmd_file(path, disable=()):
+    with open(path, encoding='utf-8', errors='replace') as fh:
+        return lint_spmd_source(fh.read(), filename=path,
+                                disable=disable)
+
+
+def lint_spmd_sources(paths, disable=()):
+    """Lint every .py under `paths` -> LintReport."""
+    from .threads import _iter_py_files
+    rep = LintReport(name='spmd')
+    n_files = 0
+    for path in _iter_py_files(paths):
+        n_files += 1
+        rep.extend(lint_spmd_file(path, disable=disable))
+    rep.extras['spmd'] = {'files': n_files,
+                          'rules': sorted(SPMD_RULES)}
+    return rep

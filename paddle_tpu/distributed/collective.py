@@ -34,10 +34,13 @@ or :class:`CoordinatedAbort`, never as an infinite wait.  These are the
 collective-layer fault seams resilience.chaos injects into.
 """
 import binascii
+import collections
 import contextlib
 import json
 import os
 import pickle
+import sys
+import threading
 import time
 
 import numpy as np
@@ -55,7 +58,10 @@ __all__ = ['ReduceOp', 'Group', 'new_group', 'get_group', 'all_reduce',
            'axis_scope', 'current_axes', 'get_axis_rank', 'split_group',
            'FileKVStore', 'HostCollectives', 'CollectiveTimeout',
            'CollectivePayloadError', 'CoordinatedAbort',
-           'get_kv_client', 'set_kv_client', 'KV_ENV']
+           'get_kv_client', 'set_kv_client', 'KV_ENV',
+           'CollectiveLedger', 'get_ledger', 'reset_ledgers',
+           'diff_ledgers', 'probe_mismatch', 'ledger_enabled',
+           'LEDGER_KEY', 'LEDGER_ENV']
 
 
 class ReduceOp:
@@ -363,16 +369,36 @@ KV_ENV = 'PADDLE_TPU_KV'
 class CollectiveTimeout(TimeoutError):
     """A host collective's deadline expired with participants still
     missing.  Carries the op/tag and which ranks never showed — the
-    watchdog and the post-mortem both need rank attribution."""
+    watchdog and the post-mortem both need rank attribution.
 
-    def __init__(self, op, tag, missing, timeout):
+    When the collective ledger is on (default) it also carries
+    ``ledger_diff``: the cross-rank ring comparison at raise time.  A
+    divergent diff names the first mismatched collective and its
+    per-rank call sites (an SPMD contract violation — some rank issued
+    a different sequence); an agreeing diff means transport loss (the
+    peer recorded the same intent but its frame never arrived)."""
+
+    def __init__(self, op, tag, missing, timeout, ledger_diff=None):
         self.op = op
         self.tag = tag
         self.missing = sorted(missing)
         self.timeout = timeout
-        super().__init__(
-            f'{op}[{tag}] timed out after {timeout:.1f}s waiting for '
-            f'rank(s) {self.missing}')
+        self.ledger_diff = ledger_diff
+        msg = (f'{op}[{tag}] timed out after {timeout:.1f}s waiting '
+               f'for rank(s) {self.missing}')
+        if ledger_diff:
+            if ledger_diff.get('agree'):
+                msg += ('; collective ledgers agree — transport '
+                        'loss, not a contract divergence')
+            else:
+                sites = ledger_diff.get('sites', {})
+                per_rank = ', '.join(
+                    f'r{r}={sites[r]}' for r in sorted(sites))
+                msg += (f'; first ledger divergence @seq '
+                        f'{ledger_diff.get("seq")} '
+                        f'(op {ledger_diff.get("op")!r}, step '
+                        f'{ledger_diff.get("step")}): {per_rank}')
+        super().__init__(msg)
 
 
 class CollectivePayloadError(ValueError):
@@ -394,6 +420,212 @@ class CoordinatedAbort(RuntimeError):
     a collective.  Raised so the hung/waiting rank exits promptly and
     the elastic supervisor restarts the cluster from the last committed
     step, instead of every rank burning its own full timeout."""
+
+
+# =============================================================================
+# Collective flight recorder (the SPMD-contract runtime half)
+# =============================================================================
+#
+# Every HostCollectives op appends (seq, op, tag, shape, dtype, step,
+# call-site) to a bounded per-rank ring, and each issue republishes
+# the ring over the non-blocking stats side channel (LEDGER_KEY).  On
+# CollectiveTimeout / watchdog straggler / rank_divergence the probe
+# diffs the rings: the first seq where two ranks that BOTH recorded an
+# entry disagree on (op, tag, shape, dtype) is the first SPMD-contract
+# divergence, attributed to its per-rank call sites — instead of the
+# generic "rank N missing" timeout.  Recording reads only host
+# metadata (never the payload values), so the ledger is sync-free and
+# safe to leave on; kill switch: PADDLE_TPU_COLLECTIVE_LEDGER=0.
+
+LEDGER_KEY = 'cledger'
+LEDGER_ENV = 'PADDLE_TPU_COLLECTIVE_LEDGER'
+LEDGER_DEPTH_ENV = 'PADDLE_TPU_LEDGER_DEPTH'
+_LEDGER_DEPTH = 256
+
+
+def ledger_enabled():
+    """Collective flight recorder armed?  Default ON (ring-bounded,
+    sync-free); PADDLE_TPU_COLLECTIVE_LEDGER=0 disarms."""
+    return os.environ.get(LEDGER_ENV, '1').lower() not in (
+        '0', 'off', 'false', 'no')
+
+
+def _ledger_depth():
+    try:
+        return max(8, int(os.environ.get(LEDGER_DEPTH_ENV,
+                                         _LEDGER_DEPTH)))
+    except (TypeError, ValueError):
+        return _LEDGER_DEPTH
+
+
+def _call_site():
+    """First stack frame outside the collective/chaos layers —
+    'file.py:lineno' of the code that issued the collective."""
+    skip = ('collective.py', 'chaos.py')
+    fr = sys._getframe(1)
+    while fr is not None and \
+            os.path.basename(fr.f_code.co_filename) in skip:
+        fr = fr.f_back
+    if fr is None:
+        return None
+    return (f'{os.path.basename(fr.f_code.co_filename)}:'
+            f'{fr.f_lineno}')
+
+
+class CollectiveLedger:
+    """Bounded per-rank ring of issued collectives with a monotone
+    sequence number.  One ledger per rank per process (see
+    :func:`get_ledger`) so every transport instance of a rank shares
+    one seq stream — the cross-rank alignment key."""
+
+    def __init__(self, rank, depth=None):
+        self.rank = int(rank)
+        self.depth = int(depth) if depth else _ledger_depth()
+        self.seq = 0                # next seq to assign
+        self.step = None            # trainer step, via note_step()
+        self._ring = collections.deque(maxlen=self.depth)
+        self._lock = threading.Lock()
+
+    def note_step(self, step):
+        """Tag subsequent entries with the trainer step (host int)."""
+        try:
+            self.step = int(step)
+        except (TypeError, ValueError):
+            pass
+
+    def record(self, op, tag, shape=(), dtype='', site=None):
+        """Append one issued collective; returns the entry."""
+        entry = {'seq': None, 'op': str(op), 'tag': str(tag),
+                 'shape': [int(d) for d in tuple(shape or ())],
+                 'dtype': str(dtype), 'step': self.step,
+                 'site': site or _call_site()}
+        with self._lock:
+            entry['seq'] = self.seq
+            self.seq += 1
+            self._ring.append(entry)
+        return entry
+
+    def entries(self):
+        with self._lock:
+            return [dict(e) for e in self._ring]
+
+    def frame(self):
+        """The publishable ring document (stats side channel)."""
+        with self._lock:
+            return {'rank': self.rank, 'seq': self.seq,
+                    'depth': self.depth, 'step': self.step,
+                    'entries': [dict(e) for e in self._ring]}
+
+    def __len__(self):
+        with self._lock:
+            return len(self._ring)
+
+
+_LEDGERS = {}
+_LEDGERS_LOCK = threading.Lock()
+
+
+def get_ledger(rank, depth=None):
+    """The per-process singleton ledger for `rank` (trainer,
+    checkpoint, and worker transports of one rank share one seq
+    stream — interleaved streams would break cross-rank alignment)."""
+    with _LEDGERS_LOCK:
+        led = _LEDGERS.get(int(rank))
+        if led is None:
+            led = _LEDGERS[int(rank)] = CollectiveLedger(rank, depth)
+        return led
+
+
+def reset_ledgers():
+    """Drop every ledger (tests; a fresh incarnation starts at seq 0)."""
+    with _LEDGERS_LOCK:
+        _LEDGERS.clear()
+
+
+def _entry_sig(entry):
+    return (entry.get('op'), entry.get('tag'),
+            tuple(entry.get('shape') or ()), entry.get('dtype'))
+
+
+def diff_ledgers(frames):
+    """Cross-rank ring comparison -> first divergence, or agreement.
+
+    `frames`: {rank: ledger frame doc}.  Per-rank ring window =
+    [seq - len(entries), seq); seqs below a rank's window are unknown
+    (ring rotated out) and skip that rank; seqs at/above its head mean
+    the rank has not issued that collective yet (normal skew, not by
+    itself a divergence).  The first seq where two ranks BOTH hold an
+    entry and the (op, tag, shape, dtype) signatures differ is the
+    first contract divergence:
+
+        {'seq': s, 'op': ..., 'step': ...,
+         'ranks': [diverging ranks], 'sites': {rank: 'file.py:line'},
+         'entries': {rank: entry}}
+
+    No such seq -> {'agree': True, 'seqs': {rank: head seq}} (rings
+    consistent on their whole overlap: a stall is transport loss or
+    lag, not a contract violation).  Fewer than 2 readable frames ->
+    None (nothing to compare)."""
+    rings = {}
+    for rank, doc in (frames or {}).items():
+        if not isinstance(doc, dict):
+            continue
+        entries = doc.get('entries') or []
+        try:
+            head = int(doc.get('seq', len(entries)))
+        except (TypeError, ValueError):
+            continue
+        start = head - len(entries)
+        rings[int(rank)] = (start, head, entries)
+    if len(rings) < 2:
+        return None
+    lo = min(start for start, _, _ in rings.values())
+    hi = max(head for _, head, _ in rings.values())
+    for s in range(max(0, lo), hi):
+        present = {}
+        for rank, (start, head, entries) in rings.items():
+            if start <= s < head:
+                present[rank] = entries[s - start]
+        if len(present) < 2:
+            continue
+        sigs = {rank: _entry_sig(e) for rank, e in present.items()}
+        if len(set(sigs.values())) > 1:
+            ranks = sorted(present)
+            first = present[ranks[0]]
+            return {
+                'seq': s,
+                'op': first.get('op'),
+                'step': first.get('step'),
+                'ranks': ranks,
+                'sites': {r: present[r].get('site') for r in ranks},
+                'entries': {r: present[r] for r in ranks},
+            }
+    return {'agree': True,
+            'seqs': {r: head for r, (_, head, _) in rings.items()}}
+
+
+def probe_mismatch(transport, trigger, emit=True):
+    """Diff this rank's live ledger against every peer's published
+    ring frame; on a definite divergence emit ``collective_mismatch``
+    naming the first mismatched entry and per-rank call sites.
+    Returns the diff (or None).  Never raises, never blocks — safe
+    from the watchdog thread and from inside an exception path."""
+    try:
+        led = get_ledger(transport.rank)
+        frames = dict(transport.read_all_stats(key=LEDGER_KEY))
+        frames[transport.rank] = led.frame()
+        diff = diff_ledgers(frames)
+        if diff and not diff.get('agree') and emit:
+            from .. import telemetry
+            telemetry.event(
+                'collective_mismatch', trigger=str(trigger),
+                seq=diff['seq'], op=diff['op'], step=diff['step'],
+                ranks=diff['ranks'],
+                sites={str(r): s for r, s in diff['sites'].items()},
+                rank=transport.rank)
+        return diff
+    except Exception:
+        return None
 
 
 class FileKVStore:
@@ -661,6 +893,19 @@ class HostCollectives:
         self._history = []          # posted (tag, op) for lazy gc
         self._epoch = time.time()   # aborts older than our start are
                                     # a previous incarnation's
+        # collective flight recorder: per-rank singleton so every
+        # transport of this rank shares one seq stream
+        self._ledger = get_ledger(self.rank) if ledger_enabled() \
+            else None
+
+    def note_step(self, step):
+        """Tag subsequent ledger entries with the trainer step."""
+        if self._ledger is not None:
+            self._ledger.note_step(step)
+
+    def ledger_frame(self):
+        """This rank's live ring document, or None (ledger off)."""
+        return None if self._ledger is None else self._ledger.frame()
 
     # -- keys / abort flag ---------------------------------------------------
 
@@ -808,6 +1053,12 @@ class HostCollectives:
         quantized wire the OWN contribution also round-trips through
         its frame: every rank reduces over identical dequantized
         values, keeping results bitwise equal across the cluster."""
+        if self._ledger is not None:
+            # host metadata only (shape/dtype attrs, never values) —
+            # recording is sync-free even for device arrays
+            self._ledger.record(
+                op, tag, getattr(arr, 'shape', ()) or (),
+                getattr(arr, 'dtype', type(arr).__name__))
         if self.client is None or self.world <= 1:
             return {self.rank: np.asarray(arr)}
         t = self._effective_timeout(timeout_s)
@@ -821,6 +1072,11 @@ class HostCollectives:
             own = _frame_quant(np.asarray(arr)) if quantized \
                 else _frame(np.asarray(arr))
             self.post(tag, op, own)
+            if self._ledger is not None:
+                # republish the ring on the non-blocking stats
+                # channel BEFORE waiting: peers can diff against our
+                # intent even while we hang
+                self.post_stats(self._ledger.frame(), key=LEDGER_KEY)
             deadline = time.monotonic() + t
             out, missing = {}, []
             for r in range(self.world):
@@ -838,8 +1094,13 @@ class HostCollectives:
                     continue
                 out[r] = _unframe(payload, op, tag, r)
         if missing:
+            # ledger diff FIRST: a divergence emits the attributed
+            # collective_mismatch before the generic timeout event
+            diff = probe_mismatch(self, trigger='timeout') \
+                if self._ledger is not None else None
             self._note_timeout(op, tag, missing, t)
-            raise CollectiveTimeout(op, tag, missing, t)
+            raise CollectiveTimeout(op, tag, missing, t,
+                                    ledger_diff=diff)
         return out
 
     def _note_timeout(self, op, tag, missing, timeout):
@@ -970,18 +1231,29 @@ class HostCollectives:
 
     def broadcast_object(self, obj, src=0, tag='bc', timeout_s=None):
         """src's object on every rank."""
+        op = 'broadcast'
+        if self._ledger is not None:
+            # both roles (post and fetch) record the SAME logical
+            # entry — a broadcast is one collective, not two
+            self._ledger.record(op, tag, (), 'object')
         if self.client is None or self.world <= 1:
             return obj
         t = self._effective_timeout(timeout_s)
-        op = 'broadcast'
         if self.rank == src:
             buf = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
             self.post(tag, op, _frame(buf))
+            if self._ledger is not None:
+                self.post_stats(self._ledger.frame(), key=LEDGER_KEY)
             return obj
+        if self._ledger is not None:
+            self.post_stats(self._ledger.frame(), key=LEDGER_KEY)
         payload = self.fetch(tag, op, src, time.monotonic() + t)
         if payload is None:
+            diff = probe_mismatch(self, trigger='timeout') \
+                if self._ledger is not None else None
             self._note_timeout(op, tag, [src], t)
-            raise CollectiveTimeout(op, tag, [src], t)
+            raise CollectiveTimeout(op, tag, [src], t,
+                                    ledger_diff=diff)
         return pickle.loads(_unframe(payload, op, tag,
                                      src).tobytes())
 

@@ -108,6 +108,8 @@ class ParallelTrainer:
         self.watchdog = watchdog
         self._watchdog = None
         self._watchdog_init = False
+        self._step_ledger_init = False
+        self._step_ledger = None
         # cluster_stats: the live training-cluster observability plane
         # (telemetry.cluster).  None → PADDLE_TPU_CLUSTER_STATS
         # decides (default OFF); False hard-off; True/float arm a
@@ -930,6 +932,7 @@ class ParallelTrainer:
             # the deadline covers dispatch + (nan path) the device
             # sync — where a hung collective actually blocks the host
             wd.step_started(self._step_no + 1, first=first_call)
+        self._note_ledger_step(self._step_no + 1)
         _t0 = _time.perf_counter()
         try:
             if self.nan_guard:
@@ -1094,6 +1097,7 @@ class ParallelTrainer:
                 budget_s = head + (k - 1) * per
             wd.step_started(self._step_no + k, budget_s=budget_s,
                             first=first_call)
+        self._note_ledger_step(self._step_no + 1, k=k)
         _t0 = _time.perf_counter()
         try:
             if self.nan_guard:
@@ -1208,6 +1212,39 @@ class ParallelTrainer:
                 cal = None
             self._calibration_obj = cal
         return self._calibration_obj
+
+    def _ensure_step_ledger(self):
+        """Latch the per-rank collective ledger on first use; None
+        when off.  The per-step cost is one attribute read + a host
+        dict append (shard_map sync sites tagged by step) — no device
+        reads, no KV writes: publication rides the host collectives
+        and the watchdog heartbeat, off the step path."""
+        if self._step_ledger_init:
+            return self._step_ledger
+        self._step_ledger_init = True
+        try:
+            from ..distributed.collective import (
+                ledger_enabled, get_ledger)
+            if ledger_enabled():
+                import os as _os
+                rank = int(_os.environ.get('PADDLE_TRAINER_ID', 0)
+                           or 0)
+                self._step_ledger = get_ledger(rank)
+        except Exception:       # supervision must never kill a step
+            self._step_ledger = None
+        return self._step_ledger
+
+    def _note_ledger_step(self, step_no, k=1):
+        """Tag the ledger with the incoming step and append the
+        trainer's shard_map sync site (the compiled dispatch is where
+        in-trace collectives synchronize ranks).  Host metadata only."""
+        led = self._ensure_step_ledger()
+        if led is None:
+            return
+        led.note_step(step_no)
+        led.record('shard_map_step' if k == 1 else 'shard_map_chunk',
+                   f'step{step_no}' if k == 1
+                   else f'step{step_no}..{step_no + k - 1}')
 
     def _ensure_watchdog(self):
         """Latch the straggler/hang watchdog on first use; None when

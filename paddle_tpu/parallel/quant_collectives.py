@@ -244,7 +244,9 @@ def quantized_allreduce(x, axis, *, n, cfg, key=None, op='sum'):
     xs = jnp.pad(x.astype(jnp.float32),
                  (0, n * chunk - g)).reshape(n, chunk)
     k1, k2 = _keys(cfg, key, axis)
-    if cfg.master_accum:
+    # cfg is a replicated QuantConfig (every rank constructs the same
+    # one), so the branch predicate cannot disagree across ranks
+    if cfg.master_accum:  # tpu-lint: disable=collective-order
         # exact f32 sum of the owned chunk; only the gather quantizes
         mine = lax.psum_scatter(xs, axis, scatter_dimension=0,
                                 tiled=True).reshape(-1)

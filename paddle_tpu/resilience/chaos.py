@@ -99,6 +99,15 @@ FAULT_KINDS = (
                          # the plan supervisor must actuate on exactly
                          # once — chaos-grade drift without waiting
                          # for a real profiled collective to degrade
+    'collective_skip',   # rank silently SKIPS a matching collective
+                         # (no post, no ledger entry) and proceeds —
+                         # the SPMD-contract violation the collective
+                         # flight recorder must attribute to its call
+                         # site.  Deliberately NOT in
+                         # COLLECTIVE_FAULT_KINDS: growing that tuple
+                         # would shift plangen's seeded draw stream
+                         # and break golden-pinned plans (opt-in via
+                         # plangen.OPTIN_KINDS, the 'drift' precedent)
 ) + COLLECTIVE_FAULT_KINDS
 
 
@@ -501,6 +510,33 @@ class ChaosEngine:
             return orig_post(transport, tag, op, payload)
 
         self._patch(_coll.HostCollectives, 'post', chaotic_post)
+
+        orig_exchange = _coll.HostCollectives._exchange
+
+        def chaotic_exchange(transport, tag, op, arr, timeout_s=None,
+                             quant=None):
+            # collective_skip intercepts the WHOLE exchange (not just
+            # the post): the rank records nothing in its ledger, posts
+            # nothing, waits for nobody, and proceeds with its own
+            # contribution — the rank-gated skipped collective whose
+            # divergence the flight recorder must attribute
+            label = f'{op}:{tag}'
+            step = eng._current_step
+            for f in eng._matching(('collective_skip',), step=step,
+                                   op=label, rank=transport.rank):
+                if f.at_step is not None and f.at_step != step:
+                    continue
+                if not eng._roll(f):
+                    continue
+                eng.record(f, op=op, tag=tag, rank=transport.rank,
+                           step=step)
+                import numpy as _np
+                return {transport.rank: _np.asarray(arr)}
+            return orig_exchange(transport, tag, op, arr,
+                                 timeout_s=timeout_s, quant=quant)
+
+        self._patch(_coll.HostCollectives, '_exchange',
+                    chaotic_exchange)
 
     def deactivate(self):
         while self._saved:

@@ -45,8 +45,10 @@ GENERATABLE_KINDS = (
 # opt-in coverage-class kinds: legal() admits them but the DEFAULT
 # pool never draws them — growing GENERATABLE_KINDS would shift every
 # seeded draw stream and silently break golden-pinned plans.  'drift'
-# is the supervisor-migration class (generate_plan(supervisor=True)).
-OPTIN_KINDS = ('drift',)
+# is the supervisor-migration class (generate_plan(supervisor=True));
+# 'collective_skip' is the SPMD-contract-violation class the
+# collective flight recorder attributes (pass kinds= explicitly).
+OPTIN_KINDS = ('drift', 'collective_skip')
 
 
 def legal(fault, steps, procs, save_every=2, hang_min_s=None):
@@ -75,6 +77,12 @@ def legal(fault, steps, procs, save_every=2, hang_min_s=None):
                 and f.rank is not None and f.at_step > save_every)
     if f.kind == 'slow_rank':
         return in_range and f.at_step is not None and f.rank is not None
+    if f.kind == 'collective_skip':
+        # same wire preconditions as the COLLECTIVE_FAULT_KINDS seams
+        # plus a bounded count: an unbounded skip would re-fire on
+        # every post-restart replay and the run would never converge
+        return (procs >= 2 and f.rank is not None and in_range
+                and f.at_step is not None and f.count is not None)
     if f.kind in COLLECTIVE_FAULT_KINDS:
         # collective faults need a wire: >1 process, an addressed rank
         # (the sequence must be attributable), a step inside the range;
@@ -121,6 +129,8 @@ def _make(kind, rng, steps, procs, save_every, hang_s):
                      delay_s=round(rng.uniform(0.05, 0.3), 3))
     if kind in ('collective_drop', 'collective_corrupt'):
         return Fault(kind, at_step=step, rank=rank)
+    if kind == 'collective_skip':
+        return Fault(kind, at_step=step, rank=rank, count=1)
     if kind == 'torn_write':
         save_step = save_every * rng.randrange(
             1, max(2, steps // save_every + 1))

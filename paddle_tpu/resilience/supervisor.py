@@ -81,6 +81,11 @@ TRIGGER_POLICIES = {
     'straggler': 'exclude_rank',
     'rank_divergence': 'backoff',
     'quorum_lost': 'backoff',
+    # an attributed SPMD-contract divergence (the collective flight
+    # recorder named the first mismatched collective + call sites):
+    # like rank_divergence no sharding plan fixes it, but the incident
+    # now carries the exact call site instead of a blind loss split
+    'collective_mismatch': 'backoff',
 }
 
 _MONO = time.monotonic

@@ -414,6 +414,7 @@ class Watchdog:
             self._emit('straggler', step=step_no, rank=self.rank,
                        elapsed_s=round(elapsed, 3),
                        budget_s=round(budget, 3))
+            self._probe_ledger('straggler')
         if now > deadline:
             self._escalate('timeout', step=step_no,
                            elapsed_s=round(elapsed, 3),
@@ -433,6 +434,18 @@ class Watchdog:
                                   self.budget, 'step_source', None)})
             tr.client.key_value_set_bytes(
                 f'{tr.namespace}/hb/r{self.rank}', doc.encode('utf-8'))
+        except Exception:
+            pass
+        try:
+            # republish the collective ledger ring at heartbeat
+            # cadence: trainer-loop entries (shard_map sync sites)
+            # reach peers for cross-rank diffing even when no host
+            # collective runs to piggyback on
+            from ..distributed.collective import (
+                ledger_enabled, get_ledger, LEDGER_KEY)
+            if ledger_enabled():
+                tr.post_stats(get_ledger(self.rank).frame(),
+                              key=LEDGER_KEY)
         except Exception:
             pass
 
@@ -470,6 +483,7 @@ class Watchdog:
                 self._emit('straggler', peer=r, rank=self.rank,
                            heartbeat_age_s=round(ages[r], 3),
                            stale_after_s=self.peer_stale_s)
+                self._probe_ledger('straggler')
         self._peer_flagged -= {r for r in list(self._peer_flagged)
                                if r in ages and
                                ages[r] <= self.peer_stale_s}
@@ -485,6 +499,19 @@ class Watchdog:
         if (live + unknown) * 2 < self.world and self.world > 1:
             self._escalate('quorum_lost', live=live, stale=stale,
                            world=self.world)
+
+    def _probe_ledger(self, trigger):
+        """Diff the collective flight-recorder rings on a straggler /
+        escalation edge (rank 0 only — one attributed
+        ``collective_mismatch`` per incident, not one per rank).
+        Never raises; must never kill the watchdog thread."""
+        if self.rank != 0 or self.transport is None:
+            return None
+        try:
+            from ..distributed.collective import probe_mismatch
+            return probe_mismatch(self.transport, trigger=trigger)
+        except Exception:
+            return None
 
     # -- escalation ----------------------------------------------------------
 
@@ -502,6 +529,10 @@ class Watchdog:
             return
         self._escalated = True
         info = dict(kind=kind, rank=self.rank, name=self.name, **data)
+        # attribute BEFORE the generic escalation event: a ledger
+        # divergence turns "rank N hung" into "rank N issued a
+        # different collective at seq S (file.py:line)"
+        self._probe_ledger(kind)
         self._emit(kind, rank=self.rank, **data)
         # durable evidence BEFORE the abort: this process may be about
         # to _exit, and the flight ring holds the straggler/timeout

@@ -531,7 +531,29 @@ class ClusterAggregator:
                 m.observe_cluster(view)
             except Exception:
                 pass                    # observers never block
+        self._maybe_probe_divergence(view)
         return view
+
+    def _maybe_probe_divergence(self, view):
+        """On the rank_divergence edge, diff the collective rings
+        once (latched until the spread re-enters its band): if the
+        divergence came from a leaked/mismatched collective, the
+        ``collective_mismatch`` event names the call site.  Rank 0
+        only; never raises."""
+        div = (view or {}).get('loss_divergence') or {}
+        if not div.get('divergent'):
+            self._div_probed = False
+            return
+        if getattr(self, '_div_probed', False):
+            return
+        self._div_probed = True
+        if getattr(self.transport, 'rank', 0) != 0:
+            return
+        try:
+            from ..distributed.collective import probe_mismatch
+            probe_mismatch(self.transport, trigger='rank_divergence')
+        except Exception:
+            pass
 
     def _build_view(self):  # locked-by: _lock
         wall = _WALL()
@@ -631,6 +653,25 @@ class ClusterAggregator:
             behind_threshold=self.behind_threshold,
             hb_stale_s=self.stale_after_s)
         div = loss_divergence(per_rank, band=self.divergence_band)
+        # collective flight recorder join: per-rank ring heads + the
+        # cross-rank diff (non-blocking cledger reads; absent when the
+        # ledger is off or no rank has published a ring yet)
+        coll = None
+        try:
+            from ..distributed.collective import (
+                LEDGER_KEY, diff_ledgers)
+            led = self.transport.read_all_stats(key=LEDGER_KEY)
+            if led:
+                coll = {'ranks': {
+                    str(r): {'seq': f.get('seq'),
+                             'step': f.get('step'),
+                             'last': (f.get('entries') or [None])[-1]}
+                    for r, f in sorted(led.items())}}
+                d = diff_ledgers(led)
+                if d is not None:
+                    coll['diff'] = d
+        except Exception:
+            coll = None
         view = {
             'v': FRAME_VERSION,
             'ts': round(wall, 3),
@@ -644,6 +685,7 @@ class ClusterAggregator:
             'straggler': straggler,
             'critical_path': critical_path(per_rank),
             'loss_divergence': div,
+            'collectives': coll,
         }
         return view
 

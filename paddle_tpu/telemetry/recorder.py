@@ -170,6 +170,12 @@ EVENT_KINDS = (
                            # MemoryMonitor; latched exactly-once like
                            # slo_breach) — the supervisor re-plans on
                            # it with a tightened hbm budget
+    'collective_mismatch',  # the collective flight recorder's
+                           # cross-rank ring diff found the first
+                           # divergent collective (op/seq/step +
+                           # per-rank call sites) — the SPMD-contract
+                           # attribution behind a CollectiveTimeout,
+                           # straggler escalation, or rank_divergence
 )
 
 _WALL = time.time

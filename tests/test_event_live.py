@@ -717,7 +717,8 @@ class TestEventKindsMeta:
 
     def test_new_kinds_documented(self):
         for kind in ('serve_trace', 'slo_breach', 'drift_detected',
-                     'crash', 'straggler_suspect', 'rank_divergence'):
+                     'crash', 'straggler_suspect', 'rank_divergence',
+                     'collective_mismatch'):
             assert kind in EVENT_KINDS
 
     def test_every_kind_rendered_or_ignore_listed(self):

@@ -87,7 +87,12 @@ RESILIENCE_KINDS = (
     # memory observatory (telemetry.memory + MemoryMonitor): live
     # bytes crossed the budget watermark — the edge the supervisor
     # re-plans on with a tightened hbm_budget_gb
-    'memory_pressure')
+    'memory_pressure',
+    # collective flight recorder (distributed.collective): the first
+    # divergent collective across ranks, with trigger/op/step/ranks
+    # and per-rank call sites — the attributed refinement of a
+    # generic timeout or rank_divergence
+    'collective_mismatch')
 
 # spans (kind='span', name=...) that belong on the resilience
 # timeline: the 2-phase commit barrier wait and the restore itself
@@ -640,7 +645,7 @@ def analyze(events, sources, skew=None):
                   'trigger', 'policy', 'outcome', 'stage',
                   'triggers', 'kinds', 'from_mesh', 'to_mesh',
                   'assignment', 'candidate_s', 'incumbent_s',
-                  'margin', 'seq',
+                  'margin', 'seq', 'ranks', 'site', 'sites',
                   'observed_bytes', 'peak_bytes', 'budget_bytes',
                   'watermark', 'frac', 'source', 'hbm_budget_gb'):
             if e.get(k) is not None:

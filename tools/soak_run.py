@@ -342,6 +342,7 @@ def worker_main():
         for i in range(start, steps + 1):
             if wd is not None:
                 wd.step_started(i, first=(i == start))
+            transport.note_step(i)  # ledger entries tagged by step
             _t0 = _time.perf_counter()
             if engine is not None:
                 engine.step(i)      # may SIGKILL/SIGTERM/throttle us

@@ -13,6 +13,7 @@ deep-lints one callable's jaxpr.
     python tools/tpu_lint.py examples/ --hlo --mesh dp=8   # SPMD audit
     python tools/tpu_lint.py --plan --chips 8 [--hbm-gb 16]  # planner
     python tools/tpu_lint.py paddle_tpu/ --threads    # concurrency lint
+    python tools/tpu_lint.py paddle_tpu/ --spmd     # SPMD contract lint
 
 --threads swaps the sweep for the concurrency rules
 (paddle_tpu.analysis.threads): guarded-by (annotated shared state
@@ -22,6 +23,18 @@ daemon-thread-lifecycle (daemon threads with no stop/join path).
 Pure source analysis, same suppression grammar; the tier-1 gate
 (tests/test_analysis_threads.py) runs it over paddle_tpu/ at zero
 HIGH.
+
+--spmd swaps the sweep for the SPMD-contract rules
+(paddle_tpu.analysis.spmd): rank-dependent-collective (a collective
+reachable on only one side of a rank/process_index/env guard — the
+deadlock hazard), collective-order (branch paths must issue identical
+collective sequences; the HLO half joins hlo.collective_instrs
+through `conditional`s on every --hlo audit), host-nondeterminism-
+into-trace (time/env/host-random feeding traced values or collective
+payloads without a broadcast) and unbroadcast-rng (host-local entropy
+seeding per-rank keys).  Same suppression grammar; the tier-1 gate
+(tests/test_analysis_spmd.py) runs it over paddle_tpu/ + tools/ at
+zero HIGH.
 
 --hlo escalates to the lowered-HLO SPMD audit (paddle_tpu.analysis.hlo):
 each target step is lowered through jax.jit under a FORCED virtual
@@ -320,6 +333,12 @@ def main(argv=None):
                          'sweep: guarded-by, blocking-under-lock and '
                          'daemon-thread-lifecycle over PATHS (pure '
                          'source analysis, no imports)')
+    ap.add_argument('--spmd', action='store_true',
+                    help='SPMD contract lint instead of the host-sync '
+                         'sweep: rank-dependent-collective, '
+                         'collective-order, host-nondeterminism-into-'
+                         'trace and unbroadcast-rng over PATHS (pure '
+                         'source analysis, no imports)')
     args = ap.parse_args(argv)
 
     if not args.paths and not args.jaxpr and not args.plan:
@@ -330,6 +349,11 @@ def main(argv=None):
     if args.threads and not args.paths:
         ap.print_usage(sys.stderr)
         print('tpu_lint: --threads needs paths to sweep',
+              file=sys.stderr)
+        return 2
+    if args.spmd and not args.paths:
+        ap.print_usage(sys.stderr)
+        print('tpu_lint: --spmd needs paths to sweep',
               file=sys.stderr)
         return 2
     for p in args.paths:
@@ -355,6 +379,9 @@ def main(argv=None):
     if args.paths:
         if args.threads:
             report.extend(analysis.lint_threads_sources(
+                args.paths, disable=args.disable))
+        elif args.spmd:
+            report.extend(analysis.lint_spmd_sources(
                 args.paths, disable=args.disable))
         else:
             report.extend(analysis.lint_sources(
