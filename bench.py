@@ -1029,6 +1029,315 @@ def _supervisor_preflight(timeout_s=900):
     return ok, summary
 
 
+def _frontdoor_smoke_child():
+    """--frontdoor-smoke-child: the serving front door's acceptance
+    evidence against a REAL 2-replica fleet (subprocess workers
+    behind serving/router.py), emitted as one JSON line.
+
+    Four drills over one tiny config:
+
+    - overload: a seeded Poisson burst far above pool+queue capacity
+      must come back with TYPED rejections only — never an OOM, a
+      wedged stream, or a silently lost rid — while every admitted
+      request still finishes;
+    - clean twin: the same request shapes, gently paced, must shed
+      NOTHING and every stream must be bit-exact vs a fresh
+      single-engine run of the same rid (per-request positional key
+      discipline);
+    - replica_kill: a seeded FaultPlan SIGKILLs the serving replica
+      mid-stream (ServingFaultInjector's fleet seam); every in-flight
+      rid must land terminal with >=1 successful retry on the
+      survivor, streams still bit-exact, and the warm spare must be
+      promoted to backfill the dead replica;
+    - drain: a forced slo_breach latch on one replica must drain it
+      (fleet_event, typed 503s for new work) with ZERO dropped
+      in-flight tokens, and the fleet keeps serving through the
+      other replica.
+    """
+    import random
+    import signal as _signal
+    import tempfile
+    import threading
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(repo, 'tools'))
+    import serve_fleet
+    from paddle_tpu.resilience.chaos import (
+        Fault, FaultPlan, ServingFaultInjector)
+    from paddle_tpu.serving import Request, RejectReason
+    from paddle_tpu.serving.router import FleetFrontend
+
+    doc = {'model': 'tiny',
+           'model_kwargs': {'num_layers': 2, 'num_heads': 2,
+                            'hidden_size': 32, 'vocab_size': 128,
+                            'max_seq_len': 128},
+           'block_size': 8, 'max_slots': 4, 'decode_span': 4,
+           'num_blocks': 64, 'temperature': 0.7, 'top_k': 8,
+           'seed': 13}
+    workdir = tempfile.mkdtemp(prefix='frontdoor_smoke_')
+    config_path = os.path.join(workdir, 'serve.json')
+    with open(config_path, 'w') as f:
+        json.dump(doc, f)
+
+    rng = random.Random(20)
+    prompts = {}
+
+    def req_shape(rid):
+        if rid not in prompts:
+            prompts[rid] = ([rng.randrange(1, 120)
+                             for _ in range(rng.randrange(4, 9))],
+                            rng.randrange(6, 10))
+        return prompts[rid]
+
+    def run_many(router, rids, pace_s=0.0, on_token=None):
+        results, threads = {}, []
+
+        def one(rid):
+            prompt, n = req_shape(rid)
+            try:
+                results[rid] = router.generate(
+                    prompt, n, rid,
+                    on_token=(None if on_token is None else
+                              (lambda i, t, _r=rid:
+                               on_token(_r, i, t))))
+            except Exception as e:       # a crash IS the finding
+                results[rid] = {'state': 'crashed',
+                                'reason': repr(e)[:120]}
+        for rid in rids:
+            t = threading.Thread(target=one, args=(rid,),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+            if pace_s:
+                time.sleep(pace_s)
+            else:
+                time.sleep(rng.expovariate(1 / 0.002))
+        for t in threads:
+            t.join(timeout=120)
+        return results
+
+    def shed_total(router):
+        n = 0
+        for rep in router.replicas + router.spares:
+            if not rep.alive():
+                continue
+            try:
+                st = rep.status(timeout_s=2.0)
+            except OSError:
+                continue
+            n += sum((st.get('shed_counts') or {}).values())
+        return n
+
+    def single_engine_tokens(rids):
+        eng = serve_fleet.build_engine(doc)
+        out = {}
+        for rid in rids:
+            prompt, n = req_shape(rid)
+            r = Request(rid, prompt, max_new_tokens=n)
+            eng.submit(r)
+            eng.run()
+            out[rid] = [int(t) for t in r.tokens]
+        return out
+
+    router = serve_fleet.launch_fleet(config_path, replicas=2,
+                                      spares=1, workdir=workdir)
+    door = FleetFrontend(router).start()
+    summary = {'workdir': workdir}
+    try:
+        # -- drill 1: Poisson overload --------------------------------
+        over_rids = [f'ov-{i}' for i in range(24)]
+        res = run_many(router, over_rids)
+        states = {}
+        for r in res.values():
+            states[r['state']] = states.get(r['state'], 0) + 1
+        typed = all(r.get('reason') in RejectReason.ALL
+                    for r in res.values()
+                    if r['state'] == 'rejected')
+        summary['overload'] = {
+            'total': len(over_rids), 'states': states,
+            'sheds': shed_total(router), 'typed': typed,
+            'invariants': router.check_invariants(),
+            'replicas_alive': sum(r.alive()
+                                  for r in router.replicas)}
+
+        # -- drill 2: clean twin, bit-exact vs single engine ----------
+        shed0 = shed_total(router)
+        clean_rids = [f'cl-{i}' for i in range(4)]
+        res = run_many(router, clean_rids, pace_s=0.4)
+        want = single_engine_tokens(clean_rids)
+        summary['clean'] = {
+            'finished': sum(r['state'] == 'finished'
+                            for r in res.values()),
+            'total': len(clean_rids),
+            'sheds': shed_total(router) - shed0,
+            'bitexact': all(res[rid].get('tokens') == want[rid]
+                            for rid in clean_rids
+                            if res[rid]['state'] == 'finished'),
+            'invariants': router.check_invariants()}
+
+        # -- drill 3: seeded replica_kill mid-stream ------------------
+        plan = FaultPlan(seed=0, faults=[
+            Fault('replica_kill', after_tokens=3, count=1)])
+        inj = ServingFaultInjector(plan)
+        kill_lock = threading.Lock()
+
+        def tap(rid, i, tok):
+            with kill_lock:
+                fired = inj.fleet_faults(rid, i + 1)
+            for _f in fired:
+                entry = router.ledger.get(rid)
+                victim = router.replica(entry['replicas'][-1])
+                if victim is not None:
+                    victim.kill(_signal.SIGKILL)
+
+        kill_rids = [f'ki-{i}' for i in range(3)]
+        res = run_many(router, kill_rids, pace_s=0.05, on_token=tap)
+        want = single_engine_tokens(kill_rids)
+        summary['kill'] = {
+            'injected': list(inj.injected),
+            'finished': sum(r['state'] == 'finished'
+                            for r in res.values()),
+            'total': len(kill_rids),
+            'retried': sum(r.get('retried', 0) for r in res.values()),
+            'bitexact': all(res[rid].get('tokens') == want[rid]
+                            for rid in kill_rids
+                            if res[rid]['state'] == 'finished'),
+            'promoted': sum(1 for e in router.events
+                            if e['action'] == 'promote'),
+            'invariants': router.check_invariants()}
+
+        # -- drill 4: forced-latch drain, zero dropped in-flight ------
+        draining = [r for r in router.dispatchable()]
+        target = draining[0] if draining else None
+        drain_res = {}
+        if target is not None:
+            t = threading.Thread(
+                target=lambda: drain_res.update(one=router.generate(
+                    *req_shape('dr-0'), 'dr-0')), daemon=True)
+            # pin dispatch: every other replica momentarily excluded
+            # is overkill for a smoke — just start the stream, then
+            # latch the alert on WHICHEVER replica took it
+            t.start()
+            while 'dr-0' not in router.ledger or \
+                    not router.ledger['dr-0']['replicas']:
+                time.sleep(0.01)
+            owner = router.replica(
+                router.ledger['dr-0']['replicas'][-1])
+            owner.post_json('/admin/alert/slo_breach')
+            router.health_tick()        # must drain the owner
+            t.join(timeout=120)
+            entry = router.ledger['dr-0']
+            want = single_engine_tokens(['dr-0'])['dr-0']
+            summary['drain'] = {
+                'owner': owner.name,
+                'drained': owner.draining,
+                'state': entry['state'],
+                'bitexact': entry['tokens'] == want,
+                'still_serving': bool(router.dispatchable()),
+                'drain_events': sum(1 for e in router.events
+                                    if e['action'] == 'drain'),
+                'invariants': router.check_invariants()}
+        summary['fleet_actions'] = sorted(
+            {e['action'] for e in router.events})
+        summary['ok'] = True
+    finally:
+        try:
+            door.stop()
+            router.stop()
+        except Exception:
+            pass
+    print(json.dumps(summary))
+
+
+def _frontdoor_preflight(timeout_s=900):
+    """--frontdoor-smoke gate: the serving front door must earn chip
+    time — overload sheds TYPED (never OOM / silent loss), a clean
+    twin sheds nothing and is bit-exact vs single-engine, a
+    mid-stream replica SIGKILL leaves every in-flight rid terminal
+    with >=1 successful bit-exact retry plus a promoted warm spare,
+    and a forced-latch drain drops zero in-flight tokens.
+
+    Returns (ok, summary).  Infra failures (timeout, dead child)
+    never block the bench — evidence beats a dead gate — but any
+    violated front-door invariant always does."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    cmd = [sys.executable, os.path.abspath(__file__),
+           '--frontdoor-smoke-child']
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=env)
+        doc = _last_json_dict(proc.stdout)
+    except Exception as e:
+        log(f'frontdoor preflight skipped ({e!r})')
+        return True, {'error': repr(e)[:200]}
+    if doc is None:
+        log(f'frontdoor preflight skipped (no child output, '
+            f'rc={proc.returncode}): {proc.stderr[-300:]}')
+        return True, {'error': f'no output (rc={proc.returncode})'}
+    failures = []
+    over = doc.get('overload') or {}
+    if not over.get('sheds'):
+        failures.append('overload burst shed nothing — admission '
+                        'control never engaged')
+    if not over.get('typed'):
+        failures.append('overload produced an UNTYPED rejection')
+    if over.get('states', {}).get('crashed') \
+            or over.get('states', {}).get('failed'):
+        failures.append(f'overload lost requests untyped: '
+                        f'{over.get("states")}')
+    if over.get('replicas_alive', 0) < 2:
+        failures.append('a replica died under pure overload (OOM?)')
+    clean = doc.get('clean') or {}
+    if clean.get('sheds'):
+        failures.append(f'clean twin shed {clean["sheds"]} '
+                        'request(s)')
+    if clean.get('finished') != clean.get('total'):
+        failures.append(f'clean twin: {clean.get("finished")} of '
+                        f'{clean.get("total")} finished')
+    if not clean.get('bitexact'):
+        failures.append('clean-twin streams not bit-exact vs '
+                        'single-engine')
+    kill = doc.get('kill') or {}
+    if kill.get('finished') != kill.get('total'):
+        failures.append(f'replica_kill: {kill.get("finished")} of '
+                        f'{kill.get("total")} in-flight reached '
+                        'finished')
+    if not kill.get('retried'):
+        failures.append('replica_kill: no in-flight request was '
+                        'retried on a survivor')
+    if not kill.get('bitexact'):
+        failures.append('replica_kill: a resumed stream diverged '
+                        'from single-engine')
+    if not kill.get('promoted'):
+        failures.append('replica_kill: warm spare never promoted')
+    drain = doc.get('drain') or {}
+    if not drain.get('drained'):
+        failures.append('forced slo_breach latch did not drain the '
+                        'owning replica')
+    if drain.get('state') != 'finished' or not drain.get('bitexact'):
+        failures.append('drain dropped or corrupted the in-flight '
+                        'stream')
+    if not drain.get('still_serving'):
+        failures.append('fleet stopped serving after the drain')
+    for phase in ('overload', 'clean', 'kill', 'drain'):
+        probs = (doc.get(phase) or {}).get('invariants')
+        if probs:
+            failures.append(f'{phase}: router invariants violated: '
+                            f'{probs[:3]}')
+    summary = dict(doc, failures=failures)
+    summary.pop('workdir', None)
+    ok = not failures
+    log(f'frontdoor preflight: {"ok" if ok else "FAIL"} '
+        f'(overload {over.get("states")}, sheds={over.get("sheds")}, '
+        f'kill retried={kill.get("retried")} '
+        f'bitexact={kill.get("bitexact")}, '
+        f'drain={drain.get("state")})')
+    for f in failures:
+        log(f'  {f}')
+    return ok, summary
+
+
 def _threads_smoke_child():
     """--threads-smoke child (forced 8-device CPU mesh): the runtime
     lock checker's acceptance evidence in one process —
@@ -3166,6 +3475,22 @@ def main():
     p.add_argument('--supervisor-smoke-child', action='store_true',
                    help='(internal) run the supervisor-smoke '
                         'measurement and emit its JSON')
+    p.add_argument('--frontdoor-smoke', action='store_true',
+                   help='preflight gate: the serving front door '
+                        '(serving/frontend.py + router.py) — a real '
+                        '2-replica fleet must shed a Poisson '
+                        'overload TYPED (429/503/413, never OOM or '
+                        'silent loss), a clean twin must shed '
+                        'nothing and stream bit-exact vs '
+                        'single-engine, a seeded replica_kill '
+                        'mid-stream must leave every in-flight rid '
+                        'terminal with >=1 bit-exact retry plus a '
+                        'promoted warm spare, and a forced '
+                        'slo_breach drain must drop zero in-flight '
+                        'tokens')
+    p.add_argument('--frontdoor-smoke-child', action='store_true',
+                   help='(internal) run the frontdoor-smoke drill '
+                        'and emit its JSON')
     p.add_argument('--threads-smoke', action='store_true',
                    help='preflight gate: the concurrency posture — '
                         'the static sweep (tpu_lint --threads) over '
@@ -3227,6 +3552,10 @@ def main():
         _supervisor_smoke_child()
         return
 
+    if args.frontdoor_smoke_child:
+        _frontdoor_smoke_child()
+        return
+
     if args.threads_smoke_child:
         _threads_smoke_child()
         return
@@ -3272,6 +3601,7 @@ def main():
     mem_summary = None
     quant_summary = None
     supervisor_summary = None
+    frontdoor_summary = None
     threads_summary = None
     spmd_summary = None
     if args.threads_smoke:
@@ -3331,6 +3661,24 @@ def main():
                          'swap); fix resilience.supervisor or re-run '
                          'without --supervisor-smoke',
                 'supervisor': supervisor_summary, 'extras': {}}))
+            sys.exit(1)
+    if args.frontdoor_smoke:
+        door_ok, frontdoor_summary = _frontdoor_preflight()
+        if not door_ok:
+            # a front door that sheds untyped, loses an in-flight rid
+            # on replica death, or drops tokens across a drain will
+            # do exactly that in production overload — fail before
+            # burning chip time, with the drill as the artifact
+            print(json.dumps({
+                'metric': METRIC_NAMES['resnet'], 'value': None,
+                'unit': UNITS['resnet'], 'vs_baseline': None,
+                'error': 'frontdoor preflight failed (untyped shed, '
+                         'lost/diverged in-flight stream on '
+                         'replica_kill, missing warm-spare '
+                         'promotion, or a drain that dropped '
+                         'tokens); fix serving/frontend.py|router.py '
+                         'or re-run without --frontdoor-smoke',
+                'frontdoor': frontdoor_summary, 'extras': {}}))
             sys.exit(1)
     if args.quant_smoke:
         quant_ok, quant_summary = _quant_preflight(args.smoke)
@@ -3622,6 +3970,8 @@ def main():
         out['quant'] = quant_summary
     if supervisor_summary is not None:
         out['supervisor'] = supervisor_summary
+    if frontdoor_summary is not None:
+        out['frontdoor'] = frontdoor_summary
     if threads_summary is not None:
         out['threads'] = threads_summary
     if spmd_summary is not None:

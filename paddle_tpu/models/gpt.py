@@ -705,15 +705,17 @@ class GPTForCausalLM(nn.Layer):
         model = self
         greedy = temperature == 0 or temperature is None
 
-        def sample(logits, key):
-            if greedy:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int64)
-            lg = logits / jnp.asarray(temperature, logits.dtype)
-            if top_k is not None:
-                kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
-                lg = jnp.where(lg < kth, -1e9, lg)
-            return jax.random.categorical(key, lg, axis=-1) \
-                .astype(jnp.int64)
+        # shared key discipline (ops/sampling): the token at absolute
+        # position `pos` of row `r` is drawn with
+        # fold_in(fold_in(base, pos), r) — a pure function of (seed,
+        # position, row), NOT of the split-chain history.  The paged
+        # serving engine derives per-request keys under the same rule
+        # (row 0), which is what makes sampled engine-vs-generate
+        # parity and mid-stream retry replay bit-exact.
+        from ..ops.sampling import sample_rows as _sample_rows
+
+        def sample(logits, base, pos):
+            return _sample_rows(logits, base, pos, temperature, top_k)
 
         # scan-over-layers decode: ONE block body over stacked
         # per-layer params — ~L-times less HLO in the decode module
@@ -811,19 +813,21 @@ class GPTForCausalLM(nn.Layer):
                 logits, cache = step(state, ids,
                                      jnp.zeros((), jnp.int32),
                                      init_cache())
-                key, sk = jax.random.split(key)
-                tok = sample(jnp.take(logits, t0 - 1, axis=1), sk)  # [B]
+                # key is the per-call BASE; each sampled token derives
+                # its own key from its absolute position (t0-1 for the
+                # prefill sample, p for each scan step)
+                tok = sample(jnp.take(logits, t0 - 1, axis=1),
+                             key, t0 - 1)  # [B]
 
                 def body(carry, _):
-                    tok, p, cache, key = carry
+                    tok, p, cache = carry
                     logits, cache = decode(state, tok[:, None], p,
                                            cache)
-                    key, sk = jax.random.split(key)
-                    ntok = sample(logits[:, -1], sk)
-                    return (ntok, p + 1, cache, key), tok
+                    ntok = sample(logits[:, -1], key, p)
+                    return (ntok, p + 1, cache), tok
 
-                (last, _, _, _), toks = jax.lax.scan(
-                    body, (tok, t0, cache, key),
+                (last, _, _), toks = jax.lax.scan(
+                    body, (tok, t0, cache),
                     None, length=max_new_tokens - 1)
                 return jnp.concatenate(
                     [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
@@ -859,6 +863,12 @@ class GPTForCausalLM(nn.Layer):
             params=pspec, batch=B, prompt_bucket=P, new=max_new_tokens,
             sampling=(greedy, float(temperature or 0.0), top_k),
             scan=use_scan,
+            # sampled modules draw keys per absolute position (the
+            # ops/sampling discipline) — a pre-discipline artifact
+            # would replay the old split-chain stream, so the marker
+            # bumps SAMPLED fingerprints only (greedy HLO never reads
+            # the key; those artifacts stay valid and cache-hit)
+            **({} if greedy else {'key_discipline': 'per-pos-row'}),
             # prompt-ids aval dtype follows the x64 setting — a module
             # exported under one setting must not be handed the other
             ids_dtype=str(jnp.asarray(0, jnp.int64).dtype))

@@ -1,0 +1,100 @@
+"""Shared token-sampling discipline for dense generate AND the paged
+serving engine.
+
+The load-bearing property is the **key discipline**: which PRNG key
+samples which token.  Before PR 20 the two decode paths disagreed —
+``generate`` split a key chain per step, the serving engine folded the
+*intervention counter* into a batch-level key — so a ``temperature>0``
+stream depended on batch composition and on WHEN the scheduler ran a
+request, and could never be reproduced across engines.  That breaks
+two things the serving front door needs:
+
+* **sampled-decode parity** (ROADMAP serving remainder): the paged
+  engine must produce the identical token stream as the dense
+  ``generate`` path for the same seed;
+* **retry replay** (PR-20 router): a request whose replica dies
+  mid-stream is replayed on a survivor as ``prompt + emitted-prefix``
+  — the continued tokens must be the ones the dead replica *would*
+  have produced, or a failover silently changes user-visible output.
+
+The shared discipline makes a sampled token a pure function of
+``(request seed, absolute position)``:
+
+    token sampled from the logits at absolute position ``pos`` of the
+    row ``row`` uses ``row_key(PRNGKey(seed), pos, row)``
+    = ``fold_in(fold_in(PRNGKey(seed), pos), row)``.
+
+``generate`` shares one seed across its batch and distinguishes rows
+by index; the serving engine gives every request its OWN per-request
+key (derived from its rid — stable across replicas and retries) and
+always uses ``row=0``, which is exactly what a batch-1 ``generate``
+computes — so engine row ``i`` at position ``p`` and ``generate(seed)``
+row 0 at position ``p`` draw the SAME key and the SAME token.  Replay
+works for free: positions are absolute, so a re-prefilled
+``prompt + prefix`` continues the original key sequence exactly.
+
+Greedy (``temperature == 0``) ignores keys entirely and is unchanged.
+"""
+import jax
+import jax.numpy as jnp
+
+__all__ = ['row_key', 'sample_token', 'make_row_sampler',
+           'sample_rows']
+
+
+def row_key(base, pos, row=0):
+    """The key that samples the token drawn from the logits at
+    absolute position ``pos`` of batch row ``row``.  ``pos``/``row``
+    may be traced ints (fold_in accepts them under jit)."""
+    return jax.random.fold_in(jax.random.fold_in(base, pos), row)
+
+
+def sample_token(logits, key, temperature, top_k):
+    """Sample ONE token id from a single row of logits ``[V]``.
+
+    The single-row primitive both decode paths vmap/call — one
+    implementation, so the two paths can never drift numerically.
+    Greedy (temperature 0/None) is the argmax and ignores the key.
+    """
+    greedy = temperature == 0 or temperature is None
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int64)
+    lg = logits / jnp.asarray(temperature, logits.dtype)
+    if top_k is not None:
+        kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+        lg = jnp.where(lg < kth, -1e9, lg)
+    return jax.random.categorical(key, lg, axis=-1).astype(jnp.int64)
+
+
+def sample_rows(logits, base, pos, temperature, top_k):
+    """generate()'s batch form: every row shares ``base`` (one seed
+    per generate call) and ``pos`` (rows advance in lockstep);
+    rows are distinguished by their index.  ``logits`` is ``[B, V]``;
+    returns ``[B]`` int64."""
+    greedy = temperature == 0 or temperature is None
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int64)
+    B = logits.shape[0]
+    keys = jax.vmap(lambda r: row_key(base, pos, r))(jnp.arange(B))
+    return jax.vmap(
+        lambda lg, k: sample_token(lg, k, temperature, top_k))(
+            logits, keys)
+
+
+def make_row_sampler(temperature, top_k):
+    """The serving engine's per-request form: ``sample(logits[B, V],
+    bases[B, 2], pos[B]) -> [B]`` where every row carries its OWN base
+    key (its request's) and its OWN absolute position, and ``row=0``
+    (per-request keys already distinguish rows — and row 0 is what a
+    batch-1 generate uses, the parity contract)."""
+    greedy = temperature == 0 or temperature is None
+
+    def sample(logits, bases, pos):
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int64)
+        return jax.vmap(
+            lambda lg, b, p: sample_token(
+                lg, row_key(b, p, 0), temperature, top_k))(
+                    logits, bases, pos)
+
+    return sample

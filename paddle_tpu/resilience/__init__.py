@@ -69,7 +69,7 @@ from .shutdown import (  # noqa: F401
 from .sentinel import NanSentinel, finite_step, guard_update  # noqa: F401
 from .chaos import (  # noqa: F401
     Fault, FaultPlan, ChaosEngine, ChaosCluster, check_invariants,
-    load_run_events)
+    load_run_events, ServingFaultInjector)
 from .watchdog import (  # noqa: F401
     Watchdog, Budget, WATCHDOG_EXIT_CODE, collective_budget,
     remaining_budget, resolve_watchdog)

@@ -60,8 +60,9 @@ import random
 import signal
 import time
 
-__all__ = ['FAULT_KINDS', 'COLLECTIVE_FAULT_KINDS', 'Fault',
-           'FaultPlan', 'ChaosEngine', 'ChaosCallback', 'ChaosCluster',
+__all__ = ['FAULT_KINDS', 'COLLECTIVE_FAULT_KINDS',
+           'SERVING_FAULT_KINDS', 'Fault', 'FaultPlan', 'ChaosEngine',
+           'ChaosCallback', 'ChaosCluster', 'ServingFaultInjector',
            'check_invariants', 'plan_from_env', 'load_run_events',
            'PLAN_ENV']
 
@@ -78,6 +79,32 @@ COLLECTIVE_FAULT_KINDS = (
     'collective_drop',     # participant drops out: raise mid-collective
     'collective_corrupt',  # flip a payload byte AFTER the crc header
                            # is computed — receivers must detect it
+)
+
+# faults that land on the serving fleet (serving/router.py front
+# door): injected by the drill driver through ServingFaultInjector's
+# two seams, NOT by ChaosEngine's file/step/collective hooks — a
+# serving drill has no training step to key on, so these fire on
+# stream progress (`after_tokens`) instead of `at_step`.  Opt-in via
+# plangen.OPTIN_KINDS, same draw-stream-stability reasoning as
+# collective_skip.
+SERVING_FAULT_KINDS = (
+    'replica_kill',       # SIGKILL a fleet replica once a targeted
+                          # stream has emitted after_tokens tokens —
+                          # the router must land every in-flight rid
+                          # in a terminal state: retried bit-exact on
+                          # a survivor, or failed TYPED (never lost)
+    'replica_hang',       # SIGSTOP a replica: its streams stall past
+                          # the router's read timeout; looks like a
+                          # dead peer that still holds the port, so
+                          # detection cannot rely on process exit
+    'client_disconnect',  # drop the CLIENT connection mid-stream
+                          # after after_tokens tokens — the frontend
+                          # must evict the rid and roll its tokens
+                          # back (PR-12 preemption accounting)
+    'slow_client',        # client stops reading between events for
+                          # delay_s — backpressure must not wedge the
+                          # engine thread or starve other streams
 )
 
 FAULT_KINDS = (
@@ -108,7 +135,7 @@ FAULT_KINDS = (
                          # would shift plangen's seeded draw stream
                          # and break golden-pinned plans (opt-in via
                          # plangen.OPTIN_KINDS, the 'drift' precedent)
-) + COLLECTIVE_FAULT_KINDS
+) + COLLECTIVE_FAULT_KINDS + SERVING_FAULT_KINDS
 
 
 class Fault:
@@ -135,11 +162,18 @@ class Fault:
                 'all-reduce').
     us_ratio    observed/predicted ratio a ``drift`` fault reports
                 (default 8.0 — far outside the monitor's 4x band).
+    after_tokens  serving seams (SERVING_FAULT_KINDS): fire once the
+                targeted stream has emitted this many tokens — the
+                serving analogue of at_step (a drill has no training
+                step; stream progress is its clock).  `rank` selects
+                the replica index for replica_* kinds; `path`
+                substring-filters the rid.
     """
 
     def __init__(self, kind, at_step=None, prob=None, count=None,
                  path=None, errno_name='EIO', delay_s=0.05,
-                 rank=None, op=None, us_ratio=None):
+                 rank=None, op=None, us_ratio=None,
+                 after_tokens=None):
         if kind not in FAULT_KINDS:
             raise ValueError(f'unknown fault kind {kind!r}; '
                              f'one of {FAULT_KINDS}')
@@ -154,18 +188,22 @@ class Fault:
         self.rank = rank
         self.op = op
         self.us_ratio = us_ratio
+        self.after_tokens = None if after_tokens is None \
+            else int(after_tokens)
         self.fired = 0
 
     _FIELDS = ('kind', 'at_step', 'prob', 'count', 'path',
-               'errno_name', 'delay_s', 'rank', 'op', 'us_ratio')
+               'errno_name', 'delay_s', 'rank', 'op', 'us_ratio',
+               'after_tokens')
 
     def to_dict(self):
         d = {k: getattr(self, k) for k in self._FIELDS}
-        # us_ratio joined the schema after plans were golden-pinned:
-        # omit it when unset so every pre-existing plan's canonical
-        # JSON (and fingerprint) stays byte-identical
-        if d['us_ratio'] is None:
-            del d['us_ratio']
+        # us_ratio / after_tokens joined the schema after plans were
+        # golden-pinned: omit them when unset so every pre-existing
+        # plan's canonical JSON (and fingerprint) stays byte-identical
+        for late in ('us_ratio', 'after_tokens'):
+            if d[late] is None:
+                del d[late]
         return d
 
     @classmethod
@@ -261,6 +299,70 @@ def plan_from_env(env=PLAN_ENV):
     without code changes beyond engine.step()/poison() hooks."""
     text = os.environ.get(env)
     return FaultPlan.from_json(text) if text else None
+
+
+class ServingFaultInjector:
+    """Interprets a plan's SERVING_FAULT_KINDS at the fleet drill's
+    two seams — the serving counterpart of ChaosEngine (which patches
+    file/step/collective seams a serving drill never crosses).
+
+    The drill driver (``bench.py --frontdoor-smoke``, the frontdoor
+    tests) calls:
+
+    * :meth:`fleet_faults` from its on_token tap: replica-side kinds
+      (replica_kill / replica_hang) due at this stream offset — the
+      driver applies them with ``ReplicaHandle.kill(SIGKILL|SIGSTOP)``;
+    * :meth:`client_faults` from the client read loop:
+      client_disconnect (close the socket now) and slow_client (sleep
+      ``delay_s`` before the next read).
+
+    Faults stay declarative and seeded exactly like every other kind:
+    same plan JSON => same injected sequence, and each firing is
+    recorded so :func:`check_invariants`-style audits can line the
+    ledger up against what was actually injected.
+    """
+
+    def __init__(self, plan, telemetry=None):
+        self.plan = plan
+        self.faults = [f for f in plan.faults
+                       if f.kind in SERVING_FAULT_KINDS]
+        self.telemetry = telemetry
+        self.injected = []      # [{'fault', 'rid', 'emitted'}, ...]
+
+    def _due(self, kinds, rid, emitted, replica_index=None):
+        out = []
+        for f in self.faults:
+            if f.kind not in kinds or f._exhausted():
+                continue
+            if f.path is not None and f.path not in str(rid):
+                continue
+            if f.after_tokens is not None and emitted < f.after_tokens:
+                continue
+            if f.rank is not None and replica_index is not None \
+                    and int(f.rank) != int(replica_index):
+                continue
+            f.fired += 1
+            rec = {'fault': f.kind, 'rid': rid, 'emitted': emitted}
+            self.injected.append(rec)
+            if self.telemetry is not None:
+                self.telemetry.event('fault_injected', fault=f.kind,
+                                     rid=str(rid), emitted=emitted)
+            out.append(f)
+        return out
+
+    def fleet_faults(self, rid, emitted, replica_index=None):
+        """replica_kill / replica_hang due now for stream `rid` at
+        global offset `emitted` (replica_index = position of the
+        serving replica in the fleet's active list, matched against
+        the fault's `rank`)."""
+        return self._due(('replica_kill', 'replica_hang'), rid,
+                         emitted, replica_index)
+
+    def client_faults(self, rid, emitted):
+        """client_disconnect / slow_client due now on `rid`'s client
+        connection."""
+        return self._due(('client_disconnect', 'slow_client'), rid,
+                         emitted)
 
 
 class ChaosEngine:

@@ -28,7 +28,8 @@ import hashlib
 import json
 import random
 
-from .chaos import Fault, FaultPlan, COLLECTIVE_FAULT_KINDS
+from .chaos import (Fault, FaultPlan, COLLECTIVE_FAULT_KINDS,
+                    SERVING_FAULT_KINDS)
 
 __all__ = ['GENERATABLE_KINDS', 'OPTIN_KINDS', 'generate_plan',
            'legal', 'shrink', 'plan_fingerprint', 'emit_regression']
@@ -47,8 +48,11 @@ GENERATABLE_KINDS = (
 # seeded draw stream and silently break golden-pinned plans.  'drift'
 # is the supervisor-migration class (generate_plan(supervisor=True));
 # 'collective_skip' is the SPMD-contract-violation class the
-# collective flight recorder attributes (pass kinds= explicitly).
-OPTIN_KINDS = ('drift', 'collective_skip')
+# collective flight recorder attributes (pass kinds= explicitly);
+# the SERVING_FAULT_KINDS are the fleet-drill class (bench.py
+# --frontdoor-smoke / ServingFaultInjector) — their drills have no
+# training step, so their clock is stream progress (after_tokens).
+OPTIN_KINDS = ('drift', 'collective_skip') + SERVING_FAULT_KINDS
 
 
 def legal(fault, steps, procs, save_every=2, hang_min_s=None):
@@ -62,6 +66,17 @@ def legal(fault, steps, procs, save_every=2, hang_min_s=None):
     if f.rank is not None and not (0 <= int(f.rank) < procs):
         return False
     in_range = f.at_step is None or (2 <= f.at_step <= steps)
+    if f.kind in SERVING_FAULT_KINDS:
+        # serving faults are clocked by stream progress, not steps:
+        # need an after_tokens mark and a bounded count (an unbounded
+        # replica_kill would murder every promoted spare in turn);
+        # `procs` is the replica count for replica-addressed kinds
+        if f.after_tokens is None or f.after_tokens < 0 \
+                or f.count is None:
+            return False
+        if f.kind in ('replica_kill', 'replica_hang'):
+            return f.rank is None or 0 <= int(f.rank) < procs
+        return True
     if f.kind == 'drift':
         # the synthetic sensor edge must land on rank 0 — the plan
         # supervisor actuator subscribes to rank 0's recorder; drift

@@ -19,11 +19,13 @@ with the engine's greedy decode by test).
 """
 from .kv_cache import PagedKVCache, PagedCacheView   # noqa: F401
 from .scheduler import (                             # noqa: F401
-    ContinuousBatchingScheduler, DecodePlan, Request)
+    ContinuousBatchingScheduler, DecodePlan, Request, RejectReason,
+    RejectedRequest)
 from .loadgen import poisson_requests                # noqa: F401
 from .engine import (                                # noqa: F401
-    DecodeAuditLayer, ServeConfig, ServingEngine)
+    DecodeAuditLayer, ServeConfig, ServingEngine, request_seed)
 
 __all__ = ['PagedKVCache', 'PagedCacheView', 'Request', 'DecodePlan',
            'ContinuousBatchingScheduler', 'poisson_requests',
-           'ServeConfig', 'ServingEngine', 'DecodeAuditLayer']
+           'ServeConfig', 'ServingEngine', 'DecodeAuditLayer',
+           'RejectReason', 'RejectedRequest', 'request_seed']

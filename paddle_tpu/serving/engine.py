@@ -44,6 +44,7 @@ pinned by test and by ``bench.py --serve-smoke``.
 import json
 import math
 import time
+import zlib
 
 import numpy as np
 
@@ -51,9 +52,21 @@ from .. import nn
 from ..core import compile_cache as _cc
 from ..resilience.watchdog import resolve_watchdog
 from .kv_cache import PagedKVCache, PagedCacheView, blocks_for
-from .scheduler import ContinuousBatchingScheduler, Request
+from .scheduler import ContinuousBatchingScheduler, Request, \
+    RejectedRequest
 
-__all__ = ['ServeConfig', 'ServingEngine', 'DecodeAuditLayer']
+__all__ = ['ServeConfig', 'ServingEngine', 'DecodeAuditLayer',
+           'request_seed']
+
+
+def request_seed(rid, engine_seed):
+    """The per-request sampling base seed: a pure function of (rid,
+    engine seed), so ANY engine sharing the config seed — including a
+    surviving replica replaying a dead replica's request — derives the
+    identical seed and continues the identical token stream (the
+    ops/sampling per-position key discipline does the rest)."""
+    return (zlib.crc32(str(rid).encode()) ^ int(engine_seed)) \
+        & 0x7FFFFFFF
 
 
 def _pow2_chain(lo, hi):
@@ -330,22 +343,22 @@ class ServingEngine:
         return self.budget.request_budget_s(
             max_new_tokens, span=self.config.decode_span)
 
-    # -- sampling (mirrors generate()'s) -------------------------------------
+    # -- sampling (the shared ops/sampling discipline) -----------------------
     def _sample_fn(self):
+        """``sample(logits[B, V], seeds[B], pos[B]) -> [B]``: each row
+        draws with ``row_key(PRNGKey(seed), pos, 0)`` — the SAME key a
+        batch-1 ``generate(seed=seed)`` would use at that absolute
+        position, which is what makes sampled engine-vs-generate
+        parity and mid-stream retry replay bit-exact (greedy ignores
+        seeds/pos entirely)."""
         import jax
-        import jax.numpy as jnp
-        temperature, top_k = self.config.temperature, self.config.top_k
-        greedy = temperature == 0 or temperature is None
+        from ..ops.sampling import make_row_sampler
+        row_sample = make_row_sampler(self.config.temperature,
+                                      self.config.top_k)
 
-        def sample(logits, key):
-            if greedy:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int64)
-            lg = logits / jnp.asarray(temperature, logits.dtype)
-            if top_k is not None:
-                kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
-                lg = jnp.where(lg < kth, -1e9, lg)
-            return jax.random.categorical(key, lg, axis=-1) \
-                .astype(jnp.int64)
+        def sample(logits, seeds, pos):
+            bases = jax.vmap(jax.random.PRNGKey)(seeds)
+            return row_sample(logits, bases, pos)
 
         return sample
 
@@ -402,7 +415,7 @@ class ServingEngine:
         hd = model.config.hidden_size // nh
 
         def prefill_fn(params, buffers, ids, t0, ks, vs, block_ids,
-                       key):
+                       seeds):
             caches = model.init_decode_caches(B, Pc)
             logits, caches = model.prefill(
                 params, buffers, ids, jnp.zeros((), jnp.int32), caches)
@@ -410,7 +423,10 @@ class ServingEngine:
             rows = jnp.take_along_axis(
                 lg, (t0 - 1)[:, None, None].astype(jnp.int32),
                 axis=1)[:, 0]                      # [B, V]
-            tok = sample(rows, key)                # [B]
+            # the first token's absolute position is t0-1 — the same
+            # position generate's prefill samples at
+            tok = sample(rows, seeds,
+                         (t0 - 1).astype(jnp.int64))  # [B]
             new_ks, new_vs = [], []
             for (kbuf, vbuf), kp, vp in zip(caches, ks, vs):
                 kbuf = kbuf.value if hasattr(kbuf, 'value') else kbuf
@@ -434,17 +450,21 @@ class ServingEngine:
         """ONE source of truth for a prefill module's (fn, fp,
         example args, name, donate) — _prefill_module compiles it,
         precompile() AOT-exports it; they can never drift apart."""
-        import jax
         import jax.numpy as jnp
         fn, nblk = self._prefill_build(P, B)
+        # keys= marks the per-request-position sampling discipline:
+        # the module signature changed from one batch PRNGKey to
+        # per-row seeds, and _fingerprint does not hash example avals
+        # — without the marker a pre-discipline AOT artifact would
+        # deserialize against the new call signature
         fp = self._fingerprint('serve-prefill', bucket=P, nblk=nblk,
-                               chunk=B)
+                               chunk=B, keys='per-request-pos')
         ks, vs = (tuple(x) for x in zip(*self.cache.pools))
         example = (self._params, self._buffers,
                    jnp.zeros((B, P), jnp.int64),
                    jnp.full((B,), P, jnp.int32), ks, vs,
                    jnp.zeros((B, nblk), jnp.int32),
-                   jax.random.PRNGKey(0))
+                   jnp.zeros((B,), jnp.int64))
         return fn, fp, example, f'serve.prefill[{P}x{B}]', (4, 5)
 
     def _prefill_module(self, P, B):
@@ -467,19 +487,22 @@ class ServingEngine:
         eos = self.config.eos_id
 
         def decode_fn(params, buffers, ks, vs, tables, ctx, tok,
-                      active, limit, key):
+                      active, limit, seeds):
             ks = tuple(maybe_shard(k, POOL_SPEC) for k in ks)
             vs = tuple(maybe_shard(v, POOL_SPEC) for v in vs)
 
             def body(carry, _):
-                tok, ctx, active, ks, vs, key = carry
+                tok, ctx, active, ks, vs = carry
                 views = [PagedCacheView(ks[l], vs[l], tables, ctx,
                                         ctx + 1) for l in range(L)]
                 logits, views = model.decode_step(
                     params, buffers, tok[:, None], ctx, views)
                 lg = logits.value if hasattr(logits, 'value') else logits
-                key, sk = jax.random.split(key)
-                ntok = sample(lg[:, -1], sk)
+                # each row samples at its OWN absolute position (the
+                # input token's slot, = generate's scan carry p) with
+                # its OWN request seed — scheduling history and batch
+                # composition cannot perturb the stream
+                ntok = sample(lg[:, -1], seeds, ctx)
                 emitted_valid = active
                 ntok = jnp.where(active, ntok, tok)
                 nctx = ctx + active.astype(ctx.dtype)
@@ -488,11 +511,11 @@ class ServingEngine:
                     nactive = nactive & (ntok != eos)
                 ks = tuple(v.k_pool for v in views)
                 vs = tuple(v.v_pool for v in views)
-                return (ntok, nctx, nactive, ks, vs, key), \
+                return (ntok, nctx, nactive, ks, vs), \
                     (ntok, emitted_valid)
 
-            (tok, ctx, active, ks, vs, key), (toks, valid) = \
-                jax.lax.scan(body, (tok, ctx, active, ks, vs, key),
+            (tok, ctx, active, ks, vs), (toks, valid) = \
+                jax.lax.scan(body, (tok, ctx, active, ks, vs),
                              None, length=K)
             return toks, valid, ks, vs
 
@@ -501,10 +524,10 @@ class ServingEngine:
     def _decode_spec(self, S, K):
         """Same single-source contract as _prefill_spec, for the
         fused decode modules."""
-        import jax
         import jax.numpy as jnp
         fn = self._decode_build(S, K)
-        fp = self._fingerprint('serve-decode', batch=S, span=K)
+        fp = self._fingerprint('serve-decode', batch=S, span=K,
+                               keys='per-request-pos')
         ks, vs = (tuple(x) for x in zip(*self.cache.pools))
         W = self.scheduler.table_width
         example = (self._params, self._buffers, ks, vs,
@@ -513,7 +536,7 @@ class ServingEngine:
                    jnp.zeros((S,), jnp.int64),
                    jnp.zeros((S,), bool),
                    jnp.zeros((S,), jnp.int64),
-                   jax.random.PRNGKey(0))
+                   jnp.zeros((S,), jnp.int64))
         return fn, fp, example, f'serve.decode[{S}x{K}]', (2, 3)
 
     def _decode_module(self, S, K):
@@ -525,6 +548,7 @@ class ServingEngine:
     # -- request lifecycle ---------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, rid=None,
                arrival_t=None, deadline_s=None):
+        from .. import telemetry
         if isinstance(prompt, Request):
             req = prompt
             if req.deadline_s is None:
@@ -540,7 +564,40 @@ class ServingEngine:
                 deadline_s=(deadline_s if deadline_s is not None
                             else self.request_deadline_s(
                                 max_new_tokens)))
-        return self.scheduler.submit(req)
+        if req.seed is None:
+            # rid-derived, so the SAME request replayed on any replica
+            # sharing the config seed samples the identical stream
+            req.seed = request_seed(req.rid, self.config.seed)
+        try:
+            return self.scheduler.submit(req)
+        except RejectedRequest as e:
+            telemetry.event('serve_reject', rid=req.rid,
+                            reason=e.reason, detail=e.detail)
+            raise
+
+    def cancel(self, rid, cause='cancelled'):
+        """Evict one in-flight request (client cancel / disconnect):
+        frees its blocks, rolls its decoded-token accounting back (the
+        preemption path's discipline — a token nobody received must
+        not count as delivered throughput), and emits the usual
+        finished-request telemetry with the typed cause.  Returns True
+        if the rid was live (queued or running), False otherwise."""
+        sched = self.scheduler
+        for req in list(sched.queue):
+            if req.rid == rid:
+                sched.queue.remove(req)
+                sched.finish(req, cause)
+                self._note_finished([req], self._clock())
+                return True
+        for req in list(sched.running):
+            if req.rid == rid:
+                rolled = len(req.tokens)
+                self.decoded_tokens -= rolled
+                self._pending_discarded += rolled
+                sched.finish(req, cause)
+                self._note_finished([req], self._clock())
+                return True
+        return False
 
     def _chunk_bucket(self, n):
         return _cc.bucket_pow2(n, cap=self.config.prefill_batch)
@@ -550,7 +607,6 @@ class ServingEngine:
         admissions (async); the pools chain through donation so
         back-to-back chunks pipeline on the device.  Returns the
         un-synced first-token device array [chunk bucket]."""
-        import jax
         import jax.numpy as jnp
         P = reqs[0].prompt_bucket
         nblk = blocks_for(P, self.config.block_size)
@@ -559,17 +615,18 @@ class ServingEngine:
         ids = np.zeros((B, P), np.int64)
         t0s = np.ones((B,), np.int32)      # padding rows sample row 0
         blocks = np.zeros((B, nblk), np.int32)   # padding -> trash
+        seeds = np.zeros((B,), np.int64)
         for i, req in enumerate(reqs):
             ids[i, :req.prompt.size] = req.prompt
             t0s[i] = req.prompt.size
             blocks[i] = self.cache.owned(req.rid)[:nblk]
+            seeds[i] = req.seed or 0
         ks, vs = (tuple(x) for x in zip(*self.cache.pools))
         self._prefills += 1
-        key = jax.random.fold_in(
-            jax.random.PRNGKey(self.config.seed), self._prefills)
         tok, ks, vs = mod(self._params, self._buffers,
                           jnp.asarray(ids), jnp.asarray(t0s),
-                          ks, vs, jnp.asarray(blocks), key)
+                          ks, vs, jnp.asarray(blocks),
+                          jnp.asarray(seeds))
         self.cache.set_pools(list(zip(ks, vs)))
         now = self._clock()
         for req in reqs:
@@ -592,18 +649,14 @@ class ServingEngine:
         return req
 
     def _decode(self, plan):
-        import jax
         import jax.numpy as jnp
         mod = self._decode_module(plan.batch, plan.span)
         ks, vs = (tuple(x) for x in zip(*self.cache.pools))
-        key = jax.random.fold_in(
-            jax.random.PRNGKey(self.config.seed + 1),
-            self.interventions)
         toks, valid, ks, vs = mod(
             self._params, self._buffers, ks, vs,
             jnp.asarray(plan.tables), jnp.asarray(plan.ctx),
             jnp.asarray(plan.tok), jnp.asarray(plan.active),
-            jnp.asarray(plan.limit), key)
+            jnp.asarray(plan.limit), jnp.asarray(plan.seed))
         self.cache.set_pools(list(zip(ks, vs)))
         return toks, valid
 
@@ -858,10 +911,8 @@ class ServingEngine:
         deterministic cold-start a serving deploy pays once, after
         which run() never compiles or first-call-stalls regardless of
         which buckets the live traffic hits.  Returns stats()."""
-        import jax
         import jax.numpy as jnp
         params, buffers = self._params, self._buffers
-        key = jax.random.PRNGKey(self.config.seed)
         for P in self.config.prompt_buckets:
             nblk = blocks_for(P, self.config.block_size)
             for B in _pow2_chain(1, self.config.prefill_batch):
@@ -870,7 +921,8 @@ class ServingEngine:
                 tok, ks, vs = mod(
                     params, buffers, jnp.zeros((B, P), jnp.int64),
                     jnp.full((B,), P, jnp.int32), ks, vs,
-                    jnp.zeros((B, nblk), jnp.int32), key)
+                    jnp.zeros((B, nblk), jnp.int32),
+                    jnp.zeros((B,), jnp.int64))
                 self.cache.set_pools(list(zip(ks, vs)))
                 np.asarray(tok)
         W = self.scheduler.table_width
@@ -881,7 +933,8 @@ class ServingEngine:
                 params, buffers, ks, vs,
                 jnp.zeros((S, W), jnp.int32),
                 jnp.zeros((S,), jnp.int64), jnp.zeros((S,), jnp.int64),
-                jnp.zeros((S,), bool), jnp.zeros((S,), jnp.int64), key)
+                jnp.zeros((S,), bool), jnp.zeros((S,), jnp.int64),
+                jnp.zeros((S,), jnp.int64))
             self.cache.set_pools(list(zip(ks, vs)))
             np.asarray(toks)
         if self.live is not None:

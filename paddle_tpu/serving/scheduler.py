@@ -31,7 +31,40 @@ import numpy as np
 
 from .kv_cache import TRASH_BLOCK, blocks_for
 
-__all__ = ['Request', 'DecodePlan', 'ContinuousBatchingScheduler']
+__all__ = ['Request', 'DecodePlan', 'ContinuousBatchingScheduler',
+           'RejectReason', 'RejectedRequest']
+
+
+class RejectReason:
+    """The typed load-shedding taxonomy — ONE source of truth shared
+    by ``ServingEngine.submit`` (EXCEEDS_POOL) and the serving front
+    door (QUEUE_FULL/DRAINING), so the engine, the HTTP plane, the
+    router and run_report can never disagree on what a rejection is.
+    Each reason maps to the HTTP status the frontend returns."""
+
+    EXCEEDS_POOL = 'exceeds_pool'   # can NEVER run on this engine
+    QUEUE_FULL = 'queue_full'       # admission queue at capacity now
+    DRAINING = 'draining'           # engine draining; retry elsewhere
+
+    ALL = (EXCEEDS_POOL, QUEUE_FULL, DRAINING)
+    HTTP_STATUS = {EXCEEDS_POOL: 413, QUEUE_FULL: 429, DRAINING: 503}
+
+
+class RejectedRequest(ValueError):
+    """A typed admission refusal.  Subclasses ValueError so callers
+    that predate the taxonomy (tests, scripts catching ValueError
+    from ``submit``) keep working unchanged."""
+
+    def __init__(self, reason, detail, rid=None):
+        assert reason in RejectReason.ALL, reason
+        super().__init__(detail)
+        self.reason = reason
+        self.detail = detail
+        self.rid = rid
+
+    @property
+    def http_status(self):
+        return RejectReason.HTTP_STATUS[self.reason]
 
 
 class Request:
@@ -41,7 +74,7 @@ class Request:
         'evicted'
 
     def __init__(self, rid, prompt, max_new_tokens, *, arrival_t=0.0,
-                 deadline_s=None):
+                 deadline_s=None, seed=None):
         self.rid = rid
         self.prompt = np.asarray(prompt, np.int64).reshape(-1)
         if self.prompt.size < 1:
@@ -51,6 +84,13 @@ class Request:
             raise ValueError('max_new_tokens must be >= 1')
         self.arrival_t = float(arrival_t)
         self.deadline_s = deadline_s
+        # per-request sampling base seed (ops/sampling discipline):
+        # every token this request samples derives its key from
+        # (seed, absolute position), NOT from batch composition or
+        # scheduling history — None means the engine derives one from
+        # the rid at submit, so a replayed retry on another replica
+        # continues the identical stream
+        self.seed = None if seed is None else int(seed)
         self.state = Request.QUEUED
         self.reason = None          # eos | max_tokens | deadline | ...
         self.tokens = []            # decoded token ids (ints)
@@ -115,6 +155,7 @@ class DecodePlan:
         self.tok = np.zeros((self.batch,), np.int64)
         self.active = np.zeros((self.batch,), bool)
         self.limit = np.zeros((self.batch,), np.int64)
+        self.seed = np.zeros((self.batch,), np.int64)
 
 
 class ContinuousBatchingScheduler:
@@ -153,9 +194,11 @@ class ContinuousBatchingScheduler:
     def submit(self, req):
         total = req.prompt.size + req.max_new_tokens
         if total > self.max_model_len:
-            raise ValueError(
+            self.counters['rejected'] += 1
+            raise RejectedRequest(
+                RejectReason.EXCEEDS_POOL,
                 f'request {req.rid}: prompt+new {total} exceeds '
-                f'max_model_len {self.max_model_len}')
+                f'max_model_len {self.max_model_len}', rid=req.rid)
         # feasibility: the request's WORST-CASE block need (prefill
         # bucket or its full trajectory, whichever is larger) must fit
         # an empty pool — otherwise reservation would preempt it
@@ -164,9 +207,12 @@ class ContinuousBatchingScheduler:
         worst = blocks_for(max(int(self.bucket_fn(req.prompt.size)),
                                req.limit), self.cache.block_size)
         if worst > self.cache.num_blocks - 1:
-            raise ValueError(
+            self.counters['rejected'] += 1
+            raise RejectedRequest(
+                RejectReason.EXCEEDS_POOL,
                 f'request {req.rid}: needs {worst} KV blocks at its '
-                f'longest, pool only has {self.cache.num_blocks - 1}')
+                f'longest, pool only has {self.cache.num_blocks - 1}',
+                rid=req.rid)
         self.queue.append(req)
         self.counters['submitted'] += 1
         req.trace_note('queued', self.now_fn(),
@@ -290,6 +336,7 @@ class ContinuousBatchingScheduler:
             plan.tok[i] = req.tokens[-1]
             plan.active[i] = len(req.tokens) < req.max_new_tokens
             plan.limit[i] = req.limit
+            plan.seed[i] = req.seed or 0
         return plan
 
     def absorb(self, plan, toks, valid):

@@ -112,6 +112,18 @@ EVENT_KINDS = (
                            # serve_request by rid; telemetry.live
                            # keeps a bounded store of these for the
                            # /requests/<rid> HTTP trace view
+    'serve_reject',        # admission control refused a request
+                           # (rid, reason: queue_full/draining/
+                           # exceeds_pool, retry_after_s, detail) —
+                           # the typed load-shedding taxonomy shared
+                           # by ServingEngine.submit and the serving
+                           # front door (serving/scheduler.py
+                           # RejectReason is the one source of truth)
+    'fleet_event',         # one serving-fleet control action
+                           # (action: dispatch/retry/drain/promote/
+                           # replica_down/replica_up, replica, rid) —
+                           # serving/router.py's control-plane trail,
+                           # joinable with serve_request by rid
     'slo_breach',          # a rolling SLO monitor tripped (what:
                            # ttft_p99 over the watchdog-derived
                            # budget, or deadline-eviction rate over

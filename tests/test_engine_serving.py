@@ -676,7 +676,7 @@ class TestServingAnalysis:
                 jax.ShapeDtypeStruct((S,), jnp.int32),
                 jax.ShapeDtypeStruct((S,), jnp.bool_),
                 jax.ShapeDtypeStruct((S,), jnp.int32),
-                jax.random.PRNGKey(0))
+                jax.ShapeDtypeStruct((S,), jnp.int32))
             high = [f for f in report if f.severity == 'high']
             assert not high, (S, high)
 

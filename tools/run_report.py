@@ -113,6 +113,8 @@ RENDERED_KINDS = RESILIENCE_KINDS + (
     'plan_selected',        # plan section
     'profile_capture',      # profile section
     'serve_step', 'serve_request', 'serve_trace',  # serving section
+    'serve_reject',         # serving section: admission shed trail
+    'fleet_event',          # serving section: router control plane
     'lint_finding',         # lint section
     'span',                 # spans table + resilience span rows
     'memory_compiled',      # memory section: per-module three-way rows
@@ -510,7 +512,9 @@ def analyze(events, sources, skew=None):
     serving = None
     serve_steps = by_kind.get('serve_step', [])
     serve_reqs = by_kind.get('serve_request', [])
-    if serve_steps or serve_reqs:
+    serve_rejects = by_kind.get('serve_reject', [])
+    fleet_events = by_kind.get('fleet_event', [])
+    if serve_steps or serve_reqs or serve_rejects or fleet_events:
         ttft_ms = [r['ttft_s'] * 1000.0 for r in serve_reqs
                    if r.get('ttft_s') is not None]
         tpot_ms = [r['tpot_s'] * 1000.0 for r in serve_reqs
@@ -570,6 +574,34 @@ def analyze(events, sources, skew=None):
             'request_timeline': requests_rows,
             'traces': traces,
         }
+        # admission shed trail (serve_reject): typed refusals are a
+        # load signal, not an error — a front door that never sheds
+        # under overload is one that OOMed instead
+        if serve_rejects:
+            shed_by_reason = {}
+            for e in serve_rejects:
+                reason = e.get('reason') or '?'
+                shed_by_reason[reason] = \
+                    shed_by_reason.get(reason, 0) + 1
+            serving['rejected'] = len(serve_rejects)
+            serving['shed_by_reason'] = shed_by_reason
+        # router control plane (fleet_event): dispatch retries,
+        # drains, warm-spare promotions, replica deaths — the fleet's
+        # failure-handling story lines up against the request rows
+        if fleet_events:
+            by_action = {}
+            for e in fleet_events:
+                action = e.get('action') or '?'
+                by_action[action] = by_action.get(action, 0) + 1
+            serving['fleet'] = {
+                'events': len(fleet_events),
+                'by_action': by_action,
+                'timeline': [
+                    {k: e.get(k) for k in (
+                        'action', 'replica', 'rid', 'cause',
+                        'offset', 'rank') if e.get(k) is not None}
+                    for e in fleet_events],
+            }
 
     # -- memory: predicted vs compiled vs live ---------------------
     # One row per compiled module (newest memory_compiled wins — a
@@ -915,6 +947,22 @@ def render(report, stream=None):
               f'batch {last.get("batch")} / {last.get("queued")} '
               f'queued / {last.get("free_blocks")} of '
               f'{last.get("total_blocks")} blocks free')
+        if sv.get('rejected'):
+            sheds = ', '.join(
+                f'{r}:{n}' for r, n in
+                sorted(sv['shed_by_reason'].items()))
+            p(f'    {sv["rejected"]} shed at admission ({sheds})')
+        fleet = sv.get('fleet')
+        if fleet:
+            acts = ', '.join(f'{a}:{n}' for a, n in
+                             sorted(fleet['by_action'].items()))
+            p(f'    fleet: {fleet["events"]} control event(s) '
+              f'({acts})')
+            for e in fleet['timeline'][:8]:
+                p(f'      {e.get("action")}: '
+                  + ' '.join(f'{k}={e[k]}' for k in
+                             ('replica', 'rid', 'cause', 'offset')
+                             if e.get(k) is not None))
         for b in sv['slo_breaches']:
             p(f'    SLO BREACH: {b}')
         for d in sv['drift_detected']:
